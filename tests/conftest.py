@@ -1,0 +1,36 @@
+"""Test harness config: run everything on a virtual 8-device CPU mesh
+(SURVEY.md §4 implications: multi-host logic tested the way the reference ran
+master+slave on loopback — here via xla_force_host_platform_device_count).
+
+jax is preloaded at interpreter startup in this image (the axon TPU tunnel),
+so env vars alone are too late — jax.config.update before the first backend
+use forces the CPU platform; XLA_FLAGS is still read at backend init, giving
+us the virtual 8-device mesh and keeping the real chip free for bench runs.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_prng():
+    from veles_tpu import prng
+    prng.streams.reset()
+    yield
+    prng.streams.reset()
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(1234)

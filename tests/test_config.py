@@ -1,0 +1,74 @@
+"""Config tree + Range tuneables + PRNG streams (reference behaviors:
+veles/config.py auto-vivification/overrides; veles/genetics/config.py Range;
+veles/prng seeding)."""
+
+import numpy as np
+
+from veles_tpu import prng
+from veles_tpu.config import (Config, Range, apply_overrides,
+                              collect_tuneables)
+
+
+def test_autovivify_and_paths():
+    cfg = Config()
+    cfg.loader.minibatch_size = 60
+    assert cfg.loader.minibatch_size == 60
+    cfg.set_path("a.b.c", 3)
+    assert cfg.get_path("a.b.c") == 3
+    assert cfg.get_path("a.missing.x", "dflt") == "dflt"
+    assert "b" in cfg.a
+
+
+def test_update_deep_merge():
+    cfg = Config()
+    cfg.update({"x": {"y": 1, "z": 2}})
+    cfg.update({"x": {"y": 10}})
+    assert cfg.x.y == 10 and cfg.x.z == 2
+    d = cfg.to_dict()
+    assert d == {"x": {"y": 10, "z": 2}}
+
+
+def test_overrides_json_parsing():
+    cfg = Config()
+    apply_overrides(cfg, ["lr=0.5", "name=hello", "flags=[1,2]"])
+    assert cfg.lr == 0.5
+    assert cfg.name == "hello"
+    assert cfg.flags == [1, 2]
+
+
+def test_range_tuneables():
+    cfg = Config()
+    cfg.opt.lr = Range(0.01, 0.001, 0.1)
+    cfg.model.act = Range.choice("relu", ["relu", "tanh"])
+    tune = collect_tuneables(cfg)
+    assert set(tune) == {"opt.lr", "model.act"}
+    # value() unwraps
+    assert cfg.opt.value("lr") == 0.01
+    assert tune["opt.lr"].clip(5.0) == 0.1
+    assert tune["model.act"].clip("bogus") == "relu"
+
+
+def test_prng_streams_deterministic():
+    s1 = prng.get("loader")
+    p1 = s1.permutation(10)
+    prng.streams.reset()
+    s2 = prng.get("loader")
+    p2 = s2.permutation(10)
+    np.testing.assert_array_equal(p1, p2)
+    # distinct names -> distinct streams
+    assert prng.get("a").seed != prng.get("b").seed
+
+
+def test_prng_state_roundtrip():
+    s = prng.get("x")
+    s.permutation(5)
+    k1 = s.next_key()
+    st = prng.streams.state()
+    # advance
+    s.permutation(7)
+    s.next_key()
+    prng.streams.set_state(st)
+    s2 = prng.get("x")
+    p_after = s2.permutation(7)
+    prng.streams.set_state(st)
+    np.testing.assert_array_equal(p_after, prng.get("x").permutation(7))

@@ -1,0 +1,131 @@
+"""Regression tests for the round-1 code-review findings."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import veles_tpu as vt
+from veles_tpu import ops
+from veles_tpu.loader.base import TRAIN, VALID
+from veles_tpu.ops import optimizers as opt
+from veles_tpu.units import (All2AllSoftmax, All2AllTanh, EvaluatorSoftmax,
+                             Spec, Workflow)
+
+
+def _fc_wf(dim=8, n_classes=3):
+    wf = Workflow("fc")
+    wf.add(All2AllTanh(16, name="fc1"))
+    wf.add(All2AllSoftmax(n_classes, name="out", inputs=("fc1",)))
+    wf.add(EvaluatorSoftmax(name="ev", inputs=("out", "@labels", "@mask")))
+    return wf
+
+
+def test_predict_without_labels():
+    """Inference must not require @labels/@mask (evaluator pruned)."""
+    wf = _fc_wf()
+    wf.build({"@input": Spec((4, 8), jnp.float32),
+              "@labels": Spec((4,), jnp.int32),
+              "@mask": Spec((4,), jnp.float32)})
+    o = opt.SGD(0.1)
+    wstate = wf.init_state(jax.random.key(0), o)
+    predict = wf.make_predict_step()
+    y = predict(wstate, {"@input": jnp.ones((4, 8))})
+    assert y.shape == (4, 3)
+
+
+def test_plain_sgd_snapshot_roundtrip(tmp_path):
+    """Empty-tuple optimizer slots must survive save/load."""
+    wf = _fc_wf()
+    wf.build({"@input": Spec((4, 8), jnp.float32),
+              "@labels": Spec((4,), jnp.int32),
+              "@mask": Spec((4,), jnp.float32)})
+    o = opt.SGD(0.1)  # momentum=0 -> slots are ()
+    wstate = wf.init_state(jax.random.key(0), o)
+    snap = vt.Snapshotter("t", str(tmp_path))
+    p = snap.save("s", {"wstate": wstate})
+    payload = vt.Snapshotter.load(p)
+    restored = vt.Snapshotter.restore_wstate(payload, like=wstate)
+    np.testing.assert_allclose(
+        np.asarray(restored["params"]["fc1"]["w"]),
+        np.asarray(wstate["params"]["fc1"]["w"]), rtol=1e-7)
+    assert restored["opt_state"]["fc1"]["w"] == ()
+
+
+def test_init_state_without_optimizer_then_train():
+    """Docstring path: init_state(key) then make_train_step must work."""
+    wf = _fc_wf()
+    wf.build({"@input": Spec((4, 8), jnp.float32),
+              "@labels": Spec((4,), jnp.int32),
+              "@mask": Spec((4,), jnp.float32)})
+    wstate = wf.init_state(jax.random.key(0))  # no optimizer
+    train = wf.make_train_step(opt.SGD(0.1, momentum=0.9))
+    batch = {"@input": jnp.ones((4, 8)),
+             "@labels": jnp.zeros((4,), jnp.int32),
+             "@mask": jnp.ones((4,))}
+    wstate2, mets = train(wstate, batch)
+    assert "loss" in mets
+
+
+def test_per_unit_momentum_with_global_zero():
+    params = {"a": {"w": jnp.asarray([1.0])}}
+    grads = {"a": {"w": jnp.asarray([1.0])}}
+    o = opt.SGD(0.1, per_unit={"a": opt.HyperParams(momentum=0.9)})
+    st = o.init(params)
+    p1, st = o.update(grads, st, params, 0)
+    p2, st = o.update(grads, st, p1, 1)
+    # with momentum: second step delta = lr*(0.9*1 + 1) = 0.19
+    np.testing.assert_allclose(float(p2["a"]["w"][0]),
+                               1.0 - 0.1 - 0.19, rtol=1e-6)
+
+
+def test_per_unit_l2_zero_override():
+    params = {"a": {"w": jnp.asarray([1.0])}, "b": {"w": jnp.asarray([1.0])}}
+    grads = {"a": {"w": jnp.asarray([0.0])}, "b": {"w": jnp.asarray([0.0])}}
+    o = opt.SGD(0.1, l2=0.5, per_unit={"b": opt.HyperParams(l2=0.0)})
+    st = o.init(params)
+    p, _ = o.update(grads, st, params, 0)
+    assert float(p["a"]["w"][0]) < 1.0      # decayed
+    assert float(p["b"]["w"][0]) == 1.0     # override disables decay
+
+
+def test_per_unit_clip_norm():
+    params = {"a": {"w": jnp.asarray([1.0])}}
+    grads = {"a": {"w": jnp.asarray([100.0])}}
+    o = opt.SGD(1.0, per_unit={"a": opt.HyperParams(clip_norm=1.0)})
+    st = o.init(params)
+    p, _ = o.update(grads, st, params, 0)
+    np.testing.assert_allclose(float(p["a"]["w"][0]), 0.0, atol=1e-5)
+
+
+def test_odd_size_pooling_argmax():
+    x = np.random.default_rng(0).standard_normal((1, 5, 5, 1)) \
+        .astype(np.float32)
+    pooled, switches = ops.max_pool_with_argmax(x, 2)
+    assert pooled.shape == (1, 2, 2, 1)
+    assert switches.shape == x.shape
+    up = ops.max_unpool(pooled, switches, 2)
+    np.testing.assert_allclose(float(np.asarray(up).sum()),
+                               float(np.asarray(pooled).sum()), rtol=1e-5)
+
+
+def test_deconv_f32_accum_dtype(rng):
+    x = rng.standard_normal((1, 4, 4, 2)).astype(np.float32)
+    w = rng.standard_normal((2, 2, 2, 3)).astype(np.float32)
+    y = ops.deconv2d(x, w, compute_dtype=jnp.bfloat16)
+    assert y.dtype == jnp.float32
+
+
+def test_rollback_uses_live_buffers(rng):
+    """Rollback after donation must not reference deleted arrays."""
+    centers = np.random.default_rng(7).standard_normal((3, 8)) * 3
+    lab = rng.integers(0, 3, 96).astype(np.int32)
+    d = (centers[lab] + rng.standard_normal((96, 8))).astype(np.float32)
+    loader = vt.ArrayLoader({TRAIN: d, VALID: d[:32]},
+                            {TRAIN: lab, VALID: lab[:32]}, minibatch_size=32)
+    wf = _fc_wf()
+    dec = vt.Decision(max_epochs=6, fail_iterations=10, rollback_after=1)
+    tr = vt.Trainer(wf, loader, opt.SGD(0.05, momentum=0.9), dec)
+    tr.initialize(seed=0)
+    tr.run()  # would raise "Array has been deleted" on alias bug
+    assert tr.wstate is not None

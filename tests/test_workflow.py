@@ -1,0 +1,197 @@
+"""Workflow core: wiring checks, topo order, compiled steps, end-to-end
+training on a learnable synthetic task, checkpoint resume.
+
+Reference test analog: veles/tests/test_workflow.py (pickle roundtrip,
+restored-from-snapshot semantics) + the MNIST-slice accuracy gate of
+SURVEY.md §7 phase 4 (synthetic stand-in: datasets are not downloadable in
+this environment; MnistLoader plugs in real files when present).
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import veles_tpu as vt
+from veles_tpu.loader.base import TRAIN, VALID
+from veles_tpu.units import (All2AllSoftmax, All2AllTanh, EvaluatorSoftmax,
+                             InputJoiner, Spec, TrivialUnit, Workflow)
+from veles_tpu.units.workflow import WorkflowError
+
+
+def make_blobs(rng, n, n_classes=4, dim=16, spread=3.0, centers=None):
+    if centers is None:
+        centers = np.random.default_rng(7).standard_normal(
+            (n_classes, dim)) * spread
+    labels = rng.integers(0, n_classes, n)
+    data = centers[labels] + rng.standard_normal((n, dim))
+    return data.astype(np.float32), labels.astype(np.int32)
+
+
+def build_fc_workflow(dim=16, n_classes=4):
+    wf = Workflow("fc")
+    wf.add(All2AllTanh(32, name="fc1", inputs=("@input",)))
+    wf.add(All2AllSoftmax(n_classes, name="out", inputs=("fc1",)))
+    wf.add(EvaluatorSoftmax(name="ev", inputs=("out", "@labels", "@mask")))
+    return wf
+
+
+def make_loader(rng, n_train=512, n_valid=128, dim=16, mb=64):
+    data_t, lab_t = make_blobs(rng, n_train, dim=dim)
+    data_v, lab_v = make_blobs(rng, n_valid, dim=dim)
+    return vt.ArrayLoader({TRAIN: data_t, VALID: data_v},
+                          {TRAIN: lab_t, VALID: lab_v},
+                          minibatch_size=mb)
+
+
+def test_topo_and_cycle_detection():
+    wf = Workflow("t")
+    a = TrivialUnit(name="a", inputs=("b",))
+    b = TrivialUnit(name="b", inputs=("a",))
+    wf.add(a)
+    wf.add(b)
+    with pytest.raises(WorkflowError, match="cycle"):
+        wf.topo_order()
+
+
+def test_unknown_source_rejected():
+    wf = Workflow("t")
+    wf.add(TrivialUnit(name="a", inputs=("nope",)))
+    with pytest.raises(WorkflowError, match="unknown source"):
+        wf.topo_order()
+
+
+def test_build_checks_batch_keys():
+    wf = build_fc_workflow()
+    with pytest.raises(WorkflowError, match="@labels"):
+        wf.build({"@input": Spec((8, 16), jnp.float32)})
+
+
+def test_checksum_stable_and_sensitive():
+    wf1, wf2 = build_fc_workflow(), build_fc_workflow()
+    assert wf1.checksum() == wf2.checksum()
+    wf2.add(TrivialUnit(name="extra", inputs=("out",)))
+    assert wf1.checksum() != wf2.checksum()
+
+
+def test_graph_dot():
+    dot = build_fc_workflow().generate_graph()
+    assert "digraph" in dot and '"fc1" -> "out"' in dot
+
+
+def test_input_joiner():
+    wf = Workflow("j")
+    wf.add(TrivialUnit(name="a"))
+    wf.add(TrivialUnit(name="b"))
+    wf.add(InputJoiner(name="join", inputs=("a", "b")))
+    specs = wf.build({"@input": Spec((4, 3), jnp.float32)})
+    assert specs["join"].shape == (4, 6)
+
+
+def test_end_to_end_training_converges(rng):
+    """The round-1 accuracy gate on a synthetic separable task: the full
+    loader→forward→evaluator→optimizer→decision loop must reach <5% valid
+    error (linearly-separable blobs)."""
+    loader = make_loader(rng)
+    wf = build_fc_workflow()
+    trainer = vt.Trainer(
+        wf, loader, vt.optimizers.SGD(0.05, momentum=0.9),
+        vt.Decision(max_epochs=15, fail_iterations=15))
+    trainer.initialize(seed=0)
+    results = trainer.run()
+    best = trainer.decision.best_value
+    assert best < 5.0, f"validation error {best}% too high"
+    assert results["train_samples_per_s"] > 0
+
+
+def test_eval_metrics_exact_with_padding(rng):
+    # 100 valid samples with minibatch 64 -> one padded batch; n_samples
+    # must still count exactly 100.
+    data_v, lab_v = make_blobs(rng, 100)
+    data_t, lab_t = make_blobs(rng, 128)
+    loader = vt.ArrayLoader({TRAIN: data_t, VALID: data_v},
+                            {TRAIN: lab_t, VALID: lab_v}, minibatch_size=64)
+    wf = build_fc_workflow()
+    trainer = vt.Trainer(wf, loader, vt.optimizers.SGD(0.01),
+                         vt.Decision(max_epochs=1))
+    trainer.initialize(seed=0)
+    mets = trainer._run_epoch_eval(VALID, 0)
+    assert mets["n_samples"] == 100.0
+
+
+def test_snapshot_resume(rng, tmp_path):
+    loader = make_loader(rng)
+    wf = build_fc_workflow()
+    snap = vt.Snapshotter("fc", str(tmp_path), interval=1)
+    trainer = vt.Trainer(wf, loader, vt.optimizers.SGD(0.05, momentum=0.9),
+                         vt.Decision(max_epochs=3), snapshotter=snap)
+    trainer.initialize(seed=0)
+    trainer.run()
+    assert snap.last_path is not None
+
+    # Fresh trainer restores and continues.
+    loader2 = make_loader(np.random.default_rng(1234))
+    wf2 = build_fc_workflow()
+    trainer2 = vt.Trainer(wf2, loader2,
+                          vt.optimizers.SGD(0.05, momentum=0.9),
+                          vt.Decision(max_epochs=6))
+    trainer2.initialize(seed=1)
+    trainer2.restore(snap.last_path)
+    # params restored identically
+    w_orig = np.asarray(trainer.wstate["params"]["fc1"]["w"])
+    w_rest = np.asarray(trainer2.wstate["params"]["fc1"]["w"])
+    np.testing.assert_allclose(w_orig, w_rest, rtol=1e-6)
+    assert trainer2.loader.epoch_number == trainer.loader.epoch_number
+    trainer2.run()
+    assert trainer2.decision.best_value <= trainer.decision.best_value + 1.0
+
+
+def test_fullbatch_loader_on_device_gather(rng):
+    data_t, lab_t = make_blobs(rng, 256)
+    loader = vt.FullBatchLoader({TRAIN: data_t}, {TRAIN: lab_t},
+                                minibatch_size=64)
+    loader.initialize()
+    assert loader.on_device
+    batch = next(loader.iter_epoch(TRAIN))
+    assert isinstance(batch["@input"], jax.Array)
+    assert batch["@input"].shape == (64, 16)
+    # same permutation as host-side accounting
+    perm = loader.epoch_permutation(TRAIN, 0)[:64]
+    np.testing.assert_allclose(np.asarray(batch["@input"]), data_t[perm],
+                               rtol=1e-6)
+
+
+def test_loader_epoch_accounting(rng):
+    """Each sample served exactly once per epoch (reference:
+    veles/loader/base.py:880-898 effective_total_samples semantics)."""
+    loader = make_loader(rng, n_train=130, mb=32)
+    loader.initialize()
+    served = []
+    for batch in loader.iter_epoch(TRAIN, 0):
+        m = batch["@mask"].astype(bool)
+        served.extend(np.asarray(batch["@labels"])[m].tolist())
+    assert len(served) == 130
+    # sharded: two shards partition the epoch
+    l2 = make_loader(rng, n_train=130, mb=32)
+    l2.shard_count, l2.shard_index = 2, 0
+    l3 = make_loader(rng, n_train=130, mb=32)
+    l3.shard_count, l3.shard_index = 2, 1
+    l2.initialize(), l3.initialize()
+    n2 = sum(int(b["@mask"].sum()) for b in l2.iter_epoch(TRAIN, 0))
+    n3 = sum(int(b["@mask"].sum()) for b in l3.iter_epoch(TRAIN, 0))
+    assert n2 + n3 == 130
+
+
+def test_dropout_train_vs_eval(rng):
+    from veles_tpu.units import Dropout
+    from veles_tpu.units.base import Context
+    d = Dropout(0.5, name="drop")
+    x = jnp.ones((4, 100))
+    ctx_t = Context(train=True, key=jax.random.key(0))
+    y, _ = d.apply({}, {}, [x], ctx_t)
+    assert 0.2 < float((np.asarray(y) == 0).mean()) < 0.8
+    ctx_e = Context(train=False, key=None)
+    y2, _ = d.apply({}, {}, [x], ctx_e)
+    np.testing.assert_array_equal(np.asarray(y2), np.asarray(x))

@@ -1,0 +1,39 @@
+"""veles_tpu — a TPU-native deep-learning framework with the capabilities of
+Samsung Veles (reference: /root/reference, mohnkhan/veles v0.9.2).
+
+Not a port: the reference's thread-pool dataflow scheduler, OpenCL/CUDA
+kernel JIT, mirrored host/device Arrays, and ZeroMQ master–slave data
+parallelism (SURVEY.md §1) are re-designed as a functional SPMD framework:
+
+* units are pure init/apply functions over pytrees (veles_tpu.units),
+* a Workflow compiles the unit DAG into jitted train/eval XLA programs,
+* ops target the MXU via jnp/lax, with Pallas kernels for fused hot paths,
+* distribution is a jax.sharding Mesh + collectives over ICI/DCN
+  (veles_tpu.parallel) instead of Twisted/ZMQ,
+* checkpoints are explicit state pytrees (veles_tpu.runtime.Snapshotter).
+
+Quick start::
+
+    import veles_tpu as vt
+    wf = vt.Workflow("mnist")
+    wf.add(vt.units.All2AllTanh(100, name="fc1"))
+    wf.add(vt.units.All2AllSoftmax(10, name="out", inputs=("fc1",)))
+    wf.add(vt.units.EvaluatorSoftmax(name="ev",
+                                     inputs=("out", "@labels", "@mask")))
+    trainer = vt.Trainer(wf, loader, vt.optimizers.SGD(0.1, momentum=0.9),
+                         vt.Decision(max_epochs=10))
+    results = trainer.run()
+"""
+
+__version__ = "0.1.0"
+
+from . import config, logger, normalization, ops, prng
+from .config import Config, Range, root
+from .logger import Logger, setup_logging
+from . import units
+from .units import Spec, Unit, Workflow
+from .ops import optimizers
+from . import loader
+from .loader import ArrayLoader, FullBatchLoader, Loader
+from . import runtime
+from .runtime import Decision, Snapshotter, Trainer

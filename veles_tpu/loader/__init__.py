@@ -1,0 +1,3 @@
+from .base import (TEST, VALID, TRAIN, CLASS_NAMES, Loader, ArrayLoader,
+                   LoaderError)
+from .fullbatch import FullBatchLoader

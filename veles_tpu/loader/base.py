@@ -1,0 +1,221 @@
+"""Loader base: the minibatch-serving contract.
+
+TPU-native re-design of the reference Loader (reference:
+veles/loader/base.py:100,120 — three sample classes test/valid/train :72-80,
+per-epoch shuffling :711-724, epoch/last-minibatch flags :862-878, label
+mapping + distribution analysis :925-1018, normalization analysis pass
+:755-803, failed/pending minibatch tracking for slave dropout :679-687,
+master-slave protocol shipping only indices :631-663).
+
+Key redesigns for SPMD/XLA:
+
+* **Static shapes.** XLA compiles per shape; the reference's variable last
+  minibatch becomes a fixed-size batch padded with a ``@mask`` array the
+  evaluators consume — metrics stay exact while every step hits the same
+  compiled program.
+* **Deterministic sharded epochs.** Instead of a master shipping indices to
+  slaves (and requeueing failed minibatches), each epoch is a deterministic
+  permutation derived from (seed, epoch); under data parallelism each host
+  slices its own shard of the permutation — same accounting, no protocol
+  (SURVEY.md §7 "hard parts": loader statefulness vs SPMD).
+* **Checkpointable.** ``state()``/``set_state()`` capture epoch, position and
+  PRNG state so resume continues the exact data order (reference restored
+  loader counters via pickle).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import prng
+from ..logger import Logger
+
+# Reference class order (veles/loader/base.py:72-80).
+TEST, VALID, TRAIN = 0, 1, 2
+CLASS_NAMES = ("test", "validation", "train")
+
+
+class LoaderError(Exception):
+    pass
+
+
+class Loader(Logger):
+    """Abstract minibatch server.
+
+    Subclasses implement :meth:`load_data` (fill ``class_lengths``) and
+    :meth:`fill_minibatch` (produce arrays for given global sample indices).
+    """
+
+    def __init__(self, minibatch_size: int = 100, *,
+                 shuffle_limit: float = np.inf,
+                 prng_name: str = "loader",
+                 shard_index: int = 0, shard_count: int = 1):
+        self.minibatch_size = int(minibatch_size)
+        self.class_lengths: List[int] = [0, 0, 0]
+        self.shuffle_limit = shuffle_limit  # epochs after which shuffling stops
+        self.epoch_number = 0
+        self.prng_name = prng_name
+        self.shard_index = int(shard_index)
+        self.shard_count = int(shard_count)
+        self.normalizer = None
+        self._loaded = False
+
+    # -- subclass contract -------------------------------------------------
+    def load_data(self) -> None:
+        """Populate class_lengths (and any dataset storage)."""
+        raise NotImplementedError
+
+    def fill_minibatch(self, indices: np.ndarray, klass: int
+                       ) -> Dict[str, np.ndarray]:
+        """Return batch arrays for the given within-class sample indices.
+        Keys are workflow input names ("@input", "@labels", "@targets")."""
+        raise NotImplementedError
+
+    # -- lifecycle ---------------------------------------------------------
+    def initialize(self) -> None:
+        if self._loaded:
+            return
+        self.load_data()
+        self._loaded = True
+        if sum(self.class_lengths) == 0:
+            raise LoaderError("loader has no samples")
+        self.info("dataset: test=%d valid=%d train=%d, minibatch=%d",
+                  *self.class_lengths, self.minibatch_size)
+
+    @property
+    def total_samples(self) -> int:
+        return sum(self.class_lengths)
+
+    def class_offset(self, klass: int) -> int:
+        return sum(self.class_lengths[:klass])
+
+    # -- epoch iteration ---------------------------------------------------
+    def epoch_permutation(self, klass: int,
+                          epoch: Optional[int] = None) -> np.ndarray:
+        """Deterministic permutation for (class, epoch). Train shuffles per
+        epoch (until shuffle_limit); valid/test are served in order
+        (reference: veles/loader/base.py:711-724)."""
+        n = self.class_lengths[klass]
+        if epoch is None:
+            epoch = self.epoch_number
+        if klass != TRAIN or epoch >= self.shuffle_limit:
+            return np.arange(n)
+        seed_stream = prng.get(self.prng_name)
+        rng = np.random.Generator(
+            np.random.PCG64([seed_stream.seed, epoch, klass]))
+        return rng.permutation(n)
+
+    def n_minibatches(self, klass: int) -> int:
+        n = self.class_lengths[klass]
+        if self.shard_count > 1:
+            n = -(-n // self.shard_count)
+        return -(-n // self.minibatch_size) if n else 0
+
+    def iter_epoch(self, klass: int, epoch: Optional[int] = None
+                   ) -> Iterator[Dict[str, np.ndarray]]:
+        """Yield fixed-size padded batches with '@mask'. Under sharding, this
+        host sees a strided slice of the permutation (reference analog: the
+        master shipped index subsets to each slave)."""
+        perm = self.epoch_permutation(klass, epoch)
+        if self.shard_count > 1:
+            perm = perm[self.shard_index::self.shard_count]
+        bs = self.minibatch_size
+        for i in range(0, len(perm), bs):
+            chunk = perm[i:i + bs]
+            yield self.make_batch(chunk, klass)
+
+    def make_batch(self, chunk: np.ndarray, klass: int
+                   ) -> Dict[str, np.ndarray]:
+        bs = self.minibatch_size
+        valid_n = len(chunk)
+        if valid_n < bs:  # pad by repeating index 0; mask zeroes them out
+            pad = np.zeros(bs - valid_n, dtype=chunk.dtype)
+            chunk = np.concatenate([chunk, pad])
+        batch = self.fill_minibatch(chunk, klass)
+        mask = np.zeros(bs, np.float32)
+        mask[:valid_n] = 1.0
+        batch["@mask"] = mask
+        return batch
+
+    def next_epoch(self) -> None:
+        self.epoch_number += 1
+
+    # -- label statistics (reference :925-1018) -----------------------------
+    def analyze_label_distribution(self, labels_by_class: Dict[int, Sequence]
+                                   ) -> Dict[str, dict]:
+        """Per-class label histogram + a chi-square-style balance report
+        between train and validation label distributions."""
+        report = {}
+        hists = {}
+        for klass, labels in labels_by_class.items():
+            vals, counts = np.unique(np.asarray(labels), return_counts=True)
+            hists[klass] = dict(zip(vals.tolist(), counts.tolist()))
+            report[CLASS_NAMES[klass]] = hists[klass]
+        if TRAIN in hists and VALID in hists and hists[VALID]:
+            keys = sorted(set(hists[TRAIN]) | set(hists[VALID]))
+            tr = np.array([hists[TRAIN].get(k, 0) for k in keys], np.float64)
+            va = np.array([hists[VALID].get(k, 0) for k in keys], np.float64)
+            tr_p = tr / max(tr.sum(), 1)
+            expected = tr_p * va.sum()
+            with np.errstate(divide="ignore", invalid="ignore"):
+                chi2 = float(np.nansum(
+                    np.where(expected > 0,
+                             np.square(va - expected) / expected, 0.0)))
+            report["train_valid_chi2"] = chi2
+        return report
+
+    # -- checkpointable state (reference: pickle of loader counters) --------
+    def state(self) -> dict:
+        return {"epoch_number": self.epoch_number,
+                "minibatch_size": self.minibatch_size,
+                "shard_index": self.shard_index,
+                "shard_count": self.shard_count}
+
+    def set_state(self, st: dict) -> None:
+        self.epoch_number = int(st["epoch_number"])
+        self.minibatch_size = int(st["minibatch_size"])
+        self.shard_index = int(st.get("shard_index", 0))
+        self.shard_count = int(st.get("shard_count", 1))
+
+
+class ArrayLoader(Loader):
+    """Loader over in-memory numpy arrays (the workhorse for tests and
+    synthetic benchmarks; reference analog: FullBatchLoader's host half,
+    veles/loader/fullbatch.py:79).
+
+    ``data[klass]`` -> (N, ...) inputs; ``labels[klass]`` -> (N,) int labels
+    or None; ``targets[klass]`` -> regression targets or None.
+    """
+
+    def __init__(self, data: Dict[int, np.ndarray],
+                 labels: Optional[Dict[int, np.ndarray]] = None,
+                 targets: Optional[Dict[int, np.ndarray]] = None,
+                 normalizer=None, **kw):
+        super().__init__(**kw)
+        self._data = data
+        self._labels = labels or {}
+        self._targets = targets or {}
+        self.normalizer = normalizer
+
+    def load_data(self):
+        for klass in (TEST, VALID, TRAIN):
+            arr = self._data.get(klass)
+            self.class_lengths[klass] = 0 if arr is None else len(arr)
+        if self.normalizer is not None:
+            for klass in (TRAIN,):  # stats from train only
+                if self._data.get(klass) is not None:
+                    self.normalizer.analyze(self._data[klass])
+            for klass in (TEST, VALID, TRAIN):
+                if self._data.get(klass) is not None:
+                    self._data[klass] = self.normalizer.normalize(
+                        self._data[klass])
+
+    def fill_minibatch(self, indices, klass):
+        batch = {"@input": self._data[klass][indices]}
+        if klass in self._labels and self._labels[klass] is not None:
+            batch["@labels"] = self._labels[klass][indices]
+        if klass in self._targets and self._targets[klass] is not None:
+            batch["@targets"] = self._targets[klass][indices]
+        return batch

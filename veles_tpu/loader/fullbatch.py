@@ -1,0 +1,85 @@
+"""Fullbatch loader: whole dataset resident in device HBM, minibatch gather
+on device.
+
+Reference parity: veles/loader/fullbatch.py:79 — dataset uploaded to device
+memory once, minibatches gathered by a fill_minibatch_data_labels kernel
+(ocl/fullbatch_loader.cl) from shuffled indices; graceful host fallback on
+OOM (:164-242).
+
+TPU redesign: the dataset lives as jax Arrays in HBM; the gather is
+``jnp.take(data, idx, axis=0)`` inside a tiny jitted function — only the
+*indices* cross the host→device boundary each step (the exact analog of the
+reference's ship-indices-only distributed protocol,
+veles/loader/base.py:631-639). On HBM-overflow the loader transparently
+degrades to host-side gather (ArrayLoader behavior), mirroring the
+reference's OOM fallback.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .base import ArrayLoader, TEST, TRAIN, VALID
+
+
+class FullBatchLoader(ArrayLoader):
+    """ArrayLoader whose gather happens on device."""
+
+    def __init__(self, *args, device=None, force_host: bool = False, **kw):
+        super().__init__(*args, **kw)
+        self._device = device
+        self._force_host = force_host
+        self._dev_data: Dict[int, dict] = {}
+        self._gather = None
+        self.on_device = False
+
+    def initialize(self):
+        super().initialize()
+        if self._force_host:
+            return
+        try:
+            self._upload()
+            self.on_device = True
+        except (RuntimeError, jax.errors.JaxRuntimeError) as e:
+            # OOM fallback (reference: veles/loader/fullbatch.py:164-242).
+            self.warning("device upload failed (%s); host-side gather", e)
+            self._dev_data.clear()
+            self.on_device = False
+
+    def _upload(self):
+        put = (lambda x: jax.device_put(x, self._device)) \
+            if self._device is not None else jax.device_put
+        for klass in (TEST, VALID, TRAIN):
+            if self.class_lengths[klass] == 0:
+                continue
+            entry = {"@input": put(self._data[klass])}
+            if self._labels.get(klass) is not None:
+                entry["@labels"] = put(self._labels[klass])
+            if self._targets.get(klass) is not None:
+                entry["@targets"] = put(self._targets[klass])
+            self._dev_data[klass] = entry
+
+        @jax.jit
+        def gather(tree, idx):
+            return jax.tree.map(lambda a: jnp.take(a, idx, axis=0), tree)
+
+        self._gather = gather
+
+    def make_batch(self, chunk: np.ndarray, klass: int):
+        if not self.on_device:
+            return super().make_batch(chunk, klass)
+        bs = self.minibatch_size
+        valid_n = len(chunk)
+        if valid_n < bs:
+            chunk = np.concatenate(
+                [chunk, np.zeros(bs - valid_n, chunk.dtype)])
+        idx = jnp.asarray(chunk, jnp.int32)
+        batch = dict(self._gather(self._dev_data[klass], idx))
+        mask = np.zeros(bs, np.float32)
+        mask[:valid_n] = 1.0
+        batch["@mask"] = jnp.asarray(mask)
+        return batch

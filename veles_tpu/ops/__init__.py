@@ -1,0 +1,16 @@
+"""Functional op library — the XLA/Pallas replacement for the reference's
+OpenCL/CUDA kernel directory (reference: ocl/*.cl, cuda/*.cu; see
+SURVEY.md §2.3). Every op is a pure jnp/lax function, testable against the
+numpy references in tests/.
+"""
+
+from .activations import (relu, scaled_tanh, sigmoid, sincos, log_softmax,
+                          softmax, ACTIVATIONS)
+from .linear import dense, smart_uniform_init
+from .convolution import conv2d, deconv2d
+from .pooling import (max_pool, avg_pool, max_pool_with_argmax, max_unpool,
+                      avg_unpool)
+from .lrn import local_response_norm
+from .losses import softmax_cross_entropy, mse_loss
+from .normalize import mean_disp_normalize
+from .reduce import matrix_reduce

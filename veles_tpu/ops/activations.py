@@ -1,0 +1,53 @@
+"""Activation functions.
+
+Covers the Znicz activation set (reference: docs
+manualrst_veles_algorithms.rst:10-30 — all2all variants tanh/relu/softmax/
+sincos). ``scaled_tanh`` is the classic 1.7159*tanh(2x/3) the 2014-era
+frameworks used for FC nets; ``sincos`` alternates sin/cos over feature
+index (Znicz's periodic activation).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def relu(x):
+    return jnp.maximum(x, 0)
+
+
+def scaled_tanh(x):
+    return 1.7159 * jnp.tanh(0.6666 * x)
+
+
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+def softmax(x, axis=-1):
+    return jax.nn.softmax(x, axis=axis)
+
+
+def log_softmax(x, axis=-1):
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+def sincos(x):
+    """Even feature indices -> sin, odd -> cos."""
+    idx = jnp.arange(x.shape[-1])
+    return jnp.where(idx % 2 == 0, jnp.sin(x), jnp.cos(x))
+
+
+def identity(x):
+    return x
+
+
+ACTIVATIONS = {
+    "linear": identity,
+    "relu": relu,
+    "tanh": scaled_tanh,
+    "raw_tanh": jnp.tanh,
+    "sigmoid": sigmoid,
+    "sincos": sincos,
+}

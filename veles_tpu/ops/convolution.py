@@ -1,0 +1,59 @@
+"""Convolution / deconvolution ops.
+
+The reference's conv/deconv lived in the absent Znicz submodule (reference:
+docs manualrst_veles_algorithms.rst:31-60; padding/stride at :167 item 14).
+On TPU these are XLA's native convs — ``lax.conv_general_dilated`` hits the
+MXU directly with NHWC layout; no hand kernel can beat it for dense convs,
+so Pallas is reserved for fused exotica (see ops/pallas_kernels.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+DIMS = ("NHWC", "HWIO", "NHWC")
+
+
+def _pair(v):
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+def conv2d(x, w, b=None, *, stride=1, padding="SAME", precision=None,
+           compute_dtype=None):
+    """x: (N,H,W,C), w: (kh,kw,Cin,Cout)."""
+    out_dtype = jnp.result_type(x.dtype, w.dtype)
+    if compute_dtype is not None:
+        x = x.astype(compute_dtype)
+        w = w.astype(compute_dtype)
+    if isinstance(padding, int):
+        p = _pair(padding)
+        padding = ((p[0], p[0]), (p[1], p[1]))
+    y = jax.lax.conv_general_dilated(
+        x, w, window_strides=_pair(stride), padding=padding,
+        dimension_numbers=DIMS, precision=precision,
+        preferred_element_type=jnp.float32)
+    y = y.astype(out_dtype)
+    if b is not None:
+        y = y + b
+    return y
+
+
+def deconv2d(x, w, b=None, *, stride=1, padding="SAME", precision=None,
+             compute_dtype=None):
+    """Transposed conv (reference Znicz 'deconv')."""
+    out_dtype = jnp.result_type(x.dtype, w.dtype)
+    if compute_dtype is not None:
+        x = x.astype(compute_dtype)
+        w = w.astype(compute_dtype)
+    if isinstance(padding, int):
+        p = _pair(padding)
+        padding = ((p[0], p[0]), (p[1], p[1]))
+    y = jax.lax.conv_transpose(
+        x, w, strides=_pair(stride), padding=padding,
+        dimension_numbers=DIMS, precision=precision,
+        preferred_element_type=jnp.float32)
+    y = y.astype(out_dtype)
+    if b is not None:
+        y = y + b
+    return y

@@ -1,0 +1,48 @@
+"""Dense (all2all) op + weight init.
+
+Replaces the reference's tiled OpenCL gemm (reference:
+ocl/matrix_multiplication.cl + ocl/gemm.cl — block-tiled, float4-vectorized,
+3 summation precision levels selected by PRECISION_LEVEL; CUDA path used
+cuBLAS, veles/backends.py:829-836). On TPU the MXU is driven through
+``jnp.dot``/``lax.dot_general``; the precision-level knob maps onto
+``jax.lax.Precision`` + float32 accumulation over bfloat16 inputs, which is
+what the Kahan/multi-partial kernels were approximating on GPUs.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def dense(x, w, b=None, *, precision=None, compute_dtype=None):
+    """y = x @ w + b with f32 accumulation.
+
+    x: (batch, in), w: (in, out). If ``compute_dtype`` is set (bf16 policy),
+    inputs are cast down but accumulation stays float32
+    (``preferred_element_type``), matching PRECISION_LEVEL>0 semantics of the
+    reference kernels without a custom kernel.
+    """
+    out_dtype = jnp.result_type(x.dtype, w.dtype)
+    if compute_dtype is not None:
+        x = x.astype(compute_dtype)
+        w = w.astype(compute_dtype)
+    y = jax.lax.dot_general(
+        x, w, (((x.ndim - 1,), (0,)), ((), ())),
+        precision=precision,
+        preferred_element_type=jnp.float32)
+    y = y.astype(out_dtype)
+    if b is not None:
+        y = y + b
+    return y
+
+
+def smart_uniform_init(key, shape, fan_in=None, dtype=jnp.float32, scale=1.0):
+    """Znicz "smart weight init" (reference: docs
+    manualrst_veles_algorithms.rst:165 item 12): uniform in
+    ±scale/sqrt(fan_in) — i.e. LeCun-style scaling."""
+    if fan_in is None:
+        fan_in = int(np.prod(shape[:-1])) if len(shape) > 1 else shape[0]
+    limit = scale / np.sqrt(max(fan_in, 1))
+    return jax.random.uniform(key, shape, dtype, -limit, limit)

@@ -1,0 +1,46 @@
+"""Loss ops (the reference's "evaluators": softmax cross-entropy and MSE,
+docs manualrst_veles_algorithms.rst:157 item 7; the Znicz EvaluatorSoftmax /
+EvaluatorMSE units plugged between forwards and gradient units)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def softmax_cross_entropy(logits, labels, *, mask=None):
+    """Mean CE over the batch; labels are integer class ids.
+
+    Returns (loss, n_err) — n_err is the reference's per-minibatch error
+    count that Decision accumulated into epoch error rates."""
+    logits = logits.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ce = -jnp.take_along_axis(logp, labels[..., None].astype(jnp.int32),
+                              axis=-1)[..., 0]
+    pred = jnp.argmax(logits, axis=-1)
+    err = (pred != labels).astype(jnp.float32)
+    if mask is not None:
+        denom = jnp.maximum(mask.sum(), 1.0)
+        return (ce * mask).sum() / denom, (err * mask).sum()
+    return ce.mean(), err.sum()
+
+
+def mse_loss(output, target, *, mask=None, root_flag=False):
+    """Mean squared error; returns (loss, sum of per-sample sq-norm errors)
+    so RMSE can be aggregated per epoch (reference AE RMSE metric,
+    manualrst_veles_algorithms.rst:71)."""
+    output = output.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+    diff = output.reshape(output.shape[0], -1) - target.reshape(
+        target.shape[0], -1)
+    per_sample = jnp.mean(jnp.square(diff), axis=-1)
+    if mask is not None:
+        denom = jnp.maximum(mask.sum(), 1.0)
+        loss = (per_sample * mask).sum() / denom
+        agg = (per_sample * mask).sum()
+    else:
+        loss = per_sample.mean()
+        agg = per_sample.sum()
+    if root_flag:
+        loss = jnp.sqrt(loss)
+    return loss, agg

@@ -1,0 +1,25 @@
+"""Local response normalization across channels (reference Znicz LRN,
+docs manualrst_veles_algorithms.rst:31-60; AlexNet-style).
+
+y = x / (k + alpha/n * sum_{j in window} x_j^2)^beta over the channel axis.
+Implemented with a window sum XLA fuses into neighboring ops.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def local_response_norm(x, *, n=5, k=2.0, alpha=1e-4, beta=0.75):
+    """x: (..., C). AlexNet semantics: alpha is divided by window size n."""
+    sq = jnp.square(x)
+    half = n // 2
+    # Pad channels and window-sum with reduce_window over the last axis.
+    pads = [(0, 0)] * (x.ndim - 1) + [(half, n - 1 - half)]
+    sq = jnp.pad(sq, pads)
+    window = (1,) * (x.ndim - 1) + (n,)
+    strides = (1,) * x.ndim
+    ssum = jax.lax.reduce_window(sq, 0.0, jax.lax.add, window, strides,
+                                 "VALID")
+    return x * jax.lax.pow(k + (alpha / n) * ssum, -beta)

@@ -1,0 +1,12 @@
+"""Mean/dispersion normalization op (reference:
+ocl/mean_disp_normalizer.cl + veles/mean_disp_normalizer.py:50-138 —
+(x - mean) * rdisp elementwise on uint8 input). One fused jnp expression on
+TPU; XLA folds the cast+sub+mul into surrounding ops."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def mean_disp_normalize(x, mean, rdisp, dtype=jnp.float32):
+    return (x.astype(dtype) - mean.astype(dtype)) * rdisp.astype(dtype)

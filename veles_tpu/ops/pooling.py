@@ -1,0 +1,76 @@
+"""Pooling / unpooling ops (reference Znicz max/avg pooling + depooling,
+docs manualrst_veles_algorithms.rst:31-60). ``lax.reduce_window`` lowers to
+the VPU; max_unpool reconstructs from stored argmax switches the way Znicz
+depooling consumed the pooling unit's output offsets.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _pair(v):
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+def max_pool(x, window=2, stride=None, padding="VALID"):
+    """x: (N,H,W,C)."""
+    w = _pair(window)
+    s = _pair(stride) if stride is not None else w
+    return jax.lax.reduce_window(
+        x, -jnp.inf, jax.lax.max,
+        (1, w[0], w[1], 1), (1, s[0], s[1], 1), padding)
+
+
+def avg_pool(x, window=2, stride=None, padding="VALID"):
+    w = _pair(window)
+    s = _pair(stride) if stride is not None else w
+    summed = jax.lax.reduce_window(
+        x, 0.0, jax.lax.add, (1, w[0], w[1], 1), (1, s[0], s[1], 1), padding)
+    if padding == "VALID":
+        return summed / (w[0] * w[1])
+    counts = jax.lax.reduce_window(
+        jnp.ones_like(x), 0.0, jax.lax.add,
+        (1, w[0], w[1], 1), (1, s[0], s[1], 1), padding)
+    return summed / counts
+
+
+def max_pool_with_argmax(x, window=2, stride=None):
+    """Returns (pooled, one-hot switches) for later unpooling."""
+    w = _pair(window)
+    s = _pair(stride) if stride is not None else w
+    pooled = max_pool(x, w, s)
+    # Switches: 1 where the input equals the pooled value broadcast back.
+    # Positions no VALID window covers (odd sizes) get -inf -> never a switch.
+    up = _broadcast_back(pooled, x.shape, s, fill=-jnp.inf)
+    switches = (x == up).astype(x.dtype)
+    return pooled, switches
+
+
+def _broadcast_back(pooled, in_shape, s, fill=0.0):
+    """Upsample pooled by stride back to in_shape, padding uncovered tail."""
+    y = jnp.repeat(jnp.repeat(pooled, s[0], axis=1), s[1], axis=2)
+    y = y[:, :in_shape[1], :in_shape[2], :]
+    pad_h = in_shape[1] - y.shape[1]
+    pad_w = in_shape[2] - y.shape[2]
+    if pad_h or pad_w:
+        y = jnp.pad(y, ((0, 0), (0, pad_h), (0, pad_w), (0, 0)),
+                    constant_values=fill)
+    return y
+
+
+def max_unpool(pooled, switches, window=2):
+    """Depool using stored switches (Znicz depooling parity)."""
+    w = _pair(window)
+    up = _broadcast_back(pooled, switches.shape, w)
+    return up * switches
+
+
+def avg_unpool(pooled, window=2, out_hw=None):
+    w = _pair(window)
+    up = jnp.repeat(jnp.repeat(pooled, w[0], axis=1), w[1], axis=2)
+    up = up / (w[0] * w[1])
+    if out_hw is not None:
+        up = up[:, :out_hw[0], :out_hw[1], :]
+    return up

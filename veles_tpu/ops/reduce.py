@@ -1,0 +1,12 @@
+"""Matrix row/column reduction (reference: ocl/matrix_reduce.cl:1-69,
+cuda/matrix_reduce.cu — shared-memory tree reduction template). On TPU this
+is ``jnp.sum``/``jnp.max`` over an axis; XLA emits the tree."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def matrix_reduce(x, axis=0, op="sum"):
+    fns = {"sum": jnp.sum, "max": jnp.max, "min": jnp.min, "mean": jnp.mean}
+    return fns[op](x, axis=axis)

@@ -1,0 +1,123 @@
+"""Seeded, named PRNG streams.
+
+TPU-native re-design of the reference PRNG registry (reference:
+veles/prng/random_generator.py:289 ``prng.get(index)`` — numbered global
+generators seeded from CLI ``--random-seed index:seed`` specs,
+veles/__main__.py:483-537) and of the per-unit reproducibility contract
+(reference: veles/units.py:859-885 ``_ensure_reproducible_rg``).
+
+JAX PRNG keys are explicit and splittable (threefry), so reproducibility is
+structural rather than promised: every stream is a deterministic function of
+(master seed, stream name, fold count). Host-side randomness (loader shuffles)
+uses numpy Generators derived from the same seeds so checkpoints can capture
+loader state exactly.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+import jax
+import numpy as np
+
+from .config import root
+
+
+class RandomStream:
+    """One named stream: a JAX key chain plus a numpy Generator.
+
+    ``next_key()`` advances the on-device key chain; ``numpy`` is the host-side
+    generator (used by loaders for epoch permutations). Both are restorable:
+    state() / set_state() round-trip through checkpoints (reference parity:
+    loader counters restored via pickle, veles/loader/base.py:617-618).
+    """
+
+    def __init__(self, name: str, seed: int):
+        self.name = name
+        self.seed = int(seed)
+        self._count = 0
+        self.numpy = np.random.Generator(np.random.PCG64(self.seed))
+        self._lock = threading.Lock()
+
+    @property
+    def key(self) -> jax.Array:
+        """Current JAX key (does not advance)."""
+        k = jax.random.key(self.seed)
+        if self._count:
+            k = jax.random.fold_in(k, self._count)
+        return k
+
+    def next_key(self) -> jax.Array:
+        """Advance and return a fresh JAX key."""
+        with self._lock:
+            self._count += 1
+            return jax.random.fold_in(jax.random.key(self.seed), self._count)
+
+    def next_keys(self, n: int):
+        return jax.random.split(self.next_key(), n)
+
+    def randint(self, low, high=None, size=None):
+        return self.numpy.integers(low, high, size)
+
+    def permutation(self, n: int) -> np.ndarray:
+        return self.numpy.permutation(n)
+
+    def state(self) -> dict:
+        return {
+            "seed": self.seed,
+            "count": self._count,
+            "numpy": self.numpy.bit_generator.state,
+        }
+
+    def set_state(self, st: dict) -> None:
+        self.seed = int(st["seed"])
+        self._count = int(st["count"])
+        self.numpy = np.random.Generator(np.random.PCG64(0))
+        self.numpy.bit_generator.state = st["numpy"]
+
+
+class _Registry:
+    def __init__(self):
+        self._streams: Dict[str, RandomStream] = {}
+        self._lock = threading.Lock()
+
+    def get(self, name: str = "default") -> RandomStream:
+        """Fetch-or-create the named stream (reference: ``prng.get(index)``,
+        veles/prng/random_generator.py:289; names replace indices)."""
+        with self._lock:
+            if name not in self._streams:
+                master = int(root.common.value("random_seed", 42))
+                # Derive a per-stream seed deterministically from the name.
+                sub = np.random.SeedSequence(
+                    [master, *[ord(c) for c in name]]).generate_state(1)[0]
+                self._streams[name] = RandomStream(name, int(sub))
+            return self._streams[name]
+
+    def seed(self, name: str, seed: int) -> RandomStream:
+        """Explicitly (re)seed a stream (CLI ``--random-seed`` parity,
+        veles/__main__.py:483-537)."""
+        with self._lock:
+            self._streams[name] = RandomStream(name, seed)
+            return self._streams[name]
+
+    def state(self) -> dict:
+        with self._lock:
+            return {k: v.state() for k, v in self._streams.items()}
+
+    def set_state(self, st: dict) -> None:
+        with self._lock:
+            for k, s in st.items():
+                stream = self._streams.get(k)
+                if stream is None:
+                    stream = self._streams[k] = RandomStream(k, s["seed"])
+                stream.set_state(s)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._streams.clear()
+
+
+streams = _Registry()
+get = streams.get
+seed = streams.seed

@@ -1,0 +1,3 @@
+from .decision import Decision
+from .snapshotter import Snapshotter
+from .trainer import Trainer

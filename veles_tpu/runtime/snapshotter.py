@@ -1,0 +1,186 @@
+"""Snapshotter: checkpoint/resume.
+
+Reference parity (reference: veles/snapshotter.py:84,360,428 — pickle of the
+whole workflow with gz/bz2/xz codecs, time/interval throttling :159-174,
+``_current`` symlink :397-409, size warning with per-unit breakdown
+:203-225; restore at CLI veles/__main__.py:539-589).
+
+TPU redesign: instead of pickling live objects, the checkpoint is the
+explicit state contract (SURVEY.md §5.4): the workflow state pytree
+(params / unit state / optimizer state / step / PRNG key), loader state,
+decision state, PRNG registry state, and the config snapshot. Tensors go
+into one ``npz`` (compressed = the codec knob); structure into a JSON
+manifest. This keeps checkpoints host-readable and independent of Python
+object layout — and resharding on load is just device_put under a new mesh
+(8→1 chip resume).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..logger import Logger
+
+
+def _flatten(tree, prefix="", out=None):
+    out = {} if out is None else out
+    if isinstance(tree, dict):
+        if not tree:
+            out[f"{prefix}/__emptydict__"] = np.zeros(0)
+            return out
+        for k in sorted(tree):
+            _flatten(tree[k], f"{prefix}/{k}" if prefix else str(k), out)
+    elif isinstance(tree, (list, tuple)):
+        out[f"{prefix}/__seq__"] = np.asarray(
+            [len(tree), int(isinstance(tree, tuple))])
+        for i, v in enumerate(tree):
+            _flatten(v, f"{prefix}/{i}", out)
+    else:
+        out[prefix] = np.asarray(tree)
+    return out
+
+
+def _to_numpy(tree):
+    """device_get with PRNG typed keys unwrapped to raw uint32 data."""
+    def conv(x):
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype,
+                                                  jax.dtypes.prng_key):
+            x = jax.random.key_data(x)
+        return np.asarray(jax.device_get(x))
+    return jax.tree.map(conv, tree)
+
+
+def _unflatten(flat: Dict[str, np.ndarray]):
+    root: dict = {}
+    seqs = set()
+    for key, value in flat.items():
+        parts = key.split("/")
+        if parts[-1] == "__seq__":
+            path = "/".join(parts[:-1])
+            seqs.add(path)
+            node = root  # materialize the node even for empty sequences
+            for p in parts[:-1]:
+                node = node.setdefault(p, {})
+            continue
+        node = root
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        if parts[-1] == "__emptydict__":
+            continue  # parent dict already materialized (possibly empty)
+        node[parts[-1]] = value
+
+    def fix(node, path=""):
+        if not isinstance(node, dict):
+            return node
+        out = {k: fix(v, f"{path}/{k}" if path else k) for k, v in
+               node.items()}
+        if path in seqs:
+            n = len(out)
+            seq = [out[str(i)] for i in range(n)]
+            meta = flat[f"{path}/__seq__"]
+            return tuple(seq) if meta[1] else seq
+        return out
+
+    return fix(root)
+
+
+class Snapshotter(Logger):
+    """Save/restore checkpoints with interval+time throttling and
+    best/current symlinks."""
+
+    def __init__(self, prefix: str, directory: str = "snapshots", *,
+                 compression: bool = True, interval: int = 1,
+                 time_interval: float = 0.0):
+        self.prefix = prefix
+        self.directory = directory
+        self.compression = compression
+        self.interval = interval          # epochs between snapshots
+        self.time_interval = time_interval  # min seconds between snapshots
+        self._last_time = 0.0
+        self._counter = 0
+        self.last_path: Optional[str] = None
+
+    def maybe_save(self, tag: str, payload: Dict[str, Any], *,
+                   best: bool = False) -> Optional[str]:
+        """Throttled save (reference: veles/snapshotter.py:159-174)."""
+        self._counter += 1
+        now = time.time()
+        if not best:
+            if self._counter % max(self.interval, 1) != 0:
+                return None
+            if now - self._last_time < self.time_interval:
+                return None
+        self._last_time = now
+        return self.save(tag, payload, best=best)
+
+    def save(self, tag: str, payload: Dict[str, Any], *,
+             best: bool = False) -> str:
+        os.makedirs(self.directory, exist_ok=True)
+        base = f"{self.prefix}_{tag}"
+        npz_path = os.path.join(self.directory, base + ".npz")
+
+        tensors = _flatten(_to_numpy(payload.get("wstate", {})))
+        saver = np.savez_compressed if self.compression else np.savez
+        saver(npz_path, **tensors)
+
+        manifest = {k: v for k, v in payload.items() if k != "wstate"}
+        manifest["tensors"] = base + ".npz"
+        manifest["saved_at"] = time.time()
+        man_path = os.path.join(self.directory, base + ".json")
+        with open(man_path, "w") as f:
+            json.dump(manifest, f, indent=1, default=repr)
+
+        for link, active in (("_current", True), ("_best", best)):
+            if not active:
+                continue
+            lpath = os.path.join(self.directory, self.prefix + link + ".json")
+            tmp = lpath + ".tmp"
+            if os.path.lexists(tmp):
+                os.remove(tmp)
+            os.symlink(os.path.basename(man_path), tmp)
+            os.replace(tmp, lpath)
+
+        size = os.path.getsize(npz_path)
+        self.info("snapshot %s (%.1f MiB)%s", man_path, size / 2**20,
+                  " [best]" if best else "")
+        self.last_path = man_path
+        return man_path
+
+    @staticmethod
+    def load(path: str) -> Dict[str, Any]:
+        """Restore a checkpoint from its manifest path (or the _current/_best
+        symlink). Returns the payload with 'wstate' as numpy pytree; call
+        ``jax.device_put`` (optionally with shardings) to place it."""
+        with open(path) as f:
+            manifest = json.load(f)
+        npz_path = os.path.join(os.path.dirname(path), manifest["tensors"])
+        with np.load(npz_path, allow_pickle=False) as z:
+            flat = {k: z[k] for k in z.files}
+        payload = dict(manifest)
+        payload["wstate"] = _unflatten(flat)
+        return payload
+
+    @staticmethod
+    def restore_wstate(payload: Dict[str, Any], like: Optional[dict] = None,
+                       shardings=None):
+        """Rebuild the on-device workflow state, casting dtypes to match a
+        template (PRNG keys need their key dtype restored)."""
+        wstate = payload["wstate"]
+        if like is not None:
+            def cast(saved, template):
+                if hasattr(template, "dtype") and jnp.issubdtype(
+                        template.dtype, jax.dtypes.prng_key):
+                    return jax.random.wrap_key_data(
+                        jnp.asarray(saved, jnp.uint32))
+                return jnp.asarray(saved).astype(template.dtype)
+            wstate = jax.tree.map(cast, wstate, like)
+        if shardings is not None:
+            return jax.device_put(wstate, shardings)
+        return jax.device_put(wstate)

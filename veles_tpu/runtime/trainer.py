@@ -1,0 +1,190 @@
+"""Trainer: the host-side epoch loop tying loader + workflow + decision +
+snapshotter together.
+
+This replaces the reference's gate-driven Repeater loop (reference:
+veles/plumbing.py:17 Repeater; Decision closing gates; EndPoint firing
+``on_workflow_finished``, veles/workflow.py:351-377). All data-dependent
+control flow (epochs, early stop, rollback, checkpoint cadence) lives here
+on the host; everything per-step is the compiled train/eval functions.
+
+Metric aggregation matches the reference Decision semantics: per-epoch sums
+of n_err / mse over served (non-padded) samples → error % / RMSE.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+from .. import prng
+from ..config import root
+from ..loader.base import TRAIN, VALID, TEST, Loader
+from ..logger import Logger, TraceContext
+from ..ops.optimizers import Optimizer
+from ..units.workflow import Workflow
+from .decision import Decision
+from .snapshotter import Snapshotter, _to_numpy
+
+
+def aggregate_epoch_metrics(sums: Dict[str, float]) -> Dict[str, float]:
+    n = max(sums.get("n_samples", 0.0), 1.0)
+    out = dict(sums)
+    if "n_err" in sums:
+        out["error_pct"] = 100.0 * sums["n_err"] / n
+    if "mse_sum" in sums:
+        out["rmse"] = float(np.sqrt(sums["mse_sum"] / n))
+    if "loss" in sums and "n_batches" in sums:
+        out["loss"] = sums["loss"] / max(sums["n_batches"], 1.0)
+    return out
+
+
+class Trainer(Logger):
+    """Standalone (or per-host SPMD) training driver."""
+
+    def __init__(self, workflow: Workflow, loader: Loader,
+                 optimizer: Optimizer, decision: Optional[Decision] = None,
+                 snapshotter: Optional[Snapshotter] = None):
+        self.workflow = workflow
+        self.loader = loader
+        self.optimizer = optimizer
+        self.decision = decision or Decision(max_epochs=10)
+        self.snapshotter = snapshotter
+        self.wstate = None
+        self._train_step = None
+        self._eval_step = None
+        self._best_wstate = None
+        self.results: Dict[str, Any] = {}
+
+    # -- setup -------------------------------------------------------------
+    def initialize(self, seed: Optional[int] = None,
+                   wstate: Optional[dict] = None) -> None:
+        self.loader.initialize()
+        batch = next(self.loader.iter_epoch(
+            TRAIN if self.loader.class_lengths[TRAIN] else VALID))
+        specs = {k: jax.ShapeDtypeStruct(np.shape(v), np.asarray(v).dtype
+                                         if not hasattr(v, "dtype")
+                                         else v.dtype)
+                 for k, v in batch.items()}
+        self.workflow.build(specs)
+        if wstate is not None:
+            self.wstate = wstate
+        else:
+            key = prng.get("init").next_key() if seed is None \
+                else jax.random.key(seed)
+            self.wstate = self.workflow.init_state(key, self.optimizer)
+        self._train_step = self.workflow.make_train_step(self.optimizer)
+        self._eval_step = self.workflow.make_eval_step()
+        self.info("workflow %s: %d params", self.workflow.name,
+                  self.workflow.n_params(self.wstate))
+
+    # -- epoch passes -------------------------------------------------------
+    def _run_epoch_train(self, epoch: int) -> Dict[str, float]:
+        sums: Dict[str, float] = {}
+        with TraceContext("train_epoch", epoch=epoch):
+            for batch in self.loader.iter_epoch(TRAIN, epoch):
+                self.wstate, mets = self._train_step(self.wstate, batch)
+                for k, v in mets.items():
+                    sums[k] = sums.get(k, 0.0) + float(v)
+                sums["n_batches"] = sums.get("n_batches", 0) + 1
+        return aggregate_epoch_metrics(sums)
+
+    def _run_epoch_eval(self, klass: int, epoch: int) -> Dict[str, float]:
+        if self.loader.class_lengths[klass] == 0:
+            return {}
+        sums: Dict[str, float] = {}
+        with TraceContext("eval_epoch", epoch=epoch, klass=klass):
+            for batch in self.loader.iter_epoch(klass, epoch):
+                mets = self._eval_step(self.wstate, batch)
+                for k, v in mets.items():
+                    sums[k] = sums.get(k, 0.0) + float(v)
+                sums["n_batches"] = sums.get("n_batches", 0) + 1
+        return aggregate_epoch_metrics(sums)
+
+    # -- main loop ----------------------------------------------------------
+    def run(self) -> Dict[str, Any]:
+        if self.wstate is None:
+            self.initialize()
+        t0 = time.time()
+        samples_done = 0
+        epoch = self.loader.epoch_number
+        while not self.decision.complete:
+            train_mets = self._run_epoch_train(epoch)
+            samples_done += int(train_mets.get("n_samples", 0))
+            valid_mets = self._run_epoch_eval(VALID, epoch)
+            stop = self.decision.on_epoch(epoch, train_mets, valid_mets)
+
+            if (self.decision.improved
+                    and self.decision.rollback_after is not None):
+                # Host-side copy: train_step donates wstate buffers, so an
+                # on-device alias would reference deleted arrays by the time
+                # a rollback happens.
+                self._best_wstate = _to_numpy(self.wstate)
+            if self.decision.want_rollback and self._best_wstate is not None:
+                # Reference: rollback to best snapshot + lr drop
+                # (manualrst_veles_algorithms.rst:164).
+                self.wstate = Snapshotter.restore_wstate(
+                    {"wstate": self._best_wstate}, like=self.wstate)
+                self.optimizer.schedule = _scaled_schedule(
+                    self.optimizer.schedule, self.decision.rollback_lr_scale)
+                self._train_step = self.workflow.make_train_step(
+                    self.optimizer)
+
+            # Advance the loader first so a restored checkpoint resumes at
+            # the *next* epoch instead of repeating the completed one.
+            self.loader.next_epoch()
+            if self.snapshotter is not None:
+                self.snapshotter.maybe_save(
+                    f"ep{epoch}", self._payload(),
+                    best=self.decision.improved)
+            epoch = self.loader.epoch_number
+            if stop:
+                break
+
+        elapsed = time.time() - t0
+        test_mets = self._run_epoch_eval(TEST, epoch)
+        self.results = self.workflow.gather_results({
+            "best_value": self.decision.best_value,
+            "best_epoch": self.decision.best_epoch,
+            "epochs": epoch,
+            "elapsed_s": elapsed,
+            "train_samples_per_s": samples_done / max(elapsed, 1e-9),
+            **{f"test_{k}": v for k, v in test_mets.items()},
+        })
+        return self.results
+
+    def _payload(self) -> Dict[str, Any]:
+        return {
+            "wstate": self.wstate,
+            "loader": self.loader.state(),
+            "decision": self.decision.state(),
+            "prng": prng.streams.state(),
+            "config": root.to_dict(),
+            "workflow_checksum": self.workflow.checksum(),
+        }
+
+    def restore(self, path: str, *, force: bool = False) -> None:
+        """Resume from a snapshot manifest (reference CLI restore path,
+        veles/__main__.py:539-589). Checksum mismatch is fatal unless
+        ``force`` (the reference validated the workflow checksum in its
+        distributed handshake, veles/server.py:478-492)."""
+        payload = Snapshotter.load(path)
+        if self.wstate is None:
+            self.initialize()
+        if payload.get("workflow_checksum") != self.workflow.checksum():
+            msg = ("snapshot was taken from a different workflow "
+                   f"(checksum {payload.get('workflow_checksum')!r} != "
+                   f"{self.workflow.checksum()!r})")
+            if not force:
+                raise ValueError(msg + "; pass force=True to override")
+            self.warning("%s — forcing restore", msg)
+        self.wstate = Snapshotter.restore_wstate(payload, like=self.wstate)
+        self.loader.set_state(payload["loader"])
+        self.decision.set_state(payload["decision"])
+        prng.streams.set_state(payload["prng"])
+
+
+def _scaled_schedule(schedule, scale):
+    return lambda step: schedule(step) * scale

@@ -1,0 +1,8 @@
+from .base import (Avatar, Context, Forward, InputJoiner, LambdaUnit, Spec,
+                   TrivialUnit, Unit, UnitRegistry)
+from .nn import (All2All, All2AllRELU, All2AllSincos, All2AllSoftmax,
+                 All2AllTanh, AvgPooling, Conv, ConvRELU, ConvTanh, Deconv,
+                 Depool, Dropout, Evaluator, EvaluatorMSE, EvaluatorSoftmax,
+                 Flatten, LRN, MaxPooling, MeanDispNormalizer,
+                 StochasticAbsPooling)
+from .workflow import Workflow, WorkflowError

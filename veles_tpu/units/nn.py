@@ -1,0 +1,377 @@
+"""NN forward-layer units — the Znicz layer library rebuilt TPU-first.
+
+Reference capability checklist (SURVEY.md §2.10; docs
+manualrst_veles_algorithms.rst:10-134): fully-connected (all2all with
+softmax/tanh/relu/sincos), conv, pooling (max/avg), deconv, depool, dropout,
+LRN, plus evaluators (softmax CE, MSE). Kohonen SOM and RBM live in
+units/kohonen.py / units/rbm.py (non-SGD custom updates).
+
+Every unit here is a thin declarative wrapper over veles_tpu.ops — pure
+functions the Workflow traces into one jitted step. Weights initialize with
+the Znicz "smart init" (uniform ±1/sqrt(fan_in)).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import ops
+from ..ops.activations import ACTIVATIONS
+from .base import Context, Forward, Spec, Unit
+
+
+def _cast_policy(dtype):
+    return None if dtype in (None, "") else jnp.dtype(dtype)
+
+
+class All2All(Forward):
+    """Fully-connected layer (reference Znicz all2all; gemm on the MXU)."""
+
+    def __init__(self, output_size: int, *, activation: str = "linear",
+                 weights_scale: float = 1.0, include_bias: bool = True,
+                 compute_dtype=None, name=None, inputs=("@input",)):
+        super().__init__(name, inputs)
+        self.output_size = int(output_size)
+        self.activation = activation
+        self.weights_scale = weights_scale
+        self.include_bias = include_bias
+        self.compute_dtype = _cast_policy(compute_dtype)
+
+    def _in_features(self, in_spec: Spec) -> int:
+        return int(np.prod(in_spec.shape[1:]))
+
+    def output_spec(self, in_specs):
+        n = in_specs[0].shape[0]
+        return Spec((n, self.output_size), in_specs[0].dtype)
+
+    def init(self, key, in_specs):
+        fan_in = self._in_features(in_specs[0])
+        kw, _ = jax.random.split(key)
+        params = {"w": ops.smart_uniform_init(
+            kw, (fan_in, self.output_size), fan_in,
+            scale=self.weights_scale)}
+        if self.include_bias:
+            params["b"] = jnp.zeros((self.output_size,), jnp.float32)
+        return params, {}
+
+    def apply(self, params, state, xs, ctx):
+        x = xs[0].reshape(xs[0].shape[0], -1)
+        y = ops.dense(x, params["w"], params.get("b"),
+                      compute_dtype=self.compute_dtype)
+        return ACTIVATIONS[self.activation](y), state
+
+
+class All2AllTanh(All2All):
+    def __init__(self, output_size, **kw):
+        kw.setdefault("activation", "tanh")
+        kw.setdefault("weights_scale", 1.0)
+        super().__init__(output_size, **kw)
+
+
+class All2AllRELU(All2All):
+    def __init__(self, output_size, **kw):
+        kw.setdefault("activation", "relu")
+        super().__init__(output_size, **kw)
+
+
+class All2AllSincos(All2All):
+    def __init__(self, output_size, **kw):
+        kw.setdefault("activation", "sincos")
+        super().__init__(output_size, **kw)
+
+
+class All2AllSoftmax(All2All):
+    """Output layer: emits LOGITS (softmax itself fuses into the CE loss —
+    the reference computed softmax in the evaluator's kernel too)."""
+
+    def __init__(self, output_size, **kw):
+        kw.setdefault("activation", "linear")
+        super().__init__(output_size, **kw)
+
+
+class Conv(Forward):
+    """2-D convolution (NHWC) with optional activation."""
+
+    def __init__(self, n_kernels: int, kx: int = 3, ky: Optional[int] = None,
+                 *, stride=1, padding="SAME", activation="linear",
+                 weights_scale=1.0, include_bias=True, compute_dtype=None,
+                 name=None, inputs=("@input",)):
+        super().__init__(name, inputs)
+        self.n_kernels = int(n_kernels)
+        self.kx = int(kx)
+        self.ky = int(ky if ky is not None else kx)
+        self.stride = stride
+        self.padding = padding
+        self.activation = activation
+        self.weights_scale = weights_scale
+        self.include_bias = include_bias
+        self.compute_dtype = _cast_policy(compute_dtype)
+
+    def output_spec(self, in_specs):
+        s = in_specs[0]
+        w = Spec((self.ky, self.kx, s.shape[-1], self.n_kernels), s.dtype)
+        return jax.eval_shape(
+            lambda x, w_: ops.conv2d(x, w_, stride=self.stride,
+                                     padding=self.padding), s, w)
+
+    def init(self, key, in_specs):
+        cin = in_specs[0].shape[-1]
+        fan_in = self.kx * self.ky * cin
+        kw, _ = jax.random.split(key)
+        params = {"w": ops.smart_uniform_init(
+            kw, (self.ky, self.kx, cin, self.n_kernels), fan_in,
+            scale=self.weights_scale)}
+        if self.include_bias:
+            params["b"] = jnp.zeros((self.n_kernels,), jnp.float32)
+        return params, {}
+
+    def apply(self, params, state, xs, ctx):
+        y = ops.conv2d(xs[0], params["w"], params.get("b"),
+                       stride=self.stride, padding=self.padding,
+                       compute_dtype=self.compute_dtype)
+        return ACTIVATIONS[self.activation](y), state
+
+
+class ConvRELU(Conv):
+    def __init__(self, n_kernels, kx=3, ky=None, **kw):
+        kw.setdefault("activation", "relu")
+        super().__init__(n_kernels, kx, ky, **kw)
+
+
+class ConvTanh(Conv):
+    def __init__(self, n_kernels, kx=3, ky=None, **kw):
+        kw.setdefault("activation", "tanh")
+        super().__init__(n_kernels, kx, ky, **kw)
+
+
+class Deconv(Forward):
+    """Transposed convolution (reference Znicz deconv)."""
+
+    def __init__(self, n_kernels: int, kx: int = 3, ky: Optional[int] = None,
+                 *, stride=1, padding="SAME", activation="linear",
+                 weights_scale=1.0, compute_dtype=None, name=None,
+                 inputs=("@input",)):
+        super().__init__(name, inputs)
+        self.n_kernels = int(n_kernels)
+        self.kx = int(kx)
+        self.ky = int(ky if ky is not None else kx)
+        self.stride = stride
+        self.padding = padding
+        self.activation = activation
+        self.weights_scale = weights_scale
+        self.compute_dtype = _cast_policy(compute_dtype)
+
+    def output_spec(self, in_specs):
+        s = in_specs[0]
+        w = Spec((self.ky, self.kx, s.shape[-1], self.n_kernels), s.dtype)
+        return jax.eval_shape(
+            lambda x, w_: ops.deconv2d(x, w_, stride=self.stride,
+                                       padding=self.padding), s, w)
+
+    def init(self, key, in_specs):
+        cin = in_specs[0].shape[-1]
+        fan_in = self.kx * self.ky * cin
+        kw, _ = jax.random.split(key)
+        return {"w": ops.smart_uniform_init(
+            kw, (self.ky, self.kx, cin, self.n_kernels), fan_in,
+            scale=self.weights_scale),
+            "b": jnp.zeros((self.n_kernels,), jnp.float32)}, {}
+
+    def apply(self, params, state, xs, ctx):
+        y = ops.deconv2d(xs[0], params["w"], params["b"],
+                         stride=self.stride, padding=self.padding,
+                         compute_dtype=self.compute_dtype)
+        return ACTIVATIONS[self.activation](y), state
+
+
+class MaxPooling(Unit):
+    def __init__(self, window=2, stride=None, name=None, inputs=("@input",)):
+        super().__init__(name, inputs)
+        self.window = window
+        self.stride = stride
+
+    def output_spec(self, in_specs):
+        return jax.eval_shape(
+            lambda x: ops.max_pool(x, self.window, self.stride), in_specs[0])
+
+    def apply(self, params, state, xs, ctx):
+        return ops.max_pool(xs[0], self.window, self.stride), state
+
+
+class AvgPooling(Unit):
+    def __init__(self, window=2, stride=None, name=None, inputs=("@input",)):
+        super().__init__(name, inputs)
+        self.window = window
+        self.stride = stride
+
+    def output_spec(self, in_specs):
+        return jax.eval_shape(
+            lambda x: ops.avg_pool(x, self.window, self.stride), in_specs[0])
+
+    def apply(self, params, state, xs, ctx):
+        return ops.avg_pool(xs[0], self.window, self.stride), state
+
+
+class StochasticAbsPooling(MaxPooling):
+    """Pool by max |x| keeping sign (Znicz's stochastic abs-pooling family;
+    deterministic variant used at inference)."""
+
+    def apply(self, params, state, xs, ctx):
+        x = xs[0]
+        mag = ops.max_pool(jnp.abs(x), self.window, self.stride)
+        pos = ops.max_pool(x, self.window, self.stride)
+        neg = -ops.max_pool(-x, self.window, self.stride)
+        return jnp.where(pos >= mag, pos, neg), state
+
+
+class Depool(Unit):
+    """Unpooling by uniform spread (pairs with Deconv for autoencoders)."""
+
+    def __init__(self, window=2, name=None, inputs=("@input",)):
+        super().__init__(name, inputs)
+        self.window = window
+
+    def output_spec(self, in_specs):
+        return jax.eval_shape(
+            lambda x: ops.avg_unpool(x, self.window), in_specs[0])
+
+    def apply(self, params, state, xs, ctx):
+        return ops.avg_unpool(xs[0], self.window), state
+
+
+class Dropout(Unit):
+    """Inverted dropout; identity at eval (reference Znicz dropout;
+    RNG = jax threefry via ctx.unit_key, replacing ocl/random.cl's
+    xorshift1024* states)."""
+
+    stochastic = True
+
+    def __init__(self, dropout_ratio=0.5, name=None, inputs=("@input",)):
+        super().__init__(name, inputs)
+        self.ratio = float(dropout_ratio)
+
+    def apply(self, params, state, xs, ctx):
+        x = xs[0]
+        if not ctx.train or self.ratio <= 0.0:
+            return x, state
+        key = ctx.unit_key(self.name)
+        keep = 1.0 - self.ratio
+        mask = jax.random.bernoulli(key, keep, x.shape)
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype), state
+
+
+class LRN(Unit):
+    """Local response normalization across channels."""
+
+    def __init__(self, n=5, k=2.0, alpha=1e-4, beta=0.75, name=None,
+                 inputs=("@input",)):
+        super().__init__(name, inputs)
+        self.n, self.k, self.alpha, self.beta = n, k, alpha, beta
+
+    def apply(self, params, state, xs, ctx):
+        return ops.local_response_norm(
+            xs[0], n=self.n, k=self.k, alpha=self.alpha, beta=self.beta), state
+
+
+class MeanDispNormalizer(Unit):
+    """(x - mean) * rdisp with dataset statistics stored in unit state
+    (reference: veles/mean_disp_normalizer.py:50-138)."""
+
+    def __init__(self, mean=None, rdisp=None, name=None, inputs=("@input",)):
+        super().__init__(name, inputs)
+        self._mean = mean
+        self._rdisp = rdisp
+
+    def output_spec(self, in_specs):
+        return Spec(in_specs[0].shape, jnp.float32)
+
+    def init(self, key, in_specs):
+        shape = in_specs[0].shape[1:]
+        mean = jnp.asarray(self._mean, jnp.float32) if self._mean is not None \
+            else jnp.zeros(shape, jnp.float32)
+        rdisp = jnp.asarray(self._rdisp, jnp.float32) \
+            if self._rdisp is not None else jnp.ones(shape, jnp.float32)
+        return {}, {"mean": mean, "rdisp": rdisp}
+
+    def apply(self, params, state, xs, ctx):
+        return ops.mean_disp_normalize(xs[0], state["mean"],
+                                       state["rdisp"]), state
+
+
+class Flatten(Unit):
+    def output_spec(self, in_specs):
+        s = in_specs[0]
+        return Spec((s.shape[0], int(np.prod(s.shape[1:]))), s.dtype)
+
+    def apply(self, params, state, xs, ctx):
+        return xs[0].reshape(xs[0].shape[0], -1), state
+
+
+# -- evaluators (loss units) -------------------------------------------------
+
+class Evaluator(Unit):
+    """Base loss unit: consumes (output, labels/targets); its output is the
+    scalar loss; metrics are returned via state-free aux (collected by the
+    Workflow). Reference: Znicz evaluator units feeding Decision."""
+
+    is_evaluator = True
+
+    def metrics(self, params, state, xs, ctx) -> dict:
+        raise NotImplementedError
+
+
+class EvaluatorSoftmax(Evaluator):
+    """Softmax cross-entropy over logits + integer labels
+    (reference 'evaluator' for classification). An optional third input
+    "@mask" (loader-provided, 1.0 per real sample) keeps metrics exact with
+    padded fixed-shape batches."""
+
+    def __init__(self, name=None, inputs=("@input", "@labels", "@mask")):
+        super().__init__(name, inputs)
+
+    def output_spec(self, in_specs):
+        return Spec((), jnp.float32)
+
+    @staticmethod
+    def _mask(xs):
+        return xs[2] if len(xs) > 2 else None
+
+    def apply(self, params, state, xs, ctx):
+        loss, _ = ops.softmax_cross_entropy(xs[0], xs[1], mask=self._mask(xs))
+        return loss, state
+
+    def metrics(self, params, state, xs, ctx):
+        mask = self._mask(xs)
+        loss, n_err = ops.softmax_cross_entropy(xs[0], xs[1], mask=mask)
+        n = mask.sum() if mask is not None else jnp.asarray(
+            xs[0].shape[0], jnp.float32)
+        return {"loss": loss, "n_err": n_err, "n_samples": n}
+
+
+class EvaluatorMSE(Evaluator):
+    """MSE against targets (reference MSE evaluator / autoencoder path)."""
+
+    def __init__(self, name=None, inputs=("@input", "@targets", "@mask")):
+        super().__init__(name, inputs)
+
+    def output_spec(self, in_specs):
+        return Spec((), jnp.float32)
+
+    @staticmethod
+    def _mask(xs):
+        return xs[2] if len(xs) > 2 else None
+
+    def apply(self, params, state, xs, ctx):
+        loss, _ = ops.mse_loss(xs[0], xs[1], mask=self._mask(xs))
+        return loss, state
+
+    def metrics(self, params, state, xs, ctx):
+        mask = self._mask(xs)
+        loss, agg = ops.mse_loss(xs[0], xs[1], mask=mask)
+        n = mask.sum() if mask is not None else jnp.asarray(
+            xs[0].shape[0], jnp.float32)
+        return {"loss": loss, "mse_sum": agg, "n_samples": n}

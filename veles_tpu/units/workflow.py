@@ -1,0 +1,315 @@
+"""Workflow: the unit container + compiled step functions.
+
+TPU-native re-design of the reference Workflow/scheduler (reference:
+veles/workflow.py:87 — ordered unit set, dependency-ordered initialize
+:303-349, run-by-gate-propagation :351-369; hot loop veles/units.py:782-803).
+
+THE core architectural change of the rebuild: instead of a thread pool
+propagating "gate open" notifications between live unit objects, the unit DAG
+is topologically sorted once and traced into **two compiled XLA programs** —
+``train_step`` (forward + backward + optimizer update, one fused program the
+MXU pipeline never leaves) and ``eval_step``. The reference's data-dependent
+gating (Decision blocking gradient units during validation,
+SURVEY.md §7 "hard parts") maps exactly onto this train/eval phase split.
+
+What survives from the reference design:
+  * the Workflow as an inspectable container of named units,
+  * wiring checks at build time (replacing ``demand()``'s runtime None
+    checks, veles/units.py:682),
+  * ``gather_results`` metric aggregation (veles/workflow.py:827-849),
+  * graph export for visualization (DOT; veles/workflow.py:628),
+  * checksum identifying the workflow for distributed handshakes
+    (veles/workflow.py:851).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..logger import Logger, TraceContext
+from ..ops.optimizers import Optimizer
+from .base import Context, Spec, Unit
+
+
+class WorkflowError(Exception):
+    pass
+
+
+def new_state(params, state, opt_state, step, key):
+    """The workflow state pytree: everything that is sharded, donated and
+    checkpointed. Replaces the reference's pickled live-object graph
+    (veles/snapshotter.py:387-409 pickled the whole Workflow)."""
+    return {"params": params, "state": state, "opt_state": opt_state,
+            "step": step, "key": key}
+
+
+class Workflow(Logger):
+    """Container + compiler for a unit DAG.
+
+    Usage::
+
+        wf = Workflow("mnist")
+        h = wf.add(All2AllTanh(100, name="fc1", inputs=("@input",)))
+        o = wf.add(All2AllSoftmax(10, name="fc2", inputs=("fc1",)))
+        wf.add(EvaluatorSoftmax(name="ev", inputs=("fc2", "@labels")))
+        wf.build({"@input": Spec((B, 784), f32), "@labels": Spec((B,), i32)})
+        opt = SGD(0.1)
+        wstate = wf.init_state(jax.random.key(0), opt)
+        train = wf.make_train_step(opt)
+        wstate, metrics = train(wstate, batch)
+    """
+
+    def __init__(self, name: str = "Workflow"):
+        self.name = name
+        self.units: List[Unit] = []
+        self._by_name: Dict[str, Unit] = {}
+        self._order: Optional[List[Unit]] = None
+        self._specs: Dict[str, Spec] = {}
+        self._input_specs: Dict[str, Spec] = {}
+        self.evaluator: Optional[Unit] = None
+        self.mesh = None
+        self.state_sharding = None
+
+    # -- construction ------------------------------------------------------
+    def add(self, unit: Unit) -> Unit:
+        if unit.name in self._by_name:
+            raise WorkflowError(f"duplicate unit name {unit.name!r}")
+        self.units.append(unit)
+        self._by_name[unit.name] = unit
+        self._order = None
+        if getattr(unit, "is_evaluator", False):
+            self.evaluator = unit
+        return unit
+
+    def __getitem__(self, name: str) -> Unit:
+        return self._by_name[name]
+
+    def __contains__(self, name):
+        return name in self._by_name
+
+    def topo_order(self) -> List[Unit]:
+        """Topological order over data edges. Build-time cycle/wiring check
+        (replaces runtime gate deadlock debugging in the reference)."""
+        if self._order is not None:
+            return self._order
+        order, seen, visiting = [], set(), set()
+
+        def visit(u: Unit):
+            if u.name in seen:
+                return
+            if u.name in visiting:
+                raise WorkflowError(f"cycle through unit {u.name!r}")
+            visiting.add(u.name)
+            for src in u.inputs:
+                if src.startswith("@"):
+                    continue
+                if src not in self._by_name:
+                    raise WorkflowError(
+                        f"unit {u.name!r} consumes unknown source {src!r}")
+                visit(self._by_name[src])
+            visiting.discard(u.name)
+            seen.add(u.name)
+            order.append(u)
+
+        for u in self.units:
+            visit(u)
+        self._order = order
+        return order
+
+    def build(self, input_specs: Dict[str, Spec]) -> Dict[str, Spec]:
+        """Infer output specs in topo order; validates all wiring."""
+        self._input_specs = dict(input_specs)
+        specs = dict(input_specs)
+        for u in self.topo_order():
+            in_specs = []
+            for src in u.inputs:
+                if src not in specs:
+                    raise WorkflowError(
+                        f"unit {u.name!r} needs {src!r} which is neither a "
+                        f"batch key nor an upstream unit output")
+                in_specs.append(specs[src])
+            specs[u.name] = u.output_spec(in_specs)
+        self._specs = specs
+        return specs
+
+    # -- state -------------------------------------------------------------
+    def init_state(self, key: jax.Array,
+                   optimizer: Optional[Optimizer] = None) -> dict:
+        if not self._specs:
+            raise WorkflowError("call build() before init_state()")
+        params, state = {}, {}
+        keys = jax.random.split(key, len(self.topo_order()) + 1)
+        for u, k in zip(self.topo_order(), keys[:-1]):
+            in_specs = [self._specs[s] for s in u.inputs]
+            p, s = u.init(k, in_specs)
+            if p:
+                params[u.name] = p
+            if s:
+                state[u.name] = s
+        opt_state = optimizer.init(params) if optimizer is not None else {}
+        return new_state(params, state, opt_state,
+                         jnp.zeros((), jnp.int32), keys[-1])
+
+    # -- tracing -----------------------------------------------------------
+    def forward(self, params, state, batch: Dict[str, jax.Array],
+                ctx: Context, *, only: Optional[set] = None
+                ) -> Tuple[Dict[str, jax.Array], dict]:
+        """Pure forward over the DAG; returns (all outputs, new unit state).
+        This is the reference's hot loop (veles/units.py:782-803) as a trace.
+        ``only`` restricts execution to a subset of unit names (ancestors of
+        a prediction target, so inference needs no labels)."""
+        outputs = dict(batch)
+        nstate = {}
+        for u in self.topo_order():
+            if only is not None and u.name not in only:
+                continue
+            xs = [outputs[s] for s in u.inputs]
+            y, ns = u.apply(params.get(u.name, {}), state.get(u.name, {}),
+                            xs, ctx)
+            outputs[u.name] = y
+            if ns:
+                nstate[u.name] = ns
+        return outputs, nstate
+
+    def ancestors(self, name: str) -> set:
+        """Unit names needed to compute ``name`` (inclusive)."""
+        need, stack = set(), [name]
+        while stack:
+            n = stack.pop()
+            if n in need or n.startswith("@"):
+                continue
+            need.add(n)
+            stack.extend(self._by_name[n].inputs)
+        return need
+
+    def _metrics(self, params, state, outputs, ctx) -> Dict[str, jax.Array]:
+        if self.evaluator is None:
+            return {}
+        ev = self.evaluator
+        xs = [outputs[s] for s in ev.inputs]
+        return ev.metrics(params.get(ev.name, {}), state.get(ev.name, {}),
+                          xs, ctx)
+
+    # -- compiled steps ----------------------------------------------------
+    def make_train_step(self, optimizer: Optimizer, *, jit: bool = True,
+                        donate: bool = True) -> Callable:
+        """(wstate, batch) -> (wstate, metrics): forward + grad + update as
+        ONE XLA program. Under a mesh, sharding propagates from the inputs
+        (data-parallel batch -> psum'd grads via jit's partitioner; no
+        hand-written collectives, per the reference→TPU mapping in
+        SURVEY.md §2.5)."""
+        selfupd = [u for u in self.units if getattr(u, "self_updating", False)]
+
+        def step(wstate, batch):
+            key, sub = jax.random.split(wstate["key"])
+            ctx = Context(train=True, key=sub)
+
+            if self.evaluator is not None:
+                def loss_fn(params):
+                    outputs, nstate = self.forward(
+                        params, wstate["state"], batch, ctx)
+                    loss = outputs[self.evaluator.name]
+                    mets = self._metrics(params, wstate["state"], outputs, ctx)
+                    return loss, (outputs, nstate, mets)
+
+                grads, (outputs, nstate, mets) = jax.grad(
+                    loss_fn, has_aux=True)(wstate["params"])
+                params, opt_state = optimizer.update(
+                    grads, wstate["opt_state"], wstate["params"],
+                    wstate["step"])
+            else:  # pure self-organizing workflows (SOM etc.)
+                outputs, nstate = self.forward(
+                    wstate["params"], wstate["state"], batch, ctx)
+                mets = {}
+                params, opt_state = wstate["params"], wstate["opt_state"]
+
+            state = {**wstate["state"], **nstate}
+            for u in selfupd:
+                xs = [outputs[s] for s in u.inputs]
+                state[u.name] = u.update_state(
+                    params.get(u.name, {}), state.get(u.name, {}), xs, ctx)
+
+            nws = new_state(params, state, opt_state,
+                            wstate["step"] + 1, key)
+            return nws, mets
+
+        if jit:
+            return jax.jit(step, donate_argnums=(0,) if donate else ())
+        return step
+
+    def make_eval_step(self, *, jit: bool = True) -> Callable:
+        """(wstate, batch) -> metrics. Separate compiled program = the
+        reference's Decision-gated validation phase."""
+
+        def step(wstate, batch):
+            ctx = Context(train=False, key=None)
+            outputs, _ = self.forward(wstate["params"], wstate["state"],
+                                      batch, ctx)
+            return self._metrics(wstate["params"], wstate["state"],
+                                 outputs, ctx)
+
+        return jax.jit(step) if jit else step
+
+    def make_predict_step(self, output_unit: Optional[str] = None, *,
+                          jit: bool = True) -> Callable:
+        """(wstate, batch) -> output of the last forward (or named) unit."""
+        if output_unit is None:
+            cands = [u.name for u in self.topo_order()
+                     if not getattr(u, "is_evaluator", False)]
+            if not cands:
+                raise WorkflowError("no forward units")
+            output_unit = cands[-1]
+        needed = self.ancestors(output_unit)
+
+        def step(wstate, batch):
+            ctx = Context(train=False, key=None)
+            outputs, _ = self.forward(wstate["params"], wstate["state"],
+                                      batch, ctx, only=needed)
+            return outputs[output_unit]
+
+        return jax.jit(step) if jit else step
+
+    # -- introspection / parity extras -------------------------------------
+    def checksum(self) -> str:
+        """Stable identity of the graph topology (reference:
+        veles/workflow.py:851 — used in the distributed handshake)."""
+        desc = [(u.name, type(u).__name__, list(u.inputs))
+                for u in self.topo_order()]
+        return hashlib.sha256(
+            json.dumps(desc, sort_keys=True).encode()).hexdigest()
+
+    def generate_graph(self) -> str:
+        """DOT source of the data DAG (reference: veles/workflow.py:628)."""
+        lines = [f'digraph "{self.name}" {{', "  rankdir=LR;"]
+        inputs = {s for u in self.units for s in u.inputs
+                  if s.startswith("@")}
+        for i in sorted(inputs):
+            lines.append(f'  "{i}" [shape=oval, style=dashed];')
+        for u in self.units:
+            shape = "diamond" if getattr(u, "is_evaluator", False) else "box"
+            lines.append(
+                f'  "{u.name}" [shape={shape}, '
+                f'label="{u.name}\\n{type(u).__name__}"];')
+            for s in u.inputs:
+                lines.append(f'  "{s}" -> "{u.name}";')
+        lines.append("}")
+        return "\n".join(lines)
+
+    def n_params(self, wstate) -> int:
+        return sum(int(x.size) for x in jax.tree.leaves(wstate["params"]))
+
+    def gather_results(self, metrics: Dict[str, Any]) -> Dict[str, Any]:
+        """JSON-able result dict (reference: IResultProvider →
+        gather_results → --result-file, veles/workflow.py:827-849)."""
+        out = {"workflow": self.name, "checksum": self.checksum()}
+        for k, v in metrics.items():
+            try:
+                out[k] = float(v)
+            except (TypeError, ValueError):
+                out[k] = repr(v)
+        return out
