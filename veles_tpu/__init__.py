@@ -37,3 +37,5 @@ from . import loader
 from .loader import ArrayLoader, FullBatchLoader, Loader
 from . import runtime
 from .runtime import Decision, Snapshotter, Trainer
+from . import parallel
+from .parallel import MeshSpec, make_mesh

@@ -1,0 +1,5 @@
+from .mesh import (MeshSpec, make_mesh, data_parallel_rules, fsdp_rules,
+                   tensor_parallel_rules, batch_shardings, state_shardings,
+                   compose_rules)
+from .distributed import initialize_distributed, is_multihost, host_count
+from .ring_attention import ring_attention, blockwise_attention

@@ -1,0 +1,55 @@
+"""Multi-host process group.
+
+Replaces the reference's Launcher master/slave mode selection + SSH slave
+spawning + Twisted reactor (reference: veles/launcher.py:100,333-342,
+617,808-842) with ``jax.distributed.initialize`` over DCN: one process per
+host, gang-scheduled SPMD, coordinator-based failure detection. Elastic
+membership (reference: slaves join/drop any time, veles/server.py:315-394)
+becomes checkpoint-restart — see runtime/trainer.py + Snapshotter
+(SURVEY.md §5.3 mapping).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+from ..logger import setup_logging
+
+
+def initialize_distributed(coordinator: Optional[str] = None,
+                           num_processes: Optional[int] = None,
+                           process_id: Optional[int] = None) -> None:
+    """Initialize the multi-host runtime (no-op single-host).
+
+    Args mirror ``jax.distributed.initialize``; when None they come from the
+    environment the way the reference's Launcher read ``-m master:port``
+    flags (veles/launcher.py:333-342): VELES_COORDINATOR,
+    VELES_NUM_PROCESSES, VELES_PROCESS_ID.
+    """
+    coordinator = coordinator or os.environ.get("VELES_COORDINATOR")
+    if coordinator is None:
+        return  # standalone
+    num_processes = num_processes if num_processes is not None else int(
+        os.environ.get("VELES_NUM_PROCESSES", "1"))
+    process_id = process_id if process_id is not None else int(
+        os.environ.get("VELES_PROCESS_ID", "0"))
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id)
+    setup_logging()
+
+
+def is_multihost() -> bool:
+    return jax.process_count() > 1
+
+
+def host_count() -> int:
+    return jax.process_count()
+
+
+def host_index() -> int:
+    return jax.process_index()

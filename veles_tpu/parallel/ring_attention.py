@@ -1,0 +1,151 @@
+"""Ring attention: sequence/context parallelism over the mesh 'seq' axis.
+
+NOT in the reference (SURVEY.md §5.7: no attention, no sequence axis — the
+reference's only scale axis was the batch). The build brief makes
+long-context first-class, so this is new TPU-native design: each device in
+the 'seq' ring holds a local block of Q/K/V; K/V blocks rotate around the
+ring via ``jax.lax.ppermute`` over ICI while an online-softmax accumulator
+(running max / denominator / output) folds in one block per step —
+attention over sequences mesh['seq']× longer than one chip's HBM could
+hold, with compute/communication overlap left to XLA's scheduler.
+
+``blockwise_attention`` is the single-device analog (scan over K/V blocks,
+FlashAttention-style numerics) used as the numerical reference and as the
+memory-efficient local path.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _attn_block(q, k, v, m, l, o, *, scale, mask=None):
+    """Fold one K/V block into the online-softmax accumulators.
+
+    q: (B, Tq, H, D); k, v: (B, Tk, H, D); m, l: (B, H, Tq); o: like q
+    (accumulated in f32)."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if mask is not None:
+        s = jnp.where(mask, s, -jnp.inf)
+    m_blk = jnp.max(s, axis=-1)
+    m_new = jnp.maximum(m, m_blk)
+    # guard: fully-masked rows keep m=-inf; exp(-inf - -inf) -> use safe m
+    m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+    alpha = jnp.exp(jnp.where(jnp.isneginf(m), -jnp.inf, m - m_safe))
+    p = jnp.exp(s - m_safe[..., None])
+    l_new = alpha * l + jnp.sum(p, axis=-1)
+    o_new = o * alpha.transpose(0, 2, 1)[..., None] + jnp.einsum(
+        "bhqk,bkhd->bqhd", p, v, preferred_element_type=jnp.float32)
+    return m_new, l_new, o_new
+
+
+def _finalize(l, o):
+    denom = jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    return o / denom
+
+
+def blockwise_attention(q, k, v, *, block_size: int = 512,
+                        causal: bool = False, scale: Optional[float] = None):
+    """Memory-efficient attention on one device: scan over K/V blocks with
+    online softmax. q/k/v: (B, T, H, D) -> (B, T, H, D)."""
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    scale = scale if scale is not None else D ** -0.5
+    block_size = min(block_size, Tk)
+    n_blocks = -(-Tk // block_size)
+    pad = n_blocks * block_size - Tk
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    kb = k.reshape(B, n_blocks, block_size, H, D)
+    vb = v.reshape(B, n_blocks, block_size, H, D)
+    q_idx = jnp.arange(Tq)
+
+    def body(carry, blk):
+        m, l, o = carry
+        k_blk, v_blk, blk_i = blk
+        k_idx = blk_i * block_size + jnp.arange(block_size)
+        mask = (k_idx < Tk)[None, None, None, :]
+        if causal:
+            mask = mask & (k_idx[None, None, None, :]
+                           <= q_idx[None, None, :, None])
+        m, l, o = _attn_block(q, k_blk, v_blk, m, l, o,
+                              scale=scale, mask=mask)
+        return (m, l, o), None
+
+    init = (jnp.full((B, H, Tq), -jnp.inf, jnp.float32),
+            jnp.zeros((B, H, Tq), jnp.float32),
+            jnp.zeros((B, Tq, H, D), jnp.float32))
+    (m, l, o), _ = jax.lax.scan(
+        body, init,
+        (kb.transpose(1, 0, 2, 3, 4), vb.transpose(1, 0, 2, 3, 4),
+         jnp.arange(n_blocks)))
+    return _finalize(l, o).astype(q.dtype)
+
+
+def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
+                          scale: Optional[float]):
+    """Per-shard body (runs under shard_map): rotate K/V around the ring."""
+    axis_size = jax.lax.psum(1, axis_name)
+    axis_idx = jax.lax.axis_index(axis_name)
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    scale_ = scale if scale is not None else D ** -0.5
+    q_pos = axis_idx * Tq + jnp.arange(Tq)
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def body(step, carry):
+        m, l, o, k_cur, v_cur = carry
+        # K/V block currently held arrived from rank (axis_idx - step).
+        src = (axis_idx - step) % axis_size
+        k_pos = src * Tk + jnp.arange(Tk)
+        if causal:
+            mask = (k_pos[None, None, None, :]
+                    <= q_pos[None, None, :, None])
+        else:
+            mask = None
+        m, l, o = _attn_block(q, k_cur, v_cur, m, l, o,
+                              scale=scale_, mask=mask)
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return m, l, o, k_nxt, v_nxt
+
+    init = (jnp.full((B, H, Tq), -jnp.inf, jnp.float32),
+            jnp.zeros((B, H, Tq), jnp.float32),
+            jnp.zeros((B, Tq, H, D), jnp.float32), k, v)
+    m, l, o, _, _ = jax.lax.fori_loop(0, axis_size, body, init)
+    return _finalize(l, o).astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh: Mesh, *, axis_name: str = "seq",
+                   causal: bool = False, scale: Optional[float] = None):
+    """Sequence-parallel attention: q/k/v (B, T, H, D) sharded on T over
+    ``axis_name``; returns output with the same sharding."""
+    spec = P(None, axis_name, None, None)
+    fn = jax.shard_map(
+        functools.partial(_ring_attention_local, axis_name=axis_name,
+                          causal=causal, scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return fn(q, k, v)
+
+
+def full_attention(q, k, v, *, causal: bool = False,
+                   scale: Optional[float] = None):
+    """Plain O(T^2) attention — the numerical reference for the tests."""
+    D = q.shape[-1]
+    scale = scale if scale is not None else D ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        Tq, Tk = q.shape[1], k.shape[1]
+        mask = jnp.arange(Tk)[None, :] <= jnp.arange(Tq)[:, None]
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v).astype(q.dtype)

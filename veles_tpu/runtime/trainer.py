@@ -46,12 +46,16 @@ class Trainer(Logger):
 
     def __init__(self, workflow: Workflow, loader: Loader,
                  optimizer: Optimizer, decision: Optional[Decision] = None,
-                 snapshotter: Optional[Snapshotter] = None):
+                 snapshotter: Optional[Snapshotter] = None, *,
+                 mesh=None, rule=None):
         self.workflow = workflow
         self.loader = loader
         self.optimizer = optimizer
         self.decision = decision or Decision(max_epochs=10)
         self.snapshotter = snapshotter
+        self.mesh = mesh          # jax.sharding.Mesh for SPMD training
+        self.rule = rule          # parameter sharding rule (parallel.mesh)
+        self._batch_sh = None
         self.wstate = None
         self._train_step = None
         self._eval_step = None
@@ -75,8 +79,22 @@ class Trainer(Logger):
             key = prng.get("init").next_key() if seed is None \
                 else jax.random.key(seed)
             self.wstate = self.workflow.init_state(key, self.optimizer)
-        self._train_step = self.workflow.make_train_step(self.optimizer)
-        self._eval_step = self.workflow.make_eval_step()
+        if self.mesh is not None:
+            batch_spec = {k: jax.ShapeDtypeStruct(np.shape(v),
+                                                  np.asarray(v).dtype
+                                                  if not hasattr(v, "dtype")
+                                                  else v.dtype)
+                          for k, v in batch.items()}
+            self._train_step, state_sh, self._batch_sh = \
+                self.workflow.make_sharded_train_step(
+                    self.optimizer, self.mesh, self.wstate, batch_spec,
+                    rule=self.rule)
+            self._eval_step, _, _ = self.workflow.make_sharded_eval_step(
+                self.mesh, self.wstate, batch_spec, rule=self.rule)
+            self.wstate = jax.device_put(self.wstate, state_sh)
+        else:
+            self._train_step = self.workflow.make_train_step(self.optimizer)
+            self._eval_step = self.workflow.make_eval_step()
         self.info("workflow %s: %d params", self.workflow.name,
                   self.workflow.n_params(self.wstate))
 
