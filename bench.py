@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Benchmark harness: AlexNet training throughput, samples/sec/chip.
+
+Metric per BASELINE.json: samples/sec/chip on ImageNet-AlexNet (the Znicz
+ImagenetWorkflow analog), vs the single-V100 CUDA-backend bar. The reference
+publishes no numbers (BASELINE.md), so the bar is the documented estimate
+V100_ALEXNET_SAMPLES_PER_SEC below; measured values land in BASELINE.md.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "samples/sec/chip", "vs_baseline": N}
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+# Published AlexNet end-to-end training throughput on one V100 (fp32 cuDNN,
+# batch 128-256) clusters around 1.5-3k img/s; 2000 is the bar recorded in
+# BASELINE.md for vs_baseline.
+V100_ALEXNET_SAMPLES_PER_SEC = 2000.0
+
+BATCH = 256
+WARMUP = 3
+ITERS = 30
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import veles_tpu as vt
+    from veles_tpu.models import alexnet_workflow
+
+    dev = jax.devices()[0]
+    # Single-device benchmark: the workload runs unsharded on device 0, so
+    # per-chip throughput divides by 1 regardless of host chip count.
+    n_chips = 1
+
+    sw = alexnet_workflow(minibatch_size=BATCH)
+    wf = sw.workflow
+    wf.build({"@input": vt.Spec((BATCH, 227, 227, 3), jnp.float32),
+              "@labels": vt.Spec((BATCH,), jnp.int32),
+              "@mask": vt.Spec((BATCH,), jnp.float32)})
+    wstate = wf.init_state(jax.random.key(0), sw.optimizer)
+    step = wf.make_train_step(sw.optimizer)
+
+    # Pre-staged on-device batches (the fullbatch-loader pattern: data
+    # resident in HBM, only indices travel — veles/loader/fullbatch.py:79).
+    rng = np.random.default_rng(0)
+    batches = []
+    for i in range(2):
+        batches.append({
+            "@input": jax.device_put(rng.standard_normal(
+                (BATCH, 227, 227, 3)).astype(np.float32), dev),
+            "@labels": jax.device_put(
+                (np.arange(BATCH) % 1000).astype(np.int32), dev),
+            "@mask": jax.device_put(np.ones(BATCH, np.float32), dev),
+        })
+
+    for i in range(WARMUP):
+        wstate, mets = step(wstate, batches[i % 2])
+    float(mets["loss"])  # force full queue drain: block_until_ready alone
+    # is unreliable over the axon tunnel (returns early on buffers not yet
+    # scheduled); a scalar read can't be faked.
+
+    t0 = time.perf_counter()
+    for i in range(ITERS):
+        wstate, mets = step(wstate, batches[i % 2])
+    final_loss = float(mets["loss"])  # chains on all prior steps
+    dt = time.perf_counter() - t0
+
+    sps = BATCH * ITERS / dt
+    sps_per_chip = sps / max(n_chips, 1)
+    result = {
+        "metric": "alexnet_train_samples_per_sec_per_chip",
+        "value": round(sps_per_chip, 1),
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(sps_per_chip / V100_ALEXNET_SAMPLES_PER_SEC, 3),
+        "batch": BATCH,
+        "iters": ITERS,
+        "n_chips": n_chips,
+        "device": str(dev),
+        "step_ms": round(1000 * dt / ITERS, 2),
+        "final_loss": round(final_loss, 4),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -141,3 +141,29 @@ def test_ring_attention_matches_full(rng, causal):
                          mesh, causal=causal)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                rtol=2e-4, atol=2e-5)
+
+
+def test_fsdp_axis_size_divisibility():
+    rule = fsdp_rules(min_size=16, axis_size=4)
+    spec = jax.ShapeDtypeStruct((50, 48), jnp.float32)
+    # dim0=50 not divisible by 4 -> falls through to dim1=48
+    assert rule((), spec) == P(None, "fsdp")
+    spec2 = jax.ShapeDtypeStruct((50, 49), jnp.float32)
+    assert rule((), spec2) == P()
+
+
+def test_sharded_rollback_keeps_mesh(rng):
+    """Rollback under a mesh must recompile sharded, not collapse to
+    single-device (review regression)."""
+    mesh = make_mesh()
+    loader = _blob_loader(np.random.default_rng(5), n=256, mb=32)
+    wf = _fc_wf()
+    dec = vt.Decision(max_epochs=4, fail_iterations=10, rollback_after=1)
+    tr = vt.Trainer(wf, loader, vt.optimizers.SGD(0.05, momentum=0.9), dec,
+                    mesh=mesh)
+    tr.initialize(seed=0)
+    tr.run()
+    # state still placed with the mesh sharding
+    sh = tr.wstate["params"]["fc1"]["w"].sharding
+    assert getattr(sh, "mesh", None) is not None
+    assert tr._state_sh is not None

@@ -39,3 +39,5 @@ from . import runtime
 from .runtime import Decision, Snapshotter, Trainer
 from . import parallel
 from .parallel import MeshSpec, make_mesh
+from . import models
+from .models import StandardWorkflow

@@ -1,0 +1,5 @@
+from .standard import StandardWorkflow, build_workflow, LAYER_TYPES
+from .mnist import mnist_workflow, MnistLoader
+from .cifar import cifar_workflow, CifarLoader
+from .alexnet import alexnet_workflow, ImagenetSyntheticLoader
+from .autoencoder import mnist_autoencoder_workflow

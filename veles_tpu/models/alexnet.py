@@ -1,0 +1,89 @@
+"""ImageNet AlexNet — the flagship/benchmark model (BASELINE.json
+north-star: samples/sec/chip on AlexNet, scaling efficiency 1→8 chips).
+
+Reference: the Znicz ImagenetWorkflow (absent submodule; architecture per
+the AlexNet caffe config the reference's docs reference). TPU-first
+choices: NHWC layout, bf16 compute with f32 master weights/accumulation,
+227×227 inputs so conv1 (k11 s4) tiles cleanly, LRN after conv1/conv2 as in
+the original.
+
+ImageNet itself cannot live in HBM or be downloaded here; the loader is a
+deterministic synthetic ImageNet-shaped stream (the throughput benchmark's
+subject is the compute pipeline, not the JPEG decode — the reference's
+fullbatch loader likewise pre-staged decoded tensors on device,
+veles/loader/fullbatch.py:79)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..loader.base import TRAIN, VALID, Loader
+from .standard import StandardWorkflow
+
+ALEXNET_CONFIG = {
+    "name": "AlexNet",
+    "compute_dtype": "bfloat16",
+    "layers": [
+        {"type": "conv_relu", "n_kernels": 96, "kx": 11, "stride": 4,
+         "padding": "VALID", "name": "conv1"},
+        {"type": "lrn", "name": "lrn1"},
+        {"type": "max_pooling", "window": 3, "stride": 2, "name": "pool1"},
+        {"type": "conv_relu", "n_kernels": 256, "kx": 5, "padding": 2,
+         "name": "conv2"},
+        {"type": "lrn", "name": "lrn2"},
+        {"type": "max_pooling", "window": 3, "stride": 2, "name": "pool2"},
+        {"type": "conv_relu", "n_kernels": 384, "kx": 3, "padding": 1,
+         "name": "conv3"},
+        {"type": "conv_relu", "n_kernels": 384, "kx": 3, "padding": 1,
+         "name": "conv4"},
+        {"type": "conv_relu", "n_kernels": 256, "kx": 3, "padding": 1,
+         "name": "conv5"},
+        {"type": "max_pooling", "window": 3, "stride": 2, "name": "pool5"},
+        {"type": "all2all_relu", "output_size": 4096, "name": "fc6"},
+        {"type": "dropout", "dropout_ratio": 0.5, "name": "drop6"},
+        {"type": "all2all_relu", "output_size": 4096, "name": "fc7"},
+        {"type": "dropout", "dropout_ratio": 0.5, "name": "drop7"},
+        {"type": "softmax", "output_size": 1000, "name": "fc8"},
+    ],
+    "loss": "softmax",
+    "optimizer": "momentum",
+    "optimizer_args": {"lr": 0.01, "momentum": 0.9, "l2": 5e-4},
+    "max_epochs": 90,
+}
+
+INPUT_HW = 227
+
+
+class ImagenetSyntheticLoader(Loader):
+    """Deterministic ImageNet-shaped stream: 227x227x3 f32, 1000 classes.
+    Batches are generated on the fly (no dataset residency), modeling the
+    reference's streaming fallback for datasets beyond device memory
+    (veles/loader/fullbatch.py:164-242)."""
+
+    def __init__(self, minibatch_size=128, n_train=4096, n_valid=512,
+                 n_classes=1000, seed=13, **kw):
+        super().__init__(minibatch_size=minibatch_size, **kw)
+        self.n_train = n_train
+        self.n_valid = n_valid
+        self.n_classes = n_classes
+        self.seed = seed
+
+    def load_data(self):
+        self.class_lengths = [0, self.n_valid, self.n_train]
+
+    def fill_minibatch(self, indices, klass):
+        rng = np.random.default_rng(
+            [self.seed, klass, int(indices[0]) if len(indices) else 0])
+        n = len(indices)
+        labels = (indices % self.n_classes).astype(np.int32)
+        x = rng.standard_normal(
+            (n, INPUT_HW, INPUT_HW, 3)).astype(np.float32)
+        return {"@input": x, "@labels": labels}
+
+
+def alexnet_workflow(minibatch_size=128, **overrides) -> StandardWorkflow:
+    cfg = dict(ALEXNET_CONFIG)
+    cfg.update(overrides)
+    sw = StandardWorkflow(cfg)
+    sw.loader = ImagenetSyntheticLoader(minibatch_size=minibatch_size)
+    return sw

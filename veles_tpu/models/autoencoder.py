@@ -1,0 +1,33 @@
+"""MNIST autoencoder (non-classification path).
+
+Reference: Znicz MNIST AE, validation RMSE target 0.5478 (reference: docs
+manualrst_veles_algorithms.rst:71) — an all2all tanh bottleneck trained
+with MSE against the input."""
+
+from __future__ import annotations
+
+from .mnist import MnistLoader
+from .standard import StandardWorkflow
+
+MNIST_AE_CONFIG = {
+    "name": "MnistAutoencoder",
+    "layers": [
+        {"type": "all2all_tanh", "output_size": 100, "name": "enc"},
+        {"type": "all2all", "output_size": 784, "name": "dec",
+         "activation": "linear"},
+    ],
+    "loss": "mse_input",
+    "optimizer": "adadelta",
+    "optimizer_args": {"lr": 1.0},
+    "max_epochs": 20,
+    "fail_iterations": 20,
+}
+
+
+def mnist_autoencoder_workflow(minibatch_size=100,
+                               **overrides) -> StandardWorkflow:
+    cfg = dict(MNIST_AE_CONFIG)
+    cfg.update(overrides)
+    sw = StandardWorkflow(cfg)
+    sw.loader = MnistLoader(minibatch_size=minibatch_size)
+    return sw

@@ -1,0 +1,111 @@
+"""CIFAR-10 convolutional workflow ("cifar_caffe" parity).
+
+Reference: Znicz CIFAR conv net, 17.21 % validation error target
+(reference: docs manualrst_veles_algorithms.rst:52) — a caffe-style
+conv32-pool-conv32-pool-conv64-pool-fc stack. Real CIFAR-10 batches load
+from local files when present; synthetic class-structured images otherwise
+(no network egress)."""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..loader.base import TEST, TRAIN, VALID
+from ..loader.fullbatch import FullBatchLoader
+from ..normalization import NormalizerRegistry
+from .standard import StandardWorkflow
+
+DATA_DIRS = [
+    os.environ.get("VELES_DATA_DIR", ""),
+    os.path.expanduser("~/data/cifar-10-batches-py"),
+    "/root/data/cifar-10-batches-py",
+]
+
+
+def load_real_cifar() -> Optional[Tuple[np.ndarray, ...]]:
+    for d in DATA_DIRS:
+        if d and os.path.exists(os.path.join(d, "data_batch_1")):
+            xs, ys = [], []
+            for i in range(1, 6):
+                with open(os.path.join(d, f"data_batch_{i}"), "rb") as f:
+                    b = pickle.load(f, encoding="bytes")
+                xs.append(b[b"data"])
+                ys.extend(b[b"labels"])
+            with open(os.path.join(d, "test_batch"), "rb") as f:
+                b = pickle.load(f, encoding="bytes")
+            xt = np.concatenate(xs).reshape(-1, 3, 32, 32) \
+                .transpose(0, 2, 3, 1)
+            xte = b[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+            return (xt, np.asarray(ys, np.int32),
+                    xte, np.asarray(b[b"labels"], np.int32))
+    return None
+
+
+def synthesize_cifar(n_train=5000, n_valid=1000, seed=99):
+    rng = np.random.default_rng(seed)
+    coarse = rng.standard_normal((10, 8, 8, 3))
+    templates = np.repeat(np.repeat(coarse, 4, 1), 4, 2) * 48 + 128
+
+    def gen(n):
+        lab = rng.integers(0, 10, n)
+        img = templates[lab] + rng.standard_normal((n, 32, 32, 3)) * 24
+        return np.clip(img, 0, 255).astype(np.uint8), lab.astype(np.int32)
+
+    xt, yt = gen(n_train)
+    xv, yv = gen(n_valid)
+    return xt, yt, xv, yv
+
+
+class CifarLoader(FullBatchLoader):
+    def __init__(self, minibatch_size=100, validation_ratio=0.1, **kw):
+        real = load_real_cifar()
+        if real is not None:
+            xt, yt, xte, yte = real
+            n_valid = int(len(xt) * validation_ratio)
+            data = {TRAIN: xt[n_valid:], VALID: xt[:n_valid], TEST: xte}
+            labels = {TRAIN: yt[n_valid:], VALID: yt[:n_valid], TEST: yte}
+            self.synthetic = False
+        else:
+            xt, yt, xv, yv = synthesize_cifar()
+            data = {TRAIN: xt, VALID: xv}
+            labels = {TRAIN: yt, VALID: yv}
+            self.synthetic = True
+        data = {k: v.astype(np.float32) for k, v in data.items()}
+        super().__init__(
+            data, labels,
+            normalizer=NormalizerRegistry.create("mean_disp"),
+            minibatch_size=minibatch_size, **kw)
+
+
+CIFAR_CONFIG = {
+    "name": "CifarWorkflow",
+    "layers": [
+        {"type": "conv_relu", "n_kernels": 32, "kx": 5, "padding": 2,
+         "name": "conv1"},
+        {"type": "max_pooling", "window": 3, "stride": 2, "name": "pool1"},
+        {"type": "conv_relu", "n_kernels": 32, "kx": 5, "padding": 2,
+         "name": "conv2"},
+        {"type": "avg_pooling", "window": 3, "stride": 2, "name": "pool2"},
+        {"type": "conv_relu", "n_kernels": 64, "kx": 5, "padding": 2,
+         "name": "conv3"},
+        {"type": "avg_pooling", "window": 3, "stride": 2, "name": "pool3"},
+        {"type": "softmax", "output_size": 10, "name": "fc_softmax"},
+    ],
+    "loss": "softmax",
+    "optimizer": "momentum",
+    "optimizer_args": {"lr": 0.01, "momentum": 0.9, "l2": 4e-3},
+    "max_epochs": 40,
+    "fail_iterations": 40,
+}
+
+
+def cifar_workflow(minibatch_size=100, **overrides) -> StandardWorkflow:
+    cfg = dict(CIFAR_CONFIG)
+    cfg.update(overrides)
+    sw = StandardWorkflow(cfg)
+    sw.loader = CifarLoader(minibatch_size=minibatch_size)
+    return sw

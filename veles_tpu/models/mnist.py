@@ -1,0 +1,137 @@
+"""MNIST fully-connected workflow ("MnistSimple" parity).
+
+Reference: the Znicz MNIST workflow — FC 784→100(tanh)→10(softmax), target
+1.48-1.92 % validation error (reference: docs
+manualrst_veles_algorithms.rst:31, manualrst_veles_example.rst:55-57).
+
+Dataset: real MNIST is loaded from local files when present (idx or npz in
+VELES_DATA_DIR / common cache paths — this environment has no network
+egress, matching the reference's Downloader-at-init semantics,
+veles/downloader.py:56). Otherwise a deterministic synthetic digit-like
+dataset (class templates + noise) keeps the full pipeline runnable; the
+quality bar then applies only to real data.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..loader.base import TEST, TRAIN, VALID
+from ..loader.fullbatch import FullBatchLoader
+from ..normalization import NormalizerRegistry
+from .standard import StandardWorkflow
+
+DATA_DIRS = [
+    os.environ.get("VELES_DATA_DIR", ""),
+    os.path.expanduser("~/data/mnist"),
+    os.path.expanduser("~/.cache/mnist"),
+    "/root/data/mnist",
+]
+
+
+def _read_idx(path: str) -> np.ndarray:
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic, = struct.unpack(">I", f.read(4))
+        ndim = magic & 0xFF
+        dims = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+        return np.frombuffer(f.read(), np.uint8).reshape(dims)
+
+
+def load_real_mnist() -> Optional[Tuple[np.ndarray, ...]]:
+    for d in DATA_DIRS:
+        if not d:
+            continue
+        npz = os.path.join(d, "mnist.npz")
+        if os.path.exists(npz):
+            with np.load(npz) as z:
+                return (z["x_train"], z["y_train"],
+                        z["x_test"], z["y_test"])
+        for ext in ("", ".gz"):
+            ti = os.path.join(d, "train-images-idx3-ubyte" + ext)
+            if os.path.exists(ti):
+                return (
+                    _read_idx(ti),
+                    _read_idx(os.path.join(
+                        d, "train-labels-idx1-ubyte" + ext)),
+                    _read_idx(os.path.join(
+                        d, "t10k-images-idx3-ubyte" + ext)),
+                    _read_idx(os.path.join(
+                        d, "t10k-labels-idx1-ubyte" + ext)))
+    return None
+
+
+def synthesize_mnist(n_train=6000, n_valid=1000, seed=77
+                     ) -> Tuple[np.ndarray, ...]:
+    """Deterministic digit-like data: 10 smooth class templates + noise."""
+    rng = np.random.default_rng(seed)
+    # smooth templates: low-frequency random images per class
+    coarse = rng.standard_normal((10, 7, 7))
+    templates = np.kron(coarse, np.ones((4, 4)))[:, :28, :28] * 64 + 128
+
+    def gen(n):
+        lab = rng.integers(0, 10, n)
+        img = templates[lab] + rng.standard_normal((n, 28, 28)) * 32
+        return np.clip(img, 0, 255).astype(np.uint8), lab.astype(np.int32)
+
+    xt, yt = gen(n_train)
+    xv, yv = gen(n_valid)
+    return xt, yt, xv, yv
+
+
+class MnistLoader(FullBatchLoader):
+    """Fullbatch MNIST loader: 28x28 uint8 -> flat normalized f32."""
+
+    def __init__(self, minibatch_size=100, validation_ratio=1 / 6,
+                 synthetic_ok=True, **kw):
+        real = load_real_mnist()
+        if real is not None:
+            xt, yt, xte, yte = real
+            n_valid = int(len(xt) * validation_ratio)
+            data = {TRAIN: xt[n_valid:], VALID: xt[:n_valid], TEST: xte}
+            labels = {TRAIN: yt[n_valid:].astype(np.int32),
+                      VALID: yt[:n_valid].astype(np.int32),
+                      TEST: yte.astype(np.int32)}
+            self.synthetic = False
+        elif synthetic_ok:
+            xt, yt, xv, yv = synthesize_mnist()
+            data = {TRAIN: xt, VALID: xv}
+            labels = {TRAIN: yt, VALID: yv}
+            self.synthetic = True
+        else:
+            raise FileNotFoundError("no MNIST data found; set VELES_DATA_DIR")
+        data = {k: (v.reshape(len(v), -1).astype(np.float32))
+                for k, v in data.items()}
+        super().__init__(
+            data, labels,
+            normalizer=NormalizerRegistry.create(
+                "range_linear", source_range=(0, 255), interval=(-1, 1)),
+            minibatch_size=minibatch_size, **kw)
+
+
+MNIST_CONFIG = {
+    "name": "MnistWorkflow",
+    "layers": [
+        {"type": "all2all_tanh", "output_size": 100, "name": "fc_tanh",
+         "hyperparams": {"lr_scale": 1.0}},
+        {"type": "softmax", "output_size": 10, "name": "fc_softmax"},
+    ],
+    "loss": "softmax",
+    "optimizer": "momentum",
+    "optimizer_args": {"lr": 0.03, "momentum": 0.9, "l2": 1e-5},
+    "max_epochs": 25,
+    "fail_iterations": 25,
+}
+
+
+def mnist_workflow(minibatch_size=100, **overrides) -> StandardWorkflow:
+    cfg = dict(MNIST_CONFIG)
+    cfg.update(overrides)
+    sw = StandardWorkflow(cfg)
+    sw.loader = MnistLoader(minibatch_size=minibatch_size)
+    return sw

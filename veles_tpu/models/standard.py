@@ -1,0 +1,121 @@
+"""StandardWorkflow: build a full training workflow from a config layer
+list.
+
+Reference parity: Znicz's ``StandardWorkflow`` wired
+loader→forwards→evaluator→decision→gradient-units→plotters from a config
+layer list (reference: docs manualrst_veles_workflow_creation.rst;
+SURVEY.md §2.10). Here gradient units don't exist (autodiff), so the factory
+wires loader→forwards→evaluator and pairs with a Trainer.
+
+Layer dicts: ``{"type": "conv_relu", "n_kernels": 96, "kx": 11, ...}``;
+``type`` resolves through LAYER_TYPES. The per-layer ``hyperparams`` key
+lands in the optimizer's per-unit table (per-layer lr/momentum/l2 —
+reference item docs manualrst_veles_algorithms.rst:166).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+from ..ops.optimizers import HyperParams, OPTIMIZERS, Optimizer
+from ..units import nn
+from ..units.workflow import Workflow
+
+LAYER_TYPES = {
+    "all2all": nn.All2All,
+    "all2all_tanh": nn.All2AllTanh,
+    "all2all_relu": nn.All2AllRELU,
+    "all2all_sincos": nn.All2AllSincos,
+    "softmax": nn.All2AllSoftmax,
+    "conv": nn.Conv,
+    "conv_relu": nn.ConvRELU,
+    "conv_tanh": nn.ConvTanh,
+    "deconv": nn.Deconv,
+    "max_pooling": nn.MaxPooling,
+    "avg_pooling": nn.AvgPooling,
+    "stochastic_abs_pooling": nn.StochasticAbsPooling,
+    "depool": nn.Depool,
+    "dropout": nn.Dropout,
+    "lrn": nn.LRN,
+    "norm": nn.MeanDispNormalizer,
+    "flatten": nn.Flatten,
+}
+
+
+def build_workflow(name: str, layers: Sequence[dict], *,
+                   loss: str = "softmax",
+                   compute_dtype: Optional[str] = None) -> Workflow:
+    """Construct a Workflow from a layer-config list.
+
+    ``loss``: "softmax" -> EvaluatorSoftmax on (@labels, @mask);
+              "mse"     -> EvaluatorMSE on (@targets, @mask);
+              "mse_input" -> EvaluatorMSE against @input (autoencoders).
+    """
+    wf = Workflow(name)
+    prev = "@input"
+    for i, spec in enumerate(layers):
+        spec = dict(spec)
+        ltype = spec.pop("type")
+        spec.pop("hyperparams", None)
+        lname = spec.pop("name", f"l{i}_{ltype}")
+        klass = LAYER_TYPES[ltype]
+        if compute_dtype is not None and ltype.startswith(
+                ("all2all", "softmax", "conv", "deconv")):
+            spec.setdefault("compute_dtype", compute_dtype)
+        unit = klass(name=lname, inputs=(prev,), **spec)
+        wf.add(unit)
+        prev = lname
+
+    if loss == "softmax":
+        wf.add(nn.EvaluatorSoftmax(name="evaluator",
+                                   inputs=(prev, "@labels", "@mask")))
+    elif loss == "mse":
+        wf.add(nn.EvaluatorMSE(name="evaluator",
+                               inputs=(prev, "@targets", "@mask")))
+    elif loss == "mse_input":
+        wf.add(nn.EvaluatorMSE(name="evaluator",
+                               inputs=(prev, "@input", "@mask")))
+    elif loss == "none":
+        pass
+    else:
+        raise ValueError(f"unknown loss {loss!r}")
+    return wf
+
+
+def build_optimizer(kind: str, layers: Sequence[dict],
+                    **kwargs) -> Optimizer:
+    """Optimizer from name + per-layer hyperparams gathered off the layer
+    configs (the reference's per-gradient-unit settings)."""
+    per_unit: Dict[str, HyperParams] = {}
+    for i, spec in enumerate(layers):
+        hp = spec.get("hyperparams")
+        if hp:
+            lname = spec.get("name", f"l{i}_{spec['type']}")
+            per_unit[lname] = HyperParams(**hp) \
+                if isinstance(hp, dict) else hp
+    return OPTIMIZERS[kind](per_unit=per_unit, **kwargs)
+
+
+class StandardWorkflow:
+    """Convenience bundle: workflow + optimizer + decision settings from one
+    config dict (the shape of a reference "workflow config" file)."""
+
+    def __init__(self, config: dict):
+        self.config = dict(config)
+        layers = self.config["layers"]
+        self.workflow = build_workflow(
+            self.config.get("name", "StandardWorkflow"), layers,
+            loss=self.config.get("loss", "softmax"),
+            compute_dtype=self.config.get("compute_dtype"))
+        okind = self.config.get("optimizer", "momentum")
+        oargs = dict(self.config.get("optimizer_args", {}))
+        self.optimizer = build_optimizer(okind, layers, **oargs)
+
+    def make_trainer(self, loader, decision=None, snapshotter=None,
+                     mesh=None, rule=None):
+        from ..runtime import Decision, Trainer
+        decision = decision or Decision(
+            max_epochs=self.config.get("max_epochs"),
+            fail_iterations=self.config.get("fail_iterations", 50))
+        return Trainer(self.workflow, loader, self.optimizer, decision,
+                       snapshotter, mesh=mesh, rule=rule)

@@ -21,18 +21,25 @@ def _pair(v):
 
 def conv2d(x, w, b=None, *, stride=1, padding="SAME", precision=None,
            compute_dtype=None):
-    """x: (N,H,W,C), w: (kh,kw,Cin,Cout)."""
+    """x: (N,H,W,C), w: (kh,kw,Cin,Cout).
+
+    Under the bf16 policy inputs are cast down and the conv runs in bf16:
+    the TPU MXU accumulates bf16 convolutions in float32 in hardware, so no
+    preferred_element_type is forced (doing so breaks the conv gradient
+    rule, which requires matching operand dtypes)."""
     out_dtype = jnp.result_type(x.dtype, w.dtype)
+    preferred = jnp.float32
     if compute_dtype is not None:
         x = x.astype(compute_dtype)
         w = w.astype(compute_dtype)
+        preferred = None
     if isinstance(padding, int):
         p = _pair(padding)
         padding = ((p[0], p[0]), (p[1], p[1]))
     y = jax.lax.conv_general_dilated(
         x, w, window_strides=_pair(stride), padding=padding,
         dimension_numbers=DIMS, precision=precision,
-        preferred_element_type=jnp.float32)
+        preferred_element_type=preferred)
     y = y.astype(out_dtype)
     if b is not None:
         y = y + b
@@ -41,18 +48,21 @@ def conv2d(x, w, b=None, *, stride=1, padding="SAME", precision=None,
 
 def deconv2d(x, w, b=None, *, stride=1, padding="SAME", precision=None,
              compute_dtype=None):
-    """Transposed conv (reference Znicz 'deconv')."""
+    """Transposed conv (reference Znicz 'deconv'). Same dtype policy as
+    conv2d: bf16 operands rely on MXU f32 accumulation."""
     out_dtype = jnp.result_type(x.dtype, w.dtype)
+    preferred = jnp.float32
     if compute_dtype is not None:
         x = x.astype(compute_dtype)
         w = w.astype(compute_dtype)
+        preferred = None
     if isinstance(padding, int):
         p = _pair(padding)
         padding = ((p[0], p[0]), (p[1], p[1]))
     y = jax.lax.conv_transpose(
         x, w, strides=_pair(stride), padding=padding,
         dimension_numbers=DIMS, precision=precision,
-        preferred_element_type=jnp.float32)
+        preferred_element_type=preferred)
     y = y.astype(out_dtype)
     if b is not None:
         y = y + b

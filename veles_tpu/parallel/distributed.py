@@ -32,10 +32,12 @@ def initialize_distributed(coordinator: Optional[str] = None,
     coordinator = coordinator or os.environ.get("VELES_COORDINATOR")
     if coordinator is None:
         return  # standalone
-    num_processes = num_processes if num_processes is not None else int(
-        os.environ.get("VELES_NUM_PROCESSES", "1"))
-    process_id = process_id if process_id is not None else int(
-        os.environ.get("VELES_PROCESS_ID", "0"))
+    # Leave None through to jax.distributed.initialize so it can auto-detect
+    # from the cluster environment; only override from VELES_* when present.
+    if num_processes is None and "VELES_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["VELES_NUM_PROCESSES"])
+    if process_id is None and "VELES_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["VELES_PROCESS_ID"])
     jax.distributed.initialize(
         coordinator_address=coordinator,
         num_processes=num_processes,

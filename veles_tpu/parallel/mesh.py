@@ -81,18 +81,24 @@ def data_parallel_rules(path, spec) -> P:
     return P()
 
 
-def fsdp_rules(min_size: int = 2 ** 16, axis: str = "fsdp") -> Rule:
+def fsdp_rules(min_size: int = 2 ** 16, axis: str = "fsdp",
+               axis_size: Optional[int] = None) -> Rule:
     """Shard large parameters over the fsdp axis on their largest
     divisible dimension (ZeRO-3-ish; weights all_gather on use,
-    grads reduce_scatter — all XLA-inserted)."""
+    grads reduce_scatter — all XLA-inserted).
+
+    Pass ``axis_size`` (the mesh's fsdp extent) to skip dims that don't
+    tile; without it the largest dim is chosen and state_shardings'
+    divisibility guard may drop the annotation entirely."""
 
     def rule(path, spec) -> P:
         if math.prod(spec.shape) < min_size:
             return P()
-        # pick the largest dim; GSPMD requires divisibility for clean tiles
         dims = sorted(range(len(spec.shape)),
                       key=lambda d: -spec.shape[d])
         for d in dims:
+            if axis_size is not None and spec.shape[d] % axis_size != 0:
+                continue
             parts: list = [None] * len(spec.shape)
             parts[d] = axis
             return P(*parts)
@@ -149,10 +155,7 @@ def state_shardings(wstate_spec, mesh: Mesh, rule: Rule = None):
         shape = getattr(spec, "shape", ())
         if len(shape) == 0:
             return NamedSharding(mesh, P())
-        try:
-            pspec = rule(path, spec)
-        except Exception:
-            pspec = P()
+        pspec = rule(path, spec)
         # divisibility guard: drop axes that don't tile
         parts = []
         for d, ax in enumerate(tuple(pspec) + (None,) * len(shape)):

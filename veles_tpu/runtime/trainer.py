@@ -56,6 +56,8 @@ class Trainer(Logger):
         self.mesh = mesh          # jax.sharding.Mesh for SPMD training
         self.rule = rule          # parameter sharding rule (parallel.mesh)
         self._batch_sh = None
+        self._state_sh = None
+        self._batch_spec = None
         self.wstate = None
         self._train_step = None
         self._eval_step = None
@@ -79,30 +81,35 @@ class Trainer(Logger):
             key = prng.get("init").next_key() if seed is None \
                 else jax.random.key(seed)
             self.wstate = self.workflow.init_state(key, self.optimizer)
-        if self.mesh is not None:
-            batch_spec = {k: jax.ShapeDtypeStruct(np.shape(v),
-                                                  np.asarray(v).dtype
-                                                  if not hasattr(v, "dtype")
-                                                  else v.dtype)
-                          for k, v in batch.items()}
-            self._train_step, state_sh, self._batch_sh = \
-                self.workflow.make_sharded_train_step(
-                    self.optimizer, self.mesh, self.wstate, batch_spec,
-                    rule=self.rule)
-            self._eval_step, _, _ = self.workflow.make_sharded_eval_step(
-                self.mesh, self.wstate, batch_spec, rule=self.rule)
-            self.wstate = jax.device_put(self.wstate, state_sh)
-        else:
-            self._train_step = self.workflow.make_train_step(self.optimizer)
-            self._eval_step = self.workflow.make_eval_step()
+        self._batch_spec = specs
+        self._compile_steps()
+        if self._state_sh is not None:
+            self.wstate = jax.device_put(self.wstate, self._state_sh)
         self.info("workflow %s: %d params", self.workflow.name,
                   self.workflow.n_params(self.wstate))
+
+    def _compile_steps(self) -> None:
+        """(Re)build train/eval steps, preserving mesh shardings — called at
+        init and after a rollback lr change."""
+        if self.mesh is not None:
+            self._train_step, self._state_sh, self._batch_sh = \
+                self.workflow.make_sharded_train_step(
+                    self.optimizer, self.mesh, self.wstate,
+                    self._batch_spec, rule=self.rule)
+            self._eval_step, _, _ = self.workflow.make_sharded_eval_step(
+                self.mesh, self.wstate, self._batch_spec, rule=self.rule)
+        else:
+            self._state_sh = None
+            self._train_step = self.workflow.make_train_step(self.optimizer)
+            self._eval_step = self.workflow.make_eval_step()
 
     # -- epoch passes -------------------------------------------------------
     def _run_epoch_train(self, epoch: int) -> Dict[str, float]:
         sums: Dict[str, float] = {}
         with TraceContext("train_epoch", epoch=epoch):
             for batch in self.loader.iter_epoch(TRAIN, epoch):
+                if self._batch_sh is not None:
+                    batch = jax.device_put(batch, self._batch_sh)
                 self.wstate, mets = self._train_step(self.wstate, batch)
                 for k, v in mets.items():
                     sums[k] = sums.get(k, 0.0) + float(v)
@@ -115,6 +122,8 @@ class Trainer(Logger):
         sums: Dict[str, float] = {}
         with TraceContext("eval_epoch", epoch=epoch, klass=klass):
             for batch in self.loader.iter_epoch(klass, epoch):
+                if self._batch_sh is not None:
+                    batch = jax.device_put(batch, self._batch_sh)
                 mets = self._eval_step(self.wstate, batch)
                 for k, v in mets.items():
                     sums[k] = sums.get(k, 0.0) + float(v)
@@ -142,13 +151,14 @@ class Trainer(Logger):
                 self._best_wstate = _to_numpy(self.wstate)
             if self.decision.want_rollback and self._best_wstate is not None:
                 # Reference: rollback to best snapshot + lr drop
-                # (manualrst_veles_algorithms.rst:164).
+                # (manualrst_veles_algorithms.rst:164). Recompile preserves
+                # mesh shardings; restore re-places onto the mesh.
                 self.wstate = Snapshotter.restore_wstate(
-                    {"wstate": self._best_wstate}, like=self.wstate)
+                    {"wstate": self._best_wstate}, like=self.wstate,
+                    shardings=self._state_sh)
                 self.optimizer.schedule = _scaled_schedule(
                     self.optimizer.schedule, self.decision.rollback_lr_scale)
-                self._train_step = self.workflow.make_train_step(
-                    self.optimizer)
+                self._compile_steps()
 
             # Advance the loader first so a restored checkpoint resumes at
             # the *next* epoch instead of repeating the completed one.
