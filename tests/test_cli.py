@@ -1,0 +1,90 @@
+"""CLI end-to-end (reference: veles/tests/test_velescli.py drove Main with
+--dry-run levels)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CONFIG_PY = """
+import numpy as np
+import veles_tpu as vt
+from veles_tpu.loader.base import TRAIN, VALID
+from veles_tpu.units import (All2AllSoftmax, All2AllTanh, EvaluatorSoftmax,
+                             Workflow)
+
+root.my.lr = root.my.get("lr", 0.05)
+
+def create(root):
+    centers = np.random.default_rng(7).standard_normal((4, 8)) * 3
+    rng = np.random.default_rng(0)
+    lab = rng.integers(0, 4, 256).astype(np.int32)
+    d = (centers[lab] + rng.standard_normal((256, 8))).astype(np.float32)
+    loader = vt.ArrayLoader({TRAIN: d, VALID: d[:64]},
+                            {TRAIN: lab, VALID: lab[:64]},
+                            minibatch_size=64)
+    wf = Workflow("cli_test")
+    wf.add(All2AllTanh(16, name="fc1"))
+    wf.add(All2AllSoftmax(4, name="out", inputs=("fc1",)))
+    wf.add(EvaluatorSoftmax(name="ev", inputs=("out", "@labels", "@mask")))
+    return vt.Trainer(wf, loader,
+                      vt.optimizers.SGD(float(root.my.lr), momentum=0.9),
+                      vt.Decision(max_epochs=2))
+"""
+
+
+def run_cli(tmp_path, *argv):
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO)
+    return subprocess.run(
+        [sys.executable, "-c",
+         "import jax; jax.config.update('jax_platforms','cpu');"
+         "from veles_tpu.__main__ import main; import sys;"
+         "sys.exit(main(sys.argv[1:]))", *argv],
+        capture_output=True, text=True, env=env, cwd=str(tmp_path),
+        timeout=300)
+
+
+@pytest.fixture
+def config_file(tmp_path):
+    p = tmp_path / "wf.py"
+    p.write_text(CONFIG_PY)
+    return str(p)
+
+
+def test_cli_dry_run_build(tmp_path, config_file):
+    r = run_cli(tmp_path, config_file, "--dry-run", "build")
+    assert r.returncode == 0, r.stderr
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["dry_run"] == "build" and out["n_params"] > 0
+
+
+def test_cli_train_and_result_file(tmp_path, config_file):
+    res = tmp_path / "res.json"
+    r = run_cli(tmp_path, config_file, "--result-file", str(res))
+    assert r.returncode == 0, r.stderr
+    data = json.loads(res.read_text())
+    assert data["workflow"] == "cli_test"
+    assert data["best_value"] < 50.0
+
+
+def test_cli_override(tmp_path, config_file):
+    r = run_cli(tmp_path, config_file, "my.lr=0.001", "--dry-run", "build")
+    assert r.returncode == 0, r.stderr
+
+
+def test_cli_dump_config(tmp_path, config_file):
+    r = run_cli(tmp_path, config_file, "--dump-config")
+    assert r.returncode == 0, r.stderr
+    assert '"lr"' in r.stdout
+
+
+def test_cli_list_units(tmp_path):
+    r = run_cli(tmp_path, "--list-units")
+    assert r.returncode == 0, r.stderr
+    assert "All2AllSoftmax" in r.stdout and "KohonenForward" in r.stdout

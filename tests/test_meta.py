@@ -1,0 +1,100 @@
+"""Meta-workflows: genetic optimization + ensemble (reference:
+veles/genetics/, veles/ensemble/ — SURVEY.md §2.6)."""
+
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import veles_tpu as vt
+from veles_tpu.config import Config, Range
+from veles_tpu.ensemble import EnsembleTester, EnsembleTrainer
+from veles_tpu.genetics import GeneticOptimizer
+from veles_tpu.loader.base import TRAIN, VALID
+from veles_tpu.units import (All2AllSoftmax, All2AllTanh, EvaluatorSoftmax,
+                             Workflow)
+
+
+def test_ga_minimizes_quadratic():
+    """GA must find the minimum of a smooth function over Range tuneables."""
+    cfg = Config()
+    cfg.model.x = Range(5.0, -10.0, 10.0)
+    cfg.model.y = Range(-3.0, -10.0, 10.0)
+    cfg.model.act = Range.choice("bad", ["bad", "good"])
+
+    def fitness(c):
+        penalty = 0.0 if c.model.act == "good" else 5.0
+        return (c.model.x - 2.0) ** 2 + (c.model.y - 1.0) ** 2 + penalty
+
+    ga = GeneticOptimizer(cfg, fitness, population_size=24, generations=15,
+                          seed=1)
+    best = ga.run()
+    assert best.fitness < 0.5, best
+    assert best.genome["model.act"] == "good"
+    # history monotone non-increasing best
+    bests = [h["best"] for h in ga.history]
+    assert bests == sorted(bests, reverse=True) or bests[-1] <= bests[0]
+
+
+def test_ga_requires_tuneables():
+    with pytest.raises(ValueError, match="no Range"):
+        GeneticOptimizer(Config(), lambda c: 0.0)
+
+
+def _blobs(seed, n, centers):
+    rng = np.random.default_rng(seed)
+    lab = rng.integers(0, 4, n).astype(np.int32)
+    return (centers[lab] + rng.standard_normal((n, 8))).astype(
+        np.float32), lab
+
+
+CENTERS = np.random.default_rng(7).standard_normal((4, 8)) * 3.0
+
+
+def _member_factory(tmp_path):
+    def factory(member_id, seed, train_ratio):
+        xt, yt = _blobs(seed, int(256 * train_ratio), CENTERS)
+        xv, yv = _blobs(999, 128, CENTERS)
+        loader = vt.ArrayLoader({TRAIN: xt, VALID: xv},
+                                {TRAIN: yt, VALID: yv}, minibatch_size=64)
+        wf = Workflow(f"member{member_id}")
+        wf.add(All2AllTanh(16, name="fc1"))
+        wf.add(All2AllSoftmax(4, name="out", inputs=("fc1",)))
+        wf.add(EvaluatorSoftmax(name="ev",
+                                inputs=("out", "@labels", "@mask")))
+        return vt.Trainer(wf, loader,
+                          vt.optimizers.SGD(0.05, momentum=0.9),
+                          vt.Decision(max_epochs=4, fail_iterations=10))
+    return factory
+
+
+def test_ensemble_train_and_vote(tmp_path, rng):
+    out = str(tmp_path / "ens")
+    et = EnsembleTrainer(_member_factory(tmp_path), n_models=3,
+                         train_ratio=0.8, out_dir=out)
+    results = et.run()
+    assert len(results) == 3
+    manifest = os.path.join(out, "ensemble.json")
+    assert os.path.exists(manifest)
+
+    def wf_factory():
+        wf = Workflow("member")
+        wf.add(All2AllTanh(16, name="fc1"))
+        wf.add(All2AllSoftmax(4, name="out", inputs=("fc1",)))
+        wf.add(EvaluatorSoftmax(name="ev",
+                                inputs=("out", "@labels", "@mask")))
+        wf.build({"@input": vt.Spec((64, 8), jnp.float32),
+                  "@labels": vt.Spec((64,), jnp.int32),
+                  "@mask": vt.Spec((64,), jnp.float32)})
+        return wf
+
+    tester = EnsembleTester(wf_factory, manifest)
+    xv, yv = _blobs(999, 128, CENTERS)
+    batches = [{"@input": xv[i:i + 64], "@labels": yv[i:i + 64],
+                "@mask": np.ones(64, np.float32)}
+               for i in range(0, 128, 64)]
+    err = tester.error_rate(batches)
+    worst_member = max(r["best_value"] for r in results)
+    assert err <= worst_member + 1.0, (err, worst_member)

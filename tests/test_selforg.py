@@ -1,0 +1,73 @@
+"""Self-organizing (non-SGD) units: Kohonen SOM + RBM — the reference's
+non-gradient training paths (docs manualrst_veles_algorithms.rst:61-114)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import veles_tpu as vt
+from veles_tpu.units import KohonenForward, RBM, Spec, Workflow
+
+
+def test_som_quantization_error_decreases(rng):
+    centers = rng.standard_normal((4, 8)) * 2
+    lab = rng.integers(0, 4, 256)
+    x = (centers[lab] + 0.1 * rng.standard_normal((256, 8))).astype(
+        np.float32)
+
+    wf = Workflow("som")
+    som = wf.add(KohonenForward((6, 6), init_lr=0.5, decay_steps=200,
+                                name="som"))
+    wf.build({"@input": Spec((64, 8), jnp.float32)})
+    ws = wf.init_state(jax.random.key(0))
+    step = wf.make_train_step(vt.optimizers.SGD(0.0), donate=False)
+
+    e0 = float(som.quantization_error(ws["state"]["som"], x))
+    for ep in range(30):
+        for i in range(0, 256, 64):
+            ws, _ = step(ws, {"@input": jnp.asarray(x[i:i + 64])})
+    e1 = float(som.quantization_error(ws["state"]["som"], x))
+    assert e1 < e0 * 0.5, (e0, e1)
+
+
+def test_som_winner_output_shape():
+    wf = Workflow("som")
+    wf.add(KohonenForward((4, 4), name="som"))
+    wf.build({"@input": Spec((8, 5), jnp.float32)})
+    ws = wf.init_state(jax.random.key(1))
+    predict = wf.make_predict_step("som")
+    y = predict(ws, {"@input": jnp.ones((8, 5))})
+    assert y.shape == (8,) and y.dtype == jnp.int32
+    assert int(y.max()) < 16
+
+
+def test_rbm_reconstruction_improves(rng):
+    # binary-ish patterns: two prototype vectors + noise
+    protos = (rng.random((2, 16)) > 0.5).astype(np.float32)
+    idx = rng.integers(0, 2, 512)
+    x = np.clip(protos[idx] + 0.05 * rng.standard_normal((512, 16)), 0, 1
+                ).astype(np.float32)
+
+    wf = Workflow("rbm")
+    rbm = wf.add(RBM(8, lr=0.1, name="rbm"))
+    wf.build({"@input": Spec((64, 16), jnp.float32)})
+    ws = wf.init_state(jax.random.key(0))
+    step = wf.make_train_step(vt.optimizers.SGD(0.0), donate=False)
+
+    e0 = float(rbm.reconstruction_error(ws["state"]["rbm"], x))
+    for ep in range(20):
+        for i in range(0, 512, 64):
+            ws, _ = step(ws, {"@input": jnp.asarray(x[i:i + 64])})
+    e1 = float(rbm.reconstruction_error(ws["state"]["rbm"], x))
+    assert e1 < e0 * 0.7, (e0, e1)
+
+
+def test_rbm_update_deterministic_given_key(rng):
+    from veles_tpu.units.base import Context
+    x = jnp.asarray(rng.random((16, 8)).astype(np.float32))
+    rbm = RBM(4, name="rbm")
+    _, st = rbm.init(jax.random.key(0), [Spec((16, 8), jnp.float32)])
+    ctx = Context(train=True, key=jax.random.key(42))
+    s1 = rbm.update_state({}, st, [x], ctx)
+    s2 = rbm.update_state({}, st, [x], ctx)
+    np.testing.assert_allclose(np.asarray(s1["w"]), np.asarray(s2["w"]))

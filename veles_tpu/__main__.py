@@ -1,0 +1,224 @@
+"""CLI entry point: ``python -m veles_tpu <config> [options] [overrides]``.
+
+Reference parity: veles/__main__.py (``Main`` :136) — positional workflow +
+config files, ``--optimize N[:G]`` GA mode (:716-734), ``--ensemble-train
+N:r`` / ``--ensemble-test``, ``--dump-config``, ``--result-file``,
+``--random-seed`` (:483-537), snapshot-restore positional (:539-589),
+``--dry-run`` levels, inline ``root.x.y=z`` overrides (:474-481).
+
+Config conventions (TPU-native redesign of "user config files are executed
+Python mutating root", veles/__main__.py:426-472):
+
+* ``config.py``  — executed with ``root`` bound; must define
+  ``create(root) -> veles_tpu.Trainer`` (full control), OR set
+  ``root.workflow`` / ``root.loader`` trees for the standard path.
+* ``config.json`` — merged into ``root``; must contain ``workflow``
+  (StandardWorkflow layer config) and ``loader`` ({"name": ..., args}).
+
+Named loaders: mnist, cifar, imagenet_synthetic.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import runpy
+import sys
+from typing import Optional
+
+from . import prng
+from .config import Config, apply_overrides, root
+from .logger import setup_logging
+from .runtime import Decision, Snapshotter, Trainer
+
+
+LOADERS = {
+    "mnist": "veles_tpu.models.mnist:MnistLoader",
+    "cifar": "veles_tpu.models.cifar:CifarLoader",
+    "imagenet_synthetic":
+        "veles_tpu.models.alexnet:ImagenetSyntheticLoader",
+}
+
+
+def make_loader(name: str, **args):
+    import importlib
+    mod, _, attr = LOADERS[name].partition(":")
+    return getattr(importlib.import_module(mod), attr)(**args)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="veles_tpu",
+        description="TPU-native deep learning framework "
+                    "(Veles-capability rebuild)")
+    p.add_argument("config", nargs="?",
+                   help="config .py/.json, or a snapshot .json manifest "
+                        "to resume")
+    p.add_argument("overrides", nargs="*", default=[],
+                   help="inline config overrides: path.to.key=value")
+    p.add_argument("--snapshot", help="snapshot manifest to restore from")
+    p.add_argument("--random-seed", type=int, default=None)
+    p.add_argument("--dump-config", action="store_true")
+    p.add_argument("--dry-run", choices=["init", "build"], default=None,
+                   help="stop after loader init / workflow build")
+    p.add_argument("--result-file", help="write results JSON here")
+    p.add_argument("--optimize", metavar="N[:G]",
+                   help="GA over config Range tuneables: population[:gens]")
+    p.add_argument("--ensemble-train", metavar="N:r",
+                   help="train N members on ratio-r subsets")
+    p.add_argument("--ensemble-test", metavar="MANIFEST",
+                   help="test an ensemble from its manifest JSON")
+    p.add_argument("--mesh", help="mesh spec, e.g. data=4,model=2")
+    p.add_argument("--max-epochs", type=int, default=None)
+    p.add_argument("--snapshot-dir", default=None)
+    p.add_argument("--verbose", "-v", action="store_true")
+    p.add_argument("--list-units", action="store_true",
+                   help="print the registered unit classes and exit")
+    return p
+
+
+def _make_trainer_from_root(cfg: Config, args) -> Trainer:
+    """The standard path: root.workflow + root.loader trees."""
+    from .models.standard import StandardWorkflow
+    wf_cfg = cfg.workflow.to_dict() if "workflow" in cfg else None
+    if not wf_cfg:
+        raise SystemExit("config must define root.workflow (layer list) "
+                         "or a create(root) function")
+    sw = StandardWorkflow(wf_cfg)
+    loader_cfg = cfg.loader.to_dict() if "loader" in cfg else {}
+    name = loader_cfg.pop("name", "mnist")
+    loader = make_loader(name, **loader_cfg)
+    decision = Decision(
+        max_epochs=args.max_epochs or wf_cfg.get("max_epochs"),
+        fail_iterations=wf_cfg.get("fail_iterations", 50))
+    snap = None
+    if args.snapshot_dir:
+        snap = Snapshotter(wf_cfg.get("name", "workflow"),
+                           args.snapshot_dir)
+    mesh = _make_mesh(args.mesh)
+    return Trainer(sw.workflow, loader, sw.optimizer, decision, snap,
+                   mesh=mesh)
+
+
+def _make_mesh(spec: Optional[str]):
+    if not spec:
+        return None
+    from .parallel import MeshSpec, make_mesh
+    kw = {}
+    for part in spec.split(","):
+        k, _, v = part.partition("=")
+        kw[k.strip()] = int(v)
+    return make_mesh(MeshSpec(**kw))
+
+
+def _load_config(path: str, overrides) -> tuple:
+    """Returns (trainer_factory or None, used create())"""
+    create = None
+    if path.endswith(".json"):
+        with open(path) as f:
+            root.update(json.load(f))
+    else:
+        ns = runpy.run_path(path, init_globals={"root": root})
+        create = ns.get("create")
+    apply_overrides(root, overrides)
+    return create
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    setup_logging(level=10 if args.verbose else 20)
+
+    if args.list_units:
+        from .units.base import UnitRegistry
+        for name in UnitRegistry.names():
+            print(name)
+        return 0
+
+    if args.ensemble_test:
+        raise SystemExit(
+            "--ensemble-test requires a workflow factory; use the "
+            "veles_tpu.ensemble.EnsembleTester API (see docs)")
+
+    if not args.config:
+        build_parser().print_help()
+        return 2
+
+    if args.random_seed is not None:
+        root.common.random_seed = args.random_seed
+        prng.streams.reset()
+
+    create = _load_config(args.config, args.overrides)
+
+    if args.dump_config:
+        print(root.dump())
+        return 0
+
+    def trainer_factory(cfg: Config) -> Trainer:
+        if create is not None:
+            return create(cfg)
+        return _make_trainer_from_root(cfg, args)
+
+    # -- GA mode (reference --optimize, veles/__main__.py:716-734) ---------
+    if args.optimize:
+        from .genetics import GeneticOptimizer
+        n, _, g = args.optimize.partition(":")
+
+        def fitness(cfg: Config) -> float:
+            t = trainer_factory(cfg)
+            t.initialize()
+            t.run()
+            return t.decision.best_value
+
+        ga = GeneticOptimizer(root, fitness, population_size=int(n),
+                              generations=int(g) if g else 10)
+        best = ga.run()
+        out = {"best_fitness": best.fitness, "best_genome": best.genome}
+        print(json.dumps(out))
+        if args.result_file:
+            with open(args.result_file, "w") as f:
+                json.dump({**out, "history": ga.history}, f, indent=1)
+        return 0
+
+    # -- ensemble train (reference --ensemble-train N:r) -------------------
+    if args.ensemble_train:
+        from .ensemble import EnsembleTrainer
+        n, _, r = args.ensemble_train.partition(":")
+
+        def member_factory(member_id, seed, train_ratio):
+            root.common.random_seed = seed
+            prng.streams.reset()
+            return trainer_factory(root)
+
+        et = EnsembleTrainer(member_factory, int(n),
+                             float(r) if r else 0.8,
+                             out_dir=args.snapshot_dir or "ensemble")
+        results = et.run()
+        print(json.dumps({"members": len(results)}))
+        return 0
+
+    # -- standalone training ------------------------------------------------
+    trainer = trainer_factory(root)
+    if args.dry_run == "init":
+        trainer.loader.initialize()
+        print(json.dumps({"dry_run": "init",
+                          "class_lengths": trainer.loader.class_lengths}))
+        return 0
+    trainer.initialize()
+    if args.dry_run == "build":
+        print(json.dumps({"dry_run": "build",
+                          "checksum": trainer.workflow.checksum(),
+                          "n_params": trainer.workflow.n_params(
+                              trainer.wstate)}))
+        return 0
+    if args.snapshot:
+        trainer.restore(args.snapshot)
+    results = trainer.run()
+    print(json.dumps(results))
+    if args.result_file:
+        with open(args.result_file, "w") as f:
+            json.dump(results, f, indent=1)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,186 @@
+"""Genetic hyperparameter optimization over config Range tuneables.
+
+Reference parity: veles/genetics/ — GA over ``Range(...)`` markers inside
+the config tree (veles/genetics/config.py:45-223: "config doubles as the
+hyperparameter search space"), population with roulette/tournament
+selection, multiple crossover and mutation operators
+(veles/genetics/core.py:371-460), each chromosome evaluated as a full
+training run (optimization_workflow.py:70-339).
+
+Redesign: evaluations are a plain ``fitness_fn(config) -> float`` callback
+(lower = better, e.g. validation error). The reference farmed evaluations to
+slaves over ZMQ; here the natural parallel axis is sequential evaluations of
+*device-parallel* trainings (each training already fills the mesh), so the
+GA loop stays simple and deterministic."""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..config import Config, Range, collect_tuneables
+from ..logger import Logger
+
+
+@dataclasses.dataclass
+class Individual:
+    genome: Dict[str, object]       # path -> value
+    fitness: float = math.inf
+    evaluated: bool = False
+
+
+class GeneticOptimizer(Logger):
+    """GA driver.
+
+    selection: "tournament" | "roulette";
+    crossover ops: uniform, single-point, blend (continuous only);
+    mutation ops: gaussian (continuous), reset (any), creep (integers).
+    """
+
+    def __init__(self, config: Config,
+                 fitness_fn: Callable[[Config], float], *,
+                 population_size: int = 16, generations: int = 10,
+                 elite: int = 2, crossover_rate: float = 0.9,
+                 mutation_rate: float = 0.15,
+                 selection: str = "tournament",
+                 tournament_k: int = 3, seed: int = 0,
+                 on_generation: Optional[Callable] = None):
+        self.config = config
+        self.tuneables = collect_tuneables(config)
+        if not self.tuneables:
+            raise ValueError("config contains no Range tuneables")
+        self.fitness_fn = fitness_fn
+        self.population_size = population_size
+        self.generations = generations
+        self.elite = elite
+        self.crossover_rate = crossover_rate
+        self.mutation_rate = mutation_rate
+        self.selection = selection
+        self.tournament_k = tournament_k
+        self.rng = np.random.default_rng(seed)
+        self.on_generation = on_generation
+        self.history: List[dict] = []
+        self.best: Optional[Individual] = None
+
+    # -- genome ops ---------------------------------------------------------
+    def _random_value(self, r: Range):
+        if r.choices is not None:
+            return r.choices[self.rng.integers(len(r.choices))]
+        lo = r.min_value if r.min_value is not None else r.value * 0.1
+        hi = r.max_value if r.max_value is not None else r.value * 10.0
+        v = self.rng.uniform(lo, hi)
+        return int(round(v)) if r.integer else float(v)
+
+    def random_individual(self) -> Individual:
+        return Individual({p: self._random_value(r)
+                           for p, r in self.tuneables.items()})
+
+    def seed_individual(self) -> Individual:
+        """The config's current values — always in the initial population
+        (reference: the original config is generation 0's elite)."""
+        return Individual({p: r.value for p, r in self.tuneables.items()})
+
+    def crossover(self, a: Individual, b: Individual) -> Individual:
+        op = self.rng.integers(3)
+        paths = list(self.tuneables)
+        child = {}
+        if op == 0:      # uniform
+            for p in paths:
+                child[p] = a.genome[p] if self.rng.random() < 0.5 \
+                    else b.genome[p]
+        elif op == 1:    # single-point
+            cut = self.rng.integers(1, max(len(paths), 2))
+            for i, p in enumerate(paths):
+                child[p] = a.genome[p] if i < cut else b.genome[p]
+        else:            # blend for continuous, uniform otherwise
+            for p in paths:
+                r = self.tuneables[p]
+                va, vb = a.genome[p], b.genome[p]
+                if r.choices is None and isinstance(va, (int, float)):
+                    t = self.rng.random()
+                    v = va * t + vb * (1 - t)
+                    child[p] = r.clip(int(round(v)) if r.integer else v)
+                else:
+                    child[p] = va if self.rng.random() < 0.5 else vb
+        return Individual(child)
+
+    def mutate(self, ind: Individual) -> Individual:
+        g = dict(ind.genome)
+        for p, r in self.tuneables.items():
+            if self.rng.random() >= self.mutation_rate:
+                continue
+            if r.choices is not None:
+                g[p] = r.choices[self.rng.integers(len(r.choices))]
+            elif r.integer:
+                lo = r.min_value if r.min_value is not None else g[p] - 5
+                hi = r.max_value if r.max_value is not None else g[p] + 5
+                step = max(1, int((hi - lo) * 0.1))
+                g[p] = r.clip(g[p] + int(self.rng.integers(-step, step + 1)))
+            else:
+                lo = r.min_value if r.min_value is not None else g[p] * 0.1
+                hi = r.max_value if r.max_value is not None else g[p] * 10
+                sigma = (hi - lo) * 0.1
+                g[p] = r.clip(float(g[p] + self.rng.normal(0, sigma)))
+        return Individual(g)
+
+    # -- selection ----------------------------------------------------------
+    def _select(self, pop: List[Individual]) -> Individual:
+        if self.selection == "tournament":
+            idx = self.rng.choice(len(pop), size=self.tournament_k,
+                                  replace=False)
+            return min((pop[i] for i in idx), key=lambda i: i.fitness)
+        # roulette on inverse fitness (lower fitness = larger slice)
+        inv = np.array([1.0 / (1e-9 + i.fitness) for i in pop])
+        probs = inv / inv.sum()
+        return pop[self.rng.choice(len(pop), p=probs)]
+
+    # -- evaluation ---------------------------------------------------------
+    def materialize(self, genome: Dict[str, object]) -> Config:
+        cfg = Config()
+        cfg.update(self.config.to_dict(unwrap_ranges=True))
+        for p, v in genome.items():
+            cfg.set_path(p, v)
+        return cfg
+
+    def _evaluate(self, ind: Individual):
+        if ind.evaluated:
+            return
+        ind.fitness = float(self.fitness_fn(self.materialize(ind.genome)))
+        ind.evaluated = True
+
+    # -- main loop ----------------------------------------------------------
+    def run(self) -> Individual:
+        pop = [self.seed_individual()] + [
+            self.random_individual()
+            for _ in range(self.population_size - 1)]
+        for gen in range(self.generations):
+            for ind in pop:
+                self._evaluate(ind)
+            pop.sort(key=lambda i: i.fitness)
+            if self.best is None or pop[0].fitness < self.best.fitness:
+                self.best = dataclasses.replace(pop[0])
+            self.history.append({
+                "generation": gen,
+                "best": pop[0].fitness,
+                "mean": float(np.mean([i.fitness for i in pop])),
+                "best_genome": dict(pop[0].genome)})
+            self.info("gen %d: best=%.5f mean=%.5f", gen, pop[0].fitness,
+                      self.history[-1]["mean"])
+            if self.on_generation is not None:
+                self.on_generation(gen, pop)
+            if gen == self.generations - 1:
+                break
+            nxt = pop[:self.elite]
+            while len(nxt) < self.population_size:
+                if self.rng.random() < self.crossover_rate:
+                    child = self.crossover(self._select(pop),
+                                           self._select(pop))
+                else:
+                    child = dataclasses.replace(
+                        self._select(pop), fitness=math.inf, evaluated=False)
+                nxt.append(self.mutate(child))
+            pop = nxt
+        return self.best

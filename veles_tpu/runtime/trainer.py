@@ -105,16 +105,20 @@ class Trainer(Logger):
 
     # -- epoch passes -------------------------------------------------------
     def _run_epoch_train(self, epoch: int) -> Dict[str, float]:
-        sums: Dict[str, float] = {}
+        sums: Dict[str, Any] = {}
         with TraceContext("train_epoch", epoch=epoch):
             for batch in self.loader.iter_epoch(TRAIN, epoch):
                 if self._batch_sh is not None:
                     batch = jax.device_put(batch, self._batch_sh)
                 self.wstate, mets = self._train_step(self.wstate, batch)
+                # Accumulate ON DEVICE — a float() here would sync the
+                # pipeline every step (the reference's --sync-run behavior,
+                # veles/accelerated_units.py:186-193, as an accident).
                 for k, v in mets.items():
-                    sums[k] = sums.get(k, 0.0) + float(v)
+                    sums[k] = sums[k] + v if k in sums else v
                 sums["n_batches"] = sums.get("n_batches", 0) + 1
-        return aggregate_epoch_metrics(sums)
+        return aggregate_epoch_metrics(
+            {k: float(v) for k, v in sums.items()})
 
     def _run_epoch_eval(self, klass: int, epoch: int) -> Dict[str, float]:
         if self.loader.class_lengths[klass] == 0:
@@ -126,9 +130,10 @@ class Trainer(Logger):
                     batch = jax.device_put(batch, self._batch_sh)
                 mets = self._eval_step(self.wstate, batch)
                 for k, v in mets.items():
-                    sums[k] = sums.get(k, 0.0) + float(v)
+                    sums[k] = sums[k] + v if k in sums else v
                 sums["n_batches"] = sums.get("n_batches", 0) + 1
-        return aggregate_epoch_metrics(sums)
+        return aggregate_epoch_metrics(
+            {k: float(v) for k, v in sums.items()})
 
     # -- main loop ----------------------------------------------------------
     def run(self) -> Dict[str, Any]:

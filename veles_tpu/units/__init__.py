@@ -5,4 +5,6 @@ from .nn import (All2All, All2AllRELU, All2AllSincos, All2AllSoftmax,
                  Depool, Dropout, Evaluator, EvaluatorMSE, EvaluatorSoftmax,
                  Flatten, LRN, MaxPooling, MeanDispNormalizer,
                  StochasticAbsPooling)
+from .kohonen import KohonenForward
+from .rbm import RBM
 from .workflow import Workflow, WorkflowError

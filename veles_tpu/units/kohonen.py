@@ -1,0 +1,81 @@
+"""Kohonen self-organizing map — a non-SGD, self-updating unit.
+
+Reference: Znicz Kohonen SOM units (docs manualrst_veles_algorithms.rst:61-70
+— "Kohonen" forward + trainer units; one of BASELINE.json's non-SGD configs).
+
+TPU redesign: SOM weights live in unit *state* (not params — nothing is
+differentiated); the competitive update is a batched, fully-vectorized
+einsum (winner search + Gaussian neighborhood pull) that the Workflow's
+train step applies via the ``self_updating`` hook — one fused XLA program,
+no per-sample loop."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .base import Spec, Unit
+
+
+class KohonenForward(Unit):
+    """Forward: winner (BMU) indices for each sample; state carries the
+    (sx*sy, features) codebook."""
+
+    self_updating = True
+
+    def __init__(self, shape=(8, 8), *, init_radius=None, init_lr=0.1,
+                 decay_steps=1000.0, name=None, inputs=("@input",)):
+        super().__init__(name, inputs)
+        self.sx, self.sy = shape
+        self.n_neurons = self.sx * self.sy
+        self.init_radius = init_radius or max(self.sx, self.sy) / 2.0
+        self.init_lr = init_lr
+        self.decay_steps = decay_steps
+
+    def output_spec(self, in_specs):
+        return Spec((in_specs[0].shape[0],), jnp.int32)
+
+    def init(self, key, in_specs):
+        feat = int(np.prod(in_specs[0].shape[1:]))
+        w = jax.random.uniform(key, (self.n_neurons, feat), jnp.float32,
+                               -0.1, 0.1)
+        gx, gy = jnp.meshgrid(jnp.arange(self.sx), jnp.arange(self.sy),
+                              indexing="ij")
+        coords = jnp.stack([gx.ravel(), gy.ravel()], axis=1).astype(
+            jnp.float32)
+        return {}, {"weights": w, "coords": coords,
+                    "t": jnp.zeros((), jnp.float32)}
+
+    def _dists(self, state, x):
+        x = x.reshape(x.shape[0], -1)
+        w = state["weights"]
+        return (jnp.sum(jnp.square(x), 1, keepdims=True)
+                - 2.0 * x @ w.T + jnp.sum(jnp.square(w), 1)[None, :])
+
+    def apply(self, params, state, xs, ctx):
+        d = self._dists(state, xs[0])
+        return jnp.argmin(d, axis=1).astype(jnp.int32), state
+
+    def update_state(self, params, state, xs, ctx):
+        """Batch SOM update with exponentially decaying lr/radius."""
+        x = xs[0].reshape(xs[0].shape[0], -1).astype(jnp.float32)
+        w, coords, t = state["weights"], state["coords"], state["t"]
+        d = self._dists(state, x)
+        winners = jnp.argmin(d, axis=1)
+        decay = jnp.exp(-t / self.decay_steps)
+        sigma = jnp.maximum(self.init_radius * decay, 0.5)
+        eta = self.init_lr * decay
+        wc = coords[winners]                                  # (B, 2)
+        g2 = jnp.sum(jnp.square(coords[None] - wc[:, None]), -1)  # (B, N)
+        h = jnp.exp(-g2 / (2.0 * jnp.square(sigma)))          # (B, N)
+        num = jnp.einsum("bn,bf->nf", h, x)
+        den = jnp.sum(h, axis=0)[:, None]
+        dw = num - den * w
+        w_new = w + eta / x.shape[0] * dw
+        return {"weights": w_new, "coords": coords, "t": t + 1.0}
+
+    def quantization_error(self, state, x) -> jax.Array:
+        """Mean distance to BMU — the SOM quality metric."""
+        d = self._dists(state, jnp.asarray(x))
+        return jnp.sqrt(jnp.maximum(jnp.min(d, axis=1), 0.0)).mean()

@@ -1,0 +1,79 @@
+"""Bernoulli RBM with contrastive-divergence training — self-updating unit.
+
+Reference: Znicz RBM units ("numpy only" in the reference — docs
+manualrst_veles_algorithms.rst:101-114). Here CD-k runs fully on the MXU:
+the positive/negative phase gemms batch over the minibatch, Gibbs sampling
+uses the ctx PRNG key."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .base import Spec, Unit
+
+
+class RBM(Unit):
+    """Forward: hidden activation probabilities. State: W, vbias, hbias."""
+
+    self_updating = True
+    stochastic = True
+
+    def __init__(self, n_hidden: int, *, lr=0.05, cd_k: int = 1,
+                 name=None, inputs=("@input",)):
+        super().__init__(name, inputs)
+        self.n_hidden = int(n_hidden)
+        self.lr = lr
+        self.cd_k = cd_k
+
+    def output_spec(self, in_specs):
+        return Spec((in_specs[0].shape[0], self.n_hidden), jnp.float32)
+
+    def init(self, key, in_specs):
+        feat = int(np.prod(in_specs[0].shape[1:]))
+        w = jax.random.normal(key, (feat, self.n_hidden)) * 0.01
+        return {}, {"w": w.astype(jnp.float32),
+                    "vbias": jnp.zeros((feat,), jnp.float32),
+                    "hbias": jnp.zeros((self.n_hidden,), jnp.float32)}
+
+    @staticmethod
+    def _h_prob(state, v):
+        return jax.nn.sigmoid(v @ state["w"] + state["hbias"])
+
+    @staticmethod
+    def _v_prob(state, h):
+        return jax.nn.sigmoid(h @ state["w"].T + state["vbias"])
+
+    def apply(self, params, state, xs, ctx):
+        v = xs[0].reshape(xs[0].shape[0], -1).astype(jnp.float32)
+        return self._h_prob(state, v), state
+
+    def update_state(self, params, state, xs, ctx):
+        v0 = xs[0].reshape(xs[0].shape[0], -1).astype(jnp.float32)
+        key = ctx.unit_key(self.name)
+        if key is None:
+            key = jax.random.key(0)
+        h0p = self._h_prob(state, v0)
+        hk = (jax.random.uniform(key, h0p.shape) < h0p).astype(jnp.float32)
+        vk = v0
+        for i in range(self.cd_k):
+            key, k1 = jax.random.split(key)
+            vk = self._v_prob(state, hk)
+            hkp = self._h_prob(state, vk)
+            hk = (jax.random.uniform(k1, hkp.shape) < hkp).astype(
+                jnp.float32)
+        hkp = self._h_prob(state, vk)
+        n = v0.shape[0]
+        dw = (v0.T @ h0p - vk.T @ hkp) / n
+        dv = jnp.mean(v0 - vk, axis=0)
+        dh = jnp.mean(h0p - hkp, axis=0)
+        return {"w": state["w"] + self.lr * dw,
+                "vbias": state["vbias"] + self.lr * dv,
+                "hbias": state["hbias"] + self.lr * dh}
+
+    def reconstruction_error(self, state, v) -> jax.Array:
+        v = jnp.asarray(v).reshape(len(v), -1).astype(jnp.float32)
+        h = self._h_prob(state, v)
+        vr = self._v_prob(state, h)
+        return jnp.mean(jnp.square(v - vr))
