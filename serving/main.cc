@@ -1,0 +1,72 @@
+// veles_serve: run an exported veles_tpu package on CPU.
+//
+// Usage: veles_serve <package_dir> <input.npy> <output.npy>
+//          [--output-unit NAME] [--threads N] [--repeat N]
+//
+// Counterpart of the reference's libVeles sample flow (reference:
+// libVeles/src/workflow_loader.cc + engine): load package, run DAG on a
+// thread pool, write result. --repeat prints latency stats for serving
+// benchmarks.
+
+#include <chrono>
+#include <cstdio>
+#include <cstring>
+#include <string>
+
+#include "src/npy.hpp"
+#include "src/workflow.hpp"
+
+int main(int argc, char** argv) {
+  if (argc < 4) {
+    std::fprintf(stderr,
+                 "usage: %s <package_dir> <input.npy> <output.npy> "
+                 "[--output-unit NAME] [--threads N] [--repeat N]\n",
+                 argv[0]);
+    return 2;
+  }
+  std::string pkg = argv[1], in_path = argv[2], out_path = argv[3];
+  std::string output_unit;
+  int threads = 0, repeat = 1;
+  for (int i = 4; i < argc; i++) {
+    if (!std::strcmp(argv[i], "--output-unit") && i + 1 < argc)
+      output_unit = argv[++i];
+    else if (!std::strcmp(argv[i], "--threads") && i + 1 < argc)
+      threads = std::atoi(argv[++i]);
+    else if (!std::strcmp(argv[i], "--repeat") && i + 1 < argc)
+      repeat = std::atoi(argv[++i]);
+  }
+
+  try {
+    auto wf = veles::Workflow::Load(pkg);
+    auto in_arr = veles::npy::Load(in_path);
+    veles::Tensor input;
+    input.shape.dims = in_arr.shape;
+    input.storage = std::move(in_arr.data);
+    input.data = input.storage.data();
+
+    veles::ThreadPool pool(threads);
+    veles::Tensor out;
+    double best_ms = 1e30, total_ms = 0;
+    for (int r = 0; r < repeat; r++) {
+      auto t0 = std::chrono::steady_clock::now();
+      out = wf.Run(input, &pool, output_unit);
+      auto t1 = std::chrono::steady_clock::now();
+      double ms =
+          std::chrono::duration<double, std::milli>(t1 - t0).count();
+      best_ms = std::min(best_ms, ms);
+      total_ms += ms;
+    }
+    veles::npy::Save(out_path, out.shape.dims, out.data);
+    std::fprintf(
+        stderr,
+        "{\"workflow\": \"%s\", \"units\": %zu, \"arena_bytes\": %lld, "
+        "\"best_ms\": %.3f, \"mean_ms\": %.3f, \"threads\": %d}\n",
+        wf.name.c_str(), wf.n_units(),
+        static_cast<long long>(wf.arena_bytes()), best_ms,
+        total_ms / repeat, pool.size());
+    return 0;
+  } catch (const std::exception& e) {
+    std::fprintf(stderr, "error: %s\n", e.what());
+    return 1;
+  }
+}

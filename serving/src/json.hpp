@@ -1,0 +1,177 @@
+// Minimal JSON parser for contents.json.
+// TPU-rebuild counterpart of the reference's rapidjson use in
+// libVeles/src/main_file_loader.cc (vendored dependency replaced by ~200
+// self-contained lines; we only need objects/arrays/strings/numbers/bools).
+#pragma once
+
+#include <cctype>
+#include <map>
+#include <memory>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace veles {
+namespace json {
+
+class Value;
+using ValuePtr = std::shared_ptr<Value>;
+
+class Value {
+ public:
+  enum class Type { Null, Bool, Number, String, Array, Object };
+  Type type = Type::Null;
+  bool b = false;
+  double num = 0;
+  std::string str;
+  std::vector<ValuePtr> arr;
+  std::map<std::string, ValuePtr> obj;
+
+  bool has(const std::string& k) const { return obj.count(k) > 0; }
+  const Value& at(const std::string& k) const {
+    auto it = obj.find(k);
+    if (it == obj.end()) throw std::runtime_error("json: no key " + k);
+    return *it->second;
+  }
+  const Value& operator[](size_t i) const { return *arr.at(i); }
+  size_t size() const {
+    return type == Type::Array ? arr.size() : obj.size();
+  }
+  double number(const std::string& k, double dflt) const {
+    return has(k) && obj.at(k)->type == Type::Number ? obj.at(k)->num
+                                                     : dflt;
+  }
+  std::string string(const std::string& k, const std::string& dflt) const {
+    return has(k) && obj.at(k)->type == Type::String ? obj.at(k)->str
+                                                     : dflt;
+  }
+};
+
+class Parser {
+ public:
+  explicit Parser(const std::string& text) : s_(text) {}
+
+  ValuePtr Parse() {
+    auto v = ParseValue();
+    SkipWs();
+    if (pos_ != s_.size()) throw std::runtime_error("json: trailing data");
+    return v;
+  }
+
+ private:
+  const std::string& s_;
+  size_t pos_ = 0;
+
+  void SkipWs() {
+    while (pos_ < s_.size() && std::isspace(
+        static_cast<unsigned char>(s_[pos_]))) pos_++;
+  }
+  char Peek() {
+    SkipWs();
+    if (pos_ >= s_.size()) throw std::runtime_error("json: eof");
+    return s_[pos_];
+  }
+  void Expect(char c) {
+    if (Peek() != c)
+      throw std::runtime_error(std::string("json: expected ") + c);
+    pos_++;
+  }
+
+  ValuePtr ParseValue() {
+    char c = Peek();
+    if (c == '{') return ParseObject();
+    if (c == '[') return ParseArray();
+    if (c == '"') return ParseString();
+    if (c == 't' || c == 'f') return ParseBool();
+    if (c == 'n') { pos_ += 4; return std::make_shared<Value>(); }
+    return ParseNumber();
+  }
+
+  ValuePtr ParseObject() {
+    auto v = std::make_shared<Value>();
+    v->type = Value::Type::Object;
+    Expect('{');
+    if (Peek() == '}') { pos_++; return v; }
+    while (true) {
+      auto key = ParseString();
+      Expect(':');
+      v->obj[key->str] = ParseValue();
+      if (Peek() == ',') { pos_++; continue; }
+      Expect('}');
+      break;
+    }
+    return v;
+  }
+
+  ValuePtr ParseArray() {
+    auto v = std::make_shared<Value>();
+    v->type = Value::Type::Array;
+    Expect('[');
+    if (Peek() == ']') { pos_++; return v; }
+    while (true) {
+      v->arr.push_back(ParseValue());
+      if (Peek() == ',') { pos_++; continue; }
+      Expect(']');
+      break;
+    }
+    return v;
+  }
+
+  ValuePtr ParseString() {
+    auto v = std::make_shared<Value>();
+    v->type = Value::Type::String;
+    Expect('"');
+    while (pos_ < s_.size() && s_[pos_] != '"') {
+      char c = s_[pos_++];
+      if (c == '\\' && pos_ < s_.size()) {
+        char e = s_[pos_++];
+        switch (e) {
+          case 'n': c = '\n'; break;
+          case 't': c = '\t'; break;
+          case 'r': c = '\r'; break;
+          case 'b': c = '\b'; break;
+          case 'f': c = '\f'; break;
+          case 'u': {  // keep only latin-1 of \uXXXX
+            if (pos_ + 4 > s_.size())
+              throw std::runtime_error("json: bad \\u");
+            c = static_cast<char>(
+                std::stoi(s_.substr(pos_, 4), nullptr, 16) & 0xFF);
+            pos_ += 4;
+            break;
+          }
+          default: c = e;
+        }
+      }
+      v->str.push_back(c);
+    }
+    Expect('"');
+    return v;
+  }
+
+  ValuePtr ParseBool() {
+    auto v = std::make_shared<Value>();
+    v->type = Value::Type::Bool;
+    if (s_.compare(pos_, 4, "true") == 0) { v->b = true; pos_ += 4; }
+    else if (s_.compare(pos_, 5, "false") == 0) { v->b = false; pos_ += 5; }
+    else throw std::runtime_error("json: bad literal");
+    return v;
+  }
+
+  ValuePtr ParseNumber() {
+    auto v = std::make_shared<Value>();
+    v->type = Value::Type::Number;
+    size_t start = pos_;
+    while (pos_ < s_.size() &&
+           (std::isdigit(static_cast<unsigned char>(s_[pos_])) ||
+            strchr("+-.eE", s_[pos_]) != nullptr)) pos_++;
+    v->num = std::stod(s_.substr(start, pos_ - start));
+    return v;
+  }
+};
+
+inline ValuePtr Parse(const std::string& text) {
+  return Parser(text).Parse();
+}
+
+}  // namespace json
+}  // namespace veles

@@ -1,0 +1,112 @@
+// Minimal .npy reader/writer (float32/int32, C-order).
+// Counterpart of libVeles' NumpyArrayLoader
+// (reference: libVeles/inc/veles/numpy_array_loader.h — 333-line template
+// parser; here only the dtypes the exporter emits are supported).
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <fstream>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace veles {
+namespace npy {
+
+struct Array {
+  std::vector<int64_t> shape;
+  std::vector<float> data;
+
+  int64_t size() const {
+    int64_t n = 1;
+    for (auto d : shape) n *= d;
+    return n;
+  }
+};
+
+inline Array Load(const std::string& path) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) throw std::runtime_error("npy: cannot open " + path);
+  char magic[6];
+  f.read(magic, 6);
+  if (std::memcmp(magic, "\x93NUMPY", 6) != 0)
+    throw std::runtime_error("npy: bad magic in " + path);
+  uint8_t ver[2];
+  f.read(reinterpret_cast<char*>(ver), 2);
+  uint32_t header_len = 0;
+  if (ver[0] == 1) {
+    uint16_t hl;
+    f.read(reinterpret_cast<char*>(&hl), 2);
+    header_len = hl;
+  } else {
+    f.read(reinterpret_cast<char*>(&header_len), 4);
+  }
+  std::string header(header_len, '\0');
+  f.read(&header[0], header_len);
+
+  if (header.find("'fortran_order': True") != std::string::npos)
+    throw std::runtime_error("npy: fortran order unsupported");
+  bool is_f4 = header.find("<f4") != std::string::npos;
+  bool is_i4 = header.find("<i4") != std::string::npos;
+  if (!is_f4 && !is_i4)
+    throw std::runtime_error("npy: only <f4/<i4 supported: " + header);
+
+  Array a;
+  auto sp = header.find("'shape':");
+  auto lp = header.find('(', sp);
+  auto rp = header.find(')', lp);
+  std::string dims = header.substr(lp + 1, rp - lp - 1);
+  size_t pos = 0;
+  while (pos < dims.size()) {
+    while (pos < dims.size() && !std::isdigit(
+        static_cast<unsigned char>(dims[pos]))) pos++;
+    if (pos >= dims.size()) break;
+    size_t end = pos;
+    while (end < dims.size() && std::isdigit(
+        static_cast<unsigned char>(dims[end]))) end++;
+    a.shape.push_back(std::stoll(dims.substr(pos, end - pos)));
+    pos = end;
+  }
+  if (a.shape.empty()) a.shape.push_back(1);
+
+  int64_t n = a.size();
+  a.data.resize(n);
+  if (is_f4) {
+    f.read(reinterpret_cast<char*>(a.data.data()), n * 4);
+  } else {
+    std::vector<int32_t> tmp(n);
+    f.read(reinterpret_cast<char*>(tmp.data()), n * 4);
+    for (int64_t i = 0; i < n; i++) a.data[i] = static_cast<float>(tmp[i]);
+  }
+  if (!f) throw std::runtime_error("npy: truncated " + path);
+  return a;
+}
+
+inline void Save(const std::string& path, const std::vector<int64_t>& shape,
+                 const float* data) {
+  std::string dims;
+  for (size_t i = 0; i < shape.size(); i++) {
+    dims += std::to_string(shape[i]);
+    if (shape.size() == 1 || i + 1 < shape.size()) dims += ",";
+    if (i + 1 < shape.size()) dims += " ";
+  }
+  std::string header = "{'descr': '<f4', 'fortran_order': False, "
+                       "'shape': (" + dims + "), }";
+  size_t total = 10 + header.size() + 1;
+  size_t pad = (64 - total % 64) % 64;
+  header += std::string(pad, ' ');
+  header += '\n';
+
+  std::ofstream f(path, std::ios::binary);
+  f.write("\x93NUMPY\x01\x00", 8);
+  uint16_t hl = static_cast<uint16_t>(header.size());
+  f.write(reinterpret_cast<char*>(&hl), 2);
+  f.write(header.data(), header.size());
+  int64_t n = 1;
+  for (auto d : shape) n *= d;
+  f.write(reinterpret_cast<const char*>(data), n * 4);
+}
+
+}  // namespace npy
+}  // namespace veles

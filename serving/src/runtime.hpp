@@ -1,0 +1,158 @@
+// veles_tpu native serving runtime: tensors, thread pool, arena planner.
+//
+// Counterpart of the reference's libVeles C++11 inference engine
+// (reference: libVeles/inc/veles/workflow.h:72 Workflow,
+// inc/veles/engine.h:43 ThreadPoolEngine, src/memory_optimizer.h:43
+// MemoryOptimizer sliding-block arena packing). The TPU training framework
+// exports packages (veles_tpu/export/package.py) that this runtime executes
+// on CPU for embedded/serving parity.
+#pragma once
+
+#include <algorithm>
+#include <atomic>
+#include <cmath>
+#include <condition_variable>
+#include <cstdint>
+#include <functional>
+#include <mutex>
+#include <queue>
+#include <stdexcept>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace veles {
+
+// ---------------------------------------------------------------------------
+struct Shape {
+  std::vector<int64_t> dims;
+  int64_t size() const {
+    int64_t n = 1;
+    for (auto d : dims) n *= d;
+    return n;
+  }
+  int64_t operator[](size_t i) const { return dims[i]; }
+  size_t rank() const { return dims.size(); }
+};
+
+// A tensor view into the arena (or owning, for weights).
+struct Tensor {
+  Shape shape;
+  float* data = nullptr;           // view (arena)
+  std::vector<float> storage;      // owning (weights / IO)
+
+  void own(const Shape& s) {
+    shape = s;
+    storage.resize(s.size());
+    data = storage.data();
+  }
+  int64_t size() const { return shape.size(); }
+};
+
+// ---------------------------------------------------------------------------
+// Thread pool with parallel_for (the reference scheduled whole units on its
+// pool, libVeles/src/engine.h:45; here units run in topo order and the
+// parallelism is *inside* each op — better cache behavior for inference).
+class ThreadPool {
+ public:
+  explicit ThreadPool(int n_threads = 0)
+      : n_(n_threads > 0 ? n_threads
+                         : static_cast<int>(
+                               std::thread::hardware_concurrency())) {
+    if (n_ < 1) n_ = 1;
+  }
+
+  int size() const { return n_; }
+
+  // Run fn(begin, end) over [0, total) split across threads.
+  void ParallelFor(int64_t total,
+                   const std::function<void(int64_t, int64_t)>& fn) {
+    if (total <= 0) return;
+    int k = static_cast<int>(
+        std::min<int64_t>(n_, std::max<int64_t>(1, total)));
+    if (k == 1) {
+      fn(0, total);
+      return;
+    }
+    std::vector<std::thread> threads;
+    int64_t chunk = (total + k - 1) / k;
+    for (int t = 0; t < k; t++) {
+      int64_t b = t * chunk, e = std::min<int64_t>(total, b + chunk);
+      if (b >= e) break;
+      threads.emplace_back([&fn, b, e] { fn(b, e); });
+    }
+    for (auto& th : threads) th.join();
+  }
+
+ private:
+  int n_;
+};
+
+// ---------------------------------------------------------------------------
+// Arena planner: assign each intermediate buffer an offset in one block,
+// reusing memory of dead buffers (parity with MemoryOptimizer,
+// libVeles/src/memory_optimizer.h:43-55 — greedy best-offset packing of
+// [def, last_use) lifetime intervals).
+struct ArenaItem {
+  int64_t size = 0;   // floats
+  int def = 0;        // producing step
+  int last_use = 0;   // last consuming step
+  int64_t offset = -1;
+};
+
+inline int64_t PlanArena(std::vector<ArenaItem>* items) {
+  std::vector<int> order(items->size());
+  for (size_t i = 0; i < order.size(); i++) order[i] = static_cast<int>(i);
+  std::sort(order.begin(), order.end(), [&](int a, int b) {
+    return (*items)[a].size > (*items)[b].size;
+  });
+  int64_t total = 0;
+  for (int idx : order) {
+    ArenaItem& it = (*items)[idx];
+    // collect intervals of temporally-overlapping, already-placed buffers
+    std::vector<std::pair<int64_t, int64_t>> busy;
+    for (const auto& other : *items) {
+      if (other.offset < 0 || &other == &it) continue;
+      bool overlap = !(other.last_use < it.def || it.last_use < other.def);
+      if (overlap) busy.emplace_back(other.offset,
+                                     other.offset + other.size);
+    }
+    std::sort(busy.begin(), busy.end());
+    int64_t pos = 0;
+    for (const auto& b : busy) {
+      if (pos + it.size <= b.first) break;
+      pos = std::max(pos, b.second);
+    }
+    it.offset = pos;
+    total = std::max(total, pos + it.size);
+  }
+  return total;
+}
+
+// ---------------------------------------------------------------------------
+// Activations (mirror veles_tpu/ops/activations.py).
+// last_dim: the feature-axis extent (sincos alternates over the feature
+// index, not the flat index).
+inline void ApplyActivation(const std::string& act, float* x, int64_t n,
+                            int64_t last_dim, ThreadPool* pool) {
+  if (act == "linear" || act.empty()) return;
+  pool->ParallelFor(n, [&](int64_t b, int64_t e) {
+    if (act == "relu") {
+      for (int64_t i = b; i < e; i++) x[i] = x[i] > 0 ? x[i] : 0;
+    } else if (act == "tanh") {
+      for (int64_t i = b; i < e; i++)
+        x[i] = 1.7159f * std::tanh(0.6666f * x[i]);
+    } else if (act == "raw_tanh") {
+      for (int64_t i = b; i < e; i++) x[i] = std::tanh(x[i]);
+    } else if (act == "sigmoid") {
+      for (int64_t i = b; i < e; i++) x[i] = 1.f / (1.f + std::exp(-x[i]));
+    } else if (act == "sincos") {
+      for (int64_t i = b; i < e; i++)
+        x[i] = ((i % last_dim) % 2 == 0) ? std::sin(x[i]) : std::cos(x[i]);
+    } else {
+      throw std::runtime_error("unknown activation " + act);
+    }
+  });
+}
+
+}  // namespace veles

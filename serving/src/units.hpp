@@ -1,0 +1,383 @@
+// Unit implementations for the native serving runtime.
+//
+// Counterpart of the reference's libZnicz C++ unit library (absent
+// submodule; factory contract libVeles/inc/veles/unit_factory.h — UUIDs
+// become registered class names). Math mirrors veles_tpu/ops/* so the
+// exported-package test compares C++ output against the JAX forward.
+#pragma once
+
+#include <cmath>
+#include <functional>
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "json.hpp"
+#include "runtime.hpp"
+
+namespace veles {
+
+struct UnitContext {
+  ThreadPool* pool;
+};
+
+class Unit {
+ public:
+  std::string name;
+  std::vector<std::string> inputs;
+
+  virtual ~Unit() = default;
+  virtual Shape OutputShape(const std::vector<Shape>& in) const = 0;
+  virtual void Run(const std::vector<const Tensor*>& in, Tensor* out,
+                   UnitContext* ctx) const = 0;
+};
+
+using UnitPtr = std::unique_ptr<Unit>;
+using Weights = std::map<std::string, npy::Array>;
+
+// ---------------------------------------------------------------------------
+class DenseUnit : public Unit {  // All2All* (reference Znicz all2all)
+ public:
+  int64_t output_size;
+  std::string activation;
+  npy::Array w, b;
+  bool has_bias = false;
+
+  Shape OutputShape(const std::vector<Shape>& in) const override {
+    return Shape{{in[0][0], output_size}};
+  }
+
+  void Run(const std::vector<const Tensor*>& in, Tensor* out,
+           UnitContext* ctx) const override {
+    const Tensor& x = *in[0];
+    int64_t batch = x.shape[0];
+    int64_t fin = x.size() / batch;
+    int64_t fout = output_size;
+    if (fin != w.shape[0])
+      throw std::runtime_error(
+          name + ": input features " + std::to_string(fin) +
+          " != weight rows " + std::to_string(w.shape[0]));
+    // row-parallel gemm: y[bi, o] = sum_i x[bi, i] * w[i, o]
+    ctx->pool->ParallelFor(batch, [&](int64_t rb, int64_t re) {
+      for (int64_t bi = rb; bi < re; bi++) {
+        const float* xr = x.data + bi * fin;
+        float* yr = out->data + bi * fout;
+        for (int64_t o = 0; o < fout; o++)
+          yr[o] = has_bias ? b.data[o] : 0.f;
+        for (int64_t i = 0; i < fin; i++) {
+          float xv = xr[i];
+          if (xv == 0.f) continue;
+          const float* wr = w.data.data() + i * fout;
+          for (int64_t o = 0; o < fout; o++) yr[o] += xv * wr[o];
+        }
+      }
+    });
+    ApplyActivation(activation, out->data, out->size(), fout, ctx->pool);
+  }
+};
+
+// ---------------------------------------------------------------------------
+class Conv2DUnit : public Unit {  // Conv* NHWC (reference Znicz conv)
+ public:
+  int64_t n_kernels, kx, ky, stride;
+  int64_t pad_h = 0, pad_w = 0;   // resolved at load
+  bool same_padding = false;
+  std::string activation;
+  npy::Array w, b;  // w: (ky, kx, cin, cout)
+  bool has_bias = false;
+
+  void ResolvePadding(const std::string& padding, double pad_num) {
+    if (padding == "SAME") {
+      same_padding = true;
+    } else if (padding == "VALID" || padding.empty()) {
+      pad_h = pad_w = 0;
+    } else {  // numeric (exported int padding)
+      pad_h = pad_w = static_cast<int64_t>(pad_num);
+    }
+  }
+
+  Shape OutputShape(const std::vector<Shape>& in) const override {
+    int64_t H = in[0][1], W = in[0][2];
+    int64_t ph = pad_h, pw = pad_w;
+    int64_t oh, ow;
+    if (same_padding) {
+      oh = (H + stride - 1) / stride;
+      ow = (W + stride - 1) / stride;
+    } else {
+      oh = (H + 2 * ph - ky) / stride + 1;
+      ow = (W + 2 * pw - kx) / stride + 1;
+    }
+    return Shape{{in[0][0], oh, ow, n_kernels}};
+  }
+
+  void Run(const std::vector<const Tensor*>& in, Tensor* out,
+           UnitContext* ctx) const override {
+    const Tensor& x = *in[0];
+    if (x.shape.rank() != 4)
+      throw std::runtime_error(name + ": conv input must be NHWC");
+    int64_t B = x.shape[0], H = x.shape[1], W = x.shape[2],
+            C = x.shape[3];
+    if (C != w.shape[2])
+      throw std::runtime_error(
+          name + ": input channels " + std::to_string(C) +
+          " != weight cin " + std::to_string(w.shape[2]));
+    Shape os = out->shape;
+    int64_t OH = os[1], OW = os[2], OC = os[3];
+    int64_t ph = pad_h, pw = pad_w;
+    if (same_padding) {
+      // TF SAME: total pad = max((o-1)*s + k - in, 0), asymmetric
+      ph = std::max<int64_t>(((OH - 1) * stride + ky - H) / 2, 0);
+      pw = std::max<int64_t>(((OW - 1) * stride + kx - W) / 2, 0);
+    }
+    ctx->pool->ParallelFor(B * OH, [&](int64_t rb, int64_t re) {
+      for (int64_t r = rb; r < re; r++) {
+        int64_t bi = r / OH, oy = r % OH;
+        float* orow = out->data + (bi * OH + oy) * OW * OC;
+        for (int64_t ox = 0; ox < OW; ox++) {
+          float* opix = orow + ox * OC;
+          for (int64_t o = 0; o < OC; o++)
+            opix[o] = has_bias ? b.data[o] : 0.f;
+          for (int64_t dy = 0; dy < ky; dy++) {
+            int64_t iy = oy * stride + dy - ph;
+            if (iy < 0 || iy >= H) continue;
+            for (int64_t dx = 0; dx < kx; dx++) {
+              int64_t ix = ox * stride + dx - pw;
+              if (ix < 0 || ix >= W) continue;
+              const float* ipix = x.data + ((bi * H + iy) * W + ix) * C;
+              const float* wrow =
+                  w.data.data() + (dy * kx + dx) * C * OC;
+              for (int64_t c = 0; c < C; c++) {
+                float xv = ipix[c];
+                const float* wc = wrow + c * OC;
+                for (int64_t o = 0; o < OC; o++) opix[o] += xv * wc[o];
+              }
+            }
+          }
+        }
+      }
+    });
+    ApplyActivation(activation, out->data, out->size(), OC, ctx->pool);
+  }
+};
+
+// ---------------------------------------------------------------------------
+class PoolUnit : public Unit {  // Max/AvgPooling, VALID (matches ops)
+ public:
+  int64_t window, stride;
+  bool is_max;
+
+  Shape OutputShape(const std::vector<Shape>& in) const override {
+    int64_t OH = (in[0][1] - window) / stride + 1;
+    int64_t OW = (in[0][2] - window) / stride + 1;
+    return Shape{{in[0][0], OH, OW, in[0][3]}};
+  }
+
+  void Run(const std::vector<const Tensor*>& in, Tensor* out,
+           UnitContext* ctx) const override {
+    const Tensor& x = *in[0];
+    int64_t B = x.shape[0], H = x.shape[1], W = x.shape[2],
+            C = x.shape[3];
+    int64_t OH = out->shape[1], OW = out->shape[2];
+    ctx->pool->ParallelFor(B * OH, [&](int64_t rb, int64_t re) {
+      for (int64_t r = rb; r < re; r++) {
+        int64_t bi = r / OH, oy = r % OH;
+        for (int64_t ox = 0; ox < OW; ox++) {
+          float* opix = out->data + ((bi * OH + oy) * OW + ox) * C;
+          for (int64_t c = 0; c < C; c++)
+            opix[c] = is_max ? -1e30f : 0.f;
+          for (int64_t dy = 0; dy < window; dy++) {
+            int64_t iy = oy * stride + dy;
+            for (int64_t dx = 0; dx < window; dx++) {
+              int64_t ix = ox * stride + dx;
+              const float* ipix =
+                  x.data + ((bi * H + iy) * W + ix) * C;
+              for (int64_t c = 0; c < C; c++) {
+                if (is_max)
+                  opix[c] = std::max(opix[c], ipix[c]);
+                else
+                  opix[c] += ipix[c];
+              }
+            }
+          }
+          if (!is_max) {
+            float inv = 1.f / (window * window);
+            for (int64_t c = 0; c < C; c++) opix[c] *= inv;
+          }
+        }
+      }
+    });
+  }
+};
+
+// ---------------------------------------------------------------------------
+class LRNUnit : public Unit {  // mirrors ops/lrn.py
+ public:
+  int64_t n;
+  float k, alpha, beta;
+
+  Shape OutputShape(const std::vector<Shape>& in) const override {
+    return in[0];
+  }
+
+  void Run(const std::vector<const Tensor*>& in, Tensor* out,
+           UnitContext* ctx) const override {
+    const Tensor& x = *in[0];
+    int64_t C = x.shape[x.shape.rank() - 1];
+    int64_t rows = x.size() / C;
+    int64_t half = n / 2;
+    ctx->pool->ParallelFor(rows, [&](int64_t rb, int64_t re) {
+      for (int64_t r = rb; r < re; r++) {
+        const float* xr = x.data + r * C;
+        float* yr = out->data + r * C;
+        for (int64_t c = 0; c < C; c++) {
+          int64_t lo = std::max<int64_t>(0, c - half);
+          int64_t hi = std::min<int64_t>(C, c - half + n);
+          float s = 0;
+          for (int64_t j = lo; j < hi; j++) s += xr[j] * xr[j];
+          yr[c] = xr[c] * std::pow(k + alpha / n * s, -beta);
+        }
+      }
+    });
+  }
+};
+
+// ---------------------------------------------------------------------------
+class FlattenUnit : public Unit {
+ public:
+  Shape OutputShape(const std::vector<Shape>& in) const override {
+    return Shape{{in[0][0], in[0].size() / in[0][0]}};
+  }
+  void Run(const std::vector<const Tensor*>& in, Tensor* out,
+           UnitContext*) const override {
+    std::copy(in[0]->data, in[0]->data + in[0]->size(), out->data);
+  }
+};
+
+class IdentityUnit : public Unit {  // Dropout at inference, Avatar, etc.
+ public:
+  Shape OutputShape(const std::vector<Shape>& in) const override {
+    return in[0];
+  }
+  void Run(const std::vector<const Tensor*>& in, Tensor* out,
+           UnitContext*) const override {
+    std::copy(in[0]->data, in[0]->data + in[0]->size(), out->data);
+  }
+};
+
+class MeanDispUnit : public Unit {  // (x - mean) * rdisp
+ public:
+  npy::Array mean, rdisp;
+  Shape OutputShape(const std::vector<Shape>& in) const override {
+    return in[0];
+  }
+  void Run(const std::vector<const Tensor*>& in, Tensor* out,
+           UnitContext* ctx) const override {
+    const Tensor& x = *in[0];
+    int64_t per = static_cast<int64_t>(mean.data.size());
+    ctx->pool->ParallelFor(x.size(), [&](int64_t b, int64_t e) {
+      for (int64_t i = b; i < e; i++)
+        out->data[i] =
+            (x.data[i] - mean.data[i % per]) * rdisp.data[i % per];
+    });
+  }
+};
+
+class SoftmaxUnit : public Unit {  // EvaluatorSoftmax at inference = probs
+ public:
+  Shape OutputShape(const std::vector<Shape>& in) const override {
+    return in[0];
+  }
+  void Run(const std::vector<const Tensor*>& in, Tensor* out,
+           UnitContext* ctx) const override {
+    const Tensor& x = *in[0];
+    int64_t C = x.shape[x.shape.rank() - 1];
+    int64_t rows = x.size() / C;
+    ctx->pool->ParallelFor(rows, [&](int64_t rb, int64_t re) {
+      for (int64_t r = rb; r < re; r++) {
+        const float* xr = x.data + r * C;
+        float* yr = out->data + r * C;
+        float m = xr[0];
+        for (int64_t c = 1; c < C; c++) m = std::max(m, xr[c]);
+        float s = 0;
+        for (int64_t c = 0; c < C; c++) {
+          yr[c] = std::exp(xr[c] - m);
+          s += yr[c];
+        }
+        for (int64_t c = 0; c < C; c++) yr[c] /= s;
+      }
+    });
+  }
+};
+
+// ---------------------------------------------------------------------------
+// Factory (reference: UnitFactory[uuid] -> instance,
+// libVeles/inc/veles/unit_factory.h).
+inline UnitPtr CreateUnit(const std::string& klass,
+                          const json::Value& config, Weights* weights) {
+  auto get_act = [&]() { return config.string("activation", "linear"); };
+
+  if (klass.rfind("All2All", 0) == 0) {
+    auto u = std::make_unique<DenseUnit>();
+    u->output_size = static_cast<int64_t>(config.number("output_size", 0));
+    u->activation = get_act();
+    if (weights->count("w")) u->w = std::move((*weights)["w"]);
+    if (weights->count("b")) {
+      u->b = std::move((*weights)["b"]);
+      u->has_bias = true;
+    }
+    return u;
+  }
+  if (klass.rfind("Conv", 0) == 0) {
+    auto u = std::make_unique<Conv2DUnit>();
+    u->n_kernels = static_cast<int64_t>(config.number("n_kernels", 0));
+    u->kx = static_cast<int64_t>(config.number("kx", 3));
+    u->ky = static_cast<int64_t>(config.number("ky", u->kx));
+    u->stride = static_cast<int64_t>(config.number("stride", 1));
+    u->activation = get_act();
+    if (config.has("padding")) {
+      const auto& pv = config.at("padding");
+      if (pv.type == json::Value::Type::Number)
+        u->pad_h = u->pad_w = static_cast<int64_t>(pv.num);
+      else
+        u->ResolvePadding(pv.str, 0);
+    } else {
+      u->same_padding = true;  // Conv's Python-side default
+    }
+    if (weights->count("w")) u->w = std::move((*weights)["w"]);
+    if (weights->count("b")) {
+      u->b = std::move((*weights)["b"]);
+      u->has_bias = true;
+    }
+    return u;
+  }
+  if (klass == "MaxPooling" || klass == "AvgPooling") {
+    auto u = std::make_unique<PoolUnit>();
+    u->window = static_cast<int64_t>(config.number("window", 2));
+    u->stride = static_cast<int64_t>(config.number("stride", u->window));
+    u->is_max = klass == "MaxPooling";
+    return u;
+  }
+  if (klass == "LRN") {
+    auto u = std::make_unique<LRNUnit>();
+    u->n = static_cast<int64_t>(config.number("n", 5));
+    u->k = static_cast<float>(config.number("k", 2.0));
+    u->alpha = static_cast<float>(config.number("alpha", 1e-4));
+    u->beta = static_cast<float>(config.number("beta", 0.75));
+    return u;
+  }
+  if (klass == "Flatten") return std::make_unique<FlattenUnit>();
+  if (klass == "Dropout" || klass == "Avatar" || klass == "TrivialUnit")
+    return std::make_unique<IdentityUnit>();
+  if (klass == "MeanDispNormalizer") {
+    auto u = std::make_unique<MeanDispUnit>();
+    u->mean = std::move((*weights)["mean"]);
+    u->rdisp = std::move((*weights)["rdisp"]);
+    return u;
+  }
+  if (klass == "EvaluatorSoftmax") return std::make_unique<SoftmaxUnit>();
+  throw std::runtime_error("no native implementation for unit class " +
+                           klass);
+}
+
+}  // namespace veles

@@ -1,0 +1,146 @@
+// Workflow loader + executor for exported packages.
+//
+// Counterpart of the reference's WorkflowLoader/Workflow
+// (reference: libVeles/src/workflow_loader.cc, inc/veles/workflow.h:72 —
+// load contents.json, build unit DAG via factory, bin-pack output buffers,
+// run). Package form: a directory of contents.json + .npy (see
+// veles_tpu/export/package.py).
+#pragma once
+
+#include <fstream>
+#include <map>
+#include <memory>
+#include <sstream>
+#include <string>
+#include <vector>
+
+#include "json.hpp"
+#include "npy.hpp"
+#include "runtime.hpp"
+#include "units.hpp"
+
+namespace veles {
+
+class Workflow {
+ public:
+  std::string name;
+  std::string checksum;
+
+  static Workflow Load(const std::string& dir) {
+    Workflow wf;
+    std::ifstream f(dir + "/contents.json");
+    if (!f) throw std::runtime_error("cannot open " + dir +
+                                     "/contents.json");
+    std::stringstream ss;
+    ss << f.rdbuf();
+    auto doc = json::Parse(ss.str());
+    wf.name = doc->string("workflow", "workflow");
+    wf.checksum = doc->string("checksum", "");
+    const auto& units = doc->at("units");
+    for (size_t i = 0; i < units.size(); i++) {
+      const auto& ud = units[i];
+      Weights weights;
+      if (ud.has("weights")) {
+        for (const auto& kv : ud.at("weights").obj)
+          weights[kv.first] = npy::Load(dir + "/" + kv.second->str);
+      }
+      std::string klass = ud.string("class", "");
+      std::string uname = ud.string("name", klass);
+      std::vector<std::string> inputs;
+      for (const auto& inp : ud.at("inputs").arr)
+        inputs.push_back(inp->str);
+      // Evaluators need labels; at inference they are skipped unless they
+      // are pure transforms (softmax probabilities on one input).
+      if (klass == "EvaluatorMSE") continue;
+      if (klass == "EvaluatorSoftmax") inputs.resize(1);
+      UnitPtr u;
+      try {
+        u = CreateUnit(klass, ud.at("config"), &weights);
+      } catch (const std::exception& e) {
+        throw std::runtime_error(std::string("unit ") + uname + ": " +
+                                 e.what());
+      }
+      u->name = uname;
+      u->inputs = inputs;
+      wf.units_.push_back(std::move(u));
+    }
+    return wf;
+  }
+
+  // Run the graph on one input; returns the last unit's output.
+  // Intermediates live in an arena planned from buffer lifetimes
+  // (MemoryOptimizer parity).
+  Tensor Run(const Tensor& input, ThreadPool* pool,
+             const std::string& output_unit = "") {
+    int n = static_cast<int>(units_.size());
+    std::map<std::string, int> producer;   // output name -> step
+    std::map<std::string, Shape> shapes;
+    shapes["@input"] = input.shape;
+
+    std::vector<ArenaItem> items(n);
+    std::vector<Shape> out_shapes(n);
+    for (int i = 0; i < n; i++) {
+      std::vector<Shape> in_shapes;
+      for (const auto& src : units_[i]->inputs) {
+        if (!shapes.count(src))
+          throw std::runtime_error("unit " + units_[i]->name +
+                                   " needs missing input " + src);
+        in_shapes.push_back(shapes[src]);
+      }
+      out_shapes[i] = units_[i]->OutputShape(in_shapes);
+      shapes[units_[i]->name] = out_shapes[i];
+      producer[units_[i]->name] = i;
+      items[i].size = out_shapes[i].size();
+      items[i].def = i;
+      items[i].last_use = i;
+    }
+    for (int i = 0; i < n; i++)
+      for (const auto& src : units_[i]->inputs)
+        if (producer.count(src))
+          items[producer[src]].last_use =
+              std::max(items[producer[src]].last_use, i);
+    // the requested output must survive to the end
+    int out_idx = n - 1;
+    if (!output_unit.empty()) {
+      if (!producer.count(output_unit))
+        throw std::runtime_error("no unit named " + output_unit);
+      out_idx = producer[output_unit];
+    }
+    items[out_idx].last_use = n;
+    arena_floats_ = PlanArena(&items);
+    arena_.resize(arena_floats_);
+
+    std::map<std::string, Tensor> outputs;
+    outputs["@input"].shape = input.shape;
+    outputs["@input"].data = const_cast<float*>(input.data);
+
+    UnitContext ctx{pool};
+    for (int i = 0; i <= out_idx || i < n; i++) {
+      if (i >= n) break;
+      std::vector<const Tensor*> ins;
+      for (const auto& src : units_[i]->inputs)
+        ins.push_back(&outputs[src]);
+      Tensor& out = outputs[units_[i]->name];
+      out.shape = out_shapes[i];
+      out.data = arena_.data() + items[i].offset;
+      units_[i]->Run(ins, &out, &ctx);
+      if (i == out_idx && output_unit.empty() == false) break;
+    }
+
+    Tensor result;
+    result.own(out_shapes[out_idx]);
+    const Tensor& src = outputs[units_[out_idx]->name];
+    std::copy(src.data, src.data + src.size(), result.data);
+    return result;
+  }
+
+  int64_t arena_bytes() const { return arena_floats_ * 4; }
+  size_t n_units() const { return units_.size(); }
+
+ private:
+  std::vector<UnitPtr> units_;
+  std::vector<float> arena_;
+  int64_t arena_floats_ = 0;
+};
+
+}  // namespace veles

@@ -129,3 +129,95 @@ def test_rollback_uses_live_buffers(rng):
     tr.initialize(seed=0)
     tr.run()  # would raise "Array has been deleted" on alias bug
     assert tr.wstate is not None
+
+
+def test_shards_equal_batch_counts():
+    """All shards must yield the SAME number of batches per epoch (r1
+    review: unequal counts desync multi-host collectives)."""
+    centers = np.random.default_rng(7).standard_normal((3, 4))
+    lab = np.random.default_rng(1).integers(0, 3, 9).astype(np.int32)
+    d = (centers[lab]).astype(np.float32)
+    counts = []
+    for shard in (0, 1):
+        l = vt.ArrayLoader({TRAIN: d}, {TRAIN: lab}, minibatch_size=4,
+                           shard_index=shard, shard_count=2)
+        l.initialize()
+        batches = list(l.iter_epoch(TRAIN, 0))
+        counts.append(len(batches))
+    assert counts[0] == counts[1]
+    # and every sample still served exactly once across shards
+    total = 0
+    for shard in (0, 1):
+        l = vt.ArrayLoader({TRAIN: d}, {TRAIN: lab}, minibatch_size=4,
+                           shard_index=shard, shard_count=2)
+        l.initialize()
+        total += sum(int(b["@mask"].sum()) for b in l.iter_epoch(TRAIN, 0))
+    assert total == 9
+
+
+def test_train_ratio_bagging():
+    d = np.arange(100, dtype=np.float32).reshape(100, 1)
+    lab = np.zeros(100, np.int32)
+    l = vt.ArrayLoader({TRAIN: d}, {TRAIN: lab}, minibatch_size=10,
+                       train_ratio=0.5, subset_seed=3)
+    l.initialize()
+    served = set()
+    for b in l.iter_epoch(TRAIN, 0):
+        m = b["@mask"].astype(bool)
+        served.update(np.asarray(b["@input"])[m, 0].astype(int).tolist())
+    assert len(served) == 50
+    # deterministic subset
+    l2 = vt.ArrayLoader({TRAIN: d}, {TRAIN: lab}, minibatch_size=10,
+                        train_ratio=0.5, subset_seed=3)
+    l2.initialize()
+    served2 = set()
+    for b in l2.iter_epoch(TRAIN, 0):
+        m = b["@mask"].astype(bool)
+        served2.update(np.asarray(b["@input"])[m, 0].astype(int).tolist())
+    assert served == served2
+
+
+def test_normalizer_state_roundtrip():
+    from veles_tpu.normalization import NormalizerRegistry
+    d = np.random.default_rng(0).standard_normal((32, 4)).astype(np.float32)
+    lab = np.zeros(32, np.int32)
+    l = vt.ArrayLoader({TRAIN: d.copy()}, {TRAIN: lab}, minibatch_size=8,
+                       normalizer=NormalizerRegistry.create("mean_disp"))
+    l.initialize()
+    st = l.state()
+    l2 = vt.ArrayLoader({TRAIN: d.copy()}, {TRAIN: lab}, minibatch_size=8)
+    l2.set_state(st)
+    assert l2.normalizer is not None
+    np.testing.assert_allclose(l2.normalizer.mean, l.normalizer.mean,
+                               rtol=1e-6)
+
+
+def test_restore_reapplies_rollback_lr(tmp_path, rng):
+    centers = np.random.default_rng(7).standard_normal((3, 8)) * 3
+    lab = rng.integers(0, 3, 96).astype(np.int32)
+    d = (centers[lab] + rng.standard_normal((96, 8))).astype(np.float32)
+
+    def mk():
+        loader = vt.ArrayLoader({TRAIN: d, VALID: d[:32]},
+                                {TRAIN: lab, VALID: lab[:32]},
+                                minibatch_size=32)
+        wf = _fc_wf(dim=8)
+        return loader, wf
+
+    loader, wf = mk()
+    snap = vt.Snapshotter("rb", str(tmp_path))
+    dec = vt.Decision(max_epochs=5, fail_iterations=10, rollback_after=1)
+    tr = vt.Trainer(wf, loader, opt.SGD(0.05, momentum=0.9), dec,
+                    snapshotter=snap)
+    tr.initialize(seed=0)
+    tr.run()
+    if tr.decision.lr_multiplier == 1.0:
+        pytest.skip("no rollback occurred on this seed")
+    loader2, wf2 = mk()
+    tr2 = vt.Trainer(wf2, loader2, opt.SGD(0.05, momentum=0.9),
+                     vt.Decision(max_epochs=6))
+    tr2.initialize(seed=1)
+    tr2.restore(snap.last_path)
+    base = opt.SGD(0.05).schedule(0)
+    assert float(tr2.optimizer.schedule(0)) == pytest.approx(
+        float(base) * tr2.decision.lr_multiplier)

@@ -111,17 +111,27 @@ def _make_mesh(spec: Optional[str]):
     return make_mesh(MeshSpec(**kw))
 
 
-def _load_config(path: str, overrides) -> tuple:
-    """Returns (trainer_factory or None, used create())"""
-    create = None
+def _load_config(path: str, overrides):
+    """Returns (create_fn_or_None, snapshot_manifest_or_None).
+
+    A positional .json that is actually a snapshot manifest (has a
+    'tensors' key — see Snapshotter.save) restores config from its
+    embedded 'config' snapshot and schedules a state restore (reference:
+    positional snapshot restore, veles/__main__.py:539-589)."""
+    create, snapshot = None, None
     if path.endswith(".json"):
         with open(path) as f:
-            root.update(json.load(f))
+            data = json.load(f)
+        if "tensors" in data:  # snapshot manifest, not a config
+            snapshot = path
+            root.update(data.get("config", {}))
+        else:
+            root.update(data)
     else:
         ns = runpy.run_path(path, init_globals={"root": root})
         create = ns.get("create")
     apply_overrides(root, overrides)
-    return create
+    return create, snapshot
 
 
 def main(argv=None) -> int:
@@ -147,7 +157,9 @@ def main(argv=None) -> int:
         root.common.random_seed = args.random_seed
         prng.streams.reset()
 
-    create = _load_config(args.config, args.overrides)
+    create, manifest_snapshot = _load_config(args.config, args.overrides)
+    if manifest_snapshot and not args.snapshot:
+        args.snapshot = manifest_snapshot
 
     if args.dump_config:
         print(root.dump())
@@ -187,6 +199,10 @@ def main(argv=None) -> int:
         def member_factory(member_id, seed, train_ratio):
             root.common.random_seed = seed
             prng.streams.reset()
+            # Standard-path loaders accept bagging args via the Loader base;
+            # create()-style configs must honor root.loader themselves.
+            root.loader.train_ratio = train_ratio
+            root.loader.subset_seed = seed
             return trainer_factory(root)
 
         et = EnsembleTrainer(member_factory, int(n),

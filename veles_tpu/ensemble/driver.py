@@ -65,25 +65,27 @@ class EnsembleTester(Logger):
 
     ``workflow_factory()`` returns a fresh (built) workflow matching the
     members; weights default to 1/best_value (better members vote more,
-    the reference's weighted voting)."""
+    the reference's weighted voting).
+
+    Snapshots are loaded and the predict step jitted ONCE at construction
+    (all members share one compiled function, wstate is an argument)."""
 
     def __init__(self, workflow_factory: Callable, manifest: str,
                  output_unit: Optional[str] = None):
-        self.workflow_factory = workflow_factory
         with open(manifest) as f:
             self.members = json.load(f)
-        self.output_unit = output_unit
+        wf = workflow_factory()
+        self._predict = wf.make_predict_step(output_unit)
+        self._wstates = [
+            Snapshotter.restore_wstate(Snapshotter.load(m["snapshot"]))
+            for m in self.members]
 
     def predict(self, batch: Dict) -> np.ndarray:
         """Ensemble class probabilities for one batch."""
         votes = None
         total_w = 0.0
-        for m in self.members:
-            wf = self.workflow_factory()
-            payload = Snapshotter.load(m["snapshot"])
-            wstate = Snapshotter.restore_wstate(payload)
-            predict = wf.make_predict_step(self.output_unit)
-            logits = np.asarray(predict(wstate, batch), np.float64)
+        for m, wstate in zip(self.members, self._wstates):
+            logits = np.asarray(self._predict(wstate, batch), np.float64)
             p = np.exp(logits - logits.max(-1, keepdims=True))
             p /= p.sum(-1, keepdims=True)
             w = 1.0 / max(float(m.get("best_value", 1.0)), 1e-3)

@@ -1,0 +1,1 @@
+from .package import export_package, load_package

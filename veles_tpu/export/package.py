@@ -1,0 +1,136 @@
+"""Workflow package export for the native serving runtime.
+
+Reference parity: ``Workflow.package_export()`` (reference:
+veles/workflow.py:868) produced an archive of ``contents.json`` + ``.npy``
+weight files that the C++ libVeles runtime loaded via UnitFactory UUIDs
+(libVeles/src/main_file_loader.h:61-80 UnitDefinition,
+inc/veles/numpy_array_loader.h). This module keeps that package shape —
+contents.json + npy entries in a zip — so the serving/ C++ runtime and its
+golden-fixture test pattern (libVeles/tests/workflow_files/) carry over.
+
+The unit 'uuid' of the reference becomes the registered class name; each
+exported unit records its constructor config and tensor refs."""
+
+from __future__ import annotations
+
+import io
+import json
+import zipfile
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+
+from ..units.workflow import Workflow
+
+#: Exportable unit types and the constructor fields the native runtime
+#: needs. Units not listed fall back to their public scalar attrs.
+_EXPORT_FIELDS = {
+    "All2All": ("output_size", "activation", "include_bias"),
+    "All2AllTanh": ("output_size", "activation", "include_bias"),
+    "All2AllRELU": ("output_size", "activation", "include_bias"),
+    "All2AllSincos": ("output_size", "activation", "include_bias"),
+    "All2AllSoftmax": ("output_size", "activation", "include_bias"),
+    "Conv": ("n_kernels", "kx", "ky", "stride", "padding", "activation"),
+    "ConvRELU": ("n_kernels", "kx", "ky", "stride", "padding",
+                 "activation"),
+    "ConvTanh": ("n_kernels", "kx", "ky", "stride", "padding",
+                 "activation"),
+    "MaxPooling": ("window", "stride"),
+    "AvgPooling": ("window", "stride"),
+    "LRN": ("n", "k", "alpha", "beta"),
+    "Dropout": ("ratio",),
+    "Flatten": (),
+    "MeanDispNormalizer": (),
+    "EvaluatorSoftmax": (),
+    "EvaluatorMSE": (),
+}
+
+
+def _unit_config(unit) -> dict:
+    fields = _EXPORT_FIELDS.get(type(unit).__name__)
+    if fields is None:
+        fields = [k for k, v in vars(unit).items()
+                  if not k.startswith("_") and isinstance(
+                      v, (int, float, str, bool))]
+    cfg = {}
+    for f in fields:
+        v = getattr(unit, f, None)
+        if isinstance(v, tuple):
+            v = list(v)
+        cfg[f] = v
+    return cfg
+
+
+def export_package(workflow: Workflow, wstate: dict, path: str, *,
+                   input_spec: Optional[dict] = None) -> str:
+    """Write a serving package zip: contents.json + <unit>_<param>.npy."""
+    units = []
+    arrays: Dict[str, np.ndarray] = {}
+    params = jax.device_get(wstate["params"])
+    state = jax.device_get(wstate["state"])
+
+    for u in workflow.topo_order():
+        entry = {
+            "name": u.name,
+            "class": type(u).__name__,
+            "inputs": list(u.inputs),
+            "config": _unit_config(u),
+            "weights": {},
+        }
+        for source, tree in (("params", params), (("state"), state)):
+            for pname, arr in tree.get(u.name, {}).items():
+                if not hasattr(arr, "shape"):
+                    continue
+                fname = f"{u.name}_{pname}.npy"
+                arrays[fname] = np.asarray(arr)
+                entry["weights"][pname] = fname
+        units.append(entry)
+
+    contents = {
+        "workflow": workflow.name,
+        "checksum": workflow.checksum(),
+        "format_version": 1,
+        "units": units,
+    }
+    if input_spec is not None:
+        contents["input_spec"] = input_spec
+
+    if path.endswith(".zip"):
+        with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
+            z.writestr("contents.json", json.dumps(contents, indent=1))
+            for fname, arr in arrays.items():
+                buf = io.BytesIO()
+                np.save(buf, np.ascontiguousarray(arr, np.float32))
+                z.writestr(fname, buf.getvalue())
+    else:  # directory package (what the C++ serving runtime consumes)
+        import os
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, "contents.json"), "w") as f:
+            json.dump(contents, f, indent=1)
+        for fname, arr in arrays.items():
+            np.save(os.path.join(path, fname),
+                    np.ascontiguousarray(arr, np.float32))
+    return path
+
+
+def load_package(path: str) -> dict:
+    """Load a package back (Python side — used by tests and the RESTful
+    server; the C++ runtime has its own loader)."""
+    import os
+    if path.endswith(".zip"):
+        with zipfile.ZipFile(path) as z:
+            contents = json.loads(z.read("contents.json"))
+            for u in contents["units"]:
+                tensors = {}
+                for pname, fname in u["weights"].items():
+                    tensors[pname] = np.load(io.BytesIO(z.read(fname)))
+                u["tensors"] = tensors
+    else:
+        with open(os.path.join(path, "contents.json")) as f:
+            contents = json.load(f)
+        for u in contents["units"]:
+            u["tensors"] = {
+                pname: np.load(os.path.join(path, fname))
+                for pname, fname in u["weights"].items()}
+    return contents

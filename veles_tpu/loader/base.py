@@ -51,7 +51,8 @@ class Loader(Logger):
     def __init__(self, minibatch_size: int = 100, *,
                  shuffle_limit: float = np.inf,
                  prng_name: str = "loader",
-                 shard_index: int = 0, shard_count: int = 1):
+                 shard_index: int = 0, shard_count: int = 1,
+                 train_ratio: float = 1.0, subset_seed: int = 0):
         self.minibatch_size = int(minibatch_size)
         self.class_lengths: List[int] = [0, 0, 0]
         self.shuffle_limit = shuffle_limit  # epochs after which shuffling stops
@@ -59,6 +60,10 @@ class Loader(Logger):
         self.prng_name = prng_name
         self.shard_index = int(shard_index)
         self.shard_count = int(shard_count)
+        # train_ratio < 1 trains on a fixed random subset (ensemble bagging,
+        # reference: veles/ensemble train_ratio semantics).
+        self.train_ratio = float(train_ratio)
+        self.subset_seed = int(subset_seed)
         self.normalizer = None
         self._loaded = False
 
@@ -92,23 +97,34 @@ class Loader(Logger):
         return sum(self.class_lengths[:klass])
 
     # -- epoch iteration ---------------------------------------------------
+    def _train_indices(self, klass: int) -> np.ndarray:
+        """Class sample indices, restricted to the bagging subset for
+        train when train_ratio < 1."""
+        n = self.class_lengths[klass]
+        if klass != TRAIN or self.train_ratio >= 1.0:
+            return np.arange(n)
+        keep = max(1, int(round(n * self.train_ratio)))
+        rng = np.random.Generator(
+            np.random.PCG64([self.subset_seed, 0xBA66]))
+        return np.sort(rng.choice(n, size=keep, replace=False))
+
     def epoch_permutation(self, klass: int,
                           epoch: Optional[int] = None) -> np.ndarray:
         """Deterministic permutation for (class, epoch). Train shuffles per
         epoch (until shuffle_limit); valid/test are served in order
         (reference: veles/loader/base.py:711-724)."""
-        n = self.class_lengths[klass]
+        base = self._train_indices(klass)
         if epoch is None:
             epoch = self.epoch_number
         if klass != TRAIN or epoch >= self.shuffle_limit:
-            return np.arange(n)
+            return base
         seed_stream = prng.get(self.prng_name)
         rng = np.random.Generator(
             np.random.PCG64([seed_stream.seed, epoch, klass]))
-        return rng.permutation(n)
+        return rng.permutation(base)
 
     def n_minibatches(self, klass: int) -> int:
-        n = self.class_lengths[klass]
+        n = len(self._train_indices(klass))
         if self.shard_count > 1:
             n = -(-n // self.shard_count)
         return -(-n // self.minibatch_size) if n else 0
@@ -117,13 +133,19 @@ class Loader(Logger):
                    ) -> Iterator[Dict[str, np.ndarray]]:
         """Yield fixed-size padded batches with '@mask'. Under sharding, this
         host sees a strided slice of the permutation (reference analog: the
-        master shipped index subsets to each slave)."""
+        master shipped index subsets to each slave). EVERY shard yields the
+        same number of batches (padding fully-empty ones at the tail if its
+        slice runs short) — all hosts must drive the same count of compiled
+        collective steps or multi-host SPMD hangs."""
         perm = self.epoch_permutation(klass, epoch)
+        n_batches = self.n_minibatches(klass)
         if self.shard_count > 1:
             perm = perm[self.shard_index::self.shard_count]
         bs = self.minibatch_size
-        for i in range(0, len(perm), bs):
-            chunk = perm[i:i + bs]
+        for i in range(n_batches):
+            chunk = perm[i * bs:(i + 1) * bs]
+            if len(chunk) == 0:  # shard exhausted: fully-masked batch
+                chunk = np.zeros(0, np.int64)
             yield self.make_batch(chunk, klass)
 
     def make_batch(self, chunk: np.ndarray, klass: int
@@ -168,16 +190,36 @@ class Loader(Logger):
 
     # -- checkpointable state (reference: pickle of loader counters) --------
     def state(self) -> dict:
-        return {"epoch_number": self.epoch_number,
-                "minibatch_size": self.minibatch_size,
-                "shard_index": self.shard_index,
-                "shard_count": self.shard_count}
+        st = {"epoch_number": self.epoch_number,
+              "minibatch_size": self.minibatch_size,
+              "shard_index": self.shard_index,
+              "shard_count": self.shard_count,
+              "train_ratio": self.train_ratio,
+              "subset_seed": self.subset_seed}
+        if self.normalizer is not None:
+            st["normalizer"] = {
+                "mapping": type(self.normalizer).MAPPING,
+                "state": {k: (v.tolist() if isinstance(v, np.ndarray) else v)
+                          for k, v in self.normalizer.state().items()},
+            }
+        return st
 
     def set_state(self, st: dict) -> None:
         self.epoch_number = int(st["epoch_number"])
         self.minibatch_size = int(st["minibatch_size"])
         self.shard_index = int(st.get("shard_index", 0))
         self.shard_count = int(st.get("shard_count", 1))
+        self.train_ratio = float(st.get("train_ratio", 1.0))
+        self.subset_seed = int(st.get("subset_seed", 0))
+        norm = st.get("normalizer")
+        if norm:
+            from ..normalization import NormalizerRegistry
+            if (self.normalizer is None
+                    or type(self.normalizer).MAPPING != norm["mapping"]):
+                self.normalizer = NormalizerRegistry.create(norm["mapping"])
+            self.normalizer.set_state({
+                k: (np.asarray(v, np.float32) if isinstance(v, list) else v)
+                for k, v in norm["state"].items()})
 
 
 class ArrayLoader(Loader):

@@ -213,10 +213,17 @@ class Trainer(Logger):
             if not force:
                 raise ValueError(msg + "; pass force=True to override")
             self.warning("%s — forcing restore", msg)
-        self.wstate = Snapshotter.restore_wstate(payload, like=self.wstate)
+        self.wstate = Snapshotter.restore_wstate(payload, like=self.wstate,
+                                                 shardings=self._state_sh)
         self.loader.set_state(payload["loader"])
         self.decision.set_state(payload["decision"])
         prng.streams.set_state(payload["prng"])
+        # Re-apply accumulated rollback lr drops to the freshly-built
+        # schedule, else a resumed run trains at the original (too-high) lr.
+        if getattr(self.decision, "lr_multiplier", 1.0) != 1.0:
+            self.optimizer.schedule = _scaled_schedule(
+                self.optimizer.schedule, self.decision.lr_multiplier)
+            self._compile_steps()
 
 
 def _scaled_schedule(schedule, scale):
