@@ -50,9 +50,11 @@ struct Tensor {
 };
 
 // ---------------------------------------------------------------------------
-// Thread pool with parallel_for (the reference scheduled whole units on its
-// pool, libVeles/src/engine.h:45; here units run in topo order and the
-// parallelism is *inside* each op — better cache behavior for inference).
+// Persistent thread pool with parallel_for (the reference scheduled whole
+// units on its pool, libVeles/src/engine.h:45; here units run in topo order
+// and the parallelism is *inside* each op — better cache behavior for
+// inference). Workers are spawned once and fed range tasks through a
+// condition variable — no per-op thread create/destroy.
 class ThreadPool {
  public:
   explicit ThreadPool(int n_threads = 0)
@@ -60,32 +62,86 @@ class ThreadPool {
                          : static_cast<int>(
                                std::thread::hardware_concurrency())) {
     if (n_ < 1) n_ = 1;
+    for (int t = 1; t < n_; t++)  // worker 0 is the calling thread
+      workers_.emplace_back([this] { WorkerLoop(); });
+  }
+
+  ~ThreadPool() {
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      stop_ = true;
+    }
+    cv_.notify_all();
+    for (auto& th : workers_) th.join();
   }
 
   int size() const { return n_; }
 
-  // Run fn(begin, end) over [0, total) split across threads.
+  // Run fn(begin, end) over [0, total) split across the pool; the calling
+  // thread executes its own share, workers take the rest.
   void ParallelFor(int64_t total,
                    const std::function<void(int64_t, int64_t)>& fn) {
     if (total <= 0) return;
     int k = static_cast<int>(
         std::min<int64_t>(n_, std::max<int64_t>(1, total)));
-    if (k == 1) {
+    if (k == 1 || workers_.empty()) {
       fn(0, total);
       return;
     }
-    std::vector<std::thread> threads;
     int64_t chunk = (total + k - 1) / k;
-    for (int t = 0; t < k; t++) {
-      int64_t b = t * chunk, e = std::min<int64_t>(total, b + chunk);
-      if (b >= e) break;
-      threads.emplace_back([&fn, b, e] { fn(b, e); });
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      task_ = &fn;
+      task_total_ = total;
+      task_chunk_ = chunk;
+      next_part_ = 1;  // part 0 belongs to the caller
+      n_parts_ = k;
+      pending_ = k - 1;
+      generation_++;
     }
-    for (auto& th : threads) th.join();
+    cv_.notify_all();
+    fn(0, std::min<int64_t>(total, chunk));  // caller's share
+    std::unique_lock<std::mutex> lk(mu_);
+    done_cv_.wait(lk, [this] { return pending_ == 0; });
+    task_ = nullptr;
   }
 
  private:
+  void WorkerLoop() {
+    uint64_t seen = 0;
+    while (true) {
+      const std::function<void(int64_t, int64_t)>* fn = nullptr;
+      int64_t b = 0, e = 0;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_.wait(lk, [this, &seen] {
+          return stop_ || (task_ != nullptr && generation_ != seen &&
+                           next_part_ < n_parts_);
+        });
+        if (stop_) return;
+        int part = next_part_++;
+        if (next_part_ >= n_parts_) seen = generation_;
+        fn = task_;
+        b = part * task_chunk_;
+        e = std::min(task_total_, b + task_chunk_);
+      }
+      if (b < e) (*fn)(b, e);
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        if (--pending_ == 0) done_cv_.notify_all();
+      }
+    }
+  }
+
   int n_;
+  std::vector<std::thread> workers_;
+  std::mutex mu_;
+  std::condition_variable cv_, done_cv_;
+  const std::function<void(int64_t, int64_t)>* task_ = nullptr;
+  int64_t task_total_ = 0, task_chunk_ = 0;
+  int next_part_ = 0, n_parts_ = 0, pending_ = 0;
+  uint64_t generation_ = 0;
+  bool stop_ = false;
 };
 
 // ---------------------------------------------------------------------------

@@ -328,19 +328,49 @@ inline UnitPtr CreateUnit(const std::string& klass,
     }
     return u;
   }
+  // strict scalar extraction: arrays (tuple strides etc.) must be
+  // handled explicitly, never silently defaulted
+  auto get_int = [&](const char* key, int64_t dflt,
+                     bool allow_equal_pair = false) -> int64_t {
+    if (!config.has(key)) return dflt;
+    const auto& v = config.at(key);
+    if (v.type == json::Value::Type::Number)
+      return static_cast<int64_t>(v.num);
+    if (allow_equal_pair && v.type == json::Value::Type::Array &&
+        v.size() == 2 &&
+        v[0].type == json::Value::Type::Number &&
+        v[0].num == v[1].num)
+      return static_cast<int64_t>(v[0].num);
+    throw std::runtime_error(std::string("unsupported config value for ") +
+                             key + " (non-scalar)");
+  };
+
   if (klass.rfind("Conv", 0) == 0) {
     auto u = std::make_unique<Conv2DUnit>();
     u->n_kernels = static_cast<int64_t>(config.number("n_kernels", 0));
     u->kx = static_cast<int64_t>(config.number("kx", 3));
     u->ky = static_cast<int64_t>(config.number("ky", u->kx));
-    u->stride = static_cast<int64_t>(config.number("stride", 1));
+    u->stride = get_int("stride", 1, /*allow_equal_pair=*/true);
     u->activation = get_act();
     if (config.has("padding")) {
       const auto& pv = config.at("padding");
-      if (pv.type == json::Value::Type::Number)
+      if (pv.type == json::Value::Type::Number) {
         u->pad_h = u->pad_w = static_cast<int64_t>(pv.num);
-      else
+      } else if (pv.type == json::Value::Type::Array) {
+        // exported flat [top, bottom, left, right] or [h, w]
+        if (pv.size() == 4 && pv[0].num == pv[1].num &&
+            pv[2].num == pv[3].num) {
+          u->pad_h = static_cast<int64_t>(pv[0].num);
+          u->pad_w = static_cast<int64_t>(pv[2].num);
+        } else if (pv.size() == 2) {
+          u->pad_h = static_cast<int64_t>(pv[0].num);
+          u->pad_w = static_cast<int64_t>(pv[1].num);
+        } else {
+          throw std::runtime_error("unsupported asymmetric padding");
+        }
+      } else {
         u->ResolvePadding(pv.str, 0);
+      }
     } else {
       u->same_padding = true;  // Conv's Python-side default
     }
@@ -353,8 +383,10 @@ inline UnitPtr CreateUnit(const std::string& klass,
   }
   if (klass == "MaxPooling" || klass == "AvgPooling") {
     auto u = std::make_unique<PoolUnit>();
-    u->window = static_cast<int64_t>(config.number("window", 2));
-    u->stride = static_cast<int64_t>(config.number("stride", u->window));
+    u->window = get_int("window", 2, /*allow_equal_pair=*/true);
+    bool has_stride = config.has("stride") &&
+        config.at("stride").type != json::Value::Type::Null;
+    u->stride = has_stride ? get_int("stride", u->window, true) : u->window;
     u->is_max = klass == "MaxPooling";
     return u;
   }

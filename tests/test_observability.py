@@ -1,0 +1,121 @@
+"""Plotting/status/RESTful serving (reference L10/L11 — SURVEY.md §2.7)."""
+
+import json
+import os
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import veles_tpu as vt
+from veles_tpu.plotting import (MetricsRecorder, confusion_matrix,
+                                histogram, render_confusion, sparkline,
+                                weights_image)
+from veles_tpu.runtime.restful import RestfulServer
+from veles_tpu.runtime.status import StatusReporter, StatusServer
+from veles_tpu.units import (All2AllSoftmax, All2AllTanh, EvaluatorSoftmax,
+                             Workflow)
+
+
+def test_sparkline_and_histogram():
+    s = sparkline([1, 2, 3, 4, 5])
+    assert len(s) == 5 and s[0] != s[-1]
+    h = histogram(np.random.default_rng(0).standard_normal(1000))
+    assert "#" in h
+
+
+def test_metrics_recorder(tmp_path):
+    rec = MetricsRecorder("train", str(tmp_path))
+    for i in range(10):
+        rec.record(i, loss=1.0 / (i + 1), error_pct=50 - i)
+    assert "loss" in rec.summary()
+    png = rec.save_png()
+    assert png and os.path.exists(png)
+    jsonl = tmp_path / "train.jsonl"
+    lines = [json.loads(l) for l in jsonl.read_text().splitlines()]
+    assert lines[0]["loss"] == 1.0
+    rec.close()
+
+
+def test_confusion():
+    cm = confusion_matrix([0, 1, 1, 2], [0, 1, 2, 2], 3)
+    assert cm[1, 1] == 1 and cm[1, 2] == 1 and cm.sum() == 4
+    table = render_confusion(cm)
+    assert "1" in table
+
+
+def test_weights_image():
+    w = np.random.default_rng(0).standard_normal((6, 16))
+    img = weights_image(w)
+    assert img.shape == (8, 12)  # gx=3, gy=2 grid of 4x4 tiles
+    assert img.min() >= 0 and img.max() <= 1
+
+
+def test_status_server(tmp_path):
+    rep = StatusReporter(str(tmp_path / "status.json"), name="t")
+    rep.update(epoch=3, error_pct=1.5)
+    srv = StatusServer(rep).start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/status.json") as r:
+            doc = json.loads(r.read())
+        assert doc["epoch"] == 3
+        with urllib.request.urlopen(f"http://127.0.0.1:{srv.port}/") as r:
+            assert b"veles_tpu" in r.read()
+    finally:
+        srv.stop()
+
+
+def test_restful_inference():
+    wf = Workflow("serve")
+    wf.add(All2AllTanh(8, name="fc1"))
+    wf.add(All2AllSoftmax(3, name="out", inputs=("fc1",)))
+    wf.add(EvaluatorSoftmax(name="ev", inputs=("out", "@labels", "@mask")))
+    wf.build({"@input": vt.Spec((4, 6), jnp.float32),
+              "@labels": vt.Spec((4,), jnp.int32),
+              "@mask": vt.Spec((4,), jnp.float32)})
+    ws = wf.init_state(jax.random.key(0), vt.optimizers.SGD(0.1))
+    srv = RestfulServer(wf.make_predict_step("out"), ws, 4, (6,)).start()
+    try:
+        # 6 samples -> two padded compiled batches
+        x = np.random.default_rng(0).standard_normal((6, 6)).tolist()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/predict",
+            json.dumps({"input": x}).encode(),
+            {"Content-Type": "application/json"})
+        with urllib.request.urlopen(req) as r:
+            out = json.loads(r.read())["output"]
+        assert np.asarray(out).shape == (6, 3)
+        # bad shape -> 400 with error json
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/predict",
+            json.dumps({"input": [[1, 2]]}).encode(),
+            {"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req)
+        assert ei.value.code == 400
+    finally:
+        srv.stop()
+
+
+def test_trainer_with_recorder_and_status(tmp_path, rng):
+    from veles_tpu.loader.base import TRAIN, VALID
+    centers = np.random.default_rng(7).standard_normal((3, 8)) * 3
+    lab = rng.integers(0, 3, 96).astype(np.int32)
+    d = (centers[lab] + rng.standard_normal((96, 8))).astype(np.float32)
+    loader = vt.ArrayLoader({TRAIN: d, VALID: d[:32]},
+                            {TRAIN: lab, VALID: lab[:32]}, minibatch_size=32)
+    wf = Workflow("obs")
+    wf.add(All2AllTanh(16, name="fc1"))
+    wf.add(All2AllSoftmax(3, name="out", inputs=("fc1",)))
+    wf.add(EvaluatorSoftmax(name="ev", inputs=("out", "@labels", "@mask")))
+    rec = MetricsRecorder("run", str(tmp_path))
+    rep = StatusReporter(str(tmp_path / "status.json"), "obs")
+    tr = vt.Trainer(wf, loader, vt.optimizers.SGD(0.05, momentum=0.9),
+                    vt.Decision(max_epochs=3), recorder=rec, status=rep)
+    tr.initialize(seed=0)
+    tr.run()
+    assert len(rec.series["valid_error_pct"]) == 3
+    assert rep.read()["epoch"] == 2

@@ -129,3 +129,31 @@ def test_cpp_arena_reuse(served, binary, rng):
     # fc 128, drop 128, out 40, softmax 40 floats = ~12.6k floats
     total = (8192 + 2048 + 2048 + 128 + 128 + 40 + 40) * 4
     assert stats["arena_bytes"] < total, stats
+
+
+def test_cpp_tuple_stride_and_strided_pool(binary, tmp_path, rng):
+    """Tuple strides and window!=stride pooling must export as scalars/
+    lists the C++ runtime parses exactly (r1 review: silent defaults)."""
+    wf = build_workflow("stride_test", [
+        {"type": "conv_relu", "n_kernels": 6, "kx": 3, "stride": (2, 2),
+         "padding": 1, "name": "conv1"},
+        {"type": "max_pooling", "window": 3, "stride": 2, "name": "pool1"},
+        {"type": "softmax", "output_size": 5, "name": "out"},
+    ])
+    wf.build({"@input": vt.Spec((2, 13, 13, 3), jnp.float32),
+              "@labels": vt.Spec((2,), jnp.int32),
+              "@mask": vt.Spec((2,), jnp.float32)})
+    ws = wf.init_state(jax.random.key(1), opt.SGD(0.01))
+    pkg = str(tmp_path / "pkg2")
+    export_package(wf, ws, pkg)
+    x = rng.standard_normal((2, 13, 13, 3)).astype(np.float32)
+    np.save(tmp_path / "in.npy", x)
+    r = subprocess.run(
+        [binary, pkg, str(tmp_path / "in.npy"), str(tmp_path / "out.npy"),
+         "--output-unit", "out"],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    got = np.load(tmp_path / "out.npy")
+    ref = np.asarray(wf.make_predict_step("out")(
+        ws, {"@input": jnp.asarray(x)}))
+    np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-4)

@@ -56,7 +56,18 @@ def _unit_config(unit) -> dict:
     cfg = {}
     for f in fields:
         v = getattr(unit, f, None)
-        if isinstance(v, tuple):
+        # Normalize pair forms so the native runtime sees scalars or
+        # explicit lists, never Python tuples with mixed meaning.
+        if f in ("stride", "window") and isinstance(v, (tuple, list)):
+            v = list(v)
+            if len(v) == 2 and v[0] == v[1]:
+                v = v[0]
+        elif f == "padding" and isinstance(v, (tuple, list)):
+            flat = []
+            for p in v:
+                flat.extend(p if isinstance(p, (tuple, list)) else [p])
+            v = flat
+        elif isinstance(v, tuple):
             v = list(v)
         cfg[f] = v
     return cfg
@@ -78,13 +89,17 @@ def export_package(workflow: Workflow, wstate: dict, path: str, *,
             "config": _unit_config(u),
             "weights": {},
         }
-        for source, tree in (("params", params), (("state"), state)):
+        for source, tree in (("params", params), ("state", state)):
             for pname, arr in tree.get(u.name, {}).items():
                 if not hasattr(arr, "shape"):
                     continue
-                fname = f"{u.name}_{pname}.npy"
+                # a name collision between params and state would silently
+                # clobber; disambiguate with the source prefix
+                key = pname if pname not in entry["weights"] \
+                    else f"{source}_{pname}"
+                fname = f"{u.name}_{key}.npy"
                 arrays[fname] = np.asarray(arr)
-                entry["weights"][pname] = fname
+                entry["weights"][key] = fname
         units.append(entry)
 
     contents = {

@@ -1,0 +1,92 @@
+"""Streaming / interactive loaders.
+
+Reference parity:
+* ``InteractiveLoader`` (reference: veles/loader/interactive.py:57 — feed()
+  samples from a REPL into a running workflow),
+* ``ZeroMQLoader`` (reference: veles/zmq_loader.py:74-138 — ROUTER-socket
+  job queue on slaves).
+
+TPU redesign: a thread-safe queue loader covers both — producers call
+``feed()`` from any thread (REPL, HTTP handler, socket reader); the
+training/inference loop consumes fixed-size batches. The ZMQ transport
+itself is dropped (SPMD needs no job sockets); network feeding composes as
+"HTTP server thread -> QueueLoader.feed"."""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Dict, Iterator, Optional
+
+import numpy as np
+
+from .base import Loader, TRAIN
+
+
+class QueueLoader(Loader):
+    """Serve batches from a thread-safe queue of fed samples."""
+
+    def __init__(self, input_shape, minibatch_size=1, *, maxsize: int = 0,
+                 **kw):
+        super().__init__(minibatch_size=minibatch_size, **kw)
+        self.input_shape = tuple(input_shape)
+        self._q: "queue.Queue" = queue.Queue(maxsize=maxsize)
+        self._closed = threading.Event()
+
+    def feed(self, sample, label: Optional[int] = None) -> None:
+        """Enqueue one sample (thread-safe)."""
+        arr = np.asarray(sample, np.float32)
+        if arr.shape != self.input_shape:
+            raise ValueError(
+                f"sample shape {arr.shape} != {self.input_shape}")
+        self._q.put((arr, label))
+
+    def close(self) -> None:
+        """No more samples; pending partial batch is flushed padded."""
+        self._closed.set()
+        self._q.put(None)  # wake the consumer
+
+    def load_data(self):
+        # Unbounded stream: lengths unknown; report one pseudo-sample so
+        # initialize() passes (the reference's interactive loader did the
+        # same trick with a fake single-sample epoch).
+        self.class_lengths = [0, 0, self.minibatch_size]
+
+    def fill_minibatch(self, indices, klass):
+        raise NotImplementedError("QueueLoader serves from the queue")
+
+    def iter_epoch(self, klass: int, epoch=None
+                   ) -> Iterator[Dict[str, np.ndarray]]:
+        if klass != TRAIN:
+            return
+        if self._closed.is_set() and self._q.empty():
+            return  # stream ended; later epochs must not block on get()
+        bs = self.minibatch_size
+        buf, labels = [], []
+        while True:
+            item = self._q.get()
+            if item is None:
+                # re-arm the sentinel so a subsequent iter_epoch (or a
+                # concurrent consumer) also terminates instead of blocking
+                self._q.put(None)
+                break
+            buf.append(item[0])
+            labels.append(item[1] if item[1] is not None else 0)
+            if len(buf) == bs:
+                yield self._emit(buf, labels, bs)
+                buf, labels = [], []
+            if self._closed.is_set() and self._q.empty():
+                break
+        if buf:
+            yield self._emit(buf, labels, bs)
+
+    def _emit(self, buf, labels, bs):
+        valid = len(buf)
+        while len(buf) < bs:
+            buf.append(np.zeros(self.input_shape, np.float32))
+            labels.append(0)
+        mask = np.zeros(bs, np.float32)
+        mask[:valid] = 1.0
+        return {"@input": np.stack(buf),
+                "@labels": np.asarray(labels, np.int32),
+                "@mask": mask}

@@ -1,0 +1,161 @@
+"""Plotting / metric visualization.
+
+Reference parity: the L10 plotting stack (reference: veles/plotter.py:48
+Plotter base; veles/plotting_units.py — AccumulatingPlotter :52,
+MatrixPlotter confusion :184, Histogram :536; served over a ZMQ PUB socket
+to a separate matplotlib GraphicsClient process,
+veles/graphics_server.py:65).
+
+TPU redesign: no socket, no second process — a MetricsRecorder accumulates
+series on the host (metrics are tiny scalars), renders (a) ASCII sparklines
+for the terminal, (b) PNG via matplotlib-Agg when available, (c) JSONL for
+external dashboards. The reference's "plotters are units inside the graph"
+becomes "recorders subscribe to Trainer epochs" — plotting must never sync
+the device pipeline."""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .logger import Logger
+
+_SPARK = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(values: Sequence[float], width: int = 40) -> str:
+    """ASCII sparkline of a series (terminal plotting path)."""
+    if not values:
+        return ""
+    v = np.asarray(values, np.float64)
+    if len(v) > width:
+        # re-bin to width
+        edges = np.linspace(0, len(v), width + 1).astype(int)
+        v = np.array([v[a:b].mean() if b > a else v[min(a, len(v) - 1)]
+                      for a, b in zip(edges[:-1], edges[1:])])
+    lo, hi = float(np.nanmin(v)), float(np.nanmax(v))
+    span = (hi - lo) or 1.0
+    idx = ((v - lo) / span * (len(_SPARK) - 1)).astype(int)
+    return "".join(_SPARK[i] for i in idx)
+
+
+class MetricsRecorder(Logger):
+    """Accumulating series recorder (reference: AccumulatingPlotter)."""
+
+    def __init__(self, name: str = "metrics", out_dir: Optional[str] = None):
+        self.name = name
+        self.out_dir = out_dir
+        self.series: Dict[str, List[float]] = {}
+        self._jsonl = None
+        if out_dir:
+            os.makedirs(out_dir, exist_ok=True)
+            self._jsonl = open(os.path.join(out_dir, name + ".jsonl"), "a")
+
+    def record(self, step: int, **values: float) -> None:
+        rec = {"step": step}
+        for k, v in values.items():
+            try:
+                v = float(v)
+            except (TypeError, ValueError):
+                continue
+            self.series.setdefault(k, []).append(v)
+            rec[k] = v
+        if self._jsonl is not None:
+            self._jsonl.write(json.dumps(rec) + "\n")
+            self._jsonl.flush()
+
+    def summary(self, width: int = 40) -> str:
+        """Terminal rendering of all series."""
+        lines = []
+        for k, v in sorted(self.series.items()):
+            lines.append(f"{k:>24s} {sparkline(v, width)}  "
+                         f"last={v[-1]:.4g} best={min(v):.4g}")
+        return "\n".join(lines)
+
+    def save_png(self, path: Optional[str] = None) -> Optional[str]:
+        """Render all series with matplotlib-Agg when available
+        (reference: the GraphicsClient matplotlib backends)."""
+        try:
+            import matplotlib
+            matplotlib.use("Agg")
+            import matplotlib.pyplot as plt
+        except ImportError:
+            self.warning("matplotlib unavailable; skipping PNG")
+            return None
+        path = path or os.path.join(self.out_dir or ".",
+                                    self.name + ".png")
+        n = max(len(self.series), 1)
+        fig, axes = plt.subplots(n, 1, figsize=(8, 2.2 * n), squeeze=False)
+        for ax, (k, v) in zip(axes[:, 0], sorted(self.series.items())):
+            ax.plot(v)
+            ax.set_title(k, fontsize=9)
+            ax.grid(True, alpha=0.3)
+        fig.tight_layout()
+        fig.savefig(path, dpi=100)
+        plt.close(fig)
+        return path
+
+    def close(self):
+        if self._jsonl is not None:
+            self._jsonl.close()
+            self._jsonl = None
+
+
+def confusion_matrix(labels: np.ndarray, preds: np.ndarray,
+                     n_classes: int) -> np.ndarray:
+    """Confusion counts (reference: MatrixPlotter input,
+    veles/plotting_units.py:184)."""
+    cm = np.zeros((n_classes, n_classes), np.int64)
+    np.add.at(cm, (np.asarray(labels, np.int64),
+                   np.asarray(preds, np.int64)), 1)
+    return cm
+
+
+def render_confusion(cm: np.ndarray, class_names=None) -> str:
+    """Terminal confusion-matrix table."""
+    n = cm.shape[0]
+    names = class_names or [str(i) for i in range(n)]
+    w = max(5, max(len(str(x)) for x in names) + 1)
+    head = " " * w + "".join(f"{m:>{w}}" for m in names)
+    rows = [head]
+    for i in range(n):
+        rows.append(f"{names[i]:>{w}}" + "".join(
+            f"{cm[i, j]:>{w}}" for j in range(n)))
+    return "\n".join(rows)
+
+
+def histogram(values: np.ndarray, bins: int = 20, width: int = 40) -> str:
+    """Terminal histogram (reference: Histogram plotter :536)."""
+    hist, edges = np.histogram(np.asarray(values).ravel(), bins=bins)
+    peak = hist.max() or 1
+    lines = []
+    for h, lo, hi in zip(hist, edges[:-1], edges[1:]):
+        bar = "#" * int(width * h / peak)
+        lines.append(f"[{lo:>10.3g}, {hi:>10.3g}) {bar} {h}")
+    return "\n".join(lines)
+
+
+def weights_image(weights: np.ndarray, grid=None) -> np.ndarray:
+    """Tile first-layer weights into one image array (reference:
+    ImagePlotter/Weights2D) — callers save via PIL/matplotlib."""
+    w = np.asarray(weights)
+    n, feat = w.shape[0], int(np.prod(w.shape[1:]))
+    side = int(round(np.sqrt(feat)))
+    if side * side != feat:
+        return w  # not square-imageable
+    if grid is None:
+        gx = int(np.ceil(np.sqrt(n)))
+        gy = int(np.ceil(n / gx))
+    else:
+        gx, gy = grid
+    tiles = np.zeros((gy * side, gx * side), np.float32)
+    for i in range(min(n, gx * gy)):
+        r, c = divmod(i, gx)
+        img = w[i].reshape(side, side)
+        rng = img.max() - img.min() or 1.0
+        tiles[r * side:(r + 1) * side, c * side:(c + 1) * side] = \
+            (img - img.min()) / rng
+    return tiles

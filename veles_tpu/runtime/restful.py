@@ -1,0 +1,101 @@
+"""RESTful inference serving.
+
+Reference parity: the RESTfulAPI unit + RestfulLoader (reference:
+veles/restful_api.py:78 — Twisted HTTP POST endpoint feeding a live
+workflow; veles/loader/restful.py:52).
+
+TPU redesign: a stdlib ThreadingHTTPServer wrapping a compiled predict
+step. POST /predict {"input": [[...]]} -> {"output": [[...]]}. Requests
+batch-pad to the compiled batch size (XLA static shapes); an optional
+normalizer denormalizes outputs (reference: inference-time denorm via
+normalizer state)."""
+
+from __future__ import annotations
+
+import http.server
+import json
+import threading
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..logger import Logger
+
+
+class RestfulServer(Logger):
+    def __init__(self, predict_fn: Callable, wstate, batch_size: int,
+                 input_shape, *, port: int = 0, host: str = "127.0.0.1",
+                 normalizer=None, denormalizer=None):
+        self.predict_fn = predict_fn
+        self.wstate = wstate
+        self.batch_size = int(batch_size)
+        self.input_shape = tuple(input_shape)
+        self.normalizer = normalizer
+        self.denormalizer = denormalizer
+        outer = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_POST(self):
+                if self.path.rstrip("/") != "/predict":
+                    self.send_error(404)
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(n))
+                    x = np.asarray(req["input"], np.float32)
+                    out = outer.infer(x)
+                    body = json.dumps({"output": out.tolist()}).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                except (KeyError, ValueError, json.JSONDecodeError) as e:
+                    body = json.dumps({"error": str(e)}).encode()
+                    self.send_response(400)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+
+            def log_message(self, *args):
+                pass
+
+        self.httpd = http.server.ThreadingHTTPServer((host, port), Handler)
+        self.port = self.httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def infer(self, x: np.ndarray) -> np.ndarray:
+        if x.shape[1:] != self.input_shape:
+            raise ValueError(
+                f"input shape {x.shape[1:]} != expected {self.input_shape}")
+        if self.normalizer is not None:
+            x = self.normalizer.normalize(x)
+        outs = []
+        bs = self.batch_size
+        for i in range(0, len(x), bs):
+            chunk = x[i:i + bs]
+            valid = len(chunk)
+            if valid < bs:  # pad to the compiled batch size
+                chunk = np.concatenate(
+                    [chunk, np.zeros((bs - valid,) + self.input_shape,
+                                     np.float32)])
+            y = np.asarray(self.predict_fn(
+                self.wstate, {"@input": chunk}))[:valid]
+            outs.append(y)
+        out = np.concatenate(outs)
+        if self.denormalizer is not None:
+            out = self.denormalizer.denormalize(out)
+        return out
+
+    def start(self):
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        self.info("RESTful inference on http://127.0.0.1:%d/predict",
+                  self.port)
+        return self
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()

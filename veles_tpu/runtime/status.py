@@ -1,0 +1,102 @@
+"""Status reporting: file + tiny HTTP endpoint.
+
+Reference parity: the web-status stack (reference: veles/web_status.py:113 —
+Tornado+MongoDB server; masters POSTed {name, master, time, slaves, plots}
+every second from veles/launcher.py:852-885).
+
+TPU redesign: a StatusReporter writes status.json atomically (any dashboard
+can poll it; no MongoDB), and an optional StatusServer thread serves it over
+stdlib HTTP with a minimal HTML view — zero dependencies, one process."""
+
+from __future__ import annotations
+
+import http.server
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+from ..logger import Logger
+
+
+class StatusReporter(Logger):
+    """Atomically maintained status.json (reference: the per-master status
+    document)."""
+
+    def __init__(self, path: str = "status.json", name: str = "workflow"):
+        self.path = path
+        self.name = name
+        self.started = time.time()
+        self._extra = {}
+
+    def update(self, **fields) -> None:
+        self._extra.update(fields)
+        doc = {
+            "name": self.name,
+            "time": time.time(),
+            "uptime_s": round(time.time() - self.started, 1),
+            **self._extra,
+        }
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1, default=repr)
+        os.replace(tmp, self.path)
+
+    def read(self) -> dict:
+        with open(self.path) as f:
+            return json.load(f)
+
+
+_HTML = """<!doctype html><meta http-equiv="refresh" content="2">
+<title>veles_tpu status</title>
+<style>body{font-family:monospace;margin:2em}td{padding:2px 12px}</style>
+<h2>veles_tpu — %s</h2><table>%s</table>"""
+
+
+class _Handler(http.server.BaseHTTPRequestHandler):
+    reporter: Optional[StatusReporter] = None
+
+    def do_GET(self):
+        try:
+            doc = self.reporter.read() if self.reporter else {}
+        except (OSError, json.JSONDecodeError):
+            doc = {}
+        if self.path.startswith("/status"):
+            body = json.dumps(doc).encode()
+            ctype = "application/json"
+        else:
+            rows = "".join(f"<tr><td>{k}</td><td>{v}</td></tr>"
+                           for k, v in sorted(doc.items()))
+            body = (_HTML % (doc.get("name", "?"), rows)).encode()
+            ctype = "text/html"
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args):  # silence request logging
+        pass
+
+
+class StatusServer(Logger):
+    """Background HTTP server for the status file."""
+
+    def __init__(self, reporter: StatusReporter, port: int = 0,
+                 host: str = "127.0.0.1"):
+        handler = type("BoundHandler", (_Handler,), {"reporter": reporter})
+        self.httpd = http.server.ThreadingHTTPServer((host, port), handler)
+        self.port = self.httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        self.info("status server on http://127.0.0.1:%d", self.port)
+        return self
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()

@@ -47,7 +47,7 @@ class Trainer(Logger):
     def __init__(self, workflow: Workflow, loader: Loader,
                  optimizer: Optimizer, decision: Optional[Decision] = None,
                  snapshotter: Optional[Snapshotter] = None, *,
-                 mesh=None, rule=None):
+                 mesh=None, rule=None, recorder=None, status=None):
         self.workflow = workflow
         self.loader = loader
         self.optimizer = optimizer
@@ -55,6 +55,8 @@ class Trainer(Logger):
         self.snapshotter = snapshotter
         self.mesh = mesh          # jax.sharding.Mesh for SPMD training
         self.rule = rule          # parameter sharding rule (parallel.mesh)
+        self.recorder = recorder  # plotting.MetricsRecorder (optional)
+        self.status = status      # runtime.status.StatusReporter (optional)
         self._batch_sh = None
         self._state_sh = None
         self._batch_spec = None
@@ -82,6 +84,10 @@ class Trainer(Logger):
                 else jax.random.key(seed)
             self.wstate = self.workflow.init_state(key, self.optimizer)
         self._batch_spec = specs
+        # The unscaled schedule: rollback/restore always compose the
+        # cumulative decision.lr_multiplier onto THIS, never onto an
+        # already-scaled schedule (which would compound the drop).
+        self._base_schedule = self.optimizer.schedule
         self._compile_steps()
         if self._state_sh is not None:
             self.wstate = jax.device_put(self.wstate, self._state_sh)
@@ -147,6 +153,16 @@ class Trainer(Logger):
             samples_done += int(train_mets.get("n_samples", 0))
             valid_mets = self._run_epoch_eval(VALID, epoch)
             stop = self.decision.on_epoch(epoch, train_mets, valid_mets)
+            if self.recorder is not None:
+                self.recorder.record(
+                    epoch,
+                    **{f"train_{k}": v for k, v in train_mets.items()},
+                    **{f"valid_{k}": v for k, v in valid_mets.items()})
+            if self.status is not None:
+                self.status.update(
+                    epoch=epoch, best_value=self.decision.best_value,
+                    best_epoch=self.decision.best_epoch,
+                    **{f"valid_{k}": v for k, v in valid_mets.items()})
 
             if (self.decision.improved
                     and self.decision.rollback_after is not None):
@@ -162,7 +178,7 @@ class Trainer(Logger):
                     {"wstate": self._best_wstate}, like=self.wstate,
                     shardings=self._state_sh)
                 self.optimizer.schedule = _scaled_schedule(
-                    self.optimizer.schedule, self.decision.rollback_lr_scale)
+                    self._base_schedule, self.decision.lr_multiplier)
                 self._compile_steps()
 
             # Advance the loader first so a restored checkpoint resumes at
@@ -218,11 +234,11 @@ class Trainer(Logger):
         self.loader.set_state(payload["loader"])
         self.decision.set_state(payload["decision"])
         prng.streams.set_state(payload["prng"])
-        # Re-apply accumulated rollback lr drops to the freshly-built
-        # schedule, else a resumed run trains at the original (too-high) lr.
+        # Re-apply accumulated rollback lr drops onto the BASE schedule,
+        # else a resumed run trains at the original (too-high) lr.
         if getattr(self.decision, "lr_multiplier", 1.0) != 1.0:
             self.optimizer.schedule = _scaled_schedule(
-                self.optimizer.schedule, self.decision.lr_multiplier)
+                self._base_schedule, self.decision.lr_multiplier)
             self._compile_steps()
 
 
