@@ -37,7 +37,8 @@ def _blob_loader(rng, n=512, mb=64):
 def test_mesh_spec_tiling():
     assert len(jax.devices()) == 8
     m = make_mesh()
-    assert m.shape == {"data": 8, "fsdp": 1, "model": 1, "seq": 1}
+    assert m.shape == {"data": 8, "fsdp": 1, "model": 1, "seq": 1,
+                       "pipe": 1, "expert": 1}
     m2 = make_mesh(MeshSpec(data=-1, model=2))
     assert m2.shape["data"] == 4 and m2.shape["model"] == 2
     with pytest.raises(ValueError, match="does not tile"):

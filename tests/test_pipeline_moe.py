@@ -1,0 +1,108 @@
+"""Pipeline parallelism + expert parallelism on the virtual 8-device mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from veles_tpu.parallel import (MeshSpec, init_moe_params, make_mesh,
+                                moe_apply, moe_shardings, pipeline_apply,
+                                pipeline_stage_shardings,
+                                stack_stage_params)
+
+
+def _stage_fn(params, x):
+    return jnp.tanh(x @ params["w"] + params["b"])
+
+
+def test_pipeline_matches_sequential(rng):
+    S, M, mb, D = 4, 6, 8, 16
+    mesh = make_mesh(MeshSpec(data=2, pipe=4))
+    keys = jax.random.split(jax.random.key(0), S)
+    per_stage = [{"w": jax.random.normal(k, (D, D)) * 0.3,
+                  "b": jnp.zeros((D,))} for k in keys]
+    stacked = stack_stage_params(per_stage)
+    x = jnp.asarray(rng.standard_normal((M, mb, D)), jnp.float32)
+
+    got = pipeline_apply(_stage_fn, stacked, x, mesh, n_microbatches=M)
+
+    # sequential reference
+    ref = x
+    for p in per_stage:
+        ref = jax.vmap(lambda xi: _stage_fn(p, xi))(ref)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_pipeline_grad_flows(rng):
+    """The pipelined forward must be differentiable (training path)."""
+    S, M, mb, D = 2, 2, 4, 8
+    mesh = make_mesh(MeshSpec(data=4, pipe=2))
+    keys = jax.random.split(jax.random.key(1), S)
+    per_stage = [{"w": jax.random.normal(k, (D, D)) * 0.3,
+                  "b": jnp.zeros((D,))} for k in keys]
+    stacked = stack_stage_params(per_stage)
+    x = jnp.asarray(rng.standard_normal((M, mb, D)), jnp.float32)
+
+    def loss(params):
+        y = pipeline_apply(_stage_fn, params, x, mesh, n_microbatches=M)
+        return jnp.sum(jnp.square(y))
+
+    g = jax.grad(loss)(stacked)
+    assert float(jnp.abs(g["w"]).sum()) > 0
+    # per-stage grads must differ (each stage saw different activations)
+    assert not np.allclose(np.asarray(g["w"][0]), np.asarray(g["w"][1]))
+
+
+def _dense_moe_reference(params, x):
+    """Per-token expert FFN without capacity limits."""
+    logits = x @ params["router"]
+    probs = jax.nn.softmax(logits, -1)
+    expert = jnp.argmax(probs, -1)
+    gate = jnp.take_along_axis(probs, expert[:, None], 1)[:, 0]
+    outs = []
+    for t in range(x.shape[0]):
+        e = int(expert[t])
+        h = jax.nn.relu(x[t] @ params["w1"][e])
+        outs.append((h @ params["w2"][e]) * gate[t])
+    return jnp.stack(outs)
+
+
+def test_moe_matches_dense_reference(rng):
+    T, D, H, E = 16, 8, 12, 4
+    params = init_moe_params(jax.random.key(0), E, D, H)
+    x = jnp.asarray(rng.standard_normal((T, D)), jnp.float32)
+    # capacity_factor high enough that nothing drops
+    y, aux = moe_apply(params, x, capacity_factor=8.0)
+    ref = _dense_moe_reference(params, x)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)
+    assert float(aux) >= 1.0  # >= 1 by Cauchy-Schwarz, = E at collapse
+
+
+def test_moe_capacity_drops_tokens(rng):
+    T, D, H, E = 16, 8, 12, 2
+    params = init_moe_params(jax.random.key(0), E, D, H)
+    x = jnp.asarray(rng.standard_normal((T, D)), jnp.float32)
+    y_full, _ = moe_apply(params, x, capacity_factor=8.0)
+    y_cap, _ = moe_apply(params, x, capacity_factor=0.25)  # C=2 per expert
+    dropped = np.asarray(jnp.all(y_cap == 0, axis=-1))
+    assert dropped.sum() >= T - 2 * E * 2  # most tokens over capacity
+    kept = ~dropped
+    np.testing.assert_allclose(np.asarray(y_cap)[kept],
+                               np.asarray(y_full)[kept], rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_moe_sharded_execution(rng):
+    """Expert banks sharded over the expert axis; jit runs under the mesh
+    (GSPMD inserts the dispatch all_to_all)."""
+    mesh = make_mesh(MeshSpec(data=2, expert=4))
+    T, D, H, E = 32, 8, 16, 4
+    params = init_moe_params(jax.random.key(0), E, D, H)
+    params = jax.device_put(params, moe_shardings(params, mesh))
+    x = jnp.asarray(rng.standard_normal((T, D)), jnp.float32)
+    y, aux = jax.jit(lambda p, x: moe_apply(p, x))(params, x)
+    ref, _ = moe_apply(jax.tree.map(np.asarray, params), x)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref), rtol=1e-4,
+                               atol=1e-5)

@@ -33,15 +33,21 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 @dataclass
 class MeshSpec:
-    """Declarative mesh description; -1 = absorb remaining devices."""
+    """Declarative mesh description; -1 = absorb remaining devices.
+
+    Axes: data (DP), fsdp (ZeRO), model (TP), seq (ring attention),
+    pipe (pipeline stages), expert (MoE banks)."""
     data: int = -1
     fsdp: int = 1
     model: int = 1
     seq: int = 1
+    pipe: int = 1
+    expert: int = 1
 
     def axis_sizes(self, n_devices: int) -> Dict[str, int]:
         sizes = {"data": self.data, "fsdp": self.fsdp,
-                 "model": self.model, "seq": self.seq}
+                 "model": self.model, "seq": self.seq,
+                 "pipe": self.pipe, "expert": self.expert}
         fixed = math.prod(v for v in sizes.values() if v > 0)
         wild = [k for k, v in sizes.items() if v == -1]
         if wild:
@@ -65,9 +71,9 @@ def make_mesh(spec: Optional[MeshSpec] = None,
     devices = list(devices if devices is not None else jax.devices())
     spec = spec or MeshSpec()
     sizes = spec.axis_sizes(len(devices))
-    arr = np.asarray(devices).reshape(
-        sizes["data"], sizes["fsdp"], sizes["model"], sizes["seq"])
-    return Mesh(arr, ("data", "fsdp", "model", "seq"))
+    names = ("data", "fsdp", "model", "seq", "pipe", "expert")
+    arr = np.asarray(devices).reshape(*(sizes[n] for n in names))
+    return Mesh(arr, names)
 
 
 # -- sharding rules ----------------------------------------------------------

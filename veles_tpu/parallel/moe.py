@@ -1,0 +1,85 @@
+"""Expert parallelism: mixture-of-experts layer sharded over a mesh axis.
+
+NOT in the reference (SURVEY.md §2.5 item 4) — new TPU-native design. The
+expert FFN bank is a batched gemm with a leading expert axis sharded over
+``expert``; top-1 routing with capacity dispatches tokens via one-hot
+einsums (dense dispatch — the XLA-friendly formulation; GSPMD turns the
+dispatch/combine einsums into all_to_all when the expert axis is sharded).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def init_moe_params(key, n_experts: int, d_model: int, d_hidden: int,
+                    dtype=jnp.float32) -> dict:
+    kw1, kw2, kr = jax.random.split(key, 3)
+    scale1 = 1.0 / jnp.sqrt(d_model)
+    scale2 = 1.0 / jnp.sqrt(d_hidden)
+    return {
+        "router": jax.random.uniform(kr, (d_model, n_experts), dtype,
+                                     -scale1, scale1),
+        "w1": jax.random.uniform(kw1, (n_experts, d_model, d_hidden),
+                                 dtype, -scale1, scale1),
+        "w2": jax.random.uniform(kw2, (n_experts, d_hidden, d_model),
+                                 dtype, -scale2, scale2),
+    }
+
+
+def moe_apply(params: dict, x: jnp.ndarray, *,
+              capacity_factor: float = 1.25
+              ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Top-1 MoE FFN.
+
+    x: (tokens, d_model) -> (tokens, d_model), plus the load-balancing
+    auxiliary loss (Switch-style: E * sum_e f_e * p_e).
+    Tokens over capacity are dropped (output 0 for the FFN path) — standard
+    Switch semantics.
+    """
+    T, D = x.shape
+    E = params["router"].shape[1]
+    C = max(1, int(capacity_factor * T / E))
+
+    logits = x @ params["router"]                    # (T, E)
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert = jnp.argmax(probs, axis=-1)              # (T,)
+    gate = jnp.take_along_axis(probs, expert[:, None], axis=1)[:, 0]
+
+    onehot = jax.nn.one_hot(expert, E, dtype=x.dtype)           # (T, E)
+    # position of each token within its expert's queue
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0             # (T, E)
+    keep = (pos >= 0) & (pos < C)
+    dispatch = onehot[..., None] * jax.nn.one_hot(
+        jnp.clip(pos, 0, C - 1).astype(jnp.int32), C,
+        dtype=x.dtype)                                          # (T, E, C)
+    dispatch = dispatch * keep.astype(x.dtype)[..., None]
+
+    # dispatch -> (E, C, D): with expert axis sharded, GSPMD lowers this
+    # to an all_to_all over ICI
+    xe = jnp.einsum("tec,td->ecd", dispatch, x)
+    h = jax.nn.relu(jnp.einsum("ecd,edh->ech", xe, params["w1"],
+                               preferred_element_type=jnp.float32))
+    ye = jnp.einsum("ech,ehd->ecd", h.astype(x.dtype), params["w2"])
+    y = jnp.einsum("tec,ecd->td", dispatch, ye)
+    y = y * gate[:, None]
+
+    # Switch load-balance loss
+    frac_tokens = jnp.mean(onehot, axis=0)
+    frac_probs = jnp.mean(probs, axis=0)
+    aux = E * jnp.sum(frac_tokens * frac_probs)
+    return y, aux
+
+
+def moe_shardings(params: dict, mesh: Mesh, axis: str = "expert") -> dict:
+    """Shard the expert banks on the expert axis; router replicated."""
+    return {
+        "router": NamedSharding(mesh, P()),
+        "w1": NamedSharding(mesh, P(axis)),
+        "w2": NamedSharding(mesh, P(axis)),
+    }

@@ -1,0 +1,110 @@
+"""Pipeline parallelism: GPipe-style microbatched execution over a mesh
+axis.
+
+NOT in the reference (SURVEY.md §2.5: the reference's only parallel axis was
+the batch); required for TPU-scale models. Design: S identical stages (a
+stack of repeated blocks, params stacked on a leading stage axis and sharded
+one-stage-per-device over the ``pipe`` mesh axis), microbatches streamed
+with ``jax.lax.ppermute`` rotating activations around the ring under
+``shard_map`` — the scan-over-microbatches schedule with (S-1) bubble steps,
+compute/transfer overlap left to XLA.
+
+Restriction (round 1): stages must share one params structure (true for the
+transformer-block / repeated-MLP models pipeline parallelism exists for);
+heterogeneous stages belong to a later round.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def stack_stage_params(per_stage_params) -> dict:
+    """Stack a list of identical-structure stage params along axis 0 (the
+    stage axis that shards over 'pipe')."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage_params)
+
+
+def _pipeline_local(params, x, *, stage_fn, axis_name: str,
+                    n_microbatches: int):
+    """Per-device body under shard_map.
+
+    params: this device's stage params (leading stage axis of size 1).
+    x: this device's slice of the microbatch stack — the full input is
+    (n_microbatches, mb, ...) sharded so device 0 holds the real inputs
+    conceptually; we instead replicate inputs and mask: simpler and correct
+    is to ppermute activations through the ring, with device d applying
+    stage d. Microbatch m enters the ring at device 0 on step m."""
+    axis_size = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    params = jax.tree.map(lambda a: a[0], params)  # drop stage axis
+
+    n_steps = n_microbatches + axis_size - 1
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+    mb_shape = x.shape[1:]
+
+    def body(carry, step):
+        held, outputs = carry
+        # device 0 injects microbatch `step` (if any remain); others keep
+        # what arrived from the previous stage.
+        inject = jnp.where(step < n_microbatches,
+                           x[jnp.minimum(step, n_microbatches - 1)],
+                           jnp.zeros(mb_shape, x.dtype))
+        cur = jnp.where(idx == 0, inject, held)
+        out = stage_fn(params, cur)
+        # the last stage finishes microbatch (step - (S-1)) on this step
+        mb_done = step - (axis_size - 1)
+        valid = (mb_done >= 0) & (mb_done < n_microbatches)
+        outputs = jnp.where(
+            valid & (idx == axis_size - 1),
+            outputs.at[jnp.clip(mb_done, 0, n_microbatches - 1)].set(out),
+            outputs)
+        held_next = jax.lax.ppermute(out, axis_name, perm)
+        return (held_next, outputs), None
+
+    outputs0 = jnp.zeros((n_microbatches,) + mb_shape, x.dtype)
+    held0 = jnp.zeros(mb_shape, x.dtype)
+    (_, outputs), _ = jax.lax.scan(body, (held0, outputs0),
+                                   jnp.arange(n_steps))
+    # outputs live on the last device; broadcast to all so out_specs can be
+    # replicated (cheap for activations-sized data; callers that keep going
+    # sharded can skip this).
+    outputs = jax.lax.psum(
+        jnp.where(idx == axis_size - 1, outputs, 0.0), axis_name)
+    return outputs
+
+
+def pipeline_apply(stage_fn: Callable, stacked_params, x, mesh: Mesh, *,
+                   axis_name: str = "pipe", n_microbatches: int = None):
+    """Run x through S pipelined stages.
+
+    stage_fn(params, x) -> y: one stage's computation (same shape in/out).
+    stacked_params: stage-stacked params (leading axis S), sharded on
+    ``axis_name``. x: (n_microbatches, mb, ...) microbatch stack.
+    Returns (n_microbatches, mb, ...) outputs.
+    """
+    S = mesh.shape[axis_name]
+    if n_microbatches is None:
+        n_microbatches = x.shape[0]
+    pspec = jax.tree.map(
+        lambda a: P(axis_name, *([None] * (a.ndim - 1))), stacked_params)
+    fn = jax.shard_map(
+        functools.partial(_pipeline_local, stage_fn=stage_fn,
+                          axis_name=axis_name,
+                          n_microbatches=n_microbatches),
+        mesh=mesh, in_specs=(pspec, P()), out_specs=P(),
+        check_vma=False)
+    return fn(stacked_params, x)
+
+
+def pipeline_stage_shardings(stacked_params, mesh: Mesh,
+                             axis_name: str = "pipe"):
+    """NamedShardings placing one stage per device along the pipe axis."""
+    return jax.tree.map(
+        lambda a: NamedSharding(
+            mesh, P(axis_name, *([None] * (a.ndim - 1)))), stacked_params)
