@@ -1,0 +1,134 @@
+"""Forge model-zoo distribution: store + server + client roundtrip.
+
+Reference test analog: the reference exercised ForgeClient against a live
+ForgeServer (veles/forge/); per SURVEY.md §4 the distributed pattern is
+master+slave in one process on loopback — here an in-process HTTP server on
+an ephemeral port."""
+
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import veles_tpu as vt
+from veles_tpu.forge import ForgeClient, ForgeServer, ForgeStore
+from veles_tpu.forge.store import Manifest
+
+
+@pytest.fixture
+def store(tmp_path):
+    return ForgeStore(str(tmp_path / "forge"))
+
+
+@pytest.fixture
+def pkg_dir(tmp_path):
+    d = tmp_path / "pkg"
+    d.mkdir()
+    (d / "workflow.py").write_text("# workflow entry\n")
+    (d / "config.py").write_text("# config\n")
+    np.save(d / "weights.npy", np.arange(6, dtype=np.float32))
+    return str(d)
+
+
+MAN = {"name": "mnist_fc", "workflow": "workflow.py",
+       "configuration": "config.py", "author": "tester",
+       "short_description": "MNIST FC baseline"}
+
+
+def test_manifest_validation():
+    Manifest.validate(dict(MAN))
+    with pytest.raises(ValueError):
+        Manifest.validate({"name": "x"})
+    with pytest.raises(ValueError):
+        Manifest.validate({**MAN, "name": "../evil"})
+
+
+def test_store_roundtrip(store, pkg_dir):
+    tar = ForgeStore.pack_dir(pkg_dir, MAN)
+    man = store.add(tar)
+    assert man["version"] == "1"
+    # versions autoincrement
+    man2 = store.add(ForgeStore.pack_dir(pkg_dir, MAN))
+    assert man2["version"] == "2"
+    assert store.resolve_version("mnist_fc", "master") == "2"
+    assert store.resolve_version("mnist_fc", "1") == "1"
+    listing = store.list()
+    assert listing[0]["name"] == "mnist_fc"
+    assert listing[0]["versions"] == ["1", "2"]
+    det = store.details("mnist_fc")
+    assert det["author"] == "tester"
+    # explicit version in manifest
+    man3 = store.add(ForgeStore.pack_dir(pkg_dir, {**MAN, "version": "9"}))
+    assert man3["version"] == "9"
+    with pytest.raises(ValueError):
+        store.add(ForgeStore.pack_dir(pkg_dir, {**MAN, "version": "9"}))
+
+
+def test_store_delete(store, pkg_dir):
+    store.add(ForgeStore.pack_dir(pkg_dir, MAN))
+    store.delete("mnist_fc")
+    assert store.list() == []
+    with pytest.raises(KeyError):
+        store.details("mnist_fc")
+
+
+def test_http_client_server_roundtrip(store, pkg_dir, tmp_path):
+    with ForgeServer(store, host="127.0.0.1") as srv:
+        client = ForgeClient(f"http://127.0.0.1:{srv.port}")
+        out = client.upload(pkg_dir, MAN)
+        assert out == {"stored": "mnist_fc", "version": "1"}
+        assert [p["name"] for p in client.list()] == ["mnist_fc"]
+        assert client.details("mnist_fc")["short_description"] == \
+            "MNIST FC baseline"
+        dest = str(tmp_path / "fetched")
+        client.fetch("mnist_fc", dest)
+        got = sorted(os.listdir(dest))
+        assert got == ["config.py", "manifest.json", "weights.npy",
+                       "workflow.py"]
+        np.testing.assert_array_equal(
+            np.load(os.path.join(dest, "weights.npy")),
+            np.arange(6, dtype=np.float32))
+        client.delete("mnist_fc")
+        assert client.list() == []
+
+
+def test_http_errors(store, tmp_path):
+    from veles_tpu.forge.client import ForgeClientError
+    with ForgeServer(store, host="127.0.0.1") as srv:
+        client = ForgeClient(f"http://127.0.0.1:{srv.port}")
+        with pytest.raises(ForgeClientError, match="no such package"):
+            client.details("ghost")
+        with pytest.raises(ForgeClientError, match="no such package"):
+            client.fetch("ghost", str(tmp_path / "x"))
+
+
+def test_upload_trained_workflow(store, tmp_path):
+    """End-to-end: export a real workflow's serving package and publish it."""
+    import jax
+    from veles_tpu.models.standard import build_workflow
+    from veles_tpu.ops import optimizers as opt
+
+    wf = build_workflow("forge_wf", [
+        {"type": "all2all_tanh", "output_size": 16, "name": "fc1"},
+        {"type": "softmax", "output_size": 4, "name": "out"},
+    ])
+    wf.build({"@input": vt.Spec((2, 8), jnp.float32),
+              "@labels": vt.Spec((2,), jnp.int32),
+              "@mask": vt.Spec((2,), jnp.float32)})
+    wstate = wf.init_state(jax.random.key(0), opt.SGD(0.1))
+
+    with ForgeServer(store, host="127.0.0.1") as srv:
+        client = ForgeClient(f"http://127.0.0.1:{srv.port}")
+        out = client.upload_workflow(
+            wf, wstate,
+            {"name": "forge_wf", "short_description": "fc net"},
+            str(tmp_path / "export"))
+        assert out["stored"] == "forge_wf"
+        dest = str(tmp_path / "fetched")
+        client.fetch("forge_wf", dest)
+        with open(os.path.join(dest, "contents.json")) as f:
+            contents = json.load(f)
+        assert contents["checksum"] == wf.checksum()
+        assert {u["name"] for u in contents["units"]} >= {"fc1", "out"}

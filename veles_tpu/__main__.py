@@ -134,7 +134,63 @@ def _load_config(path: str, overrides):
     return create, snapshot
 
 
+def _forge_main(argv) -> int:
+    """``python -m veles_tpu forge <action>`` (reference: the ``veles forge``
+    subcommand, veles/__main__.py:217 _process_special_args +
+    veles/forge/forge_client.py ACTIONS)."""
+    p = argparse.ArgumentParser(prog="veles_tpu forge")
+    sub = p.add_subparsers(dest="action", required=True)
+    for act in ("list", "details", "delete"):
+        sp = sub.add_parser(act)
+        sp.add_argument("--server", "-s", required=True)
+        if act != "list":
+            sp.add_argument("name")
+    sp = sub.add_parser("fetch")
+    sp.add_argument("--server", "-s", required=True)
+    sp.add_argument("name")
+    sp.add_argument("dest")
+    sp.add_argument("--version", default=None)
+    sp = sub.add_parser("upload")
+    sp.add_argument("--server", "-s", required=True)
+    sp.add_argument("path")
+    sp.add_argument("--manifest", "-m",
+                    help="manifest JSON file (default <path>/manifest.json)")
+    sp = sub.add_parser("serve")
+    sp.add_argument("store_dir")
+    sp.add_argument("--port", type=int, default=8080)
+    a = p.parse_args(argv)
+
+    from .forge import ForgeClient, ForgeServer, ForgeStore
+    if a.action == "serve":
+        srv = ForgeServer(ForgeStore(a.store_dir), port=a.port).start()
+        try:
+            srv._thread.join()
+        except KeyboardInterrupt:
+            srv.stop()
+        return 0
+    client = ForgeClient(a.server)
+    if a.action == "list":
+        print(json.dumps(client.list(), indent=1))
+    elif a.action == "details":
+        print(json.dumps(client.details(a.name), indent=1))
+    elif a.action == "delete":
+        client.delete(a.name)
+    elif a.action == "fetch":
+        client.fetch(a.name, a.dest, a.version)
+    elif a.action == "upload":
+        import os
+        mpath = a.manifest or os.path.join(a.path, "manifest.json")
+        with open(mpath) as f:
+            print(json.dumps(client.upload(a.path, json.load(f))))
+    return 0
+
+
 def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "forge":
+        setup_logging()
+        return _forge_main(argv[1:])
     args = build_parser().parse_args(argv)
     setup_logging(level=10 if args.verbose else 20)
 

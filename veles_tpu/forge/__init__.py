@@ -1,0 +1,15 @@
+"""Forge: model-zoo package distribution (reference: veles/forge/ — 1.7k LoC
+Tornado site + Twisted client for fetch/upload/list/details/delete of workflow
+packages with manifest.json, versioned storage, reference:
+veles/forge/forge_client.py:91, forge_server.py:462).
+
+TPU-native rebuild keeps the capability — publish/fetch versioned workflow
+packages (the export/package.py serving artifact plus manifest metadata) over
+HTTP — with a stdlib-only implementation: a directory-backed versioned store,
+a ThreadingHTTPServer, and a urllib client."""
+
+from .store import ForgeStore, Manifest
+from .server import ForgeServer
+from .client import ForgeClient
+
+__all__ = ["ForgeStore", "Manifest", "ForgeServer", "ForgeClient"]

@@ -1,0 +1,97 @@
+"""Forge client: fetch/upload/list/details/delete against a ForgeServer.
+
+Reference parity: veles/forge/forge_client.py:91 (ForgeClient with Twisted
+HTTP actions fetch :101, upload :147, list :298, details :338, delete :396).
+The rebuild uses stdlib urllib — the client is synchronous because package
+transfer is not on any training hot path.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Dict, List, Optional
+
+from ..logger import Logger
+from .store import ForgeStore, Manifest
+
+
+class ForgeClientError(RuntimeError):
+    pass
+
+
+class ForgeClient(Logger):
+    def __init__(self, base_url: str):
+        self.base_url = base_url.rstrip("/")
+
+    # -- HTTP plumbing -----------------------------------------------------
+    def _get(self, path: str, **params) -> bytes:
+        qs = urllib.parse.urlencode(
+            {k: v for k, v in params.items() if v is not None})
+        url = f"{self.base_url}/{path}" + (f"?{qs}" if qs else "")
+        try:
+            with urllib.request.urlopen(url) as resp:
+                return resp.read()
+        except urllib.error.HTTPError as e:
+            raise ForgeClientError(self._err(e)) from e
+
+    def _post(self, path: str, body: bytes) -> dict:
+        req = urllib.request.Request(
+            f"{self.base_url}/{path}", data=body,
+            headers={"Content-Type": "application/x-gzip"})
+        try:
+            with urllib.request.urlopen(req) as resp:
+                return json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            raise ForgeClientError(self._err(e)) from e
+
+    @staticmethod
+    def _err(e: urllib.error.HTTPError) -> str:
+        try:
+            return json.loads(e.read())["error"]
+        except Exception:  # noqa: BLE001
+            return f"HTTP {e.code}"
+
+    # -- actions (the reference's ACTIONS table) ---------------------------
+    def list(self) -> List[dict]:
+        return json.loads(self._get("service", query="list"))
+
+    def details(self, name: str) -> dict:
+        return json.loads(self._get("service", query="details", name=name))
+
+    def delete(self, name: str) -> None:
+        self._get("service", query="delete", name=name)
+        self.info("deleted %s from %s", name, self.base_url)
+
+    def fetch(self, name: str, dest: str,
+              version: Optional[str] = None) -> str:
+        """Download a package version and unpack it into ``dest`` (reference:
+        forge_client.py:101-133 fetched + untarred)."""
+        data = self._get("fetch", name=name, version=version)
+        ForgeStore.unpack(data, dest)
+        self.info("fetched %s -> %s", name, dest)
+        return dest
+
+    def upload(self, path: str, manifest: Dict) -> dict:
+        """Package a directory + manifest and upload (reference:
+        forge_client.py:147-296 streamed metadata + tar)."""
+        Manifest.validate(manifest)
+        body = ForgeStore.pack_dir(path, manifest)
+        out = self._post("upload", body)
+        self.info("uploaded %s==%s", out["stored"], out["version"])
+        return out
+
+    def upload_workflow(self, workflow, wstate, manifest: Dict,
+                        work_dir: str) -> dict:
+        """Convenience: export the serving package for ``workflow`` into
+        ``work_dir`` and upload it with the manifest."""
+        from ..export.package import export_package
+        os.makedirs(work_dir, exist_ok=True)
+        export_package(workflow, wstate, work_dir)
+        man = dict(manifest)
+        man.setdefault("workflow", "contents.json")
+        man.setdefault("configuration", "contents.json")
+        return self.upload(work_dir, man)

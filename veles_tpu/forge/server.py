@@ -1,0 +1,151 @@
+"""Forge HTTP server.
+
+Reference parity: veles/forge/forge_server.py — a Tornado site with
+``/service?query=list|details|delete``, ``/fetch?name=&version=`` (tar
+stream) and ``/upload?version=`` (metadata + tar body) endpoints plus an HTML
+catalog page. The rebuild serves the same endpoint contract on a stdlib
+``ThreadingHTTPServer`` so it runs anywhere (including inside tests on a
+loopback port) with zero dependencies; the HTML frontend is reduced to a
+minimal package listing page.
+"""
+
+from __future__ import annotations
+
+import html
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+from ..logger import Logger
+from .store import ForgeStore
+
+SERVICE = "service"
+FETCH = "fetch"
+UPLOAD = "upload"
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # set by ForgeServer
+    store: ForgeStore = None
+
+    def log_message(self, fmt, *args):  # route into our logger
+        self.server.owner.debug(fmt, *args)
+
+    # -- helpers -----------------------------------------------------------
+    def _json(self, obj, code=200):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _error(self, code, message):
+        self._json({"error": message}, code=code)
+
+    # -- routes ------------------------------------------------------------
+    def do_GET(self):
+        url = urlparse(self.path)
+        q = {k: v[0] for k, v in parse_qs(url.query).items()}
+        path = url.path.strip("/")
+        try:
+            if path == SERVICE:
+                self._service(q)
+            elif path == FETCH:
+                data = self.store.pack(q["name"], q.get("version"))
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-gzip")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+            elif path in ("", "index.html"):
+                self._index()
+            else:
+                self._error(404, f"unknown path /{path}")
+        except KeyError as e:
+            self._error(404, str(e))
+        except Exception as e:  # noqa: BLE001 — server must answer
+            self._error(500, f"{type(e).__name__}: {e}")
+
+    def do_POST(self):
+        url = urlparse(self.path)
+        if url.path.strip("/") != UPLOAD:
+            return self._error(404, "POST only supported on /upload")
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            man = self.store.add(self.rfile.read(length))
+            self._json({"stored": man["name"], "version": man["version"]})
+        except ValueError as e:
+            self._error(400, str(e))
+        except Exception as e:  # noqa: BLE001
+            self._error(500, f"{type(e).__name__}: {e}")
+
+    def _service(self, q):
+        query = q.get("query")
+        if query == "list":
+            self._json(self.store.list())
+        elif query == "details":
+            self._json(self.store.details(q["name"]))
+        elif query == "delete":
+            self.store.delete(q["name"])
+            self._json({"deleted": q["name"]})
+        else:
+            self._error(400, f"unknown service query {query!r}")
+
+    def _index(self):
+        rows = "".join(
+            f"<tr><td>{html.escape(p['name'])}</td>"
+            f"<td>{html.escape(p['version'])}</td>"
+            f"<td>{html.escape(p['author'])}</td>"
+            f"<td>{html.escape(p['short_description'])}</td></tr>"
+            for p in self.store.list())
+        body = (f"<html><head><title>veles-tpu forge</title></head><body>"
+                f"<h1>veles-tpu forge</h1><table border=1>"
+                f"<tr><th>name</th><th>version</th><th>author</th>"
+                f"<th>description</th></tr>{rows}</table>"
+                f"</body></html>").encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "text/html")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+class ForgeServer(Logger):
+    """Run a ForgeStore behind HTTP. ``port=0`` binds an ephemeral port
+    (tests); the bound port is in ``.port`` after start()."""
+
+    def __init__(self, store: ForgeStore, host: str = "0.0.0.0",
+                 port: int = 0):
+        self.store = store
+        self.host, self.port = host, port
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "ForgeServer":
+        handler = type("BoundHandler", (_Handler,), {"store": self.store})
+        self._httpd = ThreadingHTTPServer((self.host, self.port), handler)
+        self._httpd.owner = self
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="forge-server")
+        self._thread.start()
+        self.info("forge serving on %s:%d (store %s)",
+                  self.host, self.port, self.store.root_dir)
+        return self
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._thread.join(timeout=5)
+            self._httpd = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()

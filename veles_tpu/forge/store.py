@@ -1,0 +1,218 @@
+"""Versioned package store backing the Forge server.
+
+Reference parity: the reference ForgeServer kept packages in per-name git
+repositories with manifest.json metadata and tag-per-version semantics
+(reference: veles/forge/forge_server.py:462+, version discovery in
+FetchHandler._discover_version :259-283). The rebuild keeps the observable
+contract — names, monotonically addable versions, "master" = latest,
+manifest metadata, tar.gz package bodies — on a plain directory tree::
+
+    <root>/<name>/<version>/manifest.json + package files
+    <root>/<name>/versions.json            (ordered version list)
+
+which is trivially inspectable and needs no git dependency.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import re
+import shutil
+import tarfile
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..logger import Logger
+
+#: Manifest keys the reference required at upload (forge_server.py upload
+#: validation; manifest fields used by the client at forge_client.py:161-182).
+REQUIRED_MANIFEST_KEYS = ("name", "workflow", "configuration")
+LATEST = "master"  # the reference's "master" version alias
+
+_NAME_RE = re.compile(r"^[A-Za-z0-9_.-]+$")
+
+
+class Manifest(dict):
+    """manifest.json contents; a dict with validation."""
+
+    @classmethod
+    def validate(cls, data: dict) -> "Manifest":
+        for key in REQUIRED_MANIFEST_KEYS:
+            if key not in data:
+                raise ValueError(f"manifest misses required key {key!r}")
+        if not _NAME_RE.match(str(data["name"])):
+            raise ValueError(f"invalid package name {data['name']!r}")
+        return cls(data)
+
+
+class ForgeStore(Logger):
+    """Thread-safe versioned package store on a directory tree."""
+
+    def __init__(self, root_dir: str):
+        self.root_dir = root_dir
+        os.makedirs(root_dir, exist_ok=True)
+        self._lock = threading.Lock()
+
+    # -- queries -----------------------------------------------------------
+    def list(self) -> List[dict]:
+        """[{name, author, short_description, version, updated}] for every
+        package (reference: ServiceHandler.handle_list,
+        forge_server.py:127-138)."""
+        out = []
+        for name in sorted(os.listdir(self.root_dir)):
+            versions = self._versions(name)
+            if not versions:
+                continue
+            man = self.manifest(name, versions[-1])
+            out.append({
+                "name": name,
+                "author": man.get("author", ""),
+                "short_description": man.get("short_description", ""),
+                "version": versions[-1],
+                "versions": versions,
+                "updated": man.get("_uploaded", ""),
+            })
+        return out
+
+    def details(self, name: str) -> dict:
+        """Full manifest of the latest version + version history (reference:
+        ServiceHandler.handle_details, forge_server.py:123-126)."""
+        versions = self._versions(name)
+        if not versions:
+            raise KeyError(f"no such package {name!r}")
+        man = dict(self.manifest(name, versions[-1]))
+        man["versions"] = versions
+        return man
+
+    def manifest(self, name: str, version: str) -> Manifest:
+        path = os.path.join(self._vdir(name, version), "manifest.json")
+        with open(path) as f:
+            return Manifest(json.load(f))
+
+    def resolve_version(self, name: str, version: Optional[str]) -> str:
+        versions = self._versions(name)
+        if not versions:
+            raise KeyError(f"no such package {name!r}")
+        if version in (None, "", LATEST):
+            return versions[-1]
+        if version not in versions:
+            raise KeyError(f"{name!r} has no version {version!r} "
+                           f"(has {versions})")
+        return version
+
+    # -- mutation ----------------------------------------------------------
+    def add(self, tar_bytes: bytes) -> Manifest:
+        """Ingest an uploaded package: a tar.gz whose root contains
+        manifest.json (+ workflow/config/package files). Returns the stored
+        manifest. Version comes from the manifest ("version" key, default
+        autoincrement 1,2,3... as strings)."""
+        with io.BytesIO(tar_bytes) as bio, \
+                tarfile.open(fileobj=bio, mode="r:*") as tar:
+            names = tar.getnames()
+            if "manifest.json" not in names:
+                raise ValueError("package tar misses manifest.json")
+            man = Manifest.validate(json.load(
+                tar.extractfile("manifest.json")))
+            name = man["name"]
+            with self._lock:
+                versions = self._versions(name)
+                version = str(man.get("version") or len(versions) + 1)
+                if version in versions:
+                    raise ValueError(
+                        f"{name!r} already has version {version!r}")
+                vdir = self._vdir(name, version)
+                os.makedirs(vdir, exist_ok=True)
+                for member in tar.getmembers():
+                    if not member.isfile():
+                        continue
+                    # refuse path escapes in hostile archives
+                    target = os.path.realpath(os.path.join(vdir, member.name))
+                    if not target.startswith(os.path.realpath(vdir) + os.sep):
+                        raise ValueError(
+                            f"unsafe member path {member.name!r}")
+                    os.makedirs(os.path.dirname(target), exist_ok=True)
+                    with tar.extractfile(member) as src, \
+                            open(target, "wb") as dst:
+                        shutil.copyfileobj(src, dst)
+                man["version"] = version
+                man["_uploaded"] = time.strftime("%Y-%m-%d %H:%M:%S")
+                with open(os.path.join(vdir, "manifest.json"), "w") as f:
+                    json.dump(man, f, indent=1)
+                self._write_versions(name, versions + [version])
+        self.info("stored %s==%s", name, version)
+        return man
+
+    def delete(self, name: str) -> None:
+        """Remove a package entirely (reference: handle_delete,
+        forge_server.py:139-152)."""
+        path = os.path.join(self.root_dir, name)
+        if not os.path.isdir(path):
+            raise KeyError(f"no such package {name!r}")
+        with self._lock:
+            shutil.rmtree(path)
+        self.info("deleted %s", name)
+
+    # -- package IO --------------------------------------------------------
+    def pack(self, name: str, version: Optional[str] = None) -> bytes:
+        """tar.gz of a stored version (what /fetch streams; reference:
+        FetchHandler.get, forge_server.py:284-307)."""
+        version = self.resolve_version(name, version)
+        vdir = self._vdir(name, version)
+        bio = io.BytesIO()
+        with tarfile.open(fileobj=bio, mode="w:gz") as tar:
+            for fname in sorted(os.listdir(vdir)):
+                tar.add(os.path.join(vdir, fname), arcname=fname)
+        return bio.getvalue()
+
+    @staticmethod
+    def pack_dir(path: str, manifest: Dict) -> bytes:
+        """Client-side: build an uploadable tar.gz from a directory plus a
+        manifest dict (the reference built the tar from workflow + config +
+        extra files listed in the manifest, forge_client.py:147-192)."""
+        man = Manifest.validate(manifest)
+        bio = io.BytesIO()
+        with tarfile.open(fileobj=bio, mode="w:gz") as tar:
+            mbytes = json.dumps(man, indent=1).encode()
+            info = tarfile.TarInfo("manifest.json")
+            info.size = len(mbytes)
+            tar.addfile(info, io.BytesIO(mbytes))
+            for dirpath, _, files in os.walk(path):
+                for fname in sorted(files):
+                    if fname == "manifest.json":
+                        continue
+                    full = os.path.join(dirpath, fname)
+                    tar.add(full, arcname=os.path.relpath(full, path))
+        return bio.getvalue()
+
+    @staticmethod
+    def unpack(tar_bytes: bytes, dest: str) -> str:
+        os.makedirs(dest, exist_ok=True)
+        with io.BytesIO(tar_bytes) as bio, \
+                tarfile.open(fileobj=bio, mode="r:*") as tar:
+            for member in tar.getmembers():
+                target = os.path.realpath(os.path.join(dest, member.name))
+                if not target.startswith(os.path.realpath(dest) + os.sep):
+                    raise ValueError(f"unsafe member path {member.name!r}")
+            tar.extractall(dest)
+        return dest
+
+    # -- internals ---------------------------------------------------------
+    def _vdir(self, name: str, version: str) -> str:
+        if not _NAME_RE.match(name) or not _NAME_RE.match(version):
+            raise ValueError(f"invalid name/version {name!r}/{version!r}")
+        return os.path.join(self.root_dir, name, version)
+
+    def _versions(self, name: str) -> List[str]:
+        path = os.path.join(self.root_dir, name, "versions.json")
+        if not os.path.exists(path):
+            return []
+        with open(path) as f:
+            return json.load(f)
+
+    def _write_versions(self, name: str, versions: List[str]) -> None:
+        with open(os.path.join(self.root_dir, name, "versions.json"),
+                  "w") as f:
+            json.dump(versions, f)

@@ -35,11 +35,10 @@ def _pipeline_local(params, x, *, stage_fn, axis_name: str,
     """Per-device body under shard_map.
 
     params: this device's stage params (leading stage axis of size 1).
-    x: this device's slice of the microbatch stack — the full input is
-    (n_microbatches, mb, ...) sharded so device 0 holds the real inputs
-    conceptually; we instead replicate inputs and mask: simpler and correct
-    is to ppermute activations through the ring, with device d applying
-    stage d. Microbatch m enters the ring at device 0 on step m."""
+    x: the full (n_microbatches, mb, ...) microbatch stack, replicated on
+    every device (in_specs P()). Activations ppermute through the ring with
+    device d applying stage d; microbatch m enters at device 0 on step m,
+    so only device 0 ever reads x."""
     axis_size = jax.lax.psum(1, axis_name)
     idx = jax.lax.axis_index(axis_name)
     params = jax.tree.map(lambda a: a[0], params)  # drop stage axis
@@ -89,10 +88,17 @@ def pipeline_apply(stage_fn: Callable, stacked_params, x, mesh: Mesh, *,
     Returns (n_microbatches, mb, ...) outputs.
     """
     S = mesh.shape[axis_name]
+    n_stages = {a.shape[0] for a in jax.tree.leaves(stacked_params)}
+    if n_stages != {S}:
+        raise ValueError(
+            f"stacked_params leading axis {sorted(n_stages)} must equal the "
+            f"{axis_name!r} mesh axis size {S}")
     if n_microbatches is None:
         n_microbatches = x.shape[0]
-    pspec = jax.tree.map(
-        lambda a: P(axis_name, *([None] * (a.ndim - 1))), stacked_params)
+    elif n_microbatches != x.shape[0]:
+        raise ValueError(
+            f"n_microbatches={n_microbatches} != x.shape[0]={x.shape[0]}")
+    pspec = jax.tree.map(lambda a: _stage_spec(a, axis_name), stacked_params)
     fn = jax.shard_map(
         functools.partial(_pipeline_local, stage_fn=stage_fn,
                           axis_name=axis_name,
@@ -102,9 +108,14 @@ def pipeline_apply(stage_fn: Callable, stacked_params, x, mesh: Mesh, *,
     return fn(stacked_params, x)
 
 
+def _stage_spec(a, axis_name: str) -> P:
+    """PartitionSpec splitting the leading stage axis over the pipe axis."""
+    return P(axis_name, *([None] * (a.ndim - 1)))
+
+
 def pipeline_stage_shardings(stacked_params, mesh: Mesh,
                              axis_name: str = "pipe"):
     """NamedShardings placing one stage per device along the pipe axis."""
     return jax.tree.map(
-        lambda a: NamedSharding(
-            mesh, P(axis_name, *([None] * (a.ndim - 1)))), stacked_params)
+        lambda a: NamedSharding(mesh, _stage_spec(a, axis_name)),
+        stacked_params)
