@@ -132,3 +132,72 @@ def test_upload_trained_workflow(store, tmp_path):
             contents = json.load(f)
         assert contents["checksum"] == wf.checksum()
         assert {u["name"] for u in contents["units"]} >= {"fc1", "out"}
+
+
+def test_dotdot_name_rejected(store):
+    # '..' matched the old name regex and escaped the store root
+    with pytest.raises(ValueError):
+        Manifest.validate({**MAN, "name": ".."})
+    with pytest.raises(ValueError):
+        store._vdir("..", "1")
+    with pytest.raises(ValueError):
+        store._vdir("mnist_fc", "..")
+
+
+def _tar_with_symlink_slip(victim_dir):
+    import io
+    import tarfile
+    bio = io.BytesIO()
+    with tarfile.open(fileobj=bio, mode="w:gz") as tar:
+        info = tarfile.TarInfo("ln")
+        info.type = tarfile.SYMTYPE
+        info.linkname = str(victim_dir)
+        tar.addfile(info)
+        data = b"pwned"
+        finfo = tarfile.TarInfo("ln/pwned.txt")
+        finfo.size = len(data)
+        tar.addfile(finfo, io.BytesIO(data))
+    return bio.getvalue()
+
+
+def test_unpack_symlink_slip_blocked(tmp_path):
+    victim = tmp_path / "victim"
+    victim.mkdir()
+    evil = _tar_with_symlink_slip(victim)
+    with pytest.raises((ValueError, OSError)):
+        ForgeStore.unpack(evil, str(tmp_path / "dest"))
+    assert not (victim / "pwned.txt").exists()
+
+
+def test_add_rejected_upload_leaves_no_partial(store, pkg_dir):
+    import io
+    import tarfile
+    # tar whose LAST member escapes: earlier members extract first
+    bio = io.BytesIO()
+    with tarfile.open(fileobj=bio, mode="w:gz") as tar:
+        man = dict(MAN, version="3")
+        mb = json.dumps(man).encode()
+        info = tarfile.TarInfo("manifest.json")
+        info.size = len(mb)
+        tar.addfile(info, io.BytesIO(mb))
+        good = b"legit"
+        gi = tarfile.TarInfo("weights.npy")
+        gi.size = len(good)
+        tar.addfile(gi, io.BytesIO(good))
+        bad = b"evil"
+        bi = tarfile.TarInfo("../../escape.txt")
+        bi.size = len(bad)
+        tar.addfile(bi, io.BytesIO(bad))
+    with pytest.raises(ValueError, match="unsafe"):
+        store.add(bio.getvalue())
+    # nothing registered, no dirty version dir left behind
+    assert store._versions("mnist_fc") == []
+    vdir = os.path.join(store.root_dir, "mnist_fc", "3")
+    assert not os.path.exists(vdir)
+    assert not os.path.exists(vdir + ".ingest")
+    # a later clean upload of the same version serves only its own files
+    clean = ForgeStore.pack_dir(pkg_dir, dict(MAN, version="3"))
+    store.add(clean)
+    files = set(os.listdir(vdir))
+    assert files == {"manifest.json", "workflow.py", "config.py",
+                     "weights.npy"}

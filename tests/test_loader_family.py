@@ -1,0 +1,192 @@
+"""Extended loader family: pickles, WAV audio, CSV, ensemble results,
+downloader (reference test analog: per-loader unit tests in
+veles/loader/ and veles/tests/, SURVEY.md §2.4)."""
+
+import io
+import json
+import pickle
+import struct
+import wave
+
+import numpy as np
+import pytest
+
+from veles_tpu import downloader
+from veles_tpu.loader import (TEST, TRAIN, VALID, CsvLoader,
+                              EnsembleResultsLoader, LoaderError,
+                              PicklesLoader, WavLoader, read_wav)
+
+
+def _write_wav(path, samples, rate=8000, width=2, channels=1):
+    with wave.open(str(path), "wb") as w:
+        w.setnchannels(channels)
+        w.setsampwidth(width)
+        w.setframerate(rate)
+        if width == 2:
+            data = (np.clip(samples, -1, 1) * 32767).astype("<i2")
+        else:
+            data = ((np.clip(samples, -1, 1) * 127) + 128).astype(np.uint8)
+        if channels > 1:
+            data = np.repeat(data[:, None], channels, axis=1)
+        w.writeframes(data.tobytes())
+
+
+def test_pickles_loader(tmp_path, rng):
+    train = {"data": rng.normal(size=(20, 4)).astype(np.float32),
+             "labels": rng.integers(0, 3, 20).astype(np.int32)}
+    valid = rng.normal(size=(8, 4)).astype(np.float32)  # bare array form
+    pt, pv = tmp_path / "train.pickle", tmp_path / "valid.pickle"
+    pt.write_bytes(pickle.dumps(train))
+    pv.write_bytes(pickle.dumps(valid))
+    ld = PicklesLoader({TRAIN: str(pt), VALID: str(pv)}, minibatch_size=5)
+    ld.initialize()
+    assert ld.class_lengths == [0, 8, 20]
+    batch = next(ld.iter_epoch(TRAIN))
+    assert batch["@input"].shape == (5, 4)
+    assert batch["@labels"].shape == (5,)
+    vbatch = next(ld.iter_epoch(VALID))
+    assert "@labels" not in vbatch
+
+
+def test_wav_roundtrip_and_loader(tmp_path, rng):
+    t = np.arange(4096) / 8000.0
+    # 500 Hz = exactly bin 32 of a 512-sample window at 8 kHz (no leakage)
+    sine = np.sin(2 * np.pi * 500 * t).astype(np.float32)
+    noise = rng.normal(scale=0.3, size=4096).astype(np.float32)
+    _write_wav(tmp_path / "sine.wav", sine)
+    _write_wav(tmp_path / "noise.wav", noise)
+    x, rate = read_wav(str(tmp_path / "sine.wav"))
+    assert rate == 8000 and len(x) == 4096
+    assert np.max(np.abs(x - sine)) < 1e-3  # 16-bit quantization error
+
+    ld = WavLoader({TRAIN: [(str(tmp_path / "sine.wav"), 0),
+                            (str(tmp_path / "noise.wav"), 1)]},
+                   window=512, spectrum=True, minibatch_size=4)
+    ld.initialize()
+    assert ld.class_lengths[TRAIN] == 16  # 8 windows per file
+    batch = next(ld.iter_epoch(TRAIN))
+    assert batch["@input"].shape == (4, 257)  # rfft(512) bins
+    # The sine's spectrum concentrates in one bin; noise's does not.
+    sine_feat = ld._data[TRAIN][ld._labels[TRAIN] == 0]
+    peak_frac = sine_feat.max(axis=1) / sine_feat.sum(axis=1)
+    assert peak_frac.mean() > 0.5
+
+
+def test_wav_stereo_and_8bit(tmp_path):
+    x = np.linspace(-0.5, 0.5, 256).astype(np.float32)
+    _write_wav(tmp_path / "st.wav", x, width=2, channels=2)
+    mono, _ = read_wav(str(tmp_path / "st.wav"))
+    assert mono.shape == (256,)
+    _write_wav(tmp_path / "u8.wav", x, width=1)
+    x8, _ = read_wav(str(tmp_path / "u8.wav"))
+    assert np.max(np.abs(x8 - x)) < 0.02
+
+
+def test_csv_loader(tmp_path):
+    rows = ["f1,f2,label", "1.0,2.0,a", "3.0,4.0,b", "5.0,6.0,a"]
+    p = tmp_path / "d.csv"
+    p.write_text("\n".join(rows))
+    ld = CsvLoader({TRAIN: str(p)}, skip_header=True, minibatch_size=2)
+    ld.initialize()
+    assert ld.class_lengths[TRAIN] == 3
+    assert ld._data[TRAIN].shape == (3, 2)
+    assert ld._labels[TRAIN].tolist() == [0, 1, 0]  # a,b,a -> dense ints
+    # file-object source, no label column
+    ld2 = CsvLoader({TRAIN: io.StringIO("1,2\n3,4")}, label_column=None,
+                    minibatch_size=1)
+    ld2.initialize()
+    assert ld2._labels[TRAIN] is None
+
+
+def test_csv_hdfs_gated():
+    ld = CsvLoader({TRAIN: "hdfs://namenode/data.csv"}, minibatch_size=1)
+    with pytest.raises(LoaderError, match="hdfs"):
+        ld.initialize()
+
+
+def test_ensemble_results_loader(tmp_path, rng):
+    labels = rng.integers(0, 3, 12).astype(np.int32)
+    entries = []
+    for i in range(2):
+        probs = rng.random((12, 3)).astype(np.float32)
+        path = tmp_path / f"model{i}.npz"
+        np.savez(path, probabilities=probs, labels=labels)
+        entries.append({"results_path": f"model{i}.npz"})
+    man = tmp_path / "manifest.json"
+    man.write_text(json.dumps({"models": entries}))
+    ld = EnsembleResultsLoader(str(man), minibatch_size=4)
+    ld.initialize()
+    assert ld.class_lengths[TEST] == 12
+    batch = next(ld.iter_epoch(TEST))
+    assert batch["@input"].shape == (4, 6)  # 2 models x 3 classes
+    assert batch["@labels"].shape == (4,)
+
+
+def test_downloader_local_and_extract(tmp_path):
+    # file:// URL works without egress; tar extraction lands alongside.
+    import tarfile
+    payload = tmp_path / "src" / "hello.txt"
+    payload.parent.mkdir()
+    payload.write_text("hi")
+    tar = tmp_path / "src" / "data.tar.gz"
+    with tarfile.open(tar, "w:gz") as t:
+        t.add(payload, arcname="hello.txt")
+    dest = tmp_path / "cache"
+    got = downloader.fetch(tar.as_uri(), str(dest))
+    assert (dest / "hello.txt").read_text() == "hi"
+    # idempotent: second call reuses the cached archive
+    assert downloader.fetch(tar.as_uri(), str(dest)) == got
+
+
+def test_downloader_tar_slip_guard(tmp_path):
+    evil = tmp_path / "evil.tar"
+    with open(tmp_path / "f.txt", "w") as f:
+        f.write("x")
+    import tarfile
+    with tarfile.open(evil, "w") as t:
+        info = tarfile.TarInfo("../escape.txt")
+        info.size = 1
+        t.addfile(info, io.BytesIO(b"x"))
+    with pytest.raises(IOError, match="unsafe"):
+        downloader.extract_archive(str(evil), str(tmp_path / "out"))
+
+
+def test_downloader_unreachable(tmp_path):
+    with pytest.raises(IOError, match="egress"):
+        downloader.fetch("http://127.0.0.1:9/none.bin", str(tmp_path),
+                         timeout=0.5)
+
+
+def test_downloader_symlink_slip_blocked(tmp_path):
+    import tarfile
+    victim = tmp_path / "victim"
+    victim.mkdir()
+    evil = tmp_path / "evil.tar"
+    with tarfile.open(evil, "w") as tar:
+        info = tarfile.TarInfo("ln")
+        info.type = tarfile.SYMTYPE
+        info.linkname = str(victim)
+        tar.addfile(info)
+        data = b"pwned"
+        finfo = tarfile.TarInfo("ln/pwned.txt")
+        finfo.size = len(data)
+        tar.addfile(finfo, io.BytesIO(data))
+    with pytest.raises((IOError, OSError)):
+        downloader.extract_archive(str(evil), str(tmp_path / "out"))
+    assert not (victim / "pwned.txt").exists()
+
+
+def test_downloader_extract_cached_once(tmp_path):
+    import tarfile
+    payload = tmp_path / "x.txt"
+    payload.write_text("v1")
+    tar = tmp_path / "a.tar"
+    with tarfile.open(tar, "w") as t:
+        t.add(payload, arcname="x.txt")
+    dest = tmp_path / "cache"
+    downloader.fetch(tar.as_uri(), str(dest))
+    assert (dest / "x.txt").read_text() == "v1"
+    # mutate the extracted file; a cache-hit fetch must NOT re-extract
+    (dest / "x.txt").write_text("patched")
+    downloader.fetch(tar.as_uri(), str(dest))
+    assert (dest / "x.txt").read_text() == "patched"

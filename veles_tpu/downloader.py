@@ -1,0 +1,99 @@
+"""Dataset fetch+unpack at init (reference: veles/downloader.py:56 — a unit
+that downloads an archive URL into the data dir and extracts it before the
+loader runs).
+
+Redesigned as a plain function the loader calls from ``load_data`` — in a
+functional framework there is no "unit that runs once"; side-effecting setup
+happens on the host before tracing. Network egress is environment-gated:
+when the URL is unreachable the error tells the user to pre-seed the cache
+directory, and an already-populated cache short-circuits the fetch entirely
+(same idempotence contract as the reference's existence check).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import tarfile
+import urllib.request
+import zipfile
+
+from .logger import Logger
+
+_log = Logger()
+
+
+def fetch(url: str, dest_dir: str, *, sha256: str = "",
+          extract: bool = True, timeout: float = 60.0) -> str:
+    """Ensure ``url``'s payload exists under ``dest_dir``; return the local
+    archive path. Skips download when the target file already exists (and
+    matches ``sha256`` if given). Extracts tar/zip archives alongside."""
+    os.makedirs(dest_dir, exist_ok=True)
+    fname = os.path.basename(url.split("?", 1)[0]) or "download"
+    path = os.path.join(dest_dir, fname)
+    marker = path + ".extracted"
+    cached = os.path.exists(path) and _checksum_ok(path, sha256)
+    if not cached:
+        tmp = path + ".part"
+        try:
+            with urllib.request.urlopen(url, timeout=timeout) as r, \
+                    open(tmp, "wb") as f:
+                while True:
+                    chunk = r.read(1 << 20)
+                    if not chunk:
+                        break
+                    f.write(chunk)
+        except OSError as e:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise IOError(
+                f"cannot fetch {url} ({e}); this environment may have no "
+                f"network egress — place the file at {path} manually"
+            ) from e
+        if not _checksum_ok(tmp, sha256):
+            os.unlink(tmp)
+            raise IOError(f"checksum mismatch for {url}")
+        os.replace(tmp, path)
+        _log.info("downloaded %s -> %s", url, path)
+    if extract and not (cached and os.path.exists(marker)):
+        extract_archive(path, dest_dir)
+        with open(marker, "w") as f:
+            f.write("ok")
+    return path
+
+
+def _checksum_ok(path: str, sha256: str) -> bool:
+    if not sha256:
+        return True
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest() == sha256
+
+
+def safe_extract_tar(tar: tarfile.TarFile, dest_dir: str) -> None:
+    """Extract with tarfile's "data" filter: rejects absolute paths and
+    ``..`` escapes AND symlink/hardlink members that point outside the
+    destination — a plain name check misses the symlink case because
+    realpath cannot resolve a link that extractall is about to create."""
+    try:
+        tar.extractall(dest_dir, filter="data")
+    except tarfile.FilterError as e:
+        raise IOError(f"unsafe archive member: {e}") from e
+
+
+def extract_archive(path: str, dest_dir: str) -> None:
+    """Extract tar(.gz/.bz2/.xz) and zip archives; other files are left as
+    is. Members escaping dest_dir (via ../ or symlinks) are rejected."""
+    if tarfile.is_tarfile(path):
+        with tarfile.open(path) as tar:
+            safe_extract_tar(tar, dest_dir)
+    elif zipfile.is_zipfile(path):
+        with zipfile.ZipFile(path) as z:
+            base = os.path.realpath(dest_dir)
+            for name in z.namelist():
+                target = os.path.realpath(os.path.join(dest_dir, name))
+                if not target.startswith(base + os.sep) and target != base:
+                    raise IOError(f"unsafe archive member: {name}")
+            z.extractall(dest_dir)

@@ -32,7 +32,9 @@ from ..logger import Logger
 REQUIRED_MANIFEST_KEYS = ("name", "workflow", "configuration")
 LATEST = "master"  # the reference's "master" version alias
 
-_NAME_RE = re.compile(r"^[A-Za-z0-9_.-]+$")
+# First char must be alphanumeric/underscore: rejects ".", "..", and other
+# dot-only names that would resolve to the store root or its parent.
+_NAME_RE = re.compile(r"^[A-Za-z0-9_][A-Za-z0-9_.-]*$")
 
 
 class Manifest(dict):
@@ -124,23 +126,37 @@ class ForgeStore(Logger):
                     raise ValueError(
                         f"{name!r} already has version {version!r}")
                 vdir = self._vdir(name, version)
-                os.makedirs(vdir, exist_ok=True)
-                for member in tar.getmembers():
-                    if not member.isfile():
-                        continue
-                    # refuse path escapes in hostile archives
-                    target = os.path.realpath(os.path.join(vdir, member.name))
-                    if not target.startswith(os.path.realpath(vdir) + os.sep):
-                        raise ValueError(
-                            f"unsafe member path {member.name!r}")
-                    os.makedirs(os.path.dirname(target), exist_ok=True)
-                    with tar.extractfile(member) as src, \
-                            open(target, "wb") as dst:
-                        shutil.copyfileobj(src, dst)
-                man["version"] = version
-                man["_uploaded"] = time.strftime("%Y-%m-%d %H:%M:%S")
-                with open(os.path.join(vdir, "manifest.json"), "w") as f:
-                    json.dump(man, f, indent=1)
+                # Extract into a temp dir and rename into place: a rejected
+                # upload must not leave partial files that a later upload of
+                # the same version would silently serve.
+                tmpdir = vdir + ".ingest"
+                if os.path.exists(tmpdir):
+                    shutil.rmtree(tmpdir)
+                os.makedirs(tmpdir)
+                try:
+                    for member in tar.getmembers():
+                        if not member.isfile():
+                            continue
+                        # refuse path escapes in hostile archives
+                        target = os.path.realpath(
+                            os.path.join(tmpdir, member.name))
+                        if not target.startswith(
+                                os.path.realpath(tmpdir) + os.sep):
+                            raise ValueError(
+                                f"unsafe member path {member.name!r}")
+                        os.makedirs(os.path.dirname(target), exist_ok=True)
+                        with tar.extractfile(member) as src, \
+                                open(target, "wb") as dst:
+                            shutil.copyfileobj(src, dst)
+                    man["version"] = version
+                    man["_uploaded"] = time.strftime("%Y-%m-%d %H:%M:%S")
+                    with open(os.path.join(tmpdir, "manifest.json"),
+                              "w") as f:
+                        json.dump(man, f, indent=1)
+                except Exception:
+                    shutil.rmtree(tmpdir, ignore_errors=True)
+                    raise
+                os.rename(tmpdir, vdir)
                 self._write_versions(name, versions + [version])
         self.info("stored %s==%s", name, version)
         return man
@@ -189,14 +205,13 @@ class ForgeStore(Logger):
 
     @staticmethod
     def unpack(tar_bytes: bytes, dest: str) -> str:
+        from ..downloader import safe_extract_tar
         os.makedirs(dest, exist_ok=True)
         with io.BytesIO(tar_bytes) as bio, \
                 tarfile.open(fileobj=bio, mode="r:*") as tar:
-            for member in tar.getmembers():
-                target = os.path.realpath(os.path.join(dest, member.name))
-                if not target.startswith(os.path.realpath(dest) + os.sep):
-                    raise ValueError(f"unsafe member path {member.name!r}")
-            tar.extractall(dest)
+            # "data" filter also rejects symlink members escaping dest —
+            # the bytes come from a remote forge server and are untrusted.
+            safe_extract_tar(tar, dest)
         return dest
 
     # -- internals ---------------------------------------------------------

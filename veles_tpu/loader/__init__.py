@@ -4,3 +4,5 @@ from .fullbatch import FullBatchLoader
 from .image import FileImageLoader, Hdf5Loader, ImageLoader
 from .interactive import QueueLoader
 from .saver import MinibatchesLoader, MinibatchesSaver
+from .ext import (CsvLoader, EnsembleResultsLoader, PicklesLoader,
+                  WavLoader, read_wav)
