@@ -1,0 +1,125 @@
+"""Recurrent units: scan-cell math vs numpy reference, numeric gradients
+(the reference validated gradient units against NumDiff numeric
+differentiation — veles/numpy_ext.py, SURVEY.md §4), and end-to-end
+sequence classification through the Workflow/Trainer."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import veles_tpu as vt
+from veles_tpu.loader import TRAIN, VALID, ArrayLoader
+from veles_tpu.ops import recurrent as rec
+from veles_tpu.units import GRU, LSTM, RNN
+
+
+def test_rnn_scan_matches_reference(rng):
+    T, B, F, H = 5, 3, 4, 6
+    xs = rng.normal(size=(T, B, F)).astype(np.float32)
+    w = rng.normal(scale=0.3, size=(F + H, H)).astype(np.float32)
+    b = rng.normal(scale=0.1, size=(H,)).astype(np.float32)
+    h0 = np.zeros((B, H), np.float32)
+    ys, h_final = rec.rnn_scan(jnp.asarray(xs), jnp.asarray(h0),
+                               jnp.asarray(w), jnp.asarray(b))
+    ys_ref, h_ref = rec.rnn_reference(xs, h0, w, b)
+    np.testing.assert_allclose(np.asarray(ys), ys_ref, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(h_final), h_ref, atol=1e-5)
+
+
+@pytest.mark.parametrize("cell", ["rnn", "gru", "lstm"])
+def test_numeric_gradient(cell, rng):
+    """jax.grad of a scalar loss through the scan matches central
+    finite differences (NumDiff pattern)."""
+    T, B, F, H = 3, 2, 3, 4
+    n_gates = {"rnn": 1, "gru": 3, "lstm": 4}[cell]
+    xs = jnp.asarray(rng.normal(size=(T, B, F)).astype(np.float32))
+    w = jnp.asarray(rng.normal(
+        scale=0.4, size=(F + H, n_gates * H)).astype(np.float32))
+    b = jnp.asarray(rng.normal(scale=0.1,
+                               size=(n_gates * H,)).astype(np.float32))
+    h0 = jnp.zeros((B, H), jnp.float32)
+    c0 = jnp.zeros((B, H), jnp.float32)
+
+    def loss(w):
+        if cell == "rnn":
+            ys, _ = rec.rnn_scan(xs, h0, w, b)
+        elif cell == "gru":
+            ys, _ = rec.gru_scan(xs, h0, w, b)
+        else:
+            ys, _ = rec.lstm_scan(xs, h0, c0, w, b)
+        return jnp.sum(ys ** 2)
+
+    g = np.asarray(jax.grad(loss)(w))
+    eps = 1e-3
+    w_np = np.asarray(w)
+    for idx in [(0, 0), (F + H - 1, n_gates * H - 1), (2, 1)]:
+        wp, wm = w_np.copy(), w_np.copy()
+        wp[idx] += eps
+        wm[idx] -= eps
+        num = (float(loss(jnp.asarray(wp))) -
+               float(loss(jnp.asarray(wm)))) / (2 * eps)
+        assert abs(g[idx] - num) < 3e-2 * max(1.0, abs(num)), \
+            (cell, idx, g[idx], num)
+
+
+@pytest.mark.parametrize("unit_cls", [RNN, GRU, LSTM])
+def test_unit_shapes(unit_cls, rng):
+    B, T, F, H = 4, 7, 5, 8
+    x = jnp.asarray(rng.normal(size=(B, T, F)).astype(np.float32))
+    for return_sequences, want in [(True, (B, T, H)), (False, (B, H))]:
+        u = unit_cls(H, return_sequences=return_sequences)
+        spec = u.output_spec([vt.Spec((B, T, F), jnp.float32)])
+        assert spec.shape == want
+        params, state = u.init(jax.random.key(0),
+                               [vt.Spec((B, T, F), jnp.float32)])
+        y, _ = u.apply(params, state, [x], vt.units.Context(train=True))
+        assert y.shape == want
+        assert np.isfinite(np.asarray(y)).all()
+
+
+def test_unit_rejects_2d_input():
+    u = LSTM(4)
+    with pytest.raises(ValueError, match="batch, time"):
+        u.output_spec([vt.Spec((8, 16), jnp.float32)])
+
+
+def test_lstm_bf16_compute_close_to_f32(rng):
+    B, T, F, H = 4, 6, 8, 16
+    x = jnp.asarray(rng.normal(size=(B, T, F)).astype(np.float32))
+    u32 = LSTM(H, compute_dtype=None)
+    u16 = LSTM(H, compute_dtype="bfloat16")
+    params, state = u32.init(jax.random.key(1),
+                             [vt.Spec((B, T, F), jnp.float32)])
+    y32, _ = u32.apply(params, state, [x], vt.units.Context())
+    y16, _ = u16.apply(params, state, [x], vt.units.Context())
+    # carry stays f32; only gemm operands are bf16 -> small deviation
+    assert float(jnp.max(jnp.abs(y32 - y16))) < 0.05
+
+
+def _sequence_dataset(rng, n, T=12, F=6):
+    """Class = whether the cumulative sum of feature 0 ends positive —
+    requires integrating over time, so a pure feedforward on the last
+    step cannot solve it."""
+    x = rng.normal(size=(n, T, F)).astype(np.float32)
+    y = (x[:, :, 0].sum(axis=1) > 0).astype(np.int32)
+    return x, y
+
+
+@pytest.mark.parametrize("unit_cls", [GRU, LSTM])
+def test_sequence_classification_end_to_end(unit_cls, rng):
+    xtr, ytr = _sequence_dataset(rng, 256)
+    xva, yva = _sequence_dataset(rng, 128)
+    loader = ArrayLoader({TRAIN: xtr, VALID: xva},
+                         {TRAIN: ytr, VALID: yva}, minibatch_size=32)
+    wf = vt.Workflow(f"seq_{unit_cls.__name__}")
+    wf.add(unit_cls(16, return_sequences=False, name="rec"))
+    wf.add(vt.units.All2AllSoftmax(2, name="out", inputs=("rec",)))
+    wf.add(vt.units.EvaluatorSoftmax(
+        name="ev", inputs=("out", "@labels", "@mask")))
+    trainer = vt.Trainer(wf, loader,
+                         vt.optimizers.AdaGrad(0.08),
+                         vt.Decision(max_epochs=12))
+    trainer.initialize(seed=11)
+    results = trainer.run()
+    assert results["best_value"] < 25.0, results  # chance = 50 %

@@ -14,3 +14,4 @@ from .lrn import local_response_norm
 from .losses import softmax_cross_entropy, mse_loss
 from .normalize import mean_disp_normalize
 from .reduce import matrix_reduce
+from .recurrent import gru_scan, lstm_scan, rnn_scan

@@ -6,5 +6,6 @@ from .nn import (All2All, All2AllRELU, All2AllSincos, All2AllSoftmax,
                  Flatten, LRN, MaxPooling, MeanDispNormalizer,
                  StochasticAbsPooling)
 from .kohonen import KohonenForward
+from .recurrent import GRU, LSTM, RNN
 from .rbm import RBM
 from .workflow import Workflow, WorkflowError

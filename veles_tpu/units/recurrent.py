@@ -1,0 +1,107 @@
+"""Recurrent layer units: RNN, GRU, LSTM over lax.scan cells.
+
+Znicz parity+ (reference declared RNN/LSTM units, "created but not
+tested" — docs/source/manualrst_veles_algorithms.rst:115-134). Input is
+batch-major (B, T, F); units transpose to time-major for the scan and back,
+so the rest of the framework keeps the batch-leading convention of every
+other unit. ``return_sequences=False`` yields the last hidden state (B, H)
+— the natural input to an All2All classifier head.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import ops
+from ..ops import recurrent as rec_ops
+from .base import Forward, Spec
+
+
+class _RecurrentBase(Forward):
+    n_gates = 1  # columns of the fused gate weight = n_gates * hidden
+
+    def __init__(self, hidden: int, *, return_sequences: bool = True,
+                 compute_dtype=None, name=None, inputs=("@input",)):
+        super().__init__(name, inputs)
+        self.hidden = int(hidden)
+        self.return_sequences = bool(return_sequences)
+        self.compute_dtype = (None if compute_dtype in (None, "")
+                              else jnp.dtype(compute_dtype))
+
+    def _dims(self, in_spec: Spec):
+        if len(in_spec.shape) != 3:
+            raise ValueError(
+                f"{self.name}: recurrent input must be (batch, time, "
+                f"features), got {in_spec.shape}")
+        return in_spec.shape  # (B, T, F)
+
+    def output_spec(self, in_specs):
+        b, t, _ = self._dims(in_specs[0])
+        if self.return_sequences:
+            return Spec((b, t, self.hidden), in_specs[0].dtype)
+        return Spec((b, self.hidden), in_specs[0].dtype)
+
+    def init(self, key, in_specs):
+        _, _, f = self._dims(in_specs[0])
+        fan_in = f + self.hidden
+        params = {
+            "w": ops.smart_uniform_init(
+                key, (fan_in, self.n_gates * self.hidden), fan_in),
+            "b": jnp.zeros((self.n_gates * self.hidden,), jnp.float32),
+        }
+        return params, {}
+
+    def _scan(self, params, xs_tm, batch):
+        raise NotImplementedError
+
+    def apply(self, params, state, xs, ctx):
+        x = jnp.swapaxes(xs[0], 0, 1)  # (T, B, F) time-major for scan
+        ys_tm, _ = self._scan(params, x, x.shape[1])
+        if self.return_sequences:
+            return jnp.swapaxes(ys_tm, 0, 1), state
+        return ys_tm[-1], state
+
+
+class RNN(_RecurrentBase):
+    """Elman RNN with tanh (or relu) activation."""
+
+    n_gates = 1
+
+    def __init__(self, hidden, *, activation: str = "tanh", **kw):
+        super().__init__(hidden, **kw)
+        self.activation = activation
+
+    def _scan(self, params, xs_tm, batch):
+        act = {"tanh": jnp.tanh, "relu": jax.nn.relu}[self.activation]
+        h0 = jnp.zeros((batch, self.hidden), jnp.float32)
+        return rec_ops.rnn_scan(xs_tm, h0, params["w"], params["b"],
+                                activation=act,
+                                compute_dtype=self.compute_dtype)
+
+
+class GRU(_RecurrentBase):
+    n_gates = 3
+
+    def _scan(self, params, xs_tm, batch):
+        h0 = jnp.zeros((batch, self.hidden), jnp.float32)
+        return rec_ops.gru_scan(xs_tm, h0, params["w"], params["b"],
+                                compute_dtype=self.compute_dtype)
+
+
+class LSTM(_RecurrentBase):
+    n_gates = 4
+
+    def __init__(self, hidden, *, forget_bias: float = 1.0, **kw):
+        super().__init__(hidden, **kw)
+        self.forget_bias = float(forget_bias)
+
+    def _scan(self, params, xs_tm, batch):
+        h0 = jnp.zeros((batch, self.hidden), jnp.float32)
+        c0 = jnp.zeros((batch, self.hidden), jnp.float32)
+        return rec_ops.lstm_scan(xs_tm, h0, c0, params["w"], params["b"],
+                                 compute_dtype=self.compute_dtype,
+                                 forget_bias=self.forget_bias)
