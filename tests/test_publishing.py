@@ -1,0 +1,152 @@
+"""Publishing subsystem + interactive API (reference: veles/publishing/,
+veles/__init__.py callable module, veles/interaction.py Shell)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import veles_tpu as vt
+from veles_tpu.interaction import Shell
+from veles_tpu.loader import TRAIN, VALID, ArrayLoader
+from veles_tpu.plotting import MetricsRecorder
+from veles_tpu.publishing import (ConfluenceBackend, HtmlBackend,
+                                  MarkdownBackend, PdfBackend, Publisher)
+
+
+@pytest.fixture
+def trained(rng):
+    x = rng.normal(size=(64, 6)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.int32)
+    loader = ArrayLoader({TRAIN: x, VALID: x}, {TRAIN: y, VALID: y},
+                         minibatch_size=16)
+    wf = vt.Workflow("pub_wf")
+    wf.add(vt.units.All2AllTanh(8, name="fc1"))
+    wf.add(vt.units.All2AllSoftmax(2, name="out", inputs=("fc1",)))
+    wf.add(vt.units.EvaluatorSoftmax(
+        name="ev", inputs=("out", "@labels", "@mask")))
+    rec = MetricsRecorder("pub")
+    tr = vt.Trainer(wf, loader, vt.optimizers.SGD(0.2),
+                    vt.Decision(max_epochs=3), recorder=rec)
+    tr.initialize(seed=1)
+    tr.run()
+    return tr, rec
+
+
+def test_markdown_and_html_report(trained, tmp_path):
+    tr, rec = trained
+    pub = Publisher("Test run", "unit-test report",
+                    backends=[MarkdownBackend(str(tmp_path)),
+                              HtmlBackend(str(tmp_path))])
+    pub.gather(trainer=tr, recorder=rec, config=vt.root)
+    paths = pub.publish()
+    md = open(paths[0]).read()
+    assert "# Test run" in md
+    assert "best_value" in md
+    assert "fc1 → out → ev" in md
+    assert "valid_error_pct" in md  # sparkline section
+    html_doc = open(paths[1]).read()
+    assert "<h1>Test run</h1>" in html_doc
+    assert "fc1" in html_doc
+
+
+def test_pdf_report_valid_structure(trained, tmp_path):
+    tr, rec = trained
+    pub = Publisher("PDF run", backends=[PdfBackend(str(tmp_path))])
+    pub.gather(trainer=tr, recorder=rec)
+    (path,) = pub.publish()
+    data = open(path, "rb").read()
+    assert data.startswith(b"%PDF-1.4")
+    assert data.rstrip().endswith(b"%%EOF")
+    assert b"/Type /Catalog" in data and b"/Type /Page" in data
+    # xref offsets must point at the right objects
+    xref_at = int(data.rsplit(b"startxref", 1)[1].split()[0])
+    assert data[xref_at:xref_at + 4] == b"xref"
+    # first object offset parses and lands on "1 0 obj"
+    first_off = int(data[xref_at:].split(b"\n")[3].split()[0])
+    assert data[first_off:first_off + 7] == b"1 0 obj"
+
+
+def test_pdf_escapes_and_paginates(tmp_path):
+    from veles_tpu.publishing.publisher import Report
+    r = Report(title="esc (test) \\ page",
+               results={f"metric_{i}": float(i) for i in range(80)})
+    path = PdfBackend(str(tmp_path)).render(r)
+    data = open(path, "rb").read()
+    assert data.count(b"/Type /Page ") >= 2  # paginated
+    assert rb"esc \(test\) \\ page" in data
+
+
+def test_confluence_gated(trained):
+    tr, rec = trained
+    pub = Publisher("Conf run", backends=[
+        ConfluenceBackend("http://127.0.0.1:9", "SPACE", timeout=0.5)])
+    pub.gather(trainer=tr, recorder=rec)
+    with pytest.raises(IOError, match="Confluence"):
+        pub.publish()
+
+
+def test_callable_module(tmp_path):
+    cfg = tmp_path / "cfg.py"
+    cfg.write_text("""
+import numpy as np
+import veles_tpu as vt
+from veles_tpu.loader import ArrayLoader, TRAIN, VALID
+
+def create(root):
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(48, 4)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.int32)
+    loader = ArrayLoader({TRAIN: x, VALID: x}, {TRAIN: y, VALID: y},
+                         minibatch_size=16)
+    wf = vt.Workflow("callable_wf")
+    wf.add(vt.units.All2AllTanh(6, name="fc1"))
+    wf.add(vt.units.All2AllSoftmax(2, name="out", inputs=("fc1",)))
+    wf.add(vt.units.EvaluatorSoftmax(name="ev",
+                                     inputs=("out", "@labels", "@mask")))
+    return vt.Trainer(wf, loader, vt.optimizers.SGD(0.2),
+                      vt.Decision(max_epochs=2))
+""")
+    result_file = tmp_path / "res.json"
+    # the package itself is callable, like the reference's veles(...)
+    code = vt(str(cfg), result_file=str(result_file))
+    assert code == 0
+    results = json.loads(result_file.read_text())
+    assert "best_value" in results
+
+
+def test_shell_noninteractive_noop(trained):
+    tr, _ = trained
+    sh = Shell(tr, interval=1)
+    # stdin is not a tty under pytest: must not hang, must not raise
+    sh.record(1, error_pct=5.0)
+    sh.interact()
+
+
+def test_shell_chains_recorder(trained):
+    tr, _ = trained
+    rec = MetricsRecorder("chained")
+    sh = Shell(tr, interval=0, chain=rec)
+    sh.record(0, error_pct=4.2)
+    sh.record(1, error_pct=3.1)
+    assert rec.series["error_pct"] == [4.2, 3.1]
+    sh.close()
+
+
+def test_callable_module_false_kwargs(tmp_path):
+    # False/None kwargs must be omitted, not serialized as "--flag False"
+    from veles_tpu.interaction import run as vrun
+    cfg = tmp_path / "c.json"
+    cfg.write_text(json.dumps({"common": {"x": 1}}))
+    code = vrun(str(cfg), dump_config=True, verbose=False, snapshot=None)
+    assert code == 0
+
+
+def test_shell_exposes_chained_series(trained):
+    tr, _ = trained
+    rec = MetricsRecorder("inner")
+    sh = Shell(tr, chain=rec)
+    sh.record(0, loss=1.0)
+    assert sh.series == {"loss": [1.0]}  # Publisher.gather sees metrics
+    assert Shell(tr).series is None

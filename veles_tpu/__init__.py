@@ -41,3 +41,25 @@ from . import parallel
 from .parallel import MeshSpec, make_mesh
 from . import models
 from .models import StandardWorkflow
+from . import interaction
+from . import publishing
+from .publishing import Publisher
+
+
+def __call_module__(config, *overrides, **kwargs):
+    return interaction.run(config, *overrides, **kwargs)
+
+
+# Make the package itself callable — ``import veles_tpu; veles_tpu("cfg.py",
+# "root.x=1")`` — the reference replaced its module with a callable
+# VelesModule (veles/__init__.py:126-189); Python 3 allows swapping the
+# module's class instead.
+import sys as _sys
+import types as _types
+
+
+class _CallableModule(_types.ModuleType):
+    __call__ = staticmethod(__call_module__)
+
+
+_sys.modules[__name__].__class__ = _CallableModule

@@ -1,0 +1,100 @@
+"""Interactive use: callable module + in-training Shell.
+
+Reference parity:
+
+* ``import veles; veles("workflow.py", "config.py")`` — the reference
+  replaced its module object with a callable ``VelesModule``
+  (veles/__init__.py:126-189) that drove the same path as the CLI.
+  ``veles_tpu`` does the same via ``run()`` here, wired to the module's
+  ``__call__`` in ``veles_tpu/__init__.py``.
+* ``Shell`` — the reference embedded IPython inside a running workflow as
+  a unit (veles/interaction.py:49). Here Shell is an epoch callback the
+  Trainer invokes through the recorder interface: every ``interval``
+  epochs (or on demand) it drops into an interactive console with the
+  trainer/workflow/state in scope. Gated to interactive stdin — under a
+  driver/CI it degrades to a no-op with a log line instead of hanging on
+  input().
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Optional
+
+from .logger import Logger
+
+
+def run(config: str, *overrides, argv=(), **kwargs):
+    """Programmatic equivalent of ``python -m veles_tpu <config> ...``:
+    ``veles_tpu("cfg.py", "root.loader.name=mnist", max_epochs=3)``.
+
+    kwargs become ``--key value`` flags (underscores -> dashes; True means
+    a bare flag). Returns the CLI exit code.
+    """
+    from .__main__ import main
+    args = [config, *overrides, *argv]
+    for key, val in kwargs.items():
+        flag = "--" + key.replace("_", "-")
+        if val is True:
+            args.append(flag)
+        elif val is False or val is None:
+            continue  # omitted flag, not "--flag False"
+        else:
+            args += [flag, str(val)]
+    return main(args)
+
+
+class Shell(Logger):
+    """Interactive console breakpoints inside a training run.
+
+    Pass as (or chain behind) the Trainer's ``recorder``: its ``record``
+    hook fires each epoch. When stdin is a TTY and the epoch matches
+    ``interval``, opens IPython if available, else ``code.interact``,
+    with ``trainer``, ``workflow``, ``wstate`` and the latest metrics in
+    the namespace. Exiting the console resumes training.
+    """
+
+    def __init__(self, trainer=None, *, interval: int = 0,
+                 chain=None):
+        self.trainer = trainer
+        self.interval = int(interval)  # 0 = only explicit .interact()
+        self.chain = chain  # optional downstream recorder
+
+    @property
+    def series(self):
+        """Delegate to the chained recorder so Publisher.gather still sees
+        the metric series when Shell wraps a MetricsRecorder."""
+        return getattr(self.chain, "series", None) if self.chain else None
+
+    # recorder interface ---------------------------------------------------
+    def record(self, step: int, **values) -> None:
+        if self.chain is not None:
+            self.chain.record(step, **values)
+        if self.interval and step and step % self.interval == 0:
+            self.interact(step=step, **values)
+
+    def close(self):
+        if self.chain is not None and hasattr(self.chain, "close"):
+            self.chain.close()
+
+    # ----------------------------------------------------------------------
+    def interact(self, **extra) -> None:
+        if not sys.stdin.isatty():
+            self.info("Shell: stdin is not a TTY, skipping interactive "
+                      "breakpoint (epoch data: %s)", extra)
+            return
+        ns = dict(extra)
+        if self.trainer is not None:
+            ns.update(trainer=self.trainer,
+                      workflow=self.trainer.workflow,
+                      wstate=self.trainer.wstate,
+                      loader=self.trainer.loader)
+        banner = ("veles_tpu Shell — objects in scope: "
+                  + ", ".join(sorted(ns)))
+        try:
+            import IPython
+            IPython.embed(banner1=banner, user_ns=ns,
+                          colors="neutral")
+        except ImportError:
+            import code
+            code.interact(banner=banner, local=ns)

@@ -1,0 +1,19 @@
+"""Publishing: turn a finished training run into a report.
+
+Reference parity: veles/publishing/ — ``Publisher`` gathered workflow
+results, plots and graphs (publisher.py:57) and rendered them through
+backend classes: Markdown (markdown_backend.py), PDF (pdf_backend.py),
+Confluence wiki (confluence.py), all jinja2-templated. The rebuild keeps
+the gather→backend split with zero extra dependencies: Markdown and HTML
+are plain string templates, PDF is a minimal self-contained PDF 1.4 writer
+(text-only — the reference's PDF path pulled in wkhtmltopdf-class tooling
+we don't have), and Confluence posts through its REST API with urllib
+(gated: requires a reachable server + token).
+"""
+
+from .publisher import Publisher, Report
+from .backends import (ConfluenceBackend, HtmlBackend, MarkdownBackend,
+                       PdfBackend)
+
+__all__ = ["Publisher", "Report", "MarkdownBackend", "HtmlBackend",
+           "PdfBackend", "ConfluenceBackend"]

@@ -1,0 +1,225 @@
+"""Report render backends: Markdown, HTML, PDF, Confluence.
+
+Reference: veles/publishing/{markdown_backend,pdf_backend,confluence}.py.
+All dependency-free; see package docstring for the PDF/Confluence scope.
+"""
+
+from __future__ import annotations
+
+import html
+import json
+import os
+import urllib.request
+from typing import List
+
+from ..logger import Logger
+from ..plotting import sparkline
+from .publisher import Report
+
+
+def _fmt_val(v) -> str:
+    if isinstance(v, float):
+        return f"{v:.6g}"
+    return str(v)
+
+
+class MarkdownBackend(Logger):
+    """report.md with results table, metric sparklines, unit list
+    (reference: veles/publishing/markdown_backend.py)."""
+
+    def __init__(self, out_dir: str, filename: str = "report.md"):
+        self.out_dir = out_dir
+        self.filename = filename
+
+    def render_text(self, r: Report) -> str:
+        lines = [f"# {r.title}", ""]
+        if r.description:
+            lines += [r.description, ""]
+        lines += [f"*{r.created} — {r.user}@{r.host} — {r.platform}*", ""]
+        if r.results:
+            lines += ["## Results", "", "| metric | value |", "|---|---|"]
+            lines += [f"| {k} | {_fmt_val(v)} |"
+                      for k, v in sorted(r.results.items())]
+            lines.append("")
+        if r.metrics:
+            lines += ["## Metrics", "", "```"]
+            for name in sorted(r.metrics):
+                series = r.metric_series(name)
+                if series:
+                    lines.append(f"{name:<28} {sparkline(series)} "
+                                 f"last={series[-1]:.6g}")
+            lines += ["```", ""]
+        if r.workflow_units:
+            lines += ["## Workflow", "",
+                      " → ".join(r.workflow_units),
+                      "", f"checksum: `{r.workflow_checksum}`", ""]
+        for img in r.images:
+            lines.append(f"![plot]({os.path.basename(img)})")
+        if r.config_dump:
+            lines += ["", "## Configuration", "", "```", r.config_dump,
+                      "```"]
+        return "\n".join(lines) + "\n"
+
+    def render(self, r: Report) -> str:
+        os.makedirs(self.out_dir, exist_ok=True)
+        path = os.path.join(self.out_dir, self.filename)
+        with open(path, "w") as f:
+            f.write(self.render_text(r))
+        return path
+
+
+class HtmlBackend(MarkdownBackend):
+    """Standalone HTML page (the reference rendered Markdown to wiki/HTML
+    through jinja2; here a minimal converter over the same content)."""
+
+    def __init__(self, out_dir: str, filename: str = "report.html"):
+        super().__init__(out_dir, filename)
+
+    def render(self, r: Report) -> str:
+        os.makedirs(self.out_dir, exist_ok=True)
+        rows = "".join(
+            f"<tr><td>{html.escape(str(k))}</td>"
+            f"<td>{html.escape(_fmt_val(v))}</td></tr>"
+            for k, v in sorted(r.results.items()))
+        sparks = "".join(
+            f"<div class='spark'><b>{html.escape(n)}</b> "
+            f"<code>{html.escape(sparkline(r.metric_series(n)))}</code> "
+            f"last={r.metric_series(n)[-1]:.6g}</div>"
+            for n in sorted(r.metrics) if r.metric_series(n))
+        imgs = "".join(
+            f"<img src='{html.escape(os.path.basename(p))}' "
+            f"style='max-width:48rem'>" for p in r.images)
+        doc = f"""<!doctype html><html><head><meta charset="utf-8">
+<title>{html.escape(r.title)}</title>
+<style>body{{font-family:sans-serif;margin:2rem auto;max-width:52rem}}
+table{{border-collapse:collapse}}td{{border:1px solid #ccc;
+padding:.25rem .6rem}}code{{background:#f4f4f4}}</style></head><body>
+<h1>{html.escape(r.title)}</h1>
+<p>{html.escape(r.description)}</p>
+<p><i>{html.escape(r.created)} — {html.escape(r.user)}@{html.escape(r.host)}
+</i></p>
+<h2>Results</h2><table>{rows}</table>
+<h2>Metrics</h2>{sparks}
+{imgs}
+<h2>Workflow</h2><p>{html.escape(' → '.join(r.workflow_units))}</p>
+<pre>{html.escape(r.config_dump)}</pre>
+</body></html>"""
+        path = os.path.join(self.out_dir, self.filename)
+        with open(path, "w") as f:
+            f.write(doc)
+        return path
+
+
+class PdfBackend(MarkdownBackend):
+    """Minimal text PDF writer — no external tooling. Renders the Markdown
+    text line-by-line in Courier (monospace keeps the sparklines and
+    tables aligned). Valid PDF 1.4: catalog/pages/page+stream/font objects
+    with a correct xref table."""
+
+    def __init__(self, out_dir: str, filename: str = "report.pdf"):
+        super().__init__(out_dir, filename)
+
+    @staticmethod
+    def _esc(line: str) -> str:
+        # Latin-1-safe: PDF literal strings; replace unencodable chars.
+        out = line.encode("latin-1", "replace").decode("latin-1")
+        return (out.replace("\\", r"\\").replace("(", r"\(")
+                .replace(")", r"\)"))
+
+    def _pages(self, text: str, lines_per_page: int = 56) -> List[str]:
+        lines = text.splitlines() or [""]
+        return ["\n".join(lines[i:i + lines_per_page])
+                for i in range(0, len(lines), lines_per_page)]
+
+    def render(self, r: Report) -> str:
+        os.makedirs(self.out_dir, exist_ok=True)
+        pages = self._pages(self.render_text(r))
+        objs: List[bytes] = []  # 1-indexed PDF objects, in order
+        n_pages = len(pages)
+        # object ids: 1 catalog, 2 pages, 3 font, then (page, stream) pairs
+        page_ids = [4 + 2 * i for i in range(n_pages)]
+        objs.append(b"<< /Type /Catalog /Pages 2 0 R >>")
+        kids = " ".join(f"{pid} 0 R" for pid in page_ids)
+        objs.append(f"<< /Type /Pages /Kids [{kids}] "
+                    f"/Count {n_pages} >>".encode())
+        objs.append(b"<< /Type /Font /Subtype /Type1 "
+                    b"/BaseFont /Courier >>")
+        for i, page in enumerate(pages):
+            body = ["BT /F1 9 Tf 40 780 Td 13 TL"]
+            for ln in page.splitlines():
+                body.append(f"({self._esc(ln)}) Tj T*")
+            body.append("ET")
+            stream = "\n".join(body).encode("latin-1")
+            objs.append(
+                f"<< /Type /Page /Parent 2 0 R /MediaBox [0 0 612 792] "
+                f"/Resources << /Font << /F1 3 0 R >> >> "
+                f"/Contents {page_ids[i] + 1} 0 R >>".encode())
+            objs.append(b"<< /Length " + str(len(stream)).encode() +
+                        b" >>\nstream\n" + stream + b"\nendstream")
+        buf = bytearray(b"%PDF-1.4\n")
+        offsets = [0]
+        for i, obj in enumerate(objs, start=1):
+            offsets.append(len(buf))
+            buf += f"{i} 0 obj\n".encode() + obj + b"\nendobj\n"
+        xref_at = len(buf)
+        buf += f"xref\n0 {len(objs) + 1}\n".encode()
+        buf += b"0000000000 65535 f \n"
+        for off in offsets[1:]:
+            buf += f"{off:010d} 00000 n \n".encode()
+        buf += (f"trailer\n<< /Size {len(objs) + 1} /Root 1 0 R >>\n"
+                f"startxref\n{xref_at}\n%%EOF\n").encode()
+        path = os.path.join(self.out_dir, self.filename)
+        with open(path, "wb") as f:
+            f.write(bytes(buf))
+        return path
+
+
+class ConfluenceBackend(Logger):
+    """Posts the report as a Confluence page via the REST API (reference:
+    veles/publishing/confluence.py used the XML-RPC/SOAP API). Gated on a
+    reachable server: construction is free, render() raises a clear error
+    when the POST fails."""
+
+    def __init__(self, base_url: str, space: str, *, token: str = "",
+                 parent_id: str = "", timeout: float = 30.0):
+        self.base_url = base_url.rstrip("/")
+        self.space = space
+        self.token = token
+        self.parent_id = parent_id
+        self.timeout = timeout
+
+    def render(self, r: Report) -> str:
+        md = MarkdownBackend("", "").render_text(r)
+        # A literal "]]>" in the report would terminate the CDATA section;
+        # the standard escape splits it across two CDATA sections.
+        md = md.replace("]]>", "]]]]><![CDATA[>")
+        body_html = f"<ac:structured-macro ac:name=\"code\">" \
+                    f"<ac:plain-text-body><![CDATA[{md}]]>" \
+                    f"</ac:plain-text-body></ac:structured-macro>"
+        payload = {
+            "type": "page",
+            "title": r.title,
+            "space": {"key": self.space},
+            "body": {"storage": {"value": body_html,
+                                 "representation": "storage"}},
+        }
+        if self.parent_id:
+            payload["ancestors"] = [{"id": self.parent_id}]
+        req = urllib.request.Request(
+            self.base_url + "/rest/api/content",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json",
+                     **({"Authorization": f"Bearer {self.token}"}
+                        if self.token else {})},
+            method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                data = json.loads(resp.read())
+        except OSError as e:
+            raise IOError(
+                f"cannot publish to Confluence at {self.base_url} ({e}); "
+                "this environment may have no network egress") from e
+        link = data.get("_links", {})
+        url = (link.get("base", self.base_url) +
+               link.get("webui", f"/pages/{data.get('id', '')}"))
+        return url

@@ -1,0 +1,95 @@
+"""Report gathering (reference: veles/publishing/publisher.py:57 — the
+Publisher unit collected workflow name/description, results, image plots,
+the workflow graph and environment info, then handed a template context to
+a backend)."""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime
+import getpass
+import platform
+import socket
+from typing import Any, Dict, List, Optional, Sequence
+
+from ..logger import Logger
+
+
+@dataclasses.dataclass
+class Report:
+    """Backend-independent template context."""
+    title: str
+    description: str = ""
+    created: str = ""
+    host: str = ""
+    user: str = ""
+    platform: str = ""
+    workflow_units: List[str] = dataclasses.field(default_factory=list)
+    workflow_checksum: str = ""
+    config_dump: str = ""
+    results: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    metrics: Dict[str, List[float]] = \
+        dataclasses.field(default_factory=dict)
+    images: List[str] = dataclasses.field(default_factory=list)  # file paths
+
+    def metric_series(self, name: str) -> List[float]:
+        return list(self.metrics.get(name, []))
+
+
+class Publisher(Logger):
+    """Gathers a Report from trainer/workflow/recorder, renders via
+    backends.
+
+    Usage::
+
+        pub = Publisher("MNIST FC run", backends=[MarkdownBackend("out")])
+        pub.gather(trainer=trainer, recorder=recorder)
+        paths = pub.publish()
+    """
+
+    def __init__(self, title: str, description: str = "", *,
+                 backends: Sequence = ()):
+        self.report = Report(title=title, description=description)
+        self.backends = list(backends)
+
+    def gather(self, *, trainer=None, workflow=None, recorder=None,
+               results: Optional[Dict] = None, config=None,
+               images: Sequence[str] = ()) -> Report:
+        r = self.report
+        r.created = datetime.datetime.now().isoformat(timespec="seconds")
+        r.host = socket.gethostname()
+        try:
+            r.user = getpass.getuser()
+        except Exception:
+            r.user = "unknown"
+        r.platform = platform.platform()
+        if trainer is not None:
+            workflow = workflow or trainer.workflow
+            results = results if results is not None else trainer.results
+            recorder = recorder or trainer.recorder
+        if workflow is not None:
+            r.workflow_units = [u.name for u in workflow.units]
+            try:
+                r.workflow_checksum = workflow.checksum()
+            except Exception:
+                pass
+        if results:
+            r.results = {k: v for k, v in results.items()}
+        if recorder is not None and getattr(recorder, "series", None):
+            r.metrics = {k: list(v) for k, v in recorder.series.items()}
+        if config is not None:
+            r.config_dump = config.dump() if hasattr(config, "dump") \
+                else str(config)
+        r.images = list(images)
+        return r
+
+    def publish(self) -> List[str]:
+        """Render through every backend; returns produced artifact paths
+        (URLs for remote backends)."""
+        out = []
+        for backend in self.backends:
+            path = backend.render(self.report)
+            self.info("published %r via %s -> %s",
+                      self.report.title, type(backend).__name__, path)
+            out.append(path)
+        return out
