@@ -190,3 +190,16 @@ def test_downloader_extract_cached_once(tmp_path):
     (dest / "x.txt").write_text("patched")
     downloader.fetch(tar.as_uri(), str(dest))
     assert (dest / "x.txt").read_text() == "patched"
+
+
+def test_ensemble_results_mismatched_rows_rejected(tmp_path, rng):
+    np.savez(tmp_path / "a.npz",
+             probabilities=rng.random((10, 3)).astype(np.float32))
+    np.savez(tmp_path / "b.npz",
+             probabilities=rng.random((8, 3)).astype(np.float32))
+    man = tmp_path / "m.json"
+    man.write_text(json.dumps([{"results_path": "a.npz"},
+                               {"results_path": "b.npz"}]))
+    ld = EnsembleResultsLoader(str(man), minibatch_size=2)
+    with pytest.raises(LoaderError, match="row counts differ"):
+        ld.initialize()

@@ -153,10 +153,15 @@ class ForgeStore(Logger):
                     with open(os.path.join(tmpdir, "manifest.json"),
                               "w") as f:
                         json.dump(man, f, indent=1)
+                    # An unregistered vdir can exist if a previous process
+                    # died between rename and _write_versions; it is orphan
+                    # garbage (never listed/served), safe to replace.
+                    if os.path.exists(vdir):
+                        shutil.rmtree(vdir)
+                    os.rename(tmpdir, vdir)
                 except Exception:
                     shutil.rmtree(tmpdir, ignore_errors=True)
                     raise
-                os.rename(tmpdir, vdir)
                 self._write_versions(name, versions + [version])
         self.info("stored %s==%s", name, version)
         return man

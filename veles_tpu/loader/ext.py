@@ -251,9 +251,17 @@ class EnsembleResultsLoader(Loader):
                     labels = z["labels"].astype(np.int32)
         if not probs:
             raise LoaderError(f"no model results in {self.manifest_path}")
-        n = min(p.shape[0] for p in probs)
-        self._data = np.concatenate([p[:n] for p in probs], axis=1)
-        self._labels = None if labels is None else labels[:n]
+        lengths = {p.shape[0] for p in probs}
+        if len(lengths) > 1:
+            # Rows pair per-sample across models; differing counts mean
+            # the models were evaluated on different sample sets and the
+            # vote would silently mix samples.
+            raise LoaderError(
+                f"model result row counts differ ({sorted(lengths)}); "
+                "all models must be evaluated on the same samples")
+        n = lengths.pop()
+        self._data = np.concatenate(probs, axis=1)
+        self._labels = labels
         self.class_lengths[self.klass] = n
 
     def fill_minibatch(self, indices, klass):
