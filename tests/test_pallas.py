@@ -1,0 +1,128 @@
+"""Pallas kernel tests — run in interpreter mode on the CPU backend,
+checked against jnp references (the reference's pattern of same-math tests
+across backends, veles/tests/accelerated_test.py:41-70)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from veles_tpu.ops import pallas_kernels as pk
+from veles_tpu.parallel.ring_attention import full_attention
+
+
+@pytest.fixture
+def qkv(rng):
+    B, T, H, D = 2, 48, 2, 16
+    q = jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.float32)
+    return q, k, v
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_matches_full(qkv, causal):
+    q, k, v = qkv
+    out = pk.flash_attention(q, k, v, causal, None, 16, 16, True)
+    ref = full_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_flash_attention_unpadded_blocks(rng):
+    # T not a multiple of the block size exercises the padding/mask path.
+    B, T, H, D = 1, 37, 1, 8
+    q, k, v = (jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.float32)
+               for _ in range(3))
+    out = pk.flash_attention(q, k, v, True, None, 16, 16, True)
+    ref = full_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_flash_attention_cross_attention_lengths(rng):
+    B, H, D = 1, 2, 8
+    q = jnp.asarray(rng.standard_normal((B, 24, H, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, 40, H, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, 40, H, D)), jnp.float32)
+    out = pk.flash_attention(q, k, v, False, None, 16, 16, True)
+    ref = full_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_flash_attention_grad_matches_reference(qkv):
+    q, k, v = qkv
+
+    def loss_pallas(q, k, v):
+        return jnp.sum(jnp.square(
+            pk.flash_attention(q, k, v, True, None, 16, 16, True)))
+
+    def loss_ref(q, k, v):
+        return jnp.sum(jnp.square(full_attention(q, k, v, causal=True)))
+
+    gp = jax.grad(loss_pallas, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gp, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-4)
+
+
+def test_fused_dropout_rate_and_scaling(rng):
+    x = jnp.ones((64, 128), jnp.float32)
+    out = pk.fused_dropout(x, 7, 0.4, 32, True)
+    out = np.asarray(out)
+    kept = out != 0
+    assert abs(kept.mean() - 0.6) < 0.05
+    np.testing.assert_allclose(out[kept], 1.0 / 0.6, rtol=1e-6)
+
+
+def test_fused_dropout_deterministic_per_seed(rng):
+    x = jnp.asarray(rng.standard_normal((32, 64)), jnp.float32)
+    a = pk.fused_dropout(x, 3, 0.5, 16, True)
+    b = pk.fused_dropout(x, 3, 0.5, 16, True)
+    c = pk.fused_dropout(x, 4, 0.5, 16, True)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert not np.array_equal(np.asarray(a), np.asarray(c))
+
+
+def test_fused_dropout_grad_uses_same_mask(rng):
+    x = jnp.asarray(rng.standard_normal((16, 32)), jnp.float32)
+    out = pk.fused_dropout(x, 11, 0.3, 16, True)
+    g = jax.grad(lambda x_: jnp.sum(
+        pk.fused_dropout(x_, 11, 0.3, 16, True)))(x)
+    mask = np.asarray(out) != 0
+    expect = np.where(mask, 1.0 / 0.7, 0.0)
+    np.testing.assert_allclose(np.asarray(g), expect, rtol=1e-6)
+
+
+def test_mean_disp_normalize_matches_jnp(rng):
+    x = rng.integers(0, 256, (10, 3, 5), dtype=np.uint8)
+    mean = rng.standard_normal((3, 5)).astype(np.float32) * 10 + 128
+    rdisp = (1.0 / (rng.standard_normal((3, 5)).astype(np.float32) ** 2
+                    + 1.0))
+    out = pk.mean_disp_normalize(jnp.asarray(x), jnp.asarray(mean),
+                                 jnp.asarray(rdisp), interpret=True)
+    ref = (x.astype(np.float32) - mean) * rdisp
+    np.testing.assert_allclose(np.asarray(out), ref.reshape(10, 3, 5),
+                               rtol=1e-6)
+
+
+def test_gather_rows_matches_take(rng):
+    data = rng.standard_normal((40, 3, 7)).astype(np.float32)
+    idx = rng.integers(0, 40, 13).astype(np.int32)
+    out = pk.gather_rows(jnp.asarray(data), jnp.asarray(idx), interpret=True)
+    np.testing.assert_array_equal(np.asarray(out), data[idx])
+
+
+def test_blockwise_attention_flash_delegation(rng):
+    from veles_tpu.parallel.ring_attention import blockwise_attention
+    B, T, H, D = 1, 40, 2, 8
+    q, k, v = (jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.float32)
+               for _ in range(3))
+    out = blockwise_attention(q, k, v, block_size=16, causal=True,
+                              use_flash=True)
+    ref = blockwise_attention(q, k, v, block_size=16, causal=True,
+                              use_flash=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)

@@ -260,6 +260,10 @@ class EnsembleResultsLoader(Loader):
                 f"model result row counts differ ({sorted(lengths)}); "
                 "all models must be evaluated on the same samples")
         n = lengths.pop()
+        if labels is not None and labels.shape[0] != n:
+            raise LoaderError(
+                f"labels length {labels.shape[0]} != result rows {n}; "
+                "labels must pair one-to-one with model predictions")
         self._data = np.concatenate(probs, axis=1)
         self._labels = labels
         self.class_lengths[self.klass] = n

@@ -29,10 +29,12 @@ from .base import ArrayLoader, TEST, TRAIN, VALID
 class FullBatchLoader(ArrayLoader):
     """ArrayLoader whose gather happens on device."""
 
-    def __init__(self, *args, device=None, force_host: bool = False, **kw):
+    def __init__(self, *args, device=None, force_host: bool = False,
+                 use_pallas_gather: Optional[bool] = None, **kw):
         super().__init__(*args, **kw)
         self._device = device
         self._force_host = force_host
+        self._use_pallas_gather = use_pallas_gather
         self._dev_data: Dict[int, dict] = {}
         self._gather = None
         self.on_device = False
@@ -63,9 +65,46 @@ class FullBatchLoader(ArrayLoader):
                 entry["@targets"] = put(self._targets[klass])
             self._dev_data[klass] = entry
 
-        @jax.jit
-        def gather(tree, idx):
-            return jax.tree.map(lambda a: jnp.take(a, idx, axis=0), tree)
+        # The Pallas DMA-gather kernel is TPU-only; honor an explicit
+        # non-TPU device placement (shared policy:
+        # ops/pallas_kernels.use_pallas_default).
+        from ..ops.pallas_kernels import use_pallas_default
+        platform = (self._device.platform if self._device is not None
+                    else None)
+        use_pallas = (use_pallas_default(platform)
+                      if self._use_pallas_gather is None
+                      else self._use_pallas_gather)
+        if use_pallas:
+            # Per-index HBM→HBM DMA kernel (parity:
+            # ocl/fullbatch_loader.cl fill_minibatch_data_labels).  Big
+            # arrays are packed into the kernel's tiled row layout ONCE
+            # here; small rows (labels) would pad to a full 8x128 tile, so
+            # they stay on jnp.take.
+            from ..ops.pallas_kernels import (pack_rows, gather_rows_packed,
+                                              unpack_rows)
+            packed_meta = {}
+            for klass, entry in self._dev_data.items():
+                for key, arr in entry.items():
+                    if np.prod(arr.shape[1:]) >= 1024:
+                        packed, f, sshape = pack_rows(arr)
+                        entry[key] = packed
+                        packed_meta[key] = (f, tuple(sshape))
+
+            @jax.jit
+            def gather(tree, idx):
+                out = {}
+                for key, a in tree.items():
+                    if key in packed_meta:
+                        f, sshape = packed_meta[key]
+                        out[key] = unpack_rows(
+                            gather_rows_packed(a, idx), f, sshape)
+                    else:
+                        out[key] = jnp.take(a, idx, axis=0)
+                return out
+        else:
+            @jax.jit
+            def gather(tree, idx):
+                return jax.tree.map(lambda a: jnp.take(a, idx, axis=0), tree)
 
         self._gather = gather
 

@@ -15,3 +15,19 @@ from .losses import softmax_cross_entropy, mse_loss
 from .normalize import mean_disp_normalize
 from .reduce import matrix_reduce
 from .recurrent import gru_scan, lstm_scan, rnn_scan
+
+_PALLAS_EXPORTS = ("flash_attention", "fused_dropout", "gather_rows",
+                   "use_pallas_default")
+
+
+def __getattr__(name):
+    # Lazy: importing veles_tpu must not pull in the Mosaic TPU machinery
+    # on hosts that never run a hand-written kernel.
+    if name == "pallas_kernels" or name in _PALLAS_EXPORTS:
+        import importlib
+        mod = importlib.import_module(".pallas_kernels", __name__)
+        globals()["pallas_kernels"] = mod  # cache; skip __getattr__ next time
+        if name == "pallas_kernels":
+            return mod
+        return getattr(mod, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,376 @@
+"""Hand-written Pallas TPU kernels for the ops where fusion semantics or
+memory movement matter beyond what XLA's automatic fusion gives.
+
+Reference parity (each kernel names its OpenCL/CUDA counterpart):
+
+* ``flash_attention``        — no reference counterpart (SURVEY.md §5.7: the
+  reference has no attention); TPU-native blockwise-softmax kernel.  The
+  long-context path (parallel/ring_attention.py ``blockwise_attention``)
+  delegates to it on TPU.
+* ``fused_dropout``          — reference: Znicz dropout unit backed by the
+  parallel RNG kernels ``ocl/random.cl`` / ``cuda/random.cu`` (xorshift1024*
+  per-state, interleaved output).  Here the RNG is a counter-based
+  splitmix32 hash of (seed, linear element index) generated *inside* the
+  kernel, so mask bits never touch HBM and the backward pass can regenerate
+  them exactly instead of storing the mask.
+* ``mean_disp_normalize``    — reference: ``ocl/mean_disp_normalizer.cl`` /
+  ``cuda/mean_disp_normalizer.cu`` ((uint8 x − mean) · rdisp elementwise).
+* ``gather_rows``            — reference: ``ocl/fullbatch_loader.cl``
+  ``fill_minibatch_data_labels`` (minibatch gather from the on-device
+  dataset by shuffled indices).  TPU version: scalar-prefetched indices
+  drive the BlockSpec index_map, so each minibatch row is a direct
+  HBM→VMEM DMA — the dataset itself never streams through compute.
+
+All kernels run compiled on TPU and in interpreter mode elsewhere (tests run
+them on the CPU backend with ``interpret=True``; see tests/test_pallas.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def use_pallas_default(platform: Optional[str] = None) -> bool:
+    """Shared policy for every Pallas-vs-XLA switch in the package
+    (Dropout, blockwise_attention, FullBatchLoader): compiled kernels
+    engage only when the target platform is TPU.  Inside jit the committed
+    device is unknowable at trace time, so callers that allow non-default
+    placement must pass ``platform`` (FullBatchLoader does) or their
+    explicit ``use_pallas`` flag."""
+    return (platform or jax.default_backend()) == "tpu"
+
+
+def _interpret(interpret: Optional[bool]) -> bool:
+    if interpret is None:
+        return not use_pallas_default()
+    return interpret
+
+
+def _round_up(n: int, m: int) -> int:
+    return -(-n // m) * m
+
+
+# ---------------------------------------------------------------------------
+# Flash attention (forward kernel + recompute backward)
+# ---------------------------------------------------------------------------
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+                      scale, causal, block_q, block_k, tq, tk, n_kb):
+    """Grid = (BH, n_q_blocks, n_k_blocks); the k dimension is minor, so
+    VMEM holds only one (block_q, D) Q tile and one (block_k, D) K/V tile at
+    a time — the m/l/acc online-softmax state lives in scratch that persists
+    across the sequentially-iterated k steps (long T streams from HBM
+    block-by-block instead of residing whole in VMEM)."""
+    qi, kj = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, -1e30)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    def _step():
+        q = q_ref[0].astype(jnp.float32)  # (block_q, D)
+        k_blk = k_ref[0].astype(jnp.float32)  # (block_k, D)
+        v_blk = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        k_pos = kj * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = k_pos < tk
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            mask = mask & (k_pos <= q_pos)
+        s = jnp.where(mask, s, -1e30)
+        m = m_ref[:]
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.where(mask, jnp.exp(s - m_new[:, None]), 0.0)
+        m_ref[:] = m_new
+        l_ref[:] = alpha * l_ref[:] + jnp.sum(p, axis=-1)
+        acc_ref[:] = acc_ref[:] * alpha[:, None] + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        # K blocks strictly after this Q block are fully masked — skip.
+        pl.when(kj * block_k <= qi * block_q + block_q - 1)(_step)
+    else:
+        _step()
+
+    @pl.when(kj == n_kb - 1)
+    def _finalize():
+        o_ref[0] = (acc_ref[:]
+                    / jnp.maximum(l_ref[:], 1e-30)[:, None]
+                    ).astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, *, causal, scale, block_q, block_k, interpret):
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    scale_ = scale if scale is not None else D ** -0.5
+    block_q = min(block_q, _round_up(Tq, 8))
+    block_k = min(block_k, _round_up(Tk, 8))
+    tq_p, tk_p = _round_up(Tq, block_q), _round_up(Tk, block_k)
+
+    # (B, T, H, D) -> (B*H, T, D); pad T axes to block multiples.
+    qm = jnp.pad(q.transpose(0, 2, 1, 3).reshape(B * H, Tq, D),
+                 ((0, 0), (0, tq_p - Tq), (0, 0)))
+    km = jnp.pad(k.transpose(0, 2, 1, 3).reshape(B * H, Tk, D),
+                 ((0, 0), (0, tk_p - Tk), (0, 0)))
+    vm = jnp.pad(v.transpose(0, 2, 1, 3).reshape(B * H, Tk, D),
+                 ((0, 0), (0, tk_p - Tk), (0, 0)))
+
+    n_kb = tk_p // block_k
+    kernel = functools.partial(
+        _flash_fwd_kernel, scale=scale_, causal=causal, block_q=block_q,
+        block_k=block_k, tq=Tq, tk=Tk, n_kb=n_kb)
+    out = pl.pallas_call(
+        kernel,
+        grid=(B * H, tq_p // block_q, n_kb),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, tq_p, D), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q,), jnp.float32),
+            pltpu.VMEM((block_q,), jnp.float32),
+            pltpu.VMEM((block_q, D), jnp.float32),
+        ],
+        interpret=_interpret(interpret),
+    )(qm, km, vm)
+    return out[:, :Tq].reshape(B, H, Tq, D).transpose(0, 2, 1, 3)
+
+
+def _attention_reference(q, k, v, causal, scale):
+    """jnp attention used for the recompute backward pass (XLA fuses and
+    differentiates it; the Pallas kernel stays forward-only)."""
+    D = q.shape[-1]
+    scale_ = scale if scale is not None else D ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale_
+    if causal:
+        Tq, Tk = q.shape[1], k.shape[1]
+        mask = jnp.arange(Tk)[None, :] <= jnp.arange(Tq)[:, None]
+        s = jnp.where(mask[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v).astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
+                    block_k=128, interpret=None):
+    """Blockwise-softmax attention, forward pass as one Pallas kernel.
+
+    q/k/v: (B, T, H, D) -> (B, Tq, H, D).  Backward differentiates a jnp
+    recompute (no stored attention matrix)."""
+    return _flash_fwd(q, k, v, causal=causal, scale=scale, block_q=block_q,
+                      block_k=block_k, interpret=interpret)
+
+
+def _flash_vjp_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    out = _flash_fwd(q, k, v, causal=causal, scale=scale, block_q=block_q,
+                     block_k=block_k, interpret=interpret)
+    return out, (q, k, v)
+
+
+def _flash_vjp_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _attention_reference(q_, k_, v_, causal, scale),
+        q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Fused dropout with in-kernel counter-based RNG
+# ---------------------------------------------------------------------------
+
+import numpy as np  # noqa: E402  (np scalars stay literals under tracing)
+
+_GOLDEN = np.uint32(0x9E3779B9)
+_MIX1 = np.uint32(0x85EBCA6B)
+_MIX2 = np.uint32(0xC2B2AE35)
+
+
+def _splitmix32(z):
+    z = (z + _GOLDEN).astype(jnp.uint32)
+    z = (z ^ (z >> 16)) * _MIX1
+    z = (z ^ (z >> 13)) * _MIX2
+    return z ^ (z >> 16)
+
+
+def _dropout_kernel(seed_ref, x_ref, o_ref, *, rate, block_rows, n_cols):
+    pid = pl.program_id(0)
+    r = jax.lax.broadcasted_iota(jnp.uint32, (block_rows, n_cols), 0)
+    c = jax.lax.broadcasted_iota(jnp.uint32, (block_rows, n_cols), 1)
+    lin = ((pid.astype(jnp.uint32) * np.uint32(block_rows) + r)
+           * np.uint32(n_cols) + c)
+    bits = _splitmix32(_splitmix32(lin ^ seed_ref[0, 0]))
+    # top 24 bits -> uniform in [0, 1); Mosaic lacks uint32->f32 casts, so
+    # bitcast the (always-positive) value through int32 first.
+    u = jax.lax.bitcast_convert_type(
+        bits >> 8, jnp.int32).astype(jnp.float32) * (1.0 / 16777216.0)
+    keep = (u >= rate).astype(jnp.float32) / (1.0 - rate)
+    o_ref[:] = (x_ref[:].astype(jnp.float32) * keep).astype(o_ref.dtype)
+
+
+def _dropout_apply(x, seed, rate, block_rows, interpret):
+    orig_shape = x.shape
+    flat = x.reshape(-1, orig_shape[-1]) if x.ndim > 1 else x.reshape(1, -1)
+    rows, cols = flat.shape
+    block_rows = min(block_rows, rows)
+    rows_p = _round_up(rows, block_rows)
+    flat = jnp.pad(flat, ((0, rows_p - rows), (0, 0)))
+    seed_arr = jnp.asarray(seed, jnp.uint32).reshape(1, 1)
+    kernel = functools.partial(_dropout_kernel, rate=float(rate),
+                               block_rows=block_rows, n_cols=cols)
+    out = pl.pallas_call(
+        kernel,
+        grid=(rows_p // block_rows,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((block_rows, cols), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_rows, cols), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((rows_p, cols), x.dtype),
+        interpret=_interpret(interpret),
+    )(seed_arr, flat)
+    return out[:rows].reshape(orig_shape)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def fused_dropout(x, seed, rate, block_rows=256, interpret=None):
+    """Dropout whose mask is a deterministic splitmix32 hash of
+    (seed, element index), generated inside the kernel.  The backward pass
+    re-runs the same kernel on the cotangent — the mask is never stored
+    (reference stored the random state per unit: ocl/random.cl)."""
+    return _dropout_apply(x, seed, rate, block_rows, interpret)
+
+
+def _dropout_vjp_fwd(x, seed, rate, block_rows, interpret):
+    return _dropout_apply(x, seed, rate, block_rows, interpret), seed
+
+
+def _dropout_vjp_bwd(rate, block_rows, interpret, seed, g):
+    # Same seed -> same mask -> d/dx (x * keep) = g * keep.
+    return _dropout_apply(g, seed, rate, block_rows, interpret), None
+
+
+fused_dropout.defvjp(_dropout_vjp_fwd, _dropout_vjp_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Mean/dispersion normalize
+# ---------------------------------------------------------------------------
+
+def _mean_disp_kernel(x_ref, mean_ref, rdisp_ref, o_ref):
+    o_ref[:] = ((x_ref[:].astype(jnp.float32) - mean_ref[:])
+                * rdisp_ref[:]).astype(o_ref.dtype)
+
+
+def mean_disp_normalize(x, mean, rdisp, *, block_rows=128, interpret=None,
+                        dtype=jnp.float32):
+    """(x - mean) * rdisp with x typically uint8; one VMEM-resident
+    elementwise kernel (reference: ocl/mean_disp_normalizer.cl)."""
+    orig_shape = x.shape
+    flat = x.reshape(orig_shape[0], -1)
+    if jnp.issubdtype(flat.dtype, jnp.unsignedinteger):
+        # Mosaic has no unsigned->float casts; widen outside (XLA fuses the
+        # widening into the producing gather/copy).
+        flat = flat.astype(jnp.int32)
+    rows, cols = flat.shape
+    mean_f = mean.reshape(1, -1).astype(jnp.float32)
+    rdisp_f = rdisp.reshape(1, -1).astype(jnp.float32)
+    block_rows = min(block_rows, rows)
+    rows_p = _round_up(rows, block_rows)
+    flat = jnp.pad(flat, ((0, rows_p - rows), (0, 0)))
+    out = pl.pallas_call(
+        _mean_disp_kernel,
+        grid=(rows_p // block_rows,),
+        in_specs=[
+            pl.BlockSpec((block_rows, cols), lambda i: (i, 0)),
+            pl.BlockSpec((1, cols), lambda i: (0, 0)),
+            pl.BlockSpec((1, cols), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_rows, cols), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((rows_p, cols), dtype),
+        interpret=_interpret(interpret),
+    )(flat, mean_f, rdisp_f)
+    return out[:rows].reshape(orig_shape)
+
+
+# ---------------------------------------------------------------------------
+# Minibatch gather via scalar-prefetched indices
+# ---------------------------------------------------------------------------
+
+def _gather_kernel(idx_ref, data_ref, out_ref, sem):
+    i = pl.program_id(0)
+    dma = pltpu.make_async_copy(data_ref.at[idx_ref[i]], out_ref.at[i], sem)
+    dma.start()
+    dma.wait()
+
+
+def pack_rows(data):
+    """Pre-pack ``data`` (N, ...) into the (N, 8, f_p/8) tiled row layout
+    ``gather_rows_packed`` DMAs from (features padded to a multiple of
+    8·128 — Mosaic rejects single-row slices of a (8,128)-tiled 2-D memref,
+    so the per-index DMA must slice only an untiled leading dim).  Pack once
+    at dataset-upload time; gathering from the packed form then never
+    touches the full dataset again (see FullBatchLoader._upload)."""
+    orig_shape = data.shape
+    flat = data.reshape(orig_shape[0], -1)
+    n, f = flat.shape
+    f_p = _round_up(f, 8 * 128)
+    packed = jnp.pad(flat, ((0, 0), (0, f_p - f))).reshape(n, 8, f_p // 8)
+    return packed, f, orig_shape[1:]
+
+
+def unpack_rows(packed, f, sample_shape):
+    m = packed.shape[0]
+    return packed.reshape(m, -1)[:, :f].reshape((m,) + tuple(sample_shape))
+
+
+def gather_rows_packed(packed, idx, *, interpret=None):
+    """Gather pre-packed rows (see ``pack_rows``) as one direct HBM→HBM DMA
+    per scalar-prefetched index."""
+    m = idx.shape[0]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(m,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.HBM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.HBM),
+        scratch_shapes=[pltpu.SemaphoreType.DMA(())],
+    )
+    return pl.pallas_call(
+        _gather_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((m,) + packed.shape[1:],
+                                       packed.dtype),
+        interpret=_interpret(interpret),
+        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+    )(jnp.asarray(idx, jnp.int32), packed)
+
+
+def gather_rows(data, idx, *, interpret=None):
+    """``data[idx]`` via per-index HBM DMA (reference:
+    ocl/fullbatch_loader.cl fill_minibatch_data_labels).  Convenience
+    one-shot form — packs on every call; steady-state callers should
+    ``pack_rows`` once and use ``gather_rows_packed``."""
+    packed, f, sample_shape = pack_rows(data)
+    out = gather_rows_packed(packed, idx, interpret=interpret)
+    return unpack_rows(out, f, sample_shape)

@@ -51,9 +51,21 @@ def _finalize(l, o):
 
 
 def blockwise_attention(q, k, v, *, block_size: int = 512,
-                        causal: bool = False, scale: Optional[float] = None):
+                        causal: bool = False, scale: Optional[float] = None,
+                        use_flash: Optional[bool] = None):
     """Memory-efficient attention on one device: scan over K/V blocks with
-    online softmax. q/k/v: (B, T, H, D) -> (B, T, H, D)."""
+    online softmax. q/k/v: (B, T, H, D) -> (B, T, H, D).
+
+    On TPU this delegates to the hand-written Pallas kernel
+    (ops/pallas_kernels.flash_attention); the jnp scan below is the
+    numerical reference and the portable path."""
+    if use_flash is None:
+        from ..ops.pallas_kernels import use_pallas_default
+        use_flash = use_pallas_default()
+    if use_flash:
+        from ..ops.pallas_kernels import flash_attention
+        return flash_attention(q, k, v, causal, scale,
+                               min(128, block_size), min(128, block_size))
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
     scale = scale if scale is not None else D ** -0.5

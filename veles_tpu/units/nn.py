@@ -250,15 +250,24 @@ class Dropout(Unit):
 
     stochastic = True
 
-    def __init__(self, dropout_ratio=0.5, name=None, inputs=("@input",)):
+    def __init__(self, dropout_ratio=0.5, name=None, inputs=("@input",),
+                 use_pallas=None):
         super().__init__(name, inputs)
         self.ratio = float(dropout_ratio)
+        self.use_pallas = use_pallas
 
     def apply(self, params, state, xs, ctx):
         x = xs[0]
         if not ctx.train or self.ratio <= 0.0:
             return x, state
         key = ctx.unit_key(self.name)
+        use_pallas = (ops.use_pallas_default()
+                      if self.use_pallas is None else self.use_pallas)
+        if use_pallas:
+            # In-kernel counter-based RNG; mask regenerated in backward
+            # (ops/pallas_kernels.py, parity: ocl/random.cl).
+            seed = jax.random.bits(key, dtype=jnp.uint32)
+            return ops.fused_dropout(x, seed, self.ratio), state
         keep = 1.0 - self.ratio
         mask = jax.random.bernoulli(key, keep, x.shape)
         return jnp.where(mask, x / keep, 0.0).astype(x.dtype), state
