@@ -1,0 +1,57 @@
+"""Device benchmark / compute-power rating tests (reference protocol:
+veles/accelerated_units.py:706-858, veles/backends.py:672-731)."""
+
+import json
+import os
+
+from veles_tpu.runtime.benchmark import (DeviceBenchmark, benchmark_device,
+                                         load_device_infos, save_device_info)
+
+
+def test_device_benchmark_runs_and_rates():
+    info = DeviceBenchmark(sizes=(128,), dtypes=("float32",), reps=1).run()
+    assert info["computing_power"] > 0
+    assert info["results"][0]["tflops"] > 0
+    assert info["platform"] == "cpu"  # conftest forces CPU
+
+
+def test_device_info_persistence(tmp_path):
+    d = str(tmp_path)
+    info = {"device_kind": "fake", "platform": "cpu", "results": [],
+            "computing_power": 42.0}
+    path = save_device_info(info, d)
+    assert os.path.exists(path)
+    assert load_device_infos(d)["fake"]["computing_power"] == 42.0
+    # second save merges, doesn't clobber
+    save_device_info({"device_kind": "other", "platform": "cpu",
+                      "results": [], "computing_power": 1.0}, d)
+    infos = load_device_infos(d)
+    assert set(infos) == {"fake", "other"}
+    with open(path) as f:
+        assert json.load(f) == infos
+
+
+def test_benchmark_device_cached(tmp_path, monkeypatch):
+    d = str(tmp_path)
+    calls = []
+
+    class FakeBench(DeviceBenchmark):
+        def run(self):
+            calls.append(1)
+            return super().run()
+
+    import veles_tpu.runtime.benchmark as mod
+    monkeypatch.setattr(mod, "DeviceBenchmark", FakeBench)
+    a = benchmark_device(d, sizes=(128,), dtypes=("float32",), reps=1)
+    b = benchmark_device(d, sizes=(128,), dtypes=("float32",), reps=1)
+    assert len(calls) == 1  # second hit came from the device-info DB
+    assert a["device_kind"] == b["device_kind"]
+
+
+def test_computing_power_prefers_largest_f32():
+    entries = [
+        {"size": 1024, "dtype": "float32", "seconds": 0.5, "tflops": 1},
+        {"size": 4096, "dtype": "float32", "seconds": 0.25, "tflops": 2},
+        {"size": 4096, "dtype": "bfloat16", "seconds": 0.01, "tflops": 3},
+    ]
+    assert DeviceBenchmark.computing_power(entries) == 1000.0 / 0.25

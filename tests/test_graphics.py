@@ -1,0 +1,90 @@
+"""Live graphics channel tests — publisher/subscriber on loopback, the way
+the reference tested its transports in one process
+(veles/tests/test_network.py:111-137)."""
+
+import os
+import time
+
+import numpy as np
+
+from veles_tpu.graphics import (GraphicsClient, GraphicsServer, recv_frame,
+                                subscribe)
+from veles_tpu.plotting import MetricsRecorder
+
+
+def _wait_subs(server, n, timeout=5.0):
+    t0 = time.time()
+    while server.n_subscribers < n:
+        if time.time() - t0 > timeout:
+            raise TimeoutError("subscriber never registered")
+        time.sleep(0.01)
+
+
+def test_publish_roundtrip():
+    server = GraphicsServer()
+    try:
+        sock = subscribe(server.endpoint)
+        _wait_subs(server, 1)
+        payload = {"kind": "metrics", "step": 3,
+                   "values": {"loss": 0.5, "err": 7.0}}
+        server.publish(payload)
+        assert recv_frame(sock) == payload
+        sock.close()
+    finally:
+        server.close()
+
+
+def test_dead_subscriber_dropped_without_blocking():
+    server = GraphicsServer()
+    try:
+        sock = subscribe(server.endpoint)
+        _wait_subs(server, 1)
+        sock.close()
+        # Publishing into the closed socket must drop it, not raise/block.
+        for i in range(20):
+            server.publish({"kind": "metrics", "step": i,
+                            "values": {"x": float(i),
+                                       "pad": list(range(2000))}})
+        assert server.n_subscribers == 0
+    finally:
+        server.close()
+
+
+def test_graphics_client_renders(tmp_path):
+    server = GraphicsServer()
+    try:
+        client = GraphicsClient(server.endpoint, str(tmp_path))
+        import threading
+        got = []
+        th = threading.Thread(target=lambda: got.append(client.run(3)))
+        th.start()
+        _wait_subs(server, 1)
+        server.publish({"kind": "metrics", "step": 0,
+                        "values": {"loss": 1.0}})
+        server.publish({"kind": "metrics", "step": 1,
+                        "values": {"loss": 0.5}})
+        server.publish({"kind": "image", "name": "weights",
+                        "data": np.eye(4)})
+        th.join(10)
+        assert got == [3]
+        assert client.series["loss"] == [1.0, 0.5]
+        assert os.path.exists(tmp_path / "metrics.png")
+        assert os.path.exists(tmp_path / "weights.png")
+    finally:
+        server.close()
+
+
+def test_metrics_recorder_publishes_live(tmp_path):
+    server = GraphicsServer()
+    try:
+        sock = subscribe(server.endpoint)
+        _wait_subs(server, 1)
+        rec = MetricsRecorder("m", str(tmp_path), graphics=server)
+        rec.record(0, loss=2.0, not_a_number="skip")
+        frame = recv_frame(sock)
+        assert frame == {"kind": "metrics", "step": 0,
+                         "values": {"loss": 2.0}}
+        rec.close()
+        sock.close()
+    finally:
+        server.close()

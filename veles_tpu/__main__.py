@@ -188,6 +188,13 @@ def _forge_main(argv) -> int:
 def main(argv=None) -> int:
     if argv is None:
         argv = sys.argv[1:]
+    if argv and argv[0] == "benchmark":
+        # reference: DeviceBenchmark / device-info DB
+        # (veles/accelerated_units.py:706-824, veles/backends.py:672-731)
+        from .runtime.benchmark import benchmark_device
+        info = benchmark_device(refresh="--refresh" in argv)
+        print(json.dumps(info, indent=1))
+        return 0
     if argv and argv[0] == "forge":
         setup_logging()
         return _forge_main(argv[1:])

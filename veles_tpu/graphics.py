@@ -1,0 +1,217 @@
+"""Live graphics channel: in-process publisher → separate renderer process.
+
+Reference parity: GraphicsServer broadcast plot payloads on a ZMQ PUB
+socket (veles/graphics_server.py:65,153 — plotter units pickle themselves,
+veles/plotter.py:147-158) and a forked GraphicsClient process rendered them
+with matplotlib (veles/graphics_client.py:84).
+
+TPU redesign: the payloads are tiny host-side scalars/arrays (metrics,
+confusion matrices, weight tiles) published *outside* the jit step — the
+device pipeline is never synced for plotting.  Transport is a plain TCP
+fan-out socket (stdlib; no zmq dependency): length-prefixed pickle frames,
+PUB semantics — slow or dead subscribers are dropped, never block training
+(the reference used ZMQ PUB for exactly this property).  Pickle crosses a
+trust boundary only on localhost, same as the reference's design.
+
+Run a renderer:  ``python -m veles_tpu.graphics <endpoint> --out plots/``
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+from typing import Dict, List, Optional
+
+from .logger import Logger
+
+_MAGIC = b"VTPL"  # frame: magic + u32 length + pickle
+
+
+def _send_frame(sock: socket.socket, payload: bytes) -> None:
+    sock.sendall(_MAGIC + struct.pack("<I", len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+def recv_frame(sock: socket.socket):
+    head = _recv_exact(sock, 8)
+    if head is None or head[:4] != _MAGIC:
+        return None
+    (length,) = struct.unpack("<I", head[4:])
+    body = _recv_exact(sock, length)
+    if body is None:
+        return None
+    return pickle.loads(body)
+
+
+class GraphicsServer(Logger):
+    """Fan-out publisher of plot payloads (reference:
+    veles/graphics_server.py:65 ZMQ PUB endpoints)."""
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1"):
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(8)
+        self.endpoint = "tcp://%s:%d" % self._listener.getsockname()[:2]
+        self._subs: List[socket.socket] = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        daemon=True)
+        self._thread.start()
+        self.info("graphics server at %s", self.endpoint)
+
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                self._listener.settimeout(0.2)
+                conn, _ = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            conn.settimeout(1.0)
+            with self._lock:
+                self._subs.append(conn)
+
+    def publish(self, payload: Dict) -> None:
+        """Broadcast one payload; drop subscribers that can't keep up
+        (PUB semantics — plotting never blocks training)."""
+        data = pickle.dumps(payload, protocol=4)
+        with self._lock:
+            dead = []
+            for s in self._subs:
+                try:
+                    _send_frame(s, data)
+                except OSError:
+                    dead.append(s)
+            for s in dead:
+                self._subs.remove(s)
+                try:
+                    s.close()
+                except OSError:
+                    pass
+
+    @property
+    def n_subscribers(self) -> int:
+        with self._lock:
+            return len(self._subs)
+
+    def close(self):
+        self._stop.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._lock:
+            for s in self._subs:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+            self._subs.clear()
+
+
+def subscribe(endpoint: str) -> socket.socket:
+    """Connect a subscriber socket to ``tcp://host:port``."""
+    assert endpoint.startswith("tcp://"), endpoint
+    host, _, port = endpoint[6:].partition(":")
+    sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    sock.connect((host, int(port)))
+    return sock
+
+
+class GraphicsClient(Logger):
+    """Subscriber that renders payloads with matplotlib-Agg (reference:
+    veles/graphics_client.py:84 — separate process so rendering never
+    steals cycles from the training loop)."""
+
+    def __init__(self, endpoint: str, out_dir: str = "plots"):
+        self.endpoint = endpoint
+        self.out_dir = out_dir
+        self.series: Dict[str, List[float]] = {}
+
+    def run(self, max_payloads: Optional[int] = None) -> int:
+        import os
+        os.makedirs(self.out_dir, exist_ok=True)
+        sock = subscribe(self.endpoint)
+        n = 0
+        while max_payloads is None or n < max_payloads:
+            payload = recv_frame(sock)
+            if payload is None:
+                break
+            self.handle(payload)
+            n += 1
+        sock.close()
+        return n
+
+    def handle(self, payload: Dict) -> None:
+        kind = payload.get("kind", "metrics")
+        if kind == "metrics":
+            for key, val in payload.get("values", {}).items():
+                self.series.setdefault(key, []).append(float(val))
+            self._render_series()
+        elif kind == "image":
+            self._render_image(payload)
+        elif kind == "stop":
+            raise SystemExit(0)
+
+    def _render_series(self):
+        import os
+        try:
+            import matplotlib
+            matplotlib.use("Agg")
+            import matplotlib.pyplot as plt
+        except ImportError:  # render-less environments still drain frames
+            return
+        fig, ax = plt.subplots(figsize=(6, 3.5))
+        for key, vals in self.series.items():
+            ax.plot(vals, label=key)
+        ax.legend(loc="best", fontsize=8)
+        ax.set_xlabel("update")
+        fig.tight_layout()
+        fig.savefig(os.path.join(self.out_dir, "metrics.png"))
+        plt.close(fig)
+
+    def _render_image(self, payload: Dict):
+        import os
+        try:
+            import matplotlib
+            matplotlib.use("Agg")
+            import matplotlib.pyplot as plt
+        except ImportError:
+            return
+        import numpy as np
+        arr = np.asarray(payload["data"])
+        fig, ax = plt.subplots()
+        ax.imshow(arr, cmap=payload.get("cmap", "viridis"))
+        ax.set_title(payload.get("name", "image"))
+        fig.savefig(os.path.join(
+            self.out_dir, payload.get("name", "image") + ".png"))
+        plt.close(fig)
+
+
+def main(argv=None) -> int:
+    import argparse
+    p = argparse.ArgumentParser(prog="veles_tpu.graphics")
+    p.add_argument("endpoint", help="tcp://host:port from GraphicsServer")
+    p.add_argument("--out", default="plots")
+    args = p.parse_args(argv)
+    client = GraphicsClient(args.endpoint, args.out)
+    client.run()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

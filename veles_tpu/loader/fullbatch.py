@@ -46,13 +46,29 @@ class FullBatchLoader(ArrayLoader):
         try:
             self._upload()
             self.on_device = True
+            return
         except (RuntimeError, jax.errors.JaxRuntimeError) as e:
-            # OOM fallback (reference: veles/loader/fullbatch.py:164-242).
-            self.warning("device upload failed (%s); host-side gather", e)
             self._dev_data.clear()
-            self.on_device = False
+            if self._use_pallas_gather is False:
+                err = e
+            else:
+                # The packed-gather layout pads rows; if that padding is
+                # what overflowed HBM, retry unpacked before giving up
+                # device residency entirely.
+                self.warning("device upload failed (%s); retrying without "
+                             "packed gather", e)
+                try:
+                    self._upload(allow_pallas=False)
+                    self.on_device = True
+                    return
+                except (RuntimeError, jax.errors.JaxRuntimeError) as e2:
+                    err = e2
+        # OOM fallback (reference: veles/loader/fullbatch.py:164-242).
+        self.warning("device upload failed (%s); host-side gather", err)
+        self._dev_data.clear()
+        self.on_device = False
 
-    def _upload(self):
+    def _upload(self, allow_pallas: bool = True):
         put = (lambda x: jax.device_put(x, self._device)) \
             if self._device is not None else jax.device_put
         for klass in (TEST, VALID, TRAIN):
@@ -68,24 +84,30 @@ class FullBatchLoader(ArrayLoader):
         # The Pallas DMA-gather kernel is TPU-only; honor an explicit
         # non-TPU device placement (shared policy:
         # ops/pallas_kernels.use_pallas_default).
-        from ..ops.pallas_kernels import use_pallas_default
+        from ..ops import use_pallas_default
         platform = (self._device.platform if self._device is not None
                     else None)
-        use_pallas = (use_pallas_default(platform)
-                      if self._use_pallas_gather is None
-                      else self._use_pallas_gather)
+        use_pallas = allow_pallas and (
+            use_pallas_default(platform)
+            if self._use_pallas_gather is None
+            else self._use_pallas_gather)
         if use_pallas:
             # Per-index HBM→HBM DMA kernel (parity:
             # ocl/fullbatch_loader.cl fill_minibatch_data_labels).  Big
             # arrays are packed into the kernel's tiled row layout ONCE
-            # here; small rows (labels) would pad to a full 8x128 tile, so
-            # they stay on jnp.take.
+            # here.  The layout pads features to a multiple of 8·128, so
+            # only arrays where that padding is cheap (<12.5% HBM overhead)
+            # and the row is big enough to benefit from DMA are packed;
+            # everything else (labels, small/awkward rows) stays on
+            # jnp.take.
             from ..ops.pallas_kernels import (pack_rows, gather_rows_packed,
                                               unpack_rows)
             packed_meta = {}
             for klass, entry in self._dev_data.items():
                 for key, arr in entry.items():
-                    if np.prod(arr.shape[1:]) >= 1024:
+                    f = int(np.prod(arr.shape[1:]))
+                    f_pad = -(-f // 1024) * 1024
+                    if f >= 4096 and f_pad <= f * 1.125:
                         packed, f, sshape = pack_rows(arr)
                         entry[key] = packed
                         packed_meta[key] = (f, tuple(sshape))

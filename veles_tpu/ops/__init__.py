@@ -16,8 +16,21 @@ from .normalize import mean_disp_normalize
 from .reduce import matrix_reduce
 from .recurrent import gru_scan, lstm_scan, rnn_scan
 
-_PALLAS_EXPORTS = ("flash_attention", "fused_dropout", "gather_rows",
-                   "use_pallas_default")
+def use_pallas_default(platform=None) -> bool:
+    """Shared policy for every Pallas-vs-XLA switch in the package
+    (Dropout, blockwise_attention, FullBatchLoader): compiled kernels
+    engage only when the target platform is TPU.  Inside jit the committed
+    device is unknowable at trace time, so callers that allow non-default
+    placement must pass ``platform`` (FullBatchLoader does) or their
+    explicit ``use_pallas`` flag.  Lives here — NOT in pallas_kernels — so
+    evaluating the policy never imports the Mosaic machinery."""
+    if platform is None:
+        import jax
+        platform = jax.default_backend()
+    return platform == "tpu"
+
+
+_PALLAS_EXPORTS = ("flash_attention", "fused_dropout", "gather_rows")
 
 
 def __getattr__(name):

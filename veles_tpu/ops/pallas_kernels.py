@@ -36,14 +36,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
-def use_pallas_default(platform: Optional[str] = None) -> bool:
-    """Shared policy for every Pallas-vs-XLA switch in the package
-    (Dropout, blockwise_attention, FullBatchLoader): compiled kernels
-    engage only when the target platform is TPU.  Inside jit the committed
-    device is unknowable at trace time, so callers that allow non-default
-    placement must pass ``platform`` (FullBatchLoader does) or their
-    explicit ``use_pallas`` flag."""
-    return (platform or jax.default_backend()) == "tpu"
+from . import use_pallas_default  # policy lives pallas-free in ops/__init__
 
 
 def _interpret(interpret: Optional[bool]) -> bool:
@@ -153,28 +146,15 @@ def _flash_fwd(q, k, v, *, causal, scale, block_q, block_k, interpret):
     return out[:, :Tq].reshape(B, H, Tq, D).transpose(0, 2, 1, 3)
 
 
-def _attention_reference(q, k, v, causal, scale):
-    """jnp attention used for the recompute backward pass (XLA fuses and
-    differentiates it; the Pallas kernel stays forward-only)."""
-    D = q.shape[-1]
-    scale_ = scale if scale is not None else D ** -0.5
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
-                   preferred_element_type=jnp.float32) * scale_
-    if causal:
-        Tq, Tk = q.shape[1], k.shape[1]
-        mask = jnp.arange(Tk)[None, :] <= jnp.arange(Tq)[:, None]
-        s = jnp.where(mask[None, None], s, -1e30)
-    p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", p, v).astype(q.dtype)
-
-
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
                     block_k=128, interpret=None):
     """Blockwise-softmax attention, forward pass as one Pallas kernel.
 
-    q/k/v: (B, T, H, D) -> (B, Tq, H, D).  Backward differentiates a jnp
-    recompute (no stored attention matrix)."""
+    q/k/v: (B, T, H, D) -> (B, Tq, H, D).  Backward differentiates the
+    rematerialized jnp blockwise scan (ring_attention.blockwise_attention
+    with use_flash=False) — backward memory stays one K/V block, never the
+    full attention matrix."""
     return _flash_fwd(q, k, v, causal=causal, scale=scale, block_q=block_q,
                       block_k=block_k, interpret=interpret)
 
@@ -186,9 +166,12 @@ def _flash_vjp_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
 
 
 def _flash_vjp_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    from ..parallel.ring_attention import blockwise_attention
     q, k, v = res
     _, vjp = jax.vjp(
-        lambda q_, k_, v_: _attention_reference(q_, k_, v_, causal, scale),
+        lambda q_, k_, v_: blockwise_attention(
+            q_, k_, v_, block_size=max(block_k, 128), causal=causal,
+            scale=scale, use_flash=False),
         q, k, v)
     return vjp(g)
 
@@ -283,10 +266,11 @@ def _mean_disp_kernel(x_ref, mean_ref, rdisp_ref, o_ref):
                 * rdisp_ref[:]).astype(o_ref.dtype)
 
 
-def mean_disp_normalize(x, mean, rdisp, *, block_rows=128, interpret=None,
-                        dtype=jnp.float32):
-    """(x - mean) * rdisp with x typically uint8; one VMEM-resident
-    elementwise kernel (reference: ocl/mean_disp_normalizer.cl)."""
+def mean_disp_normalize(x, mean, rdisp, *, block_rows=128, block_cols=8192,
+                        interpret=None, dtype=jnp.float32):
+    """(x - mean) * rdisp with x typically uint8; tiled elementwise kernel
+    (reference: ocl/mean_disp_normalizer.cl).  Columns are tiled too so
+    image-scale feature counts (e.g. 224·224·3) never exceed VMEM."""
     orig_shape = x.shape
     flat = x.reshape(orig_shape[0], -1)
     if jnp.issubdtype(flat.dtype, jnp.unsignedinteger):
@@ -297,21 +281,26 @@ def mean_disp_normalize(x, mean, rdisp, *, block_rows=128, interpret=None,
     mean_f = mean.reshape(1, -1).astype(jnp.float32)
     rdisp_f = rdisp.reshape(1, -1).astype(jnp.float32)
     block_rows = min(block_rows, rows)
+    block_cols = min(block_cols, _round_up(cols, 128))
     rows_p = _round_up(rows, block_rows)
-    flat = jnp.pad(flat, ((0, rows_p - rows), (0, 0)))
+    cols_p = _round_up(cols, block_cols)
+    flat = jnp.pad(flat, ((0, rows_p - rows), (0, cols_p - cols)))
+    mean_f = jnp.pad(mean_f, ((0, 0), (0, cols_p - cols)))
+    rdisp_f = jnp.pad(rdisp_f, ((0, 0), (0, cols_p - cols)))
     out = pl.pallas_call(
         _mean_disp_kernel,
-        grid=(rows_p // block_rows,),
+        grid=(rows_p // block_rows, cols_p // block_cols),
         in_specs=[
-            pl.BlockSpec((block_rows, cols), lambda i: (i, 0)),
-            pl.BlockSpec((1, cols), lambda i: (0, 0)),
-            pl.BlockSpec((1, cols), lambda i: (0, 0)),
+            pl.BlockSpec((block_rows, block_cols), lambda i, j: (i, j)),
+            pl.BlockSpec((1, block_cols), lambda i, j: (0, j)),
+            pl.BlockSpec((1, block_cols), lambda i, j: (0, j)),
         ],
-        out_specs=pl.BlockSpec((block_rows, cols), lambda i: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((rows_p, cols), dtype),
+        out_specs=pl.BlockSpec((block_rows, block_cols),
+                               lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((rows_p, cols_p), dtype),
         interpret=_interpret(interpret),
     )(flat, mean_f, rdisp_f)
-    return out[:rows].reshape(orig_shape)
+    return out[:rows, :cols].reshape(orig_shape)
 
 
 # ---------------------------------------------------------------------------

@@ -60,7 +60,7 @@ def blockwise_attention(q, k, v, *, block_size: int = 512,
     (ops/pallas_kernels.flash_attention); the jnp scan below is the
     numerical reference and the portable path."""
     if use_flash is None:
-        from ..ops.pallas_kernels import use_pallas_default
+        from ..ops import use_pallas_default
         use_flash = use_pallas_default()
     if use_flash:
         from ..ops.pallas_kernels import flash_attention
@@ -79,6 +79,13 @@ def blockwise_attention(q, k, v, *, block_size: int = 512,
     vb = v.reshape(B, n_blocks, block_size, H, D)
     q_idx = jnp.arange(Tq)
 
+    # checkpoint: the scan otherwise saves each block's (B,H,Tq,block)
+    # probability matrix for the backward pass — in total the full Tq×Tk
+    # attention matrix, defeating the point. Rematerializing the block fold
+    # keeps backward memory at one block.
+    folded = jax.checkpoint(
+        functools.partial(_attn_block, scale=scale))
+
     def body(carry, blk):
         m, l, o = carry
         k_blk, v_blk, blk_i = blk
@@ -87,8 +94,7 @@ def blockwise_attention(q, k, v, *, block_size: int = 512,
         if causal:
             mask = mask & (k_idx[None, None, None, :]
                            <= q_idx[None, None, :, None])
-        m, l, o = _attn_block(q, k_blk, v_blk, m, l, o,
-                              scale=scale, mask=mask)
+        m, l, o = folded(q, k_blk, v_blk, m, l, o, mask=mask)
         return (m, l, o), None
 
     init = (jnp.full((B, H, Tq), -jnp.inf, jnp.float32),

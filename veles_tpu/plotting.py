@@ -45,10 +45,15 @@ def sparkline(values: Sequence[float], width: int = 40) -> str:
 class MetricsRecorder(Logger):
     """Accumulating series recorder (reference: AccumulatingPlotter)."""
 
-    def __init__(self, name: str = "metrics", out_dir: Optional[str] = None):
+    def __init__(self, name: str = "metrics", out_dir: Optional[str] = None,
+                 graphics=None):
         self.name = name
         self.out_dir = out_dir
         self.series: Dict[str, List[float]] = {}
+        # Optional live channel (graphics.GraphicsServer): every record()
+        # is also broadcast to subscribed renderer processes (reference:
+        # plotters pickled onto the ZMQ PUB socket, veles/plotter.py:147).
+        self.graphics = graphics
         self._jsonl = None
         if out_dir:
             os.makedirs(out_dir, exist_ok=True)
@@ -66,6 +71,10 @@ class MetricsRecorder(Logger):
         if self._jsonl is not None:
             self._jsonl.write(json.dumps(rec) + "\n")
             self._jsonl.flush()
+        if self.graphics is not None:
+            self.graphics.publish(
+                {"kind": "metrics", "step": step,
+                 "values": {k: v for k, v in rec.items() if k != "step"}})
 
     def summary(self, width: int = 40) -> str:
         """Terminal rendering of all series."""

@@ -1,0 +1,139 @@
+"""Device benchmark & compute-power rating.
+
+Reference parity: the gemm DeviceBenchmark unit
+(veles/accelerated_units.py:706-824) served two roles — (a) OpenCL
+block-size autotuning persisted to ``devices/device_infos.json``
+(veles/backends.py:672-731), (b) a slave ``computing_power`` rating
+(1000/gemm-time, veles/accelerated_units.py:843-858) used by the master for
+load balancing (veles/client.py:308-313).
+
+TPU redesign: XLA owns tiling, so (a) becomes a *measurement* sweep —
+gemm wall time / achieved TFLOPS per (size, dtype), persisted per device
+kind (the analog of the device-info DB).  (b) survives as the same scalar
+rating so higher layers (ensemble/GA job farming) can weight hosts by
+throughput.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+from ..config import root
+from ..logger import Logger
+
+# Reference benchmarked one size=3001 gemm (veles/backends.py:695: dtype
+# sweep at size 3001); we sweep MXU-aligned sizes instead.
+DEFAULT_SIZES = (1024, 2048, 4096)
+DEFAULT_DTYPES = ("float32", "bfloat16")
+
+
+def _gemm_seconds(n: int, dtype: str, reps: int) -> float:
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((n, n)),
+                    jnp.dtype(dtype))
+
+    @jax.jit
+    def gemm_chain(a, b, k):
+        # Chain k dependent gemms so per-call dispatch latency amortizes;
+        # the final scalar read forces a full queue drain
+        # (block_until_ready alone is unreliable over the axon tunnel —
+        # see bench.py).
+        def body(_, acc):
+            return acc @ b
+        out = jax.lax.fori_loop(0, k, body, a)
+        return jnp.sum(out[0, :1])
+
+    chain = 128  # long chain amortizes dispatch/tunnel round-trip latency
+    float(gemm_chain(x, x, chain))  # compile + warm
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        float(gemm_chain(x, x, chain))
+        best = min(best, (time.perf_counter() - t0) / chain)
+    return best
+
+
+class DeviceBenchmark(Logger):
+    """Measure gemm throughput on the current default device."""
+
+    def __init__(self, sizes: Sequence[int] = DEFAULT_SIZES,
+                 dtypes: Sequence[str] = DEFAULT_DTYPES, reps: int = 3):
+        self.sizes = tuple(sizes)
+        self.dtypes = tuple(dtypes)
+        self.reps = reps
+
+    def run(self) -> Dict:
+        import jax
+        dev = jax.devices()[0]
+        entries = []
+        for dtype in self.dtypes:
+            for n in self.sizes:
+                secs = _gemm_seconds(n, dtype, self.reps)
+                tflops = 2.0 * n ** 3 / secs / 1e12
+                entries.append({"size": n, "dtype": dtype,
+                                "seconds": secs, "tflops": tflops})
+                self.info("gemm %dx%d %s: %.3f ms, %.2f TFLOPS",
+                          n, n, dtype, secs * 1e3, tflops)
+        info = {
+            "device_kind": dev.device_kind,
+            "platform": dev.platform,
+            "results": entries,
+            "computing_power": self.computing_power(entries),
+        }
+        return info
+
+    @staticmethod
+    def computing_power(entries) -> float:
+        """Reference rating: 1000 / gemm-time on the largest f32-equivalent
+        problem (veles/accelerated_units.py:853-858: 1000/time units)."""
+        best = max((e for e in entries), key=lambda e: e["size"] * (
+            2 if e["dtype"] == "float32" else 1))
+        return 1000.0 / best["seconds"]
+
+
+def device_info_path(cache_dir: Optional[str] = None) -> str:
+    d = cache_dir or root.common.cache_dir
+    return os.path.join(d, "device_infos.json")
+
+
+def load_device_infos(cache_dir: Optional[str] = None) -> Dict:
+    path = device_info_path(cache_dir)
+    if os.path.exists(path):
+        with open(path) as f:
+            return json.load(f)
+    return {}
+
+
+def save_device_info(info: Dict, cache_dir: Optional[str] = None) -> str:
+    """Persist per device kind — the analog of the reference's
+    devices/device_infos.json block-size DB."""
+    path = device_info_path(cache_dir)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    infos = load_device_infos(cache_dir)
+    infos[info["device_kind"]] = info
+    with open(path, "w") as f:
+        json.dump(infos, f, indent=1, sort_keys=True)
+    return path
+
+
+def benchmark_device(cache_dir: Optional[str] = None, refresh: bool = False,
+                     **kw) -> Dict:
+    """Cached rating lookup (reference re-measured every 120 s on slaves;
+    device kind is stable per process here, so cache on disk keyed by kind
+    and refresh on demand)."""
+    import jax
+    kind = jax.devices()[0].device_kind
+    if not refresh:
+        cached = load_device_infos(cache_dir).get(kind)
+        if cached:
+            return cached
+    info = DeviceBenchmark(**kw).run()
+    save_device_info(info, cache_dir)
+    return info
