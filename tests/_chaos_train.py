@@ -1,0 +1,92 @@
+"""Training worker for the chaos test (tests/test_chaos.py): a standalone
+process that trains a deterministic workflow, snapshotting every epoch.
+The parent SIGKILLs it mid-run and relaunches with --resume; determinism of
+(loader order, PRNG streams, decision state) across the kill is the
+assertion.  Reference analog: slave death + master re-serving from owned
+state (veles/server.py:315-338); in SPMD the recovery unit is the process,
+so death -> checkpoint-restart (SURVEY.md §5.3)."""
+
+import argparse
+import glob
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import veles_tpu as vt  # noqa: E402
+from veles_tpu.loader.base import TRAIN, VALID  # noqa: E402
+from veles_tpu.units import nn as U  # noqa: E402
+from veles_tpu.units.workflow import Workflow  # noqa: E402
+
+
+def make_trainer(workdir, max_epochs, slow):
+    rng = np.random.default_rng(99)
+    n, f, c = 512, 32, 4
+    centers = rng.standard_normal((c, f)) * 3
+    X = np.concatenate([centers[i] + rng.standard_normal((n // c, f))
+                        for i in range(c)]).astype(np.float32)
+    y = np.repeat(np.arange(c), n // c).astype(np.int32)
+    perm = rng.permutation(n)
+    X, y = X[perm], y[perm]
+    loader = vt.ArrayLoader({TRAIN: X[:384], VALID: X[384:]},
+                            {TRAIN: y[:384], VALID: y[384:]},
+                            minibatch_size=64)
+    wf = Workflow("chaos")
+    wf.add(U.All2AllTanh(24, name="fc1"))
+    wf.add(U.All2AllSoftmax(4, name="out", inputs=("fc1",)))
+    wf.add(U.EvaluatorSoftmax(name="ev", inputs=("out", "@labels", "@mask")))
+    snap = vt.Snapshotter("chaos", os.path.join(workdir, "snaps"),
+                          interval=1)
+    trainer = vt.Trainer(wf, loader, vt.optimizers.SGD(0.1, momentum=0.9),
+                         vt.Decision(max_epochs=max_epochs),
+                         snapshotter=snap)
+    if slow:
+        # Give the parent a window to SIGKILL between epochs.
+        orig = trainer._run_epoch_train
+
+        def slowed(epoch):
+            mets = orig(epoch)
+            open(os.path.join(workdir, f"epoch{epoch}.done"), "w").close()
+            time.sleep(0.3)
+            return mets
+
+        trainer._run_epoch_train = slowed
+    return trainer, snap
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("workdir")
+    p.add_argument("--max-epochs", type=int, default=6)
+    p.add_argument("--resume", action="store_true")
+    p.add_argument("--slow", action="store_true")
+    args = p.parse_args()
+
+    trainer, snap = make_trainer(args.workdir, args.max_epochs, args.slow)
+    trainer.initialize(seed=0)
+    if args.resume:
+        manifests = sorted(
+            glob.glob(os.path.join(args.workdir, "snaps", "*.json")),
+            key=os.path.getmtime)
+        assert manifests, "nothing to resume from"
+        trainer.restore(manifests[-1])
+    trainer.run()
+
+    w = np.asarray(trainer.wstate["params"]["fc1"]["w"])
+    np.save(os.path.join(args.workdir, "final_w.npy"), w)
+    with open(os.path.join(args.workdir, "results.json"), "w") as f:
+        json.dump({k: v for k, v in trainer.results.items()
+                   if isinstance(v, (int, float, str))}, f)
+    print("WORKER DONE", trainer.results.get("epochs"))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,130 @@
+"""Chaos / elasticity: SIGKILL a training process mid-run, resume from its
+snapshot in a fresh process, and require bit-deterministic continuation.
+
+Reference analog (SURVEY.md §5.3): the master survived slave death because
+it owned all state (veles/server.py:315-338, loader failed-minibatch
+requeue veles/loader/base.py:679-687).  SPMD collectives are
+gang-scheduled, so the rebuild's recovery unit is the whole process:
+checkpoint every epoch, kill -9, restart, restore — and the resumed
+trajectory must equal the never-killed one (loader order, PRNG streams and
+decision state are all part of the snapshot payload)."""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+SCRIPT = os.path.join(os.path.dirname(__file__), "_chaos_train.py")
+
+
+def _spawn(workdir, *extra):
+    return subprocess.Popen(
+        [sys.executable, SCRIPT, str(workdir), *extra],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+
+
+def _wait_file(path, proc, timeout=120):
+    t0 = time.time()
+    while not os.path.exists(path):
+        if proc.poll() is not None:
+            raise AssertionError(
+                "worker exited early:\n" + proc.stdout.read().decode())
+        if time.time() - t0 > timeout:
+            proc.kill()
+            raise TimeoutError(path)
+        time.sleep(0.05)
+
+
+@pytest.mark.slow
+def test_sigkill_resume_is_deterministic(tmp_path):
+    # Reference run: never killed.
+    ref_dir = tmp_path / "ref"
+    ref_dir.mkdir()
+    proc = _spawn(ref_dir)
+    out, _ = proc.communicate(timeout=300)
+    assert proc.returncode == 0, out.decode()
+    w_ref = np.load(ref_dir / "final_w.npy")
+
+    # Chaos run: SIGKILL (no cleanup possible) after epoch 2 completes.
+    chaos_dir = tmp_path / "chaos"
+    chaos_dir.mkdir()
+    victim = _spawn(chaos_dir, "--slow")
+    _wait_file(str(chaos_dir / "epoch2.done"), victim)
+    os.kill(victim.pid, signal.SIGKILL)
+    victim.wait(30)
+    assert victim.returncode != 0  # died hard, mid-run
+
+    # Fresh process resumes from the last epoch snapshot and finishes.
+    resumed = _spawn(chaos_dir, "--resume")
+    out, _ = resumed.communicate(timeout=300)
+    assert resumed.returncode == 0, out.decode()
+    assert b"WORKER DONE" in out
+
+    w_chaos = np.load(chaos_dir / "final_w.npy")
+    # Deterministic continuation: same trajectory as the unkilled run.
+    np.testing.assert_allclose(w_chaos, w_ref, rtol=1e-6, atol=1e-7)
+
+
+def test_resume_across_topology_change(tmp_path):
+    """The 8→1 chip resume (SURVEY.md §7 hard parts): a snapshot taken by
+    a trainer sharded over an 8-device mesh restores into a single-device
+    trainer and vice versa — checkpoints are topology-free."""
+    import veles_tpu as vt
+    from veles_tpu.loader.base import TRAIN, VALID
+    from veles_tpu.parallel import MeshSpec, make_mesh
+    from veles_tpu.units import nn as U
+    from veles_tpu.units.workflow import Workflow
+
+    def build(seed):
+        rng = np.random.default_rng(7)
+        X = rng.standard_normal((256, 16)).astype(np.float32)
+        y = (X.sum(1) > 0).astype(np.int32)
+        loader = vt.ArrayLoader({TRAIN: X[:192], VALID: X[192:]},
+                                {TRAIN: y[:192], VALID: y[192:]},
+                                minibatch_size=32)
+        wf = Workflow("topo")
+        wf.add(U.All2AllTanh(12, name="fc1"))
+        wf.add(U.All2AllSoftmax(2, name="out", inputs=("fc1",)))
+        wf.add(U.EvaluatorSoftmax(name="ev",
+                                  inputs=("out", "@labels", "@mask")))
+        return wf, loader
+
+    snap = vt.Snapshotter("topo", str(tmp_path), interval=1)
+    mesh = make_mesh(MeshSpec(data=8))
+    wf, loader = build(0)
+    sharded = vt.Trainer(wf, loader, vt.optimizers.SGD(0.1),
+                         vt.Decision(max_epochs=2), snapshotter=snap,
+                         mesh=mesh)
+    sharded.initialize(seed=0)
+    sharded.run()
+    assert snap.last_path is not None
+
+    # 8 -> 1: restore the sharded snapshot into an unsharded trainer.
+    wf1, loader1 = build(1)
+    single = vt.Trainer(wf1, loader1, vt.optimizers.SGD(0.1),
+                        vt.Decision(max_epochs=4))
+    single.initialize(seed=1)
+    single.restore(snap.last_path)
+    np.testing.assert_allclose(
+        np.asarray(single.wstate["params"]["fc1"]["w"]),
+        np.asarray(sharded.wstate["params"]["fc1"]["w"]), rtol=1e-6)
+    single.run()
+
+    # 1 -> 8: and back onto a mesh.
+    snap2 = vt.Snapshotter("topo2", str(tmp_path), interval=1)
+    single.snapshotter = snap2
+    snap2.save("manual", single._payload())
+    wf2, loader2 = build(2)
+    resharded = vt.Trainer(wf2, loader2, vt.optimizers.SGD(0.1),
+                           vt.Decision(max_epochs=6), mesh=mesh)
+    resharded.initialize(seed=2)
+    resharded.restore(snap2.last_path)
+    np.testing.assert_allclose(
+        np.asarray(resharded.wstate["params"]["fc1"]["w"]),
+        np.asarray(single.wstate["params"]["fc1"]["w"]), rtol=1e-6)
+    resharded.run()
+    assert resharded.decision.complete

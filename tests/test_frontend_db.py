@@ -1,0 +1,147 @@
+"""Frontend form + sqlite snapshot target tests."""
+
+import urllib.request
+
+import numpy as np
+
+import veles_tpu as vt
+from veles_tpu.__main__ import build_parser
+from veles_tpu.frontend import Frontend, form_to_argv, render_form
+
+
+def test_render_form_covers_parser_options():
+    html_text = render_form(build_parser())
+    for field in ("config", "optimize", "mesh", "max_epochs", "dry_run"):
+        assert f'name="{field}"' in html_text
+    assert 'name="frontend"' not in html_text  # no recursive relaunch
+
+
+def test_form_to_argv_roundtrip():
+    parser = build_parser()
+    fields = {
+        "config": ["train.py"],
+        "overrides": ["a.b=1 c.d=2"],
+        "max_epochs": ["5"],
+        "verbose": ["1"],
+        "dry_run": ["build"],
+    }
+    argv = form_to_argv(parser, fields)
+    args = parser.parse_args(argv)
+    assert args.config == "train.py"
+    assert args.overrides == ["a.b=1", "c.d=2"]
+    assert args.max_epochs == 5
+    assert args.verbose is True
+    assert args.dry_run == "build"
+
+
+def test_frontend_http_roundtrip():
+    parser = build_parser()
+    fe = Frontend(parser, port=0)
+    try:
+        url = f"http://127.0.0.1:{fe.port}/"
+        page = urllib.request.urlopen(url, timeout=10).read().decode()
+        assert "compose a run" in page
+        data = b"config=wf.py&max_epochs=3"
+        resp = urllib.request.urlopen(
+            urllib.request.Request(url, data=data), timeout=10)
+        assert b"Launched" in resp.read()
+        argv = fe.wait(10)
+        assert argv == ["wf.py", "--max-epochs", "3"]
+    finally:
+        fe.close()
+
+
+def test_snapshotter_to_db_roundtrip(tmp_path):
+    db = str(tmp_path / "snaps.sqlite")
+    snap = vt.SnapshotterToDB("m", db)
+    payload = {
+        "wstate": {"params": {"fc": {"w": np.arange(6.0).reshape(2, 3)}},
+                   "step": np.int64(7)},
+        "decision": {"best_value": 1.5},
+        "workflow_checksum": "abc",
+    }
+    uri = snap.save("ep0", payload)
+    assert uri.startswith("sqlite://") and uri.endswith("#1")
+    loaded = vt.Snapshotter.load(uri)
+    np.testing.assert_array_equal(loaded["wstate"]["params"]["fc"]["w"],
+                                  payload["wstate"]["params"]["fc"]["w"])
+    assert loaded["decision"]["best_value"] == 1.5
+    assert loaded["workflow_checksum"] == "abc"
+    # latest-row URI (no fragment)
+    snap.save("ep1", payload)
+    latest = vt.Snapshotter.load(f"sqlite://{db}")
+    assert latest["tag" if "tag" in latest else "workflow_checksum"]
+
+
+def test_trainer_restores_from_db(tmp_path, rng):
+    from veles_tpu.loader.base import TRAIN, VALID
+    from veles_tpu.units import nn as U
+    from veles_tpu.units.workflow import Workflow
+
+    X = rng.standard_normal((128, 8)).astype(np.float32)
+    y = (X.sum(1) > 0).astype(np.int32)
+
+    def build():
+        loader = vt.ArrayLoader({TRAIN: X[:96], VALID: X[96:]},
+                                {TRAIN: y[:96], VALID: y[96:]},
+                                minibatch_size=32)
+        wf = Workflow("db")
+        wf.add(U.All2AllTanh(6, name="fc1"))
+        wf.add(U.All2AllSoftmax(2, name="out", inputs=("fc1",)))
+        wf.add(U.EvaluatorSoftmax(name="ev",
+                                  inputs=("out", "@labels", "@mask")))
+        return wf, loader
+
+    wf, loader = build()
+    snap = vt.SnapshotterToDB("db", str(tmp_path / "s.sqlite"), interval=1)
+    t1 = vt.Trainer(wf, loader, vt.optimizers.SGD(0.1),
+                    vt.Decision(max_epochs=2), snapshotter=snap)
+    t1.initialize(seed=0)
+    t1.run()
+    assert snap.last_path.startswith("sqlite://")
+
+    wf2, loader2 = build()
+    t2 = vt.Trainer(wf2, loader2, vt.optimizers.SGD(0.1),
+                    vt.Decision(max_epochs=4))
+    t2.initialize(seed=1)
+    t2.restore(snap.last_path)
+    np.testing.assert_allclose(
+        np.asarray(t2.wstate["params"]["fc1"]["w"]),
+        np.asarray(t1.wstate["params"]["fc1"]["w"]), rtol=1e-6)
+
+
+def test_form_config_path_with_spaces_preserved():
+    parser = build_parser()
+    argv = form_to_argv(parser, {"config": ["/data/my runs/train.py"],
+                                 "overrides": ["a.b=1 c.d=2"]})
+    args = parser.parse_args(argv)
+    assert args.config == "/data/my runs/train.py"
+    assert args.overrides == ["a.b=1", "c.d=2"]
+
+
+def test_frontend_close_after_timeout_is_clean():
+    fe = Frontend(build_parser(), port=0)
+    assert fe.wait(0.05) is None
+    fe.close()  # must not crash the serve thread
+    assert not fe._thread.is_alive()
+
+
+def test_db_best_fragment_and_hash_path(tmp_path):
+    d = tmp_path / "odd#dir"
+    d.mkdir()
+    db = str(d / "s.sqlite")
+    snap = vt.SnapshotterToDB("m", db)
+    pay = {"wstate": {"w": np.ones(2)}, "tag": "a"}
+    snap.save("ep0", pay)
+    best_uri = snap.save("ep1", {"wstate": {"w": np.full(2, 2.0)}},
+                         best=True)
+    snap.save("ep2", {"wstate": {"w": np.full(2, 3.0)}})
+    # exact row id with '#' inside the db path
+    loaded = vt.Snapshotter.load(best_uri)
+    np.testing.assert_array_equal(loaded["wstate"]["w"], [2.0, 2.0])
+    # '#best' pseudo-fragment (the _best symlink analog)
+    best = vt.Snapshotter.load(f"sqlite://{db}#best")
+    np.testing.assert_array_equal(best["wstate"]["w"], [2.0, 2.0])
+    # latest
+    latest = vt.Snapshotter.load(f"sqlite://{db}#current")
+    np.testing.assert_array_equal(latest["wstate"]["w"], [3.0, 3.0])

@@ -71,6 +71,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--mesh", help="mesh spec, e.g. data=4,model=2")
     p.add_argument("--max-epochs", type=int, default=None)
     p.add_argument("--snapshot-dir", default=None)
+    p.add_argument("--frontend", action="store_true",
+                   help="serve a browser form that composes this command "
+                        "line (reference: veles --frontend)")
     p.add_argument("--verbose", "-v", action="store_true")
     p.add_argument("--list-units", action="store_true",
                    help="print the registered unit classes and exit")
@@ -198,6 +201,17 @@ def main(argv=None) -> int:
     if argv and argv[0] == "forge":
         setup_logging()
         return _forge_main(argv[1:])
+    if "--frontend" in argv:
+        # reference: veles --frontend web form -> composed cmdline
+        # (veles/__main__.py:258-332)
+        setup_logging()
+        from .frontend import Frontend
+        fe = Frontend(build_parser())
+        composed = fe.wait()
+        fe.close()
+        if composed is None:
+            return 1
+        return main(composed)
     args = build_parser().parse_args(argv)
     setup_logging(level=10 if args.verbose else 20)
 

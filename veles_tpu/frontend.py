@@ -1,0 +1,141 @@
+"""``--frontend``: a browser form that composes a command line.
+
+Reference parity: veles/__main__.py:258-332 — ``veles --frontend`` served a
+web form (Tornado + the ``web/`` frontend bundle), waited for the user to
+submit, and then ran with the composed command line.
+
+TPU rebuild: the form is generated straight from the argparse parser
+(every option becomes a field, choices become selects, store_true become
+checkboxes) and served by stdlib http.server on localhost; the POST handler
+converts fields back into an argv list and hands it to ``main`` — no
+Tornado, no static bundle, same workflow."""
+
+from __future__ import annotations
+
+import html
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, HTTPServer
+from typing import List, Optional
+
+from .logger import Logger
+
+_STYLE = """
+body { font-family: sans-serif; margin: 2em auto; max-width: 44em; }
+label { display: block; margin-top: .8em; font-weight: bold; }
+input[type=text] { width: 100%; } .help { color: #666; font-size: .85em; }
+button { margin-top: 1.2em; padding: .5em 2em; }
+"""
+
+
+def render_form(parser) -> str:
+    """HTML form generated from the argparse parser's actions."""
+    rows = []
+    for action in parser._actions:
+        if action.dest in ("help", "frontend"):
+            continue
+        name = html.escape(action.dest)
+        helptext = html.escape(action.help or "")
+        if not action.option_strings:  # positional
+            field = (f'<input type="text" name="{name}" '
+                     f'placeholder="{name}">')
+        elif action.const is True:  # store_true
+            field = f'<input type="checkbox" name="{name}" value="1">'
+        elif action.choices:
+            opts = "".join(
+                f'<option value="{html.escape(str(c))}">'
+                f'{html.escape(str(c))}</option>' for c in action.choices)
+            field = (f'<select name="{name}">'
+                     f'<option value=""></option>{opts}</select>')
+        else:
+            field = f'<input type="text" name="{name}">'
+        rows.append(f'<label>{name}</label>{field}'
+                    f'<div class="help">{helptext}</div>')
+    return (f"<html><head><title>veles_tpu frontend</title>"
+            f"<style>{_STYLE}</style></head><body>"
+            f"<h2>veles_tpu — compose a run</h2>"
+            f'<form method="POST">{"".join(rows)}'
+            f'<button type="submit">Run</button></form></body></html>')
+
+
+def form_to_argv(parser, fields: dict) -> List[str]:
+    """Inverse of render_form: POSTed fields -> argv list."""
+    argv: List[str] = []
+    positionals: List[str] = []
+    for action in parser._actions:
+        if action.dest in ("help", "frontend"):
+            continue  # submitting the form must not relaunch the frontend
+        raw = fields.get(action.dest, [""])[0].strip()
+        if not raw:
+            continue
+        if not action.option_strings:
+            if action.nargs in ("*", "+"):
+                # list positionals (overrides) arrive space-separated
+                positionals.extend(raw.split())
+            else:
+                positionals.append(raw)  # paths may contain spaces
+        elif action.const is True:
+            argv.append(action.option_strings[-1])
+        else:
+            argv.extend([action.option_strings[-1], raw])
+    return positionals + argv
+
+
+class Frontend(Logger):
+    """Serve the form once; ``wait()`` returns the composed argv."""
+
+    def __init__(self, parser, port: int = 8080, host: str = "127.0.0.1"):
+        self.parser = parser
+        self.argv: Optional[List[str]] = None
+        self._done = threading.Event()
+        frontend = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                body = render_form(frontend.parser).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/html; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                fields = urllib.parse.parse_qs(
+                    self.rfile.read(length).decode())
+                frontend.argv = form_to_argv(frontend.parser, fields)
+                body = (b"<html><body><h3>Launched.</h3><pre>" +
+                        html.escape(" ".join(frontend.argv)).encode() +
+                        b"</pre></body></html>")
+                self.send_response(200)
+                self.send_header("Content-Type", "text/html; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                frontend._done.set()
+
+            def log_message(self, *args):
+                pass
+
+        self._server = HTTPServer((host, port), Handler)
+        self._server.timeout = 0.2  # lets _serve poll _done; close() can
+        self.port = self._server.server_address[1]  # then join cleanly
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+        self.info("frontend at http://%s:%d/ — submit the form to run",
+                  host, self.port)
+
+    def _serve(self):
+        while not self._done.is_set():
+            self._server.handle_request()
+
+    def wait(self, timeout: Optional[float] = None) -> Optional[List[str]]:
+        """Block until the form is submitted; returns the argv."""
+        if not self._done.wait(timeout):
+            return None
+        return self.argv
+
+    def close(self):
+        self._done.set()
+        self._thread.join(2.0)  # serve loop exits on its 0.2s poll tick
+        self._server.server_close()

@@ -1,3 +1,3 @@
 from .decision import Decision
-from .snapshotter import Snapshotter
+from .snapshotter import Snapshotter, SnapshotterToDB
 from .trainer import Trainer

@@ -156,8 +156,11 @@ class Snapshotter(Logger):
     @staticmethod
     def load(path: str) -> Dict[str, Any]:
         """Restore a checkpoint from its manifest path (or the _current/_best
-        symlink). Returns the payload with 'wstate' as numpy pytree; call
-        ``jax.device_put`` (optionally with shardings) to place it."""
+        symlink), or from a ``sqlite://db.sqlite#id`` URI written by
+        SnapshotterToDB. Returns the payload with 'wstate' as numpy pytree;
+        call ``jax.device_put`` (optionally with shardings) to place it."""
+        if path.startswith("sqlite://"):
+            return SnapshotterToDB.load_uri(path)
         with open(path) as f:
             manifest = json.load(f)
         npz_path = os.path.join(os.path.dirname(path), manifest["tensors"])
@@ -184,3 +187,95 @@ class Snapshotter(Logger):
         if shardings is not None:
             return jax.device_put(wstate, shardings)
         return jax.device_put(wstate)
+
+
+class SnapshotterToDB(Snapshotter):
+    """Snapshot into a sqlite database instead of the filesystem
+    (reference: SnapshotterToDB over ODBC, veles/snapshotter.py:428-518 —
+    the portable stdlib analog).  Rows carry the manifest JSON and the
+    tensor .npz bytes; ``last_path`` is a ``sqlite://db#id`` URI accepted by
+    ``Snapshotter.load`` and therefore by ``Trainer.restore``."""
+
+    _SCHEMA = ("CREATE TABLE IF NOT EXISTS snapshots ("
+               "id INTEGER PRIMARY KEY AUTOINCREMENT, prefix TEXT, "
+               "tag TEXT, saved_at REAL, best INTEGER, manifest TEXT, "
+               "tensors BLOB)")
+
+    def __init__(self, prefix: str, db_path: str = "snapshots.sqlite", *,
+                 compression: bool = True, interval: int = 1,
+                 time_interval: float = 0.0):
+        super().__init__(prefix, os.path.dirname(db_path) or ".",
+                         compression=compression, interval=interval,
+                         time_interval=time_interval)
+        self.db_path = db_path
+
+    def _connect(self):
+        import sqlite3
+        conn = sqlite3.connect(self.db_path)
+        conn.execute(self._SCHEMA)
+        return conn
+
+    def save(self, tag: str, payload: Dict[str, Any], *,
+             best: bool = False) -> str:
+        import io
+        buf = io.BytesIO()
+        tensors = _flatten(_to_numpy(payload.get("wstate", {})))
+        saver = np.savez_compressed if self.compression else np.savez
+        saver(buf, **tensors)
+        manifest = {k: v for k, v in payload.items() if k != "wstate"}
+        manifest["saved_at"] = time.time()
+        blob = buf.getvalue()
+        conn = self._connect()
+        try:
+            with conn:
+                cur = conn.execute(
+                    "INSERT INTO snapshots (prefix, tag, saved_at, best, "
+                    "manifest, tensors) VALUES (?, ?, ?, ?, ?, ?)",
+                    (self.prefix, tag, manifest["saved_at"], int(best),
+                     json.dumps(manifest, default=repr), blob))
+                rowid = cur.lastrowid
+        finally:
+            conn.close()
+        self.last_path = f"sqlite://{self.db_path}#{rowid}"
+        self.info("snapshot %s (%.1f MiB)%s", self.last_path,
+                  len(blob) / 2**20, " [best]" if best else "")
+        return self.last_path
+
+    @staticmethod
+    def load_uri(uri: str) -> Dict[str, Any]:
+        """``sqlite://db`` (latest row), ``sqlite://db#<id>`` (exact row) or
+        ``sqlite://db#best``/``#current`` (the filesystem symlink analogs).
+        The fragment is split at the LAST '#' so db paths containing '#'
+        survive."""
+        import io
+        import sqlite3
+        assert uri.startswith("sqlite://"), uri
+        rest = uri[len("sqlite://"):]
+        head, sep, frag = rest.rpartition("#")
+        db_path = head if sep else rest
+        if not sep:
+            frag = ""
+        conn = sqlite3.connect(db_path)
+        try:
+            if frag == "best":
+                row = conn.execute(
+                    "SELECT manifest, tensors FROM snapshots WHERE best=1 "
+                    "ORDER BY id DESC LIMIT 1").fetchone()
+            elif frag and frag != "current":
+                row = conn.execute(
+                    "SELECT manifest, tensors FROM snapshots WHERE id=?",
+                    (int(frag),)).fetchone()
+            else:  # latest ("current")
+                row = conn.execute(
+                    "SELECT manifest, tensors FROM snapshots "
+                    "ORDER BY id DESC LIMIT 1").fetchone()
+        finally:
+            conn.close()
+        if row is None:
+            raise FileNotFoundError(uri)
+        manifest, blob = row
+        with np.load(io.BytesIO(blob), allow_pickle=False) as z:
+            flat = {k: z[k] for k in z.files}
+        payload = json.loads(manifest)
+        payload["wstate"] = _unflatten(flat)
+        return payload
