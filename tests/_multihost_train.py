@@ -1,0 +1,89 @@
+"""Worker for the two-process multi-host test (tests/test_multihost.py):
+the loopback analog of the reference's master+slave-in-one-process tests
+(veles/tests/test_launcher.py:91-118). Each process owns 2 virtual CPU
+devices; the 4-device global mesh trains data-parallel with per-host
+sharded-index loading."""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    workdir, pid, nproc, port = (sys.argv[1], int(sys.argv[2]),
+                                 int(sys.argv[3]), sys.argv[4])
+    from veles_tpu.parallel.distributed import initialize_distributed
+    initialize_distributed(f"127.0.0.1:{port}", nproc, pid)
+
+    import veles_tpu as vt
+    from veles_tpu.loader.base import TRAIN, VALID
+    from veles_tpu.parallel import MeshSpec, make_mesh
+    from veles_tpu.units import nn as U
+    from veles_tpu.units.workflow import Workflow
+
+    assert jax.process_count() == nproc
+    rng = np.random.default_rng(5)
+    X = rng.standard_normal((512, 24)).astype(np.float32)
+    y = (X[:, :4].sum(1) > 0).astype(np.int32)
+    loader = vt.ArrayLoader(
+        {TRAIN: X[:384], VALID: X[384:]}, {TRAIN: y[:384], VALID: y[384:]},
+        minibatch_size=32, shard_index=pid, shard_count=nproc)
+
+    wf = Workflow("mh")
+    wf.add(U.All2AllTanh(16, name="fc1"))
+    wf.add(U.All2AllSoftmax(2, name="out", inputs=("fc1",)))
+    wf.add(U.EvaluatorSoftmax(name="ev", inputs=("out", "@labels", "@mask")))
+
+    mesh = make_mesh(MeshSpec(data=len(jax.devices())))
+    snap = vt.Snapshotter("mh", os.path.join(workdir, "snaps"), interval=1)
+    trainer = vt.Trainer(wf, loader, vt.optimizers.SGD(0.1, momentum=0.9),
+                         vt.Decision(max_epochs=3), snapshotter=snap,
+                         mesh=mesh)
+    trainer.initialize(seed=0)
+    results = trainer.run()
+
+    # Barrier: host 1 must not race host 0's final snapshot write.
+    from jax.experimental import multihost_utils
+    multihost_utils.sync_global_devices("snapshot_written")
+
+    # Multi-host restore: both hosts read host-0's snapshot (shared disk)
+    # and re-place it under the global mesh shardings.
+    wf2 = Workflow("mh")
+    wf2.add(U.All2AllTanh(16, name="fc1"))
+    wf2.add(U.All2AllSoftmax(2, name="out", inputs=("fc1",)))
+    wf2.add(U.EvaluatorSoftmax(name="ev",
+                               inputs=("out", "@labels", "@mask")))
+    loader2 = vt.ArrayLoader(
+        {TRAIN: X[:384], VALID: X[384:]}, {TRAIN: y[:384], VALID: y[384:]},
+        minibatch_size=32, shard_index=pid, shard_count=nproc)
+    trainer2 = vt.Trainer(wf2, loader2, vt.optimizers.SGD(0.1, momentum=0.9),
+                          vt.Decision(max_epochs=4), mesh=mesh)
+    trainer2.initialize(seed=1)
+    trainer2.restore(os.path.join(workdir, "snaps", "mh_current.json"))
+    restored = np.asarray(
+        jax.device_get(trainer2.wstate["params"]["fc1"]["w"]))
+    trained = np.asarray(
+        jax.device_get(trainer.wstate["params"]["fc1"]["w"]))
+    np.testing.assert_allclose(restored, trained, rtol=1e-6)
+
+    w = np.asarray(jax.device_get(trainer.wstate["params"]["fc1"]["w"]))
+    np.save(os.path.join(workdir, f"w_host{pid}.npy"), w)
+    with open(os.path.join(workdir, f"results_host{pid}.json"), "w") as f:
+        json.dump({k: v for k, v in results.items()
+                   if isinstance(v, (int, float))}, f)
+    print(f"HOST {pid} DONE", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -108,3 +108,23 @@ def test_queue_loader_stream():
     assert batches[0]["@input"].shape == (4, 3)
     # last batch padded
     assert batches[-1]["@mask"].sum() == 2
+
+
+def test_socket_loader_feeds_batches():
+    """Network job queue (reference: ZeroMQLoader, veles/zmq_loader.py:74):
+    a producer pushes frames over TCP; the loader serves minibatches."""
+    import numpy as np
+    from veles_tpu.loader.base import TRAIN
+    from veles_tpu.loader.interactive import SocketLoader, feed_socket
+
+    loader = SocketLoader((4,), minibatch_size=3)
+    loader.initialize()
+    samples = np.arange(24, dtype=np.float32).reshape(6, 4)
+    feed_socket(loader.endpoint, samples, labels=[0, 1, 2, 0, 1, 2],
+                close=True)
+    batches = list(loader.iter_epoch(TRAIN))
+    got = np.concatenate([b["@input"][b["@mask"] > 0] for b in batches])
+    np.testing.assert_array_equal(np.sort(got.ravel()),
+                                  np.sort(samples.ravel()))
+    labels = np.concatenate([b["@labels"][b["@mask"] > 0] for b in batches])
+    assert sorted(labels.tolist()) == [0, 0, 1, 1, 2, 2]

@@ -195,3 +195,17 @@ def test_dropout_train_vs_eval(rng):
     ctx_e = Context(train=False, key=None)
     y2, _ = d.apply({}, {}, [x], ctx_e)
     np.testing.assert_array_equal(np.asarray(y2), np.asarray(x))
+
+
+def test_profile_units(rng):
+    loader = make_loader(rng)
+    wf = build_fc_workflow()
+    trainer = vt.Trainer(wf, loader, vt.optimizers.SGD(0.01),
+                         vt.Decision(max_epochs=1))
+    trainer.initialize(seed=0)
+    batch = next(loader.iter_epoch(TRAIN))
+    rows = wf.profile_units(trainer.wstate, batch, reps=2)
+    assert [r["unit"] for r in rows] == [u.name for u in wf.topo_order()]
+    assert all(r["ms"] >= 0 for r in rows)
+    table = vt.units.workflow.Workflow.format_profile(rows)
+    assert "TOTAL" in table and rows[0]["unit"] in table

@@ -90,3 +90,100 @@ class QueueLoader(Loader):
         return {"@input": np.stack(buf),
                 "@labels": np.asarray(labels, np.int32),
                 "@mask": mask}
+
+
+class SocketLoader(QueueLoader):
+    """Network job queue: a TCP listener feeds the queue with pickled
+    sample frames (reference: ZeroMQLoader's ROUTER socket job queue,
+    veles/zmq_loader.py:74-138 — the Mastodon/Hadoop contact point).
+
+    Frames use the package's length-prefixed pickle framing
+    (veles_tpu.graphics): each frame is ``{"input": array, "label": int?}``
+    or ``{"kind": "close"}`` to end the stream.  Pickle crosses a trust
+    boundary only on localhost/cluster-internal links, as in the
+    reference."""
+
+    def __init__(self, input_shape, minibatch_size=1, *, port: int = 0,
+                 host: str = "127.0.0.1", **kw):
+        super().__init__(input_shape, minibatch_size, **kw)
+        import socket as _socket
+        self._listener = _socket.socket(_socket.AF_INET,
+                                        _socket.SOCK_STREAM)
+        self._listener.setsockopt(_socket.SOL_SOCKET,
+                                  _socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(8)
+        self.endpoint = "tcp://%s:%d" % self._listener.getsockname()[:2]
+        self._accept_thread = threading.Thread(target=self._accept_loop,
+                                               daemon=True)
+        self._accept_thread.start()
+
+    def _accept_loop(self):
+        import socket as _socket
+        while not self._closed.is_set():
+            try:
+                self._listener.settimeout(0.2)
+                conn, _ = self._listener.accept()
+            except _socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(target=self._reader, args=(conn,),
+                             daemon=True).start()
+
+    def _reader(self, conn):
+        from ..graphics import recv_frame
+        try:
+            while not self._closed.is_set():
+                try:
+                    frame = recv_frame(conn)
+                except OSError:
+                    break
+                except Exception as e:
+                    # Corrupt pickle body / hostile length prefix: drop the
+                    # whole connection (frame boundary is lost) but never
+                    # kill the reader silently.
+                    self.warning("dropping connection on bad frame: %s", e)
+                    break
+                if frame is None:
+                    break
+                if frame.get("kind") == "close":
+                    self.close()
+                    break
+                try:
+                    self.feed(frame["input"], frame.get("label"))
+                except (ValueError, KeyError, TypeError) as e:
+                    self.warning("bad frame dropped: %s", e)
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def close(self):
+        super().close()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+
+def feed_socket(endpoint: str, samples, labels=None, *,
+                close: bool = False) -> None:
+    """Producer-side helper: push samples to a SocketLoader endpoint."""
+    import pickle
+    import socket as _socket
+    from ..graphics import _send_frame  # single source of the framing
+    assert endpoint.startswith("tcp://"), endpoint
+    host, _, port = endpoint[6:].partition(":")
+    sock = _socket.create_connection((host, int(port)))
+    try:
+        for i, sample in enumerate(samples):
+            frame = {"input": np.asarray(sample, np.float32)}
+            if labels is not None:
+                frame["label"] = int(labels[i])
+            _send_frame(sock, pickle.dumps(frame, protocol=4))
+        if close:
+            _send_frame(sock, pickle.dumps({"kind": "close"}, protocol=4))
+    finally:
+        sock.close()

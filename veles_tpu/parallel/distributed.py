@@ -55,3 +55,33 @@ def host_count() -> int:
 
 def host_index() -> int:
     return jax.process_index()
+
+
+def to_global_batch(batch, mesh, shardings):
+    """Assemble each host's local batch shard into global arrays under the
+    compiled step's batch ``shardings`` (the multi-host analog of the
+    reference's 'loader ships index subsets to each slave',
+    veles/loader/base.py:631-639: every host serves its own rows; this
+    stitches them into the global SPMD batch).  The partition spec comes
+    from each leaf's sharding — batches may be sharded over ('data','fsdp')
+    or a seq axis, not just 'data'."""
+    from jax.experimental import multihost_utils as mh
+
+    return {k: mh.host_local_array_to_global_array(v, mesh, shardings[k].spec)
+            for k, v in batch.items()}
+
+
+def place_global_state(tree, shardings):
+    """Place a host-replicated state pytree under (possibly
+    non-addressable) global shardings — every host holds the same full
+    values (identical seeds), and each device shard is sliced out locally.
+    ``jax.device_put`` refuses non-addressable shardings; the callback form
+    is the supported path (typed PRNG keys included)."""
+
+    def put(x, sh):
+        def cb(idx):
+            return x[idx] if getattr(x, "ndim", 0) else x
+        return jax.make_array_from_callback(
+            getattr(x, "shape", ()), sh, cb)
+
+    return jax.tree.map(put, tree, shardings)

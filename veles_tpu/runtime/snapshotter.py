@@ -183,8 +183,21 @@ class Snapshotter(Logger):
                     return jax.random.wrap_key_data(
                         jnp.asarray(saved, jnp.uint32))
                 return jnp.asarray(saved).astype(template.dtype)
-            wstate = jax.tree.map(cast, wstate, like)
+            try:
+                wstate = jax.tree.map(cast, wstate, like)
+            except (ValueError, AttributeError) as e:
+                raise ValueError(
+                    "snapshot state structure does not match this "
+                    "workflow's (different optimizer or architecture? "
+                    "the checksum only covers graph topology): "
+                    f"{e}") from e
         if shardings is not None:
+            from ..parallel.distributed import (is_multihost,
+                                                place_global_state)
+            if is_multihost():
+                # device_put refuses non-addressable shardings; rebuild
+                # the global arrays from the host-identical restored state.
+                return place_global_state(wstate, shardings)
             return jax.device_put(wstate, shardings)
         return jax.device_put(wstate)
 

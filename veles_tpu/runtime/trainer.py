@@ -47,7 +47,8 @@ class Trainer(Logger):
     def __init__(self, workflow: Workflow, loader: Loader,
                  optimizer: Optimizer, decision: Optional[Decision] = None,
                  snapshotter: Optional[Snapshotter] = None, *,
-                 mesh=None, rule=None, recorder=None, status=None):
+                 mesh=None, rule=None, recorder=None, status=None,
+                 prefetch: int = 2):
         self.workflow = workflow
         self.loader = loader
         self.optimizer = optimizer
@@ -57,6 +58,7 @@ class Trainer(Logger):
         self.rule = rule          # parameter sharding rule (parallel.mesh)
         self.recorder = recorder  # plotting.MetricsRecorder (optional)
         self.status = status      # runtime.status.StatusReporter (optional)
+        self.prefetch = prefetch  # batch prefetch depth (0 = synchronous)
         self._batch_sh = None
         self._state_sh = None
         self._batch_spec = None
@@ -83,6 +85,14 @@ class Trainer(Logger):
             key = prng.get("init").next_key() if seed is None \
                 else jax.random.key(seed)
             self.wstate = self.workflow.init_state(key, self.optimizer)
+        from ..parallel.distributed import host_count, is_multihost
+        if self.mesh is not None and is_multihost():
+            # Each host serves a local shard; the compiled step sees the
+            # GLOBAL batch (host shards stitched on the data axis by
+            # to_global_batch in the epoch loop).
+            specs = {k: jax.ShapeDtypeStruct(
+                (s.shape[0] * host_count(),) + tuple(s.shape[1:]), s.dtype)
+                for k, s in specs.items()}
         self._batch_spec = specs
         # The unscaled schedule: rollback/restore always compose the
         # cumulative decision.lr_multiplier onto THIS, never onto an
@@ -90,7 +100,7 @@ class Trainer(Logger):
         self._base_schedule = self.optimizer.schedule
         self._compile_steps()
         if self._state_sh is not None:
-            self.wstate = jax.device_put(self.wstate, self._state_sh)
+            self.wstate = self._place_state(self.wstate)
         self.info("workflow %s: %d params", self.workflow.name,
                   self.workflow.n_params(self.wstate))
 
@@ -110,12 +120,78 @@ class Trainer(Logger):
             self._eval_step = self.workflow.make_eval_step()
 
     # -- epoch passes -------------------------------------------------------
+    def _batches(self, klass: int, epoch):
+        """Batch stream with background prefetch: host-side minibatch
+        assembly (gather/decode/normalize) overlaps device compute — the
+        double-buffered host→device feed of SURVEY.md §7.7 (the reference
+        got overlap accidentally from its thread-pool unit graph)."""
+        it = self.loader.iter_epoch(klass, epoch)
+        if self.prefetch <= 0:
+            yield from it
+            return
+        import queue
+        import threading
+        q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
+        _end = object()
+        stop = threading.Event()
+
+        def guarded_put(item) -> bool:
+            # Bounded put that gives up when the consumer is gone —
+            # otherwise an abandoned epoch (step raised, early stop) leaves
+            # the worker blocked forever and, for streaming loaders,
+            # silently draining samples nobody will see.
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def worker():
+            try:
+                for item in it:
+                    if not guarded_put(item):
+                        return
+                guarded_put(_end)
+            except BaseException as e:  # re-raised on the consumer side
+                guarded_put(e)
+
+        threading.Thread(target=worker, daemon=True).start()
+        try:
+            while True:
+                item = q.get()
+                if item is _end:
+                    return
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            stop.set()
+
+    def _place_state(self, wstate):
+        """Place the (host-identical) state under the mesh shardings; on
+        multi-host the shardings span non-addressable devices, which
+        device_put refuses."""
+        from ..parallel.distributed import is_multihost, place_global_state
+        if is_multihost():
+            return place_global_state(wstate, self._state_sh)
+        return jax.device_put(wstate, self._state_sh)
+
+    def _place_batch(self, batch):
+        if self._batch_sh is None:
+            return batch
+        from ..parallel.distributed import is_multihost, to_global_batch
+        if is_multihost():
+            # Stitch this host's shard into the global SPMD batch.
+            return to_global_batch(batch, self.mesh, self._batch_sh)
+        return jax.device_put(batch, self._batch_sh)
+
     def _run_epoch_train(self, epoch: int) -> Dict[str, float]:
         sums: Dict[str, Any] = {}
         with TraceContext("train_epoch", epoch=epoch):
-            for batch in self.loader.iter_epoch(TRAIN, epoch):
-                if self._batch_sh is not None:
-                    batch = jax.device_put(batch, self._batch_sh)
+            for batch in self._batches(TRAIN, epoch):
+                batch = self._place_batch(batch)
                 self.wstate, mets = self._train_step(self.wstate, batch)
                 # Accumulate ON DEVICE — a float() here would sync the
                 # pipeline every step (the reference's --sync-run behavior,
@@ -131,9 +207,8 @@ class Trainer(Logger):
             return {}
         sums: Dict[str, float] = {}
         with TraceContext("eval_epoch", epoch=epoch, klass=klass):
-            for batch in self.loader.iter_epoch(klass, epoch):
-                if self._batch_sh is not None:
-                    batch = jax.device_put(batch, self._batch_sh)
+            for batch in self._batches(klass, epoch):
+                batch = self._place_batch(batch)
                 mets = self._eval_step(self.wstate, batch)
                 for k, v in mets.items():
                     sums[k] = sums[k] + v if k in sums else v
@@ -184,7 +259,9 @@ class Trainer(Logger):
             # Advance the loader first so a restored checkpoint resumes at
             # the *next* epoch instead of repeating the completed one.
             self.loader.next_epoch()
-            if self.snapshotter is not None:
+            if self.snapshotter is not None and jax.process_index() == 0:
+                # Only host 0 snapshots (reference: slaves never snapshot,
+                # veles/snapshotter.py:160).
                 self.snapshotter.maybe_save(
                     f"ep{epoch}", self._payload(),
                     best=self.decision.improved)

@@ -347,6 +347,55 @@ class Workflow(Logger):
     def n_params(self, wstate) -> int:
         return sum(int(x.size) for x in jax.tree.leaves(wstate["params"]))
 
+    def profile_units(self, wstate, batch, *, train: bool = False,
+                      reps: int = 3) -> List[Dict[str, Any]]:
+        """Per-unit wall timing: run each unit's apply as its own jitted
+        call with a forced device sync — the analog of the reference's
+        ``--sync-run`` honest per-unit timers (veles/accelerated_units.py
+        :186-193, per-unit timers veles/units.py:805-817). In the fused
+        production step XLA erases unit boundaries, so this instrumented
+        mode is how per-unit cost is attributed."""
+        import time as _time
+        ctx = Context(train=train, key=wstate.get("key"))
+        outputs = dict(batch)
+        rows = []
+
+        def drain(tree):
+            leaf = jax.tree.leaves(tree)[0]
+            jax.device_get(leaf.ravel()[:1])  # scalar read = full sync
+
+        for u in self.topo_order():
+            xs = [outputs[s] for s in u.inputs]
+            fn = jax.jit(lambda p, s, *xs, _u=u: _u.apply(p, s, list(xs),
+                                                          ctx))
+            params = wstate["params"].get(u.name, {})
+            state = wstate["state"].get(u.name, {})
+            y, _ = fn(params, state, *xs)
+            drain(y)  # compile + warm
+            best = float("inf")
+            for _ in range(reps):
+                t0 = _time.perf_counter()
+                y, _ = fn(params, state, *xs)
+                drain(y)
+                best = min(best, _time.perf_counter() - t0)
+            outputs[u.name] = y
+            rows.append({"unit": u.name, "type": type(u).__name__,
+                         "ms": best * 1e3})
+        return rows
+
+    @staticmethod
+    def format_profile(rows: List[Dict[str, Any]], top: int = 5) -> str:
+        """Top-N table with share of total (reference: Workflow.print_stats
+        top-5 table, veles/workflow.py:788-825)."""
+        total = sum(r["ms"] for r in rows) or 1e-9
+        ranked = sorted(rows, key=lambda r: -r["ms"])[:top]
+        lines = [f"{'unit':>20s} {'type':>18s} {'ms':>9s} {'share':>7s}"]
+        for r in ranked:
+            lines.append(f"{r['unit']:>20s} {r['type']:>18s} "
+                         f"{r['ms']:9.3f} {100 * r['ms'] / total:6.1f}%")
+        lines.append(f"{'TOTAL':>20s} {'':>18s} {total:9.3f}")
+        return "\n".join(lines)
+
     def gather_results(self, metrics: Dict[str, Any]) -> Dict[str, Any]:
         """JSON-able result dict (reference: IResultProvider →
         gather_results → --result-file, veles/workflow.py:827-849)."""
