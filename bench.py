@@ -21,7 +21,7 @@ import numpy as np
 # BASELINE.md for vs_baseline.
 V100_ALEXNET_SAMPLES_PER_SEC = 2000.0
 
-BATCH = 256
+BATCH = 512
 WARMUP = 3
 ITERS = 30
 

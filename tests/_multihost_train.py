@@ -45,11 +45,14 @@ def main():
     wf.add(U.All2AllSoftmax(2, name="out", inputs=("fc1",)))
     wf.add(U.EvaluatorSoftmax(name="ev", inputs=("out", "@labels", "@mask")))
 
-    mesh = make_mesh(MeshSpec(data=len(jax.devices())))
+    # data×fsdp mesh + a sharding rule: snapshots must all-gather the
+    # fsdp-sharded (non-addressable) leaves.
+    from veles_tpu.parallel import fsdp_rules
+    mesh = make_mesh(MeshSpec(data=len(jax.devices()) // 2, fsdp=2))
     snap = vt.Snapshotter("mh", os.path.join(workdir, "snaps"), interval=1)
     trainer = vt.Trainer(wf, loader, vt.optimizers.SGD(0.1, momentum=0.9),
                          vt.Decision(max_epochs=3), snapshotter=snap,
-                         mesh=mesh)
+                         mesh=mesh, rule=fsdp_rules(min_size=16))
     trainer.initialize(seed=0)
     results = trainer.run()
 
@@ -68,17 +71,21 @@ def main():
         {TRAIN: X[:384], VALID: X[384:]}, {TRAIN: y[:384], VALID: y[384:]},
         minibatch_size=32, shard_index=pid, shard_count=nproc)
     trainer2 = vt.Trainer(wf2, loader2, vt.optimizers.SGD(0.1, momentum=0.9),
-                          vt.Decision(max_epochs=4), mesh=mesh)
+                          vt.Decision(max_epochs=4), mesh=mesh,
+                          rule=fsdp_rules(min_size=16))
     trainer2.initialize(seed=1)
     trainer2.restore(os.path.join(workdir, "snaps", "mh_current.json"))
-    restored = np.asarray(
-        jax.device_get(trainer2.wstate["params"]["fc1"]["w"]))
-    trained = np.asarray(
-        jax.device_get(trainer.wstate["params"]["fc1"]["w"]))
+    # Restore must NOT adopt host-0's shard identity (it would silently
+    # train every host on shard 0's data).
+    assert loader2.shard_index == pid, (loader2.shard_index, pid)
+    assert loader2.shard_count == nproc
+    from veles_tpu.parallel.distributed import gather_to_host
+    restored = gather_to_host(trainer2.wstate["params"]["fc1"])["w"]
+    trained = gather_to_host(trainer.wstate["params"]["fc1"])["w"]
     np.testing.assert_allclose(restored, trained, rtol=1e-6)
+    trainer2.run()  # continues training with correct shards post-restore
 
-    w = np.asarray(jax.device_get(trainer.wstate["params"]["fc1"]["w"]))
-    np.save(os.path.join(workdir, f"w_host{pid}.npy"), w)
+    np.save(os.path.join(workdir, f"w_host{pid}.npy"), np.asarray(trained))
     with open(os.path.join(workdir, f"results_host{pid}.json"), "w") as f:
         json.dump({k: v for k, v in results.items()
                    if isinstance(v, (int, float))}, f)

@@ -203,3 +203,23 @@ def test_ensemble_results_mismatched_rows_rejected(tmp_path, rng):
     ld = EnsembleResultsLoader(str(man), minibatch_size=2)
     with pytest.raises(LoaderError, match="row counts differ"):
         ld.initialize()
+
+
+def test_set_state_preserves_shard_identity(rng):
+    """Restore must not adopt the snapshotting host's shard (reference
+    analog: loaders ship indices, not identity — veles/loader/base.py:631;
+    regression for multi-host checkpoint-restart data loss)."""
+    import veles_tpu as vt
+    from veles_tpu.loader.base import TRAIN
+
+    X = rng.standard_normal((64, 4)).astype(np.float32)
+    a = vt.ArrayLoader({TRAIN: X}, minibatch_size=8,
+                       shard_index=0, shard_count=2)
+    b = vt.ArrayLoader({TRAIN: X}, minibatch_size=8,
+                       shard_index=1, shard_count=2)
+    a.initialize(), b.initialize()
+    a.next_epoch(), a.next_epoch()
+    b.set_state(a.state())  # host 1 restoring host 0's snapshot
+    assert b.epoch_number == 2          # training state adopted
+    assert b.shard_index == 1           # topology kept
+    assert b.shard_count == 2

@@ -207,8 +207,10 @@ class Loader(Logger):
     def set_state(self, st: dict) -> None:
         self.epoch_number = int(st["epoch_number"])
         self.minibatch_size = int(st["minibatch_size"])
-        self.shard_index = int(st.get("shard_index", 0))
-        self.shard_count = int(st.get("shard_count", 1))
+        # shard_index/shard_count are TOPOLOGY, not training state: a
+        # multi-host restore reads host-0's snapshot on every host, and
+        # adopting its shard identity would make all hosts train shard 0
+        # (silent data loss). They stay in state() for inspection only.
         self.train_ratio = float(st.get("train_ratio", 1.0))
         self.subset_seed = int(st.get("subset_seed", 0))
         norm = st.get("normalizer")

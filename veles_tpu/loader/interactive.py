@@ -147,6 +147,10 @@ class SocketLoader(QueueLoader):
                     break
                 if frame is None:
                     break
+                if not isinstance(frame, dict):
+                    self.warning("non-dict frame dropped: %r",
+                                 type(frame).__name__)
+                    continue
                 if frame.get("kind") == "close":
                     self.close()
                     break

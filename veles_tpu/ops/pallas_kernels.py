@@ -197,12 +197,17 @@ def _splitmix32(z):
     return z ^ (z >> 16)
 
 
-def _dropout_kernel(seed_ref, x_ref, o_ref, *, rate, block_rows, n_cols):
-    pid = pl.program_id(0)
-    r = jax.lax.broadcasted_iota(jnp.uint32, (block_rows, n_cols), 0)
-    c = jax.lax.broadcasted_iota(jnp.uint32, (block_rows, n_cols), 1)
-    lin = ((pid.astype(jnp.uint32) * np.uint32(block_rows) + r)
-           * np.uint32(n_cols) + c)
+def _dropout_kernel(seed_ref, x_ref, o_ref, *, rate, block_rows, block_cols,
+                    n_cols):
+    # The mask bit for element (row, col) is a hash of its GLOBAL linear
+    # index, so the mask is identical for any (block_rows, block_cols)
+    # tiling — backward can regenerate it with different tile choices.
+    pid_r, pid_c = pl.program_id(0), pl.program_id(1)
+    r = jax.lax.broadcasted_iota(jnp.uint32, (block_rows, block_cols), 0)
+    c = jax.lax.broadcasted_iota(jnp.uint32, (block_rows, block_cols), 1)
+    row = pid_r.astype(jnp.uint32) * np.uint32(block_rows) + r
+    col = pid_c.astype(jnp.uint32) * np.uint32(block_cols) + c
+    lin = row * np.uint32(n_cols) + col
     bits = _splitmix32(_splitmix32(lin ^ seed_ref[0, 0]))
     # top 24 bits -> uniform in [0, 1); Mosaic lacks uint32->f32 casts, so
     # bitcast the (always-positive) value through int32 first.
@@ -212,28 +217,45 @@ def _dropout_kernel(seed_ref, x_ref, o_ref, *, rate, block_rows, n_cols):
     o_ref[:] = (x_ref[:].astype(jnp.float32) * keep).astype(o_ref.dtype)
 
 
+# Per-block element budget: a few f32 buffers per block must fit VMEM
+# (~16 MB) with headroom for Mosaic's stack.
+_DROPOUT_BLOCK_ELEMS = 1 << 19
+
+
 def _dropout_apply(x, seed, rate, block_rows, interpret):
     orig_shape = x.shape
     flat = x.reshape(-1, orig_shape[-1]) if x.ndim > 1 else x.reshape(1, -1)
     rows, cols = flat.shape
-    block_rows = min(block_rows, rows)
+    if cols <= 8192:
+        block_cols = _round_up(cols, 128)
+    else:
+        # Near-equal 128-aligned column blocks keep padding under one lane
+        # width (a flat 8192 cap would pad e.g. 8320 cols to 16384 —
+        # nearly doubling hashed+written elements).
+        n_cb = -(-cols // 8192)
+        block_cols = _round_up(-(-cols // n_cb), 128)
+    block_rows = max(8, min(block_rows, rows,
+                            _DROPOUT_BLOCK_ELEMS // block_cols))
     rows_p = _round_up(rows, block_rows)
-    flat = jnp.pad(flat, ((0, rows_p - rows), (0, 0)))
+    cols_p = _round_up(cols, block_cols)
+    flat = jnp.pad(flat, ((0, rows_p - rows), (0, cols_p - cols)))
     seed_arr = jnp.asarray(seed, jnp.uint32).reshape(1, 1)
     kernel = functools.partial(_dropout_kernel, rate=float(rate),
-                               block_rows=block_rows, n_cols=cols)
+                               block_rows=block_rows,
+                               block_cols=block_cols, n_cols=cols)
     out = pl.pallas_call(
         kernel,
-        grid=(rows_p // block_rows,),
+        grid=(rows_p // block_rows, cols_p // block_cols),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((block_rows, cols), lambda i: (i, 0)),
+            pl.BlockSpec((block_rows, block_cols), lambda i, j: (i, j)),
         ],
-        out_specs=pl.BlockSpec((block_rows, cols), lambda i: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((rows_p, cols), x.dtype),
+        out_specs=pl.BlockSpec((block_rows, block_cols),
+                               lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((rows_p, cols_p), x.dtype),
         interpret=_interpret(interpret),
     )(seed_arr, flat)
-    return out[:rows].reshape(orig_shape)
+    return out[:rows, :cols].reshape(orig_shape)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))

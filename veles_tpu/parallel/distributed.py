@@ -85,3 +85,23 @@ def place_global_state(tree, shardings):
             getattr(x, "shape", ()), sh, cb)
 
     return jax.tree.map(put, tree, shardings)
+
+
+def gather_to_host(tree):
+    """Host-side numpy copy of a (possibly multi-host-sharded) state tree.
+    Non-addressable leaves are all-gathered — a COLLECTIVE: every host must
+    call this at the same point (the Trainer builds snapshot payloads on
+    all hosts, then only host 0 writes)."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    def conv(x):
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype,
+                                                  jax.dtypes.prng_key):
+            x = jax.random.key_data(x)
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            from jax.experimental import multihost_utils as mh
+            x = mh.process_allgather(x, tiled=True)
+        return np.asarray(jax.device_get(x))
+
+    return jax.tree.map(conv, tree)

@@ -243,8 +243,10 @@ class Trainer(Logger):
                     and self.decision.rollback_after is not None):
                 # Host-side copy: train_step donates wstate buffers, so an
                 # on-device alias would reference deleted arrays by the time
-                # a rollback happens.
-                self._best_wstate = _to_numpy(self.wstate)
+                # a rollback happens. (All hosts reach this branch — the
+                # decision is identical everywhere — so the collective
+                # gather inside _host_state_copy is safe.)
+                self._best_wstate = self._host_state_copy()
             if self.decision.want_rollback and self._best_wstate is not None:
                 # Reference: rollback to best snapshot + lr drop
                 # (manualrst_veles_algorithms.rst:164). Recompile preserves
@@ -259,12 +261,16 @@ class Trainer(Logger):
             # Advance the loader first so a restored checkpoint resumes at
             # the *next* epoch instead of repeating the completed one.
             self.loader.next_epoch()
-            if self.snapshotter is not None and jax.process_index() == 0:
-                # Only host 0 snapshots (reference: slaves never snapshot,
-                # veles/snapshotter.py:160).
-                self.snapshotter.maybe_save(
-                    f"ep{epoch}", self._payload(),
-                    best=self.decision.improved)
+            if self.snapshotter is not None:
+                # The payload is built on EVERY host — gathering sharded
+                # state is a collective — but only host 0 writes
+                # (reference: slaves never snapshot, veles/snapshotter.py
+                # :160).
+                payload = self._payload()
+                if jax.process_index() == 0:
+                    self.snapshotter.maybe_save(
+                        f"ep{epoch}", payload,
+                        best=self.decision.improved)
             epoch = self.loader.epoch_number
             if stop:
                 break
@@ -281,9 +287,17 @@ class Trainer(Logger):
         })
         return self.results
 
+    def _host_state_copy(self):
+        """Numpy copy of wstate; all-gathers non-addressable (multi-host
+        rule-sharded) leaves — collective, call on every host."""
+        from ..parallel.distributed import gather_to_host, is_multihost
+        if is_multihost():
+            return gather_to_host(self.wstate)
+        return _to_numpy(self.wstate)
+
     def _payload(self) -> Dict[str, Any]:
         return {
-            "wstate": self.wstate,
+            "wstate": self._host_state_copy(),
             "loader": self.loader.state(),
             "decision": self.decision.state(),
             "prng": prng.streams.state(),
