@@ -1,0 +1,48 @@
+"""Host launcher tests (reference analog: veles/tests/test_launcher.py —
+master+slave Launchers driven in one process; here: gang spawn, rank env,
+failure propagation)."""
+
+import subprocess
+import sys
+
+import pytest
+
+from veles_tpu.parallel.launcher import HostLauncher
+
+
+def test_env_assignment():
+    lch = HostLauncher(["localhost", "nodeA", "nodeB"],
+                       coordinator_port=1234)
+    env1 = lch._env_for(1)
+    assert env1 == {"VELES_COORDINATOR": "127.0.0.1:1234",
+                    "VELES_NUM_PROCESSES": "3", "VELES_PROCESS_ID": "1"}
+    remote_first = HostLauncher(["nodeA", "localhost"],
+                                coordinator_port=1234)
+    assert remote_first._env_for(0)["VELES_COORDINATOR"] == "nodeA:1234"
+
+
+def test_local_gang_runs_with_ranks(tmp_path):
+    script = ("import os,sys; print('rank', os.environ['VELES_PROCESS_ID'],"
+              " 'of', os.environ['VELES_NUM_PROCESSES'])")
+    lch = HostLauncher(["localhost", "localhost"])
+    procs = lch.launch([sys.executable, "-c", script])
+    assert lch.wait(timeout=60) == 0
+    assert len(procs) == 2
+
+
+def test_failed_rank_terminates_gang():
+    lch = HostLauncher(["localhost", "localhost"])
+    # rank 0 fails fast; rank 1 would sleep forever.
+    script = ("import os,sys,time\n"
+              "if os.environ['VELES_PROCESS_ID'] == '0': sys.exit(3)\n"
+              "time.sleep(600)\n")
+    lch.launch([sys.executable, "-c", script])
+    code = lch.wait(timeout=60)
+    assert code == 3
+    for p in lch.procs:
+        assert p.poll() is not None  # the sleeper was terminated
+
+
+def test_empty_hosts_rejected():
+    with pytest.raises(ValueError):
+        HostLauncher([" ", ""])

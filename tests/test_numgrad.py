@@ -1,0 +1,113 @@
+"""Numeric-gradient validation of the op library — the reference's NumDiff
+pattern (veles/numpy_ext.py NumDiff; Znicz gradient units were validated
+against central finite differences, SURVEY.md §4). Autodiff replaces the
+hand-written gd_* units, so the check here is jax.grad vs finite
+differences through each op."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from veles_tpu import ops
+
+
+def numdiff(f, x, eps=1e-3):
+    """Central finite differences of a scalar function of one array."""
+    x = np.asarray(x, np.float64)
+    g = np.zeros_like(x)
+    it = np.nditer(x, flags=["multi_index"])
+    for _ in it:
+        idx = it.multi_index
+        xp, xm = x.copy(), x.copy()
+        xp[idx] += eps
+        xm[idx] -= eps
+        g[idx] = (f(xp) - f(xm)) / (2 * eps)
+    return g
+
+
+def check(f, x, rtol=2e-3, atol=2e-4):
+    analytic = np.asarray(
+        jax.grad(lambda a: jnp.sum(f(a) ** 2))(jnp.asarray(x, jnp.float32)),
+        np.float64)
+    numeric = numdiff(lambda a: float(np.sum(
+        np.asarray(f(jnp.asarray(a, jnp.float32)), np.float64) ** 2)), x)
+    np.testing.assert_allclose(analytic, numeric, rtol=rtol, atol=atol)
+
+
+@pytest.fixture
+def x44(rng):
+    return rng.standard_normal((2, 4, 4, 3)).astype(np.float32) * 0.5
+
+
+def test_dense_grad(rng):
+    w = jnp.asarray(rng.standard_normal((6, 4)), jnp.float32) * 0.4
+    b = jnp.asarray(rng.standard_normal(4), jnp.float32) * 0.1
+    x = rng.standard_normal((3, 6)).astype(np.float32)
+    check(lambda a: ops.dense(a, w, b), x)
+    # and w.r.t. the weights
+    xj = jnp.asarray(x)
+    check(lambda wv: ops.dense(xj, wv, b), np.asarray(w))
+
+
+def test_conv2d_grad(x44, rng):
+    w = jnp.asarray(rng.standard_normal((3, 3, 3, 5)), jnp.float32) * 0.3
+    check(lambda a: ops.conv2d(a, w, padding="SAME"), x44,
+          rtol=1e-2, atol=5e-4)
+
+
+def test_deconv2d_grad(x44, rng):
+    w = jnp.asarray(rng.standard_normal((3, 3, 3, 2)), jnp.float32) * 0.3
+    check(lambda a: ops.deconv2d(a, w, stride=2), x44)
+
+
+def test_avg_pool_grad(x44):
+    check(lambda a: ops.avg_pool(a, window=2), x44)
+
+
+def test_max_pool_grad(rng):
+    # Distinct values keep max subgradient unique at the FD probe points.
+    x = (rng.permutation(2 * 4 * 4 * 2).reshape(2, 4, 4, 2)
+         .astype(np.float32)) * 0.1
+    check(lambda a: ops.max_pool(a, window=2), x)
+
+
+def test_lrn_grad(x44):
+    # Covers the band-matmul window sum + rsqrt(y*sqrt(y)) power path.
+    check(lambda a: ops.local_response_norm(a), x44)
+
+
+def test_scaled_tanh_and_sincos_grad(rng):
+    x = rng.standard_normal((3, 6)).astype(np.float32)
+    check(ops.scaled_tanh, x)
+    check(ops.sincos, x)
+
+
+def test_softmax_cross_entropy_grad(rng):
+    logits = rng.standard_normal((4, 5)).astype(np.float32)
+    labels = jnp.asarray([0, 2, 4, 1])
+
+    def f(a):
+        return ops.softmax_cross_entropy(a, labels)[0]
+
+    analytic = np.asarray(jax.grad(lambda a: jnp.sum(f(a)))(
+        jnp.asarray(logits)), np.float64)
+    numeric = numdiff(lambda a: float(np.sum(np.asarray(
+        f(jnp.asarray(a, jnp.float32)), np.float64))), logits)
+    np.testing.assert_allclose(analytic, numeric, rtol=2e-3, atol=2e-4)
+
+
+def test_recurrent_cell_grads(rng):
+    from veles_tpu.ops.recurrent import gru_scan, lstm_scan
+    B, T, I, H = 2, 3, 4, 3
+    # time-major (T, B, F) per the scan layout
+    x = rng.standard_normal((T, B, I)).astype(np.float32) * 0.5
+    h0 = jnp.zeros((B, H), jnp.float32)
+    c0 = jnp.zeros((B, H), jnp.float32)
+    w3 = jnp.asarray(rng.standard_normal((I + H, 3 * H)), jnp.float32) * 0.3
+    b3 = jnp.zeros(3 * H, jnp.float32)
+    check(lambda a: gru_scan(a, h0, w3, b3)[0], x, rtol=5e-3, atol=5e-4)
+    w4 = jnp.asarray(rng.standard_normal((I + H, 4 * H)), jnp.float32) * 0.3
+    b4 = jnp.zeros(4 * H, jnp.float32)
+    check(lambda a: lstm_scan(a, h0, c0, w4, b4)[0], x,
+          rtol=5e-3, atol=5e-4)

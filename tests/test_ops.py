@@ -192,3 +192,43 @@ def test_lr_policies():
     assert float(s(jnp.asarray(0))) == 1.0
     np.testing.assert_allclose(float(s(jnp.asarray(7))), 0.1, rtol=1e-6)
     np.testing.assert_allclose(float(s(jnp.asarray(11))), 0.01, rtol=1e-6)
+
+
+def test_precision_level_config_mapping():
+    """PRECISION_LEVEL parity (reference: ocl/matrix_multiplication.cl
+    summation levels selected via config)."""
+    import jax
+    from veles_tpu.config import root
+    from veles_tpu.ops.linear import config_precision, dense
+
+    orig = getattr(root.common, "precision_level", 0)
+    try:
+        for level, expect in ((0, jax.lax.Precision.DEFAULT),
+                              (1, jax.lax.Precision.HIGH),
+                              (2, jax.lax.Precision.HIGHEST)):
+            root.common.precision_level = level
+            assert config_precision() == expect
+        root.common.precision_level = 2
+        x = jnp.ones((2, 3), jnp.float32)
+        w = jnp.ones((3, 4), jnp.float32)
+        np.testing.assert_allclose(np.asarray(dense(x, w)), 3.0)
+    finally:
+        root.common.precision_level = orig
+
+
+def test_lrn_even_window_matches_reduce_window():
+    """Band-matmul path must agree with the reduce_window fallback for
+    EVEN n (asymmetric window) as well as odd."""
+    import veles_tpu.ops.lrn as lrn_mod
+    rng = np.random.default_rng(5)
+    x = jnp.asarray(rng.standard_normal((3, 12)), jnp.float32)
+    for n in (2, 3, 4, 5):
+        band = lrn_mod.local_response_norm(x, n=n)
+        orig = lrn_mod._BAND_MATMUL_MAX_C
+        try:
+            lrn_mod._BAND_MATMUL_MAX_C = 0  # force reduce_window path
+            ref = lrn_mod.local_response_norm(x, n=n)
+        finally:
+            lrn_mod._BAND_MATMUL_MAX_C = orig
+        np.testing.assert_allclose(np.asarray(band), np.asarray(ref),
+                                   rtol=1e-6, atol=1e-7, err_msg=f"n={n}")

@@ -69,6 +69,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--ensemble-test", metavar="MANIFEST",
                    help="test an ensemble from its manifest JSON")
     p.add_argument("--mesh", help="mesh spec, e.g. data=4,model=2")
+    p.add_argument("--hosts",
+                   help="comma-separated hosts: respawn this command on "
+                        "each via ssh (localhost entries spawn locally) "
+                        "as one SPMD gang (reference: -n slave specs)")
     p.add_argument("--max-epochs", type=int, default=None)
     p.add_argument("--snapshot-dir", default=None)
     p.add_argument("--frontend", action="store_true",
@@ -215,6 +219,19 @@ def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     setup_logging(level=10 if args.verbose else 20)
 
+    import os
+    if args.hosts and "VELES_PROCESS_ID" not in os.environ:
+        # Launcher role: respawn this exact command on every host with
+        # rank env vars (children skip this branch — they carry
+        # VELES_PROCESS_ID). Reference: Launcher SSH slave spawn,
+        # veles/launcher.py:808-842.
+        from .parallel.launcher import launch_hosts
+        return launch_hosts(args.hosts.split(","), argv)
+    # Joins the multi-host process group when VELES_* are set (no-op
+    # standalone).
+    from .parallel.distributed import initialize_distributed
+    initialize_distributed()
+
     if args.list_units:
         from .units.base import UnitRegistry
         for name in UnitRegistry.names():
@@ -308,8 +325,10 @@ def main(argv=None) -> int:
     results = trainer.run()
     print(json.dumps(results))
     if args.result_file:
-        with open(args.result_file, "w") as f:
-            json.dump(results, f, indent=1)
+        import jax
+        if jax.process_index() == 0:  # one writer per gang (cf. master's
+            with open(args.result_file, "w") as f:  # --result-file)
+                json.dump(results, f, indent=1)
     return 0
 
 

@@ -238,6 +238,7 @@ root = Config()
 
 def _defaults():
     root.common.precision_type = "float32"   # host/reference dtype
+    root.common.precision_level = 0          # 0 fast | 1 high | 2 highest (ref PRECISION_LEVEL)
     root.common.compute_dtype = "bfloat16"   # MXU-friendly on-device dtype
     root.common.timings = False
     root.common.trace_file = ""              # JSONL event trace target

@@ -9,6 +9,8 @@ so Pallas is reserved for fused exotica (see ops/pallas_kernels.py).
 
 from __future__ import annotations
 
+from .linear import config_precision
+
 import jax
 import jax.numpy as jnp
 
@@ -38,7 +40,8 @@ def conv2d(x, w, b=None, *, stride=1, padding="SAME", precision=None,
         padding = ((p[0], p[0]), (p[1], p[1]))
     y = jax.lax.conv_general_dilated(
         x, w, window_strides=_pair(stride), padding=padding,
-        dimension_numbers=DIMS, precision=precision,
+        dimension_numbers=DIMS,
+        precision=config_precision() if precision is None else precision,
         preferred_element_type=preferred)
     y = y.astype(out_dtype)
     if b is not None:
@@ -61,7 +64,8 @@ def deconv2d(x, w, b=None, *, stride=1, padding="SAME", precision=None,
         padding = ((p[0], p[0]), (p[1], p[1]))
     y = jax.lax.conv_transpose(
         x, w, strides=_pair(stride), padding=padding,
-        dimension_numbers=DIMS, precision=precision,
+        dimension_numbers=DIMS,
+        precision=config_precision() if precision is None else precision,
         preferred_element_type=preferred)
     y = y.astype(out_dtype)
     if b is not None:

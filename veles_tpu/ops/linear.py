@@ -15,6 +15,21 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+_PRECISION_LEVELS = {0: jax.lax.Precision.DEFAULT,
+                     1: jax.lax.Precision.HIGH,
+                     2: jax.lax.Precision.HIGHEST}
+
+
+def config_precision():
+    """Map the reference's summation PRECISION_LEVEL (0 = fast, 1 = Kahan,
+    2 = multi-partial; ocl/matrix_multiplication.cl, selected via
+    root.common.precision config) onto lax.Precision for every matmul/conv
+    in the package. On TPU: 0 = bf16 MXU passes, 1/2 = extra passes for
+    f32-grade accumulation."""
+    from ..config import root
+    level = getattr(root.common, "precision_level", 0)
+    return _PRECISION_LEVELS.get(int(level), jax.lax.Precision.DEFAULT)
+
 
 def dense(x, w, b=None, *, precision=None, compute_dtype=None):
     """y = x @ w + b with f32 accumulation.
@@ -30,7 +45,7 @@ def dense(x, w, b=None, *, precision=None, compute_dtype=None):
         w = w.astype(compute_dtype)
     y = jax.lax.dot_general(
         x, w, (((x.ndim - 1,), (0,)), ((), ())),
-        precision=precision,
+        precision=config_precision() if precision is None else precision,
         preferred_element_type=jnp.float32)
     y = y.astype(out_dtype)
     if b is not None:

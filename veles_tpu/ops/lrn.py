@@ -25,8 +25,12 @@ def _window_sum(sq, n: int):
     half = n // 2
     if c <= _BAND_MATMUL_MAX_C:
         idx = jnp.arange(c)
-        band = (jnp.abs(idx[:, None] - idx[None, :]) <= half
-                ).astype(sq.dtype)
+        # Asymmetric window of exactly n: out_i sums sq[j] for
+        # j - i in [-half, n-1-half], matching the reduce_window pad
+        # below for even n too. In sq @ band, band[j, i] pairs row j with
+        # output i, and (idx[None,:]-idx[:,None])[j, i] = i - j.
+        diff = idx[None, :] - idx[:, None]
+        band = ((diff >= -(n - 1 - half)) & (diff <= half)).astype(sq.dtype)
         return jax.lax.dot_general(
             sq.reshape(-1, c), band, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32).reshape(sq.shape)

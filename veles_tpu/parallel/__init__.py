@@ -2,6 +2,7 @@ from .mesh import (MeshSpec, make_mesh, data_parallel_rules, fsdp_rules,
                    tensor_parallel_rules, batch_shardings, state_shardings,
                    compose_rules)
 from .distributed import initialize_distributed, is_multihost, host_count
+from .launcher import HostLauncher, launch_hosts
 from .ring_attention import ring_attention, blockwise_attention
 from .pipeline import (pipeline_apply, stack_stage_params,
                        pipeline_stage_shardings)

@@ -1,0 +1,112 @@
+"""Host launcher: spawn one training process per host.
+
+Reference parity: the Launcher SSH-spawned slaves from ``-n
+host/device:0-3x2`` specs and owned their lifecycle
+(veles/launcher.py:617,808-842, respawn veles/server.py:637-655).
+
+TPU redesign: there is no master — the launcher starts N identical SPMD
+processes (local ``subprocess`` for localhost entries, ``ssh`` otherwise),
+handing each its rank via the VELES_* environment that
+``initialize_distributed`` reads. Host 0's machine doubles as the JAX
+coordinator. Failure semantics follow SURVEY.md §5.3: if any process dies,
+the launcher terminates the rest (gang scheduling) and reports — recovery
+is checkpoint-restart, not slave respawn."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from typing import Dict, List, Optional, Sequence
+
+from ..logger import Logger
+
+_LOCAL = {"localhost", "127.0.0.1", ""}
+
+
+class HostLauncher(Logger):
+    """Launch ``command`` on every host with rank env vars set."""
+
+    def __init__(self, hosts: Sequence[str], *, coordinator_port: int = 9428,
+                 ssh_args: Optional[Sequence[str]] = None):
+        self.hosts = [h.strip() for h in hosts if h.strip()]
+        if not self.hosts:
+            raise ValueError("no hosts")
+        self.coordinator_port = coordinator_port
+        self.ssh_args = list(ssh_args or ("-o", "BatchMode=yes"))
+        self.procs: List[subprocess.Popen] = []
+
+    def _env_for(self, rank: int) -> Dict[str, str]:
+        coord_host = ("127.0.0.1" if self.hosts[0] in _LOCAL
+                      else self.hosts[0])
+        return {
+            "VELES_COORDINATOR": f"{coord_host}:{self.coordinator_port}",
+            "VELES_NUM_PROCESSES": str(len(self.hosts)),
+            "VELES_PROCESS_ID": str(rank),
+        }
+
+    def launch(self, command: Sequence[str]) -> List[subprocess.Popen]:
+        """Start the command on every host; returns the process handles
+        (remote hosts run under ssh)."""
+        for rank, host in enumerate(self.hosts):
+            env_vars = self._env_for(rank)
+            if host in _LOCAL:
+                env = dict(os.environ)
+                env.update(env_vars)
+                proc = subprocess.Popen(list(command), env=env)
+            else:
+                import shlex
+                exports = " ".join(f"{k}={v}" for k, v in env_vars.items())
+                remote = (f"cd {shlex.quote(os.getcwd())} && {exports} "
+                          + " ".join(shlex.quote(c) for c in command))
+                proc = subprocess.Popen(
+                    ["ssh", *self.ssh_args, host, remote])
+            self.info("rank %d on %s: pid %d", rank, host or "localhost",
+                      proc.pid)
+            self.procs.append(proc)
+        return self.procs
+
+    def wait(self, timeout: Optional[float] = None) -> int:
+        """Wait for all ranks, polling EVERY process — a failure in any
+        rank must be seen even while another rank hangs in a collective
+        waiting for it (SPMD is gang-scheduled; a lone survivor never
+        exits on its own). On the first non-zero exit the rest are
+        terminated. Returns the first non-zero exit code, else 0."""
+        import time as _time
+        deadline = None if timeout is None else _time.monotonic() + timeout
+        failed = 0
+        pending = list(self.procs)
+        while pending:
+            progressed = False
+            for proc in list(pending):
+                code = proc.poll()
+                if code is None:
+                    continue
+                progressed = True
+                pending.remove(proc)
+                if code != 0 and failed == 0:
+                    failed = code
+                    self.warning("rank %d exited %d; terminating the gang",
+                                 self.procs.index(proc), code)
+                    for other in pending:
+                        other.terminate()
+            if pending and not progressed:
+                if deadline is not None and _time.monotonic() > deadline:
+                    self.terminate()
+                    raise subprocess.TimeoutExpired(
+                        "gang", timeout if timeout is not None else 0)
+                _time.sleep(0.05)
+        return failed
+
+    def terminate(self):
+        for proc in self.procs:
+            if proc.poll() is None:
+                proc.terminate()
+
+
+def launch_hosts(hosts: Sequence[str], argv: Sequence[str], *,
+                 coordinator_port: int = 9428) -> int:
+    """One-shot: spawn ``python -m veles_tpu <argv>`` per host and wait."""
+    launcher = HostLauncher(hosts, coordinator_port=coordinator_port)
+    launcher.launch([sys.executable, "-m", "veles_tpu", *argv])
+    return launcher.wait()

@@ -107,17 +107,26 @@ class Snapshotter(Logger):
         self._counter = 0
         self.last_path: Optional[str] = None
 
-    def maybe_save(self, tag: str, payload: Dict[str, Any], *,
-                   best: bool = False) -> Optional[str]:
-        """Throttled save (reference: veles/snapshotter.py:159-174)."""
+    def tick(self, *, best: bool = False) -> bool:
+        """Advance the throttle and report whether this epoch snapshots
+        (reference: veles/snapshotter.py:159-174). Deterministic given the
+        call sequence — on multi-host every host ticks identically, so
+        all hosts can agree to skip the (collective) payload gather."""
         self._counter += 1
         now = time.time()
         if not best:
             if self._counter % max(self.interval, 1) != 0:
-                return None
+                return False
             if now - self._last_time < self.time_interval:
-                return None
+                return False
         self._last_time = now
+        return True
+
+    def maybe_save(self, tag: str, payload: Dict[str, Any], *,
+                   best: bool = False) -> Optional[str]:
+        """Throttled save."""
+        if not self.tick(best=best):
+            return None
         return self.save(tag, payload, best=best)
 
     def save(self, tag: str, payload: Dict[str, Any], *,

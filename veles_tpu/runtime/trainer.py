@@ -86,6 +86,13 @@ class Trainer(Logger):
                 else jax.random.key(seed)
             self.wstate = self.workflow.init_state(key, self.optimizer)
         from ..parallel.distributed import host_count, is_multihost
+        if (is_multihost() and self.snapshotter is not None
+                and self.snapshotter.time_interval > 0):
+            raise ValueError(
+                "time_interval snapshot throttling is wall-clock and can "
+                "diverge across hosts (the payload gather is a collective "
+                "every host must join); use epoch-interval throttling on "
+                "multi-host runs")
         if self.mesh is not None and is_multihost():
             # Each host serves a local shard; the compiled step sees the
             # GLOBAL batch (host shards stitched on the data axis by
@@ -261,16 +268,21 @@ class Trainer(Logger):
             # Advance the loader first so a restored checkpoint resumes at
             # the *next* epoch instead of repeating the completed one.
             self.loader.next_epoch()
-            if self.snapshotter is not None:
-                # The payload is built on EVERY host — gathering sharded
-                # state is a collective — but only host 0 writes
-                # (reference: slaves never snapshot, veles/snapshotter.py
-                # :160).
+            if (self.snapshotter is not None
+                    and self.snapshotter.tick(best=self.decision.improved)):
+                # tick() is deterministic across hosts, so throttled
+                # epochs skip the payload entirely (no wasted device→host
+                # copy). On a snapshot epoch the payload is built on EVERY
+                # host — gathering sharded state is a collective — but
+                # only host 0 writes (reference: slaves never snapshot,
+                # veles/snapshotter.py:160). Multi-host runs must give
+                # every host a snapshotter with the same interval;
+                # wall-clock time_interval throttling can diverge across
+                # hosts and is rejected at initialize().
                 payload = self._payload()
                 if jax.process_index() == 0:
-                    self.snapshotter.maybe_save(
-                        f"ep{epoch}", payload,
-                        best=self.decision.improved)
+                    self.snapshotter.save(f"ep{epoch}", payload,
+                                          best=self.decision.improved)
             epoch = self.loader.epoch_number
             if stop:
                 break
