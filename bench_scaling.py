@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""Scaling-efficiency harness: AlexNet data-parallel throughput over
+1..N chips (BASELINE.json north star: scaling efficiency 1→8 chips).
+
+On a multi-chip host it measures real ICI scaling; on a single chip it
+reports n/a for >1 (the sharded step itself is validated on the virtual
+CPU mesh by __graft_entry__.dryrun_multichip and tests/test_parallel.py —
+this harness exists so a multi-chip round can produce the BASELINE.md
+scaling row unchanged).
+
+Prints one JSON line:
+  {"metric": "alexnet_scaling", "points": [{"chips": n, "samples_per_sec":
+   s, "efficiency": e}, ...]}
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+PER_CHIP_BATCH = 256
+ITERS = 20
+
+
+def measure(n_chips: int) -> float:
+    import jax
+    import jax.numpy as jnp
+    import veles_tpu as vt
+    from veles_tpu.models import alexnet_workflow
+    from veles_tpu.parallel import MeshSpec, make_mesh
+
+    batch = PER_CHIP_BATCH * n_chips
+    sw = alexnet_workflow(minibatch_size=batch)
+    wf = sw.workflow
+    specs = {"@input": vt.Spec((batch, 227, 227, 3), jnp.float32),
+             "@labels": vt.Spec((batch,), jnp.int32),
+             "@mask": vt.Spec((batch,), jnp.float32)}
+    wf.build(specs)
+    wstate = wf.init_state(jax.random.key(0), sw.optimizer)
+    mesh = make_mesh(MeshSpec(data=n_chips),
+                     devices=jax.devices()[:n_chips])
+    step, state_sh, batch_sh = wf.make_sharded_train_step(
+        sw.optimizer, mesh, wstate, specs)
+    wstate = jax.device_put(wstate, state_sh)
+    rng = np.random.default_rng(0)
+    batches = []
+    for _ in range(2):
+        host = {"@input": rng.standard_normal(
+                    (batch, 227, 227, 3)).astype(np.float32),
+                "@labels": (np.arange(batch) % 1000).astype(np.int32),
+                "@mask": np.ones(batch, np.float32)}
+        batches.append(jax.device_put(host, batch_sh))
+    for i in range(3):
+        wstate, mets = step(wstate, batches[i % 2])
+    float(mets["loss"])  # drain (see bench.py)
+    t0 = time.perf_counter()
+    for i in range(ITERS):
+        wstate, mets = step(wstate, batches[i % 2])
+    float(mets["loss"])
+    return batch * ITERS / (time.perf_counter() - t0)
+
+
+def main():
+    import jax
+    avail = len(jax.devices())
+    points = []
+    base = None
+    n = 1
+    while n <= avail:
+        sps = measure(n)
+        if base is None:
+            base = sps
+        points.append({"chips": n, "samples_per_sec": round(sps, 1),
+                       "efficiency": round(sps / (base * n), 4)})
+        n *= 2
+    print(json.dumps({"metric": "alexnet_scaling",
+                      "device": str(jax.devices()[0]),
+                      "available_chips": avail,
+                      "points": points,
+                      "note": None if avail > 1 else
+                      "single chip visible; >1-chip rows need multi-chip "
+                      "hardware (sharded step validated on virtual mesh)"}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -11,11 +11,18 @@ from veles_tpu.parallel.launcher import HostLauncher
 
 
 def test_env_assignment():
+    import socket
+    # Mixed local/remote: remote ranks must get a reachable name for this
+    # machine, never their own loopback.
     lch = HostLauncher(["localhost", "nodeA", "nodeB"],
                        coordinator_port=1234)
     env1 = lch._env_for(1)
-    assert env1 == {"VELES_COORDINATOR": "127.0.0.1:1234",
-                    "VELES_NUM_PROCESSES": "3", "VELES_PROCESS_ID": "1"}
+    assert env1 == {
+        "VELES_COORDINATOR": f"{socket.gethostname()}:1234",
+        "VELES_NUM_PROCESSES": "3", "VELES_PROCESS_ID": "1"}
+    all_local = HostLauncher(["localhost", "localhost"],
+                             coordinator_port=1234)
+    assert all_local._env_for(0)["VELES_COORDINATOR"] == "127.0.0.1:1234"
     remote_first = HostLauncher(["nodeA", "localhost"],
                                 coordinator_port=1234)
     assert remote_first._env_for(0)["VELES_COORDINATOR"] == "nodeA:1234"

@@ -128,3 +128,25 @@ def test_socket_loader_feeds_batches():
                                   np.sort(samples.ravel()))
     labels = np.concatenate([b["@labels"][b["@mask"] > 0] for b in batches])
     assert sorted(labels.tolist()) == [0, 0, 1, 1, 2, 2]
+
+
+def test_image_rotation_and_background(image_tree):
+    """Rotation + background-fill augmentation (reference:
+    veles/loader/image.py rotation/background blending)."""
+    loader = FileImageLoader(
+        train_paths=[str(image_tree / "train")],
+        scale=(16, 16), rotations=(0.0, 15.0, -15.0), background=128.0,
+        minibatch_size=4)
+    loader.initialize()
+    b_e0 = next(loader.iter_epoch(TRAIN, 0))
+    assert b_e0["@input"].shape == (4, 16, 16, 3)
+    # deterministic per (epoch, index): same epoch reproduces exactly
+    b_e0b = next(loader.iter_epoch(TRAIN, 0))
+    np.testing.assert_array_equal(b_e0["@input"], b_e0b["@input"])
+    # un-rotated loader differs (rotation actually applied for some draw)
+    plain = FileImageLoader(
+        train_paths=[str(image_tree / "train")],
+        scale=(16, 16), minibatch_size=4)
+    plain.initialize()
+    p_e0 = next(plain.iter_epoch(TRAIN, 0))
+    assert not np.array_equal(b_e0["@input"], p_e0["@input"])

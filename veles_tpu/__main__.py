@@ -281,8 +281,10 @@ def main(argv=None) -> int:
         out = {"best_fitness": best.fitness, "best_genome": best.genome}
         print(json.dumps(out))
         if args.result_file:
-            with open(args.result_file, "w") as f:
-                json.dump({**out, "history": ga.history}, f, indent=1)
+            import jax
+            if jax.process_index() == 0:  # one writer per gang
+                with open(args.result_file, "w") as f:
+                    json.dump({**out, "history": ga.history}, f, indent=1)
         return 0
 
     # -- ensemble train (reference --ensemble-train N:r) -------------------

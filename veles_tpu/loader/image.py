@@ -32,17 +32,25 @@ class ImageLoader(Loader):
     """Base for image loaders: decode → scale → crop → (mirror) → float.
 
     scale: (W, H) target;  crop: (W, H) center crop after scale;
-    mirror: "random" | True | False;  grayscale: collapse channels.
+    mirror: "random" | True | False;  grayscale: collapse channels;
+    rotations: sequence of degrees to sample per train image (reference:
+    rotation augmentation, veles/loader/image.py:106);
+    background: None | float | array — fill revealed by rotation/crop
+    (reference: background blending).
     """
 
     def __init__(self, scale: Tuple[int, int] = (32, 32),
                  crop: Optional[Tuple[int, int]] = None,
-                 mirror=False, grayscale: bool = False, **kw):
+                 mirror=False, grayscale: bool = False,
+                 rotations: Optional[Tuple[float, ...]] = None,
+                 background=None, **kw):
         super().__init__(**kw)
         self.scale = tuple(scale)
         self.crop = tuple(crop) if crop else None
         self.mirror = mirror
         self.grayscale = grayscale
+        self.rotations = tuple(rotations) if rotations else None
+        self.background = background
 
     # -- subclass contract: sample keys ------------------------------------
     def get_image_paths(self, klass: int) -> List[str]:
@@ -62,8 +70,35 @@ class ImageLoader(Loader):
             arr = arr[..., None]
         return arr
 
+    def _bg_value(self, arr: np.ndarray):
+        if self.background is None:
+            return 0.0
+        if np.isscalar(self.background):
+            return float(self.background)
+        return np.asarray(self.background, np.float32)
+
     def augment(self, arr: np.ndarray, index: int, epoch: int,
                 klass: int) -> np.ndarray:
+        if self.rotations and klass == TRAIN:
+            rng = np.random.Generator(np.random.PCG64(
+                [self.subset_seed, epoch, index, 0x207A7E]))
+            deg = float(self.rotations[rng.integers(len(self.rotations))])
+            if deg:
+                Image = _pil()
+                bg = self._bg_value(arr)
+                if arr.ndim == 3 and arr.shape[-1] == 3:
+                    # broadcast a scalar to all 3 channels — a 1-tuple
+                    # fillcolor would paint (bg, 0, 0)
+                    fill = tuple(int(v) for v in np.broadcast_to(
+                        np.atleast_1d(bg), (3,)))
+                else:
+                    fill = int(np.mean(bg))
+                im = Image.fromarray(arr.astype(np.uint8).squeeze())
+                im = im.rotate(deg, resample=Image.BILINEAR,
+                               fillcolor=fill)
+                arr = np.asarray(im, np.float32)
+                if arr.ndim == 2:
+                    arr = arr[..., None]
         if self.crop:
             cw, ch = self.crop
             h, w = arr.shape[:2]

@@ -33,12 +33,21 @@ class HostLauncher(Logger):
         if not self.hosts:
             raise ValueError("no hosts")
         self.coordinator_port = coordinator_port
-        self.ssh_args = list(ssh_args or ("-o", "BatchMode=yes"))
+        # -tt forces a pty so terminating the ssh client HUPs the remote
+        # process — without it "terminate the gang" would only kill the
+        # local ssh while the remote rank keeps holding its chips.
+        self.ssh_args = list(ssh_args or ("-o", "BatchMode=yes", "-tt"))
         self.procs: List[subprocess.Popen] = []
 
     def _env_for(self, rank: int) -> Dict[str, str]:
-        coord_host = ("127.0.0.1" if self.hosts[0] in _LOCAL
-                      else self.hosts[0])
+        if self.hosts[0] in _LOCAL:
+            # With remote ranks in the gang, "127.0.0.1" would point each
+            # one at ITS OWN loopback; give them this machine's name.
+            any_remote = any(h not in _LOCAL for h in self.hosts)
+            import socket
+            coord_host = socket.gethostname() if any_remote else "127.0.0.1"
+        else:
+            coord_host = self.hosts[0]
         return {
             "VELES_COORDINATOR": f"{coord_host}:{self.coordinator_port}",
             "VELES_NUM_PROCESSES": str(len(self.hosts)),

@@ -231,9 +231,21 @@ class Trainer(Logger):
         samples_done = 0
         epoch = self.loader.epoch_number
         while not self.decision.complete:
+            t_ep = time.time()
             train_mets = self._run_epoch_train(epoch)
+            t_train = time.time()
             samples_done += int(train_mets.get("n_samples", 0))
             valid_mets = self._run_epoch_eval(VALID, epoch)
+            if root.common.timings:
+                # reference: per-unit/root.common.timings wall prints
+                # (veles/units.py:144-149); per-unit attribution needs the
+                # instrumented Workflow.profile_units mode.
+                self.info(
+                    "epoch %d timings: train %.3fs (%.0f samples/s), "
+                    "eval %.3fs", epoch, t_train - t_ep,
+                    train_mets.get("n_samples", 0.0)
+                    / max(t_train - t_ep, 1e-9),
+                    time.time() - t_train)
             stop = self.decision.on_epoch(epoch, train_mets, valid_mets)
             if self.recorder is not None:
                 self.recorder.record(
