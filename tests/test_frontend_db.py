@@ -1,5 +1,7 @@
 """Frontend form + sqlite snapshot target tests."""
 
+import re
+import urllib.error
 import urllib.request
 
 import numpy as np
@@ -41,12 +43,40 @@ def test_frontend_http_roundtrip():
         url = f"http://127.0.0.1:{fe.port}/"
         page = urllib.request.urlopen(url, timeout=10).read().decode()
         assert "compose a run" in page
-        data = b"config=wf.py&max_epochs=3"
+        # The anti-CSRF token is embedded in the form; a legitimate
+        # same-origin submit echoes it back.
+        m = re.search(r'name="_token" value="([^"]+)"', page)
+        assert m, "form must embed the CSRF token"
+        data = f"_token={m.group(1)}&config=wf.py&max_epochs=3".encode()
         resp = urllib.request.urlopen(
             urllib.request.Request(url, data=data), timeout=10)
         assert b"Launched" in resp.read()
         argv = fe.wait(10)
         assert argv == ["wf.py", "--max-epochs", "3"]
+    finally:
+        fe.close()
+
+
+def test_frontend_rejects_cross_origin_post():
+    """A drive-by cross-origin POST carries no token — must not launch."""
+    fe = Frontend(build_parser(), port=0)
+    try:
+        url = f"http://127.0.0.1:{fe.port}/"
+        req = urllib.request.Request(url, data=b"config=evil.py")
+        try:
+            urllib.request.urlopen(req, timeout=10)
+            raise AssertionError("tokenless POST must be rejected")
+        except urllib.error.HTTPError as e:
+            assert e.code == 403
+        assert fe.wait(0.05) is None  # nothing launched
+        # Wrong Host header (DNS-rebinding shape) is rejected too.
+        req = urllib.request.Request(url, data=b"x=1",
+                                     headers={"Host": "evil.example"})
+        try:
+            urllib.request.urlopen(req, timeout=10)
+            raise AssertionError("foreign Host must be rejected")
+        except urllib.error.HTTPError as e:
+            assert e.code == 403
     finally:
         fe.close()
 

@@ -1,0 +1,133 @@
+"""Wire-format tests: the pickle-free socket serializer must round-trip
+every payload the graphics/loader channels emit and refuse anything that
+could execute code (advisor r1 finding on the old pickle framing)."""
+
+import pickle
+import struct
+
+import numpy as np
+import pytest
+
+from veles_tpu import wire
+
+
+def test_roundtrip_nested():
+    payload = {
+        "kind": "metrics", "step": 3, "ok": True, "none": None,
+        "values": {"loss": 0.5, "err": 7.0},
+        "list": [1, "two", 3.0, [4]],
+        "arr": np.arange(12, dtype=np.int16).reshape(3, 4),
+        "f64": np.linspace(0, 1, 5),
+        "scalar": np.float32(2.5),
+    }
+    out = wire.loads(wire.dumps(payload))
+    assert out["kind"] == "metrics" and out["ok"] is True
+    assert out["none"] is None and out["list"] == [1, "two", 3.0, [4]]
+    assert out["arr"].dtype == np.int16
+    np.testing.assert_array_equal(out["arr"], payload["arr"])
+    np.testing.assert_allclose(out["f64"], payload["f64"])
+    assert out["scalar"] == pytest.approx(2.5)
+
+
+def test_empty_and_zero_size_arrays():
+    out = wire.loads(wire.dumps({"e": np.zeros((0, 4)), "d": {}}))
+    assert out["e"].shape == (0, 4) and out["d"] == {}
+
+
+def test_rejects_pickle_bytes():
+    with pytest.raises(wire.WireError):
+        wire.loads(pickle.dumps({"x": 1}))
+
+
+def test_rejects_unserializable_types():
+    with pytest.raises(wire.WireError):
+        wire.dumps({"fn": len})
+    with pytest.raises(wire.WireError):
+        wire.dumps({"obj": np.array([object()], dtype=object)})
+    with pytest.raises(wire.WireError):
+        wire.dumps({1: "non-string key"})
+    with pytest.raises(wire.WireError):
+        wire.dumps({"\x00nd": "reserved prefix"})
+
+
+def test_rejects_truncated_and_hostile_frames():
+    body = wire.dumps({"a": np.ones(8)})
+    with pytest.raises(wire.WireError):
+        wire.loads(body[:-5])  # truncated buffer
+    with pytest.raises(wire.WireError):
+        wire.loads(body[:6])  # shorter than the fixed header
+    # hostile header lengths
+    with pytest.raises(wire.WireError):
+        wire.loads(struct.pack("<II", 2 ** 31, 9) + b"x" * 32)
+    # hostile buffer index in the structure header
+    evil = (b'{"\\u0000nd":99,"dtype":"<f8","shape":[1]}')
+    sizes = b"[8]"
+    frame = (struct.pack("<II", len(evil), len(sizes))
+             + evil + sizes + b"\x00" * 8)
+    with pytest.raises(wire.WireError):
+        wire.loads(frame)
+
+
+def test_object_dtype_refused_on_decode():
+    evil = b'{"\\u0000nd":0,"dtype":"|O","shape":[1]}'
+    sizes = b"[8]"
+    frame = (struct.pack("<II", len(evil), len(sizes))
+             + evil + sizes + b"\x00" * 8)
+    with pytest.raises(wire.WireError):
+        wire.loads(frame)
+
+
+def test_malformed_array_headers_raise_wireerror_only():
+    """Any malformed frame must surface as WireError (module contract) —
+    never a raw ValueError/KeyError that kills a renderer loop."""
+    # shape product disagrees with the buffer
+    evil = b'{"\\u0000nd":0,"dtype":"<f8","shape":[2]}'
+    sizes = b"[8]"
+    frame = (struct.pack("<II", len(evil), len(sizes))
+             + evil + sizes + b"\x00" * 8)
+    with pytest.raises(wire.WireError):
+        wire.loads(frame)
+    # missing dtype key
+    evil = b'{"\\u0000nd":0,"shape":[1]}'
+    frame = (struct.pack("<II", len(evil), len(sizes))
+             + evil + sizes + b"\x00" * 8)
+    with pytest.raises(wire.WireError):
+        wire.loads(frame)
+    # non-numeric shape entry
+    evil = b'{"\\u0000nd":0,"dtype":"<f8","shape":["x"]}'
+    frame = (struct.pack("<II", len(evil), len(sizes))
+             + evil + sizes + b"\x00" * 8)
+    with pytest.raises(wire.WireError):
+        wire.loads(frame)
+
+
+def test_structured_object_dtype_refused_on_encode():
+    rec = np.empty(2, dtype=[("a", "O"), ("b", "<i4")])
+    with pytest.raises(wire.WireError):
+        wire.dumps({"x": rec})
+
+
+def test_hostile_size_table_entries():
+    body = wire.dumps({"a": np.ones(4)})
+    hlen, slen = struct.unpack("<II", body[:8])
+    header = body[8:8 + hlen]
+    for bad_sizes in (b'["x"]', b"[null]", b"[-1]"):
+        frame = (struct.pack("<II", hlen, len(bad_sizes))
+                 + header + bad_sizes + body[8 + hlen + slen:])
+        with pytest.raises(wire.WireError):
+            wire.loads(frame)
+
+
+def test_oversize_publish_dropped_not_crashed():
+    """publish() must drop undeliverable frames, never raise into the
+    training loop (PUB guarantee)."""
+    from veles_tpu import graphics
+    from veles_tpu.graphics import GraphicsServer
+    server = GraphicsServer()
+    old = wire.MAX_FRAME
+    wire.MAX_FRAME = 1024
+    try:
+        server.publish({"big": np.zeros(4096)})  # larger than the cap
+    finally:
+        wire.MAX_FRAME = old
+        server.close()

@@ -8,11 +8,18 @@ TPU rebuild: the form is generated straight from the argparse parser
 (every option becomes a field, choices become selects, store_true become
 checkboxes) and served by stdlib http.server on localhost; the POST handler
 converts fields back into an argv list and hands it to ``main`` — no
-Tornado, no static bundle, same workflow."""
+Tornado, no static bundle, same workflow.
+
+Cross-origin hardening (advisor r1): a ``.py`` config path in the form is
+*executed*, so a drive-by cross-origin POST from any web page must not be
+able to start a run.  The served form embeds a per-process random token;
+POSTs without it are rejected (a foreign origin cannot read the form to
+learn the token), and the Host header must be local."""
 
 from __future__ import annotations
 
 import html
+import secrets
 import threading
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, HTTPServer
@@ -28,9 +35,12 @@ button { margin-top: 1.2em; padding: .5em 2em; }
 """
 
 
-def render_form(parser) -> str:
+def render_form(parser, token: str = "") -> str:
     """HTML form generated from the argparse parser's actions."""
     rows = []
+    if token:
+        rows.append(f'<input type="hidden" name="_token" '
+                    f'value="{html.escape(token)}">')
     for action in parser._actions:
         if action.dest in ("help", "frontend"):
             continue
@@ -87,12 +97,38 @@ class Frontend(Logger):
     def __init__(self, parser, port: int = 8080, host: str = "127.0.0.1"):
         self.parser = parser
         self.argv: Optional[List[str]] = None
+        self.token = secrets.token_urlsafe(24)
         self._done = threading.Event()
         frontend = self
 
         class Handler(BaseHTTPRequestHandler):
+            def _host_ok(self):
+                # The Host check only defends loopback binds against DNS
+                # rebinding; an explicit non-loopback bind is reachable
+                # under names we cannot enumerate — there the token is
+                # the sole (and sufficient) launch guard.
+                if host not in ("127.0.0.1", "localhost", "::1"):
+                    return True
+                raw = (self.headers.get("Host") or "").strip()
+                if raw.startswith("["):  # bracketed IPv6, maybe with port
+                    req_host = raw[1:].split("]", 1)[0]
+                else:
+                    req_host = raw.split(":")[0]
+                return req_host in ("127.0.0.1", "localhost", "::1", host)
+
+            def _reject(self, code, msg):
+                body = msg.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_GET(self):
-                body = render_form(frontend.parser).encode()
+                if not self._host_ok():
+                    return self._reject(403, "bad Host header")
+                body = render_form(frontend.parser,
+                                   frontend.token).encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "text/html; charset=utf-8")
                 self.send_header("Content-Length", str(len(body)))
@@ -100,9 +136,17 @@ class Frontend(Logger):
                 self.wfile.write(body)
 
             def do_POST(self):
-                length = int(self.headers.get("Content-Length", 0))
-                fields = urllib.parse.parse_qs(
-                    self.rfile.read(length).decode())
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    fields = urllib.parse.parse_qs(
+                        self.rfile.read(length).decode())
+                except (ValueError, UnicodeDecodeError):
+                    return self._reject(400, "malformed body")
+                if not self._host_ok():
+                    return self._reject(403, "bad Host header")
+                sent = fields.pop("_token", [""])[0]
+                if not secrets.compare_digest(sent, frontend.token):
+                    return self._reject(403, "missing/invalid form token")
                 frontend.argv = form_to_argv(frontend.parser, fields)
                 body = (b"<html><body><h3>Launched.</h3><pre>" +
                         html.escape(" ".join(frontend.argv)).encode() +

@@ -8,25 +8,27 @@ with matplotlib (veles/graphics_client.py:84).
 TPU redesign: the payloads are tiny host-side scalars/arrays (metrics,
 confusion matrices, weight tiles) published *outside* the jit step — the
 device pipeline is never synced for plotting.  Transport is a plain TCP
-fan-out socket (stdlib; no zmq dependency): length-prefixed pickle frames,
+fan-out socket (stdlib; no zmq dependency): length-prefixed frames in the
+pickle-free :mod:`veles_tpu.wire` format (JSON header + raw array bytes),
 PUB semantics — slow or dead subscribers are dropped, never block training
-(the reference used ZMQ PUB for exactly this property).  Pickle crosses a
-trust boundary only on localhost, same as the reference's design.
+(the reference used ZMQ PUB for exactly this property).  Unlike the
+reference's pickle streams, a hostile peer can at worst inject wrong
+numbers, never code.
 
 Run a renderer:  ``python -m veles_tpu.graphics <endpoint> --out plots/``
 """
 
 from __future__ import annotations
 
-import pickle
 import socket
 import struct
 import threading
 from typing import Dict, List, Optional
 
+from . import wire
 from .logger import Logger
 
-_MAGIC = b"VTPL"  # frame: magic + u32 length + pickle
+_MAGIC = b"VTPL"  # frame: magic + u32 length + wire body
 
 
 def _send_frame(sock: socket.socket, payload: bytes) -> None:
@@ -48,10 +50,12 @@ def recv_frame(sock: socket.socket):
     if head is None or head[:4] != _MAGIC:
         return None
     (length,) = struct.unpack("<I", head[4:])
+    if length > wire.MAX_FRAME:
+        raise wire.WireError(f"frame length {length} exceeds cap")
     body = _recv_exact(sock, length)
     if body is None:
         return None
-    return pickle.loads(body)
+    return wire.loads(body)
 
 
 class GraphicsServer(Logger):
@@ -88,7 +92,14 @@ class GraphicsServer(Logger):
     def publish(self, payload: Dict) -> None:
         """Broadcast one payload; drop subscribers that can't keep up
         (PUB semantics — plotting never blocks training)."""
-        data = pickle.dumps(payload, protocol=4)
+        data = wire.dumps(payload)
+        if len(data) > wire.MAX_FRAME:
+            # Receivers cap frames at MAX_FRAME; silently shipping an
+            # undeliverable frame (or overflowing the u32 length prefix)
+            # must never crash or stall the training loop.
+            self.warning("payload of %d bytes exceeds frame cap; dropped",
+                         len(data))
+            return
         with self._lock:
             dead = []
             for s in self._subs:
@@ -148,7 +159,13 @@ class GraphicsClient(Logger):
         sock = subscribe(self.endpoint)
         n = 0
         while max_payloads is None or n < max_payloads:
-            payload = recv_frame(sock)
+            try:
+                payload = recv_frame(sock)
+            except wire.WireError as e:
+                # Frame boundary is lost after a corrupt frame: drop the
+                # connection, keep the renderer process alive.
+                self.warning("dropping connection on bad frame: %s", e)
+                break
             if payload is None:
                 break
             self.handle(payload)

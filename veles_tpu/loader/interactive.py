@@ -93,15 +93,15 @@ class QueueLoader(Loader):
 
 
 class SocketLoader(QueueLoader):
-    """Network job queue: a TCP listener feeds the queue with pickled
-    sample frames (reference: ZeroMQLoader's ROUTER socket job queue,
+    """Network job queue: a TCP listener feeds the queue with sample
+    frames (reference: ZeroMQLoader's ROUTER socket job queue,
     veles/zmq_loader.py:74-138 — the Mastodon/Hadoop contact point).
 
-    Frames use the package's length-prefixed pickle framing
-    (veles_tpu.graphics): each frame is ``{"input": array, "label": int?}``
-    or ``{"kind": "close"}`` to end the stream.  Pickle crosses a trust
-    boundary only on localhost/cluster-internal links, as in the
-    reference."""
+    Frames use the package's length-prefixed framing with the pickle-free
+    ``veles_tpu.wire`` serializer (JSON header + raw array bytes): each
+    frame is ``{"input": array, "label": int?}`` or ``{"kind": "close"}``
+    to end the stream.  A hostile peer can inject bogus samples but never
+    execute code — unlike the reference's pickled ZMQ payloads."""
 
     def __init__(self, input_shape, minibatch_size=1, *, port: int = 0,
                  host: str = "127.0.0.1", **kw):
@@ -175,8 +175,8 @@ class SocketLoader(QueueLoader):
 def feed_socket(endpoint: str, samples, labels=None, *,
                 close: bool = False) -> None:
     """Producer-side helper: push samples to a SocketLoader endpoint."""
-    import pickle
     import socket as _socket
+    from .. import wire
     from ..graphics import _send_frame  # single source of the framing
     assert endpoint.startswith("tcp://"), endpoint
     host, _, port = endpoint[6:].partition(":")
@@ -186,8 +186,8 @@ def feed_socket(endpoint: str, samples, labels=None, *,
             frame = {"input": np.asarray(sample, np.float32)}
             if labels is not None:
                 frame["label"] = int(labels[i])
-            _send_frame(sock, pickle.dumps(frame, protocol=4))
+            _send_frame(sock, wire.dumps(frame))
         if close:
-            _send_frame(sock, pickle.dumps({"kind": "close"}, protocol=4))
+            _send_frame(sock, wire.dumps({"kind": "close"}))
     finally:
         sock.close()

@@ -15,6 +15,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from .linear import config_precision
+
 # Above this channel count the C×C band matrix stops being "almost free";
 # fall back to reduce_window.
 _BAND_MATMUL_MAX_C = 2048
@@ -31,8 +33,15 @@ def _window_sum(sq, n: int):
         # output i, and (idx[None,:]-idx[:,None])[j, i] = i - j.
         diff = idx[None, :] - idx[:, None]
         band = ((diff >= -(n - 1 - half)) & (diff <= half)).astype(sq.dtype)
+        # The C×C band contraction is cheap; never let a DEFAULT bf16 MXU
+        # pass truncate the f32 squared activations (advisor r1). Honour
+        # the precision_level knob, but floor it at HIGH.
+        prec = config_precision()
+        if prec == jax.lax.Precision.DEFAULT:
+            prec = jax.lax.Precision.HIGH
         return jax.lax.dot_general(
             sq.reshape(-1, c), band, (((1,), (0,)), ((), ())),
+            precision=prec,
             preferred_element_type=jnp.float32).reshape(sq.shape)
     pads = [(0, 0)] * (sq.ndim - 1) + [(half, n - 1 - half)]
     return jax.lax.reduce_window(
