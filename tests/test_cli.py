@@ -88,3 +88,98 @@ def test_cli_list_units(tmp_path):
     r = run_cli(tmp_path, "--list-units")
     assert r.returncode == 0, r.stderr
     assert "All2AllSoftmax" in r.stdout and "KohonenForward" in r.stdout
+
+
+def test_cli_visualize(tmp_path, config_file):
+    dot = tmp_path / "graph.dot"
+    r = run_cli(tmp_path, config_file, "--visualize", str(dot),
+                "--dry-run", "build")
+    assert r.returncode == 0, r.stderr
+    src = dot.read_text()
+    assert "digraph" in src and '"fc1"' in src and '"@labels"' in src
+
+
+def test_cli_background_daemonizes(tmp_path, config_file):
+    import time
+    res = tmp_path / "res.json"
+    r = run_cli(tmp_path, config_file, "--background",
+                "--background-log", str(tmp_path / "bg.log"),
+                "--result-file", str(res))
+    assert r.returncode == 0, r.stderr
+    pid = json.loads(r.stdout.strip().splitlines()[-1])["daemon_pid"]
+    assert pid > 0
+    for _ in range(600):  # the detached daemon finishes the 2-epoch run
+        if res.exists() and res.read_text().strip():
+            break
+        time.sleep(0.5)
+    data = json.loads(res.read_text())
+    assert data["workflow"] == "cli_test"
+
+
+GA_CONFIG_PY = CONFIG_PY.replace(
+    'root.my.lr = root.my.get("lr", 0.05)',
+    'from veles_tpu.config import Range\n'
+    'if "lr" not in root.my:\n'
+    '    root.my.lr = Range(0.05, 0.005, 0.2)')
+
+
+def test_cli_optimize_parallel_workers(tmp_path):
+    """--optimize with --workers N farms each chromosome to a standalone
+    CLI subprocess (reference slave farm-out,
+    veles/genetics/optimization_workflow.py)."""
+    cfg = tmp_path / "ga.py"
+    cfg.write_text(GA_CONFIG_PY)
+    res = tmp_path / "ga_res.json"
+    r = run_cli(tmp_path, str(cfg), "--optimize", "3:2", "--workers", "3",
+                "--result-file", str(res))
+    assert r.returncode == 0, r.stderr
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["best_fitness"] < 60.0
+    assert "my.lr" in out["best_genome"]
+    hist = json.loads(res.read_text())["history"]
+    assert len(hist) == 2
+
+
+def test_cli_ensemble_train_parallel_workers(tmp_path, config_file):
+    """--ensemble-train with --workers: members run as concurrent
+    standalone CLI trainings (reference:
+    veles/ensemble/base_workflow.py:135-143)."""
+    out = tmp_path / "ens"
+    r = run_cli(tmp_path, config_file, "--ensemble-train", "2:0.8",
+                "--workers", "2", "--snapshot-dir", str(out))
+    assert r.returncode == 0, r.stderr
+    manifest = json.loads((out / "ensemble.json").read_text())
+    assert len(manifest) == 2
+    for m in manifest:
+        assert m["best_value"] is not None and m["best_value"] < 60.0
+        assert m["snapshot"] and os.path.exists(m["snapshot"])
+
+
+def test_snapshot_http_restore(tmp_path):
+    """http(s):// snapshot source (reference: veles/__main__.py:539-589)."""
+    import functools
+    import http.server
+    import threading
+
+    import numpy as np
+    from veles_tpu.runtime.snapshotter import Snapshotter
+
+    snap = Snapshotter("wf", str(tmp_path), interval=1)
+    wstate = {"params": {"fc": {"w": np.arange(6.).reshape(2, 3)}},
+              "step": np.int64(7)}
+    snap.save("ep1", {"wstate": wstate, "loader": {"epoch": 1},
+                      "decision": {}, "workflow_checksum": "abc"})
+    handler = functools.partial(http.server.SimpleHTTPRequestHandler,
+                                directory=str(tmp_path))
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        port = srv.server_address[1]
+        name = [f for f in os.listdir(tmp_path)
+                if f.endswith(".json") and "current" not in f][0]
+        payload = Snapshotter.load(f"http://127.0.0.1:{port}/{name}")
+        np.testing.assert_array_equal(
+            payload["wstate"]["params"]["fc"]["w"], wstate["params"]["fc"]["w"])
+        assert payload["workflow_checksum"] == "abc"
+    finally:
+        srv.shutdown()

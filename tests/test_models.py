@@ -39,18 +39,34 @@ def test_per_layer_hyperparams_reach_optimizer():
 
 
 def test_mnist_workflow_trains():
-    sw = mnist_workflow(minibatch_size=100,
-                        max_epochs=3, fail_iterations=5)
+    # small SynthDigits subset for CI speed; the full-size 60k/10k run with
+    # the reference schedule is the BASELINE.md quality-bar run.
+    sw = mnist_workflow(minibatch_size=100, max_epochs=4,
+                        fail_iterations=5,
+                        loader_args={"n_train": 6000, "n_valid": 1000})
     assert sw.loader.synthetic  # no real MNIST in this environment
     trainer = sw.make_trainer(sw.loader)
     trainer.initialize(seed=0)
     trainer.run()
-    # synthetic digits are easily separable: expect near-zero error
-    assert trainer.decision.best_value < 10.0
+    assert trainer.decision.best_value < 15.0
+
+
+def test_synth_digits_deterministic():
+    from veles_tpu.models.synth_data import synth_digits
+    a = synth_digits(64, 16, cache=False)
+    b = synth_digits(64, 16, cache=False)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+    # classes must be visually distinct: mean images differ
+    means = np.stack([a[0][a[1] == c].mean(0) for c in range(10)])
+    d = np.abs(means[:, None] - means[None, :]).mean((-1, -2))
+    assert (d[np.triu_indices(10, 1)] > 5).all()
 
 
 def test_mnist_ae_trains():
-    sw = mnist_autoencoder_workflow(minibatch_size=100, max_epochs=2)
+    sw = mnist_autoencoder_workflow(
+        minibatch_size=100, max_epochs=2,
+        loader_args={"n_train": 3000, "n_valid": 500})
     trainer = sw.make_trainer(sw.loader)
     trainer.initialize(seed=0)
     trainer.run()
@@ -60,7 +76,8 @@ def test_mnist_ae_trains():
 
 
 def test_cifar_workflow_single_step():
-    sw = cifar_workflow(minibatch_size=32)
+    sw = cifar_workflow(minibatch_size=32,
+                        loader_args={"n_train": 512, "n_valid": 128})
     wf = sw.workflow
     wf.build({"@input": vt.Spec((32, 32, 32, 3), jnp.float32),
               "@labels": vt.Spec((32,), jnp.int32),

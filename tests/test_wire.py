@@ -131,3 +131,20 @@ def test_oversize_publish_dropped_not_crashed():
     finally:
         wire.MAX_FRAME = old
         server.close()
+
+
+def test_deeply_nested_header_raises_wireerror():
+    """A hostile header that passes json.loads but would blow the decode
+    stack must surface as WireError (receivers catch only WireError)."""
+    import json as _json
+    import struct as _struct
+    header = ("[" * 4000) + "1" + ("]" * 4000)
+    try:
+        _json.loads(header)  # some json builds cap nesting; then moot
+    except RecursionError:
+        pytest.skip("stdlib json already rejects this depth")
+    body = header.encode()
+    sizes = b"[]"
+    frame = _struct.pack("<II", len(body), len(sizes)) + body + sizes
+    with pytest.raises(wire.WireError):
+        wire.loads(frame)

@@ -56,7 +56,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "to resume")
     p.add_argument("overrides", nargs="*", default=[],
                    help="inline config overrides: path.to.key=value")
-    p.add_argument("--snapshot", help="snapshot manifest to restore from")
+    p.add_argument("--snapshot",
+                   help="snapshot manifest to restore from: a file path, "
+                        "sqlite://db#id, or http(s):// manifest URL")
+    p.add_argument("--visualize", metavar="PATH",
+                   help="write the workflow DOT graph here (and PATH.svg "
+                        "when graphviz is installed), then continue "
+                        "(reference: veles --visualize)")
+    p.add_argument("--background", action="store_true",
+                   help="daemonize: detach from the terminal and keep "
+                        "training (reference: veles --background); logs "
+                        "go to --background-log")
+    p.add_argument("--background-log", default="veles_tpu.log",
+                   help="log file for --background mode")
     p.add_argument("--random-seed", type=int, default=None)
     p.add_argument("--dump-config", action="store_true")
     p.add_argument("--dry-run", choices=["init", "build"], default=None,
@@ -64,6 +76,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--result-file", help="write results JSON here")
     p.add_argument("--optimize", metavar="N[:G]",
                    help="GA over config Range tuneables: population[:gens]")
+    p.add_argument("--workers", type=int, default=1,
+                   help="parallel evaluation workers for --optimize / "
+                        "--ensemble-train: each evaluation runs as a "
+                        "standalone CLI subprocess on a pool this size "
+                        "(reference: slave farm-out). Workers default to "
+                        "CPU (JAX_PLATFORMS=cpu) so they don't fight over "
+                        "one TPU chip")
     p.add_argument("--ensemble-train", metavar="N:r",
                    help="train N members on ratio-r subsets")
     p.add_argument("--ensemble-test", metavar="MANIFEST",
@@ -192,6 +211,49 @@ def _forge_main(argv) -> int:
     return 0
 
 
+def _daemonize(log_path: str) -> int:
+    """Double-fork daemonization. Returns the daemon pid in the original
+    process, 0 in the daemon (which has stdio redirected to ``log_path``),
+    -1 if the intermediate child died before reporting a pid."""
+    import os
+    r, w = os.pipe()
+    pid = os.fork()
+    if pid > 0:  # original process
+        os.close(w)
+        data = os.read(r, 32)
+        os.close(r)
+        os.waitpid(pid, 0)
+        return int(data) if data else -1
+    os.close(r)
+    os.setsid()
+    pid2 = os.fork()
+    if pid2 > 0:  # session leader: report the grandchild and vanish
+        os.write(w, str(pid2).encode())
+        os._exit(0)
+    os.close(w)
+    os.environ["VELES_DAEMONIZED"] = "1"
+    fd = os.open(log_path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+    null = os.open(os.devnull, os.O_RDONLY)
+    os.dup2(null, 0)
+    os.dup2(fd, 1)
+    os.dup2(fd, 2)
+    os.close(fd)
+    os.close(null)
+    return 0
+
+
+def _write_graph(workflow, path: str) -> None:
+    """Dump the workflow DOT (reference: --visualize rendered the graph;
+    here it lands as files: PATH and PATH.svg when graphviz is around)."""
+    with open(path, "w") as f:
+        f.write(workflow.generate_graph())
+    import shutil
+    import subprocess
+    if shutil.which("dot"):
+        subprocess.run(["dot", "-Tsvg", path, "-o", path + ".svg"],
+                       check=False)
+
+
 def main(argv=None) -> int:
     if argv is None:
         argv = sys.argv[1:]
@@ -217,9 +279,21 @@ def main(argv=None) -> int:
             return 1
         return main(composed)
     args = build_parser().parse_args(argv)
-    setup_logging(level=10 if args.verbose else 20)
 
     import os
+    if args.background and "VELES_DAEMONIZED" not in os.environ:
+        # Classic double-fork daemonization (reference: veles --background,
+        # veles/external/daemon). Must happen BEFORE any XLA client exists:
+        # forking a process with live device handles corrupts them.
+        pid = _daemonize(args.background_log)
+        if pid > 0:  # launcher process: report the daemon pid and leave
+            print(json.dumps({"daemon_pid": pid}))
+            return 0
+        if pid < 0:  # intermediate child died before reporting
+            print("daemonization failed", file=sys.stderr)
+            return 1
+    setup_logging(level=10 if args.verbose else 20)
+
     if args.hosts and "VELES_PROCESS_ID" not in os.environ:
         # Launcher role: respawn this exact command on every host with
         # rank env vars (children skip this branch — they carry
@@ -266,17 +340,30 @@ def main(argv=None) -> int:
 
     # -- GA mode (reference --optimize, veles/__main__.py:716-734) ---------
     if args.optimize:
-        from .genetics import GeneticOptimizer
+        from .genetics import GeneticOptimizer, SubprocessEvaluator
         n, _, g = args.optimize.partition(":")
 
-        def fitness(cfg: Config) -> float:
-            t = trainer_factory(cfg)
-            t.initialize()
-            t.run()
-            return t.decision.best_value
+        fitness, evaluator = None, None
+        if args.workers > 1:
+            # Reference farm-out: every chromosome is a standalone run on
+            # the worker pool (veles/genetics/optimization_workflow.py).
+            extra = list(args.overrides)
+            if args.max_epochs:
+                extra += ["--max-epochs", str(args.max_epochs)]
+            if args.random_seed is not None:
+                extra += ["--random-seed", str(args.random_seed)]
+            evaluator = SubprocessEvaluator(
+                extra, base_config=args.config, n_workers=args.workers)
+        else:
+            def fitness(cfg: Config) -> float:
+                t = trainer_factory(cfg)
+                t.initialize()
+                t.run()
+                return t.decision.best_value
 
         ga = GeneticOptimizer(root, fitness, population_size=int(n),
-                              generations=int(g) if g else 10)
+                              generations=int(g) if g else 10,
+                              evaluator=evaluator)
         best = ga.run()
         out = {"best_fitness": best.fitness, "best_genome": best.genome}
         print(json.dumps(out))
@@ -292,30 +379,47 @@ def main(argv=None) -> int:
         from .ensemble import EnsembleTrainer
         n, _, r = args.ensemble_train.partition(":")
 
-        def member_factory(member_id, seed, train_ratio):
-            root.common.random_seed = seed
-            prng.streams.reset()
-            # Standard-path loaders accept bagging args via the Loader base;
-            # create()-style configs must honor root.loader themselves.
-            root.loader.train_ratio = train_ratio
-            root.loader.subset_seed = seed
-            return trainer_factory(root)
+        member_factory, cli_argv = None, None
+        if args.workers > 1:
+            # Reference farm-out: each member is a standalone CLI run
+            # (veles/ensemble/base_workflow.py:135-143).
+            cli_argv = [args.config, *args.overrides]
+            if args.max_epochs:
+                cli_argv += ["--max-epochs", str(args.max_epochs)]
+        else:
+            def member_factory(member_id, seed, train_ratio):
+                root.common.random_seed = seed
+                prng.streams.reset()
+                # Standard-path loaders accept bagging args via the Loader
+                # base; create()-style configs must honor root.loader
+                # themselves.
+                root.loader.train_ratio = train_ratio
+                root.loader.subset_seed = seed
+                return trainer_factory(root)
 
         et = EnsembleTrainer(member_factory, int(n),
                              float(r) if r else 0.8,
-                             out_dir=args.snapshot_dir or "ensemble")
+                             out_dir=args.snapshot_dir or "ensemble",
+                             n_workers=args.workers, cli_argv=cli_argv)
         results = et.run()
         print(json.dumps({"members": len(results)}))
         return 0
 
     # -- standalone training ------------------------------------------------
     trainer = trainer_factory(root)
+    if args.snapshot_dir and trainer.snapshotter is None:
+        # create()-style configs get the CLI snapshot dir too (the standard
+        # path wires this inside _make_trainer_from_root)
+        trainer.snapshotter = Snapshotter(trainer.workflow.name,
+                                          args.snapshot_dir)
     if args.dry_run == "init":
         trainer.loader.initialize()
         print(json.dumps({"dry_run": "init",
                           "class_lengths": trainer.loader.class_lengths}))
         return 0
     trainer.initialize()
+    if args.visualize:
+        _write_graph(trainer.workflow, args.visualize)
     if args.dry_run == "build":
         print(json.dumps({"dry_run": "build",
                           "checksum": trainer.workflow.checksum(),

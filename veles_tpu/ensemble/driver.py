@@ -7,8 +7,12 @@ then test by weighted vote over the stored snapshots
 per-model results consumed by veles/loader/ensemble.py:53-143).
 
 Redesign: the reference exec'd a standalone ``veles`` subprocess per model
-on each slave; here each member is an in-process training (already
-device-parallel), parameterized by (seed, subset)."""
+on each slave (base_workflow.py:135-143). The rebuild offers both shapes:
+in-process members via ``member_factory`` (each training already
+device-parallel), and the reference's farm-out via ``cli_argv`` +
+``n_workers`` — every member becomes a standalone CLI run on a bounded
+subprocess pool (parallel/pool.py), subset/seed injected as inline config
+overrides."""
 
 from __future__ import annotations
 
@@ -30,18 +34,34 @@ class EnsembleTrainer(Logger):
     Trainer (workflow+loader+optimizer wired); the loader should subsample
     its train set with the given ratio/seed."""
 
-    def __init__(self, member_factory: Callable, n_models: int,
+    def __init__(self, member_factory: Optional[Callable], n_models: int,
                  train_ratio: float = 0.8, *, base_seed: int = 1000,
-                 out_dir: str = "ensemble"):
+                 out_dir: str = "ensemble", n_workers: int = 1,
+                 cli_argv: Optional[Sequence[str]] = None,
+                 env: Optional[Dict[str, str]] = None):
+        if member_factory is None and cli_argv is None:
+            raise ValueError("need member_factory or cli_argv")
         self.member_factory = member_factory
         self.n_models = n_models
         self.train_ratio = train_ratio
         self.base_seed = base_seed
         self.out_dir = out_dir
+        self.n_workers = max(int(n_workers), 1)
+        self.cli_argv = list(cli_argv) if cli_argv is not None else None
+        self.env = env
         self.results: List[dict] = []
 
     def run(self) -> List[dict]:
         os.makedirs(self.out_dir, exist_ok=True)
+        if self.cli_argv is not None:
+            self._run_subprocess_members()
+        else:
+            self._run_inprocess_members()
+        with open(os.path.join(self.out_dir, "ensemble.json"), "w") as f:
+            json.dump(self.results, f, indent=1, default=repr)
+        return self.results
+
+    def _run_inprocess_members(self) -> None:
         for m in range(self.n_models):
             seed = self.base_seed + m
             trainer = self.member_factory(m, seed, self.train_ratio)
@@ -55,9 +75,45 @@ class EnsembleTrainer(Logger):
             self.results.append(entry)
             self.info("member %d/%d: best=%.4f", m + 1, self.n_models,
                       trainer.decision.best_value)
-        with open(os.path.join(self.out_dir, "ensemble.json"), "w") as f:
-            json.dump(self.results, f, indent=1, default=repr)
-        return self.results
+
+    def _run_subprocess_members(self) -> None:
+        """Reference farm-out: each member is a standalone CLI training
+        (veles/ensemble/base_workflow.py:135-143) on the worker pool."""
+        from ..parallel.pool import CliRunner
+        jobs = []
+        for m in range(self.n_models):
+            seed = self.base_seed + m
+            member_dir = os.path.join(self.out_dir, f"member{m}")
+            jobs.append([
+                *self.cli_argv,
+                f"loader.train_ratio={self.train_ratio}",
+                f"loader.subset_seed={seed}",
+                "--random-seed", str(seed),
+                "--snapshot-dir", member_dir,
+            ])
+        runner = CliRunner(n_workers=self.n_workers, env=self.env)
+        for m, res in enumerate(runner.run_jobs(jobs)):
+            member_dir = os.path.join(self.out_dir, f"member{m}")
+            snap_path = None
+            if os.path.isdir(member_dir):
+                for link in ("_best.json", "_current.json"):
+                    cands = [f for f in os.listdir(member_dir)
+                             if f.endswith(link)]
+                    if cands:
+                        snap_path = os.path.realpath(
+                            os.path.join(member_dir, cands[0]))
+                        break
+            entry = {"id": m, "seed": self.base_seed + m,
+                     "snapshot": snap_path,
+                     "best_value": res.get("best_value"),
+                     "results": res}
+            self.results.append(entry)
+            if "error" in res:
+                self.warning("member %d failed: %s", m,
+                             str(res["error"])[:300])
+            else:
+                self.info("member %d/%d: best=%s", m + 1, self.n_models,
+                          res.get("best_value"))
 
 
 class EnsembleTester(Logger):

@@ -145,7 +145,12 @@ class Frontend(Logger):
                 if not self._host_ok():
                     return self._reject(403, "bad Host header")
                 sent = fields.pop("_token", [""])[0]
-                if not secrets.compare_digest(sent, frontend.token):
+                # compare bytes: compare_digest raises TypeError on
+                # non-ASCII str input (a malformed POST must get the same
+                # clean 403 as every other rejection)
+                if not secrets.compare_digest(
+                        sent.encode("utf-8", "surrogatepass"),
+                        frontend.token.encode("utf-8")):
                     return self._reject(403, "missing/invalid form token")
                 frontend.argv = form_to_argv(frontend.parser, fields)
                 body = (b"<html><body><h3>Launched.</h3><pre>" +

@@ -1,1 +1,2 @@
-from .core import GeneticOptimizer, Individual
+from .core import (GeneticOptimizer, Individual,
+                   SubprocessEvaluator)

@@ -8,15 +8,23 @@ selection, multiple crossover and mutation operators
 training run (optimization_workflow.py:70-339).
 
 Redesign: evaluations are a plain ``fitness_fn(config) -> float`` callback
-(lower = better, e.g. validation error). The reference farmed evaluations to
-slaves over ZMQ; here the natural parallel axis is sequential evaluations of
-*device-parallel* trainings (each training already fills the mesh), so the
-GA loop stays simple and deterministic."""
+(lower = better, e.g. validation error). The reference farmed evaluations
+to slaves over ZMQ (optimization_workflow.py:70-339); the rebuild keeps the
+farm-out as an optional ``evaluator`` hook that receives the whole batch of
+unevaluated configs per generation: ``SubprocessEvaluator`` runs each
+config as a standalone CLI training on a bounded worker pool
+(parallel/pool.py), which is exactly the reference's
+one-standalone-run-per-chromosome semantic without the master/slave
+plumbing. The default stays the sequential in-process loop (one training
+already fills the device mesh)."""
 
 from __future__ import annotations
 
 import dataclasses
+import json
 import math
+import os
+import tempfile
 from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
@@ -41,18 +49,24 @@ class GeneticOptimizer(Logger):
     """
 
     def __init__(self, config: Config,
-                 fitness_fn: Callable[[Config], float], *,
+                 fitness_fn: Optional[Callable[[Config], float]] = None, *,
                  population_size: int = 16, generations: int = 10,
                  elite: int = 2, crossover_rate: float = 0.9,
                  mutation_rate: float = 0.15,
                  selection: str = "tournament",
                  tournament_k: int = 3, seed: int = 0,
-                 on_generation: Optional[Callable] = None):
+                 on_generation: Optional[Callable] = None,
+                 evaluator: Optional[Callable[
+                     [List[Config], List[Dict[str, object]]],
+                     Sequence[float]]] = None):
         self.config = config
         self.tuneables = collect_tuneables(config)
         if not self.tuneables:
             raise ValueError("config contains no Range tuneables")
+        if fitness_fn is None and evaluator is None:
+            raise ValueError("need fitness_fn or evaluator")
         self.fitness_fn = fitness_fn
+        self.evaluator = evaluator
         self.population_size = population_size
         self.generations = generations
         self.elite = elite
@@ -145,11 +159,29 @@ class GeneticOptimizer(Logger):
             cfg.set_path(p, v)
         return cfg
 
-    def _evaluate(self, ind: Individual):
-        if ind.evaluated:
+    def _evaluate_all(self, pop: List[Individual]) -> None:
+        """Evaluate every not-yet-evaluated individual — as one batch when
+        an ``evaluator`` is installed (parallel farm-out), else one by one
+        through ``fitness_fn``."""
+        todo = [i for i in pop if not i.evaluated]
+        if not todo:
             return
-        ind.fitness = float(self.fitness_fn(self.materialize(ind.genome)))
-        ind.evaluated = True
+        cfgs = [self.materialize(i.genome) for i in todo]
+        if self.evaluator is not None:
+            # contract: evaluator(materialized_configs, genomes) — genomes
+            # let override-style evaluators rerun the original config file
+            # with path=value args instead of dumping whole configs.
+            fits = self.evaluator(cfgs, [i.genome for i in todo])
+            if len(fits) != len(todo):
+                raise ValueError(
+                    f"evaluator returned {len(fits)} fitnesses for "
+                    f"{len(todo)} configs; score failed runs as math.inf "
+                    "instead of dropping them")
+        else:
+            fits = [self.fitness_fn(c) for c in cfgs]
+        for ind, fit in zip(todo, fits):
+            ind.fitness = float(fit)
+            ind.evaluated = True
 
     # -- main loop ----------------------------------------------------------
     def run(self) -> Individual:
@@ -157,8 +189,7 @@ class GeneticOptimizer(Logger):
             self.random_individual()
             for _ in range(self.population_size - 1)]
         for gen in range(self.generations):
-            for ind in pop:
-                self._evaluate(ind)
+            self._evaluate_all(pop)
             pop.sort(key=lambda i: i.fitness)
             if self.best is None or pop[0].fitness < self.best.fitness:
                 self.best = dataclasses.replace(pop[0])
@@ -184,3 +215,65 @@ class GeneticOptimizer(Logger):
                 nxt.append(self.mutate(child))
             pop = nxt
         return self.best
+
+
+class SubprocessEvaluator(Logger):
+    """Farm chromosome evaluations out as standalone CLI trainings.
+
+    With ``base_config`` (a workflow config file path), each genome becomes
+    ``python -m veles_tpu <base_config> path=value ... [extra_argv...]`` —
+    inline overrides, so executed-Python configs with ``create()`` keep
+    working. Without it, each materialized config is dumped to a temp JSON
+    and run directly. Runs land on a bounded pool of ``n_workers``
+    subprocesses (parallel/pool.py CliRunner) — the reference's
+    one-standalone-run-per-chromosome farm-out (reference:
+    veles/genetics/optimization_workflow.py:70-339) without master/slave
+    plumbing. Fitness = the run's ``best_value``; failed runs score +inf
+    (the reference likewise dropped failed evaluations rather than
+    aborting the GA)."""
+
+    def __init__(self, extra_argv: Sequence[str] = (), *,
+                 base_config: Optional[str] = None,
+                 n_workers: int = 1, env: Optional[Dict[str, str]] = None,
+                 fitness_key: str = "best_value",
+                 timeout: Optional[float] = None):
+        from ..parallel.pool import CliRunner
+        self.extra_argv = list(extra_argv)
+        self.base_config = base_config
+        self.fitness_key = fitness_key
+        self.runner = CliRunner(n_workers=n_workers, env=env,
+                                timeout=timeout)
+
+    def __call__(self, configs: List[Config],
+                 genomes: Optional[List[Dict[str, object]]] = None
+                 ) -> List[float]:
+        paths, jobs = [], []
+        if self.base_config is not None and genomes is not None:
+            for genome in genomes:
+                ovs = [f"{p}={json.dumps(v)}" for p, v in genome.items()]
+                jobs.append([self.base_config, *ovs, *self.extra_argv])
+        else:
+            for cfg in configs:
+                fd, path = tempfile.mkstemp(prefix="veles_ga_",
+                                            suffix=".json")
+                with os.fdopen(fd, "w") as f:
+                    json.dump(cfg.to_dict(), f)
+                paths.append(path)
+                jobs.append([path, *self.extra_argv])
+        try:
+            results = self.runner.run_jobs(jobs)
+        finally:
+            for p in paths:
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+        fits = []
+        for res in results:
+            if "error" in res or self.fitness_key not in res:
+                self.warning("evaluation failed: %s",
+                             res.get("error", "no fitness in result")[:300])
+                fits.append(math.inf)
+            else:
+                fits.append(float(res[self.fitness_key]))
+        return fits

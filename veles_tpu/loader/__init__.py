@@ -6,3 +6,4 @@ from .interactive import QueueLoader
 from .saver import MinibatchesLoader, MinibatchesSaver
 from .ext import (CsvLoader, EnsembleResultsLoader, PicklesLoader,
                   WavLoader, read_wav)
+from .hdfs import HdfsTextLoader, WebHdfsClient

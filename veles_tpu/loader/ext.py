@@ -25,6 +25,7 @@ import json
 import os
 import pickle
 import wave
+from urllib.parse import urlparse
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -150,10 +151,11 @@ class WavLoader(Loader):
 class CsvLoader(Loader):
     """Delimited text -> float feature rows, optional label column.
 
-    ``sources[klass]`` is a filesystem path or an open text-file object.
-    ``hdfs://`` URLs are recognized but gated: this environment has no
-    hadoop client (reference required one: veles/loader/hdfs_loader.py:48);
-    the error message says exactly that instead of a random IOError.
+    ``sources[klass]`` is a filesystem path, an open text-file object, or a
+    ``webhdfs://namenode:port/path`` URL read through the WebHDFS REST
+    gateway (loader/hdfs.py — the rebuild of the reference's snakebite
+    HDFS loader, veles/loader/hdfs_loader.py:48). Bare ``hdfs://`` (native
+    RPC) stays gated with an explanatory error pointing at webhdfs.
     """
 
     def __init__(self, sources: Dict[int, object], delimiter: str = ",",
@@ -170,13 +172,20 @@ class CsvLoader(Loader):
 
     def _read_rows(self, src) -> List[List[str]]:
         if isinstance(src, str):
-            if src.startswith("hdfs://"):
+            if src.startswith("webhdfs://"):
+                from .hdfs import WebHdfsClient
+                u = urlparse(src)
+                lines = list(WebHdfsClient(
+                    f"http://{u.netloc}").text(u.path))
+            elif src.startswith("hdfs://"):
                 raise LoaderError(
-                    "hdfs:// sources need a hadoop client, which is not "
-                    "available in this environment; copy the file locally "
-                    "(reference analog: veles/loader/hdfs_loader.py)")
-            with open(src, "r") as f:
-                lines = f.read().splitlines()
+                    "hdfs:// (native RPC) needs a hadoop client; use a "
+                    "webhdfs://namenode:port/path URL instead (WebHDFS "
+                    "REST gateway, loader/hdfs.py; reference analog: "
+                    "veles/loader/hdfs_loader.py)")
+            else:
+                with open(src, "r") as f:
+                    lines = f.read().splitlines()
         else:
             lines = src.read().splitlines()
         if self.skip_header and lines:

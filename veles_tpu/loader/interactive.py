@@ -186,7 +186,15 @@ def feed_socket(endpoint: str, samples, labels=None, *,
             frame = {"input": np.asarray(sample, np.float32)}
             if labels is not None:
                 frame["label"] = int(labels[i])
-            _send_frame(sock, wire.dumps(frame))
+            data = wire.dumps(frame)
+            if len(data) > wire.MAX_FRAME:
+                # The receiving SocketLoader caps frames at MAX_FRAME and
+                # drops the connection on violation — which would silently
+                # discard every later sample; fail loudly at the producer.
+                raise ValueError(
+                    f"sample {i} serializes to {len(data)} bytes, over the "
+                    f"wire frame cap ({wire.MAX_FRAME})")
+            _send_frame(sock, data)
         if close:
             _send_frame(sock, wire.dumps({"kind": "close"}))
     finally:

@@ -24,10 +24,11 @@ MNIST_AE_CONFIG = {
 }
 
 
-def mnist_autoencoder_workflow(minibatch_size=100,
+def mnist_autoencoder_workflow(minibatch_size=100, loader_args=None,
                                **overrides) -> StandardWorkflow:
     cfg = dict(MNIST_AE_CONFIG)
     cfg.update(overrides)
     sw = StandardWorkflow(cfg)
-    sw.loader = MnistLoader(minibatch_size=minibatch_size)
+    sw.loader = MnistLoader(minibatch_size=minibatch_size,
+                            **(loader_args or {}))
     return sw

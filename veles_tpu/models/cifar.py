@@ -3,8 +3,10 @@
 Reference: Znicz CIFAR conv net, 17.21 % validation error target
 (reference: docs manualrst_veles_algorithms.rst:52) — a caffe-style
 conv32-pool-conv32-pool-conv64-pool-fc stack. Real CIFAR-10 batches load
-from local files when present; synthetic class-structured images otherwise
-(no network egress)."""
+from local files when present; otherwise the full-size fixed-seed
+SynthShapes procedural dataset (models/synth_data.py) stands in — 50k/10k
+SDF-rendered shape images calibrated so the conv bar (17.21 % val error)
+is meaningful. See BASELINE.md."""
 
 from __future__ import annotations
 
@@ -45,32 +47,24 @@ def load_real_cifar() -> Optional[Tuple[np.ndarray, ...]]:
     return None
 
 
-def synthesize_cifar(n_train=5000, n_valid=1000, seed=99):
-    rng = np.random.default_rng(seed)
-    coarse = rng.standard_normal((10, 8, 8, 3))
-    templates = np.repeat(np.repeat(coarse, 4, 1), 4, 2) * 48 + 128
-
-    def gen(n):
-        lab = rng.integers(0, 10, n)
-        img = templates[lab] + rng.standard_normal((n, 32, 32, 3)) * 24
-        return np.clip(img, 0, 255).astype(np.uint8), lab.astype(np.int32)
-
-    xt, yt = gen(n_train)
-    xv, yv = gen(n_valid)
-    return xt, yt, xv, yv
+def synthesize_cifar(n_train=50000, n_valid=10000, seed=20260730):
+    """Full-size deterministic SynthShapes (see models/synth_data.py)."""
+    from .synth_data import synth_shapes
+    return synth_shapes(n_train, n_valid, seed)
 
 
 class CifarLoader(FullBatchLoader):
-    def __init__(self, minibatch_size=100, validation_ratio=0.1, **kw):
+    def __init__(self, minibatch_size=100, validation_ratio=0.1,
+                 n_train=50000, n_valid=10000, **kw):
         real = load_real_cifar()
         if real is not None:
             xt, yt, xte, yte = real
-            n_valid = int(len(xt) * validation_ratio)
-            data = {TRAIN: xt[n_valid:], VALID: xt[:n_valid], TEST: xte}
-            labels = {TRAIN: yt[n_valid:], VALID: yt[:n_valid], TEST: yte}
+            nv = int(len(xt) * validation_ratio)
+            data = {TRAIN: xt[nv:], VALID: xt[:nv], TEST: xte}
+            labels = {TRAIN: yt[nv:], VALID: yt[:nv], TEST: yte}
             self.synthetic = False
         else:
-            xt, yt, xv, yv = synthesize_cifar()
+            xt, yt, xv, yv = synthesize_cifar(n_train, n_valid)
             data = {TRAIN: xt, VALID: xv}
             labels = {TRAIN: yt, VALID: yv}
             self.synthetic = True
@@ -103,9 +97,11 @@ CIFAR_CONFIG = {
 }
 
 
-def cifar_workflow(minibatch_size=100, **overrides) -> StandardWorkflow:
+def cifar_workflow(minibatch_size=100, loader_args=None,
+                   **overrides) -> StandardWorkflow:
     cfg = dict(CIFAR_CONFIG)
     cfg.update(overrides)
     sw = StandardWorkflow(cfg)
-    sw.loader = CifarLoader(minibatch_size=minibatch_size)
+    sw.loader = CifarLoader(minibatch_size=minibatch_size,
+                            **(loader_args or {}))
     return sw

@@ -7,3 +7,4 @@ from .ring_attention import ring_attention, blockwise_attention
 from .pipeline import (pipeline_apply, stack_stage_params,
                        pipeline_stage_shardings)
 from .moe import init_moe_params, moe_apply, moe_shardings
+from .pool import CliRunner, ParallelMap

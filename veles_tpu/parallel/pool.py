@@ -1,0 +1,102 @@
+"""Host-process worker pools for meta-workflow evaluation fan-out.
+
+Reference parity: the reference farmed GA chromosomes and ensemble members
+out as standalone ``veles`` runs on slaves (reference:
+veles/genetics/optimization_workflow.py:70-339,
+veles/ensemble/base_workflow.py:135-143 — each evaluation exec'd a full
+subprocess). The rebuild keeps exactly that semantic — one independent
+training process per evaluation — but replaces the ZMQ master/slave
+plumbing with a bounded local pool of CLI subprocesses (a gang spawned
+through ssh can do the same across hosts via parallel/launcher.py).
+
+Device discipline: concurrent subprocesses must not fight over one TPU
+chip. ``CliRunner`` therefore pins workers to CPU by default
+(``JAX_PLATFORMS=cpu``) unless the caller passes ``env`` overrides
+mapping each worker to its own accelerator (e.g. one entry per host in a
+gang, or TPU visible-device masks on a pod slice).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional, Sequence
+
+from ..logger import Logger
+
+
+class CliRunner(Logger):
+    """Run ``python -m veles_tpu <argv>`` jobs on up to ``n_workers``
+    concurrent subprocesses; returns each job's ``--result-file`` JSON."""
+
+    def __init__(self, n_workers: int = 1,
+                 env: Optional[Dict[str, str]] = None,
+                 timeout: Optional[float] = None):
+        self.n_workers = max(int(n_workers), 1)
+        self.env = env
+        self.timeout = timeout
+
+    def _run_one(self, argv: Sequence[str], tag: str) -> dict:
+        fd, result_path = tempfile.mkstemp(
+            prefix=f"veles_job_{tag}_", suffix=".json")
+        os.close(fd)
+        env = dict(os.environ)
+        # Pin workers to CPU even when the parent selected a platform —
+        # concurrent subprocesses must never fight over one TPU chip; the
+        # caller-level override channel is self.env.
+        env["JAX_PLATFORMS"] = "cpu"
+        if self.env:
+            env.update(self.env)
+        cmd = [sys.executable, "-m", "veles_tpu", *argv,
+               "--result-file", result_path]
+        try:
+            try:
+                proc = subprocess.run(cmd, capture_output=True, text=True,
+                                      env=env, timeout=self.timeout)
+            except subprocess.TimeoutExpired:
+                self.warning("job %s timed out after %.0fs", tag,
+                             self.timeout)
+                return {"error": f"timeout after {self.timeout}s",
+                        "returncode": -1}
+            if proc.returncode != 0:
+                self.warning("job %s failed (rc=%d): %s", tag,
+                             proc.returncode, proc.stderr[-2000:])
+                return {"error": proc.stderr[-2000:],
+                        "returncode": proc.returncode}
+            with open(result_path) as f:
+                data = f.read()
+            return json.loads(data) if data.strip() else {}
+        finally:
+            try:
+                os.unlink(result_path)
+            except OSError:
+                pass
+
+    def run_jobs(self, jobs: Sequence[Sequence[str]]) -> List[dict]:
+        """Execute all jobs; order of results matches order of jobs."""
+        if self.n_workers == 1:
+            return [self._run_one(j, str(i)) for i, j in enumerate(jobs)]
+        with ThreadPoolExecutor(self.n_workers) as ex:
+            futs = [ex.submit(self._run_one, j, str(i))
+                    for i, j in enumerate(jobs)]
+            return [f.result() for f in futs]
+
+
+class ParallelMap:
+    """Thread-pool map for in-process fitness callables whose heavy work
+    releases the GIL or blocks on IO/subprocesses (the degenerate
+    n_workers=1 case is a plain loop, keeping determinism)."""
+
+    def __init__(self, fn, n_workers: int = 1):
+        self.fn = fn
+        self.n_workers = max(int(n_workers), 1)
+
+    def __call__(self, items: Sequence) -> List:
+        if self.n_workers == 1:
+            return [self.fn(x) for x in items]
+        with ThreadPoolExecutor(self.n_workers) as ex:
+            return list(ex.map(self.fn, items))

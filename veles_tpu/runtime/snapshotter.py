@@ -165,15 +165,37 @@ class Snapshotter(Logger):
     @staticmethod
     def load(path: str) -> Dict[str, Any]:
         """Restore a checkpoint from its manifest path (or the _current/_best
-        symlink), or from a ``sqlite://db.sqlite#id`` URI written by
-        SnapshotterToDB. Returns the payload with 'wstate' as numpy pytree;
-        call ``jax.device_put`` (optionally with shardings) to place it."""
+        symlink), from a ``sqlite://db.sqlite#id`` URI written by
+        SnapshotterToDB, or from an ``http(s)://`` manifest URL (reference:
+        the CLI's http snapshot source, veles/__main__.py:539-589). Returns
+        the payload with 'wstate' as numpy pytree; call ``jax.device_put``
+        (optionally with shardings) to place it."""
         if path.startswith("sqlite://"):
             return SnapshotterToDB.load_uri(path)
+        if path.startswith(("http://", "https://")):
+            return Snapshotter._load_http(path)
         with open(path) as f:
             manifest = json.load(f)
         npz_path = os.path.join(os.path.dirname(path), manifest["tensors"])
         with np.load(npz_path, allow_pickle=False) as z:
+            flat = {k: z[k] for k in z.files}
+        payload = dict(manifest)
+        payload["wstate"] = _unflatten(flat)
+        return payload
+
+    @staticmethod
+    def _load_http(url: str) -> Dict[str, Any]:
+        """Fetch manifest + tensors npz over HTTP; the tensors reference in
+        the manifest is resolved relative to the manifest URL."""
+        import io
+        import urllib.parse
+        import urllib.request
+        with urllib.request.urlopen(url) as r:
+            manifest = json.load(r)
+        tensors_url = urllib.parse.urljoin(url, manifest["tensors"])
+        with urllib.request.urlopen(tensors_url) as r:
+            buf = io.BytesIO(r.read())
+        with np.load(buf, allow_pickle=False) as z:
             flat = {k: z[k] for k in z.files}
         payload = dict(manifest)
         payload["wstate"] = _unflatten(flat)

@@ -123,4 +123,14 @@ def loads(data: bytes) -> Any:
             raise WireError("buffer overruns frame")
         offsets.append((pos, pos + s))
         pos += s
-    return _decode(header, offsets, data)
+    return _decode_checked(header, offsets, data)
+
+
+def _decode_checked(header, offsets, data) -> Any:
+    # A hostile header like "[[[[...1...]]]]" passes json.loads but can
+    # blow the stack inside _decode — that must surface as WireError, not
+    # RecursionError (receivers catch only WireError).
+    try:
+        return _decode(header, offsets, data)
+    except RecursionError:
+        raise WireError("header nesting too deep") from None
