@@ -68,6 +68,29 @@ def test_flash_attention_grad_matches_reference(qkv):
                                    rtol=2e-3, atol=2e-4)
 
 
+@pytest.mark.parametrize("causal,tq,tk", [(True, 37, 37), (False, 24, 40)])
+def test_flash_attention_grad_padded_and_cross(rng, causal, tq, tk):
+    """Backward kernels must mask padded Q rows (their lse is bogus) and
+    handle Tq != Tk — the failure surfaces of the dq/dkv Pallas kernels."""
+    B, H, D = 1, 2, 8
+    q = jnp.asarray(rng.standard_normal((B, tq, H, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, tk, H, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, tk, H, D)), jnp.float32)
+
+    def loss_pallas(q, k, v):
+        return jnp.sum(jnp.square(
+            pk.flash_attention(q, k, v, causal, None, 16, 16, True)))
+
+    def loss_ref(q, k, v):
+        return jnp.sum(jnp.square(full_attention(q, k, v, causal=causal)))
+
+    gp = jax.grad(loss_pallas, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gp, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-4)
+
+
 def test_fused_dropout_rate_and_scaling(rng):
     x = jnp.ones((64, 128), jnp.float32)
     out = pk.fused_dropout(x, 7, 0.4, 32, True)

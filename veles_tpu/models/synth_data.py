@@ -160,13 +160,20 @@ def _render_stroke_batch(points: np.ndarray, pairs: np.ndarray,
     return img.reshape(n, size, size)
 
 
+_RENDER_CHUNK = 2048  # PART OF THE DATASET IDENTITY: per-chunk RNG streams
+# are seeded at chunk boundaries, so a different chunking produces a
+# different (equally valid) dataset — bump _*_VERSION if this changes.
+
+
 def render_digits(labels: np.ndarray, rng: np.random.Generator,
-                  size: int = 28, chunk: int = 2048) -> np.ndarray:
+                  size: int = 28) -> np.ndarray:
     """Render one image per label with random pose/jitter. Returns uint8."""
+    chunk = _RENDER_CHUNK
     skel = digit_strokes()
     out = np.empty((len(labels), size, size), np.uint8)
-    # Per-sample nuisance parameters (drawn for ALL samples up front so the
-    # result is independent of chunking).
+    # Pose/width/intensity nuisances are drawn for ALL samples up front;
+    # the per-chunk `local` streams below are seeded at _RENDER_CHUNK
+    # boundaries (part of the dataset identity, see above).
     n = len(labels)
     rot = rng.uniform(-0.33, 0.33, n)
     shear = rng.uniform(-0.26, 0.26, n)
@@ -313,8 +320,9 @@ def _low_freq_noise(rng: np.random.Generator, n: int, size: int,
 
 
 def render_shapes(labels: np.ndarray, rng: np.random.Generator,
-                  size: int = 32, chunk: int = 4096) -> np.ndarray:
+                  size: int = 32) -> np.ndarray:
     """Render RGB shape images; returns (n, size, size, 3) uint8."""
+    chunk = 2 * _RENDER_CHUNK
     n = len(labels)
     out = np.empty((n, size, size, 3), np.uint8)
     # global per-sample nuisances (chunk-independent)

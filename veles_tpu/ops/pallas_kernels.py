@@ -53,7 +53,8 @@ def _round_up(n: int, m: int) -> int:
 # Flash attention (forward kernel + recompute backward)
 # ---------------------------------------------------------------------------
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
+                      acc_ref, *,
                       scale, causal, block_q, block_k, tq, tk, n_kb):
     """Grid = (BH, n_q_blocks, n_k_blocks); the k dimension is minor, so
     VMEM holds only one (block_q, D) Q tile and one (block_k, D) K/V tile at
@@ -104,29 +105,39 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
         o_ref[0] = (acc_ref[:]
                     / jnp.maximum(l_ref[:], 1e-30)[:, None]
                     ).astype(o_ref.dtype)
+        # logsumexp per row, consumed by the Pallas backward kernels
+        lse_ref[0] = m_ref[:] + jnp.log(jnp.maximum(l_ref[:], 1e-30))
 
 
-def _flash_fwd(q, k, v, *, causal, scale, block_q, block_k, interpret):
+def _flash_layout(x, T, t_p):
+    """(B, T, H, D) -> (B*H, t_p, D) with the T axis zero-padded."""
+    B, _, H, D = x.shape
+    return jnp.pad(x.transpose(0, 2, 1, 3).reshape(B * H, T, D),
+                   ((0, 0), (0, t_p - T), (0, 0)))
+
+
+def _flash_blocks(Tq, Tk, block_q, block_k):
+    block_q = min(block_q, _round_up(Tq, 8))
+    block_k = min(block_k, _round_up(Tk, 8))
+    return block_q, block_k, _round_up(Tq, block_q), _round_up(Tk, block_k)
+
+
+def _flash_fwd(q, k, v, *, causal, scale, block_q, block_k, interpret,
+               return_lse=False):
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
     scale_ = scale if scale is not None else D ** -0.5
-    block_q = min(block_q, _round_up(Tq, 8))
-    block_k = min(block_k, _round_up(Tk, 8))
-    tq_p, tk_p = _round_up(Tq, block_q), _round_up(Tk, block_k)
+    block_q, block_k, tq_p, tk_p = _flash_blocks(Tq, Tk, block_q, block_k)
 
-    # (B, T, H, D) -> (B*H, T, D); pad T axes to block multiples.
-    qm = jnp.pad(q.transpose(0, 2, 1, 3).reshape(B * H, Tq, D),
-                 ((0, 0), (0, tq_p - Tq), (0, 0)))
-    km = jnp.pad(k.transpose(0, 2, 1, 3).reshape(B * H, Tk, D),
-                 ((0, 0), (0, tk_p - Tk), (0, 0)))
-    vm = jnp.pad(v.transpose(0, 2, 1, 3).reshape(B * H, Tk, D),
-                 ((0, 0), (0, tk_p - Tk), (0, 0)))
+    qm = _flash_layout(q, Tq, tq_p)
+    km = _flash_layout(k, Tk, tk_p)
+    vm = _flash_layout(v, Tk, tk_p)
 
     n_kb = tk_p // block_k
     kernel = functools.partial(
         _flash_fwd_kernel, scale=scale_, causal=causal, block_q=block_q,
         block_k=block_k, tq=Tq, tk=Tk, n_kb=n_kb)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(B * H, tq_p // block_q, n_kb),
         in_specs=[
@@ -134,8 +145,14 @@ def _flash_fwd(q, k, v, *, causal, scale, block_q, block_k, interpret):
             pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * H, tq_p, D), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, tq_p, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, tq_p), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q,), jnp.float32),
             pltpu.VMEM((block_q,), jnp.float32),
@@ -143,37 +160,205 @@ def _flash_fwd(q, k, v, *, causal, scale, block_q, block_k, interpret):
         ],
         interpret=_interpret(interpret),
     )(qm, km, vm)
-    return out[:, :Tq].reshape(B, H, Tq, D).transpose(0, 2, 1, 3)
+    out = out[:, :Tq].reshape(B, H, Tq, D).transpose(0, 2, 1, 3)
+    if return_lse:
+        return out, lse
+    return out
+
+
+def _flash_bwd_mask(qi, kj, *, causal, block_q, block_k, tq, tk):
+    """Validity mask for one (block_q, block_k) tile: in-range rows/cols
+    plus the causal triangle.  Padded Q rows carry a bogus lse (=-1e30 +
+    log eps), so P must be forced to zero there or they'd pollute dK/dV."""
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    k_pos = kj * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    mask = (q_pos < tq) & (k_pos < tk)
+    if causal:
+        mask = mask & (k_pos <= q_pos)
+    return mask
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, acc_ref, *, scale, causal, block_q,
+                         block_k, tq, tk, n_kb):
+    """Grid = (BH, n_q_blocks, n_k_blocks), k minor; dQ accumulates in
+    scratch across the k sweep (two-pass recompute backward: S and P are
+    rebuilt from Q/K and the saved row logsumexp, never materialized)."""
+    qi, kj = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    def _step():
+        q = q_ref[0].astype(jnp.float32)
+        k_blk = k_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        mask = _flash_bwd_mask(qi, kj, causal=causal, block_q=block_q,
+                               block_k=block_k, tq=tq, tk=tk)
+        p = jnp.where(mask, jnp.exp(s - lse_ref[0][:, None]), 0.0)
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, None]) * scale
+        acc_ref[:] += jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        pl.when(kj * block_k <= qi * block_q + block_q - 1)(_step)
+    else:
+        _step()
+
+    @pl.when(kj == n_kb - 1)
+    def _finalize():
+        dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
+                          block_q, block_k, tq, tk, n_qb):
+    """Grid = (BH, n_k_blocks, n_q_blocks), q minor; dK/dV accumulate in
+    scratch across the q sweep."""
+    kj, qi = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    def _step():
+        q = q_ref[0].astype(jnp.float32)
+        k_blk = k_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        mask = _flash_bwd_mask(qi, kj, causal=causal, block_q=block_q,
+                               block_k=block_k, tq=tq, tk=tk)
+        p = jnp.where(mask, jnp.exp(s - lse_ref[0][:, None]), 0.0)
+        dv_acc[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, None]) * scale
+        dk_acc[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        pl.when(qi * block_q + block_q - 1 >= kj * block_k)(_step)
+    else:
+        _step()
+
+    @pl.when(qi == n_qb - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, out, lse, g, *, causal, scale, block_q, block_k,
+               interpret):
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    scale_ = scale if scale is not None else D ** -0.5
+    block_q, block_k, tq_p, tk_p = _flash_blocks(Tq, Tk, block_q, block_k)
+    n_qb, n_kb = tq_p // block_q, tk_p // block_k
+
+    qm = _flash_layout(q, Tq, tq_p)
+    km = _flash_layout(k, Tk, tk_p)
+    vm = _flash_layout(v, Tk, tk_p)
+    dom = _flash_layout(g, Tq, tq_p)
+    om = _flash_layout(out, Tq, tq_p)
+    # delta_i = rowsum(dO * O) — cheap elementwise+reduce, left to XLA
+    delta = jnp.sum(dom.astype(jnp.float32) * om.astype(jnp.float32),
+                    axis=-1)
+
+    itp = _interpret(interpret)
+    common = dict(scale=scale_, causal=causal, block_q=block_q,
+                  block_k=block_k, tq=Tq, tk=Tk)
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, n_kb=n_kb, **common),
+        grid=(B * H, n_qb, n_kb),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, tq_p, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        interpret=itp,
+    )(qm, km, vm, dom, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, n_qb=n_qb, **common),
+        grid=(B * H, n_kb, n_qb),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
+            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, tk_p, D), k.dtype),
+            jax.ShapeDtypeStruct((B * H, tk_p, D), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
+        ],
+        interpret=itp,
+    )(qm, km, vm, dom, lse, delta)
+
+    def back(x, T):
+        return x[:, :T].reshape(B, H, T, D).transpose(0, 2, 1, 3)
+
+    return back(dq, Tq), back(dk, Tk), back(dv, Tk)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
                     block_k=128, interpret=None):
-    """Blockwise-softmax attention, forward pass as one Pallas kernel.
+    """Blockwise-softmax attention, forward and backward as Pallas kernels.
 
-    q/k/v: (B, T, H, D) -> (B, Tq, H, D).  Backward differentiates the
-    rematerialized jnp blockwise scan (ring_attention.blockwise_attention
-    with use_flash=False) — backward memory stays one K/V block, never the
-    full attention matrix."""
+    q/k/v: (B, T, H, D) -> (B, Tq, H, D).  The backward is the standard
+    two-pass recompute (dQ kernel + dK/dV kernel) driven by the forward's
+    saved row logsumexp — memory stays one tile per operand, the full
+    attention matrix is never materialized in either direction."""
     return _flash_fwd(q, k, v, causal=causal, scale=scale, block_q=block_q,
                       block_k=block_k, interpret=interpret)
 
 
 def _flash_vjp_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    out = _flash_fwd(q, k, v, causal=causal, scale=scale, block_q=block_q,
-                     block_k=block_k, interpret=interpret)
-    return out, (q, k, v)
+    out, lse = _flash_fwd(q, k, v, causal=causal, scale=scale,
+                          block_q=block_q, block_k=block_k,
+                          interpret=interpret, return_lse=True)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_vjp_bwd(causal, scale, block_q, block_k, interpret, res, g):
-    from ..parallel.ring_attention import blockwise_attention
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: blockwise_attention(
-            q_, k_, v_, block_size=max(block_k, 128), causal=causal,
-            scale=scale, use_flash=False),
-        q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = res
+    return _flash_bwd(q, k, v, out, lse, g, causal=causal, scale=scale,
+                      block_q=block_q, block_k=block_k, interpret=interpret)
 
 
 flash_attention.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)

@@ -33,32 +33,51 @@ def init_moe_params(key, n_experts: int, d_model: int, d_hidden: int,
 
 
 def moe_apply(params: dict, x: jnp.ndarray, *,
-              capacity_factor: float = 1.25
+              capacity_factor: float = 1.25, top_k: int = 1
               ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Top-1 MoE FFN.
+    """Top-k MoE FFN (round 2: k >= 1 with renormalized combine weights;
+    round 1 was top-1 only).
 
     x: (tokens, d_model) -> (tokens, d_model), plus the load-balancing
-    auxiliary loss (Switch-style: E * sum_e f_e * p_e).
-    Tokens over capacity are dropped (output 0 for the FFN path) — standard
-    Switch semantics.
+    auxiliary loss (Switch-style: E * sum_e f_e * p_e over the primary
+    assignment).  Slot priority is GShard-style: all tokens' first choices
+    queue before any second choice, so capacity overflow drops the weakest
+    routes first.  Tokens over capacity are dropped (0 contribution for
+    that route).
     """
     T, D = x.shape
     E = params["router"].shape[1]
-    C = max(1, int(capacity_factor * T / E))
+    K = int(top_k)
+    C = max(1, int(capacity_factor * T * K / E))
 
     logits = x @ params["router"]                    # (T, E)
     probs = jax.nn.softmax(logits, axis=-1)
-    expert = jnp.argmax(probs, axis=-1)              # (T,)
-    gate = jnp.take_along_axis(probs, expert[:, None], axis=1)[:, 0]
+    topv, topi = jax.lax.top_k(probs, K)             # (T, K)
+    if K == 1:
+        # Switch semantics: scale by the raw top-1 probability — the path
+        # that carries router gradients (renormalizing would make it 1.0
+        # and cut the router out of the backward graph)
+        gates = topv
+    else:
+        gates = topv / jnp.maximum(
+            jnp.sum(topv, axis=-1, keepdims=True), 1e-9)
 
-    onehot = jax.nn.one_hot(expert, E, dtype=x.dtype)           # (T, E)
-    # position of each token within its expert's queue
-    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0             # (T, E)
+    onehots = jax.nn.one_hot(topi, E, dtype=x.dtype)  # (T, K, E)
+    # queue positions, slot-major: every token's slot-0 route is queued
+    # before any slot-1 route (GShard priority).  The cumsum runs in f32
+    # regardless of activation dtype — a bf16 cumsum loses integer
+    # exactness past 256 and collides capacity slots.
+    oh_flat = onehots.transpose(1, 0, 2).reshape(K * T, E) \
+        .astype(jnp.float32)
+    pos_flat = jnp.cumsum(oh_flat, axis=0) * oh_flat - 1.0
+    pos = pos_flat.reshape(K, T, E).transpose(1, 0, 2)          # (T, K, E)
     keep = (pos >= 0) & (pos < C)
-    dispatch = onehot[..., None] * jax.nn.one_hot(
+    slot = jax.nn.one_hot(
         jnp.clip(pos, 0, C - 1).astype(jnp.int32), C,
-        dtype=x.dtype)                                          # (T, E, C)
-    dispatch = dispatch * keep.astype(x.dtype)[..., None]
+        dtype=x.dtype) * keep.astype(x.dtype)[..., None]        # (T,K,E,C)
+    # combine carries the gate weights; dispatch is its 0/1 support
+    combine = jnp.einsum("tk,tkec->tec", gates.astype(x.dtype), slot)
+    dispatch = (combine > 0).astype(x.dtype)
 
     # dispatch -> (E, C, D): with expert axis sharded, GSPMD lowers this
     # to an all_to_all over ICI
@@ -66,11 +85,10 @@ def moe_apply(params: dict, x: jnp.ndarray, *,
     h = jax.nn.relu(jnp.einsum("ecd,edh->ech", xe, params["w1"],
                                preferred_element_type=jnp.float32))
     ye = jnp.einsum("ech,ehd->ecd", h.astype(x.dtype), params["w2"])
-    y = jnp.einsum("tec,ecd->td", dispatch, ye)
-    y = y * gate[:, None]
+    y = jnp.einsum("tec,ecd->td", combine, ye)
 
-    # Switch load-balance loss
-    frac_tokens = jnp.mean(onehot, axis=0)
+    # Switch load-balance loss on the primary assignment
+    frac_tokens = jnp.mean(onehots[:, 0, :], axis=0)
     frac_probs = jnp.mean(probs, axis=0)
     aux = E * jnp.sum(frac_tokens * frac_probs)
     return y, aux

@@ -2,26 +2,46 @@
 axis.
 
 NOT in the reference (SURVEY.md §2.5: the reference's only parallel axis was
-the batch); required for TPU-scale models. Design: S identical stages (a
-stack of repeated blocks, params stacked on a leading stage axis and sharded
-one-stage-per-device over the ``pipe`` mesh axis), microbatches streamed
+the batch); required for TPU-scale models. Design: S stages sharded
+one-stage-per-device over the ``pipe`` mesh axis, microbatches streamed
 with ``jax.lax.ppermute`` rotating activations around the ring under
-``shard_map`` — the scan-over-microbatches schedule with (S-1) bubble steps,
-compute/transfer overlap left to XLA.
+``shard_map`` — the scan-over-microbatches schedule, compute/transfer
+overlap left to XLA.
 
-Restriction (round 1): stages must share one params structure (true for the
-transformer-block / repeated-MLP models pipeline parallelism exists for);
-heterogeneous stages belong to a later round.
+Round-2 redesign (the round-1 restrictions removed):
+
+* **Sharded input/output.** Round 1 replicated the full microbatch stack to
+  every device with only rank 0 reading it.  Now the input is sharded
+  ``P(pipe)`` on the microbatch axis (device d owns block d) and delivered
+  to stage 0 just-in-time on a one-microbatch "conveyor" that rotates one
+  hop per step — per-device input memory drops S×, per-step transfer stays
+  one microbatch.  Outputs travel home the same way and come back sharded
+  ``P(pipe)``: memory S×, no final psum broadcast.
+* **Heterogeneous stages.** ``stage_fn`` may be a list of S different
+  callables with per-stage parameter pytrees of different structures; each
+  stage's params are raveled (jax.flatten_util), zero-padded to the widest
+  stage and stacked (S, P_max) sharded on ``pipe`` — every device holds
+  max-stage params, not the sum — and applied under ``lax.switch`` on the
+  device's stage index.  Activation shapes must still agree between stages
+  (the thing that physically rides the ring).
+* **Bubble accounting.** ``bubble_fraction(S, n_mb)`` is the idle share of
+  the schedule; ``pipeline_apply`` logs it per call.
 """
 
 from __future__ import annotations
 
 import functools
-from typing import Callable
+from typing import Callable, List, Optional, Sequence, Union
 
 import jax
 import jax.numpy as jnp
+from jax.flatten_util import ravel_pytree
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..logger import Logger as _Logger
+
+
+_log = _Logger()
 
 
 def stack_stage_params(per_stage_params) -> dict:
@@ -30,82 +50,167 @@ def stack_stage_params(per_stage_params) -> dict:
     return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage_params)
 
 
-def _pipeline_local(params, x, *, stage_fn, axis_name: str,
-                    n_microbatches: int):
+def bubble_fraction(n_stages: int, n_microbatches: int) -> float:
+    """Idle fraction of the fwd schedule: each device does n_mb useful
+    stage applications out of n_mb + 2(S-1) steps (fill + drain, plus the
+    S-1 output-return tail)."""
+    steps = n_microbatches + 2 * (n_stages - 1)
+    return 1.0 - n_microbatches / steps
+
+
+def _pipeline_local(stage_params, x_blk, *, apply_local, axis_name: str,
+                    n_microbatches: int, n_stages: int):
     """Per-device body under shard_map.
 
-    params: this device's stage params (leading stage axis of size 1).
-    x: the full (n_microbatches, mb, ...) microbatch stack, replicated on
-    every device (in_specs P()). Activations ppermute through the ring with
-    device d applying stage d; microbatch m enters at device 0 on step m,
-    so only device 0 ever reads x."""
-    axis_size = jax.lax.psum(1, axis_name)
+    stage_params: this device's stage params — every leaf has leading
+    stage-axis extent 1 (homogeneous: the P(pipe)-sharded stacked tree;
+    heterogeneous: a (1, P_max) raveled vector).
+    x_blk: (1, Q, mb...) this device's contiguous block of Q = n_mb/S
+    microbatches.  Stage-0 inputs and finished outputs each travel on a
+    one-microbatch conveyor rotating one hop per step (see module doc).
+    """
+    S, Q = n_stages, n_microbatches // n_stages
     idx = jax.lax.axis_index(axis_name)
-    params = jax.tree.map(lambda a: a[0], params)  # drop stage axis
+    p_local = jax.tree.map(lambda a: a[0], stage_params)
+    x_local = x_blk[0]                       # (Q, mb...)
+    mb_shape = x_local.shape[1:]
 
-    n_steps = n_microbatches + axis_size - 1
-    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
-    mb_shape = x.shape[1:]
+    # conveyors rotate DOWN (i -> i-1): inputs converge on device 0;
+    # activations rotate UP (i -> i+1): stage d feeds stage d+1; finished
+    # outputs also rotate UP, S-1 -> 0 -> ... -> home device.
+    down = [(i, (i - 1) % S) for i in range(S)]
+    up = [(i, (i + 1) % S) for i in range(S)]
 
-    def body(carry, step):
-        held, outputs = carry
-        # device 0 injects microbatch `step` (if any remain); others keep
-        # what arrived from the previous stage.
-        inject = jnp.where(step < n_microbatches,
-                           x[jnp.minimum(step, n_microbatches - 1)],
-                           jnp.zeros(mb_shape, x.dtype))
-        cur = jnp.where(idx == 0, inject, held)
-        out = stage_fn(params, cur)
-        # the last stage finishes microbatch (step - (S-1)) on this step
-        mb_done = step - (axis_size - 1)
-        valid = (mb_done >= 0) & (mb_done < n_microbatches)
-        outputs = jnp.where(
-            valid & (idx == axis_size - 1),
-            outputs.at[jnp.clip(mb_done, 0, n_microbatches - 1)].set(out),
-            outputs)
-        held_next = jax.lax.ppermute(out, axis_name, perm)
-        return (held_next, outputs), None
+    n_steps = n_microbatches + 2 * (S - 1)
 
-    outputs0 = jnp.zeros((n_microbatches,) + mb_shape, x.dtype)
-    held0 = jnp.zeros(mb_shape, x.dtype)
-    (_, outputs), _ = jax.lax.scan(body, (held0, outputs0),
-                                   jnp.arange(n_steps))
-    # outputs live on the last device; broadcast to all so out_specs can be
-    # replicated (cheap for activations-sized data; callers that keep going
-    # sharded can skip this).
-    outputs = jax.lax.psum(
-        jnp.where(idx == axis_size - 1, outputs, 0.0), axis_name)
-    return outputs
+    def body(carry, s):
+        held, in_conv, out_conv, out_local = carry
+
+        # -- input conveyor: device c loads mb t = s + c when it owns it
+        t_here = s + idx
+        own = (t_here >= idx * Q) & (t_here < (idx + 1) * Q) \
+            & (t_here < n_microbatches)
+        local_i = jnp.clip(t_here - idx * Q, 0, Q - 1)
+        in_conv = jnp.where(own, x_local[local_i], in_conv)
+
+        # -- stage compute: device 0 consumes the conveyor head (mb s)
+        cur = jnp.where(idx == 0, in_conv, held)
+        out = apply_local(idx, p_local, cur)
+
+        # -- output conveyor: last stage writes mb m = s - (S-1)
+        m_written = s - (S - 1)
+        write = (idx == S - 1) & (m_written >= 0) \
+            & (m_written < n_microbatches)
+        out_conv = jnp.where(write, out, out_conv)
+
+        # -- harvest: mb m arrives home h = m // Q after (h+1) mod S hops
+        m_arr = s - (S - 1) - ((idx + 1) % S)
+        harvest = (m_arr >= 0) & (m_arr < n_microbatches) \
+            & (m_arr // Q == idx)
+        local_o = jnp.clip(m_arr - idx * Q, 0, Q - 1)
+        out_local = jnp.where(
+            harvest,
+            out_local.at[local_o].set(out_conv),
+            out_local)
+
+        held = jax.lax.ppermute(out, axis_name, up)
+        in_conv = jax.lax.ppermute(in_conv, axis_name, down)
+        out_conv = jax.lax.ppermute(out_conv, axis_name, up)
+        return (held, in_conv, out_conv, out_local), None
+
+    zeros = jnp.zeros(mb_shape, x_local.dtype)
+    out_local0 = jnp.zeros((Q,) + mb_shape, x_local.dtype)
+    (_, _, _, out_local), _ = jax.lax.scan(
+        body, (zeros, zeros, zeros, out_local0), jnp.arange(n_steps))
+    return out_local[None]                   # (1, Q, mb...)
 
 
-def pipeline_apply(stage_fn: Callable, stacked_params, x, mesh: Mesh, *,
-                   axis_name: str = "pipe", n_microbatches: int = None):
+def _ravel_stages(stage_fns: Sequence[Callable], params_list):
+    """Heterogeneous-stage path: ravel per-stage params, zero-pad to the
+    widest stage, stack (S, P_max), apply via lax.switch on stage index."""
+    vecs, unravels, lens = [], [], []
+    for p in params_list:
+        v, un = ravel_pytree(p)
+        vecs.append(v)
+        unravels.append(un)
+        lens.append(v.shape[0])
+    pmax = max(lens)
+    stacked = jnp.stack([jnp.pad(v, (0, pmax - v.shape[0])) for v in vecs])
+    branches = [
+        (lambda vec, x, _fn=fn, _un=un, _l=l:
+         _fn(_un(vec[:_l]), x))
+        for fn, un, l in zip(stage_fns, unravels, lens)]
+
+    def apply_vec(idx, vec, x):
+        return jax.lax.switch(idx, branches, vec, x)
+
+    return stacked, apply_vec
+
+
+def pipeline_apply(stage_fn: Union[Callable, Sequence[Callable]],
+                   params, x, mesh: Mesh, *,
+                   axis_name: str = "pipe",
+                   n_microbatches: Optional[int] = None):
     """Run x through S pipelined stages.
 
-    stage_fn(params, x) -> y: one stage's computation (same shape in/out).
-    stacked_params: stage-stacked params (leading axis S), sharded on
-    ``axis_name``. x: (n_microbatches, mb, ...) microbatch stack.
-    Returns (n_microbatches, mb, ...) outputs.
+    ``stage_fn(params, x) -> y``: one stage's computation (same activation
+    shape in/out).  Homogeneous form: one callable + stage-stacked params
+    (leading axis S, see :func:`stack_stage_params`).  Heterogeneous form:
+    a list of S callables + a list of S per-stage param pytrees (arbitrary,
+    possibly different structures).
+
+    x: (n_microbatches, mb, ...) microbatch stack; ``n_microbatches`` must
+    be a multiple of S (it is sharded ``P(axis_name)`` across stages).
+    Returns (n_microbatches, mb, ...) outputs, sharded the same way.
     """
     S = mesh.shape[axis_name]
-    n_stages = {a.shape[0] for a in jax.tree.leaves(stacked_params)}
-    if n_stages != {S}:
+    if callable(stage_fn):
+        # homogeneous fast path: use the stacked tree directly — each
+        # leaf shards P(pipe) on its stage axis, no ravel round-trip
+        n_stages = {a.shape[0] for a in jax.tree.leaves(params)}
+        if n_stages != {S}:
+            raise ValueError(
+                f"stacked params leading axis {sorted(n_stages)} must equal "
+                f"the {axis_name!r} mesh axis size {S}")
+        stacked = params
+        p_specs = jax.tree.map(lambda a: _stage_spec(a, axis_name), params)
+
+        def apply_local(idx, p, x):
+            return stage_fn(p, x)
+    else:
+        stage_fns = list(stage_fn)
+        per_stage = list(params)
+        if len(stage_fns) != S or len(per_stage) != S:
+            raise ValueError(
+                f"need {S} stage fns + param sets for the {axis_name!r} "
+                f"axis, got {len(stage_fns)}/{len(per_stage)}")
+        stacked, apply_local = _ravel_stages(stage_fns, per_stage)
+        p_specs = P(axis_name)
+    n_mb = x.shape[0]
+    if n_microbatches is not None and n_microbatches != n_mb:
         raise ValueError(
-            f"stacked_params leading axis {sorted(n_stages)} must equal the "
-            f"{axis_name!r} mesh axis size {S}")
-    if n_microbatches is None:
-        n_microbatches = x.shape[0]
-    elif n_microbatches != x.shape[0]:
+            f"n_microbatches={n_microbatches} != x.shape[0]={n_mb}")
+    if n_mb % S:
         raise ValueError(
-            f"n_microbatches={n_microbatches} != x.shape[0]={x.shape[0]}")
-    pspec = jax.tree.map(lambda a: _stage_spec(a, axis_name), stacked_params)
+            f"n_microbatches={n_mb} must be a multiple of the pipeline "
+            f"depth {S} (inputs/outputs are sharded over {axis_name!r})")
+
+    _log.debug("pipeline: S=%d n_mb=%d bubble=%.1f%%", S, n_mb,
+               100 * bubble_fraction(S, n_mb))
+
     fn = jax.shard_map(
-        functools.partial(_pipeline_local, stage_fn=stage_fn,
-                          axis_name=axis_name,
-                          n_microbatches=n_microbatches),
-        mesh=mesh, in_specs=(pspec, P()), out_specs=P(),
+        functools.partial(_pipeline_local, apply_local=apply_local,
+                          axis_name=axis_name, n_microbatches=n_mb,
+                          n_stages=S),
+        mesh=mesh,
+        in_specs=(p_specs, P(axis_name)),
+        out_specs=P(axis_name),
         check_vma=False)
-    return fn(stacked_params, x)
+    # group the microbatch axis into (S, Q) so P(axis) places block d on
+    # stage d, then flatten back
+    grouped = x.reshape((S, n_mb // S) + x.shape[1:])
+    out = fn(stacked, grouped)
+    return out.reshape((n_mb,) + x.shape[1:])
 
 
 def _stage_spec(a, axis_name: str) -> P:

@@ -190,10 +190,10 @@ class Snapshotter(Logger):
         import io
         import urllib.parse
         import urllib.request
-        with urllib.request.urlopen(url) as r:
+        with urllib.request.urlopen(url, timeout=30.0) as r:
             manifest = json.load(r)
         tensors_url = urllib.parse.urljoin(url, manifest["tensors"])
-        with urllib.request.urlopen(tensors_url) as r:
+        with urllib.request.urlopen(tensors_url, timeout=30.0) as r:
             buf = io.BytesIO(r.read())
         with np.load(buf, allow_pickle=False) as z:
             flat = {k: z[k] for k in z.files}

@@ -5,6 +5,8 @@ from .nn import (All2All, All2AllRELU, All2AllSincos, All2AllSoftmax,
                  Depool, Dropout, Evaluator, EvaluatorMSE, EvaluatorSoftmax,
                  Flatten, LRN, MaxPooling, MeanDispNormalizer,
                  StochasticAbsPooling)
+from .parallel_nn import (MoEFFN, MultiHeadAttention, PipelineStack,
+                          expert_rules, pipeline_rules)
 from .kohonen import KohonenForward
 from .recurrent import GRU, LSTM, RNN
 from .rbm import RBM

@@ -49,9 +49,18 @@ class Context:
     """Per-call context threaded through apply(): train/eval phase flag and
     a PRNG key (replaces the reference's per-unit reproducible generators,
     veles/units.py:859-885 — keys are split per unit name, so adding units
-    never perturbs other units' streams)."""
+    never perturbs other units' streams).  ``mesh`` is the device mesh the
+    step was compiled under (None on single-device paths) — parallelism-
+    aware units (ring attention, pipeline stacks, MoE) read their axis
+    sizes off it."""
     train: bool = True
     key: Optional[jax.Array] = None
+    mesh: Optional[Any] = None
+
+    def axis_size(self, name: str) -> int:
+        if self.mesh is None or name not in self.mesh.shape:
+            return 1
+        return self.mesh.shape[name]
 
     def unit_key(self, name: str) -> Optional[jax.Array]:
         if self.key is None:

@@ -1,0 +1,221 @@
+"""Parallelism-aware NN units: sequence-parallel attention, pipelined
+stacks, mixture-of-experts — the sp/pp/ep axes as *product features*
+constructible from StandardWorkflow configs (round-1 verdict #3: these were
+library functions exercised only by dryrun demos).
+
+No reference counterpart (SURVEY.md §5.7/§2.5: the reference's only
+parallel axis was the batch); the build brief makes long-context and
+multi-axis distribution first-class, so these are new TPU-native designs
+layered on parallel/{ring_attention,pipeline,moe}.py.
+
+Mesh discipline: each unit reads its axis size off ``ctx.mesh`` (threaded
+by Workflow.make_sharded_train_step).  On a single device — or when the
+relevant mesh axis has size 1 — every unit falls back to the numerically
+identical local computation, so the same config runs anywhere.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import smart_uniform_init as _uniform_init
+from .base import Context, Forward, Spec
+
+
+class MultiHeadAttention(Forward):
+    """Self-attention over (B, T, E) activations.
+
+    Sequence parallelism: when ``ctx.mesh`` has a ``seq`` axis > 1, the
+    attention core runs as ring attention (parallel/ring_attention.py) —
+    K/V blocks rotate over ICI while each device holds one sequence shard.
+    Otherwise the blockwise/flash local kernel handles arbitrary T on one
+    device.  Projections are plain gemms GSPMD shards by rule.
+    """
+
+    stochastic = False
+
+    def __init__(self, n_heads: int, head_dim: Optional[int] = None,
+                 name=None, inputs=("@input",), *, causal: bool = True,
+                 seq_axis: str = "seq", block_size: int = 512,
+                 compute_dtype=None):
+        super().__init__(name, inputs)
+        self.n_heads = int(n_heads)
+        self.head_dim = head_dim
+        self.causal = causal
+        self.seq_axis = seq_axis
+        self.block_size = int(block_size)
+        self.compute_dtype = compute_dtype
+
+    def output_spec(self, in_specs: Sequence[Spec]) -> Spec:
+        return in_specs[0]
+
+    def init(self, key, in_specs):
+        E = in_specs[0].shape[-1]
+        H = self.n_heads
+        D = self.head_dim or E // H
+        if self.head_dim is None and E % H:
+            raise ValueError(f"model dim {E} not divisible by {H} heads")
+        kq, kk, kv, ko = jax.random.split(key, 4)
+        return {
+            "wq": _uniform_init(kq, (E, H * D), E),
+            "wk": _uniform_init(kk, (E, H * D), E),
+            "wv": _uniform_init(kv, (E, H * D), E),
+            "wo": _uniform_init(ko, (H * D, E), H * D),
+        }, {}
+
+    def apply(self, params, state, xs, ctx: Context):
+        from ..parallel.ring_attention import (blockwise_attention,
+                                               ring_attention)
+        x = xs[0]
+        B, T, E = x.shape
+        H = self.n_heads
+        dt = self.compute_dtype or x.dtype
+        xq = x.astype(dt)
+
+        def proj(w):
+            return (xq @ w.astype(dt)).reshape(B, T, H, -1)
+
+        q, k, v = proj(params["wq"]), proj(params["wk"]), proj(params["wv"])
+        if ctx.axis_size(self.seq_axis) > 1:
+            o = ring_attention(q, k, v, ctx.mesh, axis_name=self.seq_axis,
+                               causal=self.causal)
+        else:
+            o = blockwise_attention(q, k, v, block_size=self.block_size,
+                                    causal=self.causal)
+        y = o.reshape(B, T, -1) @ params["wo"].astype(dt)
+        return y.astype(x.dtype), state
+
+
+class MoEFFN(Forward):
+    """Mixture-of-experts FFN over (B, T, E) or (N, E) activations.
+
+    Expert parallelism: the expert banks shard over the ``expert`` mesh
+    axis (see ``expert_rules`` below); the dispatch/combine einsums become
+    all_to_all over ICI under GSPMD.  The Switch/GShard load-balance
+    auxiliary loss rides the unit-state channel — Workflow._build_step sums
+    ``aux_loss * aux_weight`` into the training loss automatically.
+    """
+
+    has_aux_loss = True
+
+    def __init__(self, n_experts: int, d_hidden: int, name=None,
+                 inputs=("@input",), *, top_k: int = 2,
+                 capacity_factor: float = 1.25, aux_weight: float = 0.01):
+        super().__init__(name, inputs)
+        self.n_experts = int(n_experts)
+        self.d_hidden = int(d_hidden)
+        self.top_k = int(top_k)
+        self.capacity_factor = float(capacity_factor)
+        self.aux_weight = float(aux_weight)
+
+    def output_spec(self, in_specs):
+        return in_specs[0]
+
+    def init(self, key, in_specs):
+        from ..parallel.moe import init_moe_params
+        E = in_specs[0].shape[-1]
+        params = init_moe_params(key, self.n_experts, E, self.d_hidden)
+        return params, {"aux_loss": jnp.zeros((), jnp.float32)}
+
+    def apply(self, params, state, xs, ctx: Context):
+        from ..parallel.moe import moe_apply
+        x = xs[0]
+        flat = x.reshape(-1, x.shape[-1])
+        y, aux = moe_apply(params, flat, top_k=self.top_k,
+                           capacity_factor=self.capacity_factor)
+        return (y.reshape(x.shape),
+                {"aux_loss": aux.astype(jnp.float32)})
+
+
+class PipelineStack(Forward):
+    """A stack of S residual-MLP blocks pipelined over the ``pipe`` mesh
+    axis (GPipe schedule, parallel/pipeline.py).
+
+    With pipe size 1 (or no mesh) the stages run as a sequential scan —
+    the same math, so configs are portable.  The batch is split into
+    microbatches along axis 0; batch size must divide evenly.
+    """
+
+    def __init__(self, n_stages: int, d_hidden: int, name=None,
+                 inputs=("@input",), *, pipe_axis: str = "pipe",
+                 n_microbatches: Optional[int] = None):
+        super().__init__(name, inputs)
+        self.n_stages = int(n_stages)
+        self.d_hidden = int(d_hidden)
+        self.pipe_axis = pipe_axis
+        self.n_microbatches = n_microbatches
+
+    def output_spec(self, in_specs):
+        return in_specs[0]
+
+    def init(self, key, in_specs):
+        E = in_specs[0].shape[-1]
+        H = self.d_hidden
+        keys = jax.random.split(key, self.n_stages)
+
+        def one(k):
+            k1, k2 = jax.random.split(k)
+            return {"w1": _uniform_init(k1, (E, H), E),
+                    "w2": _uniform_init(k2, (H, E), H)}
+
+        from ..parallel.pipeline import stack_stage_params
+        stacked = stack_stage_params([one(k) for k in keys])
+        # flat per-unit param dict (optimizer contract); the leading axis
+        # of each stage_* array is the stage axis sharded over 'pipe'
+        return {"stage_w1": stacked["w1"], "stage_w2": stacked["w2"]}, {}
+
+    @staticmethod
+    def _stage_fn(p, x):
+        return x + jax.nn.relu(x @ p["w1"]) @ p["w2"]
+
+    def apply(self, params, state, xs, ctx: Context):
+        x = xs[0]
+        S = ctx.axis_size(self.pipe_axis)
+        stages = {"w1": params["stage_w1"], "w2": params["stage_w2"]}
+        if S > 1:
+            from ..parallel.pipeline import pipeline_apply
+            n_mb = self.n_microbatches or S
+            B = x.shape[0]
+            if B % n_mb:
+                raise ValueError(
+                    f"batch {B} not divisible into {n_mb} microbatches")
+            xm = x.reshape((n_mb, B // n_mb) + x.shape[1:])
+            y = pipeline_apply(self._stage_fn, stages, xm, ctx.mesh,
+                               axis_name=self.pipe_axis)
+            return y.reshape(x.shape), state
+        # sequential fallback: scan over the stage axis
+        def body(h, p):
+            return self._stage_fn(p, h), None
+
+        y, _ = jax.lax.scan(body, x, stages)
+        return y, state
+
+
+def expert_rules(axis: str = "expert"):
+    """Sharding rule for MoEFFN params: expert banks split on the expert
+    axis, router replicated (compose with other rules via
+    parallel.mesh.compose_rules)."""
+    from jax.sharding import PartitionSpec as P
+
+    def rule(path, spec):
+        if len(path) >= 2 and path[-1] in ("w1", "w2") \
+                and spec.ndim == 3:
+            return P(axis)
+        return P()
+
+    return rule
+
+
+def pipeline_rules(axis: str = "pipe"):
+    """Sharding rule for PipelineStack params: stage axis over 'pipe'."""
+    from jax.sharding import PartitionSpec as P
+
+    def rule(path, spec):
+        if path and path[-1].startswith("stage_"):
+            return P(axis, *([None] * (spec.ndim - 1)))
+        return P()
+
+    return rule

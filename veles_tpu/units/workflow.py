@@ -200,9 +200,12 @@ class Workflow(Logger):
         """The pure (wstate, batch) -> (wstate, metrics) train function."""
         selfupd = [u for u in self.units if getattr(u, "self_updating", False)]
 
+        aux_units = [u for u in self.units
+                     if getattr(u, "has_aux_loss", False)]
+
         def step(wstate, batch):
             key, sub = jax.random.split(wstate["key"])
-            ctx = Context(train=True, key=sub)
+            ctx = Context(train=True, key=sub, mesh=self.mesh)
 
             if self.evaluator is not None:
                 def loss_fn(params):
@@ -210,6 +213,13 @@ class Workflow(Logger):
                         params, wstate["state"], batch, ctx)
                     loss = outputs[self.evaluator.name]
                     mets = self._metrics(params, wstate["state"], outputs, ctx)
+                    # auxiliary losses (e.g. MoE load balance) ride the
+                    # unit-state channel and are summed into the training
+                    # loss with per-unit weights
+                    for u in aux_units:
+                        aux = nstate[u.name]["aux_loss"]
+                        loss = loss + u.aux_weight * aux
+                        mets = {**mets, f"aux_{u.name}": aux}
                     return loss, (outputs, nstate, mets)
 
                 grads, (outputs, nstate, mets) = jax.grad(
@@ -262,22 +272,23 @@ class Workflow(Logger):
         from ..parallel.mesh import batch_shardings, state_shardings
         state_sh = state_shardings(wstate, mesh, rule)
         batch_sh = batch_shardings(batch_spec, mesh)
+        self.mesh = mesh  # BEFORE _build_step: the traced ctx carries it
+        self.state_sharding = state_sh
         step = self._build_step(optimizer)
         fn = jax.jit(step,
                      in_shardings=(state_sh, batch_sh),
                      out_shardings=(state_sh, None),
                      donate_argnums=(0,) if donate else ())
-        self.mesh = mesh
-        self.state_sharding = state_sh
         return fn, state_sh, batch_sh
 
     def make_sharded_eval_step(self, mesh, wstate, batch_spec, *, rule=None):
         from ..parallel.mesh import batch_shardings, state_shardings
         state_sh = state_shardings(wstate, mesh, rule)
         batch_sh = batch_shardings(batch_spec, mesh)
+        self.mesh = mesh
 
         def step(wstate, batch):
-            ctx = Context(train=False, key=None)
+            ctx = Context(train=False, key=None, mesh=self.mesh)
             outputs, _ = self.forward(wstate["params"], wstate["state"],
                                       batch, ctx)
             return self._metrics(wstate["params"], wstate["state"],
@@ -291,7 +302,7 @@ class Workflow(Logger):
         reference's Decision-gated validation phase."""
 
         def step(wstate, batch):
-            ctx = Context(train=False, key=None)
+            ctx = Context(train=False, key=None, mesh=self.mesh)
             outputs, _ = self.forward(wstate["params"], wstate["state"],
                                       batch, ctx)
             return self._metrics(wstate["params"], wstate["state"],
