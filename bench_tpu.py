@@ -59,7 +59,8 @@ def main():
         return 1
 
     from veles_tpu.ops import pallas_kernels as pk
-    from veles_tpu.parallel.ring_attention import blockwise_attention
+    from veles_tpu.parallel.ring_attention import (blockwise_attention,
+                                                   full_attention)
 
     results = []
 
@@ -73,16 +74,8 @@ def main():
 
     rng = np.random.default_rng(0)
 
-    # -- flash attention fwd + bwd ---------------------------------------
-    def full_attention(q, k, v, causal):
-        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * (q.shape[-1] ** -0.5)
-        if causal:
-            tq, tk = q.shape[1], k.shape[1]
-            mask = (jnp.arange(tk)[None, :] <= jnp.arange(tq)[:, None])
-            s = jnp.where(mask[None, None], s, -1e30)
-        p = jax.nn.softmax(s, axis=-1)
-        return jnp.einsum("bhqk,bkhd->bqhd", p, v)
-
+    # -- flash attention fwd + bwd (reference = the library's f32-accum
+    # full attention, same one the test suite uses) ------------------------
     for T, dtype_name in ((2048, "float32"), (4096, "bfloat16")):
         B, H, D = 2, 8, 64
         dtype = jnp.dtype(dtype_name)
@@ -91,7 +84,7 @@ def main():
 
         flash = jax.jit(lambda q, k, v: pk.flash_attention(
             q, k, v, True, None, 128, 128, False))
-        xla = jax.jit(lambda q, k, v: full_attention(q, k, v, True))
+        xla = jax.jit(lambda q, k, v: full_attention(q, k, v, causal=True))
         t_p, out_p = timeit(flash, q, k, v)
         t_x, out_x = timeit(xla, q, k, v)
         record(f"flash_attention_fwd_T{T}_{dtype_name}", t_p, t_x,
@@ -108,7 +101,7 @@ def main():
                 q, k, v, block_size=128, causal=True, use_flash=False)
                 .astype(jnp.float32)), argnums=(0, 1, 2)))
         xla_g = jax.jit(jax.grad(
-            lambda q, k, v: jnp.sum(full_attention(q, k, v, True)
+            lambda q, k, v: jnp.sum(full_attention(q, k, v, causal=True)
                                     .astype(jnp.float32)),
             argnums=(0, 1, 2)))
         t_pg, g_p = timeit(flash_g, q, k, v, iters=10)

@@ -107,6 +107,37 @@ def test_alexnet_builds_and_steps():
     assert np.isfinite(float(mets["loss"]))
 
 
+def test_imagenet_host_loader_augmentation():
+    """End-to-end input path: uint8 host store, random crop+mirror on
+    host, normalization left to the device-side norm unit."""
+    from veles_tpu.models.alexnet import INPUT_HW, ImagenetHostLoader
+    l = ImagenetHostLoader(minibatch_size=8, n_train=32, n_valid=8)
+    l.initialize()
+    b = next(l.iter_epoch(TRAIN, 0))
+    assert b["@input"].dtype == np.uint8
+    assert b["@input"].shape == (8, INPUT_HW, INPUT_HW, 3)
+    # deterministic per (seed, epoch)
+    l2 = ImagenetHostLoader(minibatch_size=8, n_train=32, n_valid=8)
+    l2.initialize()
+    np.testing.assert_array_equal(b["@input"],
+                                  next(l2.iter_epoch(TRAIN, 0))["@input"])
+    # validation uses the deterministic center crop
+    bv = next(l.iter_epoch(VALID, 0))
+    bv2 = next(l.iter_epoch(VALID, 1))
+    np.testing.assert_array_equal(bv["@input"], bv2["@input"])
+
+
+def test_alexnet_e2e_workflow_steps():
+    """uint8 batch -> device-side mean/disp norm -> conv trunk: one train
+    step of the end-to-end bench configuration (tiny host store)."""
+    from veles_tpu.models.alexnet import alexnet_e2e_workflow
+    sw = alexnet_e2e_workflow(minibatch_size=4, n_train=16)
+    trainer = sw.make_trainer(sw.loader)
+    trainer.initialize(seed=0)
+    mets = trainer._run_epoch_train(0)
+    assert np.isfinite(mets["loss"])
+
+
 def test_imagenet_loader_deterministic():
     from veles_tpu.models.alexnet import ImagenetSyntheticLoader
     l1 = ImagenetSyntheticLoader(minibatch_size=8, n_train=64)

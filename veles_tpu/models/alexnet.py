@@ -81,9 +81,82 @@ class ImagenetSyntheticLoader(Loader):
         return {"@input": x, "@labels": labels}
 
 
-def alexnet_workflow(minibatch_size=128, **overrides) -> StandardWorkflow:
+class ImagenetHostLoader(Loader):
+    """End-to-end input-pipeline variant: a host-resident uint8 image store
+    with per-sample random crop + mirror augmentation on the host (the
+    ImageLoader path, reference: veles/loader/image.py:106) and the
+    uint8→float mean/disp normalization left ON DEVICE (the first workflow
+    unit, backed by the Pallas mean_disp kernel) — so the host does only
+    slicing + one memcpy per batch and the VPU does the arithmetic.
+
+    Measures what the round-1 bench skipped: host augmentation + the
+    Trainer's prefetch overlap (BASELINE.md staged vs end-to-end rows).
+    """
+
+    STORE_HW = 256  # stored image side; random-cropped to INPUT_HW
+
+    def __init__(self, minibatch_size=128, n_train=4096, n_valid=512,
+                 n_classes=1000, seed=13, **kw):
+        super().__init__(minibatch_size=minibatch_size, **kw)
+        self.n_train = n_train
+        self.n_valid = n_valid
+        self.n_classes = n_classes
+        self.seed = seed
+        self._store = None
+
+    def load_data(self):
+        rng = np.random.default_rng(self.seed)
+        hw = self.STORE_HW
+        # deterministic synthetic "decoded JPEG" store (uint8)
+        self._store = rng.integers(
+            0, 256, (self.n_train + self.n_valid, hw, hw, 3), np.uint8)
+        self.class_lengths = [0, self.n_valid, self.n_train]
+
+    def fill_minibatch(self, indices, klass):
+        hw, out = self.STORE_HW, INPUT_HW
+        base = self.n_valid if klass == TRAIN else 0
+        rng = np.random.default_rng(
+            [self.seed, klass, int(indices[0]) if len(indices) else 0])
+        n = len(indices)
+        xs = np.empty((n, out, out, 3), np.uint8)
+        if klass == TRAIN:
+            offs = rng.integers(0, hw - out + 1, (n, 2))
+            flip = rng.random(n) < 0.5
+        else:
+            c = (hw - out) // 2
+            offs = np.full((n, 2), c)
+            flip = np.zeros(n, bool)
+        for i, idx in enumerate(indices):
+            oy, ox = offs[i]
+            img = self._store[base + idx, oy:oy + out, ox:ox + out]
+            xs[i] = img[:, ::-1] if flip[i] else img
+        labels = (indices % self.n_classes).astype(np.int32)
+        return {"@input": xs, "@labels": labels}
+
+
+def alexnet_workflow(minibatch_size=128, loader=None,
+                     **overrides) -> StandardWorkflow:
     cfg = dict(ALEXNET_CONFIG)
     cfg.update(overrides)
     sw = StandardWorkflow(cfg)
-    sw.loader = ImagenetSyntheticLoader(minibatch_size=minibatch_size)
+    sw.loader = loader if loader is not None else \
+        ImagenetSyntheticLoader(minibatch_size=minibatch_size)
+    return sw
+
+
+def alexnet_e2e_workflow(minibatch_size=128, n_train=4096,
+                         **overrides) -> StandardWorkflow:
+    """AlexNet fed through the host image path: uint8 batches from
+    ImagenetHostLoader, normalized on device by a prepended MeanDisp unit
+    (Pallas kernel) — the end-to-end throughput configuration."""
+    cfg = dict(ALEXNET_CONFIG)
+    cfg["layers"] = [
+        {"type": "norm", "name": "norm0",
+         "mean": np.full((INPUT_HW, INPUT_HW, 3), 127.5, np.float32),
+         "rdisp": np.full((INPUT_HW, INPUT_HW, 3), 1 / 64.0, np.float32)},
+    ] + [dict(l) for l in ALEXNET_CONFIG["layers"]]
+    cfg.update(overrides)
+    sw = StandardWorkflow(cfg)
+    sw.loader = ImagenetHostLoader(minibatch_size=minibatch_size,
+                                   n_train=n_train)
     return sw

@@ -31,6 +31,7 @@ Round-2 redesign (the round-1 restrictions removed):
 from __future__ import annotations
 
 import functools
+import math
 from typing import Callable, List, Optional, Sequence, Union
 
 import jax
@@ -150,7 +151,8 @@ def _ravel_stages(stage_fns: Sequence[Callable], params_list):
 def pipeline_apply(stage_fn: Union[Callable, Sequence[Callable]],
                    params, x, mesh: Mesh, *,
                    axis_name: str = "pipe",
-                   n_microbatches: Optional[int] = None):
+                   n_microbatches: Optional[int] = None,
+                   batch_axes: Sequence[str] = ()):
     """Run x through S pipelined stages.
 
     ``stage_fn(params, x) -> y``: one stage's computation (same activation
@@ -161,6 +163,9 @@ def pipeline_apply(stage_fn: Union[Callable, Sequence[Callable]],
 
     x: (n_microbatches, mb, ...) microbatch stack; ``n_microbatches`` must
     be a multiple of S (it is sharded ``P(axis_name)`` across stages).
+    ``batch_axes``: mesh axes the per-microbatch batch dim (axis 1) is
+    sharded over (e.g. ("data",)) — without it a dp×pp mesh would
+    all-gather the batch and run the FULL batch through every data shard.
     Returns (n_microbatches, mb, ...) outputs, sharded the same way.
     """
     S = mesh.shape[axis_name]
@@ -198,13 +203,24 @@ def pipeline_apply(stage_fn: Union[Callable, Sequence[Callable]],
     _log.debug("pipeline: S=%d n_mb=%d bubble=%.1f%%", S, n_mb,
                100 * bubble_fraction(S, n_mb))
 
+    batch_axes = tuple(a for a in batch_axes if mesh.shape[a] > 1)
+    bsz = math.prod(mesh.shape[a] for a in batch_axes) if batch_axes else 1
+    if batch_axes and x.shape[1] % bsz:
+        raise ValueError(
+            f"microbatch size {x.shape[1]} not divisible over batch axes "
+            f"{batch_axes} (total {bsz})")
+    mb_ax = (batch_axes if len(batch_axes) > 1 else batch_axes[0]) \
+        if batch_axes else None
+    # grouped layout (S, Q, mb, ...): stage blocks on 'pipe', the batch
+    # dim on the data axes
+    x_spec = P(axis_name, None, mb_ax)
     fn = jax.shard_map(
         functools.partial(_pipeline_local, apply_local=apply_local,
                           axis_name=axis_name, n_microbatches=n_mb,
                           n_stages=S),
         mesh=mesh,
-        in_specs=(p_specs, P(axis_name)),
-        out_specs=P(axis_name),
+        in_specs=(p_specs, x_spec),
+        out_specs=x_spec,
         check_vma=False)
     # group the microbatch axis into (S, Q) so P(axis) places block d on
     # stage d, then flatten back

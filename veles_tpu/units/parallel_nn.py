@@ -183,8 +183,11 @@ class PipelineStack(Forward):
                 raise ValueError(
                     f"batch {B} not divisible into {n_mb} microbatches")
             xm = x.reshape((n_mb, B // n_mb) + x.shape[1:])
+            dp = tuple(a for a in ("data", "fsdp")
+                       if ctx.axis_size(a) > 1
+                       and (B // n_mb) % ctx.axis_size(a) == 0)
             y = pipeline_apply(self._stage_fn, stages, xm, ctx.mesh,
-                               axis_name=self.pipe_axis)
+                               axis_name=self.pipe_axis, batch_axes=dp)
             return y.reshape(x.shape), state
         # sequential fallback: scan over the stage axis
         def body(h, p):
