@@ -123,3 +123,33 @@ def test_sequence_classification_end_to_end(unit_cls, rng):
     trainer.initialize(seed=11)
     results = trainer.run()
     assert results["best_value"] < 25.0, results  # chance = 50 %
+
+
+def test_recurrent_layers_from_standard_config(rng):
+    """rnn/gru/lstm are config-constructible through StandardWorkflow
+    (the reference shipped its RNN/LSTM units outside the workflow
+    factory and untested)."""
+    import veles_tpu as vt
+    from veles_tpu.models.standard import StandardWorkflow
+    for kind in ("rnn", "gru", "lstm"):
+        sw = StandardWorkflow({
+            "name": f"{kind}_model",
+            "layers": [
+                {"type": kind, "hidden": 12, "name": "rec",
+                 "return_sequences": False},
+                {"type": "softmax", "output_size": 3, "name": "out"},
+            ],
+            "optimizer": "sgd",
+            "optimizer_args": {"lr": 0.1},
+        })
+        wf = sw.workflow
+        batch = {
+            "@input": jnp.asarray(
+                rng.standard_normal((4, 6, 8)), jnp.float32),
+            "@labels": jnp.zeros((4,), jnp.int32),
+            "@mask": jnp.ones((4,), jnp.float32)}
+        wf.build({k: vt.Spec(v.shape, v.dtype) for k, v in batch.items()})
+        ws = wf.init_state(jax.random.key(0), sw.optimizer)
+        step = wf.make_train_step(sw.optimizer)
+        ws, mets = step(ws, batch)
+        assert np.isfinite(float(mets["loss"])), kind

@@ -209,3 +209,17 @@ def test_profile_units(rng):
     assert all(r["ms"] >= 0 for r in rows)
     table = vt.units.workflow.Workflow.format_profile(rows)
     assert "TOTAL" in table and rows[0]["unit"] in table
+
+
+def test_decision_gauges_rmse_for_mse_workflows():
+    """An MSE workflow's decision gauge is RMSE (not a mislabeled loss):
+    error_pct -> rmse -> loss fallback order."""
+    from veles_tpu.runtime.decision import Decision
+    d = Decision(max_epochs=5)
+    d.on_epoch(0, {}, {"rmse": 0.5, "loss": 0.25, "n_samples": 10.0})
+    assert d.history[-1]["metric"] == "rmse"
+    assert d.best_value == 0.5
+    d2 = Decision(max_epochs=5)
+    d2.on_epoch(0, {}, {"error_pct": 7.0, "loss": 0.1})
+    assert d2.history[-1]["metric"] == "error_pct"
+    assert d2.best_value == 7.0

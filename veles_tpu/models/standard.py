@@ -18,7 +18,7 @@ from __future__ import annotations
 from typing import Dict, List, Optional, Sequence
 
 from ..ops.optimizers import HyperParams, OPTIMIZERS, Optimizer
-from ..units import nn, parallel_nn
+from ..units import nn, parallel_nn, recurrent
 from ..units.workflow import Workflow
 
 LAYER_TYPES = {
@@ -26,6 +26,11 @@ LAYER_TYPES = {
     "attention": parallel_nn.MultiHeadAttention,
     "moe": parallel_nn.MoEFFN,
     "pipeline_stack": parallel_nn.PipelineStack,
+    # recurrent family (reference: Znicz RNN/LSTM "created but not
+    # tested", manualrst_veles_algorithms.rst:115-134 — here tested)
+    "rnn": recurrent.RNN,
+    "gru": recurrent.GRU,
+    "lstm": recurrent.LSTM,
     "all2all": nn.All2All,
     "all2all_tanh": nn.All2AllTanh,
     "all2all_relu": nn.All2AllRELU,

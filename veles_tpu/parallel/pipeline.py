@@ -94,9 +94,16 @@ def _pipeline_local(stage_params, x_blk, *, apply_local, axis_name: str,
         local_i = jnp.clip(t_here - idx * Q, 0, Q - 1)
         in_conv = jnp.where(own, x_local[local_i], in_conv)
 
-        # -- stage compute: device 0 consumes the conveyor head (mb s)
+        # -- stage compute: device 0 consumes the conveyor head (mb s).
+        # checkpoint: the backward (reverse schedule via jax.grad of this
+        # scan) rematerializes stage internals instead of stashing them
+        # per step — per-device backward memory stays O(steps) carries,
+        # the GPipe-with-remat memory profile (1F1B's further O(S) stash
+        # reduction would need a manual interleaved bwd schedule; not
+        # worth the complexity at this depth).
         cur = jnp.where(idx == 0, in_conv, held)
-        out = apply_local(idx, p_local, cur)
+        out = jax.checkpoint(
+            lambda p, c: apply_local(idx, p, c))(p_local, cur)
 
         # -- output conveyor: last stage writes mb m = s - (S-1)
         m_written = s - (S - 1)
@@ -209,8 +216,7 @@ def pipeline_apply(stage_fn: Union[Callable, Sequence[Callable]],
         raise ValueError(
             f"microbatch size {x.shape[1]} not divisible over batch axes "
             f"{batch_axes} (total {bsz})")
-    mb_ax = (batch_axes if len(batch_axes) > 1 else batch_axes[0]) \
-        if batch_axes else None
+    mb_ax = batch_axes or None
     # grouped layout (S, Q, mb, ...): stage blocks on 'pipe', the batch
     # dim on the data axes
     x_spec = P(axis_name, None, mb_ax)

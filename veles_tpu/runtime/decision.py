@@ -47,18 +47,30 @@ class Decision(Logger):
         self.improved = False
         self.want_rollback = False
         self.lr_multiplier = 1.0
+        self._gauge_key = metric
         self.history: list = []
 
     def on_epoch(self, epoch: int, train_metrics: Dict[str, float],
                  valid_metrics: Dict[str, float]) -> bool:
         """Feed epoch results; returns True when training should stop."""
         gauge = valid_metrics if valid_metrics else train_metrics
-        value = gauge.get(self.metric)
+        # fall back classification -> regression -> raw loss, and report
+        # the key actually used (an MSE workflow's gauge is its RMSE, not
+        # a metric labeled "error_pct")
+        used = self.metric
+        value = gauge.get(used)
         if value is None:
-            value = gauge.get("loss", math.inf)
+            for used in ("rmse", "loss"):
+                if used in gauge:
+                    value = gauge[used]
+                    break
+            else:
+                used, value = "loss", math.inf
+        self._gauge_key = used
         self.history.append(
             {"epoch": epoch, "train": dict(train_metrics),
-             "valid": dict(valid_metrics), "value": value})
+             "valid": dict(valid_metrics), "value": value,
+             "metric": used})
 
         self.improved = value < self.best_value
         self.want_rollback = False
@@ -78,7 +90,7 @@ class Decision(Logger):
                           epoch, self.lr_multiplier)
 
         self.info("epoch %d: %s=%.4f (best %.4f @ %d)%s", epoch,
-                  self.metric, value, self.best_value, self.best_epoch,
+                  self._gauge_key, value, self.best_value, self.best_epoch,
                   " *" if self.improved else "")
 
         if self.max_epochs is not None and epoch + 1 >= self.max_epochs:

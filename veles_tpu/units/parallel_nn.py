@@ -183,11 +183,18 @@ class PipelineStack(Forward):
                 raise ValueError(
                     f"batch {B} not divisible into {n_mb} microbatches")
             xm = x.reshape((n_mb, B // n_mb) + x.shape[1:])
-            dp = tuple(a for a in ("data", "fsdp")
-                       if ctx.axis_size(a) > 1
-                       and (B // n_mb) % ctx.axis_size(a) == 0)
+            # greedy: take batch axes while the RUNNING PRODUCT still
+            # divides the per-microbatch batch (pipeline_apply validates
+            # against the product, not per axis)
+            dp, prod = [], 1
+            for a in ("data", "fsdp"):
+                sz = ctx.axis_size(a)
+                if sz > 1 and (B // n_mb) % (prod * sz) == 0:
+                    dp.append(a)
+                    prod *= sz
             y = pipeline_apply(self._stage_fn, stages, xm, ctx.mesh,
-                               axis_name=self.pipe_axis, batch_axes=dp)
+                               axis_name=self.pipe_axis,
+                               batch_axes=tuple(dp))
             return y.reshape(x.shape), state
         # sequential fallback: scan over the stage axis
         def body(h, p):
