@@ -254,6 +254,23 @@ class FlattenUnit : public Unit {
   }
 };
 
+class ReshapeUnit : public Unit {  // veles_tpu Reshape (e.g. 784 -> 28x28x1)
+ public:
+  std::vector<int64_t> dims;  // per-sample trailing dims
+  Shape OutputShape(const std::vector<Shape>& in) const override {
+    Shape s;
+    s.dims.push_back(in[0][0]);
+    for (auto d : dims) s.dims.push_back(d);
+    if (s.size() != in[0].size())
+      throw std::runtime_error("Reshape: element count mismatch");
+    return s;
+  }
+  void Run(const std::vector<const Tensor*>& in, Tensor* out,
+           UnitContext*) const override {
+    std::copy(in[0]->data, in[0]->data + in[0]->size(), out->data);
+  }
+};
+
 class IdentityUnit : public Unit {  // Dropout at inference, Avatar, etc.
  public:
   Shape OutputShape(const std::vector<Shape>& in) const override {
@@ -399,6 +416,15 @@ inline UnitPtr CreateUnit(const std::string& klass,
     return u;
   }
   if (klass == "Flatten") return std::make_unique<FlattenUnit>();
+  if (klass == "Reshape") {
+    auto u = std::make_unique<ReshapeUnit>();
+    if (config.has("shape")) {
+      const auto& arr = config.obj.at("shape");
+      for (size_t i = 0; i < arr->size(); ++i)
+        u->dims.push_back(static_cast<int64_t>((*arr)[i].num));
+    }
+    return u;
+  }
   if (klass == "Dropout" || klass == "Avatar" || klass == "TrivialUnit")
     return std::make_unique<IdentityUnit>();
   if (klass == "MeanDispNormalizer") {

@@ -157,3 +157,35 @@ def test_cpp_tuple_stride_and_strided_pool(binary, tmp_path, rng):
     ref = np.asarray(wf.make_predict_step("out")(
         ws, {"@input": jnp.asarray(x)}))
     np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-4)
+
+
+def test_cpp_reshape_conv_roundtrip(binary, tmp_path, rng):
+    """Reshape (flat 784 -> 28x28x1) exports and matches JAX through the
+    native runtime — the SynthDigitsConv serving path."""
+    import subprocess
+
+    import veles_tpu as vt
+    from veles_tpu.units import (All2AllSoftmax, ConvRELU, Flatten,
+                                 MaxPooling, Reshape, Workflow)
+
+    wf = Workflow("reshape_conv")
+    wf.add(Reshape((8, 8, 1), name="img"))
+    wf.add(ConvRELU(4, kx=3, padding=1, name="c1", inputs=("img",)))
+    wf.add(MaxPooling(window=2, stride=2, name="p1", inputs=("c1",)))
+    wf.add(Flatten(name="fl", inputs=("p1",)))
+    wf.add(All2AllSoftmax(5, name="out", inputs=("fl",)))
+    wf.build({"@input": vt.Spec((2, 64), jnp.float32)})
+    ws = wf.init_state(jax.random.key(0))
+    pkg = str(tmp_path / "pkg")
+    export_package(wf, ws, pkg,
+                   input_spec={"shape": [2, 64], "dtype": "float32"})
+
+    x = rng.standard_normal((2, 64)).astype(np.float32)
+    xin = str(tmp_path / "x.npy")
+    np.save(xin, x)
+    out = str(tmp_path / "y.npy")
+    subprocess.run([binary, pkg, xin, out], check=True,
+                   capture_output=True)
+    got = np.load(out)
+    ref = np.asarray(wf.make_predict_step("out")(ws, {"@input": x}))
+    np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-6)

@@ -41,6 +41,7 @@ _EXPORT_FIELDS = {
     "LRN": ("n", "k", "alpha", "beta"),
     "Dropout": ("ratio",),
     "Flatten": (),
+    "Reshape": ("shape",),
     "MeanDispNormalizer": (),
     "EvaluatorSoftmax": (),
     "EvaluatorMSE": (),

@@ -48,6 +48,7 @@ LAYER_TYPES = {
     "lrn": nn.LRN,
     "norm": nn.MeanDispNormalizer,
     "flatten": nn.Flatten,
+    "reshape": nn.Reshape,
 }
 
 
@@ -69,7 +70,8 @@ def build_workflow(name: str, layers: Sequence[dict], *,
         lname = spec.pop("name", f"l{i}_{ltype}")
         klass = LAYER_TYPES[ltype]
         if compute_dtype is not None and ltype.startswith(
-                ("all2all", "softmax", "conv", "deconv")):
+                ("all2all", "softmax", "conv", "deconv", "rnn", "gru",
+                 "lstm", "attention")):
             spec.setdefault("compute_dtype", compute_dtype)
         unit = klass(name=lname, inputs=(prev,), **spec)
         wf.add(unit)

@@ -320,6 +320,25 @@ class Flatten(Unit):
         return xs[0].reshape(xs[0].shape[0], -1), state
 
 
+class Reshape(Unit):
+    """Reshape the per-sample trailing dims (e.g. flat 784 -> 28x28x1 for a
+    conv trunk fed by a vector loader)."""
+
+    def __init__(self, shape, name=None, inputs=("@input",)):
+        super().__init__(name, inputs)
+        self.shape = tuple(int(s) for s in shape)
+
+    def output_spec(self, in_specs):
+        s = in_specs[0]
+        if int(np.prod(s.shape[1:])) != int(np.prod(self.shape)):
+            raise ValueError(
+                f"cannot reshape {s.shape[1:]} to {self.shape}")
+        return Spec((s.shape[0],) + self.shape, s.dtype)
+
+    def apply(self, params, state, xs, ctx):
+        return xs[0].reshape((xs[0].shape[0],) + self.shape), state
+
+
 # -- evaluators (loss units) -------------------------------------------------
 
 class Evaluator(Unit):

@@ -183,15 +183,18 @@ class PipelineStack(Forward):
                 raise ValueError(
                     f"batch {B} not divisible into {n_mb} microbatches")
             xm = x.reshape((n_mb, B // n_mb) + x.shape[1:])
-            # greedy: take batch axes while the RUNNING PRODUCT still
-            # divides the per-microbatch batch (pipeline_apply validates
-            # against the product, not per axis)
-            dp, prod = [], 1
-            for a in ("data", "fsdp"):
-                sz = ctx.axis_size(a)
-                if sz > 1 and (B // n_mb) % (prod * sz) == 0:
-                    dp.append(a)
-                    prod *= sz
+            # pick the batch-axis subset with the LARGEST dividing product
+            # (a fixed greedy order could choose data=2 over fsdp=4)
+            mb = B // n_mb
+            cands = [a for a in ("data", "fsdp") if ctx.axis_size(a) > 1]
+            best, dp = 1, []
+            for pick in range(1 << len(cands)):
+                sub = [a for i, a in enumerate(cands) if pick >> i & 1]
+                prod = 1
+                for a in sub:
+                    prod *= ctx.axis_size(a)
+                if mb % prod == 0 and prod > best:
+                    best, dp = prod, sub
             y = pipeline_apply(self._stage_fn, stages, xm, ctx.mesh,
                                axis_name=self.pipe_axis,
                                batch_axes=tuple(dp))
