@@ -154,6 +154,15 @@ def test_cli_ensemble_train_parallel_workers(tmp_path, config_file):
         assert m["best_value"] is not None and m["best_value"] < 60.0
         assert m["snapshot"] and os.path.exists(m["snapshot"])
 
+    # --ensemble-test: weighted vote over the stored member snapshots
+    # (reference: veles/ensemble/test_workflow.py:50-107)
+    r2 = run_cli(tmp_path, config_file,
+                 "--ensemble-test", str(out / "ensemble.json"))
+    assert r2.returncode == 0, r2.stderr
+    res = json.loads(r2.stdout.strip().splitlines()[-1])
+    assert res["ensemble_members"] == 2
+    assert res["valid_error_pct"] < 60.0
+
 
 def test_snapshot_http_restore(tmp_path):
     """http(s):// snapshot source (reference: veles/__main__.py:539-589)."""

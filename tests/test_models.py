@@ -147,3 +147,54 @@ def test_imagenet_loader_deterministic():
     l2.initialize()
     b2 = next(l2.iter_epoch(TRAIN, 0))
     np.testing.assert_array_equal(b1["@input"], b2["@input"])
+
+
+def test_conv_autoencoder_config_trains():
+    """Conv encoder + depool/deconv decoder from one StandardWorkflow
+    config (the Znicz deconv/depool AE pattern,
+    manualrst_veles_algorithms.rst) — loss decreasing on SynthDigits."""
+    from veles_tpu.models.standard import StandardWorkflow
+    from veles_tpu.models.mnist import MnistLoader
+    sw = StandardWorkflow({
+        "name": "ConvAE",
+        "layers": [
+            {"type": "reshape", "shape": [28, 28, 1], "name": "img"},
+            {"type": "conv_relu", "n_kernels": 8, "kx": 3, "padding": 1,
+             "name": "enc_conv"},
+            {"type": "max_pooling", "window": 2, "stride": 2,
+             "name": "enc_pool"},
+            {"type": "depool", "window": 2, "name": "dec_depool"},
+            {"type": "deconv", "n_kernels": 1, "kx": 3, "padding": "SAME",
+             "name": "dec_deconv"},
+            {"type": "flatten", "name": "flat"},
+        ],
+        "loss": "mse_input",
+        "optimizer": "adadelta",
+        "optimizer_args": {"lr": 1.0},
+        "max_epochs": 2,
+    })
+    sw.loader = MnistLoader(minibatch_size=100,
+                            n_train=1500, n_valid=300)
+    trainer = sw.make_trainer(sw.loader)
+    trainer.initialize(seed=0)
+    trainer.run()
+    hist = trainer.decision.history
+    assert hist[-1]["metric"] == "rmse"
+    assert hist[-1]["value"] < hist[0]["value"]
+
+
+def test_lr_policy_from_config():
+    """JSON-expressible lr adjust policies (reference: lr policies item 3,
+    manualrst_veles_algorithms.rst:156) resolve via LR_POLICIES."""
+    layers = [{"type": "softmax", "output_size": 2, "name": "out"}]
+    o = build_optimizer("momentum", layers, lr=0.1,
+                        lr_policy={"type": "exp", "gamma": 0.5,
+                                   "step_size": 10})
+    assert float(o.schedule(0)) == pytest.approx(0.1)
+    assert float(o.schedule(10)) == pytest.approx(0.05)
+    assert float(o.schedule(20)) == pytest.approx(0.025)
+    o2 = build_optimizer("sgd", layers, lr=0.2,
+                         lr_policy={"type": "step", "boundaries": [5],
+                                    "values": [0.02]})
+    assert float(o2.schedule(0)) == pytest.approx(0.2)
+    assert float(o2.schedule(6)) == pytest.approx(0.02)

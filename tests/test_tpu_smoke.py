@@ -1,0 +1,82 @@
+"""Opt-in on-chip smoke: run the Pallas kernels COMPILED (Mosaic) on a
+real TPU, in a subprocess free of the suite's CPU pin.
+
+The regular suite exercises these kernels in interpreter mode
+(tests/conftest.py pins the CPU platform); this module is the
+compiled-lowering proof, enabled with ``VELES_TPU_TESTS=1`` on a host
+with a healthy TPU. ``bench_tpu.py`` is the full timing harness; this is
+the fast correctness gate (reference analog: the per-backend same-math
+discipline of veles/tests/accelerated_test.py:41-70).
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("VELES_TPU_TESTS") != "1",
+    reason="set VELES_TPU_TESTS=1 on a TPU host to run compiled-kernel "
+           "smoke tests")
+
+SMOKE = r"""
+import numpy as np, jax, jax.numpy as jnp
+dev = jax.devices()[0]
+assert "TPU" in dev.device_kind.upper(), dev.device_kind
+from veles_tpu.ops import pallas_kernels as pk
+from veles_tpu.parallel.ring_attention import full_attention
+rng = np.random.default_rng(0)
+
+# flash attention fwd+bwd compiled vs XLA reference
+q, k, v = (jnp.asarray(rng.standard_normal((1, 384, 2, 64)), jnp.float32)
+           for _ in range(3))
+out = pk.flash_attention(q, k, v, True, None, 128, 128, False)
+ref = full_attention(q, k, v, causal=True)
+np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                           rtol=2e-4, atol=2e-5)
+gp = jax.grad(lambda a, b, c: jnp.sum(pk.flash_attention(
+    a, b, c, True, None, 128, 128, False)), argnums=(0, 1, 2))(q, k, v)
+gr = jax.grad(lambda a, b, c: jnp.sum(full_attention(
+    a, b, c, causal=True)), argnums=(0, 1, 2))(q, k, v)
+for a, b in zip(gp, gr):
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               rtol=2e-3, atol=2e-4)
+
+# fused dropout: rate + determinism
+x = jnp.asarray(rng.standard_normal((256, 512)), jnp.float32)
+o1 = pk.fused_dropout(x, 7, 0.4, 256, False)
+o2 = pk.fused_dropout(x, 7, 0.4, 256, False)
+np.testing.assert_array_equal(np.asarray(o1), np.asarray(o2))
+kept = float(jnp.mean(o1 != 0))
+assert abs(kept - 0.6) < 0.05, kept
+
+# mean/disp normalize vs jnp
+xb = jnp.asarray(rng.integers(0, 256, (64, 3072)), jnp.uint8)
+mean = jnp.asarray(rng.uniform(100, 150, 3072), jnp.float32)
+rd = jnp.asarray(rng.uniform(0.01, 0.02, 3072), jnp.float32)
+got = pk.mean_disp_normalize(xb, mean, rd, interpret=False)
+ref = (xb.astype(jnp.float32) - mean[None]) * rd[None]
+np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-6,
+                           atol=1e-5)
+
+# DMA gather vs take
+data = jnp.asarray(rng.standard_normal((1000, 784)), jnp.float32)
+idx = jnp.asarray(rng.permutation(1000)[:64], jnp.int32)
+np.testing.assert_array_equal(
+    np.asarray(pk.gather_rows(data, idx, interpret=False)),
+    np.asarray(jnp.take(data, idx, axis=0)))
+print("TPU_SMOKE_OK")
+"""
+
+
+def test_pallas_kernels_compiled_on_tpu():
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # let the TPU platform claim
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run([sys.executable, "-c", SMOKE], capture_output=True,
+                       text=True, env=env, timeout=1200)
+    assert r.returncode == 0, r.stderr[-3000:]
+    assert "TPU_SMOKE_OK" in r.stdout

@@ -312,10 +312,9 @@ def main(argv=None) -> int:
             print(name)
         return 0
 
-    if args.ensemble_test:
-        raise SystemExit(
-            "--ensemble-test requires a workflow factory; use the "
-            "veles_tpu.ensemble.EnsembleTester API (see docs)")
+    if args.ensemble_test and not args.config:
+        raise SystemExit("--ensemble-test needs the workflow config the "
+                         "members were trained with")
 
     if not args.config:
         build_parser().print_help()
@@ -403,6 +402,32 @@ def main(argv=None) -> int:
                              n_workers=args.workers, cli_argv=cli_argv)
         results = et.run()
         print(json.dumps({"members": len(results)}))
+        return 0
+
+    # -- ensemble test (reference --ensemble-test: weighted vote over the
+    # stored member snapshots, veles/ensemble/test_workflow.py:50-107) ----
+    if args.ensemble_test:
+        from .ensemble import EnsembleTester
+        from .loader.base import VALID
+
+        from .units.base import spec_of
+
+        trainer = trainer_factory(root)
+        trainer.loader.initialize()
+        if trainer.loader.class_lengths[VALID] == 0:
+            raise SystemExit(
+                "--ensemble-test needs a validation split in the loader")
+        batch = next(trainer.loader.iter_epoch(VALID))
+        trainer.workflow.build({k: spec_of(v) for k, v in batch.items()})
+        tester = EnsembleTester(lambda: trainer.workflow,
+                                args.ensemble_test)
+        err = tester.error_rate(trainer.loader.iter_epoch(VALID))
+        out = {"ensemble_members": len(tester.members),
+               "valid_error_pct": err}
+        print(json.dumps(out))
+        if args.result_file:
+            with open(args.result_file, "w") as f:
+                json.dump(out, f, indent=1)
         return 0
 
     # -- standalone training ------------------------------------------------

@@ -129,7 +129,16 @@ class EnsembleTester(Logger):
     def __init__(self, workflow_factory: Callable, manifest: str,
                  output_unit: Optional[str] = None):
         with open(manifest) as f:
-            self.members = json.load(f)
+            entries = json.load(f)
+        # drop failed members (the farm-out records them with
+        # snapshot=None rather than aborting the whole training run)
+        self.members = [m for m in entries if m.get("snapshot")]
+        dropped = len(entries) - len(self.members)
+        if dropped:
+            Logger.warning(self, "%d member(s) without snapshots skipped",
+                           dropped)
+        if not self.members:
+            raise ValueError(f"no usable members in {manifest}")
         wf = workflow_factory()
         self._predict = wf.make_predict_step(output_unit)
         self._wstates = [
@@ -144,7 +153,8 @@ class EnsembleTester(Logger):
             logits = np.asarray(self._predict(wstate, batch), np.float64)
             p = np.exp(logits - logits.max(-1, keepdims=True))
             p /= p.sum(-1, keepdims=True)
-            w = 1.0 / max(float(m.get("best_value", 1.0)), 1e-3)
+            bv = m.get("best_value")
+            w = 1.0 / max(float(bv if bv is not None else 1.0), 1e-3)
             votes = p * w if votes is None else votes + p * w
             total_w += w
         return votes / total_w

@@ -96,7 +96,19 @@ def build_workflow(name: str, layers: Sequence[dict], *,
 def build_optimizer(kind: str, layers: Sequence[dict],
                     **kwargs) -> Optimizer:
     """Optimizer from name + per-layer hyperparams gathered off the layer
-    configs (the reference's per-gradient-unit settings)."""
+    configs (the reference's per-gradient-unit settings).
+
+    ``lr_policy`` may be a config dict — ``{"type": "exp"|"inv"|"step"|
+    "fixed", ...args}`` — resolved through ops.optimizers.LR_POLICIES, so
+    JSON workflow configs can express the reference's lr adjust policies
+    (docs manualrst_veles_algorithms.rst:156 item 3)."""
+    policy = kwargs.get("lr_policy")
+    if isinstance(policy, dict):
+        from ..ops.optimizers import LR_POLICIES
+        p = dict(policy)
+        ptype = p.pop("type")
+        p.setdefault("base", kwargs.get("lr", 0.01))
+        kwargs["lr_policy"] = LR_POLICIES[ptype](**p)
     per_unit: Dict[str, HyperParams] = {}
     for i, spec in enumerate(layers):
         hp = spec.get("hyperparams")
