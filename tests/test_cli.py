@@ -192,3 +192,15 @@ def test_snapshot_http_restore(tmp_path):
         assert payload["workflow_checksum"] == "abc"
     finally:
         srv.shutdown()
+
+
+def test_cli_publish_report(tmp_path, config_file):
+    """--publish writes a run report after training (reference: the
+    Publisher unit, veles/publishing/publisher.py:57)."""
+    rep = tmp_path / "report"
+    r = run_cli(tmp_path, config_file,
+                "--publish", f"{rep}:markdown,html")
+    assert r.returncode == 0, r.stderr
+    md = (rep / "report.md").read_text()
+    assert "cli_test" in md and "best_value" in md
+    assert (rep / "report.html").exists()

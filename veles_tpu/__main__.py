@@ -97,6 +97,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--frontend", action="store_true",
                    help="serve a browser form that composes this command "
                         "line (reference: veles --frontend)")
+    p.add_argument("--publish", metavar="DIR[:FMT]",
+                   help="after training, write a run report to DIR; FMT "
+                        "is markdown (default), html or pdf — comma-"
+                        "separate for several (reference: the Publisher "
+                        "unit, veles/publishing/publisher.py:57)")
     p.add_argument("--verbose", "-v", action="store_true")
     p.add_argument("--list-units", action="store_true",
                    help="print the registered unit classes and exit")
@@ -454,6 +459,18 @@ def main(argv=None) -> int:
     if args.snapshot:
         trainer.restore(args.snapshot)
     results = trainer.run()
+    if args.publish:
+        from .plotting import MetricsRecorder  # noqa: F401 (type source)
+        from .publishing import (HtmlBackend, MarkdownBackend, PdfBackend,
+                                 Publisher)
+        out_dir, _, fmts = args.publish.partition(":")
+        kinds = {"markdown": MarkdownBackend, "html": HtmlBackend,
+                 "pdf": PdfBackend}
+        backends = [kinds[f.strip()](out_dir)
+                    for f in (fmts or "markdown").split(",")]
+        pub = Publisher(trainer.workflow.name, backends=backends)
+        pub.gather(trainer=trainer, config=root)
+        pub.publish()
     print(json.dumps(results))
     if args.result_file:
         import jax
