@@ -204,3 +204,42 @@ def test_cli_publish_report(tmp_path, config_file):
     md = (rep / "report.md").read_text()
     assert "cli_test" in md and "best_value" in md
     assert (rep / "report.html").exists()
+
+
+MESH_CONFIG_JSON = json.dumps({
+    "workflow": {
+        "name": "mesh_moe",
+        "layers": [
+            {"type": "moe", "n_experts": 2, "d_hidden": 16,
+             "name": "moe1", "top_k": 2},
+            {"type": "softmax", "output_size": 4, "name": "out"},
+        ],
+        "optimizer": "sgd",
+        "optimizer_args": {"lr": 0.05},
+        "max_epochs": 2,
+    },
+    "loader": {"name": "mnist", "minibatch_size": 64,
+               "n_train": 256, "n_valid": 64},
+})
+
+
+def test_cli_mesh_with_moe_autoshards(tmp_path):
+    """--mesh data=4,expert=2 on a config containing an MoE unit composes
+    the expert sharding rule automatically."""
+    cfg = tmp_path / "mesh_moe.json"
+    cfg.write_text(MESH_CONFIG_JSON)
+    res = tmp_path / "res.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO,
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "import jax; jax.config.update('jax_platforms','cpu');"
+         "from veles_tpu.__main__ import main; import sys;"
+         "sys.exit(main(sys.argv[1:]))",
+         str(cfg), "--mesh", "data=4,expert=2",
+         "--result-file", str(res)],
+        capture_output=True, text=True, env=env, cwd=str(tmp_path),
+        timeout=300)
+    assert r.returncode == 0, r.stderr
+    data = json.loads(res.read_text())
+    assert data["workflow"] == "mesh_moe"

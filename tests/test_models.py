@@ -198,3 +198,12 @@ def test_lr_policy_from_config():
                                     "values": [0.02]})
     assert float(o2.schedule(0)) == pytest.approx(0.2)
     assert float(o2.schedule(6)) == pytest.approx(0.02)
+
+
+def test_lr_policy_uses_optimizer_default_base():
+    """lr_policy without lr/base falls back to the optimizer's own lr
+    default (AdaDelta 1.0, not a flat 0.01)."""
+    layers = [{"type": "softmax", "output_size": 2, "name": "out"}]
+    o = build_optimizer("adadelta", layers,
+                        lr_policy={"type": "fixed"})
+    assert float(o.schedule(0)) == pytest.approx(1.0)

@@ -127,8 +127,25 @@ def _make_trainer_from_root(cfg: Config, args) -> Trainer:
         snap = Snapshotter(wf_cfg.get("name", "workflow"),
                            args.snapshot_dir)
     mesh = _make_mesh(args.mesh)
+    rule = None
+    if mesh is not None:
+        # auto-compose sharding rules for parallel units present in the
+        # graph (expert banks on 'expert', pipeline stages on 'pipe')
+        from .parallel.mesh import compose_rules, fsdp_rules
+        from .units.parallel_nn import (MoEFFN, PipelineStack,
+                                        expert_rules, pipeline_rules)
+        rules = []
+        kinds = {type(u) for u in sw.workflow.units}
+        if MoEFFN in kinds and mesh.shape.get("expert", 1) > 1:
+            rules.append(expert_rules())
+        if PipelineStack in kinds and mesh.shape.get("pipe", 1) > 1:
+            rules.append(pipeline_rules())
+        if mesh.shape.get("fsdp", 1) > 1:
+            rules.append(fsdp_rules(axis_size=mesh.shape["fsdp"]))
+        if rules:
+            rule = compose_rules(*rules)
     return Trainer(sw.workflow, loader, sw.optimizer, decision, snap,
-                   mesh=mesh)
+                   mesh=mesh, rule=rule)
 
 
 def _make_mesh(spec: Optional[str]):
@@ -214,6 +231,33 @@ def _forge_main(argv) -> int:
         with open(mpath) as f:
             print(json.dumps(client.upload(a.path, json.load(f))))
     return 0
+
+
+def _publish_backends():
+    from .publishing import HtmlBackend, MarkdownBackend, PdfBackend
+    return {"markdown": MarkdownBackend, "html": HtmlBackend,
+            "pdf": PdfBackend}
+
+
+class _LazyBackends:
+    def __getitem__(self, k):
+        return _publish_backends()[k]
+
+    def __contains__(self, k):
+        return k in ("markdown", "html", "pdf")
+
+
+_PUBLISH_BACKENDS = _LazyBackends()
+
+
+def _publish_fmts(fmts: str):
+    out = [f.strip() for f in (fmts or "markdown").split(",")]
+    bad = [f for f in out if f not in _PUBLISH_BACKENDS]
+    if bad:
+        raise SystemExit(
+            f"unknown --publish format(s) {bad}; "
+            "choose from markdown, html, pdf")
+    return out
 
 
 def _daemonize(log_path: str) -> int:
@@ -324,6 +368,14 @@ def main(argv=None) -> int:
     if not args.config:
         build_parser().print_help()
         return 2
+
+    if args.publish:
+        _publish_fmts(args.publish.partition(":")[2])  # fail fast on typos
+        if (args.optimize or args.ensemble_train or args.ensemble_test
+                or args.dry_run):
+            raise SystemExit("--publish applies to standalone training "
+                             "runs (meta-workflow reports: use the "
+                             "Publisher API)")
 
     if args.random_seed is not None:
         root.common.random_seed = args.random_seed
@@ -459,19 +511,17 @@ def main(argv=None) -> int:
     if args.snapshot:
         trainer.restore(args.snapshot)
     results = trainer.run()
+    print(json.dumps(results))
     if args.publish:
-        from .plotting import MetricsRecorder  # noqa: F401 (type source)
-        from .publishing import (HtmlBackend, MarkdownBackend, PdfBackend,
-                                 Publisher)
+        # after the results are emitted — a report typo must never eat a
+        # finished training run
+        from .publishing import Publisher
         out_dir, _, fmts = args.publish.partition(":")
-        kinds = {"markdown": MarkdownBackend, "html": HtmlBackend,
-                 "pdf": PdfBackend}
-        backends = [kinds[f.strip()](out_dir)
-                    for f in (fmts or "markdown").split(",")]
+        backends = [_PUBLISH_BACKENDS[f](out_dir) for f in _publish_fmts(
+            fmts)]
         pub = Publisher(trainer.workflow.name, backends=backends)
         pub.gather(trainer=trainer, config=root)
         pub.publish()
-    print(json.dumps(results))
     if args.result_file:
         import jax
         if jax.process_index() == 0:  # one writer per gang (cf. master's

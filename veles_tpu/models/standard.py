@@ -104,9 +104,17 @@ def build_optimizer(kind: str, layers: Sequence[dict],
     (docs manualrst_veles_algorithms.rst:156 item 3)."""
     policy = kwargs.get("lr_policy")
     if isinstance(policy, dict):
+        import inspect
+
         from ..ops.optimizers import LR_POLICIES
         p = dict(policy)
         ptype = p.pop("type")
+        if "base" not in p and "lr" not in kwargs:
+            # fall back to the optimizer's OWN lr default (AdaDelta is
+            # 1.0, Adam 1e-3 — a flat 0.01 would silently rescale them)
+            sig = inspect.signature(OPTIMIZERS[kind]).parameters.get("lr")
+            if sig is not None and sig.default is not inspect.Parameter.empty:
+                p["base"] = sig.default
         p.setdefault("base", kwargs.get("lr", 0.01))
         kwargs["lr_policy"] = LR_POLICIES[ptype](**p)
     per_unit: Dict[str, HyperParams] = {}
