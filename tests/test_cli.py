@@ -243,3 +243,28 @@ def test_cli_mesh_with_moe_autoshards(tmp_path):
     assert r.returncode == 0, r.stderr
     data = json.loads(res.read_text())
     assert data["workflow"] == "mesh_moe"
+
+
+def test_cli_profile_units(tmp_path, config_file):
+    """--profile-units prints the per-unit timing table before training
+    (reference: --sync-run + Workflow.print_stats top-5 table)."""
+    r = run_cli(tmp_path, config_file, "--profile-units")
+    assert r.returncode == 0, r.stderr
+    assert "TOTAL" in r.stdout and "fc1" in r.stdout
+
+
+def test_cli_random_seed_forms(tmp_path, config_file):
+    """--random-seed accepts int, 0x-hex, and entropy files (reference:
+    veles/__main__.py:483-537)."""
+    for seed in ("12345", "0xdeadbeef"):
+        r = run_cli(tmp_path, config_file, "--random-seed", seed,
+                    "--dry-run", "init")
+        assert r.returncode == 0, (seed, r.stderr)
+    sf = tmp_path / "seedfile"
+    sf.write_bytes(b"\x01\x02\x03\x04\x05\x06\x07\x08")
+    r = run_cli(tmp_path, config_file, "--random-seed", str(sf),
+                "--dry-run", "init")
+    assert r.returncode == 0, r.stderr
+    r = run_cli(tmp_path, config_file, "--random-seed", "nope!",
+                "--dry-run", "init")
+    assert r.returncode != 0

@@ -69,7 +69,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "go to --background-log")
     p.add_argument("--background-log", default="veles_tpu.log",
                    help="log file for --background mode")
-    p.add_argument("--random-seed", type=int, default=None)
+    p.add_argument("--random-seed", default=None,
+                   help="int, hex (0x...), or a file whose bytes seed the "
+                        "generators (reference: veles/__main__.py:483-537 "
+                        "accepted hex strings and /dev/urandom-style "
+                        "sources)")
     p.add_argument("--dump-config", action="store_true")
     p.add_argument("--dry-run", choices=["init", "build"], default=None,
                    help="stop after loader init / workflow build")
@@ -102,6 +106,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "is markdown (default), html or pdf — comma-"
                         "separate for several (reference: the Publisher "
                         "unit, veles/publishing/publisher.py:57)")
+    p.add_argument("--profile-units", action="store_true",
+                   help="before training, time each unit's apply with a "
+                        "forced device sync and print the top-5 table "
+                        "(reference: --sync-run honest per-unit timers + "
+                        "Workflow.print_stats)")
     p.add_argument("--verbose", "-v", action="store_true")
     p.add_argument("--list-units", action="store_true",
                    help="print the registered unit classes and exit")
@@ -233,30 +242,44 @@ def _forge_main(argv) -> int:
     return 0
 
 
+def _parse_seed(s: str) -> int:
+    """int, 0x-hex, or a file/device whose first 8 bytes seed things."""
+    import os
+    try:
+        return int(s, 10)
+    except ValueError:
+        pass
+    if s.lower().startswith("0x"):
+        try:
+            return int(s, 16)
+        except ValueError:
+            raise SystemExit(f"--random-seed {s!r}: bad hex literal")
+    if os.path.exists(s):  # regular file OR char device (/dev/urandom)
+        with open(s, "rb") as f:
+            data = f.read(8)
+        if not data:
+            raise SystemExit(f"seed file {s!r} is empty")
+        return int.from_bytes(data, "little")
+    raise SystemExit(
+        f"--random-seed {s!r}: not an int, 0x-hex, or readable file")
+
+
+_PUBLISH_FORMATS = ("markdown", "html", "pdf")
+
+
 def _publish_backends():
     from .publishing import HtmlBackend, MarkdownBackend, PdfBackend
     return {"markdown": MarkdownBackend, "html": HtmlBackend,
             "pdf": PdfBackend}
 
 
-class _LazyBackends:
-    def __getitem__(self, k):
-        return _publish_backends()[k]
-
-    def __contains__(self, k):
-        return k in ("markdown", "html", "pdf")
-
-
-_PUBLISH_BACKENDS = _LazyBackends()
-
-
 def _publish_fmts(fmts: str):
     out = [f.strip() for f in (fmts or "markdown").split(",")]
-    bad = [f for f in out if f not in _PUBLISH_BACKENDS]
+    bad = [f for f in out if f not in _PUBLISH_FORMATS]
     if bad:
         raise SystemExit(
             f"unknown --publish format(s) {bad}; "
-            "choose from markdown, html, pdf")
+            f"choose from {', '.join(_PUBLISH_FORMATS)}")
     return out
 
 
@@ -378,7 +401,7 @@ def main(argv=None) -> int:
                              "Publisher API)")
 
     if args.random_seed is not None:
-        root.common.random_seed = args.random_seed
+        root.common.random_seed = _parse_seed(args.random_seed)
         prng.streams.reset()
 
     create, manifest_snapshot = _load_config(args.config, args.overrides)
@@ -510,6 +533,12 @@ def main(argv=None) -> int:
         return 0
     if args.snapshot:
         trainer.restore(args.snapshot)
+    if args.profile_units:
+        from .loader.base import TRAIN, VALID as _VALID
+        klass = TRAIN if trainer.loader.class_lengths[TRAIN] else _VALID
+        batch = next(trainer.loader.iter_epoch(klass))
+        rows = trainer.workflow.profile_units(trainer.wstate, batch)
+        print(trainer.workflow.format_profile(rows))
     results = trainer.run()
     print(json.dumps(results))
     if args.publish:
@@ -517,8 +546,8 @@ def main(argv=None) -> int:
         # finished training run
         from .publishing import Publisher
         out_dir, _, fmts = args.publish.partition(":")
-        backends = [_PUBLISH_BACKENDS[f](out_dir) for f in _publish_fmts(
-            fmts)]
+        kinds = _publish_backends()
+        backends = [kinds[f](out_dir) for f in _publish_fmts(fmts)]
         pub = Publisher(trainer.workflow.name, backends=backends)
         pub.gather(trainer=trainer, config=root)
         pub.publish()
