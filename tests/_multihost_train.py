@@ -89,6 +89,37 @@ def main():
     with open(os.path.join(workdir, f"results_host{pid}.json"), "w") as f:
         json.dump({k: v for k, v in results.items()
                    if isinstance(v, (int, float))}, f)
+
+    # -- phase 3: composed dp(cross-host) × sp(intra-host) attention ------
+    # The pod-correct topology: the seq ring rides the fast intra-host
+    # axis while data parallelism crosses the process boundary (DCN).
+    from veles_tpu.models.standard import StandardWorkflow
+    B, T, E = 8, 8, 16
+    xs = rng.standard_normal((64, T, E)).astype(np.float32)
+    ys = (xs.mean((1, 2)) > 0).astype(np.int32)
+    sp_loader = vt.ArrayLoader({TRAIN: xs, VALID: xs[:16]},
+                               {TRAIN: ys, VALID: ys[:16]},
+                               minibatch_size=B,
+                               shard_index=pid, shard_count=nproc)
+    sw = StandardWorkflow({
+        "name": "mh_sp",
+        "layers": [
+            {"type": "attention", "n_heads": 2, "name": "attn",
+             "causal": False},
+            {"type": "flatten", "name": "flat"},
+            {"type": "softmax", "output_size": 2, "name": "out"},
+        ],
+        "optimizer": "momentum",
+        "optimizer_args": {"lr": 0.05, "momentum": 0.9},
+        "max_epochs": 2,
+    })
+    sp_mesh = make_mesh(MeshSpec(data=nproc, seq=2))
+    sp_tr = sw.make_trainer(sp_loader, mesh=sp_mesh)
+    sp_tr.initialize(seed=2)
+    sp_res = sp_tr.run()
+    assert np.isfinite(sp_res["best_value"]), sp_res
+    wq = gather_to_host(sp_tr.wstate["params"]["attn"])["wq"]
+    np.save(os.path.join(workdir, f"wq_host{pid}.npy"), np.asarray(wq))
     print(f"HOST {pid} DONE", flush=True)
 
 

@@ -52,3 +52,9 @@ def test_two_process_training_loopback(tmp_path):
     assert any(s.endswith(".json") for s in snaps)
     manifests = [s for s in snaps if s.startswith("mh_ep")]
     assert manifests, snaps
+
+    # phase 3: dp(cross-host) x sp(intra-host) attention training kept the
+    # replicated projections identical on both hosts
+    q0 = np.load(tmp_path / "wq_host0.npy")
+    q1 = np.load(tmp_path / "wq_host1.npy")
+    np.testing.assert_array_equal(q0, q1)
