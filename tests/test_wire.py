@@ -148,3 +148,54 @@ def test_deeply_nested_header_raises_wireerror():
     frame = _struct.pack("<II", len(body), len(sizes)) + body + sizes
     with pytest.raises(wire.WireError):
         wire.loads(frame)
+
+
+# -- adversarial robustness (hypothesis fuzz) -------------------------------
+
+try:
+    from hypothesis import given, settings, strategies as st
+    HAVE_HYP = True
+except ImportError:  # pragma: no cover
+    HAVE_HYP = False
+
+if HAVE_HYP:
+
+    @given(st.binary(max_size=512))
+    @settings(max_examples=300, deadline=None)
+    def test_wire_loads_never_raises_anything_but_wireerror(data):
+        """Contract: hostile bytes surface as WireError, never as any
+        other exception type (receivers catch only WireError)."""
+        try:
+            wire.loads(data)
+        except wire.WireError:
+            pass
+
+    @given(st.binary(max_size=200), st.binary(max_size=200))
+    @settings(max_examples=200, deadline=None)
+    def test_wire_frame_mutation_robustness(prefix, suffix):
+        """Valid frame with hostile prefix/suffix bytes spliced in."""
+        good = wire.dumps({"a": [1, 2], "x": np.arange(6.).reshape(2, 3)})
+        for candidate in (prefix + good, good + suffix,
+                          prefix + good[:len(good) // 2]):
+            try:
+                wire.loads(candidate)
+            except wire.WireError:
+                pass
+
+    @given(st.recursive(
+        st.one_of(st.none(), st.booleans(),
+                  st.floats(allow_nan=False, allow_infinity=False),
+                  st.integers(min_value=-2**53, max_value=2**53),
+                  st.text(max_size=20)),
+        lambda children: st.one_of(
+            st.lists(children, max_size=4),
+            st.dictionaries(
+                st.text(max_size=8).filter(
+                    lambda s: not s.startswith("\x00")),  # reserved prefix
+                children, max_size=4)),
+        max_leaves=20))
+    @settings(max_examples=200, deadline=None)
+    def test_wire_roundtrip_json_values(payload):
+        """dumps->loads is identity for JSON-shaped payloads."""
+        out = wire.loads(wire.dumps(payload))
+        assert out == payload or (payload != payload)  # NaN-free by strategy
