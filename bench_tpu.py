@@ -21,6 +21,7 @@ import numpy as np
 
 WARMUP = 3
 ITERS = 20
+REPS = 8  # in-graph repetitions per dispatch (see timeit)
 
 
 def drain(out):
@@ -33,14 +34,47 @@ def drain(out):
 
 
 def timeit(fn, *args, iters=ITERS):
-    for _ in range(WARMUP):
+    """Per-call wall time of ``fn`` — measured with REPS invocations
+    chained INSIDE one jit.  The axon tunnel adds a ~4 ms fixed dispatch
+    latency per executable launch (measured: a 256x256 scalar multiply
+    costs 4 ms end-to-end), which would swamp any sub-10 ms kernel; the
+    chain amortizes it.  A denormal-scaled feedback term creates a data
+    dependence between repetitions that XLA cannot constant-fold away
+    (0.0 * x WOULD be folded), so the repetitions really serialize."""
+    import jax
+    import jax.numpy as jnp
+
+    # Thread the dependence through the SMALLEST argument so the chain
+    # edge itself costs almost nothing (chaining through e.g. the 188 MB
+    # gather dataset would add a full HBM pass per repetition).
+    j = int(np.argmin([np.prod(a.shape, dtype=np.int64) if a.shape else 1
+                       for a in args]))
+
+    def chained(*args):
         out = fn(*args)
+        for _ in range(REPS - 1):
+            # The barrier forces each repetition's outputs to actually
+            # materialize: without it XLA fuses an intermediate rep's
+            # elementwise output straight into the scalar feedback sum and
+            # never writes it — an unfair edge over the opaque pallas_call,
+            # which always writes its outputs.
+            out = jax.lax.optimization_barrier(out)
+            leaf = jax.tree.leaves(out)[0]
+            eps = jnp.sum(leaf.astype(jnp.float32)) * 1e-38
+            args = list(args)
+            args[j] = args[j] + eps.astype(args[j].dtype)
+            out = fn(*args)
+        return out
+
+    cf = jax.jit(chained)
+    for _ in range(WARMUP):
+        out = cf(*args)
     drain(out)
     t0 = time.perf_counter()
     for _ in range(iters):
-        out = fn(*args)
+        out = cf(*args)
     drain(out)
-    return (time.perf_counter() - t0) / iters, out
+    return (time.perf_counter() - t0) / (iters * REPS), fn(*args)
 
 
 def rel_err(a, b):
@@ -83,7 +117,7 @@ def main():
             rng.standard_normal((B, T, H, D)), dtype) for _ in range(3))
 
         flash = jax.jit(lambda q, k, v: pk.flash_attention(
-            q, k, v, True, None, 128, 128, False))
+            q, k, v, True, None, interpret=False))
         xla = jax.jit(lambda q, k, v: full_attention(q, k, v, causal=True))
         t_p, out_p = timeit(flash, q, k, v)
         t_x, out_x = timeit(xla, q, k, v)
@@ -94,7 +128,7 @@ def main():
         # (the round-1 path) vs full XLA attention grad
         flash_g = jax.jit(jax.grad(
             lambda q, k, v: jnp.sum(pk.flash_attention(
-                q, k, v, True, None, 128, 128, False)
+                q, k, v, True, None, interpret=False)
                 .astype(jnp.float32)), argnums=(0, 1, 2)))
         block_g = jax.jit(jax.grad(
             lambda q, k, v: jnp.sum(blockwise_attention(

@@ -207,3 +207,22 @@ def test_lr_policy_uses_optimizer_default_base():
     o = build_optimizer("adadelta", layers,
                         lr_policy={"type": "fixed"})
     assert float(o.schedule(0)) == pytest.approx(1.0)
+
+
+def test_stl_workflow_single_step():
+    from veles_tpu.models import stl_workflow
+    sw = stl_workflow(minibatch_size=16,
+                      loader_args={"n_train": 64, "n_valid": 32})
+    assert sw.loader.synthetic
+    assert sw.loader._data[TRAIN].shape[1:] == (96, 96, 3)
+    wf = sw.workflow
+    wf.build({"@input": vt.Spec((16, 96, 96, 3), jnp.float32),
+              "@labels": vt.Spec((16,), jnp.int32),
+              "@mask": vt.Spec((16,), jnp.float32)})
+    ws = wf.init_state(jax.random.key(0), sw.optimizer)
+    step = wf.make_train_step(sw.optimizer)
+    batch = {"@input": jnp.ones((16, 96, 96, 3)),
+             "@labels": jnp.zeros((16,), jnp.int32),
+             "@mask": jnp.ones((16,))}
+    ws, mets = step(ws, batch)
+    assert np.isfinite(float(mets["loss"]))

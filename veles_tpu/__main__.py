@@ -35,6 +35,7 @@ from .runtime import Decision, Snapshotter, Trainer
 LOADERS = {
     "mnist": "veles_tpu.models.mnist:MnistLoader",
     "cifar": "veles_tpu.models.cifar:CifarLoader",
+    "stl": "veles_tpu.models.stl:StlLoader",
     "imagenet_synthetic":
         "veles_tpu.models.alexnet:ImagenetSyntheticLoader",
 }

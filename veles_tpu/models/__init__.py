@@ -3,3 +3,4 @@ from .mnist import mnist_workflow, MnistLoader
 from .cifar import cifar_workflow, CifarLoader
 from .alexnet import alexnet_workflow, ImagenetSyntheticLoader
 from .autoencoder import mnist_autoencoder_workflow
+from .stl import stl_workflow, StlLoader

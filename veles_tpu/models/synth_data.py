@@ -391,10 +391,13 @@ def render_shapes(labels: np.ndarray, rng: np.random.Generator,
 
 
 def synth_shapes(n_train: int = 50000, n_valid: int = 10000,
-                 seed: int = 20260730, cache: bool = True
+                 seed: int = 20260730, cache: bool = True, size: int = 32
                  ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
-    """Full-size deterministic shape dataset (CIFAR-10 stand-in)."""
-    tag = f"synthshapes_v{_SHAPES_VERSION}_{n_train}_{n_valid}_{seed}.npz"
+    """Full-size deterministic shape dataset (CIFAR-10 stand-in; at
+    ``size=96`` with STL-10 split sizes it is the STL-10 stand-in —
+    see models/stl.py)."""
+    tag = (f"synthshapes_v{_SHAPES_VERSION}_{n_train}_{n_valid}_{seed}"
+           + (f"_s{size}" if size != 32 else "") + ".npz")
     path = os.path.join(CACHE_DIR, tag)
     if cache and os.path.exists(path):
         with np.load(path) as z:
@@ -402,8 +405,8 @@ def synth_shapes(n_train: int = 50000, n_valid: int = 10000,
     rng = np.random.default_rng(seed)
     yt = rng.integers(0, 10, n_train).astype(np.int32)
     yv = rng.integers(0, 10, n_valid).astype(np.int32)
-    xt = render_shapes(yt, rng)
-    xv = render_shapes(yv, rng)
+    xt = render_shapes(yt, rng, size=size)
+    xv = render_shapes(yv, rng, size=size)
     if cache:
         _publish_cache(path, xt=xt, yt=yt, xv=xv, yv=yv)
     return xt, yt, xv, yv

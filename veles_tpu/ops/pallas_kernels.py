@@ -70,9 +70,13 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
     def _step():
-        q = q_ref[0].astype(jnp.float32)  # (block_q, D)
-        k_blk = k_ref[0].astype(jnp.float32)  # (block_k, D)
-        v_blk = v_ref[0].astype(jnp.float32)
+        # Operands stay in their storage dtype (bf16 inputs hit the MXU at
+        # the bf16 rate); accumulation is forced to f32 via
+        # preferred_element_type — casting to f32 first would silently run
+        # the matmuls at the several-times-slower f32 MXU rate.
+        q = q_ref[0]  # (block_q, D)
+        k_blk = k_ref[0]  # (block_k, D)
+        v_blk = v_ref[0]
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
@@ -84,14 +88,17 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
                 jnp.int32, (block_q, block_k), 0)
             mask = mask & (k_pos <= q_pos)
         s = jnp.where(mask, s, -1e30)
+        # Row state m/l is kept as (block_q, 1) column vectors — keepdims
+        # math throughout, because Mosaic's layout rules want >=2-D values
+        # (rank-2 with a unit minor dim lowers cleanly; rank-1 does not).
         m = m_ref[:]
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         alpha = jnp.exp(m - m_new)
-        p = jnp.where(mask, jnp.exp(s - m_new[:, None]), 0.0)
+        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
         m_ref[:] = m_new
-        l_ref[:] = alpha * l_ref[:] + jnp.sum(p, axis=-1)
-        acc_ref[:] = acc_ref[:] * alpha[:, None] + jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())),
+        l_ref[:] = alpha * l_ref[:] + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     if causal:
@@ -103,9 +110,10 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
     @pl.when(kj == n_kb - 1)
     def _finalize():
         o_ref[0] = (acc_ref[:]
-                    / jnp.maximum(l_ref[:], 1e-30)[:, None]
-                    ).astype(o_ref.dtype)
-        # logsumexp per row, consumed by the Pallas backward kernels
+                    / jnp.maximum(l_ref[:], 1e-30)).astype(o_ref.dtype)
+        # logsumexp per row, consumed by the Pallas backward kernels.
+        # Stored as (BH, T, 1): the unit minor dim keeps the block shape
+        # legal under Mosaic's (8, 128)-divisible-or-full rule.
         lse_ref[0] = m_ref[:] + jnp.log(jnp.maximum(l_ref[:], 1e-30))
 
 
@@ -147,17 +155,22 @@ def _flash_fwd(q, k, v, *, causal, scale, block_q, block_k, interpret,
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B * H, tq_p, D), q.dtype),
-            jax.ShapeDtypeStruct((B * H, tq_p), jnp.float32),
+            jax.ShapeDtypeStruct((B * H, tq_p, 1), jnp.float32),
         ],
         scratch_shapes=[
-            pltpu.VMEM((block_q,), jnp.float32),
-            pltpu.VMEM((block_q,), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, D), jnp.float32),
         ],
+        # batch*head and q-block steps are independent; only the k sweep
+        # carries the online-softmax state — telling Mosaic lets it
+        # pipeline DMAs across grid steps instead of serializing.
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(interpret),
     )(qm, km, vm)
     out = out[:, :Tq].reshape(B, H, Tq, D).transpose(0, 2, 1, 3)
@@ -193,22 +206,21 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
     def _step():
-        q = q_ref[0].astype(jnp.float32)
-        k_blk = k_ref[0].astype(jnp.float32)
-        v_blk = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q, k_blk, v_blk, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
         mask = _flash_bwd_mask(qi, kj, causal=causal, block_q=block_q,
                                block_k=block_k, tq=tq, tk=tk)
-        p = jnp.where(mask, jnp.exp(s - lse_ref[0][:, None]), 0.0)
+        # lse/delta blocks are (block_q, 1) column vectors — broadcast
+        # against the (block_q, block_k) score tile directly.
+        p = jnp.where(mask, jnp.exp(s - lse_ref[0]), 0.0)
         dp = jax.lax.dot_general(
             do, v_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0][:, None]) * scale
+        ds = p * (dp - delta_ref[0]) * scale
         acc_ref[:] += jax.lax.dot_general(
-            ds, k_blk, (((1,), (0,)), ((), ())),
+            ds.astype(k_blk.dtype), k_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     if causal:
@@ -234,25 +246,22 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
     def _step():
-        q = q_ref[0].astype(jnp.float32)
-        k_blk = k_ref[0].astype(jnp.float32)
-        v_blk = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q, k_blk, v_blk, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
         mask = _flash_bwd_mask(qi, kj, causal=causal, block_q=block_q,
                                block_k=block_k, tq=tq, tk=tk)
-        p = jnp.where(mask, jnp.exp(s - lse_ref[0][:, None]), 0.0)
+        p = jnp.where(mask, jnp.exp(s - lse_ref[0]), 0.0)
         dv_acc[:] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(
             do, v_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0][:, None]) * scale
+        ds = p * (dp - delta_ref[0]) * scale
         dk_acc[:] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     if causal:
@@ -279,9 +288,10 @@ def _flash_bwd(q, k, v, out, lse, g, *, causal, scale, block_q, block_k,
     vm = _flash_layout(v, Tk, tk_p)
     dom = _flash_layout(g, Tq, tq_p)
     om = _flash_layout(out, Tq, tq_p)
-    # delta_i = rowsum(dO * O) — cheap elementwise+reduce, left to XLA
+    # delta_i = rowsum(dO * O) — cheap elementwise+reduce, left to XLA;
+    # shaped (BH, T, 1) to match the kernels' column-vector blocks.
     delta = jnp.sum(dom.astype(jnp.float32) * om.astype(jnp.float32),
-                    axis=-1)
+                    axis=-1, keepdims=True)
 
     itp = _interpret(interpret)
     common = dict(scale=scale_, causal=causal, block_q=block_q,
@@ -294,12 +304,14 @@ def _flash_bwd(q, k, v, out, lse, g, *, causal, scale, block_q, block_k,
             pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B * H, tq_p, D), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=itp,
     )(qm, km, vm, dom, lse, delta)
 
@@ -311,8 +323,8 @@ def _flash_bwd(q, k, v, out, lse, g, *, causal, scale, block_q, block_k,
             pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
+            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
@@ -326,6 +338,8 @@ def _flash_bwd(q, k, v, out, lse, g, *, causal, scale, block_q, block_k,
             pltpu.VMEM((block_k, D), jnp.float32),
             pltpu.VMEM((block_k, D), jnp.float32),
         ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=itp,
     )(qm, km, vm, dom, lse, delta)
 
@@ -336,8 +350,8 @@ def _flash_bwd(q, k, v, out, lse, g, *, causal, scale, block_q, block_k,
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
-                    block_k=128, interpret=None):
+def flash_attention(q, k, v, causal=False, scale=None, block_q=256,
+                    block_k=1024, interpret=None):
     """Blockwise-softmax attention, forward and backward as Pallas kernels.
 
     q/k/v: (B, T, H, D) -> (B, Tq, H, D).  The backward is the standard
@@ -473,7 +487,7 @@ def _mean_disp_kernel(x_ref, mean_ref, rdisp_ref, o_ref):
                 * rdisp_ref[:]).astype(o_ref.dtype)
 
 
-def mean_disp_normalize(x, mean, rdisp, *, block_rows=128, block_cols=8192,
+def mean_disp_normalize(x, mean, rdisp, *, block_rows=128, block_cols=4096,
                         interpret=None, dtype=jnp.float32):
     """(x - mean) * rdisp with x typically uint8; tiled elementwise kernel
     (reference: ocl/mean_disp_normalizer.cl).  Columns are tiled too so

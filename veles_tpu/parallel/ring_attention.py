@@ -63,9 +63,11 @@ def blockwise_attention(q, k, v, *, block_size: int = 512,
         from ..ops import use_pallas_default
         use_flash = use_pallas_default()
     if use_flash:
+        # The kernel's own block defaults (256x1024, swept on-chip —
+        # BASELINE.md) beat any 128-capped choice; ``block_size`` here
+        # only describes the jnp scan granularity below.
         from ..ops.pallas_kernels import flash_attention
-        return flash_attention(q, k, v, causal, scale,
-                               min(128, block_size), min(128, block_size))
+        return flash_attention(q, k, v, causal, scale)
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
     scale = scale if scale is not None else D ** -0.5
