@@ -149,16 +149,18 @@ def main():
 
     # -- fused dropout ----------------------------------------------------
     x = jnp.asarray(rng.standard_normal((4096, 4096)), jnp.float32)
-    fd = jax.jit(lambda x: pk.fused_dropout(x, 123, 0.3, 256, False))
+    seed = jnp.uint32(123)  # scalar arg = cheap chain edge for timeit
+    fd = jax.jit(lambda x, s: pk.fused_dropout(x, s, 0.3, 256, False))
     key = jax.random.key(0)
 
-    def xla_dropout(x):
-        keep = jax.random.bernoulli(key, 0.7, x.shape)
+    def xla_dropout(x, s):
+        keep = jax.random.bernoulli(jax.random.fold_in(key, s), 0.7,
+                                    x.shape)
         return jnp.where(keep, x / 0.7, 0.0)
 
     xd = jax.jit(xla_dropout)
-    t_p, out_p = timeit(fd, x)
-    t_x, _ = timeit(xd, x)
+    t_p, out_p = timeit(fd, x, seed)
+    t_x, _ = timeit(xd, x, seed)
     kept = float(jnp.mean(out_p != 0))
     record("fused_dropout_4096x4096", t_p, t_x,
            abs(kept - 0.7) / 0.7, kept_fraction=round(kept, 4))
@@ -167,12 +169,14 @@ def main():
     xb = jnp.asarray(rng.integers(0, 256, (512, 224 * 224 * 3)), jnp.uint8)
     mean = jnp.asarray(rng.uniform(100, 150, 224 * 224 * 3), jnp.float32)
     rdisp = jnp.asarray(rng.uniform(0.01, 0.02, 224 * 224 * 3), jnp.float32)
-    md = jax.jit(lambda x: pk.mean_disp_normalize(x, mean, rdisp,
-                                                  interpret=False))
-    mx = jax.jit(lambda x: (x.astype(jnp.float32) - mean[None]) *
-                 rdisp[None])
-    t_p, out_p = timeit(md, xb)
-    t_x, out_x = timeit(mx, xb)
+    # mean/rdisp as real args: timeit threads its chain edge through the
+    # smallest arg, so the 77 MB image block is not rewritten per rep
+    md = jax.jit(lambda x, m, r: pk.mean_disp_normalize(x, m, r,
+                                                        interpret=False))
+    mx = jax.jit(lambda x, m, r: (x.astype(jnp.float32) - m[None]) *
+                 r[None])
+    t_p, out_p = timeit(md, xb, mean, rdisp)
+    t_x, out_x = timeit(mx, xb, mean, rdisp)
     record("mean_disp_normalize_512x150k", t_p, t_x, rel_err(out_p, out_x))
 
     # -- fullbatch DMA gather --------------------------------------------

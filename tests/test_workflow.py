@@ -223,3 +223,36 @@ def test_decision_gauges_rmse_for_mse_workflows():
     d2.on_epoch(0, {}, {"error_pct": 7.0, "loss": 0.1})
     assert d2.history[-1]["metric"] == "error_pct"
     assert d2.best_value == 7.0
+
+
+def test_fullbatch_upload_failure_no_identical_retry(rng, monkeypatch):
+    """With the default gather (plain jnp.take, no packed layout) a failed
+    upload must fall straight to host gather — retrying without packing
+    would re-run a byte-identical upload (round-2 review finding)."""
+    data_t, lab_t = make_blobs(rng, 64)
+    loader = vt.FullBatchLoader({TRAIN: data_t}, {TRAIN: lab_t},
+                                minibatch_size=32)
+    calls = []
+
+    def boom(allow_pallas=True):
+        calls.append(allow_pallas)
+        raise RuntimeError("synthetic HBM OOM")
+
+    monkeypatch.setattr(loader, "_upload", boom)
+    loader.initialize()
+    assert not loader.on_device
+    assert calls == [True]
+
+    # explicit packed gather: the unpacked retry IS meaningful
+    loader2 = vt.FullBatchLoader({TRAIN: data_t}, {TRAIN: lab_t},
+                                 minibatch_size=32, use_pallas_gather=True)
+    calls2 = []
+
+    def boom2(allow_pallas=True):
+        calls2.append(allow_pallas)
+        raise RuntimeError("synthetic HBM OOM")
+
+    monkeypatch.setattr(loader2, "_upload", boom2)
+    loader2.initialize()
+    assert not loader2.on_device
+    assert calls2 == [True, False]

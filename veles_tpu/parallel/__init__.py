@@ -4,7 +4,7 @@ from .mesh import (MeshSpec, make_mesh, data_parallel_rules, fsdp_rules,
 from .distributed import initialize_distributed, is_multihost, host_count
 from .launcher import HostLauncher, launch_hosts
 from .ring_attention import ring_attention, blockwise_attention
-from .pipeline import (pipeline_apply, stack_stage_params,
-                       pipeline_stage_shardings)
+from .pipeline import (pipeline_apply, pipeline_train_step,
+                       stack_stage_params, pipeline_stage_shardings)
 from .moe import init_moe_params, moe_apply, moe_shardings
 from .pool import CliRunner, ParallelMap

@@ -240,6 +240,202 @@ def _stage_spec(a, axis_name: str) -> P:
     return P(axis_name, *([None] * (a.ndim - 1)))
 
 
+# ---------------------------------------------------------------------------
+# 1F1B fused train step
+# ---------------------------------------------------------------------------
+
+def _1f1b_local(stage_params, x_blk, y_blk, *, apply_local, loss_local,
+                axis_name: str, batch_axes, n_microbatches: int,
+                n_stages: int):
+    """Per-device 1F1B body under shard_map.
+
+    Lockstep schedule over s = 0..n_mb+2(S-1)-1 where EVERY step carries
+    one forward slot and one backward slot per device:
+
+    * fwd: device d runs stage d on microbatch ``m_f = s - d``;
+    * bwd: device d runs the stage VJP on ``m_b = s - 2(S-1) + d``.
+
+    At the last stage ``m_f == m_b`` — the loss gradient of a microbatch
+    is computed in the same step its forward completes, so backward waves
+    start draining immediately (the "one forward, one backward" steady
+    state).  Device d holds at most ``2(S-1-d)+1`` stashed stage inputs —
+    bounded by the pipeline depth, NOT by n_microbatches, which is the
+    1F1B memory property GPipe-with-tape lacks.  Stage internals are
+    rematerialized inside the VJP (activation-stash-only recompute
+    backward, the standard 1F1B memory/compute trade).
+    """
+    S, Q = n_stages, n_microbatches // n_stages
+    K = 2 * (S - 1) + 1 if S > 1 else 1      # stash depth (max in-flight)
+    idx = jax.lax.axis_index(axis_name)
+    p_local = jax.tree.map(lambda a: a[0], stage_params)
+    x_local = x_blk[0]                       # (Q, mb...)
+    y_local = y_blk[0]                       # (Q, lbl...)
+    mb_shape = x_local.shape[1:]
+    lbl_shape = y_local.shape[1:]
+
+    down = [(i, (i - 1) % S) for i in range(S)]
+    up = [(i, (i + 1) % S) for i in range(S)]
+    n_steps = n_microbatches + 2 * (S - 1)
+
+    def stage_f(p, x):
+        return apply_local(idx, p, x)
+
+    def body(carry, s):
+        (held, g_held, in_conv, lbl_conv, stash, gp_acc, loss_acc) = carry
+
+        # -- input conveyor (converges down to stage 0): load mb s+idx
+        t_in = s + idx
+        own_in = (t_in >= idx * Q) & (t_in < (idx + 1) * Q) \
+            & (t_in < n_microbatches)
+        in_conv = jnp.where(own_in, x_local[jnp.clip(t_in - idx * Q,
+                                                     0, Q - 1)], in_conv)
+
+        # -- label conveyor (converges up to stage S-1): device c loads
+        # label mb t = s - c; after S-1-c up-hops it reaches the last
+        # stage at step t + S - 1, exactly when that microbatch's forward
+        # completes there.
+        t_lb = s - idx
+        own_lb = (t_lb >= idx * Q) & (t_lb < (idx + 1) * Q) \
+            & (t_lb < n_microbatches)
+        lbl_conv = jnp.where(own_lb, y_local[jnp.clip(t_lb - idx * Q,
+                                                      0, Q - 1)], lbl_conv)
+
+        # -- forward slot: mb m_f = s - idx
+        m_f = s - idx
+        f_valid = (m_f >= 0) & (m_f < n_microbatches)
+        cur = jnp.where(idx == 0, in_conv, held)
+        out = stage_f(p_local, cur)
+        # stash this step's stage input for the matching backward
+        stash = jnp.where(f_valid,
+                          stash.at[jnp.mod(m_f, K)].set(cur), stash)
+
+        # -- backward slot: mb m_b = s - 2(S-1) + idx
+        m_b = s - 2 * (S - 1) + idx
+        b_valid = (m_b >= 0) & (m_b < n_microbatches)
+        x_saved = stash[jnp.mod(m_b, K)]
+        # last stage: m_b == m_f, loss grad comes straight off this
+        # step's forward output; other stages consume the rotated
+        # cotangent from the stage above.
+        loss_m, gy_last = jax.value_and_grad(loss_local)(out, lbl_conv)
+        gy = jnp.where(idx == S - 1, gy_last, g_held)
+        _, vjp = jax.vjp(stage_f, p_local, x_saved)
+        gp, gx = vjp(gy)
+        gp_acc = jax.tree.map(
+            lambda a, g: a + jnp.where(b_valid, g, 0), gp_acc, gp)
+        loss_acc = loss_acc + jnp.where(
+            (idx == S - 1) & f_valid, loss_m, 0.0)
+
+        held = jax.lax.ppermute(out, axis_name, up)
+        g_held = jax.lax.ppermute(jnp.where(b_valid, gx, 0.0),
+                                  axis_name, down)
+        in_conv = jax.lax.ppermute(in_conv, axis_name, down)
+        lbl_conv = jax.lax.ppermute(lbl_conv, axis_name, up)
+        return (held, g_held, in_conv, lbl_conv, stash, gp_acc,
+                loss_acc), None
+
+    zeros = jnp.zeros(mb_shape, x_local.dtype)
+    carry0 = (zeros, zeros, zeros,
+              jnp.zeros(lbl_shape, y_local.dtype),
+              jnp.zeros((K,) + mb_shape, x_local.dtype),
+              jax.tree.map(jnp.zeros_like, p_local),
+              jnp.zeros((), jnp.float32))
+    (_, _, _, _, _, gp_acc, loss_acc), _ = jax.lax.scan(
+        body, carry0, jnp.arange(n_steps))
+    # batch dims may be sharded over data axes: reduce across those shards
+    # (params are replicated there), then rescale so per-microbatch
+    # semantics stay "loss_fn over the FULL microbatch" — each shard saw
+    # loss_fn over a 1/bsz slice, so the psum of per-shard means is bsz
+    # times the global mean.
+    bsz = 1
+    for ax in batch_axes:
+        bsz *= jax.lax.psum(1, ax)
+        gp_acc = jax.tree.map(
+            lambda g: jax.lax.psum(g, ax), gp_acc)
+        loss_acc = jax.lax.psum(loss_acc, ax)
+    gp_acc = jax.tree.map(lambda g: g / bsz, gp_acc)
+    loss_acc = loss_acc / bsz
+    # the loss lives on the last stage only; share it along the pipe ring
+    loss_acc = jax.lax.psum(loss_acc, axis_name) / n_microbatches
+    return (jax.tree.map(lambda g: g[None], gp_acc), loss_acc)
+
+
+def pipeline_train_step(stage_fn: Union[Callable, Sequence[Callable]],
+                        loss_fn: Callable, params, x, labels, mesh: Mesh, *,
+                        axis_name: str = "pipe",
+                        batch_axes: Sequence[str] = ()):
+    """Fused 1F1B pipeline training step: returns ``(loss, param_grads)``.
+
+    Unlike :func:`pipeline_apply` + ``jax.grad`` (GPipe schedule: AD tapes
+    O(n_microbatches) carries per device), this hand-scheduled step
+    interleaves one forward and one backward per device per step and
+    stashes at most ``2(S-1)+1`` stage inputs — backward memory bounded by
+    pipeline depth.  The trade: it IS the training step (fwd+bwd fused),
+    so it composes with an optimizer, not with arbitrary surrounding AD —
+    use it when the model is the pipeline (the Megatron-style scheduling
+    contract).
+
+    ``loss_fn(y_mb, label_mb) -> scalar`` is evaluated on the last stage's
+    output per microbatch and MUST be a mean (not a sum) over its
+    microbatch slice when ``batch_axes`` shards the batch dim — the
+    cross-shard reduction rescales by the shard count on that assumption.
+    The returned loss is the mean over microbatches; grads are the sums
+    over microbatches of d(loss_fn per mb)/dparams.  Heterogeneous form
+    returns grads as a list of per-stage pytrees matching ``params``.
+    """
+    S = mesh.shape[axis_name]
+    unravels = None
+    if callable(stage_fn):
+        n_stages = {a.shape[0] for a in jax.tree.leaves(params)}
+        if n_stages != {S}:
+            raise ValueError(
+                f"stacked params leading axis {sorted(n_stages)} must equal "
+                f"the {axis_name!r} mesh axis size {S}")
+        stacked = params
+        p_specs = jax.tree.map(lambda a: _stage_spec(a, axis_name), params)
+
+        def apply_local(idx, p, xb):
+            return stage_fn(p, xb)
+    else:
+        stage_fns, per_stage = list(stage_fn), list(params)
+        if len(stage_fns) != S or len(per_stage) != S:
+            raise ValueError(
+                f"need {S} stage fns + param sets for the {axis_name!r} "
+                f"axis, got {len(stage_fns)}/{len(per_stage)}")
+        stacked, apply_local = _ravel_stages(stage_fns, per_stage)
+        unravels = [ravel_pytree(p) for p in per_stage]
+        p_specs = P(axis_name)
+    n_mb = x.shape[0]
+    if n_mb % S:
+        raise ValueError(
+            f"n_microbatches={n_mb} must be a multiple of the pipeline "
+            f"depth {S}")
+    if labels.shape[0] != n_mb:
+        raise ValueError("labels must have the same microbatch count as x")
+
+    batch_axes = tuple(a for a in batch_axes if mesh.shape[a] > 1)
+    mb_ax = tuple(batch_axes) or None
+    x_spec = P(axis_name, None, mb_ax)
+    lbl_spec = P(axis_name, None, mb_ax)
+    fn = jax.shard_map(
+        functools.partial(_1f1b_local, apply_local=apply_local,
+                          loss_local=loss_fn, axis_name=axis_name,
+                          batch_axes=batch_axes, n_microbatches=n_mb,
+                          n_stages=S),
+        mesh=mesh,
+        in_specs=(p_specs, x_spec, lbl_spec),
+        out_specs=(p_specs, P()),
+        check_vma=False)
+    grouped_x = x.reshape((S, n_mb // S) + x.shape[1:])
+    grouped_y = labels.reshape((S, n_mb // S) + labels.shape[1:])
+    grads, loss = fn(stacked, grouped_x, grouped_y)
+    if unravels is not None:
+        # hand grads back in the caller's per-stage structures, not the
+        # internal zero-padded raveled stack
+        grads = [un(grads[s][:v.shape[0]])
+                 for s, (v, un) in enumerate(unravels)]
+    return loss, grads
+
+
 def pipeline_stage_shardings(stacked_params, mesh: Mesh,
                              axis_name: str = "pipe"):
     """NamedShardings placing one stage per device along the pipe axis."""
