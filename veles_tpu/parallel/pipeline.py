@@ -135,7 +135,9 @@ def _pipeline_local(stage_params, x_blk, *, apply_local, axis_name: str,
 
 def _ravel_stages(stage_fns: Sequence[Callable], params_list):
     """Heterogeneous-stage path: ravel per-stage params, zero-pad to the
-    widest stage, stack (S, P_max), apply via lax.switch on stage index."""
+    widest stage, stack (S, P_max), apply via lax.switch on stage index.
+    Returns (stacked, apply_vec, unravels) where ``unravels`` maps a
+    padded row back to that stage's param pytree."""
     vecs, unravels, lens = [], [], []
     for p in params_list:
         v, un = ravel_pytree(p)
@@ -152,7 +154,56 @@ def _ravel_stages(stage_fns: Sequence[Callable], params_list):
     def apply_vec(idx, vec, x):
         return jax.lax.switch(idx, branches, vec, x)
 
-    return stacked, apply_vec
+    return stacked, apply_vec, [
+        (lambda row, _un=un, _l=l: _un(row[:_l]))
+        for un, l in zip(unravels, lens)]
+
+
+def _prep_stages(stage_fn, params, S: int, axis_name: str):
+    """Shared homogeneous/heterogeneous dispatch for pipeline_apply and
+    pipeline_train_step: validates stage counts and returns
+    (stacked, apply_local(idx, p, x), p_specs, unravels) where
+    ``unravels`` is None on the homogeneous path."""
+    if callable(stage_fn):
+        # homogeneous fast path: use the stacked tree directly — each
+        # leaf shards P(pipe) on its stage axis, no ravel round-trip
+        n_stages = {a.shape[0] for a in jax.tree.leaves(params)}
+        if n_stages != {S}:
+            raise ValueError(
+                f"stacked params leading axis {sorted(n_stages)} must equal "
+                f"the {axis_name!r} mesh axis size {S}")
+        p_specs = jax.tree.map(lambda a: _stage_spec(a, axis_name), params)
+
+        def apply_local(idx, p, x):
+            return stage_fn(p, x)
+
+        return params, apply_local, p_specs, None
+    stage_fns, per_stage = list(stage_fn), list(params)
+    if len(stage_fns) != S or len(per_stage) != S:
+        raise ValueError(
+            f"need {S} stage fns + param sets for the {axis_name!r} "
+            f"axis, got {len(stage_fns)}/{len(per_stage)}")
+    stacked, apply_local, unravels = _ravel_stages(stage_fns, per_stage)
+    return stacked, apply_local, P(axis_name), unravels
+
+
+def _prep_batch(x, n_mb: int, S: int, mesh: Mesh, axis_name: str,
+                batch_axes):
+    """Shared microbatch validation/spec construction: returns
+    (batch_axes, x_spec) with the (S, Q) grouped layout spec."""
+    if n_mb % S:
+        raise ValueError(
+            f"n_microbatches={n_mb} must be a multiple of the pipeline "
+            f"depth {S} (inputs/outputs are sharded over {axis_name!r})")
+    batch_axes = tuple(a for a in batch_axes if mesh.shape[a] > 1)
+    bsz = math.prod(mesh.shape[a] for a in batch_axes) if batch_axes else 1
+    if batch_axes and x.shape[1] % bsz:
+        raise ValueError(
+            f"microbatch size {x.shape[1]} not divisible over batch axes "
+            f"{batch_axes} (total {bsz})")
+    # grouped layout (S, Q, mb, ...): stage blocks on 'pipe', the batch
+    # dim on the data axes
+    return batch_axes, P(axis_name, None, batch_axes or None)
 
 
 def pipeline_apply(stage_fn: Union[Callable, Sequence[Callable]],
@@ -176,50 +227,16 @@ def pipeline_apply(stage_fn: Union[Callable, Sequence[Callable]],
     Returns (n_microbatches, mb, ...) outputs, sharded the same way.
     """
     S = mesh.shape[axis_name]
-    if callable(stage_fn):
-        # homogeneous fast path: use the stacked tree directly — each
-        # leaf shards P(pipe) on its stage axis, no ravel round-trip
-        n_stages = {a.shape[0] for a in jax.tree.leaves(params)}
-        if n_stages != {S}:
-            raise ValueError(
-                f"stacked params leading axis {sorted(n_stages)} must equal "
-                f"the {axis_name!r} mesh axis size {S}")
-        stacked = params
-        p_specs = jax.tree.map(lambda a: _stage_spec(a, axis_name), params)
-
-        def apply_local(idx, p, x):
-            return stage_fn(p, x)
-    else:
-        stage_fns = list(stage_fn)
-        per_stage = list(params)
-        if len(stage_fns) != S or len(per_stage) != S:
-            raise ValueError(
-                f"need {S} stage fns + param sets for the {axis_name!r} "
-                f"axis, got {len(stage_fns)}/{len(per_stage)}")
-        stacked, apply_local = _ravel_stages(stage_fns, per_stage)
-        p_specs = P(axis_name)
+    stacked, apply_local, p_specs, _ = _prep_stages(
+        stage_fn, params, S, axis_name)
     n_mb = x.shape[0]
     if n_microbatches is not None and n_microbatches != n_mb:
         raise ValueError(
             f"n_microbatches={n_microbatches} != x.shape[0]={n_mb}")
-    if n_mb % S:
-        raise ValueError(
-            f"n_microbatches={n_mb} must be a multiple of the pipeline "
-            f"depth {S} (inputs/outputs are sharded over {axis_name!r})")
-
+    batch_axes, x_spec = _prep_batch(x, n_mb, S, mesh, axis_name,
+                                     batch_axes)
     _log.debug("pipeline: S=%d n_mb=%d bubble=%.1f%%", S, n_mb,
                100 * bubble_fraction(S, n_mb))
-
-    batch_axes = tuple(a for a in batch_axes if mesh.shape[a] > 1)
-    bsz = math.prod(mesh.shape[a] for a in batch_axes) if batch_axes else 1
-    if batch_axes and x.shape[1] % bsz:
-        raise ValueError(
-            f"microbatch size {x.shape[1]} not divisible over batch axes "
-            f"{batch_axes} (total {bsz})")
-    mb_ax = batch_axes or None
-    # grouped layout (S, Q, mb, ...): stage blocks on 'pipe', the batch
-    # dim on the data axes
-    x_spec = P(axis_name, None, mb_ax)
     fn = jax.shard_map(
         functools.partial(_pipeline_local, apply_local=apply_local,
                           axis_name=axis_name, n_microbatches=n_mb,
@@ -383,39 +400,14 @@ def pipeline_train_step(stage_fn: Union[Callable, Sequence[Callable]],
     returns grads as a list of per-stage pytrees matching ``params``.
     """
     S = mesh.shape[axis_name]
-    unravels = None
-    if callable(stage_fn):
-        n_stages = {a.shape[0] for a in jax.tree.leaves(params)}
-        if n_stages != {S}:
-            raise ValueError(
-                f"stacked params leading axis {sorted(n_stages)} must equal "
-                f"the {axis_name!r} mesh axis size {S}")
-        stacked = params
-        p_specs = jax.tree.map(lambda a: _stage_spec(a, axis_name), params)
-
-        def apply_local(idx, p, xb):
-            return stage_fn(p, xb)
-    else:
-        stage_fns, per_stage = list(stage_fn), list(params)
-        if len(stage_fns) != S or len(per_stage) != S:
-            raise ValueError(
-                f"need {S} stage fns + param sets for the {axis_name!r} "
-                f"axis, got {len(stage_fns)}/{len(per_stage)}")
-        stacked, apply_local = _ravel_stages(stage_fns, per_stage)
-        unravels = [ravel_pytree(p) for p in per_stage]
-        p_specs = P(axis_name)
+    stacked, apply_local, p_specs, unravels = _prep_stages(
+        stage_fn, params, S, axis_name)
     n_mb = x.shape[0]
-    if n_mb % S:
-        raise ValueError(
-            f"n_microbatches={n_mb} must be a multiple of the pipeline "
-            f"depth {S}")
     if labels.shape[0] != n_mb:
         raise ValueError("labels must have the same microbatch count as x")
-
-    batch_axes = tuple(a for a in batch_axes if mesh.shape[a] > 1)
-    mb_ax = tuple(batch_axes) or None
-    x_spec = P(axis_name, None, mb_ax)
-    lbl_spec = P(axis_name, None, mb_ax)
+    batch_axes, x_spec = _prep_batch(x, n_mb, S, mesh, axis_name,
+                                     batch_axes)
+    lbl_spec = x_spec
     fn = jax.shard_map(
         functools.partial(_1f1b_local, apply_local=apply_local,
                           loss_local=loss_fn, axis_name=axis_name,
@@ -431,8 +423,7 @@ def pipeline_train_step(stage_fn: Union[Callable, Sequence[Callable]],
     if unravels is not None:
         # hand grads back in the caller's per-stage structures, not the
         # internal zero-padded raveled stack
-        grads = [un(grads[s][:v.shape[0]])
-                 for s, (v, un) in enumerate(unravels)]
+        grads = [un(grads[s]) for s, un in enumerate(unravels)]
     return loss, grads
 
 
