@@ -216,19 +216,22 @@ def test_precision_level_config_mapping():
         root.common.precision_level = orig
 
 
-def test_lrn_even_window_matches_reduce_window():
-    """Band-matmul path must agree with the reduce_window fallback for
-    EVEN n (asymmetric window) as well as odd."""
+def test_lrn_window_methods_agree():
+    """cumsum (default), band-matmul and the reduce_window fallback must
+    agree for EVEN n (asymmetric window) as well as odd."""
     import veles_tpu.ops.lrn as lrn_mod
     rng = np.random.default_rng(5)
     x = jnp.asarray(rng.standard_normal((3, 12)), jnp.float32)
     for n in (2, 3, 4, 5):
-        band = lrn_mod.local_response_norm(x, n=n)
+        cum = lrn_mod.local_response_norm(x, n=n)  # cumsum default
+        band = lrn_mod.local_response_norm(x, n=n, method="band")
         orig = lrn_mod._BAND_MATMUL_MAX_C
         try:
             lrn_mod._BAND_MATMUL_MAX_C = 0  # force reduce_window path
-            ref = lrn_mod.local_response_norm(x, n=n)
+            ref = lrn_mod.local_response_norm(x, n=n, method="band")
         finally:
             lrn_mod._BAND_MATMUL_MAX_C = orig
-        np.testing.assert_allclose(np.asarray(band), np.asarray(ref),
-                                   rtol=1e-6, atol=1e-7, err_msg=f"n={n}")
+        for got, label in ((cum, "cumsum"), (band, "band")):
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(ref), rtol=1e-6, atol=1e-7,
+                err_msg=f"n={n} {label}")

@@ -3,12 +3,15 @@ docs manualrst_veles_algorithms.rst:31-60; AlexNet-style).
 
 y = x / (k + alpha/n * sum_{j in window} x_j^2)^beta over the channel axis.
 
-TPU-first implementation: the channel-window sum runs as a **band-matrix
-matmul on the MXU** — a windowed reduction over the minor (lane) axis is
-the VPU's worst case (`reduce_window` measured ~1.5x slower end-to-end on
-AlexNet's LRN layers), while an (C, C) 0/1 band contraction is almost free
-on the systolic array.  The beta=0.75 power runs as rsqrt(y*sqrt(y)) — two
-sqrts instead of exp+log."""
+TPU-first implementation: the channel-window sum defaults to an **exact
+f32 cumsum difference** (two VPU passes, zero MXU time, no precision
+knob); the round-1 design — a (C, C) 0/1 **band-matrix matmul on the
+MXU** — stays selectable (``method="band"``) for A/B and for the
+reduce_window fallback above ``_BAND_MATMUL_MAX_C`` channels. A naive
+windowed reduction over the minor (lane) axis is the VPU's worst case
+(`reduce_window` measured ~1.5x slower end-to-end on AlexNet's LRN
+layers). The beta=0.75 power runs as rsqrt(y*sqrt(y)) — two sqrts
+instead of exp+log."""
 
 from __future__ import annotations
 
@@ -22,9 +25,30 @@ from .linear import config_precision
 _BAND_MATMUL_MAX_C = 2048
 
 
-def _window_sum(sq, n: int):
+def _window_sum_cumsum(sq, n: int):
+    """Windowed channel sum as a cumsum difference: two exact f32 VPU
+    passes instead of a C×C matmul — no MXU time and no precision knob
+    (measured A/B against the band matmul in bench_tpu.py/profiling; the
+    band form cost ~HIGH-precision matmul FLOPs on AlexNet's LRN layers).
+    Cancellation error is O(C·eps) — negligible inside k + alpha/n·sum."""
+    half = n // 2
+    up = n - 1 - half   # window: j - i in [-half, up] (same as the band)
+    cs = jnp.cumsum(sq.astype(jnp.float32), axis=-1)
+    pads = [(0, 0)] * (sq.ndim - 1)
+    # sum_{j=i-half}^{i+up} sq[j] = cs[min(i+up, C-1)] - cs[i-half-1]
+    hi = jnp.pad(cs, pads + [(0, up)], mode="edge")[..., up:]
+    lo = jnp.pad(cs, pads + [(half + 1, 0)])[..., :cs.shape[-1]]
+    return hi - lo
+
+
+def _window_sum(sq, n: int, method: str = "cumsum"):
+    if method not in ("cumsum", "band"):
+        raise ValueError(f"LRN method must be 'cumsum' or 'band', "
+                         f"got {method!r}")
     c = sq.shape[-1]
     half = n // 2
+    if method == "cumsum":
+        return _window_sum_cumsum(sq, n)
     if c <= _BAND_MATMUL_MAX_C:
         idx = jnp.arange(c)
         # Asymmetric window of exactly n: out_i sums sq[j] for
@@ -49,9 +73,12 @@ def _window_sum(sq, n: int):
         (1,) * (sq.ndim - 1) + (n,), (1,) * sq.ndim, "VALID")
 
 
-def local_response_norm(x, *, n=5, k=2.0, alpha=1e-4, beta=0.75):
-    """x: (..., C). AlexNet semantics: alpha is divided by window size n."""
-    ssum = _window_sum(jnp.square(x), n)
+def local_response_norm(x, *, n=5, k=2.0, alpha=1e-4, beta=0.75,
+                        method="cumsum"):
+    """x: (..., C). AlexNet semantics: alpha is divided by window size n.
+    ``method``: "cumsum" (default; exact f32, VPU-only) or "band" (C×C
+    0/1 matmul on the MXU — the round-1 design, kept for A/B)."""
+    ssum = _window_sum(jnp.square(x), n, method)
     y = k + (alpha / n) * ssum
     if beta == 0.75:
         out = x * jax.lax.rsqrt(y * jnp.sqrt(y))

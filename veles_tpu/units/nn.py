@@ -277,13 +277,15 @@ class LRN(Unit):
     """Local response normalization across channels."""
 
     def __init__(self, n=5, k=2.0, alpha=1e-4, beta=0.75, name=None,
-                 inputs=("@input",)):
+                 inputs=("@input",), method="cumsum"):
         super().__init__(name, inputs)
         self.n, self.k, self.alpha, self.beta = n, k, alpha, beta
+        self.method = method  # "cumsum" | "band" (see ops/lrn.py)
 
     def apply(self, params, state, xs, ctx):
         return ops.local_response_norm(
-            xs[0], n=self.n, k=self.k, alpha=self.alpha, beta=self.beta), state
+            xs[0], n=self.n, k=self.k, alpha=self.alpha, beta=self.beta,
+            method=self.method), state
 
 
 class MeanDispNormalizer(Unit):
