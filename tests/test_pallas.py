@@ -149,3 +149,18 @@ def test_blockwise_attention_flash_delegation(rng):
                               use_flash=False)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-4, atol=2e-5)
+
+
+def test_fused_dropout_stream_statistics():
+    """The single-pass fmix32 counter RNG must give per-seed rate
+    concentration, decorrelated masks across seeds, and no row/column
+    structure from the linear-index hashing."""
+    x = jnp.ones((512, 1024))
+    for seed in range(4):
+        o = pk.fused_dropout(x, seed, 0.4, 256, True)
+        assert abs(float(jnp.mean(o != 0)) - 0.6) < 0.01
+    m0 = np.asarray(pk.fused_dropout(x, 0, 0.4, 256, True) != 0)
+    m1 = np.asarray(pk.fused_dropout(x, 1, 0.4, 256, True) != 0)
+    # independent Bernoulli(0.6) masks agree with prob 0.6^2 + 0.4^2
+    assert abs((m0 == m1).mean() - 0.52) < 0.02
+    assert m0.mean(1).std() < 0.03 and m0.mean(0).std() < 0.03

@@ -38,7 +38,7 @@ _EXPORT_FIELDS = {
                  "activation"),
     "MaxPooling": ("window", "stride"),
     "AvgPooling": ("window", "stride"),
-    "LRN": ("n", "k", "alpha", "beta"),
+    "LRN": ("n", "k", "alpha", "beta", "method"),
     "Dropout": ("ratio",),
     "Flatten": (),
     "Reshape": ("shape",),

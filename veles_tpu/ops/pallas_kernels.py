@@ -407,7 +407,12 @@ def _dropout_kernel(seed_ref, x_ref, o_ref, *, rate, block_rows, block_cols,
     row = pid_r.astype(jnp.uint32) * np.uint32(block_rows) + r
     col = pid_c.astype(jnp.uint32) * np.uint32(block_cols) + c
     lin = row * np.uint32(n_cols) + col
-    bits = _splitmix32(_splitmix32(lin ^ seed_ref[0, 0]))
+    # One fmix32-style finalizer pass (add-xorshift-mul x2) is already a
+    # full-avalanche mixer for counter inputs; u32 multiplies are the
+    # VPU's slow op, and a second pass measurably lost to XLA's threefry
+    # on-chip (bench_tpu).  Seed is pre-whitened so consecutive seeds
+    # don't produce correlated streams.
+    bits = _splitmix32(lin ^ _splitmix32(seed_ref[0, 0]))
     # top 24 bits -> uniform in [0, 1); Mosaic lacks uint32->f32 casts, so
     # bitcast the (always-positive) value through int32 first.
     u = jax.lax.bitcast_convert_type(
