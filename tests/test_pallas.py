@@ -164,3 +164,46 @@ def test_fused_dropout_stream_statistics():
     # independent Bernoulli(0.6) masks agree with prob 0.6^2 + 0.4^2
     assert abs((m0 == m1).mean() - 0.52) < 0.02
     assert m0.mean(1).std() < 0.03 and m0.mean(0).std() < 0.03
+
+
+def _windowed_reference(q, k, v, window):
+    """Dense causal sliding-window attention reference."""
+    B, T, H, D = q.shape
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * (D ** -0.5)
+    qpos = jnp.arange(T)[:, None]
+    kpos = jnp.arange(T)[None, :]
+    mask = (kpos <= qpos) & (kpos > qpos - window)
+    s = jnp.where(mask[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+@pytest.mark.parametrize("T,window", [(96, 32), (100, 16), (64, 64)])
+def test_flash_attention_sliding_window(rng, T, window):
+    q, k, v = (jnp.asarray(rng.standard_normal((1, T, 2, 16)), jnp.float32)
+               for _ in range(3))
+    out = pk.flash_attention(q, k, v, True, None, 16, 16, True, window)
+    ref = _windowed_reference(q, k, v, window)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_flash_attention_sliding_window_grads(rng):
+    T, window = 96, 32
+    q, k, v = (jnp.asarray(rng.standard_normal((1, T, 2, 16)), jnp.float32)
+               for _ in range(3))
+    gp = jax.grad(lambda a, b, c: jnp.sum(pk.flash_attention(
+        a, b, c, True, None, 16, 16, True, window) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda a, b, c: jnp.sum(
+        _windowed_reference(a, b, c, window) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gp, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_flash_attention_window_requires_causal(rng):
+    q = jnp.ones((1, 32, 1, 8))
+    with pytest.raises(ValueError):
+        pk.flash_attention(q, q, q, False, None, 16, 16, True, 8)

@@ -168,3 +168,34 @@ def test_sharded_rollback_keeps_mesh(rng):
     sh = tr.wstate["params"]["fc1"]["w"].sharding
     assert getattr(sh, "mesh", None) is not None
     assert tr._state_sh is not None
+
+
+def test_ring_attention_sliding_window(rng):
+    """Sequence-parallel sliding-window attention matches the dense
+    windowed reference on global positions."""
+    from veles_tpu.parallel import MeshSpec, make_mesh, ring_attention
+    T, window = 64, 24
+    mesh = make_mesh(MeshSpec(seq=4))
+    q, k, v = (jnp.asarray(rng.standard_normal((1, T, 2, 8)), jnp.float32)
+               for _ in range(3))
+    out = ring_attention(q, k, v, mesh, causal=True, window=window)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * (8 ** -0.5)
+    qp = jnp.arange(T)[:, None]
+    kp = jnp.arange(T)[None, :]
+    m = (kp <= qp) & (kp > qp - window)
+    ref = jnp.einsum("bhqk,bkhd->bqhd",
+                     jax.nn.softmax(jnp.where(m[None, None], s, -jnp.inf),
+                                    axis=-1), v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_window_zero_rejected(rng):
+    from veles_tpu.parallel import MeshSpec, make_mesh, ring_attention
+    from veles_tpu.parallel.ring_attention import blockwise_attention
+    q = jnp.ones((1, 32, 1, 8))
+    with pytest.raises(ValueError):
+        blockwise_attention(q, q, q, causal=True, window=0)
+    mesh = make_mesh(MeshSpec(seq=4))
+    with pytest.raises(ValueError):
+        ring_attention(q, q, q, mesh, causal=True, window=0)

@@ -55,7 +55,8 @@ def _round_up(n: int, m: int) -> int:
 
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
                       acc_ref, *,
-                      scale, causal, block_q, block_k, tq, tk, n_kb):
+                      scale, causal, window, block_q, block_k, tq, tk,
+                      n_kb):
     """Grid = (BH, n_q_blocks, n_k_blocks); the k dimension is minor, so
     VMEM holds only one (block_q, D) Q tile and one (block_k, D) K/V tile at
     a time — the m/l/acc online-softmax state lives in scratch that persists
@@ -87,6 +88,9 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
             q_pos = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             mask = mask & (k_pos <= q_pos)
+            if window is not None:
+                # sliding window: key positions in (q - window, q]
+                mask = mask & (k_pos > q_pos - window)
         s = jnp.where(mask, s, -1e30)
         # Row state m/l is kept as (block_q, 1) column vectors — keepdims
         # math throughout, because Mosaic's layout rules want >=2-D values
@@ -101,11 +105,15 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
             p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    if causal:
-        # K blocks strictly after this Q block are fully masked — skip.
-        pl.when(kj * block_k <= qi * block_q + block_q - 1)(_step)
-    else:
+    # Skip fully-masked K blocks: with causal, those after the diagonal;
+    # with a sliding window also those entirely before it — cost becomes
+    # O(T*window) instead of O(T^2/2).
+    live = _flash_block_live(qi, kj, causal=causal, window=window,
+                             block_q=block_q, block_k=block_k)
+    if live is None:
         _step()
+    else:
+        pl.when(live)(_step)
 
     @pl.when(kj == n_kb - 1)
     def _finalize():
@@ -131,9 +139,17 @@ def _flash_blocks(Tq, Tk, block_q, block_k):
 
 
 def _flash_fwd(q, k, v, *, causal, scale, block_q, block_k, interpret,
-               return_lse=False):
+               window=None, return_lse=False):
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
+    if window is not None:
+        if not causal:
+            raise ValueError(
+                "sliding-window attention requires causal=True")
+        window = int(window)
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window} "
+                             "(use window=None to disable)")
     scale_ = scale if scale is not None else D ** -0.5
     block_q, block_k, tq_p, tk_p = _flash_blocks(Tq, Tk, block_q, block_k)
 
@@ -143,8 +159,8 @@ def _flash_fwd(q, k, v, *, causal, scale, block_q, block_k, interpret,
 
     n_kb = tk_p // block_k
     kernel = functools.partial(
-        _flash_fwd_kernel, scale=scale_, causal=causal, block_q=block_q,
-        block_k=block_k, tq=Tq, tk=Tk, n_kb=n_kb)
+        _flash_fwd_kernel, scale=scale_, causal=causal, window=window,
+        block_q=block_q, block_k=block_k, tq=Tq, tk=Tk, n_kb=n_kb)
     out, lse = pl.pallas_call(
         kernel,
         grid=(B * H, tq_p // block_q, n_kb),
@@ -179,10 +195,11 @@ def _flash_fwd(q, k, v, *, causal, scale, block_q, block_k, interpret,
     return out
 
 
-def _flash_bwd_mask(qi, kj, *, causal, block_q, block_k, tq, tk):
+def _flash_bwd_mask(qi, kj, *, causal, window, block_q, block_k, tq, tk):
     """Validity mask for one (block_q, block_k) tile: in-range rows/cols
-    plus the causal triangle.  Padded Q rows carry a bogus lse (=-1e30 +
-    log eps), so P must be forced to zero there or they'd pollute dK/dV."""
+    plus the causal triangle (and sliding window).  Padded Q rows carry a
+    bogus lse (=-1e30 + log eps), so P must be forced to zero there or
+    they'd pollute dK/dV."""
     q_pos = qi * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0)
     k_pos = kj * block_k + jax.lax.broadcasted_iota(
@@ -190,12 +207,25 @@ def _flash_bwd_mask(qi, kj, *, causal, block_q, block_k, tq, tk):
     mask = (q_pos < tq) & (k_pos < tk)
     if causal:
         mask = mask & (k_pos <= q_pos)
+        if window is not None:
+            mask = mask & (k_pos > q_pos - window)
     return mask
 
 
+def _flash_block_live(qi, kj, *, causal, window, block_q, block_k):
+    """Block-level liveness: does tile (qi, kj) contain ANY unmasked pair?
+    Shared by the fwd/dq kernels (k minor) and the dkv kernel (q minor)."""
+    if not causal:
+        return None
+    live = kj * block_k <= qi * block_q + block_q - 1
+    if window is not None:
+        live &= kj * block_k + block_k - 1 > qi * block_q - window
+    return live
+
+
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                         dq_ref, acc_ref, *, scale, causal, block_q,
-                         block_k, tq, tk, n_kb):
+                         dq_ref, acc_ref, *, scale, causal, window,
+                         block_q, block_k, tq, tk, n_kb):
     """Grid = (BH, n_q_blocks, n_k_blocks), k minor; dQ accumulates in
     scratch across the k sweep (two-pass recompute backward: S and P are
     rebuilt from Q/K and the saved row logsumexp, never materialized)."""
@@ -210,8 +240,9 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
-        mask = _flash_bwd_mask(qi, kj, causal=causal, block_q=block_q,
-                               block_k=block_k, tq=tq, tk=tk)
+        mask = _flash_bwd_mask(qi, kj, causal=causal, window=window,
+                               block_q=block_q, block_k=block_k,
+                               tq=tq, tk=tk)
         # lse/delta blocks are (block_q, 1) column vectors — broadcast
         # against the (block_q, block_k) score tile directly.
         p = jnp.where(mask, jnp.exp(s - lse_ref[0]), 0.0)
@@ -223,10 +254,12 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             ds.astype(k_blk.dtype), k_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    if causal:
-        pl.when(kj * block_k <= qi * block_q + block_q - 1)(_step)
-    else:
+    live = _flash_block_live(qi, kj, causal=causal, window=window,
+                             block_q=block_q, block_k=block_k)
+    if live is None:
         _step()
+    else:
+        pl.when(live)(_step)
 
     @pl.when(kj == n_kb - 1)
     def _finalize():
@@ -235,7 +268,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                           dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
-                          block_q, block_k, tq, tk, n_qb):
+                          window, block_q, block_k, tq, tk, n_qb):
     """Grid = (BH, n_k_blocks, n_q_blocks), q minor; dK/dV accumulate in
     scratch across the q sweep."""
     kj, qi = pl.program_id(1), pl.program_id(2)
@@ -250,8 +283,9 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
-        mask = _flash_bwd_mask(qi, kj, causal=causal, block_q=block_q,
-                               block_k=block_k, tq=tq, tk=tk)
+        mask = _flash_bwd_mask(qi, kj, causal=causal, window=window,
+                               block_q=block_q, block_k=block_k,
+                               tq=tq, tk=tk)
         p = jnp.where(mask, jnp.exp(s - lse_ref[0]), 0.0)
         dv_acc[:] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -264,10 +298,12 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    if causal:
-        pl.when(qi * block_q + block_q - 1 >= kj * block_k)(_step)
-    else:
+    live = _flash_block_live(qi, kj, causal=causal, window=window,
+                             block_q=block_q, block_k=block_k)
+    if live is None:
         _step()
+    else:
+        pl.when(live)(_step)
 
     @pl.when(qi == n_qb - 1)
     def _finalize():
@@ -276,7 +312,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd(q, k, v, out, lse, g, *, causal, scale, block_q, block_k,
-               interpret):
+               interpret, window=None):
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
     scale_ = scale if scale is not None else D ** -0.5
@@ -294,8 +330,8 @@ def _flash_bwd(q, k, v, out, lse, g, *, causal, scale, block_q, block_k,
                     axis=-1, keepdims=True)
 
     itp = _interpret(interpret)
-    common = dict(scale=scale_, causal=causal, block_q=block_q,
-                  block_k=block_k, tq=Tq, tk=Tk)
+    common = dict(scale=scale_, causal=causal, window=window,
+                  block_q=block_q, block_k=block_k, tq=Tq, tk=Tk)
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, n_kb=n_kb, **common),
         grid=(B * H, n_qb, n_kb),
@@ -349,30 +385,39 @@ def _flash_bwd(q, k, v, out, lse, g, *, causal, scale, block_q, block_k,
     return back(dq, Tq), back(dk, Tk), back(dv, Tk)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
 def flash_attention(q, k, v, causal=False, scale=None, block_q=256,
-                    block_k=1024, interpret=None):
+                    block_k=1024, interpret=None, window=None):
     """Blockwise-softmax attention, forward and backward as Pallas kernels.
 
     q/k/v: (B, T, H, D) -> (B, Tq, H, D).  The backward is the standard
     two-pass recompute (dQ kernel + dK/dV kernel) driven by the forward's
     saved row logsumexp — memory stays one tile per operand, the full
-    attention matrix is never materialized in either direction."""
+    attention matrix is never materialized in either direction.
+
+    ``window=W`` (requires ``causal=True``) restricts each query to keys
+    in ``(q - W, q]`` — sliding-window local attention. Fully-out-of-
+    window K blocks are skipped in all three kernels, so fwd+bwd cost is
+    O(T·W) instead of O(T²/2)."""
     return _flash_fwd(q, k, v, causal=causal, scale=scale, block_q=block_q,
-                      block_k=block_k, interpret=interpret)
+                      block_k=block_k, interpret=interpret, window=window)
 
 
-def _flash_vjp_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+def _flash_vjp_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
+                   window):
     out, lse = _flash_fwd(q, k, v, causal=causal, scale=scale,
                           block_q=block_q, block_k=block_k,
-                          interpret=interpret, return_lse=True)
+                          interpret=interpret, window=window,
+                          return_lse=True)
     return out, (q, k, v, out, lse)
 
 
-def _flash_vjp_bwd(causal, scale, block_q, block_k, interpret, res, g):
+def _flash_vjp_bwd(causal, scale, block_q, block_k, interpret, window,
+                   res, g):
     q, k, v, out, lse = res
     return _flash_bwd(q, k, v, out, lse, g, causal=causal, scale=scale,
-                      block_q=block_q, block_k=block_k, interpret=interpret)
+                      block_q=block_q, block_k=block_k, interpret=interpret,
+                      window=window)
 
 
 flash_attention.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)

@@ -24,6 +24,20 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 
+def _check_window(window, causal):
+    """Shared validation: window=None disables; otherwise a positive int
+    with causal=True (0 would silently mask EVERYTHING to zeros)."""
+    if window is None:
+        return None
+    if not causal:
+        raise ValueError("sliding-window attention requires causal=True")
+    window = int(window)
+    if window < 1:
+        raise ValueError(f"window must be >= 1, got {window} "
+                         "(use window=None to disable)")
+    return window
+
+
 def _attn_block(q, k, v, m, l, o, *, scale, mask=None):
     """Fold one K/V block into the online-softmax accumulators.
 
@@ -52,13 +66,17 @@ def _finalize(l, o):
 
 def blockwise_attention(q, k, v, *, block_size: int = 512,
                         causal: bool = False, scale: Optional[float] = None,
-                        use_flash: Optional[bool] = None):
+                        use_flash: Optional[bool] = None,
+                        window: Optional[int] = None):
     """Memory-efficient attention on one device: scan over K/V blocks with
     online softmax. q/k/v: (B, T, H, D) -> (B, T, H, D).
 
     On TPU this delegates to the hand-written Pallas kernel
     (ops/pallas_kernels.flash_attention); the jnp scan below is the
-    numerical reference and the portable path."""
+    numerical reference and the portable path.  ``window=W`` (causal
+    only) restricts each query to keys in (q-W, q] — sliding-window
+    local attention."""
+    window = _check_window(window, causal)
     if use_flash is None:
         from ..ops import use_pallas_default
         use_flash = use_pallas_default()
@@ -67,7 +85,7 @@ def blockwise_attention(q, k, v, *, block_size: int = 512,
         # BASELINE.md) beat any 128-capped choice; ``block_size`` here
         # only describes the jnp scan granularity below.
         from ..ops.pallas_kernels import flash_attention
-        return flash_attention(q, k, v, causal, scale)
+        return flash_attention(q, k, v, causal, scale, window=window)
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
     scale = scale if scale is not None else D ** -0.5
@@ -96,6 +114,9 @@ def blockwise_attention(q, k, v, *, block_size: int = 512,
         if causal:
             mask = mask & (k_idx[None, None, None, :]
                            <= q_idx[None, None, :, None])
+            if window is not None:
+                mask = mask & (k_idx[None, None, None, :]
+                               > q_idx[None, None, :, None] - window)
         m, l, o = folded(q, k_blk, v_blk, m, l, o, mask=mask)
         return (m, l, o), None
 
@@ -110,7 +131,8 @@ def blockwise_attention(q, k, v, *, block_size: int = 512,
 
 
 def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
-                          scale: Optional[float]):
+                          scale: Optional[float],
+                          window: Optional[int] = None):
     """Per-shard body (runs under shard_map): rotate K/V around the ring."""
     axis_size = jax.lax.psum(1, axis_name)
     axis_idx = jax.lax.axis_index(axis_name)
@@ -128,10 +150,31 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
         if causal:
             mask = (k_pos[None, None, None, :]
                     <= q_pos[None, None, :, None])
+            if window is not None:
+                mask = mask & (k_pos[None, None, None, :]
+                               > q_pos[None, None, :, None] - window)
         else:
             mask = None
-        m, l, o = _attn_block(q, k_cur, v_cur, m, l, o,
-                              scale=scale_, mask=mask)
+
+        def fold(carry):
+            m, l, o = carry
+            return _attn_block(q, k_cur, v_cur, m, l, o,
+                               scale=scale_, mask=mask)
+
+        if causal:
+            # Skip the fold when the visiting shard is entirely masked
+            # for this device (after the diagonal; with a window, also
+            # entirely before it) — the per-device compute becomes
+            # O(T·window/P); the ring rotation itself still runs (K/V
+            # must pass through to reach live devices).
+            k_lo, k_hi = src * Tk, src * Tk + Tk - 1
+            q_lo, q_hi = axis_idx * Tq, axis_idx * Tq + Tq - 1
+            live = k_lo <= q_hi
+            if window is not None:
+                live &= k_hi > q_lo - window
+            m, l, o = jax.lax.cond(live, fold, lambda c: c, (m, l, o))
+        else:
+            m, l, o = fold((m, l, o))
         k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
         v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
         return m, l, o, k_nxt, v_nxt
@@ -144,13 +187,17 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
 
 
 def ring_attention(q, k, v, mesh: Mesh, *, axis_name: str = "seq",
-                   causal: bool = False, scale: Optional[float] = None):
+                   causal: bool = False, scale: Optional[float] = None,
+                   window: Optional[int] = None):
     """Sequence-parallel attention: q/k/v (B, T, H, D) sharded on T over
-    ``axis_name``; returns output with the same sharding."""
+    ``axis_name``; returns output with the same sharding.  ``window``
+    (causal only) applies the sliding-window mask on GLOBAL positions —
+    each ring step folds only the in-window part of the visiting block."""
+    window = _check_window(window, causal)
     spec = P(None, axis_name, None, None)
     fn = jax.shard_map(
         functools.partial(_ring_attention_local, axis_name=axis_name,
-                          causal=causal, scale=scale),
+                          causal=causal, scale=scale, window=window),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False)
     return fn(q, k, v)

@@ -40,7 +40,7 @@ class MultiHeadAttention(Forward):
     def __init__(self, n_heads: int, head_dim: Optional[int] = None,
                  name=None, inputs=("@input",), *, causal: bool = True,
                  seq_axis: str = "seq", block_size: int = 512,
-                 compute_dtype=None):
+                 compute_dtype=None, window: Optional[int] = None):
         super().__init__(name, inputs)
         self.n_heads = int(n_heads)
         self.head_dim = head_dim
@@ -48,6 +48,8 @@ class MultiHeadAttention(Forward):
         self.seq_axis = seq_axis
         self.block_size = int(block_size)
         self.compute_dtype = compute_dtype
+        # sliding-window width (causal local attention); None = full
+        self.window = None if window is None else int(window)
 
     def output_spec(self, in_specs: Sequence[Spec]) -> Spec:
         return in_specs[0]
@@ -81,10 +83,10 @@ class MultiHeadAttention(Forward):
         q, k, v = proj(params["wq"]), proj(params["wk"]), proj(params["wv"])
         if ctx.axis_size(self.seq_axis) > 1:
             o = ring_attention(q, k, v, ctx.mesh, axis_name=self.seq_axis,
-                               causal=self.causal)
+                               causal=self.causal, window=self.window)
         else:
             o = blockwise_attention(q, k, v, block_size=self.block_size,
-                                    causal=self.causal)
+                                    causal=self.causal, window=self.window)
         y = o.reshape(B, T, -1) @ params["wo"].astype(dt)
         return y.astype(x.dtype), state
 
