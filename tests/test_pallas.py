@@ -207,3 +207,48 @@ def test_flash_attention_window_requires_causal(rng):
     q = jnp.ones((1, 32, 1, 8))
     with pytest.raises(ValueError):
         pk.flash_attention(q, q, q, False, None, 16, 16, True, 8)
+
+
+@pytest.mark.parametrize("G", [2, 4])
+def test_flash_attention_gqa(rng, G):
+    """Grouped-query attention: kernel with shared kv heads must equal
+    the full-attention reference on repeated kv."""
+    B, T, Hk, D = 1, 64, 2, 16
+    H = Hk * G
+    q = jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.float32)
+    k, v = (jnp.asarray(rng.standard_normal((B, T, Hk, D)), jnp.float32)
+            for _ in range(2))
+    from veles_tpu.parallel.ring_attention import full_attention
+    kf = jnp.repeat(k, G, axis=2)
+    vf = jnp.repeat(v, G, axis=2)
+    out = pk.flash_attention(q, k, v, True, None, 16, 16, True)
+    ref = full_attention(q, kf, vf, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-6)
+
+    # grads: dk/dv must come back kv-head shaped and equal the grouped
+    # sums of the full-head reference grads
+    gp = jax.grad(lambda a, b, c: jnp.sum(pk.flash_attention(
+        a, b, c, True, None, 16, 16, True) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda a, b, c: jnp.sum(
+        full_attention(a, b, c, causal=True) ** 2),
+        argnums=(0, 1, 2))(q, kf, vf)
+    np.testing.assert_allclose(np.asarray(gp[0]), np.asarray(gr[0]),
+                               rtol=2e-4, atol=2e-5)
+    for gi, ri in ((1, 1), (2, 2)):
+        grouped = np.asarray(gr[ri]).reshape(B, T, Hk, G, D).sum(3)
+        np.testing.assert_allclose(np.asarray(gp[gi]), grouped,
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_flash_attention_gqa_with_window(rng):
+    B, T, Hk, G, D, W = 1, 96, 2, 2, 16, 32
+    q = jnp.asarray(rng.standard_normal((B, T, Hk * G, D)), jnp.float32)
+    k, v = (jnp.asarray(rng.standard_normal((B, T, Hk, D)), jnp.float32)
+            for _ in range(2))
+    out = pk.flash_attention(q, k, v, True, None, 16, 16, True, W)
+    ref = _windowed_reference(q, jnp.repeat(k, G, 2), jnp.repeat(v, G, 2),
+                              W)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-6)

@@ -199,3 +199,20 @@ def test_window_zero_rejected(rng):
     mesh = make_mesh(MeshSpec(seq=4))
     with pytest.raises(ValueError):
         ring_attention(q, q, q, mesh, causal=True, window=0)
+
+
+def test_ring_attention_gqa(rng):
+    """Ring attention with grouped kv heads: ring traffic stays kv-sized,
+    numerics equal the repeated-head dense reference."""
+    from veles_tpu.parallel import MeshSpec, make_mesh, ring_attention
+    from veles_tpu.parallel.ring_attention import full_attention
+    T, Hk, G = 32, 2, 2
+    mesh = make_mesh(MeshSpec(seq=4))
+    q = jnp.asarray(rng.standard_normal((1, T, Hk * G, 8)), jnp.float32)
+    k, v = (jnp.asarray(rng.standard_normal((1, T, Hk, 8)), jnp.float32)
+            for _ in range(2))
+    out = ring_attention(q, k, v, mesh, causal=True)
+    ref = full_attention(q, jnp.repeat(k, G, 2), jnp.repeat(v, G, 2),
+                         causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-6)

@@ -185,3 +185,35 @@ def test_units_fall_back_without_mesh(rng):
     step = wf.make_train_step(sw.optimizer)
     ws, mets = step(ws, batch)
     assert np.isfinite(float(mets["loss"]))
+
+
+def test_attention_unit_gqa_trains(rng):
+    """MultiHeadAttention with n_kv_heads < n_heads builds, runs and
+    reduces loss through the config-driven workflow path."""
+    import veles_tpu as vt
+    from veles_tpu.models.standard import build_workflow, build_optimizer
+    layers = [
+        {"type": "attention", "n_heads": 4, "n_kv_heads": 2,
+         "window": 16, "name": "attn"},
+        {"type": "flatten", "name": "flat"},
+        {"type": "softmax", "output_size": 8, "name": "head"},
+    ]
+    wf = build_workflow("gqa", layers, loss="softmax")
+    B, T, E = 4, 32, 16
+    specs = {"@input": vt.Spec((B, T, E), jnp.float32),
+             "@labels": vt.Spec((B,), jnp.int32),
+             "@mask": vt.Spec((B,), jnp.float32)}
+    wf.build(specs)
+    opt = build_optimizer("momentum", layers, lr=0.05)
+    ws = wf.init_state(jax.random.key(0), opt)
+    assert ws["params"]["attn"]["wk"].shape == (E, 2 * (E // 4))
+    step = wf.make_train_step(opt)
+    rngl = np.random.default_rng(0)
+    x = jnp.asarray(rngl.standard_normal((B, T, E)), jnp.float32)
+    yb = jnp.asarray(rngl.integers(0, 8, B), jnp.int32)
+    batch = {"@input": x, "@labels": yb, "@mask": jnp.ones(B)}
+    losses = []
+    for _ in range(25):
+        ws, mets = step(ws, batch)
+        losses.append(float(mets["loss"]))
+    assert losses[-1] < losses[0]

@@ -30,6 +30,29 @@ def use_pallas_default(platform=None) -> bool:
     return platform == "tpu"
 
 
+def check_attention_window(window, causal):
+    """Shared validation for sliding-window attention (kernel, blockwise
+    and ring paths): None disables; otherwise a positive int with
+    causal=True (0 would silently mask everything to zeros)."""
+    if window is None:
+        return None
+    if not causal:
+        raise ValueError("sliding-window attention requires causal=True")
+    window = int(window)
+    if window < 1:
+        raise ValueError(f"window must be >= 1, got {window} "
+                         "(use window=None to disable)")
+    return window
+
+
+def check_gqa_heads(n_heads: int, n_kv_heads: int) -> int:
+    """Shared GQA validation: returns the group size H / H_kv."""
+    if n_kv_heads < 1 or n_heads % n_kv_heads:
+        raise ValueError(f"q heads {n_heads} must be a positive multiple "
+                         f"of kv heads {n_kv_heads}")
+    return n_heads // n_kv_heads
+
+
 _PALLAS_EXPORTS = ("flash_attention", "fused_dropout", "gather_rows")
 
 

@@ -36,7 +36,8 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
-from . import use_pallas_default  # policy lives pallas-free in ops/__init__
+from . import (use_pallas_default,  # policy lives pallas-free in ops/__init__
+               check_attention_window, check_gqa_heads)
 
 
 def _interpret(interpret: Optional[bool]) -> bool:
@@ -138,18 +139,28 @@ def _flash_blocks(Tq, Tk, block_q, block_k):
     return block_q, block_k, _round_up(Tq, block_q), _round_up(Tk, block_k)
 
 
+def _gqa_groups(q, k):
+    """Grouped-query attention factor: q heads per kv head.  H == H_kv is
+    plain MHA (G=1)."""
+    return check_gqa_heads(q.shape[2], k.shape[2])
+
+
+def _kv_row_map(H, H_kv, G):
+    """Grid-index map from q-head row b to its shared kv row — the ONE
+    definition both the forward and the dq kernel use (drift here would
+    make fwd and bwd read different kv blocks)."""
+    if G == 1:
+        return lambda b: b
+    return lambda b: (b // H) * H_kv + (b % H) // G
+
+
 def _flash_fwd(q, k, v, *, causal, scale, block_q, block_k, interpret,
                window=None, return_lse=False):
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
-    if window is not None:
-        if not causal:
-            raise ValueError(
-                "sliding-window attention requires causal=True")
-        window = int(window)
-        if window < 1:
-            raise ValueError(f"window must be >= 1, got {window} "
-                             "(use window=None to disable)")
+    H_kv = k.shape[2]
+    G = _gqa_groups(q, k)
+    window = check_attention_window(window, causal)
     scale_ = scale if scale is not None else D ** -0.5
     block_q, block_k, tq_p, tk_p = _flash_blocks(Tq, Tk, block_q, block_k)
 
@@ -161,13 +172,17 @@ def _flash_fwd(q, k, v, *, causal, scale, block_q, block_k, interpret,
     kernel = functools.partial(
         _flash_fwd_kernel, scale=scale_, causal=causal, window=window,
         block_q=block_q, block_k=block_k, tq=Tq, tk=Tk, n_kb=n_kb)
+    # GQA: index-map arithmetic on grid indices is static.
+    kv_row = _kv_row_map(H, H_kv, G)
     out, lse = pl.pallas_call(
         kernel,
         grid=(B * H, tq_p // block_q, n_kb),
         in_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D),
+                         lambda b, i, j: (kv_row(b), j, 0)),
+            pl.BlockSpec((1, block_k, D),
+                         lambda b, i, j: (kv_row(b), j, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
@@ -268,12 +283,15 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                           dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
-                          window, block_q, block_k, tq, tk, n_qb):
-    """Grid = (BH, n_k_blocks, n_q_blocks), q minor; dK/dV accumulate in
-    scratch across the q sweep."""
-    kj, qi = pl.program_id(1), pl.program_id(2)
+                          window, block_q, block_k, tq, tk, n_qb, n_qsweep):
+    """Grid = (B*H_kv, n_k_blocks, n_qsweep), q minor; dK/dV accumulate in
+    scratch across the q sweep.  With GQA, n_qsweep = n_q_blocks * G: the
+    minor axis enumerates (group member g, q block qi) — every q head of
+    the group folds into the same kv-head accumulator."""
+    kj, i = pl.program_id(1), pl.program_id(2)
+    qi = i % n_qb
 
-    @pl.when(qi == 0)
+    @pl.when(i == 0)
     def _init():
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
@@ -305,7 +323,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     else:
         pl.when(live)(_step)
 
-    @pl.when(qi == n_qb - 1)
+    @pl.when(i == n_qsweep - 1)
     def _finalize():
         dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
@@ -315,6 +333,8 @@ def _flash_bwd(q, k, v, out, lse, g, *, causal, scale, block_q, block_k,
                interpret, window=None):
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
+    H_kv = k.shape[2]
+    G = _gqa_groups(q, k)
     scale_ = scale if scale is not None else D ** -0.5
     block_q, block_k, tq_p, tk_p = _flash_blocks(Tq, Tk, block_q, block_k)
     n_qb, n_kb = tq_p // block_q, tk_p // block_k
@@ -332,13 +352,16 @@ def _flash_bwd(q, k, v, out, lse, g, *, causal, scale, block_q, block_k,
     itp = _interpret(interpret)
     common = dict(scale=scale_, causal=causal, window=window,
                   block_q=block_q, block_k=block_k, tq=Tq, tk=Tk)
+    kv_row = _kv_row_map(H, H_kv, G)
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, n_kb=n_kb, **common),
         grid=(B * H, n_qb, n_kb),
         in_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D),
+                         lambda b, i, j: (kv_row(b), j, 0)),
+            pl.BlockSpec((1, block_k, D),
+                         lambda b, i, j: (kv_row(b), j, 0)),
             pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
@@ -351,24 +374,35 @@ def _flash_bwd(q, k, v, out, lse, g, *, causal, scale, block_q, block_k,
         interpret=itp,
     )(qm, km, vm, dom, lse, delta)
 
+    # dK/dV: grid over kv heads; the minor sweep covers (group member g,
+    # q block) so all G q heads of a group fold into one accumulator.
+    # q-side rows for kv row b and sweep index i: head (b % H_kv)*G + g.
+    q_row = (lambda b, i: (b, i)) if G == 1 else \
+        (lambda b, i: ((b // H_kv) * H + (b % H_kv) * G + i // n_qb,
+                       i % n_qb))
     dk, dv = pl.pallas_call(
-        functools.partial(_flash_bwd_dkv_kernel, n_qb=n_qb, **common),
-        grid=(B * H, n_kb, n_qb),
+        functools.partial(_flash_bwd_dkv_kernel, n_qb=n_qb,
+                          n_qsweep=n_qb * G, **common),
+        grid=(B * H_kv, n_kb, n_qb * G),
         in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, D),
+                         lambda b, j, i: (*q_row(b, i), 0)),
             pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, D),
+                         lambda b, j, i: (*q_row(b, i), 0)),
+            pl.BlockSpec((1, block_q, 1),
+                         lambda b, j, i: (*q_row(b, i), 0)),
+            pl.BlockSpec((1, block_q, 1),
+                         lambda b, j, i: (*q_row(b, i), 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B * H, tk_p, D), k.dtype),
-            jax.ShapeDtypeStruct((B * H, tk_p, D), v.dtype),
+            jax.ShapeDtypeStruct((B * H_kv, tk_p, D), k.dtype),
+            jax.ShapeDtypeStruct((B * H_kv, tk_p, D), v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, D), jnp.float32),
@@ -379,10 +413,10 @@ def _flash_bwd(q, k, v, out, lse, g, *, causal, scale, block_q, block_k,
         interpret=itp,
     )(qm, km, vm, dom, lse, delta)
 
-    def back(x, T):
-        return x[:, :T].reshape(B, H, T, D).transpose(0, 2, 1, 3)
+    def back(x, T, nh):
+        return x[:, :T].reshape(B, nh, T, D).transpose(0, 2, 1, 3)
 
-    return back(dq, Tq), back(dk, Tk), back(dv, Tk)
+    return back(dq, Tq, H), back(dk, Tk, H_kv), back(dv, Tk, H_kv)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
@@ -390,9 +424,13 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=256,
                     block_k=1024, interpret=None, window=None):
     """Blockwise-softmax attention, forward and backward as Pallas kernels.
 
-    q/k/v: (B, T, H, D) -> (B, Tq, H, D).  The backward is the standard
-    two-pass recompute (dQ kernel + dK/dV kernel) driven by the forward's
-    saved row logsumexp — memory stays one tile per operand, the full
+    q: (B, Tq, H, D); k/v: (B, Tk, H_kv, D) -> (B, Tq, H, D).  H_kv may
+    divide H (grouped-query attention): q heads share kv blocks via the
+    BlockSpec index maps — the repeat is never materialized — and the
+    dK/dV kernel folds all G = H/H_kv group members of the q sweep into
+    one kv-head accumulator.  The backward is the standard two-pass
+    recompute (dQ kernel + dK/dV kernel) driven by the forward's saved
+    row logsumexp — memory stays one tile per operand, the full
     attention matrix is never materialized in either direction.
 
     ``window=W`` (requires ``causal=True``) restricts each query to keys

@@ -24,18 +24,8 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 
-def _check_window(window, causal):
-    """Shared validation: window=None disables; otherwise a positive int
-    with causal=True (0 would silently mask EVERYTHING to zeros)."""
-    if window is None:
-        return None
-    if not causal:
-        raise ValueError("sliding-window attention requires causal=True")
-    window = int(window)
-    if window < 1:
-        raise ValueError(f"window must be >= 1, got {window} "
-                         "(use window=None to disable)")
-    return window
+from ..ops import check_attention_window as _check_window  # shared rule
+from ..ops import check_gqa_heads as _check_gqa
 
 
 def _attn_block(q, k, v, m, l, o, *, scale, mask=None):
@@ -77,6 +67,7 @@ def blockwise_attention(q, k, v, *, block_size: int = 512,
     only) restricts each query to keys in (q-W, q] — sliding-window
     local attention."""
     window = _check_window(window, causal)
+    _check_gqa(q.shape[2], k.shape[2])
     if use_flash is None:
         from ..ops import use_pallas_default
         use_flash = use_pallas_default()
@@ -86,6 +77,12 @@ def blockwise_attention(q, k, v, *, block_size: int = 512,
         # only describes the jnp scan granularity below.
         from ..ops.pallas_kernels import flash_attention
         return flash_attention(q, k, v, causal, scale, window=window)
+    # GQA on the portable path: expand kv heads (the kernel path above
+    # indexes shared kv blocks instead of materializing the repeat)
+    if k.shape[2] != q.shape[2]:
+        g = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, g, axis=2)
+        v = jnp.repeat(v, g, axis=2)
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
     scale = scale if scale is not None else D ** -0.5
@@ -133,11 +130,14 @@ def blockwise_attention(q, k, v, *, block_size: int = 512,
 def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
                           scale: Optional[float],
                           window: Optional[int] = None):
-    """Per-shard body (runs under shard_map): rotate K/V around the ring."""
+    """Per-shard body (runs under shard_map): rotate K/V around the ring.
+    With GQA (fewer kv heads) the RING TRAFFIC stays kv-head sized; heads
+    expand only transiently inside each fold."""
     axis_size = jax.lax.psum(1, axis_name)
     axis_idx = jax.lax.axis_index(axis_name)
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
+    gqa = H // k.shape[2]
     scale_ = scale if scale is not None else D ** -0.5
     q_pos = axis_idx * Tq + jnp.arange(Tq)
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
@@ -158,7 +158,9 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
 
         def fold(carry):
             m, l, o = carry
-            return _attn_block(q, k_cur, v_cur, m, l, o,
+            k_use = jnp.repeat(k_cur, gqa, axis=2) if gqa > 1 else k_cur
+            v_use = jnp.repeat(v_cur, gqa, axis=2) if gqa > 1 else v_cur
+            return _attn_block(q, k_use, v_use, m, l, o,
                                scale=scale_, mask=mask)
 
         if causal:
@@ -194,6 +196,7 @@ def ring_attention(q, k, v, mesh: Mesh, *, axis_name: str = "seq",
     (causal only) applies the sliding-window mask on GLOBAL positions —
     each ring step folds only the in-window part of the visiting block."""
     window = _check_window(window, causal)
+    _check_gqa(q.shape[2], k.shape[2])
     spec = P(None, axis_name, None, None)
     fn = jax.shard_map(
         functools.partial(_ring_attention_local, axis_name=axis_name,

@@ -40,7 +40,8 @@ class MultiHeadAttention(Forward):
     def __init__(self, n_heads: int, head_dim: Optional[int] = None,
                  name=None, inputs=("@input",), *, causal: bool = True,
                  seq_axis: str = "seq", block_size: int = 512,
-                 compute_dtype=None, window: Optional[int] = None):
+                 compute_dtype=None, window: Optional[int] = None,
+                 n_kv_heads: Optional[int] = None):
         super().__init__(name, inputs)
         self.n_heads = int(n_heads)
         self.head_dim = head_dim
@@ -50,21 +51,26 @@ class MultiHeadAttention(Forward):
         self.compute_dtype = compute_dtype
         # sliding-window width (causal local attention); None = full
         self.window = None if window is None else int(window)
+        # grouped-query attention: fewer K/V heads than Q heads
+        from ..ops import check_gqa_heads
+        self.n_kv_heads = (self.n_heads if n_kv_heads is None
+                           else int(n_kv_heads))
+        check_gqa_heads(self.n_heads, self.n_kv_heads)
 
     def output_spec(self, in_specs: Sequence[Spec]) -> Spec:
         return in_specs[0]
 
     def init(self, key, in_specs):
         E = in_specs[0].shape[-1]
-        H = self.n_heads
+        H, Hk = self.n_heads, self.n_kv_heads
         D = self.head_dim or E // H
         if self.head_dim is None and E % H:
             raise ValueError(f"model dim {E} not divisible by {H} heads")
         kq, kk, kv, ko = jax.random.split(key, 4)
         return {
             "wq": _uniform_init(kq, (E, H * D), E),
-            "wk": _uniform_init(kk, (E, H * D), E),
-            "wv": _uniform_init(kv, (E, H * D), E),
+            "wk": _uniform_init(kk, (E, Hk * D), E),
+            "wv": _uniform_init(kv, (E, Hk * D), E),
             "wo": _uniform_init(ko, (H * D, E), H * D),
         }, {}
 
@@ -77,10 +83,12 @@ class MultiHeadAttention(Forward):
         dt = self.compute_dtype or x.dtype
         xq = x.astype(dt)
 
-        def proj(w):
-            return (xq @ w.astype(dt)).reshape(B, T, H, -1)
+        def proj(w, nh):
+            return (xq @ w.astype(dt)).reshape(B, T, nh, -1)
 
-        q, k, v = proj(params["wq"]), proj(params["wk"]), proj(params["wv"])
+        q = proj(params["wq"], H)
+        k = proj(params["wk"], self.n_kv_heads)
+        v = proj(params["wv"], self.n_kv_heads)
         if ctx.axis_size(self.seq_axis) > 1:
             o = ring_attention(q, k, v, ctx.mesh, axis_name=self.seq_axis,
                                causal=self.causal, window=self.window)
