@@ -68,6 +68,38 @@ idx = jnp.asarray(rng.permutation(1000)[:64], jnp.int32)
 np.testing.assert_array_equal(
     np.asarray(pk.gather_rows(data, idx, interpret=False)),
     np.asarray(jnp.take(data, idx, axis=0)))
+
+# sliding window + GQA compiled vs the repeat/masked formulations
+T, W, H, Hk = 512, 128, 4, 2
+q = jnp.asarray(rng.standard_normal((1, T, H, 64)), jnp.float32)
+kg, vg = (jnp.asarray(rng.standard_normal((1, T, Hk, 64)), jnp.float32)
+          for _ in range(2))
+got = pk.flash_attention(q, kg, vg, True, None, interpret=False,
+                         window=W)
+kf, vf = jnp.repeat(kg, H // Hk, 2), jnp.repeat(vg, H // Hk, 2)
+s = jnp.einsum("bqhd,bkhd->bhqk", q, kf) * (64 ** -0.5)
+qp, kp = jnp.arange(T)[:, None], jnp.arange(T)[None, :]
+mask = (kp <= qp) & (kp > qp - W)
+ref = jnp.einsum("bhqk,bkhd->bqhd",
+                 jax.nn.softmax(jnp.where(mask[None, None], s, -jnp.inf),
+                                axis=-1), vf)
+np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                           rtol=2e-4, atol=2e-5)
+gq, gk, gv = jax.grad(lambda a, b, c: jnp.sum(pk.flash_attention(
+    a, b, c, True, None, interpret=False, window=W) ** 2),
+    argnums=(0, 1, 2))(q, kg, vg)
+rq, rk, rv = jax.grad(lambda a, b, c: jnp.sum(jnp.einsum(
+    "bhqk,bkhd->bqhd", jax.nn.softmax(jnp.where(
+        mask[None, None], jnp.einsum("bqhd,bkhd->bhqk", a,
+                                     jnp.repeat(b, H // Hk, 2))
+        * (64 ** -0.5), -jnp.inf), axis=-1),
+    jnp.repeat(c, H // Hk, 2)) ** 2), argnums=(0, 1, 2))(q, kg, vg)
+np.testing.assert_allclose(np.asarray(gq), np.asarray(rq),
+                           rtol=2e-3, atol=2e-4)
+np.testing.assert_allclose(np.asarray(gk), np.asarray(rk),
+                           rtol=2e-3, atol=2e-4)
+np.testing.assert_allclose(np.asarray(gv), np.asarray(rv),
+                           rtol=2e-3, atol=2e-4)
 print("TPU_SMOKE_OK")
 """
 
