@@ -300,6 +300,107 @@ class MeanDispUnit : public Unit {  // (x - mean) * rdisp
   }
 };
 
+// ---------------------------------------------------------------------------
+class AttentionUnit : public Unit {  // MultiHeadAttention at inference
+ public:
+  // Mirrors veles_tpu/units/parallel_nn.py MultiHeadAttention: causal
+  // (optionally sliding-window, grouped-query) self-attention over
+  // (B, T, E).  Per-row online softmax keeps memory O(D) per query and
+  // cost O(T*window) when a window is set.
+  int64_t n_heads = 1, n_kv_heads = 1, window = 0;  // window 0 = full
+  bool causal = true;
+  npy::Array wq, wk, wv, wo;
+
+  Shape OutputShape(const std::vector<Shape>& in) const override {
+    return in[0];
+  }
+
+  void Run(const std::vector<const Tensor*>& in, Tensor* out,
+           UnitContext* ctx) const override {
+    const Tensor& x = *in[0];
+    if (x.shape.rank() != 3)
+      throw std::runtime_error(name + ": attention input must be "
+                               "(batch, time, features)");
+    int64_t B = x.shape[0], T = x.shape[1], E = x.shape[2];
+    int64_t H = n_heads, Hk = n_kv_heads;
+    int64_t D = wq.shape[1] / H;
+    int64_t G = H / Hk;
+    float scale = 1.f / std::sqrt(static_cast<float>(D));
+
+    std::vector<float> Q(B * T * H * D), K(B * T * Hk * D),
+        V(B * T * Hk * D), A(B * T * H * D);
+    auto project = [&](const npy::Array& w, std::vector<float>& dst,
+                       int64_t width) {
+      ctx->pool->ParallelFor(B * T, [&](int64_t rb, int64_t re) {
+        for (int64_t r = rb; r < re; r++) {
+          const float* xr = x.data + r * E;
+          float* dr = dst.data() + r * width;
+          for (int64_t o = 0; o < width; o++) dr[o] = 0.f;
+          for (int64_t i = 0; i < E; i++) {
+            float xv = xr[i];
+            if (xv == 0.f) continue;
+            const float* wr = w.data.data() + i * width;
+            for (int64_t o = 0; o < width; o++) dr[o] += xv * wr[o];
+          }
+        }
+      });
+    };
+    project(wq, Q, H * D);
+    project(wk, K, Hk * D);
+    project(wv, V, Hk * D);
+
+    ctx->pool->ParallelFor(B * H, [&](int64_t rb, int64_t re) {
+      std::vector<float> acc(D);
+      for (int64_t bh = rb; bh < re; bh++) {
+        int64_t b = bh / H, h = bh % H, hk = h / G;
+        for (int64_t t = 0; t < T; t++) {
+          int64_t hi = causal ? t : T - 1;
+          int64_t lo = (causal && window > 0)
+                           ? std::max<int64_t>(0, t - window + 1) : 0;
+          const float* qr = Q.data() + ((b * T + t) * H + h) * D;
+          float m = -1e30f, l = 0.f;
+          std::fill(acc.begin(), acc.end(), 0.f);
+          for (int64_t j = lo; j <= hi; j++) {
+            const float* kr = K.data() + ((b * T + j) * Hk + hk) * D;
+            float s = 0.f;
+            for (int64_t d = 0; d < D; d++) s += qr[d] * kr[d];
+            s *= scale;
+            if (s > m) {
+              float a = std::exp(m - s);
+              l *= a;
+              for (int64_t d = 0; d < D; d++) acc[d] *= a;
+              m = s;
+            }
+            float p = std::exp(s - m);
+            l += p;
+            const float* vr = V.data() + ((b * T + j) * Hk + hk) * D;
+            for (int64_t d = 0; d < D; d++) acc[d] += p * vr[d];
+          }
+          float* ar = A.data() + ((b * T + t) * H + h) * D;
+          float inv = 1.f / std::max(l, 1e-30f);
+          for (int64_t d = 0; d < D; d++) ar[d] = acc[d] * inv;
+        }
+      }
+    });
+
+    // output projection: (B*T, H*D) @ wo (H*D, E)
+    ctx->pool->ParallelFor(B * T, [&](int64_t rb, int64_t re) {
+      for (int64_t r = rb; r < re; r++) {
+        const float* arow = A.data() + r * H * D;
+        float* yr = out->data + r * E;
+        for (int64_t o = 0; o < E; o++) yr[o] = 0.f;
+        for (int64_t i = 0; i < H * D; i++) {
+          float av = arow[i];
+          if (av == 0.f) continue;
+          const float* wr = wo.data.data() + i * E;
+          for (int64_t o = 0; o < E; o++) yr[o] += av * wr[o];
+        }
+      }
+    });
+  }
+};
+
+// ---------------------------------------------------------------------------
 class SoftmaxUnit : public Unit {  // EvaluatorSoftmax at inference = probs
  public:
   Shape OutputShape(const std::vector<Shape>& in) const override {
@@ -434,6 +535,26 @@ inline UnitPtr CreateUnit(const std::string& klass,
     return u;
   }
   if (klass == "EvaluatorSoftmax") return std::make_unique<SoftmaxUnit>();
+  if (klass == "MultiHeadAttention") {
+    auto u = std::make_unique<AttentionUnit>();
+    u->n_heads = static_cast<int64_t>(config.number("n_heads", 1));
+    u->n_kv_heads = static_cast<int64_t>(
+        config.number("n_kv_heads", static_cast<double>(u->n_heads)));
+    bool has_window = config.has("window") &&
+        config.at("window").type != json::Value::Type::Null;
+    u->window = has_window
+        ? static_cast<int64_t>(config.number("window", 0)) : 0;
+    if (config.has("causal")) {
+      const auto& cv = config.at("causal");
+      u->causal = cv.type == json::Value::Type::Bool ? cv.b
+                                                     : cv.num != 0.0;
+    }
+    u->wq = std::move((*weights)["wq"]);
+    u->wk = std::move((*weights)["wk"]);
+    u->wv = std::move((*weights)["wv"]);
+    u->wo = std::move((*weights)["wo"]);
+    return u;
+  }
   throw std::runtime_error("no native implementation for unit class " +
                            klass);
 }

@@ -189,3 +189,35 @@ def test_cpp_reshape_conv_roundtrip(binary, tmp_path, rng):
     got = np.load(out)
     ref = np.asarray(wf.make_predict_step("out")(ws, {"@input": x}))
     np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-6)
+
+
+def test_cpp_attention_matches_jax(binary, tmp_path, rng):
+    """MultiHeadAttention (GQA + sliding window) served natively matches
+    the JAX forward — the serving runtime keeps pace with the attention
+    unit family."""
+    wf = build_workflow("attn_serve", [
+        {"type": "attention", "n_heads": 4, "n_kv_heads": 2, "window": 12,
+         "name": "attn"},
+        {"type": "flatten", "name": "flat"},
+        {"type": "softmax", "output_size": 5, "name": "out"},
+    ])
+    wf.build({"@input": vt.Spec((2, 24, 16), jnp.float32),
+              "@labels": vt.Spec((2,), jnp.int32),
+              "@mask": vt.Spec((2,), jnp.float32)})
+    o = opt.SGD(0.01)
+    ws = wf.init_state(jax.random.key(7), o)
+    pkg = str(tmp_path / "attn_pkg")
+    export_package(wf, ws, pkg,
+                   input_spec={"shape": [2, 24, 16], "dtype": "float32"})
+
+    x = rng.standard_normal((2, 24, 16)).astype(np.float32)
+    np.save(tmp_path / "ax.npy", x)
+    r = subprocess.run(
+        [binary, pkg, str(tmp_path / "ax.npy"), str(tmp_path / "ay.npy"),
+         "--output-unit", "out"],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    got = np.load(tmp_path / "ay.npy")
+    predict = wf.make_predict_step("out")
+    ref = np.asarray(predict(ws, {"@input": jnp.asarray(x)}))
+    np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-4)

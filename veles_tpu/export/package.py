@@ -43,6 +43,8 @@ _EXPORT_FIELDS = {
     "Flatten": (),
     "Reshape": ("shape",),
     "MeanDispNormalizer": (),
+    "MultiHeadAttention": ("n_heads", "n_kv_heads", "head_dim", "causal",
+                           "window", "block_size", "seq_axis"),
     "EvaluatorSoftmax": (),
     "EvaluatorMSE": (),
 }
