@@ -160,10 +160,24 @@ def main():
         q, k, v, True, None, interpret=False, window=W))
     t_full, _ = timeit(full, q, kf, vf, iters=10)
     t_swa, out_swa = timeit(swa, q, kf, vf, iters=10)
-    # windowed reference on a slice (full dense T=8192 ref is too big)
-    record(f"flash_swa_T{T}_W{W}_bf16", t_swa, t_full, 0.0,
+    # numerics: dense windowed reference on the last Sq query rows (their
+    # window only reaches back W keys, so a K slice of Sq+W suffices)
+    Sq = 256
+    qs = q[:, -Sq:].astype(jnp.float32)
+    ks = kf[:, -(Sq + W):].astype(jnp.float32)
+    vs = vf[:, -(Sq + W):].astype(jnp.float32)
+    s = jnp.einsum("bqhd,bkhd->bhqk", qs, ks) * (D ** -0.5)
+    qp = (T - Sq + jnp.arange(Sq))[:, None]
+    kp = (T - Sq - W + jnp.arange(Sq + W))[None, :]
+    msk = (kp <= qp) & (kp > qp - W)
+    ref_swa = jnp.einsum(
+        "bhqk,bkhd->bqhd",
+        jax.nn.softmax(jnp.where(msk[None, None], s, -jnp.inf), -1), vs)
+    record(f"flash_swa_T{T}_W{W}_bf16", t_swa, t_full,
+           rel_err(out_swa[:, -Sq:].astype(jnp.float32), ref_swa),
            note="xla_ms column = full-attention kernel (the speedup is "
-                "the window block-skip)")
+                "the window block-skip); err vs dense windowed ref on "
+                "the last 256 rows")
 
     kg, vg = (jnp.asarray(rng.standard_normal((B, T, Hk, D)), jnp.bfloat16)
               for _ in range(2))

@@ -323,6 +323,16 @@ class AttentionUnit : public Unit {  // MultiHeadAttention at inference
                                "(batch, time, features)");
     int64_t B = x.shape[0], T = x.shape[1], E = x.shape[2];
     int64_t H = n_heads, Hk = n_kv_heads;
+    if (E != wq.shape[0])
+      throw std::runtime_error(
+          name + ": input features " + std::to_string(E) +
+          " != wq rows " + std::to_string(wq.shape[0]));
+    if (wq.shape[1] % H)
+      throw std::runtime_error(name + ": wq width not divisible by heads");
+    if (window > 0 && !causal)
+      throw std::runtime_error(
+          name + ": sliding-window attention requires causal=true "
+          "(mirrors the Python-side check)");
     int64_t D = wq.shape[1] / H;
     int64_t G = H / Hk;
     float scale = 1.f / std::sqrt(static_cast<float>(D));
@@ -349,11 +359,17 @@ class AttentionUnit : public Unit {  // MultiHeadAttention at inference
     project(wk, K, Hk * D);
     project(wv, V, Hk * D);
 
-    ctx->pool->ParallelFor(B * H, [&](int64_t rb, int64_t re) {
+    // grain = (b, h, t-chunk): rows are independent, so small-batch
+    // few-head long-T serving still fills the pool
+    constexpr int64_t kRowChunk = 16;
+    int64_t t_chunks = (T + kRowChunk - 1) / kRowChunk;
+    ctx->pool->ParallelFor(B * H * t_chunks, [&](int64_t rb, int64_t re) {
       std::vector<float> acc(D);
-      for (int64_t bh = rb; bh < re; bh++) {
+      for (int64_t task = rb; task < re; task++) {
+        int64_t bh = task / t_chunks, tc = task % t_chunks;
         int64_t b = bh / H, h = bh % H, hk = h / G;
-        for (int64_t t = 0; t < T; t++) {
+        int64_t t_end = std::min(T, (tc + 1) * kRowChunk);
+        for (int64_t t = tc * kRowChunk; t < t_end; t++) {
           int64_t hi = causal ? t : T - 1;
           int64_t lo = (causal && window > 0)
                            ? std::max<int64_t>(0, t - window + 1) : 0;
@@ -549,6 +565,10 @@ inline UnitPtr CreateUnit(const std::string& klass,
       u->causal = cv.type == json::Value::Type::Bool ? cv.b
                                                      : cv.num != 0.0;
     }
+    for (const char* wn : {"wq", "wk", "wv", "wo"})
+      if (!weights->count(wn))
+        throw std::runtime_error("attention unit missing weight " +
+                                 std::string(wn));
     u->wq = std::move((*weights)["wq"]);
     u->wk = std::move((*weights)["wk"]);
     u->wv = std::move((*weights)["wv"]);
