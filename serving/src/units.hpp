@@ -308,7 +308,7 @@ class AttentionUnit : public Unit {  // MultiHeadAttention at inference
   // (B, T, E).  Per-row online softmax keeps memory O(D) per query and
   // cost O(T*window) when a window is set.
   int64_t n_heads = 1, n_kv_heads = 1, window = 0;  // window 0 = full
-  bool causal = true;
+  bool causal = true, rope = false;
   npy::Array wq, wk, wv, wo;
 
   Shape OutputShape(const std::vector<Shape>& in) const override {
@@ -358,6 +358,34 @@ class AttentionUnit : public Unit {  // MultiHeadAttention at inference
     project(wq, Q, H * D);
     project(wk, K, Hk * D);
     project(wv, V, Hk * D);
+
+    if (rope) {
+      // rotary embedding: pairs (x[2i], x[2i+1]) rotate by
+      // t * 10000^(-i/(D/2)) — mirrors ops/activations.rotary_embedding
+      int64_t half = D / 2;
+      if (D % 2)
+        throw std::runtime_error(name + ": RoPE needs an even head dim");
+      auto rotate = [&](std::vector<float>& buf, int64_t nh) {
+        ctx->pool->ParallelFor(B * T, [&](int64_t rb, int64_t re) {
+          for (int64_t r = rb; r < re; r++) {
+            int64_t t = r % T;
+            for (int64_t h = 0; h < nh; h++) {
+              float* row = buf.data() + (r * nh + h) * D;
+              for (int64_t i = 0; i < half; i++) {
+                float ang = static_cast<float>(t) *
+                    std::pow(10000.f, -static_cast<float>(i) / half);
+                float c = std::cos(ang), s = std::sin(ang);
+                float a = row[2 * i], b2 = row[2 * i + 1];
+                row[2 * i] = a * c - b2 * s;
+                row[2 * i + 1] = a * s + b2 * c;
+              }
+            }
+          }
+        });
+      };
+      rotate(Q, H);
+      rotate(K, Hk);
+    }
 
     // grain = (b, h, t-chunk): rows are independent, so small-batch
     // few-head long-T serving still fills the pool
@@ -564,6 +592,10 @@ inline UnitPtr CreateUnit(const std::string& klass,
       const auto& cv = config.at("causal");
       u->causal = cv.type == json::Value::Type::Bool ? cv.b
                                                      : cv.num != 0.0;
+    }
+    if (config.has("rope")) {
+      const auto& rv = config.at("rope");
+      u->rope = rv.type == json::Value::Type::Bool ? rv.b : rv.num != 0.0;
     }
     for (const char* wn : {"wq", "wk", "wv", "wo"})
       if (!weights->count(wn))

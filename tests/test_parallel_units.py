@@ -217,3 +217,62 @@ def test_attention_unit_gqa_trains(rng):
         ws, mets = step(ws, batch)
         losses.append(float(mets["loss"]))
     assert losses[-1] < losses[0]
+
+
+def test_rope_properties(rng):
+    """RoPE preserves norms, is identity at position 0 with offset 0, and
+    q.k dot products depend only on RELATIVE position."""
+    from veles_tpu.ops import rotary_embedding
+    x = jnp.asarray(rng.standard_normal((2, 16, 3, 8)), jnp.float32)
+    r = rotary_embedding(x)
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(r), axis=-1),
+        np.linalg.norm(np.asarray(x), axis=-1), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(r[:, 0]), np.asarray(x[:, 0]),
+                               rtol=1e-6)
+    # relative-position property: scores of (q at p+s, k at p) equal for
+    # any p when the unrotated vectors are the same
+    q0 = x[:, :1]
+    k0 = jnp.roll(x, 1, axis=1)[:, :1]
+    def score(off):
+        qq = rotary_embedding(q0, offset=off + 3)
+        kk = rotary_embedding(k0, offset=off)
+        return np.asarray(jnp.einsum("bthd,bthd->bth", qq, kk))
+    np.testing.assert_allclose(score(0), score(11), rtol=1e-4, atol=1e-5)
+    # shard-offset consistency: rotating two halves with offsets equals
+    # rotating the whole (the sequence-parallel contract)
+    whole = rotary_embedding(x)
+    lo = rotary_embedding(x[:, :8], offset=0)
+    hi = rotary_embedding(x[:, 8:], offset=8)
+    np.testing.assert_allclose(np.asarray(whole),
+                               np.asarray(jnp.concatenate([lo, hi], 1)),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_attention_unit_rope_trains(rng):
+    import veles_tpu as vt
+    from veles_tpu.models.standard import build_workflow, build_optimizer
+    layers = [
+        {"type": "attention", "n_heads": 2, "rope": True, "name": "attn"},
+        {"type": "flatten", "name": "flat"},
+        {"type": "softmax", "output_size": 4, "name": "head"},
+    ]
+    wf = build_workflow("rope", layers, loss="softmax")
+    B, T, E = 4, 16, 8
+    specs = {"@input": vt.Spec((B, T, E), jnp.float32),
+             "@labels": vt.Spec((B,), jnp.int32),
+             "@mask": vt.Spec((B,), jnp.float32)}
+    wf.build(specs)
+    opt = build_optimizer("momentum", layers, lr=0.05)
+    ws = wf.init_state(jax.random.key(1), opt)
+    step = wf.make_train_step(opt)
+    rngl = np.random.default_rng(1)
+    batch = {"@input": jnp.asarray(
+                 rngl.standard_normal((B, T, E)), jnp.float32),
+             "@labels": jnp.asarray(rngl.integers(0, 4, B), jnp.int32),
+             "@mask": jnp.ones(B)}
+    losses = []
+    for _ in range(20):
+        ws, mets = step(ws, batch)
+        losses.append(float(mets["loss"]))
+    assert losses[-1] < losses[0]

@@ -197,7 +197,7 @@ def test_cpp_attention_matches_jax(binary, tmp_path, rng):
     unit family."""
     wf = build_workflow("attn_serve", [
         {"type": "attention", "n_heads": 4, "n_kv_heads": 2, "window": 12,
-         "name": "attn"},
+         "rope": True, "name": "attn"},
         {"type": "flatten", "name": "flat"},
         {"type": "softmax", "output_size": 5, "name": "out"},
     ])

@@ -44,7 +44,7 @@ _EXPORT_FIELDS = {
     "Reshape": ("shape",),
     "MeanDispNormalizer": (),
     "MultiHeadAttention": ("n_heads", "n_kv_heads", "head_dim", "causal",
-                           "window", "block_size", "seq_axis"),
+                           "window", "block_size", "seq_axis", "rope"),
     "EvaluatorSoftmax": (),
     "EvaluatorMSE": (),
 }

@@ -5,6 +5,7 @@ numpy references in tests/.
 """
 
 from .activations import (relu, scaled_tanh, sigmoid, sincos, log_softmax,
+                          rotary_embedding,
                           softmax, ACTIVATIONS)
 from .linear import dense, smart_uniform_init
 from .convolution import conv2d, deconv2d

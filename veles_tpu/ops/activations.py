@@ -51,3 +51,27 @@ ACTIVATIONS = {
     "sigmoid": sigmoid,
     "sincos": sincos,
 }
+
+
+def rotary_embedding(x, *, base: float = 10000.0, offset: int = 0):
+    """Rotary position embedding (RoPE) over (B, T, H, D) with even D:
+    pairs (x[2i], x[2i+1]) rotate by angle pos / base^(2i/D).
+
+    Elementwise in (pos, feature), so it is GSPMD-transparent: under
+    sequence parallelism the T axis stays sharded and each shard rotates
+    by its GLOBAL positions (offset + local index) without communication.
+    """
+    import jax.numpy as jnp
+    B, T, H, D = x.shape
+    if D % 2:
+        raise ValueError(f"RoPE needs an even head dim, got {D}")
+    half = D // 2
+    inv_freq = base ** (-jnp.arange(half, dtype=jnp.float32) / half)
+    ang = (offset + jnp.arange(T, dtype=jnp.float32))[:, None] \
+        * inv_freq[None, :]                      # (T, half)
+    cos = jnp.cos(ang)[None, :, None, :].astype(x.dtype)
+    sin = jnp.sin(ang)[None, :, None, :].astype(x.dtype)
+    x1 = x[..., 0::2]
+    x2 = x[..., 1::2]
+    out = jnp.stack([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.reshape(B, T, H, D)

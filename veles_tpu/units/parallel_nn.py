@@ -41,7 +41,7 @@ class MultiHeadAttention(Forward):
                  name=None, inputs=("@input",), *, causal: bool = True,
                  seq_axis: str = "seq", block_size: int = 512,
                  compute_dtype=None, window: Optional[int] = None,
-                 n_kv_heads: Optional[int] = None):
+                 n_kv_heads: Optional[int] = None, rope: bool = False):
         super().__init__(name, inputs)
         self.n_heads = int(n_heads)
         self.head_dim = head_dim
@@ -51,6 +51,7 @@ class MultiHeadAttention(Forward):
         self.compute_dtype = compute_dtype
         # sliding-window width (causal local attention); None = full
         self.window = None if window is None else int(window)
+        self.rope = bool(rope)  # rotary position embedding on q/k
         # grouped-query attention: fewer K/V heads than Q heads
         from ..ops import check_gqa_heads
         self.n_kv_heads = (self.n_heads if n_kv_heads is None
@@ -89,6 +90,10 @@ class MultiHeadAttention(Forward):
         q = proj(params["wq"], H)
         k = proj(params["wk"], self.n_kv_heads)
         v = proj(params["wv"], self.n_kv_heads)
+        if self.rope:
+            from ..ops import rotary_embedding
+            q = rotary_embedding(q)
+            k = rotary_embedding(k)
         if ctx.axis_size(self.seq_axis) > 1:
             o = ring_attention(q, k, v, ctx.mesh, axis_name=self.seq_axis,
                                causal=self.causal, window=self.window)
