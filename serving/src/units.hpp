@@ -361,23 +361,32 @@ class AttentionUnit : public Unit {  // MultiHeadAttention at inference
 
     if (rope) {
       // rotary embedding: pairs (x[2i], x[2i+1]) rotate by
-      // t * 10000^(-i/(D/2)) — mirrors ops/activations.rotary_embedding
+      // t * 10000^(-i/(D/2)) — mirrors ops/activations.rotary_embedding.
+      // Angles depend only on (t, i): one (T, half) cos/sin table serves
+      // every row and head (pow/cos/sin off the per-element hot path).
       int64_t half = D / 2;
       if (D % 2)
         throw std::runtime_error(name + ": RoPE needs an even head dim");
+      std::vector<float> cos_t(T * half), sin_t(T * half);
+      for (int64_t i = 0; i < half; i++) {
+        float freq = std::pow(10000.f, -static_cast<float>(i) / half);
+        for (int64_t t = 0; t < T; t++) {
+          float ang = static_cast<float>(t) * freq;
+          cos_t[t * half + i] = std::cos(ang);
+          sin_t[t * half + i] = std::sin(ang);
+        }
+      }
       auto rotate = [&](std::vector<float>& buf, int64_t nh) {
         ctx->pool->ParallelFor(B * T, [&](int64_t rb, int64_t re) {
           for (int64_t r = rb; r < re; r++) {
-            int64_t t = r % T;
+            const float* ct = cos_t.data() + (r % T) * half;
+            const float* st = sin_t.data() + (r % T) * half;
             for (int64_t h = 0; h < nh; h++) {
               float* row = buf.data() + (r * nh + h) * D;
               for (int64_t i = 0; i < half; i++) {
-                float ang = static_cast<float>(t) *
-                    std::pow(10000.f, -static_cast<float>(i) / half);
-                float c = std::cos(ang), s = std::sin(ang);
                 float a = row[2 * i], b2 = row[2 * i + 1];
-                row[2 * i] = a * c - b2 * s;
-                row[2 * i + 1] = a * s + b2 * c;
+                row[2 * i] = a * ct[i] - b2 * st[i];
+                row[2 * i + 1] = a * st[i] + b2 * ct[i];
               }
             }
           }

@@ -268,3 +268,13 @@ def test_cli_random_seed_forms(tmp_path, config_file):
     r = run_cli(tmp_path, config_file, "--random-seed", "nope!",
                 "--dry-run", "init")
     assert r.returncode != 0
+
+
+def test_profile_flag_writes_trace(tmp_path, config_file):
+    """--profile DIR captures a device-level jax.profiler trace."""
+    import glob
+    tdir = tmp_path / "trace"
+    r = run_cli(tmp_path, config_file, "--profile", str(tdir))
+    assert r.returncode == 0, r.stderr
+    found = glob.glob(str(tdir) + "/**/*", recursive=True)
+    assert any(os.path.isfile(f) for f in found), found

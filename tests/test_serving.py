@@ -191,13 +191,14 @@ def test_cpp_reshape_conv_roundtrip(binary, tmp_path, rng):
     np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-6)
 
 
-def test_cpp_attention_matches_jax(binary, tmp_path, rng):
-    """MultiHeadAttention (GQA + sliding window) served natively matches
-    the JAX forward — the serving runtime keeps pace with the attention
-    unit family."""
+@pytest.mark.parametrize("rope", [False, True])
+def test_cpp_attention_matches_jax(binary, tmp_path, rng, rope):
+    """MultiHeadAttention (GQA + sliding window, with and without RoPE)
+    served natively matches the JAX forward — the serving runtime keeps
+    pace with the attention unit family."""
     wf = build_workflow("attn_serve", [
         {"type": "attention", "n_heads": 4, "n_kv_heads": 2, "window": 12,
-         "rope": True, "name": "attn"},
+         "rope": rope, "name": "attn"},
         {"type": "flatten", "name": "flat"},
         {"type": "softmax", "output_size": 5, "name": "out"},
     ])

@@ -112,6 +112,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "forced device sync and print the top-5 table "
                         "(reference: --sync-run honest per-unit timers + "
                         "Workflow.print_stats)")
+    p.add_argument("--profile", metavar="DIR",
+                   help="capture a device-level jax.profiler trace of the "
+                        "training run into DIR (view with TensorBoard / "
+                        "xprof; complements the host-side EventTracer "
+                        "timeline)")
     p.add_argument("--verbose", "-v", action="store_true")
     p.add_argument("--list-units", action="store_true",
                    help="print the registered unit classes and exit")
@@ -540,7 +545,13 @@ def main(argv=None) -> int:
         batch = next(trainer.loader.iter_epoch(klass))
         rows = trainer.workflow.profile_units(trainer.wstate, batch)
         print(trainer.workflow.format_profile(rows))
-    results = trainer.run()
+    import contextlib
+    profile_cm = contextlib.nullcontext()
+    if args.profile:
+        import jax
+        profile_cm = jax.profiler.trace(args.profile)
+    with profile_cm:
+        results = trainer.run()
     print(json.dumps(results))
     if args.publish:
         # after the results are emitted — a report typo must never eat a

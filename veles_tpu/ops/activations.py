@@ -61,7 +61,6 @@ def rotary_embedding(x, *, base: float = 10000.0, offset: int = 0):
     sequence parallelism the T axis stays sharded and each shard rotates
     by its GLOBAL positions (offset + local index) without communication.
     """
-    import jax.numpy as jnp
     B, T, H, D = x.shape
     if D % 2:
         raise ValueError(f"RoPE needs an even head dim, got {D}")
