@@ -308,7 +308,7 @@ class AttentionUnit : public Unit {  // MultiHeadAttention at inference
   // (B, T, E).  Per-row online softmax keeps memory O(D) per query and
   // cost O(T*window) when a window is set.
   int64_t n_heads = 1, n_kv_heads = 1, window = 0;  // window 0 = full
-  bool causal = true, rope = false;
+  bool causal = true, rope = false, residual = false;
   npy::Array wq, wk, wv, wo;
 
   Shape OutputShape(const std::vector<Shape>& in) const override {
@@ -436,12 +436,14 @@ class AttentionUnit : public Unit {  // MultiHeadAttention at inference
       }
     });
 
-    // output projection: (B*T, H*D) @ wo (H*D, E)
+    // output projection: (B*T, H*D) @ wo (H*D, E), + x when residual
     ctx->pool->ParallelFor(B * T, [&](int64_t rb, int64_t re) {
       for (int64_t r = rb; r < re; r++) {
         const float* arow = A.data() + r * H * D;
+        const float* xr = x.data + r * E;
         float* yr = out->data + r * E;
-        for (int64_t o = 0; o < E; o++) yr[o] = 0.f;
+        for (int64_t o = 0; o < E; o++)
+          yr[o] = residual ? xr[o] : 0.f;
         for (int64_t i = 0; i < H * D; i++) {
           float av = arow[i];
           if (av == 0.f) continue;
@@ -605,6 +607,11 @@ inline UnitPtr CreateUnit(const std::string& klass,
     if (config.has("rope")) {
       const auto& rv = config.at("rope");
       u->rope = rv.type == json::Value::Type::Bool ? rv.b : rv.num != 0.0;
+    }
+    if (config.has("residual")) {
+      const auto& sv = config.at("residual");
+      u->residual = sv.type == json::Value::Type::Bool ? sv.b
+                                                       : sv.num != 0.0;
     }
     for (const char* wn : {"wq", "wk", "wv", "wo"})
       if (!weights->count(wn))

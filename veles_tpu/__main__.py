@@ -36,6 +36,7 @@ LOADERS = {
     "mnist": "veles_tpu.models.mnist:MnistLoader",
     "cifar": "veles_tpu.models.cifar:CifarLoader",
     "stl": "veles_tpu.models.stl:StlLoader",
+    "induction": "veles_tpu.models.lm:InductionLoader",
     "imagenet_synthetic":
         "veles_tpu.models.alexnet:ImagenetSyntheticLoader",
 }
@@ -118,9 +119,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "xprof; complements the host-side EventTracer "
                         "timeline)")
     p.add_argument("--verbose", "-v", action="store_true")
+    p.add_argument("--version", action="version",
+                   version=f"veles_tpu {_version()}")
     p.add_argument("--list-units", action="store_true",
                    help="print the registered unit classes and exit")
     return p
+
+
+def _version() -> str:
+    from . import __version__
+    return __version__
 
 
 def _make_trainer_from_root(cfg: Config, args) -> Trainer:

@@ -44,7 +44,8 @@ _EXPORT_FIELDS = {
     "Reshape": ("shape",),
     "MeanDispNormalizer": (),
     "MultiHeadAttention": ("n_heads", "n_kv_heads", "head_dim", "causal",
-                           "window", "block_size", "seq_axis", "rope"),
+                           "window", "block_size", "seq_axis", "rope",
+                           "residual"),
     "EvaluatorSoftmax": (),
     "EvaluatorMSE": (),
 }

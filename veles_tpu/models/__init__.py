@@ -4,3 +4,4 @@ from .cifar import cifar_workflow, CifarLoader
 from .alexnet import alexnet_workflow, ImagenetSyntheticLoader
 from .autoencoder import mnist_autoencoder_workflow
 from .stl import stl_workflow, StlLoader
+from .lm import induction_workflow, InductionLoader

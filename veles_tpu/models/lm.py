@@ -1,0 +1,93 @@
+"""Sequence model family: induction-recall task + causal attention LM.
+
+The reference has no sequence models in core (RNN/LSTM existed only as
+untested Znicz units — SURVEY.md §5.7, docs
+manualrst_veles_algorithms.rst:115-134); long context is first-class in
+this rebuild, so this module gives the attention stack a *trainable,
+config-driven* model family with a quality bar of its own.
+
+**SynthInduction** — the classic induction-head probe: each sample is a
+token sequence whose LAST token repeats an earlier token; the label is
+the token that FOLLOWED that earlier occurrence.  Solving it requires
+attending from the last position back to the previous occurrence and
+reading its successor — a two-attention-layer circuit.  Position-free
+models (FC over the flattened sequence can memorize nothing useful at
+these sizes) sit near chance = 1/vocab, so the bar is meaningful:
+
+    bar: <= 5 % validation error (chance: 96.9 % error at vocab=32)
+
+Everything is fixed-seed numpy, cached like the other procedural sets.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..loader.base import TRAIN, VALID
+from ..loader.fullbatch import FullBatchLoader
+from .standard import StandardWorkflow
+
+
+def synth_induction(n_train: int = 20000, n_valid: int = 4000,
+                    seq_len: int = 64, vocab: int = 32,
+                    seed: int = 20260732):
+    """Token sequences (n, T) int32 + labels (n,): induction recall."""
+    rng = np.random.default_rng(seed)
+    n = n_train + n_valid
+    x = rng.integers(0, vocab, (n, seq_len)).astype(np.int32)
+    # the trigger token appears at position p, its successor at p+1, and
+    # again as the final token; the model must emit that successor
+    p = rng.integers(0, seq_len - 2, n)
+    rows = np.arange(n)
+    trigger = x[rows, p]
+    # make the trigger UNIQUE elsewhere (else duplicate occurrences with
+    # different successors would make labels ambiguous — irreducible
+    # error, not a harder task): re-draw clashing positions with a
+    # shifted value, which stays in-vocab and != trigger
+    clash = x == trigger[:, None]
+    x[clash] = (x[clash] + 1 + rng.integers(
+        0, vocab - 1, int(clash.sum()))) % vocab
+    x[rows, p] = trigger
+    x[rows, -1] = trigger
+    y = x[rows, p + 1].astype(np.int32)
+    return (x[:n_train], y[:n_train], x[n_train:], y[n_train:])
+
+
+class InductionLoader(FullBatchLoader):
+    def __init__(self, minibatch_size=100, n_train=20000, n_valid=4000,
+                 seq_len=64, vocab=32, **kw):
+        xt, yt, xv, yv = synth_induction(n_train, n_valid, seq_len, vocab)
+        super().__init__({TRAIN: xt, VALID: xv},
+                         {TRAIN: yt, VALID: yv},
+                         minibatch_size=minibatch_size, **kw)
+        self.vocab = vocab
+        self.seq_len = seq_len
+
+
+INDUCTION_CONFIG = {
+    "name": "InductionLM",
+    "layers": [
+        {"type": "embedding", "vocab": 32, "dim": 64, "name": "emb"},
+        {"type": "attention", "n_heads": 4, "rope": True,
+         "residual": True, "name": "attn1"},
+        {"type": "attention", "n_heads": 4, "rope": True,
+         "residual": True, "name": "attn2"},
+        {"type": "seq_last", "name": "last"},
+        {"type": "softmax", "output_size": 32, "name": "out"},
+    ],
+    "loss": "softmax",
+    "optimizer": "adam",
+    "optimizer_args": {"lr": 1e-3},
+    "max_epochs": 25,
+    "fail_iterations": 25,
+}
+
+
+def induction_workflow(minibatch_size=100, loader_args=None,
+                       **overrides) -> StandardWorkflow:
+    cfg = dict(INDUCTION_CONFIG)
+    cfg.update(overrides)
+    sw = StandardWorkflow(cfg)
+    sw.loader = InductionLoader(minibatch_size=minibatch_size,
+                                **(loader_args or {}))
+    return sw

@@ -49,6 +49,8 @@ LAYER_TYPES = {
     "norm": nn.MeanDispNormalizer,
     "flatten": nn.Flatten,
     "reshape": nn.Reshape,
+    "embedding": nn.Embedding,
+    "seq_last": nn.SeqLast,
 }
 
 

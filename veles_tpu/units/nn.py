@@ -322,6 +322,46 @@ class Flatten(Unit):
         return xs[0].reshape(xs[0].shape[0], -1), state
 
 
+class Embedding(Unit):
+    """Token embedding: int tokens (B, T) -> (B, T, dim) by table lookup.
+
+    The front door of the sequence/long-context model family (the
+    reference had no sequence models in core — SURVEY.md §5.7); float
+    inputs from generic loaders are cast to int32 indices."""
+
+    def __init__(self, vocab: int, dim: int, name=None,
+                 inputs=("@input",)):
+        super().__init__(name, inputs)
+        self.vocab = int(vocab)
+        self.dim = int(dim)
+
+    def output_spec(self, in_specs):
+        s = in_specs[0]
+        import jax.numpy as jnp
+        return Spec(tuple(s.shape) + (self.dim,), jnp.float32)
+
+    def init(self, key, in_specs):
+        return {"table": ops.smart_uniform_init(
+            key, (self.vocab, self.dim), self.vocab)}, {}
+
+    def apply(self, params, state, xs, ctx):
+        import jax.numpy as jnp
+        idx = xs[0].astype(jnp.int32)
+        return jnp.take(params["table"], idx, axis=0), state
+
+
+class SeqLast(Unit):
+    """(B, T, ...) -> (B, ...): the final time step (e.g. next-token
+    readout after causal attention)."""
+
+    def output_spec(self, in_specs):
+        s = in_specs[0]
+        return Spec((s.shape[0],) + tuple(s.shape[2:]), s.dtype)
+
+    def apply(self, params, state, xs, ctx):
+        return xs[0][:, -1], state
+
+
 class Reshape(Unit):
     """Reshape the per-sample trailing dims (e.g. flat 784 -> 28x28x1 for a
     conv trunk fed by a vector loader)."""

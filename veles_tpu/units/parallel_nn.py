@@ -41,7 +41,8 @@ class MultiHeadAttention(Forward):
                  name=None, inputs=("@input",), *, causal: bool = True,
                  seq_axis: str = "seq", block_size: int = 512,
                  compute_dtype=None, window: Optional[int] = None,
-                 n_kv_heads: Optional[int] = None, rope: bool = False):
+                 n_kv_heads: Optional[int] = None, rope: bool = False,
+                 residual: bool = False):
         super().__init__(name, inputs)
         self.n_heads = int(n_heads)
         self.head_dim = head_dim
@@ -52,6 +53,9 @@ class MultiHeadAttention(Forward):
         # sliding-window width (causal local attention); None = full
         self.window = None if window is None else int(window)
         self.rope = bool(rope)  # rotary position embedding on q/k
+        # y = x + attn(x): the transformer residual stream (stacked
+        # attention layers can't compose circuits without it)
+        self.residual = bool(residual)
         # grouped-query attention: fewer K/V heads than Q heads
         from ..ops import check_gqa_heads
         self.n_kv_heads = (self.n_heads if n_kv_heads is None
@@ -101,6 +105,8 @@ class MultiHeadAttention(Forward):
             o = blockwise_attention(q, k, v, block_size=self.block_size,
                                     causal=self.causal, window=self.window)
         y = o.reshape(B, T, -1) @ params["wo"].astype(dt)
+        if self.residual:
+            y = y + xq
         return y.astype(x.dtype), state
 
 
