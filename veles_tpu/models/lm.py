@@ -14,7 +14,7 @@ reading its successor — a two-attention-layer circuit.  Position-free
 models (FC over the flattened sequence can memorize nothing useful at
 these sizes) sit near chance = 1/vocab, so the bar is meaningful:
 
-    bar: <= 5 % validation error (chance: 96.9 % error at vocab=32)
+    bar: <= 5 % validation error (chance: 93.75 % error at vocab=16)
 
 Everything is fixed-seed numpy, cached like the other procedural sets.
 """
@@ -29,7 +29,7 @@ from .standard import StandardWorkflow
 
 
 def synth_induction(n_train: int = 20000, n_valid: int = 4000,
-                    seq_len: int = 64, vocab: int = 32,
+                    seq_len: int = 32, vocab: int = 16,
                     seed: int = 20260732):
     """Token sequences (n, T) int32 + labels (n,): induction recall."""
     rng = np.random.default_rng(seed)
@@ -55,7 +55,7 @@ def synth_induction(n_train: int = 20000, n_valid: int = 4000,
 
 class InductionLoader(FullBatchLoader):
     def __init__(self, minibatch_size=100, n_train=20000, n_valid=4000,
-                 seq_len=64, vocab=32, **kw):
+                 seq_len=32, vocab=16, **kw):
         xt, yt, xv, yv = synth_induction(n_train, n_valid, seq_len, vocab)
         super().__init__({TRAIN: xt, VALID: xv},
                          {TRAIN: yt, VALID: yv},
@@ -67,13 +67,13 @@ class InductionLoader(FullBatchLoader):
 INDUCTION_CONFIG = {
     "name": "InductionLM",
     "layers": [
-        {"type": "embedding", "vocab": 32, "dim": 64, "name": "emb"},
+        {"type": "embedding", "vocab": 16, "dim": 64, "name": "emb"},
         {"type": "attention", "n_heads": 4, "rope": True,
          "residual": True, "name": "attn1"},
         {"type": "attention", "n_heads": 4, "rope": True,
          "residual": True, "name": "attn2"},
         {"type": "seq_last", "name": "last"},
-        {"type": "softmax", "output_size": 32, "name": "out"},
+        {"type": "softmax", "output_size": 16, "name": "out"},
     ],
     "loss": "softmax",
     "optimizer": "adam",
