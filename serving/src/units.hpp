@@ -301,6 +301,44 @@ class MeanDispUnit : public Unit {  // (x - mean) * rdisp
 };
 
 // ---------------------------------------------------------------------------
+class LayerNormUnit : public Unit {  // LayerNorm over the feature axis
+ public:
+  float eps = 1e-5f;
+  npy::Array scale, shift;
+
+  Shape OutputShape(const std::vector<Shape>& in) const override {
+    return in[0];
+  }
+
+  void Run(const std::vector<const Tensor*>& in, Tensor* out,
+           UnitContext* ctx) const override {
+    const Tensor& x = *in[0];
+    int64_t d = x.shape[x.shape.rank() - 1];
+    int64_t rows = x.size() / d;
+    if (d != scale.size())
+      throw std::runtime_error(name + ": feature dim mismatch");
+    ctx->pool->ParallelFor(rows, [&](int64_t rb, int64_t re) {
+      for (int64_t r = rb; r < re; r++) {
+        const float* xr = x.data + r * d;
+        float* yr = out->data + r * d;
+        float mu = 0.f;
+        for (int64_t i = 0; i < d; i++) mu += xr[i];
+        mu /= d;
+        float var = 0.f;
+        for (int64_t i = 0; i < d; i++) {
+          float c = xr[i] - mu;
+          var += c * c;
+        }
+        var /= d;
+        float inv = 1.f / std::sqrt(var + eps);
+        for (int64_t i = 0; i < d; i++)
+          yr[i] = (xr[i] - mu) * inv * scale.data[i] + shift.data[i];
+      }
+    });
+  }
+};
+
+// ---------------------------------------------------------------------------
 class AttentionUnit : public Unit {  // MultiHeadAttention at inference
  public:
   // Mirrors veles_tpu/units/parallel_nn.py MultiHeadAttention: causal
@@ -590,6 +628,17 @@ inline UnitPtr CreateUnit(const std::string& klass,
     return u;
   }
   if (klass == "EvaluatorSoftmax") return std::make_unique<SoftmaxUnit>();
+  if (klass == "LayerNorm") {
+    auto u = std::make_unique<LayerNormUnit>();
+    u->eps = static_cast<float>(config.number("eps", 1e-5));
+    for (const char* wn : {"scale", "shift"})
+      if (!weights->count(wn))
+        throw std::runtime_error("LayerNorm missing weight " +
+                                 std::string(wn));
+    u->scale = std::move((*weights)["scale"]);
+    u->shift = std::move((*weights)["shift"]);
+    return u;
+  }
   if (klass == "MultiHeadAttention") {
     auto u = std::make_unique<AttentionUnit>();
     u->n_heads = static_cast<int64_t>(config.number("n_heads", 1));

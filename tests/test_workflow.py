@@ -256,3 +256,18 @@ def test_fullbatch_upload_failure_no_identical_retry(rng, monkeypatch):
     loader2.initialize()
     assert not loader2.on_device
     assert calls2 == [True, False]
+
+
+def test_layer_norm_unit(rng):
+    from veles_tpu.units import LayerNorm
+    from veles_tpu.units.workflow import Workflow
+    wf = Workflow("ln")
+    wf.add(LayerNorm(name="norm"))
+    specs = wf.build({"@input": vt.Spec((4, 6, 8), jnp.float32)})
+    assert specs["norm"].shape == (4, 6, 8)
+    ws = wf.init_state(jax.random.key(0), vt.optimizers.SGD(0.1))
+    x = jnp.asarray(rng.standard_normal((4, 6, 8)) * 3 + 2, jnp.float32)
+    fwd = wf.make_predict_step("norm")
+    y = np.asarray(fwd(ws, {"@input": x}))
+    np.testing.assert_allclose(y.mean(-1), 0.0, atol=1e-5)
+    np.testing.assert_allclose(y.std(-1), 1.0, atol=1e-3)

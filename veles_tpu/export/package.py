@@ -43,6 +43,9 @@ _EXPORT_FIELDS = {
     "Flatten": (),
     "Reshape": ("shape",),
     "MeanDispNormalizer": (),
+    "LayerNorm": ("eps",),
+    "Embedding": ("vocab", "dim"),
+    "SeqLast": (),
     "MultiHeadAttention": ("n_heads", "n_kv_heads", "head_dim", "causal",
                            "window", "block_size", "seq_axis", "rope",
                            "residual"),

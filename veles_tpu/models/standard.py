@@ -50,6 +50,7 @@ LAYER_TYPES = {
     "flatten": nn.Flatten,
     "reshape": nn.Reshape,
     "embedding": nn.Embedding,
+    "layer_norm": nn.LayerNorm,
     "seq_last": nn.SeqLast,
 }
 

@@ -3,7 +3,8 @@ from .base import (Avatar, Context, Forward, InputJoiner, LambdaUnit, Spec,
 from .nn import (All2All, All2AllRELU, All2AllSincos, All2AllSoftmax,
                  All2AllTanh, AvgPooling, Conv, ConvRELU, ConvTanh, Deconv,
                  Depool, Dropout, Evaluator, EvaluatorMSE, EvaluatorSoftmax,
-                 Embedding, Flatten, LRN, MaxPooling, MeanDispNormalizer,
+                 Embedding, Flatten, LayerNorm, LRN, MaxPooling,
+                 MeanDispNormalizer,
                  Reshape, SeqLast,
                  StochasticAbsPooling)
 from .parallel_nn import (MoEFFN, MultiHeadAttention, PipelineStack,

@@ -322,6 +322,32 @@ class Flatten(Unit):
         return xs[0].reshape(xs[0].shape[0], -1), state
 
 
+class LayerNorm(Unit):
+    """Layer normalization over the trailing feature axis with learnable
+    scale/shift — the standard companion of the attention stack (no
+    reference analog; LRN is the reference's only normalizer)."""
+
+    def __init__(self, eps: float = 1e-5, name=None, inputs=("@input",)):
+        super().__init__(name, inputs)
+        self.eps = float(eps)
+
+    def output_spec(self, in_specs):
+        return in_specs[0]
+
+    def init(self, key, in_specs):
+        d = in_specs[0].shape[-1]
+        return {"scale": jnp.ones((d,)), "shift": jnp.zeros((d,))}, {}
+
+    def apply(self, params, state, xs, ctx):
+        x = xs[0]
+        xf = x.astype(jnp.float32)
+        mu = xf.mean(-1, keepdims=True)
+        var = ((xf - mu) ** 2).mean(-1, keepdims=True)
+        y = (xf - mu) * jax.lax.rsqrt(var + self.eps)
+        out = y * params["scale"] + params["shift"]
+        return out.astype(x.dtype), state
+
+
 class Embedding(Unit):
     """Token embedding: int tokens (B, T) -> (B, T, dim) by table lookup.
 
@@ -337,7 +363,6 @@ class Embedding(Unit):
 
     def output_spec(self, in_specs):
         s = in_specs[0]
-        import jax.numpy as jnp
         return Spec(tuple(s.shape) + (self.dim,), jnp.float32)
 
     def init(self, key, in_specs):
@@ -345,7 +370,6 @@ class Embedding(Unit):
             key, (self.vocab, self.dim), self.vocab)}, {}
 
     def apply(self, params, state, xs, ctx):
-        import jax.numpy as jnp
         idx = xs[0].astype(jnp.int32)
         return jnp.take(params["table"], idx, axis=0), state
 
