@@ -301,6 +301,56 @@ class MeanDispUnit : public Unit {  // (x - mean) * rdisp
 };
 
 // ---------------------------------------------------------------------------
+class EmbeddingUnit : public Unit {  // token table lookup (B, T) -> (B,T,E)
+ public:
+  npy::Array table;  // (vocab, dim)
+
+  Shape OutputShape(const std::vector<Shape>& in) const override {
+    Shape s = in[0];
+    s.dims.push_back(table.shape[1]);
+    return s;
+  }
+
+  void Run(const std::vector<const Tensor*>& in, Tensor* out,
+           UnitContext* ctx) const override {
+    const Tensor& x = *in[0];
+    int64_t n = x.size(), V = table.shape[0], E = table.shape[1];
+    ctx->pool->ParallelFor(n, [&](int64_t rb, int64_t re) {
+      for (int64_t r = rb; r < re; r++) {
+        int64_t idx = static_cast<int64_t>(x.data[r]);
+        if (idx < 0 || idx >= V)
+          throw std::runtime_error(name + ": token id out of range");
+        const float* row = table.data.data() + idx * E;
+        float* yr = out->data + r * E;
+        for (int64_t i = 0; i < E; i++) yr[i] = row[i];
+      }
+    });
+  }
+};
+
+// ---------------------------------------------------------------------------
+class SeqLastUnit : public Unit {  // (B, T, ...) -> (B, ...)
+ public:
+  Shape OutputShape(const std::vector<Shape>& in) const override {
+    Shape s;
+    s.dims.push_back(in[0][0]);
+    for (size_t i = 2; i < in[0].rank(); i++) s.dims.push_back(in[0][i]);
+    return s;
+  }
+
+  void Run(const std::vector<const Tensor*>& in, Tensor* out,
+           UnitContext*) const override {
+    const Tensor& x = *in[0];
+    int64_t B = x.shape[0], T = x.shape[1];
+    int64_t rest = x.size() / (B * T);
+    for (int64_t b = 0; b < B; b++)
+      std::copy(x.data + ((b * T) + T - 1) * rest,
+                x.data + ((b * T) + T) * rest,
+                out->data + b * rest);
+  }
+};
+
+// ---------------------------------------------------------------------------
 class LayerNormUnit : public Unit {  // LayerNorm over the feature axis
  public:
   float eps = 1e-5f;
@@ -315,7 +365,7 @@ class LayerNormUnit : public Unit {  // LayerNorm over the feature axis
     const Tensor& x = *in[0];
     int64_t d = x.shape[x.shape.rank() - 1];
     int64_t rows = x.size() / d;
-    if (d != scale.size())
+    if (d != scale.size() || d != shift.size())
       throw std::runtime_error(name + ": feature dim mismatch");
     ctx->pool->ParallelFor(rows, [&](int64_t rb, int64_t re) {
       for (int64_t r = rb; r < re; r++) {
@@ -628,6 +678,14 @@ inline UnitPtr CreateUnit(const std::string& klass,
     return u;
   }
   if (klass == "EvaluatorSoftmax") return std::make_unique<SoftmaxUnit>();
+  if (klass == "Embedding") {
+    auto u = std::make_unique<EmbeddingUnit>();
+    if (!weights->count("table"))
+      throw std::runtime_error("Embedding missing weight table");
+    u->table = std::move((*weights)["table"]);
+    return u;
+  }
+  if (klass == "SeqLast") return std::make_unique<SeqLastUnit>();
   if (klass == "LayerNorm") {
     auto u = std::make_unique<LayerNormUnit>();
     u->eps = static_cast<float>(config.number("eps", 1e-5));

@@ -222,3 +222,35 @@ def test_cpp_attention_matches_jax(binary, tmp_path, rng, rope):
     predict = wf.make_predict_step("out")
     ref = np.asarray(predict(ws, {"@input": jnp.asarray(x)}))
     np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-4)
+
+
+def test_cpp_sequence_model_matches_jax(binary, tmp_path, rng):
+    """The full sequence family serves natively: embedding -> residual
+    RoPE attention -> layer_norm -> seq_last -> softmax."""
+    wf = build_workflow("seq_serve", [
+        {"type": "embedding", "vocab": 12, "dim": 16, "name": "emb"},
+        {"type": "attention", "n_heads": 2, "rope": True,
+         "residual": True, "name": "attn"},
+        {"type": "layer_norm", "name": "norm"},
+        {"type": "seq_last", "name": "last"},
+        {"type": "softmax", "output_size": 12, "name": "out"},
+    ])
+    wf.build({"@input": vt.Spec((3, 20), jnp.int32),
+              "@labels": vt.Spec((3,), jnp.int32),
+              "@mask": vt.Spec((3,), jnp.float32)})
+    o = opt.SGD(0.01)
+    ws = wf.init_state(jax.random.key(11), o)
+    pkg = str(tmp_path / "seq_pkg")
+    export_package(wf, ws, pkg,
+                   input_spec={"shape": [3, 20], "dtype": "float32"})
+    x = rng.integers(0, 12, (3, 20)).astype(np.float32)
+    np.save(tmp_path / "sx.npy", x)
+    r = subprocess.run(
+        [binary, pkg, str(tmp_path / "sx.npy"), str(tmp_path / "sy.npy"),
+         "--output-unit", "out"],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    got = np.load(tmp_path / "sy.npy")
+    predict = wf.make_predict_step("out")
+    ref = np.asarray(predict(ws, {"@input": jnp.asarray(x, jnp.int32)}))
+    np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-4)
