@@ -271,3 +271,25 @@ def test_layer_norm_unit(rng):
     y = np.asarray(fwd(ws, {"@input": x}))
     np.testing.assert_allclose(y.mean(-1), 0.0, atol=1e-5)
     np.testing.assert_allclose(y.std(-1), 1.0, atol=1e-3)
+
+
+def test_evaluator_softmax_sequence_form(rng):
+    """(B, T, V) logits + (B, T) labels: per-position CE with the
+    per-sample mask broadcast across positions."""
+    from veles_tpu.ops import softmax_cross_entropy
+    from veles_tpu.units.nn import EvaluatorSoftmax
+    B, T, V = 3, 5, 7
+    logits = jnp.asarray(rng.standard_normal((B, T, V)), jnp.float32)
+    labels = jnp.asarray(rng.integers(0, V, (B, T)), jnp.int32)
+    mask = jnp.asarray([1.0, 1.0, 0.0])
+    ev = EvaluatorSoftmax()
+    mets = ev.metrics(None, None, (logits, labels, mask), None)
+    assert float(mets["n_samples"]) == 2 * T
+    # reference: masked mean over the first two samples' positions
+    ref = 0.0
+    for b in range(2):
+        for t in range(T):
+            lp = jax.nn.log_softmax(logits[b, t])
+            ref -= float(lp[labels[b, t]])
+    np.testing.assert_allclose(float(mets["loss"]), ref / (2 * T),
+                               rtol=1e-5)

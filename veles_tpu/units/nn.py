@@ -422,7 +422,11 @@ class EvaluatorSoftmax(Evaluator):
     """Softmax cross-entropy over logits + integer labels
     (reference 'evaluator' for classification). An optional third input
     "@mask" (loader-provided, 1.0 per real sample) keeps metrics exact with
-    padded fixed-shape batches."""
+    padded fixed-shape batches.
+
+    Sequence form: logits (B, T, V) with labels (B, T) compute the
+    per-position loss (next-token LM training); the per-sample mask
+    broadcasts across positions and metrics count positions."""
 
     def __init__(self, name=None, inputs=("@input", "@labels", "@mask")):
         super().__init__(name, inputs)
@@ -432,7 +436,13 @@ class EvaluatorSoftmax(Evaluator):
 
     @staticmethod
     def _mask(xs):
-        return xs[2] if len(xs) > 2 else None
+        m = xs[2] if len(xs) > 2 else None
+        labels = xs[1]
+        if m is not None and m.ndim < labels.ndim:
+            m = jnp.broadcast_to(
+                m.reshape(m.shape + (1,) * (labels.ndim - m.ndim)),
+                labels.shape)
+        return m
 
     def apply(self, params, state, xs, ctx):
         loss, _ = ops.softmax_cross_entropy(xs[0], xs[1], mask=self._mask(xs))
@@ -442,7 +452,7 @@ class EvaluatorSoftmax(Evaluator):
         mask = self._mask(xs)
         loss, n_err = ops.softmax_cross_entropy(xs[0], xs[1], mask=mask)
         n = mask.sum() if mask is not None else jnp.asarray(
-            xs[0].shape[0], jnp.float32)
+            float(np.prod(xs[1].shape)), jnp.float32)
         return {"loss": loss, "n_err": n_err, "n_samples": n}
 
 
