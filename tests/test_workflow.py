@@ -276,7 +276,6 @@ def test_layer_norm_unit(rng):
 def test_evaluator_softmax_sequence_form(rng):
     """(B, T, V) logits + (B, T) labels: per-position CE with the
     per-sample mask broadcast across positions."""
-    from veles_tpu.ops import softmax_cross_entropy
     from veles_tpu.units.nn import EvaluatorSoftmax
     B, T, V = 3, 5, 7
     logits = jnp.asarray(rng.standard_normal((B, T, V)), jnp.float32)
