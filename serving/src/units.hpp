@@ -43,16 +43,23 @@ class DenseUnit : public Unit {  // All2All* (reference Znicz all2all)
   std::string activation;
   npy::Array w, b;
   bool has_bias = false;
+  bool per_position = false;  // project trailing axis only (LM heads)
 
   Shape OutputShape(const std::vector<Shape>& in) const override {
+    if (per_position) {
+      Shape s = in[0];
+      s.dims.back() = output_size;
+      return s;
+    }
     return Shape{{in[0][0], output_size}};
   }
 
   void Run(const std::vector<const Tensor*>& in, Tensor* out,
            UnitContext* ctx) const override {
     const Tensor& x = *in[0];
-    int64_t batch = x.shape[0];
-    int64_t fin = x.size() / batch;
+    int64_t fin_pp = x.shape[x.shape.rank() - 1];
+    int64_t batch = per_position ? x.size() / fin_pp : x.shape[0];
+    int64_t fin = per_position ? fin_pp : x.size() / batch;
     int64_t fout = output_size;
     if (fin != w.shape[0])
       throw std::runtime_error(
@@ -582,6 +589,11 @@ inline UnitPtr CreateUnit(const std::string& klass,
     auto u = std::make_unique<DenseUnit>();
     u->output_size = static_cast<int64_t>(config.number("output_size", 0));
     u->activation = get_act();
+    if (config.has("per_position")) {
+      const auto& pv = config.at("per_position");
+      u->per_position = pv.type == json::Value::Type::Bool
+                            ? pv.b : pv.num != 0.0;
+    }
     if (weights->count("w")) u->w = std::move((*weights)["w"]);
     if (weights->count("b")) {
       u->b = std::move((*weights)["b"]);

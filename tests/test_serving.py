@@ -232,6 +232,8 @@ def test_cpp_sequence_model_matches_jax(binary, tmp_path, rng):
         {"type": "attention", "n_heads": 2, "rope": True,
          "residual": True, "name": "attn"},
         {"type": "layer_norm", "name": "norm"},
+        {"type": "all2all", "output_size": 16, "per_position": True,
+         "name": "head"},
         {"type": "seq_last", "name": "last"},
         {"type": "softmax", "output_size": 12, "name": "out"},
     ])

@@ -26,11 +26,16 @@ from ..units.workflow import Workflow
 #: Exportable unit types and the constructor fields the native runtime
 #: needs. Units not listed fall back to their public scalar attrs.
 _EXPORT_FIELDS = {
-    "All2All": ("output_size", "activation", "include_bias"),
-    "All2AllTanh": ("output_size", "activation", "include_bias"),
-    "All2AllRELU": ("output_size", "activation", "include_bias"),
-    "All2AllSincos": ("output_size", "activation", "include_bias"),
-    "All2AllSoftmax": ("output_size", "activation", "include_bias"),
+    "All2All": ("output_size", "activation", "include_bias",
+                "per_position"),
+    "All2AllTanh": ("output_size", "activation", "include_bias",
+                "per_position"),
+    "All2AllRELU": ("output_size", "activation", "include_bias",
+                "per_position"),
+    "All2AllSincos": ("output_size", "activation", "include_bias",
+                "per_position"),
+    "All2AllSoftmax": ("output_size", "activation", "include_bias",
+                "per_position"),
     "Conv": ("n_kernels", "kx", "ky", "stride", "padding", "activation"),
     "ConvRELU": ("n_kernels", "kx", "ky", "stride", "padding",
                  "activation"),

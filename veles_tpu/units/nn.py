@@ -33,20 +33,30 @@ class All2All(Forward):
 
     def __init__(self, output_size: int, *, activation: str = "linear",
                  weights_scale: float = 1.0, include_bias: bool = True,
-                 compute_dtype=None, name=None, inputs=("@input",)):
+                 compute_dtype=None, name=None, inputs=("@input",),
+                 per_position: bool = False):
         super().__init__(name, inputs)
         self.output_size = int(output_size)
         self.activation = activation
         self.weights_scale = weights_scale
         self.include_bias = include_bias
         self.compute_dtype = _cast_policy(compute_dtype)
+        # per_position: project the TRAILING feature axis only, keeping
+        # leading (B, T, ...) dims — e.g. (B, T, E) -> (B, T, V) logits
+        # for the sequence evaluator. Default flattens per sample (the
+        # reference all2all semantics).
+        self.per_position = bool(per_position)
 
     def _in_features(self, in_spec: Spec) -> int:
+        if self.per_position:
+            return int(in_spec.shape[-1])
         return int(np.prod(in_spec.shape[1:]))
 
     def output_spec(self, in_specs):
-        n = in_specs[0].shape[0]
-        return Spec((n, self.output_size), in_specs[0].dtype)
+        s = in_specs[0]
+        if self.per_position:
+            return Spec(tuple(s.shape[:-1]) + (self.output_size,), s.dtype)
+        return Spec((s.shape[0], self.output_size), s.dtype)
 
     def init(self, key, in_specs):
         fan_in = self._in_features(in_specs[0])
@@ -59,9 +69,17 @@ class All2All(Forward):
         return params, {}
 
     def apply(self, params, state, xs, ctx):
-        x = xs[0].reshape(xs[0].shape[0], -1)
+        x = xs[0]
+        if self.per_position:
+            lead = x.shape[:-1]
+            x = x.reshape(-1, x.shape[-1])
+        else:
+            lead = None
+            x = x.reshape(x.shape[0], -1)
         y = ops.dense(x, params["w"], params.get("b"),
                       compute_dtype=self.compute_dtype)
+        if lead is not None:
+            y = y.reshape(lead + (self.output_size,))
         return ACTIVATIONS[self.activation](y), state
 
 
