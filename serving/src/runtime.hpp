@@ -79,6 +79,12 @@ class ThreadPool {
 
   // Run fn(begin, end) over [0, total) split across the pool; the calling
   // thread executes its own share, workers take the rest.
+  //
+  // Exception safety: a throw from any chunk (worker or caller) must not
+  // escape a worker's thread function (std::terminate) nor leave pending_
+  // undrained (deadlock + workers dereferencing a destroyed closure). Every
+  // chunk runs under try/catch; the first exception is captured in eptr_
+  // and rethrown here after ALL chunks have joined.
   void ParallelFor(int64_t total,
                    const std::function<void(int64_t, int64_t)>& fn) {
     if (total <= 0) return;
@@ -98,12 +104,24 @@ class ThreadPool {
       n_parts_ = k;
       pending_ = k - 1;
       generation_++;
+      eptr_ = nullptr;
     }
     cv_.notify_all();
-    fn(0, std::min<int64_t>(total, chunk));  // caller's share
-    std::unique_lock<std::mutex> lk(mu_);
-    done_cv_.wait(lk, [this] { return pending_ == 0; });
-    task_ = nullptr;
+    try {
+      fn(0, std::min<int64_t>(total, chunk));  // caller's share
+    } catch (...) {
+      std::unique_lock<std::mutex> lk(mu_);
+      if (!eptr_) eptr_ = std::current_exception();
+    }
+    std::exception_ptr eptr;
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      done_cv_.wait(lk, [this] { return pending_ == 0; });
+      task_ = nullptr;
+      eptr = eptr_;
+      eptr_ = nullptr;
+    }
+    if (eptr) std::rethrow_exception(eptr);
   }
 
  private:
@@ -125,7 +143,14 @@ class ThreadPool {
         b = part * task_chunk_;
         e = std::min(task_total_, b + task_chunk_);
       }
-      if (b < e) (*fn)(b, e);
+      if (b < e) {
+        try {
+          (*fn)(b, e);
+        } catch (...) {
+          std::unique_lock<std::mutex> lk(mu_);
+          if (!eptr_) eptr_ = std::current_exception();
+        }
+      }
       {
         std::unique_lock<std::mutex> lk(mu_);
         if (--pending_ == 0) done_cv_.notify_all();
@@ -141,6 +166,7 @@ class ThreadPool {
   int64_t task_total_ = 0, task_chunk_ = 0;
   int next_part_ = 0, n_parts_ = 0, pending_ = 0;
   uint64_t generation_ = 0;
+  std::exception_ptr eptr_;
   bool stop_ = false;
 };
 

@@ -322,11 +322,24 @@ class EmbeddingUnit : public Unit {  // token table lookup (B, T) -> (B,T,E)
            UnitContext* ctx) const override {
     const Tensor& x = *in[0];
     int64_t n = x.size(), V = table.shape[0], E = table.shape[1];
+    // Validate ids serially up front: one pass over ints is cheap, the
+    // error is deterministic (first bad position), and nothing is written
+    // before it fires. ParallelFor also captures+rethrows as a backstop.
+    for (int64_t r = 0; r < n; r++) {
+      // Range-check as float BEFORE the cast: float->int64 conversion of
+      // NaN/inf/out-of-range values is UB, so the comparison must reject
+      // them while still in the float domain (V fits exactly in a float's
+      // integer range for any realistic vocab).
+      float v = x.data[r];
+      if (!(v >= 0.0f) || v >= static_cast<float>(V))
+        throw std::runtime_error(
+            name + ": token id " + std::to_string(v) + " at position " +
+            std::to_string(r) + " out of range [0, " + std::to_string(V) +
+            ")");
+    }
     ctx->pool->ParallelFor(n, [&](int64_t rb, int64_t re) {
       for (int64_t r = rb; r < re; r++) {
         int64_t idx = static_cast<int64_t>(x.data[r]);
-        if (idx < 0 || idx >= V)
-          throw std::runtime_error(name + ": token id out of range");
         const float* row = table.data.data() + idx * E;
         float* yr = out->data + r * E;
         for (int64_t i = 0; i < E; i++) yr[i] = row[i];

@@ -256,3 +256,30 @@ def test_cpp_sequence_model_matches_jax(binary, tmp_path, rng):
     predict = wf.make_predict_step("out")
     ref = np.asarray(predict(ws, {"@input": jnp.asarray(x, jnp.int32)}))
     np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-4)
+
+
+def test_cpp_bad_token_id_clean_error(binary, tmp_path, rng):
+    """A malformed inference input (out-of-range token id) must produce a
+    clean nonzero exit with a diagnostic — not std::terminate / a pool
+    deadlock (the exception used to escape a ParallelFor worker thread)."""
+    wf = build_workflow("bad_tok", [
+        {"type": "embedding", "vocab": 8, "dim": 16, "name": "emb"},
+        {"type": "seq_last", "name": "last"},
+        {"type": "softmax", "output_size": 8, "name": "out"},
+    ])
+    wf.build({"@input": vt.Spec((2, 12), jnp.int32),
+              "@labels": vt.Spec((2,), jnp.int32),
+              "@mask": vt.Spec((2,), jnp.float32)})
+    ws = wf.init_state(jax.random.key(5), opt.SGD(0.01))
+    pkg = str(tmp_path / "bad_tok_pkg")
+    export_package(wf, ws, pkg,
+                   input_spec={"shape": [2, 12], "dtype": "float32"})
+    x = rng.integers(0, 8, (2, 12)).astype(np.float32)
+    x[1, 3] = 99.0  # out of vocab range
+    np.save(tmp_path / "bx.npy", x)
+    r = subprocess.run(
+        [binary, pkg, str(tmp_path / "bx.npy"), str(tmp_path / "by.npy")],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode != 0
+    assert "out of range" in (r.stderr + r.stdout)
+    assert "terminate" not in r.stderr.lower()

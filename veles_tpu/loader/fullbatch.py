@@ -85,10 +85,14 @@ class FullBatchLoader(ArrayLoader):
 
         # The Pallas DMA-gather kernel is TPU-only AND opt-in: measured
         # on-chip (bench_tpu.py, v5e, 512 rows of a 60k x 784 set) XLA's
-        # own gather wins — 0.64 ms vs 0.84 ms — so jnp.take is the
+        # own gather won — 0.64 ms vs 0.84 ms — so jnp.take is the
         # default and the DMA kernel engages only on an explicit
         # ``use_pallas_gather=True`` (kept for parity with
         # ocl/fullbatch_loader.cl and for layouts where take regresses).
+        # PROVISIONAL: that measurement used the pre-optimization_barrier
+        # harness that BASELINE.md says flattered XLA on bandwidth-bound
+        # kernels; the default follows whichever side wins the barrier'd
+        # re-measurement (bench_tpu.py gather row).
         use_pallas = allow_pallas and self._use_pallas_gather is True
         if use_pallas:
             # Per-index HBM→HBM DMA kernel (parity:

@@ -373,6 +373,11 @@ def _1f1b_local(stage_params, x_blk, y_blk, *, apply_local, loss_local,
     loss_acc = loss_acc / bsz
     # the loss lives on the last stage only; share it along the pipe ring
     loss_acc = jax.lax.psum(loss_acc, axis_name) / n_microbatches
+    # grads are accumulated as SUMS over microbatches; rescale to the mean
+    # so (loss, grads) form a consistent pair with the pipeline_apply +
+    # jax.grad path — swapping schedules must not change the effective
+    # learning rate by a factor of n_microbatches.
+    gp_acc = jax.tree.map(lambda g: g / n_microbatches, gp_acc)
     return (jax.tree.map(lambda g: g[None], gp_acc), loss_acc)
 
 
@@ -395,8 +400,10 @@ def pipeline_train_step(stage_fn: Union[Callable, Sequence[Callable]],
     output per microbatch and MUST be a mean (not a sum) over its
     microbatch slice when ``batch_axes`` shards the batch dim — the
     cross-shard reduction rescales by the shard count on that assumption.
-    The returned loss is the mean over microbatches; grads are the sums
-    over microbatches of d(loss_fn per mb)/dparams.  Heterogeneous form
+    The returned loss is the mean over microbatches and the grads are
+    d(that mean)/dparams — the same (loss, grads) contract as
+    ``jax.value_and_grad`` over ``pipeline_apply``, so the two schedules
+    are drop-in interchangeable under one optimizer.  Heterogeneous form
     returns grads as a list of per-stage pytrees matching ``params``.
     """
     S = mesh.shape[axis_name]
