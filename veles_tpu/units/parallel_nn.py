@@ -152,27 +152,107 @@ class MoEFFN(Forward):
 
 
 class PipelineStack(Forward):
-    """A stack of S residual-MLP blocks pipelined over the ``pipe`` mesh
-    axis (GPipe schedule, parallel/pipeline.py).
+    """A stack of S stages pipelined over the ``pipe`` mesh axis.
 
-    With pipe size 1 (or no mesh) the stages run as a sequential scan —
-    the same math, so configs are portable.  The batch is split into
+    Two forms:
+
+    * **Homogeneous (legacy)**: ``PipelineStack(n_stages, d_hidden)`` — S
+      identical residual-MLP blocks, params stage-stacked ``(S, ...)`` and
+      sharded ``P('pipe')``.
+    * **Config stages (round-3)**: ``PipelineStack(stages=[[cfg, ...],
+      ...])`` — each stage is an arbitrary layer-config sublist (e.g. an
+      attention block ``[{"type": "attention", "residual": True}, {"type":
+      "layer_norm"}]``), resolved through ``models.standard.LAYER_TYPES``.
+      Stages may differ (the heterogeneous ravel+switch machinery of
+      ``parallel/pipeline.py`` handles mixed param structures); every
+      stage must PRESERVE the activation shape/dtype — that is what
+      physically rides the pipeline ring.
+
+    With pipe size 1 (or no mesh) stages run sequentially — the same
+    math, so configs are portable.  Under ``Workflow.make_pipeline_
+    train_step`` the stack trains on the fused 1F1B schedule; under plain
+    AD it forwards on the GPipe schedule.  The batch is split into
     microbatches along axis 0; batch size must divide evenly.
     """
 
-    def __init__(self, n_stages: int, d_hidden: int, name=None,
+    def __init__(self, n_stages: Optional[int] = None,
+                 d_hidden: Optional[int] = None, name=None,
                  inputs=("@input",), *, pipe_axis: str = "pipe",
-                 n_microbatches: Optional[int] = None):
+                 n_microbatches: Optional[int] = None,
+                 stages: Optional[Sequence[Sequence[dict]]] = None):
         super().__init__(name, inputs)
-        self.n_stages = int(n_stages)
-        self.d_hidden = int(d_hidden)
         self.pipe_axis = pipe_axis
         self.n_microbatches = n_microbatches
+        self.stages_cfg = stages
+        if stages is not None:
+            self.n_stages = len(stages)
+            self.d_hidden = None
+            self._stage_units = [self._build_stage_units(i, cfg)
+                                 for i, cfg in enumerate(stages)]
+        else:
+            if n_stages is None or d_hidden is None:
+                raise ValueError(
+                    "PipelineStack needs (n_stages, d_hidden) or stages=")
+            self.n_stages = int(n_stages)
+            self.d_hidden = int(d_hidden)
+            self._stage_units = None
+
+    @staticmethod
+    def _build_stage_units(i: int, cfg: Sequence[dict]):
+        # Lazy import: models.standard imports this module at load time;
+        # by the time a stack is instantiated the registry exists.
+        from ..models.standard import LAYER_TYPES
+        units = []
+        for j, spec in enumerate(cfg):
+            spec = dict(spec)
+            ltype = spec.pop("type")
+            lname = spec.pop("name", f"s{i}u{j}_{ltype}")
+            u = LAYER_TYPES[ltype](name=lname, inputs=("@x",), **spec)
+            if getattr(u, "stochastic", False):
+                # Inside a stage body there is no per-microbatch RNG: the
+                # fused path has no key at all and the GPipe path would
+                # reuse one key across microbatches (diverging from the
+                # sequential pipe=1 fallback).
+                raise ValueError(
+                    f"stochastic unit {lname!r} ({ltype}) inside a "
+                    "pipeline stage is unsupported")
+            units.append(u)
+        return units
 
     def output_spec(self, in_specs):
+        if self._stage_units is not None:
+            spec = in_specs[0]
+            for i, units in enumerate(self._stage_units):
+                s = spec
+                for u in units:
+                    s = u.output_spec([s])
+                if (tuple(s.shape), s.dtype) != (tuple(spec.shape),
+                                                 spec.dtype):
+                    raise ValueError(
+                        f"pipeline stage {i} must preserve the activation "
+                        f"spec {tuple(spec.shape)}/{spec.dtype} (it rides "
+                        f"the ring), got {tuple(s.shape)}/{s.dtype}")
         return in_specs[0]
 
     def init(self, key, in_specs):
+        if self._stage_units is not None:
+            params = {}
+            keys = jax.random.split(key, self.n_stages)
+            for i, (units, k) in enumerate(zip(self._stage_units, keys)):
+                spec = in_specs[0]
+                sp, uks = {}, jax.random.split(k, max(len(units), 1))
+                for u, uk in zip(units, uks):
+                    p, s = u.init(uk, [spec])
+                    if s:
+                        raise ValueError(
+                            f"stateful unit {u.name!r} inside a pipeline "
+                            "stage is unsupported (stage state does not "
+                            "ride the ring)")
+                    if p:
+                        sp[u.name] = p
+                    spec = u.output_spec([spec])
+                params[f"s{i}"] = sp
+            return params, {}
         E = in_specs[0].shape[-1]
         H = self.d_hidden
         keys = jax.random.split(key, self.n_stages)
@@ -192,17 +272,51 @@ class PipelineStack(Forward):
     def _stage_fn(p, x):
         return x + jax.nn.relu(x @ p["w1"]) @ p["w2"]
 
+    # -- per-stage access (the fused-1F1B compiler's contract,
+    # parallel/pipeline_compile.py) ---------------------------------------
+    def stage_param_slice(self, params, i: int):
+        """Stage i's param pytree, as stage_apply(i, ...) consumes it."""
+        if self._stage_units is not None:
+            return params[f"s{i}"]
+        return {"w1": params["stage_w1"][i], "w2": params["stage_w2"][i]}
+
+    def restack_stage_grads(self, glist):
+        """Inverse of stage_param_slice over a list of per-stage grads."""
+        if self._stage_units is not None:
+            return {f"s{i}": g for i, g in enumerate(glist)}
+        return {"stage_w1": jnp.stack([g["w1"] for g in glist]),
+                "stage_w2": jnp.stack([g["w2"] for g in glist])}
+
+    def stage_apply(self, i: int, p, x, ctx: Context):
+        """Apply stage i's computation to one activation block."""
+        if self._stage_units is not None:
+            for u in self._stage_units[i]:
+                x, _ = u.apply(p.get(u.name, {}), {}, [x], ctx)
+            return x
+        return self._stage_fn(p, x)
+
+    def _inner_ctx(self, ctx: Context) -> Context:
+        # Stage bodies execute inside pipeline_apply's shard_map; a unit
+        # starting its own collective there (ring attention reading
+        # ctx.mesh) would illegally nest shard_maps — so stage units see
+        # mesh=None and use their local formulations.
+        return Context(train=ctx.train, key=ctx.key, mesh=None)
+
     def apply(self, params, state, xs, ctx: Context):
         x = xs[0]
         S = ctx.axis_size(self.pipe_axis)
-        stages = {"w1": params["stage_w1"], "w2": params["stage_w2"]}
-        if S > 1:
-            from ..parallel.pipeline import pipeline_apply
-            n_mb = self.n_microbatches or S
-            B = x.shape[0]
-            if B % n_mb:
+        n_mb = self.n_microbatches or S
+        # An indivisible batch (single-sample predict on a mesh-attached
+        # workflow) falls back to the numerically identical sequential
+        # path instead of erroring — serving a trained pipeline must not
+        # demand microbatchable shapes.
+        if S > 1 and x.shape[0] % n_mb == 0:
+            if S != self.n_stages:
                 raise ValueError(
-                    f"batch {B} not divisible into {n_mb} microbatches")
+                    f"PipelineStack has {self.n_stages} stages but the "
+                    f"{self.pipe_axis!r} mesh axis is {S}")
+            from ..parallel.pipeline import pipeline_apply
+            B = x.shape[0]
             xm = x.reshape((n_mb, B // n_mb) + x.shape[1:])
             # pick the batch-axis subset with the LARGEST dividing product
             # (a fixed greedy order could choose data=2 over fsdp=4)
@@ -216,10 +330,27 @@ class PipelineStack(Forward):
                     prod *= ctx.axis_size(a)
                 if mb % prod == 0 and prod > best:
                     best, dp = prod, sub
-            y = pipeline_apply(self._stage_fn, stages, xm, ctx.mesh,
-                               axis_name=self.pipe_axis,
-                               batch_axes=tuple(dp))
+            if self._stage_units is not None:
+                ictx = self._inner_ctx(ctx)
+                fns = [(lambda p, x, _i=i: self.stage_apply(_i, p, x, ictx))
+                       for i in range(self.n_stages)]
+                plist = [params[f"s{i}"] for i in range(self.n_stages)]
+                y = pipeline_apply(fns, plist, xm, ctx.mesh,
+                                   axis_name=self.pipe_axis,
+                                   batch_axes=tuple(dp))
+            else:
+                stages = {"w1": params["stage_w1"],
+                          "w2": params["stage_w2"]}
+                y = pipeline_apply(self._stage_fn, stages, xm, ctx.mesh,
+                                   axis_name=self.pipe_axis,
+                                   batch_axes=tuple(dp))
             return y.reshape(x.shape), state
+        if self._stage_units is not None:
+            for i in range(self.n_stages):
+                x = self.stage_apply(i, params[f"s{i}"], x, ctx)
+            return x, state
+        stages = {"w1": params["stage_w1"], "w2": params["stage_w2"]}
+
         # sequential fallback: scan over the stage axis
         def body(h, p):
             return self._stage_fn(p, h), None

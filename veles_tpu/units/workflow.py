@@ -281,6 +281,26 @@ class Workflow(Logger):
                      donate_argnums=(0,) if donate else ())
         return fn, state_sh, batch_sh
 
+    def make_pipeline_train_step(self, optimizer: Optimizer, mesh,
+                                 wstate, batch_spec, *,
+                                 n_microbatches: int, rule=None,
+                                 batch_axes: Sequence[str] = ("data",
+                                                              "fsdp"),
+                                 donate: bool = True):
+        """Compile the FUSED 1F1B pipeline training step (the model IS the
+        pipeline): pre-units fold into stage 0, post-units + evaluator
+        loss into the last stage, one PipelineStack supplies the stages.
+        Same return contract as :meth:`make_sharded_train_step` —
+        ``(step_fn, state_shardings, batch_shardings)`` — so the Trainer
+        swaps schedules on a config switch.  Backward memory is bounded
+        by pipeline depth, not microbatch count (parallel/pipeline.py).
+        """
+        from ..parallel.pipeline_compile import build_pipeline_step
+        return build_pipeline_step(
+            self, optimizer, mesh, wstate, batch_spec,
+            n_microbatches=n_microbatches, rule=rule,
+            batch_axes=batch_axes, donate=donate)
+
     def make_sharded_eval_step(self, mesh, wstate, batch_spec, *, rule=None):
         from ..parallel.mesh import batch_shardings, state_shardings
         state_sh = state_shardings(wstate, mesh, rule)
@@ -322,7 +342,7 @@ class Workflow(Logger):
         needed = self.ancestors(output_unit)
 
         def step(wstate, batch):
-            ctx = Context(train=False, key=None)
+            ctx = Context(train=False, key=None, mesh=self.mesh)
             outputs, _ = self.forward(wstate["params"], wstate["state"],
                                       batch, ctx, only=needed)
             return outputs[output_unit]
