@@ -240,3 +240,21 @@ def test_config_stack_gpipe_forward_matches_sequential(rng):
     got = np.asarray(pred_pp(jax.device_put(ws, state_sh), batch))
     np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
     wf.mesh = None
+
+
+def test_trainer_rejects_padded_tail_batches(rng):
+    """Fused 1F1B + a loader whose train count doesn't divide the batch
+    size would silently rescale tail-batch loss (all-pad microbatch);
+    the Trainer must reject it up front."""
+    from veles_tpu.loader.base import TRAIN, VALID
+    S, T, V = 4, 8, 12
+    cfg = dict(_seq_config(S, T, V), max_epochs=1)
+    sw = StandardWorkflow(cfg)
+    rng2 = np.random.default_rng(1)
+    x = rng2.integers(0, V, (60, T)).astype(np.int32)  # 60 % 16 != 0
+    loader = vt.ArrayLoader({TRAIN: x}, {TRAIN: x[:, -1].astype(np.int32)},
+                            minibatch_size=16)
+    mesh = make_mesh(MeshSpec(data=2, pipe=S))
+    trainer = sw.make_trainer(loader, mesh=mesh)
+    with pytest.raises(ValueError, match="full batches"):
+        trainer.initialize(seed=0)

@@ -55,6 +55,12 @@ LAYER_TYPES = {
 }
 
 
+# layer-type prefixes that take a compute_dtype kwarg (the MXU-bf16
+# switch); shared with PipelineStack's stage-config builder
+COMPUTE_DTYPE_TYPES = ("all2all", "softmax", "conv", "deconv", "rnn",
+                       "gru", "lstm", "attention")
+
+
 def build_workflow(name: str, layers: Sequence[dict], *,
                    loss: str = "softmax",
                    compute_dtype: Optional[str] = None) -> Workflow:
@@ -73,8 +79,9 @@ def build_workflow(name: str, layers: Sequence[dict], *,
         lname = spec.pop("name", f"l{i}_{ltype}")
         klass = LAYER_TYPES[ltype]
         if compute_dtype is not None and ltype.startswith(
-                ("all2all", "softmax", "conv", "deconv", "rnn", "gru",
-                 "lstm", "attention")):
+                COMPUTE_DTYPE_TYPES + ("pipeline_stack",)):
+            # pipeline_stack forwards compute_dtype into its stage
+            # sublists (only to unit types that take it)
             spec.setdefault("compute_dtype", compute_dtype)
         unit = klass(name=lname, inputs=(prev,), **spec)
         wf.add(unit)

@@ -2,9 +2,21 @@
 
 NOT in the reference (SURVEY.md §2.5 item 4) — new TPU-native design. The
 expert FFN bank is a batched gemm with a leading expert axis sharded over
-``expert``; top-1 routing with capacity dispatches tokens via one-hot
-einsums (dense dispatch — the XLA-friendly formulation; GSPMD turns the
-dispatch/combine einsums into all_to_all when the expert axis is sharded).
+``expert``.
+
+Two dispatch formulations, same routing semantics (GShard slot priority:
+every token's slot-0 route queues before any slot-1 route; capacity
+overflow drops the weakest routes):
+
+* ``"sort"`` (default, round 3): route queue positions come from a
+  stable argsort by expert id; tokens scatter into their (expert, slot)
+  rows and combine gathers them back.  Peak memory is
+  O(T·K + E·C·D + T·K·D) — no tensor couples T with C, so it scales to
+  real token counts (the round-2 one-hot formulation's (T, K, E, C)
+  slot tensor is O(T²·K/E) at fixed capacity_factor and dominated HBM).
+* ``"dense"`` (round 2): one-hot einsum dispatch — kept because its
+  dispatch/combine einsums are what GSPMD lowers to all_to_all over ICI
+  when the expert axis is sharded, and as the cross-check reference.
 """
 
 from __future__ import annotations
@@ -32,8 +44,28 @@ def init_moe_params(key, n_experts: int, d_model: int, d_hidden: int,
     }
 
 
+def _route_positions(topi: jnp.ndarray, E: int) -> jnp.ndarray:
+    """Queue position of each (token, slot) route within its expert.
+
+    Routes are ordered slot-major (all slot-0 routes before any slot-1
+    route — GShard priority); the position equals the count of earlier
+    same-expert routes, exactly what the dense formulation's masked
+    cumsum computed, at O(T·K·log) sort cost and O(T·K) memory instead
+    of an O(T·K·E) cumsum tensor."""
+    T, K = topi.shape
+    flat_e = topi.T.reshape(-1)                     # slot-major (K*T,)
+    perm = jnp.argsort(flat_e, stable=True)         # groups by expert,
+    seg = flat_e[perm]                              # priority-stable
+    starts = jnp.searchsorted(seg, jnp.arange(E), side="left")
+    pos_sorted = jnp.arange(T * K, dtype=jnp.int32) \
+        - starts[seg].astype(jnp.int32)
+    pos_flat = jnp.zeros(T * K, jnp.int32).at[perm].set(pos_sorted)
+    return pos_flat.reshape(K, T).T                 # (T, K)
+
+
 def moe_apply(params: dict, x: jnp.ndarray, *,
-              capacity_factor: float = 1.25, top_k: int = 1
+              capacity_factor: float = 1.25, top_k: int = 1,
+              dispatch_mode: str = "sort"
               ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Top-k MoE FFN (round 2: k >= 1 with renormalized combine weights;
     round 1 was top-1 only).
@@ -43,7 +75,9 @@ def moe_apply(params: dict, x: jnp.ndarray, *,
     assignment).  Slot priority is GShard-style: all tokens' first choices
     queue before any second choice, so capacity overflow drops the weakest
     routes first.  Tokens over capacity are dropped (0 contribution for
-    that route).
+    that route).  ``dispatch_mode``: "sort" (scalable scatter/gather,
+    default) or "dense" (one-hot einsums) — identical outputs (tests
+    assert it); see the module docstring for the trade.
     """
     T, D = x.shape
     E = params["router"].shape[1]
@@ -62,34 +96,57 @@ def moe_apply(params: dict, x: jnp.ndarray, *,
         gates = topv / jnp.maximum(
             jnp.sum(topv, axis=-1, keepdims=True), 1e-9)
 
-    onehots = jax.nn.one_hot(topi, E, dtype=x.dtype)  # (T, K, E)
-    # queue positions, slot-major: every token's slot-0 route is queued
-    # before any slot-1 route (GShard priority).  The cumsum runs in f32
-    # regardless of activation dtype — a bf16 cumsum loses integer
-    # exactness past 256 and collides capacity slots.
-    oh_flat = onehots.transpose(1, 0, 2).reshape(K * T, E) \
-        .astype(jnp.float32)
-    pos_flat = jnp.cumsum(oh_flat, axis=0) * oh_flat - 1.0
-    pos = pos_flat.reshape(K, T, E).transpose(1, 0, 2)          # (T, K, E)
-    keep = (pos >= 0) & (pos < C)
-    slot = jax.nn.one_hot(
-        jnp.clip(pos, 0, C - 1).astype(jnp.int32), C,
-        dtype=x.dtype) * keep.astype(x.dtype)[..., None]        # (T,K,E,C)
-    # combine carries the gate weights; dispatch is its 0/1 support
-    combine = jnp.einsum("tk,tkec->tec", gates.astype(x.dtype), slot)
-    dispatch = (combine > 0).astype(x.dtype)
+    if dispatch_mode == "sort":
+        pos = _route_positions(topi, E)              # (T, K)
+        keep = pos < C
+        # dropped routes target the out-of-bounds row E*C; scatter mode
+        # 'drop' discards them. Slot rows are unique (positions are a
+        # per-expert enumeration), so 'add' never accumulates two tokens.
+        slot_idx = jnp.where(keep, topi * C + pos, E * C)
+        xk = jnp.broadcast_to(x[:, None, :], (T, K, D)).reshape(T * K, D)
+        xe = jnp.zeros((E * C, D), x.dtype) \
+            .at[slot_idx.reshape(-1)].add(xk, mode="drop") \
+            .reshape(E, C, D)
+        h = jax.nn.relu(jnp.einsum("ecd,edh->ech", xe, params["w1"],
+                                   preferred_element_type=jnp.float32))
+        ye = jnp.einsum("ech,ehd->ecd", h.astype(x.dtype), params["w2"])
+        yk = ye.reshape(E * C, D)[
+            jnp.clip(slot_idx, 0, E * C - 1).reshape(-1)] \
+            .reshape(T, K, D)
+        w = (gates * keep.astype(gates.dtype)).astype(x.dtype)
+        y = jnp.einsum("tk,tkd->td", w, yk)
+    elif dispatch_mode == "dense":
+        onehots = jax.nn.one_hot(topi, E, dtype=x.dtype)  # (T, K, E)
+        # queue positions, slot-major (GShard priority).  The cumsum runs
+        # in f32 regardless of activation dtype — a bf16 cumsum loses
+        # integer exactness past 256 and collides capacity slots.
+        oh_flat = onehots.transpose(1, 0, 2).reshape(K * T, E) \
+            .astype(jnp.float32)
+        pos_flat = jnp.cumsum(oh_flat, axis=0) * oh_flat - 1.0
+        pos = pos_flat.reshape(K, T, E).transpose(1, 0, 2)    # (T, K, E)
+        keep = (pos >= 0) & (pos < C)
+        slot = jax.nn.one_hot(
+            jnp.clip(pos, 0, C - 1).astype(jnp.int32), C,
+            dtype=x.dtype) * keep.astype(x.dtype)[..., None]  # (T,K,E,C)
+        # combine carries the gate weights; dispatch is its 0/1 support
+        combine = jnp.einsum("tk,tkec->tec", gates.astype(x.dtype), slot)
+        dispatch = (combine > 0).astype(x.dtype)
 
-    # dispatch -> (E, C, D): with expert axis sharded, GSPMD lowers this
-    # to an all_to_all over ICI
-    xe = jnp.einsum("tec,td->ecd", dispatch, x)
-    h = jax.nn.relu(jnp.einsum("ecd,edh->ech", xe, params["w1"],
-                               preferred_element_type=jnp.float32))
-    ye = jnp.einsum("ech,ehd->ecd", h.astype(x.dtype), params["w2"])
-    y = jnp.einsum("tec,ecd->td", combine, ye)
+        # dispatch -> (E, C, D): with expert axis sharded, GSPMD lowers
+        # this to an all_to_all over ICI
+        xe = jnp.einsum("tec,td->ecd", dispatch, x)
+        h = jax.nn.relu(jnp.einsum("ecd,edh->ech", xe, params["w1"],
+                                   preferred_element_type=jnp.float32))
+        ye = jnp.einsum("ech,ehd->ecd", h.astype(x.dtype), params["w2"])
+        y = jnp.einsum("tec,ecd->td", combine, ye)
+    else:
+        raise ValueError(f"unknown dispatch_mode {dispatch_mode!r}")
 
-    # Switch load-balance loss on the primary assignment
-    frac_tokens = jnp.mean(onehots[:, 0, :], axis=0)
-    frac_probs = jnp.mean(probs, axis=0)
+    # Switch load-balance loss on the primary assignment (bincount form:
+    # no (T, E) one-hot materialization)
+    frac_tokens = jnp.zeros(E, jnp.float32) \
+        .at[topi[:, 0]].add(1.0) / T
+    frac_probs = jnp.mean(probs.astype(jnp.float32), axis=0)
     aux = E * jnp.sum(frac_tokens * frac_probs)
     return y, aux
 

@@ -257,6 +257,24 @@ def _stage_spec(a, axis_name: str) -> P:
     return P(axis_name, *([None] * (a.ndim - 1)))
 
 
+def pick_batch_axes(axis_sizes: dict, mb: int,
+                    candidates: Sequence[str] = ("data", "fsdp")
+                    ) -> Tuple[str, ...]:
+    """The candidate-axis SUBSET with the largest product dividing ``mb``
+    (per-axis checks would accept data=2 AND fsdp=2 for mb=2 — an
+    impossible 4-way shard of 2 samples; a fixed greedy order could pick
+    data=2 over fsdp=4).  Shared by PipelineStack.apply and the fused
+    1F1B compiler so both schedules shard a model identically."""
+    cands = [a for a in candidates if axis_sizes.get(a, 1) > 1]
+    best, picked = 1, ()
+    for pick in range(1 << len(cands)):
+        sub = tuple(a for i, a in enumerate(cands) if pick >> i & 1)
+        prod = math.prod(axis_sizes[a] for a in sub) if sub else 1
+        if mb % prod == 0 and prod > best:
+            best, picked = prod, sub
+    return picked
+
+
 # ---------------------------------------------------------------------------
 # 1F1B fused train step
 # ---------------------------------------------------------------------------

@@ -268,9 +268,9 @@ def build_pipeline_step(wf, optimizer, mesh, wstate, batch_spec, *,
     Loss/grad semantics match the AD path: loss is the mean of the
     evaluator's per-microbatch losses; grads differentiate that mean
     (``pipeline.py`` rescales the 1F1B sums).  With a non-uniform @mask
-    the mean-of-means differs from the global masked mean — masks must be
-    uniform across microbatches (full batches), which the fullbatch
-    loaders guarantee for training classes.
+    the mean-of-means differs from the global masked mean — every train
+    batch must be FULL (uniform mask); the Trainer rejects loaders whose
+    train count does not divide by the batch size before routing here.
     """
     from .mesh import batch_shardings, state_shardings
     from .pipeline import pipeline_train_step
@@ -293,17 +293,9 @@ def build_pipeline_step(wf, optimizer, mesh, wstate, batch_spec, *,
     ctx = Context(train=True, key=None, mesh=None)
     stage_fns = plan.stage_fns(ctx)
     loss_fn = plan.loss_fn(ctx)
-    # Keep the batch-axis SUBSET with the largest product that still
-    # divides the microbatch (per-axis checks would accept data=2 AND
-    # fsdp=2 for mb=2, an impossible 4-way shard of 2 samples).
-    cands = [a for a in batch_axes
-             if a in mesh.shape and mesh.shape[a] > 1]
-    best, baxes = 1, ()
-    for pick in range(1 << len(cands)):
-        sub = tuple(a for i, a in enumerate(cands) if pick >> i & 1)
-        prod = math.prod(mesh.shape[a] for a in sub) if sub else 1
-        if plan.mb % prod == 0 and prod > best:
-            best, baxes = prod, sub
+    from .pipeline import pick_batch_axes
+    baxes = pick_batch_axes(dict(mesh.shape), plan.mb,
+                            candidates=batch_axes)
     state_sh = state_shardings(wstate, mesh, rule)
     batch_sh = batch_shardings(batch_spec, mesh)
     wf.mesh = mesh

@@ -124,13 +124,17 @@ class MoEFFN(Forward):
 
     def __init__(self, n_experts: int, d_hidden: int, name=None,
                  inputs=("@input",), *, top_k: int = 2,
-                 capacity_factor: float = 1.25, aux_weight: float = 0.01):
+                 capacity_factor: float = 1.25, aux_weight: float = 0.01,
+                 dispatch_mode: str = "sort"):
         super().__init__(name, inputs)
         self.n_experts = int(n_experts)
         self.d_hidden = int(d_hidden)
         self.top_k = int(top_k)
         self.capacity_factor = float(capacity_factor)
         self.aux_weight = float(aux_weight)
+        # "sort" (scalable scatter/gather) or "dense" (one-hot einsums);
+        # see parallel/moe.py module docstring
+        self.dispatch_mode = dispatch_mode
 
     def output_spec(self, in_specs):
         return in_specs[0]
@@ -146,7 +150,8 @@ class MoEFFN(Forward):
         x = xs[0]
         flat = x.reshape(-1, x.shape[-1])
         y, aux = moe_apply(params, flat, top_k=self.top_k,
-                           capacity_factor=self.capacity_factor)
+                           capacity_factor=self.capacity_factor,
+                           dispatch_mode=self.dispatch_mode)
         return (y.reshape(x.shape),
                 {"aux_loss": aux.astype(jnp.float32)})
 
@@ -179,7 +184,8 @@ class PipelineStack(Forward):
                  d_hidden: Optional[int] = None, name=None,
                  inputs=("@input",), *, pipe_axis: str = "pipe",
                  n_microbatches: Optional[int] = None,
-                 stages: Optional[Sequence[Sequence[dict]]] = None):
+                 stages: Optional[Sequence[Sequence[dict]]] = None,
+                 compute_dtype=None):
         super().__init__(name, inputs)
         self.pipe_axis = pipe_axis
         self.n_microbatches = n_microbatches
@@ -187,8 +193,9 @@ class PipelineStack(Forward):
         if stages is not None:
             self.n_stages = len(stages)
             self.d_hidden = None
-            self._stage_units = [self._build_stage_units(i, cfg)
-                                 for i, cfg in enumerate(stages)]
+            self._stage_units = [
+                self._build_stage_units(i, cfg, compute_dtype)
+                for i, cfg in enumerate(stages)]
         else:
             if n_stages is None or d_hidden is None:
                 raise ValueError(
@@ -198,15 +205,26 @@ class PipelineStack(Forward):
             self._stage_units = None
 
     @staticmethod
-    def _build_stage_units(i: int, cfg: Sequence[dict]):
+    def _build_stage_units(i: int, cfg: Sequence[dict], compute_dtype):
         # Lazy import: models.standard imports this module at load time;
         # by the time a stack is instantiated the registry exists.
-        from ..models.standard import LAYER_TYPES
+        from ..models.standard import COMPUTE_DTYPE_TYPES, LAYER_TYPES
         units = []
         for j, spec in enumerate(cfg):
             spec = dict(spec)
             ltype = spec.pop("type")
             lname = spec.pop("name", f"s{i}u{j}_{ltype}")
+            if "hyperparams" in spec:
+                # per-layer optimizer hyperparams key on unit names; the
+                # stack is ONE unit, so they cannot reach the optimizer
+                # table — reject instead of silently dropping them
+                raise ValueError(
+                    f"per-layer 'hyperparams' on {lname!r} are not "
+                    "supported inside pipeline stages (the stack is one "
+                    "optimizer unit); set them on the stack's unit name")
+            if compute_dtype is not None and ltype.startswith(
+                    COMPUTE_DTYPE_TYPES):
+                spec.setdefault("compute_dtype", compute_dtype)
             u = LAYER_TYPES[ltype](name=lname, inputs=("@x",), **spec)
             if getattr(u, "stochastic", False):
                 # Inside a stage body there is no per-microbatch RNG: the
@@ -306,30 +324,26 @@ class PipelineStack(Forward):
         x = xs[0]
         S = ctx.axis_size(self.pipe_axis)
         n_mb = self.n_microbatches or S
-        # An indivisible batch (single-sample predict on a mesh-attached
-        # workflow) falls back to the numerically identical sequential
-        # path instead of erroring — serving a trained pipeline must not
-        # demand microbatchable shapes.
-        if S > 1 and x.shape[0] % n_mb == 0:
+        if S > 1:
             if S != self.n_stages:
                 raise ValueError(
                     f"PipelineStack has {self.n_stages} stages but the "
                     f"{self.pipe_axis!r} mesh axis is {S}")
-            from ..parallel.pipeline import pipeline_apply
+            if x.shape[0] % n_mb and ctx.train:
+                # At eval/predict an indivisible batch (single-sample
+                # serving) falls through to the numerically identical
+                # sequential path below; during TRAINING it is a config
+                # error — silently idling the whole pipe axis would be a
+                # large hidden perf cliff.
+                raise ValueError(
+                    f"batch {x.shape[0]} not divisible into {n_mb} "
+                    "microbatches")
+        if S > 1 and x.shape[0] % n_mb == 0:
+            from ..parallel.pipeline import pick_batch_axes, pipeline_apply
             B = x.shape[0]
             xm = x.reshape((n_mb, B // n_mb) + x.shape[1:])
-            # pick the batch-axis subset with the LARGEST dividing product
-            # (a fixed greedy order could choose data=2 over fsdp=4)
-            mb = B // n_mb
-            cands = [a for a in ("data", "fsdp") if ctx.axis_size(a) > 1]
-            best, dp = 1, []
-            for pick in range(1 << len(cands)):
-                sub = [a for i, a in enumerate(cands) if pick >> i & 1]
-                prod = 1
-                for a in sub:
-                    prod *= ctx.axis_size(a)
-                if mb % prod == 0 and prod > best:
-                    best, dp = prod, sub
+            dp = pick_batch_axes(
+                {a: ctx.axis_size(a) for a in ("data", "fsdp")}, B // n_mb)
             if self._stage_units is not None:
                 ictx = self._inner_ctx(ctx)
                 fns = [(lambda p, x, _i=i: self.stage_apply(_i, p, x, ictx))
