@@ -2,11 +2,14 @@
 //
 // Usage: veles_serve <package_dir> <input.npy> <output.npy>
 //          [--output-unit NAME] [--threads N] [--repeat N]
+//          [--generate N]
 //
 // Counterpart of the reference's libVeles sample flow (reference:
 // libVeles/src/workflow_loader.cc + engine): load package, run DAG on a
 // thread pool, write result. --repeat prints latency stats for serving
-// benchmarks.
+// benchmarks. --generate N decodes N tokens greedily after the prompt in
+// input.npy (sequence-family packages; KV-cached incremental attention)
+// and writes the (B, P+N) token matrix.
 
 #include <chrono>
 #include <cstdio>
@@ -26,7 +29,7 @@ int main(int argc, char** argv) {
   }
   std::string pkg = argv[1], in_path = argv[2], out_path = argv[3];
   std::string output_unit;
-  int threads = 0, repeat = 1;
+  int threads = 0, repeat = 1, generate = 0;
   for (int i = 4; i < argc; i++) {
     if (!std::strcmp(argv[i], "--output-unit") && i + 1 < argc)
       output_unit = argv[++i];
@@ -34,6 +37,8 @@ int main(int argc, char** argv) {
       threads = std::atoi(argv[++i]);
     else if (!std::strcmp(argv[i], "--repeat") && i + 1 < argc)
       repeat = std::max(1, std::atoi(argv[++i]));
+    else if (!std::strcmp(argv[i], "--generate") && i + 1 < argc)
+      generate = std::max(0, std::atoi(argv[++i]));
   }
 
   try {
@@ -45,6 +50,34 @@ int main(int argc, char** argv) {
     input.data = input.storage.data();
 
     veles::ThreadPool pool(threads);
+    if (generate > 0) {
+      if (!output_unit.empty())
+        throw std::runtime_error(
+            "--output-unit is not supported with --generate (decoding "
+            "always samples from the chain's final head)");
+      auto t0 = std::chrono::steady_clock::now();
+      veles::Tensor toks = wf.Generate(input, generate, &pool);
+      auto t1 = std::chrono::steady_clock::now();
+      double ms =
+          std::chrono::duration<double, std::milli>(t1 - t0).count();
+      veles::npy::Save(out_path, toks.shape.dims, toks.data);
+      // positions_per_sec is the raw cached-step rate (prefill + decode);
+      // tokens_per_sec counts NEW tokens only but the wall time includes
+      // prefilling the prompt — same convention as bench_lm.py.
+      long long n_pos = input.shape[1] + generate - 1;
+      std::fprintf(
+          stderr,
+          "{\"workflow\": \"%s\", \"mode\": \"generate\", \"steps\": %d, "
+          "\"total_ms\": %.3f, \"tokens_per_sec\": %.1f, "
+          "\"positions_per_sec\": %.1f, \"threads\": %d, "
+          "\"note\": \"tokens_per_sec counts new tokens; wall time "
+          "includes prompt prefill\"}\n",
+          wf.name.c_str(), generate, ms,
+          generate * input.shape[0] * 1e3 / ms,
+          static_cast<double>(n_pos) * input.shape[0] * 1e3 / ms,
+          pool.size());
+      return 0;
+    }
     veles::Tensor out;
     double best_ms = 1e30, total_ms = 0;
     for (int r = 0; r < repeat; r++) {

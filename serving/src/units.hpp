@@ -561,6 +561,114 @@ class AttentionUnit : public Unit {  // MultiHeadAttention at inference
       }
     });
   }
+
+  // Incremental decode: one query position against a K/V cache —
+  // O(pos) per step instead of the full-T O(T^2) recompute (the
+  // round-2 verdict's "the one thing an LM is for" gap). x: (B, E)
+  // activation at ``pos``; K/V caches are (B, L, Hk, D) row-major,
+  // appended in place; y: (B, E).
+  void DecodeStep(const float* x, float* y, int64_t B, int64_t E,
+                  int64_t pos, int64_t L, std::vector<float>* K,
+                  std::vector<float>* V, ThreadPool* pool) const {
+    int64_t H = n_heads, Hk = n_kv_heads;
+    int64_t D = wq.shape[1] / H, G = H / Hk;
+    float scale = 1.f / std::sqrt(static_cast<float>(D));
+    std::vector<float> Q(B * H * D), Kt(B * Hk * D), Vt(B * Hk * D);
+    auto project = [&](const npy::Array& w, std::vector<float>& dst,
+                       int64_t width) {
+      pool->ParallelFor(B, [&](int64_t rb, int64_t re) {
+        for (int64_t b = rb; b < re; b++) {
+          const float* xr = x + b * E;
+          float* dr = dst.data() + b * width;
+          for (int64_t o = 0; o < width; o++) dr[o] = 0.f;
+          for (int64_t i = 0; i < E; i++) {
+            float xv = xr[i];
+            if (xv == 0.f) continue;
+            const float* wr = w.data.data() + i * width;
+            for (int64_t o = 0; o < width; o++) dr[o] += xv * wr[o];
+          }
+        }
+      });
+    };
+    project(wq, Q, H * D);
+    project(wk, Kt, Hk * D);
+    project(wv, Vt, Hk * D);
+    if (rope) {
+      int64_t half = D / 2;
+      std::vector<float> ct(half), st(half);
+      for (int64_t i = 0; i < half; i++) {
+        float freq = std::pow(10000.f, -static_cast<float>(i) / half);
+        ct[i] = std::cos(static_cast<float>(pos) * freq);
+        st[i] = std::sin(static_cast<float>(pos) * freq);
+      }
+      auto rotate = [&](std::vector<float>& buf, int64_t nh) {
+        for (int64_t r = 0; r < B * nh; r++) {
+          float* row = buf.data() + r * D;
+          for (int64_t i = 0; i < half; i++) {
+            float a = row[2 * i], b2 = row[2 * i + 1];
+            row[2 * i] = a * ct[i] - b2 * st[i];
+            row[2 * i + 1] = a * st[i] + b2 * ct[i];
+          }
+        }
+      };
+      rotate(Q, H);
+      rotate(Kt, Hk);
+    }
+    // append this position's K/V to the caches
+    for (int64_t b = 0; b < B; b++)
+      for (int64_t h = 0; h < Hk; h++)
+        for (int64_t d = 0; d < D; d++) {
+          (*K)[((b * L + pos) * Hk + h) * D + d] =
+              Kt[(b * Hk + h) * D + d];
+          (*V)[((b * L + pos) * Hk + h) * D + d] =
+              Vt[(b * Hk + h) * D + d];
+        }
+    // attend q against cache rows [lo, pos] with online softmax
+    int64_t lo = (window > 0) ? std::max<int64_t>(0, pos - window + 1) : 0;
+    std::vector<float> A(B * H * D);
+    pool->ParallelFor(B * H, [&](int64_t rb, int64_t re) {
+      std::vector<float> acc(D);
+      for (int64_t task = rb; task < re; task++) {
+        int64_t b = task / H, h = task % H, hk = h / G;
+        const float* qr = Q.data() + (b * H + h) * D;
+        float m = -1e30f, l = 0.f;
+        std::fill(acc.begin(), acc.end(), 0.f);
+        for (int64_t j = lo; j <= pos; j++) {
+          const float* kr = K->data() + ((b * L + j) * Hk + hk) * D;
+          float s = 0.f;
+          for (int64_t d = 0; d < D; d++) s += qr[d] * kr[d];
+          s *= scale;
+          if (s > m) {
+            float a = std::exp(m - s);
+            l *= a;
+            for (int64_t d = 0; d < D; d++) acc[d] *= a;
+            m = s;
+          }
+          float p = std::exp(s - m);
+          l += p;
+          const float* vr = V->data() + ((b * L + j) * Hk + hk) * D;
+          for (int64_t d = 0; d < D; d++) acc[d] += p * vr[d];
+        }
+        float* ar = A.data() + (b * H + h) * D;
+        float inv = 1.f / std::max(l, 1e-30f);
+        for (int64_t d = 0; d < D; d++) ar[d] = acc[d] * inv;
+      }
+    });
+    pool->ParallelFor(B, [&](int64_t rb, int64_t re) {
+      for (int64_t b = rb; b < re; b++) {
+        const float* arow = A.data() + b * H * D;
+        const float* xr = x + b * E;
+        float* yr = y + b * E;
+        for (int64_t o = 0; o < E; o++) yr[o] = residual ? xr[o] : 0.f;
+        for (int64_t i = 0; i < H * D; i++) {
+          float av = arow[i];
+          if (av == 0.f) continue;
+          const float* wr = wo.data.data() + i * E;
+          for (int64_t o = 0; o < E; o++) yr[o] += av * wr[o];
+        }
+      }
+    });
+  }
 };
 
 // ---------------------------------------------------------------------------

@@ -137,6 +137,100 @@ class Workflow {
   int64_t arena_bytes() const { return arena_floats_ * 4; }
   size_t n_units() const { return units_.size(); }
 
+  // Autoregressive decode with per-layer KV caches (counterpart of
+  // veles_tpu/runtime/generate.py — greedy only; golden-tested against
+  // the JAX generate()). prompt: (B, P) token ids as floats; returns
+  // (B, P + n_steps) tokens. Every non-attention unit reuses its normal
+  // Run() on (B, 1, ...) single-position tensors; attention units run
+  // DecodeStep against their cache — O(L) per generated token.
+  Tensor Generate(const Tensor& prompt, int n_steps, ThreadPool* pool) {
+    if (prompt.shape.rank() != 2)
+      throw std::runtime_error("generate: prompt must be (batch, time)");
+    int64_t B = prompt.shape[0], P = prompt.shape[1];
+    int64_t L = P + n_steps;
+    if (units_.empty() ||
+        dynamic_cast<EmbeddingUnit*>(units_[0].get()) == nullptr)
+      throw std::runtime_error(
+          "generate: the first unit must be an Embedding (token ids are "
+          "the decode interface)");
+
+    // per-attention-layer caches
+    struct Cache { std::vector<float> k, v; };
+    std::map<const Unit*, Cache> caches;
+    for (const auto& u : units_) {
+      if (auto* a = dynamic_cast<AttentionUnit*>(u.get())) {
+        if (!a->causal)
+          throw std::runtime_error(
+              "generate: attention unit " + u->name + " is non-causal; "
+              "autoregressive decoding requires causal attention "
+              "(mirrors the Python-side check)");
+        int64_t D = a->wq.shape[1] / a->n_heads;
+        caches[u.get()].k.assign(B * L * a->n_kv_heads * D, 0.f);
+        caches[u.get()].v.assign(B * L * a->n_kv_heads * D, 0.f);
+      }
+    }
+
+    // single-position shapes through the chain (validates decodability)
+    std::map<std::string, Shape> shapes;
+    shapes["@input"] = Shape{{B, 1}};
+    std::map<std::string, Tensor> bufs;
+    {
+      Tensor& t = bufs["@input"];
+      t.own(Shape{{B, 1}});
+    }
+    for (const auto& u : units_) {
+      std::vector<Shape> in_shapes;
+      for (const auto& src : u->inputs) {
+        if (!shapes.count(src))
+          throw std::runtime_error("generate: unit " + u->name +
+                                   " needs missing input " + src);
+        in_shapes.push_back(shapes[src]);
+      }
+      Shape os = u->OutputShape(in_shapes);
+      shapes[u->name] = os;
+      bufs[u->name].own(os);
+    }
+    const std::string& head = units_.back()->name;
+    int64_t V = shapes[head].dims.back();
+
+    Tensor toks;
+    toks.own(Shape{{B, L}});
+    for (int64_t b = 0; b < B; b++)
+      for (int64_t t = 0; t < P; t++)
+        toks.data[b * L + t] = prompt.data[b * P + t];
+
+    UnitContext ctx{pool};
+    for (int64_t pos = 0; pos + 1 < L; pos++) {
+      Tensor& xin = bufs["@input"];
+      for (int64_t b = 0; b < B; b++)
+        xin.data[b] = toks.data[b * L + pos];
+      for (const auto& u : units_) {
+        std::vector<const Tensor*> ins;
+        for (const auto& src : u->inputs) ins.push_back(&bufs[src]);
+        Tensor& out = bufs[u->name];
+        if (auto* a = dynamic_cast<AttentionUnit*>(u.get())) {
+          int64_t E = ins[0]->shape.dims.back();
+          Cache& c = caches[u.get()];
+          a->DecodeStep(ins[0]->data, out.data, B, E, pos, L, &c.k,
+                        &c.v, pool);
+        } else {
+          u->Run(ins, &out, &ctx);
+        }
+      }
+      // greedy next token (softmax head preserves the argmax)
+      const Tensor& logits = bufs[head];
+      for (int64_t b = 0; b < B; b++) {
+        if (pos + 1 < P) continue;  // teacher-forced prompt positions
+        const float* row = logits.data + b * V;
+        int64_t best = 0;
+        for (int64_t o = 1; o < V; o++)
+          if (row[o] > row[best]) best = o;
+        toks.data[b * L + pos + 1] = static_cast<float>(best);
+      }
+    }
+    return toks;
+  }
+
  private:
   std::vector<UnitPtr> units_;
   std::vector<float> arena_;

@@ -1,0 +1,168 @@
+"""Autoregressive generation with KV cache (round-2 verdict gap #2):
+greedy decode must reproduce the full-forward argmax at EVERY step, and
+sampling must respect temperature semantics."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import veles_tpu as vt
+from veles_tpu.models.standard import build_workflow
+from veles_tpu.ops import optimizers as opt
+from veles_tpu.runtime.generate import generate
+from veles_tpu.units.workflow import WorkflowError
+
+
+def _build_lm(layers, B, T, V, seed=0):
+    wf = build_workflow("lm", layers)
+    wf.build({"@input": vt.Spec((B, T), jnp.int32),
+              "@labels": vt.Spec((B,), jnp.int32),
+              "@mask": vt.Spec((B,), jnp.float32)})
+    ws = wf.init_state(jax.random.key(seed), opt.SGD(0.1))
+    return wf, ws
+
+
+def _greedy_reference(wf, ws, prompt, n_steps):
+    """Step-by-step full forward: at each step run the WHOLE sequence so
+    far (padded to a fixed length with the model's causal mask making the
+    pad irrelevant is NOT assumed — we rebuild at the true length)."""
+    toks = np.asarray(prompt).copy()
+    B = toks.shape[0]
+    for _ in range(n_steps):
+        T_cur = toks.shape[1]
+        wf2 = build_workflow("lm_ref", wf._layers_cfg)
+        wf2.build({"@input": vt.Spec((B, T_cur), jnp.int32),
+                   "@labels": vt.Spec((B,), jnp.int32),
+                   "@mask": vt.Spec((B,), jnp.float32)})
+        predict = wf2.make_predict_step(jit=True)
+        logits = predict(ws, {"@input": jnp.asarray(toks, jnp.int32)})
+        if logits.ndim == 3:           # per-position head: take last pos
+            logits = logits[:, -1]
+        nxt = np.asarray(jnp.argmax(logits, -1)).astype(np.int32)
+        toks = np.concatenate([toks, nxt[:, None]], axis=1)
+    return toks
+
+
+CASES = {
+    "plain": lambda V: [
+        {"type": "embedding", "vocab": V, "dim": 16, "name": "emb"},
+        {"type": "attention", "n_heads": 2, "rope": True,
+         "residual": True, "name": "a1"},
+        {"type": "layer_norm", "name": "n1"},
+        {"type": "attention", "n_heads": 2, "rope": True,
+         "residual": True, "name": "a2"},
+        {"type": "seq_last", "name": "last"},
+        {"type": "softmax", "output_size": V, "name": "out"},
+    ],
+    "gqa_window": lambda V: [
+        {"type": "embedding", "vocab": V, "dim": 16, "name": "emb"},
+        {"type": "attention", "n_heads": 4, "n_kv_heads": 2,
+         "window": 6, "rope": True, "residual": True, "name": "a1"},
+        {"type": "seq_last", "name": "last"},
+        {"type": "softmax", "output_size": V, "name": "out"},
+    ],
+    "per_position_head": lambda V: [
+        {"type": "embedding", "vocab": V, "dim": 16, "name": "emb"},
+        {"type": "attention", "n_heads": 2, "rope": True,
+         "residual": True, "name": "a1"},
+        {"type": "all2all", "output_size": V, "per_position": True,
+         "name": "head"},
+    ],
+    "pipeline_stack": lambda V: [
+        {"type": "embedding", "vocab": V, "dim": 16, "name": "emb"},
+        {"type": "pipeline_stack", "stages": [
+            [{"type": "attention", "n_heads": 2, "rope": True,
+              "residual": True}, {"type": "layer_norm"}],
+            [{"type": "attention", "n_heads": 2, "rope": True,
+              "residual": True}],
+        ], "name": "stack"},
+        {"type": "seq_last", "name": "last"},
+        {"type": "softmax", "output_size": V, "name": "out"},
+    ],
+}
+
+
+@pytest.mark.parametrize("case", sorted(CASES))
+def test_greedy_decode_matches_full_forward(rng, case):
+    B, P, V, N = 2, 5, 12, 6
+    layers = CASES[case](V)
+    wf, ws = _build_lm(layers, B, P, V, seed=3)
+    wf._layers_cfg = layers  # for the reference rebuild
+    prompt = rng.integers(0, V, (B, P)).astype(np.int32)
+
+    got = np.asarray(generate(wf, ws, prompt, N))
+    ref = _greedy_reference(wf, ws, prompt, N)
+    np.testing.assert_array_equal(got, ref, err_msg=case)
+    np.testing.assert_array_equal(got[:, :P], prompt)
+
+
+def test_temperature_sampling_properties(rng):
+    B, P, V, N = 2, 4, 12, 8
+    layers = CASES["plain"](V)
+    wf, ws = _build_lm(layers, B, P, V)
+    prompt = rng.integers(0, V, (B, P)).astype(np.int32)
+    # near-zero temperature converges to greedy
+    greedy = np.asarray(generate(wf, ws, prompt, N))
+    cold = np.asarray(generate(wf, ws, prompt, N, temperature=1e-4,
+                               key=jax.random.key(1)))
+    np.testing.assert_array_equal(cold, greedy)
+    # hot sampling with different keys gives different continuations
+    h1 = np.asarray(generate(wf, ws, prompt, N, temperature=5.0,
+                             key=jax.random.key(1)))
+    h2 = np.asarray(generate(wf, ws, prompt, N, temperature=5.0,
+                             key=jax.random.key(2)))
+    assert not np.array_equal(h1, h2)
+    # prompts always preserved
+    np.testing.assert_array_equal(h1[:, :P], prompt)
+
+
+def test_generate_rejects_unsupported_chains(rng):
+    B, T, V = 2, 6, 10
+    # no embedding at the front
+    wf = build_workflow("bad", [
+        {"type": "all2all_tanh", "output_size": 16, "name": "fc"},
+        {"type": "softmax", "output_size": V, "name": "out"},
+    ])
+    wf.build({"@input": vt.Spec((B, 8), jnp.float32),
+              "@labels": vt.Spec((B,), jnp.int32),
+              "@mask": vt.Spec((B,), jnp.float32)})
+    ws = wf.init_state(jax.random.key(0), opt.SGD(0.1))
+    with pytest.raises(WorkflowError, match="Embedding"):
+        generate(wf, ws, np.zeros((B, 2), np.int32), 2)
+
+    # non-causal attention cannot decode autoregressively
+    wf2, ws2 = _build_lm([
+        {"type": "embedding", "vocab": V, "dim": 16, "name": "emb"},
+        {"type": "attention", "n_heads": 2, "causal": False,
+         "name": "a1"},
+        {"type": "seq_last", "name": "last"},
+        {"type": "softmax", "output_size": V, "name": "out"},
+    ], B, T, V)
+    with pytest.raises(WorkflowError, match="causal"):
+        generate(wf2, ws2, np.zeros((B, 2), np.int32), 2)
+
+
+def test_decode_cost_is_linear_in_context(rng):
+    """The cached step must not recompute full-T attention: FLOPs per
+    generated token grow ~linearly in context length (cost analysis of
+    the compiled step), not quadratically."""
+    B, V = 1, 16
+    layers = CASES["plain"](V)
+
+    def cost(P):
+        wf, ws = _build_lm(layers, B, P, V)
+        from veles_tpu.runtime.generate import DecodePlan
+        from veles_tpu.units.base import Context
+        plan = DecodePlan(wf)
+        L = P + 1
+        caches = plan.init_caches(ws["params"], B, L, jnp.float32)
+        ctx = Context(train=False, key=None, mesh=None)
+        f = jax.jit(lambda p, c, t: plan.step(
+            p, c, t, jnp.asarray(P - 1), ctx))
+        an = f.lower(ws["params"], caches,
+                     jnp.zeros((B,), jnp.int32)).compile().cost_analysis()
+        return an["flops"]
+
+    c1, c4 = cost(128), cost(512)
+    assert c4 < 5.5 * c1, (c1, c4)  # linear-ish; quadratic would be ~16x

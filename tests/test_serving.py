@@ -283,3 +283,41 @@ def test_cpp_bad_token_id_clean_error(binary, tmp_path, rng):
     assert r.returncode != 0
     assert "out of range" in (r.stderr + r.stdout)
     assert "terminate" not in r.stderr.lower()
+
+
+def test_cpp_generate_matches_jax(binary, tmp_path, rng):
+    """veles_serve --generate: KV-cached greedy decode golden-matches the
+    JAX generate() on an exported sequence model (GQA + RoPE + window +
+    layer_norm + per-position plumbing through seq_last)."""
+    from veles_tpu.runtime.generate import generate
+    V, T, N = 12, 6, 7
+    wf = build_workflow("gen_serve", [
+        {"type": "embedding", "vocab": V, "dim": 16, "name": "emb"},
+        {"type": "attention", "n_heads": 4, "n_kv_heads": 2, "rope": True,
+         "residual": True, "window": 5, "name": "a1"},
+        {"type": "layer_norm", "name": "n1"},
+        {"type": "attention", "n_heads": 2, "rope": True,
+         "residual": True, "name": "a2"},
+        {"type": "seq_last", "name": "last"},
+        {"type": "softmax", "output_size": V, "name": "out"},
+    ])
+    wf.build({"@input": vt.Spec((2, T), jnp.int32),
+              "@labels": vt.Spec((2,), jnp.int32),
+              "@mask": vt.Spec((2,), jnp.float32)})
+    ws = wf.init_state(jax.random.key(21), opt.SGD(0.01))
+    pkg = str(tmp_path / "gen_pkg")
+    export_package(wf, ws, pkg,
+                   input_spec={"shape": [2, T], "dtype": "float32"})
+    prompt = rng.integers(0, V, (2, T)).astype(np.int32)
+    ref = np.asarray(generate(wf, ws, prompt, N))
+
+    np.save(tmp_path / "gp.npy", prompt.astype(np.float32))
+    r = subprocess.run(
+        [binary, pkg, str(tmp_path / "gp.npy"), str(tmp_path / "gt.npy"),
+         "--generate", str(N)],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    got = np.load(tmp_path / "gt.npy").astype(np.int32)
+    stats = json.loads(r.stderr.strip().splitlines()[-1])
+    assert stats["mode"] == "generate" and stats["tokens_per_sec"] > 0
+    np.testing.assert_array_equal(got, ref)

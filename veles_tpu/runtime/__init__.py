@@ -1,3 +1,4 @@
 from .decision import Decision
+from .generate import DecodePlan, generate
 from .snapshotter import Snapshotter, SnapshotterToDB
 from .trainer import Trainer
