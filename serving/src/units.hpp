@@ -702,6 +702,306 @@ class SoftmaxUnit : public Unit {  // EvaluatorSoftmax at inference = probs
 // ---------------------------------------------------------------------------
 // Factory (reference: UnitFactory[uuid] -> instance,
 // libVeles/inc/veles/unit_factory.h).
+// ---------------------------------------------------------------------------
+class RecurrentUnit : public Unit {  // RNN / GRU / LSTM inference
+ public:
+  // Mirrors veles_tpu/ops/recurrent.py: one fused (B, F+H) x (F+H, G*H)
+  // gate matmul per step, f32 carried state. kind: 0=rnn, 1=gru, 2=lstm.
+  int kind = 0;
+  int64_t hidden = 0;
+  bool return_sequences = true;
+  std::string activation = "tanh";  // rnn only: tanh|relu (raw tanh)
+  float forget_bias = 1.f;          // lstm only
+  npy::Array w, b;
+
+  Shape OutputShape(const std::vector<Shape>& in) const override {
+    if (in[0].rank() != 3)
+      throw std::runtime_error(name +
+                               ": recurrent input must be (B, T, F)");
+    if (return_sequences)
+      return Shape{{in[0][0], in[0][1], hidden}};
+    return Shape{{in[0][0], hidden}};
+  }
+
+  void Run(const std::vector<const Tensor*>& in, Tensor* out,
+           UnitContext* ctx) const override {
+    const Tensor& x = *in[0];
+    int64_t B = x.shape[0], T = x.shape[1], F = x.shape[2], H = hidden;
+    int64_t G = kind == 0 ? 1 : (kind == 1 ? 3 : 4);
+    if (w.shape[0] != F + H || w.shape[1] != G * H)
+      throw std::runtime_error(
+          name + ": weight shape mismatch (want (" +
+          std::to_string(F + H) + ", " + std::to_string(G * H) + "))");
+    std::vector<float> h(B * H, 0.f), c(kind == 2 ? B * H : 0, 0.f);
+    std::vector<float> gates(B * G * H);
+    // xh @ w for a column range [g0*H, g1*H) of the fused gate weight
+    auto matmul = [&](const float* xt, const std::vector<float>& hh,
+                      int64_t g0, int64_t g1, float* dst) {
+      int64_t width = (g1 - g0) * H;
+      ctx->pool->ParallelFor(B, [&](int64_t rb, int64_t re) {
+        for (int64_t bi = rb; bi < re; bi++) {
+          float* dr = dst + bi * width;
+          for (int64_t o = 0; o < width; o++) dr[o] = b.data[g0 * H + o];
+          auto fold = [&](const float* row, int64_t n, int64_t woff) {
+            for (int64_t i = 0; i < n; i++) {
+              float xv = row[i];
+              if (xv == 0.f) continue;
+              const float* wr =
+                  w.data.data() + (woff + i) * (G * H) + g0 * H;
+              for (int64_t o = 0; o < width; o++) dr[o] += xv * wr[o];
+            }
+          };
+          fold(xt + bi * F, F, 0);
+          fold(hh.data() + bi * H, H, F);
+        }
+      });
+    };
+    auto sigmoid = [](float v) { return 1.f / (1.f + std::exp(-v)); };
+    std::vector<float> rh(kind == 1 ? B * H : 0);
+    std::vector<float> xslice(B * F);
+    std::vector<float> cand(kind == 1 ? B * H : 0);
+    for (int64_t t = 0; t < T; t++) {
+      // x is (B, T, F) row-major; the matmul expects contiguous (B, F)
+      // rows, so gather the time slice once per step.
+      for (int64_t bi = 0; bi < B; bi++)
+        std::copy(x.data + (bi * T + t) * F,
+                  x.data + (bi * T + t) * F + F,
+                  xslice.data() + bi * F);
+      const float* xt = xslice.data();
+      if (kind == 0) {  // RNN: h = act(xh @ w + b)
+        matmul(xt, h, 0, 1, gates.data());
+        bool relu = activation == "relu";
+        for (int64_t i = 0; i < B * H; i++)
+          h[i] = relu ? (gates[i] > 0 ? gates[i] : 0.f)
+                      : std::tanh(gates[i]);
+      } else if (kind == 1) {  // GRU: rz from [x,h]; cand from [x, r*h]
+        matmul(xt, h, 0, 2, gates.data());
+        for (int64_t bi = 0; bi < B; bi++)
+          for (int64_t i = 0; i < H; i++) {
+            float r = sigmoid(gates[bi * 2 * H + i]);
+            rh[bi * H + i] = r * h[bi * H + i];
+          }
+        matmul(xt, rh, 2, 3, cand.data());
+        for (int64_t bi = 0; bi < B; bi++)
+          for (int64_t i = 0; i < H; i++) {
+            float z = sigmoid(gates[bi * 2 * H + H + i]);
+            float cv = std::tanh(cand[bi * H + i]);
+            float& hv = h[bi * H + i];
+            hv = (1.f - z) * hv + z * cv;
+          }
+      } else {  // LSTM: gates [i, f, g, o]
+        matmul(xt, h, 0, 4, gates.data());
+        for (int64_t bi = 0; bi < B; bi++)
+          for (int64_t i = 0; i < H; i++) {
+            const float* gr = gates.data() + bi * 4 * H;
+            float ig = sigmoid(gr[i]);
+            float fg = sigmoid(gr[H + i] + forget_bias);
+            float gg = std::tanh(gr[2 * H + i]);
+            float og = sigmoid(gr[3 * H + i]);
+            float& cv = c[bi * H + i];
+            cv = fg * cv + ig * gg;
+            h[bi * H + i] = og * std::tanh(cv);
+          }
+      }
+      if (return_sequences)
+        for (int64_t bi = 0; bi < B; bi++)
+          std::copy(h.data() + bi * H, h.data() + bi * H + H,
+                    out->data + (bi * T + t) * H);
+    }
+    if (!return_sequences)
+      std::copy(h.begin(), h.end(), out->data);
+  }
+};
+
+// ---------------------------------------------------------------------------
+class MoEUnit : public Unit {  // MoEFFN inference (dense top-k routing)
+ public:
+  // Mirrors veles_tpu/parallel/moe.py semantics: top-k softmax routing
+  // with GShard slot priority (all primary routes queue before any
+  // secondary) and capacity drops; per-token expert FFN on CPU.
+  int64_t n_experts = 0, d_hidden = 0, top_k = 1;
+  float capacity_factor = 1.25f;
+  npy::Array router, w1, w2;  // (D,E), (E,D,Hd), (E,Hd,D)
+
+  Shape OutputShape(const std::vector<Shape>& in) const override {
+    return in[0];
+  }
+
+  void Run(const std::vector<const Tensor*>& in, Tensor* out,
+           UnitContext* ctx) const override {
+    const Tensor& x = *in[0];
+    int64_t D = x.shape[x.shape.rank() - 1];
+    int64_t T = x.size() / D;
+    int64_t E = n_experts, K = top_k, Hd = d_hidden;
+    if (D != router.shape[0] || E != router.shape[1])
+      throw std::runtime_error(
+          name + ": router shape (" + std::to_string(router.shape[0]) +
+          ", " + std::to_string(router.shape[1]) + ") does not match "
+          "input features " + std::to_string(D) + " x " +
+          std::to_string(E) + " experts");
+    if (w1.shape[0] != E || w1.shape[1] != D || w1.shape[2] != Hd ||
+        w2.shape[0] != E || w2.shape[1] != Hd || w2.shape[2] != D)
+      throw std::runtime_error(name + ": expert bank shape mismatch");
+    if (K < 1 || K > E)
+      throw std::runtime_error(
+          name + ": top_k " + std::to_string(K) +
+          " out of range [1, " + std::to_string(E) + "]");
+    int64_t C = std::max<int64_t>(
+        1, static_cast<int64_t>(capacity_factor * T * K / E));
+    // route: per-token softmax over router logits, top-k
+    std::vector<float> gates(T * K);
+    std::vector<int64_t> topi(T * K);
+    ctx->pool->ParallelFor(T, [&](int64_t rb, int64_t re) {
+      std::vector<float> logits(E);
+      for (int64_t t = rb; t < re; t++) {
+        const float* xr = x.data + t * D;
+        for (int64_t e = 0; e < E; e++) {
+          float s = 0.f;
+          for (int64_t d = 0; d < D; d++)
+            s += xr[d] * router.data[d * E + e];
+          logits[e] = s;
+        }
+        float m = logits[0];
+        for (int64_t e = 1; e < E; e++) m = std::max(m, logits[e]);
+        float z = 0.f;
+        for (int64_t e = 0; e < E; e++) {
+          logits[e] = std::exp(logits[e] - m);
+          z += logits[e];
+        }
+        for (int64_t e = 0; e < E; e++) logits[e] /= z;
+        // top-k selection (E is small)
+        std::vector<char> used(E, 0);
+        float gsum = 0.f;
+        for (int64_t k = 0; k < K; k++) {
+          int64_t best = -1;
+          for (int64_t e = 0; e < E; e++)
+            if (!used[e] && (best < 0 || logits[e] > logits[best]))
+              best = e;
+          used[best] = 1;
+          topi[t * K + k] = best;
+          gates[t * K + k] = logits[best];
+          gsum += logits[best];
+        }
+        if (K > 1)
+          for (int64_t k = 0; k < K; k++)
+            gates[t * K + k] /= std::max(gsum, 1e-9f);
+      }
+    });
+    // capacity accounting, slot-major (GShard priority): serial pass
+    std::vector<int64_t> count(E, 0);
+    std::vector<char> keep(T * K, 0);
+    for (int64_t k = 0; k < K; k++)
+      for (int64_t t = 0; t < T; t++) {
+        int64_t e = topi[t * K + k];
+        if (count[e] < C) {
+          count[e]++;
+          keep[t * K + k] = 1;
+        }
+      }
+    // per-token expert FFN for kept routes
+    ctx->pool->ParallelFor(T, [&](int64_t rb, int64_t re) {
+      std::vector<float> hbuf(Hd);
+      for (int64_t t = rb; t < re; t++) {
+        const float* xr = x.data + t * D;
+        float* yr = out->data + t * D;
+        for (int64_t d = 0; d < D; d++) yr[d] = 0.f;
+        for (int64_t k = 0; k < K; k++) {
+          if (!keep[t * K + k]) continue;
+          int64_t e = topi[t * K + k];
+          float g = gates[t * K + k];
+          const float* W1 = w1.data.data() + e * D * Hd;
+          const float* W2 = w2.data.data() + e * Hd * D;
+          for (int64_t hh = 0; hh < Hd; hh++) hbuf[hh] = 0.f;
+          for (int64_t d = 0; d < D; d++) {
+            float xv = xr[d];
+            if (xv == 0.f) continue;
+            const float* wr = W1 + d * Hd;
+            for (int64_t hh = 0; hh < Hd; hh++) hbuf[hh] += xv * wr[hh];
+          }
+          for (int64_t hh = 0; hh < Hd; hh++) {
+            float hv = hbuf[hh] > 0.f ? hbuf[hh] : 0.f;  // relu
+            if (hv == 0.f) continue;
+            const float* wr = W2 + hh * D;
+            for (int64_t d = 0; d < D; d++) yr[d] += g * hv * wr[d];
+          }
+        }
+      }
+    });
+  }
+};
+
+// ---------------------------------------------------------------------------
+class KohonenUnit : public Unit {  // SOM forward: winner (BMU) indices
+ public:
+  npy::Array weights;  // (n_neurons, F) codebook
+
+  Shape OutputShape(const std::vector<Shape>& in) const override {
+    return Shape{{in[0][0]}};
+  }
+
+  void Run(const std::vector<const Tensor*>& in, Tensor* out,
+           UnitContext* ctx) const override {
+    const Tensor& x = *in[0];
+    int64_t B = x.shape[0], F = x.size() / B;
+    int64_t N = weights.shape[0];
+    if (F != weights.shape[1])
+      throw std::runtime_error(name + ": feature dim mismatch");
+    ctx->pool->ParallelFor(B, [&](int64_t rb, int64_t re) {
+      for (int64_t bi = rb; bi < re; bi++) {
+        const float* xr = x.data + bi * F;
+        int64_t best = 0;
+        float bd = 1e30f;
+        for (int64_t nrn = 0; nrn < N; nrn++) {
+          const float* wr = weights.data.data() + nrn * F;
+          float d = 0.f;
+          for (int64_t i = 0; i < F; i++) {
+            float c = xr[i] - wr[i];
+            d += c * c;
+          }
+          if (d < bd) {
+            bd = d;
+            best = nrn;
+          }
+        }
+        out->data[bi] = static_cast<float>(best);
+      }
+    });
+  }
+};
+
+// ---------------------------------------------------------------------------
+class RBMUnit : public Unit {  // RBM forward: hidden probabilities
+ public:
+  npy::Array w, hbias;  // (F, Hd), (Hd)
+
+  Shape OutputShape(const std::vector<Shape>& in) const override {
+    return Shape{{in[0][0], w.shape[1]}};
+  }
+
+  void Run(const std::vector<const Tensor*>& in, Tensor* out,
+           UnitContext* ctx) const override {
+    const Tensor& x = *in[0];
+    int64_t B = x.shape[0], F = x.size() / B, Hd = w.shape[1];
+    if (F != w.shape[0])
+      throw std::runtime_error(name + ": feature dim mismatch");
+    ctx->pool->ParallelFor(B, [&](int64_t rb, int64_t re) {
+      for (int64_t bi = rb; bi < re; bi++) {
+        const float* xr = x.data + bi * F;
+        float* yr = out->data + bi * Hd;
+        for (int64_t o = 0; o < Hd; o++) yr[o] = hbias.data[o];
+        for (int64_t i = 0; i < F; i++) {
+          float xv = xr[i];
+          if (xv == 0.f) continue;
+          const float* wr = w.data.data() + i * Hd;
+          for (int64_t o = 0; o < Hd; o++) yr[o] += xv * wr[o];
+        }
+        for (int64_t o = 0; o < Hd; o++)
+          yr[o] = 1.f / (1.f + std::exp(-yr[o]));
+      }
+    });
+  }
+};
+
 inline UnitPtr CreateUnit(const std::string& klass,
                           const json::Value& config, Weights* weights) {
   auto get_act = [&]() { return config.string("activation", "linear"); };
@@ -861,6 +1161,57 @@ inline UnitPtr CreateUnit(const std::string& klass,
     u->wk = std::move((*weights)["wk"]);
     u->wv = std::move((*weights)["wv"]);
     u->wo = std::move((*weights)["wo"]);
+    return u;
+  }
+  if (klass == "RNN" || klass == "GRU" || klass == "LSTM") {
+    auto u = std::make_unique<RecurrentUnit>();
+    u->kind = klass == "RNN" ? 0 : (klass == "GRU" ? 1 : 2);
+    u->hidden = static_cast<int64_t>(config.number("hidden", 0));
+    if (config.has("return_sequences")) {
+      const auto& rv = config.at("return_sequences");
+      u->return_sequences =
+          rv.type == json::Value::Type::Bool ? rv.b : rv.num != 0.0;
+    }
+    u->activation = config.string("activation", "tanh");
+    u->forget_bias = static_cast<float>(config.number("forget_bias", 1.0));
+    for (const char* wn : {"w", "b"})
+      if (!weights->count(wn))
+        throw std::runtime_error(klass + " missing weight " +
+                                 std::string(wn));
+    u->w = std::move((*weights)["w"]);
+    u->b = std::move((*weights)["b"]);
+    return u;
+  }
+  if (klass == "MoEFFN") {
+    auto u = std::make_unique<MoEUnit>();
+    u->n_experts = static_cast<int64_t>(config.number("n_experts", 0));
+    u->d_hidden = static_cast<int64_t>(config.number("d_hidden", 0));
+    u->top_k = static_cast<int64_t>(config.number("top_k", 1));
+    u->capacity_factor =
+        static_cast<float>(config.number("capacity_factor", 1.25));
+    for (const char* wn : {"router", "w1", "w2"})
+      if (!weights->count(wn))
+        throw std::runtime_error("MoEFFN missing weight " +
+                                 std::string(wn));
+    u->router = std::move((*weights)["router"]);
+    u->w1 = std::move((*weights)["w1"]);
+    u->w2 = std::move((*weights)["w2"]);
+    return u;
+  }
+  if (klass == "KohonenForward") {
+    auto u = std::make_unique<KohonenUnit>();
+    if (!weights->count("weights"))
+      throw std::runtime_error("KohonenForward missing codebook weights");
+    u->weights = std::move((*weights)["weights"]);
+    return u;
+  }
+  if (klass == "RBM") {
+    auto u = std::make_unique<RBMUnit>();
+    for (const char* wn : {"w", "hbias"})
+      if (!weights->count(wn))
+        throw std::runtime_error("RBM missing weight " + std::string(wn));
+    u->w = std::move((*weights)["w"]);
+    u->hbias = std::move((*weights)["hbias"]);
     return u;
   }
   throw std::runtime_error("no native implementation for unit class " +

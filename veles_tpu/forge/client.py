@@ -90,7 +90,7 @@ class ForgeClient(Logger):
         ``work_dir`` and upload it with the manifest."""
         from ..export.package import export_package
         os.makedirs(work_dir, exist_ok=True)
-        export_package(workflow, wstate, work_dir)
+        export_package(workflow, wstate, work_dir, servable=False)
         man = dict(manifest)
         man.setdefault("workflow", "contents.json")
         man.setdefault("configuration", "contents.json")
