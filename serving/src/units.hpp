@@ -732,6 +732,10 @@ class RecurrentUnit : public Unit {  // RNN / GRU / LSTM inference
       throw std::runtime_error(
           name + ": weight shape mismatch (want (" +
           std::to_string(F + H) + ", " + std::to_string(G * H) + "))");
+    if (b.size() != G * H)
+      throw std::runtime_error(
+          name + ": bias length " + std::to_string(b.size()) +
+          " != " + std::to_string(G * H));
     std::vector<float> h(B * H, 0.f), c(kind == 2 ? B * H : 0, 0.f);
     std::vector<float> gates(B * G * H);
     // xh @ w for a column range [g0*H, g1*H) of the fused gate weight

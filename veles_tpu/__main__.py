@@ -122,6 +122,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "forced device sync and print the top-5 table "
                         "(reference: --sync-run honest per-unit timers + "
                         "Workflow.print_stats)")
+    p.add_argument("--status-port", type=int, default=None,
+                   help="serve a live status page (JSON + HTML with "
+                        "auto-refreshing metric plots) on this port; 0 "
+                        "picks a free port (reference: the Tornado web "
+                        "status + WebAgg live plots, veles/web_status.py)")
+    p.add_argument("--plots", metavar="DIR", default=None,
+                   help="write metric-curve PNGs/JSONL here each epoch "
+                        "(default 'plots' when --status-port is set)")
     p.add_argument("--profile", metavar="DIR",
                    help="capture a device-level jax.profiler trace of the "
                         "training run into DIR (view with TensorBoard / "
@@ -540,6 +548,35 @@ def main(argv=None) -> int:
 
     # -- standalone training ------------------------------------------------
     trainer = trainer_factory(root)
+    status_server = None
+    if args.status_port is not None or args.plots:
+        # Live observability: recorder autosaves metric-curve PNGs each
+        # epoch; the status server embeds them in an auto-refreshing
+        # page — a running job is watchable at an HTTP URL (reference:
+        # web_status.py + the WebAgg graphics backend).
+        from .plotting import MetricsRecorder
+        from .runtime.status import StatusReporter, StatusServer
+        plots_dir = args.plots or "plots"
+        os.makedirs(plots_dir, exist_ok=True)
+        if trainer.recorder is None:
+            trainer.recorder = MetricsRecorder(
+                name=trainer.workflow.name, out_dir=plots_dir,
+                autosave_png=True)
+        else:
+            # a create()-style config may have wired its own recorder;
+            # the flags still promise live plots — upgrade it in place
+            trainer.recorder.out_dir = trainer.recorder.out_dir \
+                or plots_dir
+            trainer.recorder.autosave_png = True
+        if args.status_port is not None:
+            if trainer.status is None:
+                trainer.status = StatusReporter(
+                    os.path.join(plots_dir, "status.json"),
+                    name=trainer.workflow.name, plots_dir=plots_dir)
+            elif trainer.status.plots_dir is None:
+                trainer.status.plots_dir = trainer.recorder.out_dir
+            status_server = StatusServer(
+                trainer.status, port=args.status_port).start()
     if args.snapshot_dir and trainer.snapshotter is None:
         # create()-style configs get the CLI snapshot dir too (the standard
         # path wires this inside _make_trainer_from_root)
@@ -572,8 +609,12 @@ def main(argv=None) -> int:
     if args.profile:
         import jax
         profile_cm = jax.profiler.trace(args.profile)
-    with profile_cm:
-        results = trainer.run()
+    try:
+        with profile_cm:
+            results = trainer.run()
+    finally:
+        if status_server is not None:
+            status_server.stop()
     print(json.dumps(results))
     if args.publish:
         # after the results are emitted — a report typo must never eat a

@@ -46,7 +46,7 @@ class MetricsRecorder(Logger):
     """Accumulating series recorder (reference: AccumulatingPlotter)."""
 
     def __init__(self, name: str = "metrics", out_dir: Optional[str] = None,
-                 graphics=None):
+                 graphics=None, autosave_png: bool = False):
         self.name = name
         self.out_dir = out_dir
         self.series: Dict[str, List[float]] = {}
@@ -54,6 +54,10 @@ class MetricsRecorder(Logger):
         # is also broadcast to subscribed renderer processes (reference:
         # plotters pickled onto the ZMQ PUB socket, veles/plotter.py:147).
         self.graphics = graphics
+        # Refresh the PNG on every record() — the browser status page
+        # embeds it for live watching (runtime/status.py). Epoch cadence,
+        # host-side only; never syncs the device pipeline.
+        self.autosave_png = autosave_png
         self._jsonl = None
         if out_dir:
             os.makedirs(out_dir, exist_ok=True)
@@ -75,6 +79,8 @@ class MetricsRecorder(Logger):
             self.graphics.publish(
                 {"kind": "metrics", "step": step,
                  "values": {k: v for k, v in rec.items() if k != "step"}})
+        if self.autosave_png and self.out_dir:
+            self.save_png()
 
     def summary(self, width: int = 40) -> str:
         """Terminal rendering of all series."""
