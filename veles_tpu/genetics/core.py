@@ -44,8 +44,15 @@ class GeneticOptimizer(Logger):
     """GA driver.
 
     selection: "tournament" | "roulette";
-    crossover ops: uniform, single-point, blend (continuous only);
+    crossover ops: uniform, single-point (the reference's "pointed"),
+    blend, arithmetic mean, geometric mean (reference operator set:
+    veles/genetics/core.py:371-460);
     mutation ops: gaussian (continuous), reset (any), creep (integers).
+
+    ``binary_bits=N`` switches to the reference's binary-code mode:
+    numeric genes are Gray-free fixed-point N-bit codes over their
+    range, crossover cuts the concatenated bitstring, and mutation
+    flips individual bits.
     """
 
     def __init__(self, config: Config,
@@ -56,6 +63,7 @@ class GeneticOptimizer(Logger):
                  selection: str = "tournament",
                  tournament_k: int = 3, seed: int = 0,
                  on_generation: Optional[Callable] = None,
+                 binary_bits: Optional[int] = None,
                  evaluator: Optional[Callable[
                      [List[Config], List[Dict[str, object]]],
                      Sequence[float]]] = None):
@@ -76,6 +84,7 @@ class GeneticOptimizer(Logger):
         self.tournament_k = tournament_k
         self.rng = np.random.default_rng(seed)
         self.on_generation = on_generation
+        self.binary_bits = binary_bits
         self.history: List[dict] = []
         self.best: Optional[Individual] = None
 
@@ -97,31 +106,97 @@ class GeneticOptimizer(Logger):
         (reference: the original config is generation 0's elite)."""
         return Individual({p: r.value for p, r in self.tuneables.items()})
 
+    # -- binary-code mode (reference: BinaryChromosome) ---------------------
+    def _gene_bounds(self, p: str):
+        r = self.tuneables[p]
+        if r.choices is not None:
+            return 0, len(r.choices) - 1
+        lo = r.min_value if r.min_value is not None else r.value * 0.1
+        hi = r.max_value if r.max_value is not None else r.value * 10.0
+        return lo, hi
+
+    def encode_bits(self, genome: Dict[str, object]) -> np.ndarray:
+        """Concatenated fixed-point bit code of all genes."""
+        nb = self.binary_bits
+        out = []
+        for p, r in self.tuneables.items():
+            lo, hi = self._gene_bounds(p)
+            if r.choices is not None:
+                q = r.choices.index(genome[p]) \
+                    if genome[p] in r.choices else 0
+            else:
+                span = (hi - lo) or 1.0
+                q = int(round((float(genome[p]) - lo) / span
+                              * (2 ** nb - 1)))
+            q = int(np.clip(q, 0, 2 ** nb - 1))
+            out.extend((q >> i) & 1 for i in reversed(range(nb)))
+        return np.asarray(out, np.uint8)
+
+    def decode_bits(self, bits: np.ndarray) -> Dict[str, object]:
+        nb = self.binary_bits
+        genome, off = {}, 0
+        for p, r in self.tuneables.items():
+            q = 0
+            for bit in bits[off:off + nb]:
+                q = (q << 1) | int(bit)
+            off += nb
+            lo, hi = self._gene_bounds(p)
+            if r.choices is not None:
+                genome[p] = r.choices[min(q, len(r.choices) - 1)]
+            else:
+                v = lo + (hi - lo) * q / (2 ** nb - 1)
+                genome[p] = r.clip(int(round(v)) if r.integer else float(v))
+        return genome
+
     def crossover(self, a: Individual, b: Individual) -> Individual:
-        op = self.rng.integers(3)
         paths = list(self.tuneables)
         child = {}
+        if self.binary_bits:
+            # binary-code single-point: cut the concatenated bitstring
+            ba, bb = self.encode_bits(a.genome), self.encode_bits(b.genome)
+            cut = self.rng.integers(1, max(len(ba), 2))
+            return Individual(self.decode_bits(
+                np.concatenate([ba[:cut], bb[cut:]])))
+        op = self.rng.integers(5)
         if op == 0:      # uniform
             for p in paths:
                 child[p] = a.genome[p] if self.rng.random() < 0.5 \
                     else b.genome[p]
-        elif op == 1:    # single-point
+        elif op == 1:    # single-point (reference "pointed")
             cut = self.rng.integers(1, max(len(paths), 2))
             for i, p in enumerate(paths):
                 child[p] = a.genome[p] if i < cut else b.genome[p]
-        else:            # blend for continuous, uniform otherwise
+        elif op in (2, 3, 4):
+            # numeric combinators; categorical genes fall back to uniform
             for p in paths:
                 r = self.tuneables[p]
                 va, vb = a.genome[p], b.genome[p]
-                if r.choices is None and isinstance(va, (int, float)):
+                if r.choices is not None or not isinstance(va, (int, float)):
+                    child[p] = va if self.rng.random() < 0.5 else vb
+                    continue
+                if op == 2:      # blend: random convex combination
                     t = self.rng.random()
                     v = va * t + vb * (1 - t)
-                    child[p] = r.clip(int(round(v)) if r.integer else v)
-                else:
-                    child[p] = va if self.rng.random() < 0.5 else vb
+                elif op == 3:    # arithmetic mean (reference :409)
+                    v = (va + vb) / 2.0
+                else:            # geometric mean (reference :430); falls
+                    # back to arithmetic when signs differ / zero-crossing
+                    if va * vb > 0:
+                        v = math.copysign(math.sqrt(va * vb), va)
+                    else:
+                        v = (va + vb) / 2.0
+                child[p] = r.clip(int(round(v)) if r.integer else float(v))
         return Individual(child)
 
     def mutate(self, ind: Individual) -> Individual:
+        if self.binary_bits:
+            # bit-flip mutation: expected flips per genome track the
+            # gene-level mutation_rate
+            bits = self.encode_bits(ind.genome)
+            rate = self.mutation_rate / self.binary_bits
+            flips = self.rng.random(len(bits)) < rate
+            bits = bits ^ flips.astype(np.uint8)
+            return Individual(self.decode_bits(bits))
         g = dict(ind.genome)
         for p, r in self.tuneables.items():
             if self.rng.random() >= self.mutation_rate:
