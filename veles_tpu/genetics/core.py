@@ -89,16 +89,15 @@ class GeneticOptimizer(Logger):
         self.best: Optional[Individual] = None
 
     # -- genome ops ---------------------------------------------------------
-    def _random_value(self, r: Range):
+    def _random_value(self, p: str, r: Range):
         if r.choices is not None:
             return r.choices[self.rng.integers(len(r.choices))]
-        lo = r.min_value if r.min_value is not None else r.value * 0.1
-        hi = r.max_value if r.max_value is not None else r.value * 10.0
+        lo, hi = self._gene_bounds(p)
         v = self.rng.uniform(lo, hi)
         return int(round(v)) if r.integer else float(v)
 
     def random_individual(self) -> Individual:
-        return Individual({p: self._random_value(r)
+        return Individual({p: self._random_value(p, r)
                            for p, r in self.tuneables.items()})
 
     def seed_individual(self) -> Individual:
@@ -149,14 +148,14 @@ class GeneticOptimizer(Logger):
         return genome
 
     def crossover(self, a: Individual, b: Individual) -> Individual:
-        paths = list(self.tuneables)
-        child = {}
         if self.binary_bits:
             # binary-code single-point: cut the concatenated bitstring
             ba, bb = self.encode_bits(a.genome), self.encode_bits(b.genome)
             cut = self.rng.integers(1, max(len(ba), 2))
             return Individual(self.decode_bits(
                 np.concatenate([ba[:cut], bb[cut:]])))
+        paths = list(self.tuneables)
+        child = {}
         op = self.rng.integers(5)
         if op == 0:      # uniform
             for p in paths:
