@@ -700,8 +700,70 @@ class SoftmaxUnit : public Unit {  // EvaluatorSoftmax at inference = probs
 };
 
 // ---------------------------------------------------------------------------
-// Factory (reference: UnitFactory[uuid] -> instance,
-// libVeles/inc/veles/unit_factory.h).
+class FFNUnit : public Unit {  // per-position residual MLP (transformer FFN)
+ public:
+  int64_t d_hidden = 0;
+  std::string activation = "relu";
+  bool residual = true;
+  npy::Array w1, b1, w2, b2;
+
+  Shape OutputShape(const std::vector<Shape>& in) const override {
+    return in[0];
+  }
+
+  void Run(const std::vector<const Tensor*>& in, Tensor* out,
+           UnitContext* ctx) const override {
+    const Tensor& x = *in[0];
+    int64_t E = x.shape[x.shape.rank() - 1];
+    int64_t rows = x.size() / E, Hd = d_hidden;
+    if (E != w1.shape[0] || w1.shape[1] != Hd ||
+        w2.shape[0] != Hd || w2.shape[1] != E ||
+        b1.size() != Hd || b2.size() != E)
+      throw std::runtime_error(name + ": FFN weight shape mismatch");
+    bool relu = activation == "relu";
+    ctx->pool->ParallelFor(rows, [&](int64_t rb, int64_t re) {
+      std::vector<float> h(Hd);
+      for (int64_t r = rb; r < re; r++) {
+        const float* xr = x.data + r * E;
+        float* yr = out->data + r * E;
+        for (int64_t o = 0; o < Hd; o++) h[o] = b1.data[o];
+        for (int64_t i = 0; i < E; i++) {
+          float xv = xr[i];
+          if (xv == 0.f) continue;
+          const float* wr = w1.data.data() + i * Hd;
+          for (int64_t o = 0; o < Hd; o++) h[o] += xv * wr[o];
+        }
+        if (relu) {
+          for (int64_t o = 0; o < Hd; o++) h[o] = h[o] > 0 ? h[o] : 0.f;
+        } else if (activation == "tanh") {
+          for (int64_t o = 0; o < Hd; o++)
+            h[o] = 1.7159f * std::tanh(0.6666f * h[o]);
+        } else if (activation == "raw_tanh") {
+          for (int64_t o = 0; o < Hd; o++) h[o] = std::tanh(h[o]);
+        } else if (activation == "sigmoid") {
+          for (int64_t o = 0; o < Hd; o++)
+            h[o] = 1.f / (1.f + std::exp(-h[o]));
+        } else if (activation == "sincos") {
+          // alternates by feature index (ops/activations.py sincos)
+          for (int64_t o = 0; o < Hd; o++)
+            h[o] = (o % 2 == 0) ? std::sin(h[o]) : std::cos(h[o]);
+        } else if (activation != "linear" && !activation.empty()) {
+          throw std::runtime_error(name + ": unknown FFN activation " +
+                                   activation);
+        }
+        for (int64_t o = 0; o < E; o++)
+          yr[o] = b2.data[o] + (residual ? xr[o] : 0.f);
+        for (int64_t i = 0; i < Hd; i++) {
+          float hv = h[i];
+          if (hv == 0.f) continue;
+          const float* wr = w2.data.data() + i * E;
+          for (int64_t o = 0; o < E; o++) yr[o] += hv * wr[o];
+        }
+      }
+    });
+  }
+};
+
 // ---------------------------------------------------------------------------
 class RecurrentUnit : public Unit {  // RNN / GRU / LSTM inference
  public:
@@ -1006,6 +1068,10 @@ class RBMUnit : public Unit {  // RBM forward: hidden probabilities
   }
 };
 
+// ---------------------------------------------------------------------------
+// Factory (reference: UnitFactory[uuid] -> instance,
+// libVeles/inc/veles/unit_factory.h).
+// ---------------------------------------------------------------------------
 inline UnitPtr CreateUnit(const std::string& klass,
                           const json::Value& config, Weights* weights) {
   auto get_act = [&]() { return config.string("activation", "linear"); };
@@ -1165,6 +1231,24 @@ inline UnitPtr CreateUnit(const std::string& klass,
     u->wk = std::move((*weights)["wk"]);
     u->wv = std::move((*weights)["wv"]);
     u->wo = std::move((*weights)["wo"]);
+    return u;
+  }
+  if (klass == "FFN") {
+    auto u = std::make_unique<FFNUnit>();
+    u->d_hidden = static_cast<int64_t>(config.number("d_hidden", 0));
+    u->activation = config.string("activation", "relu");
+    if (config.has("residual")) {
+      const auto& rv = config.at("residual");
+      u->residual = rv.type == json::Value::Type::Bool ? rv.b
+                                                       : rv.num != 0.0;
+    }
+    for (const char* wn : {"w1", "b1", "w2", "b2"})
+      if (!weights->count(wn))
+        throw std::runtime_error("FFN missing weight " + std::string(wn));
+    u->w1 = std::move((*weights)["w1"]);
+    u->b1 = std::move((*weights)["b1"]);
+    u->w2 = std::move((*weights)["w2"]);
+    u->b2 = std::move((*weights)["b2"]);
     return u;
   }
   if (klass == "RNN" || klass == "GRU" || klass == "LSTM") {

@@ -69,6 +69,15 @@ CASES = {
         {"type": "all2all", "output_size": V, "per_position": True,
          "name": "head"},
     ],
+    "transformer_block": lambda V: [
+        {"type": "embedding", "vocab": V, "dim": 16, "name": "emb"},
+        {"type": "attention", "n_heads": 2, "rope": True,
+         "residual": True, "name": "a1"},
+        {"type": "layer_norm", "name": "n1"},
+        {"type": "ffn", "d_hidden": 32, "name": "f1"},
+        {"type": "seq_last", "name": "last"},
+        {"type": "softmax", "output_size": V, "name": "out"},
+    ],
     "pipeline_stack": lambda V: [
         {"type": "embedding", "vocab": V, "dim": 16, "name": "emb"},
         {"type": "pipeline_stack", "stages": [

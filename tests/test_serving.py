@@ -454,3 +454,35 @@ def test_export_rejects_unservable_at_export_time(tmp_path):
         export_package(wf, ws, str(tmp_path / "pp_pkg"))
     # Python-side-only escape hatch still works (forge uploads)
     export_package(wf, ws, str(tmp_path / "pp_pkg2"), servable=False)
+
+
+def test_cpp_ffn_matches_jax(binary, tmp_path, rng):
+    """Transformer FFN block (per-position residual MLP) serves
+    natively, incl. inside a full attention+FFN block stack."""
+    wf = build_workflow("ffn_serve", [
+        {"type": "embedding", "vocab": 9, "dim": 16, "name": "emb"},
+        {"type": "attention", "n_heads": 2, "rope": True,
+         "residual": True, "name": "a1"},
+        {"type": "layer_norm", "name": "n1"},
+        {"type": "ffn", "d_hidden": 40, "name": "f1"},
+        {"type": "seq_last", "name": "last"},
+        {"type": "softmax", "output_size": 9, "name": "out"},
+    ])
+    wf.build({"@input": vt.Spec((2, 11), jnp.int32),
+              "@labels": vt.Spec((2,), jnp.int32),
+              "@mask": vt.Spec((2,), jnp.float32)})
+    ws = wf.init_state(jax.random.key(29), opt.SGD(0.01))
+    pkg = str(tmp_path / "ffn_pkg")
+    export_package(wf, ws, pkg,
+                   input_spec={"shape": [2, 11], "dtype": "float32"})
+    x = rng.integers(0, 9, (2, 11)).astype(np.float32)
+    np.save(tmp_path / "fx.npy", x)
+    r = subprocess.run(
+        [binary, pkg, str(tmp_path / "fx.npy"), str(tmp_path / "fy.npy"),
+         "--output-unit", "out"],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    got = np.load(tmp_path / "fy.npy")
+    ref = np.asarray(wf.make_predict_step("out")(
+        ws, {"@input": jnp.asarray(x, jnp.int32)}))
+    np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-4)

@@ -49,6 +49,7 @@ _EXPORT_FIELDS = {
     "Reshape": ("shape",),
     "MeanDispNormalizer": (),
     "LayerNorm": ("eps",),
+    "FFN": ("d_hidden", "activation", "residual"),
     "Embedding": ("vocab", "dim"),
     "SeqLast": (),
     "MultiHeadAttention": ("n_heads", "n_kv_heads", "head_dim", "causal",

@@ -50,6 +50,7 @@ LAYER_TYPES = {
     "flatten": nn.Flatten,
     "reshape": nn.Reshape,
     "embedding": nn.Embedding,
+    "ffn": nn.FFN,
     "layer_norm": nn.LayerNorm,
     "seq_last": nn.SeqLast,
 }
@@ -58,7 +59,7 @@ LAYER_TYPES = {
 # layer-type prefixes that take a compute_dtype kwarg (the MXU-bf16
 # switch); shared with PipelineStack's stage-config builder
 COMPUTE_DTYPE_TYPES = ("all2all", "softmax", "conv", "deconv", "rnn",
-                       "gru", "lstm", "attention")
+                       "gru", "lstm", "attention", "ffn")
 
 
 def build_workflow(name: str, layers: Sequence[dict], *,

@@ -155,7 +155,7 @@ class DecodePlan:
     @staticmethod
     def _pointwise_ok(u):
         from ..units import nn
-        ok = isinstance(u, (nn.LayerNorm, nn.Dropout)) or (
+        ok = isinstance(u, (nn.LayerNorm, nn.Dropout, nn.FFN)) or (
             isinstance(u, nn.All2All) and u.per_position)
         if not ok:
             raise WorkflowError(

@@ -366,6 +366,48 @@ class LayerNorm(Unit):
         return out.astype(x.dtype), state
 
 
+class FFN(Unit):
+    """Per-position two-layer MLP with residual — the transformer block's
+    FFN half (y = x + W2·act(W1·x)); pairs with the attention unit the
+    way MoEFFN does for the sparse case. No reference analog (the
+    reference has no sequence models — SURVEY.md §5.7)."""
+
+    def __init__(self, d_hidden: int, activation: str = "relu",
+                 residual: bool = True, name=None, inputs=("@input",),
+                 compute_dtype=None):
+        super().__init__(name, inputs)
+        self.d_hidden = int(d_hidden)
+        self.activation = activation
+        self.residual = bool(residual)
+        self.compute_dtype = _cast_policy(compute_dtype)
+
+    def output_spec(self, in_specs):
+        return in_specs[0]
+
+    def init(self, key, in_specs):
+        E = in_specs[0].shape[-1]
+        k1, k2 = jax.random.split(key)
+        return {"w1": ops.smart_uniform_init(k1, (E, self.d_hidden), E),
+                "b1": jnp.zeros((self.d_hidden,), jnp.float32),
+                "w2": ops.smart_uniform_init(k2, (self.d_hidden, E),
+                                             self.d_hidden),
+                "b2": jnp.zeros((E,), jnp.float32)}, {}
+
+    def apply(self, params, state, xs, ctx):
+        x = xs[0]
+        lead = x.shape[:-1]
+        flat = x.reshape(-1, x.shape[-1])
+        h = ops.dense(flat, params["w1"], params["b1"],
+                      compute_dtype=self.compute_dtype)
+        h = ACTIVATIONS[self.activation](h)
+        y = ops.dense(h, params["w2"], params["b2"],
+                      compute_dtype=self.compute_dtype)
+        y = y.reshape(lead + (x.shape[-1],))
+        if self.residual:
+            y = y + x
+        return y.astype(x.dtype), state
+
+
 class Embedding(Unit):
     """Token embedding: int tokens (B, T) -> (B, T, dim) by table lookup.
 
