@@ -213,27 +213,34 @@ inline int64_t PlanArena(std::vector<ArenaItem>* items) {
 
 // ---------------------------------------------------------------------------
 // Activations (mirror veles_tpu/ops/activations.py).
-// last_dim: the feature-axis extent (sincos alternates over the feature
-// index, not the flat index).
+// ApplyActivationRange: the shared scalar ladder over x[b, e) with feature
+// indices i % last_dim (sincos alternates over the feature index, not the
+// flat index). Safe inside a worker lambda (no pool dispatch) — FFNUnit
+// calls it per row from within its own ParallelFor.
+inline void ApplyActivationRange(const std::string& act, float* x,
+                                 int64_t b, int64_t e, int64_t last_dim) {
+  if (act == "relu") {
+    for (int64_t i = b; i < e; i++) x[i] = x[i] > 0 ? x[i] : 0;
+  } else if (act == "tanh") {
+    for (int64_t i = b; i < e; i++)
+      x[i] = 1.7159f * std::tanh(0.6666f * x[i]);
+  } else if (act == "raw_tanh") {
+    for (int64_t i = b; i < e; i++) x[i] = std::tanh(x[i]);
+  } else if (act == "sigmoid") {
+    for (int64_t i = b; i < e; i++) x[i] = 1.f / (1.f + std::exp(-x[i]));
+  } else if (act == "sincos") {
+    for (int64_t i = b; i < e; i++)
+      x[i] = ((i % last_dim) % 2 == 0) ? std::sin(x[i]) : std::cos(x[i]);
+  } else {
+    throw std::runtime_error("unknown activation " + act);
+  }
+}
+
 inline void ApplyActivation(const std::string& act, float* x, int64_t n,
                             int64_t last_dim, ThreadPool* pool) {
   if (act == "linear" || act.empty()) return;
   pool->ParallelFor(n, [&](int64_t b, int64_t e) {
-    if (act == "relu") {
-      for (int64_t i = b; i < e; i++) x[i] = x[i] > 0 ? x[i] : 0;
-    } else if (act == "tanh") {
-      for (int64_t i = b; i < e; i++)
-        x[i] = 1.7159f * std::tanh(0.6666f * x[i]);
-    } else if (act == "raw_tanh") {
-      for (int64_t i = b; i < e; i++) x[i] = std::tanh(x[i]);
-    } else if (act == "sigmoid") {
-      for (int64_t i = b; i < e; i++) x[i] = 1.f / (1.f + std::exp(-x[i]));
-    } else if (act == "sincos") {
-      for (int64_t i = b; i < e; i++)
-        x[i] = ((i % last_dim) % 2 == 0) ? std::sin(x[i]) : std::cos(x[i]);
-    } else {
-      throw std::runtime_error("unknown activation " + act);
-    }
+    ApplyActivationRange(act, x, b, e, last_dim);
   });
 }
 

@@ -733,23 +733,11 @@ class FFNUnit : public Unit {  // per-position residual MLP (transformer FFN)
           const float* wr = w1.data.data() + i * Hd;
           for (int64_t o = 0; o < Hd; o++) h[o] += xv * wr[o];
         }
-        if (relu) {
+        if (relu) {  // the hot default, branch-free fast path
           for (int64_t o = 0; o < Hd; o++) h[o] = h[o] > 0 ? h[o] : 0.f;
-        } else if (activation == "tanh") {
-          for (int64_t o = 0; o < Hd; o++)
-            h[o] = 1.7159f * std::tanh(0.6666f * h[o]);
-        } else if (activation == "raw_tanh") {
-          for (int64_t o = 0; o < Hd; o++) h[o] = std::tanh(h[o]);
-        } else if (activation == "sigmoid") {
-          for (int64_t o = 0; o < Hd; o++)
-            h[o] = 1.f / (1.f + std::exp(-h[o]));
-        } else if (activation == "sincos") {
-          // alternates by feature index (ops/activations.py sincos)
-          for (int64_t o = 0; o < Hd; o++)
-            h[o] = (o % 2 == 0) ? std::sin(h[o]) : std::cos(h[o]);
         } else if (activation != "linear" && !activation.empty()) {
-          throw std::runtime_error(name + ": unknown FFN activation " +
-                                   activation);
+          // shared scalar ladder (runtime.hpp) — per-row, no pool
+          ApplyActivationRange(activation, h.data(), 0, Hd, Hd);
         }
         for (int64_t o = 0; o < E; o++)
           yr[o] = b2.data[o] + (residual ? xr[o] : 0.f);

@@ -98,6 +98,43 @@ def _unit_config(unit) -> dict:
     return cfg
 
 
+def _stack_sub_units(stack):
+    """The units a PipelineStack expands into at export (config form);
+    legacy homogeneous stages expand into FFN units, always servable."""
+    if stack._stage_units is None:
+        return []
+    return [su for units in stack._stage_units for su in units]
+
+
+def _expand_stack_entries(stack, ptree):
+    """Yield (name, class, config, weights, input) unit entries replacing
+    a PipelineStack with its sequential stage chain (pipe=1 math).
+
+    Legacy form: each stage ``x + relu(x @ w1) @ w2`` IS an FFN unit with
+    zero biases. Config form: the stage sub-units export as themselves.
+    """
+    prev = stack.inputs[0]
+    if stack._stage_units is not None:
+        flat = [(i, su) for i, units in enumerate(stack._stage_units)
+                for su in units]
+        for idx, (i, su) in enumerate(flat):
+            name = stack.name if idx == len(flat) - 1 \
+                else f"{stack.name}__s{i}_{su.name}"
+            w = ptree.get(f"s{i}", {}).get(su.name, {})
+            yield name, type(su).__name__, _unit_config(su), w, prev
+            prev = name
+        return
+    w1, w2 = ptree["stage_w1"], ptree["stage_w2"]
+    S, E, H = w1.shape[0], w1.shape[1], w1.shape[2]
+    for i in range(S):
+        name = stack.name if i == S - 1 else f"{stack.name}__s{i}_ffn"
+        cfg = {"d_hidden": int(H), "activation": "relu", "residual": True}
+        w = {"w1": w1[i], "b1": np.zeros(H, np.float32),
+             "w2": w2[i], "b2": np.zeros(E, np.float32)}
+        yield name, "FFN", cfg, w, prev
+        prev = name
+
+
 def export_package(workflow: Workflow, wstate: dict, path: str, *,
                    input_spec: Optional[dict] = None,
                    servable: bool = True) -> str:
@@ -110,10 +147,20 @@ def export_package(workflow: Workflow, wstate: dict, path: str, *,
     unit_factory.h; round-2 verdict missing #1). Pass ``servable=False``
     for Python-side-only packages (forge uploads).
     """
+    from ..units.parallel_nn import PipelineStack
     if servable:
-        bad = [f"{u.name} ({type(u).__name__})"
-               for u in workflow.topo_order()
-               if type(u).__name__ not in _EXPORT_FIELDS]
+        bad = []
+        for u in workflow.topo_order():
+            if isinstance(u, PipelineStack):
+                # the stack exports UNSTACKED (see _expand_stack_entries);
+                # validate what it expands into
+                for su in _stack_sub_units(u):
+                    if type(su).__name__ not in _EXPORT_FIELDS:
+                        bad.append(f"{u.name}/{su.name} "
+                                   f"({type(su).__name__})")
+                continue
+            if type(u).__name__ not in _EXPORT_FIELDS:
+                bad.append(f"{u.name} ({type(u).__name__})")
         if bad:
             raise ValueError(
                 "units not supported by the native serving runtime: "
@@ -126,6 +173,23 @@ def export_package(workflow: Workflow, wstate: dict, path: str, *,
     state = jax.device_get(wstate["state"])
 
     for u in workflow.topo_order():
+        if isinstance(u, PipelineStack):
+            # Pipeline parallelism is a TRAINING-time sharding construct;
+            # stages are ordinary shape-preserving units, so the export
+            # unstacks them into the plain sequential chain (same math —
+            # the pipe=1 fallback) and the native runtime serves it with
+            # no stack-specific machinery. The last expanded unit takes
+            # the stack's name so downstream inputs resolve unchanged.
+            for name, klass, cfg, wdict, inp in _expand_stack_entries(
+                    u, params.get(u.name, {})):
+                entry = {"name": name, "class": klass, "inputs": [inp],
+                         "config": cfg, "weights": {}}
+                for pname, arr in wdict.items():
+                    fname = f"{name}_{pname}.npy"
+                    arrays[fname] = np.asarray(arr)
+                    entry["weights"][pname] = fname
+                units.append(entry)
+            continue
         entry = {
             "name": u.name,
             "class": type(u).__name__,

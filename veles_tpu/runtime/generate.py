@@ -161,7 +161,7 @@ class DecodePlan:
             raise WorkflowError(
                 f"unit {u.name!r} ({type(u).__name__}) mixes sequence "
                 "positions (or is not per-position); generate() supports "
-                "attention, layer_norm, per-position all2all, "
+                "attention, layer_norm, ffn, per-position all2all, "
                 "pipeline_stack and seq_last before the head")
 
     def _iter_attn(self):
