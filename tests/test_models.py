@@ -226,3 +226,44 @@ def test_stl_workflow_single_step():
              "@mask": jnp.ones((16,))}
     ws, mets = step(ws, batch)
     assert np.isfinite(float(mets["loss"]))
+
+
+def test_induction_loader_per_position_masks():
+    """per_position mode: TRAIN = varied-offset repeated segments with
+    next-token labels masked to the predictable second copy; VALID =
+    the trigger task with the mask on ONLY the last position
+    (error_pct = induction recall)."""
+    from veles_tpu.loader.base import TRAIN, VALID
+    from veles_tpu.models.lm import InductionLoader
+    # n_train NOT divisible by the batch size: the padded tail batch
+    # must mask its pad rows, not crash (review regression)
+    ld = InductionLoader(minibatch_size=10, n_train=55, n_valid=20,
+                        seq_len=16, vocab=8, per_position=True)
+    ld.initialize()
+    bt = next(ld.iter_epoch(TRAIN))
+    bv = next(ld.iter_epoch(VALID))
+    x, y = np.asarray(bt["@input"]), np.asarray(bt["@labels"])
+    mt = np.asarray(bt["@mask"])
+    assert y.shape == x.shape and mt.shape == x.shape
+    np.testing.assert_array_equal(y[:, :-1], x[:, 1:])  # next-token shift
+    for r in range(10):
+        L = int(mt[r].sum())
+        assert 4 <= L <= 8  # varied per-sample repeat extent
+        assert (mt[r, -L:] == 1).all() and (mt[r, :-L] == 0).all()
+        # the masked (trainable) second copy repeats the first copy
+        np.testing.assert_array_equal(x[r, -L:], x[r, -2 * L:-L])
+        assert y[r, -1] == x[r, -2 * L]  # the repetition continues
+    # VALID keeps the trigger-recall task: last-position-only metric
+    xv, yv = np.asarray(bv["@input"]), np.asarray(bv["@labels"])
+    mv = np.asarray(bv["@mask"])
+    assert (mv[:, :-1] == 0).all() and (mv[:, -1] == 1).all()
+    # tail batch: pad rows fully masked, all batches iterable
+    batches = list(ld.iter_epoch(TRAIN))
+    assert len(batches) == 6
+    tail_mask = np.asarray(batches[-1]["@mask"])
+    assert (tail_mask[5:] == 0).all()
+    for r in range(10):
+        trig = xv[r, -1]
+        pos = np.where(xv[r, :-1] == trig)[0]
+        assert len(pos) == 1
+        assert yv[r, -1] == xv[r, pos[0] + 1]

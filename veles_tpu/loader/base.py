@@ -158,7 +158,17 @@ class Loader(Logger):
         batch = self.fill_minibatch(chunk, klass)
         mask = np.zeros(bs, np.float32)
         mask[:valid_n] = 1.0
-        batch["@mask"] = mask
+        if "@mask" in batch:
+            # loader-supplied mask (e.g. per-position loss weighting for
+            # sequence training): AND it with the padding mask so padded
+            # tail samples stay excluded. Host-path contract: the
+            # on-device FullBatchLoader gather returns only uploaded
+            # keys, so device-path loaders layer custom masks in a
+            # make_batch override instead (see models/lm.py).
+            m = np.asarray(batch["@mask"], np.float32)
+            batch["@mask"] = m * mask.reshape((bs,) + (1,) * (m.ndim - 1))
+        else:
+            batch["@mask"] = mask
         return batch
 
     def next_epoch(self) -> None:

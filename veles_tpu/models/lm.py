@@ -53,15 +53,79 @@ def synth_induction(n_train: int = 20000, n_valid: int = 4000,
     return (x[:n_train], y[:n_train], x[n_train:], y[n_train:])
 
 
+def synth_repeat(n: int, seq_len: int, vocab: int, seed: int = 20260733):
+    """Repeated-segment sequences: a random filler prefix, then a random
+    segment ``u`` twice — every position of the SECOND copy is
+    predictable by 'find the previous occurrence, copy its successor'
+    (dense induction signal; random trigger sequences carry it at only
+    ~2 of T positions, which is why attention stacks stall on them at
+    larger T).  The segment length — hence the repeat offset — VARIES
+    per sample: with a fixed offset a RoPE model learns a positional
+    copy head (train loss -> 0, zero recall transfer, observed); varied
+    offsets force content matching, the actual induction circuit.
+
+    Returns (x, y, m): tokens, next-token labels, and the trainable-
+    position mask (second copy only)."""
+    rng = np.random.default_rng(seed)
+    T = seq_len
+    x = rng.integers(0, vocab, (n, T)).astype(np.int32)
+    y = np.zeros((n, T), np.int32)
+    m = np.zeros((n, T), np.float32)
+    lens = rng.integers(4, T // 2 + 1, n)
+    for i in range(n):
+        L = int(lens[i])
+        u = x[i, T - 2 * L:T - L]          # segment = its first copy
+        x[i, T - L:] = u                   # second copy
+        y[i, :-1] = x[i, 1:]
+        y[i, -1] = u[0]                    # the repetition continues
+        m[i, T - L:] = 1.0
+    return x, y, m
+
+
 class InductionLoader(FullBatchLoader):
+    """``per_position=True`` switches to next-token LM training: labels
+    are the one-step shift and the loss is per-position CE.  The TRAIN
+    split then uses repeated-segment sequences (``synth_repeat``) with
+    the mask covering the predictable second half — dense induction
+    signal — while VALID keeps the trigger-recall task with the mask on
+    ONLY the last position, so the Decision's ``error_pct`` still
+    measures pure induction recall (the family's quality bar)."""
+
     def __init__(self, minibatch_size=100, n_train=20000, n_valid=4000,
-                 seq_len=32, vocab=16, **kw):
+                 seq_len=32, vocab=16, per_position=False, **kw):
+        # per_position discards the synth_induction train half below;
+        # regenerating with n_train=0 would change the (seeded) valid
+        # slice, so the one-time ~0.2 s is kept for reproducibility
         xt, yt, xv, yv = synth_induction(n_train, n_valid, seq_len, vocab)
+        self.per_position = bool(per_position)
+        self._train_mask = None
+        if self.per_position:
+            xt, yt, self._train_mask = synth_repeat(n_train, seq_len,
+                                                    vocab)
+            yv = np.concatenate([xv[:, 1:], yv[:, None]], axis=1)
         super().__init__({TRAIN: xt, VALID: xv},
                          {TRAIN: yt, VALID: yv},
                          minibatch_size=minibatch_size, **kw)
         self.vocab = vocab
         self.seq_len = seq_len
+
+    def make_batch(self, chunk, klass):
+        batch = super().make_batch(chunk, klass)
+        if self.per_position:
+            pad = np.asarray(batch["@mask"], np.float32)  # (bs,)
+            m = np.repeat(pad[:, None], self.seq_len, axis=1)
+            if klass == TRAIN:
+                # train on the induction-predictable second copy only
+                # (per-sample extent — the repeat offset varies). chunk
+                # is the UNPADDED index list; pad it like super() does
+                # (pad rows index row 0 and are zeroed by `pad` anyway).
+                idx = np.zeros(self.minibatch_size, np.int64)
+                idx[:len(chunk)] = chunk
+                m = m * self._train_mask[idx]
+            else:
+                m[:, :-1] = 0.0  # metric = last-position recall only
+            batch["@mask"] = m
+        return batch
 
 
 INDUCTION_CONFIG = {
