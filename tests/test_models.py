@@ -246,13 +246,30 @@ def test_induction_loader_per_position_masks():
     mt = np.asarray(bt["@mask"])
     assert y.shape == x.shape and mt.shape == x.shape
     np.testing.assert_array_equal(y[:, :-1], x[:, 1:])  # next-token shift
+    saw_rep = saw_trig = False
     for r in range(10):
         L = int(mt[r].sum())
+        if L == 1 and mt[r, -1] == 1:
+            # trigger-task training row: supervised at the last position
+            saw_trig = True
+            continue
+        saw_rep = True
         assert 4 <= L <= 8  # varied per-sample repeat extent
         assert (mt[r, -L:] == 1).all() and (mt[r, :-L] == 0).all()
         # the masked (trainable) second copy repeats the first copy
         np.testing.assert_array_equal(x[r, -L:], x[r, -2 * L:-L])
         assert y[r, -1] == x[r, -2 * L]  # the repetition continues
+    # the curriculum mixes both row kinds (scan ALL batches for the
+    # rarer kind so the assertion is not permutation-dependent)
+    assert saw_rep
+    for b2 in ld.iter_epoch(TRAIN):
+        m2 = np.asarray(b2["@mask"])
+        pad2 = m2.sum(1)
+        if ((pad2 == 1) & (m2[:, -1] == 1)).any():
+            saw_trig = True
+            break
+    assert saw_trig
+
     # VALID keeps the trigger-recall task: last-position-only metric
     xv, yv = np.asarray(bv["@input"]), np.asarray(bv["@labels"])
     mv = np.asarray(bv["@mask"])

@@ -329,3 +329,21 @@ def test_per_position_dense_sequence_head(rng):
         ws, mets = step(ws, batch)
         losses.append(float(mets["loss"]))
     assert losses[-1] < losses[0] * 0.8
+
+
+def test_decision_restore_honors_new_budget():
+    """Resuming a snapshot must keep the CURRENT run's epoch budget:
+    restoring max_epochs/fail_iterations/complete from the payload would
+    pin a curriculum fine-tune to the original run's budget."""
+    from veles_tpu.runtime.decision import Decision
+    d1 = Decision(max_epochs=10, fail_iterations=5)
+    for ep in range(10):
+        d1.on_epoch(ep, {}, {"error_pct": 50.0 - ep})
+    assert d1.complete
+    st = d1.state()
+    d2 = Decision(max_epochs=30, fail_iterations=30)
+    d2.set_state(st)
+    assert d2.max_epochs == 30 and d2.fail_iterations == 30
+    assert not d2.complete          # derived, not restored
+    assert d2.best_value == st["best_value"]  # progress IS restored
+    assert not d2.on_epoch(10, {}, {"error_pct": 39.0})  # keeps going

@@ -92,16 +92,35 @@ class InductionLoader(FullBatchLoader):
     measures pure induction recall (the family's quality bar)."""
 
     def __init__(self, minibatch_size=100, n_train=20000, n_valid=4000,
-                 seq_len=32, vocab=16, per_position=False, **kw):
-        # per_position discards the synth_induction train half below;
+                 seq_len=32, vocab=16, per_position=False,
+                 repeat_fraction=0.5, **kw):
+        # per_position replaces the synth_induction train half below;
         # regenerating with n_train=0 would change the (seeded) valid
         # slice, so the one-time ~0.2 s is kept for reproducibility
         xt, yt, xv, yv = synth_induction(n_train, n_valid, seq_len, vocab)
         self.per_position = bool(per_position)
         self._train_mask = None
         if self.per_position:
-            xt, yt, self._train_mask = synth_repeat(n_train, seq_len,
-                                                    vocab)
+            # curriculum mixture in one dataset, expressed purely via
+            # per-sample masks: ``repeat_fraction`` varied-offset
+            # repeated segments (dense generic copy signal — forms the
+            # induction circuit) and the rest trigger-task sequences
+            # supervised at the last position only (the evaluation
+            # distribution — consolidates the circuit on arbitrary
+            # trigger placements). Phase the fractions via snapshot
+            # restore for a sequential curriculum (repeats first).
+            if not 0.0 <= float(repeat_fraction) <= 1.0:
+                raise ValueError(
+                    f"repeat_fraction={repeat_fraction} must be in [0, 1]")
+            n_rep = int(n_train * float(repeat_fraction))
+            xr, yr, mr = synth_repeat(n_rep, seq_len, vocab)
+            xg, yg = xt[:n_train - n_rep], yt[:n_train - n_rep]
+            yg = np.concatenate([xg[:, 1:], yg[:, None]], axis=1)
+            mg = np.zeros((len(xg), seq_len), np.float32)
+            mg[:, -1] = 1.0
+            xt = np.concatenate([xr, xg])
+            yt = np.concatenate([yr, yg])
+            self._train_mask = np.concatenate([mr, mg])
             yv = np.concatenate([xv[:, 1:], yv[:, None]], axis=1)
         super().__init__({TRAIN: xt, VALID: xv},
                          {TRAIN: yt, VALID: yv},

@@ -101,9 +101,27 @@ class Decision(Logger):
             self.complete = True
         return self.complete
 
+    #: config knobs belong to the CURRENT run's config — restoring them
+    #: from a snapshot would silently pin a resumed run to the ORIGINAL
+    #: run's settings (observed: a curriculum fine-tune with
+    #: max_epochs=330 stopping at the phase-1 budget of 170). Progress
+    #: fields (best_value, epochs_since_improvement, lr_multiplier...)
+    #: DO restore.
+    _CONFIG_KEYS = ("max_epochs", "fail_iterations", "metric",
+                    "rollback_after", "rollback_lr_scale")
+
     def state(self) -> dict:
         return {k: v for k, v in vars(self).items() if not k.startswith("_")}
 
     def set_state(self, st: dict) -> None:
+        # ``complete`` is derived from progress vs budget: keep it only
+        # when the budget is unchanged (plain resume of a finished run
+        # exits immediately); under a NEW budget it must be recomputed,
+        # i.e. training continues.
+        same_budget = (st.get("max_epochs") == self.max_epochs and
+                       st.get("fail_iterations") == self.fail_iterations)
         for k, v in st.items():
+            if k in self._CONFIG_KEYS or (k == "complete"
+                                          and not same_budget):
+                continue
             setattr(self, k, v)
