@@ -254,11 +254,18 @@ def test_induction_loader_per_position_masks():
             saw_trig = True
             continue
         saw_rep = True
-        assert 4 <= L <= 8  # varied per-sample repeat extent
+        assert 2 <= L <= 4  # varied per-sample segment length (T//4=4)
         assert (mt[r, -L:] == 1).all() and (mt[r, :-L] == 0).all()
-        # the masked (trainable) second copy repeats the first copy
-        np.testing.assert_array_equal(x[r, -L:], x[r, -2 * L:-L])
-        assert y[r, -1] == x[r, -2 * L]  # the repetition continues
+        # the masked tail copy repeats some earlier window (the source
+        # sits at a varied position -> varied match distances)
+        T = x.shape[1]
+        starts = [a for a in range(0, T - 2 * L + 1)
+                  if (x[r, a:a + L] == x[r, -L:]).all()]
+        assert starts, (r, L, x[r])
+        # chance duplicates can match too; the label follows ONE source
+        assert any(
+            y[r, -1] == (x[r, a + L] if a + L < T - L else x[r, a])
+            for a in starts)
     # the curriculum mixes both row kinds (scan ALL batches for the
     # rarer kind so the assertion is not permutation-dependent)
     assert saw_rep

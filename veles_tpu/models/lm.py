@@ -71,13 +71,23 @@ def synth_repeat(n: int, seq_len: int, vocab: int, seed: int = 20260733):
     x = rng.integers(0, vocab, (n, T)).astype(np.int32)
     y = np.zeros((n, T), np.int32)
     m = np.zeros((n, T), np.float32)
-    lens = rng.integers(4, T // 2 + 1, n)
+    # Short segments whose FIRST copy sits anywhere before the tail
+    # copy: the source→copy match distance then spans ~[2, T-2]. (A
+    # contiguous-[u,u] variant only trains distances ≤ T/2, and the
+    # trigger task's matches reach T-2 — the untrained long-distance
+    # half dominated the residual error.)
+    max_l = max(2, min(16, T // 4))
+    lens = rng.integers(2, max_l + 1, n)
     for i in range(n):
         L = int(lens[i])
-        u = x[i, T - 2 * L:T - L]          # segment = its first copy
-        x[i, T - L:] = u                   # second copy
+        a = int(rng.integers(0, T - 2 * L + 1))   # first-copy start
+        u = x[i, a:a + L]
+        x[i, T - L:] = u                          # tail copy
         y[i, :-1] = x[i, 1:]
-        y[i, -1] = u[0]                    # the repetition continues
+        # the last position's induction answer: the token after the
+        # SOURCE copy (x[a+L] — for j < L-1 the shift labels already
+        # agree with the copy structure)
+        y[i, -1] = x[i, a + L] if a + L < T - L else u[0]
         m[i, T - L:] = 1.0
     return x, y, m
 
@@ -93,7 +103,7 @@ class InductionLoader(FullBatchLoader):
 
     def __init__(self, minibatch_size=100, n_train=20000, n_valid=4000,
                  seq_len=32, vocab=16, per_position=False,
-                 repeat_fraction=0.5, **kw):
+                 repeat_fraction=0.5, data_seed=None, **kw):
         # per_position replaces the synth_induction train half below;
         # regenerating with n_train=0 would change the (seeded) valid
         # slice, so the one-time ~0.2 s is kept for reproducibility
@@ -113,7 +123,13 @@ class InductionLoader(FullBatchLoader):
                 raise ValueError(
                     f"repeat_fraction={repeat_fraction} must be in [0, 1]")
             n_rep = int(n_train * float(repeat_fraction))
-            xr, yr, mr = synth_repeat(n_rep, seq_len, vocab)
+            # data_seed varies the REPEAT half across curriculum phases
+            # (fresh samples per phase); the trigger/valid sets keep the
+            # fixed benchmark seed
+            xr, yr, mr = synth_repeat(
+                n_rep, seq_len, vocab,
+                **({"seed": int(data_seed)} if data_seed is not None
+                   else {}))
             xg, yg = xt[:n_train - n_rep], yt[:n_train - n_rep]
             yg = np.concatenate([xg[:, 1:], yg[:, None]], axis=1)
             mg = np.zeros((len(xg), seq_len), np.float32)

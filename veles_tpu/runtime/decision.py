@@ -125,3 +125,10 @@ class Decision(Logger):
                                           and not same_budget):
                 continue
             setattr(self, k, v)
+        if "metric" in st and st["metric"] != self.metric:
+            # best_value is in the SAVED metric's units; comparing the
+            # current metric against it would freeze/poison improvement
+            # tracking — start the gauge fresh under the new metric
+            self.best_value = float("inf")
+            self.best_epoch = -1
+            self.epochs_since_improvement = 0
