@@ -142,8 +142,7 @@ class DecodePlan:
             else:
                 self._pointwise_ok(u)
                 self.seq_handlers.append(("pointwise", u))
-        self._attn_units = [
-            h for h in self._iter_attn()]
+        self._attn_units = list(self._iter_attn())
 
     @staticmethod
     def _check_attn(u):

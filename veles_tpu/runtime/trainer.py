@@ -137,7 +137,6 @@ class Trainer(Logger):
                         "(the padded tail batch would skew the "
                         "per-microbatch loss mean); adjust the batch "
                         "size or unset pipeline_microbatches")
-            if fused_pp:
                 self._train_step, self._state_sh, self._batch_sh = \
                     self.workflow.make_pipeline_train_step(
                         self.optimizer, self.mesh, self.wstate,
