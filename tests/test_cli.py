@@ -278,3 +278,51 @@ def test_profile_flag_writes_trace(tmp_path, config_file):
     assert r.returncode == 0, r.stderr
     found = glob.glob(str(tdir) + "/**/*", recursive=True)
     assert any(os.path.isfile(f) for f in found), found
+
+
+LM_CONFIG_JSON = {
+    "workflow": {
+        "name": "cli_lm",
+        "layers": [
+            {"type": "embedding", "vocab": 10, "dim": 16, "name": "emb"},
+            {"type": "attention", "n_heads": 2, "rope": True,
+             "residual": True, "name": "a1"},
+            {"type": "seq_last", "name": "last"},
+            {"type": "softmax", "output_size": 10, "name": "out"},
+        ],
+        "loss": "softmax",
+        "optimizer": "adam",
+        "optimizer_args": {"lr": 0.002},
+        "max_epochs": 1,
+        "fail_iterations": 5,
+    },
+    "loader": {"name": "induction", "minibatch_size": 50,
+               "n_train": 200, "n_valid": 50, "seq_len": 12,
+               "vocab": 10},
+}
+
+
+def test_cli_generate_mode(tmp_path):
+    """--generate decodes a continuation with the (restored) model
+    instead of training (pairs with veles_serve --generate)."""
+    cfg = tmp_path / "lm.json"
+    cfg.write_text(json.dumps(LM_CONFIG_JSON))
+    r = run_cli(tmp_path, str(cfg), "--random-seed", "1",
+                "--snapshot-dir", str(tmp_path / "snap"))
+    assert r.returncode == 0, r.stderr
+    snap = tmp_path / "snap" / "cli_lm_best.json"
+    assert snap.exists()
+    r2 = run_cli(tmp_path, str(cfg), "--snapshot", str(snap),
+                 "--generate", "4", "--prompt", "1,2,3,4,5;5,6,7,8,9",
+                 "--result-file", str(tmp_path / "gen.json"))
+    assert r2.returncode == 0, r2.stderr
+    out = json.loads(r2.stdout.strip().splitlines()[-1])
+    assert out["prompt_len"] == 5
+    toks = out["tokens"]
+    assert len(toks) == 2 and len(toks[0]) == 9
+    assert toks[0][:5] == [1, 2, 3, 4, 5]
+    assert all(0 <= t < 10 for row in toks for t in row)
+    assert json.loads((tmp_path / "gen.json").read_text()) == out
+    # --generate without --prompt is a clear error
+    r3 = run_cli(tmp_path, str(cfg), "--generate", "2")
+    assert r3.returncode != 0 and "--prompt" in (r3.stderr + r3.stdout)

@@ -122,6 +122,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "forced device sync and print the top-5 table "
                         "(reference: --sync-run honest per-unit timers + "
                         "Workflow.print_stats)")
+    p.add_argument("--generate", type=int, metavar="N", default=None,
+                   help="decode N tokens after --prompt with the "
+                        "(restored) sequence model instead of training "
+                        "— KV-cached greedy/temperature sampling "
+                        "(veles_tpu.generate); prints the token rows "
+                        "as JSON")
+    p.add_argument("--prompt", default=None,
+                   help="comma-separated token ids for --generate "
+                        "(';' separates batch rows), or @file.npy")
+    p.add_argument("--temperature", type=float, default=0.0,
+                   help="sampling temperature for --generate "
+                        "(0 = greedy)")
     p.add_argument("--status-port", type=int, default=None,
                    help="serve a live status page (JSON + HTML with "
                         "auto-refreshing metric plots) on this port; 0 "
@@ -598,6 +610,39 @@ def main(argv=None) -> int:
         return 0
     if args.snapshot:
         trainer.restore(args.snapshot)
+    if args.generate is not None:
+        # decode mode: the trained (or restored) sequence model emits a
+        # continuation instead of training (reference has no LM family;
+        # this pairs with `veles_serve --generate` for the native path)
+        import numpy as np
+
+        from .runtime.generate import generate as _generate
+        if not args.prompt:
+            raise SystemExit("--generate needs --prompt "
+                             "(token ids, or @file.npy)")
+        if args.prompt.startswith("@"):
+            prompt = np.atleast_2d(
+                np.load(args.prompt[1:])).astype(np.int32)
+        else:
+            rows = [[int(t) for t in row.split(",") if t.strip()]
+                    for row in args.prompt.split(";") if row.strip()]
+            if not rows or len({len(r) for r in rows}) != 1:
+                raise SystemExit(
+                    "--prompt rows must be non-empty and equal length "
+                    f"(got lengths {[len(r) for r in rows]})")
+            prompt = np.asarray(rows, np.int32)
+        import jax as _jax
+        key = _jax.random.key(int(root.common.get("random_seed", 0)))
+        toks = _generate(trainer.workflow, trainer.wstate, prompt,
+                         args.generate, temperature=args.temperature,
+                         key=key)
+        out = {"prompt_len": int(prompt.shape[1]),
+               "tokens": np.asarray(toks).tolist()}
+        print(json.dumps(out))
+        if args.result_file:
+            with open(args.result_file, "w") as f:
+                json.dump(out, f, indent=1)
+        return 0
     if args.profile_units:
         from .loader.base import TRAIN, VALID as _VALID
         klass = TRAIN if trainer.loader.class_lengths[TRAIN] else _VALID
