@@ -11,7 +11,8 @@
 #
 # Measured trajectory (2026-07-31, --random-seed per phase as below):
 # 96.7 % -> 36.7 % (phase 1) -> 10.6 % -> 7.1 % (all-distance data)
-# -> 5.6 % -> 4.3 % -> 4.0 % ... (fresh-data phases). Result file of
+# -> 5.6 % -> 4.3 % -> 4.0 % -> 3.5 % (fresh-data phases; converged
+# after ~3 stagnant phases). Result file of
 # the last phase carries the final best_value.
 set -e
 CFG=configs/induction_lm64.json
