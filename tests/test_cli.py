@@ -326,3 +326,40 @@ def test_cli_generate_mode(tmp_path):
     # --generate without --prompt is a clear error
     r3 = run_cli(tmp_path, str(cfg), "--generate", "2")
     assert r3.returncode != 0 and "--prompt" in (r3.stderr + r3.stdout)
+
+
+def test_cli_export_mode(tmp_path):
+    """--export writes a native-serving package of the restored model:
+    train -> snapshot -> export -> veles_serve is fully CLI-driven."""
+    cfg = tmp_path / "lm.json"
+    cfg.write_text(json.dumps(LM_CONFIG_JSON))
+    r = run_cli(tmp_path, str(cfg), "--random-seed", "1",
+                "--snapshot-dir", str(tmp_path / "snap"))
+    assert r.returncode == 0, r.stderr
+    snap = tmp_path / "snap" / "cli_lm_best.json"
+    pkg = tmp_path / "pkg"
+    r2 = run_cli(tmp_path, str(cfg), "--snapshot", str(snap),
+                 "--export", str(pkg))
+    assert r2.returncode == 0, r2.stderr
+    out = json.loads(r2.stdout.strip().splitlines()[-1])
+    assert out["exported"] == str(pkg)
+    contents = json.loads((pkg / "contents.json").read_text())
+    assert any(u["class"] == "MultiHeadAttention"
+               for u in contents["units"])
+    # the exported package runs in the native runtime (build on demand
+    # like tests/test_serving.py's binary fixture)
+    binary = os.path.join(REPO, "serving", "veles_serve")
+    if not os.path.exists(binary):
+        rb = subprocess.run(["make", "-s"],
+                            cwd=os.path.join(REPO, "serving"),
+                            capture_output=True, text=True, timeout=300)
+        assert rb.returncode == 0, rb.stderr
+    import numpy as np
+    x = np.random.default_rng(0).integers(0, 10, (50, 12))
+    np.save(tmp_path / "x.npy", x.astype(np.float32))
+    r3 = subprocess.run(
+        [binary, str(pkg), str(tmp_path / "x.npy"),
+         str(tmp_path / "y.npy")],
+        capture_output=True, text=True, timeout=120)
+    assert r3.returncode == 0, r3.stderr
+    assert np.load(tmp_path / "y.npy").shape == (50, 10)

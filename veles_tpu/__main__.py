@@ -122,6 +122,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "forced device sync and print the top-5 table "
                         "(reference: --sync-run honest per-unit timers + "
                         "Workflow.print_stats)")
+    p.add_argument("--export", metavar="DIR[.zip]", default=None,
+                   help="write a native-serving package of the "
+                        "(restored) model and exit — contents.json + "
+                        "npy for veles_serve (reference: "
+                        "Workflow.package_export, veles/workflow.py:868)")
     p.add_argument("--generate", type=int, metavar="N", default=None,
                    help="decode N tokens after --prompt with the "
                         "(restored) sequence model instead of training "
@@ -610,6 +615,19 @@ def main(argv=None) -> int:
         return 0
     if args.snapshot:
         trainer.restore(args.snapshot)
+    if args.export:
+        from .export import export_package
+        spec = trainer._batch_spec["@input"]
+        export_package(trainer.workflow, trainer.wstate, args.export,
+                       input_spec={"shape": list(spec.shape),
+                                   "dtype": str(spec.dtype)})
+        out = {"exported": args.export,
+               "units": len(trainer.workflow.units)}
+        print(json.dumps(out))
+        if args.result_file:
+            with open(args.result_file, "w") as f:
+                json.dump(out, f, indent=1)
+        return 0
     if args.generate is not None:
         # decode mode: the trained (or restored) sequence model emits a
         # continuation instead of training (reference has no LM family;
