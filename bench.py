@@ -70,13 +70,17 @@ def main():
     import os
     import signal
 
+    # Metrics measured so far; _die prints them so a mid-bench hang
+    # (e.g. during the optional e2e blocks) still reports the staged
+    # number instead of discarding it.
+    partial = {"metric": "alexnet_train_samples_per_sec_per_chip",
+               "value": None, "unit": "samples/sec/chip",
+               "vs_baseline": None}
+
     def _die():
-        print(json.dumps({
-            "metric": "alexnet_train_samples_per_sec_per_chip",
-            "value": None, "unit": "samples/sec/chip",
-            "vs_baseline": None,
-            "error": "device hang after successful probe (watchdog)",
-        }), flush=True)
+        out = dict(partial)
+        out["error"] = "device hang after successful probe (watchdog)"
+        print(json.dumps(out), flush=True)
         os.kill(os.getpid(), signal.SIGKILL)
 
     watchdog = threading.Timer(180.0, _die)
@@ -136,33 +140,88 @@ def main():
 
     sps = BATCH * ITERS / dt
     sps_per_chip = sps / max(n_chips, 1)
+    partial.update(
+        value=round(sps_per_chip, 1),
+        vs_baseline=round(sps_per_chip / V100_ALEXNET_SAMPLES_PER_SEC, 3),
+        step_ms=round(1000 * dt / ITERS, 2))
 
-    # -- end-to-end variant: host image path + prefetch -------------------
-    # (round-1 verdict weak #3: the staged number excludes the input
-    # pipeline). uint8 host store -> random crop/mirror on host ->
-    # device-side mean/disp normalize (Pallas) via Trainer prefetch.
-    from veles_tpu.models.alexnet import alexnet_e2e_workflow
-    e2e_sps = None
+    # -- end-to-end input-pipeline variants (round-1 verdict weak #3: the
+    # staged number excludes the input pipeline). Both variants share one
+    # measurement recipe so their comparison is apples-to-apples; each
+    # block gets its OWN watchdog budget (a fresh tunnel hang window —
+    # round-2 outage postmortem), and results land in `partial` as they
+    # are measured so a later hang cannot discard them.
+    def timed_e2e(build, label, check=None, budget_s=900.0):
+        w = threading.Timer(budget_s, _die)
+        w.daemon = True
+        w.start()
+        try:
+            sw = build()
+            trainer = sw.make_trainer(sw.loader)
+            trainer.initialize(seed=0)
+            if check is not None:
+                check(sw)
+            trainer._run_epoch_train(0)  # compile + warm
+            t0 = time.perf_counter()
+            tot = 0.0
+            for ep in (1, 2):
+                tot += trainer._run_epoch_train(ep).get("n_samples", 0.0)
+            return tot / (time.perf_counter() - t0)
+        except Exception as e:  # keep earlier numbers even if this breaks
+            print(f"# {label} e2e measurement failed: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+            return None
+        finally:
+            w.cancel()
+
+    # host path: uint8 host store -> random crop/mirror on host ->
+    # device-side mean/disp normalize via Trainer prefetch
+    from veles_tpu.models.alexnet import (alexnet_e2e_device_workflow,
+                                          alexnet_e2e_workflow)
+    e2e_sps = timed_e2e(
+        lambda: alexnet_e2e_workflow(minibatch_size=BATCH, n_train=8192),
+        "host-path")
+    if e2e_sps:
+        partial["e2e_samples_per_sec"] = round(e2e_sps, 1)
+
+    # TPU-native formulation: device-resident uint8 store, on-device
+    # crop/mirror/normalize (FullBatchAugmentedLoader) — only indices +
+    # augmentation descriptors cross the host->device boundary
+
+    def _must_be_on_device(sw):
+        if not sw.loader.on_device:
+            # OOM fallback silently degrades to the HOST gather — that
+            # would time the wrong pipeline under this row's name.
+            raise RuntimeError("store fell back to host gather (OOM?)")
+
+    e2e_dev_sps = timed_e2e(
+        lambda: alexnet_e2e_device_workflow(minibatch_size=BATCH,
+                                            n_train=8192),
+        "device-aug", check=_must_be_on_device)
+    if e2e_dev_sps:
+        partial["e2e_device_aug_samples_per_sec"] = round(e2e_dev_sps, 1)
+
+    # -- host->device link bandwidth (context for the host-path e2e row:
+    # over the axon tunnel this is the binding constraint, not the
+    # framework; on a real v5e host PCIe gives ~GB/s x10 more).
+    h2d_mb_s = None
+    watchdog = threading.Timer(300.0, _die)
+    watchdog.daemon = True
+    watchdog.start()
     try:
-        sw2 = alexnet_e2e_workflow(minibatch_size=BATCH, n_train=8192)
-        trainer = sw2.make_trainer(sw2.loader)
-        trainer.initialize(seed=0)
-        trainer._run_epoch_train(0)  # compile + warm
+        import jax as _jax
+        buf = np.zeros((64, 1024, 1024), np.uint8)  # 64 MB
+        _jax.device_put(buf[:1], dev).block_until_ready()
         t0 = time.perf_counter()
-        tot = 0.0
-        for ep in (1, 2):
-            mets2 = trainer._run_epoch_train(ep)
-            tot += mets2.get("n_samples", 0.0)
-        e2e_sps = tot / (time.perf_counter() - t0)
-    except Exception as e:  # report the staged number even if e2e breaks
-        print(f"# e2e measurement failed: {type(e).__name__}: {e}",
-              file=sys.stderr)
+        _jax.device_put(buf, dev).block_until_ready()
+        h2d_mb_s = buf.nbytes / (time.perf_counter() - t0) / 1e6
+    except Exception:
+        pass
+    watchdog.cancel()
 
-    result = {
-        "metric": "alexnet_train_samples_per_sec_per_chip",
-        "value": round(sps_per_chip, 1),
-        "unit": "samples/sec/chip",
-        "vs_baseline": round(sps_per_chip / V100_ALEXNET_SAMPLES_PER_SEC, 3),
+    # One source of truth: everything already accumulated in `partial`
+    # (what a watchdog _die would have printed) + final-only context.
+    partial.update({
         "vs_baseline_range": [
             round(sps_per_chip / V100_BRACKET[1], 3),
             round(sps_per_chip / V100_BRACKET[0], 3)],
@@ -170,13 +229,12 @@ def main():
         "iters": ITERS,
         "n_chips": n_chips,
         "device": str(dev),
-        "step_ms": round(1000 * dt / ITERS, 2),
         "final_loss": round(final_loss, 4),
-        "e2e_samples_per_sec": round(e2e_sps, 1) if e2e_sps else None,
         "e2e_over_staged": round(e2e_sps / sps_per_chip, 3)
         if e2e_sps else None,
-    }
-    print(json.dumps(result))
+        "h2d_link_mb_per_sec": round(h2d_mb_s, 1) if h2d_mb_s else None,
+    })
+    print(json.dumps(partial))
 
 
 if __name__ == "__main__":

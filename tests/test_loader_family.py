@@ -223,3 +223,46 @@ def test_set_state_preserves_shard_identity(rng):
     assert b.epoch_number == 2          # training state adopted
     assert b.shard_index == 1           # topology kept
     assert b.shard_count == 2
+
+
+def test_fullbatch_augmented_device_matches_host(rng):
+    """Device-side crop+mirror (FullBatchAugmentedLoader) must produce
+    byte-identical pixels to the host numpy fallback — the same-math
+    discipline of the reference's per-backend tests
+    (veles/tests/accelerated_test.py:41-70)."""
+    from veles_tpu.loader import FullBatchAugmentedLoader
+    from veles_tpu.loader.base import TRAIN, VALID
+
+    store = {TRAIN: rng.integers(0, 256, (40, 12, 12, 3)).astype(np.uint8),
+             VALID: rng.integers(0, 256, (16, 12, 12, 3)).astype(np.uint8)}
+    labels = {TRAIN: np.arange(40, dtype=np.int32) % 7,
+              VALID: np.arange(16, dtype=np.int32) % 7}
+
+    def build(force_host):
+        ld = FullBatchAugmentedLoader(
+            {k: v.copy() for k, v in store.items()},
+            {k: v.copy() for k, v in labels.items()},
+            minibatch_size=8, crop_hw=(8, 8), mirror=True,
+            force_host=force_host)
+        ld.initialize()
+        return ld
+
+    dev, host = build(False), build(True)
+    assert dev.on_device and not host.on_device
+    for klass in (TRAIN, VALID):
+        for bd, bh in zip(dev.iter_epoch(klass, 0),
+                          host.iter_epoch(klass, 0)):
+            for key in bh:
+                np.testing.assert_array_equal(
+                    np.asarray(bd[key]), np.asarray(bh[key]),
+                    err_msg=f"klass={klass} key={key}")
+
+    # train crops really vary; eval is the deterministic center crop
+    b0 = next(dev.iter_epoch(TRAIN, 0))
+    x0 = np.asarray(b0["@input"])
+    assert x0.shape == (8, 8, 8, 3) and x0.dtype == np.uint8
+    offs, flips = dev._draw_aug(64, TRAIN, 0)
+    assert offs.min() >= 0 and offs.max() <= 4
+    assert 0 < flips.sum() < 64 and len(np.unique(offs, axis=0)) > 1
+    offs_e, flips_e = dev._draw_aug(8, VALID, 0)
+    assert (offs_e == 2).all() and not flips_e.any()

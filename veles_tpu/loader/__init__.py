@@ -1,6 +1,6 @@
 from .base import (TEST, VALID, TRAIN, CLASS_NAMES, Loader, ArrayLoader,
                    LoaderError)
-from .fullbatch import FullBatchLoader
+from .fullbatch import FullBatchAugmentedLoader, FullBatchLoader
 from .image import FileImageLoader, Hdf5Loader, ImageLoader
 from .interactive import QueueLoader
 from .saver import MinibatchesLoader, MinibatchesSaver

@@ -6,13 +6,14 @@ memory once, minibatches gathered by a fill_minibatch_data_labels kernel
 (ocl/fullbatch_loader.cl) from shuffled indices; graceful host fallback on
 OOM (:164-242).
 
-TPU redesign: the dataset lives as jax Arrays in HBM; the gather is
-``jnp.take(data, idx, axis=0)`` inside a tiny jitted function — only the
-*indices* cross the host→device boundary each step (the exact analog of the
-reference's ship-indices-only distributed protocol,
-veles/loader/base.py:631-639). On HBM-overflow the loader transparently
-degrades to host-side gather (ArrayLoader behavior), mirroring the
-reference's OOM fallback.
+TPU redesign: the dataset lives as jax Arrays in HBM; the gather runs in a
+tiny jitted function — the Pallas per-index DMA kernel on TPU (barrier'd
+on-chip winner, 1.42x vs jnp.take) and ``jnp.take(data, idx, axis=0)``
+elsewhere — so only the *indices* cross the host→device boundary each step
+(the exact analog of the reference's ship-indices-only distributed
+protocol, veles/loader/base.py:631-639). On HBM-overflow the loader
+transparently degrades to host-side gather (ArrayLoader behavior),
+mirroring the reference's OOM fallback.
 """
 
 from __future__ import annotations
@@ -23,7 +24,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..ops import use_pallas_default
 from .base import ArrayLoader, TEST, TRAIN, VALID
+
+# Packed-DMA-gather eligibility, calibrated to the on-chip measurement
+# (bench_tpu.py gather row: 3,136-byte rows at 30% pad overhead won 1.42x
+# vs jnp.take on v5e) — don't pack below the measured-winning envelope.
+_PACK_MIN_ROW_BYTES = 3072
+_PACK_MAX_PAD = 1.35
 
 
 class FullBatchLoader(ArrayLoader):
@@ -49,7 +57,7 @@ class FullBatchLoader(ArrayLoader):
             return
         except (RuntimeError, jax.errors.JaxRuntimeError) as e:
             self._dev_data.clear()
-            if self._use_pallas_gather is not True:
+            if not self._want_pallas():
                 # gather is plain jnp.take (no packed layout) — a retry
                 # without packing would re-run a byte-identical upload.
                 err = e
@@ -70,6 +78,15 @@ class FullBatchLoader(ArrayLoader):
         self._dev_data.clear()
         self.on_device = False
 
+    def _want_pallas(self) -> bool:
+        """Effective gather policy: explicit flag wins; None follows the
+        shared platform default (Pallas on TPU — see comment in _upload)."""
+        if self._use_pallas_gather is not None:
+            return bool(self._use_pallas_gather)
+        platform = (self._device.platform if self._device is not None
+                    else None)
+        return use_pallas_default(platform)
+
     def _upload(self, allow_pallas: bool = True):
         put = (lambda x: jax.device_put(x, self._device)) \
             if self._device is not None else jax.device_put
@@ -83,26 +100,30 @@ class FullBatchLoader(ArrayLoader):
                 entry["@targets"] = put(self._targets[klass])
             self._dev_data[klass] = entry
 
-        # The Pallas DMA-gather kernel is TPU-only AND opt-in: measured
-        # on-chip (bench_tpu.py, v5e, 512 rows of a 60k x 784 set) XLA's
-        # own gather won — 0.64 ms vs 0.84 ms — so jnp.take is the
-        # default and the DMA kernel engages only on an explicit
-        # ``use_pallas_gather=True`` (kept for parity with
-        # ocl/fullbatch_loader.cl and for layouts where take regresses).
-        # PROVISIONAL: that measurement used the pre-optimization_barrier
-        # harness that BASELINE.md says flattered XLA on bandwidth-bound
-        # kernels; the default follows whichever side wins the barrier'd
-        # re-measurement (bench_tpu.py gather row).
-        use_pallas = allow_pallas and self._use_pallas_gather is True
+        # The Pallas DMA-gather kernel is the TPU default: measured on-chip
+        # with the optimization_barrier'd harness (bench_tpu.py, v5e,
+        # 512 rows of a 60k x 784 set) the per-index DMA kernel wins —
+        # 0.63 ms vs 0.89 ms for jnp.take (1.42x; gather-only — the
+        # bench row now also folds in the unpack slice, a ~1.6 MB
+        # reshape that cannot flip a 0.26 ms margin).  The earlier
+        # pre-barrier measurement that favored XLA (0.64 vs 0.84) let the
+        # chained harness fuse away XLA's output materialization; with a
+        # fair harness the winner flips, so per the reference's
+        # bench-and-persist-the-winner discipline
+        # (veles/backends.py:672-731) the default follows the platform
+        # policy, and ``use_pallas_gather=False`` forces jnp.take.
+        use_pallas = allow_pallas and self._want_pallas()
         if use_pallas:
             # Per-index HBM→HBM DMA kernel (parity:
             # ocl/fullbatch_loader.cl fill_minibatch_data_labels).  Big
             # arrays are packed into the kernel's tiled row layout ONCE
-            # here.  The layout pads features to a multiple of 8·128, so
-            # only arrays where that padding is cheap (<12.5% HBM overhead)
-            # and the row is big enough to benefit from DMA are packed;
-            # everything else (labels, small/awkward rows) stays on
-            # jnp.take.
+            # here.  Eligibility mirrors the measured winning envelope
+            # (bench_tpu.py gather row, which times the loader's full
+            # pack→gather→unpack path): the 784-feature f32 case (3.1 KB
+            # rows, padded to 1024 features = 30% HBM overhead) still won
+            # 1.42x, so rows of >= _PACK_MIN_ROW_BYTES with padding
+            # overhead <= _PACK_MAX_PAD are packed; labels, small and
+            # awkward rows stay on jnp.take.
             from ..ops.pallas_kernels import (pack_rows, gather_rows_packed,
                                               unpack_rows)
             packed_meta = {}
@@ -110,7 +131,12 @@ class FullBatchLoader(ArrayLoader):
                 for key, arr in entry.items():
                     f = int(np.prod(arr.shape[1:]))
                     f_pad = -(-f // 1024) * 1024
-                    if f >= 4096 and f_pad <= f * 1.125:
+                    # 4-byte dtypes only: the kernel's (8, 128) block
+                    # tiling and the measurements are f32/i32; narrower
+                    # dtypes tile differently and were never benched.
+                    if (arr.dtype.itemsize == 4
+                            and f * 4 >= _PACK_MIN_ROW_BYTES
+                            and f_pad <= f * _PACK_MAX_PAD):
                         packed, f, sshape = pack_rows(arr)
                         entry[key] = packed
                         packed_meta[key] = (f, tuple(sshape))
@@ -146,4 +172,158 @@ class FullBatchLoader(ArrayLoader):
         mask = np.zeros(bs, np.float32)
         mask[:valid_n] = 1.0
         batch["@mask"] = jnp.asarray(mask)
+        return batch
+
+class FullBatchAugmentedLoader(FullBatchLoader):
+    """Device-side random-crop + mirror augmentation over a device-resident
+    uint8 image store — the TPU-native input pipeline.
+
+    Reference analog: the host image pipeline's random crop/mirror
+    (veles/loader/image.py:106) feeding the fullbatch on-device gather
+    (veles/loader/fullbatch.py:79).  The reference did augmentation on the
+    host because its devices were remote OpenCL contexts; on TPU the HBM
+    holds the decoded uint8 store and the crop/mirror is pure slicing, so
+    the whole pipeline — gather by shuffled index, per-sample dynamic-slice
+    crop, conditional mirror — runs inside ONE jitted function on device.
+    Per step the host ships only the index vector plus a (B, 2) crop-offset
+    array and a (B,) flip mask (a few KB), not the pixels: the gather
+    half of the reference's ship-indices-only discipline, extended to
+    augmentation descriptors.
+
+    Train batches get random offsets/flips drawn deterministically from the
+    loader PRNG stream (reproducible across resume/shards, like
+    epoch_permutation); valid/test batches get the center crop, no flip.
+    The host OOM fallback reproduces identical pixels with numpy slicing.
+    """
+
+    def __init__(self, *args, crop_hw, mirror: bool = True, **kw):
+        # The packed Pallas gather stores rows flattened — useless here,
+        # since the crop must slice the (H, W, C) geometry before any
+        # reshape; the fused take+crop below IS the device path.
+        if kw.pop("use_pallas_gather", None):
+            raise ValueError(
+                "FullBatchAugmentedLoader fuses its own take+crop device "
+                "gather; use_pallas_gather does not apply")
+        super().__init__(*args, use_pallas_gather=False, **kw)
+        self.crop_hw = tuple(int(c) for c in crop_hw)
+        self.mirror = bool(mirror)
+        self._aug = None
+        self._aug_epoch = 0
+
+    def initialize(self):
+        # Validate BEFORE the (possibly GB-scale) upload: otherwise the
+        # same mistake fails three different ways later (np rng low>=high
+        # on train, negative center offsets on the host path, XLA
+        # dynamic_slice error on device).
+        ch, cw = self.crop_hw
+        for klass in (TEST, VALID, TRAIN):
+            if self._data.get(klass) is None:
+                continue
+            if self._data[klass].ndim < 3:
+                raise ValueError(
+                    f"class-{klass} store must be (N, H, W[, C]) images, "
+                    f"got shape {self._data[klass].shape}")
+            hs, ws = self._store_hw(klass)
+            if ch > hs or cw > ws:
+                raise ValueError(
+                    f"crop_hw {self.crop_hw} exceeds class-{klass} store "
+                    f"geometry {(hs, ws)}")
+        super().initialize()
+
+    def _store_hw(self, klass: int):
+        return self._data[klass].shape[1:3]
+
+    def iter_epoch(self, klass, epoch=None):
+        # Stash the epoch for _draw_aug (make_batch's signature has no
+        # epoch): crops must differ per epoch even after shuffle_limit
+        # freezes the permutation — epoch_permutation mixes epoch into
+        # its seed for the same reason (base.py). Only TRAIN draws
+        # consult it, so only a TRAIN iterator may write it — an eval
+        # iterator started mid-train-epoch (spec probe, mid-epoch
+        # validation) must not retroactively change the train crops.
+        if klass == TRAIN:
+            self._aug_epoch = (self.epoch_number if epoch is None
+                               else int(epoch))
+        yield from super().iter_epoch(klass, epoch)
+
+    def _draw_aug(self, n: int, klass: int, anchor: int):
+        """(offsets (n,2) int32, flips (n,) bool) for one minibatch —
+        deterministic in (loader seed, epoch, klass, first index),
+        matching the epoch_permutation determinism contract."""
+        hs, ws = self._store_hw(klass)
+        ch, cw = self.crop_hw
+        if klass == TRAIN:
+            from .. import prng
+            rng = np.random.Generator(np.random.PCG64(
+                [prng.get(self.prng_name).seed, self._aug_epoch, klass,
+                 anchor, 0xC407]))
+            offs = np.stack([rng.integers(0, hs - ch + 1, n),
+                             rng.integers(0, ws - cw + 1, n)],
+                            1).astype(np.int32)
+            flips = (rng.random(n) < 0.5) if self.mirror \
+                else np.zeros(n, bool)
+        else:
+            offs = np.broadcast_to(
+                np.array([(hs - ch) // 2, (ws - cw) // 2], np.int32),
+                (n, 2)).copy()
+            flips = np.zeros(n, bool)
+        return offs, flips
+
+    def _upload(self, allow_pallas: bool = True):
+        super()._upload(allow_pallas=False)
+        ch, cw = self.crop_hw
+
+        @jax.jit
+        def aug(tree, idx, offs, flips):
+            out = {}
+            for key, a in tree.items():
+                if key == "@input":
+                    imgs = jnp.take(a, idx, axis=0)
+
+                    def crop1(img, off, flip):
+                        c = jax.lax.dynamic_slice(
+                            img, (off[0], off[1]) + (0,) * (img.ndim - 2),
+                            (ch, cw) + img.shape[2:])
+                        return jnp.where(flip, c[:, ::-1], c)
+
+                    out[key] = jax.vmap(crop1)(imgs, offs, flips)
+                else:
+                    out[key] = jnp.take(a, idx, axis=0)
+            return out
+
+        self._aug = aug
+
+    def make_batch(self, chunk: np.ndarray, klass: int):
+        if not self.on_device:
+            return super(FullBatchLoader, self).make_batch(chunk, klass)
+        bs = self.minibatch_size
+        valid_n = len(chunk)
+        if valid_n < bs:
+            chunk = np.concatenate(
+                [chunk, np.zeros(bs - valid_n, chunk.dtype)])
+        anchor = int(chunk[0]) if valid_n else 0
+        offs, flips = self._draw_aug(bs, klass, anchor)
+        batch = dict(self._aug(self._dev_data[klass],
+                               jnp.asarray(chunk, jnp.int32),
+                               jnp.asarray(offs), jnp.asarray(flips)))
+        mask = np.zeros(bs, np.float32)
+        mask[:valid_n] = 1.0
+        batch["@mask"] = jnp.asarray(mask)
+        return batch
+
+    def fill_minibatch(self, indices, klass):
+        """Host fallback: numpy slicing, pixel-identical to the device
+        path (same _draw_aug descriptors)."""
+        batch = super().fill_minibatch(indices, klass)
+        ch, cw = self.crop_hw
+        offs, flips = self._draw_aug(
+            len(indices), klass, int(indices[0]) if len(indices) else 0)
+        imgs = batch["@input"]
+        out = np.empty(imgs.shape[:1] + (ch, cw) + imgs.shape[3:],
+                       imgs.dtype)
+        for i in range(len(imgs)):
+            oy, ox = offs[i]
+            c = imgs[i, oy:oy + ch, ox:ox + cw]
+            out[i] = c[:, ::-1] if flips[i] else c
+        batch["@input"] = out
         return batch

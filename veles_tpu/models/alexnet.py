@@ -116,11 +116,8 @@ class ImagenetHostLoader(Loader):
         return self._pool
 
     def load_data(self):
-        rng = np.random.default_rng(self.seed)
-        hw = self.STORE_HW
-        # deterministic synthetic "decoded JPEG" store (uint8)
-        self._store = rng.integers(
-            0, 256, (self.n_train + self.n_valid, hw, hw, 3), np.uint8)
+        self._store, _ = _synth_store(self.n_train + self.n_valid,
+                                      self.seed)
         self.class_lengths = [0, self.n_valid, self.n_train]
 
     def fill_minibatch(self, indices, klass):
@@ -172,11 +169,10 @@ def alexnet_workflow(minibatch_size=128, loader=None,
     return sw
 
 
-def alexnet_e2e_workflow(minibatch_size=128, n_train=4096,
-                         **overrides) -> StandardWorkflow:
-    """AlexNet fed through the host image path: uint8 batches from
-    ImagenetHostLoader, normalized on device by a prepended MeanDisp unit
-    (Pallas kernel) — the end-to-end throughput configuration."""
+def _e2e_config(**overrides) -> dict:
+    """AlexNet config with the device-side mean/disp normalize unit
+    prepended — shared by BOTH e2e variants so they measure the same
+    compute pipeline and differ only in where augmentation runs."""
     cfg = dict(ALEXNET_CONFIG)
     cfg["layers"] = [
         {"type": "norm", "name": "norm0",
@@ -184,7 +180,48 @@ def alexnet_e2e_workflow(minibatch_size=128, n_train=4096,
          "rdisp": np.full((INPUT_HW, INPUT_HW, 3), 1 / 64.0, np.float32)},
     ] + [dict(l) for l in ALEXNET_CONFIG["layers"]]
     cfg.update(overrides)
-    sw = StandardWorkflow(cfg)
+    return cfg
+
+
+def _synth_store(n: int, seed: int = 13):
+    """Deterministic synthetic decoded-JPEG store (uint8 256x256x3) +
+    labels — the single recipe behind every e2e input-pipeline variant."""
+    hw = ImagenetHostLoader.STORE_HW
+    rng = np.random.default_rng(seed)
+    store = rng.integers(0, 256, (n, hw, hw, 3), np.uint8)
+    labels = np.arange(n, dtype=np.int32) % 1000
+    return store, labels
+
+
+def alexnet_e2e_workflow(minibatch_size=128, n_train=4096,
+                         **overrides) -> StandardWorkflow:
+    """AlexNet fed through the host image path: uint8 batches from
+    ImagenetHostLoader, normalized on device by a prepended MeanDisp unit
+    (Pallas kernel) — the end-to-end throughput configuration."""
+    sw = StandardWorkflow(_e2e_config(**overrides))
     sw.loader = ImagenetHostLoader(minibatch_size=minibatch_size,
                                    n_train=n_train)
+    return sw
+
+
+def alexnet_e2e_device_workflow(minibatch_size=128, n_train=4096,
+                                n_valid=512, seed=13,
+                                **overrides) -> StandardWorkflow:
+    """End-to-end AlexNet on the TPU-native input pipeline: the uint8
+    256x256 store lives in HBM (FullBatchAugmentedLoader) and the random
+    crop + mirror + mean/disp normalize all run on device — per step the
+    host ships indices and a few KB of augmentation descriptors, nothing
+    else.  This is the formulation the host-streaming variant
+    (alexnet_e2e_workflow) converges to when host->device bandwidth, not
+    compute, is the binding constraint."""
+    from ..loader.base import TRAIN, VALID
+    from ..loader.fullbatch import FullBatchAugmentedLoader
+
+    sw = StandardWorkflow(_e2e_config(**overrides))
+    store, labels = _synth_store(n_train + n_valid, seed)
+    sw.loader = FullBatchAugmentedLoader(
+        {TRAIN: store[n_valid:], VALID: store[:n_valid]},
+        {TRAIN: labels[n_valid:], VALID: labels[:n_valid]},
+        minibatch_size=minibatch_size, crop_hw=(INPUT_HW, INPUT_HW),
+        mirror=True)
     return sw
