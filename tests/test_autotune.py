@@ -1,0 +1,130 @@
+"""Autotune: per-device measured op picks with a persisted winner DB
+(reference parity: veles/backends.py:672-731 block-size sweep persisted
+to devices/device_infos.json)."""
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from veles_tpu.config import root
+from veles_tpu.runtime import autotune
+
+
+@pytest.fixture
+def tuned(tmp_path, monkeypatch):
+    monkeypatch.setattr(root.common, "autotune", True)
+    monkeypatch.setattr(root.common, "cache_dir", str(tmp_path))
+    autotune._memo.clear()
+    yield str(tmp_path)
+    autotune._memo.clear()
+
+
+def test_pick_measures_and_persists(tuned):
+    calls = {"fast": 0, "slow": 0}
+
+    def fast(x):
+        calls["fast"] += 1
+        return x + 1.0
+
+    def slow(x):
+        calls["slow"] += 1
+        # 40 chained matmuls: reliably slower than one add
+        for _ in range(40):
+            x = x @ x * 1e-3
+        return x
+
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((128, 128)),
+                    jnp.float32)
+    w = autotune.pick("toy_op", {"slow": slow, "fast": fast}, [x])
+    assert w == "fast"
+
+    # persisted under the device DB with timings for both candidates
+    path = os.path.join(tuned, "device_infos.json")
+    db = json.load(open(path))
+    (kind,) = db.keys()
+    (key,) = db[kind]["autotune"].keys()
+    assert key.startswith("toy_op|128x128")
+    rec = db[kind]["autotune"][key]
+    assert rec["winner"] == "fast"
+    assert set(rec["ms"]) == {"fast", "slow"}
+    assert rec["ms"]["fast"] < rec["ms"]["slow"]
+
+    # second ask: answered from memo — no re-tracing
+    calls["fast"] = calls["slow"] = 0
+    assert autotune.pick("toy_op", {"slow": slow, "fast": fast}, [x]) \
+        == "fast"
+    assert calls == {"fast": 0, "slow": 0}
+
+    # fresh process simulation: memo cleared, DB answers without measuring
+    autotune._memo.clear()
+    assert autotune.pick("toy_op", {"slow": slow, "fast": fast}, [x]) \
+        == "fast"
+    assert calls == {"fast": 0, "slow": 0}
+
+
+def test_pick_disabled_returns_default(tuned):
+    root.common.autotune = False
+
+    def never(x):
+        raise AssertionError("must not measure when disabled")
+
+    x = jnp.ones((4, 4))
+    assert autotune.pick("op2", {"a": never, "b": never}, [x],
+                         default="b") == "b"
+
+
+def test_pick_failure_falls_back(tuned):
+    def broken(x):
+        raise RuntimeError("boom")
+
+    def ok(x):
+        return x * 2
+
+    x = jnp.ones((4, 4))
+    assert autotune.pick("op3", {"ok": ok, "broken": broken}, [x],
+                         default="ok") == "ok"
+
+
+def test_lrn_auto_resolves_via_autotune(tuned):
+    """LRN method='auto' resolves to a concrete formulation at build time
+    and the concrete name (never 'auto') is what export would see."""
+    import veles_tpu as vt
+    from veles_tpu.units import nn
+
+    u = nn.LRN(method="auto", name="lrn")
+    spec = vt.Spec((4, 6, 6, 32), jnp.float32)
+    u.prepare([spec])
+    assert u.method in ("cumsum", "band")
+    assert u._resolved == u.method
+
+    # winner persisted; a second unit with the same shape reuses it
+    u2 = nn.LRN(method="auto", name="lrn2")
+    u2.prepare([spec])
+    assert u2.method == u.method
+
+
+def test_lrn_auto_disabled_uses_default():
+    from veles_tpu.units import nn
+    import veles_tpu as vt
+
+    u = nn.LRN(method="auto", name="lrn")
+    u.prepare([vt.Spec((2, 4, 4, 16), jnp.float32)])
+    assert u.method == "cumsum"  # autotune off under test -> default
+
+
+def test_pipeline_stack_propagates_prepare(tuned):
+    """Composite units must forward prepare() to sub-units: an LRN with
+    method='auto' inside a pipeline stage resolves at build time (never
+    reaching trace or export as 'auto')."""
+    import veles_tpu as vt
+    from veles_tpu.units.parallel_nn import PipelineStack
+
+    st = PipelineStack(stages=[
+        [{"type": "lrn", "method": "auto"}],
+        [{"type": "layer_norm"}],
+    ], name="stack")
+    st.prepare([vt.Spec((4, 6, 6, 32), jnp.float32)])
+    lrn = st._stage_units[0][0]
+    assert lrn.method in ("cumsum", "band")

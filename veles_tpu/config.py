@@ -243,6 +243,7 @@ def _defaults():
     root.common.timings = False
     root.common.trace_file = ""              # JSONL event trace target
     root.common.cache_dir = ".veles_tpu"
+    root.common.autotune = True              # measured per-device op picks
     root.common.snapshot_dir = "snapshots"
     root.common.random_seed = 42
     root.common.platform = ""                # "" = let JAX pick

@@ -28,11 +28,11 @@ ALEXNET_CONFIG = {
     "layers": [
         {"type": "conv_relu", "n_kernels": 96, "kx": 11, "stride": 4,
          "padding": "VALID", "name": "conv1"},
-        {"type": "lrn", "name": "lrn1"},
+        {"type": "lrn", "name": "lrn1", "method": "auto"},
         {"type": "max_pooling", "window": 3, "stride": 2, "name": "pool1"},
         {"type": "conv_relu", "n_kernels": 256, "kx": 5, "padding": 2,
          "name": "conv2"},
-        {"type": "lrn", "name": "lrn2"},
+        {"type": "lrn", "name": "lrn2", "method": "auto"},
         {"type": "max_pooling", "window": 3, "stride": 2, "name": "pool2"},
         {"type": "conv_relu", "n_kernels": 384, "kx": 3, "padding": 1,
          "name": "conv3"},

@@ -1,0 +1,135 @@
+"""Per-device op-variant autotuning with a persisted winner DB.
+
+Reference parity: veles/backends.py:672-731 — the OpenCL backend swept
+gemm block sizes (3 reps, size 3001) per device and persisted the winner
+to ``devices/device_infos.json``, reused on every later run. Generalized
+here for the TPU build: any op with several mathematically-equivalent
+formulations (LRN band-matmul vs cumsum-difference, Pallas kernel vs XLA
+expression, ...) asks :func:`pick` for the measured winner on THIS device
+for THIS shape class; winners persist under the ``autotune`` key of the
+same per-device-kind DB the gemm benchmark uses
+(``runtime/benchmark.py``).
+
+Measurement methodology matches ``bench_tpu.py``: repetitions are chained
+INSIDE one jit with an ``optimization_barrier`` and a denormal feedback
+term, so per-dispatch tunnel latency is amortized and XLA can neither
+fold repetitions nor skip materializing outputs (the round-2 harness bug
+that mis-decided two kernel defaults — BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, Mapping, Optional, Sequence
+
+from ..config import root
+from ..logger import Logger
+from .benchmark import (device_info_path, load_device_infos,
+                        update_device_info)
+
+class _AutotuneLog(Logger):
+    pass
+
+
+_log = _AutotuneLog()
+
+# In-process memo so one run never re-reads the DB (or re-measures) for
+# the same decision twice.
+_memo: Dict[str, str] = {}
+
+
+def _shape_key(args: Sequence) -> str:
+    parts = []
+    for a in args:
+        shape = tuple(getattr(a, "shape", ()) or ())
+        dtype = getattr(a, "dtype", None)
+        parts.append(f"{'x'.join(map(str, shape))}:{dtype}")
+    return ",".join(parts)
+
+
+def measure(fn: Callable, args: Sequence, reps: int = 4,
+            iters: int = 3) -> float:
+    """Per-call seconds for fn(*args), reps chained in-graph."""
+    import jax
+    import jax.numpy as jnp
+
+    def chained(*args):
+        out = fn(*args)
+        for _ in range(reps - 1):
+            out = jax.lax.optimization_barrier(out)
+            leaf = jax.tree.leaves(out)[0]
+            eps = jnp.sum(leaf.astype(jnp.float32)) * 1e-38
+            args = list(args)
+            args[0] = args[0] + eps.astype(args[0].dtype)
+            out = fn(*args)
+        return out
+
+    cf = jax.jit(chained)
+    out = cf(*args)
+    # scalar read drains the queue (block_until_ready is unreliable over
+    # the axon tunnel — bench.py)
+    float(jnp.sum(jax.tree.leaves(out)[0].astype(jnp.float32)))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = cf(*args)
+    float(jnp.sum(jax.tree.leaves(out)[0].astype(jnp.float32)))
+    return (time.perf_counter() - t0) / (iters * reps)
+
+
+def pick(op: str, candidates: Mapping[str, Callable], args: Sequence,
+         default: Optional[str] = None, cache_dir: Optional[str] = None,
+         refresh: bool = False) -> str:
+    """Name of the fastest candidate for ``op`` on the current device.
+
+    Measured at most once per (device kind, op, arg shapes/dtypes);
+    afterwards answered from the in-process memo or the persisted DB.
+    On any measurement failure returns ``default`` (or the first
+    candidate) — autotuning must never break the build.
+    """
+    import jax
+
+    names = list(candidates)
+    if default is None:
+        default = names[0]
+    if len(names) == 1:
+        return names[0]
+    if not bool(root.common.autotune):
+        return default
+
+    kind = jax.devices()[0].device_kind
+    key = f"{op}|{_shape_key(args)}"
+    # cache_dir in the memo key: callers mixing explicit and default DBs
+    # must not receive each other's winners
+    memo_key = f"{device_info_path(cache_dir)}|{kind}|{key}"
+    if not refresh and memo_key in _memo:
+        return _memo[memo_key]
+
+    infos = load_device_infos(cache_dir)
+    table = infos.get(kind, {}).get("autotune", {})
+    if not refresh and key in table and table[key].get("winner") in names:
+        _memo[memo_key] = table[key]["winner"]
+        return _memo[memo_key]
+
+    timings = {}
+    try:
+        for name in names:
+            timings[name] = measure(candidates[name], args)
+    except Exception as e:
+        _log.warning("autotune %s failed (%s: %s); using default %r",
+                     op, type(e).__name__, e, default)
+        _memo[memo_key] = default
+        return default
+
+    winner = min(timings, key=timings.get)
+    _log.info("autotune %s on %s: %s  -> %s", op, kind,
+              {k: f"{v * 1e3:.3f}ms" for k, v in timings.items()}, winner)
+    record = {"winner": winner,
+              "ms": {k: round(v * 1e3, 4) for k, v in timings.items()}}
+    try:
+        update_device_info(
+            kind, lambda rec: rec.setdefault("autotune", {})
+            .__setitem__(key, record), cache_dir)
+    except OSError as e:  # read-only cwd etc. — the memo still holds
+        _log.warning("autotune DB not persisted: %s", e)
+    _memo[memo_key] = winner
+    return winner

@@ -111,16 +111,38 @@ def load_device_infos(cache_dir: Optional[str] = None) -> Dict:
     return {}
 
 
-def save_device_info(info: Dict, cache_dir: Optional[str] = None) -> str:
-    """Persist per device kind — the analog of the reference's
-    devices/device_infos.json block-size DB."""
+def update_device_info(kind: str, mutate, cache_dir: Optional[str] = None
+                       ) -> str:
+    """Atomic read-modify-write of one device-kind record under an
+    exclusive file lock. Concurrent trainers/benchmarks (multi-process
+    GA/ensemble pools, multi-host launches) share this DB; an unlocked
+    load→save would clobber entries written in between."""
+    import fcntl
     path = device_info_path(cache_dir)
-    os.makedirs(os.path.dirname(path), exist_ok=True)
-    infos = load_device_infos(cache_dir)
-    infos[info["device_kind"]] = info
-    with open(path, "w") as f:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "a+") as f:
+        fcntl.flock(f, fcntl.LOCK_EX)
+        f.seek(0)
+        raw = f.read()
+        try:
+            infos = json.loads(raw) if raw.strip() else {}
+        except json.JSONDecodeError:
+            infos = {}
+        info = infos.get(kind, {"device_kind": kind})
+        mutate(info)
+        infos[kind] = info
+        f.seek(0)
+        f.truncate()
         json.dump(infos, f, indent=1, sort_keys=True)
     return path
+
+
+def save_device_info(info: Dict, cache_dir: Optional[str] = None) -> str:
+    """Persist per device kind — the analog of the reference's
+    devices/device_infos.json block-size DB. Merges under the DB lock so
+    concurrent writers of other keys are not clobbered."""
+    return update_device_info(info["device_kind"],
+                              lambda rec: rec.update(info), cache_dir)
 
 
 def benchmark_device(cache_dir: Optional[str] = None, refresh: bool = False,

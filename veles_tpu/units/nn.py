@@ -292,18 +292,60 @@ class Dropout(Unit):
 
 
 class LRN(Unit):
-    """Local response normalization across channels."""
+    """Local response normalization across channels.
+
+    method: "cumsum" (default — stable across devices, keeps test
+    numerics fixed) | "band" (see ops/lrn.py) | "auto" — measure both
+    formulations fwd+bwd on the actual device at build time and persist
+    the winner per (device kind, shape) in the autotune DB (the
+    reference's per-device bench-and-persist discipline,
+    veles/backends.py:672-731; motivated by a real regression where a
+    hand-picked default cost ~40% AlexNet throughput on v5e —
+    BASELINE.md AlexNet r3 row)."""
 
     def __init__(self, n=5, k=2.0, alpha=1e-4, beta=0.75, name=None,
                  inputs=("@input",), method="cumsum"):
         super().__init__(name, inputs)
         self.n, self.k, self.alpha, self.beta = n, k, alpha, beta
-        self.method = method  # "cumsum" | "band" (see ops/lrn.py)
+        self.method = method
+        self._resolved = method if method != "auto" else None
+
+    def prepare(self, in_specs):
+        if self.method != "auto":
+            self._resolved = self.method
+            return
+        from ..runtime import autotune
+        spec = in_specs[0]
+        x = jnp.asarray(
+            np.random.default_rng(0).standard_normal(spec.shape),
+            spec.dtype)
+
+        def run(method):
+            # Time the training cost: forward + backward, like the unit
+            # executes inside the train step.
+            def f(x):
+                return jax.grad(lambda x: jnp.sum(
+                    ops.local_response_norm(
+                        x, n=self.n, k=self.k, alpha=self.alpha,
+                        beta=self.beta, method=method)
+                    .astype(jnp.float32)))(x)
+            return f
+
+        # n/beta in the key: band's C x C matmul cost is n-independent
+        # while cumsum's isn't, so different windows may have different
+        # winners even at one shape
+        self._resolved = autotune.pick(
+            f"lrn_fwd_bwd_n{self.n}_b{self.beta}",
+            {"cumsum": run("cumsum"), "band": run("band")},
+            [x], default="cumsum")
+        # expose the concrete choice (export serializes `method`; the
+        # serving runtime must never see "auto")
+        self.method = self._resolved
 
     def apply(self, params, state, xs, ctx):
         return ops.local_response_norm(
             xs[0], n=self.n, k=self.k, alpha=self.alpha, beta=self.beta,
-            method=self.method), state
+            method=self._resolved or self.method), state
 
 
 class MeanDispNormalizer(Unit):

@@ -237,6 +237,19 @@ class PipelineStack(Forward):
             units.append(u)
         return units
 
+    def prepare(self, in_specs):
+        # Composite unit: Workflow.build only calls prepare() on
+        # top-level units, so the stack must propagate it to its stage
+        # sub-units (an LRN with method="auto" inside a stage resolves
+        # here, never reaching trace/export as "auto").
+        if self._stage_units is not None:
+            spec = in_specs[0]
+            for units in self._stage_units:
+                s = spec
+                for u in units:
+                    u.prepare([s])
+                    s = u.output_spec([s])
+
     def output_spec(self, in_specs):
         if self._stage_units is not None:
             spec = in_specs[0]

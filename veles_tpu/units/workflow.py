@@ -133,6 +133,7 @@ class Workflow(Logger):
                         f"unit {u.name!r} needs {src!r} which is neither a "
                         f"batch key nor an upstream unit output")
                 in_specs.append(specs[src])
+            u.prepare(in_specs)
             specs[u.name] = u.output_spec(in_specs)
         self._specs = specs
         return specs
