@@ -96,7 +96,7 @@ def test_lrn_auto_resolves_via_autotune(tuned):
     u = nn.LRN(method="auto", name="lrn")
     spec = vt.Spec((4, 6, 6, 32), jnp.float32)
     u.prepare([spec])
-    assert u.method in ("cumsum", "band")
+    assert u.method in ("cumsum", "band", "band_bf16")
     assert u._resolved == u.method
 
     # winner persisted; a second unit with the same shape reuses it
@@ -127,4 +127,33 @@ def test_pipeline_stack_propagates_prepare(tuned):
     ], name="stack")
     st.prepare([vt.Spec((4, 6, 6, 32), jnp.float32)])
     lrn = st._stage_units[0][0]
-    assert lrn.method in ("cumsum", "band")
+    assert lrn.method in ("cumsum", "band", "band_bf16")
+
+
+def test_new_candidate_triggers_remeasure(tuned):
+    """A winner persisted for an older candidate set must not suppress
+    measuring a newly added formulation."""
+    def a(x):
+        return x + 1
+
+    def b(x):
+        y = x
+        for _ in range(40):
+            y = y @ y * 1e-3
+        return y
+
+    x = jnp.ones((64, 64), jnp.float32)
+    assert autotune.pick("grow_op", {"b": b, "a": a}, [x]) == "a"
+    autotune._memo.clear()
+
+    def c(x):
+        return x * 2.0  # new fast candidate
+
+    w = autotune.pick("grow_op", {"b": b, "a": a, "c": c}, [x])
+    path = os.path.join(tuned, "device_infos.json")
+    db = json.load(open(path))
+    (kind,) = db.keys()
+    rec = [v for k, v in db[kind]["autotune"].items()
+           if k.startswith("grow_op")][0]
+    assert set(rec["ms"]) == {"a", "b", "c"}  # re-measured with all three
+    assert w in ("a", "c")

@@ -42,9 +42,9 @@ def _window_sum_cumsum(sq, n: int):
 
 
 def _window_sum(sq, n: int, method: str = "cumsum"):
-    if method not in ("cumsum", "band"):
-        raise ValueError(f"LRN method must be 'cumsum' or 'band', "
-                         f"got {method!r}")
+    if method not in ("cumsum", "band", "band_bf16"):
+        raise ValueError(f"LRN method must be 'cumsum', 'band' or "
+                         f"'band_bf16', got {method!r}")
     c = sq.shape[-1]
     half = n // 2
     if method == "cumsum":
@@ -56,16 +56,32 @@ def _window_sum(sq, n: int, method: str = "cumsum"):
         # below for even n too. In sq @ band, band[j, i] pairs row j with
         # output i, and (idx[None,:]-idx[:,None])[j, i] = i - j.
         diff = idx[None, :] - idx[:, None]
-        band = ((diff >= -(n - 1 - half)) & (diff <= half)).astype(sq.dtype)
-        # The C×C band contraction is cheap; never let a DEFAULT bf16 MXU
-        # pass truncate the f32 squared activations (advisor r1). Honour
-        # the precision_level knob, but floor it at HIGH.
-        prec = config_precision()
-        if prec == jax.lax.Precision.DEFAULT:
-            prec = jax.lax.Precision.HIGH
+        mask = (diff >= -(n - 1 - half)) & (diff <= half)
+        if method == "band_bf16":
+            # Single-pass MXU rate: squared activations quantized to
+            # bf16 (~0.4% relative), 0/1 band exact in bf16, f32
+            # accumulation. Sound for LRN because the window sum only
+            # perturbs the denominator k + (alpha/n)·ssum — at AlexNet's
+            # alpha=1e-4 a 0.4% error on ssum moves y by ~1e-6 relative.
+            # This is the round-1 formulation that measured +22% AlexNet
+            # throughput before the precision floor below made the f32
+            # band cost 3 MXU passes (BASELINE.md AlexNet r3 row).
+            operand = sq.reshape(-1, c).astype(jnp.bfloat16)
+            band = mask.astype(jnp.bfloat16)
+            prec = None
+        else:
+            # The f32 C×C band contraction must not let a DEFAULT bf16
+            # MXU pass truncate the f32 squared activations SILENTLY
+            # (advisor r1): honour the precision_level knob but floor it
+            # at HIGH. Callers who accept the (benign, see above) bf16
+            # quantization say so explicitly with method="band_bf16".
+            operand = sq.reshape(-1, c)
+            band = mask.astype(sq.dtype)
+            prec = config_precision()
+            if prec == jax.lax.Precision.DEFAULT:
+                prec = jax.lax.Precision.HIGH
         return jax.lax.dot_general(
-            sq.reshape(-1, c), band, (((1,), (0,)), ((), ())),
-            precision=prec,
+            operand, band, (((1,), (0,)), ((), ())), precision=prec,
             preferred_element_type=jnp.float32).reshape(sq.shape)
     pads = [(0, 0)] * (sq.ndim - 1) + [(half, n - 1 - half)]
     return jax.lax.reduce_window(
@@ -76,8 +92,10 @@ def _window_sum(sq, n: int, method: str = "cumsum"):
 def local_response_norm(x, *, n=5, k=2.0, alpha=1e-4, beta=0.75,
                         method="cumsum"):
     """x: (..., C). AlexNet semantics: alpha is divided by window size n.
-    ``method``: "cumsum" (default; exact f32, VPU-only) or "band" (C×C
-    0/1 matmul on the MXU — the round-1 design, kept for A/B)."""
+    ``method``: "cumsum" (default; exact f32, VPU-only), "band" (C×C 0/1
+    matmul on the MXU at >=HIGH precision) or "band_bf16" (same band at
+    single-pass MXU rate with bf16-quantized inputs + f32 accumulation —
+    benign for the LRN denominator, see _window_sum)."""
     ssum = _window_sum(jnp.square(x), n, method)
     y = k + (alpha / n) * ssum
     if beta == 0.75:

@@ -104,10 +104,17 @@ def pick(op: str, candidates: Mapping[str, Callable], args: Sequence,
     if not refresh and memo_key in _memo:
         return _memo[memo_key]
 
-    infos = load_device_infos(cache_dir)
-    table = infos.get(kind, {}).get("autotune", {})
-    if not refresh and key in table and table[key].get("winner") in names:
-        _memo[memo_key] = table[key]["winner"]
+    try:
+        infos = load_device_infos(cache_dir)
+    except Exception:  # torn/corrupt DB must never break the build
+        infos = {}
+    rec = infos.get(kind, {}).get("autotune", {}).get(key)
+    # Reuse only if the persisted record measured the SAME candidate set:
+    # a winner recorded before a new formulation was added must not
+    # suppress measuring it (e.g. LRN gaining band_bf16).
+    if (not refresh and rec and rec.get("winner") in names
+            and set(rec.get("ms", ())) == set(names)):
+        _memo[memo_key] = rec["winner"]
         return _memo[memo_key]
 
     timings = {}

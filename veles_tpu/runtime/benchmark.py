@@ -113,27 +113,29 @@ def load_device_infos(cache_dir: Optional[str] = None) -> Dict:
 
 def update_device_info(kind: str, mutate, cache_dir: Optional[str] = None
                        ) -> str:
-    """Atomic read-modify-write of one device-kind record under an
-    exclusive file lock. Concurrent trainers/benchmarks (multi-process
-    GA/ensemble pools, multi-host launches) share this DB; an unlocked
-    load→save would clobber entries written in between."""
+    """Atomic read-modify-write of one device-kind record. Concurrent
+    trainers/benchmarks (multi-process GA/ensemble pools, multi-host
+    launches) share this DB; an unlocked load→save would clobber entries
+    written in between. Writers serialize on a SIDECAR lock file (the DB
+    file itself is replaced by rename, so locking its inode would race),
+    and the tmp-write + os.replace keeps the DB complete at every instant
+    for lock-free readers (load_device_infos)."""
     import fcntl
     path = device_info_path(cache_dir)
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    with open(path, "a+") as f:
-        fcntl.flock(f, fcntl.LOCK_EX)
-        f.seek(0)
-        raw = f.read()
+    with open(path + ".lock", "w") as lockf:
+        fcntl.flock(lockf, fcntl.LOCK_EX)
         try:
-            infos = json.loads(raw) if raw.strip() else {}
-        except json.JSONDecodeError:
+            infos = load_device_infos(cache_dir)
+        except json.JSONDecodeError:  # pre-rename-era torn file
             infos = {}
         info = infos.get(kind, {"device_kind": kind})
         mutate(info)
         infos[kind] = info
-        f.seek(0)
-        f.truncate()
-        json.dump(infos, f, indent=1, sort_keys=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(infos, f, indent=1, sort_keys=True)
+        os.replace(tmp, path)
     return path
 
 

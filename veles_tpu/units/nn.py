@@ -336,16 +336,24 @@ class LRN(Unit):
         # winners even at one shape
         self._resolved = autotune.pick(
             f"lrn_fwd_bwd_n{self.n}_b{self.beta}",
-            {"cumsum": run("cumsum"), "band": run("band")},
+            {"cumsum": run("cumsum"), "band": run("band"),
+             "band_bf16": run("band_bf16")},
             [x], default="cumsum")
         # expose the concrete choice (export serializes `method`; the
         # serving runtime must never see "auto")
         self.method = self._resolved
 
     def apply(self, params, state, xs, ctx):
+        method = self._resolved or self.method
+        if method == "auto":
+            raise RuntimeError(
+                f"LRN {self.name!r} has method='auto' but prepare() was "
+                "never called — build the workflow (Workflow.build calls "
+                "prepare), or propagate prepare() from the composite "
+                "unit wrapping this one, or set a concrete method")
         return ops.local_response_norm(
             xs[0], n=self.n, k=self.k, alpha=self.alpha, beta=self.beta,
-            method=self._resolved or self.method), state
+            method=method), state
 
 
 class MeanDispNormalizer(Unit):

@@ -237,26 +237,34 @@ class PipelineStack(Forward):
             units.append(u)
         return units
 
+    def _thread_stage_specs(self, spec, visit=None):
+        """Single source of truth for threading the activation spec
+        through every stage sub-unit (prepare/output_spec/init all need
+        this walk). ``visit(unit, in_spec)`` runs before each unit's
+        output_spec advances the spec; returns per-stage final specs."""
+        outs = []
+        for units in self._stage_units:
+            s = spec
+            for u in units:
+                if visit is not None:
+                    visit(u, s)
+                s = u.output_spec([s])
+            outs.append(s)
+        return outs
+
     def prepare(self, in_specs):
         # Composite unit: Workflow.build only calls prepare() on
         # top-level units, so the stack must propagate it to its stage
         # sub-units (an LRN with method="auto" inside a stage resolves
         # here, never reaching trace/export as "auto").
         if self._stage_units is not None:
-            spec = in_specs[0]
-            for units in self._stage_units:
-                s = spec
-                for u in units:
-                    u.prepare([s])
-                    s = u.output_spec([s])
+            self._thread_stage_specs(
+                in_specs[0], lambda u, s: u.prepare([s]))
 
     def output_spec(self, in_specs):
         if self._stage_units is not None:
             spec = in_specs[0]
-            for i, units in enumerate(self._stage_units):
-                s = spec
-                for u in units:
-                    s = u.output_spec([s])
+            for i, s in enumerate(self._thread_stage_specs(spec)):
                 if (tuple(s.shape), s.dtype) != (tuple(spec.shape),
                                                  spec.dtype):
                     raise ValueError(
