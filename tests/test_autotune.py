@@ -157,3 +157,49 @@ def test_new_candidate_triggers_remeasure(tuned):
            if k.startswith("grow_op")][0]
     assert set(rec["ms"]) == {"a", "b", "c"}  # re-measured with all three
     assert w in ("a", "c")
+
+
+def test_fullbatch_gather_decision_measured(tuned):
+    """With autotune on, the loader's pack-vs-take choice is measured on
+    the actual dataset shape and persisted; batches stay exact either
+    way."""
+    from veles_tpu.loader.fullbatch import FullBatchLoader
+    from veles_tpu.loader.base import TRAIN
+
+    X = np.random.default_rng(0).standard_normal((256, 1024)) \
+        .astype(np.float32)
+    ld = FullBatchLoader({TRAIN: X}, minibatch_size=16,
+                         use_pallas_gather=True)
+    ld.initialize()
+    assert ld.on_device
+    b = next(ld.iter_epoch(TRAIN, 0))
+    perm = ld.epoch_permutation(TRAIN, 0)[:16]
+    np.testing.assert_allclose(np.asarray(b["@input"]), X[perm])
+
+    db = json.load(open(os.path.join(tuned, "device_infos.json")))
+    (kind,) = db.keys()
+    keys = [k for k in db[kind]["autotune"]
+            if k.startswith("fullbatch_gather_f1024")]
+    assert keys, db[kind]["autotune"].keys()
+    assert db[kind]["autotune"][keys[0]]["winner"] in ("packed", "take")
+
+
+def test_fullbatch_gather_per_class_consistency(tuned):
+    """The pack decision is uniform across classes of one shape (keyed on
+    the full minibatch size, not the class length), and a class smaller
+    than the minibatch still gathers correctly through its own jit."""
+    from veles_tpu.loader.fullbatch import FullBatchLoader
+    from veles_tpu.loader.base import TRAIN, VALID
+
+    rng = np.random.default_rng(1)
+    X = {TRAIN: rng.standard_normal((300, 1024)).astype(np.float32),
+         VALID: rng.standard_normal((7, 1024)).astype(np.float32)}
+    ld = FullBatchLoader({k: v.copy() for k, v in X.items()},
+                         minibatch_size=16, use_pallas_gather=True)
+    ld.initialize()
+    assert ld.on_device
+    for klass in (TRAIN, VALID):
+        for i, b in enumerate(ld.iter_epoch(klass, 0)):
+            perm = ld.epoch_permutation(klass, 0)[i * 16:(i + 1) * 16]
+            got = np.asarray(b["@input"])[: len(perm)]
+            np.testing.assert_allclose(got, X[klass][perm])

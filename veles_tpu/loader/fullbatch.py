@@ -126,6 +126,10 @@ class FullBatchLoader(ArrayLoader):
             # awkward rows stay on jnp.take.
             from ..ops.pallas_kernels import (pack_rows, gather_rows_packed,
                                               unpack_rows)
+            # packed_meta is PER (class, key): the measured decision (and
+            # even eligibility, via dtype) can differ between classes of
+            # one dataset, and the gather jit below must exactly match
+            # what its own class's arrays look like.
             packed_meta = {}
             for klass, entry in self._dev_data.items():
                 for key, arr in entry.items():
@@ -136,28 +140,72 @@ class FullBatchLoader(ArrayLoader):
                     # dtypes tile differently and were never benched.
                     if (arr.dtype.itemsize == 4
                             and f * 4 >= _PACK_MIN_ROW_BYTES
-                            and f_pad <= f * _PACK_MAX_PAD):
+                            and f_pad <= f * _PACK_MAX_PAD
+                            and self._gather_pack_wins(arr)):
                         packed, f, sshape = pack_rows(arr)
                         entry[key] = packed
-                        packed_meta[key] = (f, tuple(sshape))
+                        packed_meta[(klass, key)] = (f, tuple(sshape))
 
-            @jax.jit
-            def gather(tree, idx):
-                out = {}
-                for key, a in tree.items():
-                    if key in packed_meta:
-                        f, sshape = packed_meta[key]
-                        out[key] = unpack_rows(
-                            gather_rows_packed(a, idx), f, sshape)
-                    else:
-                        out[key] = jnp.take(a, idx, axis=0)
-                return out
+            def make_gather(klass):
+                @jax.jit
+                def gather(tree, idx):
+                    out = {}
+                    for key, a in tree.items():
+                        meta = packed_meta.get((klass, key))
+                        if meta is not None:
+                            f, sshape = meta
+                            out[key] = unpack_rows(
+                                gather_rows_packed(a, idx), f, sshape)
+                        else:
+                            out[key] = jnp.take(a, idx, axis=0)
+                    return out
+                return gather
+
+            self._gather = {klass: make_gather(klass)
+                            for klass in self._dev_data}
         else:
             @jax.jit
-            def gather(tree, idx):
+            def take_gather(tree, idx):
                 return jax.tree.map(lambda a: jnp.take(a, idx, axis=0), tree)
 
-        self._gather = gather
+            self._gather = {klass: take_gather
+                            for klass in self._dev_data}
+
+    def _gather_pack_wins(self, arr) -> bool:
+        """Measured per-dataset-shape decision: time the full
+        pack→gather→unpack path vs jnp.take on a sample slice of the
+        uploaded array (per-row DMA cost is row-count independent, so a
+        slice is representative) and persist the winner in the autotune
+        DB. With autotune disabled the static envelope above decides
+        alone (returns True). The decision uses the FULL minibatch size
+        even for smaller classes so every class of one dataset shape
+        agrees (the gather jits are per class, but a uniform verdict
+        keeps behavior predictable)."""
+        from ..config import root
+        if not bool(root.common.autotune):
+            return True
+        from ..runtime import autotune
+        f = int(np.prod(arr.shape[1:]))
+        bs = self.minibatch_size
+        op = f"fullbatch_gather_f{f}_{arr.dtype}_bs{bs}"
+        idx = jnp.arange(bs, dtype=jnp.int32)
+        names = ("packed", "take")
+        cached = autotune.lookup(op, names, [idx])
+        if cached is not None:  # warm start: no sample pack at all
+            return cached == "packed"
+        from ..ops.pallas_kernels import (pack_rows, gather_rows_packed,
+                                          unpack_rows)
+        n = int(min(len(arr), 4096))
+        sample = arr[:n]
+        packed, fp, sshape = pack_rows(sample)
+        idx = jnp.arange(bs, dtype=jnp.int32) % n
+        winner = autotune.pick(
+            op,
+            {"packed": lambda i: unpack_rows(
+                gather_rows_packed(packed, i), fp, sshape),
+             "take": lambda i: jnp.take(sample, i, axis=0)},
+            [idx], default="packed")
+        return winner == "packed"
 
     def make_batch(self, chunk: np.ndarray, klass: int):
         if not self.on_device:
@@ -168,7 +216,7 @@ class FullBatchLoader(ArrayLoader):
             chunk = np.concatenate(
                 [chunk, np.zeros(bs - valid_n, chunk.dtype)])
         idx = jnp.asarray(chunk, jnp.int32)
-        batch = dict(self._gather(self._dev_data[klass], idx))
+        batch = dict(self._gather[klass](self._dev_data[klass], idx))
         mask = np.zeros(bs, np.float32)
         mask[:valid_n] = 1.0
         batch["@mask"] = jnp.asarray(mask)
