@@ -203,3 +203,29 @@ def test_fullbatch_gather_per_class_consistency(tuned):
             perm = ld.epoch_permutation(klass, 0)[i * 16:(i + 1) * 16]
             got = np.asarray(b["@input"])[: len(perm)]
             np.testing.assert_allclose(got, X[klass][perm])
+
+
+def test_dropout_and_meandisp_resolve_via_autotune(tuned):
+    """The remaining Pallas-vs-XLA switches resolve by measurement when
+    autotune is on, and keep the static platform default when off."""
+    import veles_tpu as vt
+    from veles_tpu.units import nn
+
+    d = nn.Dropout(0.3, name="drop")
+    d.prepare([vt.Spec((64, 256), jnp.float32)])
+    assert d._resolved in (True, False)
+
+    m = nn.MeanDispNormalizer(name="norm")
+    m.prepare([vt.Spec((32, 12, 12, 3), jnp.uint8)])
+    assert m._resolved in (True, False)
+
+    db = json.load(open(os.path.join(tuned, "device_infos.json")))
+    (kind,) = db.keys()
+    ops_seen = {k.split("|")[0] for k in db[kind]["autotune"]}
+    assert "dropout_fwd_bwd_r0.3" in ops_seen
+    assert "mean_disp_normalize" in ops_seen
+
+    root.common.autotune = False
+    d2 = nn.Dropout(0.3, name="d2")
+    d2.prepare([vt.Spec((64, 256), jnp.float32)])
+    assert d2._resolved is None  # static platform default at apply time

@@ -52,6 +52,14 @@ def measure(fn: Callable, args: Sequence, reps: int = 4,
     """Per-call seconds for fn(*args), reps chained in-graph."""
     import jax
     import jax.numpy as jnp
+    import numpy as np
+
+    # Chain the inter-rep data dependence through the SMALLEST argument
+    # so the chain edge itself is nearly free (threading it through a
+    # large operand would add a full HBM pass per repetition —
+    # bench_tpu.py's harness note).
+    j = int(np.argmin([int(np.prod(getattr(a, "shape", ()) or (1,)))
+                       for a in args]))
 
     def chained(*args):
         out = fn(*args)
@@ -60,7 +68,7 @@ def measure(fn: Callable, args: Sequence, reps: int = 4,
             leaf = jax.tree.leaves(out)[0]
             eps = jnp.sum(leaf.astype(jnp.float32)) * 1e-38
             args = list(args)
-            args[0] = args[0] + eps.astype(args[0].dtype)
+            args[j] = args[j] + eps.astype(args[j].dtype)
             out = fn(*args)
         return out
 
@@ -126,21 +134,10 @@ def pick(op: str, candidates: Mapping[str, Callable], args: Sequence,
     # cache_dir in the memo key: callers mixing explicit and default DBs
     # must not receive each other's winners
     memo_key = f"{device_info_path(cache_dir)}|{kind}|{key}"
-    if not refresh and memo_key in _memo:
-        return _memo[memo_key]
-
-    try:
-        infos = load_device_infos(cache_dir)
-    except Exception:  # torn/corrupt DB must never break the build
-        infos = {}
-    rec = infos.get(kind, {}).get("autotune", {}).get(key)
-    # Reuse only if the persisted record measured the SAME candidate set:
-    # a winner recorded before a new formulation was added must not
-    # suppress measuring it (e.g. LRN gaining band_bf16).
-    if (not refresh and rec and rec.get("winner") in names
-            and set(rec.get("ms", ())) == set(names)):
-        _memo[memo_key] = rec["winner"]
-        return _memo[memo_key]
+    if not refresh:
+        cached = lookup(op, names, args, cache_dir)
+        if cached is not None:
+            return cached
 
     timings = {}
     try:

@@ -264,7 +264,12 @@ class Depool(Unit):
 class Dropout(Unit):
     """Inverted dropout; identity at eval (reference Znicz dropout;
     RNG = jax threefry via ctx.unit_key, replacing ocl/random.cl's
-    xorshift1024* states)."""
+    xorshift1024* states).
+
+    use_pallas: True/False forces a formulation; None = measure both
+    fwd+bwd at the build shape and persist the winner (autotune;
+    barrier'd v5e measurement at 4096x4096: Pallas 1.13x), falling back
+    to the static platform default when autotune is disabled."""
 
     stochastic = True
 
@@ -273,6 +278,53 @@ class Dropout(Unit):
         super().__init__(name, inputs)
         self.ratio = float(dropout_ratio)
         self.use_pallas = use_pallas
+        self._resolved = use_pallas
+
+    def prepare(self, in_specs):
+        from ..config import root
+        if self.use_pallas is not None:
+            self._resolved = self.use_pallas
+            return
+        if not bool(root.common.autotune):
+            self._resolved = None  # static platform default at apply
+            return
+        from ..runtime import autotune
+        spec = in_specs[0]
+        ratio, keep = self.ratio, 1.0 - self.ratio
+        op = f"dropout_fwd_bwd_r{ratio}"
+        specs = [jax.ShapeDtypeStruct(spec.shape, spec.dtype),
+                 jax.ShapeDtypeStruct((), jnp.uint32)]
+        names = ("pallas", "xla")
+        cached = autotune.lookup(op, names, specs)
+        if cached is not None:  # warm start: no arrays materialized
+            self._resolved = cached == "pallas"
+            return
+        x = jnp.asarray(np.random.default_rng(0).standard_normal(
+            spec.shape), spec.dtype)
+        seed = jnp.uint32(123)
+        key = jax.random.key(0)
+
+        def g(f):
+            # value_and_grad, both outputs returned: plain grad discards
+            # the primal and the fused kernel's forward would be
+            # dead-code-eliminated (its vjp residual is just the seed),
+            # timing half the real training cost.
+            def timed(x, s):
+                v, gx = jax.value_and_grad(
+                    lambda x: jnp.sum(f(x, s).astype(jnp.float32)))(x)
+                return v, gx
+            return timed
+
+        winner = autotune.pick(
+            op,
+            {"pallas": g(lambda x, s: ops.fused_dropout(x, s, ratio)),
+             "xla": g(lambda x, s: jnp.where(
+                 jax.random.bernoulli(jax.random.fold_in(key, s), keep,
+                                      x.shape),
+                 x / keep, 0.0).astype(x.dtype))},
+            [x, seed],
+            default="pallas" if ops.use_pallas_default() else "xla")
+        self._resolved = winner == "pallas"
 
     def apply(self, params, state, xs, ctx):
         x = xs[0]
@@ -280,7 +332,7 @@ class Dropout(Unit):
             return x, state
         key = ctx.unit_key(self.name)
         use_pallas = (ops.use_pallas_default()
-                      if self.use_pallas is None else self.use_pallas)
+                      if self._resolved is None else self._resolved)
         if use_pallas:
             # In-kernel counter-based RNG; mask regenerated in backward
             # (ops/pallas_kernels.py, parity: ocl/random.cl).
@@ -322,9 +374,11 @@ class LRN(Unit):
 
         def run(method):
             # Time the training cost: forward + backward, like the unit
-            # executes inside the train step.
+            # executes inside the train step. value_and_grad (not grad):
+            # returning the primal too keeps the whole forward alive
+            # under DCE.
             def f(x):
-                return jax.grad(lambda x: jnp.sum(
+                return jax.value_and_grad(lambda x: jnp.sum(
                     ops.local_response_norm(
                         x, n=self.n, k=self.k, alpha=self.alpha,
                         beta=self.beta, method=method)
@@ -360,10 +414,49 @@ class MeanDispNormalizer(Unit):
     """(x - mean) * rdisp with dataset statistics stored in unit state
     (reference: veles/mean_disp_normalizer.py:50-138)."""
 
-    def __init__(self, mean=None, rdisp=None, name=None, inputs=("@input",)):
+    def __init__(self, mean=None, rdisp=None, name=None, inputs=("@input",),
+                 use_pallas=None):
         super().__init__(name, inputs)
         self._mean = mean
         self._rdisp = rdisp
+        # None = autotune at build shape (static XLA default when
+        # disabled — the barrier'd v5e measurement has XLA 2.5x ahead on
+        # this op, but the winner is persisted per shape, not assumed);
+        # True/False forces.
+        self.use_pallas = use_pallas
+        self._resolved = use_pallas
+
+    def prepare(self, in_specs):
+        from ..config import root
+        if self.use_pallas is not None or not bool(root.common.autotune):
+            self._resolved = self.use_pallas
+            return
+        from ..runtime import autotune
+        spec = in_specs[0]
+        feat = spec.shape[1:]
+        specs = [jax.ShapeDtypeStruct(spec.shape, spec.dtype),
+                 jax.ShapeDtypeStruct(feat, jnp.float32),
+                 jax.ShapeDtypeStruct(feat, jnp.float32)]
+        names = ("xla", "pallas")
+        cached = autotune.lookup("mean_disp_normalize", names, specs)
+        if cached is not None:  # warm start: no arrays materialized
+            self._resolved = cached == "pallas"
+            return
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(
+            rng.integers(0, 256, spec.shape)
+            if np.issubdtype(np.dtype(spec.dtype), np.integer)
+            else rng.standard_normal(spec.shape), spec.dtype)
+        mean = jnp.asarray(rng.uniform(100, 150, feat), jnp.float32)
+        rdisp = jnp.asarray(rng.uniform(0.01, 0.02, feat), jnp.float32)
+        winner = autotune.pick(
+            "mean_disp_normalize",
+            {"xla": lambda x, m, r: ops.mean_disp_normalize(
+                x, m, r, use_pallas=False),
+             "pallas": lambda x, m, r: ops.mean_disp_normalize(
+                 x, m, r, use_pallas=True)},
+            [x, mean, rdisp], default="xla")
+        self._resolved = winner == "pallas"
 
     def output_spec(self, in_specs):
         return Spec(in_specs[0].shape, jnp.float32)
@@ -377,8 +470,9 @@ class MeanDispNormalizer(Unit):
         return {}, {"mean": mean, "rdisp": rdisp}
 
     def apply(self, params, state, xs, ctx):
-        return ops.mean_disp_normalize(xs[0], state["mean"],
-                                       state["rdisp"]), state
+        return ops.mean_disp_normalize(
+            xs[0], state["mean"], state["rdisp"],
+            use_pallas=bool(self._resolved)), state
 
 
 class Flatten(Unit):
