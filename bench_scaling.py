@@ -23,14 +23,15 @@ PER_CHIP_BATCH = 256
 ITERS = 20
 
 
-def measure(n_chips: int) -> float:
+def measure(n_chips: int, per_chip_batch: int = None,
+            iters: int = None) -> float:
     import jax
     import jax.numpy as jnp
     import veles_tpu as vt
     from veles_tpu.models import alexnet_workflow
     from veles_tpu.parallel import MeshSpec, make_mesh
 
-    batch = PER_CHIP_BATCH * n_chips
+    batch = (per_chip_batch or PER_CHIP_BATCH) * n_chips
     sw = alexnet_workflow(minibatch_size=batch)
     wf = sw.workflow
     specs = {"@input": vt.Spec((batch, 227, 227, 3), jnp.float32),
@@ -55,20 +56,26 @@ def measure(n_chips: int) -> float:
         wstate, mets = step(wstate, batches[i % 2])
     float(mets["loss"])  # drain (see bench.py)
     t0 = time.perf_counter()
-    for i in range(ITERS):
+    iters = iters or ITERS
+    for i in range(iters):
         wstate, mets = step(wstate, batches[i % 2])
     float(mets["loss"])
-    return batch * ITERS / (time.perf_counter() - t0)
+    return batch * iters / (time.perf_counter() - t0)
 
 
 def main():
     import jax
+    # --tiny: validation mode for the virtual CPU mesh (the sharded step
+    # and measurement plumbing run end-to-end at toy size, so a future
+    # multi-chip round can trust the harness has not bit-rotted).
+    tiny = "--tiny" in sys.argv
     avail = len(jax.devices())
     points = []
     base = None
     n = 1
     while n <= avail:
-        sps = measure(n)
+        sps = measure(n, per_chip_batch=4 if tiny else None,
+                      iters=2 if tiny else None)
         if base is None:
             base = sps
         points.append({"chips": n, "samples_per_sec": round(sps, 1),
@@ -78,7 +85,12 @@ def main():
                       "device": str(jax.devices()[0]),
                       "available_chips": avail,
                       "points": points,
-                      "note": None if avail > 1 else
+                      "tiny": tiny,
+                      "note": ("VALIDATION RUN (virtual CPU mesh / tiny "
+                               "shapes) — efficiencies are not hardware "
+                               "numbers") if tiny or
+                      jax.devices()[0].platform == "cpu" else
+                      None if avail > 1 else
                       "single chip visible; >1-chip rows need multi-chip "
                       "hardware (sharded step validated on virtual mesh)"}))
     return 0
