@@ -4,8 +4,9 @@ decode tokens/sec (round-2 verdict #6: add an LM training-throughput row
 with MFU; #4: a tokens/sec number for the decode path).
 
 Model: the induction-LM topology scaled to a real size — embedding ->
-4x (residual RoPE attention + per-position FFN via all2all) -> per-
-position softmax head, bf16 compute. Prints one JSON line per metric.
+4x full transformer blocks (residual RoPE attention, layer_norm,
+residual 4E FFN unit, layer_norm) -> per-position softmax head, bf16
+compute. Prints one JSON line per metric.
 
 Run on the TPU host: ``python bench_lm.py [--decode-only]``.
 """
@@ -32,10 +33,14 @@ def build(wstate_seed=0):
     layers = [{"type": "embedding", "vocab": VOCAB, "dim": E,
                "name": "emb"}]
     for i in range(LAYERS):
+        # full transformer block: attention + FFN halves (an
+        # attention-only stack would understate both FLOPs and MFU)
         layers += [
             {"type": "attention", "n_heads": HEADS, "rope": True,
              "residual": True, "name": f"attn{i}"},
-            {"type": "layer_norm", "name": f"ln{i}"},
+            {"type": "layer_norm", "name": f"ln{i}a"},
+            {"type": "ffn", "d_hidden": 4 * E, "name": f"ffn{i}"},
+            {"type": "layer_norm", "name": f"ln{i}b"},
         ]
     layers += [{"type": "all2all", "output_size": VOCAB,
                 "per_position": True, "name": "head"}]
