@@ -545,3 +545,40 @@ def test_cpp_ffn_matches_jax(binary, tmp_path, rng):
     ref = np.asarray(wf.make_predict_step("out")(
         ws, {"@input": jnp.asarray(x, jnp.int32)}))
     np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-4)
+
+
+def test_cpp_lrn_band_bf16_within_tolerance(binary, tmp_path, rng):
+    """A model whose JAX forward uses the band_bf16 LRN formulation
+    exports the concrete method and still golden-matches the C++
+    runtime's exact-f32 LRN: the bf16 quantization only perturbs the
+    k + (alpha/n)*ssum denominator (~1e-6 relative at default alpha),
+    far inside the serving tolerance."""
+    wf = build_workflow("lrn_bf16_serve", [
+        {"type": "conv_relu", "n_kernels": 8, "kx": 3, "padding": 1,
+         "name": "c1"},
+        {"type": "lrn", "method": "band_bf16", "name": "lrn1"},
+        {"type": "all2all_tanh", "output_size": 16, "name": "fc1"},
+        {"type": "softmax", "output_size": 4, "name": "out"},
+    ])
+    wf.build({"@input": vt.Spec((2, 8, 8, 3), jnp.float32),
+              "@labels": vt.Spec((2,), jnp.int32),
+              "@mask": vt.Spec((2,), jnp.float32)})
+    ws = wf.init_state(jax.random.key(9), opt.SGD(0.01))
+    pkg = str(tmp_path / "pkg")
+    export_package(wf, ws, pkg,
+                   input_spec={"shape": [2, 8, 8, 3], "dtype": "float32"})
+    data = load_package(pkg)
+    lrn = next(u for u in data["units"] if u["name"] == "lrn1")
+    assert lrn["config"]["method"] == "band_bf16"  # concrete, exported
+
+    x = rng.standard_normal((2, 8, 8, 3)).astype(np.float32)
+    np.save(tmp_path / "lx.npy", x)
+    r = subprocess.run(
+        [binary, pkg, str(tmp_path / "lx.npy"), str(tmp_path / "ly.npy"),
+         "--output-unit", "out"],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    got = np.load(tmp_path / "ly.npy")
+    predict = wf.make_predict_step("out")
+    ref = np.asarray(predict(ws, {"@input": jnp.asarray(x)}))
+    np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-4)
