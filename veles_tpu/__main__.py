@@ -93,6 +93,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="train N members on ratio-r subsets")
     p.add_argument("--ensemble-test", metavar="MANIFEST",
                    help="test an ensemble from its manifest JSON")
+    p.add_argument("--curriculum", metavar="SPEC.json",
+                   help="snapshot-phased curriculum: run the config as "
+                        "chained training phases per the spec (each "
+                        "phase restores the best snapshot so far; see "
+                        "runtime/curriculum.py)")
+    p.add_argument("--curriculum-out", default="curriculum_out",
+                   help="directory for per-phase snapshots/results")
     p.add_argument("--mesh", help="mesh spec, e.g. data=4,model=2")
     p.add_argument("--platform", default=None,
                    help="pin the jax platform (cpu/tpu/axon) BEFORE first "
@@ -448,14 +455,49 @@ def main(argv=None) -> int:
     if args.publish:
         _publish_fmts(args.publish.partition(":")[2])  # fail fast on typos
         if (args.optimize or args.ensemble_train or args.ensemble_test
-                or args.dry_run):
+                or args.dry_run or args.curriculum):
             raise SystemExit("--publish applies to standalone training "
                              "runs (meta-workflow reports: use the "
                              "Publisher API)")
+    if args.curriculum and (args.dry_run or args.export
+                            or args.generate is not None):
+        raise SystemExit("--curriculum is a training meta-mode; "
+                         "--dry-run/--export/--generate apply to single "
+                         "runs (run them on the final best snapshot)")
 
     if args.random_seed is not None:
         root.common.random_seed = _parse_seed(args.random_seed)
         prng.streams.reset()
+
+    # -- curriculum mode (chained CLI phases; productized
+    # configs/induction_lm64_curriculum.sh — BASELINE.md stretch bar).
+    # Dispatched BEFORE _load_config: the parent only needs the config
+    # PATH — each phase subprocess loads/executes it itself, so loading
+    # here would double any import-time side effects. Warm start comes
+    # from an explicit --snapshot (a config-manifest snapshot is a
+    # single-run convenience and is not consulted).
+    if args.curriculum:
+        from .runtime.curriculum import CurriculumRunner
+        with open(args.curriculum) as f:
+            spec = json.load(f)
+        extra = list(args.overrides)
+        if args.platform:
+            # phases run in subprocesses; the flag (not the env) selects
+            # the platform there, so forward it
+            extra += ["--platform", args.platform]
+        seed = (_parse_seed(args.random_seed)
+                if args.random_seed is not None else None)
+        summary = CurriculumRunner(args.config, spec,
+                                   args.curriculum_out,
+                                   extra_argv=extra,
+                                   initial_snapshot=args.snapshot,
+                                   default_seed=seed).run()
+        print(json.dumps({k: v for k, v in summary.items()
+                          if k != "phases"}))
+        if args.result_file:
+            with open(args.result_file, "w") as f:
+                json.dump(summary, f, indent=1)
+        return 0
 
     create, manifest_snapshot = _load_config(args.config, args.overrides)
     if manifest_snapshot and not args.snapshot:

@@ -35,20 +35,26 @@ class CliRunner(Logger):
 
     def __init__(self, n_workers: int = 1,
                  env: Optional[Dict[str, str]] = None,
-                 timeout: Optional[float] = None):
+                 timeout: Optional[float] = None,
+                 pin_cpu: bool = True):
         self.n_workers = max(int(n_workers), 1)
         self.env = env
         self.timeout = timeout
+        # pin_cpu=False: serial callers (curriculum) whose single job may
+        # legitimately use the accelerator inherit the parent platform.
+        self.pin_cpu = pin_cpu
 
     def _run_one(self, argv: Sequence[str], tag: str) -> dict:
         fd, result_path = tempfile.mkstemp(
             prefix=f"veles_job_{tag}_", suffix=".json")
         os.close(fd)
         env = dict(os.environ)
-        # Pin workers to CPU even when the parent selected a platform —
-        # concurrent subprocesses must never fight over one TPU chip; the
-        # caller-level override channel is self.env.
-        env["JAX_PLATFORMS"] = "cpu"
+        if self.pin_cpu:
+            # Pin workers to CPU even when the parent selected a
+            # platform — concurrent subprocesses must never fight over
+            # one TPU chip; the caller-level override channel is
+            # self.env.
+            env["JAX_PLATFORMS"] = "cpu"
         if self.env:
             env.update(self.env)
         cmd = [sys.executable, "-m", "veles_tpu", *argv,
