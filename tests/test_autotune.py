@@ -207,7 +207,11 @@ def test_fullbatch_gather_per_class_consistency(tuned):
 
 def test_dropout_and_meandisp_resolve_via_autotune(tuned):
     """The remaining Pallas-vs-XLA switches resolve by measurement when
-    autotune is on, and keep the static platform default when off."""
+    autotune is on — but ONLY where the Pallas candidate actually
+    compiles (TPU). Off-TPU it would run in interpret mode, so the build
+    stays measurement-free and resolves straight to the XLA formulation
+    (no DB entry)."""
+    import jax
     import veles_tpu as vt
     from veles_tpu.units import nn
 
@@ -219,11 +223,23 @@ def test_dropout_and_meandisp_resolve_via_autotune(tuned):
     m.prepare([vt.Spec((32, 12, 12, 3), jnp.uint8)])
     assert m._resolved in (True, False)
 
-    db = json.load(open(os.path.join(tuned, "device_infos.json")))
-    (kind,) = db.keys()
-    ops_seen = {k.split("|")[0] for k in db[kind]["autotune"]}
-    assert "dropout_fwd_bwd_r0.3" in ops_seen
-    assert "mean_disp_normalize" in ops_seen
+    on_tpu = jax.devices()[0].platform == "tpu"
+    db_path = os.path.join(tuned, "device_infos.json")
+    if on_tpu:
+        db = json.load(open(db_path))
+        (kind,) = db.keys()
+        ops_seen = {k.split("|")[0] for k in db[kind]["autotune"]}
+        assert "dropout_fwd_bwd_r0.3" in ops_seen
+        assert "mean_disp_normalize" in ops_seen
+    else:
+        # foregone conclusion: XLA wins, nothing measured or persisted
+        assert d._resolved is False and m._resolved is False
+        if os.path.exists(db_path):
+            db = json.load(open(db_path))
+            ops_seen = {k.split("|")[0] for kind in db
+                        for k in db[kind].get("autotune", {})}
+            assert "dropout_fwd_bwd_r0.3" not in ops_seen
+            assert "mean_disp_normalize" not in ops_seen
 
     root.common.autotune = False
     d2 = nn.Dropout(0.3, name="d2")

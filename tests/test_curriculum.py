@@ -101,7 +101,9 @@ def test_curriculum_warm_start_and_seed_forwarding(tmp_path, config_file):
                 "--result-file", str(res))
     assert r.returncode == 0, r.stderr
     assert f"restore {warm}" in (r.stdout + r.stderr)
-    assert "--random-seed" not in r.stderr or True  # phases logged only
+    # the runner logs each phase's full argv; the forwarded seed must be
+    # in it (the spec sets none, so it comes from --random-seed 7)
+    assert "--random-seed 7" in (r.stdout + r.stderr)
 
     # conflicting flags rejected up front
     r2 = run_cli(tmp_path, config_file, "--curriculum", str(spec),

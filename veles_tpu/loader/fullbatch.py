@@ -198,7 +198,14 @@ class FullBatchLoader(ArrayLoader):
         n = int(min(len(arr), 4096))
         sample = arr[:n]
         packed, fp, sshape = pack_rows(sample)
-        idx = jnp.arange(bs, dtype=jnp.int32) % n
+        # Time with a shuffled permutation, matching the production
+        # access pattern (epoch shuffles): sequential indices have a
+        # locality jnp.take can exploit that a real gather never sees,
+        # which would bias the persisted winner.
+        idx = jnp.asarray(
+            np.random.default_rng(0).permutation(n)[:bs] if n >= bs
+            else np.random.default_rng(0).integers(0, n, bs),
+            jnp.int32)
         winner = autotune.pick(
             op,
             {"packed": lambda i: unpack_rows(

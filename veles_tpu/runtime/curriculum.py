@@ -110,8 +110,17 @@ class CurriculumRunner(Logger):
         self.default_seed = default_seed
 
     def _best_snapshot(self, phase_dir: str) -> Optional[str]:
-        hits = sorted(glob.glob(os.path.join(phase_dir, "*_best.json")))
-        return hits[0] if hits else None
+        hits = glob.glob(os.path.join(phase_dir, "*_best.json"))
+        if not hits:
+            return None
+        if len(hits) > 1:
+            # a phase dir normally holds exactly one best snapshot; take
+            # the newest and say so rather than an alphabetical accident
+            hits.sort(key=os.path.getmtime)
+            self.warning("phase dir %s holds %d *_best.json files; "
+                         "using newest %s", phase_dir, len(hits),
+                         os.path.basename(hits[-1]))
+        return hits[-1]
 
     def run(self) -> dict:
         from ..parallel.pool import CliRunner
@@ -121,8 +130,9 @@ class CurriculumRunner(Logger):
         # to CPU — they inherit the parent platform (or an explicit
         # --platform in extra_argv).
         runner = CliRunner(n_workers=1, pin_cpu=False)
-        best = None          # (value, phase index)
+        best = None          # (value, phase index) — drives the bar
         best_snapshot = self.initial_snapshot
+        best_snapshot_phase = None  # phase that wrote best_snapshot
         results = []
         for ph in phases:
             i = ph["index"]
@@ -135,9 +145,9 @@ class CurriculumRunner(Logger):
                 argv += ["--random-seed", str(seed)]
             if best_snapshot:
                 argv += ["--snapshot", best_snapshot]
-            self.info("curriculum phase %d/%d%s", i, len(phases),
+            self.info("curriculum phase %d/%d%s: %s", i, len(phases),
                       f" (restore {best_snapshot})" if best_snapshot
-                      else "")
+                      else "", " ".join(argv))
             res = runner.run_jobs([argv])[0]
             if "error" in res:
                 raise CurriculumError(
@@ -151,8 +161,23 @@ class CurriculumRunner(Logger):
                 best = (val, i)
                 if snap:
                     best_snapshot = snap
+                    best_snapshot_phase = i
+                else:
+                    # value and snapshot advance atomically; a phase that
+                    # improved the value but wrote no snapshot must not
+                    # let the summary pair its value with an older,
+                    # worse phase's snapshot silently
+                    self.warning(
+                        "phase %d improved best_value to %.4g but wrote "
+                        "no *_best.json; best_snapshot stays at %s",
+                        i, val,
+                        f"phase {best_snapshot_phase}"
+                        if best_snapshot_phase is not None
+                        else (f"the initial snapshot {best_snapshot}"
+                              if best_snapshot else "none"))
             elif best_snapshot is None and snap:
                 best_snapshot = snap
+                best_snapshot_phase = i
             if (self.bar is not None and best is not None
                     and best[0] <= float(self.bar)):
                 self.info("bar %.4g reached at phase %d (%.4g) — stop",
@@ -165,6 +190,7 @@ class CurriculumRunner(Logger):
             "phases_run": len(results),
             "phases": results,
             "best_snapshot": best_snapshot,
+            "best_snapshot_phase": best_snapshot_phase,
         }
         with open(os.path.join(self.out_dir, "curriculum.json"),
                   "w") as f:

@@ -288,6 +288,12 @@ class Dropout(Unit):
         if not bool(root.common.autotune):
             self._resolved = None  # static platform default at apply
             return
+        if not ops.use_pallas_default():
+            # Off-TPU the Pallas candidate runs in interpret mode — timing
+            # it is a foregone conclusion; keep off-TPU builds
+            # measurement-free.
+            self._resolved = False
+            return
         from ..runtime import autotune
         spec = in_specs[0]
         ratio, keep = self.ratio, 1.0 - self.ratio
@@ -322,8 +328,7 @@ class Dropout(Unit):
                  jax.random.bernoulli(jax.random.fold_in(key, s), keep,
                                       x.shape),
                  x / keep, 0.0).astype(x.dtype))},
-            [x, seed],
-            default="pallas" if ops.use_pallas_default() else "xla")
+            [x, seed], default="pallas")
         self._resolved = winner == "pallas"
 
     def apply(self, params, state, xs, ctx):
@@ -366,8 +371,21 @@ class LRN(Unit):
         if self.method != "auto":
             self._resolved = self.method
             return
+        from ..config import root
         from ..runtime import autotune
         spec = in_specs[0]
+        op = f"lrn_fwd_bwd_n{self.n}_b{self.beta}"
+        names = ("cumsum", "band", "band_bf16")
+        if not bool(root.common.autotune):
+            self._resolved = "cumsum"
+            self.method = self._resolved
+            return
+        cached = autotune.lookup(
+            op, names, [jax.ShapeDtypeStruct(spec.shape, spec.dtype)])
+        if cached is not None:  # warm start: no arrays materialized
+            self._resolved = cached
+            self.method = cached
+            return
         x = jnp.asarray(
             np.random.default_rng(0).standard_normal(spec.shape),
             spec.dtype)
@@ -389,7 +407,7 @@ class LRN(Unit):
         # while cumsum's isn't, so different windows may have different
         # winners even at one shape
         self._resolved = autotune.pick(
-            f"lrn_fwd_bwd_n{self.n}_b{self.beta}",
+            op,
             {"cumsum": run("cumsum"), "band": run("band"),
              "band_bf16": run("band_bf16")},
             [x], default="cumsum")
@@ -430,6 +448,10 @@ class MeanDispNormalizer(Unit):
         from ..config import root
         if self.use_pallas is not None or not bool(root.common.autotune):
             self._resolved = self.use_pallas
+            return
+        if not ops.use_pallas_default():
+            # interpret-mode Pallas off-TPU: skip the measurement
+            self._resolved = False
             return
         from ..runtime import autotune
         spec = in_specs[0]
