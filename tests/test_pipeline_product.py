@@ -173,27 +173,10 @@ def test_trainer_uses_fused_pipeline(rng):
     assert np.isfinite(res["best_value"])
 
 
-def test_1f1b_rejects_nonlinear_and_stochastic(rng):
-    B, T, V, S = 16, 8, 12, 4
+def test_1f1b_rejects_missing_stack(rng):
+    B, S = 16, 4
     mesh = make_mesh(MeshSpec(pipe=S))
-    # stochastic unit (dropout) in the chain
-    wf = build_workflow("bad1", [
-        {"type": "embedding", "vocab": V, "dim": 16, "name": "emb"},
-        {"type": "dropout", "dropout_ratio": 0.2, "name": "drop"},
-        {"type": "pipeline_stack", "n_stages": S, "d_hidden": 16,
-         "name": "stack"},
-        {"type": "seq_last", "name": "last"},
-        {"type": "softmax", "output_size": V, "name": "out"},
-    ])
-    specs = {"@input": vt.Spec((B, T), jnp.int32),
-             "@labels": vt.Spec((B,), jnp.int32),
-             "@mask": vt.Spec((B,), jnp.float32)}
-    wf.build(specs)
     o = opt.SGD(0.1)
-    ws = wf.init_state(jax.random.key(0), o)
-    with pytest.raises(WorkflowError, match="stochastic"):
-        wf.make_pipeline_train_step(o, mesh, ws, specs, n_microbatches=S)
-
     # no PipelineStack at all
     wf2 = build_workflow("bad2", [
         {"type": "all2all_tanh", "output_size": 16, "name": "fc"},
@@ -240,6 +223,197 @@ def test_config_stack_gpipe_forward_matches_sequential(rng):
     got = np.asarray(pred_pp(jax.device_put(ws, state_sh), batch))
     np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
     wf.mesh = None
+
+
+def _dropout_config(S=4, T=8, V=12, E=16, ratio=0.25):
+    """Transformer-block stages WITH dropout — the round-3 verdict's
+    showcase the fused schedule previously rejected."""
+    stage = [{"type": "attention", "n_heads": 2, "rope": True,
+              "residual": True},
+             {"type": "dropout", "dropout_ratio": ratio,
+              "use_pallas": False},
+             {"type": "layer_norm"}]
+    return {
+        "name": "pp_lm_drop",
+        "layers": [
+            {"type": "embedding", "vocab": V, "dim": E, "name": "emb"},
+            {"type": "pipeline_stack", "stages": [stage] * S,
+             "n_microbatches": S, "name": "stack"},
+            {"type": "seq_last", "name": "last"},
+            {"type": "softmax", "output_size": V, "name": "out"},
+        ],
+        "optimizer": "sgd",
+        "optimizer_args": {"lr": 0.1},
+        "pipeline_microbatches": S,
+    }
+
+
+def test_config_1f1b_dropout_matches_gpipe_ad(rng):
+    """Round-4 lift: dropout INSIDE pipeline stages trains on the fused
+    1F1B schedule and is grad-exact against AD-through-GPipe on the SAME
+    mesh — both derive unit keys from fold_in(step_key, mb_index), so
+    the masks are identical draws."""
+    S, B, T, V, E = 4, 16, 8, 12, 16
+    cfg = _dropout_config(S, T, V, E)
+    mesh = make_mesh(MeshSpec(data=2, pipe=S))
+    sw, wf, specs = _build(cfg, B, T, V)
+    ws0 = wf.init_state(jax.random.key(0), sw.optimizer)
+    batch = _lm_batch(rng, B, T, V)
+
+    step_pp, state_sh, _ = wf.make_pipeline_train_step(
+        sw.optimizer, mesh, ws0, specs, n_microbatches=S, donate=False)
+    ws_pp, mets_pp = step_pp(jax.device_put(ws0, state_sh), batch)
+
+    # AD reference on the SAME mesh: PipelineStack runs the keyed GPipe
+    # schedule, drawing the same per-microbatch dropout masks
+    sw2, wf2, _ = _build(cfg, B, T, V)
+    step_ad, state_sh2, _ = wf2.make_sharded_train_step(
+        sw2.optimizer, mesh, ws0, specs, donate=False)
+    ws_ad, mets_ad = step_ad(
+        jax.device_put(jax.tree.map(jnp.copy, ws0), state_sh2), batch)
+
+    np.testing.assert_allclose(float(mets_pp["loss"]),
+                               float(mets_ad["loss"]), rtol=2e-5)
+    fp = {jax.tree_util.keystr(p): v for p, v in
+          jax.tree_util.tree_leaves_with_path(ws_pp["params"])}
+    fa = {jax.tree_util.keystr(p): v for p, v in
+          jax.tree_util.tree_leaves_with_path(ws_ad["params"])}
+    assert fp.keys() == fa.keys()
+    for k in fp:
+        np.testing.assert_allclose(np.asarray(fp[k]), np.asarray(fa[k]),
+                                   rtol=2e-4, atol=2e-5, err_msg=k)
+    # the masks actually did something: training with ratio=0 diverges
+    # from the dropout run (guards against dropout silently disabled)
+    cfg0 = _dropout_config(S, T, V, E, ratio=0.0)
+    sw3, wf3, _ = _build(cfg0, B, T, V)
+    step0, state_sh3, _ = wf3.make_pipeline_train_step(
+        sw3.optimizer, mesh, ws0, specs, n_microbatches=S, donate=False)
+    _, mets0 = step0(jax.device_put(jax.tree.map(jnp.copy, ws0),
+                                    state_sh3), batch)
+    assert abs(float(mets0["loss"]) - float(mets_pp["loss"])) > 1e-6
+
+
+def test_config_1f1b_moe_aux_matches_gpipe_ad(rng):
+    """Round-4 lift: a MoE stage trains on the fused schedule with its
+    load-balance aux loss included — loss and updated params exactly
+    match AD-through-GPipe on the same mesh."""
+    S, B, T, V, E = 2, 8, 4, 10, 8
+    stage_moe = [{"type": "moe", "n_experts": 4, "d_hidden": 16,
+                  "top_k": 2, "aux_weight": 0.05, "name": "moe"},
+                 {"type": "layer_norm"}]
+    stage_att = [{"type": "attention", "n_heads": 2, "residual": True}]
+    cfg = {
+        "name": "pp_moe",
+        "layers": [
+            {"type": "embedding", "vocab": V, "dim": E, "name": "emb"},
+            {"type": "pipeline_stack", "stages": [stage_att, stage_moe],
+             "n_microbatches": S, "name": "stack"},
+            {"type": "seq_last", "name": "last"},
+            {"type": "softmax", "output_size": V, "name": "out"},
+        ],
+        "optimizer": "sgd", "optimizer_args": {"lr": 0.1},
+        "pipeline_microbatches": S,
+    }
+    mesh = make_mesh(MeshSpec(data=4, pipe=S))
+    sw, wf, specs = _build(cfg, B, T, V)
+    ws0 = wf.init_state(jax.random.key(0), sw.optimizer)
+    batch = _lm_batch(rng, B, T, V)
+
+    step_pp, state_sh, _ = wf.make_pipeline_train_step(
+        sw.optimizer, mesh, ws0, specs, n_microbatches=S, donate=False)
+    ws_pp, mets_pp = step_pp(jax.device_put(ws0, state_sh), batch)
+
+    sw2, wf2, _ = _build(cfg, B, T, V)
+    step_ad, state_sh2, _ = wf2.make_sharded_train_step(
+        sw2.optimizer, mesh, ws0, specs, donate=False)
+    ws_ad, mets_ad = step_ad(
+        jax.device_put(jax.tree.map(jnp.copy, ws0), state_sh2), batch)
+
+    # both paths report the main loss and the aux separately and must
+    # agree on each (gradients include aux on both)
+    np.testing.assert_allclose(float(mets_pp["loss"]),
+                               float(mets_ad["loss"]), rtol=2e-5)
+    np.testing.assert_allclose(float(mets_pp["aux"]),
+                               float(mets_ad["aux_stack"]), rtol=2e-5)
+    assert float(mets_ad["aux_stack"]) > 0.0  # the balance term is live
+    fp = {jax.tree_util.keystr(p): v for p, v in
+          jax.tree_util.tree_leaves_with_path(ws_pp["params"])}
+    fa = {jax.tree_util.keystr(p): v for p, v in
+          jax.tree_util.tree_leaves_with_path(ws_ad["params"])}
+    assert fp.keys() == fa.keys()
+    for k in fp:
+        np.testing.assert_allclose(np.asarray(fp[k]), np.asarray(fa[k]),
+                                   rtol=2e-4, atol=2e-5, err_msg=k)
+    # expert params actually moved (aux + routed tokens reach them)
+    moe_p = [v for k, v in fp.items() if "moe" in k]
+    moe_0 = [v for p, v in jax.tree_util.tree_leaves_with_path(
+        ws0["params"]) if "moe" in jax.tree_util.keystr(p)]
+    assert any(float(jnp.abs(a - b).max()) > 0
+               for a, b in zip(moe_p, moe_0))
+
+
+def test_1f1b_ring_width_independent_of_vocab(rng):
+    """Round-3 verdict #6: the activation ring must not scale with the
+    output/vocab width, and dtypes ride the ring unchanged (bf16 stays
+    bf16, int ids stay int)."""
+    from veles_tpu.parallel.pipeline_compile import PipelinePlan
+    S, B, T, E = 4, 16, 16, 8
+    mesh = make_mesh(MeshSpec(pipe=S))
+
+    def plan_for(V):
+        cfg = _seq_config(S, T, V, E)
+        sw, wf, specs = _build(cfg, B, T, V)
+        return PipelinePlan(wf, mesh, S), sw, wf, specs
+
+    p_small, *_ = plan_for(64)
+    p_big, sw, wf, specs = plan_for(32768)
+    # ring width: T*E activations, independent of V; the logits live
+    # only in the last stage's local loss input
+    assert p_small.act_width == p_big.act_width == T * E
+    assert p_big.y_width == T * 32768 or p_big.y_width == 32768
+    # input conveyor keeps token ids as int32 (no float round-trip)
+    assert p_big.in_dtype == jnp.int32
+    x = jnp.asarray(np.arange(B * T).reshape(B, T) % 7, jnp.int32)
+    packed = p_big.pack_input(x)
+    assert packed.dtype == jnp.int32
+
+    # the fused step still compiles and trains at the 32k vocab
+    ws = wf.init_state(jax.random.key(0), sw.optimizer)
+    step, state_sh, _ = wf.make_pipeline_train_step(
+        sw.optimizer, mesh, ws, specs, n_microbatches=S, donate=False)
+    batch = _lm_batch(rng, B, T, 32768)
+    _, mets = step(jax.device_put(ws, state_sh), batch)
+    assert np.isfinite(float(mets["loss"]))
+
+
+def test_1f1b_ring_preserves_bf16(rng):
+    """bf16 activations must not be upcast to f32 on the ring (round-3
+    silently carried everything as f32)."""
+    from veles_tpu.parallel.pipeline_compile import PipelinePlan
+    S, B, D = 4, 16, 16
+    mesh = make_mesh(MeshSpec(pipe=S))
+    wf = build_workflow("pp_bf16", [
+        {"type": "pipeline_stack", "n_stages": S, "d_hidden": 32,
+         "n_microbatches": S, "name": "stack"},
+        {"type": "softmax", "output_size": 5, "name": "out"},
+    ])
+    specs = {"@input": vt.Spec((B, D), jnp.bfloat16),
+             "@labels": vt.Spec((B,), jnp.int32),
+             "@mask": vt.Spec((B,), jnp.float32)}
+    wf.build(specs)
+    plan = PipelinePlan(wf, mesh, S)
+    assert plan.act_dtype == jnp.bfloat16
+    assert plan.in_dtype == jnp.bfloat16
+    o = opt.SGD(0.1)
+    ws = wf.init_state(jax.random.key(1), o)
+    step, state_sh, _ = wf.make_pipeline_train_step(
+        o, mesh, ws, specs, n_microbatches=S, donate=False)
+    batch = {"@input": jnp.asarray(rng.standard_normal((B, D)),
+                                   jnp.bfloat16),
+             "@labels": jnp.asarray(rng.integers(0, 5, B), jnp.int32),
+             "@mask": jnp.ones((B,), jnp.float32)}
+    _, mets = step(jax.device_put(ws, state_sh), batch)
+    assert np.isfinite(float(mets["loss"]))
 
 
 def test_trainer_rejects_padded_tail_batches(rng):

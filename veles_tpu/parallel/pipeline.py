@@ -59,8 +59,9 @@ def bubble_fraction(n_stages: int, n_microbatches: int) -> float:
     return 1.0 - n_microbatches / steps
 
 
-def _pipeline_local(stage_params, x_blk, *, apply_local, axis_name: str,
-                    n_microbatches: int, n_stages: int):
+def _pipeline_local(stage_params, x_blk, *args, apply_local,
+                    axis_name: str, n_microbatches: int, n_stages: int,
+                    keyed: bool = False, batch_axes=()):
     """Per-device body under shard_map.
 
     stage_params: this device's stage params — every leaf has leading
@@ -69,7 +70,15 @@ def _pipeline_local(stage_params, x_blk, *, apply_local, axis_name: str,
     x_blk: (1, Q, mb...) this device's contiguous block of Q = n_mb/S
     microbatches.  Stage-0 inputs and finished outputs each travel on a
     one-microbatch conveyor rotating one hop per step (see module doc).
+
+    ``keyed``: stage fns take ``(p, x, key)`` and return ``(y, aux)``
+    where ``key`` is ``fold_in(rng, microbatch_index)`` — the SAME
+    derivation the fused 1F1B schedule uses, so a stochastic unit draws
+    identical randomness under both schedules, and per-microbatch aux
+    losses (MoE load balance) accumulate into the second output (mean
+    over microbatches, replicated).
     """
+    rng = args[0] if keyed else None
     S, Q = n_stages, n_microbatches // n_stages
     idx = jax.lax.axis_index(axis_name)
     p_local = jax.tree.map(lambda a: a[0], stage_params)
@@ -85,7 +94,7 @@ def _pipeline_local(stage_params, x_blk, *, apply_local, axis_name: str,
     n_steps = n_microbatches + 2 * (S - 1)
 
     def body(carry, s):
-        held, in_conv, out_conv, out_local = carry
+        held, in_conv, out_conv, out_local, aux_acc = carry
 
         # -- input conveyor: device c loads mb t = s + c when it owns it
         t_here = s + idx
@@ -102,8 +111,19 @@ def _pipeline_local(stage_params, x_blk, *, apply_local, axis_name: str,
         # reduction would need a manual interleaved bwd schedule; not
         # worth the complexity at this depth).
         cur = jnp.where(idx == 0, in_conv, held)
-        out = jax.checkpoint(
-            lambda p, c: apply_local(idx, p, c))(p_local, cur)
+        m_f = s - idx                        # this device's forward mb
+        f_valid = (m_f >= 0) & (m_f < n_microbatches)
+        if keyed:
+            key_m = jax.random.fold_in(
+                rng, jnp.clip(m_f, 0, n_microbatches - 1))
+            out, aux = jax.checkpoint(
+                lambda p, c, k: apply_local(idx, p, c, k))(
+                    p_local, cur, key_m)
+            aux_acc = aux_acc + jnp.where(
+                f_valid, aux.astype(jnp.float32), 0.0)
+        else:
+            out = jax.checkpoint(
+                lambda p, c: apply_local(idx, p, c))(p_local, cur)
 
         # -- output conveyor: last stage writes mb m = s - (S-1)
         m_written = s - (S - 1)
@@ -124,13 +144,24 @@ def _pipeline_local(stage_params, x_blk, *, apply_local, axis_name: str,
         held = jax.lax.ppermute(out, axis_name, up)
         in_conv = jax.lax.ppermute(in_conv, axis_name, down)
         out_conv = jax.lax.ppermute(out_conv, axis_name, up)
-        return (held, in_conv, out_conv, out_local), None
+        return (held, in_conv, out_conv, out_local, aux_acc), None
 
     zeros = jnp.zeros(mb_shape, x_local.dtype)
     out_local0 = jnp.zeros((Q,) + mb_shape, x_local.dtype)
-    (_, _, _, out_local), _ = jax.lax.scan(
-        body, (zeros, zeros, zeros, out_local0), jnp.arange(n_steps))
-    return out_local[None]                   # (1, Q, mb...)
+    (_, _, _, out_local, aux_acc), _ = jax.lax.scan(
+        body,
+        (zeros, zeros, zeros, out_local0, jnp.zeros((), jnp.float32)),
+        jnp.arange(n_steps))
+    if not keyed:
+        return out_local[None]               # (1, Q, mb...)
+    # per-stage aux sums -> replicated mean over microbatches (each aux
+    # is already a mean over its microbatch slice; data shards average
+    # via psum/bsz, psum over the pipe ring collects stages, /n_mb
+    # averages microbatches)
+    for ax in batch_axes:
+        aux_acc = jax.lax.psum(aux_acc, ax) / jax.lax.psum(1, ax)
+    aux_acc = jax.lax.psum(aux_acc, axis_name) / n_microbatches
+    return out_local[None], aux_acc
 
 
 def _ravel_stages(stage_fns: Sequence[Callable], params_list):
@@ -147,12 +178,12 @@ def _ravel_stages(stage_fns: Sequence[Callable], params_list):
     pmax = max(lens)
     stacked = jnp.stack([jnp.pad(v, (0, pmax - v.shape[0])) for v in vecs])
     branches = [
-        (lambda vec, x, _fn=fn, _un=un, _l=l:
-         _fn(_un(vec[:_l]), x))
+        (lambda vec, *xs, _fn=fn, _un=un, _l=l:
+         _fn(_un(vec[:_l]), *xs))
         for fn, un, l in zip(stage_fns, unravels, lens)]
 
-    def apply_vec(idx, vec, x):
-        return jax.lax.switch(idx, branches, vec, x)
+    def apply_vec(idx, vec, *xs):
+        return jax.lax.switch(idx, branches, vec, *xs)
 
     return stacked, apply_vec, [
         (lambda row, _un=un, _l=l: _un(row[:_l]))
@@ -174,8 +205,8 @@ def _prep_stages(stage_fn, params, S: int, axis_name: str):
                 f"the {axis_name!r} mesh axis size {S}")
         p_specs = jax.tree.map(lambda a: _stage_spec(a, axis_name), params)
 
-        def apply_local(idx, p, x):
-            return stage_fn(p, x)
+        def apply_local(idx, p, *xs):
+            return stage_fn(p, *xs)
 
         return params, apply_local, p_specs, None
     stage_fns, per_stage = list(stage_fn), list(params)
@@ -210,7 +241,8 @@ def pipeline_apply(stage_fn: Union[Callable, Sequence[Callable]],
                    params, x, mesh: Mesh, *,
                    axis_name: str = "pipe",
                    n_microbatches: Optional[int] = None,
-                   batch_axes: Sequence[str] = ()):
+                   batch_axes: Sequence[str] = (),
+                   rng: Optional[jax.Array] = None):
     """Run x through S pipelined stages.
 
     ``stage_fn(params, x) -> y``: one stage's computation (same activation
@@ -225,6 +257,13 @@ def pipeline_apply(stage_fn: Union[Callable, Sequence[Callable]],
     sharded over (e.g. ("data",)) — without it a dp×pp mesh would
     all-gather the batch and run the FULL batch through every data shard.
     Returns (n_microbatches, mb, ...) outputs, sharded the same way.
+
+    ``rng``: keyed mode — stage fns take ``(params, x, key)`` with
+    ``key = fold_in(rng, microbatch_index)`` (identical derivation to the
+    fused 1F1B schedule, so stochastic stages draw the same randomness
+    under either schedule) and return ``(y, aux)``; the call then returns
+    ``(outputs, aux_mean)`` where ``aux_mean`` is the replicated mean
+    over microbatches of the summed per-stage aux losses.
     """
     S = mesh.shape[axis_name]
     stacked, apply_local, p_specs, _ = _prep_stages(
@@ -237,17 +276,22 @@ def pipeline_apply(stage_fn: Union[Callable, Sequence[Callable]],
                                      batch_axes)
     _log.debug("pipeline: S=%d n_mb=%d bubble=%.1f%%", S, n_mb,
                100 * bubble_fraction(S, n_mb))
+    keyed = rng is not None
     fn = jax.shard_map(
         functools.partial(_pipeline_local, apply_local=apply_local,
                           axis_name=axis_name, n_microbatches=n_mb,
-                          n_stages=S),
+                          n_stages=S, keyed=keyed,
+                          batch_axes=batch_axes),
         mesh=mesh,
-        in_specs=(p_specs, x_spec),
-        out_specs=x_spec,
+        in_specs=(p_specs, x_spec) + ((P(),) if keyed else ()),
+        out_specs=(x_spec, P()) if keyed else x_spec,
         check_vma=False)
     # group the microbatch axis into (S, Q) so P(axis) places block d on
     # stage d, then flatten back
     grouped = x.reshape((S, n_mb // S) + x.shape[1:])
+    if keyed:
+        out, aux = fn(stacked, grouped, rng)
+        return out.reshape((n_mb,) + x.shape[1:]), aux
     out = fn(stacked, grouped)
     return out.reshape((n_mb,) + x.shape[1:])
 
@@ -279,9 +323,10 @@ def pick_batch_axes(axis_sizes: dict, mb: int,
 # 1F1B fused train step
 # ---------------------------------------------------------------------------
 
-def _1f1b_local(stage_params, x_blk, y_blk, *, apply_local, loss_local,
+def _1f1b_local(stage_params, x_blk, y_blk, *args, apply_local, loss_local,
                 axis_name: str, batch_axes, n_microbatches: int,
-                n_stages: int):
+                n_stages: int, het: bool = False, keyed: bool = False,
+                ring_feat=(), ring_dtype=None):
     """Per-device 1F1B body under shard_map.
 
     Lockstep schedule over s = 0..n_mb+2(S-1)-1 where EVERY step carries
@@ -298,7 +343,28 @@ def _1f1b_local(stage_params, x_blk, y_blk, *, apply_local, loss_local,
     1F1B memory property GPipe-with-tape lacks.  Stage internals are
     rematerialized inside the VJP (activation-stash-only recompute
     backward, the standard 1F1B memory/compute trade).
+
+    Internal stage contract (both modes lower to it):
+    ``apply_full(idx, p, x_in, x_ring, key) -> (ring_msg, out, aux)``.
+
+    * ``het=False`` (uniform buffers, the generic API): x_in/x_ring/ring/
+      out all share the input microbatch shape; the lift selects
+      ``where(idx==0, x_in, x_ring)`` and emits its output as both the
+      ring message and the loss input, aux 0.
+    * ``het=True``: the input conveyor, activation ring, and loss input
+      have their OWN static shapes/dtypes (``ring_feat``/``out_feat``) —
+      the ring never carries logits (stage S-1's output is consumed by
+      the loss locally, its ring slot is zeros nobody reads) and dtypes
+      are preserved end to end (a bf16 ring stays bf16).  Backward keeps
+      two stash buffers (stage-0 input + ring activations).
+    * ``keyed``: the per-slot key is ``fold_in(rng, mb_index)`` — forward
+      and its matching VJP recompute use the SAME key, so stochastic
+      stages (dropout) are consistent, and the derivation equals the
+      GPipe keyed path's.  Aux losses accumulate from valid forward
+      slots; their parameter/input gradients enter through the VJP's
+      aux cotangent of 1.
     """
+    rng = args[0] if keyed else None
     S, Q = n_stages, n_microbatches // n_stages
     K = 2 * (S - 1) + 1 if S > 1 else 1      # stash depth (max in-flight)
     idx = jax.lax.axis_index(axis_name)
@@ -307,16 +373,39 @@ def _1f1b_local(stage_params, x_blk, y_blk, *, apply_local, loss_local,
     y_local = y_blk[0]                       # (Q, lbl...)
     mb_shape = x_local.shape[1:]
     lbl_shape = y_local.shape[1:]
+    mb = mb_shape[0]
+    if het:
+        ring_shape, ring_dt = (mb,) + tuple(ring_feat), ring_dtype
+    else:
+        ring_shape, ring_dt = mb_shape, x_local.dtype
 
     down = [(i, (i - 1) % S) for i in range(S)]
     up = [(i, (i + 1) % S) for i in range(S)]
     n_steps = n_microbatches + 2 * (S - 1)
 
-    def stage_f(p, x):
-        return apply_local(idx, p, x)
+    if het:
+        def apply_full(p, xi, xr, key):
+            return apply_local(idx, p, xi, xr, key)
+    else:
+        def apply_full(p, xi, xr, key):
+            cur = jnp.where(idx == 0, xi, xr)
+            out = (apply_local(idx, p, cur, key) if keyed
+                   else apply_local(idx, p, cur))
+            if keyed:
+                out, aux = out
+            else:
+                aux = jnp.zeros((), jnp.float32)
+            return out, out, aux
+
+    def mb_key(m):
+        if rng is None:
+            return jax.random.key(0)  # het & deterministic: unused
+        return jax.random.fold_in(
+            rng, jnp.clip(m, 0, n_microbatches - 1))
 
     def body(carry, s):
-        (held, g_held, in_conv, lbl_conv, stash, gp_acc, loss_acc) = carry
+        (held, g_held, in_conv, lbl_conv, stash_in, stash_ring, gp_acc,
+         loss_acc, aux_acc) = carry
 
         # -- input conveyor (converges down to stage 0): load mb s+idx
         t_in = s + idx
@@ -338,43 +427,70 @@ def _1f1b_local(stage_params, x_blk, y_blk, *, apply_local, loss_local,
         # -- forward slot: mb m_f = s - idx
         m_f = s - idx
         f_valid = (m_f >= 0) & (m_f < n_microbatches)
-        cur = jnp.where(idx == 0, in_conv, held)
-        out = stage_f(p_local, cur)
-        # stash this step's stage input for the matching backward
-        stash = jnp.where(f_valid,
-                          stash.at[jnp.mod(m_f, K)].set(cur), stash)
+        ring_out, out_f, aux_f = apply_full(
+            p_local, in_conv, held, mb_key(m_f))
+        # stash this step's stage inputs for the matching backward
+        slot = jnp.mod(m_f, K)
+        if het:
+            stash_in = jnp.where(f_valid,
+                                 stash_in.at[slot].set(in_conv), stash_in)
+            stash_ring = jnp.where(
+                f_valid, stash_ring.at[slot].set(held), stash_ring)
+        else:
+            cur = jnp.where(idx == 0, in_conv, held)
+            stash_in = jnp.where(f_valid,
+                                 stash_in.at[slot].set(cur), stash_in)
 
         # -- backward slot: mb m_b = s - 2(S-1) + idx
         m_b = s - 2 * (S - 1) + idx
         b_valid = (m_b >= 0) & (m_b < n_microbatches)
-        x_saved = stash[jnp.mod(m_b, K)]
+        bslot = jnp.mod(m_b, K)
+        xi_saved = stash_in[bslot]
+        xr_saved = stash_ring[bslot] if het else xi_saved
         # last stage: m_b == m_f, loss grad comes straight off this
         # step's forward output; other stages consume the rotated
-        # cotangent from the stage above.
-        loss_m, gy_last = jax.value_and_grad(loss_local)(out, lbl_conv)
-        gy = jnp.where(idx == S - 1, gy_last, g_held)
-        _, vjp = jax.vjp(stage_f, p_local, x_saved)
-        gp, gx = vjp(gy)
+        # cotangent from the stage above (naturally zero at S-1: stage
+        # 0 reads the input conveyor, so its ring cotangent is zero).
+        loss_m, gy_last = jax.value_and_grad(loss_local)(out_f, lbl_conv)
+        key_b = mb_key(m_b)
+        _, vjp = jax.vjp(
+            lambda p, xi, xr: apply_full(p, xi, xr, key_b),
+            p_local, xi_saved, xr_saved)
+        gy = jnp.where(idx == S - 1, gy_last,
+                       jnp.zeros_like(gy_last))
+        # one VJP for all three outputs: the ring cotangent from above,
+        # the loss cotangent (last stage only), and aux cotangent 1 —
+        # aux-loss grads get the same /n_mb rescale as the main loss
+        gp, _, gx = vjp((g_held, gy, jnp.ones((), jnp.float32)))
         gp_acc = jax.tree.map(
             lambda a, g: a + jnp.where(b_valid, g, 0), gp_acc, gp)
         loss_acc = loss_acc + jnp.where(
             (idx == S - 1) & f_valid, loss_m, 0.0)
+        # aux tracked separately so the step can report it as its own
+        # metric (the AD path's loss metric excludes aux too); its
+        # gradient already entered through the vjp cotangent above
+        aux_acc = aux_acc + jnp.where(
+            f_valid, aux_f.astype(jnp.float32), 0.0)
 
-        held = jax.lax.ppermute(out, axis_name, up)
-        g_held = jax.lax.ppermute(jnp.where(b_valid, gx, 0.0),
+        held = jax.lax.ppermute(ring_out, axis_name, up)
+        g_held = jax.lax.ppermute(jnp.where(b_valid, gx, 0),
                                   axis_name, down)
         in_conv = jax.lax.ppermute(in_conv, axis_name, down)
         lbl_conv = jax.lax.ppermute(lbl_conv, axis_name, up)
-        return (held, g_held, in_conv, lbl_conv, stash, gp_acc,
-                loss_acc), None
+        return (held, g_held, in_conv, lbl_conv, stash_in, stash_ring,
+                gp_acc, loss_acc, aux_acc), None
 
-    zeros = jnp.zeros(mb_shape, x_local.dtype)
-    carry0 = (zeros, zeros, zeros,
+    carry0 = (jnp.zeros(ring_shape, ring_dt),
+              jnp.zeros(ring_shape, ring_dt),
+              jnp.zeros(mb_shape, x_local.dtype),
               jnp.zeros(lbl_shape, y_local.dtype),
               jnp.zeros((K,) + mb_shape, x_local.dtype),
+              (jnp.zeros((K,) + ring_shape, ring_dt) if het
+               else jnp.zeros((), jnp.float32)),
               jax.tree.map(jnp.zeros_like, p_local),
+              jnp.zeros((), jnp.float32),
               jnp.zeros((), jnp.float32))
-    (_, _, _, _, _, gp_acc, loss_acc), _ = jax.lax.scan(
+    (_, _, _, _, _, _, gp_acc, loss_acc, aux_acc), _ = jax.lax.scan(
         body, carry0, jnp.arange(n_steps))
     # batch dims may be sharded over data axes: reduce across those shards
     # (params are replicated there), then rescale so per-microbatch
@@ -387,22 +503,28 @@ def _1f1b_local(stage_params, x_blk, y_blk, *, apply_local, loss_local,
         gp_acc = jax.tree.map(
             lambda g: jax.lax.psum(g, ax), gp_acc)
         loss_acc = jax.lax.psum(loss_acc, ax)
+        aux_acc = jax.lax.psum(aux_acc, ax)
     gp_acc = jax.tree.map(lambda g: g / bsz, gp_acc)
     loss_acc = loss_acc / bsz
-    # the loss lives on the last stage only; share it along the pipe ring
+    aux_acc = aux_acc / bsz
+    # the loss lives on the last stage only (aux on every stage); share
+    # them along the pipe ring
     loss_acc = jax.lax.psum(loss_acc, axis_name) / n_microbatches
+    aux_acc = jax.lax.psum(aux_acc, axis_name) / n_microbatches
     # grads are accumulated as SUMS over microbatches; rescale to the mean
     # so (loss, grads) form a consistent pair with the pipeline_apply +
     # jax.grad path — swapping schedules must not change the effective
     # learning rate by a factor of n_microbatches.
     gp_acc = jax.tree.map(lambda g: g / n_microbatches, gp_acc)
-    return (jax.tree.map(lambda g: g[None], gp_acc), loss_acc)
+    return (jax.tree.map(lambda g: g[None], gp_acc), loss_acc, aux_acc)
 
 
 def pipeline_train_step(stage_fn: Union[Callable, Sequence[Callable]],
                         loss_fn: Callable, params, x, labels, mesh: Mesh, *,
                         axis_name: str = "pipe",
-                        batch_axes: Sequence[str] = ()):
+                        batch_axes: Sequence[str] = (),
+                        rng: Optional[jax.Array] = None,
+                        ring_spec=None, with_aux: bool = False):
     """Fused 1F1B pipeline training step: returns ``(loss, param_grads)``.
 
     Unlike :func:`pipeline_apply` + ``jax.grad`` (GPipe schedule: AD tapes
@@ -423,6 +545,23 @@ def pipeline_train_step(stage_fn: Union[Callable, Sequence[Callable]],
     ``jax.value_and_grad`` over ``pipeline_apply``, so the two schedules
     are drop-in interchangeable under one optimizer.  Heterogeneous form
     returns grads as a list of per-stage pytrees matching ``params``.
+
+    Two stage contracts:
+
+    * **uniform** (``ring_spec=None``): ``stage_fn(p, x) -> y`` with the
+      same microbatch shape in/out; with ``rng`` given, ``stage_fn(p, x,
+      key) -> (y, aux)`` where ``key = fold_in(rng, mb_index)`` (the same
+      derivation :func:`pipeline_apply`'s keyed mode uses, so stochastic
+      stages match across schedules) and ``aux`` joins the loss with
+      cotangent 1.
+    * **heterogeneous buffers** (``ring_spec`` a per-sample
+      ``ShapeDtypeStruct``): ``stage_fn(p, x_in, x_ring, key) ->
+      (ring_msg, out, aux)``.  The input conveyor keeps x's shape/dtype,
+      the activation ring carries exactly ``ring_spec`` per sample
+      (dtype preserved — never upcast), and the last stage's ``out``
+      feeds ``loss_fn`` locally without ever riding the ring, so ring
+      bytes are independent of the output/vocab width.  Used by the
+      fused workflow compiler (``pipeline_compile.py``).
     """
     S = mesh.shape[axis_name]
     stacked, apply_local, p_specs, unravels = _prep_stages(
@@ -433,22 +572,34 @@ def pipeline_train_step(stage_fn: Union[Callable, Sequence[Callable]],
     batch_axes, x_spec = _prep_batch(x, n_mb, S, mesh, axis_name,
                                      batch_axes)
     lbl_spec = x_spec
+    het = ring_spec is not None
+    keyed = rng is not None or het
+    if het and rng is None:
+        rng = jax.random.key(0)  # deterministic het stages: key unused
     fn = jax.shard_map(
         functools.partial(_1f1b_local, apply_local=apply_local,
                           loss_local=loss_fn, axis_name=axis_name,
                           batch_axes=batch_axes, n_microbatches=n_mb,
-                          n_stages=S),
+                          n_stages=S, het=het, keyed=keyed,
+                          ring_feat=(tuple(ring_spec.shape) if het
+                                     else ()),
+                          ring_dtype=ring_spec.dtype if het else None),
         mesh=mesh,
-        in_specs=(p_specs, x_spec, lbl_spec),
-        out_specs=(p_specs, P()),
+        in_specs=(p_specs, x_spec, lbl_spec) + ((P(),) if keyed else ()),
+        out_specs=(p_specs, P(), P()),
         check_vma=False)
     grouped_x = x.reshape((S, n_mb // S) + x.shape[1:])
     grouped_y = labels.reshape((S, n_mb // S) + labels.shape[1:])
-    grads, loss = fn(stacked, grouped_x, grouped_y)
+    args = (rng,) if keyed else ()
+    grads, loss, aux = fn(stacked, grouped_x, grouped_y, *args)
     if unravels is not None:
         # hand grads back in the caller's per-stage structures, not the
         # internal zero-padded raveled stack
         grads = [un(grads[s]) for s, un in enumerate(unravels)]
+    # `loss` excludes aux (the AD path's reporting contract: aux is its
+    # own metric); grads ARE d(loss + aux)/dparams
+    if with_aux:
+        return loss, aux, grads
     return loss, grads
 
 

@@ -15,17 +15,33 @@ Mapping (all shapes validated at compile time):
 * forward units AFTER the stack (seq_last, heads…) plus the evaluator
   loss fold into stage S-1.
 
-The 1F1B ring carries ONE uniform buffer shape, but the folded segments
-change shapes (token ids -> activations -> logits).  Rather than teaching
-the verified schedule about shape polymorphism, every inter-stage tensor
-is carried **flat-padded per sample**: ``(mb, Fs)`` where ``Fs`` is the
-widest per-sample flat size along the chain.  Each stage closure
-unflattens its true input shape, applies its units, and re-pads — pad
-lanes are written as zeros each step, so no garbage propagates, and the
-per-sample layout keeps the microbatch dim shardable over data axes
-(dp×pp composition).  Labels/masks ride the existing label conveyor the
-same way.  Parameters reuse the heterogeneous ravel+switch machinery of
-``pipeline.py`` unchanged.
+The folded segments change shapes (token ids -> activations -> logits),
+so the schedule's three transports each carry their OWN static flat
+shape/dtype (``pipeline_train_step``'s heterogeneous-buffer mode):
+
+* the **input conveyor** carries ``(mb, in_width)`` in the input dtype
+  (token ids stay int32 — no float round-trip);
+* the **activation ring** carries ``(mb, act_width)`` in the activation
+  dtype (bf16 stays bf16 — round 3 silently upcast to f32);
+* the last stage's **logits never ride the ring**: the loss consumes
+  them locally in the same step, so ring bytes are independent of the
+  vocab width (round 3 padded every hop to ``max(in, act, T·V)`` —
+  4·T·V bytes per hop at a real vocab regardless of the model width).
+
+Each stage closure unflattens its true input shape, applies its units,
+and re-pads; pad lanes are written as zeros each step, so no garbage
+propagates, and the per-sample layout keeps the microbatch dim shardable
+over data axes (dp×pp composition).  Labels/masks ride the label
+conveyor the same way.  Parameters reuse the heterogeneous ravel+switch
+machinery of ``pipeline.py`` unchanged.
+
+Stochastic units (dropout) draw from ``fold_in(step_key, mb_index)`` —
+the schedule threads the per-microbatch key into every stage closure and
+its backward recompute, and the GPipe keyed path uses the identical
+derivation, so the two schedules are grad-exact against each other.
+Aux-loss units (MoE load balance) accumulate through the stage
+closures' aux output across stages AND microbatches; aux gradients
+enter through the schedule's aux cotangent.
 
 No reference counterpart (the reference's only parallel axis was the
 batch, SURVEY.md §2.5); the scheduling contract follows the 1F1B /
@@ -48,9 +64,9 @@ def _sample_size(shape: Sequence[int]) -> int:
 
 
 def _flatten_pad(x: jax.Array, width: int) -> jax.Array:
-    """(mb, *s) -> (mb, width) f32, zero-padded per sample."""
+    """(mb, *s) -> (mb, width), zero-padded per sample, dtype preserved."""
     mb = x.shape[0]
-    flat = x.reshape(mb, -1).astype(jnp.float32)
+    flat = x.reshape(mb, -1)
     pad = width - flat.shape[1]
     if pad:
         flat = jnp.pad(flat, ((0, 0), (0, pad)))
@@ -94,18 +110,15 @@ class PipelinePlan:
                     f"evaluator side input {src!r} must be a batch key "
                     "(it rides the label conveyor)")
         for u in order:
-            if getattr(u, "stochastic", False):
+            # stochastic units draw per-microbatch keys and aux-loss
+            # units accumulate through the stage closures' aux channel
+            # (round-4 lift); only self-updating units stay out — their
+            # state writes do not ride the pipeline ring
+            if getattr(u, "self_updating", False):
                 raise WorkflowError(
-                    f"stochastic unit {u.name!r} ({type(u).__name__}) is "
-                    "not supported inside the fused 1F1B step (no per-"
-                    "microbatch RNG plumbing); drop it or train with the "
-                    "GPipe/AD path")
-            if getattr(u, "has_aux_loss", False) or \
-                    getattr(u, "self_updating", False):
-                raise WorkflowError(
-                    f"unit {u.name!r} carries auxiliary loss or self-"
-                    "updating state, which the fused 1F1B step does not "
-                    "thread; use the GPipe/AD path")
+                    f"self-updating unit {u.name!r} is not supported in "
+                    "the fused 1F1B step (its state updates do not ride "
+                    "the pipeline ring); use the GPipe/AD path")
         stacks = [u for u in order if isinstance(u, PipelineStack)]
         if len(stacks) != 1:
             raise WorkflowError(
@@ -145,9 +158,11 @@ class PipelinePlan:
         y_spec = specs[order[-1].name]
         self.y_shape = tuple(y_spec.shape[1:])
         self.y_dtype = y_spec.dtype
-        self.width = max(_sample_size(self.in_shape),
-                         _sample_size(self.act_shape),
-                         _sample_size(self.y_shape))
+        # three independent transports (module doc): ring width must not
+        # depend on the output/vocab width
+        self.in_width = _sample_size(self.in_shape)
+        self.act_width = _sample_size(self.act_shape)
+        self.y_width = _sample_size(self.y_shape)
         # label conveyor layout: evaluator side inputs packed in order
         self.label_keys = tuple(ev.inputs[1:])
         self.label_shapes = []
@@ -161,9 +176,9 @@ class PipelinePlan:
 
     # -- packing -----------------------------------------------------------
     def pack_input(self, x: jax.Array) -> jax.Array:
-        """(B, *in) -> (n_mb, mb, width)."""
+        """(B, *in) -> (n_mb, mb, in_width), input dtype preserved."""
         xm = x.reshape((self.n_mb, self.mb) + self.in_shape)
-        return jax.vmap(lambda b: _flatten_pad(b, self.width))(xm)
+        return jax.vmap(lambda b: _flatten_pad(b, self.in_width))(xm)
 
     def pack_labels(self, batch: Dict[str, jax.Array]) -> jax.Array:
         """Evaluator side inputs -> (n_mb, mb, label_width)."""
@@ -190,25 +205,55 @@ class PipelinePlan:
         return out
 
     # -- stage closures ----------------------------------------------------
+    @staticmethod
+    def _apply_acc(u, p, x, ictx, aux):
+        """One unit with aux-loss accumulation (the workflow AD path's
+        aux channel, folded into the stage closure)."""
+        y, st = u.apply(p.get(u.name, {}), {}, [x], ictx)
+        if getattr(u, "has_aux_loss", False):
+            aux = aux + u.aux_weight * st["aux_loss"]
+        return y, aux
+
     def stage_fns(self, ctx: Context) -> List:
-        """Per-stage flat (mb, width) -> (mb, width) closures.  ``ctx``
-        must carry mesh=None: the closures execute inside the schedule's
-        shard_map, where a unit starting its own collective (ring
-        attention) would illegally nest."""
+        """Per-stage closures in ``pipeline_train_step``'s heterogeneous-
+        buffer contract: ``(p, x_in, x_ring, key) -> (ring, out, aux)``
+        where ``key`` is the schedule's per-microbatch key (stochastic
+        units read it through their unit ctx) and ``aux`` the stage's
+        summed weighted aux losses.  ``ctx`` must carry mesh=None: the
+        closures execute inside the schedule's shard_map, where a unit
+        starting its own collective (ring attention) would illegally
+        nest."""
         fns = []
         for i in range(self.S):
-            def fn(p, xf, _i=i):
+            def fn(p, x_in, x_ring, key, _i=i):
+                ictx = Context(train=ctx.train, key=key, mesh=None)
+                mb = x_in.shape[0]
+                aux = jnp.zeros((), jnp.float32)
                 if _i == 0:
-                    x = _unflatten(xf, self.in_shape, self.in_dtype)
+                    x = _unflatten(x_in, self.in_shape, self.in_dtype)
                     for u in self.pre:
-                        x, _ = u.apply(p.get(u.name, {}), {}, [x], ctx)
+                        x, aux = self._apply_acc(u, p, x, ictx, aux)
                 else:
-                    x = _unflatten(xf, self.act_shape, self.act_dtype)
-                x = self.stack.stage_apply(_i, p["__stack__"], x, ctx)
+                    x = _unflatten(x_ring, self.act_shape, self.act_dtype)
+                x, a = self.stack.stage_apply_aux(
+                    _i, p["__stack__"], x, ictx)
+                aux = aux + a
+                # transports carry the DECLARED spec dtypes: a unit that
+                # internally promotes (f32 math on a bf16 stream) is cast
+                # back at the stage boundary, exactly like the spec
+                # contract between workflow units
                 if _i == self.S - 1:
                     for u in self.post:
-                        x, _ = u.apply(p.get(u.name, {}), {}, [x], ctx)
-                return _flatten_pad(x, self.width)
+                        x, aux = self._apply_acc(u, p, x, ictx, aux)
+                    # logits are consumed by the loss locally — the ring
+                    # slot is a zeros placeholder nobody reads
+                    return (jnp.zeros((mb, self.act_width),
+                                      self.act_dtype),
+                            _flatten_pad(x.astype(self.y_dtype),
+                                         self.y_width), aux)
+                return (_flatten_pad(x.astype(self.act_dtype),
+                                     self.act_width),
+                        jnp.zeros((mb, self.y_width), self.y_dtype), aux)
             fns.append(fn)
         return fns
 
@@ -279,10 +324,12 @@ def build_pipeline_step(wf, optimizer, mesh, wstate, batch_spec, *,
     plan = PipelinePlan(wf, mesh, n_microbatches, axis_name=axis_name)
     # Stage closures run units with empty state; a unit that actually
     # CARRIES state (MeanDispNormalizer stats, BN...) would read missing
-    # keys at trace time — reject it up front with a real error.
+    # keys at trace time — reject it up front with a real error.  An
+    # aux-loss channel is a per-step output, not persistent state: it
+    # accumulates through the stage closures instead.
     from ..units.workflow import WorkflowError
     stateful = [u.name for u in plan.pre + [plan.stack] + plan.post
-                if wstate["state"].get(u.name)]
+                if set(wstate["state"].get(u.name, {})) - {"aux_loss"}]
     if stateful:
         raise WorkflowError(
             f"stateful units {stateful} are not supported in the fused "
@@ -301,21 +348,29 @@ def build_pipeline_step(wf, optimizer, mesh, wstate, batch_spec, *,
     wf.mesh = mesh
     wf.state_sharding = state_sh
     n_samples = jnp.asarray(plan.batch_size, jnp.float32)
+    ring_spec = jax.ShapeDtypeStruct((plan.act_width,), plan.act_dtype)
 
     def step(wstate, batch):
         params = wstate["params"]
         xf = plan.pack_input(batch["@input"])
         lf = plan.pack_labels(batch)
-        loss, sgrads = pipeline_train_step(
+        # the SAME key split as Workflow._build_step: both schedules
+        # derive per-microbatch unit keys from `sub`, so a stochastic
+        # stage draws identical masks under either — the grad-exactness
+        # contract (tests/test_pipeline_product.py)
+        key, sub = jax.random.split(wstate["key"])
+        loss, aux, sgrads = pipeline_train_step(
             stage_fns, loss_fn, plan.split_params(params), xf, lf, mesh,
-            axis_name=axis_name, batch_axes=baxes)
+            axis_name=axis_name, batch_axes=baxes, rng=sub,
+            ring_spec=ring_spec, with_aux=True)
         grads = plan.merge_grads(sgrads, params)
         nparams, opt_state = optimizer.update(
             grads, wstate["opt_state"], params, wstate["step"])
-        key, _ = jax.random.split(wstate["key"])
         nws = new_state(nparams, wstate["state"], opt_state,
                         wstate["step"] + 1, key)
-        return nws, {"loss": loss, "n_samples": n_samples}
+        # `loss` excludes aux (the AD path's metric contract); the
+        # gradient step above includes it
+        return nws, {"loss": loss, "aux": aux, "n_samples": n_samples}
 
     fn = jax.jit(step,
                  in_shardings=(state_sh, batch_sh),

@@ -196,6 +196,16 @@ class PipelineStack(Forward):
             self._stage_units = [
                 self._build_stage_units(i, cfg, compute_dtype)
                 for i, cfg in enumerate(stages)]
+            subs = [u for us in self._stage_units for u in us]
+            # sub-unit aux losses surface through the stack's own aux
+            # channel (weights already applied per sub-unit, so the
+            # stack-level weight is 1); stochastic sub-units make the
+            # stack itself stochastic for workflow bookkeeping
+            self.has_aux_loss = any(
+                getattr(u, "has_aux_loss", False) for u in subs)
+            self.aux_weight = 1.0
+            self.stochastic = any(
+                getattr(u, "stochastic", False) for u in subs)
         else:
             if n_stages is None or d_hidden is None:
                 raise ValueError(
@@ -203,6 +213,8 @@ class PipelineStack(Forward):
             self.n_stages = int(n_stages)
             self.d_hidden = int(d_hidden)
             self._stage_units = None
+            self.has_aux_loss = False
+            self.aux_weight = 1.0
 
     @staticmethod
     def _build_stage_units(i: int, cfg: Sequence[dict], compute_dtype):
@@ -225,16 +237,12 @@ class PipelineStack(Forward):
             if compute_dtype is not None and ltype.startswith(
                     COMPUTE_DTYPE_TYPES):
                 spec.setdefault("compute_dtype", compute_dtype)
-            u = LAYER_TYPES[ltype](name=lname, inputs=("@x",), **spec)
-            if getattr(u, "stochastic", False):
-                # Inside a stage body there is no per-microbatch RNG: the
-                # fused path has no key at all and the GPipe path would
-                # reuse one key across microbatches (diverging from the
-                # sequential pipe=1 fallback).
-                raise ValueError(
-                    f"stochastic unit {lname!r} ({ltype}) inside a "
-                    "pipeline stage is unsupported")
-            units.append(u)
+            # Stochastic units (dropout) and aux-loss units (MoE) are
+            # fine inside stages: both pipeline schedules thread a
+            # per-microbatch key (fold_in(step_key, mb_index)) and an
+            # aux-loss channel through the stage contract.
+            units.append(LAYER_TYPES[ltype](name=lname, inputs=("@x",),
+                                            **spec))
         return units
 
     def _thread_stage_specs(self, spec, visit=None):
@@ -282,7 +290,10 @@ class PipelineStack(Forward):
                 sp, uks = {}, jax.random.split(k, max(len(units), 1))
                 for u, uk in zip(units, uks):
                     p, s = u.init(uk, [spec])
-                    if s:
+                    # an aux-loss channel is a per-step OUTPUT, not
+                    # persistent state — it rides the stack's own aux
+                    # accumulator, so it needs no stage state
+                    if s and set(s) - {"aux_loss"}:
                         raise ValueError(
                             f"stateful unit {u.name!r} inside a pipeline "
                             "stage is unsupported (stage state does not "
@@ -291,7 +302,9 @@ class PipelineStack(Forward):
                         sp[u.name] = p
                     spec = u.output_spec([spec])
                 params[f"s{i}"] = sp
-            return params, {}
+            state = ({"aux_loss": jnp.zeros((), jnp.float32)}
+                     if self.has_aux_loss else {})
+            return params, state
         E = in_specs[0].shape[-1]
         H = self.d_hidden
         keys = jax.random.split(key, self.n_stages)
@@ -328,11 +341,20 @@ class PipelineStack(Forward):
 
     def stage_apply(self, i: int, p, x, ctx: Context):
         """Apply stage i's computation to one activation block."""
+        return self.stage_apply_aux(i, p, x, ctx)[0]
+
+    def stage_apply_aux(self, i: int, p, x, ctx: Context):
+        """Stage i on one activation block -> ``(y, aux)`` where ``aux``
+        is the weighted sum of the stage's unit aux losses (MoE load
+        balance) — the fused-1F1B compiler's stage contract."""
+        aux = jnp.zeros((), jnp.float32)
         if self._stage_units is not None:
             for u in self._stage_units[i]:
-                x, _ = u.apply(p.get(u.name, {}), {}, [x], ctx)
-            return x
-        return self._stage_fn(p, x)
+                x, st = u.apply(p.get(u.name, {}), {}, [x], ctx)
+                if getattr(u, "has_aux_loss", False):
+                    aux = aux + u.aux_weight * st["aux_loss"]
+            return x, aux
+        return self._stage_fn(p, x), aux
 
     def _inner_ctx(self, ctx: Context) -> Context:
         # Stage bodies execute inside pipeline_apply's shard_map; a unit
@@ -359,12 +381,29 @@ class PipelineStack(Forward):
                 raise ValueError(
                     f"batch {x.shape[0]} not divisible into {n_mb} "
                     "microbatches")
+        rich = self.has_aux_loss or getattr(self, "stochastic", False)
         if S > 1 and x.shape[0] % n_mb == 0:
             from ..parallel.pipeline import pick_batch_axes, pipeline_apply
             B = x.shape[0]
             xm = x.reshape((n_mb, B // n_mb) + x.shape[1:])
             dp = pick_batch_axes(
                 {a: ctx.axis_size(a) for a in ("data", "fsdp")}, B // n_mb)
+            if self._stage_units is not None and rich:
+                # keyed schedule: per-microbatch keys fold_in(step_key,
+                # mb) — identical to the fused 1F1B derivation, so both
+                # schedules draw the same dropout masks — and sub-unit
+                # aux losses return through the stack's aux channel
+                rng = ctx.key if ctx.key is not None else jax.random.key(0)
+                fns = [(lambda p, x, k, _i=i: self.stage_apply_aux(
+                            _i, p, x,
+                            Context(train=ctx.train, key=k, mesh=None)))
+                       for i in range(self.n_stages)]
+                plist = [params[f"s{i}"] for i in range(self.n_stages)]
+                y, aux = pipeline_apply(fns, plist, xm, ctx.mesh,
+                                        axis_name=self.pipe_axis,
+                                        batch_axes=tuple(dp), rng=rng)
+                return y.reshape(x.shape), (
+                    {"aux_loss": aux} if self.has_aux_loss else state)
             if self._stage_units is not None:
                 ictx = self._inner_ctx(ctx)
                 fns = [(lambda p, x, _i=i: self.stage_apply(_i, p, x, ictx))
@@ -381,9 +420,11 @@ class PipelineStack(Forward):
                                    batch_axes=tuple(dp))
             return y.reshape(x.shape), state
         if self._stage_units is not None:
+            aux_t = jnp.zeros((), jnp.float32)
             for i in range(self.n_stages):
-                x = self.stage_apply(i, params[f"s{i}"], x, ctx)
-            return x, state
+                x, a = self.stage_apply_aux(i, params[f"s{i}"], x, ctx)
+                aux_t = aux_t + a
+            return x, ({"aux_loss": aux_t} if self.has_aux_loss else state)
         stages = {"w1": params["stage_w1"], "w2": params["stage_w2"]}
 
         # sequential fallback: scan over the stage axis
