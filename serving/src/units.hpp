@@ -777,7 +777,51 @@ class RecurrentUnit : public Unit {  // RNN / GRU / LSTM inference
            UnitContext* ctx) const override {
     const Tensor& x = *in[0];
     int64_t B = x.shape[0], T = x.shape[1], F = x.shape[2], H = hidden;
-    int64_t G = kind == 0 ? 1 : (kind == 1 ? 3 : 4);
+    CheckWeights(F);
+    std::vector<float> h(B * H, 0.f), c(kind == 2 ? B * H : 0, 0.f);
+    std::vector<float> xslice(B * F);
+    Scratch scr(B, H, kind);  // hoisted: no per-timestep allocations
+    for (int64_t t = 0; t < T; t++) {
+      // x is (B, T, F) row-major; the matmul expects contiguous (B, F)
+      // rows, so gather the time slice once per step.
+      for (int64_t bi = 0; bi < B; bi++)
+        std::copy(x.data + (bi * T + t) * F,
+                  x.data + (bi * T + t) * F + F,
+                  xslice.data() + bi * F);
+      StepBody(xslice.data(), B, F, &h, &c, &scr, ctx->pool);
+      if (return_sequences)
+        for (int64_t bi = 0; bi < B; bi++)
+          std::copy(h.data() + bi * H, h.data() + bi * H + H,
+                    out->data + (bi * T + t) * H);
+    }
+    if (!return_sequences)
+      std::copy(h.begin(), h.end(), out->data);
+  }
+
+  // One decode position with EXTERNALLY carried state (Generate): the
+  // O(1)-state counterpart of runtime/generate.py's _rec_decode_step.
+  // x: (B, F) activation at this position (a (B, 1, F) buffer is the
+  // same bytes); h/(c for LSTM): (B, H) persistent across positions.
+  void DecodeStep(const float* x, float* out, int64_t B, int64_t F,
+                  std::vector<float>* h, std::vector<float>* c,
+                  ThreadPool* pool) const {
+    CheckWeights(F);
+    Scratch scr(B, hidden, kind);
+    StepBody(x, B, F, h, c, &scr, pool);
+    std::copy(h->begin(), h->end(), out);
+  }
+
+ private:
+  struct Scratch {  // per-step work buffers, allocated once per call site
+    std::vector<float> gates, rh, cand;
+    Scratch(int64_t B, int64_t H, int kind)
+        : gates(B * (kind == 0 ? 1 : (kind == 1 ? 3 : 4)) * H),
+          rh(kind == 1 ? B * H : 0),
+          cand(kind == 1 ? B * H : 0) {}
+  };
+
+  void CheckWeights(int64_t F) const {
+    int64_t H = hidden, G = kind == 0 ? 1 : (kind == 1 ? 3 : 4);
     if (w.shape[0] != F + H || w.shape[1] != G * H)
       throw std::runtime_error(
           name + ": weight shape mismatch (want (" +
@@ -786,13 +830,23 @@ class RecurrentUnit : public Unit {  // RNN / GRU / LSTM inference
       throw std::runtime_error(
           name + ": bias length " + std::to_string(b.size()) +
           " != " + std::to_string(G * H));
-    std::vector<float> h(B * H, 0.f), c(kind == 2 ? B * H : 0, 0.f);
-    std::vector<float> gates(B * G * H);
+  }
+
+  // One time step: advance h (and c) in place from a contiguous (B, F)
+  // input slice. Shared by the full forward and the decode step so the
+  // two paths cannot drift.
+  void StepBody(const float* xt, int64_t B, int64_t F,
+                std::vector<float>* hp, std::vector<float>* cp,
+                Scratch* scr, ThreadPool* pool) const {
+    int64_t H = hidden, G = kind == 0 ? 1 : (kind == 1 ? 3 : 4);
+    std::vector<float>& h = *hp;
+    std::vector<float>& c = *cp;
+    std::vector<float>& gates = scr->gates;
     // xh @ w for a column range [g0*H, g1*H) of the fused gate weight
-    auto matmul = [&](const float* xt, const std::vector<float>& hh,
+    auto matmul = [&](const float* xs, const std::vector<float>& hh,
                       int64_t g0, int64_t g1, float* dst) {
       int64_t width = (g1 - g0) * H;
-      ctx->pool->ParallelFor(B, [&](int64_t rb, int64_t re) {
+      pool->ParallelFor(B, [&](int64_t rb, int64_t re) {
         for (int64_t bi = rb; bi < re; bi++) {
           float* dr = dst + bi * width;
           for (int64_t o = 0; o < width; o++) dr[o] = b.data[g0 * H + o];
@@ -805,65 +859,49 @@ class RecurrentUnit : public Unit {  // RNN / GRU / LSTM inference
               for (int64_t o = 0; o < width; o++) dr[o] += xv * wr[o];
             }
           };
-          fold(xt + bi * F, F, 0);
+          fold(xs + bi * F, F, 0);
           fold(hh.data() + bi * H, H, F);
         }
       });
     };
     auto sigmoid = [](float v) { return 1.f / (1.f + std::exp(-v)); };
-    std::vector<float> rh(kind == 1 ? B * H : 0);
-    std::vector<float> xslice(B * F);
-    std::vector<float> cand(kind == 1 ? B * H : 0);
-    for (int64_t t = 0; t < T; t++) {
-      // x is (B, T, F) row-major; the matmul expects contiguous (B, F)
-      // rows, so gather the time slice once per step.
+    if (kind == 0) {  // RNN: h = act(xh @ w + b)
+      matmul(xt, h, 0, 1, gates.data());
+      bool relu = activation == "relu";
+      for (int64_t i = 0; i < B * H; i++)
+        h[i] = relu ? (gates[i] > 0 ? gates[i] : 0.f)
+                    : std::tanh(gates[i]);
+    } else if (kind == 1) {  // GRU: rz from [x,h]; cand from [x, r*h]
+      std::vector<float>& rh = scr->rh;
+      std::vector<float>& cand = scr->cand;
+      matmul(xt, h, 0, 2, gates.data());
       for (int64_t bi = 0; bi < B; bi++)
-        std::copy(x.data + (bi * T + t) * F,
-                  x.data + (bi * T + t) * F + F,
-                  xslice.data() + bi * F);
-      const float* xt = xslice.data();
-      if (kind == 0) {  // RNN: h = act(xh @ w + b)
-        matmul(xt, h, 0, 1, gates.data());
-        bool relu = activation == "relu";
-        for (int64_t i = 0; i < B * H; i++)
-          h[i] = relu ? (gates[i] > 0 ? gates[i] : 0.f)
-                      : std::tanh(gates[i]);
-      } else if (kind == 1) {  // GRU: rz from [x,h]; cand from [x, r*h]
-        matmul(xt, h, 0, 2, gates.data());
-        for (int64_t bi = 0; bi < B; bi++)
-          for (int64_t i = 0; i < H; i++) {
-            float r = sigmoid(gates[bi * 2 * H + i]);
-            rh[bi * H + i] = r * h[bi * H + i];
-          }
-        matmul(xt, rh, 2, 3, cand.data());
-        for (int64_t bi = 0; bi < B; bi++)
-          for (int64_t i = 0; i < H; i++) {
-            float z = sigmoid(gates[bi * 2 * H + H + i]);
-            float cv = std::tanh(cand[bi * H + i]);
-            float& hv = h[bi * H + i];
-            hv = (1.f - z) * hv + z * cv;
-          }
-      } else {  // LSTM: gates [i, f, g, o]
-        matmul(xt, h, 0, 4, gates.data());
-        for (int64_t bi = 0; bi < B; bi++)
-          for (int64_t i = 0; i < H; i++) {
-            const float* gr = gates.data() + bi * 4 * H;
-            float ig = sigmoid(gr[i]);
-            float fg = sigmoid(gr[H + i] + forget_bias);
-            float gg = std::tanh(gr[2 * H + i]);
-            float og = sigmoid(gr[3 * H + i]);
-            float& cv = c[bi * H + i];
-            cv = fg * cv + ig * gg;
-            h[bi * H + i] = og * std::tanh(cv);
-          }
-      }
-      if (return_sequences)
-        for (int64_t bi = 0; bi < B; bi++)
-          std::copy(h.data() + bi * H, h.data() + bi * H + H,
-                    out->data + (bi * T + t) * H);
+        for (int64_t i = 0; i < H; i++) {
+          float r = sigmoid(gates[bi * 2 * H + i]);
+          rh[bi * H + i] = r * h[bi * H + i];
+        }
+      matmul(xt, rh, 2, 3, cand.data());
+      for (int64_t bi = 0; bi < B; bi++)
+        for (int64_t i = 0; i < H; i++) {
+          float z = sigmoid(gates[bi * 2 * H + H + i]);
+          float cv = std::tanh(cand[bi * H + i]);
+          float& hv = h[bi * H + i];
+          hv = (1.f - z) * hv + z * cv;
+        }
+    } else {  // LSTM: gates [i, f, g, o]
+      matmul(xt, h, 0, 4, gates.data());
+      for (int64_t bi = 0; bi < B; bi++)
+        for (int64_t i = 0; i < H; i++) {
+          const float* gr = gates.data() + bi * 4 * H;
+          float ig = sigmoid(gr[i]);
+          float fg = sigmoid(gr[H + i] + forget_bias);
+          float gg = std::tanh(gr[2 * H + i]);
+          float og = sigmoid(gr[3 * H + i]);
+          float& cv = c[bi * H + i];
+          cv = fg * cv + ig * gg;
+          h[bi * H + i] = og * std::tanh(cv);
+        }
     }
-    if (!return_sequences)
-      std::copy(h.begin(), h.end(), out->data);
   }
 };
 

@@ -137,12 +137,15 @@ class Workflow {
   int64_t arena_bytes() const { return arena_floats_ * 4; }
   size_t n_units() const { return units_.size(); }
 
-  // Autoregressive decode with per-layer KV caches (counterpart of
-  // veles_tpu/runtime/generate.py — greedy only; golden-tested against
-  // the JAX generate()). prompt: (B, P) token ids as floats; returns
-  // (B, P + n_steps) tokens. Every non-attention unit reuses its normal
-  // Run() on (B, 1, ...) single-position tensors; attention units run
-  // DecodeStep against their cache — O(L) per generated token.
+  // Autoregressive decode with per-layer KV caches and O(1) recurrent
+  // state (counterpart of veles_tpu/runtime/generate.py — greedy only;
+  // golden-tested against the JAX generate()). prompt: (B, P) token ids
+  // as floats; returns (B, P + n_steps) tokens. Pointwise units reuse
+  // their normal Run() on (B, 1, ...) single-position tensors;
+  // attention units run DecodeStep against their KV cache (O(L) per
+  // token) and RNN/GRU/LSTM units run DecodeStep against carried
+  // hidden/cell state (O(1) per token) — running a recurrent unit's
+  // plain Run() here would silently RESET its state every position.
   Tensor Generate(const Tensor& prompt, int n_steps, ThreadPool* pool) {
     if (prompt.shape.rank() != 2)
       throw std::runtime_error("generate: prompt must be (batch, time)");
@@ -154,9 +157,11 @@ class Workflow {
           "generate: the first unit must be an Embedding (token ids are "
           "the decode interface)");
 
-    // per-attention-layer caches
+    // per-attention-layer caches + per-recurrent-layer carried state
     struct Cache { std::vector<float> k, v; };
+    struct RecState { std::vector<float> h, c; };
     std::map<const Unit*, Cache> caches;
+    std::map<const Unit*, RecState> rec_states;
     for (const auto& u : units_) {
       if (auto* a = dynamic_cast<AttentionUnit*>(u.get())) {
         if (!a->causal)
@@ -167,6 +172,10 @@ class Workflow {
         int64_t D = a->wq.shape[1] / a->n_heads;
         caches[u.get()].k.assign(B * L * a->n_kv_heads * D, 0.f);
         caches[u.get()].v.assign(B * L * a->n_kv_heads * D, 0.f);
+      } else if (auto* r = dynamic_cast<RecurrentUnit*>(u.get())) {
+        rec_states[u.get()].h.assign(B * r->hidden, 0.f);
+        if (r->kind == 2)  // LSTM carries a cell state too
+          rec_states[u.get()].c.assign(B * r->hidden, 0.f);
       }
     }
 
@@ -213,6 +222,11 @@ class Workflow {
           Cache& c = caches[u.get()];
           a->DecodeStep(ins[0]->data, out.data, B, E, pos, L, &c.k,
                         &c.v, pool);
+        } else if (auto* r = dynamic_cast<RecurrentUnit*>(u.get())) {
+          int64_t F = ins[0]->shape.dims.back();
+          RecState& st = rec_states[u.get()];
+          r->DecodeStep(ins[0]->data, out.data, B, F, &st.h, &st.c,
+                        pool);
         } else {
           u->Run(ins, &out, &ctx);
         }

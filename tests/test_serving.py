@@ -323,6 +323,50 @@ def test_cpp_generate_matches_jax(binary, tmp_path, rng):
     np.testing.assert_array_equal(got, ref)
 
 
+@pytest.mark.parametrize("chain", ["stacked_seq", "last_hidden"])
+def test_cpp_recurrent_generate_matches_jax(binary, tmp_path, rng, chain):
+    """Round-4: veles_serve --generate on recurrent chains — O(1)
+    carried-state decode golden-matches the JAX generate() (running the
+    units' plain forward per position would silently reset the state)."""
+    from veles_tpu.runtime.generate import generate
+    V, T, N = 11, 5, 8
+    layers = {
+        "stacked_seq": [
+            {"type": "embedding", "vocab": V, "dim": 12, "name": "emb"},
+            {"type": "gru", "hidden": 12, "name": "g1"},
+            {"type": "lstm", "hidden": 12, "name": "l1"},
+            {"type": "seq_last", "name": "last"},
+            {"type": "softmax", "output_size": V, "name": "out"},
+        ],
+        "last_hidden": [
+            {"type": "embedding", "vocab": V, "dim": 12, "name": "emb"},
+            {"type": "rnn", "hidden": 12, "name": "r1"},
+            {"type": "lstm", "hidden": 12, "return_sequences": False,
+             "name": "l1"},
+            {"type": "softmax", "output_size": V, "name": "out"},
+        ],
+    }[chain]
+    wf = build_workflow(f"rgen_{chain}", layers)
+    wf.build({"@input": vt.Spec((2, T), jnp.int32),
+              "@labels": vt.Spec((2,), jnp.int32),
+              "@mask": vt.Spec((2,), jnp.float32)})
+    ws = wf.init_state(jax.random.key(29), opt.SGD(0.01))
+    pkg = str(tmp_path / "rgen_pkg")
+    export_package(wf, ws, pkg,
+                   input_spec={"shape": [2, T], "dtype": "float32"})
+    prompt = rng.integers(0, V, (2, T)).astype(np.int32)
+    ref = np.asarray(generate(wf, ws, prompt, N))
+
+    np.save(tmp_path / "rgp.npy", prompt.astype(np.float32))
+    r = subprocess.run(
+        [binary, pkg, str(tmp_path / "rgp.npy"),
+         str(tmp_path / "rgt.npy"), "--generate", str(N)],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    got = np.load(tmp_path / "rgt.npy").astype(np.int32)
+    np.testing.assert_array_equal(got, ref)
+
+
 @pytest.mark.parametrize("rtype,kwargs", [
     ("rnn", {"hidden": 12}),
     ("rnn", {"hidden": 12, "activation": "relu"}),

@@ -37,6 +37,37 @@ def _gates_matmul(x, h, w, b, compute_dtype):
     return y + b
 
 
+def rnn_cell(x, h, w, b, *, activation=jnp.tanh, compute_dtype=None):
+    """One Elman step: (B, F), (B, H) -> new h (B, H).  Shared by the
+    training scan and the O(1)-state autoregressive decode
+    (runtime/generate.py) so the two paths cannot drift numerically."""
+    return activation(_gates_matmul(x, h, w, b, compute_dtype))
+
+
+def gru_cell(x, h, w, b, *, compute_dtype=None):
+    """One GRU step: fused [reset, update] gemm + candidate gemm on r*h."""
+    hidden = h.shape[-1]
+    w_rz, w_cand = w[:, :2 * hidden], w[:, 2 * hidden:]
+    b_rz, b_cand = b[:2 * hidden], b[2 * hidden:]
+    rz = jax.nn.sigmoid(_gates_matmul(x, h, w_rz, b_rz, compute_dtype))
+    r, z = jnp.split(rz, 2, axis=-1)
+    c = jnp.tanh(_gates_matmul(x, r * h, w_cand, b_cand, compute_dtype))
+    return (1.0 - z) * h + z * c
+
+
+def lstm_cell(x, h, c, w, b, *, compute_dtype=None,
+              forget_bias: float = 1.0):
+    """One LSTM step -> (new h, new c)."""
+    gates = _gates_matmul(x, h, w, b, compute_dtype)
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    i = jax.nn.sigmoid(i)
+    f = jax.nn.sigmoid(f + forget_bias)
+    g = jnp.tanh(g)
+    o = jax.nn.sigmoid(o)
+    c_new = f * c + i * g
+    return o * jnp.tanh(c_new), c_new
+
+
 def rnn_scan(xs: jax.Array, h0: jax.Array, w: jax.Array, b: jax.Array,
              *, activation=jnp.tanh, compute_dtype=None
              ) -> Tuple[jax.Array, jax.Array]:
@@ -44,7 +75,8 @@ def rnn_scan(xs: jax.Array, h0: jax.Array, w: jax.Array, b: jax.Array,
     (ys (T, B, H), h_T)."""
 
     def step(h, x):
-        h_new = activation(_gates_matmul(x, h, w, b, compute_dtype))
+        h_new = rnn_cell(x, h, w, b, activation=activation,
+                         compute_dtype=compute_dtype)
         return h_new, h_new
 
     h_final, ys = jax.lax.scan(step, h0, xs)
@@ -57,15 +89,9 @@ def gru_scan(xs: jax.Array, h0: jax.Array, w: jax.Array, b: jax.Array,
     uses r*h, so its slice is applied in a second small gemm on the gated
     hidden only when needed — here we follow the standard fused variant
     (candidate weights split into x- and h- halves)."""
-    hidden = h0.shape[-1]
-    w_rz, w_cand = w[:, :2 * hidden], w[:, 2 * hidden:]
-    b_rz, b_cand = b[:2 * hidden], b[2 * hidden:]
 
     def step(h, x):
-        rz = jax.nn.sigmoid(_gates_matmul(x, h, w_rz, b_rz, compute_dtype))
-        r, z = jnp.split(rz, 2, axis=-1)
-        c = jnp.tanh(_gates_matmul(x, r * h, w_cand, b_cand, compute_dtype))
-        h_new = (1.0 - z) * h + z * c
+        h_new = gru_cell(x, h, w, b, compute_dtype=compute_dtype)
         return h_new, h_new
 
     h_final, ys = jax.lax.scan(step, h0, xs)
@@ -79,18 +105,12 @@ def lstm_scan(xs: jax.Array, h0: jax.Array, c0: jax.Array,
     """LSTM. w: (F+H, 4H) for [input, forget, cell, output] gates in one
     gemm. forget_bias is added to the forget gate pre-activation (standard
     trick for gradient flow at init)."""
-    hidden = h0.shape[-1]
 
     def step(carry, x):
         h, c = carry
-        gates = _gates_matmul(x, h, w, b, compute_dtype)
-        i, f, g, o = jnp.split(gates, 4, axis=-1)
-        i = jax.nn.sigmoid(i)
-        f = jax.nn.sigmoid(f + forget_bias)
-        g = jnp.tanh(g)
-        o = jax.nn.sigmoid(o)
-        c_new = f * c + i * g
-        h_new = o * jnp.tanh(c_new)
+        h_new, c_new = lstm_cell(x, h, c, w, b,
+                                 compute_dtype=compute_dtype,
+                                 forget_bias=forget_bias)
         return (h_new, c_new), h_new
 
     (h_final, c_final), ys = jax.lax.scan(step, (h0, c0), xs)

@@ -16,10 +16,19 @@ whose greedy continuation equals the full-forward argmax at every step
 (asserted by tests/test_generate.py).
 
 Supported chains (a linear workflow, same rule as the 1F1B compiler):
-``embedding`` -> any mix of {attention, layer_norm, per-position all2all,
-pipeline_stack of those} -> optional ``seq_last`` -> dense heads. The
-prompt is prefilled through the same cached step (teacher-forced), so
-there is exactly one compiled program.
+``embedding`` -> any mix of {attention, rnn/gru/lstm, layer_norm,
+per-position all2all, pipeline_stack of those} -> optional ``seq_last``
+-> dense heads. The prompt is prefilled through the same cached step
+(teacher-forced), so there is exactly one compiled program.
+
+Recurrent units decode with O(1) carried state — the cell functions are
+the SAME ones the training scan uses (ops/recurrent.py rnn_cell/
+gru_cell/lstm_cell), so decode cannot drift from the forward pass.  A
+``return_sequences=False`` recurrent ends the sequence segment the way
+``seq_last`` does: the current hidden state IS the last hidden state at
+every step (reference capability: Znicz declared-but-untested RNN/LSTM,
+docs/source/manualrst_veles_algorithms.rst:115-134 — productized here
+through training, decode, export, and the C++ serving runtime).
 """
 
 from __future__ import annotations
@@ -38,6 +47,37 @@ def _attn_cache_init(u, params, B: int, L: int, dtype) -> dict:
     Dh = params["wk"].shape[1] // u.n_kv_heads
     shape = (B, L, u.n_kv_heads, Dh)
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def _rec_state_init(u, B: int) -> dict:
+    """O(1) carried state: hidden (and cell for LSTM), f32 like the
+    training scan's carry."""
+    from ..units.recurrent import LSTM
+    st = {"h": jnp.zeros((B, u.hidden), jnp.float32)}
+    if isinstance(u, LSTM):
+        st["c"] = jnp.zeros((B, u.hidden), jnp.float32)
+    return st
+
+
+def _rec_decode_step(u, params, st, x_t):
+    """One recurrent step via the training scan's own cell functions."""
+    from ..ops import recurrent as rec_ops
+    from ..units.recurrent import GRU, LSTM, RNN
+    if isinstance(u, LSTM):
+        h, c = rec_ops.lstm_cell(x_t, st["h"], st["c"], params["w"],
+                                 params["b"],
+                                 compute_dtype=u.compute_dtype,
+                                 forget_bias=u.forget_bias)
+        return h, {"h": h, "c": c}
+    if isinstance(u, GRU):
+        h = rec_ops.gru_cell(x_t, st["h"], params["w"], params["b"],
+                             compute_dtype=u.compute_dtype)
+        return h, {"h": h}
+    assert isinstance(u, RNN)
+    act = {"tanh": jnp.tanh, "relu": jax.nn.relu}[u.activation]
+    h = rec_ops.rnn_cell(x_t, st["h"], params["w"], params["b"],
+                         activation=act, compute_dtype=u.compute_dtype)
+    return h, {"h": h}
 
 
 def _attn_decode_step(u, params, cache, x_t, pos):
@@ -93,6 +133,7 @@ class DecodePlan:
     def __init__(self, wf, output_unit: Optional[str] = None):
         from ..units import nn
         from ..units.parallel_nn import MultiHeadAttention, PipelineStack
+        from ..units.recurrent import _RecurrentBase
         self.wf = wf
         order = [u for u in wf.topo_order()
                  if not getattr(u, "is_evaluator", False)]
@@ -125,6 +166,13 @@ class DecodePlan:
             elif isinstance(u, MultiHeadAttention):
                 self._check_attn(u)
                 self.seq_handlers.append(("attn", u))
+            elif isinstance(u, _RecurrentBase):
+                self.seq_handlers.append(("recurrent", u))
+                if not u.return_sequences:
+                    # the current hidden IS the last hidden: the unit
+                    # plays seq_last's role and the rest of the chain
+                    # operates on flat (B, H) tensors
+                    seen_last = True
             elif isinstance(u, PipelineStack):
                 if u.stages_cfg is None:
                     self.seq_handlers.append(("pointwise", u))
@@ -135,6 +183,14 @@ class DecodePlan:
                         if isinstance(su, MultiHeadAttention):
                             self._check_attn(su)
                             stage_h.append(("attn", su, i))
+                        elif isinstance(su, _RecurrentBase):
+                            if not su.return_sequences:
+                                raise WorkflowError(
+                                    f"recurrent unit {su.name!r} inside "
+                                    "a pipeline stage must return "
+                                    "sequences (stages preserve the "
+                                    "activation spec)")
+                            stage_h.append(("recurrent", su, i))
                         else:
                             self._pointwise_ok(su)
                             stage_h.append(("pointwise", su, i))
@@ -143,6 +199,7 @@ class DecodePlan:
                 self._pointwise_ok(u)
                 self.seq_handlers.append(("pointwise", u))
         self._attn_units = list(self._iter_attn())
+        self._rec_units = list(self._iter_recurrent())
 
     @staticmethod
     def _check_attn(u):
@@ -177,6 +234,18 @@ class DecodePlan:
                         yield (f"{stack.name}/s{i}/{su.name}", su,
                                (stack.name, f"s{i}", su.name))
 
+    def _iter_recurrent(self):
+        """(cache_key, unit) for every carried-state recurrent unit."""
+        for kind, payload in self.seq_handlers:
+            if kind == "recurrent":
+                yield (payload.name, payload)
+            elif kind == "stack":
+                stack, stage_h = payload
+                for h in stage_h:
+                    if h[0] == "recurrent":
+                        _, su, i = h
+                        yield (f"{stack.name}/s{i}/{su.name}", su)
+
     # -- runtime -----------------------------------------------------------
     def init_caches(self, params, B: int, L: int, dtype) -> dict:
         caches = {}
@@ -185,6 +254,8 @@ class DecodePlan:
             for seg in path:
                 p = p[seg]
             caches[key] = _attn_cache_init(u, p, B, L, dtype)
+        for key, u in self._rec_units:
+            caches[key] = _rec_state_init(u, B)
         return caches
 
     def step(self, params, caches, tok, pos, ctx: Context):
@@ -202,6 +273,10 @@ class DecodePlan:
                 u = payload
                 x, caches[u.name] = _attn_decode_step(
                     u, params[u.name], caches[u.name], x, pos)
+            elif kind == "recurrent":
+                u = payload
+                x, caches[u.name] = _rec_decode_step(
+                    u, params[u.name], caches[u.name], x)
             elif kind == "pointwise":
                 u = payload
                 x = run_pointwise(u, params.get(u.name, {}), x)
@@ -215,6 +290,11 @@ class DecodePlan:
                         key = f"{stack.name}/s{i}/{su.name}"
                         x, caches[key] = _attn_decode_step(
                             su, sp[f"s{i}"][su.name], caches[key], x, pos)
+                    elif h[0] == "recurrent":
+                        _, su, i = h
+                        key = f"{stack.name}/s{i}/{su.name}"
+                        x, caches[key] = _rec_decode_step(
+                            su, sp[f"s{i}"][su.name], caches[key], x)
                     else:
                         _, su, i = h
                         x = run_pointwise(
