@@ -192,15 +192,45 @@ def main():
            rel_err(out_gqa.astype(jnp.float32),
                    out_rep.astype(jnp.float32)),
            note="xla_ms column = same kernel on materialized repeat")
-    # gqa backward compiles and matches the repeat formulation
-    g_gqa = jax.jit(jax.grad(lambda q, k, v: jnp.sum(pk.flash_attention(
-        q, k, v, True, None, interpret=False).astype(jnp.float32)),
-        argnums=(0, 1, 2)))
-    t_gb, grads = timeit(g_gqa, q, kg, vg, iters=5)
+    # gqa backward: REAL timing row (round-3 verdict #6 — it was a
+    # lowering gate only) against the materialized-repeat formulation.
+    # value_and_grad, not grad: returning the primal keeps the forward
+    # alive under DCE, so the row prices the full training cost.
+    G = H // Hk
+
+    def vag(f):
+        def timed(q, k, v):
+            val, gs = jax.value_and_grad(
+                lambda q, k, v: jnp.sum(f(q, k, v).astype(jnp.float32)),
+                argnums=(0, 1, 2))(q, k, v)
+            return (val,) + gs
+        return jax.jit(timed)
+
+    g_gqa = vag(lambda q, k, v: pk.flash_attention(
+        q, k, v, True, None, interpret=False))
+    g_rep = vag(lambda q, k, v: pk.flash_attention(
+        q, jnp.repeat(k, G, 2), jnp.repeat(v, G, 2),
+        True, None, interpret=False))
+    t_gb, out_gb = timeit(g_gqa, q, kg, vg, iters=5)
+    t_rb, out_rb = timeit(g_rep, q, kg, vg, iters=5)
     assert all(bool(jnp.all(jnp.isfinite(x.astype(jnp.float32))))
-               for x in grads)
-    record(f"flash_gqa_bwd_T{T}_bf16", t_gb, t_gb, 0.0,
-           note="compiled-lowering gate (grads finite, kv-head shaped)")
+               for x in out_gb[1:])
+    # repeat-path dk/dv are per repeated head; the true GQA grads are
+    # their group sums
+    _, dq_g, dk_g, dv_g = out_gb
+    _, dq_r, dk_r, dv_r = out_rb
+    B_, T_, _, D_ = dq_g.shape
+    err_gb = max(
+        rel_err(dq_g.astype(jnp.float32), dq_r.astype(jnp.float32)),
+        rel_err(dk_g.astype(jnp.float32),
+                dk_r.reshape(B_, T_, Hk, G, D_).sum(3)
+                .astype(jnp.float32)),
+        rel_err(dv_g.astype(jnp.float32),
+                dv_r.reshape(B_, T_, Hk, G, D_).sum(3)
+                .astype(jnp.float32)))
+    record(f"flash_gqa_bwd_T{T}_bf16", t_gb, t_rb, err_gb,
+           note="xla_ms column = same kernel fwd+bwd on materialized "
+                "repeat (4x K/V HBM); timed via value_and_grad")
 
     # -- fused dropout ----------------------------------------------------
     x = jnp.asarray(rng.standard_normal((4096, 4096)), jnp.float32)
