@@ -94,6 +94,51 @@ def test_http_client_server_roundtrip(store, pkg_dir, tmp_path):
         assert client.list() == []
 
 
+def test_details_page_and_thumbnail(store, pkg_dir, tmp_path):
+    """Catalog cosmetics parity (round-3 verdict missing #3): per-package
+    details page with a unit-graph thumbnail, generated at upload with
+    zero dependencies (reference rendered thumbnail.png via PIL/graphviz,
+    forge_server.py:690-725)."""
+    import json as _json
+    import urllib.request
+    # package containing an exported serving package -> unit-chain SVG
+    d = tmp_path / "pkg2"
+    d.mkdir()
+    (d / "workflow.py").write_text("# wf\n")
+    (d / "config.py").write_text("# cfg\n")
+    (d / "contents.json").write_text(_json.dumps({
+        "workflow": "lm", "units": [
+            {"class": "EmbeddingUnit", "name": "emb", "inputs": []},
+            {"class": "AttentionUnit", "name": "a1", "inputs": []},
+            {"class": "DenseUnit", "name": "out", "inputs": []}]}))
+    store.add(ForgeStore.pack_dir(str(d), {**MAN, "name": "lm_pkg"}))
+    svg = open(store.thumbnail_path("lm_pkg")).read()
+    assert svg.startswith("<svg") and "emb" in svg and "out" in svg
+
+    # plain package (no contents.json): manifest summary thumbnail
+    store.add(ForgeStore.pack_dir(pkg_dir, MAN))
+    assert "workflow.py" in open(store.thumbnail_path("mnist_fc")).read()
+
+    with ForgeServer(store, host="127.0.0.1") as srv:
+        base = f"http://127.0.0.1:{srv.port}"
+        idx = urllib.request.urlopen(f"{base}/").read().decode()
+        assert "details.html?name=lm_pkg" in idx
+        page = urllib.request.urlopen(
+            f"{base}/details.html?name=lm_pkg").read().decode()
+        assert "thumbnail?name=lm_pkg" in page
+        assert "fetch?name=lm_pkg&version=1" in page
+        r = urllib.request.urlopen(f"{base}/thumbnail?name=lm_pkg")
+        assert r.headers["Content-Type"] == "image/svg+xml"
+        svg_body = r.read()
+        assert b"a1" in svg_body and svg_body.startswith(b"<svg")
+
+    # thumbnails never round-trip through fetch (derived, not content)
+    import tarfile, io as _io
+    with tarfile.open(fileobj=_io.BytesIO(store.pack("lm_pkg")),
+                      mode="r:*") as tar:
+        assert "thumbnail.svg" not in tar.getnames()
+
+
 def test_http_errors(store, tmp_path):
     from veles_tpu.forge.client import ForgeClientError
     with ForgeServer(store, host="127.0.0.1") as srv:
@@ -200,4 +245,4 @@ def test_add_rejected_upload_leaves_no_partial(store, pkg_dir):
     store.add(clean)
     files = set(os.listdir(vdir))
     assert files == {"manifest.json", "workflow.py", "config.py",
-                     "weights.npy"}
+                     "weights.npy", "thumbnail.svg"}

@@ -60,6 +60,10 @@ class _Handler(BaseHTTPRequestHandler):
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
                 self.wfile.write(data)
+            elif path == "thumbnail":
+                self._thumbnail(q)
+            elif path == "details.html":
+                self._details_page(q["name"])
             elif path in ("", "index.html"):
                 self._index()
             else:
@@ -94,23 +98,65 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             self._error(400, f"unknown service query {query!r}")
 
+    def _html(self, body: str):
+        data = body.encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "text/html")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
     def _index(self):
         rows = "".join(
-            f"<tr><td>{html.escape(p['name'])}</td>"
-            f"<td>{html.escape(p['version'])}</td>"
+            f"<tr><td><a href=\"/details.html?name="
+            f"{html.escape(p['name'])}\">{html.escape(p['name'])}</a>"
+            f"</td><td>{html.escape(p['version'])}</td>"
             f"<td>{html.escape(p['author'])}</td>"
             f"<td>{html.escape(p['short_description'])}</td></tr>"
             for p in self.store.list())
-        body = (f"<html><head><title>veles-tpu forge</title></head><body>"
-                f"<h1>veles-tpu forge</h1><table border=1>"
-                f"<tr><th>name</th><th>version</th><th>author</th>"
-                f"<th>description</th></tr>{rows}</table>"
-                f"</body></html>").encode()
+        self._html(
+            f"<html><head><title>veles-tpu forge</title></head><body>"
+            f"<h1>veles-tpu forge</h1><table border=1>"
+            f"<tr><th>name</th><th>version</th><th>author</th>"
+            f"<th>description</th></tr>{rows}</table>"
+            f"</body></html>")
+
+    def _details_page(self, name):
+        """Per-package page: full manifest, version history with fetch
+        links, and the unit-graph thumbnail (reference: forge.html /
+        image.html package pages, forge_server.py:850-865)."""
+        man = self.store.details(name)
+        versions = man.pop("versions", [])
+        rows = "".join(
+            f"<tr><th align=left>{html.escape(str(k))}</th>"
+            f"<td>{html.escape(str(v))}</td></tr>"
+            for k, v in sorted(man.items()) if not k.startswith("_"))
+        vlinks = " ".join(
+            f"<a href=\"/fetch?name={html.escape(name)}&version="
+            f"{html.escape(v)}\">{html.escape(v)}</a>"
+            for v in versions)
+        self._html(
+            f"<html><head><title>{html.escape(name)} — veles-tpu forge"
+            f"</title></head><body><h1>{html.escape(name)}</h1>"
+            f"<img src=\"/thumbnail?name={html.escape(name)}\" "
+            f"alt=\"workflow\" style=\"float:right;border:1px solid "
+            f"#ccc\"/>"
+            f"<table>{rows}</table>"
+            f"<p>versions: {vlinks}</p>"
+            f"<p><a href=\"/\">back to catalog</a></p></body></html>")
+
+    def _thumbnail(self, q):
+        import os
+        path = self.store.thumbnail_path(q["name"], q.get("version"))
+        if not os.path.exists(path):
+            return self._error(404, "no thumbnail for this package")
+        with open(path, "rb") as f:
+            data = f.read()
         self.send_response(200)
-        self.send_header("Content-Type", "text/html")
-        self.send_header("Content-Length", str(len(body)))
+        self.send_header("Content-Type", "image/svg+xml")
+        self.send_header("Content-Length", str(len(data)))
         self.end_headers()
-        self.wfile.write(body)
+        self.wfile.write(data)
 
 
 class ForgeServer(Logger):

@@ -137,6 +137,13 @@ class ForgeStore(Logger):
                     for member in tar.getmembers():
                         if not member.isfile():
                             continue
+                        # thumbnail.svg is SERVER-derived: never accept
+                        # an uploaded one (it would be served verbatim
+                        # as image/svg+xml — stored-XSS vector when
+                        # regeneration fails)
+                        if os.path.basename(member.name) == \
+                                self.THUMBNAIL:
+                            continue
                         # refuse path escapes in hostile archives
                         target = os.path.realpath(
                             os.path.join(tmpdir, member.name))
@@ -153,6 +160,16 @@ class ForgeStore(Logger):
                     with open(os.path.join(tmpdir, "manifest.json"),
                               "w") as f:
                         json.dump(man, f, indent=1)
+                    # catalog thumbnail (reference: forge_server.py
+                    # upload() rendered the workflow graph to
+                    # thumbnail.png via PIL/graphviz; here a
+                    # dependency-free SVG of the unit chain). Failure
+                    # must never reject the upload.
+                    try:
+                        self._render_thumbnail(tmpdir, man)
+                    except Exception as e:  # noqa: BLE001
+                        self.warning("thumbnail generation failed for "
+                                     "%s: %s", name, e)
                     # An unregistered vdir can exist if a previous process
                     # died between rename and _write_versions; it is orphan
                     # garbage (never listed/served), safe to replace.
@@ -176,6 +193,69 @@ class ForgeStore(Logger):
             shutil.rmtree(path)
         self.info("deleted %s", name)
 
+    THUMBNAIL = "thumbnail.svg"
+
+    def thumbnail_path(self, name: str,
+                       version: Optional[str] = None) -> str:
+        """Path of a stored version's catalog thumbnail (KeyError if the
+        package/version is unknown; the file may still be absent when
+        generation failed — callers 404 on that)."""
+        version = self.resolve_version(name, version)
+        return os.path.join(self._vdir(name, version), self.THUMBNAIL)
+
+    @classmethod
+    def _render_thumbnail(cls, vdir: str, man: Dict) -> None:
+        """Write thumbnail.svg: a unit-chain rendering of the package.
+
+        The reference shelled out to `veles --workflow-graph` and PIL to
+        produce a 256px PNG per upload (forge_server.py:690-725); the
+        rebuild renders a plain SVG with zero dependencies.  Structure
+        source, in order of preference: an exported serving package's
+        contents.json (unit classes), else the manifest's workflow/
+        configuration entries as a two-box summary.
+        """
+        labels = []
+        cj = None
+        for base, _, files in os.walk(vdir):
+            if "contents.json" in files:
+                cj = os.path.join(base, "contents.json")
+                break
+        if cj is not None:
+            with open(cj) as f:
+                doc = json.load(f)
+            labels = [u.get("name") or u.get("class", "unit")
+                      for u in doc.get("units", [])]
+        if not labels:
+            labels = [str(man.get("workflow", "workflow")),
+                      str(man.get("configuration", "config"))]
+        more = len(labels) - 10
+        if more > 0:
+            labels = labels[:9] + [f"... +{more + 1} more"]
+        W, bh, gap, pad = 256, 22, 10, 8
+        H = pad * 2 + len(labels) * bh + (len(labels) - 1) * gap
+        from html import escape as esc
+        parts = [f'<svg xmlns="http://www.w3.org/2000/svg" '
+                 f'width="{W}" height="{H}" font-family="monospace" '
+                 f'font-size="11">',
+                 f'<rect width="{W}" height="{H}" fill="#fafafa"/>']
+        for i, lab in enumerate(labels):
+            y = pad + i * (bh + gap)
+            parts.append(
+                f'<rect x="28" y="{y}" width="200" height="{bh}" '
+                f'rx="4" fill="#e8eef7" stroke="#4a6da7"/>')
+            parts.append(
+                f'<text x="{W // 2}" y="{y + bh - 7}" '
+                f'text-anchor="middle">{esc(str(lab)[:28])}</text>')
+            if i + 1 < len(labels):
+                ay = y + bh
+                parts.append(
+                    f'<line x1="{W // 2}" y1="{ay}" x2="{W // 2}" '
+                    f'y2="{ay + gap}" stroke="#4a6da7" '
+                    f'marker-end="none"/>')
+        parts.append("</svg>")
+        with open(os.path.join(vdir, cls.THUMBNAIL), "w") as f:
+            f.write("".join(parts))
+
     # -- package IO --------------------------------------------------------
     def pack(self, name: str, version: Optional[str] = None) -> bytes:
         """tar.gz of a stored version (what /fetch streams; reference:
@@ -185,6 +265,8 @@ class ForgeStore(Logger):
         bio = io.BytesIO()
         with tarfile.open(fileobj=bio, mode="w:gz") as tar:
             for fname in sorted(os.listdir(vdir)):
+                if fname == self.THUMBNAIL:
+                    continue  # server-side derived, not package content
                 tar.add(os.path.join(vdir, fname), arcname=fname)
         return bio.getvalue()
 
