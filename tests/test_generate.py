@@ -121,6 +121,31 @@ CASES = {
         {"type": "seq_last", "name": "last"},
         {"type": "softmax", "output_size": V, "name": "out"},
     ],
+    "moe_block": lambda V: [
+        # dropless capacity (cf >= E): capacity drops are batch-global
+        # and non-causal, so decode-matches-forward is only defined for
+        # the standard dropless-inference setting (generate.py module
+        # doc)
+        {"type": "embedding", "vocab": V, "dim": 16, "name": "emb"},
+        {"type": "attention", "n_heads": 2, "rope": True,
+         "residual": True, "name": "a1"},
+        {"type": "moe", "n_experts": 4, "d_hidden": 32, "top_k": 2,
+         "capacity_factor": 8.0, "name": "moe"},
+        {"type": "seq_last", "name": "last"},
+        {"type": "softmax", "output_size": V, "name": "out"},
+    ],
+    "moe_in_stack": lambda V: [
+        {"type": "embedding", "vocab": V, "dim": 16, "name": "emb"},
+        {"type": "pipeline_stack", "stages": [
+            [{"type": "attention", "n_heads": 2, "rope": True,
+              "residual": True}],
+            [{"type": "moe", "n_experts": 2, "d_hidden": 32,
+              "top_k": 1, "capacity_factor": 4.0},
+             {"type": "layer_norm"}],
+        ], "name": "stack"},
+        {"type": "seq_last", "name": "last"},
+        {"type": "softmax", "output_size": V, "name": "out"},
+    ],
     "mixed_rnn_attention": lambda V: [
         {"type": "embedding", "vocab": V, "dim": 16, "name": "emb"},
         {"type": "attention", "n_heads": 2, "rope": True,

@@ -367,6 +367,40 @@ def test_cpp_recurrent_generate_matches_jax(binary, tmp_path, rng, chain):
     np.testing.assert_array_equal(got, ref)
 
 
+def test_cpp_moe_generate_matches_jax(binary, tmp_path, rng):
+    """veles_serve --generate on a MoE transformer chain: router +
+    expert FFN are token-local, so decode runs them per position
+    (dropless capacity — see runtime/generate.py module doc)."""
+    from veles_tpu.runtime.generate import generate
+    V, T, N = 11, 5, 7
+    wf = build_workflow("moe_gen", [
+        {"type": "embedding", "vocab": V, "dim": 12, "name": "emb"},
+        {"type": "attention", "n_heads": 2, "rope": True,
+         "residual": True, "name": "a1"},
+        {"type": "moe", "n_experts": 4, "d_hidden": 24, "top_k": 2,
+         "capacity_factor": 8.0, "name": "moe"},
+        {"type": "seq_last", "name": "last"},
+        {"type": "softmax", "output_size": V, "name": "out"},
+    ])
+    wf.build({"@input": vt.Spec((2, T), jnp.int32),
+              "@labels": vt.Spec((2,), jnp.int32),
+              "@mask": vt.Spec((2,), jnp.float32)})
+    ws = wf.init_state(jax.random.key(31), opt.SGD(0.01))
+    pkg = str(tmp_path / "moe_gen_pkg")
+    export_package(wf, ws, pkg,
+                   input_spec={"shape": [2, T], "dtype": "float32"})
+    prompt = rng.integers(0, V, (2, T)).astype(np.int32)
+    ref = np.asarray(generate(wf, ws, prompt, N))
+    np.save(tmp_path / "mgp.npy", prompt.astype(np.float32))
+    r = subprocess.run(
+        [binary, pkg, str(tmp_path / "mgp.npy"),
+         str(tmp_path / "mgt.npy"), "--generate", str(N)],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    got = np.load(tmp_path / "mgt.npy").astype(np.int32)
+    np.testing.assert_array_equal(got, ref)
+
+
 @pytest.mark.parametrize("rtype,kwargs", [
     ("rnn", {"hidden": 12}),
     ("rnn", {"hidden": 12, "activation": "relu"}),

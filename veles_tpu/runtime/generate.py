@@ -29,6 +29,15 @@ gru_cell/lstm_cell), so decode cannot drift from the forward pass.  A
 every step (reference capability: Znicz declared-but-untested RNN/LSTM,
 docs/source/manualrst_veles_algorithms.rst:115-134 — productized here
 through training, decode, export, and the C++ serving runtime).
+
+MoE units decode per position (router + expert FFN are token-local).
+Caveat: MoE *capacity* is a training construct whose drops depend on
+the whole batch — in a full forward a token can even be dropped because
+of LATER positions' routes (capacity is not causal).  Decode applies
+the same capacity formula to each position's B tokens, which is
+dropless for any reasonable capacity_factor; greedy-matches the full
+forward whenever the full forward dropped nothing (the standard
+dropless-inference assumption).
 """
 
 from __future__ import annotations
@@ -211,14 +220,17 @@ class DecodePlan:
     @staticmethod
     def _pointwise_ok(u):
         from ..units import nn
-        ok = isinstance(u, (nn.LayerNorm, nn.Dropout, nn.FFN)) or (
+        from ..units.parallel_nn import MoEFFN
+        ok = isinstance(u, (nn.LayerNorm, nn.Dropout, nn.FFN,
+                            MoEFFN)) or (
             isinstance(u, nn.All2All) and u.per_position)
         if not ok:
             raise WorkflowError(
                 f"unit {u.name!r} ({type(u).__name__}) mixes sequence "
                 "positions (or is not per-position); generate() supports "
-                "attention, layer_norm, ffn, per-position all2all, "
-                "pipeline_stack and seq_last before the head")
+                "attention, rnn/gru/lstm, moe, layer_norm, ffn, "
+                "per-position all2all, pipeline_stack and seq_last "
+                "before the head")
 
     def _iter_attn(self):
         """(cache_key, unit, params_path) for every cached attention."""
