@@ -913,6 +913,11 @@ class MoEUnit : public Unit {  // MoEFFN inference (dense top-k routing)
   // secondary) and capacity drops; per-token expert FFN on CPU.
   int64_t n_experts = 0, d_hidden = 0, top_k = 1;
   float capacity_factor = 1.25f;
+  // Generate() sets this: capacity is a batch-global TRAINING construct
+  // (non-causal — a full forward can drop a token because of later
+  // positions); decode forces dropless routing, matching the Python
+  // runtime (veles_tpu/runtime/generate.py module doc).
+  bool decode_dropless = false;
   npy::Array router, w1, w2;  // (D,E), (E,D,Hd), (E,Hd,D)
 
   Shape OutputShape(const std::vector<Shape>& in) const override {
@@ -938,8 +943,10 @@ class MoEUnit : public Unit {  // MoEFFN inference (dense top-k routing)
       throw std::runtime_error(
           name + ": top_k " + std::to_string(K) +
           " out of range [1, " + std::to_string(E) + "]");
-    int64_t C = std::max<int64_t>(
-        1, static_cast<int64_t>(capacity_factor * T * K / E));
+    int64_t C = decode_dropless
+        ? T * K
+        : std::max<int64_t>(
+              1, static_cast<int64_t>(capacity_factor * T * K / E));
     // route: per-token softmax over router logits, top-k
     std::vector<float> gates(T * K);
     std::vector<int64_t> topi(T * K);

@@ -176,6 +176,10 @@ class Workflow {
         rec_states[u.get()].h.assign(B * r->hidden, 0.f);
         if (r->kind == 2)  // LSTM carries a cell state too
           rec_states[u.get()].c.assign(B * r->hidden, 0.f);
+      } else if (auto* m = dynamic_cast<MoEUnit*>(u.get())) {
+        m->decode_dropless = true;  // see MoEUnit: capacity is a
+                                    // training construct, decode is
+                                    // dropless
       }
     }
 

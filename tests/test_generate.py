@@ -171,6 +171,32 @@ def test_greedy_decode_matches_full_forward(rng, case):
     np.testing.assert_array_equal(got[:, :P], prompt)
 
 
+def test_moe_decode_forces_dropless(rng):
+    """A model trained with the DEFAULT capacity_factor (1.25 — the
+    dropping regime at B tokens/position: C = max(1, int(1.25*2*2/4)) =
+    1) must decode as if routing were dropless: greedy continuation
+    equals the full forward of the SAME params evaluated with
+    capacity_factor=E (no drops), NOT the training-capacity forward
+    whose drops are batch-global and non-causal."""
+    B, P, V, N = 2, 5, 12, 6
+    layers = lambda cf: [
+        {"type": "embedding", "vocab": V, "dim": 16, "name": "emb"},
+        {"type": "attention", "n_heads": 2, "rope": True,
+         "residual": True, "name": "a1"},
+        {"type": "moe", "n_experts": 4, "d_hidden": 32, "top_k": 2,
+         "capacity_factor": cf, "name": "moe"},
+        {"type": "seq_last", "name": "last"},
+        {"type": "softmax", "output_size": V, "name": "out"},
+    ]
+    wf, ws = _build_lm(layers(1.25), B, P, V, seed=7)
+    prompt = rng.integers(0, V, (B, P)).astype(np.int32)
+    got = np.asarray(generate(wf, ws, prompt, N))
+    # dropless reference: same params, capacity_factor=E
+    wf._layers_cfg = layers(4.0)
+    ref = _greedy_reference(wf, ws, prompt, N)
+    np.testing.assert_array_equal(got, ref)
+
+
 def test_temperature_sampling_properties(rng):
     B, P, V, N = 2, 4, 12, 8
     layers = CASES["plain"](V)

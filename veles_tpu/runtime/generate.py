@@ -33,11 +33,12 @@ through training, decode, export, and the C++ serving runtime).
 MoE units decode per position (router + expert FFN are token-local).
 Caveat: MoE *capacity* is a training construct whose drops depend on
 the whole batch — in a full forward a token can even be dropped because
-of LATER positions' routes (capacity is not causal).  Decode applies
-the same capacity formula to each position's B tokens, which is
-dropless for any reasonable capacity_factor; greedy-matches the full
-forward whenever the full forward dropped nothing (the standard
-dropless-inference assumption).
+of LATER positions' routes (capacity is not causal).  Decode therefore
+FORCES dropless routing (effective capacity_factor = n_experts, so no
+route can ever exceed capacity) regardless of the training
+capacity_factor — the standard dropless-inference setting, mirrored by
+the C++ runtime.  Greedy continuation matches the full forward exactly
+whenever the forward itself dropped nothing.
 """
 
 from __future__ import annotations
@@ -277,6 +278,17 @@ class DecodePlan:
                      tok.astype(jnp.int32), axis=0)      # (B, E)
 
         def run_pointwise(u, p, x):
+            from ..parallel.moe import moe_apply
+            from ..units.parallel_nn import MoEFFN
+            if isinstance(u, MoEFFN):
+                # dropless decode: capacity_factor=E gives C = T*K, so
+                # no route can exceed capacity (module doc) — the
+                # training capacity_factor would drop routes by batch
+                # coincidence at T=B tokens per position
+                y, _ = moe_apply(p, x, top_k=u.top_k,
+                                 capacity_factor=float(u.n_experts),
+                                 dispatch_mode=u.dispatch_mode)
+                return y
             y, _ = u.apply(p, {}, [x[:, None]], ctx)
             return y[:, 0]
 
