@@ -217,6 +217,63 @@ def test_temperature_sampling_properties(rng):
     np.testing.assert_array_equal(h1[:, :P], prompt)
 
 
+def test_top_k_top_p_sampling_semantics(rng):
+    """sample_logits: truncation actually restricts the support, greedy
+    always survives the cut, and the filters compose with temperature."""
+    from veles_tpu.runtime.generate import sample_logits
+    # a peaked distribution over 8 tokens
+    base = jnp.asarray([[5.0, 4.0, 3.0, 1.0, 0.0, -1.0, -2.0, -3.0]])
+    keys = [jax.random.fold_in(jax.random.key(0), i) for i in range(300)]
+
+    # top_k=2: only tokens {0, 1} can ever appear, even at hot temps
+    seen = {int(sample_logits(base, k, temperature=5.0, top_k=2)[0])
+            for k in keys}
+    assert seen == {0, 1}, seen
+
+    # top_p tiny: collapses to greedy (the argmax always survives)
+    seen_p = {int(sample_logits(base, k, temperature=5.0, top_p=1e-6)[0])
+              for k in keys[:50]}
+    assert seen_p == {0}
+
+    # top_p=0.99 at moderate temp: a strict subset of the vocabulary,
+    # larger than greedy
+    seen_n = {int(sample_logits(base, k, temperature=1.0, top_p=0.99)[0])
+              for k in keys}
+    assert 1 < len(seen_n) < 8
+
+    # temperature=0 ignores filters entirely (greedy)
+    assert int(sample_logits(base, keys[0], temperature=0.0,
+                             top_k=1, top_p=0.1)[0]) == 0
+
+    # degenerate filter values error loudly instead of silently
+    # disabling the filter (0/-k would keep everything)
+    with pytest.raises(ValueError, match="top_k"):
+        sample_logits(base, keys[0], temperature=1.0, top_k=0)
+    with pytest.raises(ValueError, match="top_p"):
+        sample_logits(base, keys[0], temperature=1.0, top_p=0.0)
+    # k >= V / p == 1.0 are valid no-op filters
+    assert sample_logits(base, keys[0], temperature=1.0, top_k=99,
+                         top_p=1.0).shape == (1,)
+
+
+def test_generate_top_k_end_to_end(rng):
+    """--generate plumbing: top_k through the real decode loop restricts
+    continuations to high-probability tokens while still sampling."""
+    B, P, V, N = 2, 4, 12, 10
+    layers = CASES["plain"](V)
+    wf, ws = _build_lm(layers, B, P, V)
+    prompt = rng.integers(0, V, (B, P)).astype(np.int32)
+    greedy = np.asarray(generate(wf, ws, prompt, N))
+    k1 = np.asarray(generate(wf, ws, prompt, N, temperature=3.0,
+                             top_k=1, key=jax.random.key(5)))
+    # top_k=1 at any temperature IS greedy
+    np.testing.assert_array_equal(k1, greedy)
+    hot = np.asarray(generate(wf, ws, prompt, N, temperature=3.0,
+                              top_k=3, key=jax.random.key(5)))
+    assert hot.shape == (B, P + N)
+    np.testing.assert_array_equal(hot[:, :P], prompt)
+
+
 def test_generate_rejects_unsupported_chains(rng):
     B, T, V = 2, 6, 10
     # no embedding at the front

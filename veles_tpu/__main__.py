@@ -146,6 +146,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--temperature", type=float, default=0.0,
                    help="sampling temperature for --generate "
                         "(0 = greedy)")
+    p.add_argument("--top-k", type=int, default=None,
+                   help="restrict --generate sampling to the k highest "
+                        "logits (needs --temperature > 0)")
+    p.add_argument("--top-p", type=float, default=None,
+                   help="nucleus sampling for --generate: smallest "
+                        "token set with cumulative probability >= p "
+                        "(needs --temperature > 0)")
     p.add_argument("--status-port", type=int, default=None,
                    help="serve a live status page (JSON + HTML with "
                         "auto-refreshing metric plots) on this port; 0 "
@@ -680,6 +687,17 @@ def main(argv=None) -> int:
         if not args.prompt:
             raise SystemExit("--generate needs --prompt "
                              "(token ids, or @file.npy)")
+        if (args.top_k is not None or args.top_p is not None) \
+                and args.temperature <= 0:
+            raise SystemExit(
+                "--top-k/--top-p filter SAMPLING and need "
+                "--temperature > 0 (temperature 0 is greedy decoding, "
+                "which would silently ignore them)")
+        if args.top_k is not None and args.top_k < 1:
+            raise SystemExit(f"--top-k must be >= 1, got {args.top_k}")
+        if args.top_p is not None and not 0.0 < args.top_p <= 1.0:
+            raise SystemExit(f"--top-p must be in (0, 1], got "
+                             f"{args.top_p}")
         if args.prompt.startswith("@"):
             prompt = np.atleast_2d(
                 np.load(args.prompt[1:])).astype(np.int32)
@@ -695,7 +713,7 @@ def main(argv=None) -> int:
         key = _jax.random.key(int(root.common.get("random_seed", 0)))
         toks = _generate(trainer.workflow, trainer.wstate, prompt,
                          args.generate, temperature=args.temperature,
-                         key=key)
+                         top_k=args.top_k, top_p=args.top_p, key=key)
         out = {"prompt_len": int(prompt.shape[1]),
                "tokens": np.asarray(toks).tolist()}
         print(json.dumps(out))

@@ -328,16 +328,57 @@ class DecodePlan:
         return x, caches
 
 
+def sample_logits(logits, key, *, temperature: float = 0.0,
+                  top_k: Optional[int] = None,
+                  top_p: Optional[float] = None):
+    """Next-token choice from (B, V) logits.
+
+    temperature=0 -> greedy argmax; otherwise temperature-scaled
+    categorical sampling, optionally restricted to the top-k logits
+    and/or the smallest set whose probability mass reaches top_p
+    (nucleus sampling).  Pure jnp — runs inside the decode scan.
+    """
+    logits = logits.astype(jnp.float32)
+    if top_k is not None and int(top_k) < 1:
+        # 0 would silently disable the filter (index -0 is the MINIMUM
+        # logit) and negatives keep near-everything — loud error instead
+        raise ValueError(f"top_k must be >= 1, got {top_k}")
+    if top_p is not None and not 0.0 < float(top_p) <= 1.0:
+        # p <= 0 would wrap the cut index to the smallest logit and
+        # disable the filter — the opposite of the intent
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    if temperature <= 0:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / temperature
+    if top_k is not None and int(top_k) < logits.shape[-1]:
+        # k-th largest via top_k, not a full-vocabulary sort
+        kth = jax.lax.top_k(logits, int(top_k))[0][:, -1][:, None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p is not None and float(top_p) < 1.0:
+        # sort descending; keep tokens while cumulative prob (EXCLUSIVE
+        # of the current token) is < top_p — always keeps the argmax
+        srt = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(srt, axis=-1)
+        csum = jnp.cumsum(probs, axis=-1) - probs
+        cut = jnp.maximum(
+            jnp.sum(jnp.where(csum < top_p, 1, 0), axis=-1) - 1, 0)
+        thresh = jnp.take_along_axis(srt, cut[:, None], axis=-1)
+        logits = jnp.where(logits < thresh, -jnp.inf, logits)
+    return jax.random.categorical(key, logits)
+
+
 def generate(wf, wstate, prompt, n_steps: int, *,
              temperature: float = 0.0, key=None,
+             top_k: Optional[int] = None, top_p: Optional[float] = None,
              output_unit: Optional[str] = None,
              cache_dtype=jnp.float32):
     """Decode ``n_steps`` tokens after ``prompt`` (B, P) int32.
 
-    Greedy (temperature=0) or temperature sampling. Returns (B, P +
-    n_steps) int32 — prompt followed by the continuation. The prompt is
-    prefilled through the same cached decode step (teacher-forced), so
-    prefill costs O(P·L) per layer and each generated token O(L).
+    Greedy (temperature=0), temperature sampling, optionally truncated
+    by ``top_k`` and/or nucleus ``top_p``. Returns (B, P + n_steps)
+    int32 — prompt followed by the continuation. The prompt is prefilled
+    through the same cached decode step (teacher-forced), so prefill
+    costs O(P·L) per layer and each generated token O(L).
     """
     plan = DecodePlan(wf, output_unit)
     prompt = jnp.asarray(prompt, jnp.int32)
@@ -360,12 +401,9 @@ def generate(wf, wstate, prompt, n_steps: int, *,
             caches, toks = carry
             tok = jax.lax.dynamic_slice_in_dim(toks, pos, 1, 1)[:, 0]
             logits, caches = plan.step(params, caches, tok, pos, ctx)
-            if temperature > 0:
-                nxt = jax.random.categorical(
-                    jax.random.fold_in(key, pos),
-                    logits.astype(jnp.float32) / temperature)
-            else:
-                nxt = jnp.argmax(logits, axis=-1)
+            nxt = sample_logits(
+                logits, jax.random.fold_in(key, pos),
+                temperature=temperature, top_k=top_k, top_p=top_p)
             # teacher-force prompt positions; write generated thereafter
             cur = jax.lax.dynamic_slice_in_dim(toks, pos + 1, 1, 1)[:, 0]
             val = jnp.where(pos + 1 >= P, nxt.astype(jnp.int32), cur)
