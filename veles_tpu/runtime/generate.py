@@ -380,7 +380,6 @@ def generate(wf, wstate, prompt, n_steps: int, *,
     through the same cached decode step (teacher-forced), so prefill
     costs O(P·L) per layer and each generated token O(L).
     """
-    plan = DecodePlan(wf, output_unit)
     prompt = jnp.asarray(prompt, jnp.int32)
     B, P = prompt.shape
     if P < 1:
@@ -388,8 +387,24 @@ def generate(wf, wstate, prompt, n_steps: int, *,
     L = P + int(n_steps)
     if key is None:
         key = jax.random.key(0)
-    ctx = Context(train=False, key=None, mesh=None)
     params = wstate["params"]
+    # Compiled-runner cache on the workflow: repeated calls at one shape
+    # (a serving endpoint, a sampling sweep) must not re-trace and
+    # re-compile the L-step scan every time.  Keyed on everything traced
+    # into the closure; params/prompt/key are runtime args.  Top-level
+    # validation (plan construction) still runs on the first call per
+    # shape.
+    ck = (B, P, int(n_steps), float(temperature),
+          None if top_k is None else int(top_k),
+          None if top_p is None else float(top_p),
+          output_unit, jnp.dtype(cache_dtype).name)
+    cache = getattr(wf, "_decode_runners", None)
+    if cache is None:
+        cache = wf._decode_runners = {}
+    if ck in cache:
+        return cache[ck](params, prompt, key)
+    plan = DecodePlan(wf, output_unit)
+    ctx = Context(train=False, key=None, mesh=None)
 
     @jax.jit
     def run(params, prompt, key):
@@ -415,4 +430,5 @@ def generate(wf, wstate, prompt, n_steps: int, *,
             body, (caches, toks), jnp.arange(L - 1))
         return toks
 
+    cache[ck] = run
     return run(params, prompt, key)

@@ -8,7 +8,13 @@ TPU redesign: a stdlib ThreadingHTTPServer wrapping a compiled predict
 step. POST /predict {"input": [[...]]} -> {"output": [[...]]}. Requests
 batch-pad to the compiled batch size (XLA static shapes); an optional
 normalizer denormalizes outputs (reference: inference-time denorm via
-normalizer state)."""
+normalizer state).
+
+Round 4: pass ``workflow=`` to also serve POST /generate
+{"prompt": [[ids]], "steps": N, "temperature": t, "top_k": k,
+"top_p": p} -> {"tokens": [[...]]} — the KV-cached / carried-state
+decode of runtime/generate.py behind HTTP (the reference's RESTful API
+was forward-only; its framework had no sequence models to decode)."""
 
 from __future__ import annotations
 
@@ -25,38 +31,46 @@ from ..logger import Logger
 class RestfulServer(Logger):
     def __init__(self, predict_fn: Callable, wstate, batch_size: int,
                  input_shape, *, port: int = 0, host: str = "127.0.0.1",
-                 normalizer=None, denormalizer=None):
+                 normalizer=None, denormalizer=None, workflow=None):
         self.predict_fn = predict_fn
         self.wstate = wstate
         self.batch_size = int(batch_size)
         self.input_shape = tuple(input_shape)
         self.normalizer = normalizer
         self.denormalizer = denormalizer
+        self.workflow = workflow  # enables POST /generate (module doc)
         outer = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
+            def _reply(self, obj, code=200):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_POST(self):
-                if self.path.rstrip("/") != "/predict":
+                path = self.path.rstrip("/")
+                if path not in ("/predict", "/generate"):
                     self.send_error(404)
                     return
                 try:
                     n = int(self.headers.get("Content-Length", 0))
                     req = json.loads(self.rfile.read(n))
+                    if path == "/generate":
+                        self._reply(
+                            {"tokens": outer.decode(req).tolist()})
+                        return
                     x = np.asarray(req["input"], np.float32)
-                    out = outer.infer(x)
-                    body = json.dumps({"output": out.tolist()}).encode()
-                    self.send_response(200)
-                    self.send_header("Content-Type", "application/json")
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
-                except (KeyError, ValueError, json.JSONDecodeError) as e:
-                    body = json.dumps({"error": str(e)}).encode()
-                    self.send_response(400)
-                    self.send_header("Content-Type", "application/json")
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
+                    self._reply({"output": outer.infer(x).tolist()})
+                except (KeyError, TypeError, ValueError,
+                        json.JSONDecodeError) as e:
+                    self._reply({"error": str(e)}, code=400)
+                except Exception as e:  # noqa: BLE001 — e.g. an
+                    # undecodable chain (WorkflowError); server answers
+                    self._reply({"error": f"{type(e).__name__}: {e}"},
+                                code=500)
 
             def log_message(self, *args):
                 pass
@@ -87,6 +101,41 @@ class RestfulServer(Logger):
         if self.denormalizer is not None:
             out = self.denormalizer.denormalize(out)
         return out
+
+    def decode(self, req: dict) -> np.ndarray:
+        """POST /generate body -> (B, P + steps) token array."""
+        if self.workflow is None:
+            raise ValueError(
+                "this server was started without a workflow; /generate "
+                "needs RestfulServer(..., workflow=wf)")
+        from .generate import generate
+        prompt = np.asarray(req["prompt"], np.int64)
+        if prompt.ndim != 2 or 0 in prompt.shape:
+            raise ValueError("prompt must be a non-empty 2-D "
+                             "[[ids], ...] array")
+        steps = int(req.get("steps", 16))
+        if not 0 < steps <= 65536:
+            raise ValueError(f"steps must be in [1, 65536], got {steps}")
+        # bound total decode work/cache memory, not just the step count
+        B, P = prompt.shape
+        if B * (P + steps) > 1_048_576:
+            raise ValueError(
+                f"request too large: batch {B} x total length "
+                f"{P + steps} exceeds the 2^20 token-cell cap")
+        temperature = float(req.get("temperature", 0.0))
+        top_k, top_p = req.get("top_k"), req.get("top_p")
+        if (top_k is not None or top_p is not None) and temperature <= 0:
+            # same contract as the CLI: filters apply to SAMPLING;
+            # answering greedy while claiming top-k would mislead
+            raise ValueError(
+                "top_k/top_p filter sampling and need temperature > 0 "
+                "(temperature 0 is greedy decoding)")
+        import jax
+        key = jax.random.key(int(req.get("seed", 0)))
+        toks = generate(
+            self.workflow, self.wstate, prompt.astype(np.int32), steps,
+            temperature=temperature, top_k=top_k, top_p=top_p, key=key)
+        return np.asarray(toks)
 
     def start(self):
         self._thread = threading.Thread(target=self.httpd.serve_forever,
