@@ -37,7 +37,7 @@ from . import loader
 from .loader import ArrayLoader, FullBatchLoader, Loader
 from . import runtime
 from .runtime import (Decision, Snapshotter, SnapshotterToDB, Trainer,
-                      generate)
+                      generate, generate_beam)
 from . import parallel
 from .parallel import MeshSpec, make_mesh
 from . import models

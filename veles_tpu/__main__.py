@@ -153,6 +153,17 @@ def build_parser() -> argparse.ArgumentParser:
                    help="nucleus sampling for --generate: smallest "
                         "token set with cumulative probability >= p "
                         "(needs --temperature > 0)")
+    p.add_argument("--beams", type=int, default=1,
+                   help="beam-search width for --generate (>1 returns "
+                        "the highest-total-log-prob continuation; "
+                        "exclusive with sampling flags)")
+    p.add_argument("--eos-id", type=int, default=None,
+                   help="end-of-sequence token for --beams: finished "
+                        "beams freeze and pad with it")
+    p.add_argument("--length-penalty", type=float, default=0.0,
+                   help="beam score normalization exponent over the "
+                        "generated length (GNMT convention; 0 = raw "
+                        "log-prob sum)")
     p.add_argument("--status-port", type=int, default=None,
                    help="serve a live status page (JSON + HTML with "
                         "auto-refreshing metric plots) on this port; 0 "
@@ -698,6 +709,12 @@ def main(argv=None) -> int:
         if args.top_p is not None and not 0.0 < args.top_p <= 1.0:
             raise SystemExit(f"--top-p must be in (0, 1], got "
                              f"{args.top_p}")
+        if args.beams <= 1 and (args.eos_id is not None
+                                or args.length_penalty):
+            raise SystemExit(
+                "--eos-id/--length-penalty shape BEAM scores and need "
+                "--beams > 1 (greedy/sampling decode would silently "
+                "ignore them)")
         if args.prompt.startswith("@"):
             prompt = np.atleast_2d(
                 np.load(args.prompt[1:])).astype(np.int32)
@@ -711,6 +728,24 @@ def main(argv=None) -> int:
             prompt = np.asarray(rows, np.int32)
         import jax as _jax
         key = _jax.random.key(int(root.common.get("random_seed", 0)))
+        if args.beams > 1:
+            if args.temperature > 0:
+                raise SystemExit(
+                    "--beams is deterministic search; drop "
+                    "--temperature/--top-k/--top-p or use beams=1")
+            from .runtime.generate import generate_beam as _gen_beam
+            toks, scores = _gen_beam(
+                trainer.workflow, trainer.wstate, prompt, args.generate,
+                beams=args.beams, eos_id=args.eos_id,
+                length_penalty=args.length_penalty)
+            out = {"prompt_len": int(prompt.shape[1]),
+                   "tokens": np.asarray(toks).tolist(),
+                   "scores": np.asarray(scores).tolist()}
+            print(json.dumps(out))
+            if args.result_file:
+                with open(args.result_file, "w") as f:
+                    json.dump(out, f, indent=1)
+            return 0
         toks = _generate(trainer.workflow, trainer.wstate, prompt,
                          args.generate, temperature=args.temperature,
                          top_k=args.top_k, top_p=args.top_p, key=key)

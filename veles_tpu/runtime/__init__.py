@@ -1,4 +1,4 @@
 from .decision import Decision
-from .generate import DecodePlan, generate
+from .generate import DecodePlan, generate, generate_beam
 from .snapshotter import Snapshotter, SnapshotterToDB
 from .trainer import Trainer

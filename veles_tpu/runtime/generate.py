@@ -328,6 +328,29 @@ class DecodePlan:
         return x, caches
 
 
+#: Compiled decode runners kept per workflow (LRU): REST clients control
+#: shape/sampling knobs, so an unbounded cache would accumulate one XLA
+#: program per distinct request (compile-amplification + memory leak).
+_MAX_RUNNERS = 32
+
+
+def _runner_cache(wf, ck):
+    """(cache, hit_or_None) with LRU touch on hit."""
+    cache = getattr(wf, "_decode_runners", None)
+    if cache is None:
+        cache = wf._decode_runners = {}
+    run = cache.pop(ck, None)
+    if run is not None:
+        cache[ck] = run  # dicts preserve order: re-insert = most recent
+    return cache, run
+
+
+def _runner_cache_put(cache, ck, run):
+    cache[ck] = run
+    while len(cache) > _MAX_RUNNERS:
+        cache.pop(next(iter(cache)))
+
+
 def sample_logits(logits, key, *, temperature: float = 0.0,
                   top_k: Optional[int] = None,
                   top_p: Optional[float] = None):
@@ -398,11 +421,9 @@ def generate(wf, wstate, prompt, n_steps: int, *,
           None if top_k is None else int(top_k),
           None if top_p is None else float(top_p),
           output_unit, jnp.dtype(cache_dtype).name)
-    cache = getattr(wf, "_decode_runners", None)
-    if cache is None:
-        cache = wf._decode_runners = {}
-    if ck in cache:
-        return cache[ck](params, prompt, key)
+    cache, hit = _runner_cache(wf, ck)
+    if hit is not None:
+        return hit(params, prompt, key)
     plan = DecodePlan(wf, output_unit)
     ctx = Context(train=False, key=None, mesh=None)
 
@@ -430,5 +451,134 @@ def generate(wf, wstate, prompt, n_steps: int, *,
             body, (caches, toks), jnp.arange(L - 1))
         return toks
 
-    cache[ck] = run
+    _runner_cache_put(cache, ck, run)
     return run(params, prompt, key)
+
+
+def generate_beam(wf, wstate, prompt, n_steps: int, *, beams: int = 4,
+                  eos_id: Optional[int] = None,
+                  length_penalty: float = 0.0,
+                  output_unit: Optional[str] = None,
+                  cache_dtype=jnp.float32):
+    """Beam-search decode: (B, P) int32 -> (tokens (B, P + n_steps),
+    scores (B,)) — the highest-scoring of ``beams`` hypotheses per row.
+
+    Scores are the GENERATED continuation's summed token
+    log-probabilities (the prompt's own log-prob is a per-row constant
+    and is deliberately excluded — it would distort length
+    normalization), normalized by ``len ** length_penalty`` over the
+    generated length (0 = raw sum; >0 favors longer continuations, the
+    GNMT convention).  With ``eos_id`` set, a beam that emits it is
+    finished: its score freezes and it pads with ``eos_id``.
+    ``beams=1`` reduces exactly to greedy :func:`generate`; a width
+    covering the whole search space finds the global
+    maximum-probability continuation (asserted in tests against
+    brute-force enumeration).
+
+    Implementation: the batch axis carries B*W rows through the same
+    cached decode step; each expansion takes the top W of the W*V
+    candidate scores per row and REORDERS every cache (KV and recurrent
+    state alike) by the surviving beams' parents — one gather on the
+    batch axis per step.
+    """
+    prompt = jnp.asarray(prompt, jnp.int32)
+    B, P = prompt.shape
+    W = int(beams)
+    if P < 1:
+        raise ValueError("prompt must hold at least one token")
+    if W < 1:
+        raise ValueError(f"beams must be >= 1, got {W}")
+    L = P + int(n_steps)
+    params = wstate["params"]
+    ck = ("beam", B, P, int(n_steps), W, eos_id,
+          float(length_penalty), output_unit, jnp.dtype(cache_dtype).name)
+    cache, hit = _runner_cache(wf, ck)
+    if hit is not None:
+        return hit(params, prompt)
+    plan = DecodePlan(wf, output_unit)
+    ctx = Context(train=False, key=None, mesh=None)
+    NEG = jnp.float32(-1e30)
+
+    @jax.jit
+    def run(params, prompt):
+        # rows are (B, W) flattened; every beam starts as a copy of its
+        # batch row, but only beam 0 has score 0 — the first expansion
+        # would otherwise select W identical hypotheses
+        caches = plan.init_caches(params, B * W, L, cache_dtype)
+        toks = jnp.zeros((B * W, L), jnp.int32)
+        toks = jax.lax.dynamic_update_slice_in_dim(
+            toks, jnp.repeat(prompt, W, axis=0), 0, 1)
+        scores = jnp.tile(jnp.where(jnp.arange(W) == 0, 0.0, NEG), B)
+        alive = jnp.ones((B * W,), bool)
+
+        def body(carry, pos):
+            caches, toks, scores, alive = carry
+            tok = jax.lax.dynamic_slice_in_dim(toks, pos, 1, 1)[:, 0]
+            logits, caches = plan.step(params, caches, tok, pos, ctx)
+            logp = jax.nn.log_softmax(
+                logits.astype(jnp.float32), axis=-1)      # (B*W, V)
+            V = logp.shape[-1]
+            if eos_id is not None:
+                # finished beams extend ONLY with eos at zero cost
+                frozen = jnp.full((V,), NEG).at[eos_id].set(0.0)
+                logp = jnp.where(alive[:, None], logp, frozen[None])
+            gen = pos + 1 >= P
+            cur = jax.lax.dynamic_slice_in_dim(toks, pos + 1, 1, 1)[:, 0]
+            # generation: top W of the W*V candidates per batch row
+            # (prefill accumulates NOTHING — the prompt's log-prob is a
+            # per-row constant that would distort length-normalized
+            # ranking; beams only score their generated continuation)
+            cand = scores[:, None] + logp                 # (B*W, V)
+            flat = cand.reshape(B, W * V)
+            top_s, top_i = jax.lax.top_k(flat, W)         # (B, W)
+            parent = top_i // V + jnp.arange(B)[:, None] * W
+            nxt_tok = (top_i % V).astype(jnp.int32)
+
+            def expand(ops):
+                caches, toks, alive = ops
+                idx = parent.reshape(-1)
+                caches = jax.tree.map(
+                    lambda a: jnp.take(a, idx, axis=0), caches)
+                return (caches, jnp.take(toks, idx, axis=0),
+                        jnp.take(alive.astype(jnp.int32), idx,
+                                 axis=0).astype(bool))
+
+            # cond, not a traced-index gather: prefill steps must keep
+            # XLA's in-place cache updates (a where-selected index
+            # defeats them and copies every KV cache per prompt token)
+            caches, toks, alive = jax.lax.cond(
+                gen, expand, lambda ops: ops, (caches, toks, alive))
+            scores = jnp.where(gen, top_s.reshape(-1), scores)
+            if eos_id is not None:
+                alive = alive & (~gen | (nxt_tok.reshape(-1) != eos_id))
+            val = jnp.where(gen, nxt_tok.reshape(-1), cur)
+            toks = jax.lax.dynamic_update_slice_in_dim(
+                toks, val[:, None], pos + 1, 1)
+            return (caches, toks, scores, alive), None
+
+        (caches, toks, scores, alive), _ = jax.lax.scan(
+            body, (caches, toks, scores, alive), jnp.arange(L - 1))
+        # length normalization over the generated length (all beams
+        # generate n_steps here; with eos the finished length differs,
+        # but frozen padding contributed 0 — normalize by first-eos
+        # position when eos_id is set)
+        toks_bw = toks.reshape(B, W, L)
+        scores_bw = scores.reshape(B, W)
+        if length_penalty:
+            if eos_id is not None:
+                gen_part = toks_bw[:, :, P:]
+                ended = gen_part == eos_id
+                first = jnp.where(
+                    ended.any(-1), jnp.argmax(ended, -1) + 1,
+                    gen_part.shape[-1])
+            else:
+                first = jnp.full((B, W), L - P)
+            scores_bw = scores_bw / (first.astype(jnp.float32)
+                                     ** length_penalty)
+        best = jnp.argmax(scores_bw, axis=-1)
+        out = jnp.take_along_axis(
+            toks_bw, best[:, None, None].repeat(L, -1), 1)[:, 0]
+        return out, jnp.take_along_axis(scores_bw, best[:, None], 1)[:, 0]
+
+    _runner_cache_put(cache, ck, run)
+    return run(params, prompt)

@@ -102,6 +102,16 @@ class RestfulServer(Logger):
             out = self.denormalizer.denormalize(out)
         return out
 
+    def _vocab_size(self) -> Optional[int]:
+        """Embedding-table rows of the served workflow (None when the
+        chain has no embedding at the front)."""
+        from ..units.nn import Embedding
+        for u in self.workflow.topo_order():
+            if isinstance(u, Embedding):
+                return int(
+                    self.wstate["params"][u.name]["table"].shape[0])
+        return None
+
     def decode(self, req: dict) -> np.ndarray:
         """POST /generate body -> (B, P + steps) token array."""
         if self.workflow is None:
@@ -113,6 +123,14 @@ class RestfulServer(Logger):
         if prompt.ndim != 2 or 0 in prompt.shape:
             raise ValueError("prompt must be a non-empty 2-D "
                              "[[ids], ...] array")
+        # int32 narrowing would WRAP huge ids and the embedding lookup
+        # silently clips out-of-vocab ones — answer 400, not a wrong 200
+        vocab = self._vocab_size()
+        hi = vocab if vocab is not None else 2 ** 31
+        if prompt.min() < 0 or prompt.max() >= hi:
+            raise ValueError(
+                f"prompt token ids must be in [0, {hi}) "
+                f"(got min {prompt.min()}, max {prompt.max()})")
         steps = int(req.get("steps", 16))
         if not 0 < steps <= 65536:
             raise ValueError(f"steps must be in [1, 65536], got {steps}")
