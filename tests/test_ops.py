@@ -192,6 +192,40 @@ def test_lr_policies():
     assert float(s(jnp.asarray(0))) == 1.0
     np.testing.assert_allclose(float(s(jnp.asarray(7))), 0.1, rtol=1e-6)
     np.testing.assert_allclose(float(s(jnp.asarray(11))), 0.01, rtol=1e-6)
+    # warmup-cosine: linear ramp, peak at warmup, cosine to final_scale
+    f = opt.warmup_cosine_lr(2.0, 10, 100, final_scale=0.1)
+    np.testing.assert_allclose(float(f(jnp.asarray(0))), 0.0, atol=1e-7)
+    np.testing.assert_allclose(float(f(jnp.asarray(5))), 1.0, rtol=1e-6)
+    np.testing.assert_allclose(float(f(jnp.asarray(10))), 2.0, rtol=1e-6)
+    np.testing.assert_allclose(float(f(jnp.asarray(100))), 0.2, rtol=1e-5)
+    np.testing.assert_allclose(float(f(jnp.asarray(999))), 0.2, rtol=1e-5)
+    assert 0.2 < float(f(jnp.asarray(55))) < 2.0
+
+
+def test_adamw_decoupled_decay():
+    """AdamW shrinks weights even at zero gradient (decay bypasses the
+    adaptive moments); Adam does not; l2 on AdamW is rejected."""
+    params = {"u": {"w": jnp.ones((4, 4))}}
+    g0 = {"u": {"w": jnp.zeros((4, 4))}}
+    step = jnp.zeros((), jnp.int32)
+    aw = opt.AdamW(lr=0.1, weight_decay=0.5)
+    p2, _ = aw.update(g0, aw.init(params), params, step)
+    np.testing.assert_allclose(np.asarray(p2["u"]["w"]), 1 - 0.05,
+                               rtol=1e-6)
+    a = opt.Adam(lr=0.1)
+    pa, _ = a.update(g0, a.init(params), params, step)
+    np.testing.assert_allclose(np.asarray(pa["u"]["w"]), 1.0, rtol=1e-6)
+    with pytest.raises(ValueError, match="decoupled"):
+        opt.AdamW(l2=0.1)
+    with pytest.raises(ValueError, match="COUPLED"):
+        opt.AdamW(per_unit={"u": opt.HyperParams(l2=0.1)})
+    # with a real gradient the adam part matches Adam + the decay term
+    g = {"u": {"w": jnp.full((4, 4), 0.3)}}
+    paw, _ = opt.AdamW(lr=0.1, weight_decay=0.0).update(
+        g, aw.init(params), params, step)
+    pad, _ = opt.Adam(lr=0.1).update(g, a.init(params), params, step)
+    np.testing.assert_allclose(np.asarray(paw["u"]["w"]),
+                               np.asarray(pad["u"]["w"]), rtol=1e-6)
 
 
 def test_precision_level_config_mapping():

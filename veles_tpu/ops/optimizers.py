@@ -40,6 +40,26 @@ def inv_lr(base: float, gamma: float, power: float = 1.0):
     return lambda step: base * jnp.power(1.0 + gamma * step, -power)
 
 
+def warmup_cosine_lr(base: float, warmup_steps: int, total_steps: int,
+                     final_scale: float = 0.0):
+    """Linear warmup to ``base`` over ``warmup_steps``, then cosine decay
+    to ``final_scale * base`` at ``total_steps`` — the standard LM
+    training schedule (no reference analog; its schedules were
+    exp/inv/step, veles/znicz/gd.py lr_policy family)."""
+    w = float(max(warmup_steps, 1))
+    span = float(max(total_steps - warmup_steps, 1))
+
+    def f(step):
+        s = jnp.asarray(step, jnp.float32)
+        warm = s / w
+        prog = jnp.clip((s - warmup_steps) / span, 0.0, 1.0)
+        cos = final_scale + (1.0 - final_scale) * 0.5 * (
+            1.0 + jnp.cos(jnp.pi * prog))
+        return base * jnp.where(s < warmup_steps, warm, cos)
+
+    return f
+
+
 def step_lr(base: float, boundaries, values):
     """Piecewise-constant schedule."""
     bounds = jnp.asarray(boundaries)
@@ -52,6 +72,7 @@ LR_POLICIES = {
     "exp": exp_decay_lr,
     "inv": inv_lr,
     "step": step_lr,
+    "warmup_cosine": warmup_cosine_lr,
 }
 
 
@@ -91,8 +112,10 @@ class Optimizer:
     def init_slot(self, p) -> Any:
         return ()
 
-    def apply_slot(self, g, slot, lr, hp) -> tuple:
-        """Return (delta, new_slot); delta is subtracted from the param."""
+    def apply_slot(self, g, slot, lr, hp, param=None) -> tuple:
+        """Return (delta, new_slot); delta is subtracted from the param.
+        ``param`` is the f32 master weight — optimizers with decoupled
+        weight decay (AdamW) read it, the rest ignore it."""
         raise NotImplementedError
 
     # -- shared driver ------------------------------------------------------
@@ -159,7 +182,8 @@ class Optimizer:
             slot0 = ustate.get(pname, None)
             if slot0 is None:
                 slot0 = self.init_slot(p)
-            delta, slot = self.apply_slot(g, slot0, lr * scale, hp)
+            delta, slot = self.apply_slot(g, slot0, lr * scale, hp,
+                                          param=p32)
             np_[pname] = (p32 - delta).astype(p.dtype)
             ns_[pname] = slot
         return np_, ns_
@@ -180,7 +204,7 @@ class SGD(Optimizer):
         return jnp.zeros(p.shape, jnp.float32) if self._uses_momentum() \
             else ()
 
-    def apply_slot(self, g, slot, lr, hp):
+    def apply_slot(self, g, slot, lr, hp, param=None):
         mom = hp.momentum if hp.momentum is not None else self.momentum
         if isinstance(slot, tuple):  # no velocity allocated
             return lr * g, ()
@@ -196,7 +220,7 @@ class AdaGrad(Optimizer):
     def init_slot(self, p):
         return jnp.zeros(p.shape, jnp.float32)
 
-    def apply_slot(self, g, slot, lr, hp):
+    def apply_slot(self, g, slot, lr, hp, param=None):
         acc = slot + jnp.square(g)
         return lr * g / (jnp.sqrt(acc) + self.eps), acc
 
@@ -211,7 +235,7 @@ class AdaDelta(Optimizer):
         return (jnp.zeros(p.shape, jnp.float32),
                 jnp.zeros(p.shape, jnp.float32))
 
-    def apply_slot(self, g, slot, lr, hp):
+    def apply_slot(self, g, slot, lr, hp, param=None):
         acc_g, acc_d = slot
         acc_g = self.rho * acc_g + (1 - self.rho) * jnp.square(g)
         delta = g * jnp.sqrt(acc_d + self.eps) / jnp.sqrt(acc_g + self.eps)
@@ -232,7 +256,7 @@ class Adam(Optimizer):
                 jnp.zeros(p.shape, jnp.float32),
                 jnp.zeros((), jnp.float32))
 
-    def apply_slot(self, g, slot, lr, hp):
+    def apply_slot(self, g, slot, lr, hp, param=None):
         m, v, t = slot
         t = t + 1
         m = self.b1 * m + (1 - self.b1) * g
@@ -242,10 +266,37 @@ class Adam(Optimizer):
         return lr * mhat / (jnp.sqrt(vhat) + self.eps), (m, v, t)
 
 
+class AdamW(Adam):
+    """Adam with DECOUPLED weight decay (Loshchilov & Hutter, 2019): the
+    decay is applied to the parameter directly, outside the adaptive
+    moments — the LM-training standard. ``l2`` (coupled decay through
+    the gradient) is rejected to prevent silently mixing the two."""
+
+    def __init__(self, lr=1e-3, weight_decay: float = 0.01, **kw):
+        if kw.get("l2"):
+            raise ValueError(
+                "AdamW takes decoupled weight_decay=, not l2 (which "
+                "would couple the decay through the adaptive moments)")
+        coupled = [n for n, hp in (kw.get("per_unit") or {}).items()
+                   if hp.l2]
+        if coupled:
+            raise ValueError(
+                f"per-unit hyperparams l2 on {coupled} would apply "
+                "COUPLED decay under AdamW; use the optimizer-wide "
+                "weight_decay (or switch those layers to Adam + l2)")
+        super().__init__(lr, **kw)
+        self.weight_decay = float(weight_decay)
+
+    def apply_slot(self, g, slot, lr, hp, param=None):
+        delta, slot = super().apply_slot(g, slot, lr, hp)
+        return delta + lr * self.weight_decay * param, slot
+
+
 OPTIMIZERS = {
     "sgd": SGD,
     "momentum": lambda lr=0.01, **kw: SGD(lr, momentum=kw.pop("momentum", 0.9), **kw),
     "adagrad": AdaGrad,
     "adadelta": AdaDelta,
     "adam": Adam,
+    "adamw": AdamW,
 }
