@@ -60,6 +60,13 @@ def build(wstate_seed=0):
 
 def main():
     decode_only = "--decode-only" in sys.argv
+    # --smoke: tiny-shape validation (CPU-runnable) so the recovery
+    # queue never fires a bit-rotted harness at the real shapes
+    smoke = "--smoke" in sys.argv
+    global B, T, E, LAYERS, HEADS, VOCAB, DECODE_B, DECODE_P, DECODE_N
+    if smoke:
+        B, T, E, LAYERS, HEADS, VOCAB = 2, 64, 32, 2, 2, 64
+        DECODE_B, DECODE_P, DECODE_N = 2, 16, 8
     import jax
     import jax.numpy as jnp
 
@@ -82,7 +89,7 @@ def main():
         for _ in range(3):
             ws, mets = step(ws, batch)
         float(mets["loss"])  # drain (block_until_ready unreliable on axon)
-        iters = 20
+        iters = 2 if smoke else 20
         t0 = time.perf_counter()
         for _ in range(iters):
             ws, mets = step(ws, batch)

@@ -215,19 +215,14 @@ def main():
     t_rb, out_rb = timeit(g_rep, q, kg, vg, iters=5)
     assert all(bool(jnp.all(jnp.isfinite(x.astype(jnp.float32))))
                for x in out_gb[1:])
-    # repeat-path dk/dv are per repeated head; the true GQA grads are
-    # their group sums
+    # the repeat path differentiates THROUGH jnp.repeat, so AD already
+    # group-sums its dk/dv back to kv-head shape — compare directly
     _, dq_g, dk_g, dv_g = out_gb
     _, dq_r, dk_r, dv_r = out_rb
-    B_, T_, _, D_ = dq_g.shape
     err_gb = max(
         rel_err(dq_g.astype(jnp.float32), dq_r.astype(jnp.float32)),
-        rel_err(dk_g.astype(jnp.float32),
-                dk_r.reshape(B_, T_, Hk, G, D_).sum(3)
-                .astype(jnp.float32)),
-        rel_err(dv_g.astype(jnp.float32),
-                dv_r.reshape(B_, T_, Hk, G, D_).sum(3)
-                .astype(jnp.float32)))
+        rel_err(dk_g.astype(jnp.float32), dk_r.astype(jnp.float32)),
+        rel_err(dv_g.astype(jnp.float32), dv_r.astype(jnp.float32)))
     record(f"flash_gqa_bwd_T{T}_bf16", t_gb, t_rb, err_gb,
            note="xla_ms column = same kernel fwd+bwd on materialized "
                 "repeat (4x K/V HBM); timed via value_and_grad")
