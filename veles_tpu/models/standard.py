@@ -78,6 +78,16 @@ def build_workflow(name: str, layers: Sequence[dict], *,
         ltype = spec.pop("type")
         spec.pop("hyperparams", None)
         lname = spec.pop("name", f"l{i}_{ltype}")
+        # activation rematerialization knob: the training forward wraps
+        # this unit in jax.checkpoint, recomputing its internals in the
+        # backward instead of taping them (HBM-for-FLOPs trade — the
+        # standard lever for deep stacks that don't fit; numerics are
+        # identical, tests/test_workflow.py asserts grad exactness).
+        # pipeline_stack bodies are ALREADY rematerialized by both
+        # schedules — an outer checkpoint would recompute stages twice
+        # for no memory benefit, so the flag is dropped there.
+        remat = bool(spec.pop("remat", False)) \
+            and ltype != "pipeline_stack"
         klass = LAYER_TYPES[ltype]
         if compute_dtype is not None and ltype.startswith(
                 COMPUTE_DTYPE_TYPES + ("pipeline_stack",)):
@@ -85,6 +95,7 @@ def build_workflow(name: str, layers: Sequence[dict], *,
             # sublists (only to unit types that take it)
             spec.setdefault("compute_dtype", compute_dtype)
         unit = klass(name=lname, inputs=(prev,), **spec)
+        unit.remat = remat
         wf.add(unit)
         prev = lname
 

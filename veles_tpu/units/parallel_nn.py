@@ -295,6 +295,11 @@ class PipelineStack(Forward):
             spec = dict(spec)
             ltype = spec.pop("type")
             lname = spec.pop("name", f"s{i}u{j}_{ltype}")
+            # stage bodies are already rematerialized by both pipeline
+            # schedules (GPipe wraps each stage in jax.checkpoint; 1F1B
+            # recomputes inside the VJP), so a per-sub-unit remat flag
+            # is a no-op here — accept and drop it for config symmetry
+            spec.pop("remat", None)
             if "hyperparams" in spec:
                 # per-layer optimizer hyperparams key on unit names; the
                 # stack is ONE unit, so they cannot reach the optimizer

@@ -170,8 +170,20 @@ class Workflow(Logger):
             if only is not None and u.name not in only:
                 continue
             xs = [outputs[s] for s in u.inputs]
-            y, ns = u.apply(params.get(u.name, {}), state.get(u.name, {}),
-                            xs, ctx)
+            up = params.get(u.name, {})
+            us = state.get(u.name, {})
+            if getattr(u, "remat", False) and ctx.train:
+                # activation rematerialization: recompute this unit's
+                # internals in the backward instead of taping them —
+                # jax.checkpoint over the unit apply (build brief: trade
+                # FLOPs for HBM). Stochastic units are safe: the ctx key
+                # is a closed-over tracer, so the recompute draws the
+                # SAME mask.
+                y, ns = jax.checkpoint(
+                    lambda p, s, *xs, _u=u: _u.apply(p, s, list(xs),
+                                                     ctx))(up, us, *xs)
+            else:
+                y, ns = u.apply(up, us, xs, ctx)
             outputs[u.name] = y
             if ns:
                 nstate[u.name] = ns
