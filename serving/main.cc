@@ -2,7 +2,7 @@
 //
 // Usage: veles_serve <package_dir> <input.npy> <output.npy>
 //          [--output-unit NAME] [--threads N] [--repeat N]
-//          [--generate N]
+//          [--generate N [--temperature T [--top-k K] [--seed S]]]
 //
 // Counterpart of the reference's libVeles sample flow (reference:
 // libVeles/src/workflow_loader.cc + engine): load package, run DAG on a
@@ -29,7 +29,9 @@ int main(int argc, char** argv) {
   }
   std::string pkg = argv[1], in_path = argv[2], out_path = argv[3];
   std::string output_unit;
-  int threads = 0, repeat = 1, generate = 0;
+  int threads = 0, repeat = 1, generate = 0, top_k = 0;
+  float temperature = 0.f;
+  long long seed = 0;
   for (int i = 4; i < argc; i++) {
     if (!std::strcmp(argv[i], "--output-unit") && i + 1 < argc)
       output_unit = argv[++i];
@@ -39,6 +41,25 @@ int main(int argc, char** argv) {
       repeat = std::max(1, std::atoi(argv[++i]));
     else if (!std::strcmp(argv[i], "--generate") && i + 1 < argc)
       generate = std::max(0, std::atoi(argv[++i]));
+    else if (!std::strcmp(argv[i], "--temperature") && i + 1 < argc)
+      temperature = std::atof(argv[++i]);
+    else if (!std::strcmp(argv[i], "--top-k") && i + 1 < argc)
+      top_k = std::atoi(argv[++i]);
+    else if (!std::strcmp(argv[i], "--seed") && i + 1 < argc)
+      seed = std::atoll(argv[++i]);
+  }
+  if (top_k > 0 && temperature <= 0.f) {
+    // same contract as the Python CLI: the filter applies to SAMPLING
+    std::fprintf(stderr,
+                 "error: --top-k filters sampling and needs "
+                 "--temperature > 0 (temperature 0 is greedy)\n");
+    return 2;
+  }
+  if (generate == 0 && (temperature > 0.f || top_k > 0 || seed != 0)) {
+    std::fprintf(stderr,
+                 "error: --temperature/--top-k/--seed shape --generate "
+                 "decoding; they have no effect on a forward run\n");
+    return 2;
   }
 
   try {
@@ -56,7 +77,9 @@ int main(int argc, char** argv) {
             "--output-unit is not supported with --generate (decoding "
             "always samples from the chain's final head)");
       auto t0 = std::chrono::steady_clock::now();
-      veles::Tensor toks = wf.Generate(input, generate, &pool);
+      veles::Tensor toks =
+          wf.Generate(input, generate, &pool, temperature, top_k,
+                      static_cast<uint64_t>(seed));
       auto t1 = std::chrono::steady_clock::now();
       double ms =
           std::chrono::duration<double, std::milli>(t1 - t0).count();

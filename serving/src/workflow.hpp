@@ -7,9 +7,14 @@
 // veles_tpu/export/package.py).
 #pragma once
 
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
 #include <fstream>
+#include <limits>
 #include <map>
 #include <memory>
+#include <random>
 #include <sstream>
 #include <string>
 #include <vector>
@@ -146,7 +151,16 @@ class Workflow {
   // token) and RNN/GRU/LSTM units run DecodeStep against carried
   // hidden/cell state (O(1) per token) — running a recurrent unit's
   // plain Run() here would silently RESET its state every position.
-  Tensor Generate(const Tensor& prompt, int n_steps, ThreadPool* pool) {
+  // temperature <= 0: greedy (golden-matches the JAX generate()).
+  // temperature > 0: temperature-scaled categorical sampling, optionally
+  // restricted to the top_k logits — seeded per (seed, position, row)
+  // so runs are reproducible.  The sampler RNG is the C++ runtime's own
+  // (std::mt19937_64); it intentionally does NOT mirror JAX's threefry
+  // stream, so sampled continuations are runtime-specific (greedy is
+  // the cross-runtime golden contract).
+  Tensor Generate(const Tensor& prompt, int n_steps, ThreadPool* pool,
+                  float temperature = 0.f, int top_k = 0,
+                  uint64_t seed = 0) {
     if (prompt.shape.rank() != 2)
       throw std::runtime_error("generate: prompt must be (batch, time)");
     int64_t B = prompt.shape[0], P = prompt.shape[1];
@@ -235,15 +249,64 @@ class Workflow {
           u->Run(ins, &out, &ctx);
         }
       }
-      // greedy next token (softmax head preserves the argmax)
+      // next token: greedy argmax, or seeded temperature/top-k sampling
       const Tensor& logits = bufs[head];
+      // exported packages usually end in the evaluator-derived
+      // SoftmaxUnit, which emits PROBABILITIES; temperature math needs
+      // the log domain or the distribution flattens to near-uniform
+      // (the JAX sample_logits sees pre-softmax logits)
+      const bool head_probs =
+          dynamic_cast<SoftmaxUnit*>(units_.back().get()) != nullptr;
       for (int64_t b = 0; b < B; b++) {
         if (pos + 1 < P) continue;  // teacher-forced prompt positions
         const float* row = logits.data + b * V;
+        auto lg = [&](int64_t o) -> double {
+          if (!head_probs) return row[o];
+          return row[o] > 0 ? std::log(static_cast<double>(row[o]))
+                            : -std::numeric_limits<double>::infinity();
+        };
         int64_t best = 0;
         for (int64_t o = 1; o < V; o++)
           if (row[o] > row[best]) best = o;
-        toks.data[b * L + pos + 1] = static_cast<float>(best);
+        int64_t pick = best;
+        if (temperature > 0.f) {
+          // top-k threshold: k-th largest logit (k<=0 disables)
+          double thresh = -std::numeric_limits<double>::infinity();
+          if (top_k > 0 && top_k < V) {
+            std::vector<double> sorted(V);
+            for (int64_t o = 0; o < V; o++) sorted[o] = lg(o);
+            std::nth_element(sorted.begin(),
+                             sorted.begin() + (top_k - 1), sorted.end(),
+                             std::greater<double>());
+            thresh = sorted[top_k - 1];
+          }
+          // numerically-stable softmax over the kept support
+          double denom = 0;
+          std::vector<double> p(V, 0.0);
+          for (int64_t o = 0; o < V; o++) {
+            if (lg(o) < thresh) continue;
+            p[o] = std::exp((lg(o) - lg(best)) / temperature);
+            denom += p[o];
+          }
+          // seed_seq keeps 32 bits per entry: split the 64-bit seed so
+          // high-half-only differences still change the stream
+          std::seed_seq sq{
+              static_cast<uint32_t>(seed),
+              static_cast<uint32_t>(seed >> 32),
+              static_cast<uint32_t>(pos),
+              static_cast<uint32_t>(b)};
+          std::mt19937_64 rng(sq);
+          double u = std::uniform_real_distribution<double>(0, 1)(rng)
+              * denom;
+          double acc = 0;
+          for (int64_t o = 0; o < V; o++) {
+            if (p[o] == 0) continue;  // filtered: never selectable,
+                                      // even at u == 0 boundaries
+            acc += p[o];
+            if (u <= acc) { pick = o; break; }
+          }
+        }
+        toks.data[b * L + pos + 1] = static_cast<float>(pick);
       }
     }
     return toks;

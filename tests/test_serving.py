@@ -367,6 +367,88 @@ def test_cpp_recurrent_generate_matches_jax(binary, tmp_path, rng, chain):
     np.testing.assert_array_equal(got, ref)
 
 
+def test_cpp_generate_sampling(binary, tmp_path, rng):
+    """veles_serve --temperature/--top-k/--seed: seeded runs reproduce,
+    different seeds diverge, top-k=1 collapses to the greedy golden, and
+    --top-k without temperature is rejected (the Python CLI contract)."""
+    from veles_tpu.runtime.generate import generate
+    V, T, N = 12, 5, 10
+    wf = build_workflow("samp_serve", [
+        {"type": "embedding", "vocab": V, "dim": 12, "name": "emb"},
+        {"type": "attention", "n_heads": 2, "rope": True,
+         "residual": True, "name": "a1"},
+        {"type": "seq_last", "name": "last"},
+        {"type": "softmax", "output_size": V, "name": "out"},
+    ])
+    wf.build({"@input": vt.Spec((2, T), jnp.int32),
+              "@labels": vt.Spec((2,), jnp.int32),
+              "@mask": vt.Spec((2,), jnp.float32)})
+    ws = wf.init_state(jax.random.key(17), opt.SGD(0.01))
+    pkg = str(tmp_path / "samp_pkg")
+    export_package(wf, ws, pkg,
+                   input_spec={"shape": [2, T], "dtype": "float32"})
+    prompt = rng.integers(0, V, (2, T)).astype(np.int32)
+    np.save(tmp_path / "sp.npy", prompt.astype(np.float32))
+
+    def gen(out, *extra):
+        r = subprocess.run(
+            [binary, pkg, str(tmp_path / "sp.npy"),
+             str(tmp_path / out), "--generate", str(N), *extra],
+            capture_output=True, text=True, timeout=120)
+        return r
+
+    assert gen("g.npy").returncode == 0
+    greedy = np.load(tmp_path / "g.npy").astype(np.int32)
+    np.testing.assert_array_equal(
+        greedy, np.asarray(generate(wf, ws, prompt, N)))
+
+    # reproducible under one seed, divergent across seeds
+    assert gen("s1.npy", "--temperature", "2.0", "--seed",
+               "7").returncode == 0
+    assert gen("s1b.npy", "--temperature", "2.0", "--seed",
+               "7").returncode == 0
+    assert gen("s2.npy", "--temperature", "2.0", "--seed",
+               "8").returncode == 0
+    s1 = np.load(tmp_path / "s1.npy")
+    np.testing.assert_array_equal(s1, np.load(tmp_path / "s1b.npy"))
+    assert not np.array_equal(s1, np.load(tmp_path / "s2.npy"))
+    np.testing.assert_array_equal(
+        s1[:, :T].astype(np.int32), prompt)  # prompt preserved
+
+    # top-k=1 at any temperature IS greedy
+    assert gen("k1.npy", "--temperature", "5.0", "--top-k", "1",
+               "--seed", "3").returncode == 0
+    np.testing.assert_array_equal(
+        np.load(tmp_path / "k1.npy").astype(np.int32), greedy)
+
+    # filter without sampling rejected loudly
+    r = gen("x.npy", "--top-k", "4")
+    assert r.returncode != 0 and "temperature" in r.stderr
+    # sampling flags without --generate rejected too
+    r2 = subprocess.run(
+        [binary, pkg, str(tmp_path / "sp.npy"), str(tmp_path / "x.npy"),
+         "--temperature", "1.0"], capture_output=True, text=True,
+        timeout=60)
+    assert r2.returncode != 0 and "generate" in r2.stderr
+
+    # distributional sanity at T=1: the first sampled token's frequency
+    # must track the model's softmax probability (the exported head
+    # emits PROBABILITIES — sampling must go through the log domain; the
+    # probs-as-logits bug gives a near-uniform distribution instead)
+    logits = np.asarray(wf.make_predict_step("out")(
+        ws, {"@input": jnp.asarray(prompt)}))
+    probs = np.asarray(jax.nn.softmax(jnp.asarray(logits), axis=-1))
+    counts = np.zeros(V)
+    n_trials = 200
+    for s in range(n_trials):
+        assert gen("d.npy", "--temperature", "1.0", "--seed",
+                   str(1000 + s)).returncode == 0
+        counts[int(np.load(tmp_path / "d.npy")[0, T])] += 1
+    top = int(np.argmax(probs[0]))
+    assert abs(counts[top] / n_trials - probs[0, top]) < 0.12, \
+        (counts / n_trials, probs[0])
+
+
 def test_cpp_moe_generate_matches_jax(binary, tmp_path, rng):
     """veles_serve --generate on a MoE transformer chain: router +
     expert FFN are token-local, so decode runs them per position
