@@ -2,7 +2,8 @@
 //
 // Usage: veles_serve <package_dir> <input.npy> <output.npy>
 //          [--output-unit NAME] [--threads N] [--repeat N]
-//          [--generate N [--temperature T [--top-k K] [--seed S]]]
+//          [--generate N [--temperature T [--top-k K] [--top-p P]
+//            [--seed S]]]
 //
 // Counterpart of the reference's libVeles sample flow (reference:
 // libVeles/src/workflow_loader.cc + engine): load package, run DAG on a
@@ -30,7 +31,8 @@ int main(int argc, char** argv) {
   std::string pkg = argv[1], in_path = argv[2], out_path = argv[3];
   std::string output_unit;
   int threads = 0, repeat = 1, generate = 0, top_k = 0;
-  float temperature = 0.f;
+  float temperature = 0.f, top_p = 0.f;
+  bool top_p_given = false;
   long long seed = 0;
   for (int i = 4; i < argc; i++) {
     if (!std::strcmp(argv[i], "--output-unit") && i + 1 < argc)
@@ -47,15 +49,26 @@ int main(int argc, char** argv) {
       top_k = std::atoi(argv[++i]);
     else if (!std::strcmp(argv[i], "--seed") && i + 1 < argc)
       seed = std::atoll(argv[++i]);
+    else if (!std::strcmp(argv[i], "--top-p") && i + 1 < argc) {
+      top_p = std::atof(argv[++i]);
+      top_p_given = true;
+    }
   }
-  if (top_k > 0 && temperature <= 0.f) {
-    // same contract as the Python CLI: the filter applies to SAMPLING
+  if ((top_k > 0 || top_p_given) && temperature <= 0.f) {
+    // same contract as the Python CLI: the filters apply to SAMPLING
     std::fprintf(stderr,
-                 "error: --top-k filters sampling and needs "
+                 "error: --top-k/--top-p filter sampling and need "
                  "--temperature > 0 (temperature 0 is greedy)\n");
     return 2;
   }
-  if (generate == 0 && (temperature > 0.f || top_k > 0 || seed != 0)) {
+  if (top_p_given && !(top_p > 0.f && top_p <= 1.f)) {
+    // rejects 0 (would silently disable the filter) and NaN too —
+    // the Python CLI contract
+    std::fprintf(stderr, "error: --top-p must be in (0, 1]\n");
+    return 2;
+  }
+  if (generate == 0 &&
+      (temperature > 0.f || top_k > 0 || top_p > 0.f || seed != 0)) {
     std::fprintf(stderr,
                  "error: --temperature/--top-k/--seed shape --generate "
                  "decoding; they have no effect on a forward run\n");
@@ -79,7 +92,7 @@ int main(int argc, char** argv) {
       auto t0 = std::chrono::steady_clock::now();
       veles::Tensor toks =
           wf.Generate(input, generate, &pool, temperature, top_k,
-                      static_cast<uint64_t>(seed));
+                      static_cast<uint64_t>(seed), top_p);
       auto t1 = std::chrono::steady_clock::now();
       double ms =
           std::chrono::duration<double, std::milli>(t1 - t0).count();

@@ -160,7 +160,7 @@ class Workflow {
   // the cross-runtime golden contract).
   Tensor Generate(const Tensor& prompt, int n_steps, ThreadPool* pool,
                   float temperature = 0.f, int top_k = 0,
-                  uint64_t seed = 0) {
+                  uint64_t seed = 0, float top_p = 0.f) {
     if (prompt.shape.rank() != 2)
       throw std::runtime_error("generate: prompt must be (batch, time)");
     int64_t B = prompt.shape[0], P = prompt.shape[1];
@@ -287,6 +287,31 @@ class Workflow {
             if (lg(o) < thresh) continue;
             p[o] = std::exp((lg(o) - lg(best)) / temperature);
             denom += p[o];
+          }
+          if (top_p > 0.f && top_p < 1.f) {
+            // nucleus: keep the smallest descending-prob prefix whose
+            // EXCLUSIVE cumulative mass is < top_p, then keep ALL
+            // tokens tied with the weakest kept one — the threshold
+            // semantics of the JAX sample_logits (which masks
+            // `logits < thresh`), so the selectable SET matches even
+            // on tied/degenerate distributions
+            std::vector<double> sorted;
+            for (int64_t o = 0; o < V; o++)
+              if (p[o] > 0) sorted.push_back(p[o]);
+            std::sort(sorted.begin(), sorted.end(),
+                      std::greater<double>());
+            double acc = 0, pmin = sorted[0];
+            for (double w : sorted) {
+              if (acc / denom >= top_p) break;
+              acc += w;
+              pmin = w;
+            }
+            for (int64_t o = 0; o < V; o++) {
+              if (p[o] > 0 && p[o] < pmin) {
+                denom -= p[o];
+                p[o] = 0;
+              }
+            }
           }
           // seed_seq keeps 32 bits per entry: split the 64-bit seed so
           // high-half-only differences still change the stream

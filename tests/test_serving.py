@@ -421,8 +421,16 @@ def test_cpp_generate_sampling(binary, tmp_path, rng):
     np.testing.assert_array_equal(
         np.load(tmp_path / "k1.npy").astype(np.int32), greedy)
 
+    # tiny top-p collapses to greedy (the argmax always survives)
+    assert gen("p1.npy", "--temperature", "5.0", "--top-p", "0.0001",
+               "--seed", "3").returncode == 0
+    np.testing.assert_array_equal(
+        np.load(tmp_path / "p1.npy").astype(np.int32), greedy)
+
     # filter without sampling rejected loudly
     r = gen("x.npy", "--top-k", "4")
+    assert r.returncode != 0 and "temperature" in r.stderr
+    r = gen("x.npy", "--top-p", "0.9")
     assert r.returncode != 0 and "temperature" in r.stderr
     # sampling flags without --generate rejected too
     r2 = subprocess.run(
