@@ -328,6 +328,64 @@ def test_cli_generate_mode(tmp_path):
     assert r3.returncode != 0 and "--prompt" in (r3.stderr + r3.stdout)
 
 
+def test_cli_serve_mode(tmp_path):
+    """--serve exposes the restored model over HTTP: /predict and (for
+    sequence chains) /generate, until the process is stopped."""
+    import urllib.request
+
+    cfg = tmp_path / "lm.json"
+    cfg.write_text(json.dumps(LM_CONFIG_JSON))
+    r = run_cli(tmp_path, str(cfg), "--random-seed", "1",
+                "--snapshot-dir", str(tmp_path / "snap"))
+    assert r.returncode == 0, r.stderr
+    snap = tmp_path / "snap" / "cli_lm_best.json"
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    proc = subprocess.Popen(
+        [sys.executable, "-c",
+         "import jax; jax.config.update('jax_platforms','cpu');"
+         "from veles_tpu.__main__ import main; import sys;"
+         "sys.exit(main(sys.argv[1:]))",
+         str(cfg), "--snapshot", str(snap), "--serve", "0"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env, cwd=str(tmp_path))
+    try:
+        # the server announces its (ephemeral) port on stdout
+        import time
+        port = None
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            if proc.poll() is not None:  # crashed at startup
+                raise AssertionError(
+                    f"server died rc={proc.returncode}: "
+                    f"{proc.stderr.read()[-2000:]}")
+            line = proc.stdout.readline()
+            if line.startswith("{"):
+                port = json.loads(line)["serving"]
+                break
+        assert port, f"no port announced; stderr: {proc.stderr.read()[-2000:]}"
+        base = f"http://127.0.0.1:{port}"
+        req = urllib.request.Request(
+            f"{base}/generate",
+            json.dumps({"prompt": [[1, 2, 3]], "steps": 4}).encode(),
+            {"Content-Type": "application/json"})
+        toks = json.loads(urllib.request.urlopen(req, timeout=60)
+                          .read())["tokens"]
+        assert len(toks[0]) == 7 and toks[0][:3] == [1, 2, 3]
+        # /predict takes token-id inputs (input dtype follows the spec;
+        # the compiled forward is fixed at the training seq_len of 12)
+        req2 = urllib.request.Request(
+            f"{base}/predict",
+            json.dumps({"input": [list(range(10)) + [1, 2]]}).encode(),
+            {"Content-Type": "application/json"})
+        out = json.loads(urllib.request.urlopen(req2, timeout=60)
+                         .read())["output"]
+        assert len(out) == 1 and len(out[0]) == 10  # vocab logits
+    finally:
+        proc.terminate()
+        proc.wait(timeout=30)
+
+
 def test_cli_export_mode(tmp_path):
     """--export writes a native-serving package of the restored model:
     train -> snapshot -> export -> veles_serve is fully CLI-driven."""

@@ -164,6 +164,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="beam score normalization exponent over the "
                         "generated length (GNMT convention; 0 = raw "
                         "log-prob sum)")
+    p.add_argument("--serve", type=int, metavar="PORT", default=None,
+                   help="serve the (restored) model over HTTP instead "
+                        "of training: POST /predict, plus POST "
+                        "/generate for sequence chains "
+                        "(runtime/restful.py; 0 = ephemeral port); "
+                        "blocks until interrupted")
     p.add_argument("--status-port", type=int, default=None,
                    help="serve a live status page (JSON + HTML with "
                         "auto-refreshing metric plots) on this port; 0 "
@@ -478,10 +484,12 @@ def main(argv=None) -> int:
                              "runs (meta-workflow reports: use the "
                              "Publisher API)")
     if args.curriculum and (args.dry_run or args.export
-                            or args.generate is not None):
+                            or args.generate is not None
+                            or args.serve is not None):
         raise SystemExit("--curriculum is a training meta-mode; "
-                         "--dry-run/--export/--generate apply to single "
-                         "runs (run them on the final best snapshot)")
+                         "--dry-run/--export/--generate/--serve apply "
+                         "to single runs (run them on the final best "
+                         "snapshot)")
 
     if args.random_seed is not None:
         root.common.random_seed = _parse_seed(args.random_seed)
@@ -687,6 +695,29 @@ def main(argv=None) -> int:
         if args.result_file:
             with open(args.result_file, "w") as f:
                 json.dump(out, f, indent=1)
+        return 0
+    if args.serve is not None:
+        # HTTP serving mode: the reference's RESTfulAPI unit as a CLI
+        # switch (veles/restful_api.py:78) — POST /predict on the chain
+        # head, POST /generate for sequence chains
+        import time as _time
+
+        from .runtime.restful import RestfulServer
+        wf = trainer.workflow
+        head = wf.default_output()
+        spec = trainer._batch_spec["@input"]
+        srv = RestfulServer(
+            wf.make_predict_step(head), trainer.wstate,
+            int(spec.shape[0]), tuple(spec.shape[1:]),
+            port=args.serve, workflow=wf,
+            input_dtype=spec.dtype).start()
+        print(json.dumps({"serving": srv.port, "predict_head": head}),
+              flush=True)
+        try:
+            while True:
+                _time.sleep(3600)
+        except KeyboardInterrupt:
+            srv.stop()
         return 0
     if args.generate is not None:
         # decode mode: the trained (or restored) sequence model emits a

@@ -31,11 +31,13 @@ from ..logger import Logger
 class RestfulServer(Logger):
     def __init__(self, predict_fn: Callable, wstate, batch_size: int,
                  input_shape, *, port: int = 0, host: str = "127.0.0.1",
-                 normalizer=None, denormalizer=None, workflow=None):
+                 normalizer=None, denormalizer=None, workflow=None,
+                 input_dtype=np.float32):
         self.predict_fn = predict_fn
         self.wstate = wstate
         self.batch_size = int(batch_size)
         self.input_shape = tuple(input_shape)
+        self.input_dtype = np.dtype(input_dtype)  # int32 for token LMs
         self.normalizer = normalizer
         self.denormalizer = denormalizer
         self.workflow = workflow  # enables POST /generate (module doc)
@@ -62,8 +64,8 @@ class RestfulServer(Logger):
                         self._reply(
                             {"tokens": outer.decode(req).tolist()})
                         return
-                    x = np.asarray(req["input"], np.float32)
-                    self._reply({"output": outer.infer(x).tolist()})
+                    self._reply(
+                        {"output": outer.infer(req["input"]).tolist()})
                 except (KeyError, TypeError, ValueError,
                         json.JSONDecodeError) as e:
                     self._reply({"error": str(e)}, code=400)
@@ -79,7 +81,22 @@ class RestfulServer(Logger):
         self.port = self.httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
 
-    def infer(self, x: np.ndarray) -> np.ndarray:
+    def infer(self, x) -> np.ndarray:
+        if np.issubdtype(self.input_dtype, np.integer):
+            # token-id inputs: int32 narrowing would WRAP huge ids and
+            # the embedding lookup silently clips out-of-vocab ones —
+            # the same 400-not-wrong-200 contract decode() enforces
+            xi = np.asarray(x, np.int64)
+            vocab = (self._vocab_size() if self.workflow is not None
+                     else None)
+            hi = vocab if vocab is not None else 2 ** 31
+            if xi.size and (xi.min() < 0 or xi.max() >= hi):
+                raise ValueError(
+                    f"input token ids must be in [0, {hi}) "
+                    f"(got min {xi.min()}, max {xi.max()})")
+            x = xi.astype(self.input_dtype)
+        else:
+            x = np.asarray(x, self.input_dtype)
         if x.shape[1:] != self.input_shape:
             raise ValueError(
                 f"input shape {x.shape[1:]} != expected {self.input_shape}")
@@ -93,7 +110,7 @@ class RestfulServer(Logger):
             if valid < bs:  # pad to the compiled batch size
                 chunk = np.concatenate(
                     [chunk, np.zeros((bs - valid,) + self.input_shape,
-                                     np.float32)])
+                                     self.input_dtype)])
             y = np.asarray(self.predict_fn(
                 self.wstate, {"@input": chunk}))[:valid]
             outs.append(y)

@@ -343,15 +343,20 @@ class Workflow(Logger):
 
         return jax.jit(step) if jit else step
 
+    def default_output(self) -> str:
+        """Name of the last forward (non-evaluator) unit — the chain's
+        natural prediction head (shared by predict/serve/decode)."""
+        cands = [u.name for u in self.topo_order()
+                 if not getattr(u, "is_evaluator", False)]
+        if not cands:
+            raise WorkflowError("no forward units")
+        return cands[-1]
+
     def make_predict_step(self, output_unit: Optional[str] = None, *,
                           jit: bool = True) -> Callable:
         """(wstate, batch) -> output of the last forward (or named) unit."""
         if output_unit is None:
-            cands = [u.name for u in self.topo_order()
-                     if not getattr(u, "is_evaluator", False)]
-            if not cands:
-                raise WorkflowError("no forward units")
-            output_unit = cands[-1]
+            output_unit = self.default_output()
         needed = self.ancestors(output_unit)
 
         def step(wstate, batch):
