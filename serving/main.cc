@@ -70,8 +70,9 @@ int main(int argc, char** argv) {
   if (generate == 0 &&
       (temperature > 0.f || top_k > 0 || top_p > 0.f || seed != 0)) {
     std::fprintf(stderr,
-                 "error: --temperature/--top-k/--seed shape --generate "
-                 "decoding; they have no effect on a forward run\n");
+                 "error: --temperature/--top-k/--top-p/--seed shape "
+                 "--generate decoding; they have no effect on a "
+                 "forward run\n");
     return 2;
   }
 

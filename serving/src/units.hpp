@@ -798,20 +798,6 @@ class RecurrentUnit : public Unit {  // RNN / GRU / LSTM inference
       std::copy(h.begin(), h.end(), out->data);
   }
 
-  // One decode position with EXTERNALLY carried state (Generate): the
-  // O(1)-state counterpart of runtime/generate.py's _rec_decode_step.
-  // x: (B, F) activation at this position (a (B, 1, F) buffer is the
-  // same bytes); h/(c for LSTM): (B, H) persistent across positions.
-  void DecodeStep(const float* x, float* out, int64_t B, int64_t F,
-                  std::vector<float>* h, std::vector<float>* c,
-                  ThreadPool* pool) const {
-    CheckWeights(F);
-    Scratch scr(B, hidden, kind);
-    StepBody(x, B, F, h, c, &scr, pool);
-    std::copy(h->begin(), h->end(), out);
-  }
-
- private:
   struct Scratch {  // per-step work buffers, allocated once per call site
     std::vector<float> gates, rh, cand;
     Scratch(int64_t B, int64_t H, int kind)
@@ -820,6 +806,26 @@ class RecurrentUnit : public Unit {  // RNN / GRU / LSTM inference
           cand(kind == 1 ? B * H : 0) {}
   };
 
+  // One decode position with EXTERNALLY carried state (Generate): the
+  // O(1)-state counterpart of runtime/generate.py's _rec_decode_step.
+  // x: (B, F) activation at this position (a (B, 1, F) buffer is the
+  // same bytes); h/(c for LSTM): (B, H) persistent across positions.
+  // Callers in a decode loop pass a persistent Scratch to keep the
+  // per-token hot path allocation-free (Generate does).
+  void DecodeStep(const float* x, float* out, int64_t B, int64_t F,
+                  std::vector<float>* h, std::vector<float>* c,
+                  ThreadPool* pool, Scratch* scr = nullptr) const {
+    CheckWeights(F);
+    if (scr == nullptr) {
+      Scratch local(B, hidden, kind);
+      StepBody(x, B, F, h, c, &local, pool);
+    } else {
+      StepBody(x, B, F, h, c, scr, pool);
+    }
+    std::copy(h->begin(), h->end(), out);
+  }
+
+ private:
   void CheckWeights(int64_t F) const {
     int64_t H = hidden, G = kind == 0 ? 1 : (kind == 1 ? 3 : 4);
     if (w.shape[0] != F + H || w.shape[1] != G * H)

@@ -173,7 +173,19 @@ class Workflow {
 
     // per-attention-layer caches + per-recurrent-layer carried state
     struct Cache { std::vector<float> k, v; };
-    struct RecState { std::vector<float> h, c; };
+    struct RecState {
+      std::vector<float> h, c;
+      std::unique_ptr<RecurrentUnit::Scratch> scr;  // hot-loop reuse
+    };
+    // dropless routing is a DECODE-scoped override (capacity is a
+    // training construct); restore on every exit path so later plain
+    // Run() calls on this Workflow keep the exported forward semantics
+    struct DroplessGuard {
+      std::vector<MoEUnit*> units;
+      ~DroplessGuard() {
+        for (auto* m : units) m->decode_dropless = false;
+      }
+    } dropless;
     std::map<const Unit*, Cache> caches;
     std::map<const Unit*, RecState> rec_states;
     for (const auto& u : units_) {
@@ -187,13 +199,15 @@ class Workflow {
         caches[u.get()].k.assign(B * L * a->n_kv_heads * D, 0.f);
         caches[u.get()].v.assign(B * L * a->n_kv_heads * D, 0.f);
       } else if (auto* r = dynamic_cast<RecurrentUnit*>(u.get())) {
-        rec_states[u.get()].h.assign(B * r->hidden, 0.f);
+        RecState& st = rec_states[u.get()];
+        st.h.assign(B * r->hidden, 0.f);
         if (r->kind == 2)  // LSTM carries a cell state too
-          rec_states[u.get()].c.assign(B * r->hidden, 0.f);
+          st.c.assign(B * r->hidden, 0.f);
+        st.scr = std::make_unique<RecurrentUnit::Scratch>(
+            B, r->hidden, r->kind);
       } else if (auto* m = dynamic_cast<MoEUnit*>(u.get())) {
-        m->decode_dropless = true;  // see MoEUnit: capacity is a
-                                    // training construct, decode is
-                                    // dropless
+        m->decode_dropless = true;  // see MoEUnit doc
+        dropless.units.push_back(m);
       }
     }
 
@@ -244,7 +258,7 @@ class Workflow {
           int64_t F = ins[0]->shape.dims.back();
           RecState& st = rec_states[u.get()];
           r->DecodeStep(ins[0]->data, out.data, B, F, &st.h, &st.c,
-                        pool);
+                        pool, st.scr.get());
         } else {
           u->Run(ins, &out, &ctx);
         }

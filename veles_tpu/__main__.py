@@ -740,6 +740,10 @@ def main(argv=None) -> int:
         if args.top_p is not None and not 0.0 < args.top_p <= 1.0:
             raise SystemExit(f"--top-p must be in (0, 1], got "
                              f"{args.top_p}")
+        if args.beams < 1:
+            raise SystemExit(f"--beams must be >= 1, got {args.beams} "
+                             "(a value < 1 would silently fall back to "
+                             "greedy/sampling decode)")
         if args.beams <= 1 and (args.eos_id is not None
                                 or args.length_penalty):
             raise SystemExit(

@@ -331,24 +331,33 @@ class DecodePlan:
 #: Compiled decode runners kept per workflow (LRU): REST clients control
 #: shape/sampling knobs, so an unbounded cache would accumulate one XLA
 #: program per distinct request (compile-amplification + memory leak).
+#: Callers insert only AFTER the first successful execution, so a
+#: trace-time validation error can never cache a broken runner (or
+#: evict good ones).  The lock keeps the pop/re-insert LRU touch atomic
+#: under the REST server's worker threads; duplicate compilation of the
+#: same brand-new shape by two concurrent requests is accepted (results
+#: identical, last insert wins).
 _MAX_RUNNERS = 32
+_runner_lock = __import__("threading").Lock()
 
 
 def _runner_cache(wf, ck):
     """(cache, hit_or_None) with LRU touch on hit."""
-    cache = getattr(wf, "_decode_runners", None)
-    if cache is None:
-        cache = wf._decode_runners = {}
-    run = cache.pop(ck, None)
-    if run is not None:
-        cache[ck] = run  # dicts preserve order: re-insert = most recent
-    return cache, run
+    with _runner_lock:
+        cache = getattr(wf, "_decode_runners", None)
+        if cache is None:
+            cache = wf._decode_runners = {}
+        run = cache.pop(ck, None)
+        if run is not None:
+            cache[ck] = run  # dict order: re-insert = most recent
+        return cache, run
 
 
 def _runner_cache_put(cache, ck, run):
-    cache[ck] = run
-    while len(cache) > _MAX_RUNNERS:
-        cache.pop(next(iter(cache)))
+    with _runner_lock:
+        cache[ck] = run
+        while len(cache) > _MAX_RUNNERS:
+            cache.pop(next(iter(cache)))
 
 
 def sample_logits(logits, key, *, temperature: float = 0.0,
@@ -451,8 +460,9 @@ def generate(wf, wstate, prompt, n_steps: int, *,
             body, (caches, toks), jnp.arange(L - 1))
         return toks
 
-    _runner_cache_put(cache, ck, run)
-    return run(params, prompt, key)
+    out = run(params, prompt, key)
+    _runner_cache_put(cache, ck, run)  # only successful runners cache
+    return out
 
 
 def generate_beam(wf, wstate, prompt, n_steps: int, *, beams: int = 4,
@@ -580,5 +590,6 @@ def generate_beam(wf, wstate, prompt, n_steps: int, *, beams: int = 4,
             toks_bw, best[:, None, None].repeat(L, -1), 1)[:, 0]
         return out, jnp.take_along_axis(scores_bw, best[:, None], 1)[:, 0]
 
-    _runner_cache_put(cache, ck, run)
-    return run(params, prompt)
+    out = run(params, prompt)
+    _runner_cache_put(cache, ck, run)  # only successful runners cache
+    return out
