@@ -100,6 +100,27 @@ np.testing.assert_allclose(np.asarray(gk), np.asarray(rk),
                            rtol=2e-3, atol=2e-4)
 np.testing.assert_allclose(np.asarray(gv), np.asarray(rv),
                            rtol=2e-3, atol=2e-4)
+
+# round-4: attention autotune measures ON CHIP and persists a winner
+import tempfile
+from veles_tpu.config import root
+from veles_tpu.runtime import autotune as _at
+import veles_tpu as vt
+from veles_tpu.units.parallel_nn import MultiHeadAttention
+_tmp = tempfile.mkdtemp()
+root.common.autotune = True
+root.common.cache_dir = _tmp
+_at._memo.clear()
+u = MultiHeadAttention(4, name="smoke_attn", rope=True, residual=True)
+u.prepare([vt.Spec((2, 256, 256), jnp.bfloat16)])
+assert u._resolved_flash in (True, False), u._resolved_flash
+import json as _json, os as _os
+_db = _json.load(open(_os.path.join(_tmp, "device_infos.json")))
+assert any(k.startswith("attention_fwd_bwd")
+           for kind in _db for k in _db[kind].get("autotune", {}))
+print("attention autotune winner:",
+      "flash" if u._resolved_flash else "xla")
+
 print("TPU_SMOKE_OK")
 """
 
