@@ -337,6 +337,25 @@ def test_beam_finds_global_optimum(rng):
     assert best_lp >= seq_logp(tuple(int(t) for t in greedy)) - 1e-6
 
 
+def test_beam_score_monotone_in_width(rng):
+    """Fixed-length beam search keeps the W best prefixes at every
+    expansion, and the W2 > W1 survivor set contains the W1 one — so the
+    returned best score must be non-decreasing in width (and hits the
+    brute-force optimum once the width covers the space)."""
+    from veles_tpu.runtime.generate import generate_beam
+    B, P, V, N = 2, 4, 8, 4
+    for case in ("plain", "gru_lstm_stacked"):
+        wf, ws = _build_lm(CASES[case](V), B, P, V, seed=11)
+        prompt = rng.integers(0, V, (B, P)).astype(np.int32)
+        prev = None
+        for W in (1, 2, 4, 16, 64):
+            _, scores = generate_beam(wf, ws, prompt, N, beams=W)
+            s = np.asarray(scores)
+            if prev is not None:
+                assert np.all(s >= prev - 1e-5), (case, W, s, prev)
+            prev = s
+
+
 def test_beam_eos_freezes_and_pads(rng):
     from veles_tpu.runtime.generate import generate_beam
     B, P, V, N = 2, 3, 8, 8
