@@ -31,7 +31,8 @@ int main(int argc, char** argv) {
   std::string pkg = argv[1], in_path = argv[2], out_path = argv[3];
   std::string output_unit;
   int threads = 0, repeat = 1, generate = 0, top_k = 0;
-  float temperature = 0.f, top_p = 0.f;
+  int beams = 1, eos_id = -1;
+  float temperature = 0.f, top_p = 0.f, length_penalty = 0.f;
   bool top_p_given = false;
   long long seed = 0;
   for (int i = 4; i < argc; i++) {
@@ -53,6 +54,12 @@ int main(int argc, char** argv) {
       top_p = std::atof(argv[++i]);
       top_p_given = true;
     }
+    else if (!std::strcmp(argv[i], "--beams") && i + 1 < argc)
+      beams = std::atoi(argv[++i]);
+    else if (!std::strcmp(argv[i], "--eos-id") && i + 1 < argc)
+      eos_id = std::atoi(argv[++i]);
+    else if (!std::strcmp(argv[i], "--length-penalty") && i + 1 < argc)
+      length_penalty = std::atof(argv[++i]);
   }
   if ((top_k > 0 || top_p_given) && temperature <= 0.f) {
     // same contract as the Python CLI: the filters apply to SAMPLING
@@ -67,8 +74,26 @@ int main(int argc, char** argv) {
     std::fprintf(stderr, "error: --top-p must be in (0, 1]\n");
     return 2;
   }
+  if (beams < 1) {
+    std::fprintf(stderr, "error: --beams must be >= 1\n");
+    return 2;
+  }
+  if (beams > 1 && (temperature > 0.f || seed != 0)) {
+    std::fprintf(stderr,
+                 "error: --beams is deterministic search; drop "
+                 "--temperature/--top-k/--top-p/--seed or use "
+                 "--beams 1\n");
+    return 2;
+  }
+  if (beams <= 1 && (eos_id >= 0 || length_penalty != 0.f)) {
+    std::fprintf(stderr,
+                 "error: --eos-id/--length-penalty shape BEAM scores "
+                 "and need --beams > 1\n");
+    return 2;
+  }
   if (generate == 0 &&
-      (temperature > 0.f || top_k > 0 || top_p > 0.f || seed != 0)) {
+      (temperature > 0.f || top_k > 0 || top_p > 0.f || seed != 0 ||
+       beams > 1)) {
     std::fprintf(stderr,
                  "error: --temperature/--top-k/--top-p/--seed shape "
                  "--generate decoding; they have no effect on a "
@@ -91,13 +116,28 @@ int main(int argc, char** argv) {
             "--output-unit is not supported with --generate (decoding "
             "always samples from the chain's final head)");
       auto t0 = std::chrono::steady_clock::now();
+      std::vector<float> beam_scores;
       veles::Tensor toks =
-          wf.Generate(input, generate, &pool, temperature, top_k,
-                      static_cast<uint64_t>(seed), top_p);
+          beams > 1
+              ? wf.GenerateBeam(input, generate, &pool, beams, eos_id,
+                                length_penalty, &beam_scores)
+              : wf.Generate(input, generate, &pool, temperature, top_k,
+                            static_cast<uint64_t>(seed), top_p);
       auto t1 = std::chrono::steady_clock::now();
       double ms =
           std::chrono::duration<double, std::milli>(t1 - t0).count();
       veles::npy::Save(out_path, toks.shape.dims, toks.data);
+      std::string scores_json;
+      if (beams > 1) {
+        scores_json = ", \"scores\": [";
+        for (size_t i = 0; i < beam_scores.size(); i++) {
+          char buf[32];
+          std::snprintf(buf, sizeof buf, "%s%.4f", i ? ", " : "",
+                        beam_scores[i]);
+          scores_json += buf;
+        }
+        scores_json += "]";
+      }
       // positions_per_sec is the raw cached-step rate (prefill + decode);
       // tokens_per_sec counts NEW tokens only but the wall time includes
       // prefilling the prompt — same convention as bench_lm.py.
@@ -105,14 +145,15 @@ int main(int argc, char** argv) {
       std::fprintf(
           stderr,
           "{\"workflow\": \"%s\", \"mode\": \"generate\", \"steps\": %d, "
+          "\"beams\": %d, "
           "\"total_ms\": %.3f, \"tokens_per_sec\": %.1f, "
           "\"positions_per_sec\": %.1f, \"threads\": %d, "
           "\"note\": \"tokens_per_sec counts new tokens; wall time "
-          "includes prompt prefill\"}\n",
-          wf.name.c_str(), generate, ms,
+          "includes prompt prefill\"%s}\n",
+          wf.name.c_str(), generate, beams, ms,
           generate * input.shape[0] * 1e3 / ms,
           static_cast<double>(n_pos) * input.shape[0] * 1e3 / ms,
-          pool.size());
+          pool.size(), scores_json.c_str());
       return 0;
     }
     veles::Tensor out;

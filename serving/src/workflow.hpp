@@ -153,11 +153,11 @@ class Workflow {
   // plain Run() here would silently RESET its state every position.
   // temperature <= 0: greedy (golden-matches the JAX generate()).
   // temperature > 0: temperature-scaled categorical sampling, optionally
-  // restricted to the top_k logits — seeded per (seed, position, row)
-  // so runs are reproducible.  The sampler RNG is the C++ runtime's own
-  // (std::mt19937_64); it intentionally does NOT mirror JAX's threefry
-  // stream, so sampled continuations are runtime-specific (greedy is
-  // the cross-runtime golden contract).
+  // restricted to the top_k logits / the top_p nucleus — seeded per
+  // (seed, position, row) so runs are reproducible.  The sampler RNG is
+  // the C++ runtime's own (std::mt19937_64); it intentionally does NOT
+  // mirror JAX's threefry stream, so sampled continuations are
+  // runtime-specific (greedy is the cross-runtime golden contract).
   Tensor Generate(const Tensor& prompt, int n_steps, ThreadPool* pool,
                   float temperature = 0.f, int top_k = 0,
                   uint64_t seed = 0, float top_p = 0.f) {
@@ -165,74 +165,8 @@ class Workflow {
       throw std::runtime_error("generate: prompt must be (batch, time)");
     int64_t B = prompt.shape[0], P = prompt.shape[1];
     int64_t L = P + n_steps;
-    if (units_.empty() ||
-        dynamic_cast<EmbeddingUnit*>(units_[0].get()) == nullptr)
-      throw std::runtime_error(
-          "generate: the first unit must be an Embedding (token ids are "
-          "the decode interface)");
-
-    // per-attention-layer caches + per-recurrent-layer carried state
-    struct Cache { std::vector<float> k, v; };
-    struct RecState {
-      std::vector<float> h, c;
-      std::unique_ptr<RecurrentUnit::Scratch> scr;  // hot-loop reuse
-    };
-    // dropless routing is a DECODE-scoped override (capacity is a
-    // training construct); restore on every exit path so later plain
-    // Run() calls on this Workflow keep the exported forward semantics
-    struct DroplessGuard {
-      std::vector<MoEUnit*> units;
-      ~DroplessGuard() {
-        for (auto* m : units) m->decode_dropless = false;
-      }
-    } dropless;
-    std::map<const Unit*, Cache> caches;
-    std::map<const Unit*, RecState> rec_states;
-    for (const auto& u : units_) {
-      if (auto* a = dynamic_cast<AttentionUnit*>(u.get())) {
-        if (!a->causal)
-          throw std::runtime_error(
-              "generate: attention unit " + u->name + " is non-causal; "
-              "autoregressive decoding requires causal attention "
-              "(mirrors the Python-side check)");
-        int64_t D = a->wq.shape[1] / a->n_heads;
-        caches[u.get()].k.assign(B * L * a->n_kv_heads * D, 0.f);
-        caches[u.get()].v.assign(B * L * a->n_kv_heads * D, 0.f);
-      } else if (auto* r = dynamic_cast<RecurrentUnit*>(u.get())) {
-        RecState& st = rec_states[u.get()];
-        st.h.assign(B * r->hidden, 0.f);
-        if (r->kind == 2)  // LSTM carries a cell state too
-          st.c.assign(B * r->hidden, 0.f);
-        st.scr = std::make_unique<RecurrentUnit::Scratch>(
-            B, r->hidden, r->kind);
-      } else if (auto* m = dynamic_cast<MoEUnit*>(u.get())) {
-        m->decode_dropless = true;  // see MoEUnit doc
-        dropless.units.push_back(m);
-      }
-    }
-
-    // single-position shapes through the chain (validates decodability)
-    std::map<std::string, Shape> shapes;
-    shapes["@input"] = Shape{{B, 1}};
-    std::map<std::string, Tensor> bufs;
-    {
-      Tensor& t = bufs["@input"];
-      t.own(Shape{{B, 1}});
-    }
-    for (const auto& u : units_) {
-      std::vector<Shape> in_shapes;
-      for (const auto& src : u->inputs) {
-        if (!shapes.count(src))
-          throw std::runtime_error("generate: unit " + u->name +
-                                   " needs missing input " + src);
-        in_shapes.push_back(shapes[src]);
-      }
-      Shape os = u->OutputShape(in_shapes);
-      shapes[u->name] = os;
-      bufs[u->name].own(os);
-    }
-    const std::string& head = units_.back()->name;
-    int64_t V = shapes[head].dims.back();
+    DecodeSession s = InitDecode(B, L, "generate");
+    const int64_t V = s.V;
 
     Tensor toks;
     toks.own(Shape{{B, L}});
@@ -240,45 +174,18 @@ class Workflow {
       for (int64_t t = 0; t < P; t++)
         toks.data[b * L + t] = prompt.data[b * P + t];
 
-    UnitContext ctx{pool};
     for (int64_t pos = 0; pos + 1 < L; pos++) {
-      Tensor& xin = bufs["@input"];
+      Tensor& xin = s.bufs["@input"];
       for (int64_t b = 0; b < B; b++)
         xin.data[b] = toks.data[b * L + pos];
-      for (const auto& u : units_) {
-        std::vector<const Tensor*> ins;
-        for (const auto& src : u->inputs) ins.push_back(&bufs[src]);
-        Tensor& out = bufs[u->name];
-        if (auto* a = dynamic_cast<AttentionUnit*>(u.get())) {
-          int64_t E = ins[0]->shape.dims.back();
-          Cache& c = caches[u.get()];
-          a->DecodeStep(ins[0]->data, out.data, B, E, pos, L, &c.k,
-                        &c.v, pool);
-        } else if (auto* r = dynamic_cast<RecurrentUnit*>(u.get())) {
-          int64_t F = ins[0]->shape.dims.back();
-          RecState& st = rec_states[u.get()];
-          r->DecodeStep(ins[0]->data, out.data, B, F, &st.h, &st.c,
-                        pool, st.scr.get());
-        } else {
-          u->Run(ins, &out, &ctx);
-        }
-      }
-      // next token: greedy argmax, or seeded temperature/top-k sampling
-      const Tensor& logits = bufs[head];
-      // exported packages usually end in the evaluator-derived
-      // SoftmaxUnit, which emits PROBABILITIES; temperature math needs
-      // the log domain or the distribution flattens to near-uniform
-      // (the JAX sample_logits sees pre-softmax logits)
-      const bool head_probs =
-          dynamic_cast<SoftmaxUnit*>(units_.back().get()) != nullptr;
+      ChainStep(s, B, pos, L, pool);
+      // next token: greedy argmax, or seeded temperature/top-k/top-p
+      // sampling over true log-probs (ChainStep exposes the pre-softmax
+      // logits even when the exported head emits probabilities)
+      const Tensor& logits = s.bufs[s.logits_src];
       for (int64_t b = 0; b < B; b++) {
         if (pos + 1 < P) continue;  // teacher-forced prompt positions
         const float* row = logits.data + b * V;
-        auto lg = [&](int64_t o) -> double {
-          if (!head_probs) return row[o];
-          return row[o] > 0 ? std::log(static_cast<double>(row[o]))
-                            : -std::numeric_limits<double>::infinity();
-        };
         int64_t best = 0;
         for (int64_t o = 1; o < V; o++)
           if (row[o] > row[best]) best = o;
@@ -287,8 +194,7 @@ class Workflow {
           // top-k threshold: k-th largest logit (k<=0 disables)
           double thresh = -std::numeric_limits<double>::infinity();
           if (top_k > 0 && top_k < V) {
-            std::vector<double> sorted(V);
-            for (int64_t o = 0; o < V; o++) sorted[o] = lg(o);
+            std::vector<double> sorted(row, row + V);
             std::nth_element(sorted.begin(),
                              sorted.begin() + (top_k - 1), sorted.end(),
                              std::greater<double>());
@@ -298,8 +204,9 @@ class Workflow {
           double denom = 0;
           std::vector<double> p(V, 0.0);
           for (int64_t o = 0; o < V; o++) {
-            if (lg(o) < thresh) continue;
-            p[o] = std::exp((lg(o) - lg(best)) / temperature);
+            if (double(row[o]) < thresh) continue;
+            p[o] = std::exp((double(row[o]) - double(row[best])) /
+                            temperature);
             denom += p[o];
           }
           if (top_p > 0.f && top_p < 1.f) {
@@ -351,7 +258,301 @@ class Workflow {
     return toks;
   }
 
+  // Deterministic beam-search decode — the counterpart of the JAX
+  // generate_beam (no RNG in the loop, so tokens golden-match across
+  // runtimes on non-degenerate models; exact score TIES can resolve
+  // differently under float rounding — both sides then break toward the
+  // lowest flat candidate index, minimizing divergence).  Returns the
+  // best beam per batch row (B, P + n_steps); per-row normalized scores
+  // are written to *scores_out when non-null.  Contract mirrors the
+  // Python side: scores are the GENERATED continuation's summed token
+  // log-probs (log-softmax over the pre-softmax logits; the prompt's
+  // log-prob is a per-row constant and excluded), normalized by
+  // gen_len ** length_penalty; eos_id >= 0 freezes finished beams
+  // (they pad with eos and their normalization length stops there).
+  Tensor GenerateBeam(const Tensor& prompt, int n_steps,
+                      ThreadPool* pool, int beams, int eos_id = -1,
+                      float length_penalty = 0.f,
+                      std::vector<float>* scores_out = nullptr) {
+    if (prompt.shape.rank() != 2)
+      throw std::runtime_error("beam: prompt must be (batch, time)");
+    if (beams < 1)
+      throw std::runtime_error("beam: beams must be >= 1");
+    int64_t B = prompt.shape[0], P = prompt.shape[1];
+    int64_t W = beams, BW = B * W, L = P + n_steps;
+    DecodeSession s = InitDecode(BW, L, "beam");
+    const int64_t V = s.V;
+    if (eos_id >= V)
+      throw std::runtime_error(
+          "beam: --eos-id " + std::to_string(eos_id) +
+          " is outside the model vocabulary (V=" + std::to_string(V) +
+          "); it could never fire and would silently disable eos "
+          "freezing");
+    const double NEG = -1e30;
+
+    Tensor toks;
+    toks.own(Shape{{BW, L}});
+    for (int64_t b = 0; b < B; b++)
+      for (int64_t w = 0; w < W; w++)
+        for (int64_t t = 0; t < P; t++)
+          toks.data[(b * W + w) * L + t] = prompt.data[b * P + t];
+    std::vector<double> scores(BW);
+    for (int64_t bw = 0; bw < BW; bw++)
+      scores[bw] = (bw % W == 0) ? 0.0 : NEG;
+    std::vector<char> alive(BW, 1);
+
+    // row-gather helper for the beam reorder (parents may repeat, so
+    // gather into a scratch copy first; the scratch is hoisted out of
+    // the hot loop and reused across steps/caches)
+    std::vector<float> gather_tmp;
+    auto gather_rows = [&gather_tmp](std::vector<float>& a,
+                                     int64_t rowlen,
+                                     const std::vector<int64_t>& parent) {
+      gather_tmp.resize(parent.size() * rowlen);
+      for (size_t i = 0; i < parent.size(); i++)
+        std::copy(a.begin() + parent[i] * rowlen,
+                  a.begin() + (parent[i] + 1) * rowlen,
+                  gather_tmp.begin() + i * rowlen);
+      a.swap(gather_tmp);
+    };
+
+    std::vector<double> logp(BW * V);
+    std::vector<int64_t> parent(BW), nxt(BW);
+    std::vector<double> nscore(BW);
+    std::vector<std::pair<double, int64_t>> cand;
+    cand.reserve(W * V);
+    for (int64_t pos = 0; pos + 1 < L; pos++) {
+      Tensor& xin = s.bufs["@input"];
+      for (int64_t bw = 0; bw < BW; bw++)
+        xin.data[bw] = toks.data[bw * L + pos];
+      ChainStep(s, BW, pos, L, pool);
+      if (pos + 1 < P) continue;  // teacher-forced prefill: no scoring
+
+      // per-row token log-probs: log-softmax over the pre-softmax
+      // logits (ChainStep exposes them even when the exported head
+      // emits probabilities — log(f32 probs) would hit the underflow
+      // cliff ~88 nats below the max and kill beams JAX keeps)
+      const Tensor& logits = s.bufs[s.logits_src];
+      for (int64_t bw = 0; bw < BW; bw++) {
+        const float* row = logits.data + bw * V;
+        double* lp = logp.data() + bw * V;
+        if (eos_id >= 0 && !alive[bw]) {
+          for (int64_t o = 0; o < V; o++) lp[o] = NEG;
+          lp[eos_id] = 0.0;  // frozen beams extend only with eos, free
+          continue;
+        }
+        double m = row[0];
+        for (int64_t o = 1; o < V; o++) m = std::max(m, double(row[o]));
+        double sum = 0;
+        for (int64_t o = 0; o < V; o++) sum += std::exp(row[o] - m);
+        double lse = m + std::log(sum);
+        for (int64_t o = 0; o < V; o++) lp[o] = row[o] - lse;
+      }
+
+      // expand: top W of the W*V candidates per batch row; ties break
+      // toward the lowest flat index, matching jax.lax.top_k
+      for (int64_t b = 0; b < B; b++) {
+        cand.clear();  // hoisted (score, w*V+o) buffer, capacity kept
+        for (int64_t w = 0; w < W; w++) {
+          int64_t bw = b * W + w;
+          const double* lp = logp.data() + bw * V;
+          for (int64_t o = 0; o < V; o++)
+            cand.emplace_back(scores[bw] + lp[o], w * V + o);
+        }
+        std::partial_sort(cand.begin(), cand.begin() + W, cand.end(),
+                          [](const auto& x, const auto& y) {
+                            return x.first > y.first ||
+                                   (x.first == y.first &&
+                                    x.second < y.second);
+                          });
+        for (int64_t w = 0; w < W; w++) {
+          parent[b * W + w] = b * W + cand[w].second / V;
+          nxt[b * W + w] = cand[w].second % V;
+          nscore[b * W + w] = cand[w].first;
+        }
+      }
+      // reorder every beam-carried row by parent, then append tokens
+      gather_rows(toks.storage, L, parent);
+      toks.data = toks.storage.data();
+      for (auto& kv : s.caches) {
+        gather_rows(kv.second.k, kv.second.row, parent);
+        gather_rows(kv.second.v, kv.second.row, parent);
+      }
+      for (auto& kv : s.rec_states) {
+        int64_t H =
+            dynamic_cast<const RecurrentUnit*>(kv.first)->hidden;
+        gather_rows(kv.second.h, H, parent);
+        if (!kv.second.c.empty()) gather_rows(kv.second.c, H, parent);
+      }
+      if (eos_id >= 0) {
+        std::vector<char> na(BW);
+        for (int64_t bw = 0; bw < BW; bw++)
+          na[bw] = alive[parent[bw]] && nxt[bw] != eos_id;
+        alive.swap(na);
+      }
+      for (int64_t bw = 0; bw < BW; bw++) {
+        scores[bw] = nscore[bw];
+        toks.data[bw * L + pos + 1] = static_cast<float>(nxt[bw]);
+      }
+    }
+
+    // best beam per row under GNMT length normalization
+    Tensor out;
+    out.own(Shape{{B, L}});
+    if (scores_out != nullptr) scores_out->assign(B, 0.f);
+    for (int64_t b = 0; b < B; b++) {
+      int64_t best_w = 0;
+      double best_s = -std::numeric_limits<double>::infinity();
+      for (int64_t w = 0; w < W; w++) {
+        int64_t bw = b * W + w;
+        double sc = scores[bw];
+        if (length_penalty != 0.f) {
+          int64_t gen_len = L - P;
+          if (eos_id >= 0) {
+            for (int64_t t = P; t < L; t++)
+              if (static_cast<int64_t>(toks.data[bw * L + t]) ==
+                  eos_id) {
+                gen_len = t - P + 1;
+                break;
+              }
+          }
+          sc /= std::pow(double(gen_len), double(length_penalty));
+        }
+        if (sc > best_s) { best_s = sc; best_w = w; }
+      }
+      std::copy(toks.data + (b * W + best_w) * L,
+                toks.data + (b * W + best_w + 1) * L,
+                out.data + b * L);
+      if (scores_out != nullptr)
+        (*scores_out)[b] = static_cast<float>(best_s);
+    }
+    return out;
+  }
+
  private:
+  // Shared decode-session state for Generate/GenerateBeam: ONE init and
+  // ONE per-position chain step, so cache/state handling cannot drift
+  // between the two decode engines.
+  struct DecodeSession {
+    struct Cache { std::vector<float> k, v; int64_t row; };
+    struct RecState {
+      std::vector<float> h, c;
+      std::unique_ptr<RecurrentUnit::Scratch> scr;
+    };
+    struct DroplessGuard {
+      std::vector<MoEUnit*> units;
+      DroplessGuard() = default;
+      DroplessGuard(const DroplessGuard&) = delete;
+      DroplessGuard& operator=(const DroplessGuard&) = delete;
+      ~DroplessGuard() {
+        for (auto* m : units) m->decode_dropless = false;
+      }
+    };
+    // unique_ptr: DecodeSession is returned by value, and a moved-from
+    // guard must not fire its restore early (NRVO is optional)
+    std::unique_ptr<DroplessGuard> dropless =
+        std::make_unique<DroplessGuard>();
+    std::map<const Unit*, Cache> caches;
+    std::map<const Unit*, RecState> rec_states;
+    std::map<std::string, Shape> shapes;
+    std::map<std::string, Tensor> bufs;
+    int64_t V = 0;
+    // buffer holding the PRE-softmax logits: the exported head is
+    // usually the evaluator-derived SoftmaxUnit (emits probabilities),
+    // whose INPUT buffer carries the logits the JAX decode scores with
+    std::string logits_src;
+  };
+
+  DecodeSession InitDecode(int64_t rows, int64_t L, const char* what) {
+    if (units_.empty() ||
+        dynamic_cast<EmbeddingUnit*>(units_[0].get()) == nullptr)
+      throw std::runtime_error(
+          std::string(what) + ": the first unit must be an Embedding "
+          "(token ids are the decode interface)");
+    DecodeSession s;
+    for (const auto& u : units_) {
+      if (auto* a = dynamic_cast<AttentionUnit*>(u.get())) {
+        if (!a->causal)
+          throw std::runtime_error(
+              std::string(what) + ": attention unit " + u->name +
+              " is non-causal; autoregressive decoding requires causal "
+              "attention (mirrors the Python-side check)");
+        int64_t D = a->wq.shape[1] / a->n_heads;
+        DecodeSession::Cache& c = s.caches[u.get()];
+        c.row = L * a->n_kv_heads * D;  // per-row contiguous block
+        c.k.assign(rows * c.row, 0.f);
+        c.v.assign(rows * c.row, 0.f);
+      } else if (auto* r = dynamic_cast<RecurrentUnit*>(u.get())) {
+        DecodeSession::RecState& st = s.rec_states[u.get()];
+        st.h.assign(rows * r->hidden, 0.f);
+        if (r->kind == 2)  // LSTM carries a cell state too
+          st.c.assign(rows * r->hidden, 0.f);
+        st.scr = std::make_unique<RecurrentUnit::Scratch>(
+            rows, r->hidden, r->kind);
+      } else if (auto* m = dynamic_cast<MoEUnit*>(u.get())) {
+        m->decode_dropless = true;  // see MoEUnit doc; guard restores
+        s.dropless->units.push_back(m);
+      }
+    }
+    // single-position shapes through the chain (validates decodability)
+    s.shapes["@input"] = Shape{{rows, 1}};
+    s.bufs["@input"].own(Shape{{rows, 1}});
+    for (const auto& u : units_) {
+      std::vector<Shape> in_shapes;
+      for (const auto& src : u->inputs) {
+        if (!s.shapes.count(src))
+          throw std::runtime_error(std::string(what) + ": unit " +
+                                   u->name + " needs missing input " +
+                                   src);
+        in_shapes.push_back(s.shapes[src]);
+      }
+      s.shapes[u->name] = u->OutputShape(in_shapes);
+      s.bufs[u->name].own(s.shapes[u->name]);
+    }
+    const std::string& head = units_.back()->name;
+    s.V = s.shapes[head].dims.back();
+    const bool head_probs =
+        dynamic_cast<SoftmaxUnit*>(units_.back().get()) != nullptr;
+    s.logits_src = head;
+    if (head_probs && !units_.back()->inputs.empty()) {
+      const std::string& src = units_.back()->inputs[0];
+      const bool batch_key = src.rfind("@", 0) == 0;
+      if (!batch_key && s.shapes.count(src) &&
+          s.shapes[src].dims.back() == s.V)
+        s.logits_src = src;
+    }
+    return s;
+  }
+
+  // One decode position: run every unit on (rows, 1) inputs against the
+  // session's caches/carried state.
+  void ChainStep(DecodeSession& s, int64_t rows, int64_t pos, int64_t L,
+                 ThreadPool* pool) {
+    UnitContext ctx{pool};
+    // when the sampler reads the softmax head's INPUT (logits_src
+    // remap), the head's probability output is dead work — skip it
+    const bool skip_head = s.logits_src != units_.back()->name;
+    for (const auto& u : units_) {
+      if (skip_head && u.get() == units_.back().get()) continue;
+      std::vector<const Tensor*> ins;
+      for (const auto& src : u->inputs) ins.push_back(&s.bufs[src]);
+      Tensor& out = s.bufs[u->name];
+      if (auto* a = dynamic_cast<AttentionUnit*>(u.get())) {
+        int64_t E = ins[0]->shape.dims.back();
+        DecodeSession::Cache& c = s.caches[u.get()];
+        a->DecodeStep(ins[0]->data, out.data, rows, E, pos, L, &c.k,
+                      &c.v, pool);
+      } else if (auto* r = dynamic_cast<RecurrentUnit*>(u.get())) {
+        int64_t F = ins[0]->shape.dims.back();
+        DecodeSession::RecState& st = s.rec_states[u.get()];
+        r->DecodeStep(ins[0]->data, out.data, rows, F, &st.h, &st.c,
+                      pool, st.scr.get());
+      } else {
+        u->Run(ins, &out, &ctx);
+      }
+    }
+  }
+
   std::vector<UnitPtr> units_;
   std::vector<float> arena_;
   int64_t arena_floats_ = 0;

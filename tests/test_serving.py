@@ -457,6 +457,74 @@ def test_cpp_generate_sampling(binary, tmp_path, rng):
         (counts / n_trials, probs[0])
 
 
+@pytest.mark.parametrize("chain", ["attn", "recurrent"])
+def test_cpp_beam_matches_jax(binary, tmp_path, rng, chain):
+    """veles_serve --beams: deterministic beam search golden-matches the
+    JAX generate_beam token-for-token (no RNG in the loop), including
+    eos freezing + GNMT length normalization; beams=1 equals greedy."""
+    from veles_tpu.runtime.generate import generate, generate_beam
+    V, T, N, W = 11, 5, 8, 4
+    layers = {
+        "attn": [
+            {"type": "embedding", "vocab": V, "dim": 12, "name": "emb"},
+            {"type": "attention", "n_heads": 2, "rope": True,
+             "residual": True, "name": "a1"},
+            {"type": "layer_norm", "name": "n1"},
+            {"type": "seq_last", "name": "last"},
+            {"type": "softmax", "output_size": V, "name": "out"},
+        ],
+        "recurrent": [
+            {"type": "embedding", "vocab": V, "dim": 12, "name": "emb"},
+            {"type": "gru", "hidden": 12, "name": "g1"},
+            {"type": "lstm", "hidden": 12, "name": "l1"},
+            {"type": "seq_last", "name": "last"},
+            {"type": "softmax", "output_size": V, "name": "out"},
+        ],
+    }[chain]
+    wf = build_workflow(f"beam_{chain}", layers)
+    wf.build({"@input": vt.Spec((2, T), jnp.int32),
+              "@labels": vt.Spec((2,), jnp.int32),
+              "@mask": vt.Spec((2,), jnp.float32)})
+    ws = wf.init_state(jax.random.key(37), opt.SGD(0.01))
+    pkg = str(tmp_path / "beam_pkg")
+    export_package(wf, ws, pkg,
+                   input_spec={"shape": [2, T], "dtype": "float32"})
+    prompt = rng.integers(0, V, (2, T)).astype(np.int32)
+    np.save(tmp_path / "bp.npy", prompt.astype(np.float32))
+
+    def serve(name, *extra):
+        r = subprocess.run(
+            [binary, pkg, str(tmp_path / "bp.npy"),
+             str(tmp_path / name), "--generate", str(N), *extra],
+            capture_output=True, text=True, timeout=120)
+        assert r.returncode == 0, r.stderr
+        return np.load(tmp_path / name).astype(np.int32)
+
+    ref_toks, ref_scores = generate_beam(wf, ws, prompt, N, beams=W)
+    got = serve("b.npy", "--beams", str(W))
+    np.testing.assert_array_equal(got, np.asarray(ref_toks),
+                                  err_msg=chain)
+
+    # beams=1 is greedy in both runtimes
+    g1 = serve("b1.npy", "--beams", "1")
+    np.testing.assert_array_equal(
+        g1, np.asarray(generate(wf, ws, prompt, N)))
+
+    # eos + length penalty path agrees too
+    rt, _ = generate_beam(wf, ws, prompt, N, beams=W, eos_id=0,
+                          length_penalty=0.6)
+    ge = serve("be.npy", "--beams", str(W), "--eos-id", "0",
+               "--length-penalty", "0.6")
+    np.testing.assert_array_equal(ge, np.asarray(rt), err_msg=chain)
+
+    # contract checks
+    r = subprocess.run(
+        [binary, pkg, str(tmp_path / "bp.npy"), str(tmp_path / "x.npy"),
+         "--generate", str(N), "--beams", "4", "--temperature", "1.0"],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode != 0 and "deterministic" in r.stderr
+
+
 def test_cpp_moe_generate_matches_jax(binary, tmp_path, rng):
     """veles_serve --generate on a MoE transformer chain: router +
     expert FFN are token-local, so decode runs them per position
