@@ -152,6 +152,28 @@ def test_restful_generate_endpoint(rng):
         with pytest.raises(urllib.error.HTTPError) as ei:
             urllib.request.urlopen(bad)
         assert ei.value.code == 400
+        # beam search over HTTP matches the library generate_beam
+        from veles_tpu.runtime.generate import generate_beam
+        breq = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/generate",
+            json.dumps({"prompt": prompt.tolist(), "steps": 5,
+                        "beams": 4, "eos_id": 0,
+                        "length_penalty": 0.6}).encode(),
+            {"Content-Type": "application/json"})
+        with urllib.request.urlopen(breq) as r:
+            btoks = np.asarray(json.loads(r.read())["tokens"])
+        bref, _ = generate_beam(wf, ws, prompt, 5, beams=4, eos_id=0,
+                                length_penalty=0.6)
+        np.testing.assert_array_equal(btoks, np.asarray(bref))
+        # beams + temperature conflict -> 400
+        conflict = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/generate",
+            json.dumps({"prompt": prompt.tolist(), "steps": 5,
+                        "beams": 4, "temperature": 1.0}).encode(),
+            {"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(conflict)
+        assert ei.value.code == 400
     finally:
         srv.stop()
 

@@ -12,9 +12,12 @@ normalizer state).
 
 Round 4: pass ``workflow=`` to also serve POST /generate
 {"prompt": [[ids]], "steps": N, "temperature": t, "top_k": k,
-"top_p": p} -> {"tokens": [[...]]} — the KV-cached / carried-state
-decode of runtime/generate.py behind HTTP (the reference's RESTful API
-was forward-only; its framework had no sequence models to decode)."""
+"top_p": p, "seed": s} -> {"tokens": [[...]]} — the KV-cached /
+carried-state decode of runtime/generate.py behind HTTP — or
+deterministic beam search with {"beams": W, "eos_id": E,
+"length_penalty": a} -> {"tokens": ..., "scores": [...]} (the
+reference's RESTful API was forward-only; its framework had no
+sequence models to decode)."""
 
 from __future__ import annotations
 
@@ -61,8 +64,7 @@ class RestfulServer(Logger):
                     n = int(self.headers.get("Content-Length", 0))
                     req = json.loads(self.rfile.read(n))
                     if path == "/generate":
-                        self._reply(
-                            {"tokens": outer.decode(req).tolist()})
+                        self._reply(outer.decode(req))
                         return
                     self._reply(
                         {"output": outer.infer(req["input"]).tolist()})
@@ -129,8 +131,9 @@ class RestfulServer(Logger):
                     self.wstate["params"][u.name]["table"].shape[0])
         return None
 
-    def decode(self, req: dict) -> np.ndarray:
-        """POST /generate body -> (B, P + steps) token array."""
+    def decode(self, req: dict) -> dict:
+        """POST /generate body -> {"tokens": [[...]]} (+ "scores" for
+        beam search)."""
         if self.workflow is None:
             raise ValueError(
                 "this server was started without a workflow; /generate "
@@ -151,12 +154,16 @@ class RestfulServer(Logger):
         steps = int(req.get("steps", 16))
         if not 0 < steps <= 65536:
             raise ValueError(f"steps must be in [1, 65536], got {steps}")
-        # bound total decode work/cache memory, not just the step count
+        beams = int(req.get("beams", 1))
+        if beams < 1:
+            raise ValueError(f"beams must be >= 1, got {beams}")
+        # bound total decode work/cache memory, not just the step
+        # count (beam search multiplies every cache by its width)
         B, P = prompt.shape
-        if B * (P + steps) > 1_048_576:
+        if B * beams * (P + steps) > 1_048_576:
             raise ValueError(
-                f"request too large: batch {B} x total length "
-                f"{P + steps} exceeds the 2^20 token-cell cap")
+                f"request too large: batch {B} x beams {beams} x total "
+                f"length {P + steps} exceeds the 2^20 token-cell cap")
         temperature = float(req.get("temperature", 0.0))
         top_k, top_p = req.get("top_k"), req.get("top_p")
         if (top_k is not None or top_p is not None) and temperature <= 0:
@@ -165,12 +172,28 @@ class RestfulServer(Logger):
             raise ValueError(
                 "top_k/top_p filter sampling and need temperature > 0 "
                 "(temperature 0 is greedy decoding)")
+        if beams > 1:
+            if temperature > 0:
+                raise ValueError(
+                    "beams is deterministic search; drop temperature/"
+                    "top_k/top_p or use beams=1")
+            from .generate import generate_beam
+            toks, scores = generate_beam(
+                self.workflow, self.wstate, prompt.astype(np.int32),
+                steps, beams=beams, eos_id=req.get("eos_id"),
+                length_penalty=float(req.get("length_penalty", 0.0)))
+            return {"tokens": np.asarray(toks).tolist(),
+                    "scores": np.asarray(scores).tolist()}
+        if req.get("eos_id") is not None or req.get("length_penalty"):
+            raise ValueError(
+                "eos_id/length_penalty shape BEAM scores and need "
+                "beams > 1")
         import jax
         key = jax.random.key(int(req.get("seed", 0)))
         toks = generate(
             self.workflow, self.wstate, prompt.astype(np.int32), steps,
             temperature=temperature, top_k=top_k, top_p=top_p, key=key)
-        return np.asarray(toks)
+        return {"tokens": np.asarray(toks).tolist()}
 
     def start(self):
         self._thread = threading.Thread(target=self.httpd.serve_forever,
