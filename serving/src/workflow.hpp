@@ -316,12 +316,50 @@ class Workflow {
       a.swap(gather_tmp);
     };
 
+    // prefill ONCE at batch width B and replicate the caches W-fold:
+    // all W beams of a row are identical until the first expansion, so
+    // running the prompt through B*W rows would waste (W-1)/W of the
+    // prefill compute (the JAX version cannot do this — in-place cache
+    // updates under jit — but C++ can). The nested session must NOT
+    // manage the dropless override: its destructor would clear the
+    // outer session's flags mid-decode.
+    int64_t start_pos = 0;
+    if (W > 1 && P > 1) {
+      DecodeSession pre = InitDecode(B, L, "beam", false);
+      for (int64_t pos = 0; pos + 1 < P; pos++) {
+        Tensor& xin = pre.bufs["@input"];
+        for (int64_t b = 0; b < B; b++)
+          xin.data[b] = prompt.data[b * P + pos];
+        ChainStep(pre, B, pos, L, pool);
+      }
+      auto replicate = [&](const std::vector<float>& src,
+                           std::vector<float>& dst, int64_t rowlen) {
+        for (int64_t b = 0; b < B; b++)
+          for (int64_t w = 0; w < W; w++)
+            std::copy(src.begin() + b * rowlen,
+                      src.begin() + (b + 1) * rowlen,
+                      dst.begin() + (b * W + w) * rowlen);
+      };
+      for (auto& kv : s.caches) {
+        const DecodeSession::Cache& pc = pre.caches[kv.first];
+        replicate(pc.k, kv.second.k, kv.second.row);
+        replicate(pc.v, kv.second.v, kv.second.row);
+      }
+      for (auto& kv : s.rec_states) {
+        const DecodeSession::RecState& pr = pre.rec_states[kv.first];
+        replicate(pr.h, kv.second.h, kv.second.row);
+        if (!kv.second.c.empty())
+          replicate(pr.c, kv.second.c, kv.second.row);
+      }
+      start_pos = P - 1;
+    }
+
     std::vector<double> logp(BW * V);
     std::vector<int64_t> parent(BW), nxt(BW);
     std::vector<double> nscore(BW);
     std::vector<std::pair<double, int64_t>> cand;
     cand.reserve(W * V);
-    for (int64_t pos = 0; pos + 1 < L; pos++) {
+    for (int64_t pos = start_pos; pos + 1 < L; pos++) {
       Tensor& xin = s.bufs["@input"];
       for (int64_t bw = 0; bw < BW; bw++)
         xin.data[bw] = toks.data[bw * L + pos];
@@ -379,10 +417,9 @@ class Workflow {
         gather_rows(kv.second.v, kv.second.row, parent);
       }
       for (auto& kv : s.rec_states) {
-        int64_t H =
-            dynamic_cast<const RecurrentUnit*>(kv.first)->hidden;
-        gather_rows(kv.second.h, H, parent);
-        if (!kv.second.c.empty()) gather_rows(kv.second.c, H, parent);
+        gather_rows(kv.second.h, kv.second.row, parent);
+        if (!kv.second.c.empty())
+          gather_rows(kv.second.c, kv.second.row, parent);
       }
       if (eos_id >= 0) {
         std::vector<char> na(BW);
@@ -437,6 +474,7 @@ class Workflow {
     struct Cache { std::vector<float> k, v; int64_t row; };
     struct RecState {
       std::vector<float> h, c;
+      int64_t row = 0;  // hidden size (mirrors Cache::row)
       std::unique_ptr<RecurrentUnit::Scratch> scr;
     };
     struct DroplessGuard {
@@ -463,7 +501,8 @@ class Workflow {
     std::string logits_src;
   };
 
-  DecodeSession InitDecode(int64_t rows, int64_t L, const char* what) {
+  DecodeSession InitDecode(int64_t rows, int64_t L, const char* what,
+                           bool manage_dropless = true) {
     if (units_.empty() ||
         dynamic_cast<EmbeddingUnit*>(units_[0].get()) == nullptr)
       throw std::runtime_error(
@@ -484,14 +523,17 @@ class Workflow {
         c.v.assign(rows * c.row, 0.f);
       } else if (auto* r = dynamic_cast<RecurrentUnit*>(u.get())) {
         DecodeSession::RecState& st = s.rec_states[u.get()];
+        st.row = r->hidden;
         st.h.assign(rows * r->hidden, 0.f);
         if (r->kind == 2)  // LSTM carries a cell state too
           st.c.assign(rows * r->hidden, 0.f);
         st.scr = std::make_unique<RecurrentUnit::Scratch>(
             rows, r->hidden, r->kind);
       } else if (auto* m = dynamic_cast<MoEUnit*>(u.get())) {
-        m->decode_dropless = true;  // see MoEUnit doc; guard restores
-        s.dropless->units.push_back(m);
+        if (manage_dropless) {
+          m->decode_dropless = true;  // see MoEUnit doc; guard restores
+          s.dropless->units.push_back(m);
+        }
       }
     }
     // single-position shapes through the chain (validates decodability)

@@ -525,6 +525,41 @@ def test_cpp_beam_matches_jax(binary, tmp_path, rng, chain):
     assert r.returncode != 0 and "deterministic" in r.stderr
 
 
+def test_cpp_beam_long_prompt_prefill(binary, tmp_path, rng):
+    """The C++ beam prefills ONCE at batch width and replicates the
+    caches W-fold (the JAX version can't — in-place jit updates);
+    a long prompt pins that the replicated state is identical to the
+    all-beams prefill the JAX reference effectively performs."""
+    from veles_tpu.runtime.generate import generate_beam
+    V, T, N, W = 11, 24, 6, 4
+    wf = build_workflow("beam_longp", [
+        {"type": "embedding", "vocab": V, "dim": 12, "name": "emb"},
+        {"type": "attention", "n_heads": 2, "rope": True,
+         "residual": True, "name": "a1"},
+        {"type": "gru", "hidden": 12, "name": "g1"},
+        {"type": "seq_last", "name": "last"},
+        {"type": "softmax", "output_size": V, "name": "out"},
+    ])
+    wf.build({"@input": vt.Spec((2, T), jnp.int32),
+              "@labels": vt.Spec((2,), jnp.int32),
+              "@mask": vt.Spec((2,), jnp.float32)})
+    ws = wf.init_state(jax.random.key(41), opt.SGD(0.01))
+    pkg = str(tmp_path / "beam_lp_pkg")
+    export_package(wf, ws, pkg,
+                   input_spec={"shape": [2, T], "dtype": "float32"})
+    prompt = rng.integers(0, V, (2, T)).astype(np.int32)
+    np.save(tmp_path / "lp.npy", prompt.astype(np.float32))
+    r = subprocess.run(
+        [binary, pkg, str(tmp_path / "lp.npy"),
+         str(tmp_path / "lt.npy"), "--generate", str(N),
+         "--beams", str(W)],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    ref, _ = generate_beam(wf, ws, prompt, N, beams=W)
+    np.testing.assert_array_equal(
+        np.load(tmp_path / "lt.npy").astype(np.int32), np.asarray(ref))
+
+
 def test_cpp_moe_generate_matches_jax(binary, tmp_path, rng):
     """veles_serve --generate on a MoE transformer chain: router +
     expert FFN are token-local, so decode runs them per position
