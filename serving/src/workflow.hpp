@@ -494,6 +494,20 @@ class Workflow {
     std::map<const Unit*, RecState> rec_states;
     std::map<std::string, Shape> shapes;
     std::map<std::string, Tensor> bufs;
+    // flat per-position dispatch plan: unit kind, input/output tensor
+    // pointers, cache/state bindings — resolved ONCE so the decode hot
+    // loop does no map lookups, RTTI casts, or vector allocations per
+    // position (at serving shapes the loop is overhead-bound)
+    struct StepOp {
+      Unit* u = nullptr;
+      int kind = 0;  // 0 plain Run, 1 attention, 2 recurrent
+      std::vector<const Tensor*> ins;
+      Tensor* out = nullptr;
+      Cache* cache = nullptr;
+      RecState* rec = nullptr;
+      int64_t feat = 0;  // trailing input dim (attention E / rec F)
+    };
+    std::vector<StepOp> plan;
     int64_t V = 0;
     // buffer holding the PRE-softmax logits: the exported head is
     // usually the evaluator-derived SoftmaxUnit (emits probabilities),
@@ -563,34 +577,55 @@ class Workflow {
           s.shapes[src].dims.back() == s.V)
         s.logits_src = src;
     }
+    // resolve the flat dispatch plan (std::map node pointers are
+    // stable, so Tensor*/Cache*/RecState* stay valid for the session's
+    // lifetime). When the sampler reads the softmax head's INPUT
+    // (logits_src remap), the head's probability output is dead work —
+    // it is left out of the plan entirely.
+    for (const auto& u : units_) {
+      if (s.logits_src != units_.back()->name &&
+          u.get() == units_.back().get())
+        continue;
+      DecodeSession::StepOp op;
+      op.u = u.get();
+      for (const auto& src : u->inputs)
+        op.ins.push_back(&s.bufs[src]);
+      op.out = &s.bufs[u->name];
+      op.feat = op.ins.empty() ? 0
+                               : op.ins[0]->shape.dims.back();
+      if (s.caches.count(u.get())) {
+        op.kind = 1;
+        op.cache = &s.caches[u.get()];
+      } else if (s.rec_states.count(u.get())) {
+        op.kind = 2;
+        op.rec = &s.rec_states[u.get()];
+      }
+      s.plan.push_back(std::move(op));
+    }
     return s;
   }
 
-  // One decode position: run every unit on (rows, 1) inputs against the
-  // session's caches/carried state.
+  // One decode position: execute the pre-resolved plan on (rows, 1)
+  // inputs against the session's caches/carried state — no map
+  // lookups, RTTI, or allocation in here (serving shapes are small
+  // enough that per-position overhead is measurable).
   void ChainStep(DecodeSession& s, int64_t rows, int64_t pos, int64_t L,
                  ThreadPool* pool) {
     UnitContext ctx{pool};
-    // when the sampler reads the softmax head's INPUT (logits_src
-    // remap), the head's probability output is dead work — skip it
-    const bool skip_head = s.logits_src != units_.back()->name;
-    for (const auto& u : units_) {
-      if (skip_head && u.get() == units_.back().get()) continue;
-      std::vector<const Tensor*> ins;
-      for (const auto& src : u->inputs) ins.push_back(&s.bufs[src]);
-      Tensor& out = s.bufs[u->name];
-      if (auto* a = dynamic_cast<AttentionUnit*>(u.get())) {
-        int64_t E = ins[0]->shape.dims.back();
-        DecodeSession::Cache& c = s.caches[u.get()];
-        a->DecodeStep(ins[0]->data, out.data, rows, E, pos, L, &c.k,
-                      &c.v, pool);
-      } else if (auto* r = dynamic_cast<RecurrentUnit*>(u.get())) {
-        int64_t F = ins[0]->shape.dims.back();
-        DecodeSession::RecState& st = s.rec_states[u.get()];
-        r->DecodeStep(ins[0]->data, out.data, rows, F, &st.h, &st.c,
-                      pool, st.scr.get());
-      } else {
-        u->Run(ins, &out, &ctx);
+    for (auto& op : s.plan) {
+      switch (op.kind) {
+        case 1:
+          static_cast<AttentionUnit*>(op.u)->DecodeStep(
+              op.ins[0]->data, op.out->data, rows, op.feat, pos, L,
+              &op.cache->k, &op.cache->v, pool);
+          break;
+        case 2:
+          static_cast<RecurrentUnit*>(op.u)->DecodeStep(
+              op.ins[0]->data, op.out->data, rows, op.feat, &op.rec->h,
+              &op.rec->c, pool, op.rec->scr.get());
+          break;
+        default:
+          op.u->Run(op.ins, op.out, &ctx);
       }
     }
   }
