@@ -85,6 +85,10 @@ int main(int argc, char** argv) {
                  "--beams 1\n");
     return 2;
   }
+  if (length_penalty < 0.f) {
+    std::fprintf(stderr, "error: --length-penalty must be >= 0\n");
+    return 2;
+  }
   if (beams <= 1 && (eos_id >= 0 || length_penalty != 0.f)) {
     std::fprintf(stderr,
                  "error: --eos-id/--length-penalty shape BEAM scores "
@@ -131,7 +135,7 @@ int main(int argc, char** argv) {
       if (beams > 1) {
         scores_json = ", \"scores\": [";
         for (size_t i = 0; i < beam_scores.size(); i++) {
-          char buf[32];
+          char buf[64];
           std::snprintf(buf, sizeof buf, "%s%.4f", i ? ", " : "",
                         beam_scores[i]);
           scores_json += buf;

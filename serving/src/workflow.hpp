@@ -359,6 +359,7 @@ class Workflow {
     std::vector<double> nscore(BW);
     std::vector<std::pair<double, int64_t>> cand;
     cand.reserve(W * V);
+    std::vector<char> alive_next(BW);
     for (int64_t pos = start_pos; pos + 1 < L; pos++) {
       Tensor& xin = s.bufs["@input"];
       for (int64_t bw = 0; bw < BW; bw++)
@@ -422,10 +423,9 @@ class Workflow {
           gather_rows(kv.second.c, kv.second.row, parent);
       }
       if (eos_id >= 0) {
-        std::vector<char> na(BW);
         for (int64_t bw = 0; bw < BW; bw++)
-          na[bw] = alive[parent[bw]] && nxt[bw] != eos_id;
-        alive.swap(na);
+          alive_next[bw] = alive[parent[bw]] && nxt[bw] != eos_id;
+        alive.swap(alive_next);
       }
       for (int64_t bw = 0; bw < BW; bw++) {
         scores[bw] = nscore[bw];

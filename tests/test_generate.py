@@ -337,23 +337,23 @@ def test_beam_finds_global_optimum(rng):
     assert best_lp >= seq_logp(tuple(int(t) for t in greedy)) - 1e-6
 
 
-def test_beam_score_monotone_in_width(rng):
-    """Fixed-length beam search keeps the W best prefixes at every
-    expansion, and the W2 > W1 survivor set contains the W1 one — so the
-    returned best score must be non-decreasing in width (and hits the
-    brute-force optimum once the width covers the space)."""
+def test_beam_covering_width_bounds_all_widths(rng):
+    """Beam search is NOT monotone in width in general (a wider beam
+    can displace the eventual-best prefix at an intermediate step), but
+    a width covering the search space (W >= V^(N-1)) IS the exact
+    maximum — so every narrower width's score is bounded above by the
+    covering width's."""
     from veles_tpu.runtime.generate import generate_beam
-    B, P, V, N = 2, 4, 8, 4
+    B, P, V, N = 2, 4, 6, 3  # V^(N-1) = 36: W=64 covers the space
     for case in ("plain", "gru_lstm_stacked"):
         wf, ws = _build_lm(CASES[case](V), B, P, V, seed=11)
         prompt = rng.integers(0, V, (B, P)).astype(np.int32)
-        prev = None
-        for W in (1, 2, 4, 16, 64):
+        _, opt_scores = generate_beam(wf, ws, prompt, N, beams=64)
+        opt = np.asarray(opt_scores)
+        for W in (1, 2, 4, 16):
             _, scores = generate_beam(wf, ws, prompt, N, beams=W)
-            s = np.asarray(scores)
-            if prev is not None:
-                assert np.all(s >= prev - 1e-5), (case, W, s, prev)
-            prev = s
+            assert np.all(np.asarray(scores) <= opt + 1e-5), \
+                (case, W, scores, opt)
 
 
 def test_beam_eos_freezes_and_pads(rng):

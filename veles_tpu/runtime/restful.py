@@ -173,15 +173,26 @@ class RestfulServer(Logger):
                 "top_k/top_p filter sampling and need temperature > 0 "
                 "(temperature 0 is greedy decoding)")
         if beams > 1:
-            if temperature > 0:
+            if temperature > 0 or req.get("seed") is not None:
                 raise ValueError(
                     "beams is deterministic search; drop temperature/"
-                    "top_k/top_p or use beams=1")
+                    "top_k/top_p/seed or use beams=1")
+            eos_id = req.get("eos_id")
+            if eos_id is not None and not 0 <= int(eos_id) < hi:
+                # out-of-vocab eos could never fire and would silently
+                # disable eos freezing (the native CLI rejects it too)
+                raise ValueError(
+                    f"eos_id {eos_id} is outside the model vocabulary "
+                    f"[0, {hi})")
+            length_penalty = float(req.get("length_penalty", 0.0))
+            if length_penalty < 0:
+                raise ValueError(
+                    f"length_penalty must be >= 0, got {length_penalty}")
             from .generate import generate_beam
             toks, scores = generate_beam(
                 self.workflow, self.wstate, prompt.astype(np.int32),
-                steps, beams=beams, eos_id=req.get("eos_id"),
-                length_penalty=float(req.get("length_penalty", 0.0)))
+                steps, beams=beams, eos_id=eos_id,
+                length_penalty=length_penalty)
             return {"tokens": np.asarray(toks).tolist(),
                     "scores": np.asarray(scores).tolist()}
         if req.get("eos_id") is not None or req.get("length_penalty"):
