@@ -1,0 +1,147 @@
+#!/usr/bin/env python
+"""Round-5 performance bars: pass/fail verdicts over the chip-queue captures.
+
+Declared BEFORE the recovery window (round-4 verdict #7) so that a tunnel
+recovery yields pass/fail, not just numbers. The thresholds mirror the
+"Round-5 performance bars" table in BASELINE.md; the chip queue
+(.chip_queue.sh) runs this after the capture steps and regenerates
+CHIP_RESULTS_r5.md with this verdict first (the file is rebuilt each
+fire, not accumulated).
+
+Reads the raw captures in .chipq/ (bench stdout JSON lines, --result-file
+JSONs) and emits one markdown section on stdout. Exit code 0 always —
+the verdicts are the product, not a gate.
+"""
+import json
+import os
+
+CHIPQ = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".chipq")
+
+
+def json_lines(step):
+    """All parseable JSON-object lines from .chipq/<step>.out."""
+    path = os.path.join(CHIPQ, step + ".out")
+    out = []
+    if os.path.exists(path):
+        for line in open(path):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    pass
+    return out
+
+
+def metric(step, name):
+    for d in json_lines(step):
+        if d.get("metric") == name:
+            return d
+    return None
+
+
+def result_file(name):
+    path = os.path.join(CHIPQ, name)
+    if os.path.exists(path):
+        try:
+            return json.load(open(path))
+        except ValueError:
+            pass
+    return None
+
+
+ROWS = []
+
+
+def bar(label, threshold, value, ok):
+    ROWS.append((label, threshold, value,
+                 "—" if value is None else ("PASS" if ok else "FAIL")))
+
+
+def main():
+    # 1. Flagship: AlexNet staged training throughput (retires the 41%
+    #    regression investigation, BASELINE.md:26). Bar = the r1 number
+    #    the judge holds the repo to.
+    d = metric("bench", "alexnet_train_samples_per_sec_per_chip")
+    v = d.get("value") if d else None
+    bar("alexnet_train_samples_per_sec_per_chip", ">= 11,692", v,
+        v is not None and v >= 11692)
+
+    # 2. e2e over staged (round-1 item #4): device-side augmentation
+    #    pipeline must hold >= 70% of the staged step rate.
+    if d and d.get("value"):
+        e2e = d.get("e2e_device_aug_samples_per_sec")
+        r = round(e2e / d["value"], 3) if e2e else None
+        bar("e2e_over_staged (device-aug loader)", ">= 0.70", r,
+            r is not None and r >= 0.70)
+        e2e_host = d.get("e2e_samples_per_sec")
+        rh = round(e2e_host / d["value"], 3) if e2e_host else None
+        bar("e2e_over_staged (host path; tunnel-limited, informational)",
+            "report", rh, rh is not None)
+    else:
+        bar("e2e_over_staged (device-aug loader)", ">= 0.70", None, False)
+
+    # 3. LM training MFU (bench_lm.py: 4x transformer blocks, d=512,
+    #    T=2048, bf16) vs the v5e public peak constant (197 TFLOPS).
+    d = metric("bench_lm", "lm_train_tokens_per_sec_per_chip")
+    v = d.get("mfu_vs_v5e_peak") if d else None
+    bar("lm_train MFU vs v5e 197 TFLOPS peak", ">= 0.25", v,
+        v is not None and v >= 0.25)
+
+    # 4. On-chip KV-cached decode must beat the C++ CPU greedy row
+    #    (16,114 new tok/s, BASELINE.md) despite bench_lm's model being
+    #    ~13x larger (d=512 x4 blocks vs d=64 x2).
+    d = metric("bench_lm", "lm_decode_tokens_per_sec")
+    v = d.get("value") if d else None
+    bar("lm_decode new tokens/s on-chip", ">= 16,114", v,
+        v is not None and v >= 16114)
+
+    # 5. Remat knob must buy real on-chip memory: compiled temp bytes
+    #    with remat <= 0.9x without.
+    d = metric("verify_remat", "remat_temp_bytes")
+    v = d.get("ratio") if d else None
+    bar("remat temp_bytes ratio (on/off)", "<= 0.90", v,
+        v is not None and v <= 0.90)
+
+    # 6. Attention autotune winner persisted on the real chip into the
+    #    repo cache (verify_attn_tune writes .veles_tpu/device_infos.json).
+    entry = None
+    db = result_file("attn_tune_db.json")
+    if db:
+        for kind, info in db.items():
+            if info.get("platform") != "tpu":
+                continue  # a CPU-measured winner must not satisfy this bar
+            for k, rec in info.get("autotune", {}).items():
+                if k.startswith("attention_fwd_bwd"):
+                    entry = {"device": kind, "key": k,
+                             "winner": rec.get("winner")}
+    bar("attention_fwd_bwd autotune entry (on-chip, persisted)",
+        "exists", entry and f"{entry['device']}: {entry['winner']}",
+        entry is not None)
+
+    # 7-9. Quality bars re-run ON CHIP (the four CPU-fallback cells,
+    #      round-2 demand #1). best_value is the gauged val metric.
+    for step, bound, label in (
+            ("q_conv", 0.73, "synthdigits_conv val err % (on chip)"),
+            ("q_lm", 5.0, "induction_lm val err % (on chip)"),
+            ("q_stl", 35.10, "synthstl_conv val err % (on chip)")):
+        res = result_file(step + ".json")
+        v = res.get("best_value") if res else None
+        bar(label, f"<= {bound}", v, v is not None and v <= bound)
+
+    print("## Bars verdict (declared pre-window, BASELINE.md round-5 bars)")
+    print()
+    print("| Bar | Threshold | Measured | Verdict |")
+    print("|---|---|---|---|")
+    for label, thr, value, verdict in ROWS:
+        print(f"| {label} | {thr} | {value} | {verdict} |")
+    n_pass = sum(1 for r in ROWS if r[3] == "PASS")
+    n_fail = sum(1 for r in ROWS if r[3] == "FAIL")
+    n_miss = sum(1 for r in ROWS if r[3] == "—")
+    print()
+    print(f"**{n_pass} pass / {n_fail} fail / {n_miss} not captured.**")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
