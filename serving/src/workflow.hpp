@@ -174,6 +174,13 @@ class Workflow {
       for (int64_t t = 0; t < P; t++)
         toks.data[b * L + t] = prompt.data[b * P + t];
 
+    // Sampling scratch hoisted out of the pos/b hot loops: the decode
+    // session's contract is an allocation-free per-position loop, and
+    // these O(V) buffers were the last per-token allocations on the
+    // sampling path (greedy/beam never touch them). assign() below
+    // reuses the capacity after the first token.
+    std::vector<double> samp_p, samp_sorted;
+
     for (int64_t pos = 0; pos + 1 < L; pos++) {
       Tensor& xin = s.bufs["@input"];
       for (int64_t b = 0; b < B; b++)
@@ -194,15 +201,16 @@ class Workflow {
           // top-k threshold: k-th largest logit (k<=0 disables)
           double thresh = -std::numeric_limits<double>::infinity();
           if (top_k > 0 && top_k < V) {
-            std::vector<double> sorted(row, row + V);
-            std::nth_element(sorted.begin(),
-                             sorted.begin() + (top_k - 1), sorted.end(),
-                             std::greater<double>());
-            thresh = sorted[top_k - 1];
+            samp_sorted.assign(row, row + V);
+            std::nth_element(samp_sorted.begin(),
+                             samp_sorted.begin() + (top_k - 1),
+                             samp_sorted.end(), std::greater<double>());
+            thresh = samp_sorted[top_k - 1];
           }
           // numerically-stable softmax over the kept support
           double denom = 0;
-          std::vector<double> p(V, 0.0);
+          std::vector<double>& p = samp_p;
+          p.assign(V, 0.0);
           for (int64_t o = 0; o < V; o++) {
             if (double(row[o]) < thresh) continue;
             p[o] = std::exp((double(row[o]) - double(row[best])) /
@@ -216,7 +224,8 @@ class Workflow {
             // semantics of the JAX sample_logits (which masks
             // `logits < thresh`), so the selectable SET matches even
             // on tied/degenerate distributions
-            std::vector<double> sorted;
+            std::vector<double>& sorted = samp_sorted;
+            sorted.clear();
             for (int64_t o = 0; o < V; o++)
               if (p[o] > 0) sorted.push_back(p[o]);
             std::sort(sorted.begin(), sorted.end(),

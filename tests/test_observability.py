@@ -174,6 +174,40 @@ def test_restful_generate_endpoint(rng):
         with pytest.raises(urllib.error.HTTPError) as ei:
             urllib.request.urlopen(conflict)
         assert ei.value.code == 400
+        # boundary coercion (advisor r4): JSON floats/strings must be
+        # coerced or 400'd at the boundary, never crash deep in jnp (500)
+        def _post(body):
+            return urllib.request.urlopen(urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/generate",
+                json.dumps(body).encode(),
+                {"Content-Type": "application/json"}))
+        base = {"prompt": prompt.tolist(), "steps": 5}
+        # whole-valued float eos_id is coerced, matches the int result
+        with _post({**base, "beams": 4, "eos_id": 0.0,
+                    "length_penalty": 0.6}) as r:
+            np.testing.assert_array_equal(
+                np.asarray(json.loads(r.read())["tokens"]),
+                np.asarray(bref))
+        for bad_body in (
+                {**base, "beams": 4, "eos_id": 2.5},      # fractional
+                {**base, "beams": 4, "eos_id": "two"},    # non-numeric
+                {**base, "beams": 4, "eos_id": float("inf")},  # json
+                # emits bare Infinity: OverflowError must still be a 400
+                {**base, "temperature": 1.0, "top_p": "oops"},
+                {**base, "temperature": 1.0, "top_k": 2.7},  # silent
+                # truncation would filter with k=2 while claiming 2.7
+                {**base, "steps": 2.5},
+                {"prompt": [[1.5, 2.7]], "steps": 5},     # fractional ids
+                {"prompt": [["a", "b"]], "steps": 5}):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(bad_body)
+            assert ei.value.code == 400, bad_body
+        # whole-valued float prompt ids are accepted (coerced)
+        with _post({"prompt": [[float(t) for t in row]
+                               for row in prompt.tolist()],
+                    "steps": 5}) as r:
+            np.testing.assert_array_equal(
+                np.asarray(json.loads(r.read())["tokens"]), ref)
     finally:
         srv.stop()
 

@@ -131,6 +131,22 @@ class RestfulServer(Logger):
                     self.wstate["params"][u.name]["table"].shape[0])
         return None
 
+    @staticmethod
+    def _req_int(v, name):
+        """Integral coercion for JSON numerics: 2 / 2.0 / "2" -> 2;
+        2.5 / "x" / Infinity -> ValueError (the handler's 400 path).
+        JSON has no int/float distinction, so whole-valued floats must
+        coerce; silent truncation (int(2.7) -> 2) must not."""
+        try:
+            f = float(v)
+            i = int(f)
+            if f != i:
+                raise ValueError
+            return i
+        except (TypeError, ValueError, OverflowError):
+            raise ValueError(
+                f"{name} must be an integer, got {v!r}") from None
+
     def decode(self, req: dict) -> dict:
         """POST /generate body -> {"tokens": [[...]]} (+ "scores" for
         beam search)."""
@@ -139,7 +155,20 @@ class RestfulServer(Logger):
                 "this server was started without a workflow; /generate "
                 "needs RestfulServer(..., workflow=wf)")
         from .generate import generate
-        prompt = np.asarray(req["prompt"], np.int64)
+        # Coerce once at the boundary: np.asarray(..., int64) would
+        # silently TRUNCATE fractional ids (2.7 -> 2) and a float/str
+        # passed through raw would crash deep in jnp with an opaque 500.
+        prompt = np.asarray(req["prompt"])
+        if not np.issubdtype(prompt.dtype, np.integer):
+            if (np.issubdtype(prompt.dtype, np.floating)
+                    and np.all(np.isfinite(prompt))
+                    and np.all(prompt == np.floor(prompt))):
+                prompt = prompt.astype(np.int64)  # whole-valued floats ok
+            else:
+                raise ValueError(
+                    "prompt token ids must be integers "
+                    f"(got dtype {prompt.dtype})")
+        prompt = prompt.astype(np.int64)
         if prompt.ndim != 2 or 0 in prompt.shape:
             raise ValueError("prompt must be a non-empty 2-D "
                              "[[ids], ...] array")
@@ -151,10 +180,10 @@ class RestfulServer(Logger):
             raise ValueError(
                 f"prompt token ids must be in [0, {hi}) "
                 f"(got min {prompt.min()}, max {prompt.max()})")
-        steps = int(req.get("steps", 16))
+        steps = self._req_int(req.get("steps", 16), "steps")
         if not 0 < steps <= 65536:
             raise ValueError(f"steps must be in [1, 65536], got {steps}")
-        beams = int(req.get("beams", 1))
+        beams = self._req_int(req.get("beams", 1), "beams")
         if beams < 1:
             raise ValueError(f"beams must be >= 1, got {beams}")
         # bound total decode work/cache memory, not just the step
@@ -164,8 +193,16 @@ class RestfulServer(Logger):
             raise ValueError(
                 f"request too large: batch {B} x beams {beams} x total "
                 f"length {P + steps} exceeds the 2^20 token-cell cap")
-        temperature = float(req.get("temperature", 0.0))
-        top_k, top_p = req.get("top_k"), req.get("top_p")
+        try:
+            temperature = float(req.get("temperature", 0.0))
+            top_p = req.get("top_p")
+            top_p = None if top_p is None else float(top_p)
+        except (TypeError, ValueError) as e:
+            raise ValueError(
+                f"temperature/top_p must be numeric: {e}") from None
+        top_k = req.get("top_k")
+        if top_k is not None:
+            top_k = self._req_int(top_k, "top_k")
         if (top_k is not None or top_p is not None) and temperature <= 0:
             # same contract as the CLI: filters apply to SAMPLING;
             # answering greedy while claiming top-k would mislead
@@ -178,12 +215,18 @@ class RestfulServer(Logger):
                     "beams is deterministic search; drop temperature/"
                     "top_k/top_p/seed or use beams=1")
             eos_id = req.get("eos_id")
-            if eos_id is not None and not 0 <= int(eos_id) < hi:
-                # out-of-vocab eos could never fire and would silently
-                # disable eos freezing (the native CLI rejects it too)
-                raise ValueError(
-                    f"eos_id {eos_id} is outside the model vocabulary "
-                    f"[0, {hi})")
+            if eos_id is not None:
+                # forward the COERCED value: a float 2.0 would pass the
+                # range check then raise TypeError inside generate_beam's
+                # .at[eos_id]
+                eos_id = self._req_int(eos_id, "eos_id")
+                if not 0 <= eos_id < hi:
+                    # out-of-vocab eos could never fire and would
+                    # silently disable eos freezing (the native CLI
+                    # rejects it too)
+                    raise ValueError(
+                        f"eos_id {eos_id} is outside the model "
+                        f"vocabulary [0, {hi})")
             length_penalty = float(req.get("length_penalty", 0.0))
             if length_penalty < 0:
                 raise ValueError(
@@ -200,7 +243,7 @@ class RestfulServer(Logger):
                 "eos_id/length_penalty shape BEAM scores and need "
                 "beams > 1")
         import jax
-        key = jax.random.key(int(req.get("seed", 0)))
+        key = jax.random.key(self._req_int(req.get("seed", 0), "seed"))
         toks = generate(
             self.workflow, self.wstate, prompt.astype(np.int32), steps,
             temperature=temperature, top_k=top_k, top_p=top_p, key=key)
