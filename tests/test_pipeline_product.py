@@ -416,13 +416,14 @@ def test_1f1b_ring_preserves_bf16(rng):
     assert np.isfinite(float(mets["loss"]))
 
 
-def test_trainer_rejects_padded_tail_batches(rng):
-    """Fused 1F1B + a loader whose train count doesn't divide the batch
-    size would silently rescale tail-batch loss (all-pad microbatch);
-    the Trainer must reject it up front."""
-    from veles_tpu.loader.base import TRAIN, VALID
+def test_trainer_accepts_padded_tail_batches(rng):
+    """Round-5 lift (round-4 verdict #4): a loader whose train count
+    does not divide the batch size trains through the fused 1F1B path —
+    the mask-weighted loss makes the padded tail batch exact, so the
+    old up-front rejection is gone."""
+    from veles_tpu.loader.base import TRAIN
     S, T, V = 4, 8, 12
-    cfg = dict(_seq_config(S, T, V), max_epochs=1)
+    cfg = dict(_seq_config(S, T, V), max_epochs=2)
     sw = StandardWorkflow(cfg)
     rng2 = np.random.default_rng(1)
     x = rng2.integers(0, V, (60, T)).astype(np.int32)  # 60 % 16 != 0
@@ -430,5 +431,346 @@ def test_trainer_rejects_padded_tail_batches(rng):
                             minibatch_size=16)
     mesh = make_mesh(MeshSpec(data=2, pipe=S))
     trainer = sw.make_trainer(loader, mesh=mesh)
-    with pytest.raises(ValueError, match="full batches"):
-        trainer.initialize(seed=0)
+    trainer.initialize(seed=0)
+    res = trainer.run()
+    assert np.isfinite(res["best_value"])
+
+
+def test_config_1f1b_ragged_batch_matches_ad(rng):
+    """Grad exactness with a NON-uniform @mask (the ragged tail batch):
+    one fused step on dp2×pp4 with 5 of 16 rows padded == one AD step on
+    a single device — the mask-weighted microbatch losses reassemble the
+    global masked mean exactly, including an all-pad microbatch."""
+    S, B, T, V, E = 4, 16, 8, 12, 16
+    cfg = _seq_config(S, T, V, E)
+    mesh = make_mesh(MeshSpec(data=2, pipe=S))
+
+    sw, wf, specs = _build(cfg, B, T, V)
+    ws0 = wf.init_state(jax.random.key(0), sw.optimizer)
+    batch = _lm_batch(rng, B, T, V)
+    # rows 11..15 are padding: microbatch 3 (rows 12-15) is ALL pad
+    mask = np.ones((B,), np.float32)
+    mask[11:] = 0.0
+    batch["@mask"] = jnp.asarray(mask)
+
+    step_pp, state_sh, _ = wf.make_pipeline_train_step(
+        sw.optimizer, mesh, ws0, specs, n_microbatches=S, donate=False)
+    ws_pp, mets_pp = step_pp(jax.device_put(ws0, state_sh), batch)
+
+    sw2, wf2, _ = _build(cfg, B, T, V)
+    step_ad = wf2.make_train_step(sw2.optimizer, donate=False)
+    ws_ad, mets_ad = step_ad(jax.tree.map(jnp.copy, ws0), batch)
+
+    np.testing.assert_allclose(float(mets_pp["loss"]),
+                               float(mets_ad["loss"]), rtol=2e-5)
+    _assert_params_match(ws_pp, ws_ad)
+
+
+def test_config_1f1b_ragged_with_sp_matches_ad(rng):
+    """Ragged batch composed WITH sequence parallelism: the weighted
+    loss's static rescale must cancel the seq-axis reduction too."""
+    S, B, T, V, E = 2, 8, 8, 12, 16
+    stage = [{"type": "attention", "n_heads": 2, "rope": True,
+              "residual": True},
+             {"type": "layer_norm"}]
+    cfg = _per_position_cfg(S, V, E, stage)
+    mesh = make_mesh(MeshSpec(data=2, seq=2, pipe=S))
+
+    sw, wf, specs = _pp_build(cfg, B, T, V)
+    ws0 = wf.init_state(jax.random.key(0), sw.optimizer)
+    batch = _pp_lm_batch(rng, B, T, V)
+    mask = np.ones((B,), np.float32)
+    mask[5:] = 0.0
+    batch["@mask"] = jnp.asarray(mask)
+
+    step_pp, state_sh, _ = wf.make_pipeline_train_step(
+        sw.optimizer, mesh, ws0, specs, n_microbatches=S, donate=False)
+    ws_pp, mets_pp = step_pp(jax.device_put(ws0, state_sh), batch)
+
+    sw2, wf2, _ = _pp_build(cfg, B, T, V)
+    step_ad = wf2.make_train_step(sw2.optimizer, donate=False)
+    ws_ad, mets_ad = step_ad(jax.tree.map(jnp.copy, ws0), batch)
+
+    np.testing.assert_allclose(float(mets_pp["loss"]),
+                               float(mets_ad["loss"]), rtol=2e-5)
+    _assert_params_match(ws_pp, ws_ad)
+
+
+# ---------------------------------------------------------------------------
+# round-5: collectives INSIDE fused-1F1B stages (pp×sp, pp×ep)
+# ---------------------------------------------------------------------------
+
+def _per_position_cfg(S, V, E, stage, lr=0.1):
+    """Embedding -> S pipelined stages -> per-position head: the
+    sp-compatible LM topology (every folded edge unit positionwise)."""
+    return {
+        "name": "pp_axes_lm",
+        "layers": [
+            {"type": "embedding", "vocab": V, "dim": E, "name": "emb"},
+            {"type": "pipeline_stack", "stages": [stage] * S,
+             "n_microbatches": S, "name": "stack"},
+            {"type": "softmax", "output_size": V, "per_position": True,
+             "name": "out"},
+        ],
+        "optimizer": "sgd",
+        "optimizer_args": {"lr": lr},
+        "pipeline_microbatches": S,
+    }
+
+
+def _pp_lm_batch(rng, B, T, V):
+    """Next-token per-position batch: labels are (B, T)."""
+    x = rng.integers(0, V, (B, T)).astype(np.int32)
+    y = np.roll(x, -1, axis=1).astype(np.int32)
+    return {"@input": jnp.asarray(x), "@labels": jnp.asarray(y),
+            "@mask": jnp.ones((B,), jnp.float32)}
+
+
+def _pp_build(cfg, B, T, V):
+    sw = StandardWorkflow(cfg)
+    wf = sw.workflow
+    specs = {"@input": vt.Spec((B, T), jnp.int32),
+             "@labels": vt.Spec((B, T), jnp.int32),
+             "@mask": vt.Spec((B,), jnp.float32)}
+    wf.build(specs)
+    return sw, wf, specs
+
+
+def _assert_params_match(ws_a, ws_b):
+    fa = {jax.tree_util.keystr(p): v for p, v in
+          jax.tree_util.tree_leaves_with_path(ws_a["params"])}
+    fb = {jax.tree_util.keystr(p): v for p, v in
+          jax.tree_util.tree_leaves_with_path(ws_b["params"])}
+    assert fa.keys() == fb.keys()
+    for k in fa:
+        np.testing.assert_allclose(np.asarray(fa[k]), np.asarray(fb[k]),
+                                   rtol=2e-4, atol=2e-5, err_msg=k)
+
+
+def test_config_1f1b_sp_inside_stages_matches_ad(rng):
+    """Ring attention runs INSIDE fused-1F1B stages (round-4 verdict #3):
+    pp2×sp2×dp2 on the 8-dev mesh — the transports carry T-shards, stage
+    closures run raw ppermute rings over 'seq', rope rotates by global
+    positions — and one optimizer step matches the single-device AD path
+    to fp32 tolerance."""
+    S, B, T, V, E = 2, 8, 8, 12, 16
+    stage = [{"type": "attention", "n_heads": 2, "rope": True,
+              "residual": True},
+             {"type": "layer_norm"}]
+    cfg = _per_position_cfg(S, V, E, stage)
+    mesh = make_mesh(MeshSpec(data=2, seq=2, pipe=S))
+
+    sw, wf, specs = _pp_build(cfg, B, T, V)
+    ws0 = wf.init_state(jax.random.key(0), sw.optimizer)
+    batch = _pp_lm_batch(rng, B, T, V)
+
+    step_pp, state_sh, _ = wf.make_pipeline_train_step(
+        sw.optimizer, mesh, ws0, specs, n_microbatches=S, donate=False)
+    ws_pp, mets_pp = step_pp(jax.device_put(ws0, state_sh), batch)
+
+    sw2, wf2, _ = _pp_build(cfg, B, T, V)
+    step_ad = wf2.make_train_step(sw2.optimizer, donate=False)
+    ws_ad, mets_ad = step_ad(jax.tree.map(jnp.copy, ws0), batch)
+
+    np.testing.assert_allclose(float(mets_pp["loss"]),
+                               float(mets_ad["loss"]), rtol=2e-5)
+    _assert_params_match(ws_pp, ws_ad)
+
+
+def test_config_1f1b_ep_inside_stages_matches_ad(rng):
+    """Expert-parallel MoE runs INSIDE fused-1F1B stages: pp2×ep2×dp2 —
+    microbatch samples shard over 'expert', the stage closure's manual
+    all_to_all redistributes tokens to the rank owning each expert, and
+    the full expert-bank gradient reassembles through the schedule's
+    cross-shard psum.  With ample capacity (no drops) and aux_weight=0
+    (the aux statistic is rank-local by design) one optimizer step
+    matches the single-device AD path."""
+    S, B, T, V, E = 2, 8, 8, 12, 16
+    stage = [{"type": "moe", "n_experts": 4, "d_hidden": 32, "top_k": 1,
+              "capacity_factor": 8.0, "aux_weight": 0.0},
+             {"type": "layer_norm"}]
+    cfg = _per_position_cfg(S, V, E, stage)
+    mesh = make_mesh(MeshSpec(data=2, expert=2, pipe=S))
+
+    sw, wf, specs = _pp_build(cfg, B, T, V)
+    ws0 = wf.init_state(jax.random.key(0), sw.optimizer)
+    batch = _pp_lm_batch(rng, B, T, V)
+
+    step_pp, state_sh, _ = wf.make_pipeline_train_step(
+        sw.optimizer, mesh, ws0, specs, n_microbatches=S, donate=False)
+    ws_pp, mets_pp = step_pp(jax.device_put(ws0, state_sh), batch)
+
+    sw2, wf2, _ = _pp_build(cfg, B, T, V)
+    step_ad = wf2.make_train_step(sw2.optimizer, donate=False)
+    ws_ad, mets_ad = step_ad(jax.tree.map(jnp.copy, ws0), batch)
+
+    np.testing.assert_allclose(float(mets_pp["loss"]),
+                               float(mets_ad["loss"]), rtol=2e-5)
+    _assert_params_match(ws_pp, ws_ad)
+
+
+def test_config_1f1b_sp_ep_composed_trains(rng):
+    """pp2×sp2×ep2 in ONE fused step (8 devices, three model axes): every
+    stage is the realistic transformer-MoE block (attention + MoE — the
+    uniform structure the shared SPMD dispatch requires), each stage body
+    runs BOTH a seq ring and an expert all_to_all, loss decreases, aux
+    flows."""
+    S, B, T, V, E = 2, 8, 8, 12, 16
+    block = [{"type": "attention", "n_heads": 2, "rope": True,
+              "residual": True},
+             {"type": "layer_norm"},
+             {"type": "moe", "n_experts": 4, "d_hidden": 32,
+              "top_k": 1, "capacity_factor": 4.0},
+             {"type": "layer_norm"}]
+    cfg = {
+        "name": "pp_sp_ep_lm",
+        "layers": [
+            {"type": "embedding", "vocab": V, "dim": E, "name": "emb"},
+            {"type": "pipeline_stack", "stages": [block, block],
+             "n_microbatches": S, "name": "stack"},
+            {"type": "softmax", "output_size": V, "per_position": True,
+             "name": "out"},
+        ],
+        "optimizer": "sgd",
+        "optimizer_args": {"lr": 0.3},
+        "pipeline_microbatches": S,
+    }
+    mesh = make_mesh(MeshSpec(seq=2, expert=2, pipe=S))
+    sw, wf, specs = _pp_build(cfg, B, T, V)
+    ws = wf.init_state(jax.random.key(0), sw.optimizer)
+    step, state_sh, _ = wf.make_pipeline_train_step(
+        sw.optimizer, mesh, ws, specs, n_microbatches=S, donate=False)
+    ws = jax.device_put(ws, state_sh)
+    batch = _pp_lm_batch(rng, B, T, V)
+    losses = []
+    for _ in range(8):
+        ws, mets = step(ws, batch)
+        losses.append(float(mets["loss"]))
+        assert np.isfinite(losses[-1])
+        assert np.isfinite(float(mets["aux"]))
+    assert losses[-1] < losses[0], losses
+
+
+def test_1f1b_sp_rejects_heterogeneous_stages(rng):
+    """Different collective sequences on different pipe ranks are not
+    expressible in one SPMD program — the compiler must say so instead
+    of deadlocking the runtime."""
+    S, B, T, V, E = 2, 8, 8, 12, 16
+    stage_att = [{"type": "attention", "n_heads": 2, "rope": True,
+                  "residual": True},
+                 {"type": "layer_norm"}]
+    stage_ffn = [{"type": "ffn", "d_hidden": 32},
+                 {"type": "layer_norm"}]
+    cfg = {
+        "name": "pp_het",
+        "layers": [
+            {"type": "embedding", "vocab": V, "dim": E, "name": "emb"},
+            {"type": "pipeline_stack", "stages": [stage_att, stage_ffn],
+             "n_microbatches": S, "name": "stack"},
+            {"type": "softmax", "output_size": V, "per_position": True,
+             "name": "out"},
+        ],
+        "optimizer": "sgd",
+        "optimizer_args": {"lr": 0.1},
+        "pipeline_microbatches": S,
+    }
+    mesh = make_mesh(MeshSpec(seq=2, pipe=S))
+    sw, wf, specs = _pp_build(cfg, B, T, V)
+    ws = wf.init_state(jax.random.key(0), sw.optimizer)
+    with pytest.raises(WorkflowError, match="IDENTICAL"):
+        wf.make_pipeline_train_step(sw.optimizer, mesh, ws, specs,
+                                    n_microbatches=S)
+
+
+def test_1f1b_sp_rejects_non_positionwise_post(rng):
+    """seq_last under sequence parallelism would silently take the last
+    LOCAL position — the plan must reject it with a real error."""
+    S, B, T, V = 2, 8, 8, 12
+    cfg = _seq_config(S, T, V)  # seq_last + sample-level softmax head
+    mesh = make_mesh(MeshSpec(seq=2, pipe=S))
+    sw, wf, specs = _build(cfg, B, T, V)
+    ws = wf.init_state(jax.random.key(0), sw.optimizer)
+    with pytest.raises(WorkflowError, match="positionwise"):
+        wf.make_pipeline_train_step(sw.optimizer, mesh, ws, specs,
+                                    n_microbatches=S)
+
+
+def test_config_1f1b_stateful_normalizer_matches_ad(rng):
+    """Round-5 lift (round-4 verdict #5): a stateful unit with READ-ONLY
+    state — MeanDispNormalizer's dataset statistics — folds into the
+    fused schedule's edge stage instead of being rejected; one fused
+    step matches the AD path exactly."""
+    S, B, D = 4, 16, 16
+    mean = np.linspace(-1.0, 1.0, D).astype(np.float32)
+    rdisp = np.linspace(0.5, 2.0, D).astype(np.float32)
+
+    def build():
+        wf = build_workflow("pp_statenorm", [
+            {"type": "norm", "mean": mean, "rdisp": rdisp,
+             "name": "norm"},
+            {"type": "pipeline_stack", "n_stages": S, "d_hidden": 32,
+             "n_microbatches": S, "name": "stack"},
+            {"type": "softmax", "output_size": 5, "name": "out"},
+        ])
+        specs = {"@input": vt.Spec((B, D), jnp.float32),
+                 "@labels": vt.Spec((B,), jnp.int32),
+                 "@mask": vt.Spec((B,), jnp.float32)}
+        wf.build(specs)
+        return wf, specs
+
+    wf, specs = build()
+    o = opt.SGD(0.1)
+    ws0 = wf.init_state(jax.random.key(1), o)
+    assert set(ws0["state"]["norm"]) == {"mean", "rdisp"}  # real state
+    batch = {"@input": jnp.asarray(rng.standard_normal((B, D)) * 2 + 1,
+                                   jnp.float32),
+             "@labels": jnp.asarray(rng.integers(0, 5, B), jnp.int32),
+             "@mask": jnp.ones((B,), jnp.float32)}
+
+    mesh = make_mesh(MeshSpec(data=2, pipe=S))
+    step_pp, state_sh, _ = wf.make_pipeline_train_step(
+        o, mesh, ws0, specs, n_microbatches=S, donate=False)
+    ws_pp, mets_pp = step_pp(jax.device_put(ws0, state_sh), batch)
+
+    wf2, _ = build()
+    step_ad = wf2.make_train_step(opt.SGD(0.1), donate=False)
+    ws_ad, mets_ad = step_ad(jax.tree.map(jnp.copy, ws0), batch)
+
+    np.testing.assert_allclose(float(mets_pp["loss"]),
+                               float(mets_ad["loss"]), rtol=2e-5)
+    _assert_params_match(ws_pp, ws_ad)
+    # the statistics stayed untouched (read-only contract)
+    np.testing.assert_array_equal(
+        np.asarray(ws_pp["state"]["norm"]["mean"]), mean)
+
+
+def test_1f1b_het_stages_with_idle_expert_axis(rng):
+    """Review regression guard: an expert mesh axis on a MoE-FREE model
+    must stay pure replication — heterogeneous stages keep the switch
+    dispatch instead of being rejected by the shared-dispatch rule."""
+    S, B, T, V, E = 2, 8, 8, 12, 16
+    stage_att = [{"type": "attention", "n_heads": 2, "rope": True,
+                  "residual": True},
+                 {"type": "layer_norm"}]
+    stage_ffn = [{"type": "ffn", "d_hidden": 32},
+                 {"type": "layer_norm"}]
+    cfg = {
+        "name": "pp_het_idle_ep",
+        "layers": [
+            {"type": "embedding", "vocab": V, "dim": E, "name": "emb"},
+            {"type": "pipeline_stack", "stages": [stage_att, stage_ffn],
+             "n_microbatches": S, "name": "stack"},
+            {"type": "seq_last", "name": "last"},
+            {"type": "softmax", "output_size": V, "name": "out"},
+        ],
+        "optimizer": "sgd",
+        "optimizer_args": {"lr": 0.1},
+        "pipeline_microbatches": S,
+    }
+    mesh = make_mesh(MeshSpec(data=2, expert=2, pipe=S))
+    sw, wf, specs = _build(cfg, B, T, V)
+    ws = wf.init_state(jax.random.key(0), sw.optimizer)
+    step, state_sh, _ = wf.make_pipeline_train_step(
+        sw.optimizer, mesh, ws, specs, n_microbatches=S, donate=False)
+    _, mets = step(jax.device_put(ws, state_sh), _lm_batch(rng, B, T, V))
+    assert np.isfinite(float(mets["loss"]))

@@ -5,6 +5,9 @@ config files, ``--optimize N[:G]`` GA mode (:716-734), ``--ensemble-train
 N:r`` / ``--ensemble-test``, ``--dump-config``, ``--result-file``,
 ``--random-seed`` (:483-537), snapshot-restore positional (:539-589),
 ``--dry-run`` levels, inline ``root.x.y=z`` overrides (:474-481).
+Subcommands: ``benchmark`` (device gemm DB), ``forge`` (model store),
+``compare-snapshots A B`` (per-tensor checkpoint diff — reference:
+veles/scripts/compare_snapshots.py).
 
 Config conventions (TPU-native redesign of "user config files are executed
 Python mutating root", veles/__main__.py:426-472):
@@ -397,7 +400,8 @@ def _daemonize(log_path: str) -> int:
 
 def _write_graph(workflow, path: str) -> None:
     """Dump the workflow DOT (reference: --visualize rendered the graph;
-    here it lands as files: PATH and PATH.svg when graphviz is around)."""
+    here it lands as files: PATH and PATH.svg — rendered by graphviz
+    when available, else by the native Workflow.generate_svg layout)."""
     with open(path, "w") as f:
         f.write(workflow.generate_graph())
     import shutil
@@ -405,6 +409,9 @@ def _write_graph(workflow, path: str) -> None:
     if shutil.which("dot"):
         subprocess.run(["dot", "-Tsvg", path, "-o", path + ".svg"],
                        check=False)
+    else:
+        with open(path + ".svg", "w") as f:
+            f.write(workflow.generate_svg())
 
 
 def main(argv=None) -> int:
@@ -416,6 +423,60 @@ def main(argv=None) -> int:
         from .runtime.benchmark import benchmark_device
         info = benchmark_device(refresh="--refresh" in argv)
         print(json.dumps(info, indent=1))
+        return 0
+    if argv and argv[0] == "compare-snapshots":
+        # reference: veles/scripts/compare_snapshots.py (relative diffs
+        # between two Snapshotter pickles, prettytable output)
+        p = argparse.ArgumentParser(
+            prog="veles_tpu compare-snapshots",
+            description="Per-tensor diff of two snapshot manifests "
+                        "(paths, _current/_best links, or sqlite:// / "
+                        "http:// snapshot URIs)")
+        p.add_argument("a")
+        p.add_argument("b")
+        p.add_argument("--sort", choices=("name", "maxdiff", "reldiff"),
+                       default="reldiff", help="row order (default: by "
+                       "max relative difference, largest first)")
+        p.add_argument("--top", type=int, default=0,
+                       help="print only the N most-different tensors")
+        p.add_argument("--json", action="store_true",
+                       help="machine-readable report instead of a table")
+        ca = p.parse_args(argv[1:])
+        from .runtime.snapshotter import compare_snapshots
+        rep = compare_snapshots(ca.a, ca.b)
+        if ca.json:
+            print(json.dumps(rep, indent=1))
+            return 0
+        rows = rep["rows"]
+        if ca.sort == "maxdiff":
+            rows.sort(key=lambda r: -r.get("max_abs", float("inf")))
+        elif ca.sort == "reldiff":
+            rows.sort(key=lambda r: -r.get("max_rel", float("inf")))
+        if ca.top:
+            rows = rows[:ca.top]
+        print(f"{'tensor':44s} {'shape':>16s} {'max|d|':>11s} "
+              f"{'mean|d|':>11s} {'max rel':>11s}")
+        for r in rows:
+            if r["mismatch"]:
+                print(f"{r['key']:44s} MISMATCH "
+                      f"{r['shape_a']}/{r['dtype_a']} vs "
+                      f"{r['shape_b']}/{r['dtype_b']}")
+            else:
+                print(f"{r['key']:44s} {str(tuple(r['shape'])):>16s} "
+                      f"{r['max_abs']:11.4g} {r['mean_abs']:11.4g} "
+                      f"{r['max_rel']:11.4g}")
+        for side, keys in (("a", rep["only_a"]), ("b", rep["only_b"])):
+            for k in keys:
+                print(f"{k:44s} ONLY IN {side}")
+        for k, (va, vb) in sorted(rep["meta"].items()):
+            sa, sb = repr(va), repr(vb)
+            if len(sa) + len(sb) > 160:  # decision history etc.
+                sa, sb = sa[:76] + "…", sb[:76] + "…"
+            print(f"meta {k}: {sa} -> {sb}")
+        n_diff = sum(1 for r in rep["rows"]  # count BEFORE --top cut
+                     if r["mismatch"] or r.get("max_abs", 0) > 0)
+        print(f"-- {len(rep['rows'])} shared tensors, {n_diff} differ; "
+              f"{len(rep['only_a'])}+{len(rep['only_b'])} unmatched")
         return 0
     if argv and argv[0] == "forge":
         setup_logging()
@@ -660,6 +721,16 @@ def main(argv=None) -> int:
                     name=trainer.workflow.name, plots_dir=plots_dir)
             elif trainer.status.plots_dir is None:
                 trainer.status.plots_dir = trainer.recorder.out_dir
+            if trainer.status.graph_svg is None:
+                # the page embeds the live workflow graph (reference:
+                # web/viz.js rendered the DOT feed in the browser)
+                svg_path = os.path.join(plots_dir, "workflow.svg")
+                try:
+                    with open(svg_path, "w") as f:
+                        f.write(trainer.workflow.generate_svg())
+                    trainer.status.graph_svg = svg_path
+                except OSError:
+                    pass
             status_server = StatusServer(
                 trainer.status, port=args.status_port).start()
     if args.snapshot_dir and trainer.snapshotter is None:

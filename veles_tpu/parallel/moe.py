@@ -63,6 +63,68 @@ def _route_positions(topi: jnp.ndarray, E: int) -> jnp.ndarray:
     return pos_flat.reshape(K, T).T                 # (T, K)
 
 
+# -- shared building blocks of the "sort" formulation -----------------------
+# moe_apply's local path and moe_apply_manual's expert-parallel path are
+# contractually identical in routing, combine weights, and aux statistics
+# (the fused-1F1B exactness tests depend on it) — so the steps live ONCE.
+
+def _route(params: dict, x: jnp.ndarray, top_k: int):
+    """Router logits -> (gates, topi, probs); Switch keeps the raw top-1
+    probability (renormalizing would cut the router out of backward)."""
+    logits = x @ params["router"]
+    probs = jax.nn.softmax(logits, axis=-1)
+    topv, topi = jax.lax.top_k(probs, top_k)
+    if top_k == 1:
+        gates = topv
+    else:
+        gates = topv / jnp.maximum(
+            jnp.sum(topv, axis=-1, keepdims=True), 1e-9)
+    return gates, topi, probs
+
+
+def _pack_slots(x: jnp.ndarray, topi: jnp.ndarray, E: int, C: int):
+    """Scatter tokens into their (expert, slot) rows -> (slot_idx, keep,
+    xe (E, C, D)); dropped routes target the out-of-bounds row E*C."""
+    T, D = x.shape
+    K = topi.shape[1]
+    pos = _route_positions(topi, E)
+    keep = pos < C
+    slot_idx = jnp.where(keep, topi * C + pos, E * C)
+    xk = jnp.broadcast_to(x[:, None, :], (T, K, D)).reshape(T * K, D)
+    xe = jnp.zeros((E * C, D), x.dtype) \
+        .at[slot_idx.reshape(-1)].add(xk, mode="drop") \
+        .reshape(E, C, D)
+    return slot_idx, keep, xe
+
+
+def _expert_ffn(xe: jnp.ndarray, w1: jnp.ndarray, w2: jnp.ndarray,
+                out_dtype) -> jnp.ndarray:
+    h = jax.nn.relu(jnp.einsum("ecd,edh->ech", xe, w1,
+                               preferred_element_type=jnp.float32))
+    return jnp.einsum("ech,ehd->ecd", h.astype(out_dtype), w2)
+
+
+def _combine_slots(ye: jnp.ndarray, slot_idx: jnp.ndarray,
+                   keep: jnp.ndarray, gates: jnp.ndarray,
+                   x_dtype) -> jnp.ndarray:
+    E_C, D = ye.shape[0] * ye.shape[1], ye.shape[2]
+    T, K = slot_idx.shape
+    yk = ye.reshape(E_C, D)[
+        jnp.clip(slot_idx, 0, E_C - 1).reshape(-1)].reshape(T, K, D)
+    w = (gates * keep.astype(gates.dtype)).astype(x_dtype)
+    return jnp.einsum("tk,tkd->td", w, yk)
+
+
+def _switch_aux(topi: jnp.ndarray, probs: jnp.ndarray) -> jnp.ndarray:
+    """Switch load-balance loss on the primary assignment (bincount form:
+    no (T, E) one-hot materialization)."""
+    T, E = probs.shape
+    frac_tokens = jnp.zeros(E, jnp.float32) \
+        .at[topi[:, 0]].add(1.0) / T
+    frac_probs = jnp.mean(probs.astype(jnp.float32), axis=0)
+    return E * jnp.sum(frac_tokens * frac_probs)
+
+
 def moe_apply(params: dict, x: jnp.ndarray, *,
               capacity_factor: float = 1.25, top_k: int = 1,
               dispatch_mode: str = "sort"
@@ -84,37 +146,12 @@ def moe_apply(params: dict, x: jnp.ndarray, *,
     K = int(top_k)
     C = max(1, int(capacity_factor * T * K / E))
 
-    logits = x @ params["router"]                    # (T, E)
-    probs = jax.nn.softmax(logits, axis=-1)
-    topv, topi = jax.lax.top_k(probs, K)             # (T, K)
-    if K == 1:
-        # Switch semantics: scale by the raw top-1 probability — the path
-        # that carries router gradients (renormalizing would make it 1.0
-        # and cut the router out of the backward graph)
-        gates = topv
-    else:
-        gates = topv / jnp.maximum(
-            jnp.sum(topv, axis=-1, keepdims=True), 1e-9)
+    gates, topi, probs = _route(params, x, K)
 
     if dispatch_mode == "sort":
-        pos = _route_positions(topi, E)              # (T, K)
-        keep = pos < C
-        # dropped routes target the out-of-bounds row E*C; scatter mode
-        # 'drop' discards them. Slot rows are unique (positions are a
-        # per-expert enumeration), so 'add' never accumulates two tokens.
-        slot_idx = jnp.where(keep, topi * C + pos, E * C)
-        xk = jnp.broadcast_to(x[:, None, :], (T, K, D)).reshape(T * K, D)
-        xe = jnp.zeros((E * C, D), x.dtype) \
-            .at[slot_idx.reshape(-1)].add(xk, mode="drop") \
-            .reshape(E, C, D)
-        h = jax.nn.relu(jnp.einsum("ecd,edh->ech", xe, params["w1"],
-                                   preferred_element_type=jnp.float32))
-        ye = jnp.einsum("ech,ehd->ecd", h.astype(x.dtype), params["w2"])
-        yk = ye.reshape(E * C, D)[
-            jnp.clip(slot_idx, 0, E * C - 1).reshape(-1)] \
-            .reshape(T, K, D)
-        w = (gates * keep.astype(gates.dtype)).astype(x.dtype)
-        y = jnp.einsum("tk,tkd->td", w, yk)
+        slot_idx, keep, xe = _pack_slots(x, topi, E, C)
+        ye = _expert_ffn(xe, params["w1"], params["w2"], x.dtype)
+        y = _combine_slots(ye, slot_idx, keep, gates, x.dtype)
     elif dispatch_mode == "dense":
         onehots = jax.nn.one_hot(topi, E, dtype=x.dtype)  # (T, K, E)
         # queue positions, slot-major (GShard priority).  The cumsum runs
@@ -142,12 +179,63 @@ def moe_apply(params: dict, x: jnp.ndarray, *,
     else:
         raise ValueError(f"unknown dispatch_mode {dispatch_mode!r}")
 
-    # Switch load-balance loss on the primary assignment (bincount form:
-    # no (T, E) one-hot materialization)
-    frac_tokens = jnp.zeros(E, jnp.float32) \
-        .at[topi[:, 0]].add(1.0) / T
-    frac_probs = jnp.mean(probs.astype(jnp.float32), axis=0)
-    aux = E * jnp.sum(frac_tokens * frac_probs)
+    aux = _switch_aux(topi, probs)
+    return y, aux
+
+
+def moe_apply_manual(params: dict, x: jnp.ndarray, *, axis_name: str,
+                     capacity_factor: float = 1.25, top_k: int = 1
+                     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Expert-parallel MoE for code ALREADY inside a ``shard_map`` (a
+    pipeline-schedule body, Context.manual_axes): ``x`` is this rank's
+    token shard, the expert partition lives on mesh axis ``axis_name``,
+    and dispatch/combine are explicit ``all_to_all`` over that axis —
+    the hand-written form of what GSPMD lowers the sharded einsums to
+    (round-4 verdict #3: expert-parallel MoE inside fused-1F1B stages).
+
+    Every rank routes its own tokens with the (replicated) router, packs
+    them into per-expert capacity slots exactly like ``moe_apply``'s
+    "sort" mode, then exchanges slots so each rank computes ONLY its
+    E/n experts — on slots from all ranks — with its slice of the
+    (replicated) expert bank, and a second all_to_all carries results
+    home.  Parameter gradients compose with a psum over ``axis_name``:
+    each rank's grad is nonzero only in its expert slice (the slice is
+    a dynamic_slice of the replicated bank), so the sum reassembles the
+    full bank gradient exactly once per expert.
+
+    Semantics vs the non-distributed ``moe_apply``: identical routing
+    and combine weights; capacity is enforced PER SOURCE RANK (C =
+    cf·T_local·K/E slots per expert per rank) rather than globally —
+    the standard expert-parallel behavior.  With capacity ample enough
+    that nothing drops the outputs are exact to the global formulation;
+    the load-balance aux loss uses LOCAL token statistics (the caller
+    averages it across ranks).
+    """
+    T = x.shape[0]
+    E = params["router"].shape[1]
+    n = jax.lax.psum(1, axis_name)           # static inside shard_map
+    if E % n:
+        raise ValueError(
+            f"n_experts={E} must divide over the {axis_name!r} axis ({n})")
+    El = E // n
+    rank = jax.lax.axis_index(axis_name)
+    K = int(top_k)
+    C = max(1, int(capacity_factor * T * K / E))
+
+    gates, topi, probs = _route(params, x, K)
+    slot_idx, keep, xe = _pack_slots(x, topi, E, C)
+    # exchange: expert-major split — rank r receives every rank's slots
+    # for ITS El experts, concatenated source-major on the slot axis
+    xr = jax.lax.all_to_all(xe, axis_name, split_axis=0, concat_axis=1,
+                            tiled=True)       # (El, n*C, D)
+    w1 = jax.lax.dynamic_slice_in_dim(params["w1"], rank * El, El, 0)
+    w2 = jax.lax.dynamic_slice_in_dim(params["w2"], rank * El, El, 0)
+    yr = _expert_ffn(xr, w1, w2, x.dtype)
+    # inverse exchange: slot chunks go back to their source ranks
+    ye = jax.lax.all_to_all(yr, axis_name, split_axis=1, concat_axis=0,
+                            tiled=True)       # (E, C, D)
+    y = _combine_slots(ye, slot_idx, keep, gates, x.dtype)
+    aux = _switch_aux(topi, probs)
     return y, aux
 
 

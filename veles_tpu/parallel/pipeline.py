@@ -190,11 +190,49 @@ def _ravel_stages(stage_fns: Sequence[Callable], params_list):
         for un, l in zip(unravels, lens)]
 
 
-def _prep_stages(stage_fn, params, S: int, axis_name: str):
+def _prep_stages(stage_fn, params, S: int, axis_name: str,
+                 shared: bool = False):
     """Shared homogeneous/heterogeneous dispatch for pipeline_apply and
     pipeline_train_step: validates stage counts and returns
     (stacked, apply_local(idx, p, x), p_specs, unravels) where
-    ``unravels`` is None on the homogeneous path."""
+    ``unravels`` is None on the homogeneous path.
+
+    ``shared``: ONE callable ``stage_fn(idx, p, *xs)`` applied to every
+    stage with per-stage params of IDENTICAL pytree structure (a list of
+    S pytrees).  Unlike the heterogeneous ``lax.switch`` dispatch, every
+    device traces the SAME stage body — required when stage bodies
+    contain collectives over other mesh axes (ring attention, MoE
+    all_to_all): a switch would diverge the collective sequence across
+    pipe ranks, which a single SPMD program cannot express (the XLA CPU
+    rendezvous deadlocks on it, and relying on CSE to merge identical
+    branches is fragile)."""
+    if shared:
+        if not callable(stage_fn) or callable(params):
+            raise ValueError(
+                "shared mode takes one stage_fn(idx, p, *xs) plus a "
+                "list of per-stage param pytrees")
+        per_stage = list(params)
+        if len(per_stage) != S:
+            raise ValueError(
+                f"need {S} per-stage param sets, got {len(per_stage)}")
+        vecs, unravels, lens = [], [], []
+        for p in per_stage:
+            v, un = ravel_pytree(p)
+            vecs.append(v)
+            unravels.append(un)
+            lens.append(v.shape[0])
+        if len(set(lens)) != 1 or len({
+                jax.tree_util.tree_structure(p) for p in per_stage}) != 1:
+            raise ValueError(
+                "shared stage dispatch needs structurally identical "
+                f"per-stage params (raveled lengths {lens})")
+        stacked = jnp.stack(vecs)
+        un0, l0 = unravels[0], lens[0]
+
+        def apply_shared(idx, vec, *xs):
+            return stage_fn(idx, un0(vec[:l0]), *xs)
+
+        return stacked, apply_shared, P(axis_name), unravels
     if callable(stage_fn):
         # homogeneous fast path: use the stacked tree directly — each
         # leaf shards P(pipe) on its stage axis, no ravel round-trip
@@ -523,8 +561,10 @@ def pipeline_train_step(stage_fn: Union[Callable, Sequence[Callable]],
                         loss_fn: Callable, params, x, labels, mesh: Mesh, *,
                         axis_name: str = "pipe",
                         batch_axes: Sequence[str] = (),
+                        width_axes: Sequence[str] = (),
                         rng: Optional[jax.Array] = None,
-                        ring_spec=None, with_aux: bool = False):
+                        ring_spec=None, with_aux: bool = False,
+                        shared: bool = False):
     """Fused 1F1B pipeline training step: returns ``(loss, param_grads)``.
 
     Unlike :func:`pipeline_apply` + ``jax.grad`` (GPipe schedule: AD tapes
@@ -562,10 +602,21 @@ def pipeline_train_step(stage_fn: Union[Callable, Sequence[Callable]],
       feeds ``loss_fn`` locally without ever riding the ring, so ring
       bytes are independent of the output/vocab width.  Used by the
       fused workflow compiler (``pipeline_compile.py``).
+
+    ``width_axes`` (heterogeneous mode only): mesh axes sharding the
+    trailing FEATURE dim of the input conveyor and the activation ring —
+    sequence parallelism (round-4 verdict #3: ring attention inside
+    fused-1F1B stages).  The per-sample ring payload each device carries
+    becomes ``ring_spec/∏width_axes``; labels stay width-replicated (the
+    loss slices them by rank); stage closures see LOCAL shards and may
+    run raw collectives over these axes (they are part of this
+    shard_map's mesh).  The per-device loss must then be the mean over
+    the LOCAL slice — the cross-shard reduction treats width axes
+    exactly like batch axes (psum of per-shard means / shard count).
     """
     S = mesh.shape[axis_name]
     stacked, apply_local, p_specs, unravels = _prep_stages(
-        stage_fn, params, S, axis_name)
+        stage_fn, params, S, axis_name, shared=shared)
     n_mb = x.shape[0]
     if labels.shape[0] != n_mb:
         raise ValueError("labels must have the same microbatch count as x")
@@ -573,16 +624,32 @@ def pipeline_train_step(stage_fn: Union[Callable, Sequence[Callable]],
                                      batch_axes)
     lbl_spec = x_spec
     het = ring_spec is not None
+    width_axes = tuple(a for a in width_axes if mesh.shape[a] > 1)
+    ring_feat = tuple(ring_spec.shape) if het else ()
+    if width_axes:
+        if not het:
+            raise ValueError(
+                "width_axes needs the heterogeneous-buffer mode "
+                "(ring_spec): uniform stages carry the input shape")
+        wsz = math.prod(mesh.shape[a] for a in width_axes)
+        if x.shape[-1] % wsz or (ring_feat and ring_feat[-1] % wsz):
+            raise ValueError(
+                f"conveyor width {x.shape[-1]} / ring width {ring_feat} "
+                f"not divisible over width axes {width_axes} ({wsz})")
+        # (S, Q, mb, width): width sharded, labels stay replicated there
+        x_spec = P(axis_name, None,
+                   x_spec[2] if len(x_spec) > 2 else None, width_axes)
+        ring_feat = ring_feat[:-1] + (ring_feat[-1] // wsz,)
     keyed = rng is not None or het
     if het and rng is None:
         rng = jax.random.key(0)  # deterministic het stages: key unused
     fn = jax.shard_map(
         functools.partial(_1f1b_local, apply_local=apply_local,
                           loss_local=loss_fn, axis_name=axis_name,
-                          batch_axes=batch_axes, n_microbatches=n_mb,
+                          batch_axes=batch_axes + width_axes,
+                          n_microbatches=n_mb,
                           n_stages=S, het=het, keyed=keyed,
-                          ring_feat=(tuple(ring_spec.shape) if het
-                                     else ()),
+                          ring_feat=ring_feat,
                           ring_dtype=ring_spec.dtype if het else None),
         mesh=mesh,
         in_specs=(p_specs, x_spec, lbl_spec) + ((P(),) if keyed else ()),

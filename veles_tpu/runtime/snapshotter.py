@@ -323,3 +323,54 @@ class SnapshotterToDB(Snapshotter):
         payload = json.loads(manifest)
         payload["wstate"] = _unflatten(flat)
         return payload
+
+
+def compare_snapshots(path_a: str, path_b: str) -> Dict[str, Any]:
+    """Per-tensor diff of two checkpoints (reference:
+    /root/reference/veles/scripts/compare_snapshots.py, which printed
+    relative differences between the pickled Arrays of two Snapshotter
+    files; here the inputs are this runtime's npz+JSON manifests,
+    ``_current``/``_best`` symlinks, or ``sqlite://``/``http(s)://``
+    snapshot URIs).
+
+    Returns ``{"rows": [...], "only_a": [...], "only_b": [...],
+    "meta": {...}}`` where each row carries key/shape/dtype and
+    max|Δ| / mean|Δ| / max relative Δ (0-denominators excluded), a
+    ``mismatch`` flag for shape/dtype disagreements, and ``meta`` maps
+    differing manifest fields to their (a, b) values."""
+    pa, pb = Snapshotter.load(path_a), Snapshotter.load(path_b)
+    fa = _flatten(_to_numpy(pa.get("wstate", {})))
+    fb = _flatten(_to_numpy(pb.get("wstate", {})))
+    rows = []
+    for k in sorted(set(fa) & set(fb)):
+        a, b = np.asarray(fa[k]), np.asarray(fb[k])
+        if a.shape != b.shape or a.dtype != b.dtype:
+            rows.append({"key": k, "mismatch": True,
+                         "shape_a": list(a.shape), "dtype_a": str(a.dtype),
+                         "shape_b": list(b.shape), "dtype_b": str(b.dtype)})
+            continue
+        af = a.astype(np.float64, copy=False)
+        bf = b.astype(np.float64, copy=False)
+        d = np.abs(af - bf)
+        denom = np.maximum(np.abs(af), np.abs(bf))
+        nz = denom > 0
+        rows.append({
+            "key": k, "mismatch": False,
+            "shape": list(a.shape), "dtype": str(a.dtype),
+            "max_abs": float(d.max()) if d.size else 0.0,
+            "mean_abs": float(d.mean()) if d.size else 0.0,
+            "max_rel": float((d[nz] / denom[nz]).max()) if nz.any()
+            else 0.0,
+        })
+    skip = {"tensors", "saved_at", "wstate"}
+    meta = {}
+    for k in sorted((set(pa) | set(pb)) - skip):
+        va, vb = pa.get(k), pb.get(k)
+        if isinstance(va, np.ndarray) or isinstance(vb, np.ndarray):
+            continue
+        if va != vb:
+            meta[k] = [va, vb]
+    return {"rows": rows,
+            "only_a": sorted(set(fa) - set(fb)),
+            "only_b": sorted(set(fb) - set(fa)),
+            "meta": meta}

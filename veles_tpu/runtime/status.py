@@ -31,10 +31,15 @@ class StatusReporter(Logger):
     document)."""
 
     def __init__(self, path: str = "status.json", name: str = "workflow",
-                 plots_dir: Optional[str] = None):
+                 plots_dir: Optional[str] = None,
+                 graph_svg: Optional[str] = None):
         self.path = path
         self.name = name
         self.plots_dir = plots_dir
+        # path to the rendered workflow-graph SVG (Workflow.generate_svg)
+        # — the status page embeds it, closing the reference's live
+        # browser graph view (/root/reference/web/viz.js)
+        self.graph_svg = graph_svg
         self.started = time.time()
         self._extra = {}
 
@@ -80,6 +85,21 @@ class _Handler(http.server.BaseHTTPRequestHandler):
     reporter: Optional[StatusReporter] = None
 
     def do_GET(self):
+        if self.path.split("?", 1)[0] == "/graph.svg":
+            svg = self.reporter.graph_svg if self.reporter else None
+            if not svg or not os.path.isfile(svg):
+                self.send_response(404)
+                self.end_headers()
+                return
+            with open(svg, "rb") as f:
+                body = f.read()
+            self.send_response(200)
+            self.send_header("Content-Type", "image/svg+xml")
+            self.send_header("Cache-Control", "no-store")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
         if self.path.startswith("/plots/"):
             # serve a PNG from plots_dir; basename-only lookup so a
             # crafted path can never escape the directory
@@ -116,7 +136,14 @@ class _Handler(http.server.BaseHTTPRequestHandler):
             imgs = "".join(
                 f'<p><img src="/plots/{fn}?t={int(mt)}" '
                 f'style="max-width:95%"></p>' for fn, mt in plots)
-            body = (_HTML % (doc.get("name", "?"), rows) + imgs).encode()
+            graph = ""
+            if self.reporter and self.reporter.graph_svg \
+                    and os.path.isfile(self.reporter.graph_svg):
+                graph = ('<h3>workflow graph</h3>'
+                         '<p><img src="/graph.svg" '
+                         'style="max-width:95%"></p>')
+            body = (_HTML % (doc.get("name", "?"), rows)
+                    + graph + imgs).encode()
             ctype = "text/html"
         self.send_response(200)
         self.send_header("Content-Type", ctype)

@@ -122,21 +122,11 @@ class Trainer(Logger):
             fused_pp = (self.pipeline_microbatches is not None
                         and self.mesh.shape.get("pipe", 1) > 1)
             if fused_pp:
-                # The fused step's loss is the mean of per-microbatch
-                # masked means — correct only when every train batch is
-                # FULL. A padded tail batch would silently rescale
-                # loss/grads (an all-pad microbatch contributes 0 to the
-                # mean), so reject the configuration up front.
-                from ..loader.base import TRAIN
-                n_train = self.loader.class_lengths[TRAIN]
-                if n_train % self.loader.minibatch_size:
-                    raise ValueError(
-                        f"fused 1F1B training needs full batches: "
-                        f"n_train={n_train} is not a multiple of "
-                        f"minibatch_size={self.loader.minibatch_size} "
-                        "(the padded tail batch would skew the "
-                        "per-microbatch loss mean); adjust the batch "
-                        "size or unset pipeline_microbatches")
+                # Ragged tail batches are fine since round 5: the fused
+                # step weights each microbatch's loss by its mask count
+                # and normalizes by the batch total, landing exactly on
+                # the AD path's global masked mean
+                # (pipeline_compile.build_pipeline_step).
                 self._train_step, self._state_sh, self._batch_sh = \
                     self.workflow.make_pipeline_train_step(
                         self.optimizer, self.mesh, self.wstate,

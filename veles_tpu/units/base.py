@@ -52,15 +52,40 @@ class Context:
     never perturbs other units' streams).  ``mesh`` is the device mesh the
     step was compiled under (None on single-device paths) — parallelism-
     aware units (ring attention, pipeline stacks, MoE) read their axis
-    sizes off it."""
+    sizes off it.
+
+    ``manual_axes`` distinguishes the two collective regimes a unit can
+    find itself in.  ``None`` (the default) means ordinary traced code
+    under jit: a unit may open its own ``shard_map`` (the ring-attention
+    wrapper) or rely on GSPMD sharding propagation.  A tuple means the
+    unit is ALREADY executing inside an enclosing ``shard_map`` (a
+    pipeline schedule body) where opening another shard_map would
+    illegally nest — but raw named-axis collectives (psum / ppermute /
+    all_to_all) over the listed axes are legal and the schedule has laid
+    the unit's data out for them (round-4 verdict #3: collectives inside
+    fused-1F1B stages)."""
     train: bool = True
     key: Optional[jax.Array] = None
     mesh: Optional[Any] = None
+    manual_axes: Optional[Tuple[str, ...]] = None
 
     def axis_size(self, name: str) -> int:
         if self.mesh is None or name not in self.mesh.shape:
             return 1
         return self.mesh.shape[name]
+
+    def collective_mode(self, name: str) -> str:
+        """How a unit should parallelize over mesh axis ``name``:
+        ``"none"`` (axis absent/size 1, or inside a schedule that has not
+        prepared this axis — use the local formulation), ``"wrapper"``
+        (ordinary jit — open a shard_map / let GSPMD shard), or
+        ``"manual"`` (inside an enclosing shard_map — use raw collectives
+        over the named axis)."""
+        if self.axis_size(name) <= 1:
+            return "none"
+        if self.manual_axes is None:
+            return "wrapper"
+        return "manual" if name in self.manual_axes else "none"
 
     def unit_key(self, name: str) -> Optional[jax.Array]:
         if self.key is None:

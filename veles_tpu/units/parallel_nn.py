@@ -148,13 +148,15 @@ class MultiHeadAttention(Forward):
         }, {}
 
     def apply(self, params, state, xs, ctx: Context):
-        from ..parallel.ring_attention import (blockwise_attention,
+        from ..parallel.ring_attention import (_ring_attention_local,
+                                               blockwise_attention,
                                                ring_attention)
         x = xs[0]
         B, T, E = x.shape
         H = self.n_heads
         dt = self.compute_dtype or x.dtype
         xq = x.astype(dt)
+        mode = ctx.collective_mode(self.seq_axis)
 
         def proj(w, nh):
             return (xq @ w.astype(dt)).reshape(B, T, nh, -1)
@@ -164,9 +166,21 @@ class MultiHeadAttention(Forward):
         v = proj(params["wv"], self.n_kv_heads)
         if self.rope:
             from ..ops import rotary_embedding
-            q = rotary_embedding(q)
-            k = rotary_embedding(k)
-        if ctx.axis_size(self.seq_axis) > 1:
+            # manual mode: x is this rank's T-shard inside an enclosing
+            # shard_map (a pipeline schedule) — rotate by GLOBAL
+            # positions (rank offset); elsewhere x is logically global
+            off = (jax.lax.axis_index(self.seq_axis) * T
+                   if mode == "manual" else 0)
+            q = rotary_embedding(q, offset=off)
+            k = rotary_embedding(k, offset=off)
+        if mode == "manual":
+            # inside the fused-1F1B / schedule shard_map: the wrapper
+            # would illegally nest, but the ring body's raw ppermutes
+            # over the seq axis are legal — call it directly
+            o = _ring_attention_local(q, k, v, axis_name=self.seq_axis,
+                                      causal=self.causal, scale=None,
+                                      window=self.window)
+        elif mode == "wrapper":
             o = ring_attention(q, k, v, ctx.mesh, axis_name=self.seq_axis,
                                causal=self.causal, window=self.window)
         else:
@@ -194,7 +208,7 @@ class MoEFFN(Forward):
     def __init__(self, n_experts: int, d_hidden: int, name=None,
                  inputs=("@input",), *, top_k: int = 2,
                  capacity_factor: float = 1.25, aux_weight: float = 0.01,
-                 dispatch_mode: str = "sort"):
+                 dispatch_mode: str = "sort", expert_axis: str = "expert"):
         super().__init__(name, inputs)
         self.n_experts = int(n_experts)
         self.d_hidden = int(d_hidden)
@@ -204,6 +218,7 @@ class MoEFFN(Forward):
         # "sort" (scalable scatter/gather) or "dense" (one-hot einsums);
         # see parallel/moe.py module docstring
         self.dispatch_mode = dispatch_mode
+        self.expert_axis = expert_axis
 
     def output_spec(self, in_specs):
         return in_specs[0]
@@ -215,12 +230,24 @@ class MoEFFN(Forward):
         return params, {"aux_loss": jnp.zeros((), jnp.float32)}
 
     def apply(self, params, state, xs, ctx: Context):
-        from ..parallel.moe import moe_apply
+        from ..parallel.moe import moe_apply, moe_apply_manual
         x = xs[0]
         flat = x.reshape(-1, x.shape[-1])
-        y, aux = moe_apply(params, flat, top_k=self.top_k,
-                           capacity_factor=self.capacity_factor,
-                           dispatch_mode=self.dispatch_mode)
+        if ctx.collective_mode(self.expert_axis) == "manual":
+            # inside a pipeline-schedule shard_map with tokens sharded
+            # over the expert axis: explicit all_to_all dispatch to the
+            # rank owning each expert (round-4 verdict #3); GSPMD cannot
+            # see inside the manual body, so the exchange is hand-written
+            y, aux = moe_apply_manual(
+                params, flat, axis_name=self.expert_axis,
+                top_k=self.top_k, capacity_factor=self.capacity_factor)
+        else:
+            # ordinary jit: GSPMD lowers the dispatch/combine einsums to
+            # all_to_all when the expert banks are sharded; with no
+            # expert axis this IS the local dense-expert formulation
+            y, aux = moe_apply(params, flat, top_k=self.top_k,
+                               capacity_factor=self.capacity_factor,
+                               dispatch_mode=self.dispatch_mode)
         return (y.reshape(x.shape),
                 {"aux_loss": aux.astype(jnp.float32)})
 

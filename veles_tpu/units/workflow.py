@@ -393,6 +393,74 @@ class Workflow(Logger):
         lines.append("}")
         return "\n".join(lines)
 
+    def generate_svg(self) -> str:
+        """Self-contained SVG of the data DAG — a native renderer for the
+        browser workflow viewer (reference: the web UI's live graph,
+        /root/reference/web/viz.js fed by veles/workflow.py:628's DOT).
+        The reference shelled out to graphviz; this image has none, so a
+        simple layered layout (layer = 1 + max layer of inputs, left to
+        right) is computed here — exact enough for the linear-ish unit
+        chains workflows are."""
+        layer: Dict[str, int] = {}
+        inputs = sorted({s for u in self.units for s in u.inputs
+                         if s.startswith("@")})
+        for s in inputs:
+            layer[s] = 0
+        for u in self.topo_order():
+            layer[u.name] = 1 + max(
+                (layer.get(s, 0) for s in u.inputs), default=0)
+        cols: Dict[int, List[str]] = {}
+        kinds: Dict[str, str] = {s: "input" for s in inputs}
+        for u in self.topo_order():
+            kinds[u.name] = ("evaluator"
+                             if getattr(u, "is_evaluator", False)
+                             else type(u).__name__)
+        for name, li in layer.items():
+            cols.setdefault(li, []).append(name)
+        BW, BH, GX, GY, PAD = 148, 42, 52, 18, 16
+        pos: Dict[str, Tuple[int, int]] = {}
+        for li in sorted(cols):
+            for ri, name in enumerate(sorted(cols[li])):
+                pos[name] = (PAD + li * (BW + GX),
+                             PAD + ri * (BH + GY))
+        width = PAD * 2 + (max(cols) + 1) * (BW + GX) - GX
+        height = PAD * 2 + max(
+            len(v) for v in cols.values()) * (BH + GY) - GY
+        fills = {"input": "#eef", "evaluator": "#fee"}
+        parts = [
+            f'<svg xmlns="http://www.w3.org/2000/svg" width="{width}" '
+            f'height="{height}" font-family="monospace" font-size="11">',
+            '<defs><marker id="arr" viewBox="0 0 10 10" refX="9" refY="5"'
+            ' markerWidth="6" markerHeight="6" orient="auto">'
+            '<path d="M0,0L10,5L0,10z" fill="#555"/></marker></defs>']
+        for u in self.units:
+            x1, y1 = pos[u.name]
+            for s in u.inputs:
+                if s not in pos:
+                    continue
+                x0, y0 = pos[s]
+                parts.append(
+                    f'<line x1="{x0 + BW}" y1="{y0 + BH // 2}" '
+                    f'x2="{x1}" y2="{y1 + BH // 2}" stroke="#555" '
+                    'marker-end="url(#arr)"/>')
+        from html import escape
+        for name, (x, y) in pos.items():
+            kind = kinds.get(name, "")
+            fill = fills.get(kind, "#efe")
+            dash = ' stroke-dasharray="4 2"' if kind == "input" else ""
+            label = name if kind in ("input", "") else kind
+            parts.append(
+                f'<rect x="{x}" y="{y}" width="{BW}" height="{BH}" '
+                f'rx="6" fill="{fill}" stroke="#333"{dash}/>')
+            parts.append(f'<text x="{x + 6}" y="{y + 17}">'
+                         f'{escape(name[:20])}</text>')
+            if label != name:
+                parts.append(
+                    f'<text x="{x + 6}" y="{y + 33}" fill="#666">'
+                    f'{escape(label[:20])}</text>')
+        parts.append("</svg>")
+        return "\n".join(parts)
+
     def n_params(self, wstate) -> int:
         return sum(int(x.size) for x in jax.tree.leaves(wstate["params"]))
 
