@@ -618,6 +618,8 @@ def test_config_1f1b_sp_ep_composed_trains(rng):
     S, B, T, V, E = 2, 8, 8, 12, 16
     block = [{"type": "attention", "n_heads": 2, "rope": True,
               "residual": True},
+             {"type": "dropout", "dropout_ratio": 0.1},  # stochastic
+             # draws decorrelate via the stage-index + seq-rank key fold
              {"type": "layer_norm"},
              {"type": "moe", "n_experts": 4, "d_hidden": 32,
               "top_k": 1, "capacity_factor": 4.0},
@@ -774,3 +776,31 @@ def test_1f1b_het_stages_with_idle_expert_axis(rng):
         sw.optimizer, mesh, ws, specs, n_microbatches=S, donate=False)
     _, mets = step(jax.device_put(ws, state_sh), _lm_batch(rng, B, T, V))
     assert np.isfinite(float(mets["loss"]))
+
+
+def test_config_1f1b_sp_swa_gqa_matches_ad(rng):
+    """The manual ring inside fused stages carries the full attention
+    feature set: sliding-window (global-position mask) + grouped-query
+    (kv-head-sized ring traffic) — exact vs the AD path on pp2×sp2."""
+    S, B, T, V, E = 2, 8, 16, 12, 16
+    stage = [{"type": "attention", "n_heads": 4, "n_kv_heads": 2,
+              "window": 8, "rope": True, "residual": True},
+             {"type": "layer_norm"}]
+    cfg = _per_position_cfg(S, V, E, stage)
+    mesh = make_mesh(MeshSpec(data=2, seq=2, pipe=S))
+
+    sw, wf, specs = _pp_build(cfg, B, T, V)
+    ws0 = wf.init_state(jax.random.key(0), sw.optimizer)
+    batch = _pp_lm_batch(rng, B, T, V)
+
+    step_pp, state_sh, _ = wf.make_pipeline_train_step(
+        sw.optimizer, mesh, ws0, specs, n_microbatches=S, donate=False)
+    ws_pp, mets_pp = step_pp(jax.device_put(ws0, state_sh), batch)
+
+    sw2, wf2, _ = _pp_build(cfg, B, T, V)
+    step_ad = wf2.make_train_step(sw2.optimizer, donate=False)
+    ws_ad, mets_ad = step_ad(jax.tree.map(jnp.copy, ws0), batch)
+
+    np.testing.assert_allclose(float(mets_pp["loss"]),
+                               float(mets_ad["loss"]), rtol=2e-5)
+    _assert_params_match(ws_pp, ws_ad)
