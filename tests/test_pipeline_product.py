@@ -804,3 +804,58 @@ def test_config_1f1b_sp_swa_gqa_matches_ad(rng):
     np.testing.assert_allclose(float(mets_pp["loss"]),
                                float(mets_ad["loss"]), rtol=2e-5)
     _assert_params_match(ws_pp, ws_ad)
+
+
+def test_config_1f1b_fsdp_sharded_stage_params_matches_ad(rng):
+    """pp×fsdp at rest: stage parameters (and their optimizer state)
+    shard over the fsdp axis via the sharding rule; GSPMD all-gathers
+    them into the schedule's P(pipe) layout at step entry and
+    reduce-scatters the updates back — one fused step still matches the
+    single-device AD path exactly."""
+    from jax.sharding import PartitionSpec as P
+    from veles_tpu.parallel.mesh import compose_rules
+    from veles_tpu.units.parallel_nn import pipeline_rules
+    S, B, D = 2, 16, 16
+    mesh = make_mesh(MeshSpec(data=2, fsdp=2, pipe=S))
+    wf = build_workflow("pp_fsdp", [
+        {"type": "pipeline_stack", "n_stages": S, "d_hidden": 64,
+         "n_microbatches": S, "name": "stack"},
+        {"type": "softmax", "output_size": 5, "name": "out"},
+    ])
+    specs = {"@input": vt.Spec((B, D), jnp.float32),
+             "@labels": vt.Spec((B,), jnp.int32),
+             "@mask": vt.Spec((B,), jnp.float32)}
+    wf.build(specs)
+    o = opt.SGD(0.1)
+    ws0 = wf.init_state(jax.random.key(1), o)
+
+    def rule(path, spec):
+        # stage arrays (S, d_in, d_out): stage axis on pipe, the hidden
+        # dim on fsdp — persistent storage holds 1/(S·n_f) per device
+        if path and path[-1].startswith("stage_"):
+            return P("pipe", None, "fsdp")
+        return P()
+
+    batch = {"@input": jnp.asarray(rng.standard_normal((B, D)),
+                                   jnp.float32),
+             "@labels": jnp.asarray(rng.integers(0, 5, B), jnp.int32),
+             "@mask": jnp.ones((B,), jnp.float32)}
+    step_pp, state_sh, _ = wf.make_pipeline_train_step(
+        o, mesh, ws0, specs, n_microbatches=S, rule=rule, donate=False)
+    # the rule actually sharded the stage params at rest
+    sh = state_sh["params"]["stack"]["stage_w1"]
+    assert "fsdp" in str(sh.spec), sh.spec
+    ws_pp, mets_pp = step_pp(jax.device_put(ws0, state_sh), batch)
+
+    wf2 = build_workflow("pp_fsdp", [
+        {"type": "pipeline_stack", "n_stages": S, "d_hidden": 64,
+         "n_microbatches": S, "name": "stack"},
+        {"type": "softmax", "output_size": 5, "name": "out"},
+    ])
+    wf2.build(specs)
+    step_ad = wf2.make_train_step(opt.SGD(0.1), donate=False)
+    ws_ad, mets_ad = step_ad(jax.tree.map(jnp.copy, ws0), batch)
+
+    np.testing.assert_allclose(float(mets_pp["loss"]),
+                               float(mets_ad["loss"]), rtol=2e-5)
+    _assert_params_match(ws_pp, ws_ad)
