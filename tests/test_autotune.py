@@ -288,3 +288,60 @@ def test_attention_flash_choice_via_autotune(tuned):
     y, _ = u.apply(params, {}, [x], Context(train=True, key=key,
                                             mesh=None))
     assert y.shape == x.shape
+
+
+def test_attention_block_size_sweep(tuned, monkeypatch):
+    """Round-5: the attention autotune sweeps flash (block_q, block_k)
+    candidates per build shape (deduped by the kernel's effective
+    clamped blocks); a pre-sweep DB record fails lookup's candidate-set
+    staleness check and re-measures instead of mis-parsing."""
+    import jax
+    import veles_tpu as vt
+    from veles_tpu.runtime import autotune as at
+    from veles_tpu.runtime.benchmark import update_device_info
+    import veles_tpu.ops as vops
+    from veles_tpu.units.parallel_nn import MultiHeadAttention
+
+    # force the sweep path off-TPU: interpret-mode flash is measurable
+    # at tiny shapes (the product gate skips it; this tests the
+    # machinery, not the winner).  The gate (units' ops.use_pallas_
+    # default) must say "TPU-ish" while the kernels' own binding keeps
+    # saying CPU so _interpret(None) stays in interpreter mode.
+    from veles_tpu.ops import pallas_kernels as pk
+    monkeypatch.setattr(vops, "use_pallas_default", lambda *a: True)
+    monkeypatch.setattr(pk, "use_pallas_default", lambda *a: False)
+
+    u = MultiHeadAttention(2, name="sweep_attn", rope=True,
+                           residual=True)
+    u.prepare([vt.Spec((1, 16, 8), jnp.float32)])
+    assert u._resolved_flash in (True, False)
+    if u._resolved_flash:
+        assert u._resolved_blocks is None or (
+            isinstance(u._resolved_blocks, tuple)
+            and len(u._resolved_blocks) == 2)
+    db = json.load(open(os.path.join(tuned, "device_infos.json")))
+    (kind,) = db.keys()
+    entries = {k: v for k, v in db[kind]["autotune"].items()
+               if k.startswith("attention_fwd_bwd")}
+    assert entries
+    (key, rec), = entries.items()
+    # tiny T dedupes every candidate pair to ONE effective flash entry
+    flash_names = [n for n in rec["ms"] if n.startswith("flash_")]
+    assert len(flash_names) == 1, rec["ms"]
+    assert rec["winner"] in list(rec["ms"])
+
+    # a pre-sweep record ({flash, xla} candidate set) is STALE against
+    # the swept set: lookup returns None and prepare re-measures,
+    # overwriting the record with the full sweep
+    def seed_legacy(infos):
+        infos.setdefault("autotune", {})[key] = {
+            "ms": {"flash": 0.1, "xla": 0.2}, "winner": "flash"}
+    update_device_info(kind, seed_legacy)
+    at._memo.clear()
+    u2 = MultiHeadAttention(2, name="legacy_attn", rope=True,
+                            residual=True)
+    u2.prepare([vt.Spec((1, 16, 8), jnp.float32)])
+    db2 = json.load(open(os.path.join(tuned, "device_infos.json")))
+    rec2 = db2[kind]["autotune"][key]
+    assert "flash" not in rec2["ms"]          # re-measured, not reused
+    assert set(rec2["ms"]) == set(rec["ms"])

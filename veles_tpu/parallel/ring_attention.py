@@ -57,7 +57,8 @@ def _finalize(l, o):
 def blockwise_attention(q, k, v, *, block_size: int = 512,
                         causal: bool = False, scale: Optional[float] = None,
                         use_flash: Optional[bool] = None,
-                        window: Optional[int] = None):
+                        window: Optional[int] = None,
+                        flash_blocks: Optional[tuple] = None):
     """Memory-efficient attention on one device: scan over K/V blocks with
     online softmax. q/k/v: (B, T, H, D) -> (B, T, H, D).
 
@@ -72,10 +73,16 @@ def blockwise_attention(q, k, v, *, block_size: int = 512,
         from ..ops import use_pallas_default
         use_flash = use_pallas_default()
     if use_flash:
-        # The kernel's own block defaults (256x1024, swept on-chip —
-        # BASELINE.md) beat any 128-capped choice; ``block_size`` here
-        # only describes the jnp scan granularity below.
+        # The kernel's block defaults (256x1024, swept on-chip —
+        # BASELINE.md) beat any 128-capped choice; ``flash_blocks``
+        # overrides them with a per-build-shape autotuned pair
+        # (MultiHeadAttention.prepare).  ``block_size`` only describes
+        # the jnp scan granularity below.
         from ..ops.pallas_kernels import flash_attention
+        if flash_blocks is not None:
+            bq, bk = flash_blocks
+            return flash_attention(q, k, v, causal, scale, block_q=bq,
+                                   block_k=bk, window=window)
         return flash_attention(q, k, v, causal, scale, window=window)
     # GQA on the portable path: expand kv heads (the kernel path above
     # indexes shared kv blocks instead of materializing the repeat)

@@ -66,6 +66,9 @@ class MultiHeadAttention(Forward):
         # True/False forces; falls back to the platform default
         self.use_flash = use_flash
         self._resolved_flash = use_flash
+        # per-shape autotuned (block_q, block_k) for the flash kernel;
+        # None = the kernel's globally-swept defaults
+        self._resolved_blocks = None
 
     def prepare(self, in_specs):
         """Measure flash-kernel vs XLA blockwise attention fwd+bwd at
@@ -105,15 +108,36 @@ class MultiHeadAttention(Forward):
               f"_w{self.window}_hk{Hk}_bs{self.block_size}")
         shapes = [(B, T, H, D), (B, T, Hk, D), (B, T, Hk, D)]
         specs = [jax.ShapeDtypeStruct(s, dt) for s in shapes]
-        names = ("flash", "xla")
+
+        def parse(name):
+            # swept candidates carry their blocks in the name; a
+            # pre-sweep DB record fails lookup's candidate-set check
+            # and simply re-measures once
+            if name.startswith("flash_"):
+                bq, bk = name[len("flash_"):].split("x")
+                return True, (int(bq), int(bk))
+            return False, None
+
+        # flash candidates: the global on-chip default plus per-shape
+        # alternatives; dedupe by the kernel's EFFECTIVE clamped blocks
+        # so tiny T doesn't measure the same program four times
+        from ..ops.pallas_kernels import _flash_blocks
+        cand_blocks, seen = [], set()
+        for bq, bk in ((256, 1024), (512, 512), (256, 512), (128, 1024)):
+            eff = _flash_blocks(T, T, bq, bk)
+            if eff not in seen:
+                seen.add(eff)
+                cand_blocks.append((bq, bk))
+        names = tuple(f"flash_{bq}x{bk}" for bq, bk in cand_blocks) \
+            + ("xla",)
         cached = autotune.lookup(op, names, specs)
         if cached is not None:
-            self._resolved_flash = cached == "flash"
+            self._resolved_flash, self._resolved_blocks = parse(cached)
             return
         rng = np.random.default_rng(0)
         args = [jnp.asarray(rng.standard_normal(s), dt) for s in shapes]
 
-        def run(use_flash):
+        def run(use_flash, blocks=None):
             def f(q, k, v):
                 # value_and_grad: the primal keeps the forward alive
                 # under DCE, timing the full training cost
@@ -121,14 +145,18 @@ class MultiHeadAttention(Forward):
                     lambda q, k, v: jnp.sum(blockwise_attention(
                         q, k, v, block_size=self.block_size,
                         causal=self.causal, window=self.window,
-                        use_flash=use_flash).astype(jnp.float32)),
+                        use_flash=use_flash,
+                        flash_blocks=blocks).astype(jnp.float32)),
                     argnums=(0, 1, 2))(q, k, v)
             return f
 
-        winner = autotune.pick(
-            op, {"flash": run(True), "xla": run(False)}, args,
-            default="flash")
-        self._resolved_flash = winner == "flash"
+        candidates = {f"flash_{bq}x{bk}": run(True, (bq, bk))
+                      for bq, bk in cand_blocks}
+        candidates["xla"] = run(False)
+        winner = autotune.pick(op, candidates, args,
+                               default=f"flash_{cand_blocks[0][0]}"
+                                       f"x{cand_blocks[0][1]}")
+        self._resolved_flash, self._resolved_blocks = parse(winner)
 
     def output_spec(self, in_specs: Sequence[Spec]) -> Spec:
         return in_specs[0]
@@ -186,7 +214,8 @@ class MultiHeadAttention(Forward):
         else:
             o = blockwise_attention(q, k, v, block_size=self.block_size,
                                     causal=self.causal, window=self.window,
-                                    use_flash=self._resolved_flash)
+                                    use_flash=self._resolved_flash,
+                                    flash_blocks=self._resolved_blocks)
         y = o.reshape(B, T, -1) @ params["wo"].astype(dt)
         if self.residual:
             y = y + xq
