@@ -488,3 +488,46 @@ def test_cli_mesh_pp_sp_fused(tmp_path):
     assert data["workflow"] == "InductionLMPipeSeq"
     import math
     assert math.isfinite(float(data["best_value"]))
+
+
+def test_cli_mesh_interleaved_fused(tmp_path):
+    """pipeline_interleave in a JSON config reaches the interleaved
+    schedule through the CLI's direct Trainer construction (round-5:
+    this plumbing was missed until the verify drive caught it)."""
+    cfg = {
+        "workflow": {
+            "name": "cli_interleaved",
+            "layers": [
+                {"type": "embedding", "vocab": 12, "dim": 16,
+                 "name": "emb"},
+                {"type": "pipeline_stack", "name": "stack",
+                 "n_microbatches": 2,
+                 "stages": [[{"type": "attention", "n_heads": 2,
+                              "rope": True, "residual": True},
+                             {"type": "layer_norm"}]] * 4},
+                {"type": "seq_last", "name": "last"},
+                {"type": "softmax", "output_size": 12, "name": "out"},
+            ],
+            "optimizer": "sgd", "optimizer_args": {"lr": 0.1},
+            "max_epochs": 2, "pipeline_microbatches": 2,
+            "pipeline_interleave": 2},
+        "loader": {"name": "induction", "minibatch_size": 32,
+                   "seq_len": 8, "vocab": 12, "n_train": 128,
+                   "n_valid": 64}}
+    p = tmp_path / "iv.json"
+    p.write_text(json.dumps(cfg))
+    res = tmp_path / "res.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO,
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "import jax; jax.config.update('jax_platforms','cpu');"
+         "from veles_tpu.__main__ import main; import sys;"
+         "sys.exit(main(sys.argv[1:]))",
+         str(p), "--mesh", "data=4,pipe=2", "--result-file", str(res)],
+        capture_output=True, text=True, env=env, cwd=str(tmp_path),
+        timeout=600)
+    assert r.returncode == 0, r.stderr
+    data = json.loads(res.read_text())
+    import math
+    assert math.isfinite(float(data["best_value"]))

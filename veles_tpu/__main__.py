@@ -238,7 +238,9 @@ def _make_trainer_from_root(cfg: Config, args) -> Trainer:
     return Trainer(sw.workflow, loader, sw.optimizer, decision, snap,
                    mesh=mesh, rule=rule,
                    pipeline_microbatches=wf_cfg.get(
-                       "pipeline_microbatches"))
+                       "pipeline_microbatches"),
+                   pipeline_interleave=wf_cfg.get(
+                       "pipeline_interleave", 1))
 
 
 def _make_mesh(spec: Optional[str]):

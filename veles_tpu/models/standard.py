@@ -173,4 +173,6 @@ class StandardWorkflow:
         return Trainer(self.workflow, loader, self.optimizer, decision,
                        snapshotter, mesh=mesh, rule=rule,
                        pipeline_microbatches=self.config.get(
-                           "pipeline_microbatches"))
+                           "pipeline_microbatches"),
+                       pipeline_interleave=self.config.get(
+                           "pipeline_interleave", 1))

@@ -5,6 +5,7 @@ from .distributed import initialize_distributed, is_multihost, host_count
 from .launcher import HostLauncher, launch_hosts
 from .ring_attention import ring_attention, blockwise_attention
 from .pipeline import (pipeline_apply, pipeline_train_step,
-                       stack_stage_params, pipeline_stage_shardings)
+                       interleaved_train_step, stack_stage_params,
+                       pipeline_stage_shardings)
 from .moe import init_moe_params, moe_apply, moe_shardings
 from .pool import CliRunner, ParallelMap

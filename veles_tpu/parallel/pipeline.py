@@ -564,7 +564,7 @@ def pipeline_train_step(stage_fn: Union[Callable, Sequence[Callable]],
                         width_axes: Sequence[str] = (),
                         rng: Optional[jax.Array] = None,
                         ring_spec=None, with_aux: bool = False,
-                        shared: bool = False):
+                        shared: bool = False, interleave: int = 1):
     """Fused 1F1B pipeline training step: returns ``(loss, param_grads)``.
 
     Unlike :func:`pipeline_apply` + ``jax.grad`` (GPipe schedule: AD tapes
@@ -615,8 +615,27 @@ def pipeline_train_step(stage_fn: Union[Callable, Sequence[Callable]],
     exactly like batch axes (psum of per-shard means / shard count).
     """
     S = mesh.shape[axis_name]
+    v = int(interleave)
+    if v > 1 and not shared:
+        raise ValueError(
+            "interleave > 1 needs the shared stage dispatch (uniform "
+            "virtual chunks; heterogeneous lax.switch stages cannot "
+            "interleave)")
+    if v > 1 and ring_spec is None:
+        raise ValueError(
+            "pipeline_train_step's interleave mode uses the "
+            "heterogeneous-buffer contract (ring_spec); for the plain "
+            "uniform contract use interleaved_train_step")
     stacked, apply_local, p_specs, unravels = _prep_stages(
-        stage_fn, params, S, axis_name, shared=shared)
+        stage_fn, params, S * v if shared else S, axis_name,
+        shared=shared)
+    if v > 1:
+        # (L, P) raveled rows -> (S, v, P): row [d, j] is logical stage
+        # j*S + d, so P(pipe) shards the DEVICE axis
+        L = v * S
+        stacked = jnp.stack(
+            [jnp.stack([stacked[j * S + d] for j in range(v)])
+             for d in range(S)])
     n_mb = x.shape[0]
     if labels.shape[0] != n_mb:
         raise ValueError("labels must have the same microbatch count as x")
@@ -643,14 +662,23 @@ def pipeline_train_step(stage_fn: Union[Callable, Sequence[Callable]],
     keyed = rng is not None or het
     if het and rng is None:
         rng = jax.random.key(0)  # deterministic het stages: key unused
+    if v > 1:
+        body = functools.partial(
+            _interleaved_local, apply_local=apply_local,
+            loss_local=loss_fn, axis_name=axis_name,
+            batch_axes=batch_axes + width_axes, n_microbatches=n_mb,
+            n_stages=S, v=v, het=het, keyed=keyed,
+            ring_feat=ring_feat,
+            ring_dtype=ring_spec.dtype if het else None)
+    else:
+        body = functools.partial(
+            _1f1b_local, apply_local=apply_local,
+            loss_local=loss_fn, axis_name=axis_name,
+            batch_axes=batch_axes + width_axes, n_microbatches=n_mb,
+            n_stages=S, het=het, keyed=keyed, ring_feat=ring_feat,
+            ring_dtype=ring_spec.dtype if het else None)
     fn = jax.shard_map(
-        functools.partial(_1f1b_local, apply_local=apply_local,
-                          loss_local=loss_fn, axis_name=axis_name,
-                          batch_axes=batch_axes + width_axes,
-                          n_microbatches=n_mb,
-                          n_stages=S, het=het, keyed=keyed,
-                          ring_feat=ring_feat,
-                          ring_dtype=ring_spec.dtype if het else None),
+        body,
         mesh=mesh,
         in_specs=(p_specs, x_spec, lbl_spec) + ((P(),) if keyed else ()),
         out_specs=(p_specs, P(), P()),
@@ -659,12 +687,268 @@ def pipeline_train_step(stage_fn: Union[Callable, Sequence[Callable]],
     grouped_y = labels.reshape((S, n_mb // S) + labels.shape[1:])
     args = (rng,) if keyed else ()
     grads, loss, aux = fn(stacked, grouped_x, grouped_y, *args)
-    if unravels is not None:
+    if v > 1:
+        # (S, v, P) device/lane grouping -> the caller's logical order
+        grads = [unravels[l](grads[l % S, l // S])
+                 for l in range(S * v)]
+    elif unravels is not None:
         # hand grads back in the caller's per-stage structures, not the
         # internal zero-padded raveled stack
         grads = [un(grads[s]) for s, un in enumerate(unravels)]
     # `loss` excludes aux (the AD path's reporting contract: aux is its
     # own metric); grads ARE d(loss + aux)/dparams
+    if with_aux:
+        return loss, aux, grads
+    return loss, grads
+
+
+# ---------------------------------------------------------------------------
+# Interleaved 1F1B (virtual pipeline stages)
+# ---------------------------------------------------------------------------
+
+def _interleaved_local(stage_params, x_blk, y_blk, *args, apply_local,
+                       loss_local, axis_name: str, batch_axes,
+                       n_microbatches: int, n_stages: int, v: int,
+                       keyed: bool = False, het: bool = False,
+                       ring_feat=(), ring_dtype=None):
+    """Per-device interleaved-1F1B body under shard_map.
+
+    L = v·S logical stages; stage l lives on device l % S as lane
+    j = l // S — the Megatron interleaved schedule.  fwd slot (l, m)
+    runs at step s = m + l and bwd slot (l, m) at s = m + 2(L-1) - l;
+    each device runs v forward and v backward chunk-slots per step, so
+    the fill/drain bubble shrinks to (S-1)/(v·n_mb + ...) — v× smaller
+    than plain 1F1B — at the cost of v× the activation stash (the
+    standard bubble-for-memory trade; chunks are 1/v of the model, so
+    parameter memory per device is unchanged).
+
+    Activations hop up one device per CHUNK boundary on a (v, mb, ...)
+    stacked ring; at the S-1 → 0 wrap the message moves to the next
+    lane (chunk j·S-1 feeds chunk j·S).  Cotangents hop down with the
+    inverse lane shift.  The label conveyor loads L-S steps later than
+    the plain schedule so labels meet microbatch m's FINAL chunk at
+    step m + L - 1.
+
+    ``het``: the fused-compiler contract (heterogeneous buffers):
+    ``apply_local(l, p, x_in, x_ring, key) -> (ring_msg, out, aux)``
+    per LOGICAL stage l — the input conveyor keeps x's shape/dtype, the
+    ring lanes carry ``ring_feat`` per sample, and the last stage's
+    ``out`` feeds the loss locally (never rides the ring), exactly like
+    ``_1f1b_local``'s het mode."""
+    rng = args[0] if keyed else None
+    S, L = n_stages, v * n_stages
+    n_mb = n_microbatches
+    Q = n_mb // S
+    K = 2 * (L - 1) + 1
+    idx = jax.lax.axis_index(axis_name)
+    p_lanes = jax.tree.map(lambda a: a[0], stage_params)   # (v, ...)
+    x_local = x_blk[0]
+    y_local = y_blk[0]
+    mb_shape = x_local.shape[1:]
+    mb = mb_shape[0]
+    lbl_shape = y_local.shape[1:]
+    if het:
+        ring_shape, ring_dt = (mb,) + tuple(ring_feat), ring_dtype
+    else:
+        ring_shape, ring_dt = mb_shape, x_local.dtype
+
+    up = [(i, (i + 1) % S) for i in range(S)]
+    down = [(i, (i - 1) % S) for i in range(S)]
+    n_steps = n_mb + 2 * (L - 1)
+
+    def mb_key(m):
+        if rng is None:
+            return None
+        return jax.random.fold_in(rng, jnp.clip(m, 0, n_mb - 1))
+
+    if het:
+        def apply_full(l, p, xi, xr, key):
+            return apply_local(l, p, xi, xr, key)
+    else:
+        def apply_full(l, p, xi, xr, key):
+            cur = jnp.where(l == 0, xi, xr)
+            if keyed:
+                out, aux = apply_local(p, cur, key)
+            else:
+                out, aux = apply_local(p, cur), \
+                    jnp.zeros((), jnp.float32)
+            return out, out, aux
+
+    def body(carry, s):
+        (held, g_held, in_conv, lbl_conv, stash_in, stash_ring, gp_acc,
+         loss_acc, aux_acc) = carry
+
+        t_in = s + idx
+        own_in = (t_in >= idx * Q) & (t_in < (idx + 1) * Q) \
+            & (t_in < n_mb)
+        in_conv = jnp.where(
+            own_in, x_local[jnp.clip(t_in - idx * Q, 0, Q - 1)], in_conv)
+        t_lb = s - idx - (L - S)
+        own_lb = (t_lb >= idx * Q) & (t_lb < (idx + 1) * Q) \
+            & (t_lb < n_mb)
+        lbl_conv = jnp.where(
+            own_lb, y_local[jnp.clip(t_lb - idx * Q, 0, Q - 1)], lbl_conv)
+
+        ring_out, gx_out = [], []
+        gp_new = gp_acc
+        for j in range(v):
+            l = j * S + idx
+            m_f = s - l
+            f_valid = (m_f >= 0) & (m_f < n_mb)
+            p_j = jax.tree.map(lambda a, _j=j: a[_j], p_lanes)
+            ring_msg, out, aux_f = apply_full(
+                l, p_j, in_conv, held[j], mb_key(m_f))
+            slot = jnp.mod(jnp.clip(m_f, 0, n_mb - 1), K)
+            if het:
+                stash_in = jnp.where(
+                    f_valid, stash_in.at[j, slot].set(in_conv), stash_in)
+                stash_ring = jnp.where(
+                    f_valid, stash_ring.at[j, slot].set(held[j]),
+                    stash_ring)
+            else:
+                # one stash buffer: the pre-selected chunk input (the
+                # ring/conveyor selection re-applies identically in the
+                # VJP) — matching _1f1b_local's memory footprint
+                cur = jnp.where(l == 0, in_conv, held[j])
+                stash_in = jnp.where(
+                    f_valid, stash_in.at[j, slot].set(cur), stash_in)
+            ring_out.append(ring_msg)
+            aux_acc = aux_acc + jnp.where(
+                f_valid, aux_f.astype(jnp.float32), 0.0)
+
+            m_b = s - (2 * (L - 1) - l)
+            b_valid = (m_b >= 0) & (m_b < n_mb)
+            bslot = jnp.mod(jnp.clip(m_b, 0, n_mb - 1), K)
+            xi_saved = stash_in[j, bslot]
+            xr_saved = stash_ring[j, bslot] if het else xi_saved
+            is_last = l == L - 1
+            if j == v - 1:
+                # only lane v-1 can host the last logical stage: the
+                # loss forward+grad runs once per step, not per lane
+                loss_m, gy_last = jax.value_and_grad(loss_local)(
+                    out, lbl_conv)
+            else:
+                loss_m = jnp.zeros((), jnp.float32)
+                gy_last = jnp.zeros_like(out)
+            gy = jnp.where(is_last, gy_last, jnp.zeros_like(gy_last))
+            key_b = mb_key(m_b)
+            _, vjp = jax.vjp(
+                lambda p, xi, xr, _l=l: apply_full(_l, p, xi, xr, key_b),
+                p_j, xi_saved, xr_saved)
+            # one VJP for all three outputs; in uniform mode ring_msg
+            # and out alias one computation, so the ring cotangent (off
+            # the last stage) and the loss cotangent (on it) sum
+            # naturally — the same masking _1f1b_local uses
+            g_ring = g_held[j] if het else jnp.where(
+                is_last, jnp.zeros_like(g_held[j]), g_held[j])
+            gp, _, gxr = vjp((g_ring, gy, jnp.ones((), jnp.float32)))
+            gx = gxr  # zero at l == 0 (the stage read the conveyor)
+            gp_new = jax.tree.map(
+                lambda acc, g, _j=j: acc.at[_j].add(
+                    jnp.where(b_valid, g, 0)),
+                gp_new, gp)
+            gx_out.append(jnp.where(b_valid, gx, 0))
+            loss_acc = loss_acc + jnp.where(
+                is_last & f_valid, loss_m, 0.0)
+
+        ring = jax.lax.ppermute(jnp.stack(ring_out), axis_name, up)
+        gxs = jax.lax.ppermute(jnp.stack(gx_out), axis_name, down)
+        # lane shifts at the ring wrap (module doc)
+        ring = jnp.where(idx == 0, jnp.roll(ring, 1, axis=0), ring)
+        gxs = jnp.where(idx == S - 1, jnp.roll(gxs, -1, axis=0), gxs)
+        in_conv = jax.lax.ppermute(in_conv, axis_name, down)
+        lbl_conv = jax.lax.ppermute(lbl_conv, axis_name, up)
+        return (ring, gxs, in_conv, lbl_conv, stash_in, stash_ring,
+                gp_new, loss_acc, aux_acc), None
+
+    zeros_lane = jnp.zeros((v,) + ring_shape, ring_dt)
+    carry0 = (zeros_lane, zeros_lane,
+              jnp.zeros(mb_shape, x_local.dtype),
+              jnp.zeros(lbl_shape, y_local.dtype),
+              jnp.zeros((v, K) + mb_shape, x_local.dtype),
+              (jnp.zeros((v, K) + ring_shape, ring_dt) if het
+               else jnp.zeros((), jnp.float32)),
+              jax.tree.map(jnp.zeros_like, p_lanes),
+              jnp.zeros((), jnp.float32),
+              jnp.zeros((), jnp.float32))
+    (_, _, _, _, _, _, gp_acc, loss_acc, aux_acc), _ = jax.lax.scan(
+        body, carry0, jnp.arange(n_steps))
+    bsz = 1
+    for ax in batch_axes:
+        bsz *= jax.lax.psum(1, ax)
+        gp_acc = jax.tree.map(lambda g: jax.lax.psum(g, ax), gp_acc)
+        loss_acc = jax.lax.psum(loss_acc, ax)
+        aux_acc = jax.lax.psum(aux_acc, ax)
+    gp_acc = jax.tree.map(lambda g: g / bsz, gp_acc)
+    loss_acc = jax.lax.psum(loss_acc, axis_name) / bsz / n_mb
+    aux_acc = jax.lax.psum(aux_acc, axis_name) / bsz / n_mb
+    gp_acc = jax.tree.map(lambda g: g[None] / n_mb, gp_acc)
+    return gp_acc, loss_acc, aux_acc
+
+
+def interleaved_train_step(stage_fn: Callable, loss_fn: Callable,
+                           params, x, labels, mesh: Mesh, *,
+                           interleave: int,
+                           axis_name: str = "pipe",
+                           batch_axes: Sequence[str] = (),
+                           rng: Optional[jax.Array] = None,
+                           with_aux: bool = False):
+    """Interleaved (virtual-stage) 1F1B training step.
+
+    ``params``: stage-stacked pytree with leading axis L = interleave·S
+    (logical stage l lives on device l % S — the caller keeps the plain
+    (L, ...) layout; this function regroups to (S, v, ...) so P(pipe)
+    shards the device axis).  Uniform-buffer contract only (every chunk
+    preserves the microbatch shape); ``stage_fn(p, x)`` or — with
+    ``rng`` — ``stage_fn(p, x, key) -> (y, aux)`` exactly like
+    :func:`pipeline_train_step`'s uniform keyed mode, and the returned
+    (loss, grads) pair matches it: mean over microbatches, so the two
+    schedules are drop-in interchangeable under one optimizer.  Grads
+    come back in the caller's (L, ...) stacking.
+
+    Why: the fill/drain bubble of plain 1F1B is (S-1)/(n_mb + S-1);
+    splitting the model into v chunks per device overlaps v× more
+    useful work into the same fill, the Megatron interleaved schedule —
+    at v× the activation stash.
+    """
+    v = int(interleave)
+    S = mesh.shape[axis_name]
+    L = v * S
+    leaves = jax.tree.leaves(params)
+    if not leaves or any(a.shape[0] != L for a in leaves):
+        raise ValueError(
+            f"interleaved params need leading stage axis {L} "
+            f"(= interleave {v} × {axis_name} {S}); got "
+            f"{sorted({a.shape[0] for a in leaves})}")
+    n_mb = x.shape[0]
+    if labels.shape[0] != n_mb:
+        raise ValueError("labels must have the same microbatch count as x")
+    batch_axes, x_spec = _prep_batch(x, n_mb, S, mesh, axis_name,
+                                     batch_axes)
+    # (L, ...) -> (S, v, ...): row [d, j] is logical stage j*S + d
+    regrouped = jax.tree.map(
+        lambda a: jnp.stack(
+            [jnp.stack([a[j * S + d] for j in range(v)])
+             for d in range(S)]), params)
+    p_specs = jax.tree.map(
+        lambda a: P(axis_name, *([None] * (a.ndim - 1))), regrouped)
+    keyed = rng is not None
+    fn = jax.shard_map(
+        functools.partial(_interleaved_local, apply_local=stage_fn,
+                          loss_local=loss_fn, axis_name=axis_name,
+                          batch_axes=batch_axes, n_microbatches=n_mb,
+                          n_stages=S, v=v, keyed=keyed),
+        mesh=mesh,
+        in_specs=(p_specs, x_spec, x_spec) + ((P(),) if keyed else ()),
+        out_specs=(p_specs, P(), P()),
+        check_vma=False)
+    gx = x.reshape((S, n_mb // S) + x.shape[1:])
+    gy = labels.reshape((S, n_mb // S) + labels.shape[1:])
+    args = (rng,) if keyed else ()
+    grads, loss, aux = fn(regrouped, gx, gy, *args)
+    # (S, v, ...) -> caller's (L, ...)
+    grads = jax.tree.map(
+        lambda a: jnp.stack([a[l % S, l // S] for l in range(L)]), grads)
     if with_aux:
         return loss, aux, grads
     return loss, grads

@@ -91,7 +91,8 @@ class PipelinePlan:
     GPipe tape."""
 
     def __init__(self, wf, mesh, n_microbatches: int, *,
-                 axis_name: str = "pipe", seq_axis: str = "seq"):
+                 axis_name: str = "pipe", seq_axis: str = "seq",
+                 interleave: int = 1):
         from ..units.parallel_nn import PipelineStack
         from ..units.workflow import WorkflowError
         if wf.evaluator is None:
@@ -135,10 +136,15 @@ class PipelinePlan:
                 f"PipelineStack unit, found {len(stacks)}")
         self.stack = stacks[0]
         S = mesh.shape[axis_name]
-        if self.stack.n_stages != S:
+        self.v = int(interleave)
+        self.L = S * self.v
+        if self.stack.n_stages != self.L:
             raise WorkflowError(
                 f"PipelineStack has {self.stack.n_stages} stages but the "
-                f"{axis_name!r} mesh axis is {S}")
+                f"{axis_name!r} mesh axis is {S}"
+                + (f" with interleave {self.v} (needs {self.L} stages, "
+                   "one chunk lane per virtual stage)"
+                   if self.v > 1 else ""))
         si = order.index(self.stack)
         self.pre: List = order[:si]
         self.post: List = order[si + 1:]
@@ -460,7 +466,7 @@ class PipelinePlan:
     def split_params_shared(self, params: dict) -> List[dict]:
         units = self.stack._stage_units
         out = []
-        for i in range(self.S):
+        for i in range(self.L):
             sp = self.stack.stage_param_slice(params[self.stack.name], i)
             if units is not None:
                 sp = {f"u{j}": sp[u.name]
@@ -509,8 +515,8 @@ class PipelinePlan:
         act_w = _sample_size(act_l)
         y_l = self._local(self.y_shape)
         y_w = _sample_size(y_l)
-        S = self.S
-        stack = self.stack
+        last = self.L - 1   # logical: with interleave the template gets
+        stack = self.stack  # the LOGICAL stage index
 
         def template_apply(p_stack, x, ictx):
             if stack._stage_units is None:
@@ -537,7 +543,7 @@ class PipelinePlan:
                            manual_axes=ctx.manual_axes)
             mb = x_in.shape[0]
             is_first = idx == 0
-            is_last = idx == S - 1
+            is_last = idx == last
             aux = jnp.zeros((), jnp.float32)
             # pre chain on every device (uniform trace; garbage-in on
             # non-edge rows is masked out by the where below)
@@ -571,7 +577,7 @@ def build_pipeline_step(wf, optimizer, mesh, wstate, batch_spec, *,
                         n_microbatches: int, rule=None,
                         axis_name: str = "pipe",
                         batch_axes: Sequence[str] = ("data", "fsdp"),
-                        donate: bool = True):
+                        donate: bool = True, interleave: int = 1):
     """The product entry point (used by ``Workflow.make_pipeline_train_
     step``): returns ``(step_fn, state_shardings, batch_shardings)`` with
     the same call contract as ``make_sharded_train_step`` — so the Trainer
@@ -590,7 +596,8 @@ def build_pipeline_step(wf, optimizer, mesh, wstate, batch_spec, *,
     from .pipeline import pipeline_train_step
     from ..units.workflow import new_state
 
-    plan = PipelinePlan(wf, mesh, n_microbatches, axis_name=axis_name)
+    plan = PipelinePlan(wf, mesh, n_microbatches, axis_name=axis_name,
+                        interleave=interleave)
     # Unit state (MeanDispNormalizer dataset statistics) is READ-ONLY in
     # this framework's non-self-updating units — round-5 lift (round-4
     # verdict #5): the step threads wstate["state"] into the stage
@@ -625,7 +632,9 @@ def build_pipeline_step(wf, optimizer, mesh, wstate, batch_spec, *,
     if "expert" in baxes:
         manual += ("expert",)
     ctx = Context(train=True, key=None, mesh=mesh, manual_axes=manual)
-    shared = bool(manual)
+    # in-stage collectives AND virtual-stage interleaving both demand
+    # the shared (uniform-template) dispatch
+    shared = bool(manual) or plan.v > 1
     if shared:
         # In-stage collectives demand the SHARED stage dispatch (one
         # SPMD program cannot diverge its collective sequence across
@@ -699,7 +708,8 @@ def build_pipeline_step(wf, optimizer, mesh, wstate, batch_spec, *,
             stage_fns, loss_fn, split(params), xf, lf, mesh,
             axis_name=axis_name, batch_axes=baxes,
             width_axes=width_axes, rng=sub,
-            ring_spec=ring_spec, with_aux=True, shared=shared)
+            ring_spec=ring_spec, with_aux=True, shared=shared,
+            interleave=plan.v)
         merge = (plan.merge_grads_shared if shared
                  else plan.merge_grads)
         grads = merge(sgrads, params)

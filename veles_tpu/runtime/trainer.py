@@ -48,7 +48,8 @@ class Trainer(Logger):
                  optimizer: Optimizer, decision: Optional[Decision] = None,
                  snapshotter: Optional[Snapshotter] = None, *,
                  mesh=None, rule=None, recorder=None, status=None,
-                 prefetch: int = 2, pipeline_microbatches=None):
+                 prefetch: int = 2, pipeline_microbatches=None,
+                 pipeline_interleave: int = 1):
         self.workflow = workflow
         self.loader = loader
         self.optimizer = optimizer
@@ -63,6 +64,9 @@ class Trainer(Logger):
         # fused 1F1B schedule (Workflow.make_pipeline_train_step) instead
         # of AD-through-GPipe; eval keeps the forward GPipe path.
         self.pipeline_microbatches = pipeline_microbatches
+        # v>1: the interleaved (virtual-stage) 1F1B schedule —
+        # the stack needs v*pipe uniform stages
+        self.pipeline_interleave = int(pipeline_interleave)
         self._batch_sh = None
         self._state_sh = None
         self._batch_spec = None
@@ -121,6 +125,12 @@ class Trainer(Logger):
         if self.mesh is not None:
             fused_pp = (self.pipeline_microbatches is not None
                         and self.mesh.shape.get("pipe", 1) > 1)
+            if self.pipeline_interleave > 1 and not fused_pp:
+                raise ValueError(
+                    "pipeline_interleave needs the fused 1F1B schedule: "
+                    "set pipeline_microbatches and give the mesh a "
+                    "'pipe' axis > 1 (otherwise the v*S-stage stack "
+                    "would silently train sequentially)")
             if fused_pp:
                 # Ragged tail batches are fine since round 5: the fused
                 # step weights each microbatch's loss by its mask count
@@ -131,7 +141,8 @@ class Trainer(Logger):
                     self.workflow.make_pipeline_train_step(
                         self.optimizer, self.mesh, self.wstate,
                         self._batch_spec, rule=self.rule,
-                        n_microbatches=self.pipeline_microbatches)
+                        n_microbatches=self.pipeline_microbatches,
+                        interleave=self.pipeline_interleave)
             else:
                 self._train_step, self._state_sh, self._batch_sh = \
                     self.workflow.make_sharded_train_step(

@@ -497,11 +497,24 @@ class PipelineStack(Forward):
         x = xs[0]
         S = ctx.axis_size(self.pipe_axis)
         n_mb = self.n_microbatches or S
-        if S > 1:
-            if S != self.n_stages:
+        if S > 1 and S != self.n_stages:
+            if self.n_stages % S == 0 and not ctx.train:
+                # interleaved fused training (n_stages = v·S virtual
+                # chunks): the GPipe forward has no interleaved
+                # schedule, so EVAL/PREDICT run the numerically
+                # identical sequential form (GSPMD still shards the
+                # batch over the data axes).  At TRAIN time a mismatch
+                # stays an error — silently idling the pipe axis would
+                # be a large hidden perf cliff.
+                S = 1
+            else:
                 raise ValueError(
                     f"PipelineStack has {self.n_stages} stages but the "
-                    f"{self.pipe_axis!r} mesh axis is {S}")
+                    f"{self.pipe_axis!r} mesh axis is {S}"
+                    + (" (interleaved stacks train via "
+                       "pipeline_microbatches + pipeline_interleave)"
+                       if self.n_stages % S == 0 else ""))
+        if S > 1:
             if x.shape[0] % n_mb and ctx.train:
                 # At eval/predict an indivisible batch (single-sample
                 # serving) falls through to the numerically identical

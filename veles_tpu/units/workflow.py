@@ -299,7 +299,8 @@ class Workflow(Logger):
                                  n_microbatches: int, rule=None,
                                  batch_axes: Sequence[str] = ("data",
                                                               "fsdp"),
-                                 donate: bool = True):
+                                 donate: bool = True,
+                                 interleave: int = 1):
         """Compile the FUSED 1F1B pipeline training step (the model IS the
         pipeline): pre-units fold into stage 0, post-units + evaluator
         loss into the last stage, one PipelineStack supplies the stages.
@@ -307,12 +308,18 @@ class Workflow(Logger):
         ``(step_fn, state_shardings, batch_shardings)`` — so the Trainer
         swaps schedules on a config switch.  Backward memory is bounded
         by pipeline depth, not microbatch count (parallel/pipeline.py).
+
+        ``interleave=v`` runs the Megatron INTERLEAVED schedule: the
+        stack must have v·S uniform stages, device d hosts virtual
+        chunks d, S+d, ... and the fill/drain bubble shrinks ~v× at the
+        cost of v× the activation stash.
         """
         from ..parallel.pipeline_compile import build_pipeline_step
         return build_pipeline_step(
             self, optimizer, mesh, wstate, batch_spec,
             n_microbatches=n_microbatches, rule=rule,
-            batch_axes=batch_axes, donate=donate)
+            batch_axes=batch_axes, donate=donate,
+            interleave=interleave)
 
     def make_sharded_eval_step(self, mesh, wstate, batch_spec, *, rule=None):
         from ..parallel.mesh import batch_shardings, state_shardings
