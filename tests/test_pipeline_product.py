@@ -969,3 +969,36 @@ def test_trainer_interleaved_config_switch(rng):
     trainer.initialize(seed=0)
     res = trainer.run()
     assert np.isfinite(res["best_value"])
+
+
+def test_config_1f1b_interleaved_ep_matches_ad(rng):
+    """Interleave composes with expert parallelism too: pp2 × v2 × ep2
+    × dp2 — four virtual transformer-MoE chunks, manual all_to_all
+    inside each, one fused step exact vs AD (ample capacity,
+    aux_weight 0 — the rank-local aux statistic)."""
+    S, v, B, T, V, E = 2, 2, 8, 8, 12, 16
+    block = [{"type": "attention", "n_heads": 2, "rope": True,
+              "residual": True}, {"type": "layer_norm"},
+             {"type": "moe", "n_experts": 4, "d_hidden": 32, "top_k": 1,
+              "capacity_factor": 8.0, "aux_weight": 0.0},
+             {"type": "layer_norm"}]
+    cfg = _per_position_cfg(S, V, E, block)
+    cfg["layers"][1]["stages"] = [block] * (S * v)
+    mesh = make_mesh(MeshSpec(data=2, expert=2, pipe=S))
+
+    sw, wf, specs = _pp_build(cfg, B, T, V)
+    ws0 = wf.init_state(jax.random.key(0), sw.optimizer)
+    batch = _pp_lm_batch(rng, B, T, V)
+
+    step_pp, state_sh, _ = wf.make_pipeline_train_step(
+        sw.optimizer, mesh, ws0, specs, n_microbatches=S,
+        interleave=v, donate=False)
+    ws_pp, mets_pp = step_pp(jax.device_put(ws0, state_sh), batch)
+
+    sw2, wf2, _ = _pp_build(cfg, B, T, V)
+    step_ad = wf2.make_train_step(sw2.optimizer, donate=False)
+    ws_ad, mets_ad = step_ad(jax.tree.map(jnp.copy, ws0), batch)
+
+    np.testing.assert_allclose(float(mets_pp["loss"]),
+                               float(mets_ad["loss"]), rtol=2e-5)
+    _assert_params_match(ws_pp, ws_ad)
