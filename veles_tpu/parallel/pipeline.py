@@ -711,34 +711,54 @@ def _interleaved_local(stage_params, x_blk, y_blk, *args, apply_local,
                        n_microbatches: int, n_stages: int, v: int,
                        keyed: bool = False, het: bool = False,
                        ring_feat=(), ring_dtype=None):
-    """Per-device interleaved-1F1B body under shard_map.
+    """Per-device interleaved-1F1B body under shard_map — the Megatron
+    "virtual pipeline" schedule, one chunk-slot pair per device-step.
 
-    L = v·S logical stages; stage l lives on device l % S as lane
-    j = l // S — the Megatron interleaved schedule.  fwd slot (l, m)
-    runs at step s = m + l and bwd slot (l, m) at s = m + 2(L-1) - l;
-    each device runs v forward and v backward chunk-slots per step, so
-    the fill/drain bubble shrinks to (S-1)/(v·n_mb + ...) — v× smaller
-    than plain 1F1B — at the cost of v× the activation stash (the
-    standard bubble-for-memory trade; chunks are 1/v of the model, so
-    parameter memory per device is unchanged).
+    L = v·S logical stages; stage l lives on device d = l % S as lane
+    j = l // S.  Device d runs ONE chunk forward and ONE chunk backward
+    per step in the LOOPING order (groups of S microbatches sweep each
+    lane before the next lane starts):
 
-    Activations hop up one device per CHUNK boundary on a (v, mb, ...)
-    stacked ring; at the S-1 → 0 wrap the message moves to the next
-    lane (chunk j·S-1 feeds chunk j·S).  Cotangents hop down with the
-    inverse lane shift.  The label conveyor loads L-S steps later than
-    the plain schedule so labels meet microbatch m's FINAL chunk at
-    step m + L - 1.
+    * fwd slot of stage l on microbatch m at step
+      ``F(l, m) = vS·(m÷S) + S·j + (m mod S) + d``;
+    * bwd slot at
+      ``B(l, m) = vS·(m÷S) + S·(v−1−j) + (m mod S) + (S−1−d) + (L−1)``.
 
-    ``het``: the fused-compiler contract (heterogeneous buffers):
-    ``apply_local(l, p, x_in, x_ring, key) -> (ring_msg, out, aux)``
-    per LOGICAL stage l — the input conveyor keeps x's shape/dtype, the
-    ring lanes carry ``ring_feat`` per sample, and the last stage's
-    ``out`` feeds the loss locally (never rides the ring), exactly like
-    ``_1f1b_local``'s het mode."""
+    Both assignments give every device exactly one fwd and one bwd slot
+    per step (contiguous once filled), satisfy the one-hop dependency
+    chains (``F(l,m) = F(l−1,m)+1``, ``B(l,m) = B(l+1,m)+1``), and put
+    the last stage's bwd in the SAME step as its fwd (loss grad straight
+    off the fresh output, like the plain schedule).  Total span is
+    ``v·n_mb + L + S − 2`` chunk-pair steps — dependency-chain optimal
+    for this lockstep form, and v=1 reduces exactly to plain 1F1B.
+
+    Honest bubble accounting: the same L-chunk model folded v-per-stage
+    into plain 1F1B spans ``v·n_mb + 2v(S−1)`` chunk-pairs, so the
+    interleave saves ``v(S−2) − S + 2`` bubble steps — up to ~2× less
+    bubble at deep pipes (ratio → 2(S−1)/S), NOT the (S−1)/v of the
+    MPMD Megatron schedule: a single SPMD scan executes masked slots at
+    full cost and cannot skip a phase (a per-device fwd/bwd cond would
+    diverge the in-stage collective sequence), so the fill fills v×
+    faster but the paired fwd+bwd lockstep bounds the total gain.  The
+    trade costs v× the activation stash; parameter bytes per device are
+    unchanged.
+
+    Ring traffic is ONE chunk message per hop: consecutive devices'
+    current slots are lane-aligned by the timetable (the lane index
+    advances automatically across the S−1 → 0 wrap), so no stacked
+    lanes ride the ring.  The input conveyor loads mb m on its owner so
+    it reaches device 0 at ``F(0, m)``; the label conveyor reaches
+    device S−1 at ``F(L−1, m)``.
+
+    ``het``: the fused-compiler contract — ``apply_local(l, p, x_in,
+    x_ring, key) -> (ring_msg, out, aux)`` with l the (traced) logical
+    stage; uniform mode wraps ``stage_fn(p, x[, key])`` the same way
+    ``_1f1b_local`` does."""
     rng = args[0] if keyed else None
     S, L = n_stages, v * n_stages
     n_mb = n_microbatches
     Q = n_mb // S
+    vS = v * S
     K = 2 * (L - 1) + 1
     idx = jax.lax.axis_index(axis_name)
     p_lanes = jax.tree.map(lambda a: a[0], stage_params)   # (v, ...)
@@ -754,7 +774,7 @@ def _interleaved_local(stage_params, x_blk, y_blk, *args, apply_local,
 
     up = [(i, (i + 1) % S) for i in range(S)]
     down = [(i, (i - 1) % S) for i in range(S)]
-    n_steps = n_mb + 2 * (L - 1)
+    n_steps = v * n_mb + L + S - 2
 
     def mb_key(m):
         if rng is None:
@@ -774,95 +794,102 @@ def _interleaved_local(stage_params, x_blk, y_blk, *args, apply_local,
                     jnp.zeros((), jnp.float32)
             return out, out, aux
 
+    def lane_p(j):
+        return jax.tree.map(
+            lambda a: jax.lax.dynamic_index_in_dim(
+                a, j, 0, keepdims=False), p_lanes)
+
     def body(carry, s):
         (held, g_held, in_conv, lbl_conv, stash_in, stash_ring, gp_acc,
          loss_acc, aux_acc) = carry
 
-        t_in = s + idx
-        own_in = (t_in >= idx * Q) & (t_in < (idx + 1) * Q) \
-            & (t_in < n_mb)
+        # -- input conveyor: mb m must reach device 0 at F(0, m) =
+        # vS·g + r; loading on owner c happens c down-hops earlier
+        w_in = s + idx
+        r_in = jnp.mod(w_in, vS)
+        m_in = (w_in // vS) * S + r_in
+        own_in = (r_in < S) & (m_in >= idx * Q) \
+            & (m_in < (idx + 1) * Q) & (m_in < n_mb)
         in_conv = jnp.where(
-            own_in, x_local[jnp.clip(t_in - idx * Q, 0, Q - 1)], in_conv)
-        t_lb = s - idx - (L - S)
-        own_lb = (t_lb >= idx * Q) & (t_lb < (idx + 1) * Q) \
-            & (t_lb < n_mb)
+            own_in, x_local[jnp.clip(m_in - idx * Q, 0, Q - 1)], in_conv)
+        # -- label conveyor: arrival at device S-1 at F(L-1, m) =
+        # vS·g + S(v-1) + r + (S-1); loading is S-1-c up-hops earlier
+        w_lb = s - idx - S * (v - 1)
+        r_lb = jnp.mod(w_lb, vS)
+        m_lb = (w_lb // vS) * S + r_lb
+        own_lb = (w_lb >= 0) & (r_lb < S) & (m_lb >= idx * Q) \
+            & (m_lb < (idx + 1) * Q) & (m_lb < n_mb)
         lbl_conv = jnp.where(
-            own_lb, y_local[jnp.clip(t_lb - idx * Q, 0, Q - 1)], lbl_conv)
+            own_lb, y_local[jnp.clip(m_lb - idx * Q, 0, Q - 1)], lbl_conv)
 
-        ring_out, gx_out = [], []
-        gp_new = gp_acc
-        for j in range(v):
-            l = j * S + idx
-            m_f = s - l
-            f_valid = (m_f >= 0) & (m_f < n_mb)
-            p_j = jax.tree.map(lambda a, _j=j: a[_j], p_lanes)
-            ring_msg, out, aux_f = apply_full(
-                l, p_j, in_conv, held[j], mb_key(m_f))
-            slot = jnp.mod(jnp.clip(m_f, 0, n_mb - 1), K)
-            if het:
-                stash_in = jnp.where(
-                    f_valid, stash_in.at[j, slot].set(in_conv), stash_in)
-                stash_ring = jnp.where(
-                    f_valid, stash_ring.at[j, slot].set(held[j]),
-                    stash_ring)
-            else:
-                # one stash buffer: the pre-selected chunk input (the
-                # ring/conveyor selection re-applies identically in the
-                # VJP) — matching _1f1b_local's memory footprint
-                cur = jnp.where(l == 0, in_conv, held[j])
-                stash_in = jnp.where(
-                    f_valid, stash_in.at[j, slot].set(cur), stash_in)
-            ring_out.append(ring_msg)
-            aux_acc = aux_acc + jnp.where(
-                f_valid, aux_f.astype(jnp.float32), 0.0)
+        # -- forward slot: u = s - d encodes (group, lane, rank)
+        u_f = s - idx
+        j_f = jnp.mod(u_f, vS) // S
+        m_f = (u_f // vS) * S + jnp.mod(u_f, S)
+        l_f = j_f * S + idx
+        f_valid = (u_f >= 0) & (m_f < n_mb)
+        ring_msg, out, aux_f = apply_full(
+            l_f, lane_p(j_f), in_conv, held, mb_key(m_f))
+        slot_f = jnp.mod(jnp.clip(m_f, 0, n_mb - 1), K)
+        if het:
+            stash_in = jnp.where(
+                f_valid, stash_in.at[j_f, slot_f].set(in_conv), stash_in)
+            stash_ring = jnp.where(
+                f_valid, stash_ring.at[j_f, slot_f].set(held), stash_ring)
+        else:
+            cur = jnp.where(l_f == 0, in_conv, held)
+            stash_in = jnp.where(
+                f_valid, stash_in.at[j_f, slot_f].set(cur), stash_in)
+        aux_acc = aux_acc + jnp.where(
+            f_valid, aux_f.astype(jnp.float32), 0.0)
 
-            m_b = s - (2 * (L - 1) - l)
-            b_valid = (m_b >= 0) & (m_b < n_mb)
-            bslot = jnp.mod(jnp.clip(m_b, 0, n_mb - 1), K)
-            xi_saved = stash_in[j, bslot]
-            xr_saved = stash_ring[j, bslot] if het else xi_saved
-            is_last = l == L - 1
-            if j == v - 1:
-                # only lane v-1 can host the last logical stage: the
-                # loss forward+grad runs once per step, not per lane
-                loss_m, gy_last = jax.value_and_grad(loss_local)(
-                    out, lbl_conv)
-            else:
-                loss_m = jnp.zeros((), jnp.float32)
-                gy_last = jnp.zeros_like(out)
-            gy = jnp.where(is_last, gy_last, jnp.zeros_like(gy_last))
-            key_b = mb_key(m_b)
-            _, vjp = jax.vjp(
-                lambda p, xi, xr, _l=l: apply_full(_l, p, xi, xr, key_b),
-                p_j, xi_saved, xr_saved)
-            # one VJP for all three outputs; in uniform mode ring_msg
-            # and out alias one computation, so the ring cotangent (off
-            # the last stage) and the loss cotangent (on it) sum
-            # naturally — the same masking _1f1b_local uses
-            g_ring = g_held[j] if het else jnp.where(
-                is_last, jnp.zeros_like(g_held[j]), g_held[j])
-            gp, _, gxr = vjp((g_ring, gy, jnp.ones((), jnp.float32)))
-            gx = gxr  # zero at l == 0 (the stage read the conveyor)
-            gp_new = jax.tree.map(
-                lambda acc, g, _j=j: acc.at[_j].add(
-                    jnp.where(b_valid, g, 0)),
-                gp_new, gp)
-            gx_out.append(jnp.where(b_valid, gx, 0))
-            loss_acc = loss_acc + jnp.where(
-                is_last & f_valid, loss_m, 0.0)
+        # -- backward slot: u = s - (S-1-d) - (L-1) encodes the
+        # mirrored (group, lane, rank)
+        u_b = s - (S - 1 - idx) - (L - 1)
+        j_b = v - 1 - jnp.mod(u_b, vS) // S
+        m_b = (u_b // vS) * S + jnp.mod(u_b, S)
+        l_b = j_b * S + idx
+        b_valid = (u_b >= 0) & (m_b >= 0) & (m_b < n_mb)
+        slot_b = jnp.mod(jnp.clip(m_b, 0, n_mb - 1), K)
+        xi_saved = stash_in[j_b, slot_b]
+        xr_saved = stash_ring[j_b, slot_b] if het else xi_saved
+        is_last = (idx == S - 1) & (j_b == v - 1)
+        # B(L-1, m) == F(L-1, m): the last stage's loss grad comes off
+        # THIS step's forward output, exactly like the plain schedule
+        loss_m, gy_last = jax.value_and_grad(loss_local)(out, lbl_conv)
+        gy = jnp.where(is_last, gy_last, jnp.zeros_like(gy_last))
+        key_b = mb_key(m_b)
+        j_b_ = j_b
 
-        ring = jax.lax.ppermute(jnp.stack(ring_out), axis_name, up)
-        gxs = jax.lax.ppermute(jnp.stack(gx_out), axis_name, down)
-        # lane shifts at the ring wrap (module doc)
-        ring = jnp.where(idx == 0, jnp.roll(ring, 1, axis=0), ring)
-        gxs = jnp.where(idx == S - 1, jnp.roll(gxs, -1, axis=0), gxs)
+        def bwd_fn(p, xi, xr):
+            return apply_full(j_b_ * S + idx, p, xi, xr, key_b)
+
+        _, vjp = jax.vjp(bwd_fn, lane_p(j_b), xi_saved, xr_saved)
+        g_ring = g_held if het else jnp.where(
+            is_last, jnp.zeros_like(g_held), g_held)
+        gp, _, gxr = vjp((g_ring, gy, jnp.ones((), jnp.float32)))
+        gp_acc = jax.tree.map(
+            lambda acc, g: jax.lax.dynamic_update_index_in_dim(
+                acc, jnp.where(b_valid, g, 0)
+                + jax.lax.dynamic_index_in_dim(acc, j_b, 0,
+                                               keepdims=False),
+                j_b, 0),
+            gp_acc, gp)
+        gx = jnp.where(b_valid, gxr, jnp.zeros_like(gxr))
+        loss_acc = loss_acc + jnp.where(
+            is_last & (m_b >= 0) & (m_b < n_mb), loss_m, 0.0)
+
+        # -- hops: one chunk message each way; consecutive devices'
+        # slots are lane-aligned by the timetable (incl. at the wrap)
+        held = jax.lax.ppermute(ring_msg, axis_name, up)
+        g_held = jax.lax.ppermute(gx, axis_name, down)
         in_conv = jax.lax.ppermute(in_conv, axis_name, down)
         lbl_conv = jax.lax.ppermute(lbl_conv, axis_name, up)
-        return (ring, gxs, in_conv, lbl_conv, stash_in, stash_ring,
-                gp_new, loss_acc, aux_acc), None
+        return (held, g_held, in_conv, lbl_conv, stash_in, stash_ring,
+                gp_acc, loss_acc, aux_acc), None
 
-    zeros_lane = jnp.zeros((v,) + ring_shape, ring_dt)
-    carry0 = (zeros_lane, zeros_lane,
+    carry0 = (jnp.zeros(ring_shape, ring_dt),
+              jnp.zeros(ring_shape, ring_dt),
               jnp.zeros(mb_shape, x_local.dtype),
               jnp.zeros(lbl_shape, y_local.dtype),
               jnp.zeros((v, K) + mb_shape, x_local.dtype),
@@ -906,10 +933,12 @@ def interleaved_train_step(stage_fn: Callable, loss_fn: Callable,
     schedules are drop-in interchangeable under one optimizer.  Grads
     come back in the caller's (L, ...) stacking.
 
-    Why: the fill/drain bubble of plain 1F1B is (S-1)/(n_mb + S-1);
-    splitting the model into v chunks per device overlaps v× more
-    useful work into the same fill, the Megatron interleaved schedule —
-    at v× the activation stash.
+    Why: splitting the model into v chunks per device fills the
+    pipeline v× faster; total span drops from ``v·n_mb + 2v(S−1)``
+    chunk-pair steps (the same model folded into plain 1F1B) to
+    ``v·n_mb + L + S − 2`` — up to ~2× less bubble at deep pipes (see
+    ``_interleaved_local`` for why the SPMD lockstep bounds the gain
+    below MPMD Megatron's (S−1)/v) — at v× the activation stash.
     """
     v = int(interleave)
     S = mesh.shape[axis_name]

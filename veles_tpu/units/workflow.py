@@ -309,10 +309,11 @@ class Workflow(Logger):
         swaps schedules on a config switch.  Backward memory is bounded
         by pipeline depth, not microbatch count (parallel/pipeline.py).
 
-        ``interleave=v`` runs the Megatron INTERLEAVED schedule: the
-        stack must have v·S uniform stages, device d hosts virtual
-        chunks d, S+d, ... and the fill/drain bubble shrinks ~v× at the
-        cost of v× the activation stash.
+        ``interleave=v`` runs the INTERLEAVED schedule: the stack must
+        have v·S uniform stages, device d hosts virtual chunks d, S+d,
+        ... — up to ~2× less pipeline bubble than folding the chunks
+        into plain 1F1B (see parallel/pipeline.py::_interleaved_local
+        for the exact accounting) at v× the activation stash.
         """
         from ..parallel.pipeline_compile import build_pipeline_step
         return build_pipeline_step(
