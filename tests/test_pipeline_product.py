@@ -1002,3 +1002,37 @@ def test_config_1f1b_interleaved_ep_matches_ad(rng):
     np.testing.assert_allclose(float(mets_pp["loss"]),
                                float(mets_ad["loss"]), rtol=2e-5)
     _assert_params_match(ws_pp, ws_ad)
+
+
+def test_config_1f1b_interleaved_ragged_matches_ad(rng):
+    """Ragged batches compose with the interleaved timetable: the
+    mask-weighted loss's static rescale is schedule-independent — a
+    non-uniform @mask (incl. an all-pad microbatch) on pipe2×v2×dp4
+    matches the AD path exactly."""
+    S, v, B, T, V, E = 2, 2, 8, 8, 12, 16
+    stage = [{"type": "attention", "n_heads": 2, "rope": True,
+              "residual": True},
+             {"type": "layer_norm"}]
+    cfg = _per_position_cfg(S, V, E, stage)
+    cfg["layers"][1]["stages"] = [stage] * (S * v)
+    mesh = make_mesh(MeshSpec(data=4, pipe=S))
+
+    sw, wf, specs = _pp_build(cfg, B, T, V)
+    ws0 = wf.init_state(jax.random.key(0), sw.optimizer)
+    batch = _pp_lm_batch(rng, B, T, V)
+    mask = np.ones((B,), np.float32)
+    mask[5:] = 0.0
+    batch["@mask"] = jnp.asarray(mask)
+
+    step_pp, state_sh, _ = wf.make_pipeline_train_step(
+        sw.optimizer, mesh, ws0, specs, n_microbatches=S,
+        interleave=v, donate=False)
+    ws_pp, mets_pp = step_pp(jax.device_put(ws0, state_sh), batch)
+
+    sw2, wf2, _ = _pp_build(cfg, B, T, V)
+    step_ad = wf2.make_train_step(sw2.optimizer, donate=False)
+    ws_ad, mets_ad = step_ad(jax.tree.map(jnp.copy, ws0), batch)
+
+    np.testing.assert_allclose(float(mets_pp["loss"]),
+                               float(mets_ad["loss"]), rtol=2e-5)
+    _assert_params_match(ws_pp, ws_ad)
