@@ -61,6 +61,7 @@ def main():
         print(json.dumps({
             "metric": "alexnet_train_samples_per_sec_per_chip",
             "value": None, "unit": "samples/sec/chip", "vs_baseline": None,
+            "train_step_recompiles": None, "compile_wall_s": None,
             "error": f"device unavailable: {err}",
         }))
         return 1
@@ -73,9 +74,14 @@ def main():
     # Metrics measured so far; _die prints them so a mid-bench hang
     # (e.g. during the optional e2e blocks) still reports the staged
     # number instead of discarding it.
+    # train_step_recompiles / compile_wall_s track the compile-time side
+    # of the perf trajectory (the recompile-free lifecycle of
+    # docs/compile_cache.md) and are reported even when a later e2e
+    # block hangs, like the throughput numbers.
     partial = {"metric": "alexnet_train_samples_per_sec_per_chip",
                "value": None, "unit": "samples/sec/chip",
-               "vs_baseline": None}
+               "vs_baseline": None,
+               "train_step_recompiles": None, "compile_wall_s": None}
 
     def _die():
         out = dict(partial)
@@ -110,7 +116,23 @@ def main():
               "@labels": vt.Spec((BATCH,), jnp.int32),
               "@mask": vt.Spec((BATCH,), jnp.float32)})
     wstate = wf.init_state(jax.random.key(0), sw.optimizer)
-    step = wf.make_train_step(sw.optimizer)
+    # AOT-compile through the StepCache so the bench reports compile wall
+    # time and recompile count alongside throughput (compile-time wins
+    # register in the trajectory even when the device probe is flaky).
+    from veles_tpu.runtime.step_cache import StepCache
+    batch_spec = {
+        "@input": jax.ShapeDtypeStruct((BATCH, 227, 227, 3), jnp.float32),
+        "@labels": jax.ShapeDtypeStruct((BATCH,), jnp.int32),
+        "@mask": jax.ShapeDtypeStruct((BATCH,), jnp.float32)}
+    cache = StepCache()
+    step, _, _ = cache.get_step(
+        "train",
+        cache.trainer_key(wf, sw.optimizer, wstate, batch_spec),
+        lambda: (wf.make_train_step(sw.optimizer), None, None),
+        (wf.state_struct(wstate), batch_spec))
+    partial["compile_wall_s"] = round(cache.compile_wall_s, 3)
+    partial["train_step_recompiles"] = cache.recompiles
+    recompile_cnt = [cache]  # per-path caches; summed before printing
 
     # Pre-staged on-device batches (the fullbatch-loader pattern: data
     # resident in HBM, only indices travel — veles/loader/fullbatch.py:79).
@@ -159,6 +181,7 @@ def main():
             sw = build()
             trainer = sw.make_trainer(sw.loader)
             trainer.initialize(seed=0)
+            recompile_cnt.append(trainer.step_cache)
             if check is not None:
                 check(sw)
             trainer._run_epoch_train(0)  # compile + warm
@@ -200,6 +223,14 @@ def main():
         "device-aug", check=_must_be_on_device)
     if e2e_dev_sps:
         partial["e2e_device_aug_samples_per_sec"] = round(e2e_dev_sps, 1)
+
+    # compile-side trajectory: total compile wall across all measured
+    # paths and any compile beyond one-per-program (must stay 0 — the
+    # recompile-free lifecycle contract, tests/test_step_cache.py)
+    partial["train_step_recompiles"] = sum(
+        c.recompiles for c in recompile_cnt)
+    partial["compile_wall_s"] = round(
+        sum(c.compile_wall_s for c in recompile_cnt), 3)
 
     # -- host->device link bandwidth (context for the host-path e2e row:
     # over the axon tunnel this is the binding constraint, not the

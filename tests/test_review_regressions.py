@@ -219,5 +219,8 @@ def test_restore_reapplies_rollback_lr(tmp_path, rng):
     tr2.initialize(seed=1)
     tr2.restore(snap.last_path)
     base = opt.SGD(0.05).schedule(0)
-    assert float(tr2.optimizer.schedule(0)) == pytest.approx(
+    # the drop rides opt_state as a traced scalar (recompile-free
+    # restore); the base schedule itself is never mutated
+    assert float(tr2.optimizer.schedule(0)) == pytest.approx(float(base))
+    assert tr2.effective_lr(0) == pytest.approx(
         float(base) * tr2.decision.lr_multiplier)

@@ -104,6 +104,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--curriculum-out", default="curriculum_out",
                    help="directory for per-phase snapshots/results")
     p.add_argument("--mesh", help="mesh spec, e.g. data=4,model=2")
+    p.add_argument("--compile-cache", metavar="DIR", default=None,
+                   help="persistent XLA compilation cache directory "
+                        "(root.common.compile_cache): restarted runs "
+                        "with unchanged step programs skip the backend "
+                        "compile entirely; see docs/compile_cache.md")
     p.add_argument("--platform", default=None,
                    help="pin the jax platform (cpu/tpu/axon) BEFORE first "
                         "backend use. Needed because env vars alone are "
@@ -591,6 +596,10 @@ def main(argv=None) -> int:
     create, manifest_snapshot = _load_config(args.config, args.overrides)
     if manifest_snapshot and not args.snapshot:
         args.snapshot = manifest_snapshot
+    if args.compile_cache:
+        # flag wins over config/overrides; Trainer.initialize() activates
+        # it right before the first compile
+        root.common.compile_cache = args.compile_cache
 
     if args.dump_config:
         print(root.dump())

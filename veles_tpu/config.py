@@ -245,6 +245,16 @@ def _defaults():
     root.common.cache_dir = ".veles_tpu"
     root.common.autotune = True              # measured per-device op picks
     root.common.snapshot_dir = "snapshots"
+    # Persistent XLA compilation cache directory ("" = disabled): set via
+    # --compile-cache or root.common.compile_cache=DIR overrides; see
+    # runtime/step_cache.py and docs/compile_cache.md. Programs whose
+    # backend compile is faster than compile_cache_min_compile_secs are
+    # not persisted (0 = persist everything).
+    root.common.compile_cache = ""
+    root.common.compile_cache_min_compile_secs = 0.0
+    # Upper bound (MiB) on the tensors blob compare_snapshots /
+    # Snapshotter.load will download from an http(s):// snapshot URI.
+    root.common.snapshot_http_max_mb = 2048
     root.common.random_seed = 42
     root.common.platform = ""                # "" = let JAX pick
     root.common.mesh = dict(data=-1)          # -1: all remaining devices

@@ -75,6 +75,15 @@ LR_POLICIES = {
     "warmup_cosine": warmup_cosine_lr,
 }
 
+#: Reserved opt_state key carrying the cumulative learning-rate multiplier
+#: as a TRACED device scalar. Decision rollbacks multiply it by
+#: ``rollback_lr_scale`` on the host and write the new value into the live
+#: state — the compiled train step reads it every update, so an lr drop
+#: never forces a re-trace/re-compile (the multiplier used to be baked
+#: into a Python schedule closure, invalidating the whole XLA program).
+#: Unit names cannot collide with it (dunder names are not valid units).
+LR_MULT_KEY = "__lr_mult__"
+
 
 @dataclasses.dataclass(frozen=True)
 class HyperParams:
@@ -120,13 +129,27 @@ class Optimizer:
 
     # -- shared driver ------------------------------------------------------
     def init(self, params) -> Any:
-        return jax.tree.map(self.init_slot, params)
+        state = jax.tree.map(self.init_slot, params)
+        if isinstance(state, dict):
+            # the traced lr multiplier rides opt_state so it is sharded
+            # (replicated scalar), donated, and checkpointed with the rest
+            # of the training state
+            state[LR_MULT_KEY] = jnp.ones((), jnp.float32)
+        return state
 
     def _hp(self, unit_name: str) -> HyperParams:
         return self.per_unit.get(unit_name, HyperParams())
 
     def update(self, grads, state, params, step):
         lr = self.schedule(step)
+        # The traced rollback multiplier: states from init() carry it;
+        # legacy/empty states (init_state without an optimizer) fall back
+        # to the plain schedule and keep their structure unchanged (the
+        # step's output state must match its input sharding tree).
+        lr_mult = state.get(LR_MULT_KEY) if isinstance(state, dict) \
+            else None
+        if lr_mult is not None:
+            lr = lr * lr_mult
         if self.clip_norm is not None:
             gnorm = jnp.sqrt(sum(
                 jnp.sum(jnp.square(g.astype(jnp.float32)))
@@ -135,6 +158,8 @@ class Optimizer:
             grads = jax.tree.map(lambda g: g * scale, grads)
 
         new_params, new_state = {}, {}
+        if lr_mult is not None:
+            new_state[LR_MULT_KEY] = lr_mult
         for uname, uparams in params.items():
             hp = self._hp(uname)
             ugrads = grads[uname]

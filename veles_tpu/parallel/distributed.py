@@ -71,6 +71,19 @@ def to_global_batch(batch, mesh, shardings):
             for k, v in batch.items()}
 
 
+def place_batch(batch, mesh, shardings):
+    """Place one host batch under the compiled step's batch shardings —
+    the single entry point the Trainer's prefetch worker thread calls, so
+    the H2D transfer overlaps the previous step's compute.  Single-host:
+    an async ``jax.device_put`` under the NamedShardings.  Multi-host:
+    stitch this host's shard into the global SPMD batch
+    (``to_global_batch`` is collective-free — purely local buffer
+    assembly — hence safe off the main thread)."""
+    if is_multihost():
+        return to_global_batch(batch, mesh, shardings)
+    return jax.device_put(batch, shardings)
+
+
 def place_global_state(tree, shardings):
     """Place a host-replicated state pytree under (possibly
     non-addressable) global shardings — every host holds the same full

@@ -115,13 +115,27 @@ def _combine_slots(ye: jnp.ndarray, slot_idx: jnp.ndarray,
     return jnp.einsum("tk,tkd->td", w, yk)
 
 
-def _switch_aux(topi: jnp.ndarray, probs: jnp.ndarray) -> jnp.ndarray:
+def _switch_aux(topi: jnp.ndarray, probs: jnp.ndarray,
+                axis_name: Optional[str] = None) -> jnp.ndarray:
     """Switch load-balance loss on the primary assignment (bincount form:
-    no (T, E) one-hot materialization)."""
+    no (T, E) one-hot materialization).
+
+    With ``axis_name`` (the expert-parallel shard_map path) the token
+    statistics are psum'd over that axis first, so ``frac_tokens`` /
+    ``frac_probs`` are fractions of the dispatch group's FULL token batch
+    and the aux matches ``moe_apply``'s global-batch formulation exactly
+    — rank-local fractions averaged after the fact are NOT the same
+    number (E·Σ mean_r(f_r)·mean_r(p_r) ≠ mean_r(E·Σ f_r·p_r))."""
     T, E = probs.shape
-    frac_tokens = jnp.zeros(E, jnp.float32) \
-        .at[topi[:, 0]].add(1.0) / T
-    frac_probs = jnp.mean(probs.astype(jnp.float32), axis=0)
+    counts = jnp.zeros(E, jnp.float32).at[topi[:, 0]].add(1.0)
+    prob_sums = jnp.sum(probs.astype(jnp.float32), axis=0)
+    n_tokens = jnp.asarray(T, jnp.float32)
+    if axis_name is not None:
+        counts = jax.lax.psum(counts, axis_name)
+        prob_sums = jax.lax.psum(prob_sums, axis_name)
+        n_tokens = n_tokens * jax.lax.psum(1, axis_name)
+    frac_tokens = counts / n_tokens
+    frac_probs = prob_sums / n_tokens
     return E * jnp.sum(frac_tokens * frac_probs)
 
 
@@ -208,8 +222,10 @@ def moe_apply_manual(params: dict, x: jnp.ndarray, *, axis_name: str,
     cf·T_local·K/E slots per expert per rank) rather than globally —
     the standard expert-parallel behavior.  With capacity ample enough
     that nothing drops the outputs are exact to the global formulation;
-    the load-balance aux loss uses LOCAL token statistics (the caller
-    averages it across ranks).
+    the load-balance aux loss psums ``frac_tokens``/``frac_probs`` over
+    ``axis_name`` so it equals ``moe_apply``'s global-batch formulation
+    on the dispatch group's full token set (every rank returns the same
+    value — reductions that average it across ranks keep it exact).
     """
     T = x.shape[0]
     E = params["router"].shape[1]
@@ -235,7 +251,7 @@ def moe_apply_manual(params: dict, x: jnp.ndarray, *, axis_name: str,
     ye = jax.lax.all_to_all(yr, axis_name, split_axis=1, concat_axis=0,
                             tiled=True)       # (E, C, D)
     y = _combine_slots(ye, slot_idx, keep, gates, x.dtype)
-    aux = _switch_aux(topi, probs)
+    aux = _switch_aux(topi, probs, axis_name=axis_name)
     return y, aux
 
 

@@ -591,6 +591,13 @@ def build_pipeline_step(wf, optimizer, mesh, wstate, batch_spec, *,
     identically to the AD path instead of being rejected.  Without a
     mask input the loss is the mean of per-microbatch means (equal by
     construction).
+
+    The returned program is IMMORTAL for the workflow lifetime: the
+    optimizer update reads its lr (and the rollback multiplier) from
+    traced state (``ops.optimizers.LR_MULT_KEY``), so Decision rollbacks
+    and checkpoint restores never force this — by far the most expensive
+    — compile to rerun (runtime/step_cache.py caches the AOT
+    executable and logs its cost analysis).
     """
     from .mesh import batch_shardings, state_shardings
     from .pipeline import pipeline_train_step
@@ -598,6 +605,12 @@ def build_pipeline_step(wf, optimizer, mesh, wstate, batch_spec, *,
 
     plan = PipelinePlan(wf, mesh, n_microbatches, axis_name=axis_name,
                         interleave=interleave)
+    import logging
+    logging.getLogger("PipelinePlan").info(
+        "1F1B plan: %d stages (pipe=%d × v=%d), %d microbatches of %d, "
+        "transports in=%d/act=%d/out=%d lanes, seq shards=%d",
+        plan.L, plan.S, plan.v, plan.n_mb, plan.mb, plan.in_width,
+        plan.act_width, plan.y_width, plan.seq_shards)
     # Unit state (MeanDispNormalizer dataset statistics) is READ-ONLY in
     # this framework's non-self-updating units — round-5 lift (round-4
     # verdict #5): the step threads wstate["state"] into the stage

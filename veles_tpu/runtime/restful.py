@@ -134,9 +134,13 @@ class RestfulServer(Logger):
     @staticmethod
     def _req_int(v, name):
         """Integral coercion for JSON numerics: 2 / 2.0 / "2" -> 2;
-        2.5 / "x" / Infinity -> ValueError (the handler's 400 path).
-        JSON has no int/float distinction, so whole-valued floats must
-        coerce; silent truncation (int(2.7) -> 2) must not."""
+        2.5 / "x" / Infinity / true -> ValueError (the handler's 400
+        path).  JSON has no int/float distinction, so whole-valued floats
+        must coerce; silent truncation (int(2.7) -> 2) must not, and JSON
+        booleans must not ride the float path (float(True) == 1.0 would
+        silently accept {"n": true})."""
+        if isinstance(v, bool):
+            raise ValueError(f"{name} must be an integer, got {v!r}")
         try:
             f = float(v)
             i = int(f)

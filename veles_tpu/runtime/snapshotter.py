@@ -183,18 +183,59 @@ class Snapshotter(Logger):
         payload["wstate"] = _unflatten(flat)
         return payload
 
+    #: manifest JSON cap (MiB) — structure only, tensors live in the npz
+    _HTTP_MANIFEST_MAX_MB = 64
+
+    @staticmethod
+    def _read_capped(resp, limit: int, what: str, knob: str) -> bytes:
+        """Chunked read that refuses to exceed ``limit`` bytes — an
+        http(s):// snapshot URI points at a remote the caller may not
+        control (compare_snapshots on user-supplied URLs), so an
+        unbounded ``r.read()`` is a memory/denial surface.  The declared
+        Content-Length fails fast; a lying/chunked response is caught by
+        the running total.  ``knob`` names the limit's origin in the
+        error so the operator raises the RIGHT setting."""
+        try:  # a hostile server may declare garbage; the running total
+            declared = int(resp.headers.get("Content-Length", ""))
+        except ValueError:  # below still enforces the cap
+            declared = None
+        if declared is not None and declared > limit:
+            raise ValueError(
+                f"{what} declares {declared} bytes, over the "
+                f"{limit}-byte cap ({knob})")
+        chunks, total = [], 0
+        while True:
+            chunk = resp.read(1 << 20)
+            if not chunk:
+                return b"".join(chunks)
+            total += len(chunk)
+            if total > limit:
+                raise ValueError(
+                    f"{what} exceeded the {limit}-byte cap ({knob})")
+            chunks.append(chunk)
+
     @staticmethod
     def _load_http(url: str) -> Dict[str, Any]:
         """Fetch manifest + tensors npz over HTTP; the tensors reference in
-        the manifest is resolved relative to the manifest URL."""
+        the manifest is resolved relative to the manifest URL.  Both
+        downloads are size-capped (``root.common.snapshot_http_max_mb``
+        for the tensors blob)."""
         import io
         import urllib.parse
         import urllib.request
+        from ..config import root
+        max_bytes = int(float(root.common.get(
+            "snapshot_http_max_mb", 2048)) * 2**20)
         with urllib.request.urlopen(url, timeout=30.0) as r:
-            manifest = json.load(r)
+            manifest = json.loads(Snapshotter._read_capped(
+                r, Snapshotter._HTTP_MANIFEST_MAX_MB << 20,
+                f"snapshot manifest {url}",
+                "Snapshotter._HTTP_MANIFEST_MAX_MB"))
         tensors_url = urllib.parse.urljoin(url, manifest["tensors"])
         with urllib.request.urlopen(tensors_url, timeout=30.0) as r:
-            buf = io.BytesIO(r.read())
+            buf = io.BytesIO(Snapshotter._read_capped(
+                r, max_bytes, f"snapshot tensors {tensors_url}",
+                "root.common.snapshot_http_max_mb"))
         with np.load(buf, allow_pickle=False) as z:
             flat = {k: z[k] for k in z.files}
         payload = dict(manifest)

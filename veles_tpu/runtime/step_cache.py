@@ -1,0 +1,225 @@
+"""StepCache: ahead-of-time step compilation cache + persistent XLA cache.
+
+On TPU a sharded (or 1F1B-pipelined) train-step compile is the single most
+expensive host-side event in a run — tens of seconds for a real model.  The
+rebuild's two-program design (SURVEY.md §7) makes the program set small and
+static, so the lifecycle goal is simple: **compile each program exactly
+once per workflow lifetime**, and never again on a Decision rollback, a
+``Trainer.restore``, or a re-``initialize`` with unchanged shapes.
+
+Three layers:
+
+* the traced lr multiplier (``ops.optimizers.LR_MULT_KEY``) removes the
+  only *semantic* reason the Trainer ever re-traced a step;
+* this in-process cache AOT-compiles each step via ``.lower().compile()``
+  and keys it on everything that determines the traced program — the
+  workflow instance (pinned), its graph checksum, the state/batch
+  structures, mesh axes + devices, sharding-rule identity, optimizer
+  configuration, and the pipeline schedule knobs.  Its counters
+  (``compiles`` / ``hits`` / ``recompiles``) are the observable contract
+  tests assert on;
+* JAX's persistent compilation cache (:func:`enable_persistent_cache`,
+  ``root.common.compile_cache`` / ``--compile-cache``) carries compiled
+  executables ACROSS processes, keyed on the HLO — a restarted run with
+  an unchanged program skips XLA entirely.
+
+Per-program cost analysis (FLOPs, bytes accessed, compile wall seconds)
+is logged through the existing :class:`~veles_tpu.logger.TraceContext` /
+event-trace path, so ``root.common.trace_file`` timelines show compile
+cost next to step cost.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+
+from ..config import root
+from ..logger import Logger, TraceContext
+
+
+def enable_persistent_cache(cache_dir: Optional[str] = None) -> bool:
+    """Point JAX's persistent compilation cache at ``cache_dir`` (default:
+    ``root.common.compile_cache``; empty = disabled).  Idempotent, safe to
+    call before every compile; returns whether the cache is active.
+
+    The persistent cache is keyed on the optimized HLO + compile options,
+    so it composes with (rather than replaces) the in-process StepCache:
+    a process restart re-traces but skips the XLA backend compile.
+    """
+    import os
+    cache_dir = cache_dir if cache_dir is not None \
+        else root.common.get("compile_cache", "")
+    if not cache_dir:
+        return False
+    cache_dir = os.path.abspath(os.path.expanduser(str(cache_dir)))
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    # default min-compile-time gate (1s) would silently skip the small
+    # CPU-tier programs the tests exercise; cache everything unless the
+    # config says otherwise
+    min_secs = float(root.common.get("compile_cache_min_compile_secs", 0.0))
+    try:
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", min_secs)
+    except (AttributeError, ValueError):  # older jax without the knob
+        pass
+    # jax initializes its cache object at most ONCE, at the first backend
+    # compile — a loader/prng jit before this call would freeze it to
+    # "no directory" forever; reset to pristine when the live cache does
+    # not point at the requested directory so the update takes effect.
+    try:
+        from jax._src import compilation_cache as _cc
+        live = getattr(_cc, "_cache", None)
+        # _path is a pathlib-style object — compare as str, else the
+        # mismatch guard is always true and every call resets
+        live_path = str(getattr(live, "_path", "")) if live is not None \
+            else None
+        if getattr(_cc, "_cache_initialized", False) \
+                and live_path != cache_dir:
+            _cc.reset_cache()
+    except Exception:
+        pass
+    return True
+
+
+def _leaf_sig(path, leaf) -> Tuple[str, str, str]:
+    return (jax.tree_util.keystr(path),
+            str(getattr(leaf, "shape", ())),
+            str(getattr(leaf, "dtype", type(leaf).__name__)))
+
+
+def tree_signature(tree) -> Tuple:
+    """Hashable (path, shape, dtype) signature of a pytree of arrays or
+    ShapeDtypeStructs — the part of a step's identity its checksum does
+    not cover (layer widths, optimizer slot layout, batch geometry)."""
+    return tuple(_leaf_sig(p, l) for p, l in
+                 jax.tree_util.tree_leaves_with_path(tree))
+
+
+def _optimizer_signature(optimizer) -> Tuple:
+    """Scalar hyperparameters by value + schedule IDENTITY.  The schedule
+    is an opaque closure baked into the trace, so it can only be compared
+    by object identity — a rebuilt optimizer therefore always misses even
+    with identical settings (conservative: a stale hit would silently
+    train with the wrong lr curve).  The scalars still matter: they make
+    a mutated optimizer on the SAME schedule object miss."""
+    scalars = tuple(sorted(
+        (k, v) for k, v in vars(optimizer).items()
+        if isinstance(v, (int, float, bool, str))))
+    per_unit = getattr(optimizer, "per_unit", None)
+    return (type(optimizer).__name__, scalars,
+            id(getattr(optimizer, "schedule", None)),
+            repr(sorted(per_unit.items())) if per_unit else None)
+
+
+class StepCache(Logger):
+    """Process-level cache of AOT-compiled step executables.
+
+    ``get_step(kind, key, builder, args)`` returns the cached
+    ``(step_fn, state_shardings, batch_shardings)`` for ``(kind, key)``
+    or invokes ``builder`` once, lowers the jitted function against the
+    argument ShapeDtypeStructs, compiles it, logs its cost analysis, and
+    caches the executable.  ``builder`` must return the
+    ``(jitted_fn, state_sh, batch_sh)`` triple of the Workflow ``make_*``
+    contract (state_sh/batch_sh may be None off-mesh).
+
+    Counters: ``compiles`` is the number of trace+compile events ever,
+    ``hits`` the number served from cache, ``recompiles`` the compiles
+    beyond one per distinct program — the quantity the recompile-free
+    lifecycle keeps at zero across rollbacks and restores.
+    """
+
+    def __init__(self, *, aot: bool = True):
+        self.aot = aot
+        self._entries: Dict[Any, dict] = {}
+        self.compiles = 0
+        self.hits = 0
+        self.compile_wall_s = 0.0
+
+    @property
+    def recompiles(self) -> int:
+        return self.compiles - len(self._entries)
+
+    # -- keys ---------------------------------------------------------------
+    def trainer_key(self, workflow, optimizer, wstate, batch_spec, *,
+                    mesh=None, rule=None, pipeline: Tuple = ()) -> Tuple:
+        """Cache key for a Trainer's step programs.
+
+        The workflow INSTANCE anchors the key (unit hyperparameters like
+        dropout ratios live on unit objects and are invisible to both the
+        topology checksum and the state signature); the entry pins a
+        strong reference so ``id`` stays unique while cached.  The
+        structural components make shape/mesh/optimizer changes miss
+        instead of serving a stale executable.
+        """
+        mesh_sig = None
+        if mesh is not None:
+            mesh_sig = (tuple(mesh.shape.items()),
+                        tuple(d.id for d in mesh.devices.flat))
+        return (id(workflow), workflow.checksum(),
+                tree_signature(wstate), tree_signature(batch_spec),
+                mesh_sig, id(rule) if rule is not None else None,
+                _optimizer_signature(optimizer), tuple(pipeline))
+
+    # -- the cache ----------------------------------------------------------
+    def get_step(self, kind: str, key: Tuple,
+                 builder: Callable[[], Tuple], args: Tuple, *,
+                 pin: Tuple = ()) -> Tuple:
+        """Fetch or build+AOT-compile the ``kind`` ('train'/'eval') step."""
+        full_key = (kind,) + tuple(key)
+        ent = self._entries.get(full_key)
+        if ent is not None:
+            self.hits += 1
+            return ent["fn"], ent["state_sh"], ent["batch_sh"]
+
+        with TraceContext("step_compile", program=kind):
+            t0 = time.perf_counter()
+            fn, state_sh, batch_sh = builder()
+            compiled = None
+            if self.aot:
+                try:
+                    compiled = fn.lower(*args).compile()
+                except Exception as e:  # exotic signature: keep the jit
+                    self.warning(
+                        "AOT compile of %s step failed (%s: %s); falling "
+                        "back to on-demand jit", kind, type(e).__name__, e)
+            wall = time.perf_counter() - t0
+        self.compiles += 1
+        self.compile_wall_s += wall
+
+        cost: Dict[str, float] = {}
+        if compiled is not None:
+            try:
+                ca = compiled.cost_analysis()
+                if isinstance(ca, (list, tuple)):
+                    ca = ca[0] if ca else {}
+                for label, k in (("flops", "flops"),
+                                 ("bytes_accessed", "bytes accessed")):
+                    if k in ca:
+                        cost[label] = float(ca[k])
+            except Exception:  # cost analysis is best-effort observability
+                pass
+        self.event("step_compile", program=kind, wall_s=round(wall, 4),
+                   **cost)
+        self.info(
+            "compiled %s step in %.2fs (%.3g GFLOP/step, %.3g MB/step)",
+            kind, wall, cost.get("flops", 0.0) / 1e9,
+            cost.get("bytes_accessed", 0.0) / 1e6)
+        self._entries[full_key] = {
+            "fn": compiled if compiled is not None else fn,
+            "state_sh": state_sh, "batch_sh": batch_sh,
+            "wall_s": wall, "cost": cost,
+            # strong refs keep id()-anchored key components unique for
+            # the cache's lifetime (id reuse after GC would alias keys)
+            "pin": pin,
+        }
+        return (self._entries[full_key]["fn"], state_sh, batch_sh)
+
+    def stats(self) -> Dict[str, Any]:
+        """JSON-able summary for benchmarks and status pages."""
+        return {"programs": len(self._entries), "compiles": self.compiles,
+                "hits": self.hits, "recompiles": self.recompiles,
+                "compile_wall_s": round(self.compile_wall_s, 3)}

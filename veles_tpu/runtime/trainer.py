@@ -17,16 +17,18 @@ import time
 from typing import Any, Dict, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from .. import prng
 from ..config import root
 from ..loader.base import TRAIN, VALID, TEST, Loader
 from ..logger import Logger, TraceContext
-from ..ops.optimizers import Optimizer
+from ..ops.optimizers import LR_MULT_KEY, Optimizer
 from ..units.workflow import Workflow
 from .decision import Decision
 from .snapshotter import Snapshotter, _to_numpy
+from .step_cache import StepCache, enable_persistent_cache
 
 
 def aggregate_epoch_metrics(sums: Dict[str, float]) -> Dict[str, float]:
@@ -49,7 +51,8 @@ class Trainer(Logger):
                  snapshotter: Optional[Snapshotter] = None, *,
                  mesh=None, rule=None, recorder=None, status=None,
                  prefetch: int = 2, pipeline_microbatches=None,
-                 pipeline_interleave: int = 1):
+                 pipeline_interleave: int = 1,
+                 step_cache: Optional[StepCache] = None):
         self.workflow = workflow
         self.loader = loader
         self.optimizer = optimizer
@@ -67,12 +70,18 @@ class Trainer(Logger):
         # v>1: the interleaved (virtual-stage) 1F1B schedule —
         # the stack needs v*pipe uniform stages
         self.pipeline_interleave = int(pipeline_interleave)
+        # AOT step-compilation cache: each program compiles once per
+        # workflow lifetime; rollbacks/restores are cache hits (the lr
+        # drop rides opt_state as a traced scalar, see ops.optimizers).
+        self.step_cache = step_cache if step_cache is not None \
+            else StepCache()
         self._batch_sh = None
         self._state_sh = None
         self._batch_spec = None
         self.wstate = None
         self._train_step = None
         self._eval_step = None
+        self._eval_entry = None
         self._best_wstate = None
         self.results: Dict[str, Any] = {}
 
@@ -109,10 +118,10 @@ class Trainer(Logger):
                 (s.shape[0] * host_count(),) + tuple(s.shape[1:]), s.dtype)
                 for k, s in specs.items()}
         self._batch_spec = specs
-        # The unscaled schedule: rollback/restore always compose the
-        # cumulative decision.lr_multiplier onto THIS, never onto an
-        # already-scaled schedule (which would compound the drop).
-        self._base_schedule = self.optimizer.schedule
+        # Persistent XLA compilation cache (no-op unless
+        # root.common.compile_cache / --compile-cache points somewhere):
+        # must be active BEFORE the first compile to be of any use.
+        enable_persistent_cache()
         self._compile_steps()
         if self._state_sh is not None:
             self.wstate = self._place_state(self.wstate)
@@ -120,8 +129,19 @@ class Trainer(Logger):
                   self.workflow.n_params(self.wstate))
 
     def _compile_steps(self) -> None:
-        """(Re)build train/eval steps, preserving mesh shardings — called at
-        init and after a rollback lr change."""
+        """Build (or fetch from the StepCache) the AOT-compiled train/eval
+        steps, preserving mesh shardings.  Compiled exactly ONCE per
+        workflow lifetime: a Decision rollback or ``restore`` with
+        ``lr_multiplier != 1`` is a pure cache hit — the lr drop is a
+        traced opt_state scalar, not a new Python closure."""
+        state_struct = self.workflow.state_struct(self.wstate)
+        key = self.step_cache.trainer_key(
+            self.workflow, self.optimizer, self.wstate, self._batch_spec,
+            mesh=self.mesh, rule=self.rule,
+            pipeline=(self.pipeline_microbatches,
+                      self.pipeline_interleave))
+        pin = (self.workflow, self.rule, self.optimizer)
+        args = (state_struct, dict(self._batch_spec))
         if self.mesh is not None:
             fused_pp = (self.pipeline_microbatches is not None
                         and self.mesh.shape.get("pipe", 1) > 1)
@@ -137,33 +157,64 @@ class Trainer(Logger):
                 # and normalizes by the batch total, landing exactly on
                 # the AD path's global masked mean
                 # (pipeline_compile.build_pipeline_step).
-                self._train_step, self._state_sh, self._batch_sh = \
-                    self.workflow.make_pipeline_train_step(
+                def build_train():
+                    return self.workflow.make_pipeline_train_step(
                         self.optimizer, self.mesh, self.wstate,
                         self._batch_spec, rule=self.rule,
                         n_microbatches=self.pipeline_microbatches,
                         interleave=self.pipeline_interleave)
             else:
-                self._train_step, self._state_sh, self._batch_sh = \
-                    self.workflow.make_sharded_train_step(
+                def build_train():
+                    return self.workflow.make_sharded_train_step(
                         self.optimizer, self.mesh, self.wstate,
                         self._batch_spec, rule=self.rule)
-            self._eval_step, _, _ = self.workflow.make_sharded_eval_step(
-                self.mesh, self.wstate, self._batch_spec, rule=self.rule)
+
+            def build_eval():
+                return self.workflow.make_sharded_eval_step(
+                    self.mesh, self.wstate, self._batch_spec,
+                    rule=self.rule)
         else:
-            self._state_sh = None
-            self._train_step = self.workflow.make_train_step(self.optimizer)
-            self._eval_step = self.workflow.make_eval_step()
+            def build_train():
+                return (self.workflow.make_train_step(self.optimizer),
+                        None, None)
+
+            def build_eval():
+                return self.workflow.make_eval_step(), None, None
+
+        self._train_step, self._state_sh, self._batch_sh = \
+            self.step_cache.get_step("train", key, build_train, args,
+                                     pin=pin)
+        # The eval program compiles LAZILY on the first eval epoch — a
+        # train-only run (no VALID/TEST data, bench loops) never pays
+        # for a program it does not execute.
+        self._eval_step = None
+        self._eval_entry = (key, build_eval, args, pin)
+
+    def _ensure_eval_step(self):
+        if self._eval_step is None:
+            key, build_eval, args, pin = self._eval_entry
+            self._eval_step, _, _ = \
+                self.step_cache.get_step("eval", key, build_eval, args,
+                                         pin=pin)
+        return self._eval_step
 
     # -- epoch passes -------------------------------------------------------
     def _batches(self, klass: int, epoch):
-        """Batch stream with background prefetch: host-side minibatch
-        assembly (gather/decode/normalize) overlaps device compute — the
-        double-buffered host→device feed of SURVEY.md §7.7 (the reference
-        got overlap accidentally from its thread-pool unit graph)."""
+        """DEVICE-PLACED batch stream with background prefetch: host-side
+        minibatch assembly (gather/decode/normalize) AND the H2D transfer
+        (``_place_batch``: ``jax.device_put`` under the batch shardings,
+        multihost ``to_global_batch`` included) run in the worker thread,
+        overlapping the previous step's compute — the double-buffered
+        host→device feed of SURVEY.md §7.7 (the reference got overlap
+        accidentally from its thread-pool unit graph).  The queue depth
+        (``prefetch``) bounds the number of batches resident in HBM, so
+        the default of 2 is a classic device-side double buffer.  The
+        ``prefetch=0`` synchronous fallback places batches inline with
+        identical semantics."""
         it = self.loader.iter_epoch(klass, epoch)
         if self.prefetch <= 0:
-            yield from it
+            for item in it:
+                yield self._place_batch(item)
             return
         import queue
         import threading
@@ -187,7 +238,11 @@ class Trainer(Logger):
         def worker():
             try:
                 for item in it:
-                    if not guarded_put(item):
+                    # H2D inside the worker: device_put is async and
+                    # thread-safe, so the transfer of batch N+1 rides
+                    # under step N's compute instead of serializing in
+                    # the consumer loop.
+                    if not guarded_put(self._place_batch(item)):
                         return
                 guarded_put(_end)
             except BaseException as e:  # re-raised on the consumer side
@@ -215,19 +270,20 @@ class Trainer(Logger):
         return jax.device_put(wstate, self._state_sh)
 
     def _place_batch(self, batch):
+        """H2D placement under the compiled step's batch shardings.
+        Called from the prefetch worker thread (see ``_batches``); the
+        single/multi-host branching lives in distributed.place_batch."""
         if self._batch_sh is None:
             return batch
-        from ..parallel.distributed import is_multihost, to_global_batch
-        if is_multihost():
-            # Stitch this host's shard into the global SPMD batch.
-            return to_global_batch(batch, self.mesh, self._batch_sh)
-        return jax.device_put(batch, self._batch_sh)
+        from ..parallel.distributed import place_batch
+        return place_batch(batch, self.mesh, self._batch_sh)
 
     def _run_epoch_train(self, epoch: int) -> Dict[str, float]:
         sums: Dict[str, Any] = {}
         with TraceContext("train_epoch", epoch=epoch):
+            # _batches yields batches already device-placed (H2D runs in
+            # the prefetch worker, overlapped with the previous step)
             for batch in self._batches(TRAIN, epoch):
-                batch = self._place_batch(batch)
                 self.wstate, mets = self._train_step(self.wstate, batch)
                 # Accumulate ON DEVICE — a float() here would sync the
                 # pipeline every step (the reference's --sync-run behavior,
@@ -241,10 +297,10 @@ class Trainer(Logger):
     def _run_epoch_eval(self, klass: int, epoch: int) -> Dict[str, float]:
         if self.loader.class_lengths[klass] == 0:
             return {}
+        self._ensure_eval_step()
         sums: Dict[str, float] = {}
         with TraceContext("eval_epoch", epoch=epoch, klass=klass):
             for batch in self._batches(klass, epoch):
-                batch = self._place_batch(batch)
                 mets = self._eval_step(self.wstate, batch)
                 for k, v in mets.items():
                     sums[k] = sums[k] + v if k in sums else v
@@ -297,14 +353,14 @@ class Trainer(Logger):
                 self._best_wstate = self._host_state_copy()
             if self.decision.want_rollback and self._best_wstate is not None:
                 # Reference: rollback to best snapshot + lr drop
-                # (manualrst_veles_algorithms.rst:164). Recompile preserves
-                # mesh shardings; restore re-places onto the mesh.
+                # (manualrst_veles_algorithms.rst:164). The cumulative
+                # multiplier is written into the restored state's traced
+                # opt_state scalar — the compiled steps are untouched
+                # (ZERO recompiles; the restore re-places onto the mesh).
                 self.wstate = Snapshotter.restore_wstate(
                     {"wstate": self._best_wstate}, like=self.wstate,
                     shardings=self._state_sh)
-                self.optimizer.schedule = _scaled_schedule(
-                    self._base_schedule, self.decision.lr_multiplier)
-                self._compile_steps()
+                self.wstate = self._apply_lr_multiplier(self.wstate)
 
             # Advance the loader first so a restored checkpoint resumes at
             # the *next* epoch instead of repeating the completed one.
@@ -340,6 +396,41 @@ class Trainer(Logger):
         })
         return self.results
 
+    # -- traced lr multiplier ----------------------------------------------
+    def _apply_lr_multiplier(self, wstate):
+        """Write ``decision.lr_multiplier`` into the traced opt_state
+        scalar the compiled step multiplies onto its base schedule —
+        the recompile-free replacement for swapping in a scaled Python
+        schedule closure and re-tracing both step programs."""
+        mult = float(getattr(self.decision, "lr_multiplier", 1.0))
+        opt_state = wstate.get("opt_state")
+        if not isinstance(opt_state, dict) or LR_MULT_KEY not in opt_state:
+            if mult != 1.0:
+                self.warning(
+                    "optimizer state carries no %s slot; lr multiplier "
+                    "%g NOT applied (optimizer-less workflow?)",
+                    LR_MULT_KEY, mult)
+            return wstate
+        leaf = jnp.asarray(mult, jnp.float32)
+        if self._state_sh is not None:
+            sh = self._state_sh["opt_state"][LR_MULT_KEY]
+            from ..parallel.distributed import (is_multihost,
+                                                place_global_state)
+            leaf = place_global_state(leaf, sh) if is_multihost() \
+                else jax.device_put(leaf, sh)
+        return {**wstate,
+                "opt_state": {**opt_state, LR_MULT_KEY: leaf}}
+
+    def effective_lr(self, step: int = 0) -> float:
+        """The learning rate the compiled step applies at ``step``: the
+        base schedule × the traced rollback multiplier riding opt_state
+        (``optimizer.schedule`` itself is never mutated anymore)."""
+        lr = float(self.optimizer.schedule(step))
+        opt_state = (self.wstate or {}).get("opt_state")
+        if isinstance(opt_state, dict) and LR_MULT_KEY in opt_state:
+            lr *= float(jax.device_get(opt_state[LR_MULT_KEY]))
+        return lr
+
     def _host_state_copy(self):
         """Numpy copy of wstate; all-gathers non-addressable (multi-host
         rule-sharded) leaves — collective, call on every host."""
@@ -373,18 +464,22 @@ class Trainer(Logger):
             if not force:
                 raise ValueError(msg + "; pass force=True to override")
             self.warning("%s — forcing restore", msg)
+        # Pre-change snapshots carry no traced-multiplier slot; inject a
+        # neutral one so the structural tree-map succeeds, then overwrite
+        # it from the restored decision below.
+        saved = payload.get("wstate")
+        live_os = self.wstate.get("opt_state")
+        if (isinstance(saved, dict) and isinstance(live_os, dict)
+                and LR_MULT_KEY in live_os
+                and isinstance(saved.get("opt_state"), dict)
+                and LR_MULT_KEY not in saved["opt_state"]):
+            saved["opt_state"][LR_MULT_KEY] = np.ones((), np.float32)
         self.wstate = Snapshotter.restore_wstate(payload, like=self.wstate,
                                                  shardings=self._state_sh)
         self.loader.set_state(payload["loader"])
         self.decision.set_state(payload["decision"])
         prng.streams.set_state(payload["prng"])
-        # Re-apply accumulated rollback lr drops onto the BASE schedule,
-        # else a resumed run trains at the original (too-high) lr.
-        if getattr(self.decision, "lr_multiplier", 1.0) != 1.0:
-            self.optimizer.schedule = _scaled_schedule(
-                self._base_schedule, self.decision.lr_multiplier)
-            self._compile_steps()
-
-
-def _scaled_schedule(schedule, scale):
-    return lambda step: schedule(step) * scale
+        # Re-apply accumulated rollback lr drops as the traced opt_state
+        # scalar (else a resumed run trains at the original, too-high lr).
+        # The compiled steps are untouched: restore is recompile-free.
+        self.wstate = self._apply_lr_multiplier(self.wstate)

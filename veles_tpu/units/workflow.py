@@ -375,6 +375,16 @@ class Workflow(Logger):
 
         return jax.jit(step) if jit else step
 
+    @staticmethod
+    def state_struct(wstate) -> dict:
+        """ShapeDtypeStruct skeleton of a workflow state pytree — the
+        argument signature ``runtime.step_cache.StepCache`` lowers the
+        step programs against (AOT ``.lower().compile()``), typed PRNG
+        key leaves included."""
+        return jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(
+                getattr(x, "shape", ()), x.dtype), wstate)
+
     # -- introspection / parity extras -------------------------------------
     def checksum(self) -> str:
         """Stable identity of the graph topology (reference:
