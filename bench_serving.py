@@ -1,0 +1,190 @@
+#!/usr/bin/env python
+"""Serving benchmark: continuous-batching engine vs per-request generate().
+
+Offered-load sweep over a MIXED-SHAPE decode workload — the traffic
+pattern the ISSUE names: prompt lengths and n_steps vary per request,
+so the serial ``generate()`` path compiles a fresh whole-sequence scan
+per distinct ``(B, P, n_steps, ...)`` tuple and then serves requests one
+at a time, while the engine's program set is fixed (prefill buckets + 1
+decode step) and requests share slots.
+
+Two comparisons, both reported:
+
+* **endpoint** (the acceptance comparison): first exposure to the
+  workload, compiles included on BOTH sides — what a fresh server pays
+  on real heterogeneous traffic.  The engine's bounded program set is
+  the tentpole win; ``vs_baseline`` uses this.
+* **warm**: steady state with every program already compiled.  On a
+  CPU this box's shape (flops-bound, batched matmuls scale ~linearly)
+  batching cannot beat a fused B=1 scan per token, so the warm ratio is
+  honest context, not the headline — on TPU the decode step is
+  weight/bandwidth-bound and slots amortize it (docs/serving.md).
+
+Prints ONE JSON line in the bench.py contract:
+  {"metric": "serving_decode_tokens_per_sec", "value": N,
+   "unit": "tokens/s", "vs_baseline": N, ...}
+"""
+
+import json
+import sys
+import threading
+import time
+
+import numpy as np
+
+V = 256
+DIM = 128
+# 24 DISTINCT (P, n_steps) combos — the serving distribution: user
+# prompt lengths are arbitrary, so the serial path compiles one scan
+# program PER REQUEST SHAPE (24 here, unbounded on a real endpoint,
+# LRU-evicted and recompiled past root.common.serve.runner_cache) while
+# the engine needs 3 prefill buckets + 1 decode step, ever.
+SHAPES = [(5 + int(1.5 * i), (16, 24, 32)[i % 3]) for i in range(24)]
+REPEATS = 1
+CONCURRENCY = (1, 4, 8)
+SLOTS = 8
+L_MAX = 80  # covers max P + n_steps = 72; every step streams this cache
+
+
+def build(jnp, vt):
+    from veles_tpu.models.standard import build_workflow
+    from veles_tpu.ops import optimizers as opt
+    import jax
+    layers = [
+        {"type": "embedding", "vocab": V, "dim": DIM, "name": "emb"},
+        {"type": "attention", "n_heads": 4, "rope": True,
+         "residual": True, "name": "a1"},
+        {"type": "layer_norm", "name": "n1"},
+        {"type": "ffn", "d_hidden": 2 * DIM, "name": "f1"},
+        {"type": "attention", "n_heads": 4, "rope": True,
+         "residual": True, "name": "a2"},
+        {"type": "seq_last", "name": "last"},
+        {"type": "softmax", "output_size": V, "name": "out"},
+    ]
+    wf = build_workflow("bench_serve_lm", layers)
+    wf.build({"@input": vt.Spec((1, 8), jnp.int32),
+              "@labels": vt.Spec((1,), jnp.int32),
+              "@mask": vt.Spec((1,), jnp.float32)})
+    ws = wf.init_state(jax.random.key(0), opt.SGD(0.01))
+    return wf, ws
+
+
+def main():
+    import jax.numpy as jnp
+
+    import veles_tpu as vt
+    from veles_tpu.runtime.engine import DecodeEngine
+    from veles_tpu.runtime.generate import generate
+
+    rng = np.random.default_rng(7)
+    wf, ws = build(jnp, vt)
+    work = [(rng.integers(0, V, p).astype(np.int32), n)
+            for _ in range(REPEATS) for p, n in SHAPES]
+    total_tokens = sum(n for _, n in work)
+
+    def run_serial():
+        t0 = time.perf_counter()
+        for p, n in work:
+            np.asarray(generate(wf, ws, p[None], n))
+        return total_tokens / (time.perf_counter() - t0)
+
+    # -- serial: endpoint (cold — compiles one scan per distinct shape)
+    # then warm (steady state)
+    serial_endpoint_tps = run_serial()
+    serial_warm_tps = run_serial()
+
+    # -- engine: init compiles the lifetime decode step; the cold run
+    # compiles its prefill buckets — everything it will EVER compile
+    t0 = time.perf_counter()
+    eng = DecodeEngine(wf, ws, slots=SLOTS, l_max=L_MAX,
+                       window_ms=1.0, queue_depth=len(work)).start()
+
+    def run_engine(conc):
+        sem = threading.Semaphore(conc)
+        lat = []
+        lat_lock = threading.Lock()
+        errs = []
+        st0 = eng.stats()
+        occ_sum0, steps0 = eng._occupancy_sum, st0["decode_steps"]
+
+        def worker(i):
+            with sem:
+                p, n = work[i]
+                t = time.perf_counter()
+                try:
+                    eng.generate(p[None], n, timeout=600)
+                except Exception as e:  # noqa: BLE001
+                    errs.append(repr(e))
+                with lat_lock:
+                    lat.append(time.perf_counter() - t)
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(len(work))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        dsteps = eng.stats()["decode_steps"] - steps0
+        return {
+            "concurrency": conc,
+            "tokens_per_sec": round(total_tokens / wall, 1),
+            "p50_latency_ms": round(1e3 * float(np.percentile(lat, 50)), 1),
+            "p95_latency_ms": round(1e3 * float(np.percentile(lat, 95)), 1),
+            "avg_slot_occupancy": round(
+                (eng._occupancy_sum - occ_sum0) / dsteps, 2) if dsteps
+            else 0.0,
+            "errors": errs,
+        }, wall
+
+    try:
+        cold, cold_wall = run_engine(4)
+        engine_endpoint_tps = total_tokens / (time.perf_counter() - t0)
+        sweep = [run_engine(c)[0] for c in CONCURRENCY]
+        final = eng.stats()
+    finally:
+        eng.stop()
+
+    best = max(sweep, key=lambda r: r["tokens_per_sec"])
+    conc4 = next(r for r in sweep if r["concurrency"] == 4)
+    out = {
+        "metric": "serving_decode_tokens_per_sec",
+        "value": best["tokens_per_sec"],
+        "unit": "tokens/s",
+        # acceptance comparison: first exposure to the mixed-shape
+        # workload, compile cost included on both sides
+        "vs_baseline": round(engine_endpoint_tps / serial_endpoint_tps, 3),
+        "endpoint": {
+            "engine_tokens_per_sec": round(engine_endpoint_tps, 1),
+            "serial_tokens_per_sec": round(serial_endpoint_tps, 1),
+            "engine_cold_run": cold,
+            "batched_above_serial_at_conc4":
+                engine_endpoint_tps > serial_endpoint_tps,
+        },
+        "warm": {
+            "serial_tokens_per_sec": round(serial_warm_tps, 1),
+            "vs_warm_baseline": round(
+                best["tokens_per_sec"] / serial_warm_tps, 3),
+            "note": "flops-bound CPU: batched matmuls scale ~linearly, "
+                    "so warm batching parity is the ceiling here; the "
+                    "engine's win on this box is the bounded program "
+                    "set + concurrency (see docs/serving.md)",
+        },
+        "sweep": sweep,
+        "decode_recompiles": final["compile"]["recompiles"],
+        "compiled_programs": final["compile"]["programs"],
+        "engine_compile_wall_s": final["compile"]["compile_wall_s"],
+        "serial_compiled_runners": len(getattr(wf, "_decode_runners", ())),
+        "slots": SLOTS, "l_max": L_MAX,
+        "n_requests": len(work), "total_tokens": total_tokens,
+        "shapes": SHAPES, "repeats": REPEATS,
+        "model": {"vocab": V, "dim": DIM, "layers": 2},
+        "conc4_tokens_per_sec": conc4["tokens_per_sec"],
+    }
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,388 @@
+"""Continuous-batching decode engine (runtime/engine.py): mixed-shape
+serving must stay inside the two-program compile budget while returning
+tokens identical to per-request generate() calls, retire slots on eos /
+length, answer overload with EngineOverloaded (HTTP 429 + Retry-After),
+enforce deadlines, and publish gauges through the status path."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import veles_tpu as vt
+from veles_tpu.models.standard import build_workflow
+from veles_tpu.ops import optimizers as opt
+from veles_tpu.runtime.engine import DecodeEngine, EngineOverloaded
+from veles_tpu.runtime.generate import generate
+from veles_tpu.runtime.restful import RestfulServer
+
+V = 12
+
+
+def _build_lm(layers, B=2, T=6, seed=3):
+    wf = build_workflow("eng_lm", layers)
+    wf.build({"@input": vt.Spec((B, T), jnp.int32),
+              "@labels": vt.Spec((B,), jnp.int32),
+              "@mask": vt.Spec((B,), jnp.float32)})
+    ws = wf.init_state(jax.random.key(seed), opt.SGD(0.1))
+    return wf, ws
+
+
+TRANSFORMER = [
+    {"type": "embedding", "vocab": V, "dim": 16, "name": "emb"},
+    {"type": "attention", "n_heads": 2, "rope": True,
+     "residual": True, "name": "a1"},
+    {"type": "layer_norm", "name": "n1"},
+    {"type": "ffn", "d_hidden": 32, "name": "f1"},
+    {"type": "seq_last", "name": "last"},
+    {"type": "softmax", "output_size": V, "name": "out"},
+]
+
+RECURRENT = [
+    {"type": "embedding", "vocab": V, "dim": 12, "name": "emb"},
+    {"type": "gru", "hidden": 12, "name": "g1"},
+    {"type": "lstm", "hidden": 12, "name": "l1"},
+    {"type": "seq_last", "name": "last"},
+    {"type": "softmax", "output_size": V, "name": "out"},
+]
+
+
+@pytest.mark.parametrize("layers", [TRANSFORMER, RECURRENT],
+                         ids=["transformer", "recurrent"])
+def test_mixed_shapes_concurrent_match_sequential(rng, layers):
+    """N concurrent requests with heterogeneous prompt lengths and
+    n_steps: tokens identical to sequential generate() calls, and the
+    compile counters stay at the bucket bound (prefill buckets + one
+    decode step) with ZERO recompiles."""
+    wf, ws = _build_lm(layers)
+    eng = DecodeEngine(wf, ws, slots=4, l_max=64, window_ms=1.0).start()
+    shapes = [(3, 5), (7, 4), (11, 6), (4, 3), (9, 7), (17, 5),
+              (5, 8), (13, 2)]
+    prompts = [rng.integers(0, V, (1, p)).astype(np.int32)
+               for p, _ in shapes]
+    refs = [np.asarray(generate(wf, ws, pr, n))
+            for pr, (_, n) in zip(prompts, shapes)]
+    try:
+        results = [None] * len(shapes)
+
+        def worker(i):
+            results[i] = eng.generate(prompts[i], shapes[i][1],
+                                      timeout=180)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(len(shapes))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=240)
+        for i, (got, ref) in enumerate(zip(results, refs)):
+            np.testing.assert_array_equal(got, ref, err_msg=str(shapes[i]))
+
+        st = eng.stats()
+        buckets = {max(16, 1 << int(np.ceil(np.log2(p))))
+                   for p, _ in shapes}
+        assert st["compile"]["compiles"] <= len(buckets) + 1, st
+        assert st["compile"]["recompiles"] == 0, st
+        assert st["admitted"] == len(shapes) and st["retired"] == len(shapes)
+        # steady state: resubmitting the same mix compiles NOTHING new
+        before = st["compile"]["compiles"]
+        got = eng.generate(prompts[0], shapes[0][1], timeout=180)
+        np.testing.assert_array_equal(got, refs[0])
+        assert eng.stats()["compile"]["compiles"] == before
+    finally:
+        eng.stop()
+
+
+def test_sampled_single_row_bitwise_matches_generate(rng):
+    """Per-slot keys fold in the slot position exactly like the
+    generate() scan, so a sampled single-row request reproduces
+    generate() bit for bit under the same key."""
+    wf, ws = _build_lm(TRANSFORMER)
+    eng = DecodeEngine(wf, ws, slots=2, l_max=32).start()
+    prompt = rng.integers(0, V, (1, 5)).astype(np.int32)
+    try:
+        for kwargs in ({"temperature": 2.0, "top_k": 4},
+                       {"temperature": 1.5, "top_p": 0.9},
+                       {"temperature": 0.7, "top_k": 6, "top_p": 0.8}):
+            ref = np.asarray(generate(wf, ws, prompt, 6,
+                                      key=jax.random.key(7), **kwargs))
+            got = eng.generate(prompt, 6, key=jax.random.key(7),
+                               timeout=120, **kwargs)
+            np.testing.assert_array_equal(got, ref, err_msg=str(kwargs))
+    finally:
+        eng.stop()
+
+
+def test_eos_retires_slot_and_pads(rng):
+    """A slot that emits eos retires immediately (frees capacity) and
+    the row comes back eos-padded, matching generate(eos_id=...)."""
+    wf, ws = _build_lm(TRANSFORMER, seed=5)
+    # bias the head hard toward token 0 so eos is GUARANTEED to fire
+    ws["params"]["out"]["b"] = ws["params"]["out"]["b"].at[0].add(6.0)
+    eng = DecodeEngine(wf, ws, slots=2, l_max=32).start()
+    prompt = rng.integers(1, V, (2, 4)).astype(np.int32)
+    try:
+        ref = np.asarray(generate(wf, ws, prompt, 10, eos_id=0))
+        got = eng.generate(prompt, 10, eos_id=0, timeout=120)
+        np.testing.assert_array_equal(got, ref)
+        assert (got[:, 4:] == 0).any(), got  # eos actually fired
+        st = eng.stats()
+        assert st["occupancy"] == 0 and st["retired"] == 2
+        # eos fired early: strictly fewer decode steps than n_steps
+        # per request would take without retirement
+        assert st["tokens_generated"] < 2 * 10 + 2
+    finally:
+        eng.stop()
+
+
+def test_admission_is_mid_flight(rng):
+    """No drain barrier: a short request submitted while a long one is
+    decoding finishes FIRST — it was admitted into a free slot mid-
+    flight instead of waiting for the batch to drain."""
+    wf, ws = _build_lm(TRANSFORMER)
+    eng = DecodeEngine(wf, ws, slots=2, l_max=128, window_ms=0.0).start()
+    try:
+        long_req = eng.submit(rng.integers(0, V, 4), 90)
+        deadline = time.monotonic() + 60
+        while eng.stats()["occupancy"] == 0:  # long request is decoding
+            assert time.monotonic() < deadline
+            time.sleep(0.001)
+        short_req = eng.submit(rng.integers(0, V, 4), 2)
+        assert short_req.done.wait(60) and short_req.error is None
+        assert not long_req.done.is_set()  # still going: no barrier
+        assert long_req.done.wait(120) and long_req.error is None
+        assert short_req.finished_at < long_req.finished_at
+    finally:
+        eng.stop()
+
+
+def _wait_busy(eng, timeout=60):
+    """Block until the single slot is occupied and the queue drained."""
+    deadline = time.monotonic() + timeout
+    while True:
+        st = eng.stats()
+        if st["occupancy"] >= 1 and st["queue_depth"] == 0:
+            return
+        assert time.monotonic() < deadline, st
+        time.sleep(0.001)
+
+
+def test_queue_overflow_answers_429(rng):
+    wf, ws = _build_lm(TRANSFORMER)
+    eng = DecodeEngine(wf, ws, slots=1, l_max=64, queue_depth=2,
+                       window_ms=0.0).start()
+    try:
+        held = [eng.submit(rng.integers(0, V, 4), 40)]
+        _wait_busy(eng)  # slot taken, queue empty — fills are now queued
+        held += [eng.submit(rng.integers(0, V, 4), 40)
+                 for _ in range(2)]
+        with pytest.raises(EngineOverloaded) as ei:
+            eng.submit(rng.integers(0, V, 4), 4)
+        assert ei.value.retry_after_s >= 1.0
+        assert eng.stats()["rejected"] == 1
+        for r in held:
+            assert r.done.wait(180) and r.error is None
+    finally:
+        eng.stop()
+
+
+def test_queued_deadline_fails_loudly(rng):
+    wf, ws = _build_lm(TRANSFORMER)
+    eng = DecodeEngine(wf, ws, slots=1, l_max=64, queue_depth=8,
+                       window_ms=0.0).start()
+    try:
+        long_req = eng.submit(rng.integers(0, V, 4), 40)
+        _wait_busy(eng)
+        # a queued request with an already-hopeless deadline fails
+        # loudly (TimeoutError) instead of wedging the queue
+        doomed = eng.submit(np.asarray([1, 2], np.int32), 4,
+                            deadline_s=0.0)
+        assert doomed.done.wait(60)
+        assert isinstance(doomed.error, TimeoutError)
+        assert eng.stats()["timeouts"] == 1
+        assert long_req.done.wait(180) and long_req.error is None
+    finally:
+        eng.stop()
+
+
+def test_engine_rejects_oversized_request(rng):
+    wf, ws = _build_lm(TRANSFORMER)
+    eng = DecodeEngine(wf, ws, slots=1, l_max=16)
+    with pytest.raises(ValueError, match="l_max"):
+        eng.submit(rng.integers(0, V, 12), 8)
+    eng.stop()
+
+
+def test_submit_to_stopped_engine_fails_loudly(rng):
+    """With no scheduler alive nothing would ever drain the queue or
+    enforce deadlines — submit must raise, not enqueue a request whose
+    caller then blocks forever."""
+    from veles_tpu.runtime.engine import EngineStopped
+    wf, ws = _build_lm(TRANSFORMER)
+    eng = DecodeEngine(wf, ws, slots=1, l_max=32)
+    with pytest.raises(EngineStopped, match="not running"):
+        eng.submit(rng.integers(0, V, 4), 2)
+    eng.start()
+    eng.generate(rng.integers(0, V, (1, 4)).astype(np.int32), 2,
+                 timeout=120)
+    eng.stop()
+    with pytest.raises(EngineStopped, match="not running"):
+        eng.submit(rng.integers(0, V, 4), 2)
+
+
+def test_generate_cancels_batch_on_mid_batch_overflow(rng):
+    """If row k of a batch overflows the queue, rows 0..k-1 must not
+    keep decoding to discarded results (retry amplification): the
+    failed generate() expires their deadlines and the engine drains."""
+    wf, ws = _build_lm(TRANSFORMER)
+    eng = DecodeEngine(wf, ws, slots=1, l_max=64, queue_depth=2,
+                       window_ms=0.0).start()
+    try:
+        blocker = eng.submit(rng.integers(0, V, 4), 60)
+        _wait_busy(eng)
+        with pytest.raises(EngineOverloaded):
+            # 3 rows into a 2-deep queue behind a busy slot
+            eng.generate(rng.integers(0, V, (3, 4)).astype(np.int32), 30)
+        deadline = time.monotonic() + 60
+        while eng.stats()["queue_depth"] > 0:  # cancelled rows drain
+            assert time.monotonic() < deadline
+            time.sleep(0.005)
+        assert blocker.done.wait(180) and blocker.error is None
+        st = eng.stats()
+        assert st["timeouts"] == 2 and st["queue_depth"] == 0, st
+    finally:
+        eng.stop()
+
+
+def test_engine_gauges_reach_status_reporter(rng, tmp_path):
+    """The engine publishes its gauges through the existing status path:
+    StatusReporter.update(engine=...) lands in status.json and the HTML
+    page renders the nested dict as dotted rows."""
+    from veles_tpu.runtime.status import StatusReporter, StatusServer
+    rep = StatusReporter(str(tmp_path / "status.json"), name="serve")
+    wf, ws = _build_lm(TRANSFORMER)
+    eng = DecodeEngine(wf, ws, slots=2, l_max=32, status=rep).start()
+    try:
+        eng.generate(rng.integers(0, V, (1, 4)).astype(np.int32), 4,
+                     timeout=120)
+        deadline = time.monotonic() + 10
+        while "engine" not in rep._extra:  # reporter updates are async
+            assert time.monotonic() < deadline
+            time.sleep(0.05)
+        doc = rep.read()
+        for k in ("slots", "occupancy", "queue_depth", "tokens_per_sec",
+                  "admitted", "retired", "rejected", "compile"):
+            assert k in doc["engine"], k
+        srv = StatusServer(rep).start()
+        try:
+            page = urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/").read().decode()
+            assert "engine.occupancy" in page
+            assert "engine.compile.recompiles" in page
+        finally:
+            srv.stop()
+    finally:
+        eng.stop()
+
+
+def test_restful_generate_rides_the_engine(rng):
+    """POST /generate through engine=: greedy + eos results match the
+    library paths, queue overflow answers 429 with Retry-After, and
+    GET /engine serves the gauges."""
+    wf, ws = _build_lm(TRANSFORMER, T=6)
+    eng = DecodeEngine(wf, ws, slots=2, l_max=32, queue_depth=2)
+    srv = RestfulServer(wf.make_predict_step("out"), ws, 2, (6,),
+                        workflow=wf, engine=eng).start()
+    prompt = rng.integers(1, V, (2, 6)).astype(np.int32)
+
+    def post(body):
+        return urllib.request.urlopen(urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/generate",
+            json.dumps(body).encode(),
+            {"Content-Type": "application/json"}))
+
+    try:
+        ref = np.asarray(generate(wf, ws, prompt, 5))
+        with post({"prompt": prompt.tolist(), "steps": 5}) as r:
+            np.testing.assert_array_equal(
+                np.asarray(json.loads(r.read())["tokens"]), ref)
+        # eos_id is now first-class on the non-beam path
+        ws["params"]["out"]["b"] = ws["params"]["out"]["b"].at[0].add(6.0)
+        eref = np.asarray(generate(wf, ws, prompt, 8, eos_id=0))
+        with post({"prompt": prompt.tolist(), "steps": 8,
+                   "eos_id": 0}) as r:
+            np.testing.assert_array_equal(
+                np.asarray(json.loads(r.read())["tokens"]), eref)
+        # beam requests still take the deterministic legacy path
+        from veles_tpu.runtime.generate import generate_beam
+        bref, _ = generate_beam(wf, ws, prompt, 5, beams=4, eos_id=0)
+        with post({"prompt": prompt.tolist(), "steps": 5, "beams": 4,
+                   "eos_id": 0}) as r:
+            np.testing.assert_array_equal(
+                np.asarray(json.loads(r.read())["tokens"]),
+                np.asarray(bref))
+        # gauges over HTTP
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/engine") as r:
+            st = json.loads(r.read())
+        assert st["slots"] == 2 and st["compile"]["recompiles"] == 0
+        # saturate: queue overflow must answer 429 + Retry-After, not
+        # queue unbounded latency
+        codes = []
+
+        def hammer():
+            try:
+                with post({"prompt": [prompt[0].tolist()],
+                           "steps": 20}) as r:
+                    codes.append(r.status)
+            except urllib.error.HTTPError as e:
+                codes.append(e.code)
+                if e.code == 429:
+                    assert int(e.headers["Retry-After"]) >= 1
+
+        threads = [threading.Thread(target=hammer) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=240)
+        assert 429 in codes, codes  # 2 slots + 2 queued < 8 offered
+        assert all(c in (200, 429) for c in codes), codes
+    finally:
+        srv.stop()
+    assert not eng.started  # server stop tears the engine down
+
+
+def test_restful_body_size_cap(rng):
+    """Oversized POST bodies answer 413 BEFORE being read (the
+    snapshot_http_max_mb pattern on the ingress side)."""
+    from veles_tpu.config import root
+    wf, ws = _build_lm(TRANSFORMER, T=6)
+    srv = RestfulServer(wf.make_predict_step("out"), ws, 2, (6,),
+                        workflow=wf).start()
+    prev = root.common.serve.get("max_body_mb", 64)
+    root.common.serve.max_body_mb = 0.001  # ~1 KB for the test
+    try:
+        big = {"prompt": [[1] * 2000], "steps": 1}
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/generate",
+            json.dumps(big).encode(),
+            {"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req)
+        assert ei.value.code == 413
+        # small bodies still served
+        small = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/generate",
+            json.dumps({"prompt": [[1, 2]], "steps": 1}).encode(),
+            {"Content-Type": "application/json"})
+        with urllib.request.urlopen(small) as r:
+            assert r.status == 200
+    finally:
+        root.common.serve.max_body_mb = prev
+        srv.stop()

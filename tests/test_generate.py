@@ -379,6 +379,82 @@ def test_beam_eos_freezes_and_pads(rng):
     assert hits == len(gen), gen  # the bias makes every row finish
 
 
+def test_generate_eos_stops_and_pads(rng):
+    """generate(eos_id=...): a row that emits eos pads the rest of its
+    positions with eos, the pre-eos tokens equal the eos-free greedy
+    decode, and shape stays (B, P + n_steps)."""
+    B, P, V, N = 2, 4, 12, 10
+    wf, ws = _build_lm(CASES["plain"](V), B, P, V, seed=5)
+    # bias the head hard toward token 0 so eos is GUARANTEED to fire
+    ws["params"]["out"]["b"] = ws["params"]["out"]["b"].at[0].add(6.0)
+    prompt = rng.integers(1, V, (B, P)).astype(np.int32)
+    free = np.asarray(generate(wf, ws, prompt, N))
+    got = np.asarray(generate(wf, ws, prompt, N, eos_id=0))
+    assert got.shape == (B, P + N)
+    np.testing.assert_array_equal(got[:, :P], prompt)
+    fired = 0
+    for r in range(B):
+        hit = np.where(got[r, P:] == 0)[0]
+        if len(hit):
+            fired += 1
+            stop = P + hit[0]
+            np.testing.assert_array_equal(got[r, :stop], free[r, :stop])
+            assert np.all(got[r, stop:] == 0), got[r]
+        else:
+            np.testing.assert_array_equal(got[r], free[r])
+    assert fired == B, got  # the bias makes every row finish
+
+    # an eos that never fires leaves the decode identical to eos-free
+    same = np.asarray(generate(wf, ws, prompt, N, eos_id=V - 1))
+    if not (free == V - 1).any():
+        np.testing.assert_array_equal(same, free)
+
+
+def test_runner_cache_lru_cap(rng):
+    """root.common.serve.runner_cache bounds the compiled-runner cache:
+    a public endpoint fed varied prompt lengths must not leak one XLA
+    program per distinct shape forever."""
+    from veles_tpu.config import root
+    B, V = 1, 12
+    wf, ws = _build_lm(CASES["plain"](V), B, 4, V)
+    prev = root.common.serve.get("runner_cache", 32)
+    root.common.serve.runner_cache = 3
+    try:
+        for P in range(2, 9):  # 7 distinct shapes
+            prompt = rng.integers(0, V, (B, P)).astype(np.int32)
+            generate(wf, ws, prompt, 2)
+        assert len(wf._decode_runners) == 3
+        # most-recent shapes survived; a hit needs no new entry
+        keys = set(wf._decode_runners)
+        generate(wf, ws, rng.integers(0, V, (B, 8)).astype(np.int32), 2)
+        assert set(wf._decode_runners) == keys
+    finally:
+        root.common.serve.runner_cache = prev
+
+
+def test_runner_cache_hit_uses_fresh_params_and_key(rng):
+    """A cached runner must read params and the PRNG key from its CALL
+    arguments — closing over the first call's values would silently
+    replay the first seed and serve stale weights after training updates
+    (review regression: body_step once captured generate()'s locals)."""
+    B, P, V, N = 1, 4, 12, 8
+    wf, ws = _build_lm(CASES["plain"](V), B, P, V, seed=6)
+    prompt = rng.integers(0, V, (B, P)).astype(np.int32)
+    # same shape + sampling knobs -> same cached runner, different keys
+    h1 = np.asarray(generate(wf, ws, prompt, N, temperature=5.0,
+                             key=jax.random.key(1)))
+    h2 = np.asarray(generate(wf, ws, prompt, N, temperature=5.0,
+                             key=jax.random.key(2)))
+    assert not np.array_equal(h1, h2)
+    # greedy cache hit after a params update must see the new weights
+    g1 = np.asarray(generate(wf, ws, prompt, N))
+    tgt = (int(g1[0, -1]) + 1) % V
+    ws["params"]["out"]["b"] = \
+        ws["params"]["out"]["b"].at[tgt].add(100.0)
+    g2 = np.asarray(generate(wf, ws, prompt, N))
+    assert np.all(g2[:, P:] == tgt), (g1, g2)
+
+
 def test_generate_rejects_unsupported_chains(rng):
     B, T, V = 2, 6, 10
     # no embedding at the front

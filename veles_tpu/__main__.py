@@ -166,8 +166,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "the highest-total-log-prob continuation; "
                         "exclusive with sampling flags)")
     p.add_argument("--eos-id", type=int, default=None,
-                   help="end-of-sequence token for --beams: finished "
-                        "beams freeze and pad with it")
+                   help="end-of-sequence token: greedy/sampling decode "
+                        "stops a row that emits it (padding the rest); "
+                        "with --beams, finished beams freeze and pad")
     p.add_argument("--length-penalty", type=float, default=0.0,
                    help="beam score normalization exponent over the "
                         "generated length (GNMT convention; 0 = raw "
@@ -826,12 +827,11 @@ def main(argv=None) -> int:
             raise SystemExit(f"--beams must be >= 1, got {args.beams} "
                              "(a value < 1 would silently fall back to "
                              "greedy/sampling decode)")
-        if args.beams <= 1 and (args.eos_id is not None
-                                or args.length_penalty):
+        if args.beams <= 1 and args.length_penalty:
             raise SystemExit(
-                "--eos-id/--length-penalty shape BEAM scores and need "
+                "--length-penalty shapes BEAM scores and needs "
                 "--beams > 1 (greedy/sampling decode would silently "
-                "ignore them)")
+                "ignore it)")
         if args.prompt.startswith("@"):
             prompt = np.atleast_2d(
                 np.load(args.prompt[1:])).astype(np.int32)
@@ -865,7 +865,8 @@ def main(argv=None) -> int:
             return 0
         toks = _generate(trainer.workflow, trainer.wstate, prompt,
                          args.generate, temperature=args.temperature,
-                         top_k=args.top_k, top_p=args.top_p, key=key)
+                         top_k=args.top_k, top_p=args.top_p,
+                         eos_id=args.eos_id, key=key)
         out = {"prompt_len": int(prompt.shape[1]),
                "tokens": np.asarray(toks).tolist()}
         print(json.dumps(out))

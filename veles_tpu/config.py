@@ -258,6 +258,15 @@ def _defaults():
     root.common.random_seed = 42
     root.common.platform = ""                # "" = let JAX pick
     root.common.mesh = dict(data=-1)          # -1: all remaining devices
+    # Serving knobs (runtime/engine.py + runtime/restful.py, docs/serving.md).
+    root.common.serve.slots = 8              # decode slots (engine batch)
+    root.common.serve.l_max = 512            # per-slot KV length cap
+    root.common.serve.prefill_bucket_min = 16  # smallest pow2 prompt bucket
+    root.common.serve.window_ms = 2.0        # admission batching window
+    root.common.serve.queue_depth = 64       # pending requests before 429
+    root.common.serve.deadline_s = 120.0     # default per-request deadline
+    root.common.serve.runner_cache = 32      # generate() compiled-runner LRU
+    root.common.serve.max_body_mb = 64       # POST body cap -> 413
 
 
 _defaults()

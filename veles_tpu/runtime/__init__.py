@@ -1,4 +1,5 @@
 from .decision import Decision
+from .engine import DecodeEngine, EngineOverloaded, EngineStopped
 from .generate import DecodePlan, generate, generate_beam
 from .snapshotter import Snapshotter, SnapshotterToDB
 from .step_cache import StepCache, enable_persistent_cache

@@ -1,0 +1,613 @@
+"""Continuous-batching decode engine: slot-based serving with bucketed
+prefill and a lifetime-compiled decode step.
+
+``generate()`` is the wrong engine for serving: every distinct
+``(B, P, n_steps, sampling)`` tuple compiles a fresh whole-sequence scan
+and requests run serially — the opposite of the ROADMAP's "heavy traffic"
+north star, and the reason the reference project shipped a standalone
+inference runtime (libVeles) instead of serving from its training graph.
+This module applies the fixed-shape AOT discipline TPUs impose (PAPERS:
+"Automatic Full Compilation ... to Cloud TPUs") to decode, the way PR 1's
+StepCache applied it to training:
+
+* the engine owns a fixed-capacity **slot batch** ``(slots, l_max)`` of
+  KV caches (plus recurrent carried state) for its whole lifetime;
+* it compiles exactly **two kinds of programs**, AOT via the same
+  :class:`~veles_tpu.runtime.step_cache.StepCache` whose counters tests
+  assert on: a *bucketed prefill* (prompt lengths padded to power-of-two
+  buckets, so at most ``log2(l_max)``-ish compiles ever) and a single
+  *decode step* advancing every active slot one token with per-slot
+  positions, per-slot sampling params, and per-slot eos / length
+  retirement — total programs ≤ bucket count + 1, recompiles 0;
+* a host-side scheduler thread owns the request queue: admission into
+  free slots happens **mid-flight** (no drain barrier — running slots
+  keep decoding across an admission), finished sequences retire and free
+  their slot immediately, a small batching window coalesces concurrent
+  arrivals, a bounded queue raises :class:`EngineOverloaded` (HTTP 429 +
+  Retry-After in restful.py) instead of unbounded latency, and per-
+  request deadlines fail requests loudly instead of wedging a slot.
+
+Result parity: greedy tokens are identical to per-request ``generate()``
+calls (the step math IS ``DecodePlan.step``, just masked/batched), and
+sampled tokens are bitwise-identical for single-row requests with the
+same key — per-slot keys fold in the slot's own position exactly like
+the ``generate()`` scan (multi-row sampled requests draw per-row keys
+``fold_in(key, row)`` instead of one batched categorical, documented in
+docs/serving.md).
+"""
+
+from __future__ import annotations
+
+import collections
+import math
+import threading
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import root
+from ..logger import Logger
+from ..units.base import Context
+from .generate import DecodePlan
+from .step_cache import StepCache
+
+
+class EngineOverloaded(RuntimeError):
+    """Request queue is full; retry after ``retry_after_s`` seconds."""
+
+    def __init__(self, msg: str, retry_after_s: float = 1.0):
+        super().__init__(msg)
+        self.retry_after_s = max(1.0, float(retry_after_s))
+
+
+class EngineStopped(RuntimeError):
+    """The engine was stopped before this request completed."""
+
+
+class _Request:
+    __slots__ = ("prompt", "n_steps", "temperature", "top_k", "top_p",
+                 "eos_id", "key_data", "deadline", "done", "result",
+                 "error", "submitted_at", "slot", "finished_at")
+
+    def __init__(self, prompt, n_steps, temperature, top_k, top_p,
+                 eos_id, key_data, deadline):
+        self.prompt = prompt            # (P,) np.int32
+        self.n_steps = n_steps
+        self.temperature = temperature
+        self.top_k = top_k              # None or int
+        self.top_p = top_p              # None or float
+        self.eos_id = eos_id            # None or int
+        self.key_data = key_data        # raw uint32 PRNG key data
+        self.deadline = deadline        # absolute monotonic seconds
+        self.done = threading.Event()
+        self.result = None              # np.int32 tokens, prompt included
+        self.error: Optional[Exception] = None
+        self.submitted_at = time.monotonic()
+        self.finished_at = None
+        self.slot = None
+
+    def finish(self, result=None, error=None):
+        self.result, self.error = result, error
+        self.finished_at = time.monotonic()
+        self.done.set()
+
+
+def _sample_slots(logits, keys, temp, top_k, top_p):
+    """Per-slot next-token choice from (S, V) logits with per-slot
+    traced sampling params — the batched twin of ``sample_logits``.
+
+    Sentinels make a slot's filter a bitwise no-op exactly where the
+    scalar path would SKIP it: ``top_k >= V`` clips to the minimum
+    logit threshold (nothing filtered), ``top_p = 1.0`` cuts at the last
+    sorted position (same), ``temp <= 0`` selects the greedy argmax.
+    The op ORDER mirrors sample_logits: scale, top-k filter, top-p cut
+    on the filtered logits, categorical; each slot draws its gumbel
+    noise from its own key at shape (1, V) — the exact draw a B=1
+    ``generate()`` makes, so single-row results are bitwise identical.
+    """
+    lg = logits.astype(jnp.float32)
+    S, V = lg.shape
+    greedy = jnp.argmax(lg, axis=-1)
+
+    def do_sample():
+        x = lg / jnp.where(temp > 0, temp, 1.0)[:, None]
+        # top-k: k-th largest value as threshold
+        # (== lax.top_k(...)[0][:,-1])
+        srt = jnp.sort(x, axis=-1)[:, ::-1]
+        kth = jnp.take_along_axis(
+            srt, jnp.clip(top_k - 1, 0, V - 1)[:, None], axis=-1)
+        x2 = jnp.where(x < kth, -jnp.inf, x)
+        # top-p on the top-k-FILTERED logits (sample_logits order)
+        srt2 = jnp.sort(x2, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(srt2, axis=-1)
+        csum = jnp.cumsum(probs, axis=-1) - probs
+        cut = jnp.maximum(
+            jnp.sum(jnp.where(csum < top_p[:, None], 1, 0), axis=-1) - 1,
+            0)
+        thresh = jnp.take_along_axis(srt2, cut[:, None], axis=-1)
+        x3 = jnp.where(x2 < thresh, -jnp.inf, x2)
+        sampled = jax.vmap(
+            lambda k, row: jax.random.categorical(k, row[None, :])[0])(
+                keys, x3)
+        return jnp.where(temp > 0, sampled, greedy)
+
+    # all-greedy steps skip the sort/softmax/gumbel machinery entirely
+    # (a runtime branch, not a trace-time one: the program stays fixed)
+    return jax.lax.cond(
+        (temp > 0).any(), do_sample, lambda: greedy).astype(jnp.int32)
+
+
+class DecodeEngine(Logger):
+    """Continuous-batching decode engine over a :class:`DecodePlan`.
+
+    ``slots`` / ``l_max`` / ``window_ms`` / ``queue_depth`` /
+    ``deadline_s`` / ``prefill_bucket_min`` default from
+    ``root.common.serve.*`` (docs/serving.md).  Requests are single
+    sequences; :meth:`generate` is the batch-blocking convenience with
+    the ``generate()`` contract, :meth:`submit` the async primitive the
+    REST layer drives.
+    """
+
+    def __init__(self, workflow, wstate, *, slots: Optional[int] = None,
+                 l_max: Optional[int] = None,
+                 window_ms: Optional[float] = None,
+                 queue_depth: Optional[int] = None,
+                 deadline_s: Optional[float] = None,
+                 output_unit: Optional[str] = None,
+                 cache_dtype=jnp.float32, status=None):
+        serve = root.common.serve
+        self.workflow = workflow
+        self.wstate = wstate
+        self.slots = int(slots if slots is not None
+                         else serve.get("slots", 8))
+        self.l_max = int(l_max if l_max is not None
+                         else serve.get("l_max", 512))
+        self.window_s = float(window_ms if window_ms is not None
+                              else serve.get("window_ms", 2.0)) / 1e3
+        self.queue_depth = int(queue_depth if queue_depth is not None
+                               else serve.get("queue_depth", 64))
+        self.deadline_s = float(deadline_s if deadline_s is not None
+                                else serve.get("deadline_s", 120.0))
+        self.bucket_min = max(1, int(serve.get("prefill_bucket_min", 16)))
+        if self.slots < 1 or self.l_max < 2:
+            raise ValueError("need slots >= 1 and l_max >= 2")
+        self.plan = DecodePlan(workflow, output_unit)
+        self.cache_dtype = cache_dtype
+        self._ctx = Context(train=False, key=None, mesh=None)
+        self.step_cache = StepCache()
+        self.status = status
+
+        params = wstate["params"]
+        self._caches = self.plan.init_caches(
+            params, self.slots, self.l_max, cache_dtype)
+        self._toks = jnp.zeros((self.slots, self.l_max), jnp.int32)
+        # host-side per-slot metadata, passed into the compiled step
+        S = self.slots
+        self._pos = np.zeros(S, np.int32)       # index of last written tok
+        self._active = np.zeros(S, bool)
+        self._temp = np.zeros(S, np.float32)
+        self._topk = np.zeros(S, np.int32)      # sentinel: V (keeps all)
+        self._topp = np.ones(S, np.float32)     # sentinel: 1.0
+        self._eos = np.full(S, -1, np.int32)    # sentinel: -1 (never hits)
+        self._end = np.zeros(S, np.int32)       # final token index
+        kd = jax.random.key_data(jax.random.key(0))
+        self._keys = np.zeros((S,) + kd.shape, kd.dtype)
+        self._slot_req: list = [None] * S
+
+        # queue + scheduler
+        self._queue: collections.deque = collections.deque()
+        self._qlock = threading.Lock()
+        self._wake = threading.Event()
+        self._stop_evt = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+        # gauges
+        self._admitted = 0
+        self._retired = 0
+        self._rejected = 0
+        self._timeouts = 0
+        self._decode_steps = 0
+        self._occupancy_sum = 0
+        self._tok_count = 0
+        self._rate_mark = (time.monotonic(), 0)
+        self._tokens_per_sec = 0.0
+        self._status_mark = 0.0
+
+        # head width (== logits' last dim), for the top_k no-op sentinel
+        shallow = dict(self._caches)  # plan.step rebinds top-level keys
+        self._vocab = int(jax.eval_shape(
+            lambda p, c, t, pv: self.plan.step(p, c, t, pv, self._ctx)[0],
+            params, shallow, jnp.zeros(S, jnp.int32),
+            jnp.zeros(S, jnp.int32)).shape[-1])
+
+        # the lifetime decode program, AOT-compiled up front
+        self._decode = self._compile_decode(params)
+
+    # -- compiled programs --------------------------------------------------
+    @staticmethod
+    def _sds(tree):
+        return jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(jnp.shape(x), jnp.asarray(x).dtype),
+            tree)
+
+    def _compile_decode(self, params):
+        plan, ctx = self.plan, self._ctx
+        S = self.slots
+
+        def decode_step(params, caches, toks, pos, active, temp, topk,
+                        topp, eos, end, keys):
+            rows = jnp.arange(S)
+            tok = toks[rows, pos]
+            logits, caches = plan.step(params, caches, tok, pos, ctx)
+            step_keys = jax.vmap(jax.random.fold_in)(
+                jax.random.wrap_key_data(keys), pos)
+            nxt = _sample_slots(logits, step_keys, temp, topk, topp)
+            new_pos = jnp.where(active, pos + 1, pos)
+            cur = toks[rows, new_pos]
+            toks = toks.at[rows, new_pos].set(jnp.where(active, nxt, cur))
+            finished = active & ((nxt == eos) | (new_pos >= end))
+            return caches, toks, new_pos, active & ~finished, finished
+
+        fn = jax.jit(decode_step, donate_argnums=(1, 2))
+        args = self._sds((params, self._caches, self._toks, self._pos,
+                          self._active, self._temp, self._topk, self._topp,
+                          self._eos, self._end, self._keys))
+        step, _, _ = self.step_cache.get_step(
+            "decode", (S, self.l_max), lambda: (fn, None, None), args,
+            pin=(self.workflow,))
+        return step
+
+    def _bucket(self, p: int) -> int:
+        return min(1 << max(0, math.ceil(math.log2(max(p, self.bucket_min)))),
+                   self.l_max)
+
+    def _prefill_fn(self, pb: int, params):
+        """Fetch/compile the prefill program for bucket length ``pb``."""
+        plan, ctx, dtype = self.plan, self._ctx, self.cache_dtype
+
+        def prefill(params, caches, toks, prompt, true_len, slot, temp,
+                    topk, topp, key_data):
+            local = plan.init_caches(params, 1, pb, dtype)
+
+            def body(carry, pos):
+                local = carry
+                tok = prompt[:, pos]
+                # plan.step REBINDS the dict's top-level entries in
+                # place — hand it a shallow copy so ``local`` still
+                # holds the pre-step leaves the gate needs
+                logits, new = plan.step(params, dict(local), tok, pos, ctx)
+                # pad positions beyond the true prompt must not advance
+                # carried state (recurrent) nor write KV
+                valid = pos < true_len
+                local = jax.tree.map(
+                    lambda n, o: jnp.where(valid, n, o), new, local)
+                return local, logits
+
+            local, ys = jax.lax.scan(body, local, jnp.arange(pb))
+            last = jax.lax.dynamic_index_in_dim(
+                ys, true_len - 1, 0, keepdims=False)        # (1, V)
+            key = jax.random.fold_in(
+                jax.random.wrap_key_data(key_data), true_len - 1)
+            first = _sample_slots(
+                last, key[None], temp[None], topk[None], topp[None])[0]
+            # splice the slot's fresh state into the engine batch
+            caches = jax.tree.map(
+                lambda big, loc: jax.lax.dynamic_update_slice(
+                    big, loc.astype(big.dtype),
+                    (slot,) + (jnp.int32(0),) * (loc.ndim - 1)),
+                caches, local)
+            row = jnp.where(jnp.arange(pb) < true_len, prompt[0], 0)
+            toks = jax.lax.dynamic_update_slice(
+                toks, row[None], (slot, jnp.int32(0)))
+            toks = toks.at[slot, true_len].set(first)
+            return caches, toks, first
+
+        fn = jax.jit(prefill, donate_argnums=(1, 2))
+        z32 = np.int32(0)
+        args = self._sds((params, self._caches, self._toks,
+                          np.zeros((1, pb), np.int32), z32, z32,
+                          np.float32(0), z32, np.float32(1),
+                          self._keys[0]))
+        step, _, _ = self.step_cache.get_step(
+            "prefill", (pb, self.slots, self.l_max),
+            lambda: (fn, None, None), args, pin=(self.workflow,))
+        return step
+
+    # -- public API ---------------------------------------------------------
+    def start(self):
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._stop_evt.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="decode-engine", daemon=True)
+        self._thread.start()
+        self.info("decode engine: %d slots x L=%d, queue %d",
+                  self.slots, self.l_max, self.queue_depth)
+        return self
+
+    @property
+    def started(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def stop(self):
+        self._stop_evt.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+
+    def submit(self, prompt, n_steps: int, *, temperature: float = 0.0,
+               top_k: Optional[int] = None, top_p: Optional[float] = None,
+               eos_id: Optional[int] = None, key=None,
+               deadline_s: Optional[float] = None) -> _Request:
+        """Enqueue one sequence; returns a request whose ``done`` event
+        fires with ``result`` (np.int32, prompt + generated, trimmed at
+        eos) or ``error``.  Raises :class:`EngineOverloaded` when the
+        queue is full (the REST layer's 429)."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("prompt must hold at least one token")
+        n_steps = int(n_steps)
+        if n_steps < 1:
+            raise ValueError("n_steps must be >= 1")
+        if prompt.size + n_steps > self.l_max:
+            raise ValueError(
+                f"prompt {prompt.size} + n_steps {n_steps} exceeds the "
+                f"engine's l_max {self.l_max}")
+        if key is None:
+            key = jax.random.key(0)
+        if not self.started:
+            # a dead scheduler (stopped, or its loop died) would leave
+            # the request queued forever with nothing enforcing its
+            # deadline — fail the caller loudly instead
+            raise EngineStopped("engine is not running (call start())")
+        req = _Request(
+            prompt, n_steps, float(temperature),
+            None if top_k is None else int(top_k),
+            None if top_p is None else float(top_p),
+            None if eos_id is None else int(eos_id),
+            np.asarray(jax.random.key_data(key)),
+            time.monotonic() + (self.deadline_s if deadline_s is None
+                                else float(deadline_s)))
+        with self._qlock:
+            if len(self._queue) >= self.queue_depth:
+                self._rejected += 1
+                raise EngineOverloaded(
+                    f"queue full ({self.queue_depth} pending)",
+                    self._retry_after())
+            self._queue.append(req)
+        self._wake.set()
+        return req
+
+    def generate(self, prompt, n_steps: int, *, temperature: float = 0.0,
+                 top_k=None, top_p=None, eos_id=None, key=None,
+                 timeout: Optional[float] = None):
+        """Blocking batch decode with the ``generate()`` contract:
+        (B, P) int32 -> (B, P + n_steps) int32, rows past their eos
+        padded with ``eos_id``.  Each row rides its own slot; row ``r``
+        of a multi-row sampled request draws from ``fold_in(key, r)``
+        (single-row requests use ``key`` itself, bitwise-matching
+        ``generate()``)."""
+        prompt = np.asarray(prompt, np.int32)
+        if prompt.ndim != 2:
+            raise ValueError("prompt must be (B, P)")
+        B, P = prompt.shape
+        if key is None:
+            key = jax.random.key(0)
+        reqs = []
+        try:
+            for r in range(B):
+                rk = key if B == 1 else jax.random.fold_in(key, r)
+                reqs.append(self.submit(
+                    prompt[r], n_steps, temperature=temperature,
+                    top_k=top_k, top_p=top_p, eos_id=eos_id, key=rk))
+            out = np.full((B, P + n_steps),
+                          eos_id if eos_id is not None else 0, np.int32)
+            for r, req in enumerate(reqs):
+                if not req.done.wait(timeout):
+                    raise TimeoutError("engine.generate timed out")
+                if req.error is not None:
+                    raise req.error
+                out[r, :len(req.result)] = req.result
+            return out
+        except BaseException:
+            # don't leak the batch's other rows: a mid-batch overflow
+            # (or timeout) must not leave already-submitted rows
+            # decoding to discarded results while the client retries —
+            # expiring their deadline makes the scheduler drop queued
+            # ones and retire in-flight ones on the next step
+            for req in reqs:
+                if not req.done.is_set():
+                    req.deadline = 0.0
+            raise
+
+    def stats(self) -> dict:
+        """JSON-able gauges for status pages / benches."""
+        now = time.monotonic()
+        mark_t, mark_n = self._rate_mark
+        if now - mark_t >= 0.5:
+            self._tokens_per_sec = ((self._tok_count - mark_n)
+                                    / max(now - mark_t, 1e-9))
+            self._rate_mark = (now, self._tok_count)
+        steps = max(self._decode_steps, 1)
+        return {
+            "slots": self.slots, "l_max": self.l_max,
+            "occupancy": int(self._active.sum()),
+            "avg_occupancy": round(self._occupancy_sum / steps, 3),
+            "queue_depth": len(self._queue),
+            "queue_limit": self.queue_depth,
+            "tokens_per_sec": round(self._tokens_per_sec, 1),
+            "tokens_generated": self._tok_count,
+            "decode_steps": self._decode_steps,
+            "admitted": self._admitted, "retired": self._retired,
+            "rejected": self._rejected, "timeouts": self._timeouts,
+            "compile": self.step_cache.stats(),
+        }
+
+    # -- scheduler ----------------------------------------------------------
+    def _retry_after(self) -> float:
+        """429 Retry-After estimate: queued decode work over recent
+        throughput (floor 1s)."""
+        queued = sum(r.n_steps for r in self._queue) or 1
+        rate = max(self._tokens_per_sec, 1.0)
+        return min(60.0, max(1.0, queued / rate))
+
+    def _loop(self):
+        try:
+            while not self._stop_evt.is_set():
+                self._maybe_report()
+                if not self._active.any() and not self._queue:
+                    self._wake.wait(timeout=0.05)
+                    self._wake.clear()
+                    continue
+                if not self._active.any() and self.window_s > 0:
+                    # batching window: concurrent arrivals get admitted
+                    # together and share the first decode steps instead
+                    # of the first request racing its slot ahead
+                    time.sleep(self.window_s)
+                self._expire_queue()
+                self._admit()  # mid-flight too: no drain barrier
+                if self._active.any():
+                    self._step_once()
+                self._maybe_report()
+        except Exception as e:  # noqa: BLE001 — a dead scheduler must
+            # fail pending work loudly, not hang every client forever
+            self.exception("decode engine scheduler died")
+            self._fail_all(e)
+        finally:
+            self._fail_all(EngineStopped("engine stopped"))
+
+    def _fail_all(self, err: Exception):
+        with self._qlock:
+            pending = list(self._queue)
+            self._queue.clear()
+        for req in pending:
+            req.finish(error=err)
+        for s, req in enumerate(self._slot_req):
+            if req is not None:
+                req.finish(error=err)
+                self._slot_req[s] = None
+        self._active[:] = False
+
+    def _expire_queue(self):
+        """Fail queued requests whose deadline passed while they waited
+        behind a full slot set (they'd otherwise only be checked when a
+        slot freed)."""
+        if not self._queue:
+            return
+        now = time.monotonic()
+        expired = []
+        with self._qlock:
+            if any(now > r.deadline for r in self._queue):
+                keep = collections.deque()
+                for r in self._queue:
+                    (expired if now > r.deadline else keep).append(r)
+                self._queue = keep
+        for r in expired:
+            self._timeouts += 1
+            r.finish(error=TimeoutError(
+                "request deadline expired while queued"))
+
+    def _admit(self) -> int:
+        """Move queued requests into free slots (prefill); returns the
+        number admitted.  Runs on the scheduler thread only."""
+        n = 0
+        while True:
+            free = np.flatnonzero(~self._active)
+            if not len(free):
+                return n
+            with self._qlock:
+                req = self._queue.popleft() if self._queue else None
+            if req is None:
+                return n
+            now = time.monotonic()
+            if now > req.deadline:
+                self._timeouts += 1
+                req.finish(error=TimeoutError(
+                    "request deadline expired while queued"))
+                continue
+            self._prefill(int(free[0]), req)
+            n += 1
+
+    def _prefill(self, slot: int, req: _Request):
+        params = self.wstate["params"]
+        P = int(req.prompt.size)
+        pb = self._bucket(P)
+        fn = self._prefill_fn(pb, params)
+        padded = np.zeros((1, pb), np.int32)
+        padded[0, :P] = req.prompt
+        temp = np.float32(req.temperature)
+        # sentinels: see _sample_slots
+        topk = np.int32(req.top_k if req.top_k is not None
+                        else self._vocab)
+        topp = np.float32(req.top_p if req.top_p is not None else 1.0)
+        self._caches, self._toks, first = fn(
+            params, self._caches, self._toks, padded, np.int32(P),
+            np.int32(slot), temp, topk, topp, req.key_data)
+        first = int(first)
+        self._pos[slot] = P
+        self._temp[slot] = temp
+        self._topk[slot] = topk
+        self._topp[slot] = topp
+        self._eos[slot] = -1 if req.eos_id is None else req.eos_id
+        self._end[slot] = P + req.n_steps - 1
+        self._keys[slot] = req.key_data
+        self._slot_req[slot] = req
+        req.slot = slot
+        self._admitted += 1
+        self._tok_count += 1
+        done = (req.n_steps == 1
+                or (req.eos_id is not None and first == req.eos_id))
+        self._active[slot] = not done
+        if done:
+            self._retire(slot)
+
+    def _step_once(self):
+        self._caches, self._toks, pos, active, finished = self._decode(
+            self.wstate["params"], self._caches, self._toks, self._pos,
+            self._active, self._temp, self._topk, self._topp, self._eos,
+            self._end, self._keys)
+        n_active = int(self._active.sum())
+        self._decode_steps += 1
+        self._occupancy_sum += n_active
+        self._tok_count += n_active
+        # np.array (copy): asarray would alias the read-only device view
+        self._pos = np.array(pos)
+        self._active = np.array(active)
+        now = time.monotonic()
+        for slot in np.flatnonzero(np.asarray(finished)):
+            self._retire(int(slot))
+        # mid-flight deadline: a wedged client must not hold a slot
+        for slot in np.flatnonzero(self._active):
+            req = self._slot_req[slot]
+            if req is not None and now > req.deadline:
+                self._active[slot] = False
+                self._slot_req[slot] = None
+                self._timeouts += 1
+                req.finish(error=TimeoutError(
+                    "request deadline expired while decoding"))
+
+    def _retire(self, slot: int):
+        req = self._slot_req[slot]
+        self._active[slot] = False
+        self._slot_req[slot] = None
+        if req is None:
+            return
+        toks = np.asarray(self._toks[slot, :int(self._pos[slot]) + 1],
+                          np.int32)
+        self._retired += 1
+        req.finish(result=toks)
+
+    def _maybe_report(self):
+        if self.status is None:
+            return
+        now = time.monotonic()
+        if now - self._status_mark >= 0.5:
+            self._status_mark = now
+            try:
+                self.status.update(engine=self.stats())
+            except Exception:  # status must never take the engine down
+                pass

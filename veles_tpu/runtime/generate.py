@@ -90,10 +90,31 @@ def _rec_decode_step(u, params, st, x_t):
     return h, {"h": h}
 
 
+def _rope_rows(x, pos):
+    """RoPE for a one-position activation (B, 1, H, D) where each batch
+    row sits at its OWN global position ``pos`` (B,) — the slot-batched
+    decode formulation.  The per-row angle ``pos * inv_freq`` is the same
+    product the scalar path computes (``(offset + arange(1)) * inv_freq``
+    with a zero arange), so a row here is bitwise the scalar-path row."""
+    B, T, H, D = x.shape
+    half = D // 2
+    inv_freq = 10000.0 ** (-jnp.arange(half, dtype=jnp.float32) / half)
+    ang = pos.astype(jnp.float32)[:, None] * inv_freq[None, :]  # (B, half)
+    cos = jnp.cos(ang)[:, None, None, :].astype(x.dtype)
+    sin = jnp.sin(ang)[:, None, None, :].astype(x.dtype)
+    x1 = x[..., 0::2]
+    x2 = x[..., 1::2]
+    out = jnp.stack([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.reshape(B, T, H, D)
+
+
 def _attn_decode_step(u, params, cache, x_t, pos):
     """One-position attention against the cache.
 
     x_t: (B, E) activation at position ``pos``; cache k/v: (B, L, Hk, Dh).
+    ``pos`` is either a scalar (every row at the same position — the
+    ``generate()`` scan) or a (B,) vector of PER-ROW positions (the
+    continuous-batching engine, where each slot decodes independently).
     Numerics match MultiHeadAttention.apply (f32 score/prob accumulation,
     scale Dh**-0.5, RoPE at the global position, GQA head grouping,
     sliding window, residual)."""
@@ -101,6 +122,8 @@ def _attn_decode_step(u, params, cache, x_t, pos):
     H, Hk = u.n_heads, u.n_kv_heads
     dt = u.compute_dtype or x_t.dtype
     xq = x_t.astype(dt)
+    pos = jnp.asarray(pos)
+    per_row = pos.ndim == 1
 
     def proj(w, nh):
         return (xq @ w.astype(dt)).reshape(B, 1, nh, -1)
@@ -109,12 +132,21 @@ def _attn_decode_step(u, params, cache, x_t, pos):
     k = proj(params["wk"], Hk)
     v = proj(params["wv"], Hk)
     if u.rope:
-        q = rotary_embedding(q, offset=pos)
-        k = rotary_embedding(k, offset=pos)
-    ck = jax.lax.dynamic_update_slice_in_dim(
-        cache["k"], k.astype(cache["k"].dtype), pos, axis=1)
-    cv = jax.lax.dynamic_update_slice_in_dim(
-        cache["v"], v.astype(cache["v"].dtype), pos, axis=1)
+        if per_row:
+            q = _rope_rows(q, pos)
+            k = _rope_rows(k, pos)
+        else:
+            q = rotary_embedding(q, offset=pos)
+            k = rotary_embedding(k, offset=pos)
+    if per_row:
+        rows = jnp.arange(B)
+        ck = cache["k"].at[rows, pos].set(k[:, 0].astype(cache["k"].dtype))
+        cv = cache["v"].at[rows, pos].set(v[:, 0].astype(cache["v"].dtype))
+    else:
+        ck = jax.lax.dynamic_update_slice_in_dim(
+            cache["k"], k.astype(cache["k"].dtype), pos, axis=1)
+        cv = jax.lax.dynamic_update_slice_in_dim(
+            cache["v"], v.astype(cache["v"].dtype), pos, axis=1)
 
     Dh = q.shape[-1]
     G = H // Hk
@@ -124,10 +156,16 @@ def _attn_decode_step(u, params, cache, x_t, pos):
     vf = cv.astype(jnp.float32)
     s = jnp.einsum("bkgd,btkd->bkgt", qg, kf) * (Dh ** -0.5)
     t_idx = jnp.arange(L)
-    mask = t_idx <= pos
-    if u.window is not None:
-        mask &= t_idx > pos - u.window
-    s = jnp.where(mask[None, None, None, :], s, -jnp.inf)
+    if per_row:
+        mask = t_idx[None, :] <= pos[:, None]     # (B, L)
+        if u.window is not None:
+            mask &= t_idx[None, :] > pos[:, None] - u.window
+        s = jnp.where(mask[:, None, None, :], s, -jnp.inf)
+    else:
+        mask = t_idx <= pos
+        if u.window is not None:
+            mask &= t_idx > pos - u.window
+        s = jnp.where(mask[None, None, None, :], s, -jnp.inf)
     p = jax.nn.softmax(s, axis=-1)
     o = jnp.einsum("bkgt,btkd->bkgd", p, vf)      # (B, Hk, G, Dh)
     y = o.reshape(B, H * Dh).astype(dt) @ params["wo"].astype(dt)
@@ -273,7 +311,14 @@ class DecodePlan:
 
     def step(self, params, caches, tok, pos, ctx: Context):
         """One decode position: token ids (B,) -> (logits (B, V), caches).
-        O(L) attention per layer via the cache."""
+        O(L) attention per layer via the cache.
+
+        ``pos`` may be a scalar (the whole batch at one position — the
+        ``generate()`` scan) or a (B,) vector of per-row positions, the
+        masked-batched form the continuous-batching engine
+        (runtime/engine.py) drives: each slot attends ``t <= pos[row]``
+        and writes its KV at its own position.  Recurrent / pointwise
+        units are position-free, so only attention branches on it."""
         x = jnp.take(params[self.embedding.name]["table"],
                      tok.astype(jnp.int32), axis=0)      # (B, E)
 
@@ -337,8 +382,15 @@ class DecodePlan:
 #: under the REST server's worker threads; duplicate compilation of the
 #: same brand-new shape by two concurrent requests is accepted (results
 #: identical, last insert wins).
-_MAX_RUNNERS = 32
 _runner_lock = __import__("threading").Lock()
+
+
+def _max_runners() -> int:
+    """LRU capacity, tuneable via ``root.common.serve.runner_cache`` (a
+    public endpoint decides how many distinct shape/sampling programs
+    are worth keeping warm; at least one is always retained)."""
+    from ..config import root
+    return max(1, int(root.common.serve.get("runner_cache", 32)))
 
 
 def _runner_cache(wf, ck):
@@ -356,7 +408,8 @@ def _runner_cache(wf, ck):
 def _runner_cache_put(cache, ck, run):
     with _runner_lock:
         cache[ck] = run
-        while len(cache) > _MAX_RUNNERS:
+        limit = _max_runners()
+        while len(cache) > limit:
             cache.pop(next(iter(cache)))
 
 
@@ -402,6 +455,7 @@ def sample_logits(logits, key, *, temperature: float = 0.0,
 def generate(wf, wstate, prompt, n_steps: int, *,
              temperature: float = 0.0, key=None,
              top_k: Optional[int] = None, top_p: Optional[float] = None,
+             eos_id: Optional[int] = None,
              output_unit: Optional[str] = None,
              cache_dtype=jnp.float32):
     """Decode ``n_steps`` tokens after ``prompt`` (B, P) int32.
@@ -411,6 +465,12 @@ def generate(wf, wstate, prompt, n_steps: int, *,
     int32 — prompt followed by the continuation. The prompt is prefilled
     through the same cached decode step (teacher-forced), so prefill
     costs O(P·L) per layer and each generated token O(L).
+
+    With ``eos_id`` set, a row that emits it is finished: every later
+    position of that row is ``eos_id`` (the returned shape stays
+    (B, P + n_steps)), and the token loop is a ``while_loop`` that EXITS
+    as soon as every row has finished — decode stops paying for tokens
+    past end-of-sequence instead of grinding out padding.
     """
     prompt = jnp.asarray(prompt, jnp.int32)
     B, P = prompt.shape
@@ -429,6 +489,7 @@ def generate(wf, wstate, prompt, n_steps: int, *,
     ck = (B, P, int(n_steps), float(temperature),
           None if top_k is None else int(top_k),
           None if top_p is None else float(top_p),
+          None if eos_id is None else int(eos_id),
           output_unit, jnp.dtype(cache_dtype).name)
     cache, hit = _runner_cache(wf, ck)
     if hit is not None:
@@ -436,29 +497,73 @@ def generate(wf, wstate, prompt, n_steps: int, *,
     plan = DecodePlan(wf, output_unit)
     ctx = Context(train=False, key=None, mesh=None)
 
-    @jax.jit
-    def run(params, prompt, key):
-        caches = plan.init_caches(params, B, L, cache_dtype)
-        toks = jnp.zeros((B, L), jnp.int32)
-        toks = jax.lax.dynamic_update_slice_in_dim(toks, prompt, 0, 1)
+    def body_step(params, key, caches, toks, pos, alive):
+        """One token position, shared by the scan and while_loop forms.
+        ``params``/``key`` MUST be the jitted runner's own arguments —
+        closing over generate()'s locals would bake the first call's
+        weights and PRNG key into the cached executable as constants
+        (every later cache hit would silently replay them).  ``alive``
+        is None on the eos-free path (every row runs to L)."""
+        tok = jax.lax.dynamic_slice_in_dim(toks, pos, 1, 1)[:, 0]
+        logits, caches = plan.step(params, caches, tok, pos, ctx)
+        nxt = sample_logits(
+            logits, jax.random.fold_in(key, pos),
+            temperature=temperature, top_k=top_k, top_p=top_p)
+        # teacher-force prompt positions; write generated thereafter
+        gen = pos + 1 >= P
+        cur = jax.lax.dynamic_slice_in_dim(toks, pos + 1, 1, 1)[:, 0]
+        val = nxt.astype(jnp.int32)
+        if alive is not None:
+            # finished rows pad with eos from the position after their
+            # first eos onward; a row dies the step it EMITS eos (the
+            # emitted eos itself is still written by the alive branch)
+            val = jnp.where(alive, val, jnp.int32(eos_id))
+            alive = alive & (~gen | (nxt.astype(jnp.int32) != eos_id))
+        val = jnp.where(gen, val, cur)
+        toks = jax.lax.dynamic_update_slice_in_dim(
+            toks, val[:, None], pos + 1, 1)
+        return caches, toks, alive
 
-        def body(carry, pos):
-            caches, toks = carry
-            tok = jax.lax.dynamic_slice_in_dim(toks, pos, 1, 1)[:, 0]
-            logits, caches = plan.step(params, caches, tok, pos, ctx)
-            nxt = sample_logits(
-                logits, jax.random.fold_in(key, pos),
-                temperature=temperature, top_k=top_k, top_p=top_p)
-            # teacher-force prompt positions; write generated thereafter
-            cur = jax.lax.dynamic_slice_in_dim(toks, pos + 1, 1, 1)[:, 0]
-            val = jnp.where(pos + 1 >= P, nxt.astype(jnp.int32), cur)
-            toks = jax.lax.dynamic_update_slice_in_dim(
-                toks, val[:, None], pos + 1, 1)
-            return (caches, toks), None
+    if eos_id is None:
+        @jax.jit
+        def run(params, prompt, key):
+            caches = plan.init_caches(params, B, L, cache_dtype)
+            toks = jnp.zeros((B, L), jnp.int32)
+            toks = jax.lax.dynamic_update_slice_in_dim(toks, prompt, 0, 1)
 
-        (caches, toks), _ = jax.lax.scan(
-            body, (caches, toks), jnp.arange(L - 1))
-        return toks
+            def body(carry, pos):
+                caches, toks = carry
+                caches, toks, _ = body_step(
+                    params, key, caches, toks, pos, None)
+                return (caches, toks), None
+
+            (caches, toks), _ = jax.lax.scan(
+                body, (caches, toks), jnp.arange(L - 1))
+            return toks
+    else:
+        @jax.jit
+        def run(params, prompt, key):
+            caches = plan.init_caches(params, B, L, cache_dtype)
+            toks = jnp.zeros((B, L), jnp.int32)
+            toks = jax.lax.dynamic_update_slice_in_dim(toks, prompt, 0, 1)
+            alive = jnp.ones((B,), bool)
+
+            def cond(carry):
+                _, _, pos, alive = carry
+                return (pos < L - 1) & alive.any()
+
+            def body(carry):
+                caches, toks, pos, alive = carry
+                caches, toks, alive = body_step(
+                    params, key, caches, toks, pos, alive)
+                return caches, toks, pos + 1, alive
+
+            caches, toks, pos, alive = jax.lax.while_loop(
+                cond, body, (caches, toks, jnp.int32(0), alive))
+            # rows can only die at generated positions (>= P), so every
+            # unwritten position past the early exit is eos padding
+            return jnp.where(jnp.arange(L)[None, :] > pos,
+                             jnp.int32(eos_id), toks)
 
     out = run(params, prompt, key)
     _runner_cache_put(cache, ck, run)  # only successful runners cache

@@ -12,12 +12,22 @@ normalizer state).
 
 Round 4: pass ``workflow=`` to also serve POST /generate
 {"prompt": [[ids]], "steps": N, "temperature": t, "top_k": k,
-"top_p": p, "seed": s} -> {"tokens": [[...]]} — the KV-cached /
-carried-state decode of runtime/generate.py behind HTTP — or
-deterministic beam search with {"beams": W, "eos_id": E,
+"top_p": p, "eos_id": E, "seed": s} -> {"tokens": [[...]]} — the
+KV-cached / carried-state decode of runtime/generate.py behind HTTP —
+or deterministic beam search with {"beams": W, "eos_id": E,
 "length_penalty": a} -> {"tokens": ..., "scores": [...]} (the
 reference's RESTful API was forward-only; its framework had no
-sequence models to decode)."""
+sequence models to decode).
+
+Pass ``engine=`` (a started or startable
+:class:`~veles_tpu.runtime.engine.DecodeEngine`) to serve non-beam
+/generate through the continuous-batching engine instead of per-request
+``generate()`` calls: concurrent requests share slots mid-flight, the
+program set is fixed for the engine lifetime, queue overflow answers
+**429 with a Retry-After header** (the backpressure contract of
+docs/serving.md), and GET /engine exposes the live gauges.  Request
+bodies are capped at ``root.common.serve.max_body_mb`` (413 beyond it —
+the snapshot_http_max_mb pattern applied to the ingress side)."""
 
 from __future__ import annotations
 
@@ -28,14 +38,16 @@ from typing import Callable, Optional
 
 import numpy as np
 
+from ..config import root
 from ..logger import Logger
+from .engine import EngineOverloaded
 
 
 class RestfulServer(Logger):
     def __init__(self, predict_fn: Callable, wstate, batch_size: int,
                  input_shape, *, port: int = 0, host: str = "127.0.0.1",
                  normalizer=None, denormalizer=None, workflow=None,
-                 input_dtype=np.float32):
+                 engine=None, input_dtype=np.float32):
         self.predict_fn = predict_fn
         self.wstate = wstate
         self.batch_size = int(batch_size)
@@ -44,16 +56,26 @@ class RestfulServer(Logger):
         self.normalizer = normalizer
         self.denormalizer = denormalizer
         self.workflow = workflow  # enables POST /generate (module doc)
+        self.engine = engine      # continuous-batching /generate path
         outer = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
-            def _reply(self, obj, code=200):
+            def _reply(self, obj, code=200, headers=()):
                 body = json.dumps(obj).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in headers:
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path.rstrip("/") == "/engine" \
+                        and outer.engine is not None:
+                    self._reply(outer.engine.stats())
+                    return
+                self.send_error(404)
 
             def do_POST(self):
                 path = self.path.rstrip("/")
@@ -62,12 +84,30 @@ class RestfulServer(Logger):
                     return
                 try:
                     n = int(self.headers.get("Content-Length", 0))
+                    cap = int(float(root.common.serve.get(
+                        "max_body_mb", 64)) * 2 ** 20)
+                    if n > cap:
+                        # mirror the snapshot_http_max_mb pattern: refuse
+                        # BEFORE reading an unbounded body into memory
+                        self._reply(
+                            {"error": f"request body {n} bytes exceeds "
+                                      f"the {cap} byte cap "
+                                      "(root.common.serve.max_body_mb)"},
+                            code=413)
+                        return
                     req = json.loads(self.rfile.read(n))
                     if path == "/generate":
                         self._reply(outer.decode(req))
                         return
                     self._reply(
                         {"output": outer.infer(req["input"]).tolist()})
+                except EngineOverloaded as e:
+                    self._reply(
+                        {"error": str(e)}, code=429,
+                        headers=(("Retry-After",
+                                  str(int(round(e.retry_after_s)))),))
+                except TimeoutError as e:
+                    self._reply({"error": str(e)}, code=504)
                 except (KeyError, TypeError, ValueError,
                         json.JSONDecodeError) as e:
                     self._reply({"error": str(e)}, code=400)
@@ -213,24 +253,24 @@ class RestfulServer(Logger):
             raise ValueError(
                 "top_k/top_p filter sampling and need temperature > 0 "
                 "(temperature 0 is greedy decoding)")
+        eos_id = req.get("eos_id")
+        if eos_id is not None:
+            # forward the COERCED value: a float 2.0 would pass the
+            # range check then raise TypeError inside generate_beam's
+            # .at[eos_id]
+            eos_id = self._req_int(eos_id, "eos_id")
+            if not 0 <= eos_id < hi:
+                # out-of-vocab eos could never fire and would
+                # silently disable eos freezing (the native CLI
+                # rejects it too)
+                raise ValueError(
+                    f"eos_id {eos_id} is outside the model "
+                    f"vocabulary [0, {hi})")
         if beams > 1:
             if temperature > 0 or req.get("seed") is not None:
                 raise ValueError(
                     "beams is deterministic search; drop temperature/"
                     "top_k/top_p/seed or use beams=1")
-            eos_id = req.get("eos_id")
-            if eos_id is not None:
-                # forward the COERCED value: a float 2.0 would pass the
-                # range check then raise TypeError inside generate_beam's
-                # .at[eos_id]
-                eos_id = self._req_int(eos_id, "eos_id")
-                if not 0 <= eos_id < hi:
-                    # out-of-vocab eos could never fire and would
-                    # silently disable eos freezing (the native CLI
-                    # rejects it too)
-                    raise ValueError(
-                        f"eos_id {eos_id} is outside the model "
-                        f"vocabulary [0, {hi})")
             length_penalty = float(req.get("length_penalty", 0.0))
             if length_penalty < 0:
                 raise ValueError(
@@ -242,18 +282,29 @@ class RestfulServer(Logger):
                 length_penalty=length_penalty)
             return {"tokens": np.asarray(toks).tolist(),
                     "scores": np.asarray(scores).tolist()}
-        if req.get("eos_id") is not None or req.get("length_penalty"):
+        if req.get("length_penalty"):
             raise ValueError(
-                "eos_id/length_penalty shape BEAM scores and need "
-                "beams > 1")
+                "length_penalty shapes BEAM scores and needs beams > 1")
         import jax
         key = jax.random.key(self._req_int(req.get("seed", 0), "seed"))
+        if self.engine is not None:
+            # continuous batching: rows ride slots alongside other
+            # requests; rows past their eos come back eos-padded, same
+            # as generate(eos_id).  EngineOverloaded propagates to the
+            # handler's 429 + Retry-After.
+            toks = self.engine.generate(
+                prompt.astype(np.int32), steps, temperature=temperature,
+                top_k=top_k, top_p=top_p, eos_id=eos_id, key=key)
+            return {"tokens": np.asarray(toks).tolist()}
         toks = generate(
             self.workflow, self.wstate, prompt.astype(np.int32), steps,
-            temperature=temperature, top_k=top_k, top_p=top_p, key=key)
+            temperature=temperature, top_k=top_k, top_p=top_p,
+            eos_id=eos_id, key=key)
         return {"tokens": np.asarray(toks).tolist()}
 
     def start(self):
+        if self.engine is not None and not self.engine.started:
+            self.engine.start()
         self._thread = threading.Thread(target=self.httpd.serve_forever,
                                         daemon=True)
         self._thread.start()
@@ -264,3 +315,5 @@ class RestfulServer(Logger):
     def stop(self):
         self.httpd.shutdown()
         self.httpd.server_close()
+        if self.engine is not None:
+            self.engine.stop()

@@ -128,8 +128,18 @@ class _Handler(http.server.BaseHTTPRequestHandler):
             body = json.dumps(doc).encode()
             ctype = "application/json"
         else:
+            def flat(d, prefix=""):
+                # nested gauge groups (e.g. the decode engine's) render
+                # as dotted rows instead of one opaque repr cell
+                for k, v in sorted(d.items()):
+                    key = f"{prefix}{k}"
+                    if isinstance(v, dict):
+                        yield from flat(v, key + ".")
+                    else:
+                        yield key, v
+
             rows = "".join(f"<tr><td>{k}</td><td>{v}</td></tr>"
-                           for k, v in sorted(doc.items()))
+                           for k, v in flat(doc))
             plots = self.reporter.plot_files() if self.reporter else []
             # mtime cache-buster: the 2s meta refresh re-requests each
             # image only as it actually changes
