@@ -20,6 +20,12 @@ Two comparisons, both reported:
   honest context, not the headline — on TPU the decode step is
   weight/bandwidth-bound and slots amortize it (docs/serving.md).
 
+A third scenario exercises the model lifecycle control plane
+(runtime/deploy.py): offered load held constant while the engine
+hot-swaps weights N times at decode-step boundaries — swap latency,
+dropped/errored requests (must be 0), and the p95 delta inside the
+swap windows are reported under "hot_swap".
+
 Prints ONE JSON line in the bench.py contract:
   {"metric": "serving_decode_tokens_per_sec", "value": N,
    "unit": "tokens/s", "vs_baseline": N, ...}
@@ -138,10 +144,81 @@ def main():
             "errors": errs,
         }, wall
 
+    def run_hot_swap(conc, n_swaps, params_a, params_b):
+        """Offered load held constant across n_swaps hot weight swaps
+        (runtime/deploy.py semantics: the flip happens at a decode-step
+        boundary while old requests keep their slots).  Reports swap
+        latency, dropped/errored requests (must be 0), and the p95
+        latency delta inside vs outside the swap windows."""
+        recs = []     # (start, end) per completed request
+        errs = []
+        lock = threading.Lock()
+        stop = threading.Event()
+        compiles0 = eng.stats()["compile"]["compiles"]
+
+        def worker(wid):
+            i = wid
+            while not stop.is_set():
+                p, n = work[i % len(work)]
+                i += conc
+                t = time.perf_counter()
+                try:
+                    eng.generate(p[None], n, timeout=600)
+                except Exception as e:  # noqa: BLE001
+                    errs.append(repr(e))
+                    return
+                with lock:
+                    recs.append((t, time.perf_counter()))
+
+        threads = [threading.Thread(target=worker, args=(w,))
+                   for w in range(conc)]
+        for t in threads:
+            t.start()
+        warm_deadline = time.perf_counter() + 120
+        while len(recs) < conc and not errs \
+                and time.perf_counter() < warm_deadline:
+            time.sleep(0.01)  # load flowing before the first swap
+        swap_lat, windows = [], []
+        for s in range(n_swaps):
+            time.sleep(0.3)
+            t = time.perf_counter()
+            eng.swap_params(params_b if s % 2 == 0 else params_a)
+            now = time.perf_counter()
+            swap_lat.append(now - t)
+            windows.append((t, now + 0.3))  # swap + settling tail
+        time.sleep(0.3)
+        stop.set()
+        for t in threads:
+            t.join()
+        lat_all = [(e - s, s, e) for s, e in recs]
+        in_win = [d for d, s, e in lat_all
+                  if any(ws <= e and s <= we for ws, we in windows)]
+        out_win = [d for d, s, e in lat_all
+                   if not any(ws <= e and s <= we for ws, we in windows)]
+        p95 = lambda xs: (round(1e3 * float(np.percentile(xs, 95)), 1)
+                          if xs else None)  # noqa: E731
+        return {
+            "swaps": n_swaps, "concurrency": conc,
+            "swap_latency_ms": [round(1e3 * x, 1) for x in swap_lat],
+            "requests_completed": len(recs),
+            "dropped_or_errored": len(errs), "errors": errs[:4],
+            "p95_steady_ms": p95(out_win),
+            "p95_swap_window_ms": p95(in_win),
+            "p95_delta_ms": (round(p95(in_win) - p95(out_win), 1)
+                             if in_win and out_win else None),
+            "compiles_during_swaps":
+                eng.stats()["compile"]["compiles"] - compiles0,
+        }
+
     try:
         cold, cold_wall = run_engine(4)
         engine_endpoint_tps = total_tokens / (time.perf_counter() - t0)
         sweep = [run_engine(c)[0] for c in CONCURRENCY]
+        # second weight set, same architecture: what a reload serves
+        import jax
+        from veles_tpu.ops import optimizers as opt
+        ws_b = wf.init_state(jax.random.key(1), opt.SGD(0.01))
+        hot_swap = run_hot_swap(4, 4, ws["params"], ws_b["params"])
         final = eng.stats()
     finally:
         eng.stop()
@@ -172,6 +249,7 @@ def main():
                     "set + concurrency (see docs/serving.md)",
         },
         "sweep": sweep,
+        "hot_swap": hot_swap,
         "decode_recompiles": final["compile"]["recompiles"],
         "compiled_programs": final["compile"]["programs"],
         "engine_compile_wall_s": final["compile"]["compile_wall_s"],

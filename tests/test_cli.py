@@ -531,3 +531,45 @@ def test_cli_mesh_interleaved_fused(tmp_path):
     data = json.loads(res.read_text())
     import math
     assert math.isfinite(float(data["best_value"]))
+
+
+def test_console_script_entry_point(tmp_path):
+    """pyproject.toml packages the CLI as a `veles-tpu` console script
+    mapping to __main__.main (VERDICT open item #7).  The declared
+    entry point must resolve and run --help; when the package is
+    actually installed (CI: pip install -e .), the real script on PATH
+    is exercised too."""
+    import re
+    import shutil
+
+    ppt = open(os.path.join(REPO, "pyproject.toml")).read()
+    m = re.search(r'^veles-tpu\s*=\s*"([\w.]+):(\w+)"', ppt, re.M)
+    assert m, "pyproject.toml must declare the veles-tpu console script"
+    mod, func = m.groups()
+    assert (mod, func) == ("veles_tpu.__main__", "main")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    r = subprocess.run(
+        [sys.executable, "-c",
+         f"import {mod} as m, sys\n"
+         f"sys.exit(m.{func}(['--help']))"],
+        capture_output=True, text=True, env=env, cwd=str(tmp_path),
+        timeout=300)
+    assert r.returncode == 0, r.stderr
+    assert "veles_tpu" in r.stdout and "--serve" in r.stdout
+    exe = shutil.which("veles-tpu")
+    if exe:  # installed entry point present: must behave identically
+        r = subprocess.run([exe, "--help"], capture_output=True,
+                           text=True, env=env, timeout=300)
+        assert r.returncode == 0, r.stderr
+        assert "--serve" in r.stdout
+
+
+def test_cli_lifecycle_flags_parse():
+    """--model-dir / --watch / --drain-timeout ride --serve (the deploy
+    control plane's CLI surface, runtime/deploy.py)."""
+    from veles_tpu.__main__ import build_parser
+    a = build_parser().parse_args(
+        ["cfg.py", "--serve", "0", "--model-dir", "models",
+         "--watch", "--drain-timeout", "5"])
+    assert a.serve == 0 and a.model_dir == "models"
+    assert a.watch and a.drain_timeout == 5.0

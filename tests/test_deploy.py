@@ -1,0 +1,527 @@
+"""Model lifecycle control plane (runtime/deploy.py): hot weight swaps
+under concurrent load must drop zero requests and compile zero new
+programs, mismatched trees must be rejected with the old version still
+serving, the REST lifecycle endpoints must follow the drain contract
+(/ready -> 503 before the engine stops), and the snapshot watcher must
+swap automatically with retry backoff."""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import veles_tpu as vt
+from veles_tpu.models.standard import build_workflow
+from veles_tpu.ops import optimizers as opt
+from veles_tpu.runtime.deploy import DeployController
+from veles_tpu.runtime.engine import DecodeEngine, EngineDraining
+from veles_tpu.runtime.generate import generate
+from veles_tpu.runtime.restful import RestfulServer
+from veles_tpu.runtime.snapshotter import Snapshotter
+
+V = 12
+
+LAYERS = [
+    {"type": "embedding", "vocab": V, "dim": 16, "name": "emb"},
+    {"type": "attention", "n_heads": 2, "rope": True,
+     "residual": True, "name": "a1"},
+    {"type": "layer_norm", "name": "n1"},
+    {"type": "ffn", "d_hidden": 32, "name": "f1"},
+    {"type": "seq_last", "name": "last"},
+    {"type": "softmax", "output_size": V, "name": "out"},
+]
+
+
+def _build_lm(seed=3, layers=LAYERS, name="deploy_lm"):
+    wf = build_workflow(name, layers)
+    wf.build({"@input": vt.Spec((2, 6), jnp.int32),
+              "@labels": vt.Spec((2,), jnp.int32),
+              "@mask": vt.Spec((2,), jnp.float32)})
+    ws = wf.init_state(jax.random.key(seed), opt.SGD(0.1))
+    return wf, ws
+
+
+def _snap(tmp_path, wf, ws, tag, subdir="snaps"):
+    """A snapshot manifest the control plane can load (the Trainer's
+    payload shape: wstate + workflow_checksum)."""
+    snap = Snapshotter("m", str(tmp_path / subdir))
+    return snap.save(tag, {"wstate": ws,
+                           "workflow_checksum": wf.checksum()})
+
+
+# -- engine-level swap hook -------------------------------------------------
+
+def test_hot_swap_under_load_zero_drops_flat_compiles(rng):
+    """Mixed-shape concurrent requests across TWO hot swaps: every
+    request completes, the compile counters stay flat (the swap reuses
+    the engine's compiled programs), and a fresh greedy request after
+    the final swap matches generate() on the final weights."""
+    wf, ws_a = _build_lm(seed=3)
+    _, ws_b = _build_lm(seed=11)  # same arch, different weights
+    eng = DecodeEngine(wf, ws_a, slots=4, l_max=64, window_ms=0.0).start()
+    shapes = [(3, 4), (7, 3), (11, 5), (4, 2), (17, 4), (5, 6)]
+    prompts = [rng.integers(0, V, (1, p)).astype(np.int32)
+               for p, _ in shapes]
+    try:
+        # warm every bucket BEFORE the measured window so a legitimate
+        # first-compile can't masquerade as a swap-induced one
+        for pr, (_, n) in zip(prompts, shapes):
+            eng.generate(pr, n, timeout=180)
+        compiles_before = eng.stats()["compile"]["compiles"]
+
+        errs, done = [], []
+        stop = threading.Event()
+
+        def worker(i):
+            while not stop.is_set():
+                try:
+                    out = eng.generate(prompts[i], shapes[i][1],
+                                       timeout=180)
+                    done.append(out.shape)
+                except Exception as e:  # noqa: BLE001
+                    errs.append(repr(e))
+                    return
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(len(shapes))]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 60
+        while len(done) < 4:  # load is flowing
+            assert time.monotonic() < deadline, (done, errs)
+            time.sleep(0.01)
+        eng.swap_params(ws_b["params"])
+        while len(done) < 10:  # more requests complete on new weights
+            assert time.monotonic() < deadline, (done, errs)
+            time.sleep(0.01)
+        eng.swap_params(ws_a["params"])
+        stop.set()
+        for t in threads:
+            t.join(timeout=240)
+
+        assert not errs, errs
+        st = eng.stats()
+        assert st["swaps"] == 2
+        assert st["compile"]["compiles"] == compiles_before, st
+        assert st["compile"]["recompiles"] == 0, st
+        # back on ws_a: greedy must match the library path bit for bit
+        ref = np.asarray(generate(wf, ws_a, prompts[0], shapes[0][1]))
+        got = eng.generate(prompts[0], shapes[0][1], timeout=120)
+        np.testing.assert_array_equal(got, ref)
+    finally:
+        eng.stop()
+
+
+def test_swap_serves_new_weights(rng):
+    """Post-swap greedy tokens match a FRESH engine built on the new
+    weights — the swap really serves version B, not a cached A."""
+    wf, ws_a = _build_lm(seed=3)
+    _, ws_b = _build_lm(seed=11)
+    prompt = rng.integers(0, V, (1, 5)).astype(np.int32)
+    fresh = DecodeEngine(wf, ws_b, slots=2, l_max=32).start()
+    try:
+        ref_b = fresh.generate(prompt, 6, timeout=120)
+    finally:
+        fresh.stop()
+    eng = DecodeEngine(wf, ws_a, slots=2, l_max=32).start()
+    try:
+        got_a = eng.generate(prompt, 6, timeout=120)
+        eng.swap_params(ws_b["params"])
+        got_b = eng.generate(prompt, 6, timeout=120)
+        np.testing.assert_array_equal(got_b, ref_b)
+        assert not np.array_equal(got_a, got_b)  # weights really changed
+    finally:
+        eng.stop()
+
+
+def test_swap_rejects_mismatched_tree_old_still_serving(rng):
+    """A different-architecture tree is rejected with a clear error
+    naming the offending leaves, and the old version keeps serving."""
+    wf, ws = _build_lm(seed=3)
+    other_layers = [dict(LAYERS[0], dim=8)] + [dict(d) for d in LAYERS[1:]]
+    _, ws_small = _build_lm(seed=3, layers=other_layers, name="other_lm")
+    eng = DecodeEngine(wf, ws, slots=2, l_max=32).start()
+    prompt = rng.integers(0, V, (1, 4)).astype(np.int32)
+    try:
+        ref = np.asarray(generate(wf, ws, prompt, 5))
+        with pytest.raises(ValueError, match="hot swap rejected"):
+            eng.swap_params(ws_small["params"])
+        assert eng.stats()["swaps"] == 0
+        got = eng.generate(prompt, 5, timeout=120)
+        np.testing.assert_array_equal(got, ref)  # untouched
+    finally:
+        eng.stop()
+
+
+def test_engine_drain_refuses_new_work_retires_inflight(rng):
+    """drain(): a long in-flight request retires cleanly, new submits
+    raise EngineDraining, and the engine stops afterwards."""
+    wf, ws = _build_lm()
+    eng = DecodeEngine(wf, ws, slots=2, l_max=64, window_ms=0.0).start()
+    long_req = eng.submit(rng.integers(0, V, 4), 30)
+    deadline = time.monotonic() + 60
+    while eng.stats()["occupancy"] == 0:
+        assert time.monotonic() < deadline
+        time.sleep(0.001)
+
+    drained = {}
+    t = threading.Thread(
+        target=lambda: drained.setdefault("clean", eng.drain(60)))
+    t.start()
+    deadline = time.monotonic() + 30
+    while not eng.draining:
+        assert time.monotonic() < deadline
+        time.sleep(0.001)
+    with pytest.raises(EngineDraining):
+        eng.submit(rng.integers(0, V, 4), 2)
+    t.join(timeout=120)
+    assert drained.get("clean") is True
+    assert long_req.done.is_set() and long_req.error is None
+    assert not eng.started
+
+
+# -- control plane: registry, reload, rollback ------------------------------
+
+def test_reload_from_snapshot_updates_registry(tmp_path, rng):
+    wf, ws_a = _build_lm(seed=3)
+    _, ws_b = _build_lm(seed=11)
+    path_b = _snap(tmp_path, wf, ws_b, "v2")
+    prompt = rng.integers(0, V, (1, 5)).astype(np.int32)
+    ref_b = np.asarray(generate(wf, ws_b, prompt, 6))
+    eng = DecodeEngine(wf, ws_a, slots=2, l_max=32).start()
+    dep = DeployController(engine=eng)
+    try:
+        doc = dep.models_doc()
+        assert doc["active"] == 1 and len(doc["versions"]) == 1
+        out = dep.reload(path_b)
+        assert out["active"]["version"] == 2
+        assert out["active"]["kind"] == "snapshot"
+        assert out["active"]["checksum"]  # sha256 of the npz
+        assert out["compiles_during_swap"] == 0
+        got = eng.generate(prompt, 6, timeout=120)
+        np.testing.assert_array_equal(got, ref_b)
+        doc = dep.models_doc()
+        assert doc["active"] == 2 and len(doc["versions"]) == 2
+        assert doc["versions"][0]["active"] is False
+        # version= re-activates a registry entry from its source
+        dep.reload(version=2)
+        assert dep.registry.active_version == 3  # a fresh load event
+        with pytest.raises(ValueError, match="boot"):
+            dep.reload(version=1)  # the boot state has no source
+        with pytest.raises(KeyError):
+            dep.reload(version=99)
+    finally:
+        eng.stop()
+
+
+def test_reload_failure_leaves_old_serving(tmp_path, rng):
+    """Every failure mode of reload leaves the active version untouched
+    and still serving: missing file, mismatched architecture."""
+    wf, ws = _build_lm(seed=3)
+    other_layers = [dict(LAYERS[0], dim=8)] + [dict(d) for d in LAYERS[1:]]
+    wf2, ws_small = _build_lm(seed=3, layers=other_layers, name="other_lm")
+    bad_arch = _snap(tmp_path, wf2, ws_small, "bad")
+    prompt = rng.integers(0, V, (1, 4)).astype(np.int32)
+    eng = DecodeEngine(wf, ws, slots=2, l_max=32).start()
+    dep = DeployController(engine=eng)
+    try:
+        ref = np.asarray(generate(wf, ws, prompt, 5))
+        with pytest.raises(FileNotFoundError):
+            dep.reload(str(tmp_path / "nope.json"))
+        # the layer widths differ but the graph topology (and so the
+        # checksum) matches — the SIGNATURE check is the enforcement,
+        # and its error names the offending leaves
+        with pytest.raises(ValueError, match=r"hot swap rejected.*emb"):
+            dep.reload(bad_arch)
+        assert dep.registry.active_version == 1
+        assert dep.swaps == 0 and dep.last_error
+        got = eng.generate(prompt, 5, timeout=120)
+        np.testing.assert_array_equal(got, ref)
+    finally:
+        eng.stop()
+
+
+def test_reload_from_export_package(tmp_path, rng):
+    """An export_package() directory is a weight source: float32 params
+    round-trip exactly, so greedy tokens match the packaged weights."""
+    from veles_tpu.export import export_package
+    wf, ws_a = _build_lm(seed=3)
+    _, ws_b = _build_lm(seed=11)
+    pkg = str(tmp_path / "pkg")
+    export_package(wf, ws_b, pkg)
+    prompt = rng.integers(0, V, (1, 5)).astype(np.int32)
+    ref_b = np.asarray(generate(wf, ws_b, prompt, 6))
+    eng = DecodeEngine(wf, ws_a, slots=2, l_max=32).start()
+    dep = DeployController(engine=eng)
+    try:
+        out = dep.reload(pkg)
+        assert out["active"]["kind"] == "package"
+        got = eng.generate(prompt, 6, timeout=120)
+        np.testing.assert_array_equal(got, ref_b)
+    finally:
+        eng.stop()
+
+
+def test_reload_from_forge_store(tmp_path, rng):
+    """forge://<root>/<name> resolves through ForgeStore.version_dir —
+    the versioned store is a deployment source (ISSUE: Forge packages
+    close the training->serving loop)."""
+    from veles_tpu.export import export_package
+    from veles_tpu.forge.store import ForgeStore
+    wf, ws_a = _build_lm(seed=3)
+    _, ws_b = _build_lm(seed=11)
+    pkg = str(tmp_path / "pkg")
+    export_package(wf, ws_b, pkg)
+    store = ForgeStore(str(tmp_path / "store"))
+    store.add(ForgeStore.pack_dir(pkg, {
+        "name": "lm", "workflow": "deploy_lm", "configuration": "cfg"}))
+    prompt = rng.integers(0, V, (1, 5)).astype(np.int32)
+    ref_b = np.asarray(generate(wf, ws_b, prompt, 6))
+    eng = DecodeEngine(wf, ws_a, slots=2, l_max=32).start()
+    dep = DeployController(engine=eng)
+    try:
+        out = dep.reload(f"forge://{tmp_path / 'store'}/lm")
+        assert out["active"]["kind"] == "package"
+        got = eng.generate(prompt, 6, timeout=120)
+        np.testing.assert_array_equal(got, ref_b)
+    finally:
+        eng.stop()
+
+
+# -- REST lifecycle endpoints -----------------------------------------------
+
+def _body(raw):
+    try:
+        return json.loads(raw)
+    except json.JSONDecodeError:  # send_error(404) answers HTML
+        return {}
+
+
+def _get(port, path):
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}") as r:
+            return r.status, _body(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, _body(e.read())
+
+
+def _post(port, path, body=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        json.dumps(body or {}).encode(),
+        {"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req) as r:
+            return r.status, _body(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, _body(e.read())
+
+
+def test_healthz_ready_without_engine(rng):
+    """Liveness/readiness land even on a plain predict server — no
+    engine, no workflow, no deploy controller attached."""
+    wf, ws = _build_lm()
+    srv = RestfulServer(wf.make_predict_step("out"), ws, 2, (6,),
+                        input_dtype=np.int32).start()
+    try:
+        code, doc = _get(srv.port, "/healthz")
+        assert code == 200 and doc["status"] == "alive"
+        code, doc = _get(srv.port, "/ready")
+        assert code == 200 and doc["ready"] is True
+        code, _ = _get(srv.port, "/models")      # no deploy attached
+        assert code == 404
+        code, _ = _post(srv.port, "/admin/reload", {"path": "x"})
+        assert code == 404
+    finally:
+        srv.stop()
+
+
+def test_rest_reload_under_load_and_drain(tmp_path, rng):
+    """The acceptance scenario end to end: a running endpoint under
+    concurrent load survives POST /admin/reload with zero dropped
+    requests and zero new compiles; POST /admin/drain flips GET /ready
+    to 503, in-flight work retires, and the engine stops cleanly."""
+    wf, ws_a = _build_lm(seed=3)
+    _, ws_b = _build_lm(seed=11)
+    path_b = _snap(tmp_path, wf, ws_b, "v2")
+    eng = DecodeEngine(wf, ws_a, slots=4, l_max=64, window_ms=0.0,
+                       queue_depth=64)
+    srv = RestfulServer(wf.make_predict_step("out"), ws_a, 2, (6,),
+                        workflow=wf, engine=eng).start()
+    dep = DeployController(server=srv)
+    prompt = rng.integers(0, V, (1, 5)).astype(np.int32)
+    try:
+        assert srv.deploy is dep and dep.engine is eng
+        code, doc = _get(srv.port, "/ready")
+        assert code == 200 and doc["ready"], doc
+        # warm the bucket the load uses, then pin the compile counter
+        _post(srv.port, "/generate",
+              {"prompt": prompt.tolist(), "steps": 4})
+        compiles_before = eng.stats()["compile"]["compiles"]
+
+        codes, stop = [], threading.Event()
+        lock = threading.Lock()
+
+        def hammer():
+            while not stop.is_set():
+                code, _ = _post(srv.port, "/generate",
+                                {"prompt": prompt.tolist(), "steps": 4})
+                with lock:
+                    codes.append(code)
+
+        threads = [threading.Thread(target=hammer) for _ in range(3)]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 60
+        while len(codes) < 3:
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        code, out = _post(srv.port, "/admin/reload", {"path": path_b})
+        assert code == 200, out
+        assert out["active"]["version"] == 2
+        n_at_swap = len(codes)
+        while len(codes) < n_at_swap + 3:  # load flows across the swap
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        stop.set()
+        for t in threads:
+            t.join(timeout=120)
+        assert codes and all(c == 200 for c in codes), set(codes)
+        st = eng.stats()
+        assert st["compile"]["compiles"] == compiles_before, st
+        assert st["compile"]["recompiles"] == 0, st
+        # the swap actually took: greedy now matches ws_b
+        ref_b = np.asarray(generate(wf, ws_b, prompt, 4))
+        code, out = _post(srv.port, "/generate",
+                          {"prompt": prompt.tolist(), "steps": 4})
+        np.testing.assert_array_equal(np.asarray(out["tokens"]), ref_b)
+        code, doc = _get(srv.port, "/models")
+        assert code == 200 and doc["active"] == 2 and doc["swaps"] == 1
+
+        # a bad reload answers 409 and the active version is untouched
+        code, out = _post(srv.port, "/admin/reload",
+                          {"path": str(tmp_path / "missing.json")})
+        assert code == 409 and out["active"] == 2, out
+
+        # drain: 202 now, /ready 503s, the engine retires and stops
+        slow = threading.Thread(
+            target=lambda: _post(srv.port, "/generate",
+                                 {"prompt": prompt.tolist(),
+                                  "steps": 30}))
+        slow.start()
+        deadline = time.monotonic() + 30
+        while eng.stats()["occupancy"] == 0:
+            assert time.monotonic() < deadline
+            time.sleep(0.001)
+        code, doc = _post(srv.port, "/admin/drain")
+        assert code == 202 and doc["draining"] is True
+        code, doc = _get(srv.port, "/ready")
+        assert code == 503 and doc["reason"] == "draining", doc
+        assert dep.wait(timeout=120)  # drain completes
+        slow.join(timeout=60)
+        assert not eng.started
+        code, _ = _get(srv.port, "/healthz")  # alive while draining/done
+        assert code == 200
+        code, out = _post(srv.port, "/generate",
+                          {"prompt": prompt.tolist(), "steps": 2})
+        assert code == 503, out  # new work refused after drain
+    finally:
+        srv.stop()
+
+
+# -- snapshot watcher -------------------------------------------------------
+
+def test_watcher_autoswaps_and_backs_off(tmp_path, rng):
+    """The watcher survives a corrupt newest-snapshot (backoff + retry)
+    and swaps automatically once a good one lands."""
+    wf, ws_a = _build_lm(seed=3)
+    _, ws_b = _build_lm(seed=11)
+    model_dir = tmp_path / "models"
+    model_dir.mkdir()
+    # a corrupt manifest: references a tensors blob that does not exist
+    (model_dir / "m_bad.json").write_text(
+        json.dumps({"tensors": "missing.npz", "saved_at": time.time()}))
+    prompt = rng.integers(0, V, (1, 5)).astype(np.int32)
+    ref_b = np.asarray(generate(wf, ws_b, prompt, 6))
+    eng = DecodeEngine(wf, ws_a, slots=2, l_max=32).start()
+    dep = DeployController(engine=eng, model_dir=str(model_dir),
+                           watch_interval_s=0.05,
+                           watch_backoff_max_s=0.2)
+    try:
+        dep.start_watcher()
+        deadline = time.monotonic() + 30
+        while dep.last_error is None:  # the bad snapshot was attempted
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        assert dep.registry.active_version == 1  # and rejected
+        # now land a good snapshot (newer saved_at than the corrupt one)
+        Snapshotter("m", str(model_dir)).save(
+            "v2", {"wstate": ws_b, "workflow_checksum": wf.checksum()})
+        while dep.registry.active_version == 1:
+            assert time.monotonic() < deadline, dep.last_error
+            time.sleep(0.01)
+        assert dep.swaps == 1
+        got = eng.generate(prompt, 6, timeout=120)
+        np.testing.assert_array_equal(got, ref_b)
+        # steady state: the same snapshot is not re-swapped
+        time.sleep(0.3)
+        assert dep.swaps == 1
+    finally:
+        dep.stop_watcher()
+        eng.stop()
+
+
+def test_deploy_gauges_reach_status(tmp_path, rng):
+    """Swap/version gauges ride the existing status path: update() gets
+    a deploy group and record_event ships the swap history."""
+    from veles_tpu.runtime.status import StatusReporter
+    rep = StatusReporter(str(tmp_path / "status.json"), name="deploy")
+    wf, ws_a = _build_lm(seed=3)
+    _, ws_b = _build_lm(seed=11)
+    path_b = _snap(tmp_path, wf, ws_b, "v2")
+    eng = DecodeEngine(wf, ws_a, slots=2, l_max=32).start()
+    dep = DeployController(engine=eng, status=rep)
+    try:
+        dep.reload(path_b)
+        doc = rep.read()
+        assert doc["deploy"]["active_version"] == 2
+        assert doc["deploy"]["swaps"] == 1
+        assert any(e["kind"] == "swap" and e["version"] == 2
+                   for e in doc["events"])
+    finally:
+        eng.stop()
+
+
+def test_boot_snapshot_registers_reloadable_and_dedups_watcher(
+        tmp_path, rng):
+    """A boot_source that IS a snapshot manifest registers version 1
+    with its real checksum: the watcher does not redundantly re-swap
+    the very snapshot the process booted from, and {"version": 1}
+    reloads are legal."""
+    wf, ws_a = _build_lm(seed=3)
+    model_dir = tmp_path / "models"
+    model_dir.mkdir()
+    path_a = Snapshotter("m", str(model_dir)).save(
+        "v1", {"wstate": ws_a, "workflow_checksum": wf.checksum()})
+    eng = DecodeEngine(wf, ws_a, slots=2, l_max=32).start()
+    dep = DeployController(engine=eng, model_dir=str(model_dir),
+                           boot_source=path_a, watch_interval_s=0.05)
+    try:
+        boot = dep.registry.get(1)
+        assert boot["kind"] == "snapshot" and boot["checksum"]
+        dep.start_watcher()
+        time.sleep(0.5)
+        assert dep.swaps == 0  # booted weights == newest snapshot
+        dep.reload(version=1)  # boot IS reloadable now
+        assert dep.swaps == 1 and dep.registry.active_version == 2
+    finally:
+        dep.stop_watcher()
+        eng.stop()

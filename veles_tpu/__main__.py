@@ -178,7 +178,26 @@ def build_parser() -> argparse.ArgumentParser:
                         "of training: POST /predict, plus POST "
                         "/generate for sequence chains "
                         "(runtime/restful.py; 0 = ephemeral port); "
-                        "blocks until interrupted")
+                        "blocks until drained (SIGTERM / POST "
+                        "/admin/drain) or interrupted")
+    p.add_argument("--model-dir", default=None,
+                   help="snapshot directory backing --serve's model "
+                        "lifecycle control plane (runtime/deploy.py): "
+                        "POST /admin/reload hot-swaps a snapshot/"
+                        "package with zero downtime, GET /models lists "
+                        "the versioned registry")
+    p.add_argument("--watch", action="store_true",
+                   help="with --serve --model-dir: poll the directory "
+                        "for newer snapshots and hot-swap them "
+                        "automatically (exponential retry backoff on "
+                        "failures)")
+    p.add_argument("--drain-timeout", type=float, default=None,
+                   metavar="SECONDS",
+                   help="graceful-drain deadline for SIGTERM / POST "
+                        "/admin/drain: admissions stop and /ready "
+                        "answers 503 immediately, in-flight work gets "
+                        "this long to retire (default "
+                        "root.common.serve.drain_timeout_s)")
     p.add_argument("--status-port", type=int, default=None,
                    help="serve a live status page (JSON + HTML with "
                         "auto-refreshing metric plots) on this port; 0 "
@@ -782,9 +801,11 @@ def main(argv=None) -> int:
     if args.serve is not None:
         # HTTP serving mode: the reference's RESTfulAPI unit as a CLI
         # switch (veles/restful_api.py:78) — POST /predict on the chain
-        # head, POST /generate for sequence chains
-        import time as _time
-
+        # head, POST /generate for sequence chains, wrapped in the model
+        # lifecycle control plane (runtime/deploy.py): GET /healthz +
+        # /ready + /models, POST /admin/reload hot swaps, graceful
+        # drain on SIGTERM / POST /admin/drain
+        from .runtime.deploy import DeployController
         from .runtime.restful import RestfulServer
         wf = trainer.workflow
         head = wf.default_output()
@@ -793,14 +814,28 @@ def main(argv=None) -> int:
             wf.make_predict_step(head), trainer.wstate,
             int(spec.shape[0]), tuple(spec.shape[1:]),
             port=args.serve, workflow=wf,
-            input_dtype=spec.dtype).start()
-        print(json.dumps({"serving": srv.port, "predict_head": head}),
-              flush=True)
+            input_dtype=spec.dtype)
+        if args.watch and not (args.model_dir
+                               or root.common.serve.get("model_dir")):
+            raise SystemExit("--watch needs --model-dir (the snapshot "
+                             "directory to poll)")
+        deploy = DeployController(
+            server=srv, model_dir=args.model_dir,
+            drain_timeout_s=args.drain_timeout,
+            status=trainer.status,
+            boot_source=args.snapshot or "live")
+        deploy.install_signal_handlers()
+        srv.start()
+        if args.watch:
+            deploy.start_watcher()
+        print(json.dumps({"serving": srv.port, "predict_head": head,
+                          "model_dir": deploy.model_dir,
+                          "watching": deploy.watching}), flush=True)
         try:
-            while True:
-                _time.sleep(3600)
+            deploy.wait()  # released by SIGTERM / POST /admin/drain
         except KeyboardInterrupt:
-            srv.stop()
+            deploy.drain(timeout=0)  # interactive: skip the grace hold
+        srv.stop()
         return 0
     if args.generate is not None:
         # decode mode: the trained (or restored) sequence model emits a

@@ -267,6 +267,13 @@ def _defaults():
     root.common.serve.deadline_s = 120.0     # default per-request deadline
     root.common.serve.runner_cache = 32      # generate() compiled-runner LRU
     root.common.serve.max_body_mb = 64       # POST body cap -> 413
+    # Model lifecycle control plane (runtime/deploy.py, docs/serving.md).
+    root.common.serve.model_dir = ""         # registry/watcher snapshot dir
+    root.common.serve.swap_timeout_s = 60.0  # step-boundary flip deadline
+    root.common.serve.drain_timeout_s = 30.0  # graceful-drain deadline
+    root.common.serve.drain_grace_s = 2.0    # min /ready-503 hold on drain
+    root.common.serve.watch_interval_s = 5.0  # snapshot watcher poll period
+    root.common.serve.watch_backoff_max_s = 300.0  # watcher retry ceiling
 
 
 _defaults()

@@ -94,6 +94,16 @@ class ForgeStore(Logger):
         with open(path) as f:
             return Manifest(json.load(f))
 
+    def version_dir(self, name: str,
+                    version: Optional[str] = None) -> str:
+        """Filesystem directory of a stored version (``version=None`` /
+        ``"master"`` resolves to the latest) — the deploy control
+        plane's load-by-version hook: an ``export_package()`` directory
+        uploaded to the store serves straight from here via
+        ``forge://<store_root>/<name>[@version]`` sources
+        (runtime/deploy.py)."""
+        return self._vdir(name, self.resolve_version(name, version))
+
     def resolve_version(self, name: str, version: Optional[str]) -> str:
         versions = self._versions(name)
         if not versions:

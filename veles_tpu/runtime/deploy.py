@@ -1,0 +1,701 @@
+"""Model lifecycle control plane: versioned registry, zero-downtime hot
+weight swaps, graceful drain, and a snapshot watcher.
+
+The reference treated trained models as first-class deployable
+artifacts — Snapshotter checkpoints, a versioned Forge store, workflow
+packages consumed by a standalone serving runtime — but the rebuild's
+serving path (``DecodeEngine`` + ``RestfulServer``) was born with one
+immutable ``wstate``: updating weights meant killing the process and
+recompiling everything.  This module closes the training→serving loop
+without ever paying that outage:
+
+* a **versioned model registry**: every weight set this process has
+  served gets an entry (monotonic version id, source path/URI, sha256
+  checksum of the tensors blob, load timestamp); ``GET /models`` on the
+  REST server renders it with the active version marked;
+* **zero-downtime hot swaps**: new weights are loaded from a
+  Snapshotter snapshot (file manifest, ``sqlite://`` or ``http(s)://``
+  URI), an ``export_package()`` directory/zip, or a Forge store
+  (``forge://<root>/<name>[@version]``), cast against the live template,
+  staged to device as a *double buffer* while the old version keeps
+  serving, then flipped atomically at a decode-step boundary
+  (:meth:`DecodeEngine.swap_params`).  Same shapes/dtypes reuse the
+  engine's compiled programs — the StepCache counters stay flat across a
+  swap, and a mismatched tree is rejected with a clear error while the
+  old version keeps serving.  Any failure during the flip swaps the
+  previous buffer back (rollback);
+* **graceful drain** (``POST /admin/drain`` and the SIGTERM handler):
+  stop admissions → ``GET /ready`` answers 503 → in-flight slots retire
+  → the engine stops → :meth:`DeployController.wait` releases so the
+  process can exit cleanly;
+* an optional **snapshot watcher** thread polling a directory for newer
+  snapshots (by ``saved_at``, deduplicated by tensors checksum) with
+  exponential retry backoff, swapping automatically — the CLI's
+  ``--model-dir --watch`` (docs/serving.md).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..config import root
+from ..logger import Logger
+from .engine import EngineDraining, place_like, signature_mismatch
+from .snapshotter import (Snapshotter, list_snapshots, sha256_files,
+                          snapshot_checksum)
+from .step_cache import tree_signature
+
+
+def _shape_signature(tree, *, unwrap_keys: bool = False) -> Tuple:
+    """(path, shape) signature — the structural half of
+    :func:`tree_signature`.  Dtypes are deliberately excluded: a
+    float32-trained snapshot is castable to a bfloat16 serving template,
+    but a shape mismatch means a different architecture and must
+    reject.  ``unwrap_keys`` views typed PRNG keys as their raw
+    key_data, matching how snapshots store them (Snapshotter._to_numpy)
+    so a live template compares against saved trees leaf for leaf."""
+    if unwrap_keys:
+        tree = jax.tree.map(
+            lambda x: jax.random.key_data(x)
+            if hasattr(x, "dtype") and jnp.issubdtype(
+                x.dtype, jax.dtypes.prng_key) else x, tree)
+    return tuple((p, s, "") for p, s, _ in tree_signature(tree))
+
+
+def _cast_leaf(saved, template):
+    """Snapshot leaf → the live template's dtype (PRNG keys rewrap)."""
+    if hasattr(template, "dtype") and jnp.issubdtype(
+            template.dtype, jax.dtypes.prng_key):
+        return jax.random.wrap_key_data(jnp.asarray(saved, jnp.uint32))
+    return jnp.asarray(saved).astype(template.dtype)
+
+
+def _manifest_saved_at(path) -> float:
+    """``saved_at`` of a local snapshot manifest, 0.0 when unreadable
+    (remote URIs, packages) — the watcher's newness anchor."""
+    try:
+        with open(str(path)) as f:
+            return float(json.load(f).get("saved_at") or 0.0)
+    except (OSError, TypeError, ValueError, json.JSONDecodeError):
+        return 0.0
+
+
+class ModelRegistry(Logger):
+    """Versioned record of every weight set this process has served.
+
+    Entries are metadata only (version id, label, source, checksum,
+    load timestamp) — weights themselves live on device, only the
+    active buffer at rest (plus the staged one transiently during a
+    swap).  Re-activating an older version reloads it from its
+    source."""
+
+    def __init__(self):
+        self._entries: List[dict] = []
+        self._lock = threading.Lock()
+        self.active_version: Optional[int] = None
+
+    def add(self, *, label: str, source: str, kind: str,
+            checksum: str) -> dict:
+        with self._lock:
+            entry = {"version": len(self._entries) + 1,
+                     "label": str(label), "source": str(source),
+                     "kind": str(kind), "checksum": str(checksum),
+                     "loaded_at": time.time()}
+            self._entries.append(entry)
+        return entry
+
+    def get(self, version) -> dict:
+        try:
+            version = int(version)
+        except (TypeError, ValueError):
+            raise KeyError(f"version must be an integer, got {version!r}")
+        for e in self._entries:
+            if e["version"] == version:
+                return e
+        raise KeyError(
+            f"registry has no version {version} "
+            f"(has {[e['version'] for e in self._entries]})")
+
+    def activate(self, version: int) -> None:
+        self.active_version = int(version)
+
+    @property
+    def active(self) -> Optional[dict]:
+        if self.active_version is None:
+            return None
+        return self.get(self.active_version)
+
+    def to_doc(self) -> dict:
+        """JSON document for ``GET /models``."""
+        with self._lock:
+            return {"active": self.active_version,
+                    "versions": [dict(e, active=(e["version"]
+                                                 == self.active_version))
+                                 for e in self._entries]}
+
+
+class DeployController(Logger):
+    """The control plane wrapping a live engine and/or REST server.
+
+    ``DeployController(server=srv)`` attaches itself as ``srv.deploy``
+    so the server routes ``GET /models`` and ``POST /admin/*`` here;
+    ``engine=`` defaults to the server's engine.  A server-less
+    controller (``engine=`` only) drives a library-embedded engine; an
+    engine-less controller hot-swaps a plain predict server's
+    ``wstate`` (the swap is an atomic reference flip the per-request
+    handler picks up).
+    """
+
+    def __init__(self, *, server=None, engine=None,
+                 model_dir: Optional[str] = None, status=None,
+                 drain_timeout_s: Optional[float] = None,
+                 watch_interval_s: Optional[float] = None,
+                 watch_backoff_max_s: Optional[float] = None,
+                 boot_label: str = "boot", boot_source: str = "live"):
+        if server is None and engine is None:
+            raise ValueError(
+                "DeployController needs a server and/or an engine")
+        serve = root.common.serve
+        self.server = server
+        self.engine = engine if engine is not None \
+            else getattr(server, "engine", None)
+        self.status = status
+        self.model_dir = model_dir or (serve.get("model_dir") or None)
+        self.drain_timeout_s = float(
+            drain_timeout_s if drain_timeout_s is not None
+            else serve.get("drain_timeout_s", 30.0))
+        self.drain_grace_s = float(serve.get("drain_grace_s", 2.0))
+        self.watch_interval_s = float(
+            watch_interval_s if watch_interval_s is not None
+            else serve.get("watch_interval_s", 5.0))
+        self.watch_backoff_max_s = float(
+            watch_backoff_max_s if watch_backoff_max_s is not None
+            else serve.get("watch_backoff_max_s", 300.0))
+
+        self.registry = ModelRegistry()
+        self._ck_cache = None  # (path, mtime) -> digest memo
+        # a boot source that IS a snapshot (file manifest, sqlite://,
+        # http://) registers as a reloadable version — so POST
+        # /admin/reload {"version": 1} can roll back to boot — with its
+        # real checksum when the blob is local, which also lets the
+        # watcher's dedup see the booted weights (no redundant first
+        # swap of the very snapshot the process restored from)
+        has_boot_src = boot_source not in (None, "", "live")
+        boot_checksum = self._snapshot_checksum(str(boot_source)) \
+            if has_boot_src else ""
+        boot = self.registry.add(
+            label=boot_label, source=boot_source,
+            kind="snapshot" if has_boot_src else "live",
+            checksum=boot_checksum)
+        self.registry.activate(boot["version"])
+
+        self._reload_lock = threading.Lock()
+        self._draining = False
+        self._stopped = threading.Event()
+        self._drain_thread: Optional[threading.Thread] = None
+        self._watch_stop = threading.Event()
+        self._watch_thread: Optional[threading.Thread] = None
+        # newness floor: the watcher only acts on snapshots saved AFTER
+        # the one it last swapped in (or the boot snapshot), so a stale
+        # file can never ping-pong the endpoint backwards.  Weights from
+        # other sources (a 'live' boot, a manual reload of an external
+        # path) carry no floor — the watcher's contract is then "newest
+        # snapshot in model_dir wins" (docs/serving.md).
+        self._watch_floor = _manifest_saved_at(boot_source) \
+            if has_boot_src else 0.0
+        self.swaps = 0
+        self.last_swap_ms: Optional[float] = None
+        self.last_error: Optional[str] = None
+
+        if server is not None:
+            server.deploy = self  # routes /models + /admin/* here
+        self._report()
+
+    # -- live state ---------------------------------------------------------
+    def _live_wstate(self) -> dict:
+        if self.engine is not None:
+            return self.engine.wstate
+        return self.server.wstate
+
+    def _live_checksum(self) -> Optional[str]:
+        """Topology checksum of the served workflow, when known."""
+        wf = getattr(self.engine, "workflow", None) \
+            or getattr(self.server, "workflow", None)
+        try:
+            return wf.checksum() if wf is not None else None
+        except Exception:  # noqa: BLE001 — a guard, never a blocker
+            return None
+
+    # -- source loading -----------------------------------------------------
+    def _snapshot_checksum(self, path: str) -> str:
+        """:func:`snapshot_checksum` memoized on (path, manifest mtime):
+        the watcher checks a candidate's checksum and then reload()
+        hashes the same blob — a multi-GB npz must not be read twice
+        per swap."""
+        try:
+            key = (path, os.path.getmtime(path))
+        except OSError:
+            return snapshot_checksum(path)
+        if self._ck_cache is not None and self._ck_cache[0] == key:
+            return self._ck_cache[1]
+        digest = snapshot_checksum(path)
+        self._ck_cache = (key, digest)
+        return digest
+
+    def load_source(self, source: str) -> Tuple[dict, dict]:
+        """Resolve a weight source into host trees + registry metadata:
+        ``(parts, meta)`` where ``parts`` holds numpy ``params`` (and
+        optionally ``state``) and ``meta`` has label/kind/checksum.
+
+        Accepted forms: a Snapshotter manifest path (or the
+        ``_current``/``_best`` symlinks), a ``sqlite://`` / ``http(s)://``
+        snapshot URI, an ``export_package()`` directory or ``.zip``
+        (contents.json + npy), ``forge://<store_root>/<name>[@version]``,
+        or a snapshot *directory* (its newest manifest is taken)."""
+        if not source:
+            raise ValueError(
+                "reload needs a source (snapshot manifest / package path "
+                "/ forge:// URI) or a registry version")
+        source = str(source)
+        if source.startswith("forge://"):
+            rest = source[len("forge://"):]
+            path_part, _, ver = rest.partition("@")
+            store_root, _, name = path_part.rpartition("/")
+            if not store_root or not name:
+                raise ValueError(
+                    f"bad forge source {source!r}; expected "
+                    "forge://<store_root>/<name>[@version]")
+            from ..forge.store import ForgeStore
+            store = ForgeStore(store_root)
+            # pin the RESOLVED version in the registry: a bare
+            # forge://root/name means "latest NOW" — re-activating that
+            # entry later must reload the same weights, not whatever
+            # the store's latest has become
+            resolved = store.resolve_version(name, ver or None)
+            return self._load_package(
+                store.version_dir(name, resolved),
+                f"forge://{store_root}/{name}@{resolved}")
+        if source.startswith(("sqlite://", "http://", "https://")):
+            return self._from_snapshot(Snapshotter.load(source), source,
+                                       checksum="")
+        if source.endswith(".zip"):
+            return self._load_package(source, source)
+        if os.path.isdir(source):
+            if os.path.isfile(os.path.join(source, "contents.json")):
+                return self._load_package(source, source)
+            snaps = list_snapshots(source)
+            if not snaps:
+                raise ValueError(
+                    f"{source!r} holds no snapshot manifests and is not "
+                    "an export package (no contents.json)")
+            newest = snaps[-1]["path"]
+            return self._from_snapshot(
+                Snapshotter.load(newest), newest,
+                checksum=self._snapshot_checksum(newest))
+        return self._from_snapshot(
+            Snapshotter.load(source), source,
+            checksum=self._snapshot_checksum(source))
+
+    def _from_snapshot(self, payload: dict, source: str,
+                       checksum: str) -> Tuple[dict, dict]:
+        saved = payload.get("workflow_checksum")
+        live = self._live_checksum()
+        if saved and live and saved != live:
+            raise ValueError(
+                f"snapshot {source!r} was taken from a different "
+                f"workflow (checksum {saved!r} != served {live!r}); "
+                "refusing the swap — the old version keeps serving")
+        ws = payload.get("wstate") or {}
+        parts = {k: ws[k] for k in ("params", "state") if ws.get(k)}
+        if not parts.get("params"):
+            raise ValueError(f"snapshot {source!r} holds no params")
+        label = os.path.basename(source.rstrip("/")) or source
+        return parts, {"label": label, "kind": "snapshot",
+                       "checksum": checksum, "source": source}
+
+    def _load_package(self, path: str, source: str) -> Tuple[dict, dict]:
+        """An export-package (contents.json + npy) as a weight source.
+        Tensors are routed into params/state via the LIVE template —
+        the export disambiguated collisions with a ``state_`` prefix."""
+        from ..export import load_package
+        contents = load_package(path)
+        saved = contents.get("checksum")
+        live = self._live_checksum()
+        if saved and live and saved != live:
+            raise ValueError(
+                f"package {source!r} was exported from a different "
+                f"workflow (checksum {saved!r} != served {live!r}); "
+                "refusing the swap — the old version keeps serving")
+        template = self._live_wstate()
+        tparams = template.get("params") or {}
+        tstate = template.get("state") or {}
+        params: Dict[str, dict] = {}
+        state: Dict[str, dict] = {}
+        for u in contents.get("units", ()):
+            name = u["name"]
+            for pname, arr in u.get("tensors", {}).items():
+                if pname.startswith("state_") and \
+                        pname[len("state_"):] in tstate.get(name, {}):
+                    state.setdefault(name, {})[
+                        pname[len("state_"):]] = arr
+                elif pname in tparams.get(name, {}):
+                    params.setdefault(name, {})[pname] = arr
+                elif pname in tstate.get(name, {}):
+                    state.setdefault(name, {})[pname] = arr
+                else:
+                    # surfaces in the signature check with a clear path
+                    params.setdefault(name, {})[pname] = arr
+        if not params:
+            raise ValueError(f"package {source!r} holds no unit weights")
+        if path.endswith(".zip"):
+            checksum = sha256_files([path])
+        else:
+            files = sorted(
+                os.path.join(dp, fn)
+                for dp, _, fns in os.walk(path) for fn in fns)
+            checksum = sha256_files(files)
+        parts = {"params": params}
+        if state:
+            parts["state"] = state
+        label = (contents.get("workflow") or
+                 os.path.basename(path.rstrip("/")) or path)
+        return parts, {"label": label, "kind": "package",
+                       "checksum": checksum, "source": source}
+
+    # -- staging + swap -----------------------------------------------------
+    def _stage(self, parts: dict) -> dict:
+        """Cast against the live template, enforce the structural
+        signature, and place on device — the double buffer; the old
+        tree keeps serving throughout.  ``params`` must match exactly;
+        a ``state`` tree that does not match is skipped with a warning
+        (packages may omit running statistics) rather than rejected."""
+        live = self._live_wstate()
+        new = dict(live)
+        for k in ("params", "state"):
+            saved = parts.get(k)
+            if not saved:
+                continue
+            tmpl = live.get(k)
+            want = _shape_signature(tmpl, unwrap_keys=True) \
+                if tmpl is not None else ()
+            got = _shape_signature(saved)
+            if want != got:
+                diff = signature_mismatch(want, got)
+                if k == "state":
+                    self.warning(
+                        "swap keeps the live 'state' tree (loaded one "
+                        "does not match: %s)", diff)
+                    continue
+                raise ValueError(
+                    "hot swap rejected — loaded parameter tree does not "
+                    "match the served model (same-architecture weights "
+                    f"only): {diff}")
+            cast = jax.tree.map(_cast_leaf, saved, tmpl)
+            # engine.swap_params re-places against its own live tree;
+            # with matching shardings that second device_put is a no-op
+            new[k] = place_like(cast, tmpl)
+        return new
+
+    def _apply(self, new_wstate: dict) -> None:
+        """Flip the served tree: the engine swaps at a decode-step
+        boundary (old buffer keeps serving until the flip); the server's
+        reference swap is atomic per request."""
+        if self.engine is not None:
+            self.engine.swap_params(new_wstate["params"])
+            if "state" in new_wstate:
+                # the engine only reads params, but keep the tree whole
+                # so a later _live_wstate() template is coherent
+                self.engine.wstate = dict(self.engine.wstate,
+                                          state=new_wstate["state"])
+        if self.server is not None:
+            if self.engine is not None:
+                self.server.wstate = dict(self.engine.wstate)
+            else:
+                self.server.wstate = new_wstate
+
+    def reload(self, source: Optional[str] = None,
+               version=None) -> dict:
+        """Load + hot-swap a named snapshot/package (the
+        ``POST /admin/reload`` handler).  ``version=`` re-activates a
+        registry entry by reloading from its recorded source.
+
+        Failure semantics: any load or staging failure leaves the old
+        version serving untouched; a failure during the flip itself
+        swaps the previous buffer back (rollback) before re-raising."""
+        with self._reload_lock:
+            if self.draining:
+                raise EngineDraining("draining; not accepting reloads")
+            t0 = time.monotonic()
+            if version is not None:
+                entry = self.registry.get(version)
+                if entry["kind"] == "live":
+                    raise ValueError(
+                        f"version {entry['version']} is the boot state "
+                        "with no reloadable source")
+                source = entry["source"]
+            pre = self._compile_marker()
+            try:
+                parts, meta = self.load_source(source)
+                new_wstate = self._stage(parts)
+            except KeyError as e:
+                # a malformed manifest/package raises KeyError deep in
+                # the loaders; surface it as a LOAD failure (409 on the
+                # REST side), not as the registry's version-miss 404
+                self.last_error = f"KeyError: {e}"
+                self._report()
+                raise ValueError(
+                    f"malformed source {source!r}: missing key "
+                    f"{e}") from e
+            except Exception as e:
+                self.last_error = f"{type(e).__name__}: {e}"
+                self._report()
+                raise
+            if self.draining:
+                # a drain that began while we were loading/staging wins:
+                # swapping into a stopping engine would activate a
+                # version that never serves
+                raise EngineDraining("draining; not accepting reloads")
+            prev = self._live_wstate()
+            swaps_before = self.engine.swaps if self.engine is not None \
+                else None
+            try:
+                self._apply(new_wstate)
+            except Exception as e:
+                self.last_error = f"{type(e).__name__}: {e}"
+                flipped = (swaps_before is not None
+                           and self.engine.swaps != swaps_before)
+                if flipped:
+                    self.exception(
+                        "swap failed mid-flip; rolling back to the "
+                        "previous buffer")
+                    try:
+                        self._apply(prev)
+                    except Exception:  # noqa: BLE001
+                        self.exception("rollback failed")
+                else:
+                    # the flip never landed (validation / staging /
+                    # swap timeout): the old version was never
+                    # displaced, so a "rollback" would only re-stage
+                    # the identical live tree and block another full
+                    # swap_timeout_s on an already-wedged scheduler
+                    self.warning(
+                        "swap not applied (%s); old version still "
+                        "serving", self.last_error)
+                self._report()
+                raise
+            # prev dies here: only the ACTIVE buffer stays on device
+            # (re-activating an older version reloads from its source)
+            entry = self.registry.add(
+                label=meta["label"], source=meta["source"],
+                kind=meta["kind"], checksum=meta["checksum"])
+            self.registry.activate(entry["version"])
+            self.swaps += 1
+            self.last_swap_ms = round(1e3 * (time.monotonic() - t0), 1)
+            self.last_error = None
+            post = self._compile_marker()
+            recompiled = (post - pre) if None not in (pre, post) else 0
+            if recompiled:
+                self.warning(
+                    "compile counter moved across a swap (%d new "
+                    "programs) — shapes should have matched exactly",
+                    recompiled)
+            self.info("hot-swapped to version %d (%s, %s) in %.0f ms",
+                      entry["version"], entry["label"], entry["kind"],
+                      self.last_swap_ms)
+            if self.status is not None:
+                try:
+                    self.status.record_event(
+                        "swap", version=entry["version"],
+                        label=entry["label"], swap_ms=self.last_swap_ms)
+                except Exception:  # noqa: BLE001 — the swap LANDED; a
+                    pass           # status hiccup must not report failure
+            self._report()
+            return {"active": dict(entry, active=True),
+                    "swap_ms": self.last_swap_ms,
+                    "compiles_during_swap": recompiled}
+
+    def _compile_marker(self) -> Optional[int]:
+        if self.engine is not None:
+            return int(self.engine.step_cache.compiles)
+        return None
+
+    # -- drain / shutdown ---------------------------------------------------
+    @property
+    def draining(self) -> bool:
+        return self._draining or (self.engine is not None
+                                  and self.engine.draining)
+
+    def begin_drain(self) -> dict:
+        """Async drain (the ``POST /admin/drain`` handler): flips
+        ``/ready`` to 503 immediately, retires in-flight work on a
+        background thread, then releases :meth:`wait`."""
+        self._draining = True
+        if self._drain_thread is None or not self._drain_thread.is_alive():
+            self._drain_thread = threading.Thread(
+                target=self.drain, name="deploy-drain", daemon=True)
+            self._drain_thread.start()
+        return {"draining": True,
+                "drain_timeout_s": self.drain_timeout_s}
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Graceful drain: stop admissions (503 on ``/ready``), stop the
+        watcher, let in-flight slots retire, stop the engine, release
+        :meth:`wait`.  Returns True when everything retired before the
+        deadline.  ``timeout=0`` skips the grace window (Ctrl-C)."""
+        self._draining = True
+        self.stop_watcher()
+        timeout = timeout if timeout is not None else self.drain_timeout_s
+        t0 = time.monotonic()
+        clean = True
+        if self.engine is not None:
+            clean = self.engine.drain(timeout)
+        # hold /ready at 503 for at least drain_grace_s (even when the
+        # engine retired instantly, or there is no engine to observe in-
+        # flight work on) so load balancers see the flip BEFORE the
+        # listener closes; requests keep being served during the hold.
+        # timeout=0 (the CLI's Ctrl-C) skips it.
+        grace = min(float(timeout), self.drain_grace_s) \
+            - (time.monotonic() - t0)
+        if grace > 0:
+            time.sleep(grace)
+        try:
+            if self.status is not None:
+                self.status.record_event("drain", clean=clean)
+            self._report()
+        except Exception:  # noqa: BLE001 — a status hiccup must never
+            pass           # leave wait() blocked with the engine down
+        self.info("drained%s", "" if clean else " (dirty: timeout or "
+                  "scheduler death; leftovers failed with EngineStopped)")
+        self._stopped.set()
+        return clean
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until drained/stopped (SIGTERM or ``/admin/drain``) —
+        the CLI serve loop parks here instead of sleeping forever."""
+        return self._stopped.wait(timeout)
+
+    def install_signal_handlers(self) -> bool:
+        """SIGTERM → graceful drain → clean exit.  Only possible from
+        the main thread; returns whether the handler was installed."""
+
+        def _on_sigterm(signum, frame):  # noqa: ARG001
+            self.info("SIGTERM: draining before exit")
+            self.begin_drain()
+
+        try:
+            signal.signal(signal.SIGTERM, _on_sigterm)
+            return True
+        except ValueError:
+            self.warning(
+                "not the main thread; SIGTERM handler not installed")
+            return False
+
+    # -- snapshot watcher ---------------------------------------------------
+    @property
+    def watching(self) -> bool:
+        return (self._watch_thread is not None
+                and self._watch_thread.is_alive())
+
+    def start_watcher(self):
+        """Poll ``model_dir`` for a snapshot saved after the one the
+        watcher last swapped in (the boot snapshot anchors the floor)
+        and swap automatically — "newest snapshot in model_dir wins",
+        so a manual reload from an OUTSIDE source is superseded on the
+        next newer arrival.  Failures (mid-write snapshots, rejected
+        trees, IO errors) retry with exponential backoff up to
+        ``watch_backoff_max_s``; a success resets the cadence to
+        ``watch_interval_s``."""
+        if self.model_dir is None:
+            raise ValueError("snapshot watcher needs a model_dir")
+        if self.watching:
+            return self
+        self._watch_stop.clear()
+        self._watch_thread = threading.Thread(
+            target=self._watch_loop, name="snapshot-watcher", daemon=True)
+        self._watch_thread.start()
+        self.info("watching %s every %.1fs", self.model_dir,
+                  self.watch_interval_s)
+        return self
+
+    def stop_watcher(self):
+        self._watch_stop.set()
+        t = self._watch_thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=10)
+            if t.is_alive():
+                # mid-reload (hashing / staging a big snapshot): keep
+                # the reference so ``watching`` stays true — a
+                # start_watcher() now must NOT spawn a second thread;
+                # the straggler exits after its current attempt
+                self.warning("watcher still mid-attempt; it will exit "
+                             "after the current reload")
+                return
+        self._watch_thread = None
+
+    def _watch_loop(self):
+        delay = self.watch_interval_s
+        while not self._watch_stop.wait(delay):
+            try:
+                self._watch_once()
+                delay = self.watch_interval_s
+            except Exception as e:  # noqa: BLE001 — the watcher must
+                # outlive any single bad snapshot; backoff, retry
+                delay = min(max(delay, self.watch_interval_s) * 2,
+                            self.watch_backoff_max_s)
+                self.last_error = f"{type(e).__name__}: {e}"
+                self.warning("snapshot watcher: %s (retrying in %.1fs)",
+                             self.last_error, delay)
+                self._report()
+
+    def _watch_once(self):
+        snaps = list_snapshots(self.model_dir)
+        if not snaps:
+            return
+        newest = snaps[-1]
+        if newest["saved_at"] <= self._watch_floor:
+            return  # nothing newer than what the watcher last swapped
+        checksum = self._snapshot_checksum(newest["path"])
+        active = self.registry.active
+        if active is not None and checksum \
+                and checksum == active.get("checksum"):
+            # already serving these exact weights (e.g. a re-save)
+            self._watch_floor = newest["saved_at"]
+            return
+        self.info("watcher: newer snapshot %s", newest["path"])
+        self.reload(newest["path"])  # raises -> backoff + retry
+        self._watch_floor = newest["saved_at"]
+
+    # -- observability ------------------------------------------------------
+    def models_doc(self) -> dict:
+        """The ``GET /models`` document: registry + control-plane
+        state."""
+        doc = self.registry.to_doc()
+        doc.update(self._gauges())
+        return doc
+
+    def _gauges(self) -> dict:
+        return {"swaps": self.swaps, "last_swap_ms": self.last_swap_ms,
+                "draining": self.draining, "watching": self.watching,
+                "model_dir": self.model_dir,
+                "last_error": self.last_error}
+
+    def _report(self):
+        if self.status is None:
+            return
+        try:
+            active = self.registry.active or {}
+            self.status.update(deploy={
+                "active_version": self.registry.active_version,
+                "active_label": active.get("label"),
+                "versions": len(self.registry.to_doc()["versions"]),
+                **self._gauges()})
+        except Exception:  # noqa: BLE001 — status must never take the
+            pass           # control plane down

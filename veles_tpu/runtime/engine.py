@@ -52,7 +52,7 @@ from ..config import root
 from ..logger import Logger
 from ..units.base import Context
 from .generate import DecodePlan
-from .step_cache import StepCache
+from .step_cache import StepCache, tree_signature
 
 
 class EngineOverloaded(RuntimeError):
@@ -65,6 +65,55 @@ class EngineOverloaded(RuntimeError):
 
 class EngineStopped(RuntimeError):
     """The engine was stopped before this request completed."""
+
+
+class EngineDraining(EngineStopped):
+    """The engine is draining: in-flight work retires, new work is
+    refused (the REST layer's 503 on ``/ready`` and ``/generate``)."""
+
+
+def signature_mismatch(expected, got, limit: int = 6) -> str:
+    """Human-readable diff of two :func:`tree_signature` results — the
+    clear-error half of the hot-swap contract: name WHICH leaves differ
+    instead of dumping two thousand-entry tuples at the operator."""
+    exp = {p: (s, d) for p, s, d in expected}
+    new = {p: (s, d) for p, s, d in got}
+
+    def fmt(sd):  # dtype may be blank (shape-only signatures)
+        return f"{sd[0]}/{sd[1]}" if sd[1] else f"{sd[0]}"
+
+    msgs = []
+    for p in sorted(set(exp) - set(new)):
+        msgs.append(f"{p}: missing (expected {fmt(exp[p])})")
+    for p in sorted(set(new) - set(exp)):
+        msgs.append(f"{p}: unexpected leaf {fmt(new[p])}")
+    for p in sorted(set(exp) & set(new)):
+        if exp[p] != new[p]:
+            msgs.append(f"{p}: {fmt(new[p])} != expected {fmt(exp[p])}")
+    extra = len(msgs) - limit
+    if extra > 0:
+        msgs = msgs[:limit] + [f"... and {extra} more"]
+    return "; ".join(msgs) or "identical signatures"
+
+
+def place_like(tree, template):
+    """Device-place ``tree`` mirroring ``template``'s shardings (a bare
+    device_put would commit a sharded model's replacement to one device
+    — recompile or OOM on the next step), blocking until every leaf is
+    fully transferred.  Placement errors propagate: committing the tree
+    to the wrong devices as a "fallback" would be strictly worse than
+    failing the swap with the old version still serving.  Host-array
+    templates (no ``.sharding``) take default placement."""
+    try:
+        shardings = jax.tree.map(lambda l: l.sharding, template)
+    except AttributeError:  # host/numpy template leaves
+        shardings = None
+    placed = jax.device_put(tree, shardings) if shardings is not None \
+        else jax.device_put(tree)
+    for leaf in jax.tree.leaves(placed):
+        if hasattr(leaf, "block_until_ready"):
+            leaf.block_until_ready()
+    return placed
 
 
 class _Request:
@@ -204,6 +253,13 @@ class DecodeEngine(Logger):
         self._stop_evt = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
+        # hot-swap double buffer + drain mode (runtime/deploy.py)
+        self._swap_lock = threading.Lock()
+        self._staged = None             # (placed params, applied event)
+        self._swaps = 0
+        self._draining = False
+        self._died = False              # scheduler crashed (work FAILED)
+
         # gauges
         self._admitted = 0
         self._retired = 0
@@ -335,9 +391,121 @@ class DecodeEngine(Logger):
     def stop(self):
         self._stop_evt.set()
         self._wake.set()
-        if self._thread is not None:
-            self._thread.join(timeout=30)
+        t = self._thread
+        if t is not None:
+            t.join(timeout=30)
+            if t.is_alive():
+                # a wedged scheduler must keep owning the slots: if we
+                # forgot it here, a restart would spawn a SECOND
+                # scheduler double-donating the same device buffers
+                self.warning("scheduler did not exit within 30s; "
+                             "engine cannot be restarted until it does")
+                return
             self._thread = None
+
+    # -- lifecycle ops: hot swap + drain (runtime/deploy.py drives these) ---
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    @property
+    def swaps(self) -> int:
+        return self._swaps
+
+    def swap_params(self, params, *, timeout: Optional[float] = None):
+        """Zero-downtime hot weight swap: stage ``params`` on device as a
+        double buffer while the current version keeps serving, then flip
+        the served tree atomically at a decode-step boundary.
+
+        The new tree must match the live one leaf for leaf in path,
+        shape and dtype — the compiled prefill/decode programs are
+        reused as-is (the StepCache counters stay flat across a swap); a
+        mismatched tree is rejected with a clear error and the old
+        version keeps serving.  In-flight slots finish their current
+        step on the old buffer; the next step reads the new one (their
+        KV caches are model-version-mixed for the remainder of the
+        sequence — the standard continuous-serving trade, documented in
+        docs/serving.md).  Thread-safe; blocks until the flip happened
+        or ``timeout`` (default ``root.common.serve.swap_timeout_s``)
+        expired, in which case the staged buffer is withdrawn and the
+        old version keeps serving.
+        """
+        if timeout is None:
+            timeout = float(root.common.serve.get("swap_timeout_s", 60.0))
+        old_sig = tree_signature(self.wstate["params"])
+        new_sig = tree_signature(params)
+        if old_sig != new_sig:
+            raise ValueError(
+                "hot swap rejected — parameter tree does not match the "
+                "compiled programs (same-architecture weights only; a "
+                "different architecture needs a fresh engine): "
+                + signature_mismatch(old_sig, new_sig))
+        # fully staged BEFORE the flip: the scheduler must never block
+        # a decode step on an in-flight H2D transfer (no-op when the
+        # caller pre-placed the tree, e.g. DeployController._stage)
+        staged = place_like(params, self.wstate["params"])
+        if not self.started:
+            self.wstate = dict(self.wstate, params=staged)
+            self._swaps += 1
+            return
+        done = threading.Event()
+        with self._swap_lock:
+            if self._staged is not None:
+                raise RuntimeError(
+                    "another swap is already staged and not yet applied")
+            self._staged = (staged, done)
+        self._wake.set()
+        if not done.wait(timeout):
+            with self._swap_lock:
+                if self._staged is not None and self._staged[1] is done:
+                    self._staged = None
+                    raise TimeoutError(
+                        f"swap not applied within {timeout}s (scheduler "
+                        "wedged?); the old version keeps serving")
+            # the flip landed between the wait timeout and the lock
+
+    def _apply_swap(self):
+        """Scheduler-thread only: flip the served params to the staged
+        buffer.  Called between decode steps, so no program is mid-step
+        — in-flight slots see the new weights from their NEXT token."""
+        with self._swap_lock:
+            staged, self._staged = self._staged, None
+        if staged is None:
+            return
+        params, done = staged
+        self.wstate = dict(self.wstate, params=params)
+        self._swaps += 1
+        done.set()
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Graceful drain: stop admissions (``submit`` raises
+        :class:`EngineDraining` → the REST layer's 503), let queued and
+        in-flight work retire, then stop the scheduler.  Returns True
+        when everything retired before ``timeout`` (default
+        ``root.common.serve.drain_timeout_s``); on timeout the engine
+        stops anyway and leftovers fail with :class:`EngineStopped`."""
+        if timeout is None:
+            timeout = float(root.common.serve.get("drain_timeout_s", 30.0))
+        self._draining = True
+        deadline = time.monotonic() + max(0.0, float(timeout))
+        while self.started and time.monotonic() < deadline:
+            if self._idle():
+                break
+            time.sleep(0.01)
+        # a crashed scheduler also leaves the slots/queue empty — but
+        # via _fail_all, which FAILED the work rather than retiring it:
+        # that is a dirty drain, never a clean one
+        clean = not self._died and self._idle()
+        self.stop()
+        return clean
+
+    def _idle(self) -> bool:
+        """No queued, reserved, or decoding work anywhere.  _slot_req is
+        part of the check because a request being prefilled is already
+        out of the queue but not yet in _active — drain must not
+        declare victory inside that window."""
+        return (not self._active.any() and not self._queue
+                and all(r is None for r in self._slot_req))
 
     def submit(self, prompt, n_steps: int, *, temperature: float = 0.0,
                top_k: Optional[int] = None, top_p: Optional[float] = None,
@@ -353,12 +521,24 @@ class DecodeEngine(Logger):
         n_steps = int(n_steps)
         if n_steps < 1:
             raise ValueError("n_steps must be >= 1")
+        # same contract as sample_logits: out-of-domain filters must be
+        # a loud 400, not a silently-degenerate sentinel (top_k=0 would
+        # make the k-th threshold the MAX logit — greedy in disguise)
+        if top_k is not None and int(top_k) < 1:
+            raise ValueError(f"top_k must be >= 1, got {top_k}")
+        if top_p is not None and not 0.0 < float(top_p) <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
         if prompt.size + n_steps > self.l_max:
             raise ValueError(
                 f"prompt {prompt.size} + n_steps {n_steps} exceeds the "
                 f"engine's l_max {self.l_max}")
         if key is None:
             key = jax.random.key(0)
+        if self._draining:
+            # drain contract: in-flight and already-queued work retires,
+            # NEW work is refused so the slot set empties (HTTP 503)
+            raise EngineDraining(
+                "engine is draining; not accepting new requests")
         if not self.started:
             # a dead scheduler (stopped, or its loop died) would leave
             # the request queued forever with nothing enforcing its
@@ -444,6 +624,7 @@ class DecodeEngine(Logger):
             "decode_steps": self._decode_steps,
             "admitted": self._admitted, "retired": self._retired,
             "rejected": self._rejected, "timeouts": self._timeouts,
+            "swaps": self._swaps, "draining": self._draining,
             "compile": self.step_cache.stats(),
         }
 
@@ -459,6 +640,9 @@ class DecodeEngine(Logger):
         try:
             while not self._stop_evt.is_set():
                 self._maybe_report()
+                # decode-step boundary: no program is running right now,
+                # so a staged weight swap flips here atomically
+                self._apply_swap()
                 if not self._active.any() and not self._queue:
                     self._wake.wait(timeout=0.05)
                     self._wake.clear()
@@ -475,9 +659,13 @@ class DecodeEngine(Logger):
                 self._maybe_report()
         except Exception as e:  # noqa: BLE001 — a dead scheduler must
             # fail pending work loudly, not hang every client forever
+            self._died = True
             self.exception("decode engine scheduler died")
             self._fail_all(e)
         finally:
+            # a swap staged during shutdown still flips (harmless) so
+            # its waiter is released instead of blocking to timeout
+            self._apply_swap()
             self._fail_all(EngineStopped("engine stopped"))
 
     def _fail_all(self, err: Exception):
@@ -533,6 +721,11 @@ class DecodeEngine(Logger):
             n += 1
 
     def _prefill(self, slot: int, req: _Request):
+        # reserve the slot BEFORE the device program runs: between the
+        # queue pop and _active[slot] going true the request must stay
+        # visible to drain()'s idleness check (and to _fail_all)
+        self._slot_req[slot] = req
+        req.slot = slot
         params = self.wstate["params"]
         P = int(req.prompt.size)
         pb = self._bucket(P)
@@ -555,8 +748,6 @@ class DecodeEngine(Logger):
         self._eos[slot] = -1 if req.eos_id is None else req.eos_id
         self._end[slot] = P + req.n_steps - 1
         self._keys[slot] = req.key_data
-        self._slot_req[slot] = req
-        req.slot = slot
         self._admitted += 1
         self._tok_count += 1
         done = (req.n_steps == 1

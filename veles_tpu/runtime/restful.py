@@ -27,7 +27,18 @@ program set is fixed for the engine lifetime, queue overflow answers
 **429 with a Retry-After header** (the backpressure contract of
 docs/serving.md), and GET /engine exposes the live gauges.  Request
 bodies are capped at ``root.common.serve.max_body_mb`` (413 beyond it —
-the snapshot_http_max_mb pattern applied to the ingress side)."""
+the snapshot_http_max_mb pattern applied to the ingress side).
+
+Operational endpoints (docs/serving.md "Model lifecycle"): ``GET
+/healthz`` (liveness — answers whenever the process serves HTTP, engine
+or not) and ``GET /ready`` (200 when the engine is started and nobody
+is draining, else 503) are always on.  Attaching a
+:class:`~veles_tpu.runtime.deploy.DeployController` (it sets
+``server.deploy``) additionally routes ``GET /models`` (the versioned
+registry) plus ``POST /admin/reload`` (hot weight swap; 409 with the
+old version still serving on any load/signature failure) and ``POST
+/admin/drain`` (graceful drain, async — 202).  A draining or stopped
+engine answers ``/generate`` with 503."""
 
 from __future__ import annotations
 
@@ -40,7 +51,7 @@ import numpy as np
 
 from ..config import root
 from ..logger import Logger
-from .engine import EngineOverloaded
+from .engine import EngineOverloaded, EngineStopped
 
 
 class RestfulServer(Logger):
@@ -57,6 +68,7 @@ class RestfulServer(Logger):
         self.denormalizer = denormalizer
         self.workflow = workflow  # enables POST /generate (module doc)
         self.engine = engine      # continuous-batching /generate path
+        self.deploy = None        # set by DeployController (lifecycle ops)
         outer = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
@@ -71,16 +83,38 @@ class RestfulServer(Logger):
                 self.wfile.write(body)
 
             def do_GET(self):
-                if self.path.rstrip("/") == "/engine" \
-                        and outer.engine is not None:
+                path = self.path.split("?", 1)[0].rstrip("/")
+                if path == "/healthz":
+                    # liveness: answers whenever the process serves HTTP
+                    # at all — deliberately ignores engine/drain state
+                    # (a draining server is alive, just not ready)
+                    self._reply({"status": "alive"})
+                    return
+                if path == "/ready":
+                    ok, why = outer.readiness()
+                    self._reply({"ready": ok, "reason": why},
+                                code=200 if ok else 503)
+                    return
+                if path == "/models" and outer.deploy is not None:
+                    self._reply(outer.deploy.models_doc())
+                    return
+                if path == "/engine" and outer.engine is not None:
                     self._reply(outer.engine.stats())
                     return
                 self.send_error(404)
 
             def do_POST(self):
-                path = self.path.rstrip("/")
-                if path not in ("/predict", "/generate"):
+                path = self.path.split("?", 1)[0].rstrip("/")
+                admin = path in ("/admin/reload", "/admin/drain")
+                if path not in ("/predict", "/generate") and not admin:
                     self.send_error(404)
+                    return
+                if admin and outer.deploy is None:
+                    self._reply(
+                        {"error": "no deploy control plane attached "
+                                  "(serve with DeployController / "
+                                  "--model-dir; see docs/serving.md)"},
+                        code=404)
                     return
                 try:
                     n = int(self.headers.get("Content-Length", 0))
@@ -95,7 +129,44 @@ class RestfulServer(Logger):
                                       "(root.common.serve.max_body_mb)"},
                             code=413)
                         return
-                    req = json.loads(self.rfile.read(n))
+                    req = json.loads(self.rfile.read(n)) if n else {}
+                    if path == "/admin/drain":
+                        # async: the reply must not wait for in-flight
+                        # slots to retire (202 = drain accepted)
+                        self._reply(outer.deploy.begin_drain(), code=202)
+                        return
+                    if path == "/admin/reload":
+                        source = req.get("source") or req.get("path")
+                        if source is None and req.get("version") is None:
+                            # a malformed REQUEST is the client's 400,
+                            # not a load-conflict 409
+                            self._reply(
+                                {"error": 'reload needs {"path": ...} '
+                                          '(or "source"/"version")'},
+                                code=400)
+                            return
+                        try:
+                            self._reply(outer.deploy.reload(
+                                source=source,
+                                version=req.get("version")))
+                        except KeyError as e:
+                            # only the registry's version lookup raises
+                            # KeyError here (deploy.reload converts
+                            # loader KeyErrors to ValueError)
+                            self._reply({"error": str(e)}, code=404)
+                        except (ValueError, OSError, TimeoutError) as e:
+                            # load/signature/flip-timeout failure: the
+                            # old version is STILL SERVING (the reload
+                            # contract) — 409, not a 5xx that would
+                            # page someone or a 504 masquerading as a
+                            # request deadline.  EngineDraining is NOT
+                            # caught here: it falls to the 503 below.
+                            self._reply(
+                                {"error": f"{type(e).__name__}: {e}",
+                                 "active": outer.deploy.registry
+                                 .active_version},
+                                code=409)
+                        return
                     if path == "/generate":
                         self._reply(outer.decode(req))
                         return
@@ -106,6 +177,12 @@ class RestfulServer(Logger):
                         {"error": str(e)}, code=429,
                         headers=(("Retry-After",
                                   str(int(round(e.retry_after_s)))),))
+                except EngineStopped as e:
+                    # draining or stopped: refuse new work the way a
+                    # load balancer expects (503 + Retry-After), matching
+                    # the /ready flip
+                    self._reply({"error": str(e)}, code=503,
+                                headers=(("Retry-After", "5"),))
                 except TimeoutError as e:
                     self._reply({"error": str(e)}, code=504)
                 except (KeyError, TypeError, ValueError,
@@ -122,6 +199,20 @@ class RestfulServer(Logger):
         self.httpd = http.server.ThreadingHTTPServer((host, port), Handler)
         self.port = self.httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
+
+    def readiness(self):
+        """(ready, reason) for ``GET /ready``: the engine is started and
+        nobody is draining.  A plain predict server (no engine) is ready
+        once it serves HTTP — liveness and readiness only diverge when
+        there is lifecycle state to diverge on."""
+        if self.deploy is not None and self.deploy.draining:
+            return False, "draining"
+        if self.engine is not None:
+            if self.engine.draining:
+                return False, "draining"
+            if not self.engine.started:
+                return False, "engine not started"
+        return True, "ok"
 
     def infer(self, x) -> np.ndarray:
         if np.issubdtype(self.input_dtype, np.integer):

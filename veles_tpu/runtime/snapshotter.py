@@ -366,6 +366,72 @@ class SnapshotterToDB(Snapshotter):
         return payload
 
 
+def list_snapshots(directory: str,
+                   prefix: Optional[str] = None) -> list:
+    """Inventory of the snapshot manifests in ``directory``, sorted
+    oldest → newest by the manifest's ``saved_at`` (file mtime when the
+    field is absent) — the deploy control plane's load-by-version view
+    of a snapshot directory (runtime/deploy.py watcher + registry).
+
+    Symlink manifests (the ``_current``/``_best`` conveniences) are
+    skipped: their targets are already listed.  Unparseable JSON is
+    skipped silently — a snapshot mid-write looks exactly like that and
+    will be complete on the next poll."""
+    out = []
+    if not os.path.isdir(directory):
+        return out
+    for fn in sorted(os.listdir(directory)):
+        path = os.path.join(directory, fn)
+        if not fn.endswith(".json") or os.path.islink(path):
+            continue
+        if prefix and not fn.startswith(prefix):
+            continue
+        try:
+            with open(path) as f:
+                man = json.load(f)
+        except (OSError, json.JSONDecodeError, UnicodeDecodeError):
+            continue
+        if not isinstance(man, dict) or "tensors" not in man:
+            continue  # some other JSON living in the directory
+        try:
+            saved_at = float(man.get("saved_at") or os.path.getmtime(path))
+        except (TypeError, ValueError, OSError):
+            saved_at = 0.0
+        out.append({"path": path, "tag": fn[:-len(".json")],
+                    "saved_at": saved_at, "tensors": man["tensors"]})
+    out.sort(key=lambda e: (e["saved_at"], e["path"]))
+    return out
+
+
+def sha256_files(paths) -> str:
+    """Streamed sha256 hex digest over the given files' bytes, in
+    order — the one hashing loop both the snapshot and export-package
+    checksum paths share (runtime/deploy.py registry identities)."""
+    import hashlib
+    h = hashlib.sha256()
+    for p in paths:
+        with open(p, "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                h.update(chunk)
+    return h.hexdigest()
+
+
+def snapshot_checksum(path: str) -> str:
+    """sha256 hex digest of the tensors blob a manifest references — the
+    registry's cheap version identity (two snapshots with identical
+    weights hash identically; a re-save with new weights does not).
+    Returns '' when the blob cannot be read (remote URIs, mid-write
+    snapshots) — callers treat '' as "unknown", never as a match."""
+    try:
+        with open(path) as f:
+            man = json.load(f)
+        npz = os.path.join(os.path.dirname(path), man["tensors"])
+        return sha256_files([npz])
+    except (OSError, KeyError, TypeError, ValueError,
+            json.JSONDecodeError):
+        return ""
+
+
 def compare_snapshots(path_a: str, path_b: str) -> Dict[str, Any]:
     """Per-tensor diff of two checkpoints (reference:
     /root/reference/veles/scripts/compare_snapshots.py, which printed

@@ -16,6 +16,7 @@ missing #3) — the MetricsRecorder autosaves the PNGs each epoch."""
 
 from __future__ import annotations
 
+import collections
 import http.server
 import json
 import os
@@ -32,7 +33,8 @@ class StatusReporter(Logger):
 
     def __init__(self, path: str = "status.json", name: str = "workflow",
                  plots_dir: Optional[str] = None,
-                 graph_svg: Optional[str] = None):
+                 graph_svg: Optional[str] = None,
+                 events_max: int = 20):
         self.path = path
         self.name = name
         self.plots_dir = plots_dir
@@ -42,6 +44,11 @@ class StatusReporter(Logger):
         self.graph_svg = graph_svg
         self.started = time.time()
         self._extra = {}
+        self._events = collections.deque(maxlen=max(1, int(events_max)))
+        # one reporter, many writers (engine scheduler, deploy control
+        # plane, trainer): serialize the read-modify-write on _extra and
+        # the tmp-file replace
+        self._lock = threading.Lock()
 
     def plot_files(self):
         """Sorted (name, mtime) of the PNGs currently in plots_dir."""
@@ -57,18 +64,34 @@ class StatusReporter(Logger):
                 out.append((fn, mt))
         return out
 
+    def record_event(self, kind: str, **info) -> None:
+        """Append to the bounded event log shipped inside status.json
+        (``events`` key, newest last): discrete lifecycle moments — a
+        weight swap, a drain, a watcher failure — that a sampled gauge
+        can't show (the deploy control plane's swap/version history,
+        runtime/deploy.py)."""
+        with self._lock:
+            # under the same lock update() iterates the deque with —
+            # an un-locked append can blow up that iteration
+            self._events.append(
+                {"kind": str(kind), "time": round(time.time(), 3), **info})
+        self.update()
+
     def update(self, **fields) -> None:
-        self._extra.update(fields)
-        doc = {
-            "name": self.name,
-            "time": time.time(),
-            "uptime_s": round(time.time() - self.started, 1),
-            **self._extra,
-        }
-        tmp = self.path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(doc, f, indent=1, default=repr)
-        os.replace(tmp, self.path)
+        with self._lock:
+            self._extra.update(fields)
+            doc = {
+                "name": self.name,
+                "time": time.time(),
+                "uptime_s": round(time.time() - self.started, 1),
+                **self._extra,
+            }
+            if self._events:
+                doc["events"] = list(self._events)
+            tmp = self.path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(doc, f, indent=1, default=repr)
+            os.replace(tmp, self.path)
 
     def read(self) -> dict:
         with open(self.path) as f:
