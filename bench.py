@@ -62,6 +62,7 @@ def main():
             "metric": "alexnet_train_samples_per_sec_per_chip",
             "value": None, "unit": "samples/sec/chip", "vs_baseline": None,
             "train_step_recompiles": None, "compile_wall_s": None,
+            "anomaly_steps_skipped": None, "snapshot_walkbacks": None,
             "error": f"device unavailable: {err}",
         }))
         return 1
@@ -81,7 +82,12 @@ def main():
     partial = {"metric": "alexnet_train_samples_per_sec_per_chip",
                "value": None, "unit": "samples/sec/chip",
                "vs_baseline": None,
-               "train_step_recompiles": None, "compile_wall_s": None}
+               "train_step_recompiles": None, "compile_wall_s": None,
+               # fault-tolerance gauges (docs/robustness.md): non-zero
+               # means the sentinel skipped steps / restore walked past
+               # corruption during the measurement — numbers from such a
+               # run need an asterisk
+               "anomaly_steps_skipped": 0, "snapshot_walkbacks": 0}
 
     def _die():
         out = dict(partial)
@@ -173,6 +179,9 @@ def main():
     # block gets its OWN watchdog budget (a fresh tunnel hang window —
     # round-2 outage postmortem), and results land in `partial` as they
     # are measured so a later hang cannot discard them.
+    trainers = []       # e2e trainers, for the snapshot_walkbacks gauge
+    anomalies = [0.0]   # sentinel skips observed across measured epochs
+
     def timed_e2e(build, label, check=None, budget_s=900.0):
         w = threading.Timer(budget_s, _die)
         w.daemon = True
@@ -182,13 +191,20 @@ def main():
             trainer = sw.make_trainer(sw.loader)
             trainer.initialize(seed=0)
             recompile_cnt.append(trainer.step_cache)
+            trainers.append(trainer)
             if check is not None:
                 check(sw)
-            trainer._run_epoch_train(0)  # compile + warm
+            # bench drives _run_epoch_train directly (no Trainer.run()),
+            # so sentinel skips must be read off the returned epoch
+            # metrics — the run()-only counters would always report 0
+            anomalies[0] += trainer._run_epoch_train(0).get(
+                "anomaly_steps", 0.0)  # compile + warm
             t0 = time.perf_counter()
             tot = 0.0
             for ep in (1, 2):
-                tot += trainer._run_epoch_train(ep).get("n_samples", 0.0)
+                mets = trainer._run_epoch_train(ep)
+                tot += mets.get("n_samples", 0.0)
+                anomalies[0] += mets.get("anomaly_steps", 0.0)
             return tot / (time.perf_counter() - t0)
         except Exception as e:  # keep earlier numbers even if this breaks
             print(f"# {label} e2e measurement failed: "
@@ -231,6 +247,9 @@ def main():
         c.recompiles for c in recompile_cnt)
     partial["compile_wall_s"] = round(
         sum(c.compile_wall_s for c in recompile_cnt), 3)
+    partial["anomaly_steps_skipped"] = int(anomalies[0])
+    partial["snapshot_walkbacks"] = sum(
+        t.snapshot_walkbacks for t in trainers)
 
     # -- host->device link bandwidth (context for the host-path e2e row:
     # over the axon tunnel this is the binding constraint, not the

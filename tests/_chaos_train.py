@@ -74,10 +74,16 @@ def main():
     trainer.initialize(seed=0)
     if args.resume:
         manifests = sorted(
-            glob.glob(os.path.join(args.workdir, "snaps", "*.json")),
-            key=os.path.getmtime)
+            p for p in glob.glob(
+                os.path.join(args.workdir, "snaps", "*.json"))
+            if not os.path.islink(p))
+        manifests.sort(key=os.path.getmtime)
         assert manifests, "nothing to resume from"
+        # A corrupt newest snapshot (post-kill truncation) walks back to
+        # the newest valid one inside Trainer.restore; the parent
+        # asserts on the WALKBACKS line.
         trainer.restore(manifests[-1])
+        print("WALKBACKS", trainer.snapshot_walkbacks)
     trainer.run()
 
     w = np.asarray(trainer.wstate["params"]["fc1"]["w"])

@@ -26,6 +26,9 @@ import pytest  # noqa: E402
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: multi-process / long-running tests")
+    config.addinivalue_line(
+        "markers", "faults: fault-injection / robustness tests (tier-1; "
+        "select alone with -m faults)")
 
 
 @pytest.fixture(autouse=True)

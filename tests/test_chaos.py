@@ -69,6 +69,59 @@ def test_sigkill_resume_is_deterministic(tmp_path):
     np.testing.assert_allclose(w_chaos, w_ref, rtol=1e-6, atol=1e-7)
 
 
+@pytest.mark.slow
+@pytest.mark.faults
+def test_sigkill_plus_corrupt_snapshot_walkback_resume(tmp_path):
+    """The compound failure (ISSUE 4 acceptance): the process is
+    SIGKILLed mid-run AND its newest snapshot is torn (truncated npz).
+    The resumed process must walk back to the previous valid snapshot
+    and continue BIT-DETERMINISTICALLY — landing on the same final
+    weights as a never-killed run, because loader order / PRNG streams /
+    decision state replay exactly from the earlier checkpoint."""
+    import json as _json
+
+    # Reference run: never killed, never corrupted.
+    ref_dir = tmp_path / "ref"
+    ref_dir.mkdir()
+    proc = _spawn(ref_dir)
+    out, _ = proc.communicate(timeout=300)
+    assert proc.returncode == 0, out.decode()
+    w_ref = np.load(ref_dir / "final_w.npy")
+
+    chaos_dir = tmp_path / "chaos"
+    chaos_dir.mkdir()
+    victim = _spawn(chaos_dir, "--slow")
+    _wait_file(str(chaos_dir / "epoch2.done"), victim)
+    os.kill(victim.pid, signal.SIGKILL)
+    victim.wait(30)
+    assert victim.returncode != 0
+
+    # Corrupt the newest COMPLETE snapshot: truncate its tensors blob.
+    snaps = str(chaos_dir / "snaps")
+    manifests = sorted(
+        (p for p in os.listdir(snaps)
+         if p.endswith(".json") and not os.path.islink(
+             os.path.join(snaps, p))),
+        key=lambda p: os.path.getmtime(os.path.join(snaps, p)))
+    assert len(manifests) >= 2, manifests
+    with open(os.path.join(snaps, manifests[-1])) as f:
+        npz = os.path.join(snaps, _json.load(f)["tensors"])
+    size = os.path.getsize(npz)
+    with open(npz, "rb+") as f:
+        f.truncate(size // 2)
+
+    resumed = _spawn(chaos_dir, "--resume")
+    out, _ = resumed.communicate(timeout=300)
+    assert resumed.returncode == 0, out.decode()
+    assert b"WORKER DONE" in out
+    assert b"WALKBACKS 1" in out, out.decode()
+
+    # Walk-back resume replays the missing epoch exactly: same final
+    # trajectory as the unkilled run.
+    w_chaos = np.load(chaos_dir / "final_w.npy")
+    np.testing.assert_allclose(w_chaos, w_ref, rtol=1e-6, atol=1e-7)
+
+
 def test_resume_across_topology_change(tmp_path):
     """The 8→1 chip resume (SURVEY.md §7 hard parts): a snapshot taken by
     a trainer sharded over an 8-device mesh restores into a single-device

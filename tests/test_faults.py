@@ -1,0 +1,455 @@
+"""Training fault-tolerance layer (docs/robustness.md): the in-graph
+anomaly sentinel, rollback escalation, corruption-tolerant snapshot
+restore, keep-last-K retention, transient-read retries, and the decode
+engine's scheduler crash path — all driven through the deterministic
+fault-injection harness (runtime/faults.py).
+
+The non-negotiable contract running through every test here: robustness
+costs ZERO recompiles.  Skips, clips, escalations and walk-backs are
+traced data flow or host-side state writes against the same immortal
+compiled programs (the StepCache counter idiom of tests/test_step_cache.py).
+"""
+
+import json
+import os
+import urllib.error
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import veles_tpu as vt
+from veles_tpu.config import root
+from veles_tpu.loader.base import TRAIN, VALID, LoaderError
+from veles_tpu.ops import optimizers as opt
+from veles_tpu.ops.optimizers import (ANOM_CONSEC_KEY, ANOM_SKIP_KEY,
+                                      LR_MULT_KEY)
+from veles_tpu.runtime import faults
+from veles_tpu.runtime.snapshotter import (SnapshotCorruptError, Snapshotter,
+                                           restore_with_walkback)
+from veles_tpu.units.base import Spec
+from veles_tpu.units.nn import (All2AllSoftmax, All2AllTanh,
+                                EvaluatorSoftmax)
+
+pytestmark = pytest.mark.faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults_and_knobs():
+    """Every test starts and ends with the harness disarmed and the
+    fault-tolerance knobs at their defaults."""
+    faults.reset()
+    saved = {k: root.common.train.get(k) for k in
+             ("sentinel", "clip_norm", "anomaly_patience")}
+    keep = root.common.get("snapshot_keep", 0)
+    retries = root.common.loader.get("retries", 2)
+    backoff = root.common.loader.get("retry_backoff_s", 0.05)
+    yield
+    faults.reset()
+    for k, v in saved.items():
+        setattr(root.common.train, k, v)
+    root.common.snapshot_keep = keep
+    root.common.loader.retries = retries
+    root.common.loader.retry_backoff_s = backoff
+
+
+def _wf():
+    wf = vt.Workflow("ft")
+    wf.add(All2AllTanh(16, name="fc1", inputs=("@input",)))
+    wf.add(All2AllSoftmax(3, name="fc2", inputs=("fc1",)))
+    wf.add(EvaluatorSoftmax(name="ev", inputs=("fc2", "@labels", "@mask")))
+    return wf
+
+
+def _blob(n=96, dim=8):
+    rng = np.random.default_rng(7)
+    centers = rng.standard_normal((3, dim)) * 3
+    lab = rng.integers(0, 3, n).astype(np.int32)
+    d = (centers[lab] + rng.standard_normal((n, dim))).astype(np.float32)
+    return d, lab
+
+
+def _loader(mb=32):
+    d, lab = _blob()
+    return vt.ArrayLoader({TRAIN: d, VALID: d[:32]},
+                          {TRAIN: lab, VALID: lab[:32]},
+                          minibatch_size=mb)
+
+
+def _trainer(max_epochs=3, snapshotter=None, **kw):
+    return vt.Trainer(_wf(), _loader(), opt.SGD(0.05, momentum=0.9),
+                      vt.Decision(max_epochs=max_epochs,
+                                  fail_iterations=50),
+                      snapshotter=snapshotter, **kw)
+
+
+# -- anomaly sentinel ------------------------------------------------------
+
+def test_injected_nan_run_completes_exact_skips_zero_recompiles():
+    """The acceptance run: with nan_grad_at_step armed, training
+    completes, loss is finite at every logged epoch, EXACTLY the
+    injected steps are skipped, and the train-step program never
+    recompiles across the skips."""
+    faults.configure(nan_grad_at_step=[3, 4])
+    tr = _trainer(max_epochs=3)
+    tr.initialize(seed=0)
+    tr.run()
+    assert tr.anomaly_steps_skipped == 2
+    assert int(jax.device_get(
+        tr.wstate["opt_state"][ANOM_SKIP_KEY])) == 2
+    assert all(np.isfinite(h["train"].get("loss", 0.0))
+               for h in tr.decision.history)
+    # train + lazily-compiled eval, nothing else — skip is not a compile
+    assert tr.step_cache.compiles == 2
+    assert tr.step_cache.recompiles == 0
+
+
+def test_skip_prefix_matches_uninjected_and_is_deterministic():
+    """Determinism the two ways that matter: the injected run is
+    bitwise-identical to an uninjected run UP TO the faulty step (epoch
+    0 here), and two identically-injected runs agree bitwise at the end
+    — the continuation past the skip is fully deterministic."""
+    def run(inject):
+        faults.reset()
+        if inject:
+            faults.configure(nan_grad_at_step=[7])  # epoch 2 (mb=32→3/ep)
+        tr = _trainer(max_epochs=3)
+        tr.initialize(seed=0)
+        tr.run()
+        return tr
+
+    a = run(True)
+    b = run(True)
+    clean = run(False)
+    # epoch 0 (steps 0-2) is before the injection: bitwise-equal losses
+    assert a.decision.history[0]["train"]["loss"] \
+        == clean.decision.history[0]["train"]["loss"]
+    # the injected trajectory itself is reproducible bit for bit
+    for (pa, la), (pb, lb) in zip(
+            jax.tree_util.tree_leaves_with_path(
+                jax.device_get(a.wstate["params"])),
+            jax.tree_util.tree_leaves_with_path(
+                jax.device_get(b.wstate["params"]))):
+        np.testing.assert_array_equal(la, lb,
+                                      err_msg=jax.tree_util.keystr(pa))
+    assert a.anomaly_steps_skipped == b.anomaly_steps_skipped == 1
+
+
+def test_skip_is_complete_noop_on_training_state():
+    """A skipped step leaves params AND optimizer slots untouched —
+    compared leaf for leaf against the pre-step state."""
+    faults.configure(nan_grad_at_step=[0])
+    wf = _wf()
+    wf.build({"@input": Spec((8, 8), jnp.float32),
+              "@labels": Spec((8,), jnp.int32),
+              "@mask": Spec((8,), jnp.float32)})
+    o = opt.SGD(0.05, momentum=0.9)
+    ws = wf.init_state(jax.random.key(0), o)
+    before = jax.device_get({"params": ws["params"],
+                             "opt_state": ws["opt_state"]})
+    step = wf.make_train_step(o, donate=False)
+    rng = np.random.default_rng(3)
+    batch = {"@input": rng.standard_normal((8, 8)).astype(np.float32),
+             "@labels": rng.integers(0, 3, 8).astype(np.int32),
+             "@mask": np.ones(8, np.float32)}
+    ws, mets = step(ws, batch)  # step 0: injected → skipped
+    after = jax.device_get({"params": ws["params"],
+                            "opt_state": ws["opt_state"]})
+    for (pa, a), (_, b) in zip(
+            jax.tree_util.tree_leaves_with_path(before["params"]),
+            jax.tree_util.tree_leaves_with_path(after["params"])):
+        np.testing.assert_array_equal(a, b,
+                                      err_msg=jax.tree_util.keystr(pa))
+    assert int(after["opt_state"][ANOM_SKIP_KEY]) == 1
+    assert int(after["opt_state"][ANOM_CONSEC_KEY]) == 1
+    # skipped step's metrics are zeroed so epoch sums stay finite
+    assert float(mets["loss"]) == 0.0
+    assert float(mets["anomaly_steps"]) == 1.0
+    ws, mets = step(ws, batch)  # step 1: clean → trains
+    assert float(mets["anomaly_steps"]) == 0.0
+    assert int(jax.device_get(ws["opt_state"][ANOM_CONSEC_KEY])) == 0
+    changed = jax.device_get(ws["params"])
+    assert any(not np.array_equal(a, b) for (_, a), (_, b) in zip(
+        jax.tree_util.tree_leaves_with_path(before["params"]),
+        jax.tree_util.tree_leaves_with_path(changed)))
+
+
+def test_clip_norm_bounds_update_without_recompiles():
+    """root.common.train.clip_norm rescales the global grad norm before
+    the update; the reported grad_norm metric is the PRE-clip norm and
+    the program set stays at one train program."""
+    root.common.train.clip_norm = 1e-3
+    tr = _trainer(max_epochs=2)
+    tr.initialize(seed=0)
+    tr.run()
+    assert tr.step_cache.recompiles == 0
+    clipped = jax.device_get(tr.wstate["params"]["fc1"]["w"])
+
+    root.common.train.clip_norm = 0.0
+    tr2 = _trainer(max_epochs=2)
+    tr2.initialize(seed=0)
+    tr2.run()
+    unclipped = jax.device_get(tr2.wstate["params"]["fc1"]["w"])
+    # a 1e-3 norm budget must visibly change the weight trajectory
+    assert not np.array_equal(clipped, unclipped)
+    assert float(tr.decision.history[-1]["train"]["grad_norm"]) > 0.0
+
+
+def test_escalation_restores_and_halves_lr():
+    """Persistent anomalies (every step NaN from step 6 on) cross
+    anomaly_patience and escalate: best weights restored, traced lr
+    multiplier halved, consec counter reset — all with zero recompiles."""
+    root.common.train.anomaly_patience = 3
+    faults.configure(nan_grad_at_step=list(range(6, 60)))
+    tr = _trainer(max_epochs=4)
+    tr.initialize(seed=0)
+    tr.run()
+    assert tr.anomaly_rollbacks >= 1
+    assert tr.decision.lr_multiplier <= 0.5
+    assert float(jax.device_get(
+        tr.wstate["opt_state"][LR_MULT_KEY])) == pytest.approx(
+            tr.decision.lr_multiplier)
+    assert tr.step_cache.compiles == 2  # train + eval, ever
+    assert tr.step_cache.recompiles == 0
+    assert all(np.isfinite(h["train"].get("loss", 0.0))
+               for h in tr.decision.history)
+    assert tr.results["anomaly_rollbacks"] == tr.anomaly_rollbacks
+
+
+def test_sentinel_off_keeps_legacy_structure(tmp_path):
+    """sentinel=False still trains (no guard, no counters update) and
+    restores from snapshots taken with the sentinel on (surplus reserved
+    slots are dropped on the way in)."""
+    tr = _trainer(max_epochs=1, snapshotter=None)
+    tr.initialize(seed=0)
+    tr.run()
+    snap = vt.Snapshotter("xover", str(tmp_path))
+    path = snap.save("s", tr._payload())
+
+    root.common.train.sentinel = False
+    tr2 = _trainer(max_epochs=2)
+    tr2.initialize(seed=1)
+    tr2.restore(path)
+    tr2.run()
+    assert tr2.decision.complete
+
+
+# -- snapshot integrity / walk-back / retention ----------------------------
+
+def _train_with_snaps(tmp_path, prefix="ft", max_epochs=3):
+    snap = vt.Snapshotter(prefix, str(tmp_path))
+    tr = _trainer(max_epochs=max_epochs, snapshotter=snap)
+    tr.initialize(seed=0)
+    tr.run()
+    return tr, snap
+
+
+def test_manifest_records_checksum_and_load_verifies(tmp_path):
+    tr, snap = _train_with_snaps(tmp_path)
+    with open(snap.last_path) as f:
+        man = json.load(f)
+    assert "tensors_sha256" in man and len(man["tensors_sha256"]) == 64
+    Snapshotter.load(snap.last_path)  # clean load verifies fine
+    npz = os.path.join(str(tmp_path), man["tensors"])
+    data = bytearray(open(npz, "rb").read())
+    data[len(data) // 2] ^= 0xFF  # bit flip in the middle
+    open(npz, "wb").write(bytes(data))
+    with pytest.raises(SnapshotCorruptError):
+        Snapshotter.load(snap.last_path)
+
+
+@pytest.mark.parametrize("corruption", ["truncate", "bitflip"])
+def test_restore_walks_back_to_newest_valid(tmp_path, corruption):
+    """A truncated OR bit-flipped newest snapshot makes Trainer.restore
+    land on the previous valid one, count the walk-back, and keep
+    training recompile-free."""
+    tr, snap = _train_with_snaps(tmp_path)
+    with open(snap.last_path) as f:
+        man = json.load(f)
+    npz = os.path.join(str(tmp_path), man["tensors"])
+    if corruption == "truncate":
+        with open(npz, "rb+") as f:
+            f.truncate(os.path.getsize(npz) // 2)
+    else:
+        data = bytearray(open(npz, "rb").read())
+        data[len(data) // 2] ^= 0xFF
+        open(npz, "wb").write(bytes(data))
+
+    tr2 = _trainer(max_epochs=5)
+    tr2.initialize(seed=1)
+    compiles0 = tr2.step_cache.compiles
+    tr2.restore(snap.last_path)
+    assert tr2.snapshot_walkbacks == 1
+    assert tr2.step_cache.compiles == compiles0
+    # landed on the PREVIOUS epoch's weights
+    prev = Snapshotter.load(os.path.join(str(tmp_path), "ft_ep1.json"))
+    np.testing.assert_array_equal(
+        jax.device_get(tr2.wstate["params"]["fc1"]["w"]),
+        np.asarray(prev["wstate"]["params"]["fc1"]["w"]))
+    tr2.run()
+    assert tr2.step_cache.recompiles == 0
+
+
+def test_walkback_exhaustion_raises(tmp_path):
+    tr, snap = _train_with_snaps(tmp_path)
+    for fn in os.listdir(str(tmp_path)):
+        if fn.endswith(".npz"):
+            p = os.path.join(str(tmp_path), fn)
+            with open(p, "rb+") as f:
+                f.truncate(max(os.path.getsize(p) // 2, 1))
+    with pytest.raises(SnapshotCorruptError, match="no valid snapshot"):
+        restore_with_walkback(snap.last_path)
+
+
+def test_truncate_snapshot_fault_knob(tmp_path):
+    """The harness's truncate_snapshot knob produces exactly the torn
+    write the walk-back defends against."""
+    snap = vt.Snapshotter("tk", str(tmp_path))
+    tr = _trainer(max_epochs=1, snapshotter=snap)
+    tr.initialize(seed=0)
+    payload = tr._payload()
+    good = snap.save("good", payload)
+    faults.configure(truncate_snapshot=True)
+    bad = snap.save("bad", payload)
+    faults.reset()
+    with pytest.raises(SnapshotCorruptError):
+        Snapshotter.load(bad)
+    loaded, used, skipped = restore_with_walkback(bad)
+    assert os.path.realpath(used) == os.path.realpath(good)
+    assert len(skipped) == 1
+
+
+def test_keep_last_k_gc_protects_symlink_targets(tmp_path):
+    """snapshot_keep=2 retains only the newest two manifests+blobs —
+    EXCEPT the _best/_current symlink targets, which survive no matter
+    their age; the symlinked latest is never deleted."""
+    root.common.snapshot_keep = 2
+    snap = vt.Snapshotter("gc", str(tmp_path))
+    tr = _trainer(max_epochs=1)
+    tr.initialize(seed=0)
+    payload = tr._payload()
+    snap.save("ep0", payload, best=True)  # old, but _best-protected
+    for i in range(1, 5):
+        snap.save(f"ep{i}", payload)
+    kept = sorted(fn for fn in os.listdir(str(tmp_path))
+                  if fn.startswith("gc_ep") and fn.endswith(".json"))
+    assert kept == ["gc_ep0.json", "gc_ep3.json", "gc_ep4.json"]
+    for fn in kept:  # blobs of the keepers still load
+        Snapshotter.load(os.path.join(str(tmp_path), fn))
+    cur = os.path.join(str(tmp_path), "gc_current.json")
+    assert os.path.exists(os.path.realpath(cur))
+
+
+# -- loader transient-read retry -------------------------------------------
+
+def test_loader_retry_recovers_injected_ioerror():
+    root.common.loader.retry_backoff_s = 0.001
+    faults.configure(loader_ioerror_at_batch=[1])
+    ld = _loader()
+    ld.initialize()
+    batches = list(ld.iter_epoch(TRAIN))
+    assert len(batches) == ld.n_minibatches(TRAIN)
+
+
+def test_loader_retry_exhaustion_names_batch_index():
+    root.common.loader.retries = 0
+    faults.configure(loader_ioerror_at_batch=[2])
+    ld = _loader()
+    ld.initialize()
+    with pytest.raises(LoaderError, match="minibatch 2"):
+        list(ld.iter_epoch(TRAIN))
+
+
+# -- http retry (forge client / snapshot http loads) -----------------------
+
+def test_http_retry_transient_then_success():
+    from veles_tpu.runtime.deploy import http_retry
+    calls = [0]
+
+    def flaky():
+        calls[0] += 1
+        if calls[0] < 3:
+            raise urllib.error.URLError("connection refused")
+        return "ok"
+
+    assert http_retry(flaky, base_s=0.001) == "ok"
+    assert calls[0] == 3
+
+
+def test_http_retry_5xx_retries_4xx_fails_fast():
+    from veles_tpu.runtime.deploy import http_retry
+    calls = [0]
+
+    def flaky_5xx():
+        calls[0] += 1
+        if calls[0] < 2:
+            raise urllib.error.HTTPError("u", 503, "unavailable", {}, None)
+        return "ok"
+
+    assert http_retry(flaky_5xx, base_s=0.001) == "ok"
+    assert calls[0] == 2
+
+    calls[0] = 0
+
+    def gone():
+        calls[0] += 1
+        raise urllib.error.HTTPError("u", 404, "not found", {}, None)
+
+    with pytest.raises(urllib.error.HTTPError):
+        http_retry(gone, base_s=0.001)
+    assert calls[0] == 1  # no second ask for a missing resource
+
+
+# -- engine scheduler crash path -------------------------------------------
+
+def test_scheduler_crash_fails_work_with_500_and_event(tmp_path):
+    """An injected scheduler-loop death fails the pending request with
+    SchedulerCrashed (restful's 500), records a scheduler_crash status
+    event, flips the stats gauge, and later submits keep failing
+    loudly."""
+    from veles_tpu.models.standard import build_workflow
+    from veles_tpu.runtime.engine import DecodeEngine, SchedulerCrashed
+    from veles_tpu.runtime.status import StatusReporter
+    V = 12
+    wf = build_workflow("crash_lm", [
+        {"type": "embedding", "vocab": V, "dim": 12, "name": "emb"},
+        {"type": "gru", "hidden": 12, "name": "g1"},
+        {"type": "seq_last", "name": "last"},
+        {"type": "softmax", "output_size": V, "name": "out"}])
+    wf.build({"@input": vt.Spec((2, 6), jnp.int32),
+              "@labels": vt.Spec((2,), jnp.int32),
+              "@mask": vt.Spec((2,), jnp.float32)})
+    ws = wf.init_state(jax.random.key(3), opt.SGD(0.1))
+    status = StatusReporter(str(tmp_path / "status.json"))
+    eng = DecodeEngine(wf, ws, slots=2, l_max=32, status=status)
+    eng.start()
+    try:
+        faults.configure(scheduler_crash=True)
+        req = eng.submit(np.array([1, 2, 3], np.int32), 4)
+        assert req.done.wait(20)
+        assert isinstance(req.error, SchedulerCrashed)
+        assert eng.stats()["scheduler_crashed"] is True
+        events = [e["kind"] for e in status.read().get("events", [])]
+        assert "scheduler_crash" in events
+        with pytest.raises(SchedulerCrashed):
+            eng.submit(np.array([1], np.int32), 2)
+    finally:
+        faults.reset()
+        eng.stop()
+
+
+# -- harness plumbing ------------------------------------------------------
+
+def test_fault_plan_parsing_and_one_shot():
+    plan = faults.configure(nan_grad_at_step=3, slow_batch_ms=1.5)
+    assert plan.nan_grad_at_step == (3,)
+    assert plan.slow_batch_ms == 1.5
+    assert bool(plan)
+    assert faults.fire_once("x", 1)
+    assert not faults.fire_once("x", 1)
+    assert faults.fire_once("x", 2)
+    faults.reset()
+    assert not faults.enabled()
+    assert not faults.get_plan()
+    assert faults.fire_once("x", 1)  # memory cleared

@@ -255,6 +255,22 @@ def _defaults():
     # Upper bound (MiB) on the tensors blob compare_snapshots /
     # Snapshotter.load will download from an http(s):// snapshot URI.
     root.common.snapshot_http_max_mb = 2048
+    # Snapshot retention: keep only the newest K manifests+blobs per
+    # prefix (0 = keep everything).  The _current/_best symlink targets
+    # are never collected (docs/robustness.md).
+    root.common.snapshot_keep = 0
+    # Training fault tolerance (runtime/trainer.py + docs/robustness.md).
+    root.common.train.sentinel = True       # in-graph non-finite guard
+    root.common.train.clip_norm = 0.0       # global grad-norm clip (0=off)
+    root.common.train.anomaly_patience = 0  # consecutive bad steps before
+    #                                         rollback escalation (0=never)
+    # Loader transient-read retry (loader/base.py; the Veles
+    # failed-minibatch-requeue analog).
+    root.common.loader.retries = 2          # attempts beyond the first
+    root.common.loader.retry_backoff_s = 0.05  # first retry delay (doubles)
+    # Transient HTTP retry (forge/client.py, Snapshotter http loads;
+    # backoff shape shared with the deploy watcher, runtime/deploy.py).
+    root.common.net.http_retries = 3
     root.common.random_seed = 42
     root.common.platform = ""                # "" = let JAX pick
     root.common.mesh = dict(data=-1)          # -1: all remaining devices

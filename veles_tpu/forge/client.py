@@ -28,25 +28,41 @@ class ForgeClient(Logger):
         self.base_url = base_url.rstrip("/")
 
     # -- HTTP plumbing -----------------------------------------------------
+    # Transient failures (connection refused/reset, 5xx) retry with the
+    # backoff + jitter shape shared with the deploy snapshot watcher
+    # (runtime/deploy.py http_retry, root.common.net.http_retries); 4xx
+    # fail fast — a missing package stays missing no matter how often we
+    # ask, and retrying an upload against a validation error re-sends
+    # the whole tar for nothing.
+    def _retrying(self, do, what: str):
+        from ..runtime.deploy import http_retry  # late: keeps the forge
+        try:                                     # client import-light
+            return http_retry(do, what=what, log=self)
+        except urllib.error.HTTPError as e:
+            raise ForgeClientError(self._err(e)) from e
+
     def _get(self, path: str, **params) -> bytes:
         qs = urllib.parse.urlencode(
             {k: v for k, v in params.items() if v is not None})
         url = f"{self.base_url}/{path}" + (f"?{qs}" if qs else "")
-        try:
+
+        def do():
             with urllib.request.urlopen(url) as resp:
                 return resp.read()
-        except urllib.error.HTTPError as e:
-            raise ForgeClientError(self._err(e)) from e
+
+        return self._retrying(do, f"GET {url}")
 
     def _post(self, path: str, body: bytes) -> dict:
-        req = urllib.request.Request(
-            f"{self.base_url}/{path}", data=body,
-            headers={"Content-Type": "application/x-gzip"})
-        try:
+        url = f"{self.base_url}/{path}"
+
+        def do():
+            req = urllib.request.Request(
+                url, data=body,
+                headers={"Content-Type": "application/x-gzip"})
             with urllib.request.urlopen(req) as resp:
                 return json.loads(resp.read())
-        except urllib.error.HTTPError as e:
-            raise ForgeClientError(self._err(e)) from e
+
+        return self._retrying(do, f"POST {url}")
 
     @staticmethod
     def _err(e: urllib.error.HTTPError) -> str:

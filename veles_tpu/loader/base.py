@@ -146,7 +146,56 @@ class Loader(Logger):
             chunk = perm[i * bs:(i + 1) * bs]
             if len(chunk) == 0:  # shard exhausted: fully-masked batch
                 chunk = np.zeros(0, np.int64)
-            yield self.make_batch(chunk, klass)
+            yield self._fetch_batch(chunk, klass, i)
+
+    def _fetch_batch(self, chunk: np.ndarray, klass: int,
+                     batch_index: int) -> Dict[str, np.ndarray]:
+        """``make_batch`` with bounded transient-read retry — the rebuild's
+        analog of the reference master re-serving a failed minibatch
+        (veles/loader/base.py:679-687).  ``OSError`` from the underlying
+        read (flaky NFS/HDFS/object store) retries up to
+        ``root.common.loader.retries`` times with exponential backoff;
+        exhaustion re-raises as :class:`LoaderError` naming the failing
+        batch index so the epoch position is diagnosable."""
+        import time as _time
+        from ..config import root
+        retries = int(root.common.loader.get("retries", 2))
+        backoff = float(root.common.loader.get("retry_backoff_s", 0.05))
+        attempt = 0
+        while True:
+            try:
+                self._maybe_inject_fault(batch_index)
+                return self.make_batch(chunk, klass)
+            except OSError as e:
+                if attempt >= retries:
+                    raise LoaderError(
+                        f"minibatch {batch_index} (class "
+                        f"{CLASS_NAMES[klass]}) failed after "
+                        f"{attempt + 1} attempts: {e}") from e
+                delay = backoff * (2 ** attempt)
+                self.warning(
+                    "transient read error on minibatch %d (attempt "
+                    "%d/%d): %s — retrying in %.2fs", batch_index,
+                    attempt + 1, retries + 1, e, delay)
+                _time.sleep(delay)
+                attempt += 1
+
+    def _maybe_inject_fault(self, batch_index: int) -> None:
+        """Fault-harness hook (runtime/faults.py): an armed
+        ``loader_ioerror_at_batch`` raises OSError on the FIRST fetch of
+        that index (so the bounded retry above recovers);
+        ``slow_batch_ms`` stalls every fetch."""
+        from ..runtime import faults
+        if not faults.enabled():
+            return
+        plan = faults.get_plan()
+        if plan.slow_batch_ms > 0:
+            import time as _time
+            _time.sleep(plan.slow_batch_ms / 1e3)
+        if (batch_index in plan.loader_ioerror_at_batch
+                and faults.fire_once("loader_ioerror", batch_index)):
+            raise OSError(
+                f"injected loader IOError at batch {batch_index}")
 
     def make_batch(self, chunk: np.ndarray, klass: int
                    ) -> Dict[str, np.ndarray]:

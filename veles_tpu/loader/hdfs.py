@@ -23,12 +23,29 @@ client (see ext.py), closing the round-1 "HDFS loader absent" gap.
 from __future__ import annotations
 
 import json
+import urllib.error
 import urllib.parse
 import urllib.request
 from typing import Dict, Iterator, List, Optional
 
 from ..logger import Logger
 from .base import LoaderError
+
+
+def _urlopen_retrying(url: str, timeout: float):
+    """urlopen with bounded transient retry (connection errors and 5xx —
+    a datanode mid-restart — back off and retry; 4xx like a missing path
+    fail fast).  The loader-level ``_fetch_batch`` retry can't see these
+    because this client wraps them into LoaderError for its callers.
+    Retry shape is the shared ``deploy.http_retry`` (backoff + jitter),
+    bounded by the LOADER knobs rather than the serving ones."""
+    from ..config import root
+    from ..runtime.deploy import http_retry
+    return http_retry(
+        lambda: urllib.request.urlopen(url, timeout=timeout),
+        what=f"WebHDFS {url.split('?', 1)[0]}",
+        retries=int(root.common.loader.get("retries", 2)),
+        base_s=float(root.common.loader.get("retry_backoff_s", 0.05)))
 
 
 class WebHdfsClient:
@@ -54,7 +71,7 @@ class WebHdfsClient:
 
     def _get_json(self, url: str) -> dict:
         try:
-            with urllib.request.urlopen(url, timeout=self.timeout) as r:
+            with _urlopen_retrying(url, self.timeout) as r:
                 return json.load(r)
         except urllib.error.HTTPError as e:
             raise LoaderError(
@@ -79,7 +96,7 @@ class WebHdfsClient:
         url = self._url(path, "OPEN", **params)
         try:
             # The namenode 307-redirects to a datanode; urllib follows.
-            with urllib.request.urlopen(url, timeout=self.timeout) as r:
+            with _urlopen_retrying(url, self.timeout) as r:
                 return r.read()
         except urllib.error.HTTPError as e:
             raise LoaderError(

@@ -84,6 +84,118 @@ LR_POLICIES = {
 #: Unit names cannot collide with it (dunder names are not valid units).
 LR_MULT_KEY = "__lr_mult__"
 
+#: Traced anomaly-sentinel counters riding opt_state next to the lr
+#: multiplier (same recompile-free discipline): total updates skipped on
+#: non-finite loss/grad-norm, and the CURRENT run of consecutive
+#: anomalous steps (the Trainer's escalation gauge — it reads the value
+#: once per epoch and rolls back when it crosses
+#: ``root.common.train.anomaly_patience``).  Updated in-graph by
+#: :func:`guarded_update`; carried through :meth:`Optimizer.update`
+#: untouched so the state tree is structurally stable.
+ANOM_SKIP_KEY = "__anom_skipped__"
+ANOM_CONSEC_KEY = "__anom_consec__"
+
+#: Reserved opt_state scalars and their neutral (fresh-state) values —
+#: the one table legacy-snapshot adaptation walks (Trainer.restore
+#: injects missing slots / drops surplus ones before the structural
+#: tree-map).
+def reserved_opt_neutral():
+    import numpy as np
+    return {LR_MULT_KEY: np.ones((), np.float32),
+            ANOM_SKIP_KEY: np.zeros((), np.int32),
+            ANOM_CONSEC_KEY: np.zeros((), np.int32)}
+
+
+def global_grad_norm(grads) -> jnp.ndarray:
+    """f32 global L2 norm over every gradient leaf (the quantity both
+    the anomaly sentinel and global clipping key off)."""
+    return jnp.sqrt(sum(
+        jnp.sum(jnp.square(g.astype(jnp.float32)))
+        for g in jax.tree.leaves(grads)) + 1e-12)
+
+
+def _select_leaf(ok, new, old):
+    """ok ? new : old for one leaf, PRNG-typed keys included."""
+    if hasattr(new, "dtype") and jnp.issubdtype(new.dtype,
+                                                jax.dtypes.prng_key):
+        return jax.random.wrap_key_data(jnp.where(
+            ok, jax.random.key_data(new), jax.random.key_data(old)))
+    return jnp.where(ok, new, old)
+
+
+def tree_select(ok, new_tree, old_tree):
+    """Elementwise ``jnp.where(ok, new, old)`` over two same-structure
+    pytrees — the sentinel's skip primitive (one fused select, no host
+    sync, no recompile)."""
+    return jax.tree.map(lambda n, o: _select_leaf(ok, n, o),
+                        new_tree, old_tree)
+
+
+def guarded_update(optimizer: "Optimizer", grads, opt_state, params,
+                   step, loss, *, clip_norm: float = 0.0,
+                   sentinel: bool = True, inject_nan_steps=()):
+    """Anomaly-guarded optimizer update — the in-graph sentinel of the
+    training fault-tolerance layer (docs/robustness.md).
+
+    Runs ``optimizer.update`` and, when ``sentinel`` is on, SKIPS the
+    whole update on a non-finite loss or gradient global norm: params
+    and optimizer slots are carried through unchanged via a traced
+    ``jnp.where`` select (no host sync per step, no recompile — the ok
+    flag is data, not structure), and the ``ANOM_SKIP_KEY`` /
+    ``ANOM_CONSEC_KEY`` opt_state scalars advance so the host can read
+    skip totals once per epoch.  ``clip_norm > 0`` rescales gradients to
+    that global norm first (``root.common.train.clip_norm``).
+    ``inject_nan_steps`` is the fault harness's in-graph poison point
+    (``runtime/faults.py::nan_grad_at_step``).
+
+    Returns ``(params, opt_state, ok, gnorm)``; ``ok``/``gnorm`` are
+    ``None`` when the corresponding machinery is off, so callers can
+    gate metric sanitization on them.
+    """
+    if inject_nan_steps:
+        bad_steps = jnp.asarray(tuple(inject_nan_steps), jnp.int32)
+        hit = jnp.any(jnp.asarray(step, jnp.int32) == bad_steps)
+        grads = jax.tree.map(
+            lambda g: jnp.where(hit, jnp.asarray(jnp.nan, g.dtype), g)
+            if jnp.issubdtype(jnp.asarray(g).dtype, jnp.inexact) else g,
+            grads)
+    clip_norm = float(clip_norm or 0.0)
+    if not sentinel and clip_norm <= 0.0:
+        p, s = optimizer.update(grads, opt_state, params, step)
+        return p, s, None, None
+    gnorm = global_grad_norm(grads)
+    if clip_norm > 0.0:
+        # a non-finite gnorm poisons the scale, but the ok-gate below
+        # discards the whole update anyway — no need to special-case
+        scale = jnp.minimum(1.0, jnp.asarray(clip_norm, jnp.float32)
+                            / gnorm)
+        grads = jax.tree.map(
+            lambda g: (g.astype(jnp.float32) * scale).astype(g.dtype)
+            if jnp.issubdtype(jnp.asarray(g).dtype, jnp.inexact) else g,
+            grads)
+    new_params, new_opt = optimizer.update(grads, opt_state, params, step)
+    if not sentinel:
+        return new_params, new_opt, None, gnorm
+    ok = (jnp.isfinite(jnp.asarray(loss, jnp.float32))
+          & jnp.isfinite(gnorm))
+    sel_params = tree_select(ok, new_params, params)
+    try:
+        sel_opt = tree_select(ok, new_opt, opt_state)
+    except ValueError:
+        # legacy/optimizer-less states: update() lazily materialized
+        # slots the input tree lacks, so the structures differ — take
+        # the new tree (params above are still guarded)
+        sel_opt = new_opt
+    if isinstance(sel_opt, dict) and isinstance(opt_state, dict) \
+            and ANOM_SKIP_KEY in opt_state:
+        bad = (~ok).astype(jnp.int32)
+        sel_opt = dict(sel_opt)
+        sel_opt[ANOM_SKIP_KEY] = opt_state[ANOM_SKIP_KEY] + bad
+        sel_opt[ANOM_CONSEC_KEY] = jnp.where(
+            ok, jnp.zeros((), jnp.int32),
+            opt_state[ANOM_CONSEC_KEY] + 1)
+    return sel_params, sel_opt, ok, gnorm
+
 
 @dataclasses.dataclass(frozen=True)
 class HyperParams:
@@ -131,10 +243,12 @@ class Optimizer:
     def init(self, params) -> Any:
         state = jax.tree.map(self.init_slot, params)
         if isinstance(state, dict):
-            # the traced lr multiplier rides opt_state so it is sharded
-            # (replicated scalar), donated, and checkpointed with the rest
-            # of the training state
+            # the traced lr multiplier + anomaly counters ride opt_state
+            # so they are sharded (replicated scalars), donated, and
+            # checkpointed with the rest of the training state
             state[LR_MULT_KEY] = jnp.ones((), jnp.float32)
+            state[ANOM_SKIP_KEY] = jnp.zeros((), jnp.int32)
+            state[ANOM_CONSEC_KEY] = jnp.zeros((), jnp.int32)
         return state
 
     def _hp(self, unit_name: str) -> HyperParams:
@@ -160,6 +274,13 @@ class Optimizer:
         new_params, new_state = {}, {}
         if lr_mult is not None:
             new_state[LR_MULT_KEY] = lr_mult
+        # anomaly counters pass through untouched — guarded_update (the
+        # only writer) advances them AFTER the ok-select, keeping the
+        # state tree structurally identical in and out
+        if isinstance(state, dict):
+            for k in (ANOM_SKIP_KEY, ANOM_CONSEC_KEY):
+                if k in state:
+                    new_state[k] = state[k]
         for uname, uparams in params.items():
             hp = self._hp(uname)
             ugrads = grads[uname]

@@ -693,6 +693,16 @@ def build_pipeline_step(wf, optimizer, mesh, wstate, batch_spec, *,
         pos_factor = float(math.prod(s_l[len(s_m):])) if len(s_l) > \
             len(s_m) else 1.0
 
+    # anomaly sentinel knobs, read at build time like the AD path
+    # (Workflow._build_step): the guarded update skips non-finite steps
+    # via a traced select, so the IMMORTAL program stays immortal even
+    # while it is skipping anomalies (docs/robustness.md)
+    from ..config import root as _root
+    _sentinel = bool(_root.common.train.get("sentinel", True))
+    _clip = float(_root.common.train.get("clip_norm", 0.0) or 0.0)
+    from ..runtime.faults import get_plan as _get_plan
+    _inject = _get_plan().nan_grad_at_step
+
     def step(wstate, batch):
         params = wstate["params"]
         # closures built inside the trace so they can capture this
@@ -726,13 +736,26 @@ def build_pipeline_step(wf, optimizer, mesh, wstate, batch_spec, *,
         merge = (plan.merge_grads_shared if shared
                  else plan.merge_grads)
         grads = merge(sgrads, params)
-        nparams, opt_state = optimizer.update(
-            grads, wstate["opt_state"], params, wstate["step"])
+        from ..ops.optimizers import guarded_update
+        nparams, opt_state, ok, gnorm = guarded_update(
+            optimizer, grads, wstate["opt_state"], params,
+            wstate["step"], loss, clip_norm=_clip, sentinel=_sentinel,
+            inject_nan_steps=_inject)
         nws = new_state(nparams, wstate["state"], opt_state,
                         wstate["step"] + 1, key)
         # `loss` excludes aux (the AD path's metric contract); the
         # gradient step above includes it
-        return nws, {"loss": loss, "aux": aux, "n_samples": n_samples}
+        mets = {"loss": loss, "aux": aux, "n_samples": n_samples}
+        if ok is not None:
+            mets = {k: jnp.where(ok, v, jnp.zeros_like(v))
+                    for k, v in mets.items()}
+            mets["anomaly_steps"] = (~ok).astype(jnp.float32)
+        if gnorm is not None:
+            # gated like the AD path: a skipped step's NaN norm must
+            # not poison the epoch grad_norm aggregate
+            mets["grad_norm"] = gnorm if ok is None \
+                else jnp.where(ok, gnorm, 0.0)
+        return nws, mets
 
     fn = jax.jit(step,
                  in_shardings=(state_sh, batch_sh),

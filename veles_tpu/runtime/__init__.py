@@ -1,7 +1,7 @@
 from .decision import Decision
 from .deploy import DeployController, ModelRegistry
 from .engine import (DecodeEngine, EngineDraining, EngineOverloaded,
-                     EngineStopped)
+                     EngineStopped, SchedulerCrashed)
 from .generate import DecodePlan, generate, generate_beam
 from .snapshotter import Snapshotter, SnapshotterToDB
 from .step_cache import StepCache, enable_persistent_cache

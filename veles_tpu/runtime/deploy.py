@@ -53,6 +53,52 @@ from .snapshotter import (Snapshotter, list_snapshots, sha256_files,
                           snapshot_checksum)
 from .step_cache import tree_signature
 
+#: Shared retry-backoff shape: the snapshot watcher, the forge HTTP
+#: client and Snapshotter http loads all grow their delay by
+#: BACKOFF_FACTOR per consecutive failure and add up to BACKOFF_JITTER
+#: of uniform spread (so a fleet of retriers doesn't re-stampede the
+#: endpoint that just failed).  The HTTP_* pair bounds the per-attempt
+#: delay for request-scale retries (the watcher's ceiling is the
+#: config's ``watch_backoff_max_s``).
+BACKOFF_FACTOR = 2.0
+BACKOFF_JITTER = 0.25
+HTTP_RETRY_BASE_S = 0.25
+HTTP_RETRY_MAX_S = 4.0
+
+
+def http_retry(fn, *, what: str = "http request",
+               retries: Optional[int] = None, log=None,
+               base_s: float = HTTP_RETRY_BASE_S):
+    """Call ``fn()``, retrying TRANSIENT failures — connection errors and
+    HTTP 5xx — up to ``retries`` times (default
+    ``root.common.net.http_retries``) with exponential backoff + jitter.
+    4xx responses re-raise immediately: the client is wrong, not
+    unlucky, and asking again just hammers the server."""
+    import random
+    import urllib.error
+    if retries is None:
+        retries = int(root.common.net.get("http_retries", 3))
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except urllib.error.HTTPError as e:
+            if e.code < 500 or attempt >= retries:
+                raise
+            reason = f"HTTP {e.code}"
+        except (urllib.error.URLError, ConnectionError, TimeoutError,
+                OSError) as e:
+            if attempt >= retries:
+                raise
+            reason = f"{type(e).__name__}: {e}"
+        delay = min(base_s * BACKOFF_FACTOR ** attempt, HTTP_RETRY_MAX_S)
+        delay *= 1.0 + random.random() * BACKOFF_JITTER
+        if log is not None:
+            log.warning("%s failed (%s); retry %d/%d in %.2fs", what,
+                        reason, attempt + 1, retries, delay)
+        time.sleep(delay)
+        attempt += 1
+
 
 def _shape_signature(tree, *, unwrap_keys: bool = False) -> Tuple:
     """(path, shape) signature — the structural half of
@@ -648,8 +694,8 @@ class DeployController(Logger):
                 delay = self.watch_interval_s
             except Exception as e:  # noqa: BLE001 — the watcher must
                 # outlive any single bad snapshot; backoff, retry
-                delay = min(max(delay, self.watch_interval_s) * 2,
-                            self.watch_backoff_max_s)
+                delay = min(max(delay, self.watch_interval_s)
+                            * BACKOFF_FACTOR, self.watch_backoff_max_s)
                 self.last_error = f"{type(e).__name__}: {e}"
                 self.warning("snapshot watcher: %s (retrying in %.1fs)",
                              self.last_error, delay)

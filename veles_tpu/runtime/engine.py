@@ -67,6 +67,14 @@ class EngineStopped(RuntimeError):
     """The engine was stopped before this request completed."""
 
 
+class SchedulerCrashed(RuntimeError):
+    """The scheduler loop died with an unhandled exception: every queued
+    and mid-flight request was failed with this error, and new submits
+    keep raising it.  Deliberately NOT an :class:`EngineStopped` — a
+    crash is a 500 (page someone), not a 503 drain a load balancer
+    routes around (runtime/restful.py)."""
+
+
 class EngineDraining(EngineStopped):
     """The engine is draining: in-flight work retires, new work is
     refused (the REST layer's 503 on ``/ready`` and ``/generate``)."""
@@ -539,6 +547,10 @@ class DecodeEngine(Logger):
             # NEW work is refused so the slot set empties (HTTP 503)
             raise EngineDraining(
                 "engine is draining; not accepting new requests")
+        if self._died:
+            raise SchedulerCrashed(
+                "engine scheduler crashed earlier; restart the engine "
+                "(see the scheduler_crash status event for the cause)")
         if not self.started:
             # a dead scheduler (stopped, or its loop died) would leave
             # the request queued forever with nothing enforcing its
@@ -625,6 +637,7 @@ class DecodeEngine(Logger):
             "admitted": self._admitted, "retired": self._retired,
             "rejected": self._rejected, "timeouts": self._timeouts,
             "swaps": self._swaps, "draining": self._draining,
+            "scheduler_crashed": self._died,
             "compile": self.step_cache.stats(),
         }
 
@@ -637,9 +650,19 @@ class DecodeEngine(Logger):
         return min(60.0, max(1.0, queued / rate))
 
     def _loop(self):
+        from . import faults
         try:
             while not self._stop_evt.is_set():
                 self._maybe_report()
+                if faults.enabled() and (self._queue
+                                         or self._active.any()):
+                    # injected crash point (tests/test_faults.py): fire
+                    # only with work pending so the crash exercises the
+                    # fail-all path, and only once per arming
+                    if faults.get_plan().scheduler_crash \
+                            and faults.fire_once("scheduler_crash"):
+                        raise faults.FaultInjected(
+                            "injected decode-scheduler crash")
                 # decode-step boundary: no program is running right now,
                 # so a staged weight swap flips here atomically
                 self._apply_swap()
@@ -661,7 +684,18 @@ class DecodeEngine(Logger):
             # fail pending work loudly, not hang every client forever
             self._died = True
             self.exception("decode engine scheduler died")
-            self._fail_all(e)
+            if self.status is not None:
+                try:
+                    self.status.record_event(
+                        "scheduler_crash",
+                        error=f"{type(e).__name__}: {e}")
+                except Exception:  # status must never mask the crash
+                    pass
+            # queued AND mid-flight requests all fail with the same
+            # clearly-typed error (HTTP 500 in restful.py, not the 503
+            # a drain answers) naming the original exception
+            self._fail_all(SchedulerCrashed(
+                f"engine scheduler crashed: {type(e).__name__}: {e}"))
         finally:
             # a swap staged during shutdown still flips (harmless) so
             # its waiter is released instead of blocking to timeout

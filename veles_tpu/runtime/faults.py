@@ -1,0 +1,138 @@
+"""Deterministic, config-driven fault injection for the training and
+serving lifecycle (docs/robustness.md).
+
+The reference got its chaos testing for free: a slave process could be
+killed at any point and the master re-served its minibatches from owned
+state (veles/server.py:315-338, veles/loader/base.py:679-687).  The
+SPMD rebuild's recovery unit is the whole process (tests/test_chaos.py),
+so the failure modes worth rehearsing are the ones that *don't* kill the
+process: a NaN loss, a torn snapshot write, a flaky storage read, a dead
+scheduler thread.  This module is the one switchboard those rehearsals
+go through — production code consults it at well-defined injection
+points, tests arm it through ``root.common.faults.*`` (or the
+:func:`configure` convenience) and get bit-deterministic failures.
+
+Knobs (all off by default; ``root.common.faults`` stays an empty config
+node in production, so the :func:`enabled` fast path is one falsy check):
+
+``nan_grad_at_step``
+    int or list of ints.  Poison every gradient leaf with NaN at these
+    global step numbers.  Injected IN-GRAPH at trace time (a traced
+    compare against ``wstate["step"]``), so the injection adds ZERO
+    recompiles — the property the anomaly sentinel's own tests assert.
+    Arm it BEFORE the step compiles (``Trainer.initialize``); arming
+    later hits the already-cached executable.
+``loader_ioerror_at_batch``
+    int or list of ints.  The FIRST fetch attempt of these batch
+    indices raises ``OSError`` (once per index per process), so the
+    loader's bounded retry recovers — the Veles failed-minibatch-requeue
+    analog.
+``truncate_snapshot``
+    truthy.  Every ``Snapshotter.save`` truncates its tensors blob to
+    half size AFTER the atomic symlink flip — a torn write discovered
+    only at restore time (exercises checksum verify + walk-back).
+``slow_batch_ms``
+    float.  Sleep this many milliseconds inside every batch fetch
+    (prefetch/backpressure rehearsal).
+``scheduler_crash``
+    truthy.  The decode-engine scheduler loop raises
+    :class:`FaultInjected` (once) at its next iteration with pending
+    work — exercises the fail-all-loudly crash path.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional, Tuple
+
+from ..config import root
+
+
+class FaultInjected(RuntimeError):
+    """Raised at a crash point armed via ``root.common.faults``."""
+
+
+#: one-shot firing memory: (kind, index) pairs that already fired.
+_fired: set = set()
+_lock = threading.Lock()
+
+
+def _as_steps(v) -> Tuple[int, ...]:
+    """Normalize an int / float / iterable knob to a sorted int tuple."""
+    if v is None or v is False or v == "":
+        return ()
+    if isinstance(v, bool):  # True alone names no step
+        return ()
+    if isinstance(v, (int, float)):
+        return (int(v),)
+    return tuple(sorted(int(x) for x in v))
+
+
+class FaultPlan:
+    """Immutable snapshot of the armed injection points."""
+
+    __slots__ = ("nan_grad_at_step", "loader_ioerror_at_batch",
+                 "truncate_snapshot", "slow_batch_ms", "scheduler_crash")
+
+    def __init__(self, cfg):
+        get = cfg.get
+        self.nan_grad_at_step = _as_steps(get("nan_grad_at_step"))
+        self.loader_ioerror_at_batch = _as_steps(
+            get("loader_ioerror_at_batch"))
+        self.truncate_snapshot = bool(get("truncate_snapshot", False))
+        self.slow_batch_ms = float(get("slow_batch_ms", 0.0) or 0.0)
+        self.scheduler_crash = bool(get("scheduler_crash", False))
+
+    def __bool__(self) -> bool:
+        return bool(self.nan_grad_at_step or self.loader_ioerror_at_batch
+                    or self.truncate_snapshot or self.slow_batch_ms
+                    or self.scheduler_crash)
+
+    def __repr__(self) -> str:
+        armed = {k: getattr(self, k) for k in self.__slots__
+                 if getattr(self, k)}
+        return f"FaultPlan({armed})"
+
+
+def enabled() -> bool:
+    """Cheap is-anything-armed check for hot loops: an empty (or never
+    touched) ``root.common.faults`` node is falsy."""
+    return bool(root.common.faults)
+
+
+def get_plan() -> FaultPlan:
+    """Build the current plan from ``root.common.faults``.  Cheap enough
+    to call per batch; injection points on compile-hot paths read it once
+    at trace/build time instead."""
+    return FaultPlan(root.common.faults)
+
+
+def fire_once(kind: str, index: Optional[int] = None) -> bool:
+    """True exactly once per (kind, index) for the process lifetime
+    (until :func:`reset`) — injected transients must be recoverable by a
+    bounded retry, and injected crashes must not re-kill the replacement."""
+    key = (kind, index)
+    with _lock:
+        if key in _fired:
+            return False
+        _fired.add(key)
+        return True
+
+
+def configure(**knobs) -> FaultPlan:
+    """Arm injection points programmatically (test convenience): clears
+    any previous plan AND the one-shot firing memory, then writes each
+    knob into ``root.common.faults``."""
+    reset()
+    for k, v in knobs.items():
+        setattr(root.common.faults, k, v)
+    return get_plan()
+
+
+def reset() -> None:
+    """Disarm everything and forget what already fired."""
+    with _lock:
+        _fired.clear()
+    node = root.common.faults
+    for k in list(node.keys()):
+        delattr(node, k)

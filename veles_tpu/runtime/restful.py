@@ -51,7 +51,7 @@ import numpy as np
 
 from ..config import root
 from ..logger import Logger
-from .engine import EngineOverloaded, EngineStopped
+from .engine import EngineOverloaded, EngineStopped, SchedulerCrashed
 
 
 class RestfulServer(Logger):
@@ -177,6 +177,13 @@ class RestfulServer(Logger):
                         {"error": str(e)}, code=429,
                         headers=(("Retry-After",
                                   str(int(round(e.retry_after_s)))),))
+                except SchedulerCrashed as e:
+                    # the scheduler loop died: this request (queued or
+                    # mid-flight when it happened, or submitted after)
+                    # FAILED — a clear 500 that pages someone, never the
+                    # 503 a balancer would politely route around
+                    self._reply({"error": str(e),
+                                 "kind": "scheduler_crash"}, code=500)
                 except EngineStopped as e:
                     # draining or stopped: refuse new work the way a
                     # load balancer expects (503 + Retry-After), matching

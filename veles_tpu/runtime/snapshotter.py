@@ -20,13 +20,41 @@ from __future__ import annotations
 import json
 import os
 import time
-from typing import Any, Dict, Optional
+import zipfile
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ..logger import Logger
+
+
+class SnapshotCorruptError(RuntimeError):
+    """A snapshot failed integrity verification (checksum mismatch,
+    truncated/unreadable tensors blob, unparseable manifest).  Restore
+    paths catch it and walk back to the newest VALID snapshot
+    (:func:`restore_with_walkback`)."""
+
+
+def _fsync_file(path: str) -> None:
+    """Flush a finished file's bytes to stable storage — the atomic
+    _current symlink flip is only a valid commit point if the files it
+    names survive a crash (docs/robustness.md: torn-write discipline)."""
+    with open(path, "rb") as f:
+        os.fsync(f.fileno())
+
+
+def _fsync_dir(path: str) -> None:
+    """Persist directory entries (the rename/symlink metadata)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return  # platform without directory fds: best effort
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
 
 
 def _flatten(tree, prefix="", out=None):
@@ -131,6 +159,15 @@ class Snapshotter(Logger):
 
     def save(self, tag: str, payload: Dict[str, Any], *,
              best: bool = False) -> str:
+        """Write tensors npz + JSON manifest, fsync both, THEN flip the
+        ``_current``/``_best`` symlinks — so the symlinks only ever name
+        snapshots whose bytes are on stable storage.  The manifest
+        records the tensors blob's sha256 (``tensors_sha256``); restore
+        verifies it and walks back past corruption
+        (:func:`restore_with_walkback`).  ``root.common.snapshot_keep``
+        > 0 garbage-collects all but the newest K snapshots after a
+        successful save (symlink targets are never collected)."""
+        from ..config import root
         os.makedirs(self.directory, exist_ok=True)
         base = f"{self.prefix}_{tag}"
         npz_path = os.path.join(self.directory, base + ".npz")
@@ -138,13 +175,19 @@ class Snapshotter(Logger):
         tensors = _flatten(_to_numpy(payload.get("wstate", {})))
         saver = np.savez_compressed if self.compression else np.savez
         saver(npz_path, **tensors)
+        _fsync_file(npz_path)
 
         manifest = {k: v for k, v in payload.items() if k != "wstate"}
         manifest["tensors"] = base + ".npz"
+        manifest["tensors_sha256"] = sha256_files([npz_path])
         manifest["saved_at"] = time.time()
         man_path = os.path.join(self.directory, base + ".json")
-        with open(man_path, "w") as f:
+        man_tmp = man_path + ".tmp"
+        with open(man_tmp, "w") as f:
             json.dump(manifest, f, indent=1, default=repr)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(man_tmp, man_path)
 
         for link, active in (("_current", True), ("_best", best)):
             if not active:
@@ -155,30 +198,104 @@ class Snapshotter(Logger):
                 os.remove(tmp)
             os.symlink(os.path.basename(man_path), tmp)
             os.replace(tmp, lpath)
+        _fsync_dir(self.directory)
 
         size = os.path.getsize(npz_path)
         self.info("snapshot %s (%.1f MiB)%s", man_path, size / 2**20,
                   " [best]" if best else "")
         self.last_path = man_path
+
+        keep = int(root.common.get("snapshot_keep", 0) or 0)
+        if keep > 0:
+            self._gc(keep)
+
+        # fault harness: simulate a torn write discovered only at
+        # restore time (docs/robustness.md fault-injection knobs)
+        from .faults import get_plan
+        if get_plan().truncate_snapshot:
+            with open(npz_path, "rb+") as f:
+                f.truncate(max(size // 2, 1))
+            self.warning("fault injection: truncated %s to %d bytes",
+                         npz_path, max(size // 2, 1))
         return man_path
 
+    def _gc(self, keep: int) -> None:
+        """Keep-last-K retention over THIS prefix's snapshots.  The
+        ``_current``/``_best`` symlink targets are exempt no matter how
+        old — a walk-back restore needs the newest chain, and the best
+        checkpoint must outlive the window."""
+        snaps = list_snapshots(self.directory, prefix=self.prefix + "_")
+        if len(snaps) <= keep:
+            return
+        protected = set()
+        for link in ("_current", "_best"):
+            lp = os.path.join(self.directory,
+                              self.prefix + link + ".json")
+            if os.path.lexists(lp):
+                protected.add(os.path.realpath(lp))
+        removed = []
+        for ent in snaps[:-keep]:
+            if os.path.realpath(ent["path"]) in protected:
+                continue
+            npz = os.path.join(self.directory, ent["tensors"])
+            for p in (ent["path"], npz):
+                try:
+                    os.remove(p)
+                except OSError:
+                    pass
+            removed.append(ent["tag"])
+        if removed:
+            self.info("snapshot GC (keep-last-%d): removed %s", keep,
+                      ", ".join(removed))
+
     @staticmethod
-    def load(path: str) -> Dict[str, Any]:
+    def load(path: str, *, verify: bool = True) -> Dict[str, Any]:
         """Restore a checkpoint from its manifest path (or the _current/_best
         symlink), from a ``sqlite://db.sqlite#id`` URI written by
         SnapshotterToDB, or from an ``http(s)://`` manifest URL (reference:
         the CLI's http snapshot source, veles/__main__.py:539-589). Returns
         the payload with 'wstate' as numpy pytree; call ``jax.device_put``
-        (optionally with shardings) to place it."""
+        (optionally with shardings) to place it.
+
+        ``verify`` (filesystem manifests only) checks the tensors blob
+        against the manifest's recorded ``tensors_sha256``; any
+        integrity failure — checksum mismatch, truncated/unreadable
+        blob, unparseable manifest — raises
+        :class:`SnapshotCorruptError` so callers can walk back
+        (:func:`restore_with_walkback`) instead of crashing on, or
+        silently training from, torn bytes."""
         if path.startswith("sqlite://"):
             return SnapshotterToDB.load_uri(path)
         if path.startswith(("http://", "https://")):
             return Snapshotter._load_http(path)
-        with open(path) as f:
-            manifest = json.load(f)
+        try:
+            with open(path) as f:
+                manifest = json.load(f)
+            if not isinstance(manifest, dict) or "tensors" not in manifest:
+                raise SnapshotCorruptError(
+                    f"{path}: not a snapshot manifest")
+        except (json.JSONDecodeError, UnicodeDecodeError) as e:
+            raise SnapshotCorruptError(
+                f"{path}: unparseable manifest ({e})") from e
         npz_path = os.path.join(os.path.dirname(path), manifest["tensors"])
-        with np.load(npz_path, allow_pickle=False) as z:
-            flat = {k: z[k] for k in z.files}
+        want = manifest.get("tensors_sha256")
+        if verify and want:
+            try:
+                got = sha256_files([npz_path])
+            except OSError as e:
+                raise SnapshotCorruptError(
+                    f"{path}: tensors blob unreadable ({e})") from e
+            if got != want:
+                raise SnapshotCorruptError(
+                    f"{path}: tensors checksum mismatch (manifest "
+                    f"{want[:12]}…, blob {got[:12]}…)")
+        try:
+            with np.load(npz_path, allow_pickle=False) as z:
+                flat = {k: z[k] for k in z.files}
+        except (OSError, ValueError, EOFError,
+                zipfile.BadZipFile) as e:
+            raise SnapshotCorruptError(
+                f"{path}: tensors blob unreadable ({e})") from e
         payload = dict(manifest)
         payload["wstate"] = _unflatten(flat)
         return payload
@@ -224,18 +341,27 @@ class Snapshotter(Logger):
         import urllib.parse
         import urllib.request
         from ..config import root
+        from .deploy import http_retry  # late: deploy imports this module
         max_bytes = int(float(root.common.get(
             "snapshot_http_max_mb", 2048)) * 2**20)
-        with urllib.request.urlopen(url, timeout=30.0) as r:
-            manifest = json.loads(Snapshotter._read_capped(
-                r, Snapshotter._HTTP_MANIFEST_MAX_MB << 20,
-                f"snapshot manifest {url}",
-                "Snapshotter._HTTP_MANIFEST_MAX_MB"))
+
+        def fetch(u, limit, what, knob):
+            # connection errors / 5xx retry with the shared backoff
+            # shape; 4xx fail fast (a missing snapshot will not appear
+            # because we asked four times)
+            def once():
+                with urllib.request.urlopen(u, timeout=30.0) as r:
+                    return Snapshotter._read_capped(r, limit, what, knob)
+            return http_retry(once, what=what)
+
+        manifest = json.loads(fetch(
+            url, Snapshotter._HTTP_MANIFEST_MAX_MB << 20,
+            f"snapshot manifest {url}",
+            "Snapshotter._HTTP_MANIFEST_MAX_MB"))
         tensors_url = urllib.parse.urljoin(url, manifest["tensors"])
-        with urllib.request.urlopen(tensors_url, timeout=30.0) as r:
-            buf = io.BytesIO(Snapshotter._read_capped(
-                r, max_bytes, f"snapshot tensors {tensors_url}",
-                "root.common.snapshot_http_max_mb"))
+        buf = io.BytesIO(fetch(
+            tensors_url, max_bytes, f"snapshot tensors {tensors_url}",
+            "root.common.snapshot_http_max_mb"))
         with np.load(buf, allow_pickle=False) as z:
             flat = {k: z[k] for k in z.files}
         payload = dict(manifest)
@@ -430,6 +556,46 @@ def snapshot_checksum(path: str) -> str:
     except (OSError, KeyError, TypeError, ValueError,
             json.JSONDecodeError):
         return ""
+
+
+def restore_with_walkback(path: str) -> Tuple[Dict[str, Any], str, List[dict]]:
+    """Load the snapshot at ``path``; on corruption, walk back through the
+    retained snapshots in the same directory (newest → oldest by
+    ``saved_at``) to the newest VALID one.
+
+    Returns ``(payload, used_path, skipped)`` where ``skipped`` lists
+    ``{"path", "reason"}`` for every snapshot rejected on the way — the
+    caller logs them and feeds the count to the
+    ``snapshot_walkbacks`` gauge.  Raises :class:`SnapshotCorruptError`
+    when NOTHING in the directory loads.  Remote URIs (``sqlite://`` /
+    ``http(s)://``) have no sibling inventory to walk and load directly."""
+    if path.startswith(("sqlite://", "http://", "https://")):
+        return Snapshotter.load(path), path, []
+    skipped: List[dict] = []
+    target = os.path.realpath(path)
+    try:
+        # Only INTEGRITY failures of the named snapshot trigger the
+        # walk-back (load() wraps them all in SnapshotCorruptError); a
+        # missing path is most likely a typo, and silently restoring a
+        # sibling the operator never named would be worse than failing.
+        return Snapshotter.load(path), target, skipped
+    except SnapshotCorruptError as e:
+        skipped.append({"path": target, "reason": f"{type(e).__name__}: {e}"})
+    directory = os.path.dirname(path) or "."
+    seen = {target}
+    for ent in reversed(list_snapshots(directory)):
+        real = os.path.realpath(ent["path"])
+        if real in seen:
+            continue
+        seen.add(real)
+        try:
+            return Snapshotter.load(ent["path"]), real, skipped
+        except (SnapshotCorruptError, OSError, KeyError, ValueError) as e:
+            skipped.append(
+                {"path": real, "reason": f"{type(e).__name__}: {e}"})
+    raise SnapshotCorruptError(
+        f"no valid snapshot found in {directory!r}; rejected "
+        + "; ".join(f"{s['path']} ({s['reason']})" for s in skipped))
 
 
 def compare_snapshots(path_a: str, path_b: str) -> Dict[str, Any]:

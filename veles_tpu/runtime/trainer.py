@@ -24,10 +24,11 @@ from .. import prng
 from ..config import root
 from ..loader.base import TRAIN, VALID, TEST, Loader
 from ..logger import Logger, TraceContext
-from ..ops.optimizers import LR_MULT_KEY, Optimizer
+from ..ops.optimizers import (ANOM_CONSEC_KEY, LR_MULT_KEY, Optimizer,
+                              reserved_opt_neutral)
 from ..units.workflow import Workflow
 from .decision import Decision
-from .snapshotter import Snapshotter, _to_numpy
+from .snapshotter import (Snapshotter, _to_numpy, restore_with_walkback)
 from .step_cache import StepCache, enable_persistent_cache
 
 
@@ -38,8 +39,15 @@ def aggregate_epoch_metrics(sums: Dict[str, float]) -> Dict[str, float]:
         out["error_pct"] = 100.0 * sums["n_err"] / n
     if "mse_sum" in sums:
         out["rmse"] = float(np.sqrt(sums["mse_sum"] / n))
+    # per-batch means exclude sentinel-skipped steps (their metrics were
+    # zeroed in-graph): dividing by the raw batch count would bias the
+    # epoch loss low on any epoch with anomalies
+    trained = max(sums.get("n_batches", 0.0)
+                  - sums.get("anomaly_steps", 0.0), 1.0)
     if "loss" in sums and "n_batches" in sums:
-        out["loss"] = sums["loss"] / max(sums["n_batches"], 1.0)
+        out["loss"] = sums["loss"] / trained
+    if "grad_norm" in sums and "n_batches" in sums:
+        out["grad_norm"] = sums["grad_norm"] / trained
     return out
 
 
@@ -84,6 +92,11 @@ class Trainer(Logger):
         self._eval_entry = None
         self._best_wstate = None
         self.results: Dict[str, Any] = {}
+        # fault-tolerance gauges (docs/robustness.md): fed to
+        # StatusReporter every epoch and into results/bench output
+        self.anomaly_steps_skipped = 0
+        self.anomaly_rollbacks = 0
+        self.snapshot_walkbacks = 0
 
     # -- setup -------------------------------------------------------------
     def initialize(self, seed: Optional[int] = None,
@@ -320,6 +333,9 @@ class Trainer(Logger):
             train_mets = self._run_epoch_train(epoch)
             t_train = time.time()
             samples_done += int(train_mets.get("n_samples", 0))
+            # anomaly accounting + (possibly) rollback escalation BEFORE
+            # eval, so a rolled-back epoch validates the restored weights
+            self._check_anomalies(epoch, train_mets)
             valid_mets = self._run_epoch_eval(VALID, epoch)
             if root.common.timings:
                 # reference: per-unit/root.common.timings wall prints
@@ -341,10 +357,14 @@ class Trainer(Logger):
                 self.status.update(
                     epoch=epoch, best_value=self.decision.best_value,
                     best_epoch=self.decision.best_epoch,
+                    anomaly_steps_skipped=self.anomaly_steps_skipped,
+                    anomaly_rollbacks=self.anomaly_rollbacks,
+                    snapshot_walkbacks=self.snapshot_walkbacks,
                     **{f"valid_{k}": v for k, v in valid_mets.items()})
 
             if (self.decision.improved
-                    and self.decision.rollback_after is not None):
+                    and (self.decision.rollback_after is not None
+                         or self._anomaly_patience() > 0)):
                 # Host-side copy: train_step donates wstate buffers, so an
                 # on-device alias would reference deleted arrays by the time
                 # a rollback happens. (All hosts reach this branch — the
@@ -392,9 +412,94 @@ class Trainer(Logger):
             "epochs": epoch,
             "elapsed_s": elapsed,
             "train_samples_per_s": samples_done / max(elapsed, 1e-9),
+            "anomaly_steps_skipped": self.anomaly_steps_skipped,
+            "anomaly_rollbacks": self.anomaly_rollbacks,
+            "snapshot_walkbacks": self.snapshot_walkbacks,
             **{f"test_{k}": v for k, v in test_mets.items()},
         })
         return self.results
+
+    # -- anomaly sentinel escalation ----------------------------------------
+    def _anomaly_patience(self) -> int:
+        return int(root.common.train.get("anomaly_patience", 0) or 0)
+
+    def _check_anomalies(self, epoch: int, train_mets: Dict[str, float]
+                         ) -> None:
+        """Epoch-granularity half of the sentinel: accumulate the skip
+        count the in-graph guard already summed on device, and when the
+        traced consecutive-anomaly counter crosses
+        ``root.common.train.anomaly_patience``, escalate to the Decision
+        rollback ladder — restore the best/last-snapshot weights and
+        scale the traced lr multiplier down.  One small device_get per
+        epoch; the per-step path never syncs."""
+        skipped = int(train_mets.get("anomaly_steps", 0))
+        if skipped:
+            self.anomaly_steps_skipped += skipped
+            self.warning("epoch %d: %d anomalous step(s) skipped "
+                         "(non-finite loss/grad norm)", epoch, skipped)
+        patience = self._anomaly_patience()
+        if patience <= 0:
+            return
+        opt_state = (self.wstate or {}).get("opt_state")
+        if not isinstance(opt_state, dict) \
+                or ANOM_CONSEC_KEY not in opt_state:
+            return
+        consec = int(jax.device_get(opt_state[ANOM_CONSEC_KEY]))
+        if consec >= patience:
+            self._escalate_anomaly(epoch, consec)
+
+    def _escalate_anomaly(self, epoch: int, consec: int) -> None:
+        """The escalation rung above per-step skipping (reference:
+        "rollback to best snapshot on failure + lr change",
+        manualrst_veles_algorithms.rst:164 item 11): skipping alone can't
+        cure a persistently diverging run, so restore known-good weights
+        and train gentler.  Pure state writes — the compiled step
+        programs are untouched (ZERO recompiles, tests/test_faults.py)."""
+        self.anomaly_rollbacks += 1
+        dec = self.decision
+        dec.lr_multiplier *= dec.rollback_lr_scale
+        source = None
+        if self._best_wstate is not None:
+            self.wstate = Snapshotter.restore_wstate(
+                {"wstate": self._best_wstate}, like=self.wstate,
+                shardings=self._state_sh)
+            source = "in-memory best state"
+        elif self.snapshotter is not None \
+                and self.snapshotter.last_path is not None:
+            payload, used, skipped = restore_with_walkback(
+                self.snapshotter.last_path)
+            self._note_walkback(skipped)
+            self._adapt_reserved_opt_keys(payload)
+            self.wstate = Snapshotter.restore_wstate(
+                payload, like=self.wstate, shardings=self._state_sh)
+            source = used
+        else:
+            self.warning("anomaly escalation has no snapshot or best "
+                         "state to roll back to; keeping current params")
+        self.wstate = self._apply_lr_multiplier(self.wstate)
+        self.wstate = self._write_opt_scalars(
+            self.wstate, {ANOM_CONSEC_KEY: np.zeros((), np.int32)})
+        self.error(
+            "anomaly escalation at epoch %d: %d consecutive anomalous "
+            "steps >= patience %d — restored %s, lr multiplier now %g",
+            epoch, consec, self._anomaly_patience(),
+            source or "nothing", dec.lr_multiplier)
+        if self.status is not None:
+            self.status.record_event(
+                "anomaly_rollback", epoch=epoch, consecutive=consec,
+                lr_multiplier=dec.lr_multiplier,
+                restored=source or "none")
+
+    def _note_walkback(self, skipped) -> None:
+        if not skipped:
+            return
+        self.snapshot_walkbacks += len(skipped)
+        for s in skipped:
+            self.warning("snapshot walk-back: skipped %s (%s)",
+                         s["path"], s["reason"])
+        if self.status is not None:
+            self.status.record_event(
+                "snapshot_walkback", skipped=[s["path"] for s in skipped])
 
     # -- traced lr multiplier ----------------------------------------------
     def _apply_lr_multiplier(self, wstate):
@@ -411,15 +516,32 @@ class Trainer(Logger):
                     "%g NOT applied (optimizer-less workflow?)",
                     LR_MULT_KEY, mult)
             return wstate
-        leaf = jnp.asarray(mult, jnp.float32)
-        if self._state_sh is not None:
-            sh = self._state_sh["opt_state"][LR_MULT_KEY]
-            from ..parallel.distributed import (is_multihost,
-                                                place_global_state)
-            leaf = place_global_state(leaf, sh) if is_multihost() \
-                else jax.device_put(leaf, sh)
-        return {**wstate,
-                "opt_state": {**opt_state, LR_MULT_KEY: leaf}}
+        return self._write_opt_scalars(
+            wstate, {LR_MULT_KEY: np.asarray(mult, np.float32)})
+
+    def _write_opt_scalars(self, wstate, values: Dict[str, Any]):
+        """Host-side write of reserved opt_state scalars (the traced lr
+        multiplier and anomaly counters) under the live shardings —
+        the recompile-free state-mutation primitive all the rollback
+        paths share.  Keys absent from the state are skipped."""
+        opt_state = (wstate or {}).get("opt_state")
+        if not isinstance(opt_state, dict):
+            return wstate
+        placed = {}
+        for k, v in values.items():
+            if k not in opt_state:
+                continue
+            leaf = jnp.asarray(v)
+            if self._state_sh is not None:
+                sh = self._state_sh["opt_state"][k]
+                from ..parallel.distributed import (is_multihost,
+                                                    place_global_state)
+                leaf = place_global_state(leaf, sh) if is_multihost() \
+                    else jax.device_put(leaf, sh)
+            placed[k] = leaf
+        if not placed:
+            return wstate
+        return {**wstate, "opt_state": {**opt_state, **placed}}
 
     def effective_lr(self, step: int = 0) -> float:
         """The learning rate the compiled step applies at ``step``: the
@@ -449,12 +571,39 @@ class Trainer(Logger):
             "workflow_checksum": self.workflow.checksum(),
         }
 
+    def _adapt_reserved_opt_keys(self, payload: Dict[str, Any]) -> None:
+        """Bridge snapshot ↔ live reserved opt_state scalars: snapshots
+        predating the traced multiplier / anomaly counters get neutral
+        slots injected so the structural tree-map succeeds, and slots
+        the live state doesn't carry (sentinel disabled, optimizer-less
+        workflow) are dropped from the restored tree."""
+        saved = payload.get("wstate")
+        live_os = (self.wstate or {}).get("opt_state")
+        if not (isinstance(saved, dict) and isinstance(live_os, dict)
+                and isinstance(saved.get("opt_state"), dict)):
+            return
+        saved_os = saved["opt_state"]
+        for k, neutral in reserved_opt_neutral().items():
+            if k in live_os and k not in saved_os:
+                saved_os[k] = neutral
+            elif k in saved_os and k not in live_os:
+                del saved_os[k]
+
     def restore(self, path: str, *, force: bool = False) -> None:
         """Resume from a snapshot manifest (reference CLI restore path,
         veles/__main__.py:539-589). Checksum mismatch is fatal unless
         ``force`` (the reference validated the workflow checksum in its
-        distributed handshake, veles/server.py:478-492)."""
-        payload = Snapshotter.load(path)
+        distributed handshake, veles/server.py:478-492).
+
+        Filesystem snapshots verify the manifest's tensors sha256 and,
+        when the named snapshot is corrupt (truncated write, bit rot),
+        WALK BACK through the retained snapshots to the newest valid one
+        — logging every snapshot skipped and counting it in the
+        ``snapshot_walkbacks`` gauge (docs/robustness.md)."""
+        payload, used, skipped = restore_with_walkback(path)
+        self._note_walkback(skipped)
+        if skipped:
+            self.warning("restoring %s instead of corrupt %s", used, path)
         if self.wstate is None:
             self.initialize()
         if payload.get("workflow_checksum") != self.workflow.checksum():
@@ -464,16 +613,7 @@ class Trainer(Logger):
             if not force:
                 raise ValueError(msg + "; pass force=True to override")
             self.warning("%s — forcing restore", msg)
-        # Pre-change snapshots carry no traced-multiplier slot; inject a
-        # neutral one so the structural tree-map succeeds, then overwrite
-        # it from the restored decision below.
-        saved = payload.get("wstate")
-        live_os = self.wstate.get("opt_state")
-        if (isinstance(saved, dict) and isinstance(live_os, dict)
-                and LR_MULT_KEY in live_os
-                and isinstance(saved.get("opt_state"), dict)
-                and LR_MULT_KEY not in saved["opt_state"]):
-            saved["opt_state"][LR_MULT_KEY] = np.ones((), np.float32)
+        self._adapt_reserved_opt_keys(payload)
         self.wstate = Snapshotter.restore_wstate(payload, like=self.wstate,
                                                  shardings=self._state_sh)
         self.loader.set_state(payload["loader"])
