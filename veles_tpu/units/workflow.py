@@ -32,7 +32,7 @@ import jax
 import jax.numpy as jnp
 
 from ..logger import Logger, TraceContext
-from ..ops.optimizers import Optimizer
+from ..ops.optimizers import Optimizer, guarded_update, tree_select
 from .base import Context, Spec, Unit
 
 
@@ -210,11 +210,28 @@ class Workflow(Logger):
 
     # -- compiled steps ----------------------------------------------------
     def _build_step(self, optimizer: Optimizer) -> Callable:
-        """The pure (wstate, batch) -> (wstate, metrics) train function."""
+        """The pure (wstate, batch) -> (wstate, metrics) train function.
+
+        Carries the in-graph anomaly sentinel (``ops.optimizers.
+        guarded_update``): a non-finite loss or gradient norm skips the
+        whole update via a traced select — params, optimizer slots and
+        unit state carry through unchanged, the skip counters in
+        opt_state advance, and the step's metrics zero out so epoch
+        aggregates stay finite.  All of it is data flow inside the one
+        compiled program: no host sync per step, no recompile on a bad
+        step (docs/robustness.md)."""
         selfupd = [u for u in self.units if getattr(u, "self_updating", False)]
 
         aux_units = [u for u in self.units
                      if getattr(u, "has_aux_loss", False)]
+
+        # trace-time knobs: flipping them re-traces (a new build), so a
+        # running program's behavior never changes under its feet
+        from ..config import root
+        sentinel = bool(root.common.train.get("sentinel", True))
+        clip = float(root.common.train.get("clip_norm", 0.0) or 0.0)
+        from ..runtime.faults import get_plan  # late: avoids import cycle
+        inject = get_plan().nan_grad_at_step
 
         def step(wstate, batch):
             key, sub = jax.random.split(wstate["key"])
@@ -237,20 +254,42 @@ class Workflow(Logger):
 
                 grads, (outputs, nstate, mets) = jax.grad(
                     loss_fn, has_aux=True)(wstate["params"])
-                params, opt_state = optimizer.update(
-                    grads, wstate["opt_state"], wstate["params"],
-                    wstate["step"])
+                params, opt_state, ok, gnorm = guarded_update(
+                    optimizer, grads, wstate["opt_state"],
+                    wstate["params"], wstate["step"],
+                    outputs[self.evaluator.name], clip_norm=clip,
+                    sentinel=sentinel, inject_nan_steps=inject)
+                if ok is not None:
+                    # a skipped step contributes nothing to the epoch
+                    # aggregates (its loss/n_samples would be NaN or
+                    # meaningless) and one tick to the anomaly count
+                    mets = {k: jnp.where(ok, v, jnp.zeros_like(v))
+                            for k, v in mets.items()}
+                    mets["anomaly_steps"] = (~ok).astype(jnp.float32)
+                if gnorm is not None:
+                    # gated too: a skipped step's NaN norm must not
+                    # poison the epoch grad_norm aggregate
+                    mets["grad_norm"] = gnorm if ok is None \
+                        else jnp.where(ok, gnorm, 0.0)
             else:  # pure self-organizing workflows (SOM etc.)
                 outputs, nstate = self.forward(
                     wstate["params"], wstate["state"], batch, ctx)
                 mets = {}
                 params, opt_state = wstate["params"], wstate["opt_state"]
+                ok = None
 
             state = {**wstate["state"], **nstate}
             for u in selfupd:
                 xs = [outputs[s] for s in u.inputs]
                 state[u.name] = u.update_state(
                     params.get(u.name, {}), state.get(u.name, {}), xs, ctx)
+            if ok is not None:
+                # unit state (normalizer stats, recurrent carries, aux
+                # accumulators) also freezes on an anomalous step — the
+                # skip must be a complete no-op on the training state
+                state = {k: (tree_select(ok, v, wstate["state"][k])
+                             if k in wstate["state"] else v)
+                         for k, v in state.items()}
 
             nws = new_state(params, state, opt_state,
                             wstate["step"] + 1, key)
