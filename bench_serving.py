@@ -26,6 +26,14 @@ hot-swaps weights N times at decode-step boundaries — swap latency,
 dropped/errored requests (must be 0), and the p95 delta inside the
 swap windows are reported under "hot_swap".
 
+A fourth scenario ("artifact_vs_live") seals the model into a compiled
+artifact (export/compiled.py), cold-boots an ArtifactRunner
+(deserialize + AOT-compile the whole sealed inventory — zero model
+tracing), and drives the same mixed-shape workload: export time,
+cold-boot time, first-token latency, throughput vs the live engine at
+conc 4, and the compile counters (flat after boot) capture the
+"trained here, served there" path's trajectory.
+
 Prints ONE JSON line in the bench.py contract:
   {"metric": "serving_decode_tokens_per_sec", "value": N,
    "unit": "tokens/s", "vs_baseline": N, ...}
@@ -105,20 +113,21 @@ def main():
     eng = DecodeEngine(wf, ws, slots=SLOTS, l_max=L_MAX,
                        window_ms=1.0, queue_depth=len(work)).start()
 
-    def run_engine(conc):
+    def run_engine(conc, engine=None):
+        engine = engine if engine is not None else eng
         sem = threading.Semaphore(conc)
         lat = []
         lat_lock = threading.Lock()
         errs = []
-        st0 = eng.stats()
-        occ_sum0, steps0 = eng._occupancy_sum, st0["decode_steps"]
+        st0 = engine.stats()
+        occ_sum0, steps0 = engine._occupancy_sum, st0["decode_steps"]
 
         def worker(i):
             with sem:
                 p, n = work[i]
                 t = time.perf_counter()
                 try:
-                    eng.generate(p[None], n, timeout=600)
+                    engine.generate(p[None], n, timeout=600)
                 except Exception as e:  # noqa: BLE001
                     errs.append(repr(e))
                 with lat_lock:
@@ -132,14 +141,14 @@ def main():
         for t in threads:
             t.join()
         wall = time.perf_counter() - t0
-        dsteps = eng.stats()["decode_steps"] - steps0
+        dsteps = engine.stats()["decode_steps"] - steps0
         return {
             "concurrency": conc,
             "tokens_per_sec": round(total_tokens / wall, 1),
             "p50_latency_ms": round(1e3 * float(np.percentile(lat, 50)), 1),
             "p95_latency_ms": round(1e3 * float(np.percentile(lat, 95)), 1),
             "avg_slot_occupancy": round(
-                (eng._occupancy_sum - occ_sum0) / dsteps, 2) if dsteps
+                (engine._occupancy_sum - occ_sum0) / dsteps, 2) if dsteps
             else 0.0,
             "errors": errs,
         }, wall
@@ -210,6 +219,50 @@ def main():
                 eng.stats()["compile"]["compiles"] - compiles0,
         }
 
+    def run_artifact():
+        """Compiled-artifact leg (export/compiled.py): seal the model,
+        cold-boot an ArtifactRunner (deserialize + AOT-compile the
+        whole sealed inventory), then drive the SAME mixed-shape
+        workload — cold-boot time, first-token latency and the flat
+        compile counters are the trajectory numbers for the
+        "trained here, served there" path."""
+        import shutil
+        import tempfile
+        from veles_tpu.export import export_compiled
+        from veles_tpu.runtime.artifact import ArtifactRunner
+        art_dir = tempfile.mkdtemp(prefix="bench_art_")
+        try:
+            t0 = time.perf_counter()
+            export_compiled(wf, ws, art_dir, slots=SLOTS, l_max=L_MAX)
+            export_s = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            art = ArtifactRunner(art_dir, window_ms=1.0,
+                                 queue_depth=len(work)).start()
+            boot_s = time.perf_counter() - t0
+            boot = art.stats()["compile"]
+            try:
+                p, _ = work[0]
+                t0 = time.perf_counter()
+                art.generate(p[None], 1, timeout=600)
+                first_tok_ms = 1e3 * (time.perf_counter() - t0)
+                conc4, _ = run_engine(4, engine=art)
+                final = art.stats()["compile"]
+            finally:
+                art.stop()
+            return {
+                "export_s": round(export_s, 2),
+                "cold_boot_s": round(boot_s, 2),
+                "first_token_ms": round(first_tok_ms, 1),
+                "compiles_at_boot": boot["compiles"],
+                "compiles_after_load": final["compiles"]
+                - boot["compiles"],
+                "recompiles": final["recompiles"],
+                "conc4": conc4,
+                "vs_live_conc4": None,  # filled by the caller
+            }
+        finally:
+            shutil.rmtree(art_dir, ignore_errors=True)
+
     try:
         cold, cold_wall = run_engine(4)
         engine_endpoint_tps = total_tokens / (time.perf_counter() - t0)
@@ -219,12 +272,16 @@ def main():
         from veles_tpu.ops import optimizers as opt
         ws_b = wf.init_state(jax.random.key(1), opt.SGD(0.01))
         hot_swap = run_hot_swap(4, 4, ws["params"], ws_b["params"])
+        artifact = run_artifact()
         final = eng.stats()
     finally:
         eng.stop()
 
     best = max(sweep, key=lambda r: r["tokens_per_sec"])
     conc4 = next(r for r in sweep if r["concurrency"] == 4)
+    artifact["vs_live_conc4"] = round(
+        artifact["conc4"]["tokens_per_sec"]
+        / max(conc4["tokens_per_sec"], 1e-9), 3)
     out = {
         "metric": "serving_decode_tokens_per_sec",
         "value": best["tokens_per_sec"],
@@ -250,6 +307,7 @@ def main():
         },
         "sweep": sweep,
         "hot_swap": hot_swap,
+        "artifact_vs_live": artifact,
         "decode_recompiles": final["compile"]["recompiles"],
         "compiled_programs": final["compile"]["programs"],
         "engine_compile_wall_s": final["compile"]["compile_wall_s"],

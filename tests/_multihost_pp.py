@@ -1,0 +1,125 @@
+"""Worker for the two-process PIPELINE test (tests/test_multihost.py):
+a pp2 fused-1F1B step whose shard_map schedule spans the Gloo process
+boundary — stage 0 lives on host 0's device, stage 1 on host 1's, and
+the schedule's ppermute transports + cross-shard gradient psums run
+over DCN (loopback here).  This is the multi-chip-correctness frontier
+a single-process virtual mesh cannot certify (VERDICT #2): collective
+rendezvous across processes is exactly where schedules deadlock.
+
+Each host also computes the single-device AD reference LOCALLY (same
+init, same batch — both fixed-seed) and asserts the fused two-process
+step matches it exactly: loss to fp32 tolerance, updated params leaf
+for leaf.  The test process then cross-checks that both hosts dumped
+identical results."""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# ONE device per process: the pipe axis itself crosses the process
+# boundary (2 hosts x 1 device = the pp2 mesh).
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    workdir, pid, nproc, port = (sys.argv[1], int(sys.argv[2]),
+                                 int(sys.argv[3]), sys.argv[4])
+    from veles_tpu.parallel.distributed import initialize_distributed
+    initialize_distributed(f"127.0.0.1:{port}", nproc, pid)
+
+    import jax.numpy as jnp  # noqa: E402
+    import veles_tpu as vt
+    from veles_tpu.models.standard import StandardWorkflow
+    from veles_tpu.parallel import MeshSpec, make_mesh
+    from veles_tpu.parallel.distributed import (gather_to_host,
+                                                place_global_state)
+
+    assert jax.process_count() == nproc
+    assert len(jax.devices()) == nproc  # one device per host
+
+    S, B, T, V, E = 2, 8, 8, 12, 16
+    stage = [{"type": "attention", "n_heads": 2, "rope": True,
+              "residual": True},
+             {"type": "layer_norm"}]
+    cfg = {
+        "name": "mh_pp",
+        "layers": [
+            {"type": "embedding", "vocab": V, "dim": E, "name": "emb"},
+            {"type": "pipeline_stack", "stages": [stage] * S,
+             "n_microbatches": S, "name": "stack"},
+            {"type": "seq_last", "name": "last"},
+            {"type": "softmax", "output_size": V, "name": "out"},
+        ],
+        "optimizer": "sgd",
+        "optimizer_args": {"lr": 0.1},
+        "pipeline_microbatches": S,
+    }
+
+    def build():
+        sw = StandardWorkflow(cfg)
+        wf = sw.workflow
+        specs = {"@input": vt.Spec((B, T), jnp.int32),
+                 "@labels": vt.Spec((B,), jnp.int32),
+                 "@mask": vt.Spec((B,), jnp.float32)}
+        wf.build(specs)
+        return sw, wf, specs
+
+    rng = np.random.default_rng(1234)  # identical on both hosts
+    x = rng.integers(0, V, (B, T)).astype(np.int32)
+    batch = {"@input": x,
+             "@labels": x[:, -1].astype(np.int32),
+             "@mask": np.ones((B,), np.float32)}
+
+    # -- fused 1F1B across the two processes --------------------------------
+    mesh = make_mesh(MeshSpec(pipe=S))  # 2 global devices, 1 per host
+    sw, wf, specs = build()
+    ws0 = wf.init_state(jax.random.key(0), sw.optimizer)
+    step_pp, state_sh, batch_sh = wf.make_pipeline_train_step(
+        sw.optimizer, mesh, ws0, specs, n_microbatches=S, donate=False)
+    ws_g = place_global_state(ws0, state_sh)
+    batch_g = place_global_state(batch, batch_sh) \
+        if batch_sh is not None else batch
+    ws_pp, mets_pp = step_pp(ws_g, batch_g)
+    loss_pp = float(mets_pp["loss"])
+
+    # -- single-device AD reference (local to each host) --------------------
+    sw2, wf2, _ = build()
+    ws_ad0 = wf2.init_state(jax.random.key(0), sw2.optimizer)
+    step_ad = wf2.make_train_step(sw2.optimizer, donate=False)
+    ws_ad, mets_ad = step_ad(ws_ad0, {k: jnp.asarray(v)
+                                      for k, v in batch.items()})
+    loss_ad = float(mets_ad["loss"])
+
+    np.testing.assert_allclose(loss_pp, loss_ad, rtol=2e-5)
+    pp_params = gather_to_host(ws_pp["params"])
+    fp = {jax.tree_util.keystr(p): np.asarray(v) for p, v in
+          jax.tree_util.tree_leaves_with_path(pp_params)}
+    fa = {jax.tree_util.keystr(p): np.asarray(v) for p, v in
+          jax.tree_util.tree_leaves_with_path(
+              jax.device_get(ws_ad["params"]))}
+    assert fp.keys() == fa.keys()
+    for k in fp:
+        np.testing.assert_allclose(fp[k], fa[k], rtol=2e-4, atol=2e-5,
+                                   err_msg=k)
+
+    # dump for the host-side cross-check (both files must agree bitwise)
+    emb = fp["['emb']['table']"] if "['emb']['table']" in fp else \
+        next(iter(fp.values()))
+    np.save(os.path.join(workdir, f"pp_emb_host{pid}.npy"), emb)
+    with open(os.path.join(workdir, f"pp_host{pid}.json"), "w") as f:
+        json.dump({"loss_pp": loss_pp, "loss_ad": loss_ad,
+                   "n_leaves": len(fp)}, f)
+    print(f"PP HOST {pid} DONE", flush=True)
+
+
+if __name__ == "__main__":
+    main()

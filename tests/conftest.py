@@ -22,6 +22,16 @@ jax.config.update("jax_platforms", "cpu")
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
+#: Gate for shard_map grad-exactness tests (`from conftest import
+#: NEEDS_VMA`): the jax.experimental fallback the parallel/mesh.py
+#: `shard_map` shim selects on jax < 0.5 predates the check_vma
+#: AD-transpose semantics those tests pin, and the schedules run
+#: minutes-scale on the forced-host CPU mesh — they run wherever the
+#: public jax.shard_map exists.
+NEEDS_VMA = pytest.mark.skipif(
+    not hasattr(jax, "shard_map"),
+    reason="needs the public jax.shard_map (check_vma AD semantics)")
+
 
 def pytest_configure(config):
     config.addinivalue_line(
@@ -29,6 +39,9 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "faults: fault-injection / robustness tests (tier-1; "
         "select alone with -m faults)")
+    config.addinivalue_line(
+        "markers", "artifact: compiled-artifact export/runner tests "
+        "(tier-1; select alone with -m artifact)")
 
 
 @pytest.fixture(autouse=True)

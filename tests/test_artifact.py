@@ -1,0 +1,579 @@
+"""Compiled-artifact subsystem (export/compiled.py + runtime/artifact.py):
+export -> load -> serve must be golden against the live engine AND the
+C++ runtime, integrity failures must raise the snapshot corruption
+error, version skew must fail with a re-export message, and the deploy
+control plane must hot-swap artifact weights with flat compile
+counters under concurrent load."""
+
+import json
+import os
+import subprocess
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import veles_tpu as vt
+from veles_tpu.export import export_compiled, load_package, manifest_summary
+from veles_tpu.export.compiled import MANIFEST
+from veles_tpu.models.standard import build_workflow
+from veles_tpu.ops import optimizers as opt
+from veles_tpu.runtime.artifact import (ArtifactError, ArtifactRunner,
+                                        ArtifactVersionError,
+                                        load_artifact_weights,
+                                        load_forward)
+from veles_tpu.runtime.deploy import DeployController
+from veles_tpu.runtime.engine import DecodeEngine
+from veles_tpu.runtime.generate import generate
+from veles_tpu.runtime.snapshotter import (SnapshotCorruptError,
+                                           sha256_files)
+
+pytestmark = pytest.mark.artifact
+
+V, T = 12, 6
+SLOTS, L_MAX = 3, 48
+
+#: The flagship LM shape: GQA + RoPE + window attention, layer_norm,
+#: FFN, a second attention — the chain the C++ goldens already pin
+#: (tests/test_serving.py::test_cpp_generate_matches_jax).
+LAYERS = [
+    {"type": "embedding", "vocab": V, "dim": 16, "name": "emb"},
+    {"type": "attention", "n_heads": 4, "n_kv_heads": 2, "rope": True,
+     "residual": True, "window": 5, "name": "a1"},
+    {"type": "layer_norm", "name": "n1"},
+    {"type": "ffn", "d_hidden": 32, "name": "f1"},
+    {"type": "attention", "n_heads": 2, "rope": True,
+     "residual": True, "name": "a2"},
+    {"type": "seq_last", "name": "last"},
+    {"type": "softmax", "output_size": V, "name": "out"},
+]
+
+SERVING_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "serving")
+
+
+def _build_lm(seed=21):
+    wf = build_workflow("art_lm", LAYERS)
+    wf.build({"@input": vt.Spec((2, T), jnp.int32),
+              "@labels": vt.Spec((2,), jnp.int32),
+              "@mask": vt.Spec((2,), jnp.float32)})
+    ws = wf.init_state(jax.random.key(seed), opt.SGD(0.01))
+    return wf, ws
+
+
+@pytest.fixture(scope="module")
+def exported(tmp_path_factory):
+    """One export pays for the module: (wf, ws, artifact_dir,
+    manifest)."""
+    tmp = tmp_path_factory.mktemp("artifact")
+    wf, ws = _build_lm()
+    art = str(tmp / "art")
+    man = export_compiled(wf, ws, art, slots=SLOTS, l_max=L_MAX,
+                          eos_id=0)
+    return wf, ws, art, man
+
+
+@pytest.fixture(scope="module")
+def runner(exported):
+    wf, ws, art, man = exported
+    r = ArtifactRunner(art, window_ms=0.0).start()
+    yield r
+    r.stop()
+
+
+def test_manifest_records_the_sealed_program_set(exported):
+    wf, ws, art, man = exported
+    assert man["workflow_checksum"] == wf.checksum()
+    assert man["slots"] == SLOTS and man["l_max"] == L_MAX
+    assert man["vocab"] == V and man["eos_id"] == 0
+    assert man["buckets"] == [16, 32, 48]
+    progs = man["programs"]
+    assert set(progs) == {"forward", "decode", "prefill"}
+    assert sorted(progs["prefill"]) == ["16", "32", "48"]
+    for rel, sha in [(progs["decode"]["file"],
+                      progs["decode"]["sha256"])] + [
+            (q["file"], q["sha256"]) for q in progs["prefill"].values()]:
+        assert sha256_files([os.path.join(art, rel)]) == sha
+    # the summary names every program file (the CLI's --compiled print)
+    summ = manifest_summary(man)
+    assert len(summ["programs"]) == 5
+    assert summ["checksum"] == wf.checksum()[:12]
+
+
+def test_roundtrip_greedy_golden_and_flat_counters(exported, runner, rng):
+    """The acceptance core: greedy tokens through the deserialized
+    StableHLO programs are bitwise the live ``generate()``'s, across
+    mixed shapes, with ZERO compiles after boot."""
+    wf, ws, art, man = exported
+    boot_compiles = runner.stats()["compile"]["compiles"]
+    # boot compiled the whole inventory: decode + every prefill +
+    # forward, nothing else, no recompiles
+    assert boot_compiles == 2 + len(man["buckets"])
+    # one shape per prefill bucket (16/32/48) — every sealed program
+    # gets a golden pass without paying a generate() scan compile per
+    # extra shape
+    for p, n in [(3, 5), (21, 4), (40, 6)]:
+        prompt = rng.integers(0, V, (1, p)).astype(np.int32)
+        ref = np.asarray(generate(wf, ws, prompt, n))
+        got = runner.generate(prompt, n, timeout=180)
+        np.testing.assert_array_equal(got, ref, err_msg=f"P={p}")
+    st = runner.stats()
+    assert st["compile"]["compiles"] == boot_compiles, st["compile"]
+    assert st["compile"]["recompiles"] == 0
+    assert st["artifact"]["programs"] == 2 + len(man["buckets"])
+
+
+def test_roundtrip_sampled_single_row_bitwise(exported, runner, rng):
+    """Sampled decode (temperature + filters) through the artifact is
+    bitwise the library path for single-row requests with the same
+    key — the engine's own parity contract survives serialization."""
+    wf, ws, art, man = exported
+    prompt = rng.integers(0, V, (1, 5)).astype(np.int32)
+    key = jax.random.key(7)
+    ref = np.asarray(generate(wf, ws, prompt, 6, temperature=0.8,
+                              top_k=5, top_p=0.9, key=key))
+    got = runner.generate(prompt, 6, temperature=0.8, top_k=5,
+                          top_p=0.9, key=key, timeout=180)
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_forward_program_matches_predict(exported, runner, rng):
+    wf, ws, art, man = exported
+    x = rng.integers(0, V, (2, T)).astype(np.int32)
+    ref = np.asarray(wf.make_predict_step("out")(
+        ws, {"@input": jnp.asarray(x)}))
+    got = np.asarray(runner.predict(runner.wstate,
+                                    {"@input": jnp.asarray(x)}))
+    np.testing.assert_array_equal(got, ref)
+
+
+@pytest.fixture(scope="module")
+def binary():
+    r = subprocess.run(["make", "-s"], cwd=SERVING_DIR,
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stderr
+    return os.path.join(SERVING_DIR, "veles_serve")
+
+
+def test_tri_runtime_greedy_golden(exported, runner, binary, tmp_path,
+                                   rng):
+    """The flagship acceptance bar: bitwise-identical greedy tokens
+    through (a) live generate(), (b) the ArtifactRunner's deserialized
+    programs, and (c) the C++ native runtime on the package export of
+    the SAME weights."""
+    wf, ws, art, man = exported
+    N = 7
+    prompt = rng.integers(0, V, (2, T)).astype(np.int32)
+    ref = np.asarray(generate(wf, ws, prompt, N))                 # (a)
+
+    got_art = runner.generate(prompt, N, timeout=180)             # (b)
+    np.testing.assert_array_equal(got_art, ref)
+
+    from veles_tpu.export import export_package
+    pkg = str(tmp_path / "pkg")
+    export_package(wf, ws, pkg,
+                   input_spec={"shape": [2, T], "dtype": "float32"})
+    np.save(tmp_path / "p.npy", prompt.astype(np.float32))
+    r = subprocess.run(
+        [binary, pkg, str(tmp_path / "p.npy"), str(tmp_path / "t.npy"),
+         "--generate", str(N)],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    got_cpp = np.load(tmp_path / "t.npy").astype(np.int32)        # (c)
+    np.testing.assert_array_equal(got_cpp, ref)
+
+
+# -- integrity / version discipline -----------------------------------------
+
+def _copy_artifact(src, dst):
+    import shutil
+    shutil.copytree(src, dst)
+    return str(dst)
+
+
+def _flip_byte(path, offset=100):
+    with open(path, "rb+") as f:
+        f.seek(offset)
+        b = f.read(1)
+        f.seek(offset)
+        f.write(bytes([b[0] ^ 0xFF]))
+
+
+def test_corrupt_tensors_raises_snapshot_corrupt(exported, tmp_path):
+    wf, ws, art, man = exported
+    bad = _copy_artifact(art, tmp_path / "bad_tensors")
+    _flip_byte(os.path.join(bad, "tensors.npz"))
+    with pytest.raises(SnapshotCorruptError, match="checksum mismatch"):
+        ArtifactRunner(bad)
+    # the weights-only loader (the deploy swap path) verifies too
+    with pytest.raises(SnapshotCorruptError, match="checksum mismatch"):
+        load_artifact_weights(bad)
+
+
+def test_corrupt_program_raises_snapshot_corrupt(exported, tmp_path):
+    wf, ws, art, man = exported
+    bad = _copy_artifact(art, tmp_path / "bad_prog")
+    _flip_byte(os.path.join(bad, "programs", "decode.bin"))
+    with pytest.raises(SnapshotCorruptError, match="checksum mismatch"):
+        ArtifactRunner(bad)
+
+
+def test_damaged_manifest_raises_snapshot_corrupt(exported, tmp_path):
+    """A parseable-but-damaged manifest (valid JSON, structural keys
+    gone) is corruption too — the named error, not a bare KeyError from
+    the first ``man["tensors"]``."""
+    import shutil
+    wf, ws, art, man = exported
+    for damage in (lambda d: d.pop("tensors"),
+                   lambda d: d["programs"]["decode"].pop("file"),
+                   lambda d: d["programs"]["prefill"].update(x=3),
+                   lambda d: d["programs"]["prefill"].update(
+                       {"1x6": {"file": "programs/decode.bin"}}),
+                   lambda d: d.pop("slots"),
+                   lambda d: d.pop("input_spec")):
+        bad = _copy_artifact(art, tmp_path / "bad_man")
+        mp = os.path.join(bad, MANIFEST)
+        doc = json.load(open(mp))
+        damage(doc)
+        json.dump(doc, open(mp, "w"))
+        with pytest.raises(SnapshotCorruptError, match="damaged"):
+            ArtifactRunner(bad)
+        shutil.rmtree(bad)
+
+
+def test_version_skew_fails_with_reexport_message(exported, tmp_path):
+    """A serialized program from a newer jax.export calling convention
+    must fail BEFORE deserializing, naming both versions and the fix
+    (re-export) — not crash inside the flatbuffer parser."""
+    wf, ws, art, man = exported
+    bad = _copy_artifact(art, tmp_path / "bad_ver")
+    mp = os.path.join(bad, MANIFEST)
+    doc = json.load(open(mp))
+    doc["programs"]["decode"]["calling_convention_version"] = 9999
+    json.dump(doc, open(mp, "w"))
+    with pytest.raises(ArtifactVersionError, match="re-export"):
+        ArtifactRunner(bad)
+
+
+def test_newer_format_version_refused(exported, tmp_path):
+    """A manifest from a future format revision must refuse loudly at
+    read time, not boot on a misread schema."""
+    wf, ws, art, man = exported
+    bad = _copy_artifact(art, tmp_path / "bad_fmt")
+    mp = os.path.join(bad, MANIFEST)
+    doc = json.load(open(mp))
+    doc["format_version"] = 99
+    json.dump(doc, open(mp, "w"))
+    with pytest.raises(ArtifactVersionError, match="format version 99"):
+        ArtifactRunner(bad)
+    with pytest.raises(ArtifactVersionError, match="format version 99"):
+        load_artifact_weights(bad)
+
+
+def test_undeserializable_program_clear_error(exported, tmp_path):
+    """Bytes that pass the checksum but aren't a replayable program
+    (producer/consumer skew, not transit corruption) also land on the
+    version error with the re-export hint."""
+    wf, ws, art, man = exported
+    bad = _copy_artifact(art, tmp_path / "bad_bytes")
+    prog = os.path.join(bad, "programs", "decode.bin")
+    with open(prog, "wb") as f:
+        f.write(b"not a stablehlo program")
+    mp = os.path.join(bad, MANIFEST)
+    doc = json.load(open(mp))
+    doc["programs"]["decode"]["sha256"] = sha256_files([prog])
+    json.dump(doc, open(mp, "w"))
+    with pytest.raises(ArtifactVersionError, match="re-export"):
+        ArtifactRunner(bad)
+
+
+def test_not_an_artifact_dir(tmp_path):
+    with pytest.raises(ArtifactError, match="not a compiled artifact"):
+        ArtifactRunner(str(tmp_path))
+
+
+def test_out_of_vocab_eos_rejected_at_export(tmp_path):
+    """A sealed eos_id becomes the serving default — exporting one
+    outside the model's vocabulary would 400 every /generate of the
+    artifact, so it must fail the EXPORT (and leave no artifact)."""
+    wf, ws = _build_lm()
+    out = str(tmp_path / "art")
+    with pytest.raises(ValueError, match="outside the exported"):
+        export_compiled(wf, ws, out, slots=2, l_max=16, eos_id=V)
+    assert not os.path.exists(os.path.join(out, MANIFEST))
+    assert not any(f.endswith(".tmp") for _, _, fs in os.walk(out)
+                   for f in fs)
+
+    # serving bounds eos by the INPUT embedding rows, so a head wider
+    # than the embedding must not smuggle a default the server rejects
+    wf2 = build_workflow("art_wide_head", [
+        {"type": "embedding", "vocab": 8, "dim": 16, "name": "emb"},
+        {"type": "attention", "n_heads": 2, "rope": True,
+         "residual": True, "name": "a1"},
+        {"type": "seq_last", "name": "last"},
+        {"type": "softmax", "output_size": V, "name": "out"},
+    ])
+    wf2.build({"@input": vt.Spec((2, T), jnp.int32),
+               "@labels": vt.Spec((2,), jnp.int32),
+               "@mask": vt.Spec((2,), jnp.float32)})
+    ws2 = wf2.init_state(jax.random.key(5), opt.SGD(0.01))
+    with pytest.raises(ValueError, match=r"\[0, 8\)"):
+        export_compiled(wf2, ws2, str(tmp_path / "art2"), slots=2,
+                        l_max=16, eos_id=10)
+
+
+def test_forward_only_artifact(tmp_path, rng):
+    """A non-decodable chain exports forward-only: the manifest records
+    why, ArtifactRunner refuses with a pointer to load_forward, and the
+    forward leg golden-matches predict."""
+    wf = build_workflow("art_fc", [
+        {"type": "all2all_tanh", "output_size": 8, "name": "fc1"},
+        {"type": "softmax", "output_size": 4, "name": "out"},
+    ])
+    wf.build({"@input": vt.Spec((4, 6), jnp.float32),
+              "@labels": vt.Spec((4,), jnp.int32),
+              "@mask": vt.Spec((4,), jnp.float32)})
+    ws = wf.init_state(jax.random.key(5), opt.SGD(0.1))
+    art = str(tmp_path / "fc_art")
+    man = export_compiled(wf, ws, art)
+    assert "decode" not in man["programs"]
+    assert "decode_unsupported" in man
+    with pytest.raises(ArtifactError, match="load_forward"):
+        ArtifactRunner(art)
+    predict, wstate, _ = load_forward(art)
+    x = rng.standard_normal((4, 6)).astype(np.float32)
+    ref = np.asarray(wf.make_predict_step("out")(
+        ws, {"@input": jnp.asarray(x)}))
+    np.testing.assert_array_equal(
+        np.asarray(predict(wstate, {"@input": jnp.asarray(x)})), ref)
+
+
+def test_cache_free_chain_roundtrip(tmp_path, rng):
+    """A decodable chain with NO cached state (no attention/recurrent
+    units): the manifest's cache rows are a structural marker only and
+    the runner must rebuild an EMPTY cache tree, AOT-compile at boot,
+    and serve golden tokens (regression: the empty-dict marker used to
+    rebuild as a one-child tree and crash the scheduler on the first
+    request)."""
+    wf = build_workflow("art_nocache", [
+        {"type": "embedding", "vocab": V, "dim": 8, "name": "emb"},
+        {"type": "ffn", "d_hidden": 16, "name": "f1"},
+        {"type": "seq_last", "name": "last"},
+        {"type": "softmax", "output_size": V, "name": "out"},
+    ])
+    wf.build({"@input": vt.Spec((2, T), jnp.int32),
+              "@labels": vt.Spec((2,), jnp.int32),
+              "@mask": vt.Spec((2,), jnp.float32)})
+    ws = wf.init_state(jax.random.key(11), opt.SGD(0.1))
+    art = str(tmp_path / "nc")
+    man = export_compiled(wf, ws, art, slots=2, l_max=16, bucket_min=8)
+    assert "decode" in man["programs"]
+    r = ArtifactRunner(art, window_ms=0.0).start()
+    try:
+        boot = r.stats()["compile"]["compiles"]
+        prompt = rng.integers(0, V, (1, 4)).astype(np.int32)
+        ref = np.asarray(generate(wf, ws, prompt, 4))
+        np.testing.assert_array_equal(
+            r.generate(prompt, 4, timeout=180), ref)
+        assert r.stats()["compile"]["compiles"] == boot
+    finally:
+        r.stop()
+
+
+# -- deploy control plane ----------------------------------------------------
+
+def test_live_engine_hot_swaps_artifact_weights_flat_compiles(
+        exported, tmp_path, rng):
+    """DeployController moves a LIVE engine onto an artifact's weights
+    under concurrent load: zero drops, compile counters flat, the
+    registry entry carries kind='artifact', and post-swap greedy
+    matches generate() on the artifact's weights."""
+    wf, ws_a, art_a, _ = exported
+    wf_b, ws_b = _build_lm(seed=77)            # same arch, new weights
+    art_b = str(tmp_path / "art_b")
+    export_compiled(wf_b, ws_b, art_b, slots=SLOTS, l_max=L_MAX)
+
+    eng = DecodeEngine(wf, ws_a, slots=SLOTS, l_max=L_MAX,
+                       window_ms=0.0).start()
+    deploy = DeployController(engine=eng)
+    shapes = [(3, 4), (7, 3), (11, 5)]
+    prompts = [rng.integers(0, V, (1, p)).astype(np.int32)
+               for p, _ in shapes]
+    try:
+        for pr, (_, n) in zip(prompts, shapes):  # warm every bucket
+            eng.generate(pr, n, timeout=180)
+        compiles = eng.stats()["compile"]["compiles"]
+        errs, done = [], []
+        stop = threading.Event()
+
+        def worker(i):
+            while not stop.is_set():
+                try:
+                    done.append(eng.generate(prompts[i], shapes[i][1],
+                                             timeout=180).shape)
+                except Exception as e:  # noqa: BLE001
+                    errs.append(repr(e))
+                    return
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(len(shapes))]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 120
+        while len(done) < 3:
+            assert time.monotonic() < deadline, (done, errs)
+            time.sleep(0.01)
+        res = deploy.reload(f"artifact://{art_b}")
+        assert res["compiles_during_swap"] == 0
+        while len(done) < 8:  # keeps serving on the artifact weights
+            assert time.monotonic() < deadline, (done, errs)
+            time.sleep(0.01)
+        stop.set()
+        for t in threads:
+            t.join(timeout=240)
+        assert not errs, errs
+        st = eng.stats()
+        assert st["compile"]["compiles"] == compiles, st["compile"]
+        entry = deploy.registry.active
+        assert entry["kind"] == "artifact"
+        assert entry["source"] == f"artifact://{art_b}"
+        ref = np.asarray(generate(wf_b, ws_b, prompts[0], shapes[0][1]))
+        np.testing.assert_array_equal(
+            eng.generate(prompts[0], shapes[0][1], timeout=180), ref)
+    finally:
+        eng.stop()
+
+
+def test_artifact_runner_hot_swap_under_load(exported, rng):
+    """The sealed runner itself hot-swaps weights (same-architecture)
+    with its deserialized programs untouched: counters flat across the
+    swap under concurrent load, and the deploy boot source registers
+    kind='artifact'."""
+    wf, ws_a, art, _ = exported
+    _, ws_b = _build_lm(seed=31)
+    r = ArtifactRunner(art, window_ms=0.0).start()
+    deploy = DeployController(engine=r,
+                              boot_source=f"artifact://{art}")
+    prompt = rng.integers(0, V, (1, 5)).astype(np.int32)
+    try:
+        assert deploy.registry.active["kind"] == "artifact"
+        r.generate(prompt, 4, timeout=180)
+        compiles = r.stats()["compile"]["compiles"]
+        errs, done = [], []
+        stop = threading.Event()
+
+        def worker():
+            while not stop.is_set():
+                try:
+                    done.append(len(r.generate(prompt, 4, timeout=180)))
+                except Exception as e:  # noqa: BLE001
+                    errs.append(repr(e))
+                    return
+
+        threads = [threading.Thread(target=worker) for _ in range(2)]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 120
+        while len(done) < 2:
+            assert time.monotonic() < deadline, (done, errs)
+            time.sleep(0.01)
+        r.swap_params(ws_b["params"])
+        while len(done) < 6:
+            assert time.monotonic() < deadline, (done, errs)
+            time.sleep(0.01)
+        stop.set()
+        for t in threads:
+            t.join(timeout=240)
+        assert not errs, errs
+        st = r.stats()
+        assert st["compile"]["compiles"] == compiles, st["compile"]
+        assert st["compile"]["recompiles"] == 0
+        assert st["swaps"] == 1
+        # the swapped weights serve bitwise like the library path
+        ref = np.asarray(generate(wf, ws_b, prompt, 4))
+        np.testing.assert_array_equal(
+            r.generate(prompt, 4, timeout=180), ref)
+    finally:
+        r.stop()
+
+
+def test_artifact_rejects_foreign_workflow(exported, tmp_path):
+    """An artifact exported from a DIFFERENT architecture is refused by
+    the checksum guard with the old version still serving."""
+    wf, ws, art, _ = exported
+    wf2 = build_workflow("other_lm", [
+        {"type": "embedding", "vocab": V, "dim": 16, "name": "emb"},
+        {"type": "seq_last", "name": "last"},
+        {"type": "softmax", "output_size": V, "name": "out"},
+    ])
+    wf2.build({"@input": vt.Spec((2, T), jnp.int32),
+               "@labels": vt.Spec((2,), jnp.int32),
+               "@mask": vt.Spec((2,), jnp.float32)})
+    ws2 = wf2.init_state(jax.random.key(1), opt.SGD(0.1))
+    art2 = str(tmp_path / "foreign")
+    # tiny geometry: the guard fires on the manifest checksum, long
+    # before any program would load — no need to pay big exports here
+    export_compiled(wf2, ws2, art2, slots=1, l_max=8, bucket_min=8)
+    eng = DecodeEngine(wf, ws, slots=1, l_max=16, window_ms=0.0)
+    deploy = DeployController(engine=eng)
+    with pytest.raises(ValueError, match="different\\s+workflow"):
+        deploy.reload(art2)
+    assert deploy.registry.active_version == 1  # boot still active
+
+
+def test_forge_stores_and_serves_artifact(exported, tmp_path, rng):
+    """An artifact directory uploads to a ForgeStore like any package
+    and hot-swaps back out via forge:// with kind='forge' — store/fetch
+    parity for the compiled leg."""
+    from veles_tpu.forge.store import ForgeStore
+    wf, ws, art, man = exported
+    store = ForgeStore(str(tmp_path / "store"))
+    store.add(ForgeStore.pack_dir(art, {
+        "name": "art_lm", "workflow": "art_lm",
+        "configuration": "compiled-artifact"}))
+    eng = DecodeEngine(wf, ws, slots=SLOTS, l_max=L_MAX,
+                       window_ms=0.0).start()
+    deploy = DeployController(engine=eng)
+    try:
+        res = deploy.reload(f"forge://{store.root_dir}/art_lm")
+        assert res["active"]["kind"] == "forge"
+        assert res["active"]["source"].endswith("@1")
+        assert res["compiles_during_swap"] == 0
+        prompt = rng.integers(0, V, (1, 4)).astype(np.int32)
+        ref = np.asarray(generate(wf, ws, prompt, 4))
+        np.testing.assert_array_equal(
+            eng.generate(prompt, 4, timeout=180), ref)
+    finally:
+        eng.stop()
+
+
+def test_rest_serving_without_workflow(exported, runner):
+    """The REST layer serves a workflow-less (artifact) engine: decode
+    works, vocab bounds come from the manifest, the manifest's sealed
+    eos_id is the server default for requests that don't name one, and
+    beam search is refused with a clear pointer instead of an
+    AttributeError."""
+    from veles_tpu.runtime.restful import RestfulServer
+    wf, ws, art, man = exported
+    srv = RestfulServer(
+        runner.predict, runner.wstate, 2, (T,), workflow=None,
+        engine=runner, input_dtype=np.int32,
+        default_eos_id=man["eos_id"])
+    try:
+        out = srv.decode({"prompt": [[1, 2, 3]], "steps": 3})
+        assert len(out["tokens"][0]) == 6
+        # the sealed eos (0) governs default decode — parity with the
+        # live path ASKED for that eos, not the eos-less one
+        ref = np.asarray(generate(wf, ws,
+                                  np.array([[1, 2, 3]], np.int32), 3,
+                                  eos_id=man["eos_id"]))
+        np.testing.assert_array_equal(np.asarray(out["tokens"]), ref)
+        with pytest.raises(ValueError, match="in \\[0"):
+            srv.decode({"prompt": [[V + 5]], "steps": 2})
+        with pytest.raises(ValueError, match="live workflow"):
+            srv.decode({"prompt": [[1]], "steps": 2, "beams": 3})
+    finally:
+        srv.httpd.server_close()

@@ -423,6 +423,112 @@ def test_cli_export_mode(tmp_path):
     assert np.load(tmp_path / "y.npy").shape == (50, 10)
 
 
+@pytest.mark.artifact
+def test_cli_artifact_flag_guards(tmp_path):
+    """The compiled-artifact CLI combinations fail loudly, not
+    silently: --compiled modifies --export, --artifact needs --serve,
+    and a config/--export/--snapshot cannot ride along with --artifact
+    (the sealed programs are the whole input).  All guards fire before
+    any model work, so main() runs in-process (no subprocess boots)."""
+    from veles_tpu.__main__ import main
+    cfg = tmp_path / "lm.json"
+    cfg.write_text(json.dumps(LM_CONFIG_JSON))
+
+    def rejects(argv, needle):
+        with pytest.raises(SystemExit) as e:
+            main(argv)
+        assert needle in str(e.value), (argv, e.value)
+
+    rejects(["--compiled"], "--export")
+    rejects(["--artifact", str(tmp_path)], "--serve")
+    rejects([str(cfg), "--serve", "0", "--artifact", str(tmp_path)],
+            "sealed")
+    rejects(["--serve", "0", "--artifact", str(tmp_path),
+             "--export", str(tmp_path / "pkg")], "--export")
+    rejects(["--serve", "0", "--artifact", str(tmp_path),
+             "--snapshot", str(tmp_path / "s.json")], "--snapshot")
+
+
+@pytest.mark.slow
+@pytest.mark.artifact
+def test_cli_export_compiled_and_artifact_serve(tmp_path):
+    """The full compiled-artifact CLI loop: train -> --export DIR
+    --compiled (manifest summary on stdout) -> --serve 0 --artifact DIR
+    boots REST decode from the sealed programs with no model config
+    anywhere in the serving process."""
+    import time
+    import urllib.request
+
+    cfg = tmp_path / "lm.json"
+    cfg.write_text(json.dumps(LM_CONFIG_JSON))
+    r = run_cli(tmp_path, str(cfg), "--random-seed", "1",
+                "--snapshot-dir", str(tmp_path / "snap"))
+    assert r.returncode == 0, r.stderr
+    snap = tmp_path / "snap" / "cli_lm_best.json"
+    art = tmp_path / "art"
+    r2 = run_cli(tmp_path, str(cfg), "--snapshot", str(snap),
+                 "--export", str(art), "--compiled")
+    assert r2.returncode == 0, r2.stderr
+    out = json.loads(r2.stdout.strip().splitlines()[-1])
+    assert out["compiled"] and out["manifest"]["buckets"]
+    assert (art / "artifact.json").exists()
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    proc = subprocess.Popen(
+        [sys.executable, "-c",
+         "import jax; jax.config.update('jax_platforms','cpu');"
+         "from veles_tpu.__main__ import main; import sys;"
+         "sys.exit(main(sys.argv[1:]))",
+         "--serve", "0", "--artifact", str(art)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd=str(tmp_path))
+    try:
+        import queue
+        import threading
+
+        # reader thread (not select on the fd: buffered readline can
+        # hold lines select never sees): the deadline stays real for a
+        # child that wedges silently, and the pipe keeps draining for
+        # the rest of the test
+        lines = queue.Queue()
+        threading.Thread(
+            target=lambda: [lines.put(ln) for ln in proc.stdout],
+            daemon=True).start()
+        boot = port = None
+        tail = []
+        deadline = time.time() + 180
+        while time.time() < deadline:
+            if proc.poll() is not None and lines.empty():
+                raise AssertionError(
+                    f"server died rc={proc.returncode}: "
+                    f"{''.join(tail)[-2000:]}")
+            try:
+                line = lines.get(timeout=1.0)
+            except queue.Empty:
+                continue
+            tail.append(line)
+            if line.startswith("{"):
+                boot = json.loads(line)
+                port = boot["serving"]
+                break
+        assert port, f"no port announced: {''.join(tail)[-2000:]}"
+        assert boot["programs"]["decode"] and boot["programs"]["forward"]
+        base = f"http://127.0.0.1:{port}"
+        req = urllib.request.Request(
+            f"{base}/generate",
+            json.dumps({"prompt": [[1, 2, 3]], "steps": 4}).encode(),
+            {"Content-Type": "application/json"})
+        toks = json.loads(urllib.request.urlopen(req, timeout=60)
+                          .read())["tokens"]
+        assert len(toks[0]) == 7 and toks[0][:3] == [1, 2, 3]
+        models = json.loads(urllib.request.urlopen(
+            f"{base}/models", timeout=60).read())
+        assert {e["kind"] for e in models["versions"]} == {"artifact"}
+    finally:
+        proc.terminate()
+        proc.wait(timeout=30)
+
+
 def test_cli_compare_snapshots(tmp_path, config_file):
     """`compare-snapshots A B` prints a per-tensor diff table (reference:
     veles/scripts/compare_snapshots.py): training twice with different
@@ -458,6 +564,7 @@ def test_cli_compare_snapshots(tmp_path, config_file):
     assert r.returncode == 0, r.stderr
 
 
+@pytest.mark.slow
 def test_cli_mesh_pp_sp_fused(tmp_path):
     """--mesh data=2,seq=2,pipe=2 on the round-5 showcase config routes
     the Trainer onto the fused 1F1B step with ring attention INSIDE the
@@ -490,6 +597,7 @@ def test_cli_mesh_pp_sp_fused(tmp_path):
     assert math.isfinite(float(data["best_value"]))
 
 
+@pytest.mark.slow
 def test_cli_mesh_interleaved_fused(tmp_path):
     """pipeline_interleave in a JSON config reaches the interleaved
     schedule through the CLI's direct Trainer construction (round-5:

@@ -288,7 +288,9 @@ def test_reload_from_forge_store(tmp_path, rng):
     dep = DeployController(engine=eng)
     try:
         out = dep.reload(f"forge://{tmp_path / 'store'}/lm")
-        assert out["active"]["kind"] == "package"
+        # source KIND names where the weights came from — forge sources
+        # are "forge" (snapshot|package|forge|artifact in GET /models)
+        assert out["active"]["kind"] == "forge"
         got = eng.generate(prompt, 6, timeout=120)
         np.testing.assert_array_equal(got, ref_b)
     finally:

@@ -7,6 +7,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+from conftest import NEEDS_VMA
+
 
 import veles_tpu as vt
 from veles_tpu.models.standard import StandardWorkflow, build_workflow
@@ -53,6 +55,7 @@ def _build(config, B, T, V):
     return sw, wf, specs
 
 
+@NEEDS_VMA
 def test_config_1f1b_matches_ad_path(rng):
     """One fused-1F1B optimizer step on the 8-dev mesh == one AD step on
     a single device, same init, same batch — loss AND updated params."""
@@ -89,6 +92,7 @@ def test_config_1f1b_matches_ad_path(rng):
                                    rtol=2e-4, atol=2e-5, err_msg=k)
 
 
+@NEEDS_VMA
 def test_config_1f1b_legacy_stack(rng):
     """The homogeneous (n_stages, d_hidden) stack trains on the fused
     path too, with the stage axis sharded over pipe."""
@@ -132,6 +136,7 @@ def test_config_1f1b_legacy_stack(rng):
             rtol=2e-4, atol=2e-5)
 
 
+@NEEDS_VMA
 def test_config_1f1b_loss_decreases(rng):
     """Product proof: repeated fused steps actually train."""
     S, B, T, V = 4, 16, 8, 12
@@ -150,6 +155,7 @@ def test_config_1f1b_loss_decreases(rng):
     assert losses[-1] < losses[0] * 0.6, losses[::6]
 
 
+@NEEDS_VMA
 def test_trainer_uses_fused_pipeline(rng):
     """StandardWorkflow config switch: pipeline_microbatches routes the
     Trainer onto the fused step; a short run trains and evals."""
@@ -202,6 +208,7 @@ def test_config_stack_stage_shape_check():
         stack.output_spec([vt.Spec((8, 16), jnp.float32)])
 
 
+@NEEDS_VMA
 def test_config_stack_gpipe_forward_matches_sequential(rng):
     """Config-stage PipelineStack forwards identically pipelined (GPipe,
     pipe=4) and sequential (pipe=1) — the eval/predict path."""
@@ -248,6 +255,7 @@ def _dropout_config(S=4, T=8, V=12, E=16, ratio=0.25):
     }
 
 
+@NEEDS_VMA
 def test_config_1f1b_dropout_matches_gpipe_ad(rng):
     """Round-4 lift: dropout INSIDE pipeline stages trains on the fused
     1F1B schedule and is grad-exact against AD-through-GPipe on the SAME
@@ -293,6 +301,7 @@ def test_config_1f1b_dropout_matches_gpipe_ad(rng):
     assert abs(float(mets0["loss"]) - float(mets_pp["loss"])) > 1e-6
 
 
+@NEEDS_VMA
 def test_config_1f1b_moe_aux_matches_gpipe_ad(rng):
     """Round-4 lift: a MoE stage trains on the fused schedule with its
     load-balance aux loss included — loss and updated params exactly
@@ -352,6 +361,7 @@ def test_config_1f1b_moe_aux_matches_gpipe_ad(rng):
                for a, b in zip(moe_p, moe_0))
 
 
+@NEEDS_VMA
 def test_1f1b_ring_width_independent_of_vocab(rng):
     """Round-3 verdict #6: the activation ring must not scale with the
     output/vocab width, and dtypes ride the ring unchanged (bf16 stays
@@ -386,6 +396,7 @@ def test_1f1b_ring_width_independent_of_vocab(rng):
     assert np.isfinite(float(mets["loss"]))
 
 
+@NEEDS_VMA
 def test_1f1b_ring_preserves_bf16(rng):
     """bf16 activations must not be upcast to f32 on the ring (round-3
     silently carried everything as f32)."""
@@ -416,6 +427,7 @@ def test_1f1b_ring_preserves_bf16(rng):
     assert np.isfinite(float(mets["loss"]))
 
 
+@NEEDS_VMA
 def test_trainer_accepts_padded_tail_batches(rng):
     """Round-5 lift (round-4 verdict #4): a loader whose train count
     does not divide the batch size trains through the fused 1F1B path —
@@ -436,6 +448,7 @@ def test_trainer_accepts_padded_tail_batches(rng):
     assert np.isfinite(res["best_value"])
 
 
+@NEEDS_VMA
 def test_config_1f1b_ragged_batch_matches_ad(rng):
     """Grad exactness with a NON-uniform @mask (the ragged tail batch):
     one fused step on dp2×pp4 with 5 of 16 rows padded == one AD step on
@@ -466,6 +479,7 @@ def test_config_1f1b_ragged_batch_matches_ad(rng):
     _assert_params_match(ws_pp, ws_ad)
 
 
+@NEEDS_VMA
 def test_config_1f1b_ragged_with_sp_matches_ad(rng):
     """Ragged batch composed WITH sequence parallelism: the weighted
     loss's static rescale must cancel the seq-axis reduction too."""
@@ -547,6 +561,7 @@ def _assert_params_match(ws_a, ws_b):
                                    rtol=2e-4, atol=2e-5, err_msg=k)
 
 
+@NEEDS_VMA
 def test_config_1f1b_sp_inside_stages_matches_ad(rng):
     """Ring attention runs INSIDE fused-1F1B stages (round-4 verdict #3):
     pp2×sp2×dp2 on the 8-dev mesh — the transports carry T-shards, stage
@@ -577,17 +592,20 @@ def test_config_1f1b_sp_inside_stages_matches_ad(rng):
     _assert_params_match(ws_pp, ws_ad)
 
 
+@NEEDS_VMA
 def test_config_1f1b_ep_inside_stages_matches_ad(rng):
     """Expert-parallel MoE runs INSIDE fused-1F1B stages: pp2×ep2×dp2 —
     microbatch samples shard over 'expert', the stage closure's manual
-    all_to_all redistributes tokens to the rank owning each expert, and
+    all_to_all redistributes tokens to the ranks owning each expert, and
     the full expert-bank gradient reassembles through the schedule's
-    cross-shard psum.  With ample capacity (no drops) and aux_weight=0
-    (the aux statistic is rank-local by design) one optimizer step
-    matches the single-device AD path."""
+    cross-shard psum.  aux_weight is NONZERO: the load-balance aux
+    statistics psum over the expert axis (``_switch_aux(axis_name=)``),
+    so the aux-weighted objective is exact vs the single-device AD path
+    — not just the CE term (VERDICT #4; the rank-local formulation
+    needed aux_weight=0 here)."""
     S, B, T, V, E = 2, 8, 8, 12, 16
     stage = [{"type": "moe", "n_experts": 4, "d_hidden": 32, "top_k": 1,
-              "capacity_factor": 8.0, "aux_weight": 0.0},
+              "capacity_factor": 8.0, "aux_weight": 0.01},
              {"type": "layer_norm"}]
     cfg = _per_position_cfg(S, V, E, stage)
     mesh = make_mesh(MeshSpec(data=2, expert=2, pipe=S))
@@ -609,6 +627,7 @@ def test_config_1f1b_ep_inside_stages_matches_ad(rng):
     _assert_params_match(ws_pp, ws_ad)
 
 
+@NEEDS_VMA
 def test_config_1f1b_sp_ep_composed_trains(rng):
     """pp2×sp2×ep2 in ONE fused step (8 devices, three model axes): every
     stage is the realistic transformer-MoE block (attention + MoE — the
@@ -697,6 +716,7 @@ def test_1f1b_sp_rejects_non_positionwise_post(rng):
                                     n_microbatches=S)
 
 
+@NEEDS_VMA
 def test_config_1f1b_stateful_normalizer_matches_ad(rng):
     """Round-5 lift (round-4 verdict #5): a stateful unit with READ-ONLY
     state — MeanDispNormalizer's dataset statistics — folds into the
@@ -746,6 +766,7 @@ def test_config_1f1b_stateful_normalizer_matches_ad(rng):
         np.asarray(ws_pp["state"]["norm"]["mean"]), mean)
 
 
+@NEEDS_VMA
 def test_1f1b_het_stages_with_idle_expert_axis(rng):
     """Review regression guard: an expert mesh axis on a MoE-FREE model
     must stay pure replication — heterogeneous stages keep the switch
@@ -778,6 +799,7 @@ def test_1f1b_het_stages_with_idle_expert_axis(rng):
     assert np.isfinite(float(mets["loss"]))
 
 
+@NEEDS_VMA
 def test_config_1f1b_sp_swa_gqa_matches_ad(rng):
     """The manual ring inside fused stages carries the full attention
     feature set: sliding-window (global-position mask) + grouped-query
@@ -806,6 +828,7 @@ def test_config_1f1b_sp_swa_gqa_matches_ad(rng):
     _assert_params_match(ws_pp, ws_ad)
 
 
+@NEEDS_VMA
 def test_config_1f1b_fsdp_sharded_stage_params_matches_ad(rng):
     """pp×fsdp at rest: stage parameters (and their optimizer state)
     shard over the fsdp axis via the sharding rule; GSPMD all-gathers
@@ -861,6 +884,7 @@ def test_config_1f1b_fsdp_sharded_stage_params_matches_ad(rng):
     _assert_params_match(ws_pp, ws_ad)
 
 
+@NEEDS_VMA
 def test_config_1f1b_interleaved_matches_ad(rng):
     """Interleaved virtual stages through the PRODUCT path: a 4-stage
     uniform stack on pipe=2 with interleave=2 (device d hosts chunks d
@@ -903,6 +927,7 @@ def test_config_1f1b_interleaved_matches_ad(rng):
     _assert_params_match(ws_pp, ws_ad)
 
 
+@NEEDS_VMA
 def test_config_1f1b_interleaved_sp_matches_ad(rng):
     """Interleave composes with in-stage ring attention: pipe=2 ×
     interleave=2 × seq=2 — T-sharded transports, four virtual chunks,
@@ -933,6 +958,7 @@ def test_config_1f1b_interleaved_sp_matches_ad(rng):
     _assert_params_match(ws_pp, ws_ad)
 
 
+@NEEDS_VMA
 def test_trainer_interleaved_config_switch(rng):
     """pipeline_interleave in the config routes the Trainer onto the
     interleaved schedule; a short run trains and evals (eval falls back
@@ -971,6 +997,7 @@ def test_trainer_interleaved_config_switch(rng):
     assert np.isfinite(res["best_value"])
 
 
+@NEEDS_VMA
 def test_config_1f1b_interleaved_ep_matches_ad(rng):
     """Interleave composes with expert parallelism too: pp2 × v2 × ep2
     × dp2 — four virtual transformer-MoE chunks, manual all_to_all
@@ -1004,6 +1031,7 @@ def test_config_1f1b_interleaved_ep_matches_ad(rng):
     _assert_params_match(ws_pp, ws_ad)
 
 
+@NEEDS_VMA
 def test_config_1f1b_interleaved_ragged_matches_ad(rng):
     """Ragged batches compose with the interleaved timetable: the
     mask-weighted loss's static rescale is schedule-independent — a

@@ -36,9 +36,9 @@ from .ops import optimizers
 from . import loader
 from .loader import ArrayLoader, FullBatchLoader, Loader
 from . import runtime
-from .runtime import (Decision, DecodeEngine, DeployController, Snapshotter,
-                      SnapshotterToDB, StepCache, Trainer, generate,
-                      generate_beam)
+from .runtime import (ArtifactRunner, Decision, DecodeEngine,
+                      DeployController, Snapshotter, SnapshotterToDB,
+                      StepCache, Trainer, generate, generate_beam)
 from . import parallel
 from .parallel import MeshSpec, make_mesh
 from . import models

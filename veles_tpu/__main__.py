@@ -142,6 +142,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "(restored) model and exit — contents.json + "
                         "npy for veles_serve (reference: "
                         "Workflow.package_export, veles/workflow.py:868)")
+    p.add_argument("--compiled", action="store_true",
+                   help="with --export DIR: write a sealed compiled "
+                        "artifact instead (jax.export StableHLO of the "
+                        "batched forward + the decode engine's fixed "
+                        "program set, manifest, weights blob) and print "
+                        "the manifest summary; serve it with "
+                        "--serve --artifact DIR "
+                        "(docs/serving_export.md)")
+    p.add_argument("--artifact", metavar="DIR", default=None,
+                   help="with --serve: boot from a compiled artifact "
+                        "directory (export_compiled) — deserialized "
+                        "StableHLO programs, zero model Python, no "
+                        "config file needed")
     p.add_argument("--generate", type=int, metavar="N", default=None,
                    help="decode N tokens after --prompt with the "
                         "(restored) sequence model instead of training "
@@ -441,6 +454,94 @@ def _write_graph(workflow, path: str) -> None:
             f.write(workflow.generate_svg())
 
 
+def _check_watch(args) -> None:
+    """``--watch`` needs a directory to poll — ONE check, called early
+    by ``_serve_artifact`` (before the expensive boot) and again by the
+    shared serve loop."""
+    if args.watch and not (args.model_dir
+                           or root.common.serve.get("model_dir")):
+        raise SystemExit("--watch needs --model-dir (the snapshot "
+                         "directory to poll)")
+
+
+def _run_serve_loop(args, srv, banner: dict, *, status=None,
+                    boot_source: str = "live") -> int:
+    """The ONE serve bootstrap/teardown config-booted (``--serve``) and
+    artifact-booted (``--serve --artifact``) serving share: deploy
+    control plane, signal handlers, optional snapshot watcher, JSON
+    boot banner, then block until drained."""
+    from .runtime.deploy import DeployController
+
+    _check_watch(args)
+    deploy = DeployController(
+        server=srv, model_dir=args.model_dir,
+        drain_timeout_s=args.drain_timeout,
+        status=status, boot_source=boot_source)
+    deploy.install_signal_handlers()
+    srv.start()
+    if args.watch:
+        deploy.start_watcher()
+    print(json.dumps(dict(banner, serving=srv.port,
+                          model_dir=deploy.model_dir,
+                          watching=deploy.watching)), flush=True)
+    try:
+        deploy.wait()  # released by SIGTERM / POST /admin/drain
+    except KeyboardInterrupt:
+        deploy.drain(timeout=0)  # interactive: skip the grace hold
+    srv.stop()
+    return 0
+
+
+def _serve_artifact(args) -> int:
+    """``--serve --artifact DIR``: boot HTTP serving from a sealed
+    compiled artifact (export_compiled) — deserialized StableHLO
+    programs + weights blob, no model Python config anywhere.  Decodable
+    artifacts serve POST /generate through an ArtifactRunner (the
+    continuous-batching engine over the sealed program set); the
+    exported batched forward backs POST /predict.  The deploy control
+    plane wraps it exactly like config-booted serving: /models,
+    /admin/reload (snapshots, packages, other artifacts — weights only,
+    programs stay sealed), graceful drain."""
+    import numpy as np
+
+    from .runtime.artifact import (ArtifactRunner, load_forward,
+                                   read_manifest)
+    from .runtime.restful import RestfulServer
+
+    _check_watch(args)  # fail BEFORE the expensive artifact boot
+    man = read_manifest(args.artifact)
+    runner = None
+    if "decode" in man.get("programs", {}):
+        runner = ArtifactRunner(args.artifact)
+        wstate = runner.wstate
+        predict_fn = runner.predict if runner.has_forward else None
+    else:
+        predict_fn, wstate, man = load_forward(args.artifact)
+
+    if predict_fn is None:
+        def predict_fn(wstate, batch):  # noqa: ARG001
+            raise ValueError(
+                "this artifact was exported without a forward program; "
+                "only /generate is served")
+
+    ispec = man.get("input_spec") or {}
+    shape = [int(s) for s in (ispec.get("shape") or (1, 1))]
+    srv = RestfulServer(
+        predict_fn, wstate, shape[0], tuple(shape[1:]),
+        port=args.serve, workflow=None, engine=runner,
+        input_dtype=np.dtype(ispec.get("dtype", "float32")),
+        default_eos_id=man.get("eos_id"),
+        vocab_size=man.get("input_vocab"))
+    return _run_serve_loop(args, srv, {
+        "artifact": args.artifact,
+        "workflow": man.get("workflow"),
+        "programs": {
+            "decode": "decode" in man.get("programs", {}),
+            "forward": "forward" in man.get("programs", {}),
+            "prefill_buckets": man.get("buckets", [])},
+    }, boot_source=str(args.artifact))
+
+
 def main(argv=None) -> int:
     if argv is None:
         argv = sys.argv[1:]
@@ -559,6 +660,37 @@ def main(argv=None) -> int:
     if args.ensemble_test and not args.config:
         raise SystemExit("--ensemble-test needs the workflow config the "
                          "members were trained with")
+    if args.compiled and not args.export:
+        raise SystemExit("--compiled modifies --export DIR (it writes "
+                         "the compiled artifact there)")
+
+    if args.artifact is not None:
+        # compiled-artifact serving: no config, no model Python — the
+        # sealed program set + weights blob are the whole input
+        if args.serve is None:
+            raise SystemExit("--artifact serves a compiled artifact "
+                             "and needs --serve PORT")
+        if args.config:
+            raise SystemExit("--artifact serves sealed programs; a "
+                             "workflow config cannot apply (drop "
+                             f"{args.config!r}, or serve the config "
+                             "via --serve without --artifact)")
+        if args.export:
+            raise SystemExit("--export needs the model config to "
+                             "package; it cannot combine with "
+                             "--artifact serving (export first, then "
+                             "serve the artifact)")
+        if args.snapshot:
+            raise SystemExit("--artifact serves the artifact's sealed "
+                             "weights; --snapshot cannot apply (swap "
+                             "weights at runtime via POST "
+                             "/admin/reload)")
+        if args.generate is not None:
+            raise SystemExit("--generate is a one-shot decode of a "
+                             "config/snapshot model; with an artifact, "
+                             "serve it and POST /generate")
+        apply_overrides(root, args.overrides)
+        return _serve_artifact(args)
 
     if not args.config:
         build_parser().print_help()
@@ -786,13 +918,29 @@ def main(argv=None) -> int:
     if args.snapshot:
         trainer.restore(args.snapshot)
     if args.export:
-        from .export import export_package
         spec = trainer._batch_spec["@input"]
-        export_package(trainer.workflow, trainer.wstate, args.export,
-                       input_spec={"shape": list(spec.shape),
-                                   "dtype": str(spec.dtype)})
-        out = {"exported": args.export,
-               "units": len(trainer.workflow.units)}
+        input_spec = {"shape": list(spec.shape),
+                      "dtype": str(spec.dtype)}
+        if args.compiled:
+            # sealed compiled artifact: StableHLO programs + manifest +
+            # weights (export/compiled.py); served via --serve
+            # --artifact with zero model Python
+            if args.export.endswith(".zip"):
+                raise SystemExit("--compiled exports a DIRECTORY "
+                                 "artifact (programs + manifest + "
+                                 "weights), not a .zip")
+            from .export import export_compiled, manifest_summary
+            man = export_compiled(
+                trainer.workflow, trainer.wstate, args.export,
+                input_spec=input_spec, eos_id=args.eos_id)
+            out = {"exported": args.export, "compiled": True,
+                   "manifest": manifest_summary(man)}
+        else:
+            from .export import export_package
+            export_package(trainer.workflow, trainer.wstate, args.export,
+                           input_spec=input_spec)
+            out = {"exported": args.export,
+                   "units": len(trainer.workflow.units)}
         print(json.dumps(out))
         if args.result_file:
             with open(args.result_file, "w") as f:
@@ -805,7 +953,6 @@ def main(argv=None) -> int:
         # lifecycle control plane (runtime/deploy.py): GET /healthz +
         # /ready + /models, POST /admin/reload hot swaps, graceful
         # drain on SIGTERM / POST /admin/drain
-        from .runtime.deploy import DeployController
         from .runtime.restful import RestfulServer
         wf = trainer.workflow
         head = wf.default_output()
@@ -815,28 +962,9 @@ def main(argv=None) -> int:
             int(spec.shape[0]), tuple(spec.shape[1:]),
             port=args.serve, workflow=wf,
             input_dtype=spec.dtype)
-        if args.watch and not (args.model_dir
-                               or root.common.serve.get("model_dir")):
-            raise SystemExit("--watch needs --model-dir (the snapshot "
-                             "directory to poll)")
-        deploy = DeployController(
-            server=srv, model_dir=args.model_dir,
-            drain_timeout_s=args.drain_timeout,
-            status=trainer.status,
-            boot_source=args.snapshot or "live")
-        deploy.install_signal_handlers()
-        srv.start()
-        if args.watch:
-            deploy.start_watcher()
-        print(json.dumps({"serving": srv.port, "predict_head": head,
-                          "model_dir": deploy.model_dir,
-                          "watching": deploy.watching}), flush=True)
-        try:
-            deploy.wait()  # released by SIGTERM / POST /admin/drain
-        except KeyboardInterrupt:
-            deploy.drain(timeout=0)  # interactive: skip the grace hold
-        srv.stop()
-        return 0
+        return _run_serve_loop(args, srv, {"predict_head": head},
+                               status=trainer.status,
+                               boot_source=args.snapshot or "live")
     if args.generate is not None:
         # decode mode: the trained (or restored) sequence model emits a
         # continuation instead of training (reference has no LM family;

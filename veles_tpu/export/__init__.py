@@ -1,1 +1,2 @@
+from .compiled import export_compiled, manifest_summary
 from .package import export_package, load_package

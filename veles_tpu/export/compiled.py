@@ -1,0 +1,362 @@
+"""Compiled-artifact export: StableHLO programs + manifest + weights.
+
+The package export (export/package.py) ships *weights and structure* —
+the C++ runtime re-implements the math.  This module ships the
+*compiled programs themselves*: the decode engine's fixed program set
+(pow2-bucketed prefill + the single decode step, runtime/engine.py) and
+the batched forward are lowered ONCE via ``jax.export`` and serialized
+as StableHLO, so a PJRT client anywhere can run the sealed artifact
+with zero model Python — the "compile the whole program once, run the
+artifact" move of "Automatic Full Compilation of Julia Programs and ML
+Models to Cloud TPUs" (arxiv 1810.09868), applied to serving.
+
+The exported programs are built by the SAME module-level builders the
+live engine compiles (:func:`~veles_tpu.runtime.engine.make_decode_fn`
+/ ``make_prefill_fn``), so greedy tokens from the artifact are bitwise
+the live engine's — one source of step math, never two.
+
+Artifact layout (a directory, storable in a Forge like any package)::
+
+    <out_dir>/
+      artifact.json        # manifest: avals, bucket table, checksums
+      tensors.npz          # params (+ state) — snapshotter discipline
+      programs/forward.bin           # batched predict (when exportable)
+      programs/prefill_<pb>.bin      # one per bucket
+      programs/decode.bin            # the lifetime decode step
+
+Integrity follows the snapshot checksum discipline: the manifest
+records a sha256 per blob (of the in-memory bytes, so torn writes
+fail the verify), written tmp+rename after an fsync, and the loader
+(runtime/artifact.py, via ``sha256_files``) verifies before serving
+— corruption raises ``SnapshotCorruptError``, exactly like a snapshot.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import time
+from typing import Optional
+
+import jax
+import jax.export  # noqa: F401 — not auto-imported by `import jax`
+import jax.numpy as jnp
+import numpy as np
+
+from ..units.workflow import WorkflowError
+
+#: Manifest file name inside an artifact directory — the presence test
+#: the deploy control plane uses to recognize ``artifact://`` sources.
+MANIFEST = "artifact.json"
+FORMAT = "veles-tpu-compiled-artifact"
+FORMAT_VERSION = 1
+
+
+def _aval_rows(tree):
+    """Flattened ``[{path, shape, dtype}]`` of a pytree of arrays /
+    ShapeDtypeStructs — enough for the runner to rebuild zeroed state
+    without any model code.  Paths use the snapshotter's '/'-joined
+    form so ``_unflatten`` rebuilds the exact nesting."""
+    from ..runtime.snapshotter import _flatten
+    # stride-0 stand-ins: _flatten np.asarray's its leaves, and a
+    # ShapeDtypeStruct must neither allocate nor land as dtype=object
+    spoof = jax.tree.map(
+        lambda a: np.broadcast_to(np.zeros((), np.dtype(a.dtype)),
+                                  np.shape(a)), tree)
+    return [_row(path, leaf)
+            for path, leaf in sorted(_flatten(spoof).items())]
+
+
+def _row(path: str, leaf) -> dict:
+    """One manifest aval row — the schema runtime/artifact.py's
+    ``_zeros_from_rows`` rebuilds from.  Structural markers
+    (``__seq__`` / ``__emptydict__``) carry their VALUES (seq length,
+    tuple-vs-list): ``_unflatten`` reads them, zeros would corrupt the
+    rebuild."""
+    row = {"path": path,
+           "shape": [int(s) for s in leaf.shape],
+           "dtype": str(leaf.dtype)}
+    if path.rsplit("/", 1)[-1] in ("__seq__", "__emptydict__"):
+        row["structure"] = np.asarray(leaf).tolist()
+    return row
+
+
+def _rows_from_flat(flat: dict, prefix: str):
+    """Manifest aval rows for one subtree of an ALREADY-flattened
+    host-side dict (the tensors blob) — shapes and dtypes without a
+    second device-to-host copy of the weights."""
+    pre = prefix + "/"
+    return [_row(path[len(pre):], flat[path])
+            for path in sorted(flat) if path.startswith(pre)]
+
+
+def _export_one(fn, args_sds):
+    """jax.export the jitted ``fn`` at the given ShapeDtypeStructs and
+    return (serialized bytes, info dict for the manifest)."""
+    exp = jax.export.export(fn)(*args_sds)
+    info = {
+        "platforms": list(exp.platforms),
+        "calling_convention_version":
+            int(exp.calling_convention_version),
+        "in_avals": [str(a) for a in exp.in_avals],
+        "out_avals": [str(a) for a in exp.out_avals],
+    }
+    return bytes(exp.serialize()), info
+
+
+def _write_blob(path: str, data: bytes, staged: list) -> str:
+    """Stage + fsync a blob at ``path + ".tmp"`` and record the
+    (tmp, final) rename in ``staged``; returns its sha256 (snapshot
+    discipline: the manifest's checksums must describe bytes that are
+    on stable storage before the manifest commits, and a re-export that
+    dies mid-way must leave the previous artifact's blobs untouched —
+    everything lands under final names only at commit).  The hash is of
+    the in-memory bytes, not a re-read of the file: a write torn by bad
+    disk/RAM must FAIL the load-time verify, not be sealed into the
+    manifest as the expected checksum."""
+    import hashlib
+
+    from ..runtime.snapshotter import _fsync_file
+    tmp = path + ".tmp"
+    # recorded BEFORE the write: a write/fsync that dies mid-blob
+    # (ENOSPC) must still get its partial .tmp unlinked by the caller's
+    # cleanup, not ship as a stray in a forge upload of the dir
+    staged.append((tmp, path))
+    with open(tmp, "wb") as f:
+        f.write(data)
+    _fsync_file(tmp)
+    return hashlib.sha256(data).hexdigest()
+
+
+def export_compiled(workflow, wstate, out_dir: str, *,
+                    slots: Optional[int] = None,
+                    l_max: Optional[int] = None,
+                    bucket_min: Optional[int] = None,
+                    cache_dtype=jnp.float32,
+                    output_unit: Optional[str] = None,
+                    input_spec: Optional[dict] = None,
+                    eos_id: Optional[int] = None) -> dict:
+    """Export ``workflow``'s inference step family as a sealed compiled
+    artifact under ``out_dir``; returns the manifest dict.
+
+    Always exports the batched **forward** (``make_predict_step`` at the
+    build batch shape, or ``input_spec`` {"shape", "dtype"} when given).
+    For decodable sequence chains additionally exports the engine's
+    **fixed program set** — one prefill per pow2 bucket and the single
+    decode step — sized by ``slots`` / ``l_max`` / ``bucket_min``
+    (defaults from ``root.common.serve``, the live engine's own knobs).
+    A chain ``DecodePlan`` rejects simply ships forward-only (the
+    manifest omits the decode program and records why under
+    ``decode_unsupported``).
+    """
+    from ..runtime.engine import (bucket_table, make_decode_fn,
+                                  make_prefill_fn,
+                                  resolve_serve_geometry)
+    from ..runtime.generate import DecodePlan
+    from ..runtime.snapshotter import _flatten, _fsync_dir, _to_numpy
+    from ..units.base import Context
+    from ..units.nn import input_vocab as _input_vocab
+
+    slots, l_max, bucket_min = resolve_serve_geometry(
+        slots, l_max, bucket_min)
+
+    prog_dir = os.path.join(out_dir, "programs")
+    os.makedirs(prog_dir, exist_ok=True)
+    # strays from an export that died mid-staging would otherwise ship
+    # in forge uploads of the directory
+    for stray in os.listdir(prog_dir):
+        if stray.endswith(".tmp"):
+            os.unlink(os.path.join(prog_dir, stray))
+    for stray in ("tensors.npz.tmp", MANIFEST + ".tmp"):
+        stray = os.path.join(out_dir, stray)
+        if os.path.exists(stray):
+            os.unlink(stray)
+    staged: list = []
+    params = wstate["params"]
+    state = wstate.get("state") or {}
+    # eos is sealed as the serving default — a bad value would 400
+    # every /generate of the artifact, so reject it BEFORE paying for
+    # lowering/serialization.  Serving bounds eos by the INPUT
+    # embedding rows (restful._vocab_size); the head vocab is checked
+    # below once the decode plan reveals it.
+    input_vocab = _input_vocab(workflow, params)
+    if eos_id is not None and (int(eos_id) < 0 or (
+            input_vocab is not None and int(eos_id) >= input_vocab)):
+        raise ValueError(
+            f"eos_id {eos_id} is outside the exported model's "
+            f"vocabulary [0, "
+            f"{input_vocab if input_vocab is not None else '?'})")
+    try:
+        # -- weights blob (snapshotter flatten + _write_blob staging, so
+        # the manifest hash is of the in-memory npz bytes like every
+        # program blob; the compressed buffer is transient) ---------------
+        tensors = _flatten(_to_numpy({"params": params, "state": state}))
+        buf = io.BytesIO()
+        # a handle, not the path: savez would append ".npz"
+        np.savez_compressed(buf, **tensors)
+        tensors_sha = _write_blob(os.path.join(out_dir, "tensors.npz"),
+                                  buf.getvalue(), staged)
+        del buf
+
+        programs: dict = {}
+
+        # -- batched forward ----------------------------------------------
+        head = output_unit or workflow.default_output()
+        if input_spec is None:
+            spec = getattr(workflow, "_input_specs", {}).get("@input")
+            if spec is not None:
+                input_spec = {"shape": [int(s) for s in spec.shape],
+                              "dtype": str(spec.dtype)}
+        if input_spec is not None:
+            predict = workflow.make_predict_step(head, jit=False)
+
+            def forward(params, state, x):
+                return predict({"params": params, "state": state},
+                               {"@input": x})
+
+            fwd_sds = (jax.tree.map(
+                lambda a: jax.ShapeDtypeStruct(np.shape(a), a.dtype), params),
+                jax.tree.map(
+                lambda a: jax.ShapeDtypeStruct(np.shape(a), a.dtype), state),
+                jax.ShapeDtypeStruct(tuple(input_spec["shape"]),
+                                     jnp.dtype(input_spec["dtype"])))
+            blob, info = _export_one(jax.jit(forward), fwd_sds)
+            sha = _write_blob(os.path.join(out_dir, "programs", "forward.bin"),
+                              blob, staged)
+            programs["forward"] = dict(info, file="programs/forward.bin",
+                                       sha256=sha)
+
+        # -- decode program family (the engine's fixed set) ---------------
+        decode_meta = None
+        vocab = None
+        cache_rows = []
+        try:
+            plan = DecodePlan(workflow, output_unit)
+        except WorkflowError as e:
+            plan, decode_reason = None, f"{type(e).__name__}: {e}"
+        if plan is not None:
+            ctx = Context(train=False, key=None, mesh=None)
+            # avals only — never materialize the slot-batch KV caches on
+            # the export host (slots x l_max can be GBs for a real LM)
+            csds = jax.eval_shape(
+                lambda p: plan.init_caches(p, slots, l_max, cache_dtype),
+                params)
+            cache_rows = _aval_rows(csds)
+            kd = jax.random.key_data(jax.random.key(0))
+            S = slots
+            psds = jax.tree.map(
+                lambda a: jax.ShapeDtypeStruct(np.shape(a), a.dtype), params)
+            i32 = lambda *sh: jax.ShapeDtypeStruct(sh, jnp.int32)  # noqa: E731
+            f32 = lambda *sh: jax.ShapeDtypeStruct(  # noqa: E731
+                sh, jnp.float32)
+            toks = jax.ShapeDtypeStruct((S, l_max), jnp.int32)
+            keys = jax.ShapeDtypeStruct((S,) + kd.shape, kd.dtype)
+            vocab = int(jax.eval_shape(
+                lambda p, c, t, pv: plan.step(p, c, t, pv, ctx)[0],
+                psds, dict(csds), i32(S), i32(S)).shape[-1])
+            if eos_id is not None and not 0 <= int(eos_id) < vocab:
+                raise ValueError(f"eos_id {eos_id} is outside the "
+                                 f"exported model's vocabulary "
+                                 f"[0, {vocab})")
+
+            blob, info = _export_one(
+                make_decode_fn(plan, ctx, S),
+                (psds, csds, toks, i32(S),
+                 jax.ShapeDtypeStruct((S,), jnp.bool_), f32(S), i32(S),
+                 f32(S), i32(S), i32(S), keys))
+            sha = _write_blob(
+                os.path.join(out_dir, "programs", "decode.bin"), blob, staged)
+            decode_meta = dict(info, file="programs/decode.bin", sha256=sha)
+
+            prefills = {}
+            for pb in bucket_table(bucket_min, l_max):
+                blob, info = _export_one(
+                    make_prefill_fn(plan, ctx, pb, cache_dtype),
+                    (psds, csds, toks, i32(1, pb), i32(), i32(), f32(),
+                     i32(), f32(), jax.ShapeDtypeStruct(kd.shape, kd.dtype)))
+                fname = f"programs/prefill_{pb}.bin"
+                sha = _write_blob(os.path.join(out_dir, fname), blob, staged)
+                prefills[str(pb)] = dict(info, file=fname, sha256=sha)
+            programs["decode"] = decode_meta
+            programs["prefill"] = prefills
+
+        manifest = {
+            "format": FORMAT,
+            "format_version": FORMAT_VERSION,
+            "workflow": workflow.name,
+            "workflow_checksum": workflow.checksum(),
+            "jax_version": jax.__version__,
+            "saved_at": time.time(),
+            "tensors": "tensors.npz",
+            "tensors_sha256": tensors_sha,
+            "params": _rows_from_flat(tensors, "params"),
+            "state": _rows_from_flat(tensors, "state"),
+            "caches": cache_rows,
+            "slots": slots, "l_max": l_max, "bucket_min": bucket_min,
+            "buckets": bucket_table(bucket_min, l_max) if decode_meta
+            else [],
+            "cache_dtype": jnp.dtype(cache_dtype).name,
+            "vocab": vocab,
+            "input_vocab": input_vocab,
+            "eos_id": eos_id,
+            "input_spec": input_spec,
+            "programs": programs,
+        }
+        if decode_meta is None and plan is None:
+            manifest["decode_unsupported"] = decode_reason
+
+        # -- commit: everything above only staged *.tmp files.  The
+        # manifest is staged too, so the flip is back-to-back renames
+        # (blobs first, manifest last) — a death anywhere before the
+        # loop leaves the previous artifact fully intact, manifest
+        # included; a death INSIDE it leaves old manifest + new blobs,
+        # which the loader's checksum verify detects (the window is the
+        # renames themselves — true multi-file atomicity would need a
+        # versioned dir + symlink flip, changing the artifact path
+        # contract).
+        man_path = os.path.join(out_dir, MANIFEST)
+        man_tmp = man_path + ".tmp"
+        keep = {os.path.basename(final) for _, final in staged}
+        # staged before the write so a mid-write death still cleans it
+        staged.append((man_tmp, man_path))
+        with open(man_tmp, "w") as f:
+            json.dump(manifest, f, indent=1)
+            f.flush()
+            os.fsync(f.fileno())
+        for tmp, final in staged:
+            os.replace(tmp, final)
+        for leftover in os.listdir(prog_dir):
+            # re-export into the same dir: programs not in the new manifest
+            # would otherwise ship as orphan sealed blobs (.tmp: strays of
+            # an export killed between the sweeps above and this commit)
+            if leftover.endswith((".bin", ".tmp")) and leftover not in keep:
+                os.unlink(os.path.join(prog_dir, leftover))
+        _fsync_dir(prog_dir)
+        _fsync_dir(out_dir)
+        return manifest
+    except BaseException:
+        # a dead export must not leave *.tmp strays for a forge
+        # upload of the directory to ship
+        for tmp, _ in staged:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+        raise
+
+
+def manifest_summary(manifest: dict) -> dict:
+    """Compact human-facing view of an artifact manifest (the CLI's
+    ``--export --compiled`` output)."""
+    progs = manifest.get("programs", {})
+    return {
+        "workflow": manifest.get("workflow"),
+        "checksum": (manifest.get("workflow_checksum") or "")[:12],
+        "jax_version": manifest.get("jax_version"),
+        "slots": manifest.get("slots"), "l_max": manifest.get("l_max"),
+        "buckets": manifest.get("buckets"),
+        "vocab": manifest.get("vocab"),
+        "programs": sorted(
+            [p["file"] for k, p in progs.items() if k != "prefill"]
+            + [p["file"] for p in progs.get("prefill", {}).values()]),
+        "tensors_sha256": (manifest.get("tensors_sha256") or "")[:12],
+    }

@@ -11,6 +11,14 @@ manifest metadata, tar.gz package bodies — on a plain directory tree::
     <root>/<name>/versions.json            (ordered version list)
 
 which is trivially inspectable and needs no git dependency.
+
+Payload-agnostic: an ``export_package()`` directory, a compiled
+artifact (``export_compiled()`` — artifact.json + StableHLO programs +
+tensors.npz), or any other file set uploads via :meth:`ForgeStore.
+pack_dir` and serves back byte-identical; the deploy control plane's
+``forge://<root>/<name>[@version]`` sources dispatch on the payload
+(runtime/deploy.py: contents.json -> package, artifact.json ->
+compiled artifact).
 """
 
 from __future__ import annotations

@@ -76,6 +76,20 @@ def make_mesh(spec: Optional[MeshSpec] = None,
     return Mesh(arr, names)
 
 
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+    """``jax.shard_map`` across jax versions: the public API landed
+    after 0.4.x, where it lives at ``jax.experimental.shard_map`` with
+    the replication check named ``check_rep`` instead of
+    ``check_vma``.  All veles_tpu shard_map call sites route through
+    here so schedule code is written against the current API only."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _shard_map
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=check_vma)
+
+
 # -- sharding rules ----------------------------------------------------------
 
 Rule = Callable[[Tuple[str, ...], jax.ShapeDtypeStruct], P]

@@ -40,6 +40,7 @@ from jax.flatten_util import ravel_pytree
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..logger import Logger as _Logger
+from .mesh import shard_map
 
 
 _log = _Logger()
@@ -315,7 +316,7 @@ def pipeline_apply(stage_fn: Union[Callable, Sequence[Callable]],
     _log.debug("pipeline: S=%d n_mb=%d bubble=%.1f%%", S, n_mb,
                100 * bubble_fraction(S, n_mb))
     keyed = rng is not None
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(_pipeline_local, apply_local=apply_local,
                           axis_name=axis_name, n_microbatches=n_mb,
                           n_stages=S, keyed=keyed,
@@ -677,7 +678,7 @@ def pipeline_train_step(stage_fn: Union[Callable, Sequence[Callable]],
             batch_axes=batch_axes + width_axes, n_microbatches=n_mb,
             n_stages=S, het=het, keyed=keyed, ring_feat=ring_feat,
             ring_dtype=ring_spec.dtype if het else None)
-    fn = jax.shard_map(
+    fn = shard_map(
         body,
         mesh=mesh,
         in_specs=(p_specs, x_spec, lbl_spec) + ((P(),) if keyed else ()),
@@ -962,7 +963,7 @@ def interleaved_train_step(stage_fn: Callable, loss_fn: Callable,
     p_specs = jax.tree.map(
         lambda a: P(axis_name, *([None] * (a.ndim - 1))), regrouped)
     keyed = rng is not None
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(_interleaved_local, apply_local=stage_fn,
                           loss_local=loss_fn, axis_name=axis_name,
                           batch_axes=batch_axes, n_microbatches=n_mb,

@@ -26,6 +26,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops import check_attention_window as _check_window  # shared rule
 from ..ops import check_gqa_heads as _check_gqa
+from .mesh import shard_map
 
 
 def _attn_block(q, k, v, m, l, o, *, scale, mask=None):
@@ -205,7 +206,7 @@ def ring_attention(q, k, v, mesh: Mesh, *, axis_name: str = "seq",
     window = _check_window(window, causal)
     _check_gqa(q.shape[2], k.shape[2])
     spec = P(None, axis_name, None, None)
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(_ring_attention_local, axis_name=axis_name,
                           causal=causal, scale=scale, window=window),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,

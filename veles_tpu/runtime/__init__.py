@@ -1,3 +1,5 @@
+from .artifact import (ArtifactError, ArtifactRunner,
+                       ArtifactVersionError)
 from .decision import Decision
 from .deploy import DeployController, ModelRegistry
 from .engine import (DecodeEngine, EngineDraining, EngineOverloaded,

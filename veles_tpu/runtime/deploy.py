@@ -229,18 +229,44 @@ class DeployController(Logger):
         self.registry = ModelRegistry()
         self._ck_cache = None  # (path, mtime) -> digest memo
         # a boot source that IS a snapshot (file manifest, sqlite://,
-        # http://) registers as a reloadable version — so POST
-        # /admin/reload {"version": 1} can roll back to boot — with its
-        # real checksum when the blob is local, which also lets the
-        # watcher's dedup see the booted weights (no redundant first
-        # swap of the very snapshot the process restored from)
+        # http://) or a compiled artifact registers as a reloadable
+        # version — so POST /admin/reload {"version": 1} can roll back
+        # to boot — with its real checksum when the blob is local, which
+        # also lets the watcher's dedup see the booted weights (no
+        # redundant first swap of the very snapshot the process
+        # restored from)
         has_boot_src = boot_source not in (None, "", "live")
-        boot_checksum = self._snapshot_checksum(str(boot_source)) \
-            if has_boot_src else ""
+        boot_kind = "live"
+        boot_checksum = ""
+        # the artifact manifest's recorded workflow checksum backs the
+        # foreign-workflow reload guard even for forward-only serving
+        # (no engine object to ask)
+        self._boot_workflow_checksum: Optional[str] = None
+        if has_boot_src:
+            from .artifact import is_artifact_dir, read_manifest
+            src = str(boot_source)
+            art = src[len("artifact://"):] \
+                if src.startswith("artifact://") else src
+            if src.startswith("artifact://") and not is_artifact_dir(art):
+                # an explicit artifact source must never silently
+                # register as an empty-checksum "snapshot"
+                raise ValueError(
+                    f"{src}: not a compiled artifact (no manifest)")
+            if is_artifact_dir(art):
+                boot_kind = "artifact"
+                try:
+                    bman = read_manifest(art)
+                    boot_checksum = bman.get("tensors_sha256", "")
+                    self._boot_workflow_checksum = bman.get(
+                        "workflow_checksum")
+                except Exception:  # noqa: BLE001 — identity only; the
+                    pass           # runner's own load does the verifying
+            else:
+                boot_kind = "snapshot"
+                boot_checksum = self._snapshot_checksum(src)
         boot = self.registry.add(
             label=boot_label, source=boot_source,
-            kind="snapshot" if has_boot_src else "live",
-            checksum=boot_checksum)
+            kind=boot_kind, checksum=boot_checksum)
         self.registry.activate(boot["version"])
 
         self._reload_lock = threading.Lock()
@@ -272,13 +298,18 @@ class DeployController(Logger):
         return self.server.wstate
 
     def _live_checksum(self) -> Optional[str]:
-        """Topology checksum of the served workflow, when known."""
+        """Topology checksum of the served workflow, when known.  An
+        artifact-booted engine has no workflow object at all — its
+        manifest's recorded checksum plays the same guard role."""
         wf = getattr(self.engine, "workflow", None) \
             or getattr(self.server, "workflow", None)
         try:
-            return wf.checksum() if wf is not None else None
+            if wf is not None:
+                return wf.checksum()
         except Exception:  # noqa: BLE001 — a guard, never a blocker
             return None
+        return (getattr(self.engine, "workflow_checksum", None)
+                or self._boot_workflow_checksum)
 
     # -- source loading -----------------------------------------------------
     def _snapshot_checksum(self, path: str) -> str:
@@ -304,13 +335,19 @@ class DeployController(Logger):
         Accepted forms: a Snapshotter manifest path (or the
         ``_current``/``_best`` symlinks), a ``sqlite://`` / ``http(s)://``
         snapshot URI, an ``export_package()`` directory or ``.zip``
-        (contents.json + npy), ``forge://<store_root>/<name>[@version]``,
+        (contents.json + npy), a compiled-artifact directory
+        (artifact.json — ``export_compiled()``; the ``artifact://``
+        prefix is accepted and optional), ``forge://<store_root>/
+        <name>[@version]`` (package or artifact payloads both serve),
         or a snapshot *directory* (its newest manifest is taken)."""
         if not source:
             raise ValueError(
-                "reload needs a source (snapshot manifest / package path "
-                "/ forge:// URI) or a registry version")
+                "reload needs a source (snapshot manifest / package / "
+                "artifact path / forge:// URI) or a registry version")
         source = str(source)
+        if source.startswith("artifact://"):
+            return self._load_artifact(source[len("artifact://"):],
+                                       source)
         if source.startswith("forge://"):
             rest = source[len("forge://"):]
             path_part, _, ver = rest.partition("@")
@@ -326,15 +363,23 @@ class DeployController(Logger):
             # entry later must reload the same weights, not whatever
             # the store's latest has become
             resolved = store.resolve_version(name, ver or None)
-            return self._load_package(
-                store.version_dir(name, resolved),
-                f"forge://{store_root}/{name}@{resolved}")
+            vdir = store.version_dir(name, resolved)
+            uri = f"forge://{store_root}/{name}@{resolved}"
+            from .artifact import is_artifact_dir
+            if is_artifact_dir(vdir):
+                # an uploaded compiled artifact serves from the store
+                # exactly like a package upload does
+                return self._load_artifact(vdir, uri, kind="forge")
+            return self._load_package(vdir, uri, kind="forge")
         if source.startswith(("sqlite://", "http://", "https://")):
             return self._from_snapshot(Snapshotter.load(source), source,
                                        checksum="")
         if source.endswith(".zip"):
             return self._load_package(source, source)
         if os.path.isdir(source):
+            from .artifact import is_artifact_dir
+            if is_artifact_dir(source):
+                return self._load_artifact(source, source)
             if os.path.isfile(os.path.join(source, "contents.json")):
                 return self._load_package(source, source)
             snaps = list_snapshots(source)
@@ -367,7 +412,37 @@ class DeployController(Logger):
         return parts, {"label": label, "kind": "snapshot",
                        "checksum": checksum, "source": source}
 
-    def _load_package(self, path: str, source: str) -> Tuple[dict, dict]:
+    def _load_artifact(self, path: str, source: str,
+                       kind: str = "artifact") -> Tuple[dict, dict]:
+        """A compiled-artifact directory as a weight source: the deploy
+        flip moves WEIGHTS only — a live engine keeps its own compiled
+        programs (flat counters through the swap), an
+        :class:`~veles_tpu.runtime.artifact.ArtifactRunner` keeps its
+        deserialized ones.  Integrity = the manifest's tensors sha256
+        (SnapshotCorruptError propagates into the reload's 409)."""
+        from .artifact import load_artifact_weights, read_manifest
+        man = read_manifest(path)
+        saved = man.get("workflow_checksum")
+        live = self._live_checksum()
+        if saved and live and saved != live:
+            raise ValueError(
+                f"artifact {source!r} was exported from a different "
+                f"workflow (checksum {saved!r} != served {live!r}); "
+                "refusing the swap — the old version keeps serving")
+        loaded = load_artifact_weights(path, man)
+        parts = {"params": loaded["params"]}
+        if loaded.get("state"):
+            parts["state"] = loaded["state"]
+        if not parts["params"]:
+            raise ValueError(f"artifact {source!r} holds no params")
+        label = man.get("workflow") \
+            or os.path.basename(path.rstrip("/")) or path
+        return parts, {"label": label, "kind": kind,
+                       "checksum": man.get("tensors_sha256", ""),
+                       "source": source}
+
+    def _load_package(self, path: str, source: str,
+                      kind: str = "package") -> Tuple[dict, dict]:
         """An export-package (contents.json + npy) as a weight source.
         Tensors are routed into params/state via the LIVE template —
         the export disambiguated collisions with a ``state_`` prefix."""
@@ -413,7 +488,7 @@ class DeployController(Logger):
             parts["state"] = state
         label = (contents.get("workflow") or
                  os.path.basename(path.rstrip("/")) or path)
-        return parts, {"label": label, "kind": "package",
+        return parts, {"label": label, "kind": kind,
                        "checksum": checksum, "source": source}
 
     # -- staging + swap -----------------------------------------------------

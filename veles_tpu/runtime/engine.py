@@ -124,6 +124,110 @@ def place_like(tree, template):
     return placed
 
 
+def make_decode_fn(plan, ctx, S: int):
+    """The engine's lifetime decode program as an un-compiled jitted
+    function: advance all S slots one token with per-slot positions,
+    sampling params, and eos/length retirement.  Lives at module level
+    (not closed inside the engine) so the compiled-artifact exporter
+    (export/compiled.py) serializes EXACTLY the program the live engine
+    runs — a single source of step math, never two."""
+
+    def decode_step(params, caches, toks, pos, active, temp, topk,
+                    topp, eos, end, keys):
+        rows = jnp.arange(S)
+        tok = toks[rows, pos]
+        logits, caches = plan.step(params, caches, tok, pos, ctx)
+        step_keys = jax.vmap(jax.random.fold_in)(
+            jax.random.wrap_key_data(keys), pos)
+        nxt = _sample_slots(logits, step_keys, temp, topk, topp)
+        new_pos = jnp.where(active, pos + 1, pos)
+        cur = toks[rows, new_pos]
+        toks = toks.at[rows, new_pos].set(jnp.where(active, nxt, cur))
+        finished = active & ((nxt == eos) | (new_pos >= end))
+        return caches, toks, new_pos, active & ~finished, finished
+
+    return jax.jit(decode_step, donate_argnums=(1, 2))
+
+
+def make_prefill_fn(plan, ctx, pb: int, cache_dtype):
+    """The engine's bucketed-prefill program for bucket length ``pb``
+    (un-compiled jitted function; module-level for the same exporter
+    single-source reason as :func:`make_decode_fn`)."""
+
+    def prefill(params, caches, toks, prompt, true_len, slot, temp,
+                topk, topp, key_data):
+        local = plan.init_caches(params, 1, pb, cache_dtype)
+
+        def body(carry, pos):
+            local = carry
+            tok = prompt[:, pos]
+            # plan.step REBINDS the dict's top-level entries in
+            # place — hand it a shallow copy so ``local`` still
+            # holds the pre-step leaves the gate needs
+            logits, new = plan.step(params, dict(local), tok, pos, ctx)
+            # pad positions beyond the true prompt must not advance
+            # carried state (recurrent) nor write KV
+            valid = pos < true_len
+            local = jax.tree.map(
+                lambda n, o: jnp.where(valid, n, o), new, local)
+            return local, logits
+
+        local, ys = jax.lax.scan(body, local, jnp.arange(pb))
+        last = jax.lax.dynamic_index_in_dim(
+            ys, true_len - 1, 0, keepdims=False)        # (1, V)
+        key = jax.random.fold_in(
+            jax.random.wrap_key_data(key_data), true_len - 1)
+        first = _sample_slots(
+            last, key[None], temp[None], topk[None], topp[None])[0]
+        # splice the slot's fresh state into the engine batch
+        caches = jax.tree.map(
+            lambda big, loc: jax.lax.dynamic_update_slice(
+                big, loc.astype(big.dtype),
+                (slot,) + (jnp.int32(0),) * (loc.ndim - 1)),
+            caches, local)
+        row = jnp.where(jnp.arange(pb) < true_len, prompt[0], 0)
+        toks = jax.lax.dynamic_update_slice(
+            toks, row[None], (slot, jnp.int32(0)))
+        toks = toks.at[slot, true_len].set(first)
+        return caches, toks, first
+
+    return jax.jit(prefill, donate_argnums=(1, 2))
+
+
+def resolve_serve_geometry(slots=None, l_max=None, bucket_min=None):
+    """Slot-batch geometry with ``root.common.serve`` defaults — ONE
+    resolution shared by the live engine and the compiled-artifact
+    exporter (export/compiled.py), so a default-configured export's
+    bucket inventory is exactly what a default-configured engine
+    compiles."""
+    serve = root.common.serve
+    slots = int(slots if slots is not None else serve.get("slots", 8))
+    l_max = int(l_max if l_max is not None else serve.get("l_max", 512))
+    bucket_min = max(1, int(bucket_min if bucket_min is not None
+                            else serve.get("prefill_bucket_min", 16)))
+    if slots < 1 or l_max < 2:
+        raise ValueError("need slots >= 1 and l_max >= 2")
+    return slots, l_max, bucket_min
+
+
+def prefill_bucket(p: int, bucket_min: int, l_max: int) -> int:
+    """THE bucket function: pow2 ceiling of prompt length ``p``, floored
+    at ``bucket_min``, clipped to ``l_max``.  The live lookup and the
+    exporter's inventory (:func:`bucket_table`) must agree, or an
+    ArtifactRunner request maps to a bucket absent from the sealed
+    program set."""
+    return min(1 << max(0, math.ceil(math.log2(max(p, bucket_min)))),
+               l_max)
+
+
+def bucket_table(bucket_min: int, l_max: int):
+    """The fixed prefill-bucket set a (bucket_min, l_max) engine can ever
+    request — the compiled-artifact manifest's program inventory (one
+    exported prefill per entry)."""
+    return sorted({prefill_bucket(p, bucket_min, l_max)
+                   for p in range(1, l_max + 1)})
+
+
 class _Request:
     __slots__ = ("prompt", "n_steps", "temperature", "top_k", "top_p",
                  "eos_id", "key_data", "deadline", "done", "result",
@@ -215,31 +319,37 @@ class DecodeEngine(Logger):
                  deadline_s: Optional[float] = None,
                  output_unit: Optional[str] = None,
                  cache_dtype=jnp.float32, status=None):
-        serve = root.common.serve
         self.workflow = workflow
         self.wstate = wstate
-        self.slots = int(slots if slots is not None
-                         else serve.get("slots", 8))
-        self.l_max = int(l_max if l_max is not None
-                         else serve.get("l_max", 512))
+        self._init_config(slots=slots, l_max=l_max, window_ms=window_ms,
+                          queue_depth=queue_depth, deadline_s=deadline_s)
+        self.plan = DecodePlan(workflow, output_unit)
+        self.cache_dtype = cache_dtype
+        self._ctx = Context(train=False, key=None, mesh=None)
+        self.step_cache = StepCache()
+        self.status = status
+        self._init_runtime(wstate["params"])
+
+    def _init_config(self, *, slots, l_max, window_ms, queue_depth,
+                     deadline_s, bucket_min=None):
+        serve = root.common.serve
+        self.slots, self.l_max, self.bucket_min = \
+            resolve_serve_geometry(slots, l_max, bucket_min)
         self.window_s = float(window_ms if window_ms is not None
                               else serve.get("window_ms", 2.0)) / 1e3
         self.queue_depth = int(queue_depth if queue_depth is not None
                                else serve.get("queue_depth", 64))
         self.deadline_s = float(deadline_s if deadline_s is not None
                                 else serve.get("deadline_s", 120.0))
-        self.bucket_min = max(1, int(serve.get("prefill_bucket_min", 16)))
-        if self.slots < 1 or self.l_max < 2:
-            raise ValueError("need slots >= 1 and l_max >= 2")
-        self.plan = DecodePlan(workflow, output_unit)
-        self.cache_dtype = cache_dtype
-        self._ctx = Context(train=False, key=None, mesh=None)
-        self.step_cache = StepCache()
-        self.status = status
 
-        params = wstate["params"]
-        self._caches = self.plan.init_caches(
-            params, self.slots, self.l_max, cache_dtype)
+    def _init_runtime(self, params):
+        """Slot state + scheduler + gauges + the AOT decode program —
+        everything downstream of the three program hooks
+        (:meth:`_make_caches` / :meth:`_head_width` /
+        :meth:`_compile_decode`), which the artifact runner
+        (runtime/artifact.py) overrides to serve deserialized StableHLO
+        instead of freshly traced model code."""
+        self._caches = self._make_caches(params)
         self._toks = jnp.zeros((self.slots, self.l_max), jnp.int32)
         # host-side per-slot metadata, passed into the compiled step
         S = self.slots
@@ -281,11 +391,7 @@ class DecodeEngine(Logger):
         self._status_mark = 0.0
 
         # head width (== logits' last dim), for the top_k no-op sentinel
-        shallow = dict(self._caches)  # plan.step rebinds top-level keys
-        self._vocab = int(jax.eval_shape(
-            lambda p, c, t, pv: self.plan.step(p, c, t, pv, self._ctx)[0],
-            params, shallow, jnp.zeros(S, jnp.int32),
-            jnp.zeros(S, jnp.int32)).shape[-1])
+        self._vocab = self._head_width(params)
 
         # the lifetime decode program, AOT-compiled up front
         self._decode = self._compile_decode(params)
@@ -297,87 +403,48 @@ class DecodeEngine(Logger):
             lambda x: jax.ShapeDtypeStruct(jnp.shape(x), jnp.asarray(x).dtype),
             tree)
 
-    def _compile_decode(self, params):
-        plan, ctx = self.plan, self._ctx
+    def _make_caches(self, params):
+        return self.plan.init_caches(
+            params, self.slots, self.l_max, self.cache_dtype)
+
+    def _head_width(self, params) -> int:
         S = self.slots
+        shallow = dict(self._caches)  # plan.step rebinds top-level keys
+        return int(jax.eval_shape(
+            lambda p, c, t, pv: self.plan.step(p, c, t, pv, self._ctx)[0],
+            params, shallow, jnp.zeros(S, jnp.int32),
+            jnp.zeros(S, jnp.int32)).shape[-1])
 
-        def decode_step(params, caches, toks, pos, active, temp, topk,
-                        topp, eos, end, keys):
-            rows = jnp.arange(S)
-            tok = toks[rows, pos]
-            logits, caches = plan.step(params, caches, tok, pos, ctx)
-            step_keys = jax.vmap(jax.random.fold_in)(
-                jax.random.wrap_key_data(keys), pos)
-            nxt = _sample_slots(logits, step_keys, temp, topk, topp)
-            new_pos = jnp.where(active, pos + 1, pos)
-            cur = toks[rows, new_pos]
-            toks = toks.at[rows, new_pos].set(jnp.where(active, nxt, cur))
-            finished = active & ((nxt == eos) | (new_pos >= end))
-            return caches, toks, new_pos, active & ~finished, finished
-
-        fn = jax.jit(decode_step, donate_argnums=(1, 2))
-        args = self._sds((params, self._caches, self._toks, self._pos,
+    def _decode_args_sds(self, params):
+        return self._sds((params, self._caches, self._toks, self._pos,
                           self._active, self._temp, self._topk, self._topp,
                           self._eos, self._end, self._keys))
-        step, _, _ = self.step_cache.get_step(
-            "decode", (S, self.l_max), lambda: (fn, None, None), args,
-            pin=(self.workflow,))
-        return step
 
-    def _bucket(self, p: int) -> int:
-        return min(1 << max(0, math.ceil(math.log2(max(p, self.bucket_min)))),
-                   self.l_max)
-
-    def _prefill_fn(self, pb: int, params):
-        """Fetch/compile the prefill program for bucket length ``pb``."""
-        plan, ctx, dtype = self.plan, self._ctx, self.cache_dtype
-
-        def prefill(params, caches, toks, prompt, true_len, slot, temp,
-                    topk, topp, key_data):
-            local = plan.init_caches(params, 1, pb, dtype)
-
-            def body(carry, pos):
-                local = carry
-                tok = prompt[:, pos]
-                # plan.step REBINDS the dict's top-level entries in
-                # place — hand it a shallow copy so ``local`` still
-                # holds the pre-step leaves the gate needs
-                logits, new = plan.step(params, dict(local), tok, pos, ctx)
-                # pad positions beyond the true prompt must not advance
-                # carried state (recurrent) nor write KV
-                valid = pos < true_len
-                local = jax.tree.map(
-                    lambda n, o: jnp.where(valid, n, o), new, local)
-                return local, logits
-
-            local, ys = jax.lax.scan(body, local, jnp.arange(pb))
-            last = jax.lax.dynamic_index_in_dim(
-                ys, true_len - 1, 0, keepdims=False)        # (1, V)
-            key = jax.random.fold_in(
-                jax.random.wrap_key_data(key_data), true_len - 1)
-            first = _sample_slots(
-                last, key[None], temp[None], topk[None], topp[None])[0]
-            # splice the slot's fresh state into the engine batch
-            caches = jax.tree.map(
-                lambda big, loc: jax.lax.dynamic_update_slice(
-                    big, loc.astype(big.dtype),
-                    (slot,) + (jnp.int32(0),) * (loc.ndim - 1)),
-                caches, local)
-            row = jnp.where(jnp.arange(pb) < true_len, prompt[0], 0)
-            toks = jax.lax.dynamic_update_slice(
-                toks, row[None], (slot, jnp.int32(0)))
-            toks = toks.at[slot, true_len].set(first)
-            return caches, toks, first
-
-        fn = jax.jit(prefill, donate_argnums=(1, 2))
+    def _prefill_args_sds(self, params, pb: int):
         z32 = np.int32(0)
-        args = self._sds((params, self._caches, self._toks,
+        return self._sds((params, self._caches, self._toks,
                           np.zeros((1, pb), np.int32), z32, z32,
                           np.float32(0), z32, np.float32(1),
                           self._keys[0]))
+
+    def _compile_decode(self, params):
+        step, _, _ = self.step_cache.get_step(
+            "decode", (self.slots, self.l_max),
+            lambda: (make_decode_fn(self.plan, self._ctx, self.slots),
+                     None, None),
+            self._decode_args_sds(params), pin=(self.workflow,))
+        return step
+
+    def _bucket(self, p: int) -> int:
+        return prefill_bucket(p, self.bucket_min, self.l_max)
+
+    def _prefill_fn(self, pb: int, params):
+        """Fetch/compile the prefill program for bucket length ``pb``."""
         step, _, _ = self.step_cache.get_step(
             "prefill", (pb, self.slots, self.l_max),
-            lambda: (fn, None, None), args, pin=(self.workflow,))
+            lambda: (make_prefill_fn(self.plan, self._ctx, pb,
+                                     self.cache_dtype), None, None),
+            self._prefill_args_sds(params, pb), pin=(self.workflow,))
         return step
 
     # -- public API ---------------------------------------------------------

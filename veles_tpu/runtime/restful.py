@@ -51,14 +51,17 @@ import numpy as np
 
 from ..config import root
 from ..logger import Logger
+from .artifact import ArtifactError
 from .engine import EngineOverloaded, EngineStopped, SchedulerCrashed
+from .snapshotter import SnapshotCorruptError
 
 
 class RestfulServer(Logger):
     def __init__(self, predict_fn: Callable, wstate, batch_size: int,
                  input_shape, *, port: int = 0, host: str = "127.0.0.1",
                  normalizer=None, denormalizer=None, workflow=None,
-                 engine=None, input_dtype=np.float32):
+                 engine=None, input_dtype=np.float32,
+                 default_eos_id=None, vocab_size=None):
         self.predict_fn = predict_fn
         self.wstate = wstate
         self.batch_size = int(batch_size)
@@ -68,6 +71,15 @@ class RestfulServer(Logger):
         self.denormalizer = denormalizer
         self.workflow = workflow  # enables POST /generate (module doc)
         self.engine = engine      # continuous-batching /generate path
+        # server-level eos for requests that don't name one — how a
+        # compiled artifact's sealed eos metadata reaches serving
+        self.default_eos_id = (None if default_eos_id is None
+                               else int(default_eos_id))
+        # input-vocab bound for workflow-less serving (an artifact
+        # manifest's recorded embedding rows) — keeps the /predict
+        # out-of-vocab 400 alive when there is no workflow to scan
+        self.vocab_size = (None if vocab_size is None
+                           else int(vocab_size))
         self.deploy = None        # set by DeployController (lifecycle ops)
         outer = self
 
@@ -154,13 +166,17 @@ class RestfulServer(Logger):
                             # KeyError here (deploy.reload converts
                             # loader KeyErrors to ValueError)
                             self._reply({"error": str(e)}, code=404)
-                        except (ValueError, OSError, TimeoutError) as e:
-                            # load/signature/flip-timeout failure: the
-                            # old version is STILL SERVING (the reload
-                            # contract) — 409, not a 5xx that would
-                            # page someone or a 504 masquerading as a
-                            # request deadline.  EngineDraining is NOT
-                            # caught here: it falls to the 503 below.
+                        except (ValueError, OSError, TimeoutError,
+                                SnapshotCorruptError,
+                                ArtifactError) as e:
+                            # load/signature/flip-timeout failure —
+                            # including a corrupt / version-skewed /
+                            # non-artifact source: the old version is
+                            # STILL SERVING (the reload contract) —
+                            # 409, not a 5xx that would page someone
+                            # or a 504 masquerading as a request
+                            # deadline.  EngineDraining is NOT caught
+                            # here: it falls to the 503 below.
                             self._reply(
                                 {"error": f"{type(e).__name__}: {e}",
                                  "active": outer.deploy.registry
@@ -227,8 +243,7 @@ class RestfulServer(Logger):
             # the embedding lookup silently clips out-of-vocab ones —
             # the same 400-not-wrong-200 contract decode() enforces
             xi = np.asarray(x, np.int64)
-            vocab = (self._vocab_size() if self.workflow is not None
-                     else None)
+            vocab = self._vocab_size()
             hi = vocab if vocab is not None else 2 ** 31
             if xi.size and (xi.min() < 0 or xi.max() >= hi):
                 raise ValueError(
@@ -261,13 +276,17 @@ class RestfulServer(Logger):
 
     def _vocab_size(self) -> Optional[int]:
         """Embedding-table rows of the served workflow (None when the
-        chain has no embedding at the front)."""
-        from ..units.nn import Embedding
-        for u in self.workflow.topo_order():
-            if isinstance(u, Embedding):
-                return int(
-                    self.wstate["params"][u.name]["table"].shape[0])
-        return None
+        chain has no embedding at the front).  Workflow-less serving —
+        a compiled artifact — reads the manifest's recorded embedding
+        rows instead (``input_vocab``; NOT the output head width, which
+        is no bound on what a non-embedding front accepts)."""
+        if self.workflow is None:
+            if self.vocab_size is not None:
+                return self.vocab_size
+            v = getattr(self.engine, "input_vocab", None)
+            return int(v) if v else None
+        from ..units.nn import input_vocab
+        return input_vocab(self.workflow, self.wstate["params"])
 
     @staticmethod
     def _req_int(v, name):
@@ -292,10 +311,10 @@ class RestfulServer(Logger):
     def decode(self, req: dict) -> dict:
         """POST /generate body -> {"tokens": [[...]]} (+ "scores" for
         beam search)."""
-        if self.workflow is None:
+        if self.workflow is None and self.engine is None:
             raise ValueError(
                 "this server was started without a workflow; /generate "
-                "needs RestfulServer(..., workflow=wf)")
+                "needs RestfulServer(..., workflow=wf) or engine=")
         from .generate import generate
         # Coerce once at the boundary: np.asarray(..., int64) would
         # silently TRUNCATE fractional ids (2.7 -> 2) and a float/str
@@ -352,7 +371,9 @@ class RestfulServer(Logger):
                 "top_k/top_p filter sampling and need temperature > 0 "
                 "(temperature 0 is greedy decoding)")
         eos_id = req.get("eos_id")
-        if eos_id is not None:
+        if eos_id is None:
+            eos_id = self.default_eos_id  # e.g. the artifact's sealed
+        if eos_id is not None:            # eos metadata
             # forward the COERCED value: a float 2.0 would pass the
             # range check then raise TypeError inside generate_beam's
             # .at[eos_id]
@@ -365,6 +386,11 @@ class RestfulServer(Logger):
                     f"eos_id {eos_id} is outside the model "
                     f"vocabulary [0, {hi})")
         if beams > 1:
+            if self.workflow is None:
+                raise ValueError(
+                    "beam search needs the live workflow; compiled-"
+                    "artifact serving covers greedy/sampling decode "
+                    "(the sealed program set has no beam program)")
             if temperature > 0 or req.get("seed") is not None:
                 raise ValueError(
                     "beams is deterministic search; drop temperature/"

@@ -132,8 +132,14 @@ class StepCache(Logger):
     lifecycle keeps at zero across rollbacks and restores.
     """
 
-    def __init__(self, *, aot: bool = True):
+    def __init__(self, *, aot: bool = True, strict: bool = False):
         self.aot = aot
+        # strict: an AOT lower/compile failure RAISES instead of
+        # falling back to on-demand jit.  The lazy fallback is a valid
+        # degradation for freshly traced model code (exotic signatures
+        # still run); for a sealed artifact's deserialized programs it
+        # would turn a load-time failure into a mid-request crash.
+        self.strict = strict
         self._entries: Dict[Any, dict] = {}
         self.compiles = 0
         self.hits = 0
@@ -183,6 +189,8 @@ class StepCache(Logger):
                 try:
                     compiled = fn.lower(*args).compile()
                 except Exception as e:  # exotic signature: keep the jit
+                    if self.strict:
+                        raise
                     self.warning(
                         "AOT compile of %s step failed (%s: %s); falling "
                         "back to on-demand jit", kind, type(e).__name__, e)

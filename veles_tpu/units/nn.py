@@ -600,6 +600,18 @@ class Embedding(Unit):
         return jnp.take(params["table"], idx, axis=0), state
 
 
+def input_vocab(workflow, params) -> Optional[int]:
+    """Embedding-table rows of the chain's front (None without an
+    Embedding) — THE bound on acceptable input token ids, shared by the
+    REST /predict out-of-vocab 400 guard (restful._vocab_size) and the
+    compiled-artifact export's sealed ``input_vocab`` so the two can
+    never drift."""
+    for u in workflow.topo_order():
+        if isinstance(u, Embedding):
+            return int(np.shape(params[u.name]["table"])[0])
+    return None
+
+
 class SeqLast(Unit):
     """(B, T, ...) -> (B, ...): the final time step (e.g. next-token
     readout after causal attention)."""
