@@ -42,6 +42,9 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "artifact: compiled-artifact export/runner tests "
         "(tier-1; select alone with -m artifact)")
+    config.addinivalue_line(
+        "markers", "paged: paged KV cache / shared-prefix reuse tests "
+        "(tier-1; select alone with -m paged)")
 
 
 @pytest.fixture(autouse=True)

@@ -99,6 +99,8 @@ def test_cli_visualize(tmp_path, config_file):
     assert "digraph" in src and '"fc1"' in src and '"@labels"' in src
 
 
+@pytest.mark.slow  # spawns a detached CLI training process (the slow
+# marker's multi-process case; tier-1 wall-clock budget)
 def test_cli_background_daemonizes(tmp_path, config_file):
     import time
     res = tmp_path / "res.json"
@@ -123,6 +125,8 @@ GA_CONFIG_PY = CONFIG_PY.replace(
     '    root.my.lr = Range(0.05, 0.005, 0.2)')
 
 
+@pytest.mark.slow  # farms chromosomes to concurrent CLI subprocesses
+# (multi-process; ~25s on the 2-cpu tier-1 box)
 def test_cli_optimize_parallel_workers(tmp_path):
     """--optimize with --workers N farms each chromosome to a standalone
     CLI subprocess (reference slave farm-out,
@@ -140,6 +144,8 @@ def test_cli_optimize_parallel_workers(tmp_path):
     assert len(hist) == 2
 
 
+@pytest.mark.slow  # concurrent CLI training subprocesses
+# (multi-process; tier-1 wall-clock budget)
 def test_cli_ensemble_train_parallel_workers(tmp_path, config_file):
     """--ensemble-train with --workers: members run as concurrent
     standalone CLI trainings (reference:
@@ -223,6 +229,9 @@ MESH_CONFIG_JSON = json.dumps({
 })
 
 
+@pytest.mark.slow  # subprocess training on the virtual 8-device mesh
+# (tier-1 wall-clock budget; in-process mesh/MoE sharding coverage
+# stays tier-1 via test_parallel / test_pipeline_moe)
 def test_cli_mesh_with_moe_autoshards(tmp_path):
     """--mesh data=4,expert=2 on a config containing an MoE unit composes
     the expert sharding rule automatically."""
@@ -253,6 +262,8 @@ def test_cli_profile_units(tmp_path, config_file):
     assert "TOTAL" in r.stdout and "fc1" in r.stdout
 
 
+@pytest.mark.slow  # three full CLI training subprocesses just for
+# seed-form parsing (tier-1 wall-clock budget)
 def test_cli_random_seed_forms(tmp_path, config_file):
     """--random-seed accepts int, 0x-hex, and entropy files (reference:
     veles/__main__.py:483-537)."""
@@ -270,6 +281,8 @@ def test_cli_random_seed_forms(tmp_path, config_file):
     assert r.returncode != 0
 
 
+@pytest.mark.slow  # subprocess training under the jax profiler (~25s
+# on the 2-cpu tier-1 box)
 def test_profile_flag_writes_trace(tmp_path, config_file):
     """--profile DIR captures a device-level jax.profiler trace."""
     import glob

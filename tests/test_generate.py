@@ -287,6 +287,9 @@ def test_beam_width_one_is_greedy(rng):
         assert np.all(np.isfinite(np.asarray(scores)))
 
 
+@pytest.mark.slow  # ~90s on the 2-cpu tier-1 box (brute-force
+# enumeration + a W=V^n beam program); width-monotonicity coverage
+# stays tier-1 via test_beam_covering_width_bounds_all_widths
 def test_beam_finds_global_optimum(rng):
     """A beam wide enough to cover the search space must return the
     maximum-total-log-prob continuation — checked against brute-force
@@ -500,6 +503,8 @@ def test_decode_cost_is_linear_in_context(rng):
             p, c, t, jnp.asarray(P - 1), ctx))
         an = f.lower(ws["params"], caches,
                      jnp.zeros((B,), jnp.int32)).compile().cost_analysis()
+        if isinstance(an, (list, tuple)):  # older jax wraps per-device
+            an = an[0] if an else {}
         return an["flops"]
 
     c1, c4 = cost(128), cost(512)

@@ -1,0 +1,472 @@
+"""Paged KV cache + shared-prefix reuse (runtime/engine.py): the page
+pool must serve tokens bitwise-identical to the dense layout and to
+per-request generate() — across mixed shapes, sampling, prefix-cache
+hits, and mid-page divergence (copy-on-write) — with the StepCache
+counters flat over page allocation, reclamation, eviction, prefix-hit
+admission and COW; pool exhaustion must answer the existing
+429/Retry-After backpressure even at low slot occupancy; and a sealed
+artifact must round-trip the whole paged engine, scheduler-side prefix
+cache included."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import veles_tpu as vt
+from veles_tpu.models.standard import build_workflow
+from veles_tpu.ops import optimizers as opt
+from veles_tpu.runtime.engine import (DecodeEngine, EngineOverloaded,
+                                      resolve_serve_geometry)
+from veles_tpu.runtime.generate import generate
+
+pytestmark = pytest.mark.paged
+
+V = 12
+
+LAYERS = [
+    {"type": "embedding", "vocab": V, "dim": 16, "name": "emb"},
+    {"type": "attention", "n_heads": 2, "rope": True,
+     "residual": True, "name": "a1"},
+    {"type": "layer_norm", "name": "n1"},
+    {"type": "ffn", "d_hidden": 32, "name": "f1"},
+    {"type": "seq_last", "name": "last"},
+    {"type": "softmax", "output_size": V, "name": "out"},
+]
+
+
+def _build_lm(layers=LAYERS, seed=3, name="paged_lm"):
+    wf = build_workflow(name, layers)
+    wf.build({"@input": vt.Spec((2, 6), jnp.int32),
+              "@labels": vt.Spec((2,), jnp.int32),
+              "@mask": vt.Spec((2,), jnp.float32)})
+    ws = wf.init_state(jax.random.key(seed), opt.SGD(0.1))
+    return wf, ws
+
+
+@pytest.fixture(scope="module")
+def lm():
+    return _build_lm()
+
+
+def _wait(cond, timeout=60, what=""):
+    deadline = time.monotonic() + timeout
+    while not cond():
+        assert time.monotonic() < deadline, what
+        time.sleep(0.002)
+
+
+# -- bitwise identity ---------------------------------------------------------
+
+def test_paged_matches_dense_and_generate(lm, rng):
+    """Greedy tokens through the page-pool layout are bitwise the dense
+    engine's AND per-request generate()'s for mixed prompt lengths —
+    page indirection is data flow, not new math."""
+    wf, ws = lm
+    # one shape per prefill bucket (16/32/64) + a sub-page short one —
+    # full coverage without paying extra generate() scan compiles
+    shapes = [(3, 5), (17, 6), (33, 8), (13, 2)]
+    prompts = [rng.integers(0, V, (1, p)).astype(np.int32)
+               for p, _ in shapes]
+    refs = [np.asarray(generate(wf, ws, pr, n))
+            for pr, (_, n) in zip(prompts, shapes)]
+    dense = DecodeEngine(wf, ws, slots=4, l_max=64, window_ms=1.0,
+                         paged=False).start()
+    try:
+        got_d = [dense.generate(pr, n, timeout=180)
+                 for pr, (_, n) in zip(prompts, shapes)]
+    finally:
+        dense.stop()
+    paged = DecodeEngine(wf, ws, slots=4, l_max=64, window_ms=1.0,
+                         paged=True).start()
+    try:
+        got_p = [paged.generate(pr, n, timeout=180)
+                 for pr, (_, n) in zip(prompts, shapes)]
+        st = paged.stats()
+    finally:
+        paged.stop()
+    for i, (d, p, r) in enumerate(zip(got_d, got_p, refs)):
+        np.testing.assert_array_equal(d, r, err_msg=f"dense {shapes[i]}")
+        np.testing.assert_array_equal(p, r, err_msg=f"paged {shapes[i]}")
+    assert st["paged"] and st["pages"]["pages"] == 16
+    assert st["compile"]["recompiles"] == 0
+
+
+def test_sampled_paged_bitwise_matches_generate(lm, rng):
+    """Per-slot sampling keys fold the GLOBAL position, so prefix-hit
+    prefills (which start mid-prompt) still reproduce generate() bit
+    for bit under the same key."""
+    wf, ws = lm
+    eng = DecodeEngine(wf, ws, slots=2, l_max=32).start()
+    prompt = rng.integers(0, V, (1, 18)).astype(np.int32)
+    try:
+        for kwargs in ({"temperature": 2.0, "top_k": 4},
+                       {"temperature": 1.5, "top_p": 0.9}):
+            ref = np.asarray(generate(wf, ws, prompt, 6,
+                                      key=jax.random.key(7), **kwargs))
+            got = eng.generate(prompt, 6, key=jax.random.key(7),
+                               timeout=120, **kwargs)
+            np.testing.assert_array_equal(got, ref, err_msg=str(kwargs))
+        # second pass: the prompt's full page is now cached, so this
+        # sampled request admits through a PREFIX HIT — tokens must not
+        # move (the fold position is global, not bucket-relative)
+        ref = np.asarray(generate(wf, ws, prompt, 6, temperature=2.0,
+                                  top_k=4, key=jax.random.key(7)))
+        got = eng.generate(prompt, 6, temperature=2.0, top_k=4,
+                           key=jax.random.key(7), timeout=120)
+        np.testing.assert_array_equal(got, ref)
+        assert eng.stats()["pages"]["prefix_hit_pages"] >= 1
+    finally:
+        eng.stop()
+
+
+def test_shared_prefix_cow_bitwise_and_flat_counters(lm, rng):
+    """The COW contract: request B shares request A's prompt up to a
+    mid-page divergence — B maps A's full prefix pages read-only,
+    recomputes from the first divergent page into private pages, and A's
+    shared pages are provably uncorrupted (A resubmits bitwise).  Compile
+    counters stay flat across the hit, the divergence, and reclamation."""
+    wf, ws = lm
+    eng = DecodeEngine(wf, ws, slots=4, l_max=64, window_ms=1.0).start()
+    sysp = rng.integers(0, V, 32).astype(np.int32)       # 2 full pages
+    a = np.concatenate([sysp, rng.integers(0, V, 7).astype(np.int32)])
+    b = np.concatenate([a[:36], rng.integers(0, V, 5).astype(np.int32)])
+    assert not np.array_equal(a[:41], b[:41])
+    try:
+        ra = np.asarray(generate(wf, ws, a[None], 6))
+        rb = np.asarray(generate(wf, ws, b[None], 6))
+        np.testing.assert_array_equal(eng.generate(a[None], 6,
+                                                   timeout=120), ra)
+        compiles = eng.stats()["compile"]["compiles"]
+        np.testing.assert_array_equal(eng.generate(b[None], 6,
+                                                   timeout=120), rb)
+        # A again: its shared pages survived B's divergence untouched
+        np.testing.assert_array_equal(eng.generate(a[None], 6,
+                                                   timeout=120), ra)
+        st = eng.stats()
+        pg = st["pages"]
+        # B hit A's 2 system-prompt pages; A's resubmit hit its own 2
+        assert pg["prefix_hit_pages"] == 4, pg
+        assert pg["cow_admissions"] == 2, pg
+        assert pg["prefix_hit_rate"] > 0
+        # the prefix-hit prefills compiled NOTHING new (bucket 16 was
+        # already warm from... it was not: B's tail is 9 tokens -> the
+        # 16 bucket; allow that one legitimate bucket compile, then the
+        # A resubmit must be pure cache hits)
+        assert st["compile"]["compiles"] <= compiles + 1, st["compile"]
+        assert st["compile"]["recompiles"] == 0
+    finally:
+        eng.stop()
+
+
+def test_recurrent_chain_gets_no_prefix_shortcut(rng):
+    """Recurrent carried state is position-recurrent from token 0 and is
+    not paged — identical prompts must NOT take prefix shortcuts on such
+    chains (results would be garbage); they still serve bitwise."""
+    wf, ws = _build_lm([
+        {"type": "embedding", "vocab": V, "dim": 12, "name": "emb"},
+        {"type": "attention", "n_heads": 2, "rope": True,
+         "residual": True, "name": "a1"},
+        {"type": "gru", "hidden": 12, "name": "g1"},
+        {"type": "seq_last", "name": "last"},
+        {"type": "softmax", "output_size": V, "name": "out"},
+    ], name="paged_rec")
+    eng = DecodeEngine(wf, ws, slots=2, l_max=64).start()
+    prompt = rng.integers(0, V, (1, 20)).astype(np.int32)
+    try:
+        ref = np.asarray(generate(wf, ws, prompt, 5))
+        np.testing.assert_array_equal(
+            eng.generate(prompt, 5, timeout=120), ref)
+        np.testing.assert_array_equal(
+            eng.generate(prompt, 5, timeout=120), ref)
+        pg = eng.stats()["pages"]
+        assert pg["prefix_hit_pages"] == 0 and pg["cow_admissions"] == 0
+    finally:
+        eng.stop()
+
+
+# -- pool capacity / backpressure --------------------------------------------
+
+def test_pool_exhaustion_answers_429_at_low_slot_occupancy(lm, rng):
+    """Long prompts exhaust the PAGE POOL while most slots sit free: a
+    new submit must get the existing 429/Retry-After backpressure (the
+    slot table alone no longer describes capacity), and once the pool
+    drains the same request admits."""
+    wf, ws = lm
+    eng = DecodeEngine(wf, ws, slots=4, l_max=80, pages=10,
+                       window_ms=0.0).start()   # 10 pages x 16 tokens
+    try:
+        held = [eng.submit(rng.integers(0, V, 48), 30)  # 5 pages each
+                for _ in range(2)]
+        _wait(lambda: eng.stats()["occupancy"] == 2, 60, "admission")
+        st = eng.stats()
+        assert st["pages"]["free"] == 0 and st["occupancy"] == 2
+        assert st["occupancy"] < st["slots"]     # slots are NOT the cap
+        with pytest.raises(EngineOverloaded) as ei:
+            eng.submit(rng.integers(0, V, 8), 8)  # 1 page: still refused
+        assert ei.value.retry_after_s >= 1.0
+        assert eng.stats()["pages"]["pool_rejected"] == 1
+        for r in held:
+            assert r.done.wait(180) and r.error is None
+        # pool drained (pages cached/free again): the request now admits
+        out = eng.generate(rng.integers(0, V, (1, 8)).astype(np.int32),
+                           8, timeout=120)
+        assert out.shape == (1, 16)
+        assert eng.stats()["compile"]["recompiles"] == 0
+    finally:
+        eng.stop()
+
+
+def test_busy_slots_keep_queue_backpressure_semantics(lm, rng):
+    """When the SLOT table is the binding constraint the queue keeps its
+    PR-2 contract: pool shortage alone must not 429 work that is merely
+    waiting behind busy slots."""
+    wf, ws = lm
+    eng = DecodeEngine(wf, ws, slots=1, l_max=64, queue_depth=2,
+                       window_ms=0.0).start()   # pool = 4 pages
+    try:
+        held = [eng.submit(rng.integers(0, V, 40), 20)]  # 4 pages: all
+        _wait(lambda: eng.stats()["occupancy"] == 1
+              and eng.stats()["queue_depth"] == 0, 60, "busy")
+        # pool is exhausted AND slots are busy: these queue, no 429
+        held += [eng.submit(rng.integers(0, V, 40), 20)
+                 for _ in range(2)]
+        with pytest.raises(EngineOverloaded):   # queue full, as ever
+            eng.submit(rng.integers(0, V, 4), 4)
+        for r in held:
+            assert r.done.wait(240) and r.error is None
+    finally:
+        eng.stop()
+
+
+def test_page_reclamation_and_lru_eviction_flat_counters(lm, rng):
+    """A pool much smaller than the traffic's total footprint: retired
+    requests' pages recycle, the prefix cache evicts LRU entries instead
+    of wedging, and the compile counters never move."""
+    wf, ws = lm
+    eng = DecodeEngine(wf, ws, slots=2, l_max=64, pages=4,
+                       window_ms=0.0).start()
+    prompts = [rng.integers(0, V, (1, 20)).astype(np.int32)
+               for _ in range(6)]
+    try:
+        for pr in prompts:                       # 2 pages each, serial
+            np.testing.assert_array_equal(
+                eng.generate(pr, 6, timeout=120),
+                np.asarray(generate(wf, ws, pr, 6)))
+        compiles = eng.stats()["compile"]["compiles"]
+        st = eng.stats()["pages"]
+        assert st["evictions"] > 0, st           # cache outgrew the pool
+        # the earliest prompt's cached page was evicted; it still serves
+        np.testing.assert_array_equal(
+            eng.generate(prompts[0], 6, timeout=120),
+            np.asarray(generate(wf, ws, prompts[0], 6)))
+        assert eng.stats()["compile"]["compiles"] == compiles
+        assert eng.stats()["compile"]["recompiles"] == 0
+    finally:
+        eng.stop()
+
+
+@pytest.mark.slow
+def test_pool_exhaustion_long_prompt_sweep(lm, rng):
+    """Sustained long-prompt load cycling the whole pool many times
+    under concurrency: every request serves correctly, pages never leak
+    (the pool returns to fully-available), counters stay flat."""
+    wf, ws = lm
+    eng = DecodeEngine(wf, ws, slots=4, l_max=80, pages=12,
+                       window_ms=1.0, queue_depth=64).start()
+    work = [(rng.integers(0, V, (1, int(p))).astype(np.int32), int(n))
+            for p, n in zip(rng.integers(30, 60, 24),
+                            rng.integers(4, 16, 24))]
+    refs = [np.asarray(generate(wf, ws, pr, n)) for pr, n in work]
+    try:
+        results = [None] * len(work)
+
+        def worker(i):
+            results[i] = eng.generate(work[i][0], work[i][1],
+                                      timeout=300)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(len(work))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600)
+        for i, (got, ref) in enumerate(zip(results, refs)):
+            np.testing.assert_array_equal(got, ref, err_msg=str(i))
+        st = eng.stats()
+        assert st["compile"]["recompiles"] == 0
+        pg = st["pages"]
+        assert pg["used"] == 0 and pg["free"] + pg["cached"] == 12, pg
+    finally:
+        eng.stop()
+
+
+def test_pool_backpressure_discounts_prefix_hits(lm, rng):
+    """The 429 check must not count pages a request would SHARE: with
+    the pool nearly exhausted, a request whose system prompt is cached
+    (and pinned by an active slot) admits through its hits while an
+    equal-sized cold request is refused."""
+    wf, ws = lm
+    eng = DecodeEngine(wf, ws, slots=4, l_max=64, pages=8,
+                       window_ms=0.0).start()
+    sysp = rng.integers(0, V, 32).astype(np.int32)       # 2 full pages
+    try:
+        # A pins + registers the system prompt (3 pages total), B fills
+        # 4 more: 7 of 8 pages used at occupancy 2 (slots NOT the cap)
+        a = eng.submit(np.concatenate(
+            [sysp, rng.integers(0, V, 1).astype(np.int32)]), 14)
+        b = eng.submit(rng.integers(0, V, 48), 15)
+        _wait(lambda: eng.stats()["occupancy"] == 2, 60, "admission")
+        assert eng.stats()["pages"]["used"] == 7
+        with pytest.raises(EngineOverloaded):   # cold 3-page request
+            eng.submit(rng.integers(0, V, 36), 4)
+        # same span, but 2 of its 3 pages are the cached system prompt
+        c = eng.submit(np.concatenate(
+            [sysp, rng.integers(0, V, 4).astype(np.int32)]), 4)
+        assert c.done.wait(180) and c.error is None
+        assert eng.stats()["pages"]["prefix_hit_pages"] >= 2
+        for r in (a, b):
+            assert r.done.wait(180) and r.error is None
+    finally:
+        eng.stop()
+
+
+def test_hot_swap_invalidates_prefix_cache(lm, rng):
+    """Cached prefix pages hold KV computed under the weights that
+    prefilled them: a hot swap must drop the index, so post-swap
+    requests re-prefill under the NEW weights (bitwise vs generate())
+    instead of attending to stale-model KV — and the cache then
+    rebuilds under the new version."""
+    wf, ws_a = lm
+    _, ws_b = _build_lm(seed=97)                 # same arch, new weights
+    eng = DecodeEngine(wf, ws_a, slots=2, l_max=64, window_ms=0.0).start()
+    prompt = rng.integers(0, V, (1, 37)).astype(np.int32)  # 2 full pages
+    try:
+        np.testing.assert_array_equal(
+            eng.generate(prompt, 5, timeout=120),
+            np.asarray(generate(wf, ws_a, prompt, 5)))
+        assert eng.stats()["pages"]["cached"] == 2
+        eng.swap_params(ws_b["params"])
+        assert eng.stats()["pages"]["cached"] == 0   # index dropped
+        hit0 = eng.stats()["pages"]["prefix_hit_pages"]
+        got = eng.generate(prompt, 5, timeout=120)
+        np.testing.assert_array_equal(
+            got, np.asarray(generate(wf, ws_b, prompt, 5)))
+        assert eng.stats()["pages"]["prefix_hit_pages"] == hit0  # no
+        # stale hit; the re-prefill re-registered under the new weights
+        np.testing.assert_array_equal(
+            eng.generate(prompt, 5, timeout=120), got)
+        assert eng.stats()["pages"]["prefix_hit_pages"] == hit0 + 2
+        assert eng.stats()["compile"]["recompiles"] == 0
+    finally:
+        eng.stop()
+
+
+# -- geometry ----------------------------------------------------------------
+
+def test_geometry_validation():
+    geo = resolve_serve_geometry(4, 64)
+    assert geo.paged and geo.page_size == 16 and geo.pages == 16
+    assert geo.n_ptab == 4
+    # a default page size that does not divide l_max halves itself
+    assert resolve_serve_geometry(2, 24).page_size == 8
+    with pytest.raises(ValueError, match="must divide"):
+        resolve_serve_geometry(2, 24, page_size=16)
+    with pytest.raises(ValueError, match="max-length"):
+        resolve_serve_geometry(2, 64, pages=2)
+
+
+# -- sealed artifacts ---------------------------------------------------------
+
+def test_paged_artifact_roundtrip_bitwise_flat_counters(lm, tmp_path,
+                                                        rng):
+    """Export -> ArtifactRunner with the paged layout: the manifest
+    records the pool geometry + prefix_reuse, boot compiles the whole
+    inventory, greedy tokens (prefix-hit admissions included) are
+    bitwise the live paged engine's and generate()'s, and the counters
+    never move after boot."""
+    from veles_tpu.export import export_compiled
+    from veles_tpu.runtime.artifact import ArtifactRunner
+    wf, ws = lm
+    art = str(tmp_path / "art")
+    man = export_compiled(wf, ws, art, slots=2, l_max=32)
+    assert man["paged"] and man["prefix_reuse"]
+    assert man["page_size"] == 16 and man["pages"] == 4
+    r = ArtifactRunner(art, window_ms=0.0).start()
+    try:
+        boot = r.stats()["compile"]["compiles"]
+        sysp = rng.integers(0, V, 16).astype(np.int32)   # 1 full page
+        a = np.concatenate([sysp, rng.integers(0, V, 3).astype(np.int32)])
+        b = np.concatenate([sysp, rng.integers(0, V, 5).astype(np.int32)])
+        for pr, n in ((a[None], 5), (b[None], 4), (a[None], 5)):
+            ref = np.asarray(generate(wf, ws, pr, n))
+            np.testing.assert_array_equal(
+                r.generate(pr, n, timeout=180), ref)
+        st = r.stats()
+        assert st["pages"]["prefix_hit_pages"] == 2, st["pages"]
+        assert st["compile"]["compiles"] == boot
+        assert st["compile"]["recompiles"] == 0
+    finally:
+        r.stop()
+
+
+def test_dense_artifact_still_loads(lm, tmp_path, rng):
+    """paged=False exports the PR-5 dense layout (the manifest says so)
+    and the runner serves it — the version-1 compatibility path."""
+    from veles_tpu.export import export_compiled
+    from veles_tpu.runtime.artifact import ArtifactRunner
+    wf, ws = lm
+    art = str(tmp_path / "dense_art")
+    man = export_compiled(wf, ws, art, slots=2, l_max=32, paged=False)
+    assert not man["paged"] and man["pages"] is None
+    r = ArtifactRunner(art, window_ms=0.0).start()
+    try:
+        assert not r.paged
+        prompt = rng.integers(0, V, (1, 5)).astype(np.int32)
+        ref = np.asarray(generate(wf, ws, prompt, 4))
+        np.testing.assert_array_equal(r.generate(prompt, 4, timeout=180),
+                                      ref)
+        assert "pages" not in r.stats()
+    finally:
+        r.stop()
+
+
+# -- observability ------------------------------------------------------------
+
+def test_page_gauges_reach_status_and_rest(lm, tmp_path, rng):
+    """The pool gauges ride the existing status path: stats() ->
+    StatusReporter -> status.json (+ dotted HTML rows) and GET /engine."""
+    from veles_tpu.runtime.restful import RestfulServer
+    from veles_tpu.runtime.status import StatusReporter, StatusServer
+    wf, ws = lm
+    rep = StatusReporter(str(tmp_path / "status.json"), name="serve")
+    eng = DecodeEngine(wf, ws, slots=2, l_max=32, status=rep)
+    srv = RestfulServer(wf.make_predict_step("out"), ws, 2, (6,),
+                        workflow=wf, engine=eng).start()
+    try:
+        eng.generate(rng.integers(0, V, (1, 4)).astype(np.int32), 4,
+                     timeout=120)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/engine") as resp:
+            st = json.loads(resp.read())
+        for k in ("page_size", "pages", "free", "used", "cached",
+                  "prefix_hit_rate", "tokens_resident", "evictions",
+                  "cow_admissions"):
+            assert k in st["pages"], k
+        _wait(lambda: "engine" in rep._extra, 10, "reporter")
+        assert "pages" in rep.read()["engine"]
+        ssrv = StatusServer(rep).start()
+        try:
+            page = urllib.request.urlopen(
+                f"http://127.0.0.1:{ssrv.port}/").read().decode()
+            assert "engine.pages.prefix_hit_rate" in page
+            assert "engine.pages.tokens_resident" in page
+        finally:
+            ssrv.stop()
+    finally:
+        srv.stop()

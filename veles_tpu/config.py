@@ -278,6 +278,13 @@ def _defaults():
     root.common.serve.slots = 8              # decode slots (engine batch)
     root.common.serve.l_max = 512            # per-slot KV length cap
     root.common.serve.prefill_bucket_min = 16  # smallest pow2 prompt bucket
+    # Paged KV cache + shared-prefix reuse (docs/serving.md "Paged KV
+    # cache"): the pool, not slots*l_max, is the real token capacity.
+    root.common.serve.paged = True           # page-pool KV layout
+    root.common.serve.page_size = 16         # tokens per page (divides
+    #                                          l_max; halves itself if not)
+    root.common.serve.pages = None           # pool size; None = the
+    #                                          dense-equivalent slots*l_max
     root.common.serve.window_ms = 2.0        # admission batching window
     root.common.serve.queue_depth = 64       # pending requests before 429
     root.common.serve.deadline_s = 120.0     # default per-request deadline

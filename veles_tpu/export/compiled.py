@@ -50,7 +50,13 @@ from ..units.workflow import WorkflowError
 #: the deploy control plane uses to recognize ``artifact://`` sources.
 MANIFEST = "artifact.json"
 FORMAT = "veles-tpu-compiled-artifact"
-FORMAT_VERSION = 1
+#: 2 = paged KV-cache layout (cache avals are a page pool, the decode /
+#: prefill calling conventions carry a page table, and the manifest
+#: records ``paged`` / ``page_size`` / ``pages`` / ``prefix_reuse``).
+#: Version-1 (dense) artifacts still load — the runner keeps both
+#: layouts — but v2 artifacts are refused by older readers
+#: (docs/serving_export.md).
+FORMAT_VERSION = 2
 
 
 def _aval_rows(tree):
@@ -133,6 +139,9 @@ def export_compiled(workflow, wstate, out_dir: str, *,
                     slots: Optional[int] = None,
                     l_max: Optional[int] = None,
                     bucket_min: Optional[int] = None,
+                    paged: Optional[bool] = None,
+                    page_size: Optional[int] = None,
+                    pages: Optional[int] = None,
                     cache_dtype=jnp.float32,
                     output_unit: Optional[str] = None,
                     input_spec: Optional[dict] = None,
@@ -144,11 +153,17 @@ def export_compiled(workflow, wstate, out_dir: str, *,
     build batch shape, or ``input_spec`` {"shape", "dtype"} when given).
     For decodable sequence chains additionally exports the engine's
     **fixed program set** — one prefill per pow2 bucket and the single
-    decode step — sized by ``slots`` / ``l_max`` / ``bucket_min``
-    (defaults from ``root.common.serve``, the live engine's own knobs).
-    A chain ``DecodePlan`` rejects simply ships forward-only (the
-    manifest omits the decode program and records why under
-    ``decode_unsupported``).
+    decode step — sized by ``slots`` / ``l_max`` / ``bucket_min`` /
+    ``paged`` / ``page_size`` / ``pages`` (defaults from
+    ``root.common.serve``, the live engine's own knobs).  Under the
+    default paged layout the sealed programs carry the per-slot page
+    table in their calling convention and the manifest records the pool
+    geometry plus ``prefix_reuse`` (whether the chain's state is pure
+    attention KV, i.e. safe for shared-prefix shortcuts) — the
+    ArtifactRunner rebuilds the exact paged engine, scheduler-side
+    prefix cache included.  A chain ``DecodePlan`` rejects simply ships
+    forward-only (the manifest omits the decode program and records why
+    under ``decode_unsupported``).
     """
     from ..runtime.engine import (bucket_table, make_decode_fn,
                                   make_prefill_fn,
@@ -158,8 +173,9 @@ def export_compiled(workflow, wstate, out_dir: str, *,
     from ..units.base import Context
     from ..units.nn import input_vocab as _input_vocab
 
-    slots, l_max, bucket_min = resolve_serve_geometry(
-        slots, l_max, bucket_min)
+    geo = resolve_serve_geometry(slots, l_max, bucket_min, paged=paged,
+                                 page_size=page_size, pages=pages)
+    slots, l_max, bucket_min = geo.slots, geo.l_max, geo.bucket_min
 
     prog_dir = os.path.join(out_dir, "programs")
     os.makedirs(prog_dir, exist_ok=True)
@@ -237,10 +253,14 @@ def export_compiled(workflow, wstate, out_dir: str, *,
             plan, decode_reason = None, f"{type(e).__name__}: {e}"
         if plan is not None:
             ctx = Context(train=False, key=None, mesh=None)
+            psz = geo.page_size if geo.paged else None
             # avals only — never materialize the slot-batch KV caches on
             # the export host (slots x l_max can be GBs for a real LM)
             csds = jax.eval_shape(
-                lambda p: plan.init_caches(p, slots, l_max, cache_dtype),
+                lambda p: plan.init_caches(
+                    p, slots, l_max, cache_dtype,
+                    kv_rows=geo.pages + 1 if geo.paged else None,
+                    page_size=psz),
                 params)
             cache_rows = _aval_rows(csds)
             kd = jax.random.key_data(jax.random.key(0))
@@ -252,29 +272,47 @@ def export_compiled(workflow, wstate, out_dir: str, *,
                 sh, jnp.float32)
             toks = jax.ShapeDtypeStruct((S, l_max), jnp.int32)
             keys = jax.ShapeDtypeStruct((S,) + kd.shape, kd.dtype)
+            pages_arg = None
+            if geo.paged:
+                pages_arg = (jnp.zeros((S, geo.n_ptab), jnp.int32), psz,
+                             jnp.zeros(S, bool))
             vocab = int(jax.eval_shape(
-                lambda p, c, t, pv: plan.step(p, c, t, pv, ctx)[0],
+                lambda p, c, t, pv: plan.step(p, c, t, pv, ctx,
+                                              pages=pages_arg)[0],
                 psds, dict(csds), i32(S), i32(S)).shape[-1])
             if eos_id is not None and not 0 <= int(eos_id) < vocab:
                 raise ValueError(f"eos_id {eos_id} is outside the "
                                  f"exported model's vocabulary "
                                  f"[0, {vocab})")
 
+            if geo.paged:  # page table rides the calling convention
+                decode_sds = (psds, csds, toks, i32(S, geo.n_ptab),
+                              i32(S), jax.ShapeDtypeStruct((S,), jnp.bool_),
+                              f32(S), i32(S), f32(S), i32(S), i32(S), keys)
+            else:
+                decode_sds = (psds, csds, toks, i32(S),
+                              jax.ShapeDtypeStruct((S,), jnp.bool_),
+                              f32(S), i32(S), f32(S), i32(S), i32(S), keys)
             blob, info = _export_one(
-                make_decode_fn(plan, ctx, S),
-                (psds, csds, toks, i32(S),
-                 jax.ShapeDtypeStruct((S,), jnp.bool_), f32(S), i32(S),
-                 f32(S), i32(S), i32(S), keys))
+                make_decode_fn(plan, ctx, S, page_size=psz), decode_sds)
             sha = _write_blob(
                 os.path.join(out_dir, "programs", "decode.bin"), blob, staged)
             decode_meta = dict(info, file="programs/decode.bin", sha256=sha)
 
             prefills = {}
             for pb in bucket_table(bucket_min, l_max):
+                if geo.paged:
+                    pre_sds = (psds, csds, toks, i32(geo.n_ptab),
+                               i32(1, pb), i32(), i32(), i32(), f32(),
+                               i32(), f32(),
+                               jax.ShapeDtypeStruct(kd.shape, kd.dtype))
+                else:
+                    pre_sds = (psds, csds, toks, i32(1, pb), i32(),
+                               i32(), f32(), i32(), f32(),
+                               jax.ShapeDtypeStruct(kd.shape, kd.dtype))
                 blob, info = _export_one(
-                    make_prefill_fn(plan, ctx, pb, cache_dtype),
-                    (psds, csds, toks, i32(1, pb), i32(), i32(), f32(),
-                     i32(), f32(), jax.ShapeDtypeStruct(kd.shape, kd.dtype)))
+                    make_prefill_fn(plan, ctx, pb, cache_dtype,
+                                    page_size=psz), pre_sds)
                 fname = f"programs/prefill_{pb}.bin"
                 sha = _write_blob(os.path.join(out_dir, fname), blob, staged)
                 prefills[str(pb)] = dict(info, file=fname, sha256=sha)
@@ -296,6 +334,15 @@ def export_compiled(workflow, wstate, out_dir: str, *,
             "slots": slots, "l_max": l_max, "bucket_min": bucket_min,
             "buckets": bucket_table(bucket_min, l_max) if decode_meta
             else [],
+            # paged-cache layout (FORMAT_VERSION 2): the pool geometry is
+            # part of the sealed calling convention, and prefix_reuse
+            # records whether the chain's cached state is pure attention
+            # KV (recurrent carried state cannot take prefix shortcuts)
+            "paged": bool(geo.paged and decode_meta),
+            "page_size": geo.page_size if geo.paged else None,
+            "pages": geo.pages if geo.paged else None,
+            "prefix_reuse": bool(geo.paged and decode_meta and plan
+                                 is not None and not plan._rec_units),
             "cache_dtype": jnp.dtype(cache_dtype).name,
             "vocab": vocab,
             "input_vocab": input_vocab,
@@ -353,6 +400,9 @@ def manifest_summary(manifest: dict) -> dict:
         "checksum": (manifest.get("workflow_checksum") or "")[:12],
         "jax_version": manifest.get("jax_version"),
         "slots": manifest.get("slots"), "l_max": manifest.get("l_max"),
+        "paged": manifest.get("paged", False),
+        "page_size": manifest.get("page_size"),
+        "pages": manifest.get("pages"),
         "buckets": manifest.get("buckets"),
         "vocab": manifest.get("vocab"),
         "programs": sorted(

@@ -30,6 +30,8 @@ from __future__ import annotations
 import functools
 from typing import Optional
 
+import inspect
+
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
@@ -38,6 +40,32 @@ from jax.experimental.pallas import tpu as pltpu
 
 from . import (use_pallas_default,  # policy lives pallas-free in ops/__init__
                check_attention_window, check_gqa_heads)
+
+#: ``pltpu.CompilerParams`` across jax versions (the parallel/mesh.py
+#: ``shard_map`` shim pattern): older jax names the class
+#: ``TPUCompilerParams`` and lacks some fields (e.g.
+#: ``has_side_effects``).  Fields the resident class does not know are
+#: DROPPED — they are Mosaic lowering hints, not kernel semantics, and
+#: the kernels here run interpret-mode wherever the old class exists
+#: without them (the CPU test tier), so a missing hint can never change
+#: results.
+_COMPILER_PARAMS_CLS = getattr(pltpu, "CompilerParams", None) \
+    or pltpu.TPUCompilerParams
+_COMPILER_PARAMS_FIELDS = frozenset(
+    inspect.signature(_COMPILER_PARAMS_CLS).parameters)
+
+
+def compiler_params(**kwargs):
+    """Version-portable ``pltpu.CompilerParams(**kwargs)``."""
+    return _COMPILER_PARAMS_CLS(**{k: v for k, v in kwargs.items()
+                                   if k in _COMPILER_PARAMS_FIELDS})
+
+
+#: ``pltpu.HBM`` across jax versions: older jax only exposes the ANY
+#: memory space, which is how its pallas lowering says "leave the
+#: operand in HBM / let the DMA address it" — the same contract the
+#: gather kernel wants from HBM.
+_HBM = getattr(pltpu, "HBM", None) or pltpu.ANY
 
 
 def _interpret(interpret: Optional[bool]) -> bool:
@@ -200,7 +228,7 @@ def _flash_fwd(q, k, v, *, causal, scale, block_q, block_k, interpret,
         # batch*head and q-block steps are independent; only the k sweep
         # carries the online-softmax state — telling Mosaic lets it
         # pipeline DMAs across grid steps instead of serializing.
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(interpret),
     )(qm, km, vm)
@@ -369,7 +397,7 @@ def _flash_bwd(q, k, v, out, lse, g, *, causal, scale, block_q, block_k,
         out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B * H, tq_p, D), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=itp,
     )(qm, km, vm, dom, lse, delta)
@@ -408,7 +436,7 @@ def _flash_bwd(q, k, v, out, lse, g, *, causal, scale, block_q, block_k,
             pltpu.VMEM((block_k, D), jnp.float32),
             pltpu.VMEM((block_k, D), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=itp,
     )(qm, km, vm, dom, lse, delta)
@@ -650,8 +678,8 @@ def gather_rows_packed(packed, idx, *, interpret=None):
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(m,),
-        in_specs=[pl.BlockSpec(memory_space=pltpu.HBM)],
-        out_specs=pl.BlockSpec(memory_space=pltpu.HBM),
+        in_specs=[pl.BlockSpec(memory_space=_HBM)],
+        out_specs=pl.BlockSpec(memory_space=_HBM),
         scratch_shapes=[pltpu.SemaphoreType.DMA(())],
     )
     return pl.pallas_call(
@@ -660,7 +688,7 @@ def gather_rows_packed(packed, idx, *, interpret=None):
         out_shape=jax.ShapeDtypeStruct((m,) + packed.shape[1:],
                                        packed.dtype),
         interpret=_interpret(interpret),
-        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+        compiler_params=compiler_params(has_side_effects=True),
     )(jnp.asarray(idx, jnp.int32), packed)
 
 

@@ -111,6 +111,9 @@ def read_manifest(art_dir: str) -> dict:
     if ok and "decode" in progs:  # the ArtifactRunner geometry keys
         ok = all(isinstance(man.get(k), int)
                  for k in ("slots", "l_max", "bucket_min"))
+        if ok and man.get("paged"):  # v2 paged layout: pool geometry
+            ok = all(isinstance(man.get(k), int)
+                     for k in ("page_size", "pages"))
     if ok and "forward" in progs:  # load_forward's input signature
         ispec = man.get("input_spec")
         ok = isinstance(ispec, dict) and isinstance(
@@ -320,13 +323,21 @@ class ArtifactRunner(DecodeEngine):
         self.plan = None
         self._ctx = None
         self.cache_dtype = jnp.dtype(man.get("cache_dtype", "float32"))
-        # sealed geometry: slots/l_max/bucket_min come from the manifest
-        # (the bucket table is the program inventory, not a config
-        # preference)
+        # sealed geometry: slots/l_max/bucket_min — and for v2 paged
+        # artifacts the page-pool shape — come from the manifest (the
+        # bucket table AND the page-table calling convention are the
+        # program inventory, not a config preference).  prefix_reuse is
+        # the exporter's record of whether the chain's cached state is
+        # pure attention KV; the scheduler-side prefix cache keys off it
+        # because the runner has no DecodePlan to inspect.
+        self._prefix_ok = bool(man.get("prefix_reuse", False))
         self._init_config(slots=man["slots"], l_max=man["l_max"],
                           window_ms=window_ms, queue_depth=queue_depth,
                           deadline_s=deadline_s,
-                          bucket_min=man["bucket_min"])
+                          bucket_min=man["bucket_min"],
+                          paged=bool(man.get("paged", False)),
+                          page_size=man.get("page_size"),
+                          pages=man.get("pages"))
         # strict: a sealed program that can't AOT-compile here must
         # fail the LOAD, never lazily crash the first request
         self.step_cache = StepCache(strict=True)
@@ -385,7 +396,7 @@ class ArtifactRunner(DecodeEngine):
 
     def _compile_decode(self, params):
         step, _, _ = self.step_cache.get_step(
-            "decode", (self.slots, self.l_max),
+            "decode", self._geometry_key(),
             lambda: (jax.jit(self._exp_decode.call,
                              donate_argnums=(1, 2)), None, None),
             self._decode_args_sds(params), pin=(self._exp_decode,))
@@ -399,7 +410,7 @@ class ArtifactRunner(DecodeEngine):
                 f"(inventory: {sorted(self._exp_prefill)}) — the "
                 "manifest's bucket table is the sealed program set")
         step, _, _ = self.step_cache.get_step(
-            "prefill", (pb, self.slots, self.l_max),
+            "prefill", (pb,) + self._geometry_key(),
             lambda: (jax.jit(exp.call, donate_argnums=(1, 2)),
                      None, None),
             self._prefill_args_sds(params, pb), pin=(exp,))

@@ -34,15 +34,37 @@ same key — per-slot keys fold in the slot's own position exactly like
 the ``generate()`` scan (multi-row sampled requests draw per-row keys
 ``fold_in(key, row)`` instead of one batched categorical, documented in
 docs/serving.md).
+
+**Paged KV cache + shared-prefix reuse** (default; disable with
+``root.common.serve.paged = False``): instead of one dense ``(slots,
+l_max)`` KV row per slot — which caps concurrency by HBM at
+``slots * l_max`` token-cells even though most requests use a fraction
+of ``l_max`` — the engine owns a fixed pool of ``root.common.serve
+.pages`` pages of ``page_size`` tokens each, and every slot maps its
+logical positions onto pool pages through an int32 page table threaded
+through the SAME two program kinds as traced data flow (gather/scatter
+on the page axis — no third program, StepCache counters stay flat
+across page allocation, reclamation, prefix hits, and copy-on-write).
+The host scheduler refcounts pages and keeps a chained content-hash
+index over full prompt pages: a request whose prompt prefix matches a
+cached page chain maps those pages read-only (refcount++) and prefills
+only its tail — N requests sharing a system prompt prefill it ONCE —
+with copy-on-write semantics at the first divergent token (the
+divergent page is recomputed into a private page; shared pages are
+never written: decode/prefill writes of masked-off rows route to a
+scratch pool row).  A request that cannot get pages is refused with
+the same 429/Retry-After backpressure as a full queue
+(docs/serving.md "Paged KV cache").
 """
 
 from __future__ import annotations
 
 import collections
+import hashlib
 import math
 import threading
 import time
-from typing import Optional
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -124,19 +146,22 @@ def place_like(tree, template):
     return placed
 
 
-def make_decode_fn(plan, ctx, S: int):
+def make_decode_fn(plan, ctx, S: int, *, page_size: Optional[int] = None):
     """The engine's lifetime decode program as an un-compiled jitted
     function: advance all S slots one token with per-slot positions,
     sampling params, and eos/length retirement.  Lives at module level
     (not closed inside the engine) so the compiled-artifact exporter
     (export/compiled.py) serializes EXACTLY the program the live engine
-    runs — a single source of step math, never two."""
+    runs — a single source of step math, never two.
 
-    def decode_step(params, caches, toks, pos, active, temp, topk,
-                    topp, eos, end, keys):
-        rows = jnp.arange(S)
-        tok = toks[rows, pos]
-        logits, caches = plan.step(params, caches, tok, pos, ctx)
+    With ``page_size`` set the signature gains the per-slot page table
+    ``ptab`` (S, n_ptab) int32 and the KV caches are the flat page pool
+    (page indirection is traced data flow through the same program
+    kind); inactive slots' KV writes route to the scratch pool row so a
+    retired slot can never corrupt pages reassigned to another slot."""
+
+    def step_tail(caches, toks, logits, pos, active, temp, topk, topp,
+                  eos, end, keys, rows):
         step_keys = jax.vmap(jax.random.fold_in)(
             jax.random.wrap_key_data(keys), pos)
         nxt = _sample_slots(logits, step_keys, temp, topk, topp)
@@ -146,13 +171,46 @@ def make_decode_fn(plan, ctx, S: int):
         finished = active & ((nxt == eos) | (new_pos >= end))
         return caches, toks, new_pos, active & ~finished, finished
 
+    if page_size is None:
+        def decode_step(params, caches, toks, pos, active, temp, topk,
+                        topp, eos, end, keys):
+            rows = jnp.arange(S)
+            tok = toks[rows, pos]
+            logits, caches = plan.step(params, caches, tok, pos, ctx)
+            return step_tail(caches, toks, logits, pos, active, temp,
+                             topk, topp, eos, end, keys, rows)
+    else:
+        def decode_step(params, caches, toks, ptab, pos, active, temp,
+                        topk, topp, eos, end, keys):
+            rows = jnp.arange(S)
+            tok = toks[rows, pos]
+            logits, caches = plan.step(
+                params, caches, tok, pos, ctx,
+                pages=(ptab, page_size, active))
+            return step_tail(caches, toks, logits, pos, active, temp,
+                             topk, topp, eos, end, keys, rows)
+
     return jax.jit(decode_step, donate_argnums=(1, 2))
 
 
-def make_prefill_fn(plan, ctx, pb: int, cache_dtype):
+def make_prefill_fn(plan, ctx, pb: int, cache_dtype, *,
+                    page_size: Optional[int] = None):
     """The engine's bucketed-prefill program for bucket length ``pb``
     (un-compiled jitted function; module-level for the same exporter
-    single-source reason as :func:`make_decode_fn`)."""
+    single-source reason as :func:`make_decode_fn`).
+
+    The paged form (``page_size`` set) is the shared-prefix half of the
+    paged cache: it processes only the ``new_len`` tokens AFTER the
+    traced ``start`` offset — the prefix-cache hit — writing KV straight
+    into the slot's pool pages while ATTENDING through the page table to
+    the shared prefix pages some earlier request already prefilled.  The
+    bucket is therefore sized by the un-shared tail, so a request with a
+    hot system prompt pays a small-bucket prefill instead of a full one.
+    Positions are global throughout (RoPE, masks, sampling-key folds),
+    so tokens stay bitwise identical to an un-shared prefill."""
+
+    if page_size is not None:
+        return _make_paged_prefill_fn(plan, ctx, pb, page_size)
 
     def prefill(params, caches, toks, prompt, true_len, slot, temp,
                 topk, topp, key_data):
@@ -194,12 +252,105 @@ def make_prefill_fn(plan, ctx, pb: int, cache_dtype):
     return jax.jit(prefill, donate_argnums=(1, 2))
 
 
-def resolve_serve_geometry(slots=None, l_max=None, bucket_min=None):
+def _make_paged_prefill_fn(plan, ctx, pb: int, psz: int):
+    """Paged prefill for bucket length ``pb`` (see
+    :func:`make_prefill_fn`): ``prompt`` holds the ``new_len`` un-shared
+    tail tokens, ``start`` the global position of the first one (a page
+    multiple — the prefix-cache hit boundary), ``ptab_row`` the slot's
+    complete page table (shared prefix pages + freshly allocated private
+    pages; unassigned logical pages point at the scratch row).  Attention
+    KV lands directly in the pool; recurrent carried state scans a local
+    B=1 copy and splices into the engine batch like the dense path.
+    NOTE: recurrent state is position-recurrent from token 0, so chains
+    with recurrent units never take prefix shortcuts — the engine admits
+    them with start=0 (enforced host-side in ``_reserve_pages``)."""
+    from .generate import _rec_state_init
+    attn_keys = plan.attn_keys()
+
+    def prefill(params, caches, toks, ptab_row, prompt, new_len, start,
+                slot, temp, topk, topp, key_data):
+        work = dict(caches)
+        for key, u in plan._rec_units:
+            work[key] = _rec_state_init(u, 1)
+
+        def body(carry, i):
+            work = carry
+            pos = start + i                     # global position
+            # pad steps (i >= new_len) must neither advance carried
+            # state nor write KV: attention writes route to the scratch
+            # pool row, recurrent state is where-gated below
+            valid = i < new_len
+            logits, new = plan.step(
+                params, dict(work), prompt[:, i], pos[None], ctx,
+                pages=(ptab_row[None], psz, valid[None]))
+            out = {}
+            for k in new:
+                if k in attn_keys:
+                    out[k] = new[k]             # pool: scratch-gated
+                else:
+                    out[k] = jax.tree.map(
+                        lambda n, o: jnp.where(valid, n, o),
+                        new[k], work[k])
+            return out, logits
+
+        work, ys = jax.lax.scan(body, work, jnp.arange(pb))
+        last = jax.lax.dynamic_index_in_dim(
+            ys, new_len - 1, 0, keepdims=False)         # (1, V)
+        # the fold position is GLOBAL (start + new_len - 1 == P - 1):
+        # bitwise the key a dense prefill of the whole prompt folds
+        key = jax.random.fold_in(
+            jax.random.wrap_key_data(key_data), start + new_len - 1)
+        first = _sample_slots(
+            last, key[None], temp[None], topk[None], topp[None])[0]
+        out_caches = dict(caches)
+        for k in work:
+            if k in attn_keys:
+                out_caches[k] = work[k]
+            else:  # splice the slot's fresh recurrent state into the batch
+                out_caches[k] = jax.tree.map(
+                    lambda big, loc: jax.lax.dynamic_update_slice(
+                        big, loc.astype(big.dtype),
+                        (slot,) + (jnp.int32(0),) * (loc.ndim - 1)),
+                    caches[k], work[k])
+        toks = toks.at[slot, start + new_len].set(first)
+        return out_caches, toks, first
+
+    return jax.jit(prefill, donate_argnums=(1, 2))
+
+
+class ServeGeometry(NamedTuple):
+    """Resolved serving geometry (see :func:`resolve_serve_geometry`).
+    ``paged`` selects the page-pool KV layout; ``pages`` is 0 when
+    dense.  ``n_ptab`` (= l_max // page_size) is the per-slot page-table
+    width — the number of logical pages a max-length request spans."""
+    slots: int
+    l_max: int
+    bucket_min: int
+    paged: bool
+    page_size: int
+    pages: int
+
+    @property
+    def n_ptab(self) -> int:
+        return self.l_max // self.page_size if self.paged else 0
+
+
+def resolve_serve_geometry(slots=None, l_max=None, bucket_min=None,
+                           paged=None, page_size=None, pages=None):
     """Slot-batch geometry with ``root.common.serve`` defaults — ONE
     resolution shared by the live engine and the compiled-artifact
     exporter (export/compiled.py), so a default-configured export's
     bucket inventory is exactly what a default-configured engine
-    compiles."""
+    compiles.
+
+    Paged knobs (``root.common.serve.{paged, page_size, pages}``): the
+    default pool (``slots * l_max / page_size`` pages) matches the dense
+    layout's HBM exactly; serving MORE concurrent requests in the same
+    memory means raising ``slots`` while holding ``pages`` — the pool,
+    not ``slots * l_max``, is then the real token capacity.  A
+    default ``page_size`` that does not divide ``l_max`` halves itself
+    until it does (an explicit one must divide, or the page table could
+    not tile the sequence)."""
     serve = root.common.serve
     slots = int(slots if slots is not None else serve.get("slots", 8))
     l_max = int(l_max if l_max is not None else serve.get("l_max", 512))
@@ -207,7 +358,29 @@ def resolve_serve_geometry(slots=None, l_max=None, bucket_min=None):
                             else serve.get("prefill_bucket_min", 16)))
     if slots < 1 or l_max < 2:
         raise ValueError("need slots >= 1 and l_max >= 2")
-    return slots, l_max, bucket_min
+    use_paged = bool(serve.get("paged", True) if paged is None else paged)
+    psz = int(page_size if page_size is not None
+              else serve.get("page_size", 16))
+    if not use_paged:
+        return ServeGeometry(slots, l_max, bucket_min, False, psz, 0)
+    if psz < 1:
+        raise ValueError(f"page_size must be >= 1, got {psz}")
+    if l_max % psz:
+        if page_size is not None:
+            raise ValueError(
+                f"page_size {psz} must divide l_max {l_max} (the page "
+                "table tiles the sequence in whole pages)")
+        while l_max % psz:  # default page size adapts to small l_max
+            psz //= 2
+    n_ptab = l_max // psz
+    if pages is None:
+        pages = serve.get("pages", None)     # config None = dense-equiv
+    pages = int(pages) if pages is not None else slots * n_ptab
+    if pages < n_ptab:
+        raise ValueError(
+            f"page pool of {pages} pages cannot hold one max-length "
+            f"request ({n_ptab} pages of {psz} tokens for l_max {l_max})")
+    return ServeGeometry(slots, l_max, bucket_min, True, psz, pages)
 
 
 def prefill_bucket(p: int, bucket_min: int, l_max: int) -> int:
@@ -231,7 +404,8 @@ def bucket_table(bucket_min: int, l_max: int):
 class _Request:
     __slots__ = ("prompt", "n_steps", "temperature", "top_k", "top_p",
                  "eos_id", "key_data", "deadline", "done", "result",
-                 "error", "submitted_at", "slot", "finished_at")
+                 "error", "submitted_at", "slot", "finished_at",
+                 "page_row", "prefix_start", "page_hashes")
 
     def __init__(self, prompt, n_steps, temperature, top_k, top_p,
                  eos_id, key_data, deadline):
@@ -249,6 +423,9 @@ class _Request:
         self.submitted_at = time.monotonic()
         self.finished_at = None
         self.slot = None
+        self.page_row = None            # paged: this request's page table
+        self.prefix_start = 0           # paged: first un-shared position
+        self.page_hashes = ()           # paged: chained full-page hashes
 
     def finish(self, result=None, error=None):
         self.result, self.error = result, error
@@ -318,23 +495,38 @@ class DecodeEngine(Logger):
                  queue_depth: Optional[int] = None,
                  deadline_s: Optional[float] = None,
                  output_unit: Optional[str] = None,
-                 cache_dtype=jnp.float32, status=None):
+                 cache_dtype=jnp.float32, status=None,
+                 paged: Optional[bool] = None,
+                 page_size: Optional[int] = None,
+                 pages: Optional[int] = None):
         self.workflow = workflow
         self.wstate = wstate
         self._init_config(slots=slots, l_max=l_max, window_ms=window_ms,
-                          queue_depth=queue_depth, deadline_s=deadline_s)
+                          queue_depth=queue_depth, deadline_s=deadline_s,
+                          paged=paged, page_size=page_size, pages=pages)
         self.plan = DecodePlan(workflow, output_unit)
         self.cache_dtype = cache_dtype
         self._ctx = Context(train=False, key=None, mesh=None)
         self.step_cache = StepCache()
         self.status = status
+        # recurrent carried state is position-recurrent from token 0 and
+        # is NOT paged, so prefix shortcuts are attention-only chains'
+        # win (ArtifactRunner reads the same fact off the manifest)
+        self._prefix_ok = not self.plan._rec_units
         self._init_runtime(wstate["params"])
 
     def _init_config(self, *, slots, l_max, window_ms, queue_depth,
-                     deadline_s, bucket_min=None):
+                     deadline_s, bucket_min=None, paged=None,
+                     page_size=None, pages=None):
         serve = root.common.serve
+        geo = resolve_serve_geometry(slots, l_max, bucket_min,
+                                     paged=paged, page_size=page_size,
+                                     pages=pages)
         self.slots, self.l_max, self.bucket_min = \
-            resolve_serve_geometry(slots, l_max, bucket_min)
+            geo.slots, geo.l_max, geo.bucket_min
+        self.paged, self.page_size, self.pages = \
+            geo.paged, geo.page_size, geo.pages
+        self.n_ptab = geo.n_ptab
         self.window_s = float(window_ms if window_ms is not None
                               else serve.get("window_ms", 2.0)) / 1e3
         self.queue_depth = int(queue_depth if queue_depth is not None
@@ -363,6 +555,28 @@ class DecodeEngine(Logger):
         kd = jax.random.key_data(jax.random.key(0))
         self._keys = np.zeros((S,) + kd.shape, kd.dtype)
         self._slot_req: list = [None] * S
+
+        # paged pool bookkeeping (host side; the device only ever sees
+        # the int32 page table): refcounted physical pages, a chained
+        # content-hash prefix index over full prompt pages, an LRU tick
+        # for cached-page eviction, and the pool gauges
+        if self.paged:
+            self._scratch = self.pages          # pool row absorbing
+            #                                     masked-off writes
+            self._ptab = np.full((S, self.n_ptab), self._scratch,
+                                 np.int32)
+            self._page_lock = threading.Lock()
+            self._page_ref = np.zeros(self.pages, np.int32)
+            self._page_free = list(range(self.pages))
+            self._prefix_index: dict = {}       # chained hash -> page id
+            self._page_key: dict = {}           # page id -> its hash
+            self._page_tick = np.zeros(self.pages, np.int64)
+            self._tick = 0
+            self._prefix_hit_pages = 0
+            self._prefix_miss_pages = 0
+            self._evictions = 0
+            self._cow_admissions = 0
+            self._pool_rejected = 0
 
         # queue + scheduler
         self._queue: collections.deque = collections.deque()
@@ -404,33 +618,61 @@ class DecodeEngine(Logger):
             tree)
 
     def _make_caches(self, params):
+        if self.paged:
+            return self.plan.init_caches(
+                params, self.slots, self.l_max, self.cache_dtype,
+                kv_rows=self.pages + 1, page_size=self.page_size)
         return self.plan.init_caches(
             params, self.slots, self.l_max, self.cache_dtype)
 
     def _head_width(self, params) -> int:
         S = self.slots
         shallow = dict(self._caches)  # plan.step rebinds top-level keys
+        pages_arg = None
+        if self.paged:
+            pages_arg = (jnp.zeros((S, self.n_ptab), jnp.int32),
+                         self.page_size, jnp.zeros(S, bool))
         return int(jax.eval_shape(
-            lambda p, c, t, pv: self.plan.step(p, c, t, pv, self._ctx)[0],
+            lambda p, c, t, pv: self.plan.step(p, c, t, pv, self._ctx,
+                                               pages=pages_arg)[0],
             params, shallow, jnp.zeros(S, jnp.int32),
             jnp.zeros(S, jnp.int32)).shape[-1])
 
     def _decode_args_sds(self, params):
-        return self._sds((params, self._caches, self._toks, self._pos,
-                          self._active, self._temp, self._topk, self._topp,
-                          self._eos, self._end, self._keys))
+        args = (params, self._caches, self._toks)
+        if self.paged:
+            args += (self._ptab,)
+        return self._sds(args + (self._pos, self._active, self._temp,
+                                 self._topk, self._topp, self._eos,
+                                 self._end, self._keys))
 
     def _prefill_args_sds(self, params, pb: int):
         z32 = np.int32(0)
+        if self.paged:
+            return self._sds((params, self._caches, self._toks,
+                              self._ptab[0], np.zeros((1, pb), np.int32),
+                              z32, z32, z32, np.float32(0), z32,
+                              np.float32(1), self._keys[0]))
         return self._sds((params, self._caches, self._toks,
                           np.zeros((1, pb), np.int32), z32, z32,
                           np.float32(0), z32, np.float32(1),
                           self._keys[0]))
 
+    def _geometry_key(self):
+        """StepCache key suffix: everything shape-determining about the
+        cache layout (a paged and a dense engine at the same slots/l_max
+        are DIFFERENT programs)."""
+        if self.paged:
+            return (self.slots, self.l_max, "paged", self.page_size,
+                    self.pages)
+        return (self.slots, self.l_max)
+
     def _compile_decode(self, params):
+        psz = self.page_size if self.paged else None
         step, _, _ = self.step_cache.get_step(
-            "decode", (self.slots, self.l_max),
-            lambda: (make_decode_fn(self.plan, self._ctx, self.slots),
+            "decode", self._geometry_key(),
+            lambda: (make_decode_fn(self.plan, self._ctx, self.slots,
+                                    page_size=psz),
                      None, None),
             self._decode_args_sds(params), pin=(self.workflow,))
         return step
@@ -440,10 +682,12 @@ class DecodeEngine(Logger):
 
     def _prefill_fn(self, pb: int, params):
         """Fetch/compile the prefill program for bucket length ``pb``."""
+        psz = self.page_size if self.paged else None
         step, _, _ = self.step_cache.get_step(
-            "prefill", (pb, self.slots, self.l_max),
+            "prefill", (pb,) + self._geometry_key(),
             lambda: (make_prefill_fn(self.plan, self._ctx, pb,
-                                     self.cache_dtype), None, None),
+                                     self.cache_dtype, page_size=psz),
+                     None, None),
             self._prefill_args_sds(params, pb), pin=(self.workflow,))
         return step
 
@@ -455,8 +699,15 @@ class DecodeEngine(Logger):
         self._thread = threading.Thread(
             target=self._loop, name="decode-engine", daemon=True)
         self._thread.start()
-        self.info("decode engine: %d slots x L=%d, queue %d",
-                  self.slots, self.l_max, self.queue_depth)
+        if self.paged:
+            self.info(
+                "decode engine: %d slots x L=%d over %d pages x %d "
+                "tokens (paged, prefix reuse %s), queue %d",
+                self.slots, self.l_max, self.pages, self.page_size,
+                "on" if self._prefix_ok else "off", self.queue_depth)
+        else:
+            self.info("decode engine: %d slots x L=%d, queue %d",
+                      self.slots, self.l_max, self.queue_depth)
         return self
 
     @property
@@ -522,6 +773,7 @@ class DecodeEngine(Logger):
         if not self.started:
             self.wstate = dict(self.wstate, params=staged)
             self._swaps += 1
+            self._invalidate_prefix_cache()
             return
         done = threading.Event()
         with self._swap_lock:
@@ -550,7 +802,27 @@ class DecodeEngine(Logger):
         params, done = staged
         self.wstate = dict(self.wstate, params=params)
         self._swaps += 1
+        # cached prefix pages hold KV computed under the OLD weights.
+        # In-flight slots finishing on mixed versions is the documented
+        # hot-swap trade, but a stale cached prefix would contaminate
+        # arbitrarily many NEW requests (and every hit would renew its
+        # LRU tick, so it would never age out) — drop the index now.
+        self._invalidate_prefix_cache()
         done.set()
+
+    def _invalidate_prefix_cache(self):
+        """Unregister every cached prefix page (post-swap: their KV
+        belongs to the previous weights).  Refcount-0 pages return to
+        the free list; pages still referenced by in-flight slots keep
+        serving THOSE slots and are freed by the normal release path
+        once they retire (release checks registration at that point)."""
+        if not self.paged:
+            return
+        with self._page_lock:
+            for pid in list(self._page_key):
+                del self._prefix_index[self._page_key.pop(pid)]
+                if self._page_ref[pid] == 0:
+                    self._page_free.append(pid)
 
     def drain(self, timeout: Optional[float] = None) -> bool:
         """Graceful drain: stop admissions (``submit`` raises
@@ -631,6 +903,36 @@ class DecodeEngine(Logger):
             np.asarray(jax.random.key_data(key)),
             time.monotonic() + (self.deadline_s if deadline_s is None
                                 else float(deadline_s)))
+        if self.paged:
+            # pool backpressure: when slots are free but the PAGES are
+            # gone (long prompts at low slot occupancy), admission could
+            # not happen anyway — answer the same 429/Retry-After as a
+            # full queue instead of parking work a free slot cannot
+            # serve.  A busy slot table falls through to the queue
+            # check: pages drain as slots retire, so queued waiting is
+            # the normal path there.  Prefix-cache hits are discounted
+            # from the need: a request whose system prompt is already
+            # resident only allocates its tail — the hot-shared-prefix
+            # workload must not be the one spuriously rejected.
+            need = self._page_span(prompt.size, n_steps)
+            hashes = self._prefix_hashes(prompt)
+            req.page_hashes = hashes    # _reserve_pages reuses them
+            with self._page_lock:
+                need -= self._prefix_hits_locked(hashes, prompt.size)
+                avail = self.pages - int(
+                    np.count_nonzero(self._page_ref))
+            with self._qlock:
+                free_slots = self.slots - int(self._active.sum())
+                pool_bound = (need > avail
+                              and free_slots > len(self._queue))
+                if pool_bound:
+                    self._rejected += 1
+                    self._pool_rejected += 1
+            if pool_bound:
+                raise EngineOverloaded(
+                    f"page pool exhausted ({avail} of {self.pages} "
+                    f"pages free, request needs {need} beyond its "
+                    "cached prefix)", self._retry_after())
         with self._qlock:
             if len(self._queue) >= self.queue_depth:
                 self._rejected += 1
@@ -692,8 +994,33 @@ class DecodeEngine(Logger):
                                     / max(now - mark_t, 1e-9))
             self._rate_mark = (now, self._tok_count)
         steps = max(self._decode_steps, 1)
+        pages = None
+        if self.paged:
+            with self._page_lock:
+                used = int(np.count_nonzero(self._page_ref))
+                cached = sum(1 for pid in self._page_key
+                             if self._page_ref[pid] == 0)
+            lookups = self._prefix_hit_pages + self._prefix_miss_pages
+            pages = {
+                "page_size": self.page_size, "pages": self.pages,
+                "used": used, "cached": cached,
+                "free": self.pages - used - cached,
+                "tokens_resident": (used + cached) * self.page_size,
+                "prefix_hit_pages": self._prefix_hit_pages,
+                "prefix_miss_pages": self._prefix_miss_pages,
+                "prefix_hit_rate": round(
+                    self._prefix_hit_pages / lookups, 3) if lookups
+                else 0.0,
+                "prefix_tokens_reused":
+                    self._prefix_hit_pages * self.page_size,
+                "evictions": self._evictions,
+                "cow_admissions": self._cow_admissions,
+                "pool_rejected": self._pool_rejected,
+            }
         return {
             "slots": self.slots, "l_max": self.l_max,
+            "paged": self.paged,
+            **({"pages": pages} if pages is not None else {}),
             "occupancy": int(self._active.sum()),
             "avg_occupancy": round(self._occupancy_sum / steps, 3),
             "queue_depth": len(self._queue),
@@ -779,6 +1106,7 @@ class DecodeEngine(Logger):
             if req is not None:
                 req.finish(error=err)
                 self._slot_req[s] = None
+            self._release_slot_pages(s)
         self._active[:] = False
 
     def _expire_queue(self):
@@ -818,8 +1146,165 @@ class DecodeEngine(Logger):
                 req.finish(error=TimeoutError(
                     "request deadline expired while queued"))
                 continue
+            if self.paged and not self._reserve_pages(req):
+                # the pool cannot host it right now: requeue at the
+                # FRONT (FIFO) and stop admitting — pages free as slots
+                # retire, deadlines bound the wait
+                with self._qlock:
+                    self._queue.appendleft(req)
+                return n
             self._prefill(int(free[0]), req)
             n += 1
+
+    # -- page pool (scheduler thread owns mutation; _page_lock guards the
+    # cross-thread reads in submit() and stats()) ---------------------------
+    def _touch(self, pid: int):
+        self._tick += 1
+        self._page_tick[pid] = self._tick
+
+    def _page_span(self, P: int, n_steps: int) -> int:
+        """Worst-case pages a request can ever reference: KV lands at
+        positions ``0 .. P + n_steps - 2`` (the final sampled token is
+        emitted but its KV is never computed — the slot retires at
+        ``end = P + n_steps - 1``), so the span is one cell SHORT of
+        the token count; counting the full count would strand a page
+        per request whenever the true span is page-aligned."""
+        return -(-(P + n_steps - 1) // self.page_size)
+
+    def _prefix_hashes(self, prompt):
+        """Chained content hashes of the prompt's FULL pages: page i's
+        key covers tokens ``0 .. (i+1)*page_size`` — KV content depends
+        on the whole prefix, not just the page's own tokens."""
+        if not self._prefix_ok:
+            return []
+        psz = self.page_size
+        hashes, h = [], b""
+        for i in range(int(prompt.size) // psz):
+            h = hashlib.sha256(
+                h + prompt[i * psz:(i + 1) * psz].tobytes()).digest()
+            hashes.append(h)
+        return hashes
+
+    def _prefix_hits_locked(self, hashes, P: int) -> int:
+        """Leading pages already in the prefix index (caller holds
+        ``_page_lock``), capped so at least the LAST prompt token is
+        recomputed: the first sampled token needs its logits, and a
+        fully-shared prompt would otherwise have nothing to run."""
+        hits = 0
+        for h in hashes:
+            if h not in self._prefix_index:
+                break
+            hits += 1
+        while hits and hits * self.page_size > P - 1:
+            hits -= 1
+        return hits
+
+    def _reserve_pages(self, req) -> bool:
+        """Map the request onto the pool: chained-hash prefix lookup over
+        its full prompt pages (hits map shared read-only pages,
+        refcount++), fresh pages for the rest of its worst-case span.
+        On success ``req.page_row`` / ``req.prefix_start`` /
+        ``req.page_hashes`` are set; on shortage every side effect is
+        rolled back and False is returned (the caller requeues)."""
+        psz = self.page_size
+        P = int(req.prompt.size)
+        need = self._page_span(P, req.n_steps)
+        full = P // psz                          # whole-prompt pages
+        # submit() already hashed the prompt; () is also legitimate
+        # (short prompt / prefix reuse off) and free to recompute
+        hashes = req.page_hashes or self._prefix_hashes(req.prompt)
+        with self._page_lock:
+            hits = self._prefix_hits_locked(hashes, P)
+            row = np.full(self.n_ptab, self._scratch, np.int32)
+            taken = []
+            for i in range(hits):
+                pid = self._prefix_index[hashes[i]]
+                self._page_ref[pid] += 1
+                self._touch(pid)
+                row[i] = pid
+                taken.append(pid)
+            for i in range(hits, need):
+                pid = self._alloc_page_locked()
+                if pid is None:          # shortage: roll back, requeue
+                    for p in taken:
+                        self._page_ref[p] -= 1
+                        if self._page_ref[p] <= 0:
+                            self._page_ref[p] = 0
+                            if p not in self._page_key:
+                                self._page_free.append(p)
+                    return False
+                row[i] = pid
+                taken.append(pid)
+            self._prefix_hit_pages += hits
+            self._prefix_miss_pages += max(full - hits, 0)
+            if hits:
+                # copy-on-write admission: a shared prefix was mapped
+                # read-only and the first divergent token onward is
+                # recomputed into private pages
+                self._cow_admissions += 1
+        req.page_row = row
+        req.prefix_start = hits * psz
+        req.page_hashes = hashes
+        return True
+
+    def _alloc_page_locked(self):
+        """One free page, evicting the least-recently-used CACHED page
+        (refcount 0 but still registered in the prefix index) when the
+        free list is empty; None when the pool is truly exhausted."""
+        if self._page_free:
+            pid = self._page_free.pop()
+            self._page_ref[pid] = 1
+            self._touch(pid)
+            return pid
+        best, best_tick = None, None
+        for pid in self._page_key:
+            if self._page_ref[pid] == 0 and (
+                    best is None or self._page_tick[pid] < best_tick):
+                best, best_tick = pid, self._page_tick[pid]
+        if best is None:
+            return None
+        del self._prefix_index[self._page_key.pop(best)]
+        self._evictions += 1
+        self._page_ref[best] = 1
+        self._touch(best)
+        return best
+
+    def _register_prefix_pages(self, req):
+        """After a prefill: publish the request's freshly computed FULL
+        prompt pages in the prefix index so the next request sharing the
+        prefix prefills only its tail.  Pages holding the prompt's
+        partial tail or generated tokens stay private (their content is
+        not a pure function of a whole-page prompt prefix)."""
+        psz = self.page_size
+        full = int(req.prompt.size) // psz
+        hits = req.prefix_start // psz
+        with self._page_lock:
+            for i in range(hits, min(full, len(req.page_hashes))):
+                h = req.page_hashes[i]
+                pid = int(req.page_row[i])
+                if h not in self._prefix_index:
+                    self._prefix_index[h] = pid
+                    self._page_key[pid] = h
+                self._touch(pid)
+
+    def _release_slot_pages(self, slot: int):
+        """Drop the slot's references; refcount-0 pages return to the
+        free list unless the prefix index still caches them (a cached
+        page stays resident, serving future prefix hits, until LRU
+        eviction reclaims it)."""
+        if not self.paged:
+            return
+        with self._page_lock:
+            for pid in self._ptab[slot]:
+                pid = int(pid)
+                if pid == self._scratch:
+                    continue
+                self._page_ref[pid] -= 1
+                if self._page_ref[pid] <= 0:
+                    self._page_ref[pid] = 0
+                    if pid not in self._page_key:
+                        self._page_free.append(pid)
+            self._ptab[slot] = self._scratch
 
     def _prefill(self, slot: int, req: _Request):
         # reserve the slot BEFORE the device program runs: between the
@@ -829,18 +1314,34 @@ class DecodeEngine(Logger):
         req.slot = slot
         params = self.wstate["params"]
         P = int(req.prompt.size)
-        pb = self._bucket(P)
-        fn = self._prefill_fn(pb, params)
-        padded = np.zeros((1, pb), np.int32)
-        padded[0, :P] = req.prompt
         temp = np.float32(req.temperature)
         # sentinels: see _sample_slots
         topk = np.int32(req.top_k if req.top_k is not None
                         else self._vocab)
         topp = np.float32(req.top_p if req.top_p is not None else 1.0)
-        self._caches, self._toks, first = fn(
-            params, self._caches, self._toks, padded, np.int32(P),
-            np.int32(slot), temp, topk, topp, req.key_data)
+        if self.paged:
+            # the bucket is sized by the UN-SHARED tail: a prefix-cache
+            # hit turns a long prompt into a short prefill
+            start = req.prefix_start
+            new_len = P - start
+            pb = self._bucket(new_len)
+            self._ptab[slot] = req.page_row
+            padded = np.zeros((1, pb), np.int32)
+            padded[0, :new_len] = req.prompt[start:]
+            fn = self._prefill_fn(pb, params)
+            self._caches, self._toks, first = fn(
+                params, self._caches, self._toks, req.page_row, padded,
+                np.int32(new_len), np.int32(start), np.int32(slot),
+                temp, topk, topp, req.key_data)
+            self._register_prefix_pages(req)
+        else:
+            pb = self._bucket(P)
+            fn = self._prefill_fn(pb, params)
+            padded = np.zeros((1, pb), np.int32)
+            padded[0, :P] = req.prompt
+            self._caches, self._toks, first = fn(
+                params, self._caches, self._toks, padded, np.int32(P),
+                np.int32(slot), temp, topk, topp, req.key_data)
         first = int(first)
         self._pos[slot] = P
         self._temp[slot] = temp
@@ -858,10 +1359,12 @@ class DecodeEngine(Logger):
             self._retire(slot)
 
     def _step_once(self):
+        args = (self.wstate["params"], self._caches, self._toks)
+        if self.paged:
+            args += (self._ptab,)
         self._caches, self._toks, pos, active, finished = self._decode(
-            self.wstate["params"], self._caches, self._toks, self._pos,
-            self._active, self._temp, self._topk, self._topp, self._eos,
-            self._end, self._keys)
+            *args, self._pos, self._active, self._temp, self._topk,
+            self._topp, self._eos, self._end, self._keys)
         n_active = int(self._active.sum())
         self._decode_steps += 1
         self._occupancy_sum += n_active
@@ -878,6 +1381,7 @@ class DecodeEngine(Logger):
             if req is not None and now > req.deadline:
                 self._active[slot] = False
                 self._slot_req[slot] = None
+                self._release_slot_pages(int(slot))
                 self._timeouts += 1
                 req.finish(error=TimeoutError(
                     "request deadline expired while decoding"))
@@ -886,12 +1390,17 @@ class DecodeEngine(Logger):
         req = self._slot_req[slot]
         self._active[slot] = False
         self._slot_req[slot] = None
+        self._release_slot_pages(slot)
         if req is None:
             return
-        toks = np.asarray(self._toks[slot, :int(self._pos[slot]) + 1],
-                          np.int32)
+        # paged prefill never writes the (possibly shared) prompt region
+        # of the token row, so assemble from the request's own prompt —
+        # identical bytes on the dense path, where toks[:P] IS the prompt
+        P = int(req.prompt.size)
+        gen = np.asarray(self._toks[slot, P:int(self._pos[slot]) + 1],
+                         np.int32)
         self._retired += 1
-        req.finish(result=toks)
+        req.finish(result=np.concatenate([req.prompt, gen]))
 
     def _maybe_report(self):
         if self.status is None:

@@ -53,9 +53,19 @@ from ..units.base import Context
 from ..units.workflow import WorkflowError
 
 
-def _attn_cache_init(u, params, B: int, L: int, dtype) -> dict:
+def _attn_cache_init(u, params, B: int, L: int, dtype, *,
+                     kv_rows: Optional[int] = None,
+                     page_size: Optional[int] = None) -> dict:
+    """Dense per-slot KV rows ``(B, L, Hk, Dh)``, or — when ``kv_rows`` /
+    ``page_size`` are given — the PAGED pool layout ``(kv_rows,
+    page_size, Hk, Dh)``: a flat set of fixed-size pages shared by every
+    slot through a per-slot page table (runtime/engine.py; the last pool
+    row is the scratch page that absorbs masked-off writes)."""
     Dh = params["wk"].shape[1] // u.n_kv_heads
-    shape = (B, L, u.n_kv_heads, Dh)
+    if kv_rows is not None:
+        shape = (kv_rows, page_size, u.n_kv_heads, Dh)
+    else:
+        shape = (B, L, u.n_kv_heads, Dh)
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
@@ -108,7 +118,7 @@ def _rope_rows(x, pos):
     return out.reshape(B, T, H, D)
 
 
-def _attn_decode_step(u, params, cache, x_t, pos):
+def _attn_decode_step(u, params, cache, x_t, pos, pages=None):
     """One-position attention against the cache.
 
     x_t: (B, E) activation at position ``pos``; cache k/v: (B, L, Hk, Dh).
@@ -117,13 +127,29 @@ def _attn_decode_step(u, params, cache, x_t, pos):
     continuous-batching engine, where each slot decodes independently).
     Numerics match MultiHeadAttention.apply (f32 score/prob accumulation,
     scale Dh**-0.5, RoPE at the global position, GQA head grouping,
-    sliding window, residual)."""
+    sliding window, residual).
+
+    ``pages`` switches the per-row path to the PAGED cache layout
+    (runtime/engine.py): ``(ptab, page_size, write_ok)`` where the cache
+    k/v are a flat page pool ``(pages + 1, page_size, Hk, Dh)`` (last
+    row = scratch), ``ptab`` (B, n_ptab) int32 maps each row's logical
+    pages to physical pool rows, and ``write_ok`` (B,) bool routes
+    masked-off rows' KV writes to the scratch page (an inactive slot's
+    pages may already belong to ANOTHER slot — its write must land
+    nowhere real).  The attention itself is unchanged: the gathered
+    per-row view ``pool[ptab]`` reshapes to the same (B, L, Hk, Dh)
+    logical cache the dense path reads, so tokens stay bitwise
+    identical — page indirection is traced data flow, never new
+    program structure."""
     B, E = x_t.shape
     H, Hk = u.n_heads, u.n_kv_heads
     dt = u.compute_dtype or x_t.dtype
     xq = x_t.astype(dt)
     pos = jnp.asarray(pos)
     per_row = pos.ndim == 1
+    if pages is not None and not per_row:
+        raise ValueError("paged attention requires per-row positions "
+                         "(the continuous-batching engine path)")
 
     def proj(w, nh):
         return (xq @ w.astype(dt)).reshape(B, 1, nh, -1)
@@ -138,6 +164,31 @@ def _attn_decode_step(u, params, cache, x_t, pos):
         else:
             q = rotary_embedding(q, offset=pos)
             k = rotary_embedding(k, offset=pos)
+    if pages is not None:
+        ptab, psz, write_ok = pages
+        n_ptab = ptab.shape[1]
+        pool_rows = cache["k"].shape[0]           # pages + 1 (scratch)
+        # physical write target: the row's current page (clamped — a
+        # pad-step position past l_max must not clip into a REAL page),
+        # or the scratch row when the write is masked off
+        lpage = jnp.minimum(pos // psz, n_ptab - 1)
+        pg = jnp.take_along_axis(ptab, lpage[:, None], axis=1)[:, 0]
+        if write_ok is not None:
+            pg = jnp.where(write_ok, pg, pool_rows - 1)
+        off = pos % psz
+        ck = cache["k"].at[pg, off].set(k[:, 0].astype(cache["k"].dtype))
+        cv = cache["v"].at[pg, off].set(v[:, 0].astype(cache["v"].dtype))
+        Dh = q.shape[-1]
+        G = H // Hk
+        L = n_ptab * psz
+        qg = q[:, 0].reshape(B, Hk, G, Dh).astype(jnp.float32)
+        # per-row logical view: gather the row's pages, flatten to the
+        # same (B, L, Hk, Dh) the dense path reads
+        kf = ck[ptab].reshape(B, L, Hk, Dh).astype(jnp.float32)
+        vf = cv[ptab].reshape(B, L, Hk, Dh).astype(jnp.float32)
+        return _attn_scores(u, params, xq, qg, kf, vf, pos, per_row,
+                            B, H, Hk, G, Dh, L, dt, x_t.dtype,
+                            {"k": ck, "v": cv})
     if per_row:
         rows = jnp.arange(B)
         ck = cache["k"].at[rows, pos].set(k[:, 0].astype(cache["k"].dtype))
@@ -154,6 +205,16 @@ def _attn_decode_step(u, params, cache, x_t, pos):
     qg = q[:, 0].reshape(B, Hk, G, Dh).astype(jnp.float32)
     kf = ck.astype(jnp.float32)                   # (B, L, Hk, Dh)
     vf = cv.astype(jnp.float32)
+    return _attn_scores(u, params, xq, qg, kf, vf, pos, per_row,
+                        B, H, Hk, G, Dh, L, dt, x_t.dtype,
+                        {"k": ck, "v": cv})
+
+
+def _attn_scores(u, params, xq, qg, kf, vf, pos, per_row, B, H, Hk, G,
+                 Dh, L, dt, out_dtype, new_cache):
+    """Masked score/softmax/output tail shared by the dense and paged
+    cache layouts — ONE copy of the attention math, so the two layouts
+    cannot drift numerically."""
     s = jnp.einsum("bkgd,btkd->bkgt", qg, kf) * (Dh ** -0.5)
     t_idx = jnp.arange(L)
     if per_row:
@@ -171,7 +232,7 @@ def _attn_decode_step(u, params, cache, x_t, pos):
     y = o.reshape(B, H * Dh).astype(dt) @ params["wo"].astype(dt)
     if u.residual:
         y = y + xq
-    return y.astype(x_t.dtype), {"k": ck, "v": cv}
+    return y.astype(out_dtype), new_cache
 
 
 class DecodePlan:
@@ -298,18 +359,32 @@ class DecodePlan:
                         yield (f"{stack.name}/s{i}/{su.name}", su)
 
     # -- runtime -----------------------------------------------------------
-    def init_caches(self, params, B: int, L: int, dtype) -> dict:
+    def attn_keys(self):
+        """Cache-dict keys backed by paged-able attention KV (the rest —
+        recurrent carried state — stays per-slot even under paging)."""
+        return {key for key, _, _ in self._attn_units}
+
+    def init_caches(self, params, B: int, L: int, dtype, *,
+                    kv_rows: Optional[int] = None,
+                    page_size: Optional[int] = None) -> dict:
+        """Zeroed cache tree: attention KV as dense per-slot rows
+        (B, L, Hk, Dh), or — when ``kv_rows``/``page_size`` are given —
+        as the flat page pool (kv_rows, page_size, Hk, Dh) the paged
+        engine indexes through per-slot page tables.  Recurrent carried
+        state is (B, ...) either way."""
         caches = {}
         for key, u, path in self._attn_units:
             p = params
             for seg in path:
                 p = p[seg]
-            caches[key] = _attn_cache_init(u, p, B, L, dtype)
+            caches[key] = _attn_cache_init(u, p, B, L, dtype,
+                                           kv_rows=kv_rows,
+                                           page_size=page_size)
         for key, u in self._rec_units:
             caches[key] = _rec_state_init(u, B)
         return caches
 
-    def step(self, params, caches, tok, pos, ctx: Context):
+    def step(self, params, caches, tok, pos, ctx: Context, pages=None):
         """One decode position: token ids (B,) -> (logits (B, V), caches).
         O(L) attention per layer via the cache.
 
@@ -318,7 +393,11 @@ class DecodePlan:
         masked-batched form the continuous-batching engine
         (runtime/engine.py) drives: each slot attends ``t <= pos[row]``
         and writes its KV at its own position.  Recurrent / pointwise
-        units are position-free, so only attention branches on it."""
+        units are position-free, so only attention branches on it.
+
+        ``pages`` = (ptab, page_size, write_ok) selects the paged KV
+        layout for every attention unit (see :func:`_attn_decode_step`);
+        it rides the per-row path only."""
         x = jnp.take(params[self.embedding.name]["table"],
                      tok.astype(jnp.int32), axis=0)      # (B, E)
 
@@ -341,7 +420,7 @@ class DecodePlan:
             if kind == "attn":
                 u = payload
                 x, caches[u.name] = _attn_decode_step(
-                    u, params[u.name], caches[u.name], x, pos)
+                    u, params[u.name], caches[u.name], x, pos, pages)
             elif kind == "recurrent":
                 u = payload
                 x, caches[u.name] = _rec_decode_step(
@@ -358,7 +437,8 @@ class DecodePlan:
                         _, su, i = h
                         key = f"{stack.name}/s{i}/{su.name}"
                         x, caches[key] = _attn_decode_step(
-                            su, sp[f"s{i}"][su.name], caches[key], x, pos)
+                            su, sp[f"s{i}"][su.name], caches[key], x, pos,
+                            pages)
                     elif h[0] == "recurrent":
                         _, su, i = h
                         key = f"{stack.name}/s{i}/{su.name}"

@@ -23,11 +23,15 @@ Pass ``engine=`` (a started or startable
 :class:`~veles_tpu.runtime.engine.DecodeEngine`) to serve non-beam
 /generate through the continuous-batching engine instead of per-request
 ``generate()`` calls: concurrent requests share slots mid-flight, the
-program set is fixed for the engine lifetime, queue overflow answers
-**429 with a Retry-After header** (the backpressure contract of
-docs/serving.md), and GET /engine exposes the live gauges.  Request
-bodies are capped at ``root.common.serve.max_body_mb`` (413 beyond it —
-the snapshot_http_max_mb pattern applied to the ingress side).
+program set is fixed for the engine lifetime, queue overflow — and,
+under the paged KV cache, PAGE-POOL exhaustion at low slot occupancy —
+answers **429 with a Retry-After header** (the backpressure contract of
+docs/serving.md), and GET /engine exposes the live gauges, including
+the ``pages`` group (free/used/cached pages, prefix-cache hit rate,
+tokens resident, evictions, copy-on-write admissions) when the engine
+runs the paged layout.  Request bodies are capped at
+``root.common.serve.max_body_mb`` (413 beyond it — the
+snapshot_http_max_mb pattern applied to the ingress side).
 
 Operational endpoints (docs/serving.md "Model lifecycle"): ``GET
 /healthz`` (liveness — answers whenever the process serves HTTP, engine

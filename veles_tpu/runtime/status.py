@@ -9,6 +9,10 @@ graphics_server.py:174-220).
 TPU redesign: a StatusReporter writes status.json atomically (any dashboard
 can poll it; no MongoDB), and an optional StatusServer thread serves it over
 stdlib HTTP with a minimal HTML view — zero dependencies, one process.
+Nested gauge groups render as dotted rows, so the decode engine's paged
+KV-cache pool (``engine.pages.free`` / ``engine.pages.prefix_hit_rate``
+/ ``engine.pages.tokens_resident`` / ``engine.pages.evictions`` …)
+lands on the page next to the compile counters with no schema here.
 When a ``plots_dir`` is set, the page also embeds every PNG in it with a
 mtime cache-buster under the existing 2-second meta refresh, so a running
 job's metric curves are WATCHABLE live in a browser (round-2 verdict
