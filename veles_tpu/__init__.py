@@ -23,45 +23,104 @@ Quick start::
     trainer = vt.Trainer(wf, loader, vt.optimizers.SGD(0.1, momentum=0.9),
                          vt.Decision(max_epochs=10))
     results = trainer.run()
+
+The public namespace is LAZY (PEP 562 via the callable-module class):
+``import veles_tpu`` binds no jax-heavy submodule until an attribute is
+actually touched.  That keeps tooling that lives inside the package but
+must never import the code it operates on — ``python -m
+veles_tpu.analysis`` / ``veles-tpu-lint`` (docs/analysis.md) — a
+millisecond-scale pure-stdlib import, and makes ``import veles_tpu``
+cheap for everyone else.
 """
 
 __version__ = "0.1.0"
 
-from . import config, logger, normalization, ops, prng
-from .config import Config, Range, root
-from .logger import Logger, setup_logging
-from . import units
-from .units import Spec, Unit, Workflow
-from .ops import optimizers
-from . import loader
-from .loader import ArrayLoader, FullBatchLoader, Loader
-from . import runtime
-from .runtime import (ArtifactRunner, Decision, DecodeEngine,
-                      DeployController, Snapshotter, SnapshotterToDB,
-                      StepCache, Trainer, generate, generate_beam)
-from . import parallel
-from .parallel import MeshSpec, make_mesh
-from . import models
-from .models import StandardWorkflow
-from . import interaction
-from . import publishing
-from .publishing import Publisher
+import importlib as _importlib
+import sys as _sys
+import types as _types
+
+#: public attribute -> (submodule, attribute-in-submodule or None for
+#: the submodule itself).  This IS the package namespace; add new
+#: public names here.
+_LAZY = {
+    # submodules
+    "config": ("config", None),
+    "logger": ("logger", None),
+    "normalization": ("normalization", None),
+    "ops": ("ops", None),
+    "prng": ("prng", None),
+    "units": ("units", None),
+    "loader": ("loader", None),
+    "runtime": ("runtime", None),
+    "parallel": ("parallel", None),
+    "models": ("models", None),
+    "interaction": ("interaction", None),
+    "publishing": ("publishing", None),
+    "analysis": ("analysis", None),
+    # re-exported symbols
+    "Config": ("config", "Config"),
+    "Range": ("config", "Range"),
+    "root": ("config", "root"),
+    "Logger": ("logger", "Logger"),
+    "setup_logging": ("logger", "setup_logging"),
+    "Spec": ("units", "Spec"),
+    "Unit": ("units", "Unit"),
+    "Workflow": ("units", "Workflow"),
+    "optimizers": ("ops", "optimizers"),
+    "ArrayLoader": ("loader", "ArrayLoader"),
+    "FullBatchLoader": ("loader", "FullBatchLoader"),
+    "Loader": ("loader", "Loader"),
+    "ArtifactRunner": ("runtime", "ArtifactRunner"),
+    "Decision": ("runtime", "Decision"),
+    "DecodeEngine": ("runtime", "DecodeEngine"),
+    "DeployController": ("runtime", "DeployController"),
+    "Snapshotter": ("runtime", "Snapshotter"),
+    "SnapshotterToDB": ("runtime", "SnapshotterToDB"),
+    "StepCache": ("runtime", "StepCache"),
+    "Trainer": ("runtime", "Trainer"),
+    "generate": ("runtime", "generate"),
+    "generate_beam": ("runtime", "generate_beam"),
+    "MeshSpec": ("parallel", "MeshSpec"),
+    "make_mesh": ("parallel", "make_mesh"),
+    "StandardWorkflow": ("models", "StandardWorkflow"),
+    "Publisher": ("publishing", "Publisher"),
+}
+
+
+#: PEP 562 pairing: star-import exports exactly the lazy namespace
+#: (pre-refactor, the eager imports made these module globals).
+__all__ = sorted(_LAZY)
+
+
+def _resolve(name: str):
+    mod_name, attr = _LAZY[name]
+    module = _importlib.import_module(f"{__name__}.{mod_name}")
+    value = module if attr is None else getattr(module, attr)
+    globals()[name] = value         # cache: __getattr__ runs once
+    return value
 
 
 def __call_module__(config, *overrides, **kwargs):
-    return interaction.run(config, *overrides, **kwargs)
+    return _resolve("interaction").run(config, *overrides, **kwargs)
 
 
 # Make the package itself callable — ``import veles_tpu; veles_tpu("cfg.py",
 # "root.x=1")`` — the reference replaced its module with a callable
 # VelesModule (veles/__init__.py:126-189); Python 3 allows swapping the
-# module's class instead.
-import sys as _sys
-import types as _types
-
-
+# module's class instead.  The same class hosts the lazy attribute
+# protocol (a module-level __getattr__ would work too, but instance
+# lookup beats module __getattr__ and this keeps one mechanism).
 class _CallableModule(_types.ModuleType):
     __call__ = staticmethod(__call_module__)
+
+    def __getattr__(self, name):
+        if name in _LAZY:
+            return _resolve(name)
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}")
+
+    def __dir__(self):
+        return sorted(set(super().__dir__()) | set(_LAZY))
 
 
 _sys.modules[__name__].__class__ = _CallableModule
