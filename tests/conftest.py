@@ -45,6 +45,10 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "paged: paged KV cache / shared-prefix reuse tests "
         "(tier-1; select alone with -m paged)")
+    config.addinivalue_line(
+        "markers", "analysis: static-analyzer (veles-tpu-lint) tests "
+        "incl. the zero-findings gate (tier-1; select alone with "
+        "-m analysis)")
 
 
 @pytest.fixture(autouse=True)

@@ -1,0 +1,599 @@
+"""veles_tpu.analysis — the trace-discipline / host-concurrency /
+config-drift static analyzer (docs/analysis.md).
+
+Fixture snippets per rule family (positive + negative + suppression),
+baseline semantics, the CLI contract, and — the CI gate itself — a
+self-check that the live package holds ZERO unbaselined findings, run
+pure-AST without importing any jax-heavy module.
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from veles_tpu.analysis import (analyze_files, iter_python_files,
+                                run_analysis)
+from veles_tpu.analysis.baseline import write_baseline
+from veles_tpu.analysis.cli import main as lint_main
+from veles_tpu.analysis.pysrc import parse_file
+from veles_tpu.analysis.registry import TRACE_ROOTS
+
+pytestmark = pytest.mark.analysis
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _write(tmp_path, name, src):
+    p = tmp_path / name
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(src))
+    return p
+
+
+def _lint(tmp_path, **kw):
+    return analyze_files(iter_python_files([str(tmp_path)]), **kw)
+
+
+def _rules(findings):
+    return sorted(f.rule for f in findings)
+
+
+# -- VT1xx: trace safety ----------------------------------------------------
+
+def test_vt101_tracer_branch_flagged(tmp_path):
+    _write(tmp_path, "mod.py", """\
+        import jax.numpy as jnp
+
+        def step(x):  # trace-root: traced
+            y = jnp.sum(x)
+            if y > 0:
+                return y
+            return -y
+        """)
+    found = _lint(tmp_path)
+    assert _rules(found) == ["VT101"]
+    assert "y > 0" in found[0].message
+    assert found[0].symbol == "step"
+
+
+def test_vt101_static_branches_not_flagged(tmp_path):
+    _write(tmp_path, "mod.py", """\
+        import jax.numpy as jnp
+
+        def step(x, pages=None, *, greedy=True):  # trace-root: traced
+            if pages is not None:      # None-check: static structure
+                x = x + 1
+            if greedy:                 # keyword-only knob: static
+                return jnp.max(x)
+            if x.ndim == 2:            # array metadata: static
+                return x
+            return jnp.sum(x)
+        """)
+    assert _lint(tmp_path) == []
+
+
+def test_vt101_builder_params_are_static(tmp_path):
+    # builder mode: the factory's own params are plans/config, not
+    # tracers — but its nested def IS the traced program
+    _write(tmp_path, "mod.py", """\
+        import jax.numpy as jnp
+
+        def make_step(page_size):  # trace-root: builder
+            if page_size is None:
+                page_size = 16
+
+            def step(x):
+                if jnp.sum(x) > 0:
+                    return x
+                return -x
+            return step
+        """)
+    found = _lint(tmp_path)
+    assert _rules(found) == ["VT101"]
+    assert found[0].symbol == "make_step.step"
+
+
+def test_vt102_host_coercions(tmp_path):
+    _write(tmp_path, "mod.py", """\
+        import jax.numpy as jnp
+        import numpy as np
+
+        def step(x):  # trace-root: traced
+            a = float(jnp.sum(x))
+            b = np.asarray(x * 2)
+            c = x.sum().item()
+            return a, b, c
+        """)
+    assert _rules(_lint(tmp_path)) == ["VT102", "VT102", "VT102"]
+
+
+def test_vt103_host_effects_only_inside_traced_scope(tmp_path):
+    _write(tmp_path, "mod.py", """\
+        import random
+        import time
+
+        def step(x):  # trace-root: traced
+            t = time.monotonic()
+            r = random.random()
+            return x + t + r
+
+        def host_helper():
+            return time.monotonic()    # not traced scope: fine
+        """)
+    found = _lint(tmp_path)
+    assert _rules(found) == ["VT103", "VT103"]
+    assert all(f.symbol == "step" for f in found)
+
+
+def test_vt104_unordered_iteration(tmp_path):
+    _write(tmp_path, "mod.py", """\
+        def step(x):  # trace-root: traced
+            acc = 0
+            for k in {"b", "a"}:
+                acc = acc + x
+            for k in sorted({"b", "a"}):   # deterministic: fine
+                acc = acc + x
+            return acc
+        """)
+    assert _rules(_lint(tmp_path)) == ["VT104"]
+
+
+def test_traced_scope_closes_over_local_calls(tmp_path):
+    # a helper the traced root calls joins traced scope module-locally
+    _write(tmp_path, "mod.py", """\
+        import time
+
+        def helper(n):
+            return time.sleep(n)
+
+        def step(x):  # trace-root: traced
+            helper(1)
+            return x
+        """)
+    found = _lint(tmp_path)
+    assert _rules(found) == ["VT103"]
+    assert found[0].symbol == "helper"
+
+
+# -- suppressions -----------------------------------------------------------
+
+def test_suppression_with_reason(tmp_path):
+    _write(tmp_path, "mod.py", """\
+        import jax.numpy as jnp
+
+        def step(x):  # trace-root: traced
+            y = jnp.sum(x)
+            # lint: disable=VT101 trace-time structural check, honest
+            if y > 0:
+                return y
+            return -y
+        """)
+    assert _lint(tmp_path) == []
+
+
+def test_suppression_without_reason_is_va001(tmp_path):
+    _write(tmp_path, "mod.py", """\
+        import jax.numpy as jnp
+
+        def step(x):  # trace-root: traced
+            y = jnp.sum(x)
+            if y > 0:  # lint: disable=VT101
+                return y
+            return -y
+        """)
+    found = _lint(tmp_path)
+    # the finding is suppressed, but the missing justification is
+    # itself a finding
+    assert _rules(found) == ["VA001"]
+
+
+def test_suppression_only_covers_named_rule(tmp_path):
+    _write(tmp_path, "mod.py", """\
+        import jax.numpy as jnp
+
+        def step(x):  # trace-root: traced
+            y = jnp.sum(x)
+            if y > 0:  # lint: disable=VT104 wrong rule named
+                return y
+            return -y
+        """)
+    assert _rules(_lint(tmp_path)) == ["VT101"]
+
+
+# -- VC2xx: concurrency discipline ------------------------------------------
+
+def test_vc201_guarded_field(tmp_path):
+    _write(tmp_path, "mod.py", """\
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []  # guarded-by: self._lock
+
+            def good(self, x):
+                with self._lock:
+                    self._items.append(x)
+
+            def helper(self):  # requires-lock: self._lock
+                return list(self._items)
+
+            def bad(self):
+                return len(self._items)
+        """)
+    found = _lint(tmp_path)
+    assert _rules(found) == ["VC201"]
+    assert found[0].symbol == "Box.bad"
+
+
+def test_vc201_requires_lock_call_sites_checked(tmp_path):
+    # annotating a method `# requires-lock:` moves the obligation to
+    # its callers — it must not silently erase lock checking
+    _write(tmp_path, "mod.py", """\
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0  # guarded-by: self._lock
+
+            def _bump(self):  # requires-lock: self._lock
+                self._n += 1
+
+            def good(self):
+                with self._lock:
+                    self._bump()
+
+            def bad(self):
+                self._bump()
+        """)
+    found = _lint(tmp_path)
+    assert _rules(found) == ["VC201"]
+    assert found[0].symbol == "Box.bad" and "_bump" in found[0].message
+
+
+def test_vc201_not_shared_exemption(tmp_path):
+    _write(tmp_path, "mod.py", """\
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []  # guarded-by: self._lock
+                self._setup()
+
+            def _setup(self):  # not-shared: called from __init__ only
+                self._items.append(0)
+        """)
+    assert _lint(tmp_path) == []
+
+
+def test_vc201_module_global(tmp_path):
+    _write(tmp_path, "mod.py", """\
+        import threading
+
+        _lock = threading.Lock()
+        _seen = set()  # guarded-by: _lock
+
+        def good(k):
+            with _lock:
+                _seen.add(k)
+
+        def bad(k):
+            return k in _seen
+        """)
+    found = _lint(tmp_path)
+    assert _rules(found) == ["VC201"]
+    assert found[0].symbol == "bad"
+
+
+def test_vc202_bare_acquire(tmp_path):
+    _write(tmp_path, "mod.py", """\
+        def risky(lock):
+            lock.acquire()
+            lock.release()
+
+        def safe(lock):
+            lock.acquire()
+            try:
+                pass
+            finally:
+                lock.release()
+        """)
+    found = _lint(tmp_path)
+    assert _rules(found) == ["VC202"]
+    assert found[0].symbol == "risky"
+
+
+def test_vc203_unknown_lock_name(tmp_path):
+    _write(tmp_path, "mod.py", """\
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []  # guarded-by: self._lokc
+        """)
+    assert "VC203" in _rules(_lint(tmp_path))
+
+
+# -- VK3xx: config drift ----------------------------------------------------
+
+def _config_fixture(tmp_path):
+    _write(tmp_path, "config.py", """\
+        class _C:  # stand-in tree; the rule is pure AST
+            pass
+
+        root = _C()
+
+        def _defaults():
+            root.common.alpha = 1
+            root.common.beta = 2
+            root.common.serve.gamma = 3
+        """)
+    _write(tmp_path, "user.py", """\
+        from config import root
+
+        val = root.common.alpha
+        missing = root.common.get("nope", 1)
+        serve = root.common.serve
+        g = serve.get("gamma", 3)
+        """)
+
+
+def test_vk301_undeclared_read(tmp_path):
+    _config_fixture(tmp_path)
+    found = [f for f in _lint(tmp_path) if f.rule == "VK301"]
+    assert len(found) == 1
+    assert "root.common.nope" in found[0].message
+    assert found[0].path.endswith("user.py")
+
+
+def test_vk302_dead_declaration(tmp_path):
+    _config_fixture(tmp_path)
+    dead = [f for f in _lint(tmp_path) if f.rule == "VK302"]
+    assert ["root.common.beta" in f.message for f in dead] == [True]
+    assert dead[0].path.endswith("config.py")
+
+
+def test_vk303_undocumented_key(tmp_path):
+    _config_fixture(tmp_path)
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "configuration.md").write_text(
+        "`root.common.alpha` and `root.common.serve.gamma` exist\n")
+    found = [f for f in _lint(tmp_path, docs_dir=str(docs))
+             if f.rule == "VK303"]
+    assert len(found) == 1 and "root.common.beta" in found[0].message
+
+
+def test_vk_alias_get_counts_as_read(tmp_path):
+    # serve = root.common.serve; serve.get("gamma") must NOT leave
+    # gamma "dead" (the engine/deploy idiom)
+    _config_fixture(tmp_path)
+    assert not any("gamma" in f.message for f in _lint(tmp_path)
+                   if f.rule == "VK302")
+
+
+# -- baseline ---------------------------------------------------------------
+
+def test_baseline_accepts_then_goes_stale_on_edit(tmp_path):
+    mod = _write(tmp_path, "mod.py", """\
+        import jax.numpy as jnp
+
+        def step(x):  # trace-root: traced
+            y = jnp.sum(x)
+            if y > 0:
+                return y
+            return -y
+        """)
+    bp = str(tmp_path / "baseline.json")
+    r1 = run_analysis([str(tmp_path)], baseline_path=bp, docs_dir=None)
+    assert _rules(r1["findings"]) == ["VT101"]
+
+    write_baseline(bp, r1["all"])
+    r2 = run_analysis([str(tmp_path)], baseline_path=bp, docs_dir=None)
+    assert r2["findings"] == [] and _rules(r2["accepted"]) == ["VT101"]
+
+    # editing the flagged line invalidates its fingerprint on purpose
+    mod.write_text(mod.read_text().replace("if y > 0:", "if y > 1:"))
+    r3 = run_analysis([str(tmp_path)], baseline_path=bp, docs_dir=None)
+    assert _rules(r3["findings"]) == ["VT101"]
+
+
+def test_va002_never_baselined(tmp_path):
+    # a file that does not parse was never analyzed: no baseline may
+    # green it (its fingerprint has no symbol/snippet to go stale on)
+    _write(tmp_path, "broken.py", "def oops(:\n")
+    bp = str(tmp_path / "bl.json")
+    r1 = run_analysis([str(tmp_path)], baseline_path=bp, docs_dir=None)
+    assert _rules(r1["findings"]) == ["VA002"]
+    write_baseline(bp, r1["all"])
+    r2 = run_analysis([str(tmp_path)], baseline_path=bp, docs_dir=None)
+    assert _rules(r2["findings"]) == ["VA002"]     # still new
+
+
+def test_config_alias_poisoned_by_unrelated_local(tmp_path):
+    # `serve = {...}` in another function must not make its .get()
+    # calls look like config reads (file-wide alias disqualification)
+    _write(tmp_path, "config.py", """\
+        root = None
+
+        def _defaults():
+            root.common.alpha = 1
+        """)
+    _write(tmp_path, "other.py", """\
+        from config import root
+
+        def a():
+            serve = root.common.alpha
+            return serve
+
+        def b():
+            serve = {"meta": 1}
+            return serve.get("meta")
+        """)
+    assert not [f for f in _lint(tmp_path) if f.rule == "VK301"]
+
+
+# -- CLI contract (acceptance criteria) -------------------------------------
+
+def _seeded_violations(tmp_path):
+    """One fixture dir violating all three rule families."""
+    _write(tmp_path, "config.py", """\
+        root = None
+
+        def _defaults():
+            root.common.alpha = 1
+        """)
+    _write(tmp_path, "bad.py", """\
+        import threading
+
+        import jax.numpy as jnp
+
+        from config import root
+
+        _lock = threading.Lock()
+        _state = {}  # guarded-by: _lock
+
+
+        def step(x):  # trace-root: traced
+            y = jnp.sum(x)
+            if y > 0:                      # VT101
+                return y
+            return -y
+
+
+        def poke():
+            _state["k"] = root.common.get("typo_key", 0)  # VC201+VK301
+        """)
+
+
+def test_cli_exits_nonzero_on_seeded_violations(tmp_path, capsys):
+    _seeded_violations(tmp_path)
+    rc = lint_main([str(tmp_path), "--baseline", "none", "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    rules = {f["rule"] for f in out["findings"]}
+    # all three families fire
+    assert {"VT101", "VC201", "VK301"} <= rules
+
+
+def test_cli_text_output_and_write_baseline(tmp_path, capsys):
+    _seeded_violations(tmp_path)
+    bp = str(tmp_path / "bl.json")
+    rc = lint_main([str(tmp_path), "--baseline", bp])
+    text = capsys.readouterr().out
+    assert rc == 1 and "VT101" in text and "error" in text
+
+    rc = lint_main([str(tmp_path), "--baseline", bp,
+                    "--write-baseline"])
+    capsys.readouterr()
+    assert rc == 0 and os.path.isfile(bp)
+    rc = lint_main([str(tmp_path), "--baseline", bp])
+    out = capsys.readouterr().out
+    assert rc == 0 and "accepted by baseline" in out
+
+
+# -- the gate: live package is clean, pure-AST, no heavy imports ------------
+
+def test_cli_zero_files_is_a_usage_error(tmp_path, capsys):
+    # a typo'd path / wrong cwd must not silently DISABLE the gate by
+    # "cleanly" analyzing nothing
+    rc = lint_main([str(tmp_path / "nope"), "--baseline", "none"])
+    capsys.readouterr()
+    assert rc == 2
+
+
+def test_fingerprints_are_cwd_independent(tmp_path):
+    # display paths anchor at the analyzed dir's parent, so baseline
+    # fingerprints written from the repo root match a run from anywhere
+    pkg = os.path.join(REPO, "veles_tpu")
+    files = iter_python_files([pkg])
+    rels = dict(files)
+    assert all(r.startswith("veles_tpu" + os.sep) or
+               r.startswith("veles_tpu/") for r in rels.values())
+    cwd = os.getcwd()
+    os.chdir(str(tmp_path))
+    try:
+        assert iter_python_files([pkg]) == files
+    finally:
+        os.chdir(cwd)
+
+
+def test_package_zero_unbaselined_findings():
+    """THE tier-1 gate: `python -m veles_tpu.analysis veles_tpu` exits
+    0 against the checked-in baseline (zero unbaselined findings)."""
+    env = dict(os.environ, PYTHONPATH=REPO)
+    r = subprocess.run(
+        [sys.executable, "-m", "veles_tpu.analysis", "veles_tpu"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=300)
+    assert r.returncode == 0, f"\n{r.stdout}\n{r.stderr}"
+    assert "clean: 0 findings" in r.stdout
+
+
+def test_analyzer_runs_without_importing_heavy_modules():
+    """Pure-AST regression: linting the whole package must not import
+    the modules it analyzes (runtime/units/ops/...) — the lazy package
+    __init__ keeps `veles_tpu.analysis` a stdlib-only import, so the
+    lint gate stays milliseconds-scale and jax-free."""
+    code = textwrap.dedent("""\
+        import sys
+        from veles_tpu.analysis.cli import main
+        rc = main(["veles_tpu"])
+        heavy = [m for m in sys.modules
+                 if m.startswith("veles_tpu.")
+                 and any(seg in m for seg in (
+                     "runtime", "units", "ops", "parallel", "models",
+                     "loader", "export", "forge", "genetics"))]
+        assert rc == 0, "lint gate failed"
+        assert not heavy, f"analyzer imported heavy modules: {heavy}"
+        """)
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, cwd=REPO, env=env, timeout=300)
+    assert r.returncode == 0, f"\n{r.stdout}\n{r.stderr}"
+
+
+def test_registry_roots_exist():
+    """Renaming a traced builder must not silently drop it from the
+    analyzer's root set: every registry qualname resolves in its
+    module."""
+    pkg = os.path.join(REPO, "veles_tpu")
+    for relmod, roots in TRACE_ROOTS.items():
+        path = os.path.join(pkg, relmod)
+        assert os.path.isfile(path), relmod
+        pf = parse_file(path, relmod)
+        for q in roots:
+            assert q in pf.functions, (relmod, q)
+
+
+def test_console_script_entry_point(tmp_path):
+    """pyproject.toml packages the analyzer as a `veles-tpu-lint`
+    console script (mirror of the PR 3 `veles-tpu` smoke test)."""
+    import shutil
+
+    ppt = open(os.path.join(REPO, "pyproject.toml")).read()
+    m = re.search(r'^veles-tpu-lint\s*=\s*"([\w.]+):(\w+)"', ppt, re.M)
+    assert m, "pyproject.toml must declare the veles-tpu-lint script"
+    mod, func = m.groups()
+    assert (mod, func) == ("veles_tpu.analysis.cli", "main")
+    env = dict(os.environ, PYTHONPATH=REPO)
+    r = subprocess.run(
+        [sys.executable, "-c",
+         f"import {mod} as m, sys\n"
+         f"sys.exit(m.{func}(['--help']))"],
+        capture_output=True, text=True, env=env, cwd=str(tmp_path),
+        timeout=300)
+    assert r.returncode == 0, r.stderr
+    assert "veles-tpu-lint" in r.stdout and "--baseline" in r.stdout
+    exe = shutil.which("veles-tpu-lint")
+    if exe:  # installed entry point present: must behave identically
+        r = subprocess.run([exe, "--help"], capture_output=True,
+                           text=True, timeout=300)
+        assert r.returncode == 0 and "--baseline" in r.stdout

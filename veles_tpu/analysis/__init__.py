@@ -1,0 +1,46 @@
+"""veles_tpu.analysis — trace-discipline and host-concurrency static
+analyzer (docs/analysis.md).
+
+Every invariant this codebase lives by — exactly two program kinds per
+engine lifetime, flat StepCache counters across rollback/swap/COW,
+traced-data-flow-only control decisions, lock-guarded host scheduler
+state — was previously enforced only *after the fact* by runtime counter
+assertions in tests, which catch a regression only if a test happens to
+drive the offending path.  This package enforces them at lint time,
+before any test runs, the way the reference project's per-unit
+validation hooks checked workflow graphs before a run.
+
+Three rule families (full catalogue in docs/analysis.md):
+
+* **trace-safety (VT1xx)** — inside functions reachable from the traced
+  program roots (:mod:`veles_tpu.analysis.registry`), flag Python
+  ``if``/``while``/``assert`` on tracer-valued expressions, host
+  coercions (``float()``/``int()``/``bool()``/``.item()``/
+  ``np.asarray()``), host-effect calls (``time.*``/``random.*``/IO),
+  and iteration over unordered collections feeding trace order;
+* **concurrency discipline (VC2xx)** — fields annotated
+  ``# guarded-by: self.<lock>`` must only be touched inside
+  ``with self.<lock>:`` in the same method (or a method annotated
+  ``# requires-lock: self.<lock>``), and ``.acquire()`` without a
+  ``try/finally`` release is rejected;
+* **config-key drift (VK3xx)** — every ``root.common.*`` key read in
+  the package must be declared in ``veles_tpu/config.py`` and appear in
+  the docs; declared keys nobody reads are dead.
+
+Pure ``ast``/``tokenize`` — importing or running this package never
+imports jax or any of the modules it analyzes (a lint pass must be
+cheap enough to gate every CI run).  CLI::
+
+    python -m veles_tpu.analysis veles_tpu        # or: veles-tpu-lint
+    veles-tpu-lint veles_tpu --json
+    veles-tpu-lint veles_tpu --write-baseline     # accept current findings
+
+Exit code 0 = no unbaselined findings; 1 = findings; 2 = usage error.
+"""
+
+from .baseline import load_baseline, write_baseline
+from .engine import analyze_files, iter_python_files, run_analysis
+from .findings import Finding
+
+__all__ = ["Finding", "analyze_files", "iter_python_files",
+           "load_baseline", "run_analysis", "write_baseline"]

@@ -1,0 +1,8 @@
+"""``python -m veles_tpu.analysis`` — same contract as the
+``veles-tpu-lint`` console script (analysis/cli.py)."""
+
+import sys
+
+from .cli import main
+
+sys.exit(main())

@@ -1,0 +1,86 @@
+"""Baseline: accepted legacy findings, checked in next to the code.
+
+The baseline is the migration valve every adopted-late linter needs:
+run ``veles-tpu-lint --write-baseline`` once, commit the file, and from
+then on CI fails only on NEW findings — the debt is visible (the file
+is reviewable JSON) without blocking unrelated work.  Entries match by
+:meth:`~veles_tpu.analysis.findings.Finding.fingerprint` (rule + path +
+symbol + normalized source line), so editing a baselined line un-baselines
+it on purpose.
+
+The repo's own baseline lives at ``.veles-lint-baseline.json`` in the
+repo root (found by walking up from the analyzed paths) and is EMPTY —
+every finding the analyzer surfaced on the live package was fixed or
+justified inline; keep it that way.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Iterable, List, Optional
+
+from .findings import Finding, sort_key
+
+BASELINE_NAME = ".veles-lint-baseline.json"
+
+
+def find_baseline(start: str) -> Optional[str]:
+    """Walk up from ``start`` looking for the checked-in baseline."""
+    d = os.path.abspath(start)
+    if os.path.isfile(d):
+        d = os.path.dirname(d)
+    while True:
+        cand = os.path.join(d, BASELINE_NAME)
+        if os.path.isfile(cand):
+            return cand
+        parent = os.path.dirname(d)
+        if parent == d:
+            return None
+        d = parent
+
+
+def load_baseline(path: Optional[str]) -> Dict[str, dict]:
+    """fingerprint -> entry.  A missing/None path is an empty baseline."""
+    if not path or not os.path.isfile(path):
+        return {}
+    with open(path) as f:
+        doc = json.load(f)
+    entries = doc.get("findings", doc) if isinstance(doc, dict) else doc
+    if not isinstance(entries, list):
+        raise ValueError(f"{path}: baseline must hold a findings list")
+    return {e["fingerprint"]: e for e in entries}
+
+
+def write_baseline(path: str, findings: Iterable[Finding]) -> int:
+    """Rewrite the baseline from the given findings; returns the count.
+    Stable ordering + indented JSON so diffs of accepted debt review
+    like code.  VA002 (unparseable file) is never baselined: its
+    fingerprint has no symbol/snippet to go stale on, so accepting it
+    once would exclude the file from analysis forever."""
+    entries = [f.to_dict() for f in sorted(findings, key=sort_key)
+               if f.rule != "VA002"]
+    doc = {"comment": "accepted legacy lint findings — see "
+                      "docs/analysis.md for the baseline workflow",
+           "findings": entries}
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1)
+        f.write("\n")
+    os.replace(tmp, path)
+    return len(entries)
+
+
+def split_baselined(findings: Iterable[Finding],
+                    baseline: Dict[str, dict]):
+    """(new, accepted) partition of ``findings`` against the baseline.
+    VA002 is always new — a file that does not parse was never
+    analyzed, so no baseline may green it (see write_baseline)."""
+    new: List[Finding] = []
+    accepted: List[Finding] = []
+    for f in findings:
+        if f.rule != "VA002" and f.fingerprint() in baseline:
+            accepted.append(f)
+        else:
+            new.append(f)
+    return new, accepted

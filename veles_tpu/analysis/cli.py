@@ -1,0 +1,87 @@
+"""``veles-tpu-lint`` / ``python -m veles_tpu.analysis`` — the CI gate.
+
+Exit code 0 when every finding is suppressed inline or accepted by the
+baseline; 1 when new findings exist (print them, fail the build); 2 on
+usage errors (argparse).  ``--json`` emits the machine-readable form
+the way ``veles-tpu --dump-config`` does for config.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Optional
+
+from .baseline import BASELINE_NAME, write_baseline
+from .engine import run_analysis
+from .findings import sort_key
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="veles-tpu-lint",
+        description="trace-discipline / host-concurrency / config-drift "
+                    "static analyzer for veles_tpu (docs/analysis.md)")
+    p.add_argument("paths", nargs="*", default=["veles_tpu"],
+                   help="files or directories to analyze "
+                        "(default: veles_tpu)")
+    p.add_argument("--json", action="store_true",
+                   help="emit findings as JSON instead of text")
+    p.add_argument("--baseline", default="auto", metavar="PATH",
+                   help=f"baseline file (default: nearest "
+                        f"{BASELINE_NAME} walking up from the first "
+                        "path; 'none' disables)")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="accept every current finding into the baseline "
+                        "and exit 0")
+    p.add_argument("--docs", default="auto", metavar="DIR",
+                   help="docs directory for VK303 (default: nearest "
+                        "docs/ dir; 'none' disables the docs check)")
+    return p
+
+
+def main(argv: Optional[list] = None) -> int:
+    args = build_parser().parse_args(argv)
+    baseline = None if args.baseline == "none" else args.baseline
+    docs = None if args.docs == "none" else args.docs
+    report = run_analysis(args.paths, baseline_path=baseline,
+                          docs_dir=docs)
+    if report["files"] == 0:
+        # a wrong cwd / typo'd path must not silently DISABLE the gate
+        # by "cleanly" analyzing nothing
+        print(f"veles-tpu-lint: no Python files under {args.paths!r} "
+              "(wrong directory?)", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        path = report["baseline_path"] or BASELINE_NAME
+        n = write_baseline(path, report["all"])
+        print(f"baseline: wrote {n} finding(s) to {path}")
+        return 0
+
+    new = sorted(report["findings"], key=sort_key)
+    if args.json:
+        doc = {"findings": [f.to_dict() for f in new],
+               "accepted": len(report["accepted"]),
+               "files": report["files"],
+               "baseline": report["baseline_path"]}
+        print(json.dumps(doc, indent=1))
+        return 1 if new else 0
+
+    for f in new:
+        print(f.format())
+    errors = sum(1 for f in new if f.severity == "error")
+    warnings = len(new) - errors
+    accepted = len(report["accepted"])
+    tail = f" ({accepted} accepted by baseline)" if accepted else ""
+    if new:
+        print(f"\n{errors} error(s), {warnings} warning(s) across "
+              f"{report['files']} file(s){tail}")
+        return 1
+    print(f"clean: 0 findings across {report['files']} file(s){tail}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

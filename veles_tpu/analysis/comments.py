@@ -1,0 +1,129 @@
+"""Comment-level syntax: suppressions and lock annotations.
+
+The analyzer's three in-source annotations all live in comments, so one
+``tokenize`` pass per file collects them (``ast`` drops comments):
+
+``# lint: disable=VT101[,VC201] <reason>``
+    Suppress those rules on this line — or, when the comment is a
+    standalone line, on the next code line.  The reason is REQUIRED:
+    a reasonless suppression still suppresses, but emits VA001 so the
+    missing justification is itself a finding.
+
+``# guarded-by: self._lock``
+    On a field assignment (``self.x = ... # guarded-by: self._lock``):
+    every read/write of ``self.x`` elsewhere in the class must sit
+    inside ``with self._lock:`` (concurrency_rules, VC201).
+
+``# requires-lock: self._lock``
+    On a ``def`` line: the method's contract is "caller holds the
+    lock", so its body counts as guarded without its own ``with``.
+
+``# not-shared: <reason>``
+    On a ``def`` line: the method runs before the object is visible to
+    other threads (construction helpers ``__init__`` delegates to), so
+    VC201 does not apply inside it.  The reason is required, like a
+    suppression's.
+
+``# trace-root: traced|builder``
+    On a ``def`` line: mark the function a trace root without a
+    registry entry — the escape hatch for modules the registry does not
+    know (and the fixture syntax the analyzer's own tests use).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+import re
+import tokenize
+from typing import Dict, List, Optional, Set, Tuple
+
+_DISABLE_RE = re.compile(
+    r"#\s*lint:\s*disable=([A-Z]{2}\d{3}(?:\s*,\s*[A-Z]{2}\d{3})*)"
+    r"\s*(.*)")
+_GUARDED_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][\w.]*)")
+_REQUIRES_RE = re.compile(r"#\s*requires-lock:\s*([A-Za-z_][\w.]*)")
+_TRACEROOT_RE = re.compile(r"#\s*trace-root:\s*(traced|builder)")
+_NOTSHARED_RE = re.compile(r"#\s*not-shared:\s*(\S.*)")
+
+
+@dataclasses.dataclass
+class Suppression:
+    line: int                 # line the suppression APPLIES to
+    rules: Set[str]
+    reason: str
+    comment_line: int         # line the comment itself sits on
+
+
+@dataclasses.dataclass
+class FileComments:
+    #: applies-to line -> suppression
+    suppressions: Dict[int, Suppression]
+    #: comment line -> lock expression text (``self._lock``)
+    guarded_by: Dict[int, str]
+    #: comment line -> lock expression text
+    requires_lock: Dict[int, str]
+    #: comment line -> "traced" | "builder"
+    trace_root: Dict[int, str]
+    #: comment line -> reason the method is construction-only
+    not_shared: Dict[int, str]
+
+    def suppressed(self, line: int, rule: str) -> Optional[Suppression]:
+        s = self.suppressions.get(line)
+        if s is not None and rule in s.rules:
+            return s
+        return None
+
+
+def scan_comments(source: str) -> FileComments:
+    """One tokenize pass: every comment, its line, and whether any code
+    shares that line (standalone comments bind to the NEXT code line)."""
+    comments: List[Tuple[int, int, str]] = []   # (line, col, text)
+    code_lines: Set[int] = set()
+    try:
+        toks = list(tokenize.generate_tokens(
+            io.StringIO(source).readline))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        toks = []
+    for tok in toks:
+        if tok.type == tokenize.COMMENT:
+            comments.append((tok.start[0], tok.start[1], tok.string))
+        elif tok.type not in (tokenize.NL, tokenize.NEWLINE,
+                              tokenize.INDENT, tokenize.DEDENT,
+                              tokenize.ENCODING, tokenize.ENDMARKER):
+            for ln in range(tok.start[0], tok.end[0] + 1):
+                code_lines.add(ln)
+
+    out = FileComments({}, {}, {}, {}, {})
+    n_lines = source.count("\n") + 1
+    for line, _col, text in comments:
+        m = _DISABLE_RE.search(text)
+        if m:
+            rules = {r.strip() for r in m.group(1).split(",")}
+            reason = m.group(2).strip()
+            target = line
+            if line not in code_lines:
+                # standalone comment: binds to the next code line
+                target = line + 1
+                while target <= n_lines and target not in code_lines:
+                    target += 1
+            prev = out.suppressions.get(target)
+            if prev is not None:
+                prev.rules |= rules
+                prev.reason = prev.reason or reason
+            else:
+                out.suppressions[target] = Suppression(
+                    target, rules, reason, line)
+        m = _GUARDED_RE.search(text)
+        if m:
+            out.guarded_by[line] = m.group(1)
+        m = _REQUIRES_RE.search(text)
+        if m:
+            out.requires_lock[line] = m.group(1)
+        m = _TRACEROOT_RE.search(text)
+        if m:
+            out.trace_root[line] = m.group(1)
+        m = _NOTSHARED_RE.search(text)
+        if m:
+            out.not_shared[line] = m.group(1)
+    return out

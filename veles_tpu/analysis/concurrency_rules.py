@@ -1,0 +1,347 @@
+"""VC2xx — host-concurrency discipline.
+
+The host side of this runtime is deliberately multi-threaded: the
+decode-engine scheduler, REST worker threads, the deploy control plane,
+the snapshot watcher and the status reporter all share mutable state.
+The locking convention is documented per field with a trailing
+``# guarded-by: <lock>`` comment on the field's defining assignment
+(``self._queue = deque()  # guarded-by: self._qlock``, or a module
+global guarded by a module-level lock), and this rule makes the
+convention checkable:
+
+VC201  a read or write of a guarded field outside a ``with <lock>:``
+       block in the same function.  ``__init__`` of the defining class
+       is exempt (construction precedes sharing), as is module-level
+       initialization; a method whose contract is "caller holds the
+       lock" declares it with ``# requires-lock: <lock>`` on its
+       ``def`` line.
+VC202  ``lock.acquire()`` without an enclosing/immediately-following
+       ``try/finally: lock.release()`` — an exception between acquire
+       and release deadlocks every other thread; ``with lock:`` is the
+       fix.
+VC203  a ``guarded-by``/``requires-lock`` annotation naming a lock the
+       class (or module) never defines — almost always a typo, and a
+       typo here silently un-guards the field.
+
+Scope is intra-function and syntactic (the same-method rule from the
+issue): lock aliasing, cross-function lock flow, and re-entrancy are
+out of scope — suppressions document the places that matters.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .findings import Finding
+from .pysrc import ParsedFile, dotted_name
+
+
+def _lock_key(text: str) -> str:
+    """Normalize a lock spelling: ``self._lock`` and ``_lock`` both key
+    on the attribute/name so annotation and ``with`` can't disagree on
+    the ``self.`` prefix."""
+    return text.split(".")[-1]
+
+
+class _ClassGuards:
+    def __init__(self, name: str):
+        self.name = name
+        self.fields: Dict[str, Tuple[str, int]] = {}  # field -> (lock, line)
+        self.self_attrs: Set[str] = set()             # every self.X assigned
+        #: methods annotated ``# requires-lock:`` -> the lock they need
+        #: (their CALL SITES must hold it — annotating a method shifts
+        #: the obligation to callers, it must not erase it)
+        self.requires: Dict[str, str] = {}
+
+
+def _collect_guards(pf: ParsedFile):
+    """(class guards by class name, module-global guards name->(lock,
+    line))."""
+    classes: Dict[str, _ClassGuards] = {}
+    module_guards: Dict[str, Tuple[str, int]] = {}
+
+    for node in pf.tree.body:
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            lock = pf.comments.guarded_by.get(node.lineno)
+            if lock:
+                for t in targets:
+                    if isinstance(t, ast.Name):
+                        module_guards[t.id] = (lock, node.lineno)
+
+    for cls in ast.walk(pf.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        cg = classes.setdefault(cls.name, _ClassGuards(cls.name))
+        for node in ast.walk(cls):
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                lock = pf.comments.guarded_by.get(node.lineno)
+                for t in targets:
+                    if isinstance(t, ast.Attribute) \
+                            and isinstance(t.value, ast.Name) \
+                            and t.value.id == "self":
+                        cg.self_attrs.add(t.attr)
+                        if lock and t.attr not in cg.fields:
+                            cg.fields[t.attr] = (lock, node.lineno)
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                req = pf.comments.requires_lock.get(node.lineno)
+                if req:
+                    cg.requires[node.name] = req
+    return classes, module_guards
+
+
+class _MethodWalk:
+    """Walk one function tracking held locks (``with`` nesting +
+    ``requires-lock``) and enclosing try/finally releases."""
+
+    def __init__(self, pf: ParsedFile, qualname: str, fn: ast.AST,
+                 cls: Optional[_ClassGuards],
+                 module_guards: Dict[str, Tuple[str, int]],
+                 module_names: Set[str],
+                 module_requires: Dict[str, str],
+                 out: List[Finding]):
+        self.pf = pf
+        self.qualname = qualname
+        self.fn = fn
+        self.cls = cls
+        self.module_guards = module_guards
+        self.module_names = module_names
+        self.module_requires = module_requires
+        self.out = out
+        self.held: Set[str] = set()
+        self.finally_released: Set[str] = set()
+        self.is_init = (fn.name in ("__init__", "__new__")
+                        if hasattr(fn, "name") else False) \
+            or fn.lineno in pf.comments.not_shared
+        req = pf.comments.requires_lock.get(fn.lineno)
+        if req:
+            self._check_lock_exists(req, fn.lineno)
+            self.held.add(_lock_key(req))
+
+    def _emit(self, rule, line, col, message, hint):
+        self.out.append(Finding(
+            rule=rule, path=self.pf.relpath, line=line, col=col,
+            message=message, hint=hint, symbol=self.qualname,
+            snippet=self.pf.line_text(line)))
+
+    def _check_lock_exists(self, lock: str, line: int):
+        key = _lock_key(lock)
+        known = key in self.module_names \
+            or (self.cls is not None and key in self.cls.self_attrs)
+        if not known:
+            self._emit(
+                "VC203", line, 0,
+                f"annotation names lock `{lock}`, which is defined "
+                "neither on the class nor at module level",
+                "fix the lock name — a typo here silently un-guards "
+                "the field")
+
+    # -- traversal ----------------------------------------------------------
+    def run(self):
+        self._stmts(self.fn.body)
+
+    def _stmts(self, body):
+        for i, stmt in enumerate(body):
+            nxt = body[i + 1] if i + 1 < len(body) else None
+            self._stmt(stmt, nxt)
+
+    def _stmt(self, stmt: ast.stmt, nxt: Optional[ast.stmt]):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return                      # nested defs walked separately
+        if isinstance(stmt, ast.With):
+            prev = set(self.held)
+            for item in stmt.items:
+                text = dotted_name(item.context_expr)
+                if text:
+                    self.held.add(_lock_key(text))
+                self._scan_expr(item.context_expr)
+            self._stmts(stmt.body)
+            self.held = prev
+            return
+        if isinstance(stmt, ast.Try):
+            released = set()
+            for f in ast.walk(ast.Module(body=stmt.finalbody,
+                                         type_ignores=[])):
+                if isinstance(f, ast.Call) \
+                        and isinstance(f.func, ast.Attribute) \
+                        and f.func.attr == "release":
+                    text = dotted_name(f.func.value)
+                    if text:
+                        released.add(_lock_key(text))
+            self.finally_released |= released
+            self._stmts(stmt.body)
+            self.finally_released -= released
+            for h in stmt.handlers:
+                self._stmts(h.body)
+            self._stmts(stmt.orelse)
+            self._stmts(stmt.finalbody)
+            return
+        # acquire() discipline (VC202): look at expression statements
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+            call = stmt.value
+            if isinstance(call.func, ast.Attribute) \
+                    and call.func.attr == "acquire":
+                base = dotted_name(call.func.value)
+                key = _lock_key(base) if base else None
+                ok = key is not None and (
+                    key in self.finally_released
+                    or self._next_releases(nxt, key))
+                if not ok:
+                    self._emit(
+                        "VC202", stmt.lineno, stmt.col_offset,
+                        f"bare `{base or '<lock>'}.acquire()` without a "
+                        "try/finally release — an exception here "
+                        "deadlocks every waiter",
+                        f"use `with {base or '<lock>'}:` (or wrap the "
+                        "critical section in try/finally)")
+        # compound statements: keep sibling info so acquire-then-try
+        # works anywhere, not just at function top level
+        if isinstance(stmt, (ast.If, ast.While)):
+            self._scan_expr(stmt.test)
+            self._stmts(stmt.body)
+            self._stmts(stmt.orelse)
+            return
+        if isinstance(stmt, ast.For):
+            self._scan_expr(stmt.iter)
+            self._scan_expr(stmt.target)
+            self._stmts(stmt.body)
+            self._stmts(stmt.orelse)
+            return
+        # generic statement: scan its expressions
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self._scan_expr(child)
+            elif isinstance(child, ast.stmt):
+                self._stmt(child, None)
+
+    @staticmethod
+    def _next_releases(nxt: Optional[ast.stmt], key: str) -> bool:
+        """``lock.acquire()`` directly followed by ``try: ...
+        finally: lock.release()``."""
+        if not isinstance(nxt, ast.Try):
+            return False
+        for f in ast.walk(ast.Module(body=nxt.finalbody,
+                                     type_ignores=[])):
+            if isinstance(f, ast.Call) \
+                    and isinstance(f.func, ast.Attribute) \
+                    and f.func.attr == "release":
+                text = dotted_name(f.func.value)
+                if text and _lock_key(text) == key:
+                    return True
+        return False
+
+    def _scan_expr(self, node: ast.AST):
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.Lambda,)):
+                continue
+            if isinstance(sub, ast.Call) \
+                    and isinstance(sub.func, ast.Attribute) \
+                    and isinstance(sub.func.value, ast.Name) \
+                    and sub.func.value.id == "self" \
+                    and self.cls is not None \
+                    and sub.func.attr in self.cls.requires:
+                # calling a requires-lock method without the lock:
+                # the annotation shifts the obligation here, not away
+                lock = self.cls.requires[sub.func.attr]
+                if not self.is_init and _lock_key(lock) not in self.held:
+                    self._emit(
+                        "VC201", sub.lineno, sub.col_offset,
+                        f"`self.{sub.func.attr}()` requires "
+                        f"`{lock}` held (its `# requires-lock:` "
+                        "contract) but the caller does not hold it",
+                        f"wrap the call in `with {lock}:`")
+            if isinstance(sub, ast.Call) \
+                    and isinstance(sub.func, ast.Name) \
+                    and sub.func.id in self.module_requires:
+                lock = self.module_requires[sub.func.id]
+                if not self.is_init and _lock_key(lock) not in self.held:
+                    self._emit(
+                        "VC201", sub.lineno, sub.col_offset,
+                        f"`{sub.func.id}()` requires `{lock}` held "
+                        "(its `# requires-lock:` contract) but the "
+                        "caller does not hold it",
+                        f"wrap the call in `with {lock}:`")
+            if isinstance(sub, ast.Attribute) \
+                    and isinstance(sub.value, ast.Name) \
+                    and sub.value.id == "self" and self.cls is not None:
+                self._check_access(sub.attr, sub.lineno, sub.col_offset,
+                                   f"self.{sub.attr}",
+                                   self.cls.fields)
+            elif isinstance(sub, ast.Name) and sub.id in self.module_guards:
+                self._check_access(sub.id, sub.lineno, sub.col_offset,
+                                   sub.id,
+                                   {k: v for k, v in
+                                    self.module_guards.items()})
+
+    def _check_access(self, field: str, line: int, col: int,
+                      spelled: str, table: Dict[str, Tuple[str, int]]):
+        entry = table.get(field)
+        if entry is None:
+            return
+        lock, _decl_line = entry
+        if self.is_init:
+            return                      # construction precedes sharing
+        if _lock_key(lock) in self.held:
+            return
+        self._emit(
+            "VC201", line, col,
+            f"`{spelled}` is guarded by `{lock}` but is touched "
+            f"without holding it",
+            f"wrap the access in `with {lock}:` — or, if the caller "
+            f"holds it, annotate the method `# requires-lock: {lock}`")
+
+
+def check(pf: ParsedFile) -> List[Finding]:
+    out: List[Finding] = []
+    annotated = bool(pf.comments.guarded_by) \
+        or bool(pf.comments.requires_lock)
+    if annotated:
+        classes, module_guards = _collect_guards(pf)
+    else:   # no annotations: skip the per-class tree walks entirely
+        classes, module_guards = {}, {}
+    module_names = {n.id for s in pf.tree.body
+                    if isinstance(s, (ast.Assign, ast.AnnAssign))
+                    for n in (s.targets if isinstance(s, ast.Assign)
+                              else [s.target])
+                    if isinstance(n, ast.Name)}
+    module_requires = {
+        info.node.name: pf.comments.requires_lock[info.node.lineno]
+        for q, info in pf.functions.items()
+        if "." not in q and info.node.lineno in pf.comments.requires_lock
+    } if annotated else {}
+    # validate guarded-by lock names once, at the annotation site
+    for cg in classes.values():
+        for field, (lock, line) in cg.fields.items():
+            key = _lock_key(lock)
+            if key not in cg.self_attrs and key not in module_names:
+                out.append(Finding(
+                    rule="VC203", path=pf.relpath, line=line, col=0,
+                    message=f"`{field}` is annotated guarded-by "
+                            f"`{lock}`, which is defined neither on "
+                            "the class nor at module level",
+                    hint="fix the lock name — a typo here silently "
+                         "un-guards the field",
+                    symbol=cg.name, snippet=pf.line_text(line)))
+    for name, (lock, line) in module_guards.items():
+        if _lock_key(lock) not in module_names:
+            out.append(Finding(
+                rule="VC203", path=pf.relpath, line=line, col=0,
+                message=f"`{name}` is annotated guarded-by `{lock}`, "
+                        "which is not defined at module level",
+                hint="fix the lock name — a typo here silently "
+                     "un-guards the field",
+                snippet=pf.line_text(line)))
+
+    # the walk below runs in unannotated files too: VC202 (acquire
+    # discipline) needs no guarded-by annotations to fire
+    for q, info in pf.functions.items():
+        cg = classes.get(info.cls) if info.cls else None
+        _MethodWalk(pf, q, info.node, cg, module_guards,
+                    module_names, module_requires, out).run()
+    return out

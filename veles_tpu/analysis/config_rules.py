@@ -1,0 +1,297 @@
+"""VK3xx — config-key drift between code, declared defaults, and docs.
+
+The config tree auto-vivifies (``root.common.anything`` silently
+creates a node — veles_tpu/config.py), which is ergonomic and
+treacherous: a typo'd read returns an empty node instead of failing,
+and a deleted feature leaves its knob declared forever.  This rule
+cross-references three sources of truth:
+
+* **reads** — every statically visible ``root.common.*`` access in the
+  package: attribute chains, ``.get("key", default)`` /
+  ``.value("key", default)`` calls, ``getattr(root.common, "key",
+  default)``, and single-assignment aliases
+  (``serve = root.common.serve`` … ``serve.get("slots")``);
+* **declarations** — ``root.common.<dotted> = default`` assignments in
+  ``config.py`` (the ``_defaults()`` block);
+* **docs** — literal ``root.common.<key>`` mentions anywhere under the
+  docs directory (docs/configuration.md is the reference table).
+
+VK301  a key read somewhere but declared nowhere (typo, or a knob that
+       needs a default) — error.  Keys under
+       ``registry.DYNAMIC_CONFIG_PREFIXES`` (the fault-injection
+       switchboard) are exempt by design.
+VK302  a declared key no code reads — dead weight; delete it or wire
+       it up — warning.
+VK303  a declared key the docs never mention — warning.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Dict, List, Optional, Tuple
+
+from .findings import Finding
+from .pysrc import ParsedFile, dotted_name
+from .registry import DYNAMIC_CONFIG_PREFIXES
+
+_ROOT_PREFIX = "root.common"
+
+
+@dataclasses.dataclass
+class _Use:
+    key: str
+    path: str
+    line: int
+    col: int
+    symbol: str
+    snippet: str
+
+
+def _literal_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _imports_config_root(pf: ParsedFile) -> bool:
+    """Only treat ``root`` as the config tree in files that import it
+    from a ``config`` module (or in config.py itself)."""
+    target = pf.aliases.get("root", "")
+    return target.endswith("config.root") \
+        or os.path.basename(pf.relpath) == "config.py"
+
+
+def _chain_key(pf: ParsedFile, node: ast.AST,
+               aliases: Dict[str, str]) -> Optional[str]:
+    """Dotted key relative to ``root.common`` for a chain expression,
+    via the file's config aliases; None when the chain is unrelated."""
+    dotted = dotted_name(node)
+    if dotted is None:
+        return None
+    if dotted == _ROOT_PREFIX:
+        return ""
+    if dotted.startswith(_ROOT_PREFIX + "."):
+        return dotted[len(_ROOT_PREFIX) + 1:]
+    head, _, rest = dotted.partition(".")
+    if head in aliases:
+        prefix = aliases[head]
+        if not rest:
+            return prefix
+        return f"{prefix}.{rest}" if prefix else rest
+    return None
+
+
+def _collect_declared(pf: ParsedFile) -> Dict[str, Tuple[int, str]]:
+    """key -> (line, snippet) for every ``root.common.<key> = ...``."""
+    out: Dict[str, Tuple[int, str]] = {}
+    for node in ast.walk(pf.tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        for t in node.targets:
+            dotted = dotted_name(t)
+            if dotted and dotted.startswith(_ROOT_PREFIX + "."):
+                key = dotted[len(_ROOT_PREFIX) + 1:]
+                out.setdefault(key, (node.lineno,
+                                     pf.line_text(node.lineno)))
+    return out
+
+
+def _symbol_at(pf: ParsedFile, line: int) -> str:
+    best, best_span = "", None
+    for q, info in pf.functions.items():
+        node = info.node
+        end = getattr(node, "end_lineno", node.lineno)
+        if node.lineno <= line <= end:
+            span = end - node.lineno
+            if best_span is None or span < best_span:
+                best, best_span = q, span
+    return best
+
+
+def _collect_uses(pf: ParsedFile) -> List[_Use]:
+    if not _imports_config_root(pf):
+        return []
+    # pass 1: aliases of pure root.common chains (serve = root.common
+    # .serve).  File-wide by name, BUT a name that is ever assigned
+    # anything else anywhere in the file is disqualified — an unrelated
+    # local `serve = {...}` in another function must not make its
+    # `.get()` calls look like config reads (false VK301s).
+    aliases: Dict[str, str] = {}
+    poisoned = set()
+    for node in ast.walk(pf.tree):
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            if len(targets) != 1 or not isinstance(targets[0], ast.Name):
+                continue
+            name = targets[0].id
+            dotted = dotted_name(node.value) if node.value else None
+            if dotted == _ROOT_PREFIX:
+                aliases[name] = ""
+            elif dotted and dotted.startswith(_ROOT_PREFIX + "."):
+                aliases[name] = dotted[len(_ROOT_PREFIX) + 1:]
+            else:
+                poisoned.add(name)
+        # any other binding form sharing the name — a function
+        # parameter, for/with/except/comprehension target — also
+        # disqualifies it: `def f(serve): serve.get(...)` is not a
+        # config read
+        elif isinstance(node, ast.arg):
+            poisoned.add(node.arg)
+        elif isinstance(node, (ast.For, ast.comprehension)):
+            for sub in ast.walk(node.target):
+                if isinstance(sub, ast.Name):
+                    poisoned.add(sub.id)
+        elif isinstance(node, ast.withitem) \
+                and node.optional_vars is not None:
+            for sub in ast.walk(node.optional_vars):
+                if isinstance(sub, ast.Name):
+                    poisoned.add(sub.id)
+        elif isinstance(node, ast.ExceptHandler) and node.name:
+            poisoned.add(node.name)
+    for name in poisoned:
+        aliases.pop(name, None)
+
+    uses: List[_Use] = []
+    claimed = set()     # (line, col) of chain nodes consumed by a call
+
+    def add(key: Optional[str], node: ast.AST):
+        if key:         # "" = the root.common node itself: not a key
+            uses.append(_Use(key, pf.relpath, node.lineno,
+                             node.col_offset, _symbol_at(pf, node.lineno),
+                             pf.line_text(node.lineno)))
+
+    for node in ast.walk(pf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        # root.common[.x].get("k", d) / .value("k", d)
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in ("get", "value") and node.args:
+            prefix = _chain_key(pf, node.func.value, aliases)
+            lit = _literal_str(node.args[0])
+            if prefix is not None and lit is not None:
+                key = f"{prefix}.{lit}" if prefix else lit
+                add(key, node)
+                _mark_claimed(node.func, claimed)
+        # getattr(root.common[.x], "k", d)
+        elif isinstance(node.func, ast.Name) and node.func.id == "getattr" \
+                and len(node.args) >= 2:
+            prefix = _chain_key(pf, node.args[0], aliases)
+            lit = _literal_str(node.args[1])
+            if prefix is not None and lit is not None:
+                key = f"{prefix}.{lit}" if prefix else lit
+                add(key, node)
+                _mark_claimed(node.args[0], claimed)
+
+    # bare chains (reads and writes), maximal only, not call-consumed
+    parents: Dict[int, ast.AST] = {}
+    for parent in ast.walk(pf.tree):
+        for child in ast.iter_child_nodes(parent):
+            parents[id(child)] = parent
+    for node in ast.walk(pf.tree):
+        if not isinstance(node, ast.Attribute):
+            continue
+        par = parents.get(id(node))
+        if isinstance(par, ast.Attribute) and par.value is node:
+            continue                     # not maximal
+        if (node.lineno, node.col_offset) in claimed:
+            continue
+        if isinstance(par, ast.Call) and par.func is node:
+            # ``root.common.mesh.items()``: the final attr is a Config
+            # method, not a key segment — the key is the receiver chain
+            node = node.value
+            if not isinstance(node, (ast.Attribute, ast.Name)):
+                continue
+        key = _chain_key(pf, node, aliases)
+        if key is None:
+            continue
+        # alias definitions themselves (serve = root.common.serve) are
+        # node references, not leaf reads — recorded but harmless:
+        # prefixes are always declared when any child is.
+        add(key, node)
+    return uses
+
+
+def _mark_claimed(node: ast.AST, claimed: set):
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute):
+            claimed.add((sub.lineno, sub.col_offset))
+
+
+def _docs_mentions(docs_dir: str) -> str:
+    chunks = []
+    for base, _dirs, files in os.walk(docs_dir):
+        for fn in files:
+            if fn.endswith((".md", ".rst", ".txt")):
+                try:
+                    with open(os.path.join(base, fn),
+                              encoding="utf-8") as f:
+                        chunks.append(f.read())
+                except OSError:
+                    continue
+    return "\n".join(chunks)
+
+
+def check(files: List[ParsedFile],
+          docs_dir: Optional[str] = None) -> List[Finding]:
+    config_files = [pf for pf in files
+                    if os.path.basename(pf.relpath) == "config.py"]
+    declared: Dict[str, Tuple[str, int, str]] = {}
+    for pf in config_files:
+        for key, (line, snippet) in _collect_declared(pf).items():
+            declared.setdefault(key, (pf.relpath, line, snippet))
+    if not declared:
+        return []                        # nothing to drift against
+    prefixes = set()
+    for key in declared:
+        parts = key.split(".")
+        for i in range(1, len(parts)):
+            prefixes.add(".".join(parts[:i]))
+
+    uses: List[_Use] = []
+    for pf in files:
+        if pf in config_files:
+            continue
+        uses.extend(_collect_uses(pf))
+
+    out: List[Finding] = []
+    used_keys = set()
+    for u in uses:
+        used_keys.add(u.key)
+        if u.key in declared or u.key in prefixes:
+            continue
+        if any(u.key == p or u.key.startswith(p + ".")
+               for p in DYNAMIC_CONFIG_PREFIXES):
+            continue
+        out.append(Finding(
+            rule="VK301", path=u.path, line=u.line, col=u.col,
+            message=f"config key `root.common.{u.key}` is read here "
+                    "but declared nowhere in config.py (auto-"
+                    "vivification would hand back an empty node)",
+            hint="declare a default in config.py _defaults() — or fix "
+                 "the key name",
+            symbol=u.symbol, snippet=u.snippet))
+
+    docs_text = ""
+    if docs_dir and os.path.isdir(docs_dir):
+        docs_text = _docs_mentions(docs_dir)
+    for key, (path, line, snippet) in sorted(declared.items()):
+        leaf_used = key in used_keys or any(
+            k.startswith(key + ".") for k in used_keys)
+        if not leaf_used:
+            out.append(Finding(
+                rule="VK302", path=path, line=line, col=0,
+                message=f"config key `root.common.{key}` is declared "
+                        "but no code reads it",
+                hint="delete the declaration or wire the knob up",
+                symbol="_defaults", snippet=snippet))
+        if docs_text and f"root.common.{key}" not in docs_text:
+            out.append(Finding(
+                rule="VK303", path=path, line=line, col=0,
+                message=f"config key `root.common.{key}` is not "
+                        "documented anywhere under docs/",
+                hint="add it to docs/configuration.md",
+                symbol="_defaults", snippet=snippet))
+    return out

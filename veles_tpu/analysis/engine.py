@@ -1,0 +1,152 @@
+"""Analysis driver: file discovery -> rules -> suppressions -> baseline.
+
+Everything here is pure stdlib and never imports the modules it
+analyzes; ``run_analysis`` is the programmatic entry the CLI and the
+tier-1 self-check test (tests/test_analysis.py) share.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Tuple
+
+from . import concurrency_rules, config_rules, trace_rules
+from .baseline import find_baseline, load_baseline, split_baselined
+from .findings import SEVERITIES, Finding, sort_key
+from .pysrc import ParsedFile, parse_file
+
+SEVERITIES.setdefault("VA002", "error")     # unparseable source
+
+_SKIP_DIRS = {"__pycache__", ".git", ".venv", "node_modules"}
+
+
+def _package_anchor(directory: str) -> str:
+    """Walk up past ``__init__.py`` packages: the anchor display paths
+    are computed against.  ``.../veles_tpu/runtime`` anchors at
+    ``.../`` (the repo root), so `veles-tpu-lint veles_tpu` and
+    `veles-tpu-lint veles_tpu/runtime/engine.py` both display
+    ``veles_tpu/runtime/engine.py`` and baseline fingerprints agree
+    across invocation styles, machines, and working directories."""
+    d = os.path.abspath(directory)
+    while os.path.isfile(os.path.join(d, "__init__.py")):
+        parent = os.path.dirname(d)
+        if parent == d:
+            break
+        d = parent
+    return d
+
+
+def iter_python_files(paths) -> List[Tuple[str, str]]:
+    """(abspath, display-relpath) for every .py under ``paths`` (files
+    or directories), stable order.  Display paths anchor at the
+    enclosing package root's parent (:func:`_package_anchor`), never at
+    the invoker's cwd."""
+    out: List[Tuple[str, str]] = []
+    seen = set()
+    for path in paths:
+        path = os.path.abspath(path)
+        if os.path.isfile(path):
+            anchor = _package_anchor(os.path.dirname(path))
+            if path not in seen:
+                seen.add(path)
+                out.append((path, os.path.relpath(path, anchor)))
+            continue
+        anchor = _package_anchor(path.rstrip(os.sep))
+        if anchor == path.rstrip(os.sep):   # not a package: its parent
+            anchor = os.path.dirname(path.rstrip(os.sep)) or path
+        for base, dirs, files in os.walk(path):
+            dirs[:] = sorted(d for d in dirs
+                             if d not in _SKIP_DIRS
+                             and not d.startswith("."))
+            for fn in sorted(files):
+                full = os.path.join(base, fn)
+                if fn.endswith(".py") and full not in seen:
+                    seen.add(full)
+                    out.append((full, os.path.relpath(full, anchor)))
+    return out
+
+
+def analyze_files(file_list: List[Tuple[str, str]], *,
+                  trace_roots: Optional[Dict[str, Dict[str, str]]] = None,
+                  docs_dir: Optional[str] = None) -> List[Finding]:
+    """Run every rule over the files; returns findings AFTER inline
+    suppressions (``# lint: disable=``) but BEFORE the baseline."""
+    parsed: List[ParsedFile] = []
+    findings: List[Finding] = []
+    by_path: Dict[str, ParsedFile] = {}
+    for full, rel in file_list:
+        try:
+            pf = parse_file(full, rel)
+        except (SyntaxError, UnicodeDecodeError) as e:
+            findings.append(Finding(
+                rule="VA002", path=rel.replace(os.sep, "/"),
+                line=getattr(e, "lineno", 1) or 1, col=0,
+                message=f"file does not parse: {e.msg if hasattr(e, 'msg') else e}",
+                hint="the analyzer needs valid Python"))
+            continue
+        parsed.append(pf)
+        by_path[pf.relpath] = pf
+
+    for pf in parsed:
+        findings.extend(trace_rules.check(pf, trace_roots))
+        findings.extend(concurrency_rules.check(pf))
+        for sup in pf.comments.suppressions.values():
+            if not sup.reason:
+                findings.append(Finding(
+                    rule="VA001", path=pf.relpath,
+                    line=sup.comment_line, col=0,
+                    message="suppression without a reason — the "
+                            "justification is part of the syntax "
+                            "(`# lint: disable=RULE why`)",
+                    hint="say why the finding is acceptable",
+                    snippet=pf.line_text(sup.comment_line)))
+    findings.extend(config_rules.check(parsed, docs_dir))
+
+    kept: List[Finding] = []
+    for f in findings:
+        pf = by_path.get(f.path)
+        if pf is not None and f.rule != "VA001" \
+                and pf.comments.suppressed(f.line, f.rule):
+            continue
+        kept.append(f)
+    kept.sort(key=sort_key)
+    return kept
+
+
+def _auto_docs_dir(paths) -> Optional[str]:
+    for path in paths:
+        d = os.path.abspath(path)
+        if os.path.isfile(d):
+            d = os.path.dirname(d)
+        while True:
+            cand = os.path.join(d, "docs")
+            if os.path.isdir(cand):
+                return cand
+            parent = os.path.dirname(d)
+            if parent == d:
+                break
+            d = parent
+    return None
+
+
+def run_analysis(paths, *, baseline_path: Optional[str] = "auto",
+                 docs_dir: Optional[str] = "auto",
+                 trace_roots: Optional[dict] = None) -> dict:
+    """Full pipeline; returns::
+
+        {"findings": [new Finding...], "accepted": [baselined...],
+         "all": [...], "files": N, "baseline_path": path_or_None}
+    """
+    file_list = iter_python_files(paths)
+    if docs_dir == "auto":
+        docs_dir = _auto_docs_dir(paths)
+    if baseline_path == "auto":
+        baseline_path = find_baseline(
+            os.path.abspath(paths[0])) if paths else None
+    all_findings = analyze_files(file_list, trace_roots=trace_roots,
+                                 docs_dir=docs_dir)
+    baseline = load_baseline(baseline_path)
+    new, accepted = split_baselined(all_findings, baseline)
+    return {"findings": new, "accepted": accepted, "all": all_findings,
+            "files": len(file_list), "baseline_path": baseline_path,
+            "docs_dir": docs_dir}

@@ -1,0 +1,67 @@
+"""Finding model: what every rule emits and what the baseline stores.
+
+A finding is identified across edits by its *fingerprint* — rule id,
+repo-relative path, enclosing symbol, and the whitespace-normalized
+source line — NOT by line number, so a baseline survives unrelated
+edits above the finding but goes stale the moment the flagged line
+itself changes (which is exactly when it deserves a fresh look).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+
+#: severity per rule id (docs/analysis.md has the full catalogue).
+SEVERITIES = {
+    "VA001": "warning",   # suppression without a reason
+    "VT101": "error",     # Python control flow on a traced value
+    "VT102": "error",     # host coercion of a traced value
+    "VT103": "warning",   # host-effect call inside traced scope
+    "VT104": "warning",   # unordered iteration feeding trace order
+    "VC201": "error",     # guarded field touched outside its lock
+    "VC202": "error",     # bare acquire() without try/finally release
+    "VC203": "error",     # guarded-by names a lock the class never defines
+    "VK301": "error",     # root.common.* read with no declared default
+    "VK302": "warning",   # declared config key nobody reads
+    "VK303": "warning",   # declared config key absent from the docs
+}
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str           # repo-relative, posix separators
+    line: int           # 1-based
+    col: int            # 0-based
+    message: str
+    hint: str = ""
+    symbol: str = ""    # enclosing ``Class.method`` / function, if any
+    snippet: str = ""   # stripped source line the finding anchors to
+
+    @property
+    def severity(self) -> str:
+        return SEVERITIES.get(self.rule, "error")
+
+    def fingerprint(self) -> str:
+        norm = " ".join(self.snippet.split())
+        raw = "|".join((self.rule, self.path, self.symbol, norm))
+        return hashlib.sha256(raw.encode()).hexdigest()[:16]
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "severity": self.severity,
+                "path": self.path, "line": self.line, "col": self.col,
+                "symbol": self.symbol, "message": self.message,
+                "hint": self.hint, "snippet": self.snippet,
+                "fingerprint": self.fingerprint()}
+
+    def format(self) -> str:
+        where = f"{self.path}:{self.line}:{self.col + 1}"
+        sym = f" [{self.symbol}]" if self.symbol else ""
+        hint = f"\n    fix: {self.hint}" if self.hint else ""
+        return (f"{where}: {self.rule} {self.severity}: "
+                f"{self.message}{sym}{hint}")
+
+
+def sort_key(f: Finding):
+    return (f.path, f.line, f.col, f.rule)

@@ -1,0 +1,96 @@
+"""Parsed-file model shared by every rule: one ``ast.parse`` + one
+``tokenize`` pass per file, an import-alias map for resolving dotted
+call chains, and a function index with stable qualnames
+(``Class.method``, ``outer.inner``)."""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Dict, List, Optional
+
+from .comments import FileComments, scan_comments
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a pure Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+@dataclasses.dataclass
+class FnInfo:
+    node: ast.AST           # FunctionDef | AsyncFunctionDef
+    qualname: str
+    cls: Optional[str]      # enclosing class name, for ``self.m()``
+
+
+class ParsedFile:
+    """Source + AST + comments + aliases for one .py file."""
+
+    def __init__(self, path: str, relpath: str, source: str):
+        self.path = path
+        self.relpath = relpath.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.comments: FileComments = scan_comments(source)
+        self.aliases = self._import_aliases(self.tree)
+        self.functions: Dict[str, FnInfo] = {}
+        self._index(self.tree, "", None)
+
+    def line_text(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+    # -- imports ------------------------------------------------------------
+    @staticmethod
+    def _import_aliases(tree: ast.AST) -> Dict[str, str]:
+        """local name -> canonical dotted module (``jnp`` ->
+        ``jax.numpy``, ``lax`` -> ``jax.lax``, ``np`` -> ``numpy``)."""
+        out: Dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    out[a.asname or a.name.split(".")[0]] = \
+                        a.name if a.asname else a.name.split(".")[0]
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                base = ("." * node.level) + node.module
+                for a in node.names:
+                    out[a.asname or a.name] = f"{base}.{a.name}"
+        return out
+
+    def resolve_chain(self, dotted: str) -> str:
+        """Rewrite the chain's root through the alias map."""
+        head, _, rest = dotted.partition(".")
+        base = self.aliases.get(head, head)
+        return f"{base}.{rest}" if rest else base
+
+    # -- functions ----------------------------------------------------------
+    def _index(self, node: ast.AST, prefix: str, cls: Optional[str]):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = f"{prefix}.{child.name}" if prefix else child.name
+                self.functions[q] = FnInfo(child, q, cls)
+                self._index(child, q, cls)
+            elif isinstance(child, ast.ClassDef):
+                cname = f"{prefix}.{child.name}" if prefix else child.name
+                self._index(child, cname, child.name)
+            else:
+                self._index(child, prefix, cls)
+
+    def module_functions(self) -> Dict[str, FnInfo]:
+        return {q: i for q, i in self.functions.items() if "." not in q}
+
+
+def parse_file(path: str, relpath: str) -> ParsedFile:
+    with open(path, encoding="utf-8") as f:
+        return ParsedFile(path, relpath, f.read())

@@ -1,0 +1,95 @@
+"""Trace-root registry: where traced programs begin.
+
+The trace-safety rules (VT1xx) only fire inside *traced scope* —
+functions whose bodies become XLA programs.  That set is declared here,
+per module, and closed module-locally by the analyzer: nested ``def``s
+inside a root and module-level functions a root calls are traced too
+(their parameters carry tracers), without any cross-module whole-program
+analysis.
+
+Two root modes:
+
+``BUILDER``
+    A program *factory* (``make_decode_fn``, ``generate``,
+    ``Workflow._build_step`` …): its body runs at trace/build time — so
+    host-effect calls (VT103) still matter there — but its own
+    parameters are static Python (plans, unit objects, config knobs),
+    not tracers.  The jitted functions it defines inside ARE traced and
+    get tracer-tainted parameters automatically.
+
+``TRACED``
+    A function whose positional parameters are themselves traced values
+    (``DecodePlan.step``, ``sample_logits``, ``_attn_decode_step`` …).
+    Keyword-only parameters stay untainted — in this codebase they are
+    static sampling/config knobs by convention.
+
+Extending for a new program kind (e.g. a speculative-decode step): add
+its builder/step qualnames to the module entry below — nothing else;
+the call-graph closure picks up everything they call.  docs/analysis.md
+walks through the workflow.
+"""
+
+from __future__ import annotations
+
+BUILDER = "builder"
+TRACED = "traced"
+
+#: module path (relative to the ``veles_tpu`` package, posix slashes)
+#: -> {qualname: mode}.  Qualnames are ``func`` or ``Class.method``.
+TRACE_ROOTS = {
+    "runtime/generate.py": {
+        "_attn_cache_init": BUILDER,
+        "_rec_state_init": BUILDER,
+        "_rec_decode_step": TRACED,
+        "_rope_rows": TRACED,
+        "_attn_decode_step": TRACED,
+        "_attn_scores": TRACED,
+        "DecodePlan.init_caches": BUILDER,
+        "DecodePlan.step": TRACED,
+        "sample_logits": TRACED,
+        "generate": BUILDER,
+        "generate_beam": BUILDER,
+    },
+    "runtime/engine.py": {
+        "make_decode_fn": BUILDER,
+        "make_prefill_fn": BUILDER,
+        "_make_paged_prefill_fn": BUILDER,
+        "_sample_slots": TRACED,
+    },
+    # step_cache.py compiles programs other modules build; it never
+    # traces model math itself, so it contributes no roots — listed so
+    # the next reader knows that was a decision, not an omission.
+    "runtime/step_cache.py": {},
+    "units/workflow.py": {
+        "Workflow.forward": TRACED,
+        "Workflow._metrics": TRACED,
+        "Workflow._build_step": BUILDER,
+        "Workflow.make_eval_step": BUILDER,
+        "Workflow.make_predict_step": BUILDER,
+    },
+    "parallel/pipeline_compile.py": {
+        "PipelinePlan._apply_acc": TRACED,
+        "PipelinePlan.stage_fns": BUILDER,
+        "PipelinePlan.stage_fn_shared": BUILDER,
+        "PipelinePlan.loss_fn": BUILDER,
+        "build_pipeline_step": BUILDER,
+    },
+    "export/compiled.py": {
+        "_export_one": BUILDER,
+    },
+}
+
+#: ``root.common`` subtrees that are deliberately NOT declared in
+#: config.py: the fault-injection switchboard keeps ``root.common
+#: .faults`` an empty node in production so its presence check stays one
+#: falsy read (runtime/faults.py).  VK301 skips keys under these.
+DYNAMIC_CONFIG_PREFIXES = ("faults",)
+
+#: modules whose calls inside traced scope are host effects (VT103).
+HOST_EFFECT_MODULES = (
+    "time", "random", "os", "io", "pathlib", "shutil", "socket",
+    "subprocess", "urllib", "requests", "sqlite3", "tempfile",
+)
+
+#: builtins that are host effects when called in traced scope (VT103).
+HOST_EFFECT_BUILTINS = ("open", "input", "print")

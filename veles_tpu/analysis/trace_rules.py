@@ -1,0 +1,406 @@
+"""VT1xx — trace-safety rules.
+
+Inside *traced scope* (functions reachable from the registry's trace
+roots, closed module-locally over nested ``def``s and local calls), the
+analyzer runs a light forward taint pass: values produced by
+``jax.*``/``jnp.*``/``lax.*`` calls are tracers; arithmetic, comparison,
+subscripting, method calls and calls fed tainted arguments stay
+tainted; attribute loads (``x.shape``, ``x.ndim``, ``u.rope``) break
+taint because array metadata is static at trace time.  On that lattice:
+
+VT101  Python ``if``/``while``/``assert``/conditional-expression whose
+       test is tainted — host control flow on a traced value either
+       crashes (ConcretizationError) or silently bakes one trace-time
+       value into the compiled program.  ``x is None`` / ``x is not
+       None`` and ``in``/``not in`` membership are exempt: tracers are
+       never None and dict membership reads static keys.
+VT102  ``float()``/``int()``/``bool()``/``np.asarray()``/``.item()`` on
+       a tainted value — a host sync (and a constant-bake under jit).
+VT103  host-effect calls in traced scope: ``time.*``, ``random.*``
+       (the stdlib module — ``jax.random`` is fine), file/OS/network
+       IO, ``print``/``open``/``input``.  They run at trace time, not
+       per step, and bake their one observed value into the program.
+VT104  iteration over an unordered collection (``set`` literal,
+       ``set()``/``frozenset()`` call) that is not wrapped in
+       ``sorted()`` — trace order follows iteration order, so the
+       emitted program differs between processes.
+
+The pass is deliberately a single statement-order sweep with no joins:
+a best-effort linter that must hold zero false positives on the live
+package (suppressions carry the reasons for the handful of idioms it
+cannot see through), not a sound verifier.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from .findings import Finding
+from .pysrc import FnInfo, ParsedFile, dotted_name
+from .registry import (BUILDER, HOST_EFFECT_BUILTINS, HOST_EFFECT_MODULES,
+                       TRACE_ROOTS, TRACED)
+
+#: builtins whose result is static host data even on tracer args
+#: (len/shape-like structure queries), so they break taint.
+_STATIC_BUILTINS = {
+    "isinstance", "issubclass", "len", "getattr", "hasattr", "type",
+    "repr", "str", "callable", "id", "format",
+}
+
+_COERCIONS = {"float", "int", "bool"}
+_NP_COERCIONS = {"numpy.asarray", "numpy.array", "numpy.ascontiguousarray"}
+
+
+def _roots_for(pf: ParsedFile,
+               overrides: Optional[Dict[str, Dict[str, str]]]) -> dict:
+    """Registry roots for this file (longest registry key that is a
+    path suffix wins) merged with ``# trace-root:`` def-line comments."""
+    table = overrides if overrides is not None else TRACE_ROOTS
+    roots: Dict[str, str] = {}
+    best = ""
+    for key, entry in table.items():
+        if (pf.relpath == key or pf.relpath.endswith("/" + key)) \
+                and len(key) > len(best):
+            best, roots = key, dict(entry)
+    for q, info in pf.functions.items():
+        mode = pf.comments.trace_root.get(info.node.lineno)
+        if mode:
+            roots[q] = TRACED if mode == "traced" else BUILDER
+    return roots
+
+
+def _traced_closure(pf: ParsedFile, roots: dict) -> Dict[str, bool]:
+    """qualname -> params_tainted for every function in traced scope.
+
+    Declared roots keep their declared mode.  Nested ``def``s inside a
+    traced function are the literal jit/scan bodies, so their
+    parameters ARE tracers (minus defaulted params — the ``_u=u``
+    closure-binding idiom is static).  Module-local functions a traced
+    body merely *calls* join the scope with UNTAINTED parameters: they
+    are mostly host helpers fed static plan/shape data, and anything
+    tracer-valued they produce internally (jnp/jax calls) still taints.
+    """
+    modes: Dict[str, bool] = {}
+    for q, mode in roots.items():
+        if q in pf.functions:
+            modes[q] = mode == TRACED
+    mod_fns = pf.module_functions()
+    work = list(modes)
+    while work:
+        q = work.pop()
+        info = pf.functions[q]
+        for q2 in pf.functions:
+            if q2.startswith(q + ".") and "." not in q2[len(q) + 1:]:
+                if q2 not in modes:       # nested def: traced, tainted
+                    modes[q2] = True
+                    work.append(q2)
+        for node in ast.walk(info.node):
+            target = None
+            if isinstance(node, ast.Name) and node.id in mod_fns:
+                target = node.id
+            elif isinstance(node, ast.Attribute) and info.cls \
+                    and isinstance(node.value, ast.Name) \
+                    and node.value.id == "self":
+                cand = f"{info.cls}.{node.attr}"
+                if cand in pf.functions:
+                    target = cand
+            if target is not None and target not in modes:
+                modes[target] = False     # called helper: scope only
+                work.append(target)
+    return modes
+
+
+class _Taint:
+    """Single-pass taint walk over one function body (nested defs are
+    walked separately with their own parameter taint)."""
+
+    def __init__(self, pf: ParsedFile, info: FnInfo,
+                 params_tainted: bool, out: List[Finding]):
+        self.pf = pf
+        self.info = info
+        self.out = out
+        self.env: Set[str] = set()
+        a = info.node.args
+        if params_tainted:
+            pos = list(a.posonlyargs) + list(a.args)
+            # defaulted params are def-time closure bindings (`_i=_i`,
+            # `states=None`): static, untainted
+            n_defaults = len(a.defaults)
+            tainted = pos[:len(pos) - n_defaults] if n_defaults else pos
+            for arg in tainted:
+                if arg.arg not in ("self", "cls"):
+                    self.env.add(arg.arg)
+            if a.vararg is not None:
+                self.env.add(a.vararg.arg)
+            # keyword-only params are static knobs by convention
+            # (sampling temperature, page_size, ...): untainted.
+
+    # -- reporting ----------------------------------------------------------
+    def _emit(self, rule: str, node: ast.AST, message: str, hint: str):
+        self.out.append(Finding(
+            rule=rule, path=self.pf.relpath, line=node.lineno,
+            col=node.col_offset, message=message, hint=hint,
+            symbol=self.info.qualname,
+            snippet=self.pf.line_text(node.lineno)))
+
+    @staticmethod
+    def _src(node: ast.AST, limit: int = 60) -> str:
+        try:
+            text = ast.unparse(node)
+        except Exception:  # noqa: BLE001 — cosmetics only
+            text = "<expr>"
+        return text if len(text) <= limit else text[:limit - 1] + "…"
+
+    # -- expression taint ---------------------------------------------------
+    def taint(self, node: Optional[ast.AST]) -> bool:
+        if node is None:
+            return False
+        if isinstance(node, ast.Name):
+            return node.id in self.env
+        if isinstance(node, ast.Attribute):
+            self.taint(node.value)      # still scan for findings inside
+            return False                # .shape/.ndim/.dtype are static
+        if isinstance(node, ast.Subscript):
+            return self.taint(node.value) | self.taint(node.slice)
+        if isinstance(node, (ast.BinOp,)):
+            return self.taint(node.left) | self.taint(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.taint(node.operand)
+        if isinstance(node, ast.BoolOp):
+            return any([self.taint(v) for v in node.values])
+        if isinstance(node, ast.Compare):
+            operands = [node.left] + list(node.comparators)
+            t = any([self.taint(v) for v in operands])
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops) \
+                    and all(isinstance(c, ast.Constant)
+                            and c.value is None
+                            for c in node.comparators):
+                return False            # tracers are never None
+            if all(isinstance(op, (ast.In, ast.NotIn)) for op in node.ops):
+                return False            # dict/set membership reads keys
+            return t
+        if isinstance(node, ast.IfExp):
+            if self.taint(node.test):
+                self._flag_branch(node.test, "conditional expression")
+            return self.taint(node.body) | self.taint(node.orelse)
+        if isinstance(node, ast.Call):
+            return self._call(node)
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return any([self.taint(e) for e in node.elts])
+        if isinstance(node, ast.Dict):
+            return any([self.taint(k) for k in node.keys if k]) \
+                | any([self.taint(v) for v in node.values])
+        if isinstance(node, ast.Starred):
+            return self.taint(node.value)
+        if isinstance(node, ast.NamedExpr):
+            t = self.taint(node.value)
+            self._assign_name(node.target, t)
+            return t
+        if isinstance(node, ast.Lambda):
+            sub = _Taint(self.pf, FnInfo(node, self.info.qualname,
+                                         self.info.cls), False, self.out)
+            sub.env = set(self.env)
+            for arg in node.args.args + node.args.posonlyargs:
+                sub.env.add(arg.arg)    # lambda params ride tracers
+            sub.taint(node.body)
+            return True                 # closure result: assume traced
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp,
+                             ast.DictComp)):
+            t = False
+            for gen in node.generators:
+                self._check_unordered_iter(gen.iter)
+                t |= self.taint(gen.iter)
+                for cond in gen.ifs:
+                    self.taint(cond)
+            if isinstance(node, ast.DictComp):
+                t |= self.taint(node.key) | self.taint(node.value)
+            else:
+                t |= self.taint(node.elt)
+            return t
+        if isinstance(node, ast.JoinedStr):
+            for v in node.values:
+                if isinstance(v, ast.FormattedValue):
+                    self.taint(v.value)
+            return False
+        if isinstance(node, ast.Constant):
+            return False
+        # anything else: scan children, assume untainted
+        for child in ast.iter_child_nodes(node):
+            self.taint(child) if isinstance(child, ast.expr) else None
+        return False
+
+    def _call(self, node: ast.Call) -> bool:
+        args_t = any([self.taint(a) for a in node.args]
+                     + [self.taint(k.value) for k in node.keywords])
+        func = node.func
+        chain = dotted_name(func)
+        resolved = self.pf.resolve_chain(chain) if chain else None
+        # host-effect modules / builtins: VT103
+        if resolved is not None:
+            head = resolved.split(".")[0]
+            if head in HOST_EFFECT_MODULES and "." in resolved:
+                self._emit(
+                    "VT103", node,
+                    f"host-effect call `{self._src(func)}(...)` inside "
+                    "traced scope runs once at trace time, not per step",
+                    "move it out of the traced function (or pass its "
+                    "result in as data)")
+                return False
+        if isinstance(func, ast.Name):
+            if func.id in HOST_EFFECT_BUILTINS:
+                self._emit(
+                    "VT103", node,
+                    f"host-effect call `{func.id}(...)` inside traced "
+                    "scope runs once at trace time, not per step",
+                    "move it out of the traced function")
+                return False
+            if func.id in _COERCIONS and args_t:
+                self._emit(
+                    "VT102", node,
+                    f"`{func.id}()` forces a traced value to the host "
+                    "(sync + constant-bake under jit)",
+                    "keep the value traced (jnp ops / lax.cond / "
+                    "jnp.where) or hoist the coercion out of traced "
+                    "scope")
+                return False
+            if func.id in _STATIC_BUILTINS:
+                return False
+        if resolved in _NP_COERCIONS and args_t:
+            self._emit(
+                "VT102", node,
+                f"`{self._src(func)}()` materializes a traced value on "
+                "the host",
+                "use jnp.asarray (stays traced) or hoist out of traced "
+                "scope")
+            return False
+        if isinstance(func, ast.Attribute):
+            recv_t = self.taint(func.value)
+            if func.attr == "item" and recv_t:
+                self._emit(
+                    "VT102", node,
+                    "`.item()` on a traced value is a host sync",
+                    "keep the scalar traced, or compute it outside the "
+                    "traced function")
+                return False
+            if resolved is not None \
+                    and resolved.split(".")[0] in ("jax", "jnp"):
+                return True             # tracer producer
+            return recv_t or args_t     # method call on / with tracers
+        if resolved is not None and resolved.split(".")[0] in ("jax",
+                                                               "jnp"):
+            return True
+        # unknown callable: taint flows through its arguments
+        return args_t
+
+    # -- statements ---------------------------------------------------------
+    def _flag_branch(self, test: ast.AST, what: str):
+        self._emit(
+            "VT101", test,
+            f"{what} on traced value `{self._src(test)}` — host control "
+            "flow inside a traced program (recompile/concretization "
+            "hazard)",
+            "express it as traced data flow (jnp.where / lax.cond / "
+            "lax.select) or branch on static config before tracing")
+
+    def _check_unordered_iter(self, it: ast.AST):
+        unordered = isinstance(it, ast.Set)
+        if isinstance(it, ast.Call) and isinstance(it.func, ast.Name) \
+                and it.func.id in ("set", "frozenset"):
+            unordered = True
+        if unordered:
+            self._emit(
+                "VT104", it,
+                "iteration over an unordered set feeds trace order",
+                "wrap it in sorted(...) so the emitted program is "
+                "deterministic across processes")
+
+    def _assign_name(self, target: ast.AST, tainted: bool):
+        if isinstance(target, ast.Name):
+            (self.env.add if tainted else self.env.discard)(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._assign_name(elt, tainted)
+        elif isinstance(target, ast.Starred):
+            self._assign_name(target.value, tainted)
+        # attribute/subscript targets: no tracked taint
+
+    def run(self):
+        self._stmts(self.info.node.body)
+
+    def _stmts(self, body):
+        for stmt in body:
+            self._stmt(stmt)
+
+    def _stmt(self, stmt: ast.stmt):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return                      # nested defs analyzed separately
+        if isinstance(stmt, ast.Assign):
+            t = self.taint(stmt.value)
+            for target in stmt.targets:
+                self._assign_name(target, t)
+        elif isinstance(stmt, ast.AugAssign):
+            t = self.taint(stmt.value)
+            if isinstance(stmt.target, ast.Name):
+                if t or stmt.target.id in self.env:
+                    self.env.add(stmt.target.id)
+        elif isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self._assign_name(stmt.target, self.taint(stmt.value))
+        elif isinstance(stmt, ast.If):
+            if self.taint(stmt.test):
+                self._flag_branch(stmt.test, "`if`")
+            self._stmts(stmt.body)
+            self._stmts(stmt.orelse)
+        elif isinstance(stmt, ast.While):
+            if self.taint(stmt.test):
+                self._flag_branch(stmt.test, "`while`")
+            self._stmts(stmt.body)
+            self._stmts(stmt.orelse)
+        elif isinstance(stmt, ast.Assert):
+            if self.taint(stmt.test):
+                self._flag_branch(stmt.test, "`assert`")
+        elif isinstance(stmt, ast.For):
+            self._check_unordered_iter(stmt.iter)
+            self.taint(stmt.iter)
+            # loop vars stay untainted: dict iteration yields static
+            # keys, and traced-array iteration unrolls statically
+            self._assign_name(stmt.target, False)
+            self._stmts(stmt.body)
+            self._stmts(stmt.orelse)
+        elif isinstance(stmt, ast.With):
+            for item in stmt.items:
+                self.taint(item.context_expr)
+            self._stmts(stmt.body)
+        elif isinstance(stmt, ast.Try):
+            self._stmts(stmt.body)
+            for h in stmt.handlers:
+                self._stmts(h.body)
+            self._stmts(stmt.orelse)
+            self._stmts(stmt.finalbody)
+        elif isinstance(stmt, (ast.Return, ast.Expr)):
+            self.taint(stmt.value)
+        elif isinstance(stmt, ast.Raise):
+            self.taint(stmt.exc)
+            self.taint(stmt.cause)
+        elif isinstance(stmt, ast.Delete):
+            pass
+        else:
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.expr):
+                    self.taint(child)
+                elif isinstance(child, ast.stmt):
+                    self._stmt(child)
+
+
+def check(pf: ParsedFile,
+          trace_roots: Optional[Dict[str, Dict[str, str]]] = None
+          ) -> List[Finding]:
+    roots = _roots_for(pf, trace_roots)
+    if not roots:
+        return []
+    out: List[Finding] = []
+    for q, params_tainted in sorted(_traced_closure(pf, roots).items()):
+        _Taint(pf, pf.functions[q], params_tainted, out).run()
+    return out
