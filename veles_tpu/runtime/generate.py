@@ -186,9 +186,10 @@ def _attn_decode_step(u, params, cache, x_t, pos, pages=None):
         # same (B, L, Hk, Dh) the dense path reads
         kf = ck[ptab].reshape(B, L, Hk, Dh).astype(jnp.float32)
         vf = cv[ptab].reshape(B, L, Hk, Dh).astype(jnp.float32)
-        return _attn_scores(u, params, xq, qg, kf, vf, pos, per_row,
-                            B, H, Hk, G, Dh, L, dt, x_t.dtype,
-                            {"k": ck, "v": cv})
+        return _attn_scores(u, params, xq, qg, kf, vf, pos,
+                            per_row=per_row, B=B, H=H, Hk=Hk, G=G,
+                            Dh=Dh, L=L, dt=dt, out_dtype=x_t.dtype,
+                            new_cache={"k": ck, "v": cv})
     if per_row:
         rows = jnp.arange(B)
         ck = cache["k"].at[rows, pos].set(k[:, 0].astype(cache["k"].dtype))
@@ -205,16 +206,20 @@ def _attn_decode_step(u, params, cache, x_t, pos, pages=None):
     qg = q[:, 0].reshape(B, Hk, G, Dh).astype(jnp.float32)
     kf = ck.astype(jnp.float32)                   # (B, L, Hk, Dh)
     vf = cv.astype(jnp.float32)
-    return _attn_scores(u, params, xq, qg, kf, vf, pos, per_row,
-                        B, H, Hk, G, Dh, L, dt, x_t.dtype,
-                        {"k": ck, "v": cv})
+    return _attn_scores(u, params, xq, qg, kf, vf, pos,
+                        per_row=per_row, B=B, H=H, Hk=Hk, G=G, Dh=Dh,
+                        L=L, dt=dt, out_dtype=x_t.dtype,
+                        new_cache={"k": ck, "v": cv})
 
 
-def _attn_scores(u, params, xq, qg, kf, vf, pos, per_row, B, H, Hk, G,
-                 Dh, L, dt, out_dtype, new_cache):
+def _attn_scores(u, params, xq, qg, kf, vf, pos, *, per_row, B, H, Hk,
+                 G, Dh, L, dt, out_dtype, new_cache):
     """Masked score/softmax/output tail shared by the dense and paged
     cache layouts — ONE copy of the attention math, so the two layouts
-    cannot drift numerically."""
+    cannot drift numerically.  Positional params are traced values;
+    everything static (the ``per_row`` layout switch, head geometry,
+    dtypes) is keyword-only — the trace-safety convention
+    veles_tpu.analysis checks against (docs/analysis.md)."""
     s = jnp.einsum("bkgd,btkd->bkgt", qg, kf) * (Dh ** -0.5)
     t_idx = jnp.arange(L)
     if per_row:

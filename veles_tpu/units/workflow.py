@@ -185,6 +185,8 @@ class Workflow(Logger):
             else:
                 y, ns = u.apply(up, us, xs, ctx)
             outputs[u.name] = y
+            # lint: disable=VT101 dict emptiness is static structure at
+            # trace time (sparse nstate, not a value-dependent branch)
             if ns:
                 nstate[u.name] = ns
         return outputs, nstate
