@@ -534,7 +534,7 @@ class DecodeEngine(Logger):
         self.deadline_s = float(deadline_s if deadline_s is not None
                                 else serve.get("deadline_s", 120.0))
 
-    def _init_runtime(self, params):
+    def _init_runtime(self, params):  # not-shared: __init__-only construction, precedes any thread
         """Slot state + scheduler + gauges + the AOT decode program —
         everything downstream of the three program hooks
         (:meth:`_make_caches` / :meth:`_head_width` /
@@ -563,23 +563,26 @@ class DecodeEngine(Logger):
         if self.paged:
             self._scratch = self.pages          # pool row absorbing
             #                                     masked-off writes
+            # _ptab is scheduler-thread-owned (written in _prefill,
+            # read by _step_once); only the refcount/index structures
+            # and pool gauges below cross threads via submit()/stats()
             self._ptab = np.full((S, self.n_ptab), self._scratch,
                                  np.int32)
             self._page_lock = threading.Lock()
-            self._page_ref = np.zeros(self.pages, np.int32)
-            self._page_free = list(range(self.pages))
-            self._prefix_index: dict = {}       # chained hash -> page id
-            self._page_key: dict = {}           # page id -> its hash
-            self._page_tick = np.zeros(self.pages, np.int64)
-            self._tick = 0
-            self._prefix_hit_pages = 0
-            self._prefix_miss_pages = 0
-            self._evictions = 0
-            self._cow_admissions = 0
-            self._pool_rejected = 0
+            self._page_ref = np.zeros(self.pages, np.int32)  # guarded-by: self._page_lock
+            self._page_free = list(range(self.pages))  # guarded-by: self._page_lock
+            self._prefix_index: dict = {}  # guarded-by: self._page_lock
+            self._page_key: dict = {}      # guarded-by: self._page_lock
+            self._page_tick = np.zeros(self.pages, np.int64)  # guarded-by: self._page_lock
+            self._tick = 0                 # guarded-by: self._page_lock
+            self._prefix_hit_pages = 0     # guarded-by: self._page_lock
+            self._prefix_miss_pages = 0    # guarded-by: self._page_lock
+            self._evictions = 0            # guarded-by: self._page_lock
+            self._cow_admissions = 0       # guarded-by: self._page_lock
+            self._pool_rejected = 0        # guarded-by: self._page_lock
 
         # queue + scheduler
-        self._queue: collections.deque = collections.deque()
+        self._queue: collections.deque = collections.deque()  # guarded-by: self._qlock
         self._qlock = threading.Lock()
         self._wake = threading.Event()
         self._stop_evt = threading.Event()
@@ -587,7 +590,7 @@ class DecodeEngine(Logger):
 
         # hot-swap double buffer + drain mode (runtime/deploy.py)
         self._swap_lock = threading.Lock()
-        self._staged = None             # (placed params, applied event)
+        self._staged = None  # (placed params, applied event)  # guarded-by: self._swap_lock
         self._swaps = 0
         self._draining = False
         self._died = False              # scheduler crashed (work FAILED)
@@ -851,7 +854,9 @@ class DecodeEngine(Logger):
         part of the check because a request being prefilled is already
         out of the queue but not yet in _active — drain must not
         declare victory inside that window."""
-        return (not self._active.any() and not self._queue
+        with self._qlock:
+            queued = bool(self._queue)
+        return (not self._active.any() and not queued
                 and all(r is None for r in self._slot_req))
 
     def submit(self, prompt, n_steps: int, *, temperature: float = 0.0,
@@ -927,19 +932,25 @@ class DecodeEngine(Logger):
                               and free_slots > len(self._queue))
                 if pool_bound:
                     self._rejected += 1
-                    self._pool_rejected += 1
             if pool_bound:
+                with self._page_lock:
+                    self._pool_rejected += 1
                 raise EngineOverloaded(
                     f"page pool exhausted ({avail} of {self.pages} "
                     f"pages free, request needs {need} beyond its "
                     "cached prefix)", self._retry_after())
         with self._qlock:
-            if len(self._queue) >= self.queue_depth:
+            # overflow decided under the lock; the 429 (which computes
+            # Retry-After by re-taking the lock) raises outside it
+            overloaded = len(self._queue) >= self.queue_depth
+            if overloaded:
                 self._rejected += 1
-                raise EngineOverloaded(
-                    f"queue full ({self.queue_depth} pending)",
-                    self._retry_after())
-            self._queue.append(req)
+            else:
+                self._queue.append(req)
+        if overloaded:
+            raise EngineOverloaded(
+                f"queue full ({self.queue_depth} pending)",
+                self._retry_after())
         self._wake.set()
         return req
 
@@ -996,34 +1007,43 @@ class DecodeEngine(Logger):
         steps = max(self._decode_steps, 1)
         pages = None
         if self.paged:
+            # one consistent snapshot of the pool: refcounts, the
+            # prefix index AND the gauges under the same lock hold
+            # (used/cached and hit counters torn across a concurrent
+            # admission used to disagree — veles-tpu-lint VC201)
             with self._page_lock:
                 used = int(np.count_nonzero(self._page_ref))
                 cached = sum(1 for pid in self._page_key
                              if self._page_ref[pid] == 0)
-            lookups = self._prefix_hit_pages + self._prefix_miss_pages
+                hit = self._prefix_hit_pages
+                miss = self._prefix_miss_pages
+                evictions = self._evictions
+                cow = self._cow_admissions
+                pool_rejected = self._pool_rejected
+            lookups = hit + miss
             pages = {
                 "page_size": self.page_size, "pages": self.pages,
                 "used": used, "cached": cached,
                 "free": self.pages - used - cached,
                 "tokens_resident": (used + cached) * self.page_size,
-                "prefix_hit_pages": self._prefix_hit_pages,
-                "prefix_miss_pages": self._prefix_miss_pages,
-                "prefix_hit_rate": round(
-                    self._prefix_hit_pages / lookups, 3) if lookups
+                "prefix_hit_pages": hit,
+                "prefix_miss_pages": miss,
+                "prefix_hit_rate": round(hit / lookups, 3) if lookups
                 else 0.0,
-                "prefix_tokens_reused":
-                    self._prefix_hit_pages * self.page_size,
-                "evictions": self._evictions,
-                "cow_admissions": self._cow_admissions,
-                "pool_rejected": self._pool_rejected,
+                "prefix_tokens_reused": hit * self.page_size,
+                "evictions": evictions,
+                "cow_admissions": cow,
+                "pool_rejected": pool_rejected,
             }
+        with self._qlock:
+            queue_depth = len(self._queue)
         return {
             "slots": self.slots, "l_max": self.l_max,
             "paged": self.paged,
             **({"pages": pages} if pages is not None else {}),
             "occupancy": int(self._active.sum()),
             "avg_occupancy": round(self._occupancy_sum / steps, 3),
-            "queue_depth": len(self._queue),
+            "queue_depth": queue_depth,
             "queue_limit": self.queue_depth,
             "tokens_per_sec": round(self._tokens_per_sec, 1),
             "tokens_generated": self._tok_count,
@@ -1038,8 +1058,12 @@ class DecodeEngine(Logger):
     # -- scheduler ----------------------------------------------------------
     def _retry_after(self) -> float:
         """429 Retry-After estimate: queued decode work over recent
-        throughput (floor 1s)."""
-        queued = sum(r.n_steps for r in self._queue) or 1
+        throughput (floor 1s).  Takes the queue lock itself — callers
+        raise their 429 AFTER releasing it (iterating the deque while
+        submit threads append was a mutation-during-iteration crash
+        waiting for load; veles-tpu-lint VC201)."""
+        with self._qlock:
+            queued = sum(r.n_steps for r in self._queue) or 1
         rate = max(self._tokens_per_sec, 1.0)
         return min(60.0, max(1.0, queued / rate))
 
@@ -1048,6 +1072,8 @@ class DecodeEngine(Logger):
         try:
             while not self._stop_evt.is_set():
                 self._maybe_report()
+                # lint: disable=VC201 bool(deque) is atomic under the
+                # GIL; a stale wakeup read only costs one 50ms tick
                 if faults.enabled() and (self._queue
                                          or self._active.any()):
                     # injected crash point (tests/test_faults.py): fire
@@ -1060,6 +1086,8 @@ class DecodeEngine(Logger):
                 # decode-step boundary: no program is running right now,
                 # so a staged weight swap flips here atomically
                 self._apply_swap()
+                # lint: disable=VC201 bool(deque) is atomic under the
+                # GIL; a stale wakeup read only costs one 50ms tick
                 if not self._active.any() and not self._queue:
                     self._wake.wait(timeout=0.05)
                     self._wake.clear()
@@ -1113,12 +1141,11 @@ class DecodeEngine(Logger):
         """Fail queued requests whose deadline passed while they waited
         behind a full slot set (they'd otherwise only be checked when a
         slot freed)."""
-        if not self._queue:
-            return
         now = time.monotonic()
         expired = []
         with self._qlock:
-            if any(now > r.deadline for r in self._queue):
+            if self._queue and any(now > r.deadline
+                                   for r in self._queue):
                 keep = collections.deque()
                 for r in self._queue:
                     (expired if now > r.deadline else keep).append(r)
@@ -1158,7 +1185,7 @@ class DecodeEngine(Logger):
 
     # -- page pool (scheduler thread owns mutation; _page_lock guards the
     # cross-thread reads in submit() and stats()) ---------------------------
-    def _touch(self, pid: int):
+    def _touch(self, pid: int):  # requires-lock: self._page_lock
         self._tick += 1
         self._page_tick[pid] = self._tick
 
@@ -1185,7 +1212,7 @@ class DecodeEngine(Logger):
             hashes.append(h)
         return hashes
 
-    def _prefix_hits_locked(self, hashes, P: int) -> int:
+    def _prefix_hits_locked(self, hashes, P: int) -> int:  # requires-lock: self._page_lock
         """Leading pages already in the prefix index (caller holds
         ``_page_lock``), capped so at least the LAST prompt token is
         recomputed: the first sampled token needs its logits, and a
@@ -1247,7 +1274,7 @@ class DecodeEngine(Logger):
         req.page_hashes = hashes
         return True
 
-    def _alloc_page_locked(self):
+    def _alloc_page_locked(self):  # requires-lock: self._page_lock
         """One free page, evicting the least-recently-used CACHED page
         (refcount 0 but still registered in the prefix index) when the
         free list is empty; None when the pool is truly exhausted."""
