@@ -144,9 +144,9 @@ class ModelRegistry(Logger):
     source."""
 
     def __init__(self):
-        self._entries: List[dict] = []
+        self._entries: List[dict] = []  # guarded-by: self._lock
         self._lock = threading.Lock()
-        self.active_version: Optional[int] = None
+        self.active_version: Optional[int] = None  # guarded-by: self._lock
 
     def add(self, *, label: str, source: str, kind: str,
             checksum: str) -> dict:
@@ -163,21 +163,28 @@ class ModelRegistry(Logger):
             version = int(version)
         except (TypeError, ValueError):
             raise KeyError(f"version must be an integer, got {version!r}")
-        for e in self._entries:
-            if e["version"] == version:
-                return e
+        # iterating while add() appends from another thread (watcher vs
+        # manual reload) is the unsynchronized read veles-tpu-lint VC201
+        # exists for — snapshot under the lock, raise outside it
+        with self._lock:
+            for e in self._entries:
+                if e["version"] == version:
+                    return e
+            have = [e["version"] for e in self._entries]
         raise KeyError(
-            f"registry has no version {version} "
-            f"(has {[e['version'] for e in self._entries]})")
+            f"registry has no version {version} (has {have})")
 
     def activate(self, version: int) -> None:
-        self.active_version = int(version)
+        with self._lock:
+            self.active_version = int(version)
 
     @property
     def active(self) -> Optional[dict]:
-        if self.active_version is None:
+        with self._lock:
+            version = self.active_version
+        if version is None:
             return None
-        return self.get(self.active_version)
+        return self.get(version)
 
     def to_doc(self) -> dict:
         """JSON document for ``GET /models``."""
@@ -227,7 +234,8 @@ class DeployController(Logger):
             else serve.get("watch_backoff_max_s", 300.0))
 
         self.registry = ModelRegistry()
-        self._ck_cache = None  # (path, mtime) -> digest memo
+        self._ck_lock = threading.Lock()
+        self._ck_cache = None  # (path, mtime) -> digest memo  # guarded-by: self._ck_lock
         # a boot source that IS a snapshot (file manifest, sqlite://,
         # http://) or a compiled artifact registers as a reloadable
         # version — so POST /admin/reload {"version": 1} can roll back
@@ -269,7 +277,9 @@ class DeployController(Logger):
             kind=boot_kind, checksum=boot_checksum)
         self.registry.activate(boot["version"])
 
-        self._reload_lock = threading.Lock()
+        # re-entrant: _watch_once holds it across its check-then-act
+        # (floor/dedup check -> reload()), and reload() takes it again
+        self._reload_lock = threading.RLock()
         self._draining = False
         self._stopped = threading.Event()
         self._drain_thread: Optional[threading.Thread] = None
@@ -321,10 +331,17 @@ class DeployController(Logger):
             key = (path, os.path.getmtime(path))
         except OSError:
             return snapshot_checksum(path)
-        if self._ck_cache is not None and self._ck_cache[0] == key:
-            return self._ck_cache[1]
+        # the memo is read/replaced by the watcher thread AND manual
+        # reloads: two un-locked reads of the tuple could interleave
+        # with a replacement and pair one path with the OTHER path's
+        # digest (a wrong checksum in the registry poisons the
+        # watcher's dedup) — veles-tpu-lint VC201
+        with self._ck_lock:
+            if self._ck_cache is not None and self._ck_cache[0] == key:
+                return self._ck_cache[1]
         digest = snapshot_checksum(path)
-        self._ck_cache = (key, digest)
+        with self._ck_lock:
+            self._ck_cache = (key, digest)
         return digest
 
     def load_source(self, source: str) -> Tuple[dict, dict]:
@@ -784,15 +801,21 @@ class DeployController(Logger):
         if newest["saved_at"] <= self._watch_floor:
             return  # nothing newer than what the watcher last swapped
         checksum = self._snapshot_checksum(newest["path"])
-        active = self.registry.active
-        if active is not None and checksum \
-                and checksum == active.get("checksum"):
-            # already serving these exact weights (e.g. a re-save)
+        # the dedup check and the swap must be one atomic step: without
+        # the lock a manual reload landing between "active checksum
+        # differs" and reload() made the watcher re-swap weights that
+        # were already serving (veles-tpu-lint VC201 audit, ISSUE 8).
+        # _reload_lock is re-entrant, so reload()'s own acquire nests.
+        with self._reload_lock:
+            active = self.registry.active
+            if active is not None and checksum \
+                    and checksum == active.get("checksum"):
+                # already serving these exact weights (e.g. a re-save)
+                self._watch_floor = newest["saved_at"]
+                return
+            self.info("watcher: newer snapshot %s", newest["path"])
+            self.reload(newest["path"])  # raises -> backoff + retry
             self._watch_floor = newest["saved_at"]
-            return
-        self.info("watcher: newer snapshot %s", newest["path"])
-        self.reload(newest["path"])  # raises -> backoff + retry
-        self._watch_floor = newest["saved_at"]
 
     # -- observability ------------------------------------------------------
     def models_doc(self) -> dict:
@@ -814,7 +837,7 @@ class DeployController(Logger):
         try:
             active = self.registry.active or {}
             self.status.update(deploy={
-                "active_version": self.registry.active_version,
+                "active_version": active.get("version"),
                 "active_label": active.get("label"),
                 "versions": len(self.registry.to_doc()["versions"]),
                 **self._gauges()})
