@@ -53,7 +53,9 @@ class FaultInjected(RuntimeError):
 
 
 #: one-shot firing memory: (kind, index) pairs that already fired.
-_fired: set = set()
+#: ``_lock`` also serializes arm/disarm (configure/reset) against the
+#: scheduler threads consulting the plan mid-run.
+_fired: set = set()  # guarded-by: _lock
 _lock = threading.Lock()
 
 
@@ -119,20 +121,30 @@ def fire_once(kind: str, index: Optional[int] = None) -> bool:
         return True
 
 
+def _disarm_locked() -> None:  # requires-lock: _lock
+    _fired.clear()
+    node = root.common.faults
+    for k in list(node.keys()):
+        delattr(node, k)
+
+
 def configure(**knobs) -> FaultPlan:
     """Arm injection points programmatically (test convenience): clears
     any previous plan AND the one-shot firing memory, then writes each
-    knob into ``root.common.faults``."""
-    reset()
-    for k, v in knobs.items():
-        setattr(root.common.faults, k, v)
+    knob into ``root.common.faults`` — all under the firing lock, so a
+    scheduler thread can never observe a half-armed plan with the OLD
+    one-shot memory (the fire-once check-then-act the concurrency
+    audit flagged: a crash knob could fire twice, or never, across a
+    re-configure)."""
+    with _lock:
+        _disarm_locked()
+        for k, v in knobs.items():
+            setattr(root.common.faults, k, v)
     return get_plan()
 
 
 def reset() -> None:
-    """Disarm everything and forget what already fired."""
+    """Disarm everything and forget what already fired (atomic with
+    respect to :func:`fire_once`)."""
     with _lock:
-        _fired.clear()
-    node = root.common.faults
-    for k in list(node.keys()):
-        delattr(node, k)
+        _disarm_locked()

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 import zipfile
 from typing import Any, Dict, List, Optional, Tuple
@@ -123,16 +124,26 @@ class Snapshotter(Logger):
     """Save/restore checkpoints with interval+time throttling and
     best/current symlinks."""
 
-    def __init__(self, prefix: str, directory: str = "snapshots", *,
+    def __init__(self, prefix: str, directory: Optional[str] = None, *,
                  compression: bool = True, interval: int = 1,
                  time_interval: float = 0.0):
+        if directory is None:
+            # root.common.snapshot_dir is the config-tree form of the
+            # constructor arg (docs/configuration.md); same default
+            from ..config import root
+            directory = str(root.common.get("snapshot_dir", "snapshots")
+                            or "snapshots")
         self.prefix = prefix
         self.directory = directory
         self.compression = compression
         self.interval = interval          # epochs between snapshots
         self.time_interval = time_interval  # min seconds between snapshots
-        self._last_time = 0.0
-        self._counter = 0
+        # the throttle is a read-modify-write pair: two concurrent
+        # tick() calls (trainer + a GC/maintenance caller) may not both
+        # pass the time gate, or one epoch double-snapshots
+        self._lock = threading.Lock()
+        self._last_time = 0.0             # guarded-by: self._lock
+        self._counter = 0                 # guarded-by: self._lock
         self.last_path: Optional[str] = None
 
     def tick(self, *, best: bool = False) -> bool:
@@ -140,15 +151,16 @@ class Snapshotter(Logger):
         (reference: veles/snapshotter.py:159-174). Deterministic given the
         call sequence — on multi-host every host ticks identically, so
         all hosts can agree to skip the (collective) payload gather."""
-        self._counter += 1
-        now = time.time()
-        if not best:
-            if self._counter % max(self.interval, 1) != 0:
-                return False
-            if now - self._last_time < self.time_interval:
-                return False
-        self._last_time = now
-        return True
+        with self._lock:
+            self._counter += 1
+            now = time.time()
+            if not best:
+                if self._counter % max(self.interval, 1) != 0:
+                    return False
+                if now - self._last_time < self.time_interval:
+                    return False
+            self._last_time = now
+            return True
 
     def maybe_save(self, tag: str, payload: Dict[str, Any], *,
                    best: bool = False) -> Optional[str]:

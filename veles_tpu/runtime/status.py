@@ -47,11 +47,11 @@ class StatusReporter(Logger):
         # browser graph view (/root/reference/web/viz.js)
         self.graph_svg = graph_svg
         self.started = time.time()
-        self._extra = {}
-        self._events = collections.deque(maxlen=max(1, int(events_max)))
         # one reporter, many writers (engine scheduler, deploy control
-        # plane, trainer): serialize the read-modify-write on _extra and
-        # the tmp-file replace
+        # plane, trainer): serialize the read-modify-write on _extra /
+        # _events and the tmp-file replace
+        self._extra = {}  # guarded-by: self._lock
+        self._events = collections.deque(maxlen=max(1, int(events_max)))  # guarded-by: self._lock
         self._lock = threading.Lock()
 
     def plot_files(self):
