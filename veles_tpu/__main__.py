@@ -748,6 +748,14 @@ def main(argv=None) -> int:
     create, manifest_snapshot = _load_config(args.config, args.overrides)
     if manifest_snapshot and not args.snapshot:
         args.snapshot = manifest_snapshot
+    if not args.platform:
+        # the config-file form of --platform ("" = let JAX pick): the
+        # backend has not initialized yet at this point — nothing above
+        # touches a device — so the pin still lands before first use
+        cfg_platform = str(root.common.get("platform", "") or "")
+        if cfg_platform:
+            import jax
+            jax.config.update("jax_platforms", cfg_platform)
     if args.compile_cache:
         # flag wins over config/overrides; Trainer.initialize() activates
         # it right before the first compile

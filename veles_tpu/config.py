@@ -237,9 +237,12 @@ root = Config()
 
 
 def _defaults():
-    root.common.precision_type = "float32"   # host/reference dtype
+    # NOTE: the reference's precision_type (host dtype) and a global
+    # compute_dtype used to be declared here but nothing read them —
+    # the on-device dtype is a per-unit/model knob (``compute_dtype=``
+    # on units and StandardWorkflow layer specs).  veles_tpu.analysis
+    # VK302 keeps this file honest about such drift.
     root.common.precision_level = 0          # 0 fast | 1 high | 2 highest (ref PRECISION_LEVEL)
-    root.common.compute_dtype = "bfloat16"   # MXU-friendly on-device dtype
     root.common.timings = False
     root.common.trace_file = ""              # JSONL event trace target
     root.common.cache_dir = ".veles_tpu"

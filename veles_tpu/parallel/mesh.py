@@ -69,7 +69,13 @@ def make_mesh(spec: Optional[MeshSpec] = None,
     ICI-neighbor device ranges, which is where tensor/sequence parallel
     traffic belongs (scaling-book recipe)."""
     devices = list(devices if devices is not None else jax.devices())
-    spec = spec or MeshSpec()
+    if spec is None:
+        # root.common.mesh (default ``{"data": -1}``) is the config-tree
+        # form of MeshSpec: axis name -> size, -1 absorbing the rest
+        # (docs/configuration.md)
+        from ..config import root
+        axes = {k: int(v) for k, v in root.common.mesh.items()}
+        spec = MeshSpec(**axes) if axes else MeshSpec()
     sizes = spec.axis_sizes(len(devices))
     names = ("data", "fsdp", "model", "seq", "pipe", "expert")
     arr = np.asarray(devices).reshape(*(sizes[n] for n in names))
