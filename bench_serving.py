@@ -52,9 +52,12 @@ Prints ONE JSON line in the bench.py contract:
 """
 
 import json
+import os
 import sys
+import tempfile
 import threading
 import time
+import urllib.request
 
 import numpy as np
 
@@ -95,14 +98,44 @@ def build(jnp, vt):
     return wf, ws
 
 
+def _latency_percentiles(text0, text1, name):
+    """p50/p95/p99 (ms) of one histogram between two /metrics scrapes —
+    the bench's scenarios share the process-global registry, so each
+    isolates its own distribution by cumulative-bucket delta
+    (runtime/metrics.py scrape helpers)."""
+    from veles_tpu.runtime.metrics import (cumulative_buckets,
+                                           delta_buckets, parse_samples,
+                                           quantile_from_cumulative)
+    delta = delta_buckets(
+        cumulative_buckets(parse_samples(text0), name),
+        cumulative_buckets(parse_samples(text1), name))
+    return {
+        f"p{int(q * 100)}_ms": round(
+            1e3 * quantile_from_cumulative(delta, q), 2)
+        for q in (0.5, 0.95, 0.99)}
+
+
 def main():
     import jax.numpy as jnp
 
     import veles_tpu as vt
     from veles_tpu.runtime.engine import DecodeEngine
     from veles_tpu.runtime.generate import generate
+    from veles_tpu.runtime.status import StatusReporter, StatusServer
 
     rng = np.random.default_rng(7)
+
+    # the tail-latency numbers are SCRAPED from GET /metrics (the
+    # acceptance path an operator's Prometheus walks), not read from
+    # engine internals
+    status_dir = tempfile.mkdtemp(prefix="bench_metrics_")
+    metrics_srv = StatusServer(StatusReporter(
+        os.path.join(status_dir, "status.json"))).start()
+    metrics_url = f"http://127.0.0.1:{metrics_srv.port}/metrics"
+
+    def scrape():
+        with urllib.request.urlopen(metrics_url, timeout=30) as r:
+            return r.read().decode()
     wf, ws = build(jnp, vt)
     work = [(rng.integers(0, V, p).astype(np.int32), n)
             for _ in range(REPEATS) for p, n in SHAPES]
@@ -336,8 +369,16 @@ def main():
             e = DecodeEngine(wf, ws, window_ms=1.0, queue_depth=64,
                              paged=paged, **geo).start()
             try:
+                m0 = scrape()
                 r = drive_burst(e)
                 r["prefix"] = drive_prefix(e)
+                m1 = scrape()
+                # tail latencies over burst + prefix drive, from the
+                # /metrics histograms (p50/p95/p99 by bucket delta)
+                r["ttft_from_metrics"] = _latency_percentiles(
+                    m0, m1, "vt_request_ttft_seconds")
+                r["queue_wait_from_metrics"] = _latency_percentiles(
+                    m0, m1, "vt_request_queue_wait_seconds")
                 st = e.stats()
                 r["compiles"] = st["compile"]["compiles"]
                 r["recompiles"] = st["compile"]["recompiles"]
@@ -360,9 +401,17 @@ def main():
         return out
 
     try:
+        m0 = scrape()
         cold, cold_wall = run_engine(4)
         engine_endpoint_tps = total_tokens / (time.perf_counter() - t0)
         sweep = [run_engine(c)[0] for c in CONCURRENCY]
+        m1 = scrape()
+        # the vs_baseline workload's tail latencies (cold run + sweep),
+        # scraped from GET /metrics like any external dashboard would
+        ttft_pct = _latency_percentiles(
+            m0, m1, "vt_request_ttft_seconds")
+        qwait_pct = _latency_percentiles(
+            m0, m1, "vt_request_queue_wait_seconds")
         # second weight set, same architecture: what a reload serves
         import jax
         from veles_tpu.ops import optimizers as opt
@@ -373,6 +422,9 @@ def main():
         final = eng.stats()
     finally:
         eng.stop()
+        metrics_srv.stop()
+        import shutil
+        shutil.rmtree(status_dir, ignore_errors=True)
 
     best = max(sweep, key=lambda r: r["tokens_per_sec"])
     conc4 = next(r for r in sweep if r["concurrency"] == 4)
@@ -392,6 +444,10 @@ def main():
             "engine_cold_run": cold,
             "batched_above_serial_at_conc4":
                 engine_endpoint_tps > serial_endpoint_tps,
+            # scraped from GET /metrics over the cold run + sweep: the
+            # trajectory finally carries tail latencies, not just tps
+            "ttft_from_metrics": ttft_pct,
+            "queue_wait_from_metrics": qwait_pct,
         },
         "warm": {
             "serial_tokens_per_sec": round(serial_warm_tps, 1),

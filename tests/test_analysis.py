@@ -380,6 +380,75 @@ def test_vk_alias_get_counts_as_read(tmp_path):
                    if f.rule == "VK302")
 
 
+# -- VM4xx: metric-name drift ----------------------------------------------
+
+def _metrics_fixture(tmp_path):
+    # the __init__.py makes this a package-directory scan — the shape
+    # VM402 requires (a subset scan cannot prove "registered nowhere")
+    _write(tmp_path, "__init__.py", "")
+    _write(tmp_path, "mod.py", """\
+        def setup(reg):
+            reg.counter("vt_good_total", "documented")
+            reg.histogram("vt_lat_seconds", "documented histogram")
+            reg.gauge("vt_undocumented_gauge", "nobody wrote me up")
+            reg.counter("plain_counter", "not in the vt_ namespace")
+        """)
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "observability.md").write_text(
+        "| `vt_good_total` | counter |\n"
+        "`vt_lat_seconds` (derived: `vt_lat_seconds_bucket`,\n"
+        "`vt_lat_seconds_sum`, `vt_lat_seconds_count`)\n"
+        "| `vt_ghost_total` | counter | documented, never registered |\n")
+    return docs
+
+
+def test_vm401_registered_but_undocumented(tmp_path):
+    docs = _metrics_fixture(tmp_path)
+    found = [f for f in _lint(tmp_path, docs_dir=str(docs))
+             if f.rule == "VM401"]
+    assert len(found) == 1
+    assert "vt_undocumented_gauge" in found[0].message
+    assert found[0].path.endswith("mod.py")
+    assert found[0].severity == "error"
+
+
+def test_vm402_documented_but_unregistered(tmp_path):
+    docs = _metrics_fixture(tmp_path)
+    found = [f for f in _lint(tmp_path, docs_dir=str(docs))
+             if f.rule == "VM402"]
+    # vt_ghost_total fires; the derived _bucket/_sum/_count series of
+    # the registered histogram are exempt
+    assert len(found) == 1
+    assert "vt_ghost_total" in found[0].message
+
+
+def test_vm402_skipped_on_subset_scans(tmp_path):
+    """Linting one file (no package __init__.py in the scan) must not
+    flag every metric registered in UNSCANNED modules as 'registered
+    nowhere' — VM401 still fires per-file, VM402 needs the package."""
+    docs = _metrics_fixture(tmp_path)
+    mod = str(tmp_path / "mod.py")
+    found = analyze_files(iter_python_files([mod]),
+                          docs_dir=str(docs))
+    rules = _rules(found)
+    assert "VM402" not in rules          # subset scan: no VM402
+    assert "VM401" in rules              # per-file check still on
+
+
+def test_vm4xx_noop_without_observability_md(tmp_path):
+    _write(tmp_path, "mod.py", """\
+        def setup(reg):
+            reg.counter("vt_orphan_total", "no docs tree at all")
+        """)
+    assert not [f for f in _lint(tmp_path) if f.rule.startswith("VM")]
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "other.md").write_text("no observability file here\n")
+    assert not [f for f in _lint(tmp_path, docs_dir=str(docs))
+                if f.rule.startswith("VM")]
+
+
 # -- baseline ---------------------------------------------------------------
 
 def test_baseline_accepts_then_goes_stale_on_edit(tmp_path):

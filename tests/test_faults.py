@@ -430,7 +430,17 @@ def test_scheduler_crash_fails_work_with_500_and_event(tmp_path):
         assert req.done.wait(20)
         assert isinstance(req.error, SchedulerCrashed)
         assert eng.stats()["scheduler_crashed"] is True
-        events = [e["kind"] for e in status.read().get("events", [])]
+        # the crash event may ride the status reporter's coalescing
+        # window (root.common.observe.status_flush_s): poll briefly
+        import time as _time
+        deadline = _time.monotonic() + 3.0
+        events = []
+        while _time.monotonic() < deadline:
+            events = [e["kind"]
+                      for e in status.read().get("events", [])]
+            if "scheduler_crash" in events:
+                break
+            _time.sleep(0.05)
         assert "scheduler_crash" in events
         with pytest.raises(SchedulerCrashed):
             eng.submit(np.array([1], np.int32), 2)

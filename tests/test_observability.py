@@ -1,8 +1,17 @@
-"""Plotting/status/RESTful serving (reference L10/L11 — SURVEY.md §2.7)."""
+"""Plotting/status/RESTful serving (reference L10/L11 — SURVEY.md §2.7)
+plus the metrics/tracing core (runtime/metrics.py,
+docs/observability.md "Metrics & tracing"): registry primitives vs a
+numpy reference, Prometheus text golden, label-cardinality cap,
+concurrent-writer consistency, the bounded span ring and its
+Chrome-trace export, and /metrics served from a live engine under
+concurrent load with compile counters flat."""
 
 import json
 import os
+import threading
+import time
 import urllib.error
+import urllib.parse
 import urllib.request
 
 import jax
@@ -14,6 +23,9 @@ import veles_tpu as vt
 from veles_tpu.plotting import (MetricsRecorder, confusion_matrix,
                                 histogram, render_confusion, sparkline,
                                 weights_image)
+from veles_tpu.runtime.metrics import (MetricsRegistry, SpanRing,
+                                       cumulative_buckets, parse_samples,
+                                       quantile_from_cumulative, registry)
 from veles_tpu.runtime.restful import RestfulServer
 from veles_tpu.runtime.status import StatusReporter, StatusServer
 from veles_tpu.units import (All2AllSoftmax, All2AllTanh, EvaluatorSoftmax,
@@ -324,3 +336,373 @@ def test_status_page_embeds_workflow_graph(tmp_path):
             urllib.request.urlopen(url2 + "/graph.svg")
     finally:
         srv2.stop()
+
+
+# -- metrics core (runtime/metrics.py) --------------------------------------
+
+def test_histogram_buckets_and_quantiles_vs_numpy(rng):
+    """Bucket counts must equal a numpy cumulative reference exactly,
+    and the interpolated quantile must land within one bucket width of
+    np.percentile."""
+    reg = MetricsRegistry(label_cap=8)
+    edges = tuple(np.linspace(0.05, 1.0, 20))
+    h = reg.histogram("vt_t_lat_seconds", "t", buckets=edges)
+    values = rng.uniform(0.0, 1.0, 2000)
+    for v in values:
+        h.observe(float(v))
+    cum = h._default().cumulative()
+    for le, c in cum[:-1]:
+        assert c == int(np.sum(values <= le)), le
+    assert cum[-1] == (float("inf"), len(values))
+    for q in (0.1, 0.5, 0.9, 0.95, 0.99):
+        est = h.quantile(q)
+        ref = float(np.percentile(values, 100 * q))
+        assert abs(est - ref) <= 0.06, (q, est, ref)  # one bucket width
+    assert h.count == len(values)
+    assert abs(h.sum - float(values.sum())) < 1e-6
+    # a quantile landing in the +Inf bucket clamps to the last finite
+    # bound (Prometheus histogram_quantile semantics)
+    h.observe(50.0)
+    assert h.quantile(1.0) == 1.0
+
+
+def test_label_cardinality_cap_routes_to_other():
+    """Past the cap, unseen label values collapse into one _other
+    series (bounded memory) and are counted in the dropped-labels
+    counter — never an unbounded children table."""
+    reg = MetricsRegistry(label_cap=4)
+    c = reg.counter("vt_t_req_total", "t", labels=("user",))
+    for i in range(20):
+        c.labels(user=f"u{i}").inc()
+    assert c.series_count() <= 5          # 4 real + _other
+    text = reg.render()
+    assert 'user="_other"' in text
+    assert reg.dropped_labels.value >= 16
+    # capped values keep COUNTING (into _other), they are not lost
+    total = sum(v for n, _l, v in parse_samples(text)
+                if n == "vt_t_req_total")
+    assert total == 20
+
+
+def test_prometheus_text_golden():
+    """The exposition format is a contract: TYPE/HELP lines, label
+    escaping (backslash, quote, newline), cumulative histogram buckets
+    with +Inf, _sum/_count — golden-matched byte for byte."""
+    reg = MetricsRegistry(label_cap=8)
+    c = reg.counter("vt_t_outcomes_total", 'requests by outcome\nline2',
+                    labels=("outcome",))
+    c.labels(outcome="ok").inc(3)
+    c.labels(outcome='we"ird\\x\n').inc()
+    g = reg.gauge("vt_t_depth", "queue depth")
+    g.set(2.5)
+    h = reg.histogram("vt_t_lat_seconds", "latency",
+                      buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(2.0)
+    golden = (
+        "# HELP vt_metrics_dropped_labels_total label assignments "
+        "collapsed into the _other series by the per-metric "
+        "cardinality cap (root.common.observe.label_cap)\n"
+        "# TYPE vt_metrics_dropped_labels_total counter\n"
+        "vt_metrics_dropped_labels_total 0\n"
+        "# HELP vt_t_outcomes_total requests by outcome\\nline2\n"
+        "# TYPE vt_t_outcomes_total counter\n"
+        'vt_t_outcomes_total{outcome="ok"} 3\n'
+        'vt_t_outcomes_total{outcome="we\\"ird\\\\x\\n"} 1\n'
+        "# HELP vt_t_depth queue depth\n"
+        "# TYPE vt_t_depth gauge\n"
+        "vt_t_depth 2.5\n"
+        "# HELP vt_t_lat_seconds latency\n"
+        "# TYPE vt_t_lat_seconds histogram\n"
+        'vt_t_lat_seconds_bucket{le="0.1"} 1\n'
+        'vt_t_lat_seconds_bucket{le="1"} 2\n'
+        'vt_t_lat_seconds_bucket{le="+Inf"} 3\n'
+        "vt_t_lat_seconds_sum 2.55\n"
+        "vt_t_lat_seconds_count 3\n")
+    assert reg.render() == golden
+    # and the scrape parser round-trips the escaped label value
+    parsed = parse_samples(golden)
+    assert ("vt_t_outcomes_total", {"outcome": 'we"ird\\x\n'}, 1.0) \
+        in parsed
+    # the adversarial case: literal backslash FOLLOWED BY 'n' must not
+    # un-escape into a newline (single-pass unescape, not sequential
+    # replaces)
+    c.labels(outcome="a\\nb").inc()          # backslash + 'n', no newline
+    rt = [l["outcome"] for n, l, _v in parse_samples(reg.render())
+          if n == "vt_t_outcomes_total"]
+    assert "a\\nb" in rt and "a\nb" not in rt
+
+
+def test_metrics_concurrent_writers():
+    """N threads hammering one counter + one histogram lose nothing:
+    the total is exact (the lock, not the GIL, is the guarantee)."""
+    reg = MetricsRegistry(label_cap=8)
+    c = reg.counter("vt_t_hits_total", "t", labels=("src",))
+    h = reg.histogram("vt_t_obs_seconds", "t", buckets=(0.5,))
+    N, PER = 8, 2000
+
+    def worker(i):
+        child = c.labels(src=f"s{i % 2}")
+        for k in range(PER):
+            child.inc()
+            h.observe(0.25 if k % 2 else 0.75)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(N)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    total = sum(v for n, _l, v in parse_samples(reg.render())
+                if n == "vt_t_hits_total")
+    assert total == N * PER
+    assert h.count == N * PER
+    cum = h._default().cumulative()
+    assert cum[0][1] == N * PER / 2       # the 0.25 half
+    assert cum[-1][1] == N * PER
+
+
+def test_registry_reregistration_is_idempotent_but_typed():
+    reg = MetricsRegistry(label_cap=8)
+    a = reg.counter("vt_t_x_total", "t")
+    assert reg.counter("vt_t_x_total", "t") is a
+    with pytest.raises(ValueError):
+        reg.gauge("vt_t_x_total", "t")
+    with pytest.raises(ValueError):
+        reg.counter("vt_t_x_total", "t", labels=("k",))
+
+
+def test_span_ring_bounded_and_sorted():
+    ring = SpanRing(capacity=8)
+    t0 = time.monotonic()
+    for i in range(30):
+        ring.add(f"s{i}", t0 + i * 0.001, 0.0005, tid=i)
+    assert len(ring) == 8
+    events = ring.snapshot()
+    assert [e["name"] for e in events] == [f"s{i}" for i in range(22, 30)]
+    assert events == sorted(events, key=lambda e: e["ts"])
+    doc = ring.chrome_trace()
+    assert doc["traceEvents"][0]["ph"] == "M"    # process-name metadata
+    json.loads(json.dumps(doc))                  # JSON-serializable
+
+
+# -- live engine: /metrics + /trace.json under concurrent load --------------
+
+V = 12
+T = 6
+
+
+def _obs_lm(seed=3):
+    from veles_tpu.models.standard import build_workflow
+    from veles_tpu.ops import optimizers as opt
+    wf = build_workflow("obs_lm", [
+        {"type": "embedding", "vocab": V, "dim": 16, "name": "emb"},
+        {"type": "attention", "n_heads": 2, "rope": True,
+         "residual": True, "name": "a1"},
+        {"type": "seq_last", "name": "last"},
+        {"type": "softmax", "output_size": V, "name": "out"},
+    ])
+    wf.build({"@input": vt.Spec((2, T), jnp.int32),
+              "@labels": vt.Spec((2,), jnp.int32),
+              "@mask": vt.Spec((2,), jnp.float32)})
+    ws = wf.init_state(jax.random.key(seed), opt.SGD(0.1))
+    return wf, ws
+
+
+def _hist_count(text, name):
+    return sum(v for n, labels, v in parse_samples(text)
+               if n == name + "_count")
+
+
+def test_metrics_live_engine_under_concurrent_load(rng):
+    """The acceptance criterion: GET /metrics on a live DecodeEngine
+    under concurrent mixed-shape load returns valid Prometheus text
+    with non-empty TTFT and queue-wait histograms, and the StepCache
+    compile counters are FLAT across the instrumented load (zero
+    recompiles attributable to instrumentation)."""
+    from veles_tpu.runtime.engine import DecodeEngine
+    wf, ws = _obs_lm()
+    eng = DecodeEngine(wf, ws, slots=2, l_max=64, window_ms=1.0)
+    srv = RestfulServer(wf.make_predict_step("out"), ws, 2, (T,),
+                        workflow=wf, engine=eng).start()
+    shapes = [(3, 4), (7, 3), (11, 5), (5, 2)]
+    try:
+        url = f"http://127.0.0.1:{srv.port}"
+        # warm every bucket the mixed shapes map to, then freeze the
+        # compile budget: the load below must not move it
+        for p, n in shapes:
+            body = json.dumps({
+                "prompt": rng.integers(0, V, (1, p)).tolist(),
+                "steps": n}).encode()
+            urllib.request.urlopen(urllib.request.Request(
+                url + "/generate", body,
+                {"Content-Type": "application/json"})).read()
+        m0 = urllib.request.urlopen(url + "/metrics").read().decode()
+        compiles0 = eng.stats()["compile"]["compiles"]
+        done0 = _hist_count(m0, "vt_request_ttft_seconds")
+
+        errs = []
+
+        def client(i):
+            p, n = shapes[i % len(shapes)]
+            body = json.dumps({
+                "prompt": rng.integers(0, V, (1, p)).tolist(),
+                "steps": n}).encode()
+            try:
+                urllib.request.urlopen(urllib.request.Request(
+                    url + "/generate", body,
+                    {"Content-Type": "application/json"}),
+                    timeout=120).read()
+            except Exception as e:  # noqa: BLE001
+                errs.append(repr(e))
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(12)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=180)
+        assert not errs, errs
+
+        m1 = urllib.request.urlopen(url + "/metrics").read().decode()
+        hdr = urllib.request.urlopen(url + "/metrics")
+        assert hdr.headers["Content-Type"].startswith("text/plain")
+        # every non-comment line parses under the sample grammar
+        data_lines = [l for l in m1.splitlines()
+                      if l and not l.startswith("#")]
+        assert len(parse_samples(m1)) == len(data_lines)
+        # non-empty latency distributions from THIS load
+        assert _hist_count(m1, "vt_request_ttft_seconds") - done0 >= 12
+        assert _hist_count(m1, "vt_request_queue_wait_seconds") >= 12
+        assert _hist_count(m1, "vt_decode_step_seconds") > 0
+        ttft = cumulative_buckets(parse_samples(m1),
+                                  "vt_request_ttft_seconds")
+        assert quantile_from_cumulative(ttft, 0.95) > 0
+        # compile counters flat: instrumentation compiled NOTHING
+        st = eng.stats()
+        assert st["compile"]["compiles"] == compiles0
+        assert st["compile"]["recompiles"] == 0
+        # one consistent view: stats() and /metrics agree on outcomes
+        ok = sum(v for n, labels, v in parse_samples(m1)
+                 if n == "vt_requests_total"
+                 and labels.get("outcome") == "ok")
+        assert ok >= st["retired"] >= 16       # global >= this engine
+    finally:
+        srv.stop()
+
+
+def test_trace_json_loads_and_nests(rng):
+    """GET /trace.json: valid Chrome-trace JSON whose per-request
+    phase spans (queue_wait → prefill → decode) nest inside their
+    request span on the same track."""
+    from veles_tpu.runtime.engine import DecodeEngine
+    wf, ws = _obs_lm()
+    eng = DecodeEngine(wf, ws, slots=2, l_max=32).start()
+    rep_dir = os.environ.get("TMPDIR", "/tmp")
+    try:
+        for _ in range(3):
+            p = rng.integers(0, V, (1, 5)).astype(np.int32)
+            eng.generate(p, 3, timeout=120)
+        rep = StatusReporter(os.path.join(rep_dir, "obs_status.json"))
+        ssrv = StatusServer(rep).start()
+        try:
+            doc = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{ssrv.port}/trace.json").read())
+        finally:
+            ssrv.stop()
+        events = doc["traceEvents"]
+        reqs = {e["tid"]: e for e in events
+                if e.get("name") == "request" and e.get("ph") == "X"}
+        assert reqs, "no request spans in the ring"
+        checked = 0
+        for e in events:
+            if e.get("name") not in ("queue_wait", "prefill", "decode"):
+                continue
+            parent = reqs.get(e["tid"])
+            if parent is None:
+                continue                # parent rotated out of the ring
+            assert e["ts"] >= parent["ts"] - 2.0, e
+            assert e["ts"] + e.get("dur", 0) \
+                <= parent["ts"] + parent["dur"] + 2.0, e
+            checked += 1
+        assert checked >= 6             # 3 requests x >= 2 phases
+        outcome = {e["args"]["outcome"] for e in reqs.values()
+                   if "args" in e}
+        assert "ok" in outcome
+    finally:
+        eng.stop()
+
+
+def test_trace_out_cli_helper(tmp_path):
+    from veles_tpu.runtime.metrics import span_ring, write_chrome_trace
+    span_ring().add("marker", time.monotonic(), 0.001, tid=999)
+    out = write_chrome_trace(str(tmp_path / "trace.json"))
+    doc = json.loads(open(out).read())
+    assert any(e.get("name") == "marker" for e in doc["traceEvents"])
+
+
+# -- satellite: HTML escaping on the status page ----------------------------
+
+def test_status_page_escapes_keys_values_and_plot_names(tmp_path):
+    """A metric key/value whose repr carries </& must render as text,
+    and a hostile plot filename must not break out of its src
+    attribute (and still round-trips through the URL)."""
+    plots = tmp_path / "plots"
+    plots.mkdir()
+    png = b"\x89PNG\r\n\x1a\n" + b"0" * 16
+    evil_name = 'we"ird<1>&.png'
+    (plots / evil_name).write_bytes(png)
+    rep = StatusReporter(str(tmp_path / "status.json"),
+                         name="<b>bad</b>", plots_dir=str(plots))
+    rep.update(**{"<script>k": 'v<img src="x">&'})
+    srv = StatusServer(rep).start()
+    try:
+        url = f"http://127.0.0.1:{srv.port}"
+        page = urllib.request.urlopen(url).read().decode()
+        assert "<script>k" not in page
+        assert "&lt;script&gt;k" in page
+        assert 'v<img src="x">' not in page
+        assert "&lt;b&gt;bad&lt;/b&gt;" in page
+        assert evil_name not in page           # raw name never emitted
+        quoted = urllib.parse.quote(evil_name)
+        assert quoted in page
+        body = urllib.request.urlopen(f"{url}/plots/{quoted}").read()
+        assert body == png                     # quoted URL still serves
+    finally:
+        srv.stop()
+
+
+# -- satellite: coalesced status.json event flushes -------------------------
+
+def test_record_event_bursts_coalesce_but_final_state_lands(tmp_path):
+    """An event burst must not be an fsync storm: writes are bounded by
+    the flush interval, a trailing timer lands the final state, and
+    update() still writes through immediately."""
+    reg = registry()
+    flushes = reg.get("vt_status_flushes_total")
+    rep = StatusReporter(str(tmp_path / "status.json"), name="burst",
+                         events_max=100, flush_interval_s=0.2)
+    rep.update(epoch=0)                  # immediate write, file exists
+    before = flushes.value
+    for i in range(50):
+        rep.record_event("retire_storm", i=i)
+    writes_during_burst = flushes.value - before
+    assert writes_during_burst <= 3, writes_during_burst
+    coalesced = reg.get("vt_status_flushes_coalesced_total")
+    assert coalesced.value > 0
+    # the trailing flush lands the burst's FINAL event within ~1 window
+    deadline = time.monotonic() + 2.0
+    last = None
+    while time.monotonic() < deadline:
+        events = rep.read().get("events", [])
+        if events and events[-1].get("i") == 49:
+            last = events[-1]
+            break
+        time.sleep(0.02)
+    assert last is not None, "final event never flushed"
+    # direct update() writes through (no coalescing for gauge cadence)
+    n0 = flushes.value
+    rep.update(epoch=1)
+    assert flushes.value == n0 + 1
+    assert rep.read()["epoch"] == 1

@@ -219,6 +219,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--plots", metavar="DIR", default=None,
                    help="write metric-curve PNGs/JSONL here each epoch "
                         "(default 'plots' when --status-port is set)")
+    p.add_argument("--trace-out", metavar="FILE", default=None,
+                   help="on exit, write the host-side span timeline "
+                        "(per-request queue-wait/prefill/decode spans, "
+                        "training epochs, status events) as Chrome-"
+                        "trace JSON — open in Perfetto; the same "
+                        "document GET /trace.json serves live")
     p.add_argument("--profile", metavar="DIR",
                    help="capture a device-level jax.profiler trace of the "
                         "training run into DIR (view with TensorBoard / "
@@ -489,7 +495,17 @@ def _run_serve_loop(args, srv, banner: dict, *, status=None,
     except KeyboardInterrupt:
         deploy.drain(timeout=0)  # interactive: skip the grace hold
     srv.stop()
+    _maybe_write_trace(args)
     return 0
+
+
+def _maybe_write_trace(args) -> None:
+    """``--trace-out FILE``: dump the span ring (request timelines /
+    train epochs / status events) as a Perfetto-loadable Chrome trace
+    at shutdown."""
+    if getattr(args, "trace_out", None):
+        from .runtime.metrics import write_chrome_trace
+        write_chrome_trace(args.trace_out)
 
 
 def _serve_artifact(args) -> int:
@@ -1062,6 +1078,7 @@ def main(argv=None) -> int:
     finally:
         if status_server is not None:
             status_server.stop()
+        _maybe_write_trace(args)
     print(json.dumps(results))
     if args.publish:
         # after the results are emitted — a report typo must never eat a

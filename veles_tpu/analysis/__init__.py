@@ -25,7 +25,10 @@ Three rule families (full catalogue in docs/analysis.md):
   ``try/finally`` release is rejected;
 * **config-key drift (VK3xx)** — every ``root.common.*`` key read in
   the package must be declared in ``veles_tpu/config.py`` and appear in
-  the docs; declared keys nobody reads are dead.
+  the docs; declared keys nobody reads are dead;
+* **metric-name drift (VM4xx)** — every ``vt_*`` metric registered in
+  code (runtime/metrics.py) must appear in docs/observability.md's
+  reference table, and every documented name must be registered.
 
 Pure ``ast``/``tokenize`` — importing or running this package never
 imports jax or any of the modules it analyzes (a lint pass must be

@@ -22,7 +22,8 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="veles-tpu-lint",
         description="trace-discipline / host-concurrency / config-drift "
-                    "static analyzer for veles_tpu (docs/analysis.md)")
+                    "/ metric-drift static analyzer for veles_tpu "
+                    "(docs/analysis.md)")
     p.add_argument("paths", nargs="*", default=["veles_tpu"],
                    help="files or directories to analyze "
                         "(default: veles_tpu)")

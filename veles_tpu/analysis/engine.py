@@ -10,7 +10,8 @@ from __future__ import annotations
 import os
 from typing import Dict, List, Optional, Tuple
 
-from . import concurrency_rules, config_rules, trace_rules
+from . import (concurrency_rules, config_rules, metrics_rules,
+               trace_rules)
 from .baseline import find_baseline, load_baseline, split_baselined
 from .findings import SEVERITIES, Finding, sort_key
 from .pysrc import ParsedFile, parse_file
@@ -101,6 +102,7 @@ def analyze_files(file_list: List[Tuple[str, str]], *,
                     hint="say why the finding is acceptable",
                     snippet=pf.line_text(sup.comment_line)))
     findings.extend(config_rules.check(parsed, docs_dir))
+    findings.extend(metrics_rules.check(parsed, docs_dir))
 
     kept: List[Finding] = []
     for f in findings:

@@ -25,6 +25,8 @@ SEVERITIES = {
     "VK301": "error",     # root.common.* read with no declared default
     "VK302": "warning",   # declared config key nobody reads
     "VK303": "warning",   # declared config key absent from the docs
+    "VM401": "error",     # metric registered but absent from the docs
+    "VM402": "warning",   # metric documented but registered nowhere
 }
 
 

@@ -1,0 +1,163 @@
+"""VM4xx — metric-name drift between code and docs/observability.md.
+
+The metrics registry (runtime/metrics.py) is string-keyed and
+registration is idempotent, which is ergonomic and treacherous in
+exactly the way the auto-vivifying config tree is (VK3xx): a renamed
+metric silently starts a second series, dashboards and the bench
+scraper keep reading the dead name, and nothing fails.  This rule
+cross-references two sources of truth:
+
+* **registrations** — every statically visible
+  ``.counter("vt_*", ...)`` / ``.gauge(...)`` / ``.histogram(...)``
+  call with a literal ``vt_``-prefixed name (the metric namespace; the
+  prefix is what separates a metric registration from any other
+  ``counter()`` call);
+* **docs** — ``vt_*`` names mentioned in ``docs/observability.md``
+  (the "Metrics & tracing" reference table).
+
+VM401  a metric registered in code but absent from
+       docs/observability.md — the reference table is the scrape
+       contract; an undocumented series is invisible to operators —
+       error.
+VM402  a metric documented but registered nowhere — a dashboard
+       pointed at it scrapes zeros forever — warning.  Derived
+       histogram series (``_bucket``/``_sum``/``_count`` suffixes of a
+       registered name) are exempt.  "Nowhere" needs the full
+       registration inventory, which a single-file lint run does not
+       have — so VM402 only fires on package-directory scans (an
+       ``__init__.py`` in the scanned set) that register at least one
+       metric, the way VK302 bails when config.py is not in the scan.
+
+Both checks no-op when ``docs/observability.md`` is absent (fixture
+trees), mirroring VK303's missing-docs behavior.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Tuple
+
+from .findings import Finding
+from .pysrc import ParsedFile
+
+#: the metric namespace: only literal names with this prefix count as
+#: registrations (an unrelated ``.counter()`` API elsewhere must not).
+METRIC_PREFIX = "vt_"
+
+_REGISTER_METHODS = ("counter", "gauge", "histogram")
+_NAME_RE = re.compile(r"\bvt_[a-z0-9_]+\b")
+_DERIVED_SUFFIXES = ("_bucket", "_sum", "_count")
+
+DOC_FILE = "observability.md"
+
+
+def _symbol_at(pf: ParsedFile, line: int) -> str:
+    best, best_span = "", None
+    for q, info in pf.functions.items():
+        node = info.node
+        end = getattr(node, "end_lineno", node.lineno)
+        if node.lineno <= line <= end:
+            span = end - node.lineno
+            if best_span is None or span < best_span:
+                best, best_span = q, span
+    return best
+
+
+def _collect_registrations(pf: ParsedFile) -> Dict[str, Tuple[int, int]]:
+    """name -> (line, col) of the first registration call in the file."""
+    out: Dict[str, Tuple[int, int]] = {}
+    for node in ast.walk(pf.tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        fn = node.func
+        method = fn.attr if isinstance(fn, ast.Attribute) \
+            else fn.id if isinstance(fn, ast.Name) else None
+        if method not in _REGISTER_METHODS:
+            continue
+        arg = node.args[0]
+        if not (isinstance(arg, ast.Constant)
+                and isinstance(arg.value, str)):
+            continue
+        name = arg.value
+        if not name.startswith(METRIC_PREFIX):
+            continue
+        out.setdefault(name, (node.lineno, node.col_offset))
+    return out
+
+
+def _doc_names(docs_dir: str) -> Optional[Tuple[str, str]]:
+    path = os.path.join(docs_dir, DOC_FILE)
+    if not os.path.isfile(path):
+        return None
+    try:
+        with open(path, encoding="utf-8") as f:
+            return path, f.read()
+    except OSError:
+        return None
+
+
+def check(files: List[ParsedFile],
+          docs_dir: Optional[str] = None) -> List[Finding]:
+    if not docs_dir:
+        return []
+    doc = _doc_names(docs_dir)
+    if doc is None:
+        return []
+    doc_path, doc_text = doc
+    documented = set(_NAME_RE.findall(doc_text))
+    doc_lines = doc_text.splitlines()
+
+    def _doc_line(name: str) -> int:
+        for i, line in enumerate(doc_lines, 1):
+            if name in line:
+                return i
+        return 1
+
+    registered: Dict[str, Tuple[ParsedFile, int, int]] = {}
+    for pf in files:
+        for name, (line, col) in _collect_registrations(pf).items():
+            registered.setdefault(name, (pf, line, col))
+
+    out: List[Finding] = []
+    for name in sorted(registered):
+        if name in documented:
+            continue
+        pf, line, col = registered[name]
+        out.append(Finding(
+            rule="VM401", path=pf.relpath, line=line, col=col,
+            message=f"metric `{name}` is registered here but never "
+                    f"mentioned in docs/{DOC_FILE} — the reference "
+                    "table is the scrape contract",
+            hint=f"add `{name}` to the docs/{DOC_FILE} metric table",
+            symbol=_symbol_at(pf, line),
+            snippet=pf.line_text(line)))
+
+    def _is_derived(name: str) -> bool:
+        for suf in _DERIVED_SUFFIXES:
+            if name.endswith(suf) and name[:-len(suf)] in registered:
+                return True
+        return False
+
+    # "registered nowhere" is only provable against the full inventory:
+    # skip VM402 for single-file / subset scans (no package __init__.py
+    # among the scanned files) and for trees registering nothing
+    package_scan = any(
+        os.path.basename(pf.relpath) == "__init__.py" for pf in files)
+    if registered and package_scan:
+        for name in sorted(documented):
+            if name in registered or _is_derived(name):
+                continue
+            out.append(Finding(
+                rule="VM402",
+                path=os.path.basename(os.path.dirname(doc_path))
+                + "/" + DOC_FILE,
+                line=_doc_line(name), col=0,
+                message=f"metric `{name}` is documented in "
+                        f"docs/{DOC_FILE} but registered nowhere — a "
+                        "dashboard pointed at it scrapes zeros forever",
+                hint="delete the table row or fix the name to match "
+                     "the registration",
+                symbol="", snippet=name))
+    return out

@@ -274,6 +274,14 @@ def _defaults():
     # Transient HTTP retry (forge/client.py, Snapshotter http loads;
     # backoff shape shared with the deploy watcher, runtime/deploy.py).
     root.common.net.http_retries = 3
+    # Observability (runtime/metrics.py + runtime/status.py,
+    # docs/observability.md "Metrics & tracing").
+    root.common.observe.label_cap = 64       # label series per metric;
+    #                                          beyond -> the _other series
+    root.common.observe.span_ring = 512      # request/step spans kept for
+    #                                          GET /trace.json / --trace-out
+    root.common.observe.status_flush_s = 0.25  # min interval between
+    #                                            status.json event flushes
     root.common.random_seed = 42
     root.common.platform = ""                # "" = let JAX pick
     root.common.mesh = dict(data=-1)          # -1: all remaining devices

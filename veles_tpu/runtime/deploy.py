@@ -296,6 +296,22 @@ class DeployController(Logger):
         self.swaps = 0
         self.last_swap_ms: Optional[float] = None
         self.last_error: Optional[str] = None
+        # control-plane series in the shared metrics registry
+        # (runtime/metrics.py): the swap history /metrics shows is the
+        # same one GET /models and status.json report
+        from .metrics import registry as _metrics_registry
+        _reg = _metrics_registry()
+        self._m_swaps = _reg.counter(
+            "vt_deploy_swaps_total", "hot weight swaps applied by the "
+            "deploy control plane")
+        self._m_reload_failures = _reg.counter(
+            "vt_deploy_reload_failures_total",
+            "reloads rejected with the old version still serving "
+            "(the HTTP 409 path)")
+        self._g_last_swap_ms = _reg.gauge(
+            "vt_deploy_last_swap_ms", "latency of the last hot swap")
+        self._g_active_version = _reg.gauge(
+            "vt_deploy_active_version", "registry version now serving")
 
         if server is not None:
             server.deploy = self  # routes /models + /admin/* here
@@ -588,12 +604,14 @@ class DeployController(Logger):
                 # the loaders; surface it as a LOAD failure (409 on the
                 # REST side), not as the registry's version-miss 404
                 self.last_error = f"KeyError: {e}"
+                self._m_reload_failures.inc()
                 self._report()
                 raise ValueError(
                     f"malformed source {source!r}: missing key "
                     f"{e}") from e
             except Exception as e:
                 self.last_error = f"{type(e).__name__}: {e}"
+                self._m_reload_failures.inc()
                 self._report()
                 raise
             if self.draining:
@@ -608,6 +626,7 @@ class DeployController(Logger):
                 self._apply(new_wstate)
             except Exception as e:
                 self.last_error = f"{type(e).__name__}: {e}"
+                self._m_reload_failures.inc()
                 flipped = (swaps_before is not None
                            and self.engine.swaps != swaps_before)
                 if flipped:
@@ -636,7 +655,9 @@ class DeployController(Logger):
                 kind=meta["kind"], checksum=meta["checksum"])
             self.registry.activate(entry["version"])
             self.swaps += 1
+            self._m_swaps.inc()
             self.last_swap_ms = round(1e3 * (time.monotonic() - t0), 1)
+            self._g_last_swap_ms.set(self.last_swap_ms)
             self.last_error = None
             post = self._compile_marker()
             recompiled = (post - pre) if None not in (pre, post) else 0
@@ -832,6 +853,8 @@ class DeployController(Logger):
                 "last_error": self.last_error}
 
     def _report(self):
+        active_now = self.registry.active or {}
+        self._g_active_version.set(active_now.get("version") or 0)
         if self.status is None:
             return
         try:

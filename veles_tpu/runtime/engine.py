@@ -74,6 +74,7 @@ from ..config import root
 from ..logger import Logger
 from ..units.base import Context
 from .generate import DecodePlan
+from .metrics import ScopedCounter, next_trace_id, registry, span_ring
 from .step_cache import StepCache, tree_signature
 
 
@@ -405,7 +406,8 @@ class _Request:
     __slots__ = ("prompt", "n_steps", "temperature", "top_k", "top_p",
                  "eos_id", "key_data", "deadline", "done", "result",
                  "error", "submitted_at", "slot", "finished_at",
-                 "page_row", "prefix_start", "page_hashes")
+                 "page_row", "prefix_start", "page_hashes",
+                 "trace_id", "admitted_at", "first_token_at", "bucket")
 
     def __init__(self, prompt, n_steps, temperature, top_k, top_p,
                  eos_id, key_data, deadline):
@@ -426,6 +428,13 @@ class _Request:
         self.page_row = None            # paged: this request's page table
         self.prefix_start = 0           # paged: first un-shared position
         self.page_hashes = ()           # paged: chained full-page hashes
+        # observability (runtime/metrics.py): one trace track per
+        # request, host timestamps for the queue-wait/prefill/decode
+        # span breakdown in GET /trace.json
+        self.trace_id = next_trace_id()
+        self.admitted_at = None         # prefill began (left the queue)
+        self.first_token_at = None      # prefill returned (== TTFT end)
+        self.bucket = None              # prefill bucket this request took
 
     def finish(self, result=None, error=None):
         self.result, self.error = result, error
@@ -595,14 +604,17 @@ class DecodeEngine(Logger):
         self._draining = False
         self._died = False              # scheduler crashed (work FAILED)
 
-        # gauges
-        self._admitted = 0
-        self._retired = 0
-        self._rejected = 0
-        self._timeouts = 0
-        self._decode_steps = 0
+        # gauges: per-engine views over the process-global metrics
+        # registry (runtime/metrics.py) — stats(), status.json, GET
+        # /engine and GET /metrics all read the SAME increments
+        self._init_metrics()
+        self._admitted = ScopedCounter(self._m_admitted)
+        self._retired = ScopedCounter(self._m_retired)
+        self._rejected = ScopedCounter(self._m_rejected)
+        self._timeouts = ScopedCounter(self._m_timeouts)
+        self._decode_steps = ScopedCounter(self._m_decode_steps)
+        self._tok_count = ScopedCounter(self._m_tokens)
         self._occupancy_sum = 0
-        self._tok_count = 0
         self._rate_mark = (time.monotonic(), 0)
         self._tokens_per_sec = 0.0
         self._status_mark = 0.0
@@ -612,6 +624,108 @@ class DecodeEngine(Logger):
 
         # the lifetime decode program, AOT-compiled up front
         self._decode = self._compile_decode(params)
+
+    def _init_metrics(self):  # not-shared: __init__-only construction, precedes any thread
+        """Register the serving metrics (idempotent: engines come and go
+        within one process, the registry series live on — stats() stays
+        per-engine through the ScopedCounter views).  Names are the
+        contract docs/observability.md's reference table documents and
+        the VM4xx analysis rule enforces."""
+        reg = registry()
+        self._m_queue_wait = reg.histogram(
+            "vt_request_queue_wait_seconds",
+            "time a request waited between submit() and the start of "
+            "its prefill (admission into a slot)")
+        self._m_ttft = reg.histogram(
+            "vt_request_ttft_seconds",
+            "submit-to-first-token latency, labelled by the prefill "
+            "bucket the request took", labels=("bucket",))
+        self._m_prefill = reg.histogram(
+            "vt_prefill_seconds",
+            "wall time of one prefill program call, labelled by bucket",
+            labels=("bucket",))
+        self._m_decode_step = reg.histogram(
+            "vt_decode_step_seconds",
+            "wall time of one decode step (all active slots advance one "
+            "token) — the per-token decode latency under load")
+        self._m_requests = reg.counter(
+            "vt_requests_total",
+            "finished requests by outcome: ok | 429 (overload/pool "
+            "rejection) | 504 (deadline) | crash (scheduler died) | "
+            "stopped (engine stopped with work pending)",
+            labels=("outcome",))
+        self._m_admitted = reg.counter(
+            "vt_engine_admitted_total", "requests admitted into a slot")
+        self._m_retired = reg.counter(
+            "vt_engine_retired_total", "requests retired complete")
+        self._m_rejected = reg.counter(
+            "vt_engine_rejected_total",
+            "requests refused at submit (queue overflow or page-pool "
+            "exhaustion; the HTTP 429 path)")
+        self._m_timeouts = reg.counter(
+            "vt_engine_timeouts_total",
+            "requests failed on their deadline (queued or mid-flight; "
+            "the HTTP 504 path)")
+        self._m_decode_steps = reg.counter(
+            "vt_engine_decode_steps_total", "decode steps executed")
+        self._m_tokens = reg.counter(
+            "vt_engine_tokens_total", "tokens generated")
+        self._m_swaps = reg.counter(
+            "vt_engine_swaps_total", "hot weight swaps applied")
+        self._g_occupancy = reg.gauge(
+            "vt_engine_occupancy", "slots currently decoding")
+        self._g_queue_depth = reg.gauge(
+            "vt_engine_queue_depth", "requests waiting in the queue")
+        self._g_tokens_per_sec = reg.gauge(
+            "vt_engine_tokens_per_sec",
+            "recent decode throughput (0.5s window)")
+        self._g_pages_used = reg.gauge(
+            "vt_pages_used", "pool pages referenced by live slots")
+        self._g_pages_cached = reg.gauge(
+            "vt_pages_cached",
+            "refcount-0 pages kept resident by the prefix index")
+        self._g_pages_free = reg.gauge(
+            "vt_pages_free", "pool pages on the free list")
+        self._g_prefix_hit_rate = reg.gauge(
+            "vt_prefix_hit_rate",
+            "fraction of full prompt pages served from the prefix "
+            "cache since engine start")
+
+    def _observe_finish(self, req, outcome: str):
+        """Host-side request accounting at every terminal edge: the
+        outcome counter plus the request's span-ring timeline
+        (queue-wait → prefill → decode nested under one request span,
+        one trace track per request id)."""
+        self._m_requests.labels(outcome=outcome).inc()
+        sub = req.submitted_at
+        fin = req.finished_at if req.finished_at is not None \
+            else time.monotonic()
+        ring = span_ring()
+        args = {"id": req.trace_id, "outcome": outcome,
+                "prompt_tokens": int(req.prompt.size),
+                "n_steps": int(req.n_steps)}
+        if req.slot is not None:
+            args["slot"] = int(req.slot)
+        if req.bucket is not None:
+            args["bucket"] = int(req.bucket)
+        if self.paged and req.admitted_at is not None:
+            args["prefix_start"] = int(req.prefix_start)
+        ring.add("request", sub, fin - sub, cat="request",
+                 tid=req.trace_id, args=args)
+        if req.admitted_at is None:
+            ring.add("queue_wait", sub, fin - sub, cat="serve",
+                     tid=req.trace_id)
+            return
+        ring.add("queue_wait", sub, req.admitted_at - sub, cat="serve",
+                 tid=req.trace_id)
+        if req.first_token_at is not None:
+            ring.add("prefill", req.admitted_at,
+                     req.first_token_at - req.admitted_at, cat="serve",
+                     tid=req.trace_id,
+                     args={"bucket": int(req.bucket or 0)})
+            ring.add("decode", req.first_token_at,
+                     fin - req.first_token_at, cat="serve",
+                     tid=req.trace_id)
 
     # -- compiled programs --------------------------------------------------
     @staticmethod
@@ -776,6 +890,7 @@ class DecodeEngine(Logger):
         if not self.started:
             self.wstate = dict(self.wstate, params=staged)
             self._swaps += 1
+            self._m_swaps.inc()
             self._invalidate_prefix_cache()
             return
         done = threading.Event()
@@ -805,6 +920,7 @@ class DecodeEngine(Logger):
         params, done = staged
         self.wstate = dict(self.wstate, params=params)
         self._swaps += 1
+        self._m_swaps.inc()
         # cached prefix pages hold KV computed under the OLD weights.
         # In-flight slots finishing on mixed versions is the documented
         # hot-swap trade, but a stale cached prefix would contaminate
@@ -931,10 +1047,11 @@ class DecodeEngine(Logger):
                 pool_bound = (need > avail
                               and free_slots > len(self._queue))
                 if pool_bound:
-                    self._rejected += 1
+                    self._rejected.inc()
             if pool_bound:
                 with self._page_lock:
                     self._pool_rejected += 1
+                self._m_requests.labels(outcome="429").inc()
                 raise EngineOverloaded(
                     f"page pool exhausted ({avail} of {self.pages} "
                     f"pages free, request needs {need} beyond its "
@@ -944,10 +1061,11 @@ class DecodeEngine(Logger):
             # Retry-After by re-taking the lock) raises outside it
             overloaded = len(self._queue) >= self.queue_depth
             if overloaded:
-                self._rejected += 1
+                self._rejected.inc()
             else:
                 self._queue.append(req)
         if overloaded:
+            self._m_requests.labels(outcome="429").inc()
             raise EngineOverloaded(
                 f"queue full ({self.queue_depth} pending)",
                 self._retry_after())
@@ -997,14 +1115,18 @@ class DecodeEngine(Logger):
             raise
 
     def stats(self) -> dict:
-        """JSON-able gauges for status pages / benches."""
+        """JSON-able gauges for status pages / benches.  The counters
+        are ScopedCounter views over the metrics registry, so the same
+        increments back this dict, status.json, GET /engine and GET
+        /metrics; the sampled gauges (occupancy / queue depth /
+        throughput) are published to the registry here."""
         now = time.monotonic()
         mark_t, mark_n = self._rate_mark
         if now - mark_t >= 0.5:
-            self._tokens_per_sec = ((self._tok_count - mark_n)
+            self._tokens_per_sec = ((self._tok_count.n - mark_n)
                                     / max(now - mark_t, 1e-9))
-            self._rate_mark = (now, self._tok_count)
-        steps = max(self._decode_steps, 1)
+            self._rate_mark = (now, self._tok_count.n)
+        steps = max(self._decode_steps.n, 1)
         pages = None
         if self.paged:
             # one consistent snapshot of the pool: refcounts, the
@@ -1037,19 +1159,28 @@ class DecodeEngine(Logger):
             }
         with self._qlock:
             queue_depth = len(self._queue)
+        occupancy = int(self._active.sum())
+        self._g_occupancy.set(occupancy)
+        self._g_queue_depth.set(queue_depth)
+        self._g_tokens_per_sec.set(self._tokens_per_sec)
+        if pages is not None:
+            self._g_pages_used.set(pages["used"])
+            self._g_pages_cached.set(pages["cached"])
+            self._g_pages_free.set(pages["free"])
+            self._g_prefix_hit_rate.set(pages["prefix_hit_rate"])
         return {
             "slots": self.slots, "l_max": self.l_max,
             "paged": self.paged,
             **({"pages": pages} if pages is not None else {}),
-            "occupancy": int(self._active.sum()),
+            "occupancy": occupancy,
             "avg_occupancy": round(self._occupancy_sum / steps, 3),
             "queue_depth": queue_depth,
             "queue_limit": self.queue_depth,
             "tokens_per_sec": round(self._tokens_per_sec, 1),
-            "tokens_generated": self._tok_count,
-            "decode_steps": self._decode_steps,
-            "admitted": self._admitted, "retired": self._retired,
-            "rejected": self._rejected, "timeouts": self._timeouts,
+            "tokens_generated": self._tok_count.n,
+            "decode_steps": self._decode_steps.n,
+            "admitted": self._admitted.n, "retired": self._retired.n,
+            "rejected": self._rejected.n, "timeouts": self._timeouts.n,
             "swaps": self._swaps, "draining": self._draining,
             "scheduler_crashed": self._died,
             "compile": self.step_cache.stats(),
@@ -1125,15 +1256,19 @@ class DecodeEngine(Logger):
             self._fail_all(EngineStopped("engine stopped"))
 
     def _fail_all(self, err: Exception):
+        outcome = "crash" if isinstance(err, SchedulerCrashed) \
+            else "stopped"
         with self._qlock:
             pending = list(self._queue)
             self._queue.clear()
         for req in pending:
             req.finish(error=err)
+            self._observe_finish(req, outcome)
         for s, req in enumerate(self._slot_req):
             if req is not None:
                 req.finish(error=err)
                 self._slot_req[s] = None
+                self._observe_finish(req, outcome)
             self._release_slot_pages(s)
         self._active[:] = False
 
@@ -1151,9 +1286,10 @@ class DecodeEngine(Logger):
                     (expired if now > r.deadline else keep).append(r)
                 self._queue = keep
         for r in expired:
-            self._timeouts += 1
+            self._timeouts.inc()
             r.finish(error=TimeoutError(
                 "request deadline expired while queued"))
+            self._observe_finish(r, "504")
 
     def _admit(self) -> int:
         """Move queued requests into free slots (prefill); returns the
@@ -1169,9 +1305,10 @@ class DecodeEngine(Logger):
                 return n
             now = time.monotonic()
             if now > req.deadline:
-                self._timeouts += 1
+                self._timeouts.inc()
                 req.finish(error=TimeoutError(
                     "request deadline expired while queued"))
+                self._observe_finish(req, "504")
                 continue
             if self.paged and not self._reserve_pages(req):
                 # the pool cannot host it right now: requeue at the
@@ -1339,6 +1476,8 @@ class DecodeEngine(Logger):
         # visible to drain()'s idleness check (and to _fail_all)
         self._slot_req[slot] = req
         req.slot = slot
+        req.admitted_at = time.monotonic()
+        self._m_queue_wait.observe(req.admitted_at - req.submitted_at)
         params = self.wstate["params"]
         P = int(req.prompt.size)
         temp = np.float32(req.temperature)
@@ -1370,6 +1509,14 @@ class DecodeEngine(Logger):
                 params, self._caches, self._toks, padded, np.int32(P),
                 np.int32(slot), temp, topk, topp, req.key_data)
         first = int(first)
+        # int(first) above synced on the prefill result, so this is the
+        # honest host-side time-to-first-token boundary
+        req.first_token_at = time.monotonic()
+        req.bucket = pb
+        self._m_prefill.labels(bucket=pb).observe(
+            req.first_token_at - req.admitted_at)
+        self._m_ttft.labels(bucket=pb).observe(
+            req.first_token_at - req.submitted_at)
         self._pos[slot] = P
         self._temp[slot] = temp
         self._topk[slot] = topk
@@ -1377,8 +1524,8 @@ class DecodeEngine(Logger):
         self._eos[slot] = -1 if req.eos_id is None else req.eos_id
         self._end[slot] = P + req.n_steps - 1
         self._keys[slot] = req.key_data
-        self._admitted += 1
-        self._tok_count += 1
+        self._admitted.inc()
+        self._tok_count.inc()
         done = (req.n_steps == 1
                 or (req.eos_id is not None and first == req.eos_id))
         self._active[slot] = not done
@@ -1386,6 +1533,7 @@ class DecodeEngine(Logger):
             self._retire(slot)
 
     def _step_once(self):
+        t0 = time.monotonic()
         args = (self.wstate["params"], self._caches, self._toks)
         if self.paged:
             args += (self._ptab,)
@@ -1393,12 +1541,15 @@ class DecodeEngine(Logger):
             *args, self._pos, self._active, self._temp, self._topk,
             self._topp, self._eos, self._end, self._keys)
         n_active = int(self._active.sum())
-        self._decode_steps += 1
+        self._decode_steps.inc()
         self._occupancy_sum += n_active
-        self._tok_count += n_active
+        self._tok_count.inc(n_active)
         # np.array (copy): asarray would alias the read-only device view
         self._pos = np.array(pos)
         self._active = np.array(active)
+        # the np.array copies above synced on the step result, so this
+        # wall time is the real per-token decode latency under load
+        self._m_decode_step.observe(time.monotonic() - t0)
         now = time.monotonic()
         for slot in np.flatnonzero(np.asarray(finished)):
             self._retire(int(slot))
@@ -1409,9 +1560,10 @@ class DecodeEngine(Logger):
                 self._active[slot] = False
                 self._slot_req[slot] = None
                 self._release_slot_pages(int(slot))
-                self._timeouts += 1
+                self._timeouts.inc()
                 req.finish(error=TimeoutError(
                     "request deadline expired while decoding"))
+                self._observe_finish(req, "504")
 
     def _retire(self, slot: int):
         req = self._slot_req[slot]
@@ -1426,16 +1578,23 @@ class DecodeEngine(Logger):
         P = int(req.prompt.size)
         gen = np.asarray(self._toks[slot, P:int(self._pos[slot]) + 1],
                          np.int32)
-        self._retired += 1
+        self._retired.inc()
         req.finish(result=np.concatenate([req.prompt, gen]))
+        self._observe_finish(req, "ok")
 
     def _maybe_report(self):
+        now = time.monotonic()
+        if now - self._status_mark < 0.5:
+            return
+        self._status_mark = now
+        # stats() also publishes the sampled gauges (occupancy / queue
+        # depth / throughput / pages) into the metrics registry, so the
+        # 0.5s tick keeps GET /metrics live even with NO StatusReporter
+        # attached (e.g. --serve --artifact boots status-less)
+        stats = self.stats()
         if self.status is None:
             return
-        now = time.monotonic()
-        if now - self._status_mark >= 0.5:
-            self._status_mark = now
-            try:
-                self.status.update(engine=self.stats())
-            except Exception:  # status must never take the engine down
-                pass
+        try:
+            self.status.update(engine=stats)
+        except Exception:  # status must never take the engine down
+            pass

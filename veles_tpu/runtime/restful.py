@@ -57,6 +57,7 @@ from ..config import root
 from ..logger import Logger
 from .artifact import ArtifactError
 from .engine import EngineOverloaded, EngineStopped, SchedulerCrashed
+from .metrics import registry, span_ring
 from .snapshotter import SnapshotCorruptError
 
 
@@ -100,6 +101,32 @@ class RestfulServer(Logger):
 
             def do_GET(self):
                 path = self.path.split("?", 1)[0].rstrip("/")
+                if path == "/metrics":
+                    # Prometheus text exposition on the SERVING port:
+                    # the scrape target needs no second server
+                    # (docs/observability.md "Metrics & tracing")
+                    body = registry().render().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                if path == "/trace.json":
+                    # per-request serving timelines (queue-wait →
+                    # prefill → decode) as Chrome-trace/Perfetto JSON;
+                    # default=repr because span args are arbitrary
+                    # host objects (event payloads)
+                    body = json.dumps(span_ring().chrome_trace(),
+                                      default=repr).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 if path == "/healthz":
                     # liveness: answers whenever the process serves HTTP
                     # at all — deliberately ignores engine/drain state

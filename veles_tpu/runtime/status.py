@@ -21,24 +21,38 @@ missing #3) — the MetricsRecorder autosaves the PNGs each epoch."""
 from __future__ import annotations
 
 import collections
+import html
 import http.server
 import json
 import os
 import threading
 import time
+import urllib.parse
 from typing import Optional
 
+from ..config import root
 from ..logger import Logger
+from .metrics import registry, span_ring
 
 
 class StatusReporter(Logger):
     """Atomically maintained status.json (reference: the per-master status
-    document)."""
+    document).
+
+    Event flushes COALESCE: ``record_event`` bursts (a retire storm at
+    a deadline sweep, a watcher flapping) rewrite status.json at most
+    once per ``flush_interval_s`` (default ``root.common.observe
+    .status_flush_s``) instead of fsync-storming the disk — a deferred
+    burst is always flushed by a trailing timer, so the final state
+    lands within one interval.  Direct ``update()`` calls still write
+    through immediately (their callers are already epoch/0.5s-cadence
+    throttled)."""
 
     def __init__(self, path: str = "status.json", name: str = "workflow",
                  plots_dir: Optional[str] = None,
                  graph_svg: Optional[str] = None,
-                 events_max: int = 20):
+                 events_max: int = 20,
+                 flush_interval_s: Optional[float] = None):
         self.path = path
         self.name = name
         self.plots_dir = plots_dir
@@ -47,12 +61,24 @@ class StatusReporter(Logger):
         # browser graph view (/root/reference/web/viz.js)
         self.graph_svg = graph_svg
         self.started = time.time()
+        self.flush_interval_s = float(
+            root.common.observe.get("status_flush_s", 0.25)
+            if flush_interval_s is None else flush_interval_s)
         # one reporter, many writers (engine scheduler, deploy control
         # plane, trainer): serialize the read-modify-write on _extra /
         # _events and the tmp-file replace
         self._extra = {}  # guarded-by: self._lock
         self._events = collections.deque(maxlen=max(1, int(events_max)))  # guarded-by: self._lock
         self._lock = threading.Lock()
+        self._last_flush = 0.0  # guarded-by: self._lock
+        self._flush_timer: Optional[threading.Timer] = None  # guarded-by: self._lock
+        reg = registry()
+        self._m_flushes = reg.counter(
+            "vt_status_flushes_total", "status.json writes")
+        self._m_coalesced = reg.counter(
+            "vt_status_flushes_coalesced_total",
+            "event flushes deferred into the trailing coalescing timer "
+            "(root.common.observe.status_flush_s)")
 
     def plot_files(self):
         """Sorted (name, mtime) of the PNGs currently in plots_dir."""
@@ -73,29 +99,61 @@ class StatusReporter(Logger):
         (``events`` key, newest last): discrete lifecycle moments — a
         weight swap, a drain, a watcher failure — that a sampled gauge
         can't show (the deploy control plane's swap/version history,
-        runtime/deploy.py)."""
+        runtime/deploy.py).  Writes coalesce (class docstring); events
+        also land as instants on the ``/trace.json`` timeline."""
+        at = time.monotonic()
         with self._lock:
             # under the same lock update() iterates the deque with —
             # an un-locked append can blow up that iteration
             self._events.append(
                 {"kind": str(kind), "time": round(time.time(), 3), **info})
-        self.update()
+            self._flush_locked(coalesce=True)
+        span_ring().add_instant(str(kind), at, cat="status", args=info)
 
     def update(self, **fields) -> None:
         with self._lock:
             self._extra.update(fields)
-            doc = {
-                "name": self.name,
-                "time": time.time(),
-                "uptime_s": round(time.time() - self.started, 1),
-                **self._extra,
-            }
-            if self._events:
-                doc["events"] = list(self._events)
-            tmp = self.path + ".tmp"
-            with open(tmp, "w") as f:
-                json.dump(doc, f, indent=1, default=repr)
-            os.replace(tmp, self.path)
+            self._flush_locked(coalesce=False)
+
+    def _flush_locked(self, *, coalesce: bool) -> None:  # requires-lock: self._lock
+        now = time.monotonic()
+        if coalesce and now - self._last_flush < self.flush_interval_s:
+            self._m_coalesced.inc()
+            if self._flush_timer is None:
+                # trailing flush: the burst's FINAL state always lands
+                # within one interval of its last event
+                delay = self._last_flush + self.flush_interval_s - now
+                t = threading.Timer(max(delay, 0.005), self._timer_flush)
+                t.daemon = True
+                self._flush_timer = t
+                t.start()
+            return
+        self._write_locked(now)
+
+    def _timer_flush(self) -> None:
+        with self._lock:
+            self._flush_timer = None
+            self._write_locked(time.monotonic())
+
+    def _write_locked(self, now: float) -> None:  # requires-lock: self._lock
+        self._last_flush = now
+        if self._flush_timer is not None:
+            # a direct write supersedes the pending trailing flush
+            self._flush_timer.cancel()
+            self._flush_timer = None
+        doc = {
+            "name": self.name,
+            "time": time.time(),
+            "uptime_s": round(time.time() - self.started, 1),
+            **self._extra,
+        }
+        if self._events:
+            doc["events"] = list(self._events)
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1, default=repr)
+        os.replace(tmp, self.path)
+        self._m_flushes.inc()
 
     def read(self) -> dict:
         with open(self.path) as f:
@@ -112,6 +170,28 @@ class _Handler(http.server.BaseHTTPRequestHandler):
     reporter: Optional[StatusReporter] = None
 
     def do_GET(self):
+        if self.path.split("?", 1)[0] == "/metrics":
+            # Prometheus text exposition of the process registry —
+            # the scrape target every latency histogram lands in
+            # (docs/observability.md "Metrics & tracing")
+            body = registry().render().encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        if self.path.split("?", 1)[0] == "/trace.json":
+            # Chrome-trace / Perfetto timeline of the span ring
+            body = json.dumps(span_ring().chrome_trace(),
+                              default=repr).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
         if self.path.split("?", 1)[0] == "/graph.svg":
             svg = self.reporter.graph_svg if self.reporter else None
             if not svg or not os.path.isfile(svg):
@@ -128,9 +208,11 @@ class _Handler(http.server.BaseHTTPRequestHandler):
             self.wfile.write(body)
             return
         if self.path.startswith("/plots/"):
-            # serve a PNG from plots_dir; basename-only lookup so a
-            # crafted path can never escape the directory
-            fn = os.path.basename(self.path.split("?", 1)[0])
+            # serve a PNG from plots_dir; unquote FIRST, then basename-
+            # only lookup, so a crafted (or %2F-encoded) path can never
+            # escape the directory
+            fn = os.path.basename(
+                urllib.parse.unquote(self.path.split("?", 1)[0]))
             root = self.reporter.plots_dir if self.reporter else None
             full = os.path.join(root, fn) if root else None
             if not fn.endswith(".png") or not full \
@@ -165,22 +247,31 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                     else:
                         yield key, v
 
-            rows = "".join(f"<tr><td>{k}</td><td>{v}</td></tr>"
-                           for k, v in flat(doc))
+            # html.escape EVERY interpolated key/value: a metric value
+            # whose repr carries < or & (an error string, a path, a
+            # label) must render as text, never as markup
+            rows = "".join(
+                f"<tr><td>{html.escape(str(k))}</td>"
+                f"<td>{html.escape(str(v))}</td></tr>"
+                for k, v in flat(doc))
             plots = self.reporter.plot_files() if self.reporter else []
             # mtime cache-buster: the 2s meta refresh re-requests each
-            # image only as it actually changes
+            # image only as it actually changes.  Filenames are URL-
+            # quoted for the path and HTML-escaped for the attribute —
+            # a quote or angle bracket in a plot name must not break
+            # out of the src attribute
             imgs = "".join(
-                f'<p><img src="/plots/{fn}?t={int(mt)}" '
-                f'style="max-width:95%"></p>' for fn, mt in plots)
+                '<p><img src="/plots/'
+                f'{html.escape(urllib.parse.quote(fn))}?t={int(mt)}" '
+                'style="max-width:95%"></p>' for fn, mt in plots)
             graph = ""
             if self.reporter and self.reporter.graph_svg \
                     and os.path.isfile(self.reporter.graph_svg):
                 graph = ('<h3>workflow graph</h3>'
                          '<p><img src="/graph.svg" '
                          'style="max-width:95%"></p>')
-            body = (_HTML % (doc.get("name", "?"), rows)
-                    + graph + imgs).encode()
+            body = (_HTML % (html.escape(str(doc.get("name", "?"))),
+                             rows) + graph + imgs).encode()
             ctype = "text/html"
         self.send_response(200)
         self.send_header("Content-Type", ctype)

@@ -38,6 +38,7 @@ import jax
 
 from ..config import root
 from ..logger import Logger, TraceContext
+from .metrics import registry
 
 
 def enable_persistent_cache(cache_dir: Optional[str] = None) -> bool:
@@ -144,6 +145,22 @@ class StepCache(Logger):
         self.compiles = 0
         self.hits = 0
         self.compile_wall_s = 0.0
+        # process-global compile series next to the per-cache counters
+        # (runtime/metrics.py): /metrics shows compiles across EVERY
+        # cache in the process, so "flat under load" is checkable from
+        # one scrape while stats() keeps the per-cache contract
+        reg = registry()
+        self._m_compiles = reg.counter(
+            "vt_compile_total",
+            "trace+compile events by program kind (train / eval / "
+            "decode / prefill) across every StepCache in the process",
+            labels=("program",))
+        self._m_hits = reg.counter(
+            "vt_compile_hits_total",
+            "step programs served from cache", labels=("program",))
+        self._m_wall = reg.counter(
+            "vt_compile_wall_seconds_total",
+            "wall seconds spent tracing+compiling step programs")
 
     @property
     def recompiles(self) -> int:
@@ -179,6 +196,7 @@ class StepCache(Logger):
         ent = self._entries.get(full_key)
         if ent is not None:
             self.hits += 1
+            self._m_hits.labels(program=kind).inc()
             return ent["fn"], ent["state_sh"], ent["batch_sh"]
 
         with TraceContext("step_compile", program=kind):
@@ -197,6 +215,8 @@ class StepCache(Logger):
             wall = time.perf_counter() - t0
         self.compiles += 1
         self.compile_wall_s += wall
+        self._m_compiles.labels(program=kind).inc()
+        self._m_wall.inc(wall)
 
         cost: Dict[str, float] = {}
         if compiled is not None:

@@ -28,6 +28,7 @@ from ..ops.optimizers import (ANOM_CONSEC_KEY, LR_MULT_KEY, Optimizer,
                               reserved_opt_neutral)
 from ..units.workflow import Workflow
 from .decision import Decision
+from .metrics import registry, span_ring
 from .snapshotter import (Snapshotter, _to_numpy, restore_with_walkback)
 from .step_cache import StepCache, enable_persistent_cache
 
@@ -97,6 +98,22 @@ class Trainer(Logger):
         self.anomaly_steps_skipped = 0
         self.anomaly_rollbacks = 0
         self.snapshot_walkbacks = 0
+        # per-step phase breakdown (docs/observability.md "Metrics &
+        # tracing"): where a training second actually goes — blocked on
+        # the loader, moving the batch H2D, dispatching the step, or
+        # writing a snapshot.  Host-side wall times only; the step
+        # phase is dispatch + any implicit sync the NEXT phase forces,
+        # never a device sync of its own.
+        reg = registry()
+        self._m_phase = reg.histogram(
+            "vt_train_phase_seconds",
+            "per-step wall time by phase: data_wait | h2d | step | "
+            "snapshot", labels=("phase",))
+        self._m_anom = reg.counter(
+            "vt_train_anomaly_skips_total",
+            "train steps skipped by the in-graph anomaly sentinel")
+        self._g_epoch = reg.gauge(
+            "vt_train_epoch", "current training epoch")
 
     # -- setup -------------------------------------------------------------
     def initialize(self, seed: Optional[int] = None,
@@ -289,14 +306,35 @@ class Trainer(Logger):
         if self._batch_sh is None:
             return batch
         from ..parallel.distributed import place_batch
-        return place_batch(batch, self.mesh, self._batch_sh)
+        t0 = time.monotonic()
+        placed = place_batch(batch, self.mesh, self._batch_sh)
+        # dispatch wall of the H2D transfer (device_put is async; the
+        # actual copy overlaps the previous step by design — this
+        # phase going fat means the transfer no longer hides)
+        self._m_phase.labels(phase="h2d").observe(time.monotonic() - t0)
+        return placed
 
     def _run_epoch_train(self, epoch: int) -> Dict[str, float]:
         sums: Dict[str, Any] = {}
+        phase = self._m_phase
         with TraceContext("train_epoch", epoch=epoch):
             # _batches yields batches already device-placed (H2D runs in
-            # the prefetch worker, overlapped with the previous step)
-            for batch in self._batches(TRAIN, epoch):
+            # the prefetch worker, overlapped with the previous step);
+            # data_wait is the time THIS thread blocked on the feed —
+            # near zero while prefetch keeps up, the loader's share of
+            # the step when it does not
+            it = iter(self._batches(TRAIN, epoch))
+            while True:
+                t0 = time.monotonic()
+                batch = next(it, None)
+                if batch is None:
+                    # exhausted next() is generator teardown, not batch
+                    # wait — recording it would skew the distribution
+                    # and leave data_wait one count ahead of step
+                    break
+                phase.labels(phase="data_wait").observe(
+                    time.monotonic() - t0)
+                t0 = time.monotonic()
                 self.wstate, mets = self._train_step(self.wstate, batch)
                 # Accumulate ON DEVICE — a float() here would sync the
                 # pipeline every step (the reference's --sync-run behavior,
@@ -304,6 +342,8 @@ class Trainer(Logger):
                 for k, v in mets.items():
                     sums[k] = sums[k] + v if k in sums else v
                 sums["n_batches"] = sums.get("n_batches", 0) + 1
+                phase.labels(phase="step").observe(
+                    time.monotonic() - t0)
         return aggregate_epoch_metrics(
             {k: float(v) for k, v in sums.items()})
 
@@ -330,6 +370,8 @@ class Trainer(Logger):
         epoch = self.loader.epoch_number
         while not self.decision.complete:
             t_ep = time.time()
+            mono_ep = time.monotonic()
+            self._g_epoch.set(epoch)
             train_mets = self._run_epoch_train(epoch)
             t_train = time.time()
             samples_done += int(train_mets.get("n_samples", 0))
@@ -396,10 +438,21 @@ class Trainer(Logger):
                 # every host a snapshotter with the same interval;
                 # wall-clock time_interval throttling can diverge across
                 # hosts and is rejected at initialize().
+                t_snap = time.monotonic()
                 payload = self._payload()
                 if jax.process_index() == 0:
                     self.snapshotter.save(f"ep{epoch}", payload,
                                           best=self.decision.improved)
+                self._m_phase.labels(phase="snapshot").observe(
+                    time.monotonic() - t_snap)
+            # one span per epoch in the shared ring: training epochs
+            # land on the same /trace.json timeline serving requests do
+            span_ring().add(
+                "train_epoch", mono_ep, time.monotonic() - mono_ep,
+                cat="train", tid=0,
+                args={"epoch": epoch,
+                      **{k: round(v, 6) for k, v in train_mets.items()
+                         if isinstance(v, float)}})
             epoch = self.loader.epoch_number
             if stop:
                 break
@@ -435,6 +488,7 @@ class Trainer(Logger):
         skipped = int(train_mets.get("anomaly_steps", 0))
         if skipped:
             self.anomaly_steps_skipped += skipped
+            self._m_anom.inc(skipped)
             self.warning("epoch %d: %d anomalous step(s) skipped "
                          "(non-finite loss/grad norm)", epoch, skipped)
         patience = self._anomaly_patience()
