@@ -49,8 +49,16 @@ prefill while the dense engine re-prefills the full prompt every time.
 Prints ONE JSON line in the bench.py contract:
   {"metric": "serving_decode_tokens_per_sec", "value": N,
    "unit": "tokens/s", "vs_baseline": N, ...}
+
+``--json OUT`` additionally writes the same document (plus
+``schema_version``) to a file — a stable machine-readable schema per
+scenario (tokens/s, TTFT/queue-wait percentiles, recompiles, and the
+goodput/memory numbers: decode bandwidth-utilization, tokens/s/chip,
+headroom-in-slots, component bytes) so the perf trajectory diffs
+across PRs instead of being scraped from stdout tails.
 """
 
+import argparse
 import json
 import os
 import sys
@@ -58,6 +66,9 @@ import tempfile
 import threading
 import time
 import urllib.request
+
+#: bump when a key moves/renames — consumers diff across PRs on this.
+SCHEMA_VERSION = 1
 
 import numpy as np
 
@@ -115,7 +126,14 @@ def _latency_percentiles(text0, text1, name):
         for q in (0.5, 0.95, 0.99)}
 
 
-def main():
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--json", metavar="OUT", default=None,
+                    help="also write the result document (with "
+                         "schema_version) to this file — the diffable "
+                         "perf-trajectory record")
+    cli = ap.parse_args(argv)
+
     import jax.numpy as jnp
 
     import veles_tpu as vt
@@ -382,6 +400,11 @@ def main():
                 st = e.stats()
                 r["compiles"] = st["compile"]["compiles"]
                 r["recompiles"] = st["compile"]["recompiles"]
+                # goodput + memory: bandwidth-utilization, tokens/s per
+                # chip, and the aval-derived footprint/headroom of this
+                # geometry (docs/observability.md)
+                r["goodput"] = st["goodput"]
+                r["memory"] = st["memory"]
                 r["token_cells"] = (st["pages"]["pages"]
                                     * st["pages"]["page_size"]
                                     if paged else e.slots * e.l_max)
@@ -435,6 +458,7 @@ def main():
         "metric": "serving_decode_tokens_per_sec",
         "value": best["tokens_per_sec"],
         "unit": "tokens/s",
+        "schema_version": SCHEMA_VERSION,
         # acceptance comparison: first exposure to the mixed-shape
         # workload, compile cost included on both sides
         "vs_baseline": round(engine_endpoint_tps / serial_endpoint_tps, 3),
@@ -448,6 +472,11 @@ def main():
             # trajectory finally carries tail latencies, not just tps
             "ttft_from_metrics": ttft_pct,
             "queue_wait_from_metrics": qwait_pct,
+            # goodput + memory at end of the vs_baseline workload:
+            # bandwidth-utilization, tokens/s/chip, headroom-in-slots,
+            # component bytes (docs/observability.md)
+            "goodput": final["goodput"],
+            "memory": final["memory"],
         },
         "warm": {
             "serial_tokens_per_sec": round(serial_warm_tps, 1),
@@ -474,6 +503,9 @@ def main():
         "conc4_tokens_per_sec": conc4["tokens_per_sec"],
     }
     print(json.dumps(out))
+    if cli.json:
+        with open(cli.json, "w") as f:
+            json.dump(out, f, indent=1, sort_keys=True)
     return 0
 
 

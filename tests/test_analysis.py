@@ -436,6 +436,74 @@ def test_vm402_skipped_on_subset_scans(tmp_path):
     assert "VM401" in rules              # per-file check still on
 
 
+def test_vm4xx_covers_perf_observability_names(tmp_path):
+    """The deep-performance metric family (memory ledger, goodput/MFU,
+    SLO burn, profiler) rides the same VM4xx contract as the serving
+    metrics: registered+documented names pass, an undocumented
+    registration of one fires VM401, a documented ghost fires VM402."""
+    _write(tmp_path, "__init__.py", "")
+    _write(tmp_path, "mod.py", """\
+        def setup(reg):
+            reg.gauge("vt_hbm_bytes_in_use", "documented")
+            reg.gauge("vt_train_mfu", "documented")
+            reg.gauge("vt_decode_mbu", "documented")
+            reg.gauge("vt_slo_burn_rate", "documented",
+                      labels=("slo",))
+            reg.counter("vt_profile_captures_total", "documented")
+            reg.gauge("vt_memory_headroom_slots", "nobody wrote me up")
+        """)
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "observability.md").write_text(
+        "| `vt_hbm_bytes_in_use` | gauge |\n"
+        "| `vt_train_mfu` | gauge |\n"
+        "| `vt_decode_mbu` | gauge |\n"
+        "| `vt_slo_burn_rate` | gauge |\n"
+        "| `vt_profile_captures_total` | counter |\n"
+        "| `vt_hbm_bytes_limit` | gauge | documented, registered "
+        "nowhere in this fixture |\n")
+    found = _lint(tmp_path, docs_dir=str(docs))
+    vm401 = [f for f in found if f.rule == "VM401"]
+    vm402 = [f for f in found if f.rule == "VM402"]
+    assert len(vm401) == 1
+    assert "vt_memory_headroom_slots" in vm401[0].message
+    assert len(vm402) == 1
+    assert "vt_hbm_bytes_limit" in vm402[0].message
+
+
+def test_perf_observability_modules_stay_host_side():
+    """Guard: the memory poller / SLO ring / profiler layer is host
+    code — no trace roots are declared in those modules, the analyzer
+    finds nothing in them, and the engine's traced program builders
+    never reference the observability layer (a thread or time.sleep
+    leaking into a compiled program would be a silent perf bug the
+    flat compile counters can't see)."""
+    import ast
+    for mod in ("runtime/memory.py", "runtime/slo.py",
+                "runtime/profiler.py"):
+        assert not TRACE_ROOTS.get(mod), mod
+        path = os.path.join(REPO, "veles_tpu", mod)
+        assert not analyze_files(iter_python_files([path])), mod
+    # the traced-scope builders in engine/generate must not pull the
+    # host observability layer into program scope
+    banned = re.compile(
+        r"\b(memory_monitor|slo_tracker|profiler|tree_bytes"
+        r"|HistogramWindow)\b")
+    for mod, roots in TRACE_ROOTS.items():
+        if not roots:
+            continue
+        path = os.path.join(REPO, "veles_tpu", mod)
+        tree = ast.parse(open(path).read())
+        wanted = set()
+        for q in roots:
+            wanted.add(q.split(".")[-1])
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node.name in wanted:
+                src = ast.get_source_segment(open(path).read(), node)
+                assert not banned.search(src or ""), (mod, node.name)
+
+
 def test_vm4xx_noop_without_observability_md(tmp_path):
     _write(tmp_path, "mod.py", """\
         def setup(reg):

@@ -1,0 +1,506 @@
+"""Deep performance observability (docs/observability.md): the memory
+ledger's aval-exact byte counts and /memory.json, goodput/MFU
+arithmetic, rolling SLO windows (ring rotation + windowed quantiles vs
+a numpy reference, burn-rate /ready degradation), the on-demand
+profiler endpoint lifecycle, scheduler-tick gauge freshness, and the
+acceptance bar: StepCache compile counters FLAT on a live engine with
+memory accounting, SLO windows and MFU instrumentation all enabled."""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import veles_tpu as vt
+from veles_tpu.config import root
+from veles_tpu.runtime.benchmark import (epoch_goodput, mfu_fraction,
+                                         resolve_peak_tflops)
+from veles_tpu.runtime.memory import memory_monitor, tree_bytes
+from veles_tpu.runtime.metrics import (DEFAULT_BUCKETS, HistogramWindow,
+                                       MetricsRegistry, fraction_over,
+                                       registry)
+from veles_tpu.runtime.restful import RestfulServer
+from veles_tpu.runtime.slo import (SloTracker, reset_slo_tracker,
+                                   slo_tracker)
+from veles_tpu.runtime.status import StatusReporter, StatusServer
+
+V = 12
+T = 6
+
+
+def _lm(seed=3, name="perf_obs_lm"):
+    from veles_tpu.models.standard import build_workflow
+    from veles_tpu.ops import optimizers as opt
+    wf = build_workflow(name, [
+        {"type": "embedding", "vocab": V, "dim": 16, "name": "emb"},
+        {"type": "attention", "n_heads": 2, "rope": True,
+         "residual": True, "name": "a1"},
+        {"type": "seq_last", "name": "last"},
+        {"type": "softmax", "output_size": V, "name": "out"},
+    ])
+    wf.build({"@input": vt.Spec((2, T), jnp.int32),
+              "@labels": vt.Spec((2,), jnp.int32),
+              "@mask": vt.Spec((2,), jnp.float32)})
+    ws = wf.init_state(jax.random.key(seed), opt.SGD(0.1))
+    return wf, ws
+
+
+def _bucket_width(edges, value):
+    """Width of the histogram bucket ``value`` lands in — the agreed
+    quantile-vs-numpy tolerance."""
+    prev = 0.0
+    for e in edges:
+        if value <= e:
+            return e - prev
+        prev = e
+    return float("inf")
+
+
+# -- component ledger: exact aval-derived bytes ------------------------------
+
+def test_tree_bytes_matches_numpy_arithmetic(rng):
+    tree = {"a": np.zeros((3, 5), np.float32),
+            "b": {"c": jnp.zeros((7,), jnp.int32),
+                  "d": jax.ShapeDtypeStruct((2, 2, 2), jnp.bfloat16)},
+            "e": 1.5}
+    # 3*5*4 + 7*4 + 8*2 + 8 (python float -> f64 scalar)
+    assert tree_bytes(tree) == 60 + 28 + 16 + 8
+
+
+def test_memory_json_engine_components_exact_on_cpu(rng):
+    """The acceptance criterion: /memory.json component bytes equal the
+    hand-computed shape*itemsize expectation exactly on CPU."""
+    from veles_tpu.runtime.engine import DecodeEngine
+    wf, ws = _lm()
+    eng = DecodeEngine(wf, ws, slots=2, l_max=32, page_size=16)
+    try:
+        # geometry: n_ptab = 32/16 = 2, pages = slots*n_ptab = 4, pool
+        # rows = pages + 1 (scratch).  One attention unit, n_kv_heads=2,
+        # head dim 16/2 = 8: k and v are (5, 16, 2, 8) f32 each.
+        kv_expect = 2 * (5 * 16 * 2 * 8) * 4
+        # slot state: token rows (2, 32) i32 + page table (2, 2) i32
+        slot_expect = 2 * 32 * 4 + 2 * 2 * 4
+        # params: independent numpy walk over the live arrays
+        params_expect = sum(
+            int(np.prod(np.shape(leaf))) * np.dtype(leaf.dtype).itemsize
+            for leaf in jax.tree.leaves(ws["params"]))
+        st = eng.stats()
+        assert st["memory"]["kv_cache"] == kv_expect
+        assert st["memory"]["slot_state"] == slot_expect
+        assert st["memory"]["params"] == params_expect
+        assert st["memory"]["headroom_slots"] == 2    # idle engine
+
+        rep_dir = os.environ.get("TMPDIR", "/tmp")
+        rep = StatusReporter(os.path.join(rep_dir, "mem_status.json"))
+        srv = StatusServer(rep).start()
+        try:
+            doc = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/memory.json").read())
+        finally:
+            srv.stop()
+        assert doc["components"]["engine.kv_cache"] == kv_expect
+        assert doc["components"]["engine.slot_state"] == slot_expect
+        assert doc["components"]["engine.params"] == params_expect
+        assert doc["component_total_bytes"] == sum(
+            doc["components"].values())
+        assert doc["engine"]["pages"] == 4
+        # CPU backends report no memory_stats: device is null, never a
+        # made-up number
+        assert doc["device"] is None or "bytes_in_use" in doc["device"]
+    finally:
+        eng.stop()
+
+
+# -- goodput / MFU arithmetic ------------------------------------------------
+
+def test_mfu_arithmetic_known_flops_fake_clock():
+    """Known flops over a fake-clock wall: the MFU fraction is pure
+    arithmetic with no hidden denominators."""
+    # 1 GFLOP/step x 10 steps over 2.0s = 5 GFLOP/s; peak 2 TFLOPS
+    g = epoch_goodput(1e9, 10, 2.0, peak_tflops=2.0)
+    assert g["flops_per_sec"] == pytest.approx(5e9)
+    assert g["mfu"] == pytest.approx(5e9 / 2e12)
+    assert g["peak_tflops"] == 2.0
+    # unknown anything -> 0, never a fake number
+    assert mfu_fraction(0.0, 1.0, 2.0) == 0.0
+    assert mfu_fraction(1e9, 0.0, 2.0) == 0.0
+    assert mfu_fraction(1e9, 1.0, 0.0) == 0.0
+    assert epoch_goodput(1e9, 0, 1.0, peak_tflops=1.0)["mfu"] == 0.0
+
+
+def test_resolve_peak_tflops_config_override():
+    old = root.common.observe.get("peak_tflops", 0.0)
+    try:
+        root.common.observe.peak_tflops = 3.5
+        assert resolve_peak_tflops() == 3.5
+        root.common.observe.peak_tflops = 0.0
+        assert resolve_peak_tflops() >= 0.0   # DB-or-unknown fallback
+    finally:
+        root.common.observe.peak_tflops = old
+
+
+def test_trainer_reports_mfu_and_memory_components(rng):
+    """End to end on a tiny run: the train program's cost analysis
+    feeds vt_train_flops_per_sec / vt_train_mfu (against the config
+    peak override) and the trainer registers its exact params/opt_state
+    ledger entries."""
+    from veles_tpu.loader.base import TRAIN, VALID
+    from veles_tpu.units import (All2AllSoftmax, All2AllTanh,
+                                 EvaluatorSoftmax, Workflow)
+    lab = rng.integers(0, 3, 64).astype(np.int32)
+    d = rng.standard_normal((64, 8)).astype(np.float32)
+    loader = vt.ArrayLoader({TRAIN: d, VALID: d[:16]},
+                            {TRAIN: lab, VALID: lab[:16]},
+                            minibatch_size=16)
+    wf = Workflow("mfu")
+    wf.add(All2AllTanh(16, name="fc1"))
+    wf.add(All2AllSoftmax(3, name="out", inputs=("fc1",)))
+    wf.add(EvaluatorSoftmax(name="ev", inputs=("out", "@labels",
+                                               "@mask")))
+    old = root.common.observe.get("peak_tflops", 0.0)
+    try:
+        root.common.observe.peak_tflops = 1.0   # known denominator
+        tr = vt.Trainer(wf, loader, vt.optimizers.SGD(0.05),
+                        vt.Decision(max_epochs=2))
+        tr.initialize(seed=0)
+        results = tr.run()
+    finally:
+        root.common.observe.peak_tflops = old
+    assert results["train_step_flops"] > 0     # XLA cost analysis ran
+    assert results["peak_tflops"] == 1.0
+    assert results["train_mfu"] > 0
+    reg = registry()
+    assert reg.get("vt_train_flops_per_sec").value > 0
+    assert reg.get("vt_train_mfu").value == pytest.approx(
+        results["train_mfu"], rel=1e-6)
+    comp = memory_monitor().components()
+    assert comp["train.params"] == tree_bytes(tr.wstate["params"])
+    assert comp["train.opt_state"] == tree_bytes(tr.wstate["opt_state"])
+    # prefetch staging = depth x batch bytes from the batch spec
+    assert comp["train.prefetch_staging"] == \
+        tr.prefetch * tree_bytes(tr._batch_spec)
+    # the program-cost gauges carry the same numerator
+    flops = reg.get("vt_program_flops")
+    assert flops is not None
+
+
+# -- rolling SLO windows -----------------------------------------------------
+
+def test_histogram_window_rotation_and_quantiles_vs_numpy(rng):
+    """Ring rotation: samples older than the window rotate OUT, the
+    windowed quantile matches numpy on exactly the in-window samples
+    (within one bucket width), and the ring stays bounded."""
+    reg = MetricsRegistry(label_cap=8)
+    edges = tuple(np.linspace(0.05, 1.0, 20))
+    h = reg.histogram("vt_t_win_seconds", "t", buckets=edges)
+    t = [0.0]
+    w = HistogramWindow(lambda: h, window_s=60.0, slices=6,
+                        clock=lambda: t[0])
+    w.tick()                 # baseline snapshot precedes the samples
+    # (the live engine's scheduler tick provides this continuously)
+    old_batch = rng.uniform(0.0, 1.0, 500)
+    for v in old_batch:
+        h.observe(float(v))
+    t[0] = 1.0
+    # inside the window the old batch is visible
+    _h, _pairs, count, _s = w.delta()
+    assert count == len(old_batch)
+    q99 = w.quantile(0.99)
+    ref = float(np.percentile(old_batch, 99))
+    assert abs(q99 - ref) <= _bucket_width(edges, ref) + 1e-9
+    # advance past the window, rotating every slice (10s)
+    for _ in range(8):
+        t[0] += 10.0
+        w.tick()
+    assert len(w._ring) <= w.slices + 1          # ring stays bounded
+    new_batch = rng.uniform(0.0, 0.3, 400)
+    for v in new_batch:
+        h.observe(float(v))
+    t[0] += 1.0
+    _h, _pairs, count, _s = w.delta()
+    assert count == len(new_batch)               # old batch rotated out
+    for q in (0.5, 0.95, 0.99):
+        est = w.quantile(q)
+        ref = float(np.percentile(new_batch, 100 * q))
+        assert abs(est - ref) <= _bucket_width(edges, ref) + 1e-9, q
+
+
+def test_fraction_over_matches_numpy(rng):
+    reg = MetricsRegistry(label_cap=8)
+    edges = tuple(np.linspace(0.1, 2.0, 20))
+    h = reg.histogram("vt_t_frac_seconds", "t", buckets=edges)
+    values = rng.uniform(0.0, 2.0, 3000)
+    for v in values:
+        h.observe(float(v))
+    pairs = h._default().cumulative()
+    # on a bucket EDGE the cumulative count is exact
+    assert fraction_over(pairs, 1.0) == pytest.approx(
+        float(np.mean(values > 1.0)), abs=1e-9)
+    # inside a bucket: within the bucket's share of mass
+    est = fraction_over(pairs, 0.77)
+    ref = float(np.mean(values > 0.77))
+    assert abs(est - ref) <= 0.05
+
+
+def test_slo_doc_p99_vs_numpy_and_burn_rate(rng):
+    """The acceptance criterion: /slo.json p99 TTFT over the window
+    agrees with a numpy quantile over the same recorded samples to
+    within one histogram bucket; burn rate is the exact budget ratio on
+    a bucket-edge target."""
+    reg = registry()
+    h = reg.histogram("vt_request_ttft_seconds", "ttft view",
+                      labels=("bucket",))
+    t = [1000.0]
+    tr = SloTracker(window_s=30.0, slices=6,
+                    targets_ms={"ttft": 100.0},   # 0.1s: a bucket edge
+                    burn_threshold=2.0, clock=lambda: t[0])
+    tr.tick()                    # baseline BEFORE the samples
+    samples = rng.uniform(0.001, 2.0, 600)
+    for v in samples:
+        h.labels(bucket=16).observe(float(v))
+    t[0] += 1.0                  # still inside the first slice
+    doc = tr.doc()
+    m = doc["metrics"]["ttft"]
+    assert m["count"] == len(samples)
+    p99 = m["p99_ms"] / 1e3
+    ref = float(np.percentile(samples, 99))
+    assert abs(p99 - ref) <= _bucket_width(DEFAULT_BUCKETS, ref) + 1e-9
+    # burn: target sits on a bucket edge, so frac-over is exact
+    frac = float(np.mean(samples > 0.1))
+    assert m["frac_over_target"] == pytest.approx(frac, abs=1e-6)
+    assert m["burn_rate"] == pytest.approx(frac / 0.01, rel=1e-4)
+    assert m["burning"] and doc["burning"]
+    # a bare /metrics scrape sees the burn: tick() refreshes the gauge
+    # on ring rotation without anything reading /slo.json
+    g = registry().get("vt_slo_burn_rate")
+    g.labels(slo="ttft").set(-1.0)           # poison, then rotate
+    t[0] += tr.windows["ttft"].slice_s + 0.01
+    tr.tick()
+    assert g.labels(slo="ttft").value >= 0.0
+
+
+def test_slo_degrade_ready_flips_readiness():
+    """With observe.slo.degrade_ready on and a burning window, /ready
+    goes 503; with degradation off (default) a burning SLO never
+    touches readiness."""
+    reg = registry()
+    h = reg.histogram("vt_request_ttft_seconds", "ttft view",
+                      labels=("bucket",))
+    slo_cfg = root.common.observe.slo
+    old = {k: slo_cfg.get(k) for k in
+           ("degrade_ready", "ttft_p99_ms")}
+    srv = RestfulServer(lambda w, b: None, {}, 1, (1,))
+    try:
+        root.common.observe.slo.degrade_ready = True
+        root.common.observe.slo.ttft_p99_ms = 1.0   # 1ms: all over
+        reset_slo_tracker()
+        tr = slo_tracker()
+        tr.tick()                          # baseline
+        for _ in range(20):
+            h.labels(bucket=16).observe(0.5)
+        assert tr.burning()
+        ok, why = srv.readiness()
+        assert not ok and "slo" in why
+        # flip degradation off: burning stays visible in /slo.json but
+        # readiness recovers
+        root.common.observe.slo.degrade_ready = False
+        ok, why = srv.readiness()
+        assert ok
+    finally:
+        srv.httpd.server_close()
+        root.common.observe.slo.degrade_ready = old["degrade_ready"] \
+            if old["degrade_ready"] is not None else False
+        root.common.observe.slo.ttft_p99_ms = old["ttft_p99_ms"] \
+            if old["ttft_p99_ms"] is not None else 0.0
+        reset_slo_tracker()
+
+
+# -- on-demand profiler capture ----------------------------------------------
+
+def test_profiler_endpoint_lifecycle(tmp_path):
+    """Capture -> files exist on disk -> a second POST mid-capture
+    answers 409 -> after completion the next capture succeeds again."""
+    old = root.common.observe.get("profile_dir", "")
+    rep = StatusReporter(str(tmp_path / "status.json"), name="prof")
+    rep.update(epoch=0)
+    srv = StatusServer(rep).start()
+    url = f"http://127.0.0.1:{srv.port}"
+    try:
+        root.common.observe.profile_dir = str(tmp_path / "profs")
+
+        def post(dur):
+            return urllib.request.urlopen(urllib.request.Request(
+                url + "/debug/profile",
+                json.dumps({"duration_s": dur}).encode(),
+                {"Content-Type": "application/json"}))
+
+        res = {}
+
+        def bg():
+            res["doc"] = json.loads(post(1.0).read())
+
+        t = threading.Thread(target=bg)
+        t.start()
+        # wait for the capture to actually hold the single-flight lock,
+        # then the second POST deterministically answers 409
+        from veles_tpu.runtime.profiler import profiler
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and not profiler().active:
+            time.sleep(0.01)
+        assert profiler().active, "capture never started"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post(0.05)
+        assert ei.value.code == 409
+        assert "error" in json.loads(ei.value.read())
+        t.join()
+        doc = res["doc"]
+        assert os.path.isdir(doc["path"])
+        assert doc["files"] >= 1                 # trace files landed
+        assert doc["path"].startswith(str(tmp_path / "profs"))
+        # single-flight released: the next capture succeeds
+        doc2 = json.loads(post(0.05).read())
+        assert os.path.isdir(doc2["path"]) and doc2["path"] != doc["path"]
+        # the status page links the last capture path
+        page = urllib.request.urlopen(url).read().decode()
+        assert "last profile" in page
+        assert "/slo.json" in page and "/memory.json" in page
+        # ingress cap: an oversized Content-Length is refused BEFORE
+        # the body is read (the restful.py 413 posture on this port)
+        import http.client
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                          timeout=10)
+        conn.putrequest("POST", "/debug/profile")
+        conn.putheader("Content-Length", str(10 ** 12))
+        conn.endheaders()
+        assert conn.getresponse().status == 413
+        conn.close()
+    finally:
+        srv.stop()
+        root.common.observe.profile_dir = old
+
+
+# -- gauge freshness on the scheduler tick -----------------------------------
+
+def test_engine_gauges_fresh_without_stats_polling(rng):
+    """A bare /metrics scrape must see live occupancy/queue gauges even
+    when nothing ever calls stats() or GET /engine — the scheduler tick
+    publishes them (satellite: they used to update only inside
+    stats())."""
+    from veles_tpu.runtime.engine import DecodeEngine
+    wf, ws = _lm(seed=5, name="fresh_lm")
+    eng = DecodeEngine(wf, ws, slots=2, l_max=64, window_ms=1.0).start()
+    g_occ = registry().get("vt_engine_occupancy")
+    try:
+        p = rng.integers(0, V, 3).astype(np.int32)
+        req = eng.submit(p, 55)          # long enough to observe live
+        deadline = time.monotonic() + 30
+        seen_busy = False
+        while time.monotonic() < deadline and not seen_busy:
+            seen_busy = g_occ.value >= 1
+            time.sleep(0.01)
+        assert seen_busy, "occupancy gauge never went live"
+        assert req.done.wait(120) and req.error is None
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and g_occ.value != 0:
+            time.sleep(0.01)
+        assert g_occ.value == 0          # and back down, same channel
+        assert registry().get("vt_engine_queue_depth").value == 0
+        assert registry().get("vt_memory_headroom_slots").value == 2
+        # idle decay: with no decode step for >2s the bandwidth gauge
+        # drops to 0 instead of freezing at the last load's value
+        g_bw = registry().get("vt_decode_bandwidth_bytes_per_sec")
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and g_bw.value != 0:
+            time.sleep(0.1)
+        assert g_bw.value == 0
+    finally:
+        eng.stop()
+
+
+# -- acceptance: compile counters flat with everything enabled ---------------
+
+def test_compile_flat_with_memory_slo_mfu_enabled(rng, tmp_path):
+    """THE acceptance bar: a live engine under concurrent load with
+    memory accounting, SLO windows, goodput gauges and an on-demand
+    profiler capture all active compiles NOTHING new — instrumentation
+    is host-side only."""
+    from veles_tpu.runtime.engine import DecodeEngine
+    wf, ws = _lm(seed=7, name="flat_lm")
+    eng = DecodeEngine(wf, ws, slots=2, l_max=64, window_ms=1.0)
+    srv = RestfulServer(wf.make_predict_step("out"), ws, 2, (T,),
+                        workflow=wf, engine=eng).start()
+    shapes = [(3, 4), (7, 3), (11, 5), (5, 2)]
+    url = f"http://127.0.0.1:{srv.port}"
+    old_dir = root.common.observe.get("profile_dir", "")
+    root.common.observe.profile_dir = str(tmp_path / "profs")
+    try:
+        for p, n in shapes:              # warm every bucket
+            body = json.dumps({
+                "prompt": rng.integers(0, V, (1, p)).tolist(),
+                "steps": n}).encode()
+            urllib.request.urlopen(urllib.request.Request(
+                url + "/generate", body,
+                {"Content-Type": "application/json"})).read()
+        compiles0 = eng.stats()["compile"]["compiles"]
+
+        errs = []
+
+        def client(i):
+            p, n = shapes[i % len(shapes)]
+            body = json.dumps({
+                "prompt": rng.integers(0, V, (1, p)).tolist(),
+                "steps": n}).encode()
+            try:
+                urllib.request.urlopen(urllib.request.Request(
+                    url + "/generate", body,
+                    {"Content-Type": "application/json"}),
+                    timeout=120).read()
+            except Exception as e:  # noqa: BLE001
+                errs.append(repr(e))
+
+        def observer():
+            try:
+                urllib.request.urlopen(url + "/slo.json").read()
+                urllib.request.urlopen(url + "/memory.json").read()
+                urllib.request.urlopen(url + "/metrics").read()
+                urllib.request.urlopen(urllib.request.Request(
+                    url + "/debug/profile",
+                    json.dumps({"duration_s": 0.2}).encode(),
+                    {"Content-Type": "application/json"})).read()
+            except urllib.error.HTTPError as e:
+                if e.code != 409:        # a concurrent capture is fine
+                    errs.append(repr(e))
+            except Exception as e:  # noqa: BLE001
+                errs.append(repr(e))
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(10)]
+        threads.append(threading.Thread(target=observer))
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=180)
+        assert not errs, errs
+
+        st = eng.stats()
+        assert st["compile"]["compiles"] == compiles0
+        assert st["compile"]["recompiles"] == 0
+        # the instrumentation itself carried data
+        slo = json.loads(urllib.request.urlopen(
+            url + "/slo.json").read())
+        assert slo["metrics"]["ttft"]["count"] >= 10
+        mem = json.loads(urllib.request.urlopen(
+            url + "/memory.json").read())
+        assert mem["components"]["engine.kv_cache"] > 0
+        assert st["goodput"]["decode_step_bytes"] > 0
+        assert st["goodput"]["decode_bandwidth_bytes_per_sec"] > 0
+    finally:
+        root.common.observe.profile_dir = old_dir
+        srv.stop()

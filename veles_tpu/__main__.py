@@ -489,7 +489,12 @@ def _run_serve_loop(args, srv, banner: dict, *, status=None,
         deploy.start_watcher()
     print(json.dumps(dict(banner, serving=srv.port,
                           model_dir=deploy.model_dir,
-                          watching=deploy.watching)), flush=True)
+                          watching=deploy.watching,
+                          # the deep-observability surface riding every
+                          # serve boot (docs/observability.md)
+                          observe=["/metrics", "/trace.json",
+                                   "/slo.json", "/memory.json",
+                                   "/debug/profile"])), flush=True)
     try:
         deploy.wait()  # released by SIGTERM / POST /admin/drain
     except KeyboardInterrupt:

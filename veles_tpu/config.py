@@ -282,6 +282,29 @@ def _defaults():
     #                                          GET /trace.json / --trace-out
     root.common.observe.status_flush_s = 0.25  # min interval between
     #                                            status.json event flushes
+    # Deep performance observability (docs/observability.md: memory
+    # ledger, goodput/MFU, rolling SLO windows, profiler endpoint).
+    root.common.observe.peak_tflops = 0.0    # measured peak for MFU; 0 =
+    #                                          use runtime/benchmark.py's
+    #                                          cached GEMM calibration
+    root.common.observe.peak_hbm_gbps = 0.0  # HBM bandwidth peak for the
+    #                                          decode MBU gauge (0 = MBU
+    #                                          reported as 0 / unknown)
+    root.common.observe.memory_poll_s = 2.0  # device memory_stats() poll
+    #                                          period (0 = no poller)
+    root.common.observe.slo.window_s = 60.0  # rolling SLO window length
+    root.common.observe.slo.slices = 12      # bucket-snapshot ring slices
+    root.common.observe.slo.ttft_p99_ms = 0.0       # p99 TTFT target
+    #                                                 (0 = no target)
+    root.common.observe.slo.queue_wait_p99_ms = 0.0  # p99 queue-wait
+    #                                                  target (0 = none)
+    root.common.observe.slo.burn_threshold = 2.0  # burn rate at/above
+    #                                               which the SLO "burns"
+    root.common.observe.slo.degrade_ready = False  # /ready 503s on
+    #                                                sustained burn
+    root.common.observe.profile_dir = ""     # POST /debug/profile capture
+    #                                          dir ("" = cache_dir/profiles)
+    root.common.observe.profile_max_s = 30.0  # per-capture duration cap
     root.common.random_seed = 42
     root.common.platform = ""                # "" = let JAX pick
     root.common.mesh = dict(data=-1)          # -1: all remaining devices

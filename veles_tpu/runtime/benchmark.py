@@ -98,6 +98,59 @@ class DeviceBenchmark(Logger):
         return 1000.0 / best["seconds"]
 
 
+def resolve_peak_tflops() -> float:
+    """The peak-flops denominator for MFU (docs/observability.md
+    "Goodput & MFU"): the ``root.common.observe.peak_tflops`` override
+    when set, else the best rate this device kind ever measured in the
+    GEMM calibration DB (:func:`benchmark_device` persists it), else
+    0.0 — "unknown", which every MFU consumer reports as 0 rather than
+    inventing a denominator.  Never triggers a measurement itself: an
+    MFU gauge must not cost a multi-second GEMM sweep mid-serve."""
+    override = float(root.common.observe.get("peak_tflops", 0.0) or 0.0)
+    if override > 0:
+        return override
+    try:
+        import jax
+        kind = jax.devices()[0].device_kind
+    except Exception:
+        return 0.0
+    info = load_device_infos().get(kind) or {}
+    rates = [float(e.get("tflops", 0.0))
+             for e in info.get("results", ()) or ()]
+    return max(rates) if rates else 0.0
+
+
+def mfu_fraction(flops: float, wall_s: float, peak_tflops: float) -> float:
+    """Model FLOPs utilization: achieved flops/s over the measured peak.
+    0.0 whenever any input is unknown/degenerate — an MFU of 0 reads as
+    "not measured", never as a fake 100%."""
+    if flops <= 0 or wall_s <= 0 or peak_tflops <= 0:
+        return 0.0
+    return (flops / wall_s) / (peak_tflops * 1e12)
+
+
+def epoch_goodput(flops_per_step: float, steps: float, wall_s: float,
+                  peak_tflops: Optional[float] = None) -> Dict:
+    """Goodput arithmetic for one training epoch, factored pure so the
+    MFU math is testable with known flops and a fake clock's wall time:
+    achieved flops/s over whatever wall the caller passes, and MFU
+    against the measured peak.  The Trainer passes the TRAIN-phase wall
+    (loader data waits included; eval and snapshot phases excluded —
+    the ``vt_train_phase_seconds`` histogram breaks those out)."""
+    if peak_tflops is None:
+        peak_tflops = resolve_peak_tflops()
+    total = float(flops_per_step) * float(steps)
+    fps = total / wall_s if wall_s > 0 and total > 0 else 0.0
+    return {
+        "flops_per_step": float(flops_per_step),
+        "steps": float(steps),
+        "wall_s": float(wall_s),
+        "flops_per_sec": fps,
+        "peak_tflops": float(peak_tflops),
+        "mfu": mfu_fraction(total, wall_s, peak_tflops),
+    }
+
+
 def device_info_path(cache_dir: Optional[str] = None) -> str:
     d = cache_dir or root.common.cache_dir
     return os.path.join(d, "device_infos.json")

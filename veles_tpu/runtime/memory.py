@@ -1,0 +1,253 @@
+"""Memory accounting: HBM gauges + a deterministic component ledger.
+
+Every capacity question on the ROADMAP — can quantization double
+slots-per-chip, does ZeRO-style weight-update sharding make the
+optimizer state fit, how many more requests does the page pool hold —
+starts with "how much HBM is in use and what is it".  Two layers answer
+it (docs/observability.md "Memory ledger"):
+
+* **device truth** — ``device.memory_stats()`` where the backend
+  provides it (TPU/GPU; CPU backends usually return nothing), sampled
+  into ``vt_hbm_bytes_{in_use,peak,limit}`` by an optional poller
+  thread (``root.common.observe.memory_poll_s``) and on every
+  ``GET /memory.json``;
+* **component ledger** — deterministic, CPU-testable byte counts
+  computed from avals (shape x itemsize, :func:`tree_bytes`): the
+  engine registers its params / KV page pool / slot state, the Trainer
+  its params / opt_state / prefetch staging.  The ledger is what the
+  device number decomposes INTO — the gap between the two is XLA
+  workspace + fragmentation, which is exactly the quantity an operator
+  needs named before trusting a "it should fit" estimate.
+
+Everything here is host-side (no trace roots; the analyzer's VT103 rule
+keeps it that way) — accounting never touches a compiled program.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..config import root
+from ..logger import Logger
+from .metrics import registry
+
+
+def tree_bytes(tree) -> int:
+    """Exact payload bytes of a pytree of arrays / ShapeDtypeStructs /
+    scalars: sum of ``prod(shape) * dtype.itemsize`` per leaf.  Works on
+    avals — no device sync, no materialization — which is what makes
+    the ledger CPU-testable and identical on every backend."""
+    import jax
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        shape = getattr(leaf, "shape", None)
+        if shape is None:
+            shape = np.shape(leaf)
+        dtype = getattr(leaf, "dtype", None)
+        if dtype is None:
+            dtype = np.asarray(leaf).dtype
+        total += int(np.prod(shape, dtype=np.int64)) * np.dtype(dtype).itemsize
+    return total
+
+
+class MemoryMonitor(Logger):
+    """Process-wide memory view: device HBM gauges + the component
+    ledger, one instance behind :func:`memory_monitor` (components are
+    registered by whichever engine/trainer lives in the process; the
+    newest registration of a name wins, matching every other
+    process-global gauge here)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._components: Dict[str, int] = {}  # guarded-by: self._lock
+        self._stamps: Dict[str, int] = {}      # guarded-by: self._lock
+        self._next_stamp = 0                   # guarded-by: self._lock
+        self._extras: Dict[str, object] = {}   # guarded-by: self._lock
+        self._poller: Optional[threading.Thread] = None  # guarded-by: self._lock
+        self._last_device: Optional[dict] = None  # guarded-by: self._lock
+        reg = registry()
+        self._g_in_use = reg.gauge(
+            "vt_hbm_bytes_in_use",
+            "device memory in use, summed over local devices "
+            "(device.memory_stats(); absent backends report nothing)")
+        self._g_peak = reg.gauge(
+            "vt_hbm_bytes_peak",
+            "peak device memory in use since process start, summed over "
+            "local devices")
+        self._g_limit = reg.gauge(
+            "vt_hbm_bytes_limit",
+            "device memory capacity, summed over local devices")
+        self._g_comp = reg.gauge(
+            "vt_memory_component_bytes",
+            "aval-derived byte ledger by component (engine params / KV "
+            "page pool / slot state, trainer params / opt_state / "
+            "prefetch staging)", labels=("component",))
+
+    # -- component ledger ---------------------------------------------------
+    def set_component(self, name: str, nbytes: int) -> int:
+        """Publish one ledger entry; returns a registration stamp the
+        owner passes back to :meth:`drop_component` so a dying OLD
+        registrant (a replaced engine being GC'd) can never clobber the
+        entry a newer one wrote under the same name.  The gauge write
+        happens under the same lock as the stamp, so the ledger and
+        ``vt_memory_component_bytes`` can never diverge across a
+        drop/re-register race."""
+        nbytes = int(nbytes)
+        with self._lock:
+            self._components[name] = nbytes
+            self._next_stamp += 1
+            stamp = self._stamps[name] = self._next_stamp
+            self._g_comp.labels(component=name).set(nbytes)
+        return stamp
+
+    def drop_component(self, name: str,
+                       stamp: Optional[int] = None) -> None:
+        """Remove a ledger entry — called when its owner's buffers are
+        actually released (engines/trainers hook this on finalization).
+        With ``stamp`` the drop only applies if the entry still belongs
+        to that registration (gauge write under the lock: see
+        :meth:`set_component`)."""
+        with self._lock:
+            if stamp is not None and self._stamps.get(name) != stamp:
+                return
+            self._components.pop(name, None)
+            self._stamps.pop(name, None)
+            self._g_comp.labels(component=name).set(0)
+
+    def set_extra(self, name: str, value) -> int:
+        """Free-form JSON-able annotations shipped in /memory.json next
+        to the ledger (the engine's pool geometry).  Stamped like
+        components so a freed owner's finalizer retires its own extras
+        without clobbering a newer registrant's."""
+        with self._lock:
+            self._extras[name] = value
+            self._next_stamp += 1
+            stamp = self._stamps["extra:" + name] = self._next_stamp
+        return stamp
+
+    def drop_extra(self, name: str, stamp: Optional[int] = None) -> None:
+        with self._lock:
+            if stamp is not None \
+                    and self._stamps.get("extra:" + name) != stamp:
+                return
+            self._extras.pop(name, None)
+            self._stamps.pop("extra:" + name, None)
+
+    def components(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._components)
+
+    # -- device truth -------------------------------------------------------
+    def sample_device(self) -> Optional[dict]:
+        """Sum ``memory_stats()`` over local devices into the HBM gauges;
+        None when no local device reports stats (typical CPU)."""
+        try:
+            import jax
+            devices = jax.local_devices()
+        except Exception:  # backend not initialized / unavailable
+            return None
+        in_use = peak = limit = 0
+        seen = False
+        for d in devices:
+            try:
+                stats = d.memory_stats()
+            except Exception:
+                stats = None
+            if not stats:
+                continue
+            seen = True
+            in_use += int(stats.get("bytes_in_use", 0))
+            peak += int(stats.get("peak_bytes_in_use", 0))
+            limit += int(stats.get("bytes_limit",
+                                   stats.get("bytes_reservable_limit", 0)))
+        if not seen:
+            return None
+        self._g_in_use.set(in_use)
+        self._g_peak.set(peak)
+        self._g_limit.set(limit)
+        doc = {"bytes_in_use": in_use, "peak_bytes_in_use": peak,
+               "bytes_limit": limit, "devices": len(devices)}
+        with self._lock:
+            self._last_device = doc
+        return doc
+
+    def ensure_poller(self, interval_s: Optional[float] = None) -> bool:
+        """Start the device-stats poller thread once (daemon; a no-op
+        when disabled by ``root.common.observe.memory_poll_s = 0`` or
+        when the backend reports no stats — a CPU run never spins a
+        useless thread).  Idempotent; returns whether a poller runs."""
+        if interval_s is None:
+            interval_s = float(root.common.observe.get("memory_poll_s", 2.0))
+        if interval_s <= 0 or self.sample_device() is None:
+            return False
+        with self._lock:
+            if self._poller is not None and self._poller.is_alive():
+                return True
+
+            def loop():
+                while True:
+                    time.sleep(interval_s)
+                    try:
+                        self.sample_device()
+                    except Exception:  # the poller must never die loudly
+                        pass
+
+            self._poller = threading.Thread(
+                target=loop, name="hbm-poll", daemon=True)
+            self._poller.start()
+        return True
+
+    # -- the /memory.json document ------------------------------------------
+    def doc(self) -> dict:
+        """One consistent JSON view: a fresh device sample (or the last
+        one, or null), the component ledger, and the annotations."""
+        device = self.sample_device()
+        with self._lock:
+            if device is None:
+                device = self._last_device
+            components = dict(self._components)
+            extras = dict(self._extras)
+        out = {
+            "device": device,
+            "components": components,
+            "component_total_bytes": sum(components.values()),
+        }
+        if device:
+            out["unattributed_bytes"] = max(
+                0, device["bytes_in_use"] - out["component_total_bytes"])
+        for k, v in extras.items():
+            if k not in out:    # extras never shadow the doc's own keys
+                out[k] = v
+        return out
+
+
+_MONITOR_LOCK = threading.Lock()
+_MONITOR: Optional[MemoryMonitor] = None  # guarded-by: _MONITOR_LOCK
+
+
+def memory_monitor() -> MemoryMonitor:
+    """THE process memory monitor (what ``GET /memory.json`` renders)."""
+    global _MONITOR
+    with _MONITOR_LOCK:
+        if _MONITOR is None:
+            _MONITOR = MemoryMonitor()
+        return _MONITOR
+
+
+def drop_stamped_components(stamps: Dict[str, int],
+                            extra_stamps: Optional[Dict[str, int]] = None
+                            ) -> None:
+    """Finalizer hook: drop the ledger entries (and extras) of one
+    registration — engines/trainers attach this via ``weakref.finalize``
+    so a freed object's bytes AND its geometry annotation leave
+    /memory.json; a newer registrant's entries survive the stamp
+    check."""
+    mon = memory_monitor()
+    for name, stamp in stamps.items():
+        mon.drop_component(name, stamp)
+    for name, stamp in (extra_stamps or {}).items():
+        mon.drop_extra(name, stamp)

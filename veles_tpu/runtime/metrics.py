@@ -327,6 +327,20 @@ class Histogram(_Metric):
     def quantile(self, q: float) -> float:
         return self._default().quantile(q)
 
+    def aggregate_snapshot(self) -> Tuple[List[int], float, int]:
+        """(per-bucket counts incl. +Inf, sum, count) summed across
+        every child series — the label-blind view a rolling SLO window
+        snapshots (children share one bucket layout by construction)."""
+        counts = [0] * (len(self.buckets) + 1)
+        total, n = 0.0, 0
+        for _key, child in self._snapshot():
+            c, s, cnt = child.snapshot()
+            for i, v in enumerate(c):
+                counts[i] += v
+            total += s
+            n += cnt
+        return counts, total, n
+
 
 class MetricsRegistry:
     """Named metrics in registration order.  Registration is
@@ -418,6 +432,95 @@ class MetricsRegistry:
                     lines.append(
                         f"{m.name}{suffix} {_fmt(child.value)}")
         return "\n".join(lines) + "\n"
+
+
+class HistogramWindow:
+    """Time-windowed view over a cumulative histogram: a bounded ring of
+    bucket snapshots taken at slice boundaries, so quantiles over "the
+    last ``window_s`` seconds" come out of the same fixed buckets the
+    since-boot series exposes (a cumulative histogram hides a fresh
+    regression behind hours of good history — the SLO problem,
+    docs/observability.md "Rolling SLO windows").
+
+    ``source`` is a zero-arg callable returning the Histogram (or None
+    before it is registered) — late binding keeps this module free of
+    any registration-order coupling.  Rotation is lazy: every read (or
+    an explicit :meth:`tick`) appends a snapshot once a slice elapsed,
+    so a cheap ticker — the decode scheduler tick, the SLO ticker
+    thread — keeps the ring honest and an idle process pays nothing.
+    ``clock`` is injectable for deterministic tests."""
+
+    def __init__(self, source, window_s: float, slices: int = 12,
+                 clock=time.monotonic):
+        self._source = source
+        self.window_s = max(float(window_s), 1e-9)
+        self.slices = max(int(slices), 1)
+        self.slice_s = self.window_s / self.slices
+        self._clock = clock
+        self._lock = threading.Lock()
+        # ring of (t, cumulative counts incl. +Inf, sum, count); one
+        # extra slot keeps a baseline just outside the window
+        self._ring: collections.deque = collections.deque(
+            maxlen=self.slices + 1)  # guarded-by: self._lock
+
+    def _snap(self):
+        hist = self._source()
+        if hist is None:
+            return None, [0], 0.0, 0
+        counts, total, n = hist.aggregate_snapshot()
+        return hist, counts, total, n
+
+    def tick(self) -> bool:
+        """Rotate if a slice boundary passed (idempotent; the no-op
+        path is one clock read + a deque peek).  Returns whether a
+        snapshot was appended — callers refresh derived gauges only on
+        rotation."""
+        now = self._clock()
+        with self._lock:
+            if self._ring and now - self._ring[-1][0] < self.slice_s:
+                return False
+            _hist, counts, total, n = self._snap()
+            self._ring.append((now, counts, total, n))
+            return True
+
+    def delta(self):
+        """(histogram, cumulative ``(le, count)`` pairs, count, sum) of
+        the observations inside the window: current state minus the
+        newest snapshot at least ``window_s`` old (or the oldest held —
+        a young ring covers less than the full window, never more)."""
+        self.tick()
+        now = self._clock()
+        hist, counts, total, n = self._snap()
+        if hist is None:
+            return None, [], 0, 0.0
+        base = None
+        with self._lock:
+            for t, c, s, cnt in self._ring:
+                if base is None or t <= now - self.window_s:
+                    base = (c, s, cnt)
+        bc, bs, bn = base if base is not None \
+            else ([0] * len(counts), 0.0, 0)
+        if len(bc) != len(counts):      # ring predates the registration
+            bc = [0] * len(counts)
+        pairs, acc = [], 0
+        for u, cur, old in zip(hist.buckets, counts, bc):
+            acc += cur - old
+            pairs.append((u, float(acc)))
+        pairs.append((float("inf"),
+                      float(acc + counts[-1] - bc[-1])))
+        return hist, pairs, n - bn, total - bs
+
+    def quantile(self, q: float) -> float:
+        _hist, pairs, _n, _s = self.delta()
+        return quantile_from_cumulative(pairs, q)
+
+    def summary(self, quantiles=(0.5, 0.95, 0.99)) -> dict:
+        """Windowed count / sum / quantiles in one consistent read."""
+        _hist, pairs, n, s = self.delta()
+        out = {"count": int(n), "sum": round(s, 6)}
+        for q in quantiles:
+            out[f"p{int(q * 100)}"] = quantile_from_cumulative(pairs, q)
+        return out
 
 
 class ScopedCounter:
@@ -599,6 +702,28 @@ def delta_buckets(before, after) -> List[Tuple[float, float]]:
     registry."""
     base = dict(before)
     return [(le, c - base.get(le, 0.0)) for le, c in after]
+
+
+def fraction_over(pairs, threshold: float) -> float:
+    """Fraction of observations above ``threshold`` from cumulative
+    ``(le, count)`` pairs, interpolating linearly inside the bucket the
+    threshold lands in (the same estimate the quantile helper inverts)
+    — the burn-rate numerator of the rolling SLO windows."""
+    pairs = sorted(pairs)
+    if not pairs or pairs[-1][1] <= 0:
+        return 0.0
+    total = pairs[-1][1]
+    prev_le, prev_c = 0.0, 0.0
+    for le, c in pairs:
+        if threshold <= le:
+            if le == float("inf"):
+                return (total - prev_c) / total
+            width = le - prev_le
+            frac = (threshold - prev_le) / width if width > 0 else 1.0
+            at = prev_c + frac * (c - prev_c)
+            return max(0.0, (total - at) / total)
+        prev_le, prev_c = le, c
+    return 0.0
 
 
 def quantile_from_cumulative(pairs, q: float) -> float:

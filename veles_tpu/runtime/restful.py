@@ -57,7 +57,10 @@ from ..config import root
 from ..logger import Logger
 from .artifact import ArtifactError
 from .engine import EngineOverloaded, EngineStopped, SchedulerCrashed
+from .memory import memory_monitor
 from .metrics import registry, span_ring
+from .profiler import serve_profile_post
+from .slo import slo_tracker
 from .snapshotter import SnapshotCorruptError
 
 
@@ -114,6 +117,18 @@ class RestfulServer(Logger):
                     self.end_headers()
                     self.wfile.write(body)
                     return
+                if path == "/slo.json":
+                    # rolling-window latency percentiles + burn rates
+                    # (runtime/slo.py) — "is the service meeting its
+                    # target NOW", which the since-boot histograms on
+                    # /metrics cannot answer
+                    self._reply(slo_tracker().doc())
+                    return
+                if path == "/memory.json":
+                    # device HBM truth + the aval-derived component
+                    # ledger (runtime/memory.py)
+                    self._reply(memory_monitor().doc())
+                    return
                 if path == "/trace.json":
                     # per-request serving timelines (queue-wait →
                     # prefill → decode) as Chrome-trace/Perfetto JSON;
@@ -149,6 +164,16 @@ class RestfulServer(Logger):
             def do_POST(self):
                 path = self.path.split("?", 1)[0].rstrip("/")
                 admin = path in ("/admin/reload", "/admin/drain")
+                if path == "/debug/profile":
+                    # duration-bounded on-demand jax.profiler capture:
+                    # the shared handler (runtime/profiler.py) owns the
+                    # ingress cap and the 409/400/500 mapping for both
+                    # servers; the handler blocks for the capture
+                    # (worker thread; other requests keep flowing)
+                    code, obj = serve_profile_post(self.headers,
+                                                   self.rfile)
+                    self._reply(obj, code=code)
+                    return
                 if path not in ("/predict", "/generate") and not admin:
                     self.send_error(404)
                     return
@@ -160,7 +185,10 @@ class RestfulServer(Logger):
                         code=404)
                     return
                 try:
-                    n = int(self.headers.get("Content-Length", 0))
+                    # negative Content-Length clamped: rfile.read(-1)
+                    # would block this thread until the client hangs up
+                    n = max(int(self.headers.get("Content-Length", 0)),
+                            0)
                     cap = int(float(root.common.serve.get(
                         "max_body_mb", 64)) * 2 ** 20)
                     if n > cap:
@@ -258,7 +286,10 @@ class RestfulServer(Logger):
         """(ready, reason) for ``GET /ready``: the engine is started and
         nobody is draining.  A plain predict server (no engine) is ready
         once it serves HTTP — liveness and readiness only diverge when
-        there is lifecycle state to diverge on."""
+        there is lifecycle state to diverge on.  With
+        ``root.common.observe.slo.degrade_ready`` on, a sustained SLO
+        burn (runtime/slo.py) also flips readiness so a load balancer
+        sheds traffic before the tail melts."""
         if self.deploy is not None and self.deploy.draining:
             return False, "draining"
         if self.engine is not None:
@@ -266,6 +297,8 @@ class RestfulServer(Logger):
                 return False, "draining"
             if not self.engine.started:
                 return False, "engine not started"
+        if slo_tracker().degrading():
+            return False, "slo burn-rate over threshold (see /slo.json)"
         return True, "ok"
 
     def infer(self, x) -> np.ndarray:

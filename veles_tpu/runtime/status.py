@@ -32,7 +32,10 @@ from typing import Optional
 
 from ..config import root
 from ..logger import Logger
+from .memory import memory_monitor
 from .metrics import registry, span_ring
+from .profiler import profiler, serve_profile_post
+from .slo import slo_tracker
 
 
 class StatusReporter(Logger):
@@ -163,34 +166,63 @@ class StatusReporter(Logger):
 _HTML = """<!doctype html><meta http-equiv="refresh" content="2">
 <title>veles_tpu status</title>
 <style>body{font-family:monospace;margin:2em}td{padding:2px 12px}</style>
-<h2>veles_tpu — %s</h2><table>%s</table>"""
+<h2>veles_tpu — %s</h2>
+<p>%s</p>
+<table>%s</table>"""
+
+#: the observability endpoints linked from the status page header
+#: (docs/observability.md) — every "why is it slow / will it fit"
+#: surface one click from the page an operator already has open.
+_LINKS = ("/status.json", "/metrics", "/trace.json", "/slo.json",
+          "/memory.json")
+
+
+def _header_links() -> str:
+    links = " · ".join(
+        f'<a href="{p}">{p.lstrip("/")}</a>' for p in _LINKS)
+    last = profiler().last_path
+    if last:
+        links += (" · last profile: "
+                  f"<code>{html.escape(str(last))}</code>")
+    return links
 
 
 class _Handler(http.server.BaseHTTPRequestHandler):
     reporter: Optional[StatusReporter] = None
+
+    def _reply(self, body: bytes, code: int = 200,
+               ctype: str = "application/json"):
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _json(self, obj, code: int = 200, default=None):
+        self._reply(json.dumps(obj, default=default).encode(), code)
 
     def do_GET(self):
         if self.path.split("?", 1)[0] == "/metrics":
             # Prometheus text exposition of the process registry —
             # the scrape target every latency histogram lands in
             # (docs/observability.md "Metrics & tracing")
-            body = registry().render().encode()
-            self.send_response(200)
-            self.send_header("Content-Type",
-                             "text/plain; version=0.0.4; charset=utf-8")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
+            self._reply(
+                registry().render().encode(),
+                ctype="text/plain; version=0.0.4; charset=utf-8")
+            return
+        if self.path.split("?", 1)[0] == "/slo.json":
+            # rolling-window latency percentiles + SLO burn rates
+            # (runtime/slo.py; the read also rotates the ring)
+            self._json(slo_tracker().doc())
+            return
+        if self.path.split("?", 1)[0] == "/memory.json":
+            # HBM truth + the aval-derived component ledger
+            # (runtime/memory.py)
+            self._json(memory_monitor().doc())
             return
         if self.path.split("?", 1)[0] == "/trace.json":
             # Chrome-trace / Perfetto timeline of the span ring
-            body = json.dumps(span_ring().chrome_trace(),
-                              default=repr).encode()
-            self.send_response(200)
-            self.send_header("Content-Type", "application/json")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
+            self._json(span_ring().chrome_trace(), default=repr)
             return
         if self.path.split("?", 1)[0] == "/graph.svg":
             svg = self.reporter.graph_svg if self.reporter else None
@@ -271,13 +303,19 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                          '<p><img src="/graph.svg" '
                          'style="max-width:95%"></p>')
             body = (_HTML % (html.escape(str(doc.get("name", "?"))),
-                             rows) + graph + imgs).encode()
+                             _header_links(), rows)
+                    + graph + imgs).encode()
             ctype = "text/html"
-        self.send_response(200)
-        self.send_header("Content-Type", ctype)
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
+        self._reply(body, ctype=ctype)
+
+    def do_POST(self):
+        if self.path.split("?", 1)[0] != "/debug/profile":
+            self.send_error(404)
+            return
+        # shared handler (runtime/profiler.py): ingress cap, capture,
+        # 409/400/500 mapping — one implementation for both servers
+        code, obj = serve_profile_post(self.headers, self.rfile)
+        self._json(obj, code=code)
 
     def log_message(self, *args):  # silence request logging
         pass

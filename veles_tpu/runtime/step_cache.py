@@ -161,6 +161,19 @@ class StepCache(Logger):
         self._m_wall = reg.counter(
             "vt_compile_wall_seconds_total",
             "wall seconds spent tracing+compiling step programs")
+        # per-program-kind cost analysis (the goodput/MFU numerators,
+        # docs/observability.md "Goodput & MFU"): gauges because the
+        # inventory is a point-in-time fact of the newest cache to
+        # compile that kind, not a monotone event count
+        self._g_flops = reg.gauge(
+            "vt_program_flops",
+            "XLA cost-analysis flops per execution, summed over the "
+            "compiled programs of a kind (prefill sums its buckets)",
+            labels=("program",))
+        self._g_bytes = reg.gauge(
+            "vt_program_bytes_accessed",
+            "XLA cost-analysis bytes accessed per execution, summed "
+            "over the compiled programs of a kind", labels=("program",))
 
     @property
     def recompiles(self) -> int:
@@ -244,7 +257,40 @@ class StepCache(Logger):
             # the cache's lifetime (id reuse after GC would alias keys)
             "pin": pin,
         }
+        kc = self.program_cost(kind)
+        self._g_flops.labels(program=kind).set(kc["flops"])
+        self._g_bytes.labels(program=kind).set(kc["bytes_accessed"])
         return (self._entries[full_key]["fn"], state_sh, batch_sh)
+
+    def entry_cost(self, kind: str, key: Tuple) -> Dict[str, float]:
+        """Cost analysis of ONE cached program — the entry the caller
+        actually executes.  Use this (not :meth:`program_cost`) when the
+        cache can hold superseded programs of the same kind: a Trainer
+        whose optimizer was rebuilt keeps the old train entry forever
+        (conservative cache policy), and summing both would double the
+        reported flops — on the very metric meant as the honesty
+        check."""
+        ent = self._entries.get((kind,) + tuple(key))
+        if ent is None:
+            return {"flops": 0.0, "bytes_accessed": 0.0}
+        return {"flops": float(ent["cost"].get("flops", 0.0)),
+                "bytes_accessed":
+                    float(ent["cost"].get("bytes_accessed", 0.0))}
+
+    def program_cost(self, kind: str) -> Dict[str, float]:
+        """Summed cost analysis of this cache's compiled ``kind``
+        programs: ``{"flops", "bytes_accessed"}`` per execution (zeros
+        when XLA reported nothing — consumers treat 0 as unknown).
+        Correct when every entry of the kind is live inventory (an
+        engine's prefill buckets + its one decode step); see
+        :meth:`entry_cost` for the superseded-entries caveat."""
+        flops = bytes_acc = 0.0
+        for full_key, ent in self._entries.items():
+            if full_key[0] != kind:
+                continue
+            flops += float(ent["cost"].get("flops", 0.0))
+            bytes_acc += float(ent["cost"].get("bytes_accessed", 0.0))
+        return {"flops": flops, "bytes_accessed": bytes_acc}
 
     def stats(self) -> Dict[str, Any]:
         """JSON-able summary for benchmarks and status pages."""

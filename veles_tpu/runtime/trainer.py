@@ -27,7 +27,9 @@ from ..logger import Logger, TraceContext
 from ..ops.optimizers import (ANOM_CONSEC_KEY, LR_MULT_KEY, Optimizer,
                               reserved_opt_neutral)
 from ..units.workflow import Workflow
+from .benchmark import epoch_goodput, resolve_peak_tflops
 from .decision import Decision
+from .memory import memory_monitor, tree_bytes
 from .metrics import registry, span_ring
 from .snapshotter import (Snapshotter, _to_numpy, restore_with_walkback)
 from .step_cache import StepCache, enable_persistent_cache
@@ -88,6 +90,10 @@ class Trainer(Logger):
         self._state_sh = None
         self._batch_spec = None
         self.wstate = None
+        self._train_cost = {"flops": 0.0, "bytes_accessed": 0.0}
+        self._last_mfu = 0.0    # THIS trainer's last epoch (the gauge
+        #                         is process-global; two trainers in
+        #                         one process must not read each other)
         self._train_step = None
         self._eval_step = None
         self._eval_entry = None
@@ -114,6 +120,19 @@ class Trainer(Logger):
             "train steps skipped by the in-graph anomaly sentinel")
         self._g_epoch = reg.gauge(
             "vt_train_epoch", "current training epoch")
+        # goodput (docs/observability.md "Goodput & MFU"): the train
+        # program's cost analysis over the epoch wall, against the
+        # measured peak (runtime/benchmark.py GEMM calibration or the
+        # root.common.observe.peak_tflops override)
+        self._g_flops_sec = reg.gauge(
+            "vt_train_flops_per_sec",
+            "achieved training flops/s over the last train-epoch wall "
+            "(loader data waits included; eval and snapshot phases are "
+            "outside it — vt_train_phase_seconds shows where they go)")
+        self._g_mfu = reg.gauge(
+            "vt_train_mfu",
+            "model FLOPs utilization of the last train epoch against "
+            "the measured peak (0 = peak unknown)")
 
     # -- setup -------------------------------------------------------------
     def initialize(self, seed: Optional[int] = None,
@@ -155,6 +174,27 @@ class Trainer(Logger):
         self._compile_steps()
         if self._state_sh is not None:
             self.wstate = self._place_state(self.wstate)
+        # aval-derived memory ledger (runtime/memory.py, /memory.json):
+        # what this trainer pinned, in exact bytes — the fit check the
+        # ZeRO-sharding and quantization ROADMAP items start from
+        import weakref
+
+        from .memory import drop_stamped_components
+        mem = memory_monitor()
+        stamps = {
+            name: mem.set_component(name, nbytes) for name, nbytes in (
+                ("train.params",
+                 tree_bytes(self.wstate.get("params", {}))),
+                ("train.opt_state",
+                 tree_bytes(self.wstate.get("opt_state", {}))),
+                ("train.prefetch_staging",
+                 max(self.prefetch, 0) * tree_bytes(self._batch_spec)),
+            )}
+        # stamped drop on GC: a freed trainer's bytes leave /memory.json
+        # unless a newer registrant took the names over
+        self._mem_finalizer = weakref.finalize(
+            self, drop_stamped_components, stamps)
+        mem.ensure_poller()
         self.info("workflow %s: %d params", self.workflow.name,
                   self.workflow.n_params(self.wstate))
 
@@ -214,6 +254,10 @@ class Trainer(Logger):
         self._train_step, self._state_sh, self._batch_sh = \
             self.step_cache.get_step("train", key, build_train, args,
                                      pin=pin)
+        # the cost of THIS trainer's live train program — never the
+        # kind-sum, which double-counts superseded entries after an
+        # optimizer rebuild (the cache keeps them by design)
+        self._train_cost = self.step_cache.entry_cost("train", key)
         # The eval program compiles LAZILY on the first eval epoch — a
         # train-only run (no VALID/TEST data, bench loops) never pays
         # for a program it does not execute.
@@ -375,6 +419,17 @@ class Trainer(Logger):
             train_mets = self._run_epoch_train(epoch)
             t_train = time.time()
             samples_done += int(train_mets.get("n_samples", 0))
+            # epoch goodput: the compiled step's cost analysis times the
+            # steps run, over the epoch wall — and MFU against the
+            # measured peak (runtime/benchmark.py).  Host arithmetic
+            # only; the compiled programs are untouched.
+            goodput = epoch_goodput(
+                self._train_cost["flops"],
+                train_mets.get("n_batches", 0.0),
+                max(t_train - t_ep, 1e-9))
+            self._g_flops_sec.set(goodput["flops_per_sec"])
+            self._g_mfu.set(goodput["mfu"])
+            self._last_mfu = goodput["mfu"]
             # anomaly accounting + (possibly) rollback escalation BEFORE
             # eval, so a rolled-back epoch validates the restored weights
             self._check_anomalies(epoch, train_mets)
@@ -399,6 +454,9 @@ class Trainer(Logger):
                 self.status.update(
                     epoch=epoch, best_value=self.decision.best_value,
                     best_epoch=self.decision.best_epoch,
+                    train_mfu=round(goodput["mfu"], 4),
+                    train_flops_per_sec=round(
+                        goodput["flops_per_sec"], 1),
                     anomaly_steps_skipped=self.anomaly_steps_skipped,
                     anomaly_rollbacks=self.anomaly_rollbacks,
                     snapshot_walkbacks=self.snapshot_walkbacks,
@@ -459,12 +517,18 @@ class Trainer(Logger):
 
         elapsed = time.time() - t0
         test_mets = self._run_epoch_eval(TEST, epoch)
+        flops_per_step = self._train_cost["flops"]
         self.results = self.workflow.gather_results({
             "best_value": self.decision.best_value,
             "best_epoch": self.decision.best_epoch,
             "epochs": epoch,
             "elapsed_s": elapsed,
             "train_samples_per_s": samples_done / max(elapsed, 1e-9),
+            "train_step_flops": flops_per_step,
+            # unrounded: a CPU-tier MFU is ~1e-7 and must not round to
+            # a fake zero (display rounding belongs to the status page)
+            "train_mfu": self._last_mfu,
+            "peak_tflops": resolve_peak_tflops(),
             "anomaly_steps_skipped": self.anomaly_steps_skipped,
             "anomaly_rollbacks": self.anomaly_rollbacks,
             "snapshot_walkbacks": self.snapshot_walkbacks,
