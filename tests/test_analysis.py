@@ -537,22 +537,24 @@ def test_baseline_accepts_then_goes_stale_on_edit(tmp_path):
     r2 = run_analysis([str(tmp_path)], baseline_path=bp, docs_dir=None)
     assert r2["findings"] == [] and _rules(r2["accepted"]) == ["VT101"]
 
-    # editing the flagged line invalidates its fingerprint on purpose
+    # editing the flagged line invalidates its fingerprint on purpose —
+    # and the orphaned baseline entry itself surfaces as VA002 so the
+    # debt record cannot silently linger
     mod.write_text(mod.read_text().replace("if y > 0:", "if y > 1:"))
     r3 = run_analysis([str(tmp_path)], baseline_path=bp, docs_dir=None)
-    assert _rules(r3["findings"]) == ["VT101"]
+    assert _rules(r3["findings"]) == ["VA002", "VT101"]
 
 
-def test_va002_never_baselined(tmp_path):
+def test_va003_never_baselined(tmp_path):
     # a file that does not parse was never analyzed: no baseline may
     # green it (its fingerprint has no symbol/snippet to go stale on)
     _write(tmp_path, "broken.py", "def oops(:\n")
     bp = str(tmp_path / "bl.json")
     r1 = run_analysis([str(tmp_path)], baseline_path=bp, docs_dir=None)
-    assert _rules(r1["findings"]) == ["VA002"]
+    assert _rules(r1["findings"]) == ["VA003"]
     write_baseline(bp, r1["all"])
     r2 = run_analysis([str(tmp_path)], baseline_path=bp, docs_dir=None)
-    assert _rules(r2["findings"]) == ["VA002"]     # still new
+    assert _rules(r2["findings"]) == ["VA003"]     # still new
 
 
 def test_config_alias_poisoned_by_unrelated_local(tmp_path):
@@ -576,6 +578,790 @@ def test_config_alias_poisoned_by_unrelated_local(tmp_path):
             return serve.get("meta")
         """)
     assert not [f for f in _lint(tmp_path) if f.rule == "VK301"]
+
+
+# -- VS5xx: sharding / collective discipline --------------------------------
+
+def _mesh_fixture(tmp_path):
+    """Declares axes {data, model, seq} the way parallel/mesh.py does
+    (MeshSpec dataclass fields — pure AST, nothing imported)."""
+    _write(tmp_path, "mesh.py", """\
+        from dataclasses import dataclass
+
+        @dataclass
+        class MeshSpec:
+            data: int = -1
+            model: int = 1
+            seq: int = 1
+        """)
+
+
+def test_vs501_undeclared_psum_axis(tmp_path):
+    """Acceptance seed: an undeclared psum axis produces exactly ONE
+    finding with the right rule id and file:line."""
+    _mesh_fixture(tmp_path)
+    _write(tmp_path, "coll.py", """\
+        import jax
+
+        def body(x):  # shard-map-root: data
+            return jax.lax.psum(x, "tensor")
+        """)
+    found = _lint(tmp_path)
+    assert _rules(found) == ["VS501"]
+    f = found[0]
+    assert f.path.endswith("coll.py") and f.line == 4
+    assert "tensor" in f.message and f.symbol == "body"
+
+
+def test_vs501_axis_outside_scope_environment(tmp_path):
+    # 'model' IS declared on the mesh, but this shard_map scope binds
+    # only 'data' — still VS501 (the env-mismatch variant)
+    _mesh_fixture(tmp_path)
+    _write(tmp_path, "coll.py", """\
+        import jax
+
+        def body(x):  # shard-map-root: data
+            return jax.lax.psum(x, "model")
+        """)
+    found = _lint(tmp_path)
+    assert _rules(found) == ["VS501"]
+    assert "does not bind" in found[0].message
+
+
+def test_vs501_declared_axis_clean_and_suppressible(tmp_path):
+    _mesh_fixture(tmp_path)
+    _write(tmp_path, "coll.py", """\
+        import jax
+
+        def body(x):  # shard-map-root: data
+            return jax.lax.psum(x, "data")
+
+        def odd(x):  # shard-map-root: data
+            # lint: disable=VS501 axis injected by the test harness
+            return jax.lax.psum(x, "bogus")
+        """)
+    assert _lint(tmp_path) == []
+
+
+def test_vs502_collective_outside_shard_map_scope(tmp_path):
+    _mesh_fixture(tmp_path)
+    _write(tmp_path, "coll.py", """\
+        import jax
+
+        def stray(x):
+            return jax.lax.psum(x, "data")
+        """)
+    found = _lint(tmp_path)
+    assert _rules(found) == ["VS502"]
+    assert found[0].symbol == "stray"
+
+
+def test_vs502_closure_covers_called_helpers(tmp_path):
+    # a helper the shard-map root calls joins the scope module-locally
+    _mesh_fixture(tmp_path)
+    _write(tmp_path, "coll.py", """\
+        import jax
+
+        def helper(x):
+            return jax.lax.ppermute(x, "data", [(0, 1)])
+
+        def body(x):  # shard-map-root: data
+            return helper(x)
+        """)
+    assert _lint(tmp_path) == []
+
+
+def test_vs503_partition_spec_undeclared_axis(tmp_path):
+    _mesh_fixture(tmp_path)
+    _write(tmp_path, "specs.py", """\
+        from jax.sharding import PartitionSpec as P
+
+        GOOD = P("data", None)
+        BAD = P(None, "tensor")
+        """)
+    found = _lint(tmp_path)
+    assert _rules(found) == ["VS503"]
+    assert "tensor" in found[0].message and found[0].line == 4
+
+
+def test_vs5xx_silent_without_mesh_declarations(tmp_path):
+    # a subset scan that can see no mesh cannot prove "undeclared":
+    # VS501/VS503 bail (VS502 is scope-only and still fires)
+    _write(tmp_path, "specs.py", """\
+        from jax.sharding import PartitionSpec as P
+
+        BAD = P("whatever",)
+        """)
+    assert _lint(tmp_path) == []
+
+
+def test_vs5xx_live_registry_roots_resolve():
+    """Every SHARD_MAP_ROOTS qualname resolves in its module, so
+    renames can't silently drop collective coverage (the VS twin of
+    test_registry_roots_exist)."""
+    from veles_tpu.analysis.registry import SHARD_MAP_ROOTS
+    pkg = os.path.join(REPO, "veles_tpu")
+    for relmod, roots in SHARD_MAP_ROOTS.items():
+        path = os.path.join(pkg, relmod)
+        assert os.path.isfile(path), relmod
+        pf = parse_file(path, relmod)
+        for q, env in roots.items():
+            assert q in pf.functions, (relmod, q)
+            assert env and all(isinstance(a, str) for a in env)
+
+
+# -- VP6xx: recompile hazards ------------------------------------------------
+
+def test_vp601_len_into_builder_slot(tmp_path):
+    """Acceptance seed: a len(queue) fed to a static builder slot
+    produces exactly ONE finding with the right rule id and
+    file:line."""
+    _write(tmp_path, "mod.py", """\
+        def make_step(n):  # trace-root: builder
+            def step(x):
+                return x * n
+            return step
+
+        def host(queue):
+            return make_step(len(queue))
+        """)
+    found = _lint(tmp_path)
+    assert _rules(found) == ["VP601"]
+    f = found[0]
+    assert f.path.endswith("mod.py") and f.line == 7
+    assert "len()" in f.message and f.symbol == "host"
+
+
+def test_vp601_loop_variable_and_negatives(tmp_path):
+    _write(tmp_path, "mod.py", """\
+        def make_step(n):  # trace-root: builder
+            def step(x):
+                return x * n
+            return step
+
+        def warm(sizes):
+            fns = []
+            for n in sizes:
+                fns.append(make_step(n))
+            return fns
+
+        def fine():
+            return make_step(4)
+
+        def justified(sizes):
+            for n in (8, 16):
+                # lint: disable=VP601 two static buckets by design
+                make_step(n)
+        """)
+    found = _lint(tmp_path)
+    assert _rules(found) == ["VP601"]
+    assert found[0].symbol == "warm" and "loop variable" in found[0].message
+
+
+def test_vp601_skips_builder_internal_composition(tmp_path):
+    # builders composing sub-builders at build time (loops over static
+    # model structure) are inside ONE program build, not a recompile
+    # stream — the engine/generate idiom
+    _write(tmp_path, "mod.py", """\
+        def make_cache(u):  # trace-root: builder
+            return {"u": u}
+
+        def make_all(units):  # trace-root: builder
+            out = {}
+            for i, u in enumerate(units):
+                out[str(i)] = make_cache(u)
+            return out
+        """)
+    assert _lint(tmp_path) == []
+
+
+def test_vp602_mapping_order_structure(tmp_path):
+    _write(tmp_path, "mod.py", """\
+        def make_tree(cfgs):  # trace-root: builder
+            return {k: v * 2 for k, v in cfgs.items()}
+
+        def make_tree_sorted(cfgs):  # trace-root: builder
+            return {k: v * 2 for k, v in sorted(cfgs.items())}
+        """)
+    found = _lint(tmp_path)
+    assert _rules(found) == ["VP602"]
+    assert found[0].symbol == "make_tree" and "cfgs" in found[0].message
+    assert found[0].severity == "warning"
+
+
+def test_vp602_ignores_nested_traced_defs(tmp_path):
+    # dict iteration inside the builder's NESTED def is the traced
+    # program's data plumbing (plan.step work dicts), not build-time
+    # structure construction
+    _write(tmp_path, "mod.py", """\
+        def make_step(cfgs):  # trace-root: builder
+            def step(caches):
+                return {k: v for k, v in caches.items()}
+            return step
+        """)
+    assert _lint(tmp_path) == []
+
+
+def test_vp603_builder_on_hot_path_outside_step_cache(tmp_path):
+    _write(tmp_path, "mod.py", """\
+        def make_fn(plan):  # trace-root: builder
+            def fn(x):
+                return x
+            return fn
+
+        def handler(plan):  # host-loop-root:
+            return make_fn(plan)
+
+        def good(plan, cache):  # host-loop-root:
+            step, _, _ = cache.get_step(
+                "k", (), lambda: (make_fn(plan), None, None), ())
+            return step
+        """)
+    found = _lint(tmp_path)
+    assert _rules(found) == ["VP603"]
+    assert found[0].symbol == "handler" and "make_fn" in found[0].message
+
+
+def test_vp603_self_caching_builders_declared_honestly():
+    """generate/generate_beam are exempted from VP603 because they own
+    a per-geometry memo — this guard fails if the memo disappears while
+    the registry still claims it (the declaration must stay honest)."""
+    from veles_tpu.analysis.registry import SELF_CACHING_BUILDERS
+    src = open(os.path.join(REPO, "veles_tpu", "runtime",
+                            "generate.py")).read()
+    for name in SELF_CACHING_BUILDERS:
+        assert f"def {name}(" in src, name
+    assert "_runner_cache" in src
+
+
+def test_vp6xx_skips_test_files(tmp_path):
+    # tests loop builders over geometries on purpose
+    _write(tmp_path, "test_mod.py", """\
+        def make_step(n):  # trace-root: builder
+            return n
+
+        def test_warm(sizes):
+            return [make_step(n) for n in sizes]
+        """)
+    assert _lint(tmp_path) == []
+
+
+def test_vp6xx_host_loop_registry_roots_resolve():
+    from veles_tpu.analysis.registry import HOST_LOOP_ROOTS
+    pkg = os.path.join(REPO, "veles_tpu")
+    for relmod, roots in HOST_LOOP_ROOTS.items():
+        path = os.path.join(pkg, relmod)
+        assert os.path.isfile(path), relmod
+        pf = parse_file(path, relmod)
+        for q in roots:
+            assert q in pf.functions, (relmod, q)
+
+
+# -- VC204/VC205: the interprocedural lock graph -----------------------------
+
+def test_vc204_lock_order_inversion(tmp_path):
+    """Acceptance seed: a lock-order inversion produces exactly ONE
+    finding with the right rule id and file:line."""
+    _write(tmp_path, "mod.py", """\
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def one(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def two(self):
+                with self._b:
+                    with self._a:
+                        pass
+        """)
+    found = _lint(tmp_path)
+    assert _rules(found) == ["VC204"]
+    f = found[0]
+    assert f.path.endswith("mod.py") and f.line == 10
+    assert "_a" in f.message and "_b" in f.message
+
+
+def test_vc204_interprocedural_through_calls(tmp_path):
+    # the B-acquisition hides behind a method call; the module-local
+    # closure still sees the edge
+    _write(tmp_path, "mod.py", """\
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def one(self):
+                with self._a:
+                    self._grab_b()
+
+            def _grab_b(self):
+                with self._b:
+                    pass
+
+            def two(self):
+                with self._b:
+                    with self._a:
+                        pass
+        """)
+    assert _rules(_lint(tmp_path)) == ["VC204"]
+
+
+def test_vc204_consistent_order_is_clean(tmp_path):
+    _write(tmp_path, "mod.py", """\
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def one(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def two(self):
+                with self._a:
+                    with self._b:
+                        pass
+        """)
+    assert _lint(tmp_path) == []
+
+
+def test_vc204_reentrant_self_acquire_is_clean(tmp_path):
+    # the RLock pattern the deploy control plane uses on purpose
+    _write(tmp_path, "mod.py", """\
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._a = threading.RLock()
+
+            def outer(self):
+                with self._a:
+                    self.inner()
+
+            def inner(self):
+                with self._a:
+                    pass
+        """)
+    assert _lint(tmp_path) == []
+
+
+def test_vc205_blocking_under_annotated_lock(tmp_path):
+    _write(tmp_path, "mod.py", """\
+        import threading
+        import time
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = []  # guarded-by: self._lock
+
+            def bad(self):
+                with self._lock:
+                    time.sleep(1)
+                    self._q.append(1)
+
+            def good(self):
+                time.sleep(1)
+                with self._lock:
+                    self._q.append(1)
+        """)
+    found = _lint(tmp_path)
+    assert _rules(found) == ["VC205"]
+    assert found[0].symbol == "Box.bad"
+    assert "time.sleep" in found[0].message
+
+
+def test_vc205_io_through_helper_call(tmp_path):
+    # the StatusReporter shape this rule was built to catch: file IO
+    # reached THROUGH a helper while the data lock is held
+    _write(tmp_path, "mod.py", """\
+        import threading
+
+        class Rep:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._doc = {}  # guarded-by: self._lock
+
+            def flush(self):
+                with self._lock:
+                    self._write()
+
+            def _write(self):
+                with open("f", "w") as f:
+                    f.write(str(self._doc))
+        """)
+    found = [f for f in _lint(tmp_path) if f.rule == "VC205"]
+    assert len(found) == 1
+    assert found[0].symbol == "Rep.flush" and "_write" in found[0].message
+
+
+def test_vc205_unannotated_io_mutex_is_clean(tmp_path):
+    # a dedicated IO-serialization mutex (no guarded-by fields) may
+    # block by design — the rule binds annotated data locks only.
+    # The file still annotates ANOTHER lock so the scan runs.
+    _write(tmp_path, "mod.py", """\
+        import threading
+
+        class Rep:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._doc = {}  # guarded-by: self._lock
+                self._io = threading.Lock()
+
+            def write(self, doc):
+                with self._io:
+                    with open("f", "w") as f:
+                        f.write(str(doc))
+        """)
+    assert not [f for f in _lint(tmp_path) if f.rule == "VC205"]
+
+
+def test_vc205_timeoutless_wait_and_suppression(tmp_path):
+    _write(tmp_path, "mod.py", """\
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._evt = threading.Event()
+                self._n = 0  # guarded-by: self._lock
+
+            def bad(self):
+                with self._lock:
+                    self._evt.wait()
+
+            def bounded(self):
+                with self._lock:
+                    self._evt.wait(0.1)
+
+            def justified(self):
+                with self._lock:
+                    # lint: disable=VC205 test fixture: the waiter is
+                    # the only other thread and never takes this lock
+                    self._evt.wait()
+        """)
+    found = [f for f in _lint(tmp_path) if f.rule == "VC205"]
+    assert len(found) == 1 and found[0].symbol == "Box.bad"
+
+
+def test_status_reporter_io_stays_outside_data_lock():
+    """Regression for the live VC205 fix: StatusReporter must never
+    hold `_lock` across the status.json write (the engine scheduler
+    tick calls update() synchronously)."""
+    path = os.path.join(REPO, "veles_tpu", "runtime", "status.py")
+    found = analyze_files(iter_python_files([path]))
+    assert not [f for f in found if f.rule == "VC205"], found
+
+
+# -- VA002: stale baseline entries + pruning ---------------------------------
+
+def test_va002_for_baseline_entry_of_deleted_file(tmp_path):
+    mod = _write(tmp_path, "mod.py", """\
+        import jax.numpy as jnp
+
+        def step(x):  # trace-root: traced
+            y = jnp.sum(x)
+            if y > 0:
+                return y
+            return -y
+        """)
+    bp = str(tmp_path / "bl.json")
+    r1 = run_analysis([str(tmp_path)], baseline_path=bp, docs_dir=None)
+    write_baseline(bp, r1["all"])
+    mod.unlink()
+    _write(tmp_path, "other.py", "x = 1\n")   # keep the scan non-empty
+    r2 = run_analysis([str(tmp_path)], baseline_path=bp, docs_dir=None)
+    assert _rules(r2["findings"]) == ["VA002"]
+    assert "file is gone" in r2["findings"][0].message
+    assert r2["findings"][0].severity == "warning"
+
+
+def test_va002_suppression_impossible_and_never_baselined(tmp_path):
+    # VA002 points at the baseline's own debt: writing it into the
+    # baseline must not hide it
+    mod = _write(tmp_path, "mod.py", """\
+        import jax.numpy as jnp
+
+        def step(x):  # trace-root: traced
+            y = jnp.sum(x)
+            if y > 0:
+                return y
+            return -y
+        """)
+    bp = str(tmp_path / "bl.json")
+    r1 = run_analysis([str(tmp_path)], baseline_path=bp, docs_dir=None)
+    write_baseline(bp, r1["all"])
+    mod.write_text("x = 1\n")       # finding fixed, entry now stale
+    r2 = run_analysis([str(tmp_path)], baseline_path=bp, docs_dir=None)
+    assert _rules(r2["findings"]) == ["VA002"]
+    write_baseline(bp, r2["all"])   # try to baseline the staleness
+    r3 = run_analysis([str(tmp_path)], baseline_path=bp, docs_dir=None)
+    assert _rules(r3["findings"]) == []   # rewrite pruned the entry
+
+
+def test_write_baseline_prunes_deleted_files(tmp_path, capsys):
+    mod = _write(tmp_path, "mod.py", """\
+        import jax.numpy as jnp
+
+        def step(x):  # trace-root: traced
+            y = jnp.sum(x)
+            if y > 0:
+                return y
+            return -y
+        """)
+    _write(tmp_path, "keeper.py", "x = 1\n")
+    bp = str(tmp_path / "bl.json")
+    rc = lint_main([str(tmp_path), "--baseline", bp, "--write-baseline"])
+    capsys.readouterr()
+    assert rc == 0
+    entries = json.load(open(bp))["findings"]
+    assert len(entries) == 1
+    mod.unlink()
+    rc = lint_main([str(tmp_path), "--baseline", bp, "--write-baseline"])
+    out = capsys.readouterr().out
+    assert rc == 0 and "pruned 1" in out
+    assert json.load(open(bp))["findings"] == []
+
+
+# -- CLI: --changed + JSON schema -------------------------------------------
+
+def _git(cwd, *args):
+    r = subprocess.run(["git", *args], cwd=str(cwd),
+                       capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, (args, r.stderr)
+    return r.stdout
+
+
+def test_cli_changed_lints_only_git_diff(tmp_path, capsys):
+    _git(tmp_path, "init", "-q")
+    _git(tmp_path, "-c", "user.email=t@t", "-c", "user.name=t",
+         "commit", "--allow-empty", "-q", "-m", "root")
+    clean = _write(tmp_path, "clean.py", "x = 1\n")
+    _git(tmp_path, "add", "clean.py")
+    _git(tmp_path, "-c", "user.email=t@t", "-c", "user.name=t",
+         "commit", "-q", "-m", "clean file")
+    # an UNTRACKED file with a violation: --changed must see it
+    _write(tmp_path, "dirty.py", """\
+        import jax.numpy as jnp
+
+        def step(x):  # trace-root: traced
+            y = jnp.sum(x)
+            if y > 0:
+                return y
+            return -y
+        """)
+    cwd = os.getcwd()
+    os.chdir(str(tmp_path))
+    try:
+        rc = lint_main(["--changed", "--baseline", "none", "--json"])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 1
+        assert out["files"] == 1            # only dirty.py was parsed
+        assert {f["rule"] for f in out["findings"]} == {"VT101"}
+        # nothing changed -> clean exit, NOT the zero-files usage error
+        os.remove("dirty.py")
+        rc = lint_main(["--changed", "--baseline", "none"])
+        text = capsys.readouterr().out
+        assert rc == 0 and "no changed Python files" in text
+    finally:
+        os.chdir(cwd)
+
+
+def test_changed_style_subset_scan_no_inventory_rules(tmp_path):
+    """Regression (review finding): a --changed-style FILE-LIST scan
+    that happens to include an __init__.py and a metric-registering
+    file must not fire the whole-inventory rules — VM402 ("registered
+    nowhere") and VK302/VK303 ("read/documented nowhere") need a
+    package-directory scan to prove their claim."""
+    _write(tmp_path, "__init__.py", "")
+    met = _write(tmp_path, "met.py", """\
+        def setup(reg):
+            reg.counter("vt_x_total", "documented")
+        """)
+    cfg = _write(tmp_path, "config.py", """\
+        root = None
+
+        def _defaults():
+            root.common.alpha = 1
+        """)
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "observability.md").write_text(
+        "| `vt_x_total` | counter |\n"
+        "| `vt_ghost_total` | counter | registered elsewhere |\n")
+    # package-DIRECTORY scan: inventory rules on (ghost + dead key)
+    r_dir = run_analysis([str(tmp_path)], baseline_path=None,
+                         docs_dir=str(docs))
+    assert "VM402" in _rules(r_dir["findings"])
+    assert "VK302" in _rules(r_dir["findings"])
+    # file-LIST scan of the same files: inventory rules off
+    r_files = run_analysis(
+        [str(tmp_path / "__init__.py"), str(met), str(cfg)],
+        baseline_path=None, docs_dir=str(docs))
+    rules = _rules(r_files["findings"])
+    assert "VM402" not in rules and "VK302" not in rules \
+        and "VK303" not in rules, rules
+
+
+def test_vc205_blocking_inside_except_handler(tmp_path):
+    # retry paths are where sleeps live; the walker must see them
+    _write(tmp_path, "mod.py", """\
+        import threading
+        import time
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0  # guarded-by: self._lock
+
+            def bad(self):
+                with self._lock:
+                    try:
+                        self._n += 1
+                    except ValueError:
+                        time.sleep(1)
+        """)
+    found = [f for f in _lint(tmp_path) if f.rule == "VC205"]
+    assert len(found) == 1 and "time.sleep" in found[0].message
+
+
+def test_vc205_keyword_args_are_not_an_exemption(tmp_path):
+    # q.get(block=True) and evt.wait(timeout=None) block forever —
+    # a keyword argument alone must not exempt the call
+    _write(tmp_path, "mod.py", """\
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = None  # guarded-by: self._lock
+                self._evt = threading.Event()
+
+            def bad_get(self):
+                with self._lock:
+                    return self._q.get(block=True)
+
+            def bad_wait(self):
+                with self._lock:
+                    self._evt.wait(timeout=None)
+
+            def ok_wait(self):
+                with self._lock:
+                    self._evt.wait(timeout=0.5)
+        """)
+    found = [f for f in _lint(tmp_path) if f.rule == "VC205"]
+    assert sorted(f.symbol for f in found) == ["Box.bad_get",
+                                               "Box.bad_wait"]
+
+
+def test_cli_changed_restricts_to_path_scope(tmp_path, capsys):
+    """--changed intersects the changed set with the positional scope
+    (when it exists) so the pre-commit hook can't fail on files the CI
+    gate never lints."""
+    _git(tmp_path, "init", "-q")
+    _git(tmp_path, "-c", "user.email=t@t", "-c", "user.name=t",
+         "commit", "--allow-empty", "-q", "-m", "root")
+    (tmp_path / "pkg").mkdir()
+    _write(tmp_path, "pkg/__init__.py", "")
+    _write(tmp_path, "outside.py", """\
+        import jax.numpy as jnp
+
+        def step(x):  # trace-root: traced
+            y = jnp.sum(x)
+            if y > 0:
+                return y
+            return -y
+        """)
+    _write(tmp_path, "pkg/inside.py", "x = 1\n")
+    cwd = os.getcwd()
+    os.chdir(str(tmp_path))
+    try:
+        # scoped to pkg/: the outside.py violation is out of scope
+        rc = lint_main(["pkg", "--changed", "--baseline", "none"])
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        # unscoped via a nonexistent anchor: everything changed lints
+        rc = lint_main(["--changed", "--baseline", "none"])
+        capsys.readouterr()
+        assert rc == 1      # default anchor veles_tpu doesn't exist
+    finally:
+        os.chdir(cwd)
+
+
+def test_cli_changed_json_empty_is_still_json(tmp_path, capsys):
+    _git(tmp_path, "init", "-q")
+    _git(tmp_path, "-c", "user.email=t@t", "-c", "user.name=t",
+         "commit", "--allow-empty", "-q", "-m", "root")
+    cwd = os.getcwd()
+    os.chdir(str(tmp_path))
+    try:
+        rc = lint_main(["--changed", "--baseline", "none", "--json"])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert out["schema_version"] == 1 and out["findings"] == []
+        assert sorted(out["by_family"]) == [
+            "VA0xx", "VC2xx", "VK3xx", "VM4xx", "VP6xx", "VS5xx",
+            "VT1xx"]
+    finally:
+        os.chdir(cwd)
+
+
+def test_cli_json_schema_golden(tmp_path, capsys):
+    """The --json contract CI dashboards chart: schema_version, the
+    stable per-family count keys, and the per-finding field set."""
+    _seeded_violations(tmp_path)
+    rc = lint_main([str(tmp_path), "--baseline", "none", "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert out["schema_version"] == 1
+    assert sorted(out["by_family"]) == [
+        "VA0xx", "VC2xx", "VK3xx", "VM4xx", "VP6xx", "VS5xx", "VT1xx"]
+    assert out["by_family"]["VT1xx"] == 1
+    assert out["by_family"]["VC2xx"] >= 1
+    assert out["by_family"]["VK3xx"] >= 1
+    assert out["by_family"]["VS5xx"] == 0
+    assert sum(out["by_family"].values()) == len(out["findings"])
+    for f in out["findings"]:
+        assert set(f) == {"rule", "severity", "path", "line", "col",
+                          "symbol", "message", "hint", "snippet",
+                          "fingerprint"}
+    assert set(out) == {"schema_version", "findings", "by_family",
+                        "accepted", "files", "baseline"}
+
+
+def test_pre_commit_config_runs_the_gate():
+    import re as _re
+    cfg = open(os.path.join(REPO, ".pre-commit-config.yaml")).read()
+    assert "veles_tpu.analysis" in cfg and "--changed" in cfg
+    # the hook id is the one documented in docs/analysis.md
+    assert _re.search(r"^\s*-?\s*id:\s*veles-tpu-lint\s*$", cfg, _re.M)
+
+
+def test_full_package_run_under_budget():
+    """New rule families must not quietly make the tier-1 gate slow:
+    the whole-package run stays under 3 s (best of two, damping CI
+    load noise — the budget is the contract, the retry is not)."""
+    import time
+    pkg = os.path.join(REPO, "veles_tpu")
+    best = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        report = run_analysis([pkg], baseline_path=None,
+                              docs_dir=os.path.join(REPO, "docs"))
+        best = min(best, time.perf_counter() - t0)
+    assert report["files"] > 90
+    assert best < 3.0, f"full-package analysis took {best:.2f}s"
 
 
 # -- CLI contract (acceptance criteria) -------------------------------------

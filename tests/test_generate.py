@@ -202,9 +202,14 @@ def test_temperature_sampling_properties(rng):
     layers = CASES["plain"](V)
     wf, ws = _build_lm(layers, B, P, V)
     prompt = rng.integers(0, V, (B, P)).astype(np.int32)
-    # near-zero temperature converges to greedy
+    # near-zero temperature converges to greedy.  The tolerance is the
+    # property: at temperature t a sampled flip needs a top-2 logit gap
+    # below ~t x the O(1) gumbel spread, so 1e-6 asserts convergence
+    # without being sensitive to the near-ties this random model
+    # actually has at the 1e-4 scale (which flipped with PRNG-version
+    # tie-break changes — the old flaky form of this test).
     greedy = np.asarray(generate(wf, ws, prompt, N))
-    cold = np.asarray(generate(wf, ws, prompt, N, temperature=1e-4,
+    cold = np.asarray(generate(wf, ws, prompt, N, temperature=1e-6,
                                key=jax.random.key(1)))
     np.testing.assert_array_equal(cold, greedy)
     # hot sampling with different keys gives different continuations

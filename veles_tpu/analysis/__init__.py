@@ -10,7 +10,7 @@ drive the offending path.  This package enforces them at lint time,
 before any test runs, the way the reference project's per-unit
 validation hooks checked workflow graphs before a run.
 
-Three rule families (full catalogue in docs/analysis.md):
+Rule families (full catalogue in docs/analysis.md):
 
 * **trace-safety (VT1xx)** — inside functions reachable from the traced
   program roots (:mod:`veles_tpu.analysis.registry`), flag Python
@@ -21,14 +21,25 @@ Three rule families (full catalogue in docs/analysis.md):
 * **concurrency discipline (VC2xx)** — fields annotated
   ``# guarded-by: self.<lock>`` must only be touched inside
   ``with self.<lock>:`` in the same method (or a method annotated
-  ``# requires-lock: self.<lock>``), and ``.acquire()`` without a
-  ``try/finally`` release is rejected;
+  ``# requires-lock: self.<lock>``), ``.acquire()`` without a
+  ``try/finally`` release is rejected, and — interprocedurally over
+  the module-local call graph — lock-order cycles (VC204) and
+  blocking calls under annotated locks (VC205) are deadlock/stall
+  findings;
 * **config-key drift (VK3xx)** — every ``root.common.*`` key read in
   the package must be declared in ``veles_tpu/config.py`` and appear in
   the docs; declared keys nobody reads are dead;
 * **metric-name drift (VM4xx)** — every ``vt_*`` metric registered in
   code (runtime/metrics.py) must appear in docs/observability.md's
-  reference table, and every documented name must be registered.
+  reference table, and every documented name must be registered;
+* **sharding/collective discipline (VS5xx)** — collective axis names
+  must be declared on the mesh (parallel/mesh.py MeshSpec), raw
+  collectives must sit inside a registered ``shard_map`` scope, and
+  partition specs may not reference undeclared axes;
+* **recompile hazards (VP6xx)** — per-call-varying values must not
+  flow into traced-program builder slots, builder bodies must not let
+  caller-mapping insertion order become pytree structure, and builders
+  reachable from host hot loops must route through StepCache.
 
 Pure ``ast``/``tokenize`` — importing or running this package never
 imports jax or any of the modules it analyzes (a lint pass must be

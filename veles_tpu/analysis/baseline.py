@@ -52,14 +52,43 @@ def load_baseline(path: Optional[str]) -> Dict[str, dict]:
     return {e["fingerprint"]: e for e in entries}
 
 
-def write_baseline(path: str, findings: Iterable[Finding]) -> int:
-    """Rewrite the baseline from the given findings; returns the count.
-    Stable ordering + indented JSON so diffs of accepted debt review
-    like code.  VA002 (unparseable file) is never baselined: its
-    fingerprint has no symbol/snippet to go stale on, so accepting it
-    once would exclude the file from analysis forever."""
+#: rules a baseline may never accept: VA003 (unparseable file — its
+#: fingerprint has no symbol/snippet to go stale on, so accepting it
+#: once would exclude the file from analysis forever) and VA002 (a
+#: stale-entry report about the baseline itself — baselining it would
+#: hide the staleness it reports).
+NEVER_BASELINED = ("VA002", "VA003")
+
+
+def entry_file_exists(path: str, base_dir: str) -> bool:
+    """Does a baseline entry's file still exist?  Entry paths anchor at
+    the enclosing package root's PARENT (engine._package_anchor), and
+    the baseline usually sits at that anchor — but fixture trees put it
+    inside the scanned directory, so the parent is tried too."""
+    if not path:
+        return False
+    return any(os.path.isfile(os.path.join(d, path))
+               for d in (base_dir, os.path.dirname(base_dir)))
+
+
+def prune_missing(entries: Iterable[dict], base_dir: str) -> list:
+    """Drop baseline entries whose file no longer exists."""
+    return [e for e in entries
+            if entry_file_exists(e.get("path", ""), base_dir)]
+
+
+def write_baseline(path: str, findings: Iterable[Finding], *,
+                   keep: Iterable[dict] = ()) -> int:
+    """Rewrite the baseline from the given findings (plus ``keep``
+    entries — prior accepted debt for files outside this scan, already
+    pruned by the caller); returns the count.  Stable ordering +
+    indented JSON so diffs of accepted debt review like code."""
     entries = [f.to_dict() for f in sorted(findings, key=sort_key)
-               if f.rule != "VA002"]
+               if f.rule not in NEVER_BASELINED]
+    have = {e["fingerprint"] for e in entries}
+    entries.extend(sorted(
+        (e for e in keep if e.get("fingerprint") not in have),
+        key=lambda e: (e.get("path", ""), e.get("line", 0))))
     doc = {"comment": "accepted legacy lint findings — see "
                       "docs/analysis.md for the baseline workflow",
            "findings": entries}
@@ -74,12 +103,12 @@ def write_baseline(path: str, findings: Iterable[Finding]) -> int:
 def split_baselined(findings: Iterable[Finding],
                     baseline: Dict[str, dict]):
     """(new, accepted) partition of ``findings`` against the baseline.
-    VA002 is always new — a file that does not parse was never
+    VA003 is always new — a file that does not parse was never
     analyzed, so no baseline may green it (see write_baseline)."""
     new: List[Finding] = []
     accepted: List[Finding] = []
     for f in findings:
-        if f.rule != "VA002" and f.fingerprint() in baseline:
+        if f.rule not in NEVER_BASELINED and f.fingerprint() in baseline:
             accepted.append(f)
         else:
             new.append(f)

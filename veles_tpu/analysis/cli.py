@@ -3,27 +3,37 @@
 Exit code 0 when every finding is suppressed inline or accepted by the
 baseline; 1 when new findings exist (print them, fail the build); 2 on
 usage errors (argparse).  ``--json`` emits the machine-readable form
-the way ``veles-tpu --dump-config`` does for config.
+(``schema_version`` + per-family counts — the stable contract CI
+dashboards chart, asserted by a golden test); ``--changed [BASE]``
+lints only the files ``git diff --name-only`` reports, for sub-second
+pre-commit runs (.pre-commit-config.yaml ships the hook).
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
+import subprocess
 import sys
-from typing import Optional
+from typing import List, Optional
 
-from .baseline import BASELINE_NAME, write_baseline
+from .baseline import (BASELINE_NAME, load_baseline, prune_missing,
+                       write_baseline)
 from .engine import run_analysis
-from .findings import sort_key
+from .findings import FAMILIES, family, sort_key
+
+#: bumped whenever a --json key changes meaning or disappears; adding
+#: keys is compatible and does not bump it.
+SCHEMA_VERSION = 1
 
 
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="veles-tpu-lint",
         description="trace-discipline / host-concurrency / config-drift "
-                    "/ metric-drift static analyzer for veles_tpu "
-                    "(docs/analysis.md)")
+                    "/ metric-drift / sharding / recompile-hazard "
+                    "static analyzer for veles_tpu (docs/analysis.md)")
     p.add_argument("paths", nargs="*", default=["veles_tpu"],
                    help="files or directories to analyze "
                         "(default: veles_tpu)")
@@ -35,35 +45,134 @@ def build_parser() -> argparse.ArgumentParser:
                         "path; 'none' disables)")
     p.add_argument("--write-baseline", action="store_true",
                    help="accept every current finding into the baseline "
+                        "(pruning entries whose file no longer exists) "
                         "and exit 0")
     p.add_argument("--docs", default="auto", metavar="DIR",
                    help="docs directory for VK303 (default: nearest "
                         "docs/ dir; 'none' disables the docs check)")
+    p.add_argument("--changed", nargs="?", const="HEAD", default=None,
+                   metavar="BASE",
+                   help="lint only the .py files `git diff --name-only "
+                        "BASE` reports (default BASE: HEAD — the "
+                        "working tree's changes), restricted to the "
+                        "positional path scope (default veles_tpu) "
+                        "when it exists — so the hook and the CI gate "
+                        "agree on what is clean; zero changed files "
+                        "is a clean exit, not a usage error")
     return p
+
+
+def _changed_paths(base: str, anchors: List[str]) -> Optional[List[str]]:
+    """Changed ``.py`` files from git (tracked diffs + untracked), as
+    absolute paths; None when git is unavailable / not a repository."""
+    cwd = None
+    for a in anchors:
+        a = os.path.abspath(a)
+        cwd = a if os.path.isdir(a) else os.path.dirname(a)
+        if os.path.isdir(cwd):
+            break
+    try:
+        top = subprocess.run(
+            ["git", "rev-parse", "--show-toplevel"], cwd=cwd,
+            capture_output=True, text=True, timeout=30)
+        if top.returncode != 0:
+            return None
+        root = top.stdout.strip()
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", "--diff-filter=d", base],
+            cwd=root, capture_output=True, text=True, timeout=30)
+        if diff.returncode != 0:
+            return None
+        extra = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard"],
+            cwd=root, capture_output=True, text=True, timeout=30)
+        names = diff.stdout.splitlines() + (
+            extra.stdout.splitlines() if extra.returncode == 0 else [])
+    except (OSError, subprocess.SubprocessError):
+        return None
+    out = []
+    for name in names:
+        if not name.endswith(".py"):
+            continue
+        full = os.path.join(root, name)
+        if os.path.isfile(full) and full not in out:
+            out.append(full)
+    return sorted(out)
+
+
+def _empty_json_doc() -> dict:
+    return {"schema_version": SCHEMA_VERSION, "findings": [],
+            "by_family": {fam: 0 for fam in FAMILIES},
+            "accepted": 0, "files": 0, "baseline": None}
 
 
 def main(argv: Optional[list] = None) -> int:
     args = build_parser().parse_args(argv)
     baseline = None if args.baseline == "none" else args.baseline
     docs = None if args.docs == "none" else args.docs
-    report = run_analysis(args.paths, baseline_path=baseline,
-                          docs_dir=docs)
+    paths = args.paths
+    if args.changed is not None:
+        changed = _changed_paths(args.changed, paths)
+        if changed is None:
+            print("veles-tpu-lint: --changed needs a git repository",
+                  file=sys.stderr)
+            return 2
+        # restrict to the positional scope (default veles_tpu) so the
+        # pre-commit hook and the CI gate agree on what is clean —
+        # but only where those anchors exist (a bare repo without the
+        # default package keeps the unrestricted behavior)
+        anchors = [os.path.abspath(p) for p in paths
+                   if os.path.exists(p)]
+        if anchors:
+            changed = [f for f in changed
+                       if any(f == a or f.startswith(a + os.sep)
+                              for a in anchors)]
+        if not changed:
+            if args.json:
+                print(json.dumps(_empty_json_doc(), indent=1))
+            else:
+                print("clean: no changed Python files")
+            return 0
+        paths = changed
+    report = run_analysis(paths, baseline_path=baseline, docs_dir=docs)
     if report["files"] == 0:
         # a wrong cwd / typo'd path must not silently DISABLE the gate
         # by "cleanly" analyzing nothing
-        print(f"veles-tpu-lint: no Python files under {args.paths!r} "
+        print(f"veles-tpu-lint: no Python files under {paths!r} "
               "(wrong directory?)", file=sys.stderr)
         return 2
 
     if args.write_baseline:
         path = report["baseline_path"] or BASELINE_NAME
-        n = write_baseline(path, report["all"])
+        keep = []
+        prior = load_baseline(report["baseline_path"])
+        if prior:
+            base_dir = os.path.dirname(os.path.abspath(path))
+            before = len(prior)
+            kept_entries = prune_missing(prior.values(), base_dir)
+            pruned = before - len(kept_entries)
+            # keep prior debt for files outside this scan; scanned
+            # files are fully re-derived from the current findings
+            analyzed = {rel.replace(os.sep, "/") for rel in
+                        (r for _p, r in _scan_rels(paths))}
+            keep = [e for e in kept_entries
+                    if e.get("path") not in analyzed]
+            if pruned:
+                print(f"baseline: pruned {pruned} entr"
+                      f"{'y' if pruned == 1 else 'ies'} whose file no "
+                      "longer exists")
+        n = write_baseline(path, report["all"], keep=keep)
         print(f"baseline: wrote {n} finding(s) to {path}")
         return 0
 
     new = sorted(report["findings"], key=sort_key)
     if args.json:
-        doc = {"findings": [f.to_dict() for f in new],
+        counts = {fam: 0 for fam in FAMILIES}
+        for f in new:
+            counts[family(f.rule)] = counts.get(family(f.rule), 0) + 1
+        doc = {"schema_version": SCHEMA_VERSION,
+               "findings": [f.to_dict() for f in new],
+               "by_family": counts,
                "accepted": len(report["accepted"]),
                "files": report["files"],
                "baseline": report["baseline_path"]}
@@ -82,6 +191,11 @@ def main(argv: Optional[list] = None) -> int:
         return 1
     print(f"clean: 0 findings across {report['files']} file(s){tail}")
     return 0
+
+
+def _scan_rels(paths):
+    from .engine import iter_python_files
+    return iter_python_files(paths)
 
 
 if __name__ == "__main__":

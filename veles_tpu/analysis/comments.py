@@ -28,6 +28,19 @@ The analyzer's three in-source annotations all live in comments, so one
     On a ``def`` line: mark the function a trace root without a
     registry entry — the escape hatch for modules the registry does not
     know (and the fixture syntax the analyzer's own tests use).
+
+``# shard-map-root: axis[,axis...]``
+    On a ``def`` line: the function's body runs under ``shard_map`` (or
+    a schedule's manual-axes scope) with the named mesh axes bound —
+    raw collectives (``psum``/``ppermute``/…) are legal inside it
+    (sharding_rules, VS502) and literal axis names are checked against
+    the listed environment (VS501).  The registry's ``SHARD_MAP_ROOTS``
+    is the checked-in form; the comment is the fixture/escape syntax.
+
+``# host-loop-root:``
+    On a ``def`` line: the function is a hot host loop (scheduler tick,
+    REST request handler) — traced-program builders reachable from it
+    must route through ``StepCache`` (recompile_rules, VP603).
 """
 
 from __future__ import annotations
@@ -45,6 +58,9 @@ _GUARDED_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][\w.]*)")
 _REQUIRES_RE = re.compile(r"#\s*requires-lock:\s*([A-Za-z_][\w.]*)")
 _TRACEROOT_RE = re.compile(r"#\s*trace-root:\s*(traced|builder)")
 _NOTSHARED_RE = re.compile(r"#\s*not-shared:\s*(\S.*)")
+_SHARDROOT_RE = re.compile(
+    r"#\s*shard-map-root:\s*([A-Za-z_]\w*(?:\s*,\s*[A-Za-z_]\w*)*)")
+_HOSTLOOP_RE = re.compile(r"#\s*host-loop-root:")
 
 
 @dataclasses.dataclass
@@ -67,6 +83,10 @@ class FileComments:
     trace_root: Dict[int, str]
     #: comment line -> reason the method is construction-only
     not_shared: Dict[int, str]
+    #: comment line -> tuple of mesh axes bound in the shard_map body
+    shard_map_root: Dict[int, Tuple[str, ...]]
+    #: comment lines marked as host hot loops (VP603 roots)
+    host_loop_root: Set[int]
 
     def suppressed(self, line: int, rule: str) -> Optional[Suppression]:
         s = self.suppressions.get(line)
@@ -94,7 +114,7 @@ def scan_comments(source: str) -> FileComments:
             for ln in range(tok.start[0], tok.end[0] + 1):
                 code_lines.add(ln)
 
-    out = FileComments({}, {}, {}, {}, {})
+    out = FileComments({}, {}, {}, {}, {}, {}, set())
     n_lines = source.count("\n") + 1
     for line, _col, text in comments:
         m = _DISABLE_RE.search(text)
@@ -126,4 +146,10 @@ def scan_comments(source: str) -> FileComments:
         m = _NOTSHARED_RE.search(text)
         if m:
             out.not_shared[line] = m.group(1)
+        m = _SHARDROOT_RE.search(text)
+        if m:
+            out.shard_map_root[line] = tuple(
+                a.strip() for a in m.group(1).split(","))
+        if _HOSTLOOP_RE.search(text):
+            out.host_loop_root.add(line)
     return out

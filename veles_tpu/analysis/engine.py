@@ -11,12 +11,11 @@ import os
 from typing import Dict, List, Optional, Tuple
 
 from . import (concurrency_rules, config_rules, metrics_rules,
-               trace_rules)
-from .baseline import find_baseline, load_baseline, split_baselined
-from .findings import SEVERITIES, Finding, sort_key
+               recompile_rules, sharding_rules, trace_rules)
+from .baseline import (entry_file_exists, find_baseline, load_baseline,
+                       split_baselined)
+from .findings import Finding, sort_key
 from .pysrc import ParsedFile, parse_file
-
-SEVERITIES.setdefault("VA002", "error")     # unparseable source
 
 _SKIP_DIRS = {"__pycache__", ".git", ".venv", "node_modules"}
 
@@ -69,9 +68,17 @@ def iter_python_files(paths) -> List[Tuple[str, str]]:
 
 def analyze_files(file_list: List[Tuple[str, str]], *,
                   trace_roots: Optional[Dict[str, Dict[str, str]]] = None,
-                  docs_dir: Optional[str] = None) -> List[Finding]:
+                  docs_dir: Optional[str] = None,
+                  package_scan: Optional[bool] = None) -> List[Finding]:
     """Run every rule over the files; returns findings AFTER inline
-    suppressions (``# lint: disable=``) but BEFORE the baseline."""
+    suppressions (``# lint: disable=``) but BEFORE the baseline.
+
+    ``package_scan`` gates the whole-inventory rules (VK302/VK303 dead/
+    undocumented config keys, VM402 ghost metrics): they can only prove
+    "nowhere" against a full package, so a subset scan (``--changed``,
+    a single file) must not fire them.  ``None`` keeps each rule's own
+    legacy inference; :func:`run_analysis` passes the real answer —
+    whether any analyzed PATH argument was a package directory."""
     parsed: List[ParsedFile] = []
     findings: List[Finding] = []
     by_path: Dict[str, ParsedFile] = {}
@@ -80,7 +87,7 @@ def analyze_files(file_list: List[Tuple[str, str]], *,
             pf = parse_file(full, rel)
         except (SyntaxError, UnicodeDecodeError) as e:
             findings.append(Finding(
-                rule="VA002", path=rel.replace(os.sep, "/"),
+                rule="VA003", path=rel.replace(os.sep, "/"),
                 line=getattr(e, "lineno", 1) or 1, col=0,
                 message=f"file does not parse: {e.msg if hasattr(e, 'msg') else e}",
                 hint="the analyzer needs valid Python"))
@@ -101,8 +108,12 @@ def analyze_files(file_list: List[Tuple[str, str]], *,
                             "(`# lint: disable=RULE why`)",
                     hint="say why the finding is acceptable",
                     snippet=pf.line_text(sup.comment_line)))
-    findings.extend(config_rules.check(parsed, docs_dir))
-    findings.extend(metrics_rules.check(parsed, docs_dir))
+    findings.extend(config_rules.check(parsed, docs_dir,
+                                       package_scan=package_scan))
+    findings.extend(metrics_rules.check(parsed, docs_dir,
+                                        package_scan=package_scan))
+    findings.extend(sharding_rules.check(parsed))
+    findings.extend(recompile_rules.check(parsed))
 
     kept: List[Finding] = []
     for f in findings:
@@ -145,10 +156,58 @@ def run_analysis(paths, *, baseline_path: Optional[str] = "auto",
     if baseline_path == "auto":
         baseline_path = find_baseline(
             os.path.abspath(paths[0])) if paths else None
+    # whole-inventory rules need a whole package: true only when some
+    # PATH argument is a package directory (never for --changed /
+    # single-file scans, whose file list may happen to include an
+    # __init__.py without covering the package)
+    package_scan = any(
+        os.path.isdir(p)
+        and os.path.isfile(os.path.join(p, "__init__.py"))
+        for p in paths)
     all_findings = analyze_files(file_list, trace_roots=trace_roots,
-                                 docs_dir=docs_dir)
+                                 docs_dir=docs_dir,
+                                 package_scan=package_scan)
     baseline = load_baseline(baseline_path)
     new, accepted = split_baselined(all_findings, baseline)
+    new.extend(_stale_baseline_findings(baseline, baseline_path,
+                                        file_list, accepted))
+    new.sort(key=sort_key)
     return {"findings": new, "accepted": accepted, "all": all_findings,
             "files": len(file_list), "baseline_path": baseline_path,
             "docs_dir": docs_dir}
+
+
+def _stale_baseline_findings(baseline, baseline_path, file_list,
+                             accepted):
+    """VA002 (warning) for baseline entries nothing matches anymore:
+    either the entry's file was scanned and the finding is gone (fixed
+    — the debt record lingers), or the file itself no longer exists.
+    Entries for files outside a subset scan are left alone — a
+    one-file pre-commit run cannot judge the rest of the baseline."""
+    if not baseline:
+        return []
+    matched = {f.fingerprint() for f in accepted}
+    scanned = {rel.replace(os.sep, "/") for _full, rel in file_list}
+    base_dir = os.path.dirname(os.path.abspath(baseline_path)) \
+        if baseline_path else os.getcwd()
+    bl_name = os.path.basename(baseline_path) if baseline_path \
+        else "baseline"
+    out = []
+    for fp, entry in sorted(baseline.items()):
+        if fp in matched:
+            continue
+        path = entry.get("path", "?")
+        exists = path in scanned \
+            or entry_file_exists(path, base_dir)
+        if path in scanned or not exists:
+            out.append(Finding(
+                rule="VA002", path=path,
+                line=int(entry.get("line", 1) or 1), col=0,
+                message=f"stale baseline entry ({entry.get('rule', '?')}"
+                        f" {fp}): the finding it accepted no longer "
+                        "exists" + ("" if exists
+                                    else " (file is gone)"),
+                hint=f"run --write-baseline to prune {bl_name}",
+                symbol=entry.get("symbol", ""),
+                snippet=entry.get("snippet", "")))
+    return out

@@ -15,6 +15,8 @@ import hashlib
 #: severity per rule id (docs/analysis.md has the full catalogue).
 SEVERITIES = {
     "VA001": "warning",   # suppression without a reason
+    "VA002": "warning",   # stale baseline entry (finding/file gone)
+    "VA003": "error",     # unparseable source
     "VT101": "error",     # Python control flow on a traced value
     "VT102": "error",     # host coercion of a traced value
     "VT103": "warning",   # host-effect call inside traced scope
@@ -27,7 +29,25 @@ SEVERITIES = {
     "VK303": "warning",   # declared config key absent from the docs
     "VM401": "error",     # metric registered but absent from the docs
     "VM402": "warning",   # metric documented but registered nowhere
+    "VS501": "error",     # collective/spec axis no mesh declares
+    "VS502": "error",     # collective outside shard_map/schedule scope
+    "VS503": "error",     # partition spec references undeclared axis
+    "VP601": "error",     # per-call-varying value into a builder slot
+    "VP602": "warning",   # mapping-order pytree structure in a builder
+    "VP603": "error",     # builder on a hot path outside StepCache
+    "VC204": "error",     # lock-order cycle (deadlock)
+    "VC205": "error",     # blocking call under an annotated lock
 }
+
+#: rule families for the CLI's per-family counts (--json): prefix ->
+#: catalogue family id.  Stable key set — CI dashboards chart these.
+FAMILIES = ("VA0xx", "VT1xx", "VC2xx", "VK3xx", "VM4xx", "VS5xx",
+            "VP6xx")
+
+
+def family(rule: str) -> str:
+    """``VT101`` -> ``VT1xx``."""
+    return rule[:3] + "xx"
 
 
 @dataclasses.dataclass

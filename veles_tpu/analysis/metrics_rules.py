@@ -68,6 +68,8 @@ def _symbol_at(pf: ParsedFile, line: int) -> str:
 def _collect_registrations(pf: ParsedFile) -> Dict[str, Tuple[int, int]]:
     """name -> (line, col) of the first registration call in the file."""
     out: Dict[str, Tuple[int, int]] = {}
+    if METRIC_PREFIX not in pf.source:   # cheap textual pre-filter
+        return out
     for node in ast.walk(pf.tree):
         if not isinstance(node, ast.Call) or not node.args:
             continue
@@ -99,7 +101,8 @@ def _doc_names(docs_dir: str) -> Optional[Tuple[str, str]]:
 
 
 def check(files: List[ParsedFile],
-          docs_dir: Optional[str] = None) -> List[Finding]:
+          docs_dir: Optional[str] = None, *,
+          package_scan: Optional[bool] = None) -> List[Finding]:
     if not docs_dir:
         return []
     doc = _doc_names(docs_dir)
@@ -141,10 +144,14 @@ def check(files: List[ParsedFile],
         return False
 
     # "registered nowhere" is only provable against the full inventory:
-    # skip VM402 for single-file / subset scans (no package __init__.py
-    # among the scanned files) and for trees registering nothing
-    package_scan = any(
-        os.path.basename(pf.relpath) == "__init__.py" for pf in files)
+    # skip VM402 for subset scans — the engine says whether a package
+    # DIRECTORY was analyzed (an __init__.py merely being among the
+    # changed files proves nothing); legacy callers (None) fall back to
+    # the scanned-files inference — and for trees registering nothing
+    if package_scan is None:
+        package_scan = any(
+            os.path.basename(pf.relpath) == "__init__.py"
+            for pf in files)
     if registered and package_scan:
         for name in sorted(documented):
             if name in registered or _is_derived(name):

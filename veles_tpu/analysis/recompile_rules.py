@@ -1,0 +1,417 @@
+"""VP6xx — recompile-hazard analysis at builder call sites.
+
+The two-program-kind compile discipline (docs/serving.md; StepCache's
+flat counters) holds only while every *builder* — a registry
+``BUILDER`` root whose arguments are static Python baked into the
+traced program — is fed genuinely static values and invoked through a
+compile cache.  The def-site convention (static knobs are
+keyword-only) is enforced by the VT1xx taint pass; this family
+enforces the same contract at the CALL site:
+
+VP601  a per-call-varying Python value — a loop variable, the
+       ``len()`` of a runtime collection, a ``time``/``uuid``/
+       ``random``-derived value, or anything assigned from one —
+       flowing into a builder argument slot.  Every distinct value is
+       a distinct traced program: a cache key at best, an unbounded
+       recompile stream at worst — error.  Bounded static inventories
+       (the prefill bucket table) are the legitimate exception and
+       carry an inline ``# lint: disable=VP601 <why static>``.
+VP602  dict/set iteration constructing pytree structure inside a
+       builder body: the caller's mapping insertion order becomes the
+       pytree (and therefore cache-key) order — an invisible cache key
+       that differs between processes doing the same work in a
+       different order.  ``sorted(...)`` fixes it — warning.
+       (Unordered-*set* iteration inside traced scope is VT104's; this
+       rule covers the caller-supplied-mapping case VT104 cannot see.)
+VP603  a builder reachable from a host hot loop (the engine scheduler
+       tick, a REST request handler — ``HOST_LOOP_ROOTS``, closed
+       module-locally) that is not routed through ``StepCache
+       .get_step`` or a registry-declared self-caching builder
+       (``SELF_CACHING_BUILDERS``): a lazy recompile smuggled past
+       the counters every test asserts flat — error.
+
+Builder names come from the registry (``TRACE_ROOTS`` entries in
+``BUILDER`` mode) plus per-file ``# trace-root: builder`` markers;
+call sites match on the final name (``self.plan.init_caches`` matches
+the ``DecodePlan.init_caches`` root) — module-local resolution, the
+same deliberate scope limit as every other family here.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from .findings import Finding
+from .pysrc import FnInfo, ParsedFile, dotted_name, local_closure
+from .registry import (BUILDER, HOST_LOOP_ROOTS, SELF_CACHING_BUILDERS,
+                       TRACE_ROOTS)
+
+#: modules whose call results vary per call (VP601 taint sources).
+_VARYING_MODULES = ("time", "uuid", "random", "secrets", "datetime")
+
+
+def builder_names(files: List[ParsedFile]) -> Set[str]:
+    """Final names of every registered BUILDER root (global registry +
+    inline ``# trace-root: builder`` markers in the scanned files)."""
+    names: Set[str] = set()
+    for entry in TRACE_ROOTS.values():
+        for q, mode in entry.items():
+            if mode == BUILDER:
+                names.add(q.split(".")[-1])
+    for pf in files:
+        for q, info in pf.functions.items():
+            if pf.comments.trace_root.get(info.node.lineno) == "builder":
+                names.add(q.split(".")[-1])
+    return names
+
+
+def _call_final_name(node: ast.Call) -> Optional[str]:
+    if isinstance(node.func, ast.Name):
+        return node.func.id
+    if isinstance(node.func, ast.Attribute):
+        return node.func.attr
+    return None
+
+
+def _program_scope(pf: ParsedFile) -> Set[str]:
+    """Functions inside the traced-program closure (all trace roots,
+    both modes, nested defs and called helpers included).  Builder
+    calls HERE are build-time composition inside one program build —
+    ``make_prefill_fn`` delegating to ``_make_paged_prefill_fn``, a
+    plan's ``init_caches`` assembling per-unit sub-caches — mediated by
+    whatever cache routed the outer builder; VP601/VP603 enforce at
+    the host-code boundary, not inside it.  Memoized per parse (one
+    closure walk per file, shared by VP601 and VP603)."""
+    cached = getattr(pf, "_vp_program_scope", None)
+    if cached is not None:
+        return cached
+    from .trace_rules import _roots_for
+    roots = _roots_for(pf, None)
+    scope = local_closure(pf, roots) if roots else set()
+    pf._vp_program_scope = scope
+    return scope
+
+
+def _is_test_file(pf: ParsedFile) -> bool:
+    """The compile discipline binds the PRODUCT: tests loop builders
+    over geometries on purpose (parameterized compile coverage), so
+    the VP6xx family skips them — the same reasoning as VM402's
+    package-scan gate."""
+    parts = pf.relpath.split("/")
+    return "tests" in parts[:-1] or parts[-1].startswith("test_") \
+        or parts[-1] == "conftest.py"
+
+
+def check(files: List[ParsedFile]) -> List[Finding]:
+    files = [pf for pf in files if not _is_test_file(pf)]
+    builders = builder_names(files)
+    out: List[Finding] = []
+    for pf in files:
+        _vp601_file(pf, builders, out)
+        _vp602_file(pf, out)
+        _vp603_file(pf, builders, out)
+    return out
+
+
+# -- VP601: varying values into builder slots --------------------------------
+
+class _VaryTaint:
+    """Statement-order varying-value taint over one function body:
+    sources are loop targets, ``len()`` results and ``time``/``uuid``/
+    ``random`` calls; propagation follows assignments and arithmetic.
+    Deliberately join-free, like the VT1xx pass."""
+
+    def __init__(self, pf: ParsedFile, info: FnInfo,
+                 builders: Set[str], out: List[Finding]):
+        self.pf = pf
+        self.info = info
+        self.builders = builders
+        self.out = out
+        self.env: Set[str] = set()
+        #: name -> why it varies (for the message)
+        self.why: Dict[str, str] = {}
+
+    def _emit(self, node: ast.AST, what: str):
+        self.out.append(Finding(
+            rule="VP601", path=self.pf.relpath, line=node.lineno,
+            col=node.col_offset,
+            message=f"per-call-varying value ({what}) flows into a "
+                    "static argument slot of a traced-program builder "
+                    "— every distinct value traces and compiles a new "
+                    "program",
+            hint="hoist the varying value out (pass it as traced data),"
+                 " or justify a bounded inventory inline "
+                 "(`# lint: disable=VP601 <why the set is static>`)",
+            symbol=self.info.qualname,
+            snippet=self.pf.line_text(node.lineno)))
+
+    # returns a description of why the expression varies, or None
+    def varies(self, node: Optional[ast.AST]) -> Optional[str]:
+        if node is None or isinstance(node, ast.Constant):
+            return None
+        if isinstance(node, ast.Name):
+            return self.why.get(node.id) if node.id in self.env else None
+        if isinstance(node, ast.Call):
+            name = _call_final_name(node)
+            if isinstance(node.func, ast.Name) and node.func.id == "len":
+                return "len() of a runtime collection"
+            chain = dotted_name(node.func)
+            if chain is not None:
+                head = self.pf.resolve_chain(chain).split(".")[0]
+                if head in _VARYING_MODULES:
+                    return f"`{chain}(...)` result"
+            for a in list(node.args) + [k.value for k in node.keywords]:
+                w = self.varies(a)
+                if w:
+                    return w
+            return None
+        if isinstance(node, ast.BinOp):
+            return self.varies(node.left) or self.varies(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.varies(node.operand)
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            for e in node.elts:
+                w = self.varies(e)
+                if w:
+                    return w
+            return None
+        if isinstance(node, ast.IfExp):
+            return self.varies(node.body) or self.varies(node.orelse)
+        if isinstance(node, ast.Subscript):
+            return self.varies(node.value) or self.varies(node.slice)
+        return None
+
+    def _assign(self, target: ast.AST, why: Optional[str]):
+        if isinstance(target, ast.Name):
+            if why:
+                self.env.add(target.id)
+                self.why[target.id] = why
+            else:
+                self.env.discard(target.id)
+                self.why.pop(target.id, None)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._assign(e, why)
+        elif isinstance(target, ast.Starred):
+            self._assign(target.value, why)
+
+    def _check_call(self, node: ast.Call):
+        name = _call_final_name(node)
+        if name not in self.builders:
+            return
+        for a in list(node.args) + [k.value for k in node.keywords]:
+            w = self.varies(a)
+            if w:
+                self._emit(node, w)
+                return      # one finding per call site
+
+    def run(self):
+        self._stmts(self.info.node.body)
+
+    def _stmts(self, body):
+        for stmt in body:
+            self._stmt(stmt)
+
+    def _stmt(self, stmt: ast.stmt):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return                  # nested defs get their own walk
+        if isinstance(stmt, ast.For):
+            self._scan_calls(stmt.iter)
+            self._assign(stmt.target, "loop variable")
+            self._stmts(stmt.body)
+            self._stmts(stmt.orelse)
+            return
+        if isinstance(stmt, ast.Assign):
+            self._scan_calls(stmt.value)
+            why = self.varies(stmt.value)
+            for t in stmt.targets:
+                self._assign(t, why)
+            return
+        if isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            self._scan_calls(stmt.value)
+            self._assign(stmt.target, self.varies(stmt.value))
+            return
+        if isinstance(stmt, ast.AugAssign):
+            self._scan_calls(stmt.value)
+            why = self.varies(stmt.value)
+            if why and isinstance(stmt.target, ast.Name):
+                self.env.add(stmt.target.id)
+                self.why[stmt.target.id] = why
+            return
+        # other statements: scan expressions for builder calls, recurse
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self._scan_calls(child)
+            elif isinstance(child, ast.stmt):
+                self._stmt(child)
+
+    def _scan_calls(self, node: ast.AST):
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                self._check_call(sub)
+            elif isinstance(sub, (ast.ListComp, ast.SetComp,
+                                  ast.GeneratorExp, ast.DictComp)):
+                # comprehension targets vary per element; builder calls
+                # inside the element expression see that
+                for gen in sub.generators:
+                    self._assign(gen.target, "comprehension variable")
+
+
+def _vp601_file(pf: ParsedFile, builders: Set[str],
+                out: List[Finding]):
+    if not builders or not any(b in pf.source for b in builders):
+        return
+    scope = _program_scope(pf)
+    for q, info in pf.functions.items():
+        if q in scope:
+            continue    # build-time composition: see _program_scope
+        _VaryTaint(pf, info, builders, out).run()
+
+
+# -- VP602: mapping-order pytree structure inside builders -------------------
+
+def _builder_scope(pf: ParsedFile) -> Set[str]:
+    """BUILDER-mode roots of this file (registry longest-suffix entry +
+    inline markers).  The roots THEMSELVES, not their nested defs —
+    nested defs are the traced programs, where VT104 owns iteration
+    order."""
+    table = {}
+    best = ""
+    for key, entry in TRACE_ROOTS.items():
+        if (pf.relpath == key or pf.relpath.endswith("/" + key)) \
+                and len(key) > len(best):
+            best, table = key, dict(entry)
+    roots = {q for q, mode in table.items()
+             if mode == BUILDER and q in pf.functions}
+    for q, info in pf.functions.items():
+        if pf.comments.trace_root.get(info.node.lineno) == "builder":
+            roots.add(q)
+    return roots
+
+
+def _vp602_file(pf: ParsedFile, out: List[Finding]):
+    for q in sorted(_builder_scope(pf)):
+        info = pf.functions[q]
+        params = {a.arg for a in (
+            list(info.node.args.posonlyargs) + list(info.node.args.args)
+            + list(info.node.args.kwonlyargs))} - {"self", "cls"}
+
+        def param_mapping_iter(it: ast.AST) -> Optional[str]:
+            """The parameter name when ``it`` iterates a caller-supplied
+            mapping (``p`` / ``p.items()`` / ``p.keys()`` /
+            ``p.values()``), else None."""
+            if isinstance(it, ast.Name) and it.id in params:
+                return it.id
+            if isinstance(it, ast.Call) \
+                    and isinstance(it.func, ast.Attribute) \
+                    and it.func.attr in ("items", "keys", "values") \
+                    and isinstance(it.func.value, ast.Name) \
+                    and it.func.value.id in params:
+                return it.func.value.id
+            return None
+
+        for node in ast.walk(info.node):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node is not info.node:
+                continue        # children walked via ast.walk anyway —
+            iters = []          # nested defs excluded below by line
+            if isinstance(node, ast.For):
+                iters = [(node.iter, node)]
+            elif isinstance(node, (ast.ListComp, ast.SetComp,
+                                   ast.GeneratorExp, ast.DictComp)):
+                iters = [(g.iter, node) for g in node.generators]
+            for it, at in iters:
+                if not _line_in_own_body(pf, info, at.lineno):
+                    continue
+                name = param_mapping_iter(it)
+                if name is None:
+                    continue
+                out.append(Finding(
+                    rule="VP602", path=pf.relpath, line=at.lineno,
+                    col=at.col_offset,
+                    message=f"builder iterates caller-supplied mapping "
+                            f"`{name}` — its insertion order becomes "
+                            "pytree structure order, an invisible "
+                            "compile-cache key",
+                    hint=f"iterate `sorted({name}.items())` (or take a "
+                         "static sequence) so two processes building "
+                         "the same program emit the same structure",
+                    symbol=q, snippet=pf.line_text(at.lineno)))
+
+
+def _line_in_own_body(pf: ParsedFile, info: FnInfo, line: int) -> bool:
+    """True when ``line`` is in the function's own body, not inside one
+    of its nested ``def``s (those are traced programs, not build
+    code)."""
+    for q2, i2 in pf.functions.items():
+        if i2.node is info.node:
+            continue
+        if not q2.startswith(info.qualname + "."):
+            continue
+        end = getattr(i2.node, "end_lineno", i2.node.lineno)
+        if i2.node.lineno <= line <= end:
+            return False
+    return True
+
+
+# -- VP603: builders reachable from host loops, outside StepCache ------------
+
+def _host_roots_for(pf: ParsedFile) -> Set[str]:
+    roots: Set[str] = set()
+    best = ""
+    for key, entry in HOST_LOOP_ROOTS.items():
+        if (pf.relpath == key or pf.relpath.endswith("/" + key)) \
+                and len(key) > len(best):
+            best, roots = key, set(entry)
+    for q, info in pf.functions.items():
+        if info.node.lineno in pf.comments.host_loop_root:
+            roots.add(q)
+    return {q for q in roots if q in pf.functions}
+
+
+def _vp603_file(pf: ParsedFile, builders: Set[str],
+                out: List[Finding]):
+    roots = _host_roots_for(pf)
+    if not roots or not builders:
+        return
+    scope = local_closure(pf, roots) - _program_scope(pf)
+    # parent chain for the routed-through-StepCache check
+    parents: Dict[int, ast.AST] = {}
+    for parent in ast.walk(pf.tree):
+        for child in ast.iter_child_nodes(parent):
+            parents[id(child)] = parent
+
+    def routed_through_cache(node: ast.AST) -> bool:
+        cur = node
+        while cur is not None:
+            cur = parents.get(id(cur))
+            if isinstance(cur, ast.Call):
+                chain = dotted_name(cur.func)
+                if chain and chain.split(".")[-1] == "get_step":
+                    return True
+        return False
+
+    for q in sorted(scope):
+        info = pf.functions[q]
+        for node in ast.walk(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _call_final_name(node)
+            if name not in builders or name in SELF_CACHING_BUILDERS:
+                continue
+            if routed_through_cache(node):
+                continue
+            out.append(Finding(
+                rule="VP603", path=pf.relpath, line=node.lineno,
+                col=node.col_offset,
+                message=f"builder `{name}` is reachable from a host "
+                        "hot loop (scheduler/REST) without routing "
+                        "through StepCache — a lazy recompile the flat "
+                        "compile counters never see",
+                hint="fetch the program via step_cache.get_step(...) "
+                     "(or register the builder's own memo in "
+                     "registry.SELF_CACHING_BUILDERS with a docstring "
+                     "naming its cache)",
+                symbol=q, snippet=pf.line_text(node.lineno)))

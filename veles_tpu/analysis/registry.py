@@ -79,6 +79,74 @@ TRACE_ROOTS = {
     },
 }
 
+#: module path -> {qualname: (axis, axis, ...)}: functions whose bodies
+#: run under ``shard_map`` (or a schedule's manual-axes scope) with the
+#: listed mesh axes bound.  The VS5xx rules (sharding_rules.py) close
+#: these module-locally exactly like TRACE_ROOTS: raw collectives
+#: (``psum``/``ppermute``/``all_to_all``/…) are legal only inside this
+#: closure (VS502), and literal axis names used inside it must be in
+#: the root's axis environment (VS501).  One-off modules mark roots
+#: inline with ``# shard-map-root: axis[,axis]`` on the ``def`` line.
+SHARD_MAP_ROOTS = {
+    "parallel/ring_attention.py": {
+        "_ring_attention_local": ("seq",),
+    },
+    "parallel/moe.py": {
+        # the expert-parallel formulation for code ALREADY inside a
+        # schedule shard_map (Context.manual_axes routes here)
+        "moe_apply_manual": ("expert",),
+    },
+    "parallel/pipeline.py": {
+        # per-shard schedule bodies: pipeline ring + batch/width axes
+        "_pipeline_local": ("pipe", "data", "fsdp", "seq", "expert"),
+        "_1f1b_local": ("pipe", "data", "fsdp", "seq", "expert"),
+        "_interleaved_local": ("pipe", "data", "fsdp", "seq", "expert"),
+    },
+    "parallel/pipeline_compile.py": {
+        # stage/loss closures execute inside the schedule's shard_map
+        # (PipelinePlan.stage_fns docstring: Context.manual_axes)
+        "PipelinePlan.stage_fns": ("pipe", "seq", "expert"),
+        "PipelinePlan.stage_fn_shared": ("pipe", "seq", "expert"),
+        "PipelinePlan.loss_fn": ("pipe", "seq", "expert"),
+    },
+    "units/parallel_nn.py": {
+        # unit apply bodies run INSIDE the schedule's shard_map when
+        # Context.manual_axes routes them to the manual formulations
+        # (ctx.collective_mode == "manual"); their raw collectives are
+        # gated on exactly that mode
+        "MultiHeadAttention.apply": ("seq",),
+        "MoEFFN.apply": ("expert",),
+    },
+}
+
+#: ``jax.lax`` collective ops that need a named-axis binding -> 0-based
+#: index of their axis-name argument (the VS5xx op inventory).
+COLLECTIVE_OPS = {
+    "psum": 1, "pmean": 1, "pmax": 1, "pmin": 1, "all_gather": 1,
+    "ppermute": 1, "all_to_all": 1, "psum_scatter": 1, "pshuffle": 1,
+    "axis_index": 0,
+}
+
+#: module path -> qualnames of host hot loops (scheduler ticks, REST
+#: request handlers): traced-program *builders* reachable from these
+#: must route through StepCache (recompile_rules.py, VP603) — a lazy
+#: builder call here re-traces per request and smuggles the compile
+#: past the flat-counter contract.  Fixture syntax:
+#: ``# host-loop-root:`` on the ``def`` line.
+HOST_LOOP_ROOTS = {
+    "runtime/engine.py": ("DecodeEngine._loop",),
+    "runtime/restful.py": ("RestfulServer.decode", "RestfulServer.infer"),
+}
+
+#: builders that own a documented per-geometry compile memo instead of
+#: routing through StepCache: ``generate``/``generate_beam`` keep an
+#: LRU keyed on (workflow, geometry, sampling mode) in
+#: runtime/generate.py (``_runner_cache``), sized by
+#: ``root.common.serve.runner_cache``.  VP603 accepts these routes;
+#: adding a name here is a declaration that the builder memoizes —
+#: tests/test_analysis.py guards the declared set.
+SELF_CACHING_BUILDERS = frozenset({"generate", "generate_beam"})
+
 #: ``root.common`` subtrees that are deliberately NOT declared in
 #: config.py: the fault-injection switchboard keeps ``root.common
 #: .faults`` an empty node in production so its presence check stays one

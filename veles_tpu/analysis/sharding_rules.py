@@ -1,0 +1,276 @@
+"""VS5xx — sharding / collective discipline.
+
+Every mesh-axis name in this codebase is a string handed to a
+collective (``jax.lax.psum(x, "data")``), a partition spec
+(``P(None, "seq")``) or a ``shard_map`` — and none of them fail at
+parse time when they drift from the axes the mesh actually declares:
+an undeclared axis is a runtime ``NameError`` deep inside a trace (at
+best) or a silently-replicated tensor (at worst, with
+``check_vma=False``).  GSPMD's lesson (PAPERS.md: arXiv 2105.04663)
+is that sharding bugs are *propagation* bugs — exactly what a static
+pass over the annotations catches before any device is touched.
+
+Three sources of truth are cross-referenced:
+
+* **declared axes** — collected statically from ``parallel/mesh.py``
+  (the ``MeshSpec`` dataclass fields and tuple-of-string axis-name
+  arguments to ``Mesh(...)`` constructors) and from ``config.py``
+  (keys of the ``root.common.mesh`` default dict);
+* **shard-map scope** — the registry's ``SHARD_MAP_ROOTS`` (plus
+  inline ``# shard-map-root: axis[,axis]`` markers), closed
+  module-locally exactly like the trace roots: nested ``def``s and
+  called module-local helpers join the scope;
+* **use sites** — ``jax.lax`` collective calls (``COLLECTIVE_OPS``)
+  and ``PartitionSpec``/``P`` constructions.
+
+VS501  a collective whose literal axis name is declared on no mesh —
+       or, inside a shard-map scope with a declared axis environment,
+       names an axis that scope does not bind — error.
+VS502  a collective call outside any shard-map scope: raw named-axis
+       collectives need the manual axis binding ``shard_map`` (or a
+       schedule's ``Context.manual_axes``) provides; under plain jit
+       they fail at trace time on the deployment that first reaches
+       them — error.
+VS503  a ``PartitionSpec`` (``P(...)``, ``with_sharding_constraint``
+       / ``NamedSharding`` included transitively — the spec is where
+       the literal lives) naming an undeclared axis — error.
+
+VS501/VS503 only fire when the scan actually found axis declarations
+(a subset scan without mesh.py cannot prove "undeclared", the VK302
+bail-out pattern); VS502 needs no declarations — scope is the check.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from .findings import Finding
+from .pysrc import ParsedFile, dotted_name, local_closure
+from .registry import COLLECTIVE_OPS, SHARD_MAP_ROOTS
+
+#: cheap textual pre-filter: a file mentioning none of these cannot
+#: produce a VS5xx finding, so the AST passes skip it entirely.
+_MAYBE_RE = re.compile(
+    r"\b(" + "|".join(sorted(COLLECTIVE_OPS)) + r"|PartitionSpec)\b")
+
+
+def collect_declared_axes(files: List[ParsedFile]) -> Set[str]:
+    """Mesh axis names declared anywhere in the scanned set: MeshSpec
+    dataclass fields (mesh.py), tuple-of-strings axis arguments to
+    ``Mesh(...)``, and keys of ``root.common.mesh`` config defaults."""
+    axes: Set[str] = set()
+    for pf in files:
+        base = os.path.basename(pf.relpath)
+        if base == "mesh.py":
+            for node in ast.walk(pf.tree):
+                if isinstance(node, ast.ClassDef) \
+                        and node.name == "MeshSpec":
+                    for stmt in node.body:
+                        if isinstance(stmt, ast.AnnAssign) \
+                                and isinstance(stmt.target, ast.Name):
+                            axes.add(stmt.target.id)
+                        elif isinstance(stmt, ast.Assign):
+                            for t in stmt.targets:
+                                if isinstance(t, ast.Name):
+                                    axes.add(t.id)
+        # Mesh(devices, ("data", ...)) call sites — any file
+        if "Mesh(" in pf.source:
+            for node in ast.walk(pf.tree):
+                if isinstance(node, ast.Call):
+                    chain = dotted_name(node.func)
+                    if chain and chain.split(".")[-1] == "Mesh" \
+                            and len(node.args) >= 2:
+                        axes |= _literal_strs(node.args[1])
+        if base == "config.py":
+            # root.common.mesh = dict(data=-1) / {"data": -1}
+            for node in ast.walk(pf.tree):
+                if not isinstance(node, ast.Assign):
+                    continue
+                for t in node.targets:
+                    dotted = dotted_name(t)
+                    if dotted and dotted.endswith(".mesh"):
+                        axes |= _dict_keys(node.value)
+    return axes
+
+
+def _literal_strs(node: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    if isinstance(node, (ast.Tuple, ast.List)):
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                out.add(e.value)
+    elif isinstance(node, ast.Constant) and isinstance(node.value, str):
+        out.add(node.value)
+    return out
+
+
+def _dict_keys(node: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    if isinstance(node, ast.Dict):
+        for k in node.keys:
+            if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                out.add(k.value)
+    elif isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+            and node.func.id == "dict":
+        for kw in node.keywords:
+            if kw.arg:
+                out.add(kw.arg)
+    return out
+
+
+def _shard_roots_for(pf: ParsedFile) -> Dict[str, Tuple[str, ...]]:
+    """SHARD_MAP_ROOTS entry for this file (longest path-suffix key
+    wins, the trace_rules convention) merged with inline
+    ``# shard-map-root:`` markers."""
+    roots: Dict[str, Tuple[str, ...]] = {}
+    best = ""
+    for key, entry in SHARD_MAP_ROOTS.items():
+        if (pf.relpath == key or pf.relpath.endswith("/" + key)) \
+                and len(key) > len(best):
+            best, roots = key, dict(entry)
+    for q, info in pf.functions.items():
+        env = pf.comments.shard_map_root.get(info.node.lineno)
+        if env is not None:
+            roots[q] = env
+    return roots
+
+
+def _collective_axis_literals(pf: ParsedFile,
+                              node: ast.Call) -> Tuple[str, Set[str]]:
+    """(op name, literal axis strings) for a jax.lax collective call;
+    op is "" when the call is not a collective."""
+    chain = dotted_name(node.func)
+    if chain is None:
+        return "", set()
+    resolved = pf.resolve_chain(chain)
+    leaf = resolved.split(".")[-1]
+    if leaf not in COLLECTIVE_OPS:
+        return "", set()
+    # only count the op when it comes from jax.lax (or is imported from
+    # it): a method named .psum on some object is not a collective
+    head = resolved.split(".")[0]
+    if head not in ("jax", "lax") and "lax" not in resolved.split("."):
+        return "", set()
+    idx = COLLECTIVE_OPS[leaf]
+    axes: Set[str] = set()
+    if len(node.args) > idx:
+        axes |= _literal_strs(node.args[idx])
+    for kw in node.keywords:
+        if kw.arg in ("axis_name", "axis_names"):
+            axes |= _literal_strs(kw.value)
+    return leaf, axes
+
+
+def check(files: List[ParsedFile]) -> List[Finding]:
+    declared = collect_declared_axes(files)
+    out: List[Finding] = []
+    for pf in files:
+        if _MAYBE_RE.search(pf.source):
+            out.extend(_check_file(pf, declared))
+    return out
+
+
+def _check_file(pf: ParsedFile, declared: Set[str]) -> List[Finding]:
+    out: List[Finding] = []
+    roots = _shard_roots_for(pf)
+    scope = local_closure(pf, roots) if roots else set()
+    # axis environment per in-scope function: union of the declaring
+    # roots' envs (module-local closure keeps this coarse on purpose)
+    env: Tuple[str, ...] = tuple(sorted(
+        {a for axes in roots.values() for a in axes}))
+
+    # function spans for symbol attribution
+    def symbol_at(line: int) -> str:
+        best, best_span = "", None
+        for q, info in pf.functions.items():
+            node = info.node
+            end = getattr(node, "end_lineno", node.lineno)
+            if node.lineno <= line <= end:
+                span = end - node.lineno
+                if best_span is None or span < best_span:
+                    best, best_span = q, span
+        return best
+
+    in_scope_lines: List[Tuple[int, int]] = []
+    for q in scope:
+        node = pf.functions[q].node
+        in_scope_lines.append(
+            (node.lineno, getattr(node, "end_lineno", node.lineno)))
+
+    def in_scope(line: int) -> bool:
+        return any(lo <= line <= hi for lo, hi in in_scope_lines)
+
+    for node in ast.walk(pf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        op, axes = _collective_axis_literals(pf, node)
+        if op:
+            if not in_scope(node.lineno):
+                out.append(Finding(
+                    rule="VS502", path=pf.relpath, line=node.lineno,
+                    col=node.col_offset,
+                    message=f"collective `{op}` outside any shard_map/"
+                            "schedule traced scope — raw named-axis "
+                            "collectives need the manual axis binding "
+                            "a shard_map body provides",
+                    hint="move it into a shard_map-wrapped body and "
+                         "declare the root in analysis/registry.py "
+                         "SHARD_MAP_ROOTS (or `# shard-map-root: "
+                         "axis` on the def line)",
+                    symbol=symbol_at(node.lineno),
+                    snippet=pf.line_text(node.lineno)))
+            for axis in sorted(axes):
+                if declared and axis not in declared:
+                    out.append(Finding(
+                        rule="VS501", path=pf.relpath, line=node.lineno,
+                        col=node.col_offset,
+                        message=f"collective `{op}` names axis "
+                                f"`{axis}`, which no mesh declares "
+                                f"(declared: {sorted(declared)})",
+                        hint="fix the axis name, or declare it on the "
+                             "MeshSpec in parallel/mesh.py",
+                        symbol=symbol_at(node.lineno),
+                        snippet=pf.line_text(node.lineno)))
+                elif env and in_scope(node.lineno) and axis not in env \
+                        and axis in declared:
+                    out.append(Finding(
+                        rule="VS501", path=pf.relpath, line=node.lineno,
+                        col=node.col_offset,
+                        message=f"collective `{op}` names axis "
+                                f"`{axis}`, which this shard_map scope "
+                                f"does not bind (environment: "
+                                f"{sorted(env)})",
+                        hint="bind the axis in the shard_map (and its "
+                             "registry entry) or fix the name",
+                        symbol=symbol_at(node.lineno),
+                        snippet=pf.line_text(node.lineno)))
+            continue
+        # VS503: PartitionSpec literals (P("data", None), NamedSharding
+        # and with_sharding_constraint reach here through the P inside)
+        if not declared:
+            continue
+        chain = dotted_name(node.func)
+        if chain is None:
+            continue
+        resolved = pf.resolve_chain(chain)
+        if resolved.split(".")[-1] not in ("PartitionSpec",):
+            continue
+        spec_axes: Set[str] = set()
+        for a in node.args:
+            spec_axes |= _literal_strs(a)
+        for axis in sorted(spec_axes):
+            if axis not in declared:
+                out.append(Finding(
+                    rule="VS503", path=pf.relpath, line=node.lineno,
+                    col=node.col_offset,
+                    message=f"partition spec names axis `{axis}`, "
+                            f"which no mesh declares (declared: "
+                            f"{sorted(declared)})",
+                    hint="fix the axis name, or declare it on the "
+                         "MeshSpec in parallel/mesh.py",
+                    symbol=symbol_at(node.lineno),
+                    snippet=pf.line_text(node.lineno)))
+    return out

@@ -226,6 +226,13 @@ def moe_apply_manual(params: dict, x: jnp.ndarray, *, axis_name: str,
     ``axis_name`` so it equals ``moe_apply``'s global-batch formulation
     on the dispatch group's full token set (every rank returns the same
     value — reductions that average it across ranks keep it exact).
+
+    Registered in ``analysis/registry.py`` ``SHARD_MAP_ROOTS`` with
+    axis environment ``("expert",)``: the raw ``all_to_all``/``psum``/
+    ``axis_index`` here (and in :func:`_switch_aux`, which joins the
+    scope through the module-local closure) are legal exactly because
+    callers are already inside a schedule shard_map — veles-tpu-lint
+    VS502 enforces it.
     """
     T = x.shape[0]
     E = params["router"].shape[1]

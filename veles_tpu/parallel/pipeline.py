@@ -65,6 +65,11 @@ def _pipeline_local(stage_params, x_blk, *args, apply_local,
                     keyed: bool = False, batch_axes=()):
     """Per-device body under shard_map.
 
+    (Like ``_1f1b_local`` and ``_interleaved_local``, registered in
+    ``analysis/registry.py`` ``SHARD_MAP_ROOTS`` — the schedule bodies
+    are where the analyzer permits raw ``ppermute``/``psum``, with the
+    pipe/batch/width axes as the declared environment.)
+
     stage_params: this device's stage params — every leaf has leading
     stage-axis extent 1 (homogeneous: the P(pipe)-sharded stacked tree;
     heterogeneous: a (1, P_max) raveled vector).

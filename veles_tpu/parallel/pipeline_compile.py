@@ -323,6 +323,9 @@ class PipelinePlan:
         transports are width-sharded, expert when microbatches shard
         over it), and units route to their manual formulations
         (``_ring_attention_local``, ``moe_apply_manual``) on those.
+        ``stage_fns``/``stage_fn_shared``/``loss_fn`` are registered
+        shard-map roots in ``analysis/registry.py`` for the same
+        reason (veles-tpu-lint VS502).
         Under sequence parallelism all shapes here are per-rank shards;
         the per-microbatch key additionally folds in the seq rank so
         stochastic draws decorrelate across sequence chunks."""

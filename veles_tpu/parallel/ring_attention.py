@@ -140,7 +140,15 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
                           window: Optional[int] = None):
     """Per-shard body (runs under shard_map): rotate K/V around the ring.
     With GQA (fewer kv heads) the RING TRAFFIC stays kv-head sized; heads
-    expand only transiently inside each fold."""
+    expand only transiently inside each fold.
+
+    Registered in ``analysis/registry.py`` ``SHARD_MAP_ROOTS`` with
+    axis environment ``("seq",)``: the raw ``ppermute``/``psum`` here
+    are legal exactly because this body is shard_map-wrapped (callers:
+    :func:`ring_attention`'s wrapper, and ``MultiHeadAttention.apply``
+    when ``Context.manual_axes`` says a schedule already opened the
+    shard_map) — veles-tpu-lint VS502 flags collectives outside such a
+    registered scope."""
     axis_size = jax.lax.psum(1, axis_name)
     axis_idx = jax.lax.axis_index(axis_name)
     B, Tq, H, D = q.shape

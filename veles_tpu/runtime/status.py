@@ -68,13 +68,20 @@ class StatusReporter(Logger):
             root.common.observe.get("status_flush_s", 0.25)
             if flush_interval_s is None else flush_interval_s)
         # one reporter, many writers (engine scheduler, deploy control
-        # plane, trainer): serialize the read-modify-write on _extra /
-        # _events and the tmp-file replace
+        # plane, trainer): _lock serializes the read-modify-write on
+        # _extra / _events and stays IO-free — the scheduler tick must
+        # never stall behind a slow disk (veles-tpu-lint VC205); the
+        # actual tmp-file write serializes on _io_lock, a dedicated
+        # IO mutex held across the write by design (unannotated: it
+        # guards no shared data, only orders the file replaces)
         self._extra = {}  # guarded-by: self._lock
         self._events = collections.deque(maxlen=max(1, int(events_max)))  # guarded-by: self._lock
         self._lock = threading.Lock()
         self._last_flush = 0.0  # guarded-by: self._lock
         self._flush_timer: Optional[threading.Timer] = None  # guarded-by: self._lock
+        self._doc_seq = 0  # guarded-by: self._lock
+        self._io_lock = threading.Lock()
+        self._written_seq = 0   # newest doc seq on disk (under _io_lock)
         reg = registry()
         self._m_flushes = reg.counter(
             "vt_status_flushes_total", "status.json writes")
@@ -110,15 +117,20 @@ class StatusReporter(Logger):
             # an un-locked append can blow up that iteration
             self._events.append(
                 {"kind": str(kind), "time": round(time.time(), 3), **info})
-            self._flush_locked(coalesce=True)
+            stamped = self._flush_locked(coalesce=True)
+        self._write_doc(stamped)
         span_ring().add_instant(str(kind), at, cat="status", args=info)
 
     def update(self, **fields) -> None:
         with self._lock:
             self._extra.update(fields)
-            self._flush_locked(coalesce=False)
+            stamped = self._flush_locked(coalesce=False)
+        self._write_doc(stamped)
 
-    def _flush_locked(self, *, coalesce: bool) -> None:  # requires-lock: self._lock
+    def _flush_locked(self, *, coalesce: bool):  # requires-lock: self._lock
+        """Decide defer-vs-flush and snapshot the document under the
+        lock; the caller performs the file write AFTER releasing it.
+        Returns ``(doc, seq)`` to write, or None when deferred."""
         now = time.monotonic()
         if coalesce and now - self._last_flush < self.flush_interval_s:
             self._m_coalesced.inc()
@@ -130,15 +142,18 @@ class StatusReporter(Logger):
                 t.daemon = True
                 self._flush_timer = t
                 t.start()
-            return
-        self._write_locked(now)
+            return None
+        return self._doc_locked(now)
 
     def _timer_flush(self) -> None:
         with self._lock:
             self._flush_timer = None
-            self._write_locked(time.monotonic())
+            stamped = self._doc_locked(time.monotonic())
+        self._write_doc(stamped)
 
-    def _write_locked(self, now: float) -> None:  # requires-lock: self._lock
+    def _doc_locked(self, now: float):  # requires-lock: self._lock
+        """Snapshot the status document + a monotonic sequence stamp
+        (the write-ordering token _write_doc checks)."""
         self._last_flush = now
         if self._flush_timer is not None:
             # a direct write supersedes the pending trailing flush
@@ -152,10 +167,26 @@ class StatusReporter(Logger):
         }
         if self._events:
             doc["events"] = list(self._events)
-        tmp = self.path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(doc, f, indent=1, default=repr)
-        os.replace(tmp, self.path)
+        self._doc_seq += 1
+        return doc, self._doc_seq
+
+    def _write_doc(self, stamped) -> None:
+        """Write a snapshot taken under ``_lock`` — OUTSIDE it, so no
+        reader/writer of ``_extra``/``_events`` ever stalls behind the
+        disk.  ``_io_lock`` orders concurrent writers; the sequence
+        stamp drops a write that lost the race to a newer snapshot
+        (the file must only ever move forward)."""
+        if stamped is None:
+            return
+        doc, seq = stamped
+        with self._io_lock:
+            if seq <= self._written_seq:
+                return          # a newer snapshot already landed
+            self._written_seq = seq
+            tmp = self.path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(doc, f, indent=1, default=repr)
+            os.replace(tmp, self.path)
         self._m_flushes.inc()
 
     def read(self) -> dict:
