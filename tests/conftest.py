@@ -49,6 +49,9 @@ def pytest_configure(config):
         "markers", "analysis: static-analyzer (veles-tpu-lint) tests "
         "incl. the zero-findings gate (tier-1; select alone with "
         "-m analysis)")
+    config.addinivalue_line(
+        "markers", "spec: speculative-decoding / verify-program tests "
+        "(tier-1; select alone with -m spec)")
 
 
 @pytest.fixture(autouse=True)

@@ -1520,3 +1520,76 @@ def test_console_script_entry_point(tmp_path):
         r = subprocess.run([exe, "--help"], capture_output=True,
                            text=True, timeout=300)
         assert r.returncode == 0 and "--baseline" in r.stdout
+
+
+# -- speculative-decode verify builder: registry + routing contract ----------
+
+def test_verify_builder_registered_as_trace_root():
+    """The speculative verify program's builder is a declared BUILDER
+    root (docs/analysis.md registry-extension workflow): renaming it in
+    runtime/engine.py without the registry would silently drop the
+    VT1xx/VP6xx coverage this family provides."""
+    from veles_tpu.analysis.registry import BUILDER
+    entry = TRACE_ROOTS["runtime/engine.py"]
+    assert entry.get("make_verify_fn") == BUILDER
+    # and it must NOT be declared self-caching: the engine routes it
+    # through StepCache (VP603's contract), not a private memo
+    from veles_tpu.analysis.registry import SELF_CACHING_BUILDERS
+    assert "make_verify_fn" not in SELF_CACHING_BUILDERS
+
+
+def test_vp603_verify_builder_on_hot_path(tmp_path):
+    """Positive fixture: calling the verify builder from a scheduler
+    tick without StepCache routing is the lazy-recompile hazard VP603
+    exists for — the live engine's `_compile_verify` routes through
+    get_step, mirrored by the negative half."""
+    _write(tmp_path, "mod.py", """\
+        def make_verify_fn(plan, ctx, S, K):  # trace-root: builder
+            def fn(x):
+                return x
+            return fn
+
+        def tick(self, plan, ctx):  # host-loop-root:
+            return make_verify_fn(plan, ctx, 4, 4)
+
+        def tick_routed(self, plan, ctx, cache):  # host-loop-root:
+            step, _, _ = cache.get_step(
+                "verify", ("k", 4),
+                lambda: (make_verify_fn(plan, ctx, 4, 4), None, None),
+                ())
+            return step
+        """)
+    found = _lint(tmp_path)
+    assert _rules(found) == ["VP603"]
+    assert found[0].symbol == "tick"
+    assert "make_verify_fn" in found[0].message
+
+
+def test_vp601_per_request_k_into_verify_builder(tmp_path):
+    """Positive fixture: a per-request draft length flowing into the
+    verify builder's static k slot would compile one program per
+    distinct k — the exact hazard the ONE-static-k design forbids."""
+    _write(tmp_path, "mod.py", """\
+        def make_verify_fn(plan, S, K):  # trace-root: builder
+            return K
+
+        def serve(plan, requests):
+            for req in requests:
+                make_verify_fn(plan, 4, len(req.draft))
+        """)
+    found = _lint(tmp_path)
+    assert _rules(found) == ["VP601"]
+
+
+def test_engine_verify_call_sites_lint_clean():
+    """Negative fixture on the LIVE code: runtime/engine.py (verify
+    builder + scheduler interleave + drafter) and the touched
+    generate.py/pallas path hold zero findings — the gate's exit-0 on
+    the empty baseline covers the package, this pins the PR's files
+    individually so a future regression names them."""
+    pkg = os.path.join(REPO, "veles_tpu")
+    files = [(os.path.join(pkg, rel), rel)
+             for rel in ("runtime/engine.py", "runtime/generate.py",
+                         "ops/pallas_kernels.py")]
+    found = analyze_files(files, package_scan=False)
+    assert [f for f in found if f.rule != "VM402"] == []

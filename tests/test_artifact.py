@@ -577,3 +577,82 @@ def test_rest_serving_without_workflow(exported, runner):
             srv.decode({"prompt": [[1]], "steps": 2, "beams": 3})
     finally:
         srv.httpd.server_close()
+
+
+# -- speculative decode sealing (spec_decode + the verify program) ------------
+
+def test_old_artifact_has_no_spec_and_loads_unchanged(exported, runner):
+    """The module's default export predates/omits spec: spec_decode is
+    null, no verify program ships, and the runner serves with spec off
+    — old artifacts load unchanged."""
+    _, _, _, man = exported
+    assert man["spec_decode"] is None
+    assert "verify" not in man["programs"]
+    assert not runner.spec
+
+
+def test_spec_requested_on_unsealed_artifact_is_refused(exported):
+    """spec=True against an artifact that seals no verify program is a
+    loud ArtifactError naming the re-export fix — the runner has no
+    model code to trace one from."""
+    _, _, art, _ = exported
+    with pytest.raises(ArtifactError, match="verify"):
+        ArtifactRunner(art, spec=True)
+
+
+def test_spec_sealed_artifact_roundtrip_bitwise_flat_counters(
+        tmp_path, rng):
+    """export_compiled(spec=True) seals the verify program; the runner
+    serves speculative decode by default (manifest k), bitwise the live
+    generate() including a prefix-hit admission, counters flat after
+    boot; spec=False still opts out."""
+    wf, ws = _build_lm(seed=33)
+    art = str(tmp_path / "spec_art")
+    man = export_compiled(wf, ws, art, slots=2, l_max=32, spec=True,
+                          spec_k=3)
+    assert man["spec_decode"] == {"k": 3}
+    assert "verify" in man["programs"]
+    assert "programs/verify.bin" in manifest_summary(man)["programs"]
+    r = ArtifactRunner(art, window_ms=0.0).start()
+    try:
+        assert r.spec and r.spec_k == 3
+        boot = r.stats()["compile"]["compiles"]
+        sysp = rng.integers(0, V, 16).astype(np.int32)   # 1 full page
+        a = np.concatenate([sysp,
+                            rng.integers(0, V, 3).astype(np.int32)])
+        for pr, n in ((a[None], 10), (a[None], 10)):
+            ref = np.asarray(generate(wf, ws, pr, n))
+            np.testing.assert_array_equal(
+                r.generate(pr, n, timeout=180), ref)
+        st = r.stats()
+        assert st["spec"]["verify_steps"] > 0
+        assert st["pages"]["prefix_hit_pages"] >= 1
+        assert st["compile"]["compiles"] == boot
+        assert st["compile"]["recompiles"] == 0
+        # prefill buckets + decode + verify (+ the batched forward)
+        assert st["artifact"]["programs"] == (
+            len(man["buckets"]) + 2
+            + ("forward" in man["programs"]))
+    finally:
+        r.stop()
+    assert not ArtifactRunner(art, spec=False).spec
+
+
+def test_damaged_spec_decode_manifest_is_corruption(tmp_path):
+    """A manifest claiming spec_decode without a sealed verify program
+    (or without a static k) is parseable-but-damaged: the load answers
+    SnapshotCorruptError (re-export), not a KeyError mid-boot."""
+    wf, ws = _build_lm(seed=34)
+    art = str(tmp_path / "dmg_art")
+    export_compiled(wf, ws, art, slots=2, l_max=32, spec=True, spec_k=2)
+    path = os.path.join(art, MANIFEST)
+    man = json.load(open(path))
+    man["spec_decode"] = {"k": "three"}          # no static int k
+    json.dump(man, open(path, "w"))
+    with pytest.raises(SnapshotCorruptError, match="spec_decode"):
+        ArtifactRunner(art)
+    man["spec_decode"] = {"k": 2}
+    del man["programs"]["verify"]                # claim without blob
+    json.dump(man, open(path, "w"))
+    with pytest.raises(SnapshotCorruptError, match="spec_decode"):
+        ArtifactRunner(art)

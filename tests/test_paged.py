@@ -470,3 +470,30 @@ def test_page_gauges_reach_status_and_rest(lm, tmp_path, rng):
             ssrv.stop()
     finally:
         srv.stop()
+
+
+# -- fused paged-attention kernel (bounded-error read path) -------------------
+
+def test_paged_kernel_engine_geometry_and_tokens(lm, rng):
+    """`serve.paged_kernel` swaps the decode read side onto the fused
+    Pallas kernel (interpret mode on CPU): same geometry, same program
+    count, tokens equal to generate() on this margin-comfortable model
+    (the numeric contract itself is bounded-error, pinned in
+    test_pallas.py).  The flag is part of the program identity (its own
+    StepCache geometry key) and is refused on dense layouts."""
+    wf, ws = lm
+    geo = resolve_serve_geometry(2, 64, paged_kernel=True)
+    assert geo.paged_kernel
+    with pytest.raises(ValueError, match="paged_kernel requires"):
+        resolve_serve_geometry(2, 64, paged=False, paged_kernel=True)
+    prompt = rng.integers(0, V, (1, 10)).astype(np.int32)
+    ref = np.asarray(generate(wf, ws, prompt, 6))
+    eng = DecodeEngine(wf, ws, slots=2, l_max=64,
+                       paged_kernel=True).start()
+    try:
+        np.testing.assert_array_equal(
+            eng.generate(prompt, 6, timeout=180), ref)
+        st = eng.stats()
+        assert st["compile"]["recompiles"] == 0
+    finally:
+        eng.stop()

@@ -252,3 +252,97 @@ def test_flash_attention_gqa_with_window(rng):
                               W)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-6)
+
+
+# -- paged-attention decode kernel -------------------------------------------
+
+def _paged_reference(q, pool_k, pool_v, ptab, pos, window=None):
+    """The gather-path math `_attn_decode_step` runs: flatten each
+    row's pages to the (B, L, Hk, Dh) logical view, mask, one-shot
+    softmax.  THE bounded-error contract the fused kernel is pinned
+    against (tolerances below are the contract)."""
+    B, H, Dh = q.shape
+    rows, psz, Hk, _ = pool_k.shape
+    G = H // Hk
+    n_ptab = ptab.shape[1]
+    L = n_ptab * psz
+    kf = pool_k[ptab].reshape(B, L, Hk, Dh).astype(jnp.float32)
+    vf = pool_v[ptab].reshape(B, L, Hk, Dh).astype(jnp.float32)
+    qg = q.reshape(B, Hk, G, Dh).astype(jnp.float32)
+    s = jnp.einsum("bkgd,btkd->bkgt", qg, kf) * (Dh ** -0.5)
+    t = jnp.arange(L)
+    mask = t[None, :] <= pos[:, None]
+    if window is not None:
+        mask &= t[None, :] > pos[:, None] - window
+    s = jnp.where(mask[:, None, None, :], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bkgt,btkd->bkgd", p, vf).reshape(B, H, Dh)
+
+
+def _paged_case(rng, B=3, Hk=2, G=2, Dh=8, psz=4, n_ptab=5):
+    H = Hk * G
+    rows = B * n_ptab + 1                    # + scratch page
+    pool_k = jnp.asarray(rng.standard_normal((rows, psz, Hk, Dh)),
+                         jnp.float32)
+    pool_v = jnp.asarray(rng.standard_normal((rows, psz, Hk, Dh)),
+                         jnp.float32)
+    ptab = jnp.asarray(
+        rng.permutation(rows - 1)[:B * n_ptab].reshape(B, n_ptab),
+        jnp.int32)
+    pos = jnp.asarray(rng.integers(0, n_ptab * psz, B), jnp.int32)
+    q = jnp.asarray(rng.standard_normal((B, H, Dh)), jnp.float32)
+    return q, pool_k, pool_v, ptab, pos
+
+
+def test_paged_attention_decode_bounded_error(rng):
+    """The fused kernel vs the gather-path reference: per-slot page
+    tables, mixed per-row positions, GQA grouping.  Online softmax
+    reorders the summation, so the contract is bounded error at these
+    pinned tolerances — never bitwise (docs/serving.md)."""
+    q, pk_, pv_, ptab, pos = _paged_case(rng)
+    out = pk.paged_attention_decode(q, pk_, pv_, ptab, pos,
+                                    page_size=4, n_kv_heads=2)
+    ref = _paged_reference(q, pk_, pv_, ptab, pos)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_paged_attention_decode_window_and_edges(rng):
+    """Sliding window (whole pages skipped at both ends) and the
+    position edges: pos = 0 (only one key live) and pos = L - 1 (every
+    page live)."""
+    q, pk_, pv_, ptab, _ = _paged_case(rng)
+    L = ptab.shape[1] * 4
+    pos = jnp.asarray([0, L - 1, 7], jnp.int32)
+    for w in (None, 6):
+        out = pk.paged_attention_decode(q, pk_, pv_, ptab, pos,
+                                        page_size=4, n_kv_heads=2,
+                                        window=w)
+        ref = _paged_reference(q, pk_, pv_, ptab, pos, window=w)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-6, err_msg=str(w))
+
+
+def test_paged_attention_decode_under_jit_and_scratch_rows(rng):
+    """jit'd (the decode program wraps it) and with page-table rows
+    pointing at the scratch page beyond each slot's span — masked off
+    by pos, exactly how the engine maps unassigned logical pages."""
+    q, pk_, pv_, ptab, _ = _paged_case(rng, B=2, n_ptab=4)
+    scratch = pk_.shape[0] - 1
+    ptab = ptab.at[:, 2:].set(scratch)       # span = 2 pages per row
+    pos = jnp.asarray([3, 6], jnp.int32)     # inside the real span
+    out = jax.jit(lambda *a: pk.paged_attention_decode(
+        *a, page_size=4, n_kv_heads=2))(q, pk_, pv_, ptab, pos)
+    ref = _paged_reference(q, pk_, pv_, ptab, pos)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_paged_attention_decode_validation(rng):
+    q, pk_, pv_, ptab, pos = _paged_case(rng)
+    with pytest.raises(ValueError, match="page size"):
+        pk.paged_attention_decode(q, pk_, pv_, ptab, pos,
+                                  page_size=8, n_kv_heads=2)
+    with pytest.raises(ValueError, match="kv heads"):
+        pk.paged_attention_decode(q, pk_, pv_, ptab, pos,
+                                  page_size=4, n_kv_heads=4)

@@ -1,0 +1,319 @@
+"""Speculative decoding (runtime/engine.py): the verify program — the
+third and last program kind — must emit tokens bitwise-identical to
+non-speculative decode for greedy AND sampled requests (acceptance is
+exact-match against the engine's own sampler, so the drafter can only
+change how many tokens one call emits, never which), across mixed-shape
+concurrent load, prefix-hit admissions, mid-block eos retirement and a
+k sweep, with StepCache counters flat (exactly ONE verify program, no
+per-draft or per-k recompiles) and the accept-rate gauges live."""
+
+import threading
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import veles_tpu as vt
+from veles_tpu.models.standard import build_workflow
+from veles_tpu.ops import optimizers as opt
+from veles_tpu.runtime.engine import DecodeEngine, ngram_draft
+from veles_tpu.runtime.generate import generate
+
+pytestmark = pytest.mark.spec
+
+V = 12
+
+LAYERS = [
+    {"type": "embedding", "vocab": V, "dim": 16, "name": "emb"},
+    {"type": "attention", "n_heads": 2, "rope": True,
+     "residual": True, "name": "a1"},
+    {"type": "layer_norm", "name": "n1"},
+    {"type": "ffn", "d_hidden": 32, "name": "f1"},
+    {"type": "seq_last", "name": "last"},
+    {"type": "softmax", "output_size": V, "name": "out"},
+]
+
+
+def _build_lm(layers=LAYERS, seed=3, name="spec_lm"):
+    wf = build_workflow(name, layers)
+    wf.build({"@input": vt.Spec((2, 6), jnp.int32),
+              "@labels": vt.Spec((2,), jnp.int32),
+              "@mask": vt.Spec((2,), jnp.float32)})
+    ws = wf.init_state(jax.random.key(seed), opt.SGD(0.1))
+    return wf, ws
+
+
+@pytest.fixture(scope="module")
+def lm():
+    return _build_lm()
+
+
+# -- the drafter --------------------------------------------------------------
+
+def test_ngram_draft_lookup_semantics():
+    h = np.array([1, 2, 3, 4, 1, 2, 3], np.int32)
+    # trailing trigram (1,2,3) recurred at the start: continuation 4,
+    # then the history past it (1, 2) — padded with -1
+    np.testing.assert_array_equal(ngram_draft(h, 4), [4, 1, 2, 3])
+    np.testing.assert_array_equal(ngram_draft(h, 5), [4, 1, 2, 3, -1])
+    # no n-gram of any length recurs -> no draft
+    assert ngram_draft(np.arange(8, dtype=np.int32), 3) is None
+    # too-short history
+    assert ngram_draft(np.array([5, 5], np.int32), 3) is None
+    # most RECENT earlier occurrence wins
+    h2 = np.array([7, 1, 2, 9, 1, 2, 8, 1, 2], np.int32)
+    np.testing.assert_array_equal(ngram_draft(h2, 2), [8, 1])
+
+
+# -- bitwise identity ---------------------------------------------------------
+
+def test_greedy_spec_bitwise_paged_and_dense(lm, rng):
+    """Greedy spec == non-spec engine == generate(), paged and dense,
+    across mixed prompt/step shapes — the drafter changes how many
+    tokens one program call emits, never which tokens."""
+    wf, ws = lm
+    shapes = [(5, 20), (17, 12), (9, 16), (13, 6)]
+    prompts = [rng.integers(0, V, (1, p)).astype(np.int32)
+               for p, _ in shapes]
+    refs = [np.asarray(generate(wf, ws, pr, n))
+            for pr, (_, n) in zip(prompts, shapes)]
+    for paged in (True, False):
+        eng = DecodeEngine(wf, ws, slots=4, l_max=64, window_ms=1.0,
+                           paged=paged, spec=True, spec_k=4).start()
+        try:
+            got = [eng.generate(pr, n, timeout=180)
+                   for pr, (_, n) in zip(prompts, shapes)]
+            st = eng.stats()
+        finally:
+            eng.stop()
+        for i, (g, r) in enumerate(zip(got, refs)):
+            np.testing.assert_array_equal(
+                g, r, err_msg=f"paged={paged} case {shapes[i]}")
+        # the speculative path actually ran, and paid off
+        assert st["spec"]["verify_steps"] > 0
+        assert st["spec"]["accepted"] > 0
+        assert st["compile"]["recompiles"] == 0
+
+
+def test_spec_with_prefix_hit_admissions_bitwise(lm, rng):
+    """A spec engine admitting through the paged prefix cache (shared
+    system prompt, COW divergence) still reproduces generate() bit for
+    bit — global positions drive both the sampler folds and the verify
+    micro-steps."""
+    wf, ws = lm
+    eng = DecodeEngine(wf, ws, slots=4, l_max=64, window_ms=1.0,
+                       spec=True, spec_k=4).start()
+    sysp = rng.integers(0, V, 32).astype(np.int32)       # 2 full pages
+    a = np.concatenate([sysp, rng.integers(0, V, 3).astype(np.int32)])
+    b = np.concatenate([sysp, rng.integers(0, V, 6).astype(np.int32)])
+    try:
+        for pr, n in ((a[None], 10), (b[None], 8), (a[None], 10)):
+            ref = np.asarray(generate(wf, ws, pr, n))
+            np.testing.assert_array_equal(
+                eng.generate(pr, n, timeout=180), ref)
+        st = eng.stats()
+        assert st["pages"]["prefix_hit_pages"] >= 2
+        assert st["compile"]["recompiles"] == 0
+    finally:
+        eng.stop()
+
+
+def test_sampled_spec_bitwise_distribution(lm, rng):
+    """Sampled spec decode is bitwise the non-speculative sampler under
+    every key — acceptance is exact-match against the sampler's own
+    draw, so the output DISTRIBUTION is trivially exact (stronger than
+    rejection-sampling unbiasedness; docs/serving.md).  Sweeping keys
+    is the distribution test: identical sequences per key means
+    identical induced distribution."""
+    wf, ws = lm
+    eng = DecodeEngine(wf, ws, slots=2, l_max=64, spec=True,
+                       spec_k=3).start()
+    prompt = rng.integers(0, V, (1, 7)).astype(np.int32)
+    try:
+        for seed in range(8):
+            key = jax.random.key(seed)
+            ref = np.asarray(generate(wf, ws, prompt, 12,
+                                      temperature=1.3, top_k=6,
+                                      key=key))
+            got = eng.generate(prompt, 12, temperature=1.3, top_k=6,
+                               key=key, timeout=120)
+            np.testing.assert_array_equal(got, ref, err_msg=f"key {seed}")
+        ref = np.asarray(generate(wf, ws, prompt, 12, temperature=1.1,
+                                  top_p=0.9, key=jax.random.key(11)))
+        got = eng.generate(prompt, 12, temperature=1.1, top_p=0.9,
+                           key=jax.random.key(11), timeout=120)
+        np.testing.assert_array_equal(got, ref)
+    finally:
+        eng.stop()
+
+
+def test_mid_block_eos_retirement(lm, rng):
+    """A slot whose eos lands mid-verify-block retires there: output is
+    bitwise generate(eos_id=...)'s (trimmed at eos) even when the eos
+    token was itself a draft-accepted or bonus emission."""
+    wf, ws = lm
+    prompt = rng.integers(0, V, (1, 9)).astype(np.int32)
+    # eos must FIRST occur deep enough into the continuation that the
+    # drafter has history to fire on — take the latest token whose
+    # emission is its own first occurrence (the generated suffix is
+    # deterministic, so this is a stable choice, not a flake)
+    full = np.asarray(generate(wf, ws, prompt, 24))[0, 9:]
+    eos = next(int(t) for i, t in reversed(list(enumerate(full)))
+               if t not in full[:i])
+    ref = np.asarray(generate(wf, ws, prompt, 24, eos_id=eos))
+    eng = DecodeEngine(wf, ws, slots=2, l_max=64, spec=True,
+                       spec_k=4).start()
+    try:
+        got = eng.generate(prompt, 24, eos_id=eos, timeout=120)
+        np.testing.assert_array_equal(got, ref)
+        assert eng.stats()["spec"]["verify_steps"] > 0
+    finally:
+        eng.stop()
+
+
+# -- program inventory / counters ---------------------------------------------
+
+def test_k_sweep_one_verify_program_each(lm, rng):
+    """Every k compiles exactly ONE verify program (keyed by geometry +
+    k) and stays bitwise; within one engine no draft pattern ever
+    triggers a recompile."""
+    wf, ws = lm
+    prompt = rng.integers(0, V, (1, 11)).astype(np.int32)
+    ref = np.asarray(generate(wf, ws, prompt, 14))
+    for k in (1, 2, 5):
+        eng = DecodeEngine(wf, ws, slots=2, l_max=64, spec=True,
+                           spec_k=k).start()
+        try:
+            np.testing.assert_array_equal(
+                eng.generate(prompt, 14, timeout=120), ref,
+                err_msg=f"k={k}")
+            st = eng.stats()["compile"]
+        finally:
+            eng.stop()
+        # decode + verify + 1 prefill bucket, one compile each
+        assert st["recompiles"] == 0, (k, st)
+
+
+def test_compile_counters_flat_under_concurrent_spec_load(lm, rng):
+    """THE acceptance assertion: a mixed-shape concurrent workload on a
+    spec engine — drafted and undrafted slots, retirement, admission —
+    moves the StepCache counters only for the fixed inventory (prefill
+    buckets + decode + ONE verify), then never again."""
+    wf, ws = lm
+    eng = DecodeEngine(wf, ws, slots=4, l_max=64, window_ms=1.0,
+                       queue_depth=64, spec=True, spec_k=4).start()
+    work = [(rng.integers(0, V, (1, int(p))).astype(np.int32), int(n))
+            for p, n in zip(rng.integers(4, 30, 16),
+                            rng.integers(6, 18, 16))]
+    refs = [np.asarray(generate(wf, ws, pr, n)) for pr, n in work]
+    try:
+        # warm every bucket this workload can request
+        for pr, n in work[:4]:
+            eng.generate(pr, n, timeout=180)
+        compiles = eng.stats()["compile"]["compiles"]
+        results = [None] * len(work)
+
+        def worker(i):
+            results[i] = eng.generate(work[i][0], work[i][1],
+                                      timeout=300)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(len(work))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600)
+        for i, (got, ref) in enumerate(zip(results, refs)):
+            np.testing.assert_array_equal(got, ref, err_msg=str(i))
+        st = eng.stats()
+        assert st["compile"]["compiles"] == compiles, st["compile"]
+        assert st["compile"]["recompiles"] == 0
+        # program inventory: buckets + decode + exactly one verify
+        assert st["spec"]["verify_steps"] > 0
+    finally:
+        eng.stop()
+
+
+# -- observability ------------------------------------------------------------
+
+def test_accept_rate_gauges_and_metrics(lm, rng, tmp_path):
+    """The spec gauges ride every surface: stats()["spec"] and
+    stats()["goodput"]["spec_accept_rate"], the /metrics series, and
+    the status page's dotted engine rows."""
+    import time
+    from veles_tpu.runtime.metrics import parse_samples, registry
+    from veles_tpu.runtime.status import StatusReporter, StatusServer
+    wf, ws = lm
+    rep = StatusReporter(str(tmp_path / "status.json"), name="spec")
+    eng = DecodeEngine(wf, ws, slots=2, l_max=64, spec=True, spec_k=4,
+                       status=rep).start()
+    prompt = rng.integers(0, V, (1, 8)).astype(np.int32)
+    try:
+        eng.generate(prompt, 20, timeout=120)
+        deadline = time.monotonic() + 10
+        while "engine" not in rep._extra:
+            assert time.monotonic() < deadline, "reporter never updated"
+            time.sleep(0.01)
+        st = eng.stats()
+        assert st["spec"]["proposed"] > 0
+        assert 0.0 <= st["spec"]["accept_rate"] <= 1.0
+        assert "spec_accept_rate" in st["goodput"]
+        text = registry().render()
+        samples = {n for n, _, _ in parse_samples(text)}
+        for name in ("vt_spec_proposed_total", "vt_spec_accepted_total",
+                     "vt_spec_accept_rate",
+                     "vt_spec_verify_step_seconds_count"):
+            assert name in samples, name
+        srv = StatusServer(rep).start()
+        try:
+            page = urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/").read().decode()
+            assert "engine.spec.accept_rate" in page
+            assert "engine.goodput.spec_accept_rate" in page
+        finally:
+            srv.stop()
+    finally:
+        eng.stop()
+
+
+def test_spec_config_validation(lm):
+    wf, ws = lm
+    with pytest.raises(ValueError, match="spec.k"):
+        DecodeEngine(wf, ws, slots=2, l_max=32, spec=True, spec_k=0)
+    with pytest.raises(ValueError, match="drafter"):
+        DecodeEngine(wf, ws, slots=2, l_max=32, spec=True,
+                     spec_drafter="llama")
+
+
+# -- the fused paged-attention kernel on the engine ---------------------------
+
+def test_paged_kernel_engine_serves_and_composes_with_spec(lm, rng):
+    """serve.paged_kernel routes decode (and verify) attention through
+    the fused Pallas kernel — interpret mode on CPU.  Tokens are
+    checked equal to the reference here (bounded error far below any
+    argmax margin on this model; the numeric tolerance itself is
+    pinned kernel-level in test_pallas.py), and the flag is refused on
+    dense geometries."""
+    wf, ws = lm
+    prompt = rng.integers(0, V, (1, 9)).astype(np.int32)
+    ref = np.asarray(generate(wf, ws, prompt, 8))
+    eng = DecodeEngine(wf, ws, slots=2, l_max=64,
+                       paged_kernel=True).start()
+    try:
+        np.testing.assert_array_equal(
+            eng.generate(prompt, 8, timeout=180), ref)
+    finally:
+        eng.stop()
+    eng = DecodeEngine(wf, ws, slots=2, l_max=64, paged_kernel=True,
+                       spec=True, spec_k=3).start()
+    try:
+        np.testing.assert_array_equal(
+            eng.generate(prompt, 8, timeout=180), ref)
+        assert eng.stats()["compile"]["recompiles"] == 0
+    finally:
+        eng.stop()
+    with pytest.raises(ValueError, match="paged_kernel requires"):
+        DecodeEngine(wf, ws, slots=2, l_max=32, paged=False,
+                     paged_kernel=True)

@@ -23,10 +23,11 @@ Two root modes:
     Keyword-only parameters stay untainted — in this codebase they are
     static sampling/config knobs by convention.
 
-Extending for a new program kind (e.g. a speculative-decode step): add
-its builder/step qualnames to the module entry below — nothing else;
-the call-graph closure picks up everything they call.  docs/analysis.md
-walks through the workflow.
+Extending for a new program kind: add its builder/step qualnames to
+the module entry below — nothing else; the call-graph closure picks up
+everything they call (the speculative-decode ``make_verify_fn`` below
+landed exactly this way).  docs/analysis.md walks through the
+workflow.
 """
 
 from __future__ import annotations
@@ -54,6 +55,8 @@ TRACE_ROOTS = {
         "make_decode_fn": BUILDER,
         "make_prefill_fn": BUILDER,
         "_make_paged_prefill_fn": BUILDER,
+        "make_verify_fn": BUILDER,      # speculative verify program
+        #                                 (the third program kind)
         "_sample_slots": TRACED,
     },
     # step_cache.py compiles programs other modules build; it never

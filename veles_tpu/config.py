@@ -319,6 +319,21 @@ def _defaults():
     #                                          l_max; halves itself if not)
     root.common.serve.pages = None           # pool size; None = the
     #                                          dense-equivalent slots*l_max
+    # Fused Pallas paged-attention decode kernel (docs/serving.md
+    # "Paged KV cache"): gathers K/V pages inside the kernel instead of
+    # materializing the flat pool[ptab] view.  BOUNDED-ERROR vs the
+    # bitwise gather path (online softmax reorders the summation), so
+    # it is opt-in and requires serve.paged.
+    root.common.serve.paged_kernel = False
+    # Speculative decoding (docs/serving.md "Speculative decoding"):
+    # a host-side prompt-lookup drafter proposes up to spec.k tokens
+    # per slot and ONE verify program (the third program kind) scores
+    # all k+1 positions per call; emitted tokens stay bitwise the
+    # non-speculative engine's.
+    root.common.serve.spec.enabled = False   # speculative decode on/off
+    root.common.serve.spec.k = 4             # draft tokens per verify
+    root.common.serve.spec.drafter = "ngram"  # host drafter (prompt
+    #                                           lookup; no second model)
     root.common.serve.window_ms = 2.0        # admission batching window
     root.common.serve.queue_depth = 64       # pending requests before 429
     root.common.serve.deadline_s = 120.0     # default per-request deadline

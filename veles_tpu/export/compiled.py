@@ -142,6 +142,9 @@ def export_compiled(workflow, wstate, out_dir: str, *,
                     paged: Optional[bool] = None,
                     page_size: Optional[int] = None,
                     pages: Optional[int] = None,
+                    paged_kernel: Optional[bool] = None,
+                    spec: Optional[bool] = None,
+                    spec_k: Optional[int] = None,
                     cache_dtype=jnp.float32,
                     output_unit: Optional[str] = None,
                     input_spec: Optional[dict] = None,
@@ -164,9 +167,19 @@ def export_compiled(workflow, wstate, out_dir: str, *,
     prefix cache included.  A chain ``DecodePlan`` rejects simply ships
     forward-only (the manifest omits the decode program and records why
     under ``decode_unsupported``).
+
+    ``spec`` / ``spec_k`` (defaults ``root.common.serve.spec.*``)
+    additionally seal the speculative **verify** program — the third
+    program kind, one program at static ``spec_k`` — and record
+    ``spec_decode: {"k": K}`` in the manifest; an ``ArtifactRunner``
+    serves speculative decode only when that program is sealed (old
+    artifacts load unchanged, ``spec_decode`` absent).  ``paged_kernel``
+    seals the fused Pallas paged-attention read path into the decode /
+    verify programs (bounded-error; manifest records it).
     """
+    from ..config import root
     from ..runtime.engine import (bucket_table, make_decode_fn,
-                                  make_prefill_fn,
+                                  make_prefill_fn, make_verify_fn,
                                   resolve_serve_geometry)
     from ..runtime.generate import DecodePlan
     from ..runtime.snapshotter import _flatten, _fsync_dir, _to_numpy
@@ -174,8 +187,15 @@ def export_compiled(workflow, wstate, out_dir: str, *,
     from ..units.nn import input_vocab as _input_vocab
 
     geo = resolve_serve_geometry(slots, l_max, bucket_min, paged=paged,
-                                 page_size=page_size, pages=pages)
+                                 page_size=page_size, pages=pages,
+                                 paged_kernel=paged_kernel)
     slots, l_max, bucket_min = geo.slots, geo.l_max, geo.bucket_min
+    spec_on = bool(root.common.serve.spec.get("enabled", False)
+                   if spec is None else spec)
+    spec_k = int(root.common.serve.spec.get("k", 4)
+                 if spec_k is None else spec_k)
+    if spec_on and spec_k < 1:
+        raise ValueError(f"spec_k must be >= 1, got {spec_k}")
 
     prog_dir = os.path.join(out_dir, "programs")
     os.makedirs(prog_dir, exist_ok=True)
@@ -294,10 +314,27 @@ def export_compiled(workflow, wstate, out_dir: str, *,
                               jax.ShapeDtypeStruct((S,), jnp.bool_),
                               f32(S), i32(S), f32(S), i32(S), i32(S), keys)
             blob, info = _export_one(
-                make_decode_fn(plan, ctx, S, page_size=psz), decode_sds)
+                make_decode_fn(plan, ctx, S, page_size=psz,
+                               paged_kernel=geo.paged_kernel),
+                decode_sds)
             sha = _write_blob(
                 os.path.join(out_dir, "programs", "decode.bin"), blob, staged)
             decode_meta = dict(info, file="programs/decode.bin", sha256=sha)
+
+            if spec_on:
+                # the speculative verify program: decode's calling
+                # convention + the (S, K) draft matrix — sealed at ONE
+                # static k, the manifest's spec_decode contract
+                blob, info = _export_one(
+                    make_verify_fn(plan, ctx, S, spec_k, page_size=psz,
+                                   paged_kernel=geo.paged_kernel),
+                    decode_sds + (i32(S, spec_k),))
+                sha = _write_blob(
+                    os.path.join(out_dir, "programs", "verify.bin"),
+                    blob, staged)
+                programs["verify"] = dict(info,
+                                          file="programs/verify.bin",
+                                          sha256=sha)
 
             prefills = {}
             for pb in bucket_table(bucket_min, l_max):
@@ -346,8 +383,15 @@ def export_compiled(workflow, wstate, out_dir: str, *,
             "paged": bool(geo.paged and decode_meta),
             "page_size": geo.page_size if geo.paged else None,
             "pages": geo.pages if geo.paged else None,
+            "paged_kernel": bool(geo.paged_kernel and decode_meta),
             "prefix_reuse": bool(geo.paged and decode_meta and plan
                                  is not None and not plan._rec_units),
+            # speculative decode support: present (with the sealed
+            # verify program's static k) only when the verify program
+            # is part of the sealed inventory — the ArtifactRunner's
+            # serve-spec-or-reject contract
+            "spec_decode": ({"k": spec_k} if spec_on and decode_meta
+                            else None),
             "cache_dtype": jnp.dtype(cache_dtype).name,
             "vocab": vocab,
             "input_vocab": input_vocab,
@@ -408,6 +452,8 @@ def manifest_summary(manifest: dict) -> dict:
         "paged": manifest.get("paged", False),
         "page_size": manifest.get("page_size"),
         "pages": manifest.get("pages"),
+        "paged_kernel": manifest.get("paged_kernel", False),
+        "spec_decode": manifest.get("spec_decode"),
         "buckets": manifest.get("buckets"),
         "vocab": manifest.get("vocab"),
         "programs": sorted(

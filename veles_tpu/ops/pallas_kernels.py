@@ -490,6 +490,139 @@ flash_attention.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
 # ---------------------------------------------------------------------------
+# Paged-attention decode (fused page gather + online softmax)
+# ---------------------------------------------------------------------------
+
+def _paged_attn_kernel(ptab_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
+                       m_ref, l_ref, acc_ref, *, scale, psz, hk, g,
+                       window, n_ptab):
+    """Grid = (B, n_ptab): row-major sweep over each slot's logical
+    pages.  The page axis is minor and ``k_ref``/``v_ref`` blocks are
+    addressed THROUGH the scalar-prefetched page table (``ptab_ref`` in
+    SMEM drives the BlockSpec index map), so each step is a direct
+    HBM→VMEM DMA of one physical pool page — the flat ``pool[ptab]``
+    logical view is never materialized.  Online-softmax state (m/l/acc)
+    persists in VMEM scratch across the page sweep, exactly like the
+    flash kernel's k sweep."""
+    b, j = pl.program_id(0), pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, -1e30)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    pos_b = pos_ref[b]
+
+    def _step():
+        q = q_ref[0].astype(jnp.float32)          # (H, Dh)
+        k_pg = k_ref[0].astype(jnp.float32)       # (psz, Hk, Dh)
+        v_pg = v_ref[0].astype(jnp.float32)
+        d = q.shape[-1]
+        qg = q.reshape(hk, g, d)
+        # grouped scores against this page: (Hk, G, psz)
+        s = jnp.einsum("kgd,tkd->kgt", qg, k_pg) * scale
+        k_pos = j * psz + jax.lax.broadcasted_iota(
+            jnp.int32, (hk, g, psz), 2)
+        mask = k_pos <= pos_b
+        if window is not None:
+            mask = mask & (k_pos > pos_b - window)
+        s = jnp.where(mask, s, -1e30)
+        m = m_ref[:].reshape(hk, g, 1)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
+        m_ref[:] = m_new.reshape(hk * g, 1)
+        l_ref[:] = (alpha.reshape(hk * g, 1) * l_ref[:]
+                    + jnp.sum(p, axis=-1).reshape(hk * g, 1))
+        acc_ref[:] = (acc_ref[:] * alpha.reshape(hk * g, 1)
+                      + jnp.einsum("kgt,tkd->kgd", p,
+                                   v_pg).reshape(hk * g, d))
+
+    # pages wholly past the query position (and, with a sliding window,
+    # wholly before it) contribute nothing: skip their DMA'd compute —
+    # page 0 is always live (pos >= 0), so m/l never finalize empty
+    live = j * psz <= pos_b
+    if window is not None:
+        live = live & (j * psz + psz - 1 > pos_b - window)
+    pl.when(live)(_step)
+
+    @pl.when(j == n_ptab - 1)
+    def _finalize():
+        o_ref[0] = (acc_ref[:]
+                    / jnp.maximum(l_ref[:], 1e-30)).astype(o_ref.dtype)
+
+
+def paged_attention_decode(q, k_pool, v_pool, ptab, pos, *, page_size,
+                           n_kv_heads, scale=None, window=None,
+                           interpret=None):
+    """One-position paged-attention decode: softmax(q·Kᵀ)·V where K/V
+    live in a flat page pool and each batch row's logical pages are
+    named by its page-table row.
+
+    q: (B, H, Dh) query at each row's own position ``pos`` (B,) int32
+    (RoPE already applied); k_pool/v_pool: (rows, page_size, Hk, Dh);
+    ptab: (B, n_ptab) int32 physical page per logical page (rows beyond
+    a slot's span point at the scratch page — masked off by ``pos``).
+    Returns the (B, H, Dh) float32 attention context (pre output
+    projection — the shared `_attn_scores` tail in runtime/generate.py
+    applies wo/residual so layouts cannot drift).
+
+    This is the fused half of the paged-KV design (docs/serving.md):
+    the baseline gathers ``pool[ptab]`` into a (B, l_max, Hk, Dh)
+    transient before the attention math; here the page table rides SMEM
+    (scalar prefetch) and pages stream HBM→VMEM block-by-block through
+    the BlockSpec index map, with online softmax across the sweep —
+    numerics therefore differ by summation order (bounded error, pinned
+    in tests/test_pallas.py), never bitwise.  Reference idiom: the
+    jax.experimental paged_attention TPU kernel (one DMA per
+    non-contiguous page, scalar-prefetched page indices)."""
+    B, H, Dh = q.shape
+    rows, psz, Hk, _ = k_pool.shape
+    if psz != page_size:
+        raise ValueError(f"pool page size {psz} != page_size {page_size}")
+    if n_kv_heads != Hk:
+        raise ValueError(f"pool holds {Hk} kv heads, caller declared "
+                         f"{n_kv_heads}")
+    G = check_gqa_heads(H, Hk)
+    n_ptab = ptab.shape[1]
+    window = check_attention_window(window, True)
+    scale_ = scale if scale is not None else Dh ** -0.5
+    kernel = functools.partial(
+        _paged_attn_kernel, scale=scale_, psz=psz, hk=Hk, g=G,
+        window=window, n_ptab=n_ptab)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, n_ptab),
+        in_specs=[
+            pl.BlockSpec((1, H, Dh), lambda b, j, ptab, pos: (b, 0, 0)),
+            pl.BlockSpec((1, psz, Hk, Dh),
+                         lambda b, j, ptab, pos: (ptab[b, j], 0, 0, 0)),
+            pl.BlockSpec((1, psz, Hk, Dh),
+                         lambda b, j, ptab, pos: (ptab[b, j], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, H, Dh),
+                               lambda b, j, ptab, pos: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((H, 1), jnp.float32),
+            pltpu.VMEM((H, 1), jnp.float32),
+            pltpu.VMEM((H, Dh), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, Dh), jnp.float32),
+        # batch rows are independent; the page sweep carries the
+        # online-softmax state
+        compiler_params=compiler_params(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=_interpret(interpret),
+    )(jnp.asarray(ptab, jnp.int32), jnp.asarray(pos, jnp.int32),
+      q, k_pool, v_pool)
+
+
+# ---------------------------------------------------------------------------
 # Fused dropout with in-kernel counter-based RNG
 # ---------------------------------------------------------------------------
 

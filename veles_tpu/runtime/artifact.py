@@ -302,7 +302,8 @@ class ArtifactRunner(DecodeEngine):
     def __init__(self, art_dir: str, *,
                  window_ms: Optional[float] = None,
                  queue_depth: Optional[int] = None,
-                 deadline_s: Optional[float] = None, status=None):
+                 deadline_s: Optional[float] = None, status=None,
+                 spec: Optional[bool] = None):
         self.art_dir = str(art_dir)
         man = read_manifest(self.art_dir)
         verify_artifact(self.art_dir, man)
@@ -313,6 +314,27 @@ class ArtifactRunner(DecodeEngine):
                 f"artifact {art_dir!r} holds no decode program ({why}); "
                 "ArtifactRunner serves decode — a forward-only "
                 "artifact loads through load_forward() instead")
+        # speculative decode is served iff the verify program is part
+        # of the SEALED inventory (manifest spec_decode + the program
+        # blob).  Default: serve what the artifact seals; an explicit
+        # spec=True against an unsealed artifact is refused loudly —
+        # the runner has no model code to trace a verify program from.
+        spec_meta = man.get("spec_decode") or None
+        if spec_meta is not None and (
+                not isinstance(spec_meta, dict)
+                or not isinstance(spec_meta.get("k"), int)
+                or "verify" not in progs):
+            raise SnapshotCorruptError(
+                f"{art_dir}: artifact manifest spec_decode entry is "
+                "damaged (no static k, or no sealed verify program) — "
+                "re-export")
+        want_spec = bool(spec_meta) if spec is None else bool(spec)
+        if want_spec and spec_meta is None:
+            raise ArtifactError(
+                f"artifact {art_dir!r} seals no speculative verify "
+                "program (spec_decode absent from the manifest); "
+                "re-export with export_compiled(..., spec=True) — the "
+                "runner cannot trace one from sealed programs")
 
         self.manifest = man
         self.workflow = None            # the whole point: no model code
@@ -337,7 +359,12 @@ class ArtifactRunner(DecodeEngine):
                           bucket_min=man["bucket_min"],
                           paged=bool(man.get("paged", False)),
                           page_size=man.get("page_size"),
-                          pages=man.get("pages"))
+                          pages=man.get("pages"),
+                          paged_kernel=bool(man.get("paged_kernel",
+                                                    False)),
+                          spec=want_spec,
+                          spec_k=(int(spec_meta["k"]) if want_spec
+                                  else None))
         # strict: a sealed program that can't AOT-compile here must
         # fail the LOAD, never lazily crash the first request
         self.step_cache = StepCache(strict=True)
@@ -345,6 +372,11 @@ class ArtifactRunner(DecodeEngine):
 
         self._exp_decode = _deserialize(self.art_dir, man, "decode",
                                         progs["decode"])
+        # deserialized BEFORE _init_runtime: the base engine compiles
+        # the verify program there when spec is on
+        self._exp_verify = (
+            _deserialize(self.art_dir, man, "verify", progs["verify"])
+            if want_spec else None)
         self._exp_prefill = {
             int(pb): _deserialize(self.art_dir, man, f"prefill_{pb}", q)
             for pb, q in progs.get("prefill", {}).items()}
@@ -374,12 +406,15 @@ class ArtifactRunner(DecodeEngine):
                 lambda: (jax.jit(self._exp_forward.call), None, None),
                 args)
         self.info(
-            "artifact %s: %d programs (%d prefill buckets%s), vocab=%s, "
-            "%d compiles at load",
+            "artifact %s: %d programs (%d prefill buckets%s%s), "
+            "vocab=%s, %d compiles at load",
             self.art_dir, len(self._exp_prefill) + 1
-            + (self._exp_forward is not None),
+            + (self._exp_forward is not None)
+            + (self._exp_verify is not None),
             len(self._exp_prefill),
             ", forward" if self._exp_forward is not None else "",
+            f", verify k={self.spec_k}" if self._exp_verify is not None
+            else "",
             man.get("vocab"), self.step_cache.compiles)
 
     # -- program hooks (everything else is the engine, unchanged) -----------
@@ -400,6 +435,14 @@ class ArtifactRunner(DecodeEngine):
             lambda: (jax.jit(self._exp_decode.call,
                              donate_argnums=(1, 2)), None, None),
             self._decode_args_sds(params), pin=(self._exp_decode,))
+        return step
+
+    def _compile_verify(self, params):
+        step, _, _ = self.step_cache.get_step(
+            "verify", self._geometry_key() + ("k", self.spec_k),
+            lambda: (jax.jit(self._exp_verify.call,
+                             donate_argnums=(1, 2)), None, None),
+            self._verify_args_sds(params), pin=(self._exp_verify,))
         return step
 
     def _prefill_fn(self, pb: int, params):
@@ -440,6 +483,7 @@ class ArtifactRunner(DecodeEngine):
             "checksum": (self.workflow_checksum or "")[:12],
             "jax_version": self.manifest.get("jax_version"),
             "programs": len(self._exp_prefill) + 1
-            + (self._exp_forward is not None),
+            + (self._exp_forward is not None)
+            + (self._exp_verify is not None),
         }
         return st

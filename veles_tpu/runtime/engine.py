@@ -149,7 +149,8 @@ def place_like(tree, template):
     return placed
 
 
-def make_decode_fn(plan, ctx, S: int, *, page_size: Optional[int] = None):
+def make_decode_fn(plan, ctx, S: int, *, page_size: Optional[int] = None,
+                   paged_kernel: bool = False):
     """The engine's lifetime decode program as an un-compiled jitted
     function: advance all S slots one token with per-slot positions,
     sampling params, and eos/length retirement.  Lives at module level
@@ -161,7 +162,9 @@ def make_decode_fn(plan, ctx, S: int, *, page_size: Optional[int] = None):
     ``ptab`` (S, n_ptab) int32 and the KV caches are the flat page pool
     (page indirection is traced data flow through the same program
     kind); inactive slots' KV writes route to the scratch pool row so a
-    retired slot can never corrupt pages reassigned to another slot."""
+    retired slot can never corrupt pages reassigned to another slot.
+    ``paged_kernel`` routes the paged attention read through the fused
+    Pallas kernel (bounded-error; runtime/generate.py)."""
 
     def step_tail(caches, toks, logits, pos, active, temp, topk, topp,
                   eos, end, keys, rows):
@@ -189,11 +192,149 @@ def make_decode_fn(plan, ctx, S: int, *, page_size: Optional[int] = None):
             tok = toks[rows, pos]
             logits, caches = plan.step(
                 params, caches, tok, pos, ctx,
-                pages=(ptab, page_size, active))
+                pages=(ptab, page_size, active),
+                paged_kernel=paged_kernel)
             return step_tail(caches, toks, logits, pos, active, temp,
                              topk, topp, eos, end, keys, rows)
 
     return jax.jit(decode_step, donate_argnums=(1, 2))
+
+
+def make_verify_fn(plan, ctx, S: int, K: int, *,
+                   page_size: Optional[int] = None,
+                   paged_kernel: bool = False):
+    """The engine's speculative **verify** program — the third (and
+    last) program kind next to prefill and decode, compiled once per
+    engine lifetime for a STATIC draft length ``K`` (module-level for
+    the same exporter single-source reason as :func:`make_decode_fn`).
+
+    ``draft`` (S, K) int32 carries each slot's host-drafted candidate
+    tokens (``-1`` entries never match — the no-draft fallback row).
+    One call scores all ``K + 1`` positions in one target forward (an
+    in-program scan of the SAME ``DecodePlan.step`` the decode program
+    runs — the idiom prefill already uses) and, per slot, accepts the
+    longest draft prefix whose tokens equal what the engine's own
+    sampler would have chosen at each position, then emits the first
+    non-matching (bonus) token.  Because the sampler's choice at a
+    position is a deterministic function of (logits, per-slot key
+    folded at that GLOBAL position), the emitted sequence is
+    **bitwise** the non-speculative engine's for greedy AND sampled
+    slots — the drafter only guesses which tokens the sampler will
+    pick, it never changes the pick (docs/serving.md "Speculative
+    decoding").
+
+    Per micro-step, a slot still extending feeds its last written token
+    at its own position (KV write included — identical to a decode
+    step), samples the next token, writes it, and keeps extending only
+    while the draft matched and neither eos nor the length bound hit
+    (mid-block eos retirement: later micro-steps leave the slot
+    untouched).  Slots not extending re-feed their last token with
+    writes routed to the scratch pool row (paged) or idempotently
+    rewritten in place (dense) — state provably unchanged.  Returns
+    ``(caches, toks, pos, active, finished, accepted)`` where
+    ``accepted`` (S,) int32 counts draft tokens whose emission matched
+    the proposal (the accept-rate numerator)."""
+
+    def verify_core(params, caches, toks, ptab, pos, active, temp,
+                    topk, topp, eos, end, keys, draft):
+        rows = jnp.arange(S)
+
+        def body(carry, i):
+            caches, toks, p, alive, fin, acc = carry
+            tok = toks[rows, p]
+            if page_size is None:
+                logits, caches2 = plan.step(params, caches, tok, p, ctx)
+            else:
+                logits, caches2 = plan.step(
+                    params, caches, tok, p, ctx,
+                    pages=(ptab, page_size, alive),
+                    paged_kernel=paged_kernel)
+            step_keys = jax.vmap(jax.random.fold_in)(
+                jax.random.wrap_key_data(keys), p)
+            nxt = _sample_slots(logits, step_keys, temp, topk, topp)
+            new_p = jnp.where(alive, p + 1, p)
+            cur = toks[rows, new_p]
+            toks = toks.at[rows, new_p].set(jnp.where(alive, nxt, cur))
+            done = alive & ((nxt == eos) | (new_p >= end))
+            # did the emitted token match this micro-step's proposal?
+            # (the last micro-step has none: i == K is the bonus slot)
+            d_i = draft[rows, jnp.minimum(i, K - 1)]
+            match = alive & (i < K) & (nxt == d_i)
+            acc = acc + match.astype(jnp.int32)
+            fin = fin | done
+            alive = alive & match & ~done
+            return (caches2, toks, new_p, alive, fin, acc), None
+
+        init = (caches, toks, pos, active, jnp.zeros(S, bool),
+                jnp.zeros(S, jnp.int32))
+        (caches, toks, pos, _, fin, acc), _ = jax.lax.scan(
+            body, init, jnp.arange(K + 1))
+        return caches, toks, pos, active & ~fin, fin, acc
+
+    if page_size is None:
+        def verify_step(params, caches, toks, pos, active, temp, topk,
+                        topp, eos, end, keys, draft):
+            return verify_core(params, caches, toks, None, pos, active,
+                               temp, topk, topp, eos, end, keys, draft)
+    else:
+        def verify_step(params, caches, toks, ptab, pos, active, temp,
+                        topk, topp, eos, end, keys, draft):
+            return verify_core(params, caches, toks, ptab, pos, active,
+                               temp, topk, topp, eos, end, keys, draft)
+
+    return jax.jit(verify_step, donate_argnums=(1, 2))
+
+
+#: parked/cold speculative-drafting probe interval (scheduler ticks):
+#: a workload the drafter cannot pay for decays to plain decode plus
+#: one drafting attempt — and, when a draft exists, one measuring
+#: verify step — every this many ticks, bounding the overhead of an
+#: unpredictable workload to ~(cost ratio - 1)/64 per tick while still
+#: re-qualifying speculation within one interval of a workload shift.
+_SPEC_PROBE_TICKS = 64
+
+
+def ngram_draft(hist, k: int, *, n_max: int = 3, n_min: int = 1):
+    """Prompt-lookup/n-gram drafter (host-side): propose the ``k``
+    tokens that followed the most recent earlier occurrence of the
+    history's trailing n-gram, longest match first.  Returns a (k,)
+    int32 row padded with ``-1`` past the available continuation, or
+    None when no n-gram of any tried length recurs — the draft is a
+    guess the verify program checks against the model's own choices,
+    so a bad one costs wasted micro-steps, never wrong tokens.  This is
+    the second-model-free drafter (``root.common.serve.spec.drafter =
+    "ngram"``): repetitive and structured continuations — chat turns
+    over a shared system prompt, code, the cycles greedy decode settles
+    into — are exactly where trailing n-grams recur.
+
+    The search is ``bytes.rfind`` over the raw int32 buffer (C speed —
+    this runs per slot per scheduler tick, so a numpy window scan
+    would cost more than the decode step it is trying to save), with
+    a 4-byte alignment walk rejecting the rare unaligned byte-level
+    false match."""
+    hist = np.ascontiguousarray(hist, np.int32)
+    L = int(hist.size)
+    buf = hist.tobytes()
+    for n in range(n_max, n_min - 1, -1):
+        if L < n + 2:       # need the pattern + an earlier occurrence
+            continue        # with at least one continuation token
+        pat = buf[(L - n) * 4:]
+        # search region ends at element L-2: the match must sit
+        # strictly before the trailing pattern itself
+        hi = (L - 1) * 4
+        off = buf.rfind(pat, 0, hi)
+        while off >= 0 and off % 4:     # byte-, not element-aligned
+            off = buf.rfind(pat, 0, off + len(pat) - 1)
+        if off < 0:
+            continue
+        start = off // 4 + n            # most recent occurrence
+        cont = hist[start:start + k]
+        if not cont.size:
+            continue
+        row = np.full(k, -1, np.int32)
+        row[:cont.size] = cont
+        return row
+    return None
 
 
 def make_prefill_fn(plan, ctx, pb: int, cache_dtype, *,
@@ -325,13 +466,16 @@ class ServeGeometry(NamedTuple):
     """Resolved serving geometry (see :func:`resolve_serve_geometry`).
     ``paged`` selects the page-pool KV layout; ``pages`` is 0 when
     dense.  ``n_ptab`` (= l_max // page_size) is the per-slot page-table
-    width — the number of logical pages a max-length request spans."""
+    width — the number of logical pages a max-length request spans.
+    ``paged_kernel`` routes paged attention reads through the fused
+    Pallas kernel (bounded-error; only meaningful when ``paged``)."""
     slots: int
     l_max: int
     bucket_min: int
     paged: bool
     page_size: int
     pages: int
+    paged_kernel: bool = False
 
     @property
     def n_ptab(self) -> int:
@@ -339,7 +483,8 @@ class ServeGeometry(NamedTuple):
 
 
 def resolve_serve_geometry(slots=None, l_max=None, bucket_min=None,
-                           paged=None, page_size=None, pages=None):
+                           paged=None, page_size=None, pages=None,
+                           paged_kernel=None):
     """Slot-batch geometry with ``root.common.serve`` defaults — ONE
     resolution shared by the live engine and the compiled-artifact
     exporter (export/compiled.py), so a default-configured export's
@@ -364,8 +509,19 @@ def resolve_serve_geometry(slots=None, l_max=None, bucket_min=None,
     use_paged = bool(serve.get("paged", True) if paged is None else paged)
     psz = int(page_size if page_size is not None
               else serve.get("page_size", 16))
+    # the fused Pallas read path only exists for the paged layout: an
+    # EXPLICIT request on a dense geometry is a loud misconfiguration;
+    # the config default merely doesn't apply (so a dense artifact
+    # still loads under a paged_kernel-on config)
+    use_kernel = bool(serve.get("paged_kernel", False)
+                      if paged_kernel is None else paged_kernel)
     if not use_paged:
-        return ServeGeometry(slots, l_max, bucket_min, False, psz, 0)
+        if paged_kernel:
+            raise ValueError(
+                "paged_kernel requires the paged KV layout "
+                "(root.common.serve.paged / paged=True)")
+        return ServeGeometry(slots, l_max, bucket_min, False, psz, 0,
+                             False)
     if psz < 1:
         raise ValueError(f"page_size must be >= 1, got {psz}")
     if l_max % psz:
@@ -383,7 +539,8 @@ def resolve_serve_geometry(slots=None, l_max=None, bucket_min=None,
         raise ValueError(
             f"page pool of {pages} pages cannot hold one max-length "
             f"request ({n_ptab} pages of {psz} tokens for l_max {l_max})")
-    return ServeGeometry(slots, l_max, bucket_min, True, psz, pages)
+    return ServeGeometry(slots, l_max, bucket_min, True, psz, pages,
+                         use_kernel)
 
 
 def prefill_bucket(p: int, bucket_min: int, l_max: int) -> int:
@@ -509,12 +666,18 @@ class DecodeEngine(Logger):
                  cache_dtype=jnp.float32, status=None,
                  paged: Optional[bool] = None,
                  page_size: Optional[int] = None,
-                 pages: Optional[int] = None):
+                 pages: Optional[int] = None,
+                 paged_kernel: Optional[bool] = None,
+                 spec: Optional[bool] = None,
+                 spec_k: Optional[int] = None,
+                 spec_drafter: Optional[str] = None):
         self.workflow = workflow
         self.wstate = wstate
         self._init_config(slots=slots, l_max=l_max, window_ms=window_ms,
                           queue_depth=queue_depth, deadline_s=deadline_s,
-                          paged=paged, page_size=page_size, pages=pages)
+                          paged=paged, page_size=page_size, pages=pages,
+                          paged_kernel=paged_kernel, spec=spec,
+                          spec_k=spec_k, spec_drafter=spec_drafter)
         self.plan = DecodePlan(workflow, output_unit)
         self.cache_dtype = cache_dtype
         self._ctx = Context(train=False, key=None, mesh=None)
@@ -528,22 +691,42 @@ class DecodeEngine(Logger):
 
     def _init_config(self, *, slots, l_max, window_ms, queue_depth,
                      deadline_s, bucket_min=None, paged=None,
-                     page_size=None, pages=None):
+                     page_size=None, pages=None, paged_kernel=None,
+                     spec=None, spec_k=None, spec_drafter=None):
         serve = root.common.serve
         geo = resolve_serve_geometry(slots, l_max, bucket_min,
                                      paged=paged, page_size=page_size,
-                                     pages=pages)
+                                     pages=pages,
+                                     paged_kernel=paged_kernel)
         self.slots, self.l_max, self.bucket_min = \
             geo.slots, geo.l_max, geo.bucket_min
         self.paged, self.page_size, self.pages = \
             geo.paged, geo.page_size, geo.pages
         self.n_ptab = geo.n_ptab
+        self.paged_kernel = geo.paged_kernel
         self.window_s = float(window_ms if window_ms is not None
                               else serve.get("window_ms", 2.0)) / 1e3
         self.queue_depth = int(queue_depth if queue_depth is not None
                                else serve.get("queue_depth", 64))
         self.deadline_s = float(deadline_s if deadline_s is not None
                                 else serve.get("deadline_s", 120.0))
+        # speculative decoding (docs/serving.md "Speculative decoding"):
+        # the host-side drafter proposes up to spec_k tokens per slot
+        # and the third program kind verifies them in one call
+        self.spec = bool(serve.spec.get("enabled", False)
+                         if spec is None else spec)
+        self.spec_k = int(serve.spec.get("k", 4)
+                          if spec_k is None else spec_k)
+        self.spec_drafter = str(serve.spec.get("drafter", "ngram")
+                                if spec_drafter is None else spec_drafter)
+        if self.spec:
+            if self.spec_k < 1:
+                raise ValueError(
+                    f"serve.spec.k must be >= 1, got {self.spec_k}")
+            if self.spec_drafter != "ngram":
+                raise ValueError(
+                    f"unknown speculative drafter "
+                    f"{self.spec_drafter!r} (supported: 'ngram')")
 
     def _init_runtime(self, params):  # not-shared: __init__-only construction, precedes any thread
         """Slot state + scheduler + gauges + the AOT decode program —
@@ -630,6 +813,34 @@ class DecodeEngine(Logger):
         # the lifetime decode program, AOT-compiled up front
         self._decode = self._compile_decode(params)
 
+        # speculative decoding: the ONE verify program (static k — the
+        # third and last program kind) plus the host-side token history
+        # the n-gram drafter reads.  _hist/_spec_* are scheduler-thread
+        # state like _ptab; only the ScopedCounter views cross threads.
+        self._verify = None
+        self._verify_steps = 0          # scheduler-thread-written
+        self._spec_proposed = ScopedCounter(self._m_spec_proposed)
+        self._spec_accepted = ScopedCounter(self._m_spec_accepted)
+        self._spec_rate_mark = (time.monotonic(), 0, 0)
+        self._spec_accept_rate = 0.0
+        # the interleave policy's measured state (scheduler-thread):
+        # verify-step wall EWMA (vs the decode EWMA below), a recent
+        # accept-rate EWMA (optimistic start so the first drafts run
+        # and measure), and an attempt counter so a parked/cold policy
+        # probes occasionally instead of paying drafter + history-sync
+        # overhead per tick (armed so the FIRST tick attempts)
+        self._verify_wall_ewma = 0.0
+        self._verify_bytes = 0.0
+        self._accept_ewma = 1.0
+        self._ticks_since_attempt = _SPEC_PROBE_TICKS
+        self._spec_attempts = 0         # cold-phase attempt budget
+        if self.spec:
+            self._hist = np.zeros((S, self.l_max), np.int32)
+            self._hist_pos = np.zeros(S, np.int32)  # hist valid to here
+            self._verify = self._compile_verify(params)
+            self._verify_bytes = self.step_cache.program_cost(
+                "verify")["bytes_accessed"]
+
         # goodput denominators: the decode program's cost analysis per
         # execution (bandwidth-utilization numerator) and a wall-time
         # EWMA the scheduler updates each step
@@ -637,6 +848,8 @@ class DecodeEngine(Logger):
         self._decode_flops = dc["flops"]
         self._decode_bytes = dc["bytes_accessed"]
         self._step_wall_ewma = 0.0      # scheduler-thread-written
+        self._bw_ewma = 0.0             # achieved bytes/s (decode AND
+        #                                 verify steps feed it)
         self._last_step_at = 0.0        # scheduler-thread-written
 
         # the aval-derived component ledger (runtime/memory.py,
@@ -712,8 +925,8 @@ class DecodeEngine(Logger):
         # the hardware the decode loop actually runs
         self._g_decode_bw = reg.gauge(
             "vt_decode_bandwidth_bytes_per_sec",
-            "achieved decode-step memory traffic: the decode program's "
-            "cost-analysis bytes over the recent step wall (EWMA)")
+            "achieved decode memory traffic: cost-analysis bytes over "
+            "wall (EWMA), fed by decode AND speculative verify steps")
         self._g_decode_mbu = reg.gauge(
             "vt_decode_mbu",
             "decode model-bandwidth-utilization: achieved bytes/s over "
@@ -725,6 +938,23 @@ class DecodeEngine(Logger):
             "vt_memory_headroom_slots",
             "max-length requests the engine can still admit (free "
             "slots, bounded by free+evictable pages when paged)")
+        # speculative decoding (docs/serving.md "Speculative decoding"):
+        # proposal/acceptance volume plus the windowed accept rate that
+        # decides whether the drafter is paying for its verify steps
+        self._m_spec_proposed = reg.counter(
+            "vt_spec_proposed_total",
+            "draft tokens proposed to the speculative verify program")
+        self._m_spec_accepted = reg.counter(
+            "vt_spec_accepted_total",
+            "draft tokens accepted (emitted token matched the proposal)")
+        self._g_spec_accept_rate = reg.gauge(
+            "vt_spec_accept_rate",
+            "accepted/proposed draft tokens over the recent window "
+            "(0.5s; 0 when nothing was proposed)")
+        self._m_spec_verify = reg.histogram(
+            "vt_spec_verify_step_seconds",
+            "wall time of one speculative verify step (all active "
+            "slots score k+1 positions in one call)")
 
     def _register_memory(self):  # not-shared: __init__-only construction, precedes any thread
         """Publish this engine's aval-derived byte ledger (runtime/
@@ -863,10 +1093,12 @@ class DecodeEngine(Logger):
     def _geometry_key(self):
         """StepCache key suffix: everything shape-determining about the
         cache layout (a paged and a dense engine at the same slots/l_max
-        are DIFFERENT programs)."""
+        are DIFFERENT programs, as are the gather and fused-kernel read
+        paths)."""
         if self.paged:
             return (self.slots, self.l_max, "paged", self.page_size,
-                    self.pages)
+                    self.pages) + (("pkernel",) if self.paged_kernel
+                                   else ())
         return (self.slots, self.l_max)
 
     def _compile_decode(self, params):
@@ -874,9 +1106,25 @@ class DecodeEngine(Logger):
         step, _, _ = self.step_cache.get_step(
             "decode", self._geometry_key(),
             lambda: (make_decode_fn(self.plan, self._ctx, self.slots,
-                                    page_size=psz),
+                                    page_size=psz,
+                                    paged_kernel=self.paged_kernel),
                      None, None),
             self._decode_args_sds(params), pin=(self.workflow,))
+        return step
+
+    def _verify_args_sds(self, params):
+        return self._decode_args_sds(params) + (
+            jax.ShapeDtypeStruct((self.slots, self.spec_k), jnp.int32),)
+
+    def _compile_verify(self, params):
+        psz = self.page_size if self.paged else None
+        step, _, _ = self.step_cache.get_step(
+            "verify", self._geometry_key() + ("k", self.spec_k),
+            lambda: (make_verify_fn(self.plan, self._ctx, self.slots,
+                                    self.spec_k, page_size=psz,
+                                    paged_kernel=self.paged_kernel),
+                     None, None),
+            self._verify_args_sds(params), pin=(self.workflow,))
         return step
 
     def _bucket(self, p: int) -> int:
@@ -1238,11 +1486,11 @@ class DecodeEngine(Logger):
         normalized per local device."""
         ewma = self._step_wall_ewma
         # an idle engine streams nothing: freeze-free gauges report 0
-        # once no decode step ran for a couple of seconds, instead of
-        # showing the last load's bandwidth forever
+        # once no decode OR verify step ran for a couple of seconds,
+        # instead of showing the last load's bandwidth forever
         idle = (self._last_step_at <= 0
                 or time.monotonic() - self._last_step_at > 2.0)
-        bw = self._decode_bytes / ewma if ewma > 0 and not idle else 0.0
+        bw = self._bw_ewma if self._bw_ewma > 0 and not idle else 0.0
         peak_gbps = float(
             root.common.observe.get("peak_hbm_gbps", 0.0) or 0.0)
         mbu = bw / (peak_gbps * 1e9) if peak_gbps > 0 else 0.0
@@ -1258,6 +1506,9 @@ class DecodeEngine(Logger):
             "decode_mbu": round(mbu, 5),
             "tokens_per_sec_per_chip": round(
                 self._tokens_per_sec / chips, 2),
+            # windowed speculative accept rate next to the other
+            # throughput-honesty numbers (0.0 when spec is off or idle)
+            "spec_accept_rate": round(self._spec_accept_rate, 4),
         }
 
     def _headroom_slots(self, pages: Optional[dict]) -> int:
@@ -1286,6 +1537,13 @@ class DecodeEngine(Logger):
             self._tokens_per_sec = ((self._tok_count.n - mark_n)
                                     / max(now - mark_t, 1e-9))
             self._rate_mark = (now, self._tok_count.n)
+        s_t, s_prop, s_acc = self._spec_rate_mark
+        if now - s_t >= 0.5:
+            d_prop = self._spec_proposed.n - s_prop
+            d_acc = self._spec_accepted.n - s_acc
+            self._spec_accept_rate = d_acc / d_prop if d_prop else 0.0
+            self._spec_rate_mark = (now, self._spec_proposed.n,
+                                    self._spec_accepted.n)
         pages = self._pages_summary()
         with self._qlock:
             queue_depth = len(self._queue)
@@ -1296,6 +1554,7 @@ class DecodeEngine(Logger):
         self._g_queue_depth.set(queue_depth)
         self._g_tokens_per_sec.set(self._tokens_per_sec)
         self._g_headroom.set(headroom)
+        self._g_spec_accept_rate.set(self._spec_accept_rate)
         self._g_decode_bw.set(good["decode_bandwidth_bytes_per_sec"])
         self._g_decode_mbu.set(good["decode_mbu"])
         self._g_tps_chip.set(good["tokens_per_sec_per_chip"])
@@ -1337,6 +1596,15 @@ class DecodeEngine(Logger):
             "swaps": self._swaps, "draining": self._draining,
             "scheduler_crashed": self._died,
             "compile": self.step_cache.stats(),
+            **({"spec": {
+                "k": self.spec_k, "drafter": self.spec_drafter,
+                "proposed": self._spec_proposed.n,
+                "accepted": self._spec_accepted.n,
+                "verify_steps": self._verify_steps,
+                "accept_rate": round(
+                    self._spec_accepted.n
+                    / max(self._spec_proposed.n, 1), 4),
+            }} if self.spec else {}),
             "goodput": snap["goodput"],
             "memory": {
                 "headroom_slots": snap["headroom_slots"],
@@ -1389,7 +1657,7 @@ class DecodeEngine(Logger):
                 self._expire_queue()
                 self._admit()  # mid-flight too: no drain barrier
                 if self._active.any():
-                    self._step_once()
+                    self._advance_once()
                 self._maybe_report()
         except Exception as e:  # noqa: BLE001 — a dead scheduler must
             # fail pending work loudly, not hang every client forever
@@ -1682,6 +1950,12 @@ class DecodeEngine(Logger):
         self._eos[slot] = -1 if req.eos_id is None else req.eos_id
         self._end[slot] = P + req.n_steps - 1
         self._keys[slot] = req.key_data
+        if self.spec:
+            # drafter history: the full prompt (paged prefills never
+            # write the shared prompt region of _toks) + the first token
+            self._hist[slot, :P] = req.prompt
+            self._hist[slot, P] = first
+            self._hist_pos[slot] = P
         self._admitted.inc()
         self._tok_count.inc()
         done = (req.n_steps == 1
@@ -1689,6 +1963,146 @@ class DecodeEngine(Logger):
         self._active[slot] = not done
         if done:
             self._retire(slot)
+
+    def _advance_once(self):
+        """One scheduler advance of every active slot: a speculative
+        verify step when the drafter proposed AND the measured payoff
+        test passes (slots without a draft ride along on their ``-1``
+        rows and advance exactly one token — the decode-step
+        behavior), else the plain decode step.  When even a best-case
+        draft could not pay (``_spec_worthwhile``), the drafter and its
+        history sync are skipped entirely — a workload the drafter
+        cannot predict decays to plain decode plus one drafting attempt
+        every ``_SPEC_PROBE_TICKS`` ticks (the attempt counter resets
+        whether or not a draft was found, so an undraftable stream can
+        never degrade to per-tick host overhead)."""
+        draft = None
+        if self.spec and self._spec_worthwhile():
+            probe = self._ticks_since_attempt >= _SPEC_PROBE_TICKS \
+                and self._verify_wall_ewma > 0
+            draft = self._spec_drafts()
+            self._ticks_since_attempt = 0   # attempt consumed either way
+            self._spec_attempts += 1
+            # a parked-regime probe that FOUND a draft runs the verify
+            # unconditionally — its purpose is refreshing the accept
+            # EWMA the payoff test reads; in the profitable regime the
+            # per-matrix payoff test still arbitrates
+            if draft is not None and not probe \
+                    and not self._verify_pays(draft):
+                draft = None
+        if draft is None:
+            self._step_once()
+        else:
+            self._verify_once(draft)
+
+    def _spec_worthwhile(self) -> bool:
+        """Cheap pre-draft gate: could a verify step pay even if EVERY
+        active slot drafted at the recent accept rate?  Same economics
+        as :meth:`_verify_pays` with drafted == active (its upper
+        bound), so a false here implies _verify_pays would refuse any
+        actual draft matrix — skipping the drafter is free.  Three
+        regimes: profitable (measured EWMAs, payoff positive) drafts
+        every tick; cold (no verify step has measured the walls yet)
+        measures-first on every tick but only for a BOUNDED attempt
+        budget — a stream whose history never recurs must not pay
+        drafter + history-sync per tick forever; parked (or cold
+        budget spent) rations attempts to one per
+        ``_SPEC_PROBE_TICKS``."""
+        if self._verify_wall_ewma > 0 and self._step_wall_ewma > 0:
+            ratio = self._verify_wall_ewma / max(self._step_wall_ewma,
+                                                 1e-9)
+            if 1 + self.spec_k * self._accept_ewma >= ratio:
+                return True     # profitable regime: draft every tick
+        elif self._spec_attempts < 64:
+            return True         # cold phase: measure first, boundedly
+        return self._ticks_since_attempt >= _SPEC_PROBE_TICKS
+
+    def _verify_pays(self, draft) -> bool:
+        """Interleave policy: one verify step must be expected to emit
+        at least what the SAME wall spent on decode steps would —
+        ``active + proposed·accept_ewma  >=  active · (verify wall /
+        decode wall)``, all three factors measured on THIS engine (the
+        verify/decode cost ratio is workload- and hardware-shaped:
+        near ``1`` where per-step dispatch dominates — small models on
+        CPU, bandwidth-bound decode on real accelerators — and near
+        ``k+1`` where per-position compute does).  Until both EWMAs
+        exist the answer is yes (measure first); re-qualification after
+        parking is the probe path in :meth:`_advance_once`."""
+        active = int(self._active.sum())
+        # REAL proposal count, not drafted·k: rows are capped by the
+        # slot's length bound and the continuation the n-gram found
+        proposed = int((draft >= 0).sum())
+        if self._verify_wall_ewma <= 0 or self._step_wall_ewma <= 0:
+            return True
+        ratio = self._verify_wall_ewma / max(self._step_wall_ewma, 1e-9)
+        expected = active + proposed * self._accept_ewma
+        return expected >= active * ratio
+
+    def _spec_drafts(self):
+        """(S, K) int32 draft matrix from the n-gram drafter over each
+        active slot's host-side token history, or None when no slot
+        drafted (the scheduler then runs a plain decode step).  ``-1``
+        rows/entries never match, so an undrafted slot still advances
+        one token through the verify program."""
+        self._sync_hist()
+        draft = None
+        for s in np.flatnonzero(self._active):
+            req = self._slot_req[s]
+            if req is None:
+                continue
+            pos, end = int(self._pos[s]), int(self._end[s])
+            # remaining == 1 finishes on the first emitted token: a
+            # draft could accept nothing, so don't pay for one
+            if end - pos < 2:
+                continue
+            row = ngram_draft(self._hist[s, :pos + 1], self.spec_k)
+            if row is None:
+                continue
+            # proposals past the slot's length bound are dead weight
+            keep = min(self.spec_k, end - pos - 1)
+            row[keep:] = -1
+            if not (row >= 0).any():
+                continue
+            if draft is None:
+                draft = np.full((self.slots, self.spec_k), -1, np.int32)
+            draft[s] = row
+        return draft
+
+    def _sync_hist(self):
+        """LAZILY mirror freshly written tokens into the host-side
+        history the drafter reads (one bulk D2H of the token matrix,
+        paid only on ticks that actually draft — a parked speculative
+        engine costs nothing per step).  ``_hist_pos`` tracks how far
+        each slot's mirror is valid; the prompt region stays the host
+        copy _prefill wrote, because paged prefills never write the
+        possibly-shared prompt rows of ``_toks``."""
+        stale = [int(s) for s in np.flatnonzero(self._active)
+                 if self._hist_pos[s] < self._pos[s]]
+        if not stale:
+            return
+        htoks = np.asarray(self._toks)
+        for s in stale:
+            lo, hi = int(self._hist_pos[s]), int(self._pos[s])
+            self._hist[s, lo + 1:hi + 1] = htoks[s, lo + 1:hi + 1]
+            self._hist_pos[s] = hi
+
+    def _post_step(self, finished):
+        """Retirement + mid-flight deadline sweep shared by the decode
+        and verify steps."""
+        now = time.monotonic()
+        for slot in np.flatnonzero(np.asarray(finished)):
+            self._retire(int(slot))
+        # mid-flight deadline: a wedged client must not hold a slot
+        for slot in np.flatnonzero(self._active):
+            req = self._slot_req[slot]
+            if req is not None and now > req.deadline:
+                self._active[slot] = False
+                self._slot_req[slot] = None
+                self._release_slot_pages(int(slot))
+                self._timeouts.inc()
+                req.finish(error=TimeoutError(
+                    "request deadline expired while decoding"))
+                self._observe_finish(req, "504")
 
     def _step_once(self):
         t0 = time.monotonic()
@@ -1713,21 +2127,59 @@ class DecodeEngine(Logger):
         # per-step jitter without hiding a sustained slowdown
         self._step_wall_ewma = wall if self._step_wall_ewma <= 0 \
             else 0.9 * self._step_wall_ewma + 0.1 * wall
+        rate = self._decode_bytes / max(wall, 1e-9)
+        self._bw_ewma = rate if self._bw_ewma <= 0 \
+            else 0.9 * self._bw_ewma + 0.1 * rate
         self._last_step_at = time.monotonic()
-        now = time.monotonic()
-        for slot in np.flatnonzero(np.asarray(finished)):
-            self._retire(int(slot))
-        # mid-flight deadline: a wedged client must not hold a slot
-        for slot in np.flatnonzero(self._active):
-            req = self._slot_req[slot]
-            if req is not None and now > req.deadline:
-                self._active[slot] = False
-                self._slot_req[slot] = None
-                self._release_slot_pages(int(slot))
-                self._timeouts.inc()
-                req.finish(error=TimeoutError(
-                    "request deadline expired while decoding"))
-                self._observe_finish(req, "504")
+        if self.spec:
+            self._ticks_since_attempt += 1
+        self._post_step(finished)
+
+    def _verify_once(self, draft):
+        """One speculative verify step: every active slot scores its
+        ``k + 1`` positions in one program call and advances by its
+        accepted prefix + the bonus token (1 .. k+1 tokens; undrafted
+        slots advance exactly 1).  Bitwise the decode path's tokens —
+        the program's sampler picks every emitted token; the draft only
+        decides how many picks one call makes."""
+        t0 = time.monotonic()
+        old_pos = self._pos.copy()
+        args = (self.wstate["params"], self._caches, self._toks)
+        if self.paged:
+            args += (self._ptab,)
+        (self._caches, self._toks, pos, active, finished,
+         accepted) = self._verify(
+            *args, self._pos, self._active, self._temp, self._topk,
+            self._topp, self._eos, self._end, self._keys, draft)
+        self._pos = np.array(pos)
+        self._active = np.array(active)
+        emitted = int((self._pos - old_pos).sum())
+        self._tok_count.inc(emitted)
+        self._verify_steps += 1
+        proposed = int((draft >= 0).sum())
+        acc = int(np.asarray(accepted).sum())
+        self._spec_proposed.inc(proposed)
+        self._spec_accepted.inc(acc)
+        # the np.array copies synced on the result: honest wall time
+        wall = time.monotonic() - t0
+        self._m_spec_verify.observe(wall)
+        # policy state (see _verify_pays): verify wall + accept EWMAs
+        self._verify_wall_ewma = wall if self._verify_wall_ewma <= 0 \
+            else 0.9 * self._verify_wall_ewma + 0.1 * wall
+        if proposed:
+            self._accept_ewma = (0.8 * self._accept_ewma
+                                 + 0.2 * acc / proposed)
+        # a verify step IS decode traffic: keep the achieved-bandwidth
+        # gauge live (its cost analysis over its wall) and the idle
+        # detector fed — an engine serving pure speculative load must
+        # never scrape as bandwidth-0 (the decode-wall EWMA itself
+        # stays decode-only: it is the payoff test's denominator)
+        if self._verify_bytes > 0:
+            rate = self._verify_bytes / max(wall, 1e-9)
+            self._bw_ewma = rate if self._bw_ewma <= 0 \
+                else 0.9 * self._bw_ewma + 0.1 * rate
+        self._last_step_at = time.monotonic()
+        self._post_step(finished)
 
     def _retire(self, slot: int):
         req = self._slot_req[slot]

@@ -118,7 +118,8 @@ def _rope_rows(x, pos):
     return out.reshape(B, T, H, D)
 
 
-def _attn_decode_step(u, params, cache, x_t, pos, pages=None):
+def _attn_decode_step(u, params, cache, x_t, pos, pages=None, *,
+                      paged_kernel=False):
     """One-position attention against the cache.
 
     x_t: (B, E) activation at position ``pos``; cache k/v: (B, L, Hk, Dh).
@@ -140,7 +141,18 @@ def _attn_decode_step(u, params, cache, x_t, pos, pages=None):
     per-row view ``pool[ptab]`` reshapes to the same (B, L, Hk, Dh)
     logical cache the dense path reads, so tokens stay bitwise
     identical — page indirection is traced data flow, never new
-    program structure."""
+    program structure.
+
+    ``paged_kernel`` (static, keyword-only) routes the paged read side
+    through the fused Pallas paged-attention kernel
+    (ops/pallas_kernels.py ``paged_attention_decode``): the page table
+    rides SMEM and pages stream block-by-block inside the kernel, so
+    the flat ``pool[ptab]`` (B, L, Hk, Dh) transient is never
+    materialized.  The kernel's online softmax changes summation order,
+    so this path is BOUNDED-ERROR vs the gather path (tolerance pinned
+    in tests), never bitwise — it is opt-in
+    (``root.common.serve.paged_kernel``) and composes with, but never
+    silently replaces, the bitwise layouts."""
     B, E = x_t.shape
     H, Hk = u.n_heads, u.n_kv_heads
     dt = u.compute_dtype or x_t.dtype
@@ -181,6 +193,20 @@ def _attn_decode_step(u, params, cache, x_t, pos, pages=None):
         Dh = q.shape[-1]
         G = H // Hk
         L = n_ptab * psz
+        if paged_kernel:
+            from ..ops.pallas_kernels import paged_attention_decode
+
+            def attend():          # (B, H, Dh) f32 context via the
+                return paged_attention_decode(   # fused page-streaming
+                    q[:, 0], ck, cv, ptab, pos,  # kernel
+                    page_size=psz, n_kv_heads=Hk, scale=Dh ** -0.5,
+                    window=u.window)
+
+            return _attn_scores(u, params, xq, None, None, None, pos,
+                                per_row=per_row, B=B, H=H, Hk=Hk, G=G,
+                                Dh=Dh, L=L, dt=dt, out_dtype=x_t.dtype,
+                                new_cache={"k": ck, "v": cv},
+                                attend=attend)
         qg = q[:, 0].reshape(B, Hk, G, Dh).astype(jnp.float32)
         # per-row logical view: gather the row's pages, flatten to the
         # same (B, L, Hk, Dh) the dense path reads
@@ -213,27 +239,38 @@ def _attn_decode_step(u, params, cache, x_t, pos, pages=None):
 
 
 def _attn_scores(u, params, xq, qg, kf, vf, pos, *, per_row, B, H, Hk,
-                 G, Dh, L, dt, out_dtype, new_cache):
+                 G, Dh, L, dt, out_dtype, new_cache, attend=None):
     """Masked score/softmax/output tail shared by the dense and paged
     cache layouts — ONE copy of the attention math, so the two layouts
     cannot drift numerically.  Positional params are traced values;
     everything static (the ``per_row`` layout switch, head geometry,
     dtypes) is keyword-only — the trace-safety convention
-    veles_tpu.analysis checks against (docs/analysis.md)."""
-    s = jnp.einsum("bkgd,btkd->bkgt", qg, kf) * (Dh ** -0.5)
-    t_idx = jnp.arange(L)
-    if per_row:
-        mask = t_idx[None, :] <= pos[:, None]     # (B, L)
-        if u.window is not None:
-            mask &= t_idx[None, :] > pos[:, None] - u.window
-        s = jnp.where(mask[:, None, None, :], s, -jnp.inf)
+    veles_tpu.analysis checks against (docs/analysis.md).
+
+    ``attend`` (static): when the fused Pallas paged-attention kernel
+    computes the context itself (masked softmax·V fused over the page
+    sweep), it supplies the (B, ...) float32 context here and the
+    score/softmax block is skipped (``qg``/``kf``/``vf`` are None) —
+    the output projection / residual / dtype tail below stays the ONE
+    shared copy, so even the kernel layout cannot drift on anything
+    but the documented summation order."""
+    if attend is not None:
+        o = attend()                              # (B, H|Hk*G, Dh) f32
     else:
-        mask = t_idx <= pos
-        if u.window is not None:
-            mask &= t_idx > pos - u.window
-        s = jnp.where(mask[None, None, None, :], s, -jnp.inf)
-    p = jax.nn.softmax(s, axis=-1)
-    o = jnp.einsum("bkgt,btkd->bkgd", p, vf)      # (B, Hk, G, Dh)
+        s = jnp.einsum("bkgd,btkd->bkgt", qg, kf) * (Dh ** -0.5)
+        t_idx = jnp.arange(L)
+        if per_row:
+            mask = t_idx[None, :] <= pos[:, None]     # (B, L)
+            if u.window is not None:
+                mask &= t_idx[None, :] > pos[:, None] - u.window
+            s = jnp.where(mask[:, None, None, :], s, -jnp.inf)
+        else:
+            mask = t_idx <= pos
+            if u.window is not None:
+                mask &= t_idx > pos - u.window
+            s = jnp.where(mask[None, None, None, :], s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bkgt,btkd->bkgd", p, vf)  # (B, Hk, G, Dh)
     y = o.reshape(B, H * Dh).astype(dt) @ params["wo"].astype(dt)
     if u.residual:
         y = y + xq
@@ -389,7 +426,8 @@ class DecodePlan:
             caches[key] = _rec_state_init(u, B)
         return caches
 
-    def step(self, params, caches, tok, pos, ctx: Context, pages=None):
+    def step(self, params, caches, tok, pos, ctx: Context, pages=None,
+             *, paged_kernel=False):
         """One decode position: token ids (B,) -> (logits (B, V), caches).
         O(L) attention per layer via the cache.
 
@@ -402,7 +440,10 @@ class DecodePlan:
 
         ``pages`` = (ptab, page_size, write_ok) selects the paged KV
         layout for every attention unit (see :func:`_attn_decode_step`);
-        it rides the per-row path only."""
+        it rides the per-row path only.  ``paged_kernel`` (static,
+        keyword-only) additionally routes the paged read side through
+        the fused Pallas paged-attention kernel — bounded-error, see
+        :func:`_attn_decode_step`."""
         x = jnp.take(params[self.embedding.name]["table"],
                      tok.astype(jnp.int32), axis=0)      # (B, E)
 
@@ -425,7 +466,8 @@ class DecodePlan:
             if kind == "attn":
                 u = payload
                 x, caches[u.name] = _attn_decode_step(
-                    u, params[u.name], caches[u.name], x, pos, pages)
+                    u, params[u.name], caches[u.name], x, pos, pages,
+                    paged_kernel=paged_kernel)
             elif kind == "recurrent":
                 u = payload
                 x, caches[u.name] = _rec_decode_step(
@@ -443,7 +485,7 @@ class DecodePlan:
                         key = f"{stack.name}/s{i}/{su.name}"
                         x, caches[key] = _attn_decode_step(
                             su, sp[f"s{i}"][su.name], caches[key], x, pos,
-                            pages)
+                            pages, paged_kernel=paged_kernel)
                     elif h[0] == "recurrent":
                         _, su, i = h
                         key = f"{stack.name}/s{i}/{su.name}"

@@ -153,8 +153,8 @@ class StepCache(Logger):
         self._m_compiles = reg.counter(
             "vt_compile_total",
             "trace+compile events by program kind (train / eval / "
-            "decode / prefill) across every StepCache in the process",
-            labels=("program",))
+            "decode / prefill / verify) across every StepCache in the "
+            "process", labels=("program",))
         self._m_hits = reg.counter(
             "vt_compile_hits_total",
             "step programs served from cache", labels=("program",))
