@@ -853,3 +853,37 @@ def test_cpp_lrn_band_bf16_within_tolerance(binary, tmp_path, rng):
     predict = wf.make_predict_step("out")
     ref = np.asarray(predict(ws, {"@input": jnp.asarray(x)}))
     np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-4)
+
+
+def test_export_package_crash_leaves_previous_package_intact(
+        served, tmp_path, monkeypatch):
+    """Regression for the VR704 finding the whole-package lint closure
+    surfaced: export_package used to write contents.json and every
+    weight blob directly onto their final paths, so a re-export dying
+    mid-way left a torn package that load_package (and the C++ runtime)
+    would trust.  Writes now stage as fsynced *.tmp and rename at
+    commit time, manifest last — a crash during staging must leave the
+    previous package byte-identical."""
+    wf, ws, _pkg, _tmp = served
+    dest = str(tmp_path / "pkg_atomic")
+    export_package(wf, ws, dest)
+    before = {fn: open(os.path.join(dest, fn), "rb").read()
+              for fn in os.listdir(dest)}
+
+    real_replace = os.replace
+
+    def dying(src, dst, *a, **kw):
+        if os.path.dirname(str(dst)) == dest:
+            raise OSError(28, "No space left on device (injected)")
+        return real_replace(src, dst, *a, **kw)
+
+    monkeypatch.setattr(os, "replace", dying)
+    with pytest.raises(OSError):
+        export_package(wf, ws, dest)
+    monkeypatch.setattr(os, "replace", real_replace)
+
+    after = {fn: open(os.path.join(dest, fn), "rb").read()
+             for fn in os.listdir(dest) if not fn.endswith(".tmp")}
+    assert after == before          # previous package byte-intact
+    data = load_package(dest)       # and still fully loadable
+    assert data["checksum"] == wf.checksum()

@@ -39,7 +39,18 @@ Rule families (full catalogue in docs/analysis.md):
 * **recompile hazards (VP6xx)** — per-call-varying values must not
   flow into traced-program builder slots, builder bodies must not let
   caller-mapping insertion order become pytree structure, and builders
-  reachable from host hot loops must route through StepCache.
+  reachable from host hot loops must route through StepCache;
+* **resource lifecycles (VR7xx)** — declared acquire/release pairs
+  (the paged-KV refcounts) must balance on every exit path, spawned
+  threads must be daemon or joined somewhere in the package, handles
+  must be ``with``/finally-managed, and durable writes must stage
+  tmp-fsync-rename.
+
+Every reachability closure above resolves across module boundaries
+(:mod:`~.callgraph`): ``from x import y``, module-attribute calls,
+and ``self.m()`` through inheritance and subclass overrides, with
+per-file summaries cached content-hash-keyed in
+``.veles-lint-cache.json`` so the warm gate is sub-second.
 
 Pure ``ast``/``tokenize`` — importing or running this package never
 imports jax or any of the modules it analyzes (a lint pass must be

@@ -1,0 +1,974 @@
+"""Whole-package call-graph resolution — the cross-module closure layer.
+
+Every rule family used to close its reachability scope *module-locally*
+(``pysrc.local_closure``): a deliberate blind spot while the contracts
+being checked (trace scope, lock order, builder routing, shard_map
+scope, resource lifecycles) stayed inside one file.  They no longer do —
+``runtime/engine.py`` compiles builders defined in
+``runtime/generate.py``, REST handlers reach through ``deploy.py`` into
+the engine's locks, and ``ArtifactRunner`` overrides ``DecodeEngine``
+hooks from another module.  This module closes the gap, still pure-AST
+and jax-free:
+
+* :func:`summarize` distills one :class:`~.pysrc.ParsedFile` into a
+  JSON-serializable **summary**: exported defs, class bases and
+  ``self.*`` attrs, import aliases, candidate outgoing references per
+  function, lock-acquisition facts, thread-lifecycle facts, and the
+  def-line markers (``# trace-root:`` etc).  Summaries are everything
+  the cross-module closures need — no AST required — so they cache.
+
+* :class:`PackageGraph` resolves references package-wide:
+  ``from x import y`` names, module-attribute calls
+  (``generate.make_decode_fn``), ``ClassName.method`` chains, and
+  ``self.m()`` through class inheritance **including subclass
+  overrides** (a ``DecodeEngine`` host loop calling ``self._prefill_fn``
+  reaches ``ArtifactRunner._prefill_fn`` too).  On top of resolution it
+  computes the package closures every family consumes: traced scope
+  (VT1xx), shard-map scope (VS5xx), host-loop reach (VP603), the
+  transitive lock/blocking summaries (VC204/VC205) and resource
+  release reach (VR701).  ``cross_module=False`` restricts resolution
+  to each file — the legacy scope, kept so tests can prove the blind
+  spot is closed (and ``--local`` can bisect a finding).
+
+* the **summary cache** (``.veles-lint-cache.json``, gitignored): per
+  file, keyed by content hash, plus a whole-run findings memo keyed by
+  the package-wide context digest.  ``--changed`` parses only the
+  changed files and feeds the closure from cached summaries; a warm
+  full-package run skips straight to the memoized findings.  Any edit
+  invalidates exactly that file's summary (content hash) and the
+  findings memo (context digest) — never another file's summary.
+
+Lock identity is *canonicalized*: ``self._page_lock`` acquired in an
+``ArtifactRunner`` method keys to the class that defines the attribute
+(``DecodeEngine``), so cross-module aliasing through inheritance does
+not split the lock graph, while same-named locks of unrelated classes
+never merge (the module-local analyzer keyed on the bare attribute
+name, which would create false cycles package-wide).
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import os
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .pysrc import ParsedFile, dotted_name
+from .registry import (BUILDER, HOST_LOOP_ROOTS, SHARD_MAP_ROOTS,
+                       TRACE_ROOTS, TRACED)
+
+#: bumped whenever the summary format changes shape (cache entries from
+#: an older analyzer are discarded wholesale via the analyzer digest,
+#: but the explicit version keeps hand-inspection honest).
+SUMMARY_VERSION = 1
+
+CACHE_NAME = ".veles-lint-cache.json"
+
+
+def module_name(relpath: str) -> str:
+    """``veles_tpu/runtime/engine.py`` -> ``veles_tpu.runtime.engine``;
+    ``pkg/__init__.py`` -> ``pkg``."""
+    mod = relpath[:-3] if relpath.endswith(".py") else relpath
+    parts = mod.replace(os.sep, "/").split("/")
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+def registry_entry(table: dict, relpath: str):
+    """Longest path-suffix registry key matching ``relpath`` — the
+    lookup convention every registry table shares."""
+    best, entry = "", None
+    for key, val in table.items():
+        if (relpath == key or relpath.endswith("/" + key)) \
+                and len(key) > len(best):
+            best, entry = key, val
+    return entry
+
+
+# -- blocking-call inventory (shared with concurrency_rules) -----------------
+
+#: modules whose any call blocks (network / subprocess IO).
+BLOCKING_MODULES = ("urllib", "requests", "socket", "subprocess", "http")
+
+#: method names that block when called with no timeout argument.
+TIMEOUT_METHODS = ("join", "wait", "get")
+
+
+def blocking_reason(pf: ParsedFile, node: ast.Call) -> Optional[str]:
+    """A short description when the call blocks, else None."""
+    chain = dotted_name(node.func)
+    resolved = pf.resolve_chain(chain) if chain else None
+    if resolved is not None:
+        head = resolved.split(".")[0]
+        if resolved == "time.sleep":
+            return "time.sleep"
+        if head in BLOCKING_MODULES and "." in resolved:
+            return f"`{chain}` (network/process IO)"
+        if resolved == "jax.device_get":
+            return "jax.device_get (device sync)"
+    if isinstance(node.func, ast.Name) and node.func.id == "open":
+        return "open() (file IO)"
+    if isinstance(node.func, ast.Attribute):
+        attr = node.func.attr
+        if attr == "block_until_ready":
+            return ".block_until_ready() (device sync)"
+        if attr in TIMEOUT_METHODS and not node.args:
+            t = next((k.value for k in node.keywords
+                      if k.arg == "timeout"), None)
+            if t is None or (isinstance(t, ast.Constant)
+                             and t.value is None):
+                return f".{attr}() with no timeout"
+    return None
+
+
+# -- summaries ---------------------------------------------------------------
+
+def _raw_lock(chain: str, cls: Optional[str]) -> Tuple[str, str]:
+    """(scope, attr) for a lock spelling: ``self._x`` scopes to the
+    class, a bare name to the module (``""``), a two-part
+    ``mod._lock`` chain to its head (``"@mod"`` — resolved through the
+    import aliases at canonicalization), anything longer to the
+    file-local ``"?"`` scope (never merged across files)."""
+    parts = chain.split(".")
+    if parts[0] == "self" and len(parts) == 2 and cls:
+        return (cls, parts[1])
+    if len(parts) == 1:
+        return ("", parts[0])
+    if len(parts) == 2 and parts[0] != "self":
+        return ("@" + parts[0], parts[1])
+    return ("?", parts[-1])
+
+
+def _collect_refs(pf: ParsedFile, info, known: Set[str]) -> List[list]:
+    """Candidate outgoing references of one function body (nested
+    ``def``s excluded — they have their own summaries and the closure
+    expands children): bare ``Name`` loads and dotted chains whose head
+    could resolve (a module def/class, an import alias, or ``self``).
+    Deduplicated on the raw spelling."""
+    out: List[list] = []
+    seen: Set[str] = set()
+
+    def add(raw: str, line: int):
+        if raw not in seen:
+            seen.add(raw)
+            out.append([raw, line])
+
+    skip_spans: List[Tuple[int, int]] = []
+    for child in ast.walk(info.node):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and child is not info.node:
+            skip_spans.append(
+                (child.lineno, getattr(child, "end_lineno", child.lineno)))
+
+    def skipped(line: int) -> bool:
+        return any(lo <= line <= hi for lo, hi in skip_spans)
+
+    for node in ast.walk(info.node):
+        line = getattr(node, "lineno", 0)
+        if line and skipped(line):
+            continue
+        if isinstance(node, ast.Name):
+            if node.id in known or node.id in pf.aliases:
+                add(node.id, line)
+        elif isinstance(node, ast.Attribute):
+            chain = dotted_name(node)
+            if chain is None:
+                continue
+            parts = chain.split(".")
+            if len(parts) > 4:
+                continue
+            head = parts[0]
+            if head == "self" and len(parts) == 2 and info.cls:
+                add(chain, line)
+            elif head in pf.aliases or head in known:
+                add(chain, line)
+    return out
+
+
+def _lock_facts(pf: ParsedFile, q, info) -> dict:
+    """Per-function direct lock facts with (scope, attr) raw keys:
+    acquisitions, nesting edges, direct blocking calls (with and
+    without locks held), and call sites annotated with the held-lock
+    set — the inputs of the package-level VC204/VC205 pass."""
+    facts = {"acq": {}, "edges": [], "blk": None, "under": [],
+             "calls": []}
+    entry_held: List[Tuple[str, str]] = []
+    req = pf.comments.requires_lock.get(info.node.lineno)
+    if req:
+        entry_held.append(_raw_lock(req, info.cls))
+
+    def key(raw: Tuple[str, str]) -> str:
+        return f"{raw[0]}|{raw[1]}"
+
+    def walk(stmts, held):
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            if isinstance(stmt, ast.With):
+                inner = list(held)
+                for item in stmt.items:
+                    text = dotted_name(item.context_expr)
+                    if text:
+                        raw = _raw_lock(text, info.cls)
+                        facts["acq"].setdefault(key(raw), stmt.lineno)
+                        for h in inner:
+                            if h != raw:
+                                facts["edges"].append(
+                                    [key(h), key(raw), stmt.lineno])
+                        if raw not in inner:
+                            inner.append(raw)
+                    else:
+                        scan_expr(item.context_expr, held)
+                walk(stmt.body, inner)
+                continue
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.expr):
+                    scan_expr(child, held)
+                elif isinstance(child, ast.stmt):
+                    walk([child], held)
+                elif isinstance(child, ast.ExceptHandler):
+                    walk(child.body, held)
+
+    def scan_expr(node, held):
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            why = blocking_reason(pf, sub)
+            if why is not None:
+                if facts["blk"] is None:
+                    facts["blk"] = [sub.lineno, why]
+                for h in held:
+                    facts["under"].append([key(h), sub.lineno, why])
+            chain = dotted_name(sub.func)
+            if chain is not None:
+                facts["calls"].append(
+                    [[key(h) for h in held], chain, sub.lineno])
+
+    walk(info.node.body, list(entry_held))
+    # dedup edges/calls on their identifying tuple, keep first lines
+    facts["edges"] = [list(t) for t in dict.fromkeys(
+        tuple(e) for e in facts["edges"])]
+    facts["calls"] = [[list(h), r, ln] for h, r, ln in dict.fromkeys(
+        (tuple(h), r, ln) for h, r, ln in facts["calls"])]
+    return facts
+
+
+def _thread_facts(pf: ParsedFile) -> dict:
+    """VR702 inputs: every ``threading.Thread(...)`` construction (with
+    its daemon kwarg, binding target and enclosing symbol), plus the
+    attribute/local names the file ``.join()``s or sets ``.daemon`` on."""
+    threads: List[dict] = []
+    joins: Set[str] = set()
+    daemon_sets: Set[str] = set()
+
+    for node in ast.walk(pf.tree):
+        if isinstance(node, ast.Call) and isinstance(node.func,
+                                                     ast.Attribute) \
+                and node.func.attr == "join":
+            base = dotted_name(node.func.value)
+            if base:
+                joins.add(base.split(".")[-1])
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            t = node.targets[0]
+            if isinstance(t, ast.Attribute) and t.attr == "daemon" \
+                    and isinstance(node.value, ast.Constant) \
+                    and node.value.value is True:
+                base = dotted_name(t.value)
+                if base:
+                    daemon_sets.add(base.split(".")[-1])
+
+    def symbol_at(line: int) -> str:
+        best, span = "", None
+        for q, info in pf.functions.items():
+            end = getattr(info.node, "end_lineno", info.node.lineno)
+            if info.node.lineno <= line <= end:
+                s = end - info.node.lineno
+                if span is None or s < span:
+                    best, span = q, s
+        return best
+
+    targets: Dict[int, str] = {}        # id(Thread call) -> bound name
+    for node in ast.walk(pf.tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.value, ast.Call):
+            t = node.targets[0]
+            name = None
+            if isinstance(t, ast.Attribute):
+                name = t.attr
+            elif isinstance(t, ast.Name):
+                name = t.id
+            if name:
+                targets[id(node.value)] = name
+
+    for node in ast.walk(pf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = dotted_name(node.func)
+        if chain is None or pf.resolve_chain(chain) != "threading.Thread":
+            continue
+        daemon = None
+        for kw in node.keywords:
+            if kw.arg == "daemon" and isinstance(kw.value, ast.Constant):
+                daemon = bool(kw.value.value)
+        threads.append({"line": node.lineno, "daemon": daemon,
+                        "target": targets.get(id(node)),
+                        "symbol": symbol_at(node.lineno)})
+    return {"threads": threads, "joins": sorted(joins),
+            "daemon_sets": sorted(daemon_sets)}
+
+
+def summarize(pf: ParsedFile) -> dict:
+    """The serializable cross-module summary of one parsed file."""
+    defs = {q: info.node.lineno for q, info in pf.functions.items()}
+    cls_of = {q: (info.cls or "") for q, info in pf.functions.items()
+              if info.cls}
+    classes: Dict[str, List[str]] = {}
+    attrs: Dict[str, List[str]] = {}
+    for node in ast.walk(pf.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        bases = []
+        for b in node.bases:
+            chain = dotted_name(b)
+            if chain:
+                bases.append(chain)
+        classes[node.name] = bases
+        own: Set[str] = set()
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.Assign, ast.AnnAssign)):
+                targets = sub.targets if isinstance(sub, ast.Assign) \
+                    else [sub.target]
+                for t in targets:
+                    if isinstance(t, ast.Attribute) \
+                            and isinstance(t.value, ast.Name) \
+                            and t.value.id == "self":
+                        own.add(t.attr)
+        attrs[node.name] = sorted(own)
+
+    known = set(defs) | set(classes)
+    refs = {}
+    locks = {}
+    fincalls = {}
+    for q, info in pf.functions.items():
+        r = _collect_refs(pf, info, known)
+        if r:
+            refs[q] = r
+        locks[q] = _lock_facts(pf, q, info)
+        # final names of every call in the body (receiver-agnostic):
+        # how VR701 sees `pool.free(h)` — the receiver object is not
+        # statically resolvable, the method name is
+        names = sorted({n for n in (
+            (node.func.id if isinstance(node.func, ast.Name)
+             else node.func.attr if isinstance(node.func, ast.Attribute)
+             else None)
+            for node in ast.walk(info.node)
+            if isinstance(node, ast.Call)) if n})
+        if names:
+            fincalls[q] = names
+
+    # annotated locks, qualified by the class enclosing the comment line
+    cls_spans = []
+    for node in ast.walk(pf.tree):
+        if isinstance(node, ast.ClassDef):
+            cls_spans.append((node.lineno,
+                              getattr(node, "end_lineno", node.lineno),
+                              node.name))
+
+    def cls_at(line: int) -> Optional[str]:
+        best, span = None, None
+        for lo, hi, name in cls_spans:
+            if lo <= line <= hi and (span is None or hi - lo < span):
+                best, span = name, hi - lo
+        return best
+
+    ann = set()
+    for line, lock in list(pf.comments.guarded_by.items()) \
+            + list(pf.comments.requires_lock.items()):
+        scope, attr = _raw_lock(lock, cls_at(line))
+        ann.add(f"{scope}|{attr}")
+
+    markers = {"trace": {}, "shard": {}, "host": [],
+               "acquire": {}, "release": {}, "durable": []}
+    for q, info in pf.functions.items():
+        ln = info.node.lineno
+        mode = pf.comments.trace_root.get(ln)
+        if mode:
+            markers["trace"][q] = TRACED if mode == "traced" else BUILDER
+        env = pf.comments.shard_map_root.get(ln)
+        if env is not None:
+            markers["shard"][q] = list(env)
+        if ln in pf.comments.host_loop_root:
+            markers["host"].append(q)
+        res = pf.comments.resource_acquire.get(ln)
+        if res:
+            markers["acquire"][q] = res
+        res = pf.comments.resource_release.get(ln)
+        if res:
+            markers["release"][q] = res
+        if ln in pf.comments.durable_write:
+            markers["durable"].append(q)
+
+    return {"module": module_name(pf.relpath), "defs": defs,
+            "cls_of": cls_of, "classes": classes, "attrs": attrs,
+            "aliases": dict(pf.aliases), "refs": refs, "locks": locks,
+            "fincalls": fincalls, "ann_locks": sorted(ann),
+            "markers": markers, **_thread_facts(pf)}
+
+
+# -- the graph ---------------------------------------------------------------
+
+class PackageGraph:
+    """Package-wide resolution and closures over per-file summaries.
+
+    ``cross_module=False`` restricts every resolution to the reference's
+    own file — the legacy module-local closure, byte-compatible with the
+    pre-graph analyzer (used by tests to prove a cross-module seed is
+    invisible to it, and by ``--local`` to bisect findings)."""
+
+    def __init__(self, summaries: Dict[str, dict], *,
+                 cross_module: bool = True):
+        self.summaries = summaries
+        self.cross_module = cross_module
+        self.modules: Dict[str, str] = {
+            s["module"]: rel for rel, s in summaries.items()}
+        # class name -> [(relpath, base chains)] across the package
+        self.classes: Dict[str, List[str]] = {}
+        for rel, s in summaries.items():
+            for cname in s["classes"]:
+                self.classes.setdefault(cname, []).append(rel)
+        self._subclasses: Optional[Dict[Tuple[str, str],
+                                        List[Tuple[str, str]]]] = None
+        self._resolve_memo: Dict[Tuple[str, Optional[str], str],
+                                 Tuple[Tuple[str, str], ...]] = {}
+        self._tscope_memo: Optional[Dict[Tuple[str, str], bool]] = None
+
+    # -- module / class resolution ------------------------------------------
+    def resolve_module(self, dotted: str, importer: str) -> Optional[str]:
+        """Module dotted name -> relpath.  Relative names (leading dots)
+        resolve against the importing module; absolute names match
+        exactly, then by unique dotted suffix (fixture trees anchor
+        display paths at a tmp dir the import never names)."""
+        if dotted.startswith("."):
+            level = len(dotted) - len(dotted.lstrip("."))
+            rest = dotted.lstrip(".")
+            base = self.summaries[importer]["module"].split(".")
+            base = base[:len(base) - level] if level <= len(base) else []
+            dotted = ".".join(base + ([rest] if rest else []))
+        rel = self.modules.get(dotted)
+        if rel is not None:
+            return rel
+        hits = [r for m, r in self.modules.items()
+                if m.endswith("." + dotted)]
+        return hits[0] if len(hits) == 1 else None
+
+    def _class_home(self, rel: str, cname: str) -> Optional[str]:
+        """The file defining class ``cname`` as seen from ``rel``:
+        local definition first, then the import alias, then (cross
+        module) a unique package-wide definition."""
+        s = self.summaries.get(rel)
+        if s is None:
+            return None
+        if cname in s["classes"]:
+            return rel
+        if not self.cross_module:
+            return None
+        canon = s["aliases"].get(cname)
+        if canon:
+            mod, _, leaf = canon.rpartition(".")
+            if leaf == cname and mod:
+                home = self.resolve_module(mod, rel)
+                if home and cname in self.summaries[home]["classes"]:
+                    return home
+        homes = self.classes.get(cname, [])
+        return homes[0] if len(homes) == 1 else None
+
+    def _mro(self, rel: str, cname: str,
+             limit: int = 10) -> List[Tuple[str, str]]:
+        """Linearized (relpath, class) chain: the class then its bases,
+        resolved through imports; unresolvable bases are dropped."""
+        out: List[Tuple[str, str]] = []
+        seen: Set[Tuple[str, str]] = set()
+        work = [(rel, cname)]
+        while work and len(out) < limit:
+            r, c = work.pop(0)
+            home = self._class_home(r, c)
+            if home is None or (home, c) in seen:
+                continue
+            seen.add((home, c))
+            out.append((home, c))
+            for base in self.summaries[home]["classes"].get(c, ()):
+                leaf = base.split(".")[-1]
+                work.append((home, leaf))
+        return out
+
+    def subclasses(self, rel: str, cname: str) -> List[Tuple[str, str]]:
+        """Known package subclasses of (rel, cname), transitively."""
+        if self._subclasses is None:
+            index: Dict[Tuple[str, str], List[Tuple[str, str]]] = {}
+            for r, s in self.summaries.items():
+                for c in s["classes"]:
+                    for home, base in self._mro(r, c)[1:]:
+                        index.setdefault((home, base), []).append((r, c))
+            self._subclasses = index
+        if not self.cross_module:
+            return [(r, c) for r, c in
+                    self._subclasses.get((rel, cname), []) if r == rel]
+        return self._subclasses.get((rel, cname), [])
+
+    def _method(self, rel: str, cname: str,
+                meth: str) -> Optional[Tuple[str, str]]:
+        """The defining (relpath, qualname) of ``cname.meth`` walking
+        the MRO."""
+        for r, c in self._mro(rel, cname):
+            if f"{c}.{meth}" in self.summaries[r]["defs"]:
+                return (r, f"{c}.{meth}")
+        return None
+
+    # -- reference resolution -----------------------------------------------
+    def resolve(self, rel: str, cls: Optional[str],
+                raw: str) -> List[Tuple[str, str]]:
+        """All (relpath, qualname) targets a raw reference may reach.
+
+        ``self.m`` resolves through the enclosing class's MRO *plus*
+        every package subclass override (dynamic dispatch from a base
+        method can land there); bare names resolve to module defs then
+        through ``from x import y``; dotted chains resolve through
+        module aliases (``generate.make_decode_fn``) and local/imported
+        classes (``DecodePlan.step``)."""
+        memo_key = (rel, cls, raw)
+        hit = self._resolve_memo.get(memo_key)
+        if hit is not None:
+            return list(hit)
+        out = self._resolve(rel, cls, raw)
+        self._resolve_memo[memo_key] = tuple(out)
+        return out
+
+    def _resolve(self, rel, cls, raw):
+        s = self.summaries.get(rel)
+        if s is None:
+            return []
+        parts = raw.split(".")
+        out: List[Tuple[str, str]] = []
+        if parts[0] == "self" and len(parts) == 2 and cls:
+            meth = parts[1]
+            base = self._method(rel, cls, meth)
+            if base is None:
+                return []
+            out.append(base)
+            mro = self._mro(rel, cls)
+            if mro:
+                # dynamic dispatch: a base method calling self.m() can
+                # land in any package subclass override
+                for r2, c2 in self.subclasses(*mro[0]):
+                    q2 = f"{c2}.{meth}"
+                    if q2 in self.summaries[r2]["defs"] \
+                            and (r2, q2) != base:
+                        out.append((r2, q2))
+            return out
+        if len(parts) == 1:
+            name = raw
+            if name in s["defs"] and "." not in name:
+                return [(rel, name)]
+            if not self.cross_module:
+                return []
+            canon = s["aliases"].get(name)
+            if canon and canon != name:
+                return self._resolve_canonical(canon, rel)
+            return []
+        # dotted: ClassName.method on a local or imported class, or a
+        # module-attribute chain through an import alias
+        head = parts[0]
+        if head in s["classes"] or (self.cross_module
+                                    and self._class_home(rel, head)):
+            home = self._class_home(rel, head)
+            if home is not None and len(parts) == 2:
+                m = self._method(home, head, parts[1])
+                return [m] if m else []
+            return []
+        canon = s["aliases"].get(head)
+        if canon is None:
+            return []
+        if not self.cross_module:
+            return []
+        return self._resolve_canonical(
+            canon + "." + ".".join(parts[1:]), rel)
+
+    def _resolve_canonical(self, canon: str, importer: str):
+        """``veles_tpu.runtime.generate.make_decode_fn`` (or a relative
+        ``.generate.make_decode_fn``) -> defining (relpath, qualname),
+        trying the longest module prefix first so
+        ``pkg.mod.Class.method`` splits correctly."""
+        lead = ""
+        while canon.startswith("."):
+            lead += "."
+            canon = canon[1:]
+        parts = canon.split(".")
+        for cut in range(len(parts) - 1, 0, -1):
+            mod = lead + ".".join(parts[:cut])
+            target = self.resolve_module(mod, importer)
+            if target is None:
+                continue
+            qual = ".".join(parts[cut:])
+            tdefs = self.summaries[target]["defs"]
+            if qual in tdefs:
+                return [(target, qual)]
+            # imported class referenced bare: not a function target
+            return []
+        return []
+
+    # -- closures ------------------------------------------------------------
+    def closure(self, roots: Iterable[Tuple[str, str]]
+                ) -> Set[Tuple[str, str]]:
+        """Roots + nested ``def``s + transitively referenced functions,
+        resolved package-wide (or module-locally when
+        ``cross_module=False``)."""
+        seen: Set[Tuple[str, str]] = set()
+        work: List[Tuple[str, str]] = []
+        for rel, q in roots:
+            s = self.summaries.get(rel)
+            if s is not None and q in s["defs"]:
+                seen.add((rel, q))
+                work.append((rel, q))
+        while work:
+            rel, q = work.pop()
+            s = self.summaries[rel]
+            for q2 in s["defs"]:
+                if q2.startswith(q + ".") and (rel, q2) not in seen:
+                    seen.add((rel, q2))
+                    work.append((rel, q2))
+            cls = s["cls_of"].get(q) or None
+            for raw, _line in s["refs"].get(q, ()):
+                for tgt in self.resolve(rel, cls, raw):
+                    if tgt not in seen:
+                        seen.add(tgt)
+                        work.append(tgt)
+        return seen
+
+    def traced_scope(self, overrides: Optional[dict] = None
+                     ) -> Dict[Tuple[str, str], bool]:
+        """(relpath, qualname) -> params-tainted for every function in
+        traced scope, package-wide: declared roots keep their declared
+        mode, nested ``def``s are the literal jit/scan bodies (tainted),
+        and functions a traced body merely references join with
+        untainted parameters — the per-file semantics of the legacy
+        closure, closed over the whole package.  Memoized for the
+        no-overrides case: the default run computes this fixpoint once,
+        shared by VT1xx and the VP6xx program scope."""
+        if overrides is None and self._tscope_memo is not None:
+            return self._tscope_memo
+        table = overrides if overrides is not None else TRACE_ROOTS
+        modes: Dict[Tuple[str, str], bool] = {}
+        work: List[Tuple[str, str]] = []
+        for rel, s in self.summaries.items():
+            entry = registry_entry(table, rel) or {}
+            roots = dict(entry)
+            roots.update(s["markers"]["trace"])
+            for q, mode in roots.items():
+                if q in s["defs"]:
+                    modes[(rel, q)] = mode == TRACED
+                    work.append((rel, q))
+        while work:
+            rel, q = work.pop()
+            s = self.summaries[rel]
+            for q2 in s["defs"]:
+                if q2.startswith(q + ".") \
+                        and "." not in q2[len(q) + 1:] \
+                        and (rel, q2) not in modes:
+                    modes[(rel, q2)] = True
+                    work.append((rel, q2))
+            cls = s["cls_of"].get(q) or None
+            for raw, _line in s["refs"].get(q, ()):
+                for tgt in self.resolve(rel, cls, raw):
+                    if tgt not in modes:
+                        modes[tgt] = False
+                        work.append(tgt)
+        if overrides is None:
+            self._tscope_memo = modes
+        return modes
+
+    def shard_scope(self) -> Dict[Tuple[str, str], Tuple[str, ...]]:
+        """(relpath, qualname) -> bound-axes environment for every
+        function inside a shard_map scope: the union of the axes of
+        every root whose closure reaches it."""
+        env: Dict[Tuple[str, str], Set[str]] = {}
+        for rel, s in self.summaries.items():
+            roots: Dict[str, Tuple[str, ...]] = {}
+            entry = registry_entry(SHARD_MAP_ROOTS, rel)
+            if entry:
+                roots.update(entry)
+            for q, axes in s["markers"]["shard"].items():
+                roots[q] = tuple(axes)
+            for q, axes in roots.items():
+                for tgt in self.closure([(rel, q)]):
+                    env.setdefault(tgt, set()).update(axes)
+        return {k: tuple(sorted(v)) for k, v in env.items()}
+
+    def host_scope(self) -> Set[Tuple[str, str]]:
+        """Functions reachable from the registered host hot loops
+        (scheduler ticks, REST handlers) package-wide — VP603's reach."""
+        roots: List[Tuple[str, str]] = []
+        for rel, s in self.summaries.items():
+            entry = registry_entry(HOST_LOOP_ROOTS, rel) or ()
+            for q in list(entry) + s["markers"]["host"]:
+                roots.append((rel, q))
+        return self.closure(roots)
+
+    def program_scope(self) -> Set[Tuple[str, str]]:
+        """Everything inside the traced-program closure (both root
+        modes): builder calls here are build-time composition, exempt
+        from the VP601/VP603 host-boundary rules."""
+        return set(self.traced_scope())
+
+    # -- lock graph -----------------------------------------------------------
+    def canonical_lock(self, rel: str, key: str) -> str:
+        """``scope|attr`` -> package-canonical lock id.  Class-scoped
+        locks canonicalize to the class that *defines* the attribute
+        (MRO walk), so ``self._page_lock`` held in an ``ArtifactRunner``
+        method and in a ``DecodeEngine`` method are the same lock.
+        Module-level locks canonicalize to their *defining module*
+        through the import aliases, so ``from eng import _lock`` (or
+        ``with eng._lock:``) merges with the ``guarded-by`` annotation
+        in ``eng.py``."""
+        scope, _, attr = key.partition("|")
+        if scope.startswith("@"):
+            # `mod._lock` chain: resolve the head as an imported module
+            if self.cross_module:
+                s = self.summaries.get(rel, {})
+                canon = s.get("aliases", {}).get(scope[1:])
+                if canon:
+                    home = self.resolve_module(canon, rel)
+                    if home is not None:
+                        return f"{home}::{attr}"
+            # unresolvable object head: keep the head in the id so
+            # `a._lock` and `b._lock` (distinct objects) never merge
+            # into one node — a merge would mint self-edge "deadlocks"
+            return f"{rel}:?{scope[1:]}:{attr}"
+        if scope and scope != "?":
+            for r, c in self._mro(rel, scope):
+                if attr in self.summaries[r]["attrs"].get(c, ()):
+                    return f"{r}:{c}:{attr}"
+            return f"{rel}:{scope}:{attr}"
+        if scope == "?":
+            return f"{rel}:?:{attr}"
+        # bare module-level name: a from-import of another module's
+        # global canonicalizes at the definition site
+        if self.cross_module:
+            s = self.summaries.get(rel, {})
+            canon = s.get("aliases", {}).get(attr)
+            if canon:
+                mod, _, leaf = canon.rpartition(".")
+                if leaf == attr and mod:
+                    home = self.resolve_module(mod, rel)
+                    if home is not None:
+                        return f"{home}::{attr}"
+        return f"{rel}::{attr}"
+
+    def lock_analysis(self):
+        """Package-wide transitive lock facts::
+
+            (trans_acq, trans_blk, edges, annotated)
+
+        * ``trans_acq[(rel, q)]`` — canonical locks acquired by the
+          function or anything it (transitively) calls;
+        * ``trans_blk[(rel, q)]`` — ``(line, why, rel)`` of the first
+          blocking call reachable from the function, else None;
+        * ``edges[(a, b)]`` — ``(line, rel, qual)`` witness where lock
+          ``b`` is acquired (possibly through calls) while ``a`` held;
+        * ``annotated`` — canonical ids of every ``guarded-by``/
+          ``requires-lock``-annotated lock in the package.
+        """
+        facts: Dict[Tuple[str, str], dict] = {}
+        canon_memo: Dict[Tuple[str, str], str] = {}
+
+        def canon(rel, key):
+            hit = canon_memo.get((rel, key))
+            if hit is None:
+                hit = self.canonical_lock(rel, key)
+                canon_memo[(rel, key)] = hit
+            return hit
+
+        calls: Dict[Tuple[str, str], List] = {}
+        for rel, s in self.summaries.items():
+            for q, f in s["locks"].items():
+                node = {"acq": {canon(rel, k): ln
+                                for k, ln in f["acq"].items()},
+                        # raw-distinct spellings can canonicalize to
+                        # one lock (aliases, inheritance): a collapsed
+                        # edge is re-entrancy, not an ordering cycle
+                        "edges": [(ca, cb, ln)
+                                  for a, b, ln in f["edges"]
+                                  for ca, cb in [(canon(rel, a),
+                                                  canon(rel, b))]
+                                  if ca != cb],
+                        "blk": f["blk"], "under": f["under"]}
+                facts[(rel, q)] = node
+                cls = s["cls_of"].get(q) or None
+                resolved = []
+                for held, raw, line in f["calls"]:
+                    tgts = self.resolve(rel, cls, raw)
+                    if tgts:
+                        resolved.append(
+                            ([canon(rel, h) for h in held], raw,
+                             line, tgts))
+                calls[(rel, q)] = resolved
+
+        trans_acq = {k: set(v["acq"]) for k, v in facts.items()}
+        trans_blk: Dict[Tuple[str, str], Optional[tuple]] = {
+            k: (tuple(v["blk"]) + (k[0],) if v["blk"] else None)
+            for k, v in facts.items()}
+        changed = True
+        while changed:
+            changed = False
+            for k, rcalls in calls.items():
+                for _held, _raw, _line, tgts in rcalls:
+                    for tgt in tgts:
+                        if tgt not in facts:
+                            continue
+                        extra = trans_acq[tgt] - trans_acq[k]
+                        if extra:
+                            trans_acq[k] |= extra
+                            changed = True
+                        if trans_blk[k] is None \
+                                and trans_blk[tgt] is not None:
+                            trans_blk[k] = trans_blk[tgt]
+                            changed = True
+
+        # ordering edges: locks with an unresolvable identity (the
+        # ``:?`` fallback scopes — object-attribute spellings like
+        # ``req._lock``) are excluded; object lock flow is out of
+        # scope by contract, and a speculative node would mint
+        # deadlock reports between locks that may never coexist
+        def orderable(lock: str) -> bool:
+            return ":?" not in lock
+
+        edges: Dict[Tuple[str, str], Tuple[int, str, str]] = {}
+        for (rel, q), v in facts.items():
+            for a, b, ln in v["edges"]:
+                if orderable(a) and orderable(b):
+                    edges.setdefault((a, b), (ln, rel, q))
+            for held, _raw, line, tgts in calls[(rel, q)]:
+                for tgt in tgts:
+                    for b in trans_acq.get(tgt, ()):
+                        for a in held:
+                            if a != b and orderable(a) \
+                                    and orderable(b):
+                                edges.setdefault((a, b),
+                                                 (line, rel, q))
+
+        annotated: Set[str] = set()
+        for rel, s in self.summaries.items():
+            for key in s["ann_locks"]:
+                annotated.add(canon(rel, key))
+        return trans_acq, trans_blk, edges, annotated, facts, calls
+
+
+# -- the summary cache -------------------------------------------------------
+
+def analyzer_digest() -> str:
+    """Hash of the analyzer's own sources: any rule/registry edit
+    invalidates every cached summary and findings memo."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    h = hashlib.sha256(str(SUMMARY_VERSION).encode())
+    for fn in sorted(os.listdir(here)):
+        if fn.endswith(".py"):
+            with open(os.path.join(here, fn), "rb") as f:
+                h.update(fn.encode())
+                h.update(f.read())
+    return h.hexdigest()[:16]
+
+
+def content_hash(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()[:16]
+
+
+class SummaryCache:
+    """Content-hash-keyed per-file summaries plus a whole-run findings
+    memo (``.veles-lint-cache.json``, gitignored — safe to delete at
+    any time).  An edited file misses on its content hash and refreshes
+    only its own entry; the findings memo keys on the digest of every
+    (path, hash) pair plus the docs and analyzer digests, so any edit
+    anywhere retires it without touching other files' summaries."""
+
+    def __init__(self, path: Optional[str]):
+        self.path = path
+        self.digest = analyzer_digest()
+        self.summaries: Dict[str, dict] = {}   # rel -> {hash, summary}
+        self.findings: Optional[dict] = None   # {context, report}
+        self.dirty = False
+        if path and os.path.isfile(path):
+            try:
+                with open(path) as f:
+                    doc = json.load(f)
+                if doc.get("analyzer") == self.digest:
+                    self.summaries = doc.get("files", {})
+                    self.findings = doc.get("findings")
+            except (ValueError, OSError):
+                pass
+
+    def summary(self, rel: str, h: str) -> Optional[dict]:
+        entry = self.summaries.get(rel)
+        if entry is not None and entry.get("hash") == h:
+            return entry["summary"]
+        return None
+
+    def put_summary(self, rel: str, h: str, summary: dict):
+        prev = self.summaries.get(rel)
+        if prev is None or prev.get("hash") != h:
+            self.summaries[rel] = {"hash": h, "summary": summary}
+            self.dirty = True
+
+    def context_digest(self, hashes: Dict[str, str],
+                       docs_digest: str) -> str:
+        h = hashlib.sha256(self.digest.encode())
+        h.update(docs_digest.encode())
+        for rel in sorted(hashes):
+            h.update(f"{rel}={hashes[rel]}".encode())
+        return h.hexdigest()[:16]
+
+    def memo(self, context: str) -> Optional[dict]:
+        if self.findings and self.findings.get("context") == context:
+            return self.findings.get("report")
+        return None
+
+    def put_memo(self, context: str, report: dict):
+        self.findings = {"context": context, "report": report}
+        self.dirty = True
+
+    def save(self):
+        if not self.path or not self.dirty:
+            return
+        doc = {"comment": "veles-tpu-lint summary cache — content-hash "
+                          "keyed, safe to delete (docs/analysis.md)",
+               "analyzer": self.digest, "files": self.summaries,
+               "findings": self.findings}
+        tmp = self.path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(doc, f, separators=(",", ":"))
+            os.replace(tmp, self.path)
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+        self.dirty = False
+
+
+def docs_digest(docs_dir: Optional[str]) -> str:
+    """Hash of the doc files the drift rules read (VK303/VM4xx)."""
+    if not docs_dir or not os.path.isdir(docs_dir):
+        return "nodocs"
+    h = hashlib.sha256()
+    for base, _dirs, files in os.walk(docs_dir):
+        for fn in sorted(files):
+            if fn.endswith((".md", ".rst", ".txt")):
+                try:
+                    with open(os.path.join(base, fn), "rb") as f:
+                        h.update(fn.encode())
+                        h.update(f.read())
+                except OSError:
+                    pass
+    return h.hexdigest()[:16]

@@ -58,7 +58,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "positional path scope (default veles_tpu) "
                         "when it exists — so the hook and the CI gate "
                         "agree on what is clean; zero changed files "
-                        "is a clean exit, not a usage error")
+                        "is a clean exit, not a usage error.  The "
+                        "unchanged files still feed the cross-module "
+                        "closure through cached summaries")
+    p.add_argument("--no-cache", action="store_true",
+                   help="ignore and do not write the summary cache "
+                        "(.veles-lint-cache.json — content-hash keyed, "
+                        "safe to delete any time)")
+    p.add_argument("--local", action="store_true",
+                   help="restrict every closure to module-local reach "
+                        "(the pre-cross-module analyzer) — for "
+                        "bisecting whether a finding needs the "
+                        "package-wide graph")
     return p
 
 
@@ -110,7 +121,10 @@ def main(argv: Optional[list] = None) -> int:
     args = build_parser().parse_args(argv)
     baseline = None if args.baseline == "none" else args.baseline
     docs = None if args.docs == "none" else args.docs
+    cache = None if args.no_cache else "auto"
+    cross = not args.local
     paths = args.paths
+    scope_paths = None
     if args.changed is not None:
         changed = _changed_paths(args.changed, paths)
         if changed is None:
@@ -133,8 +147,13 @@ def main(argv: Optional[list] = None) -> int:
             else:
                 print("clean: no changed Python files")
             return 0
+        # the unchanged rest of the scope still feeds the cross-module
+        # closure (cached summaries; parsed once on a cold cache)
+        scope_paths = anchors or None
         paths = changed
-    report = run_analysis(paths, baseline_path=baseline, docs_dir=docs)
+    report = run_analysis(paths, baseline_path=baseline, docs_dir=docs,
+                          cache_path=cache, scope_paths=scope_paths,
+                          cross_module=cross)
     if report["files"] == 0:
         # a wrong cwd / typo'd path must not silently DISABLE the gate
         # by "cleanly" analyzing nothing

@@ -41,6 +41,18 @@ The analyzer's three in-source annotations all live in comments, so one
     On a ``def`` line: the function is a hot host loop (scheduler tick,
     REST request handler) — traced-program builders reachable from it
     must route through ``StepCache`` (recompile_rules, VP603).
+
+``# resource-acquire: <name>`` / ``# resource-release: <name>``
+    On a ``def`` line: the function acquires / releases the named
+    resource (pages, handles, …).  The VR701 lifecycle rule pairs the
+    two over the package call graph; the registry's
+    ``RESOURCE_PAIRS`` is the checked-in form, the comment the
+    fixture/escape syntax (resource_rules).
+
+``# durable-write:``
+    On a ``def`` line: the function's file writes must follow the
+    tmp-fsync-rename idiom (resource_rules, VR704) — the fixture form
+    of the registry's ``DURABLE_WRITE_MODULES``.
 """
 
 from __future__ import annotations
@@ -61,6 +73,9 @@ _NOTSHARED_RE = re.compile(r"#\s*not-shared:\s*(\S.*)")
 _SHARDROOT_RE = re.compile(
     r"#\s*shard-map-root:\s*([A-Za-z_]\w*(?:\s*,\s*[A-Za-z_]\w*)*)")
 _HOSTLOOP_RE = re.compile(r"#\s*host-loop-root:")
+_RES_ACQ_RE = re.compile(r"#\s*resource-acquire:\s*([\w-]+)")
+_RES_REL_RE = re.compile(r"#\s*resource-release:\s*([\w-]+)")
+_DURABLE_RE = re.compile(r"#\s*durable-write:")
 
 
 @dataclasses.dataclass
@@ -87,6 +102,11 @@ class FileComments:
     shard_map_root: Dict[int, Tuple[str, ...]]
     #: comment lines marked as host hot loops (VP603 roots)
     host_loop_root: Set[int]
+    #: comment line -> resource name acquired / released (VR701)
+    resource_acquire: Dict[int, str]
+    resource_release: Dict[int, str]
+    #: comment lines whose function must write atomically (VR704)
+    durable_write: Set[int]
 
     def suppressed(self, line: int, rule: str) -> Optional[Suppression]:
         s = self.suppressions.get(line)
@@ -114,7 +134,7 @@ def scan_comments(source: str) -> FileComments:
             for ln in range(tok.start[0], tok.end[0] + 1):
                 code_lines.add(ln)
 
-    out = FileComments({}, {}, {}, {}, {}, {}, set())
+    out = FileComments({}, {}, {}, {}, {}, {}, set(), {}, {}, set())
     n_lines = source.count("\n") + 1
     for line, _col, text in comments:
         m = _DISABLE_RE.search(text)
@@ -152,4 +172,12 @@ def scan_comments(source: str) -> FileComments:
                 a.strip() for a in m.group(1).split(","))
         if _HOSTLOOP_RE.search(text):
             out.host_loop_root.add(line)
+        m = _RES_ACQ_RE.search(text)
+        if m:
+            out.resource_acquire[line] = m.group(1)
+        m = _RES_REL_RE.search(text)
+        if m:
+            out.resource_release[line] = m.group(1)
+        if _DURABLE_RE.search(text):
+            out.durable_write.add(line)
     return out

@@ -24,9 +24,10 @@ VC203  a ``guarded-by``/``requires-lock`` annotation naming a lock the
        typo here silently un-guards the field.
 
 VC201–VC203 are intra-function and syntactic.  Two rules are
-*interprocedural* over the module-local call graph (the same closure
-scope every other family uses — nested defs, bare-name calls to module
-functions, ``self.<method>`` calls):
+*interprocedural* over the package-wide call graph (the same
+resolution every other family uses — analysis/callgraph.py: nested
+defs, bare-name and from-imported calls, module-attribute chains,
+``self.<method>`` through inheritance and subclass overrides):
 
 VC204  a lock-order cycle: lock B acquired (directly or through a
        called function) while A is held on one path, and A while B is
@@ -45,8 +46,10 @@ VC205  a blocking call while holding an *annotated* lock (one named in
        stay unannotated, so the rule binds exactly the locks whose
        contract is "short critical sections".
 
-Lock aliasing and cross-object lock flow remain out of scope —
-suppressions document the places that matters.
+Lock identity canonicalizes to the attribute's defining class (an
+``ArtifactRunner`` method holding ``self._page_lock`` shares the
+``DecodeEngine`` node); lock flow through stored object references
+remains out of scope — suppressions document the places that matters.
 """
 
 from __future__ import annotations
@@ -320,215 +323,71 @@ class _MethodWalk:
 
 # -- VC204/VC205: the interprocedural lock graph ----------------------------
 
-#: modules whose any call blocks (network / subprocess IO).
-_BLOCKING_MODULES = ("urllib", "requests", "socket", "subprocess",
-                     "http")
-
-#: method names that block when called with no timeout argument.
-_TIMEOUT_METHODS = ("join", "wait", "get")
+def _short(lock: str) -> str:
+    """Canonical lock id (``rel:Class:attr``) -> display name."""
+    return lock.rsplit(":", 1)[-1]
 
 
-def _is_blocking_call(pf: ParsedFile, node: ast.Call) -> Optional[str]:
-    """A short description when the call blocks, else None."""
-    chain = dotted_name(node.func)
-    resolved = pf.resolve_chain(chain) if chain else None
-    if resolved is not None:
-        head = resolved.split(".")[0]
-        if resolved == "time.sleep":
-            return "time.sleep"
-        if head in _BLOCKING_MODULES and "." in resolved:
-            return f"`{chain}` (network/process IO)"
-        if resolved == "jax.device_get":
-            return "jax.device_get (device sync)"
-    if isinstance(node.func, ast.Name) and node.func.id == "open":
-        return "open() (file IO)"
-    if isinstance(node.func, ast.Attribute):
-        attr = node.func.attr
-        if attr == "block_until_ready":
-            return ".block_until_ready() (device sync)"
-        if attr in _TIMEOUT_METHODS and not node.args:
-            # blocking unless a bounding timeout is visibly passed:
-            # .wait() / .get(block=True) / .wait(timeout=None) all
-            # block forever; a positional arg is a timeout (or a dict
-            # key, which disqualifies .get anyway)
-            t = next((k.value for k in node.keywords
-                      if k.arg == "timeout"), None)
-            if t is None or (isinstance(t, ast.Constant)
-                             and t.value is None):
-                return f".{attr}() with no timeout"
-    return None
-
-
-class _FnLockFacts:
-    """Per-function direct facts for the lock graph."""
-
-    def __init__(self):
-        #: lock keys acquired anywhere in the body -> first line
-        self.acquires: Dict[str, int] = {}
-        #: (held lock, acquired lock) -> line of the inner acquisition
-        self.edges: Dict[Tuple[str, str], int] = {}
-        #: blocking calls -> (line, description) while ANY lock held is
-        #: recorded with the lock; direct blocking sites regardless of
-        #: locks feed the transitive summary
-        self.blocking: List[Tuple[int, str]] = []
-        #: (held lock, line, description) for direct under-lock blocks
-        self.blocked_under: List[Tuple[str, int, str]] = []
-        #: (held locks frozenset, callee qualname, line) call sites
-        self.calls: List[Tuple[frozenset, str, int]] = []
-
-
-def _collect_lock_facts(pf: ParsedFile, q: str) -> _FnLockFacts:
-    info = pf.functions[q]
-    facts = _FnLockFacts()
-    mod_fns = set(pf.module_functions())
-    entry_held: Set[str] = set()
-    req = pf.comments.requires_lock.get(info.node.lineno)
-    if req:
-        entry_held.add(_lock_key(req))
-
-    def walk(stmts, held: Set[str]):
-        for stmt in stmts:
-            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
-                                 ast.ClassDef)):
-                continue        # nested defs: separate functions
-            if isinstance(stmt, ast.With):
-                inner = set(held)
-                for item in stmt.items:
-                    text = dotted_name(item.context_expr)
-                    if text:
-                        key = _lock_key(text)
-                        facts.acquires.setdefault(key, stmt.lineno)
-                        for h in inner:
-                            if h != key:
-                                facts.edges.setdefault((h, key),
-                                                       stmt.lineno)
-                        inner.add(key)
-                    else:
-                        scan_expr(item.context_expr, held)
-                walk(stmt.body, inner)
-                continue
-            for child in ast.iter_child_nodes(stmt):
-                if isinstance(child, ast.expr):
-                    scan_expr(child, held)
-                elif isinstance(child, ast.stmt):
-                    walk([child], held)
-                elif isinstance(child, ast.ExceptHandler):
-                    # retry/cleanup paths are where sleeps and
-                    # fallback locking live — they must not be blind
-                    walk(child.body, held)
-
-    def scan_expr(node: ast.AST, held: Set[str]):
-        for sub in ast.walk(node):
-            if not isinstance(sub, ast.Call):
-                continue
-            why = _is_blocking_call(pf, sub)
-            if why is not None:
-                facts.blocking.append((sub.lineno, why))
-                for h in held:
-                    facts.blocked_under.append((h, sub.lineno, why))
-            target = None
-            if isinstance(sub.func, ast.Name) and sub.func.id in mod_fns:
-                target = sub.func.id
-            elif isinstance(sub.func, ast.Attribute) and info.cls \
-                    and isinstance(sub.func.value, ast.Name) \
-                    and sub.func.value.id == "self":
-                cand = f"{info.cls}.{sub.func.attr}"
-                if cand in pf.functions:
-                    target = cand
-            if target is not None:
-                facts.calls.append((frozenset(held), target, sub.lineno))
-
-    walk(info.node.body, set(entry_held))
-    return facts
-
-
-def check_lock_graph(pf: ParsedFile) -> List[Finding]:
+def check_lock_graph_package(graph, files: List[ParsedFile]
+                             ) -> List[Finding]:
     """VC204 (lock-order cycles) + VC205 (blocking under an annotated
-    lock), interprocedural over the module-local call graph."""
-    # cheap bail: no lock definitions and no lock annotations means
-    # neither rule can fire — skip the per-function walks entirely
-    if "Lock(" not in pf.source and "Semaphore(" not in pf.source \
-            and not pf.comments.guarded_by \
-            and not pf.comments.requires_lock:
-        return []
-    facts = {q: _collect_lock_facts(pf, q) for q in pf.functions}
-
-    # transitive summaries (fixpoint over the call graph)
-    trans_acq: Dict[str, Set[str]] = {
-        q: set(f.acquires) for q, f in facts.items()}
-    trans_blk: Dict[str, Optional[Tuple[int, str]]] = {
-        q: (f.blocking[0] if f.blocking else None)
-        for q, f in facts.items()}
-    changed = True
-    while changed:
-        changed = False
-        for q, f in facts.items():
-            for _held, callee, _line in f.calls:
-                if callee not in facts:
-                    continue
-                extra = trans_acq[callee] - trans_acq[q]
-                if extra:
-                    trans_acq[q] |= extra
-                    changed = True
-                if trans_blk[q] is None \
-                        and trans_blk[callee] is not None:
-                    trans_blk[q] = trans_blk[callee]
-                    changed = True
-
-    annotated_locks = {
-        _lock_key(lock) for lock in
-        list(pf.comments.guarded_by.values())
-        + list(pf.comments.requires_lock.values())}
-
+    lock), interprocedural over the **package-wide** call graph: a lock
+    held in ``runtime/engine.py`` across a call into ``deploy.py`` that
+    blocks (or acquires the locks in the reverse order) is in scope —
+    the module-local closure that shipped with PR 10 could not see
+    either.  Lock identity canonicalizes through class inheritance
+    (:meth:`~.callgraph.PackageGraph.canonical_lock`), so
+    ``ArtifactRunner`` methods touching ``DecodeEngine`` locks share the
+    graph node while unrelated same-named locks never merge.  Findings
+    are only emitted into files under analysis; summaries of unparsed
+    files still contribute edges and blocking facts."""
+    (trans_acq, trans_blk, edges, annotated, facts,
+     calls) = graph.lock_analysis()
+    parsed = {pf.relpath: pf for pf in files}
     out: List[Finding] = []
-    # edges: direct nesting + call-through acquisition
-    edges: Dict[Tuple[str, str], Tuple[int, str]] = {}
-    for q, f in facts.items():
-        for (a, b), line in f.edges.items():
-            edges.setdefault((a, b), (line, q))
-        for held, callee, line in f.calls:
-            if callee not in facts:
-                continue
-            for b in trans_acq[callee]:
-                for a in held:
-                    if a != b:
-                        edges.setdefault((a, b), (line, q))
-        # VC205: direct blocks recorded in _collect_lock_facts; calls
-        # into transitively-blocking functions surface at the call site
-        for held, callee, line in f.calls:
-            blk = trans_blk.get(callee)
+
+    for (rel, q) in sorted(facts):
+        pf = parsed.get(rel)
+        if pf is None:
+            continue
+        f = facts[(rel, q)]
+        seen_lines: Set[int] = set()
+        under = [(graph.canonical_lock(rel, key), line, why)
+                 for key, line, why in f["under"]]
+        for held, raw, line, tgts in calls[(rel, q)]:
+            blk = next((trans_blk[t] for t in tgts
+                        if trans_blk.get(t) is not None), None)
             if blk is None:
                 continue
-            for a in held:
-                f.blocked_under.append(
-                    (a, line, f"{blk[1]} via `{callee}()`"))
-
-    for q, f in sorted(facts.items()):
-        seen_lines = set()
-        for lock, line, why in f.blocked_under:
-            if lock not in annotated_locks or line in seen_lines:
+            where = "" if blk[2] == rel \
+                else f" in {blk[2]}"
+            for lock in held:
+                under.append(
+                    (lock, line, f"{blk[1]} via `{raw}()`{where}"))
+        for lock, line, why in under:
+            if lock not in annotated or line in seen_lines:
                 continue
             seen_lines.add(line)
             out.append(Finding(
-                rule="VC205", path=pf.relpath, line=line, col=0,
+                rule="VC205", path=rel, line=line, col=0,
                 message=f"blocking call ({why}) while holding "
-                        f"`{lock}` — every thread touching that "
-                        "lock's state stalls behind it",
+                        f"`{_short(lock)}` — every thread touching "
+                        "that lock's state stalls behind it",
                 hint="move the blocking work outside the critical "
                      "section (snapshot under the lock, block outside)",
                 symbol=q, snippet=pf.line_text(line)))
 
-    # VC204: cycle detection over the edge set (DFS; report each cycle
-    # once, at its lexicographically-first edge site)
-    graph: Dict[str, Set[str]] = {}
+    # VC204: cycle detection over the canonical edge set (DFS; report
+    # each cycle once, at its first edge site inside an analyzed file)
+    adj: Dict[str, Set[str]] = {}
     for (a, b) in edges:
-        graph.setdefault(a, set()).add(b)
+        adj.setdefault(a, set()).add(b)
     reported: Set[frozenset] = set()
-    for start in sorted(graph):
+    for start in sorted(adj):
         stack = [(start, [start])]
         while stack:
             cur, path = stack.pop()
-            for nxt in sorted(graph.get(cur, ())):
+            for nxt in sorted(adj.get(cur, ())):
                 if nxt == start:
                     cyc = frozenset(path)
                     if cyc in reported:
@@ -537,21 +396,26 @@ def check_lock_graph(pf: ParsedFile) -> List[Finding]:
                     sites = sorted(
                         edges[(x, y)] + (x, y)
                         for x, y in zip(path, path[1:] + [start]))
-                    line, q, a, b = sites[0]
-                    order = " -> ".join(path + [start])
+                    sites = [s for s in sites if s[1] in parsed]
+                    if not sites:
+                        continue    # cycle fully outside this scan
+                    line, rel, q, a, b = sites[0]
+                    order = " -> ".join(_short(x)
+                                        for x in path + [start])
                     out.append(Finding(
-                        rule="VC204", path=pf.relpath, line=line, col=0,
-                        message=f"lock-order cycle {order}: `{b}` is "
-                                f"acquired while `{a}` is held here, "
-                                "and the reverse order exists on "
-                                "another path — two threads deadlock",
+                        rule="VC204", path=rel, line=line, col=0,
+                        message=f"lock-order cycle {order}: "
+                                f"`{_short(b)}` is acquired while "
+                                f"`{_short(a)}` is held here, and the "
+                                "reverse order exists on another path "
+                                "— two threads deadlock",
                         hint="pick ONE acquisition order (document it "
                              "on the lock definitions) or merge the "
                              "locks",
-                        symbol=q, snippet=pf.line_text(line)))
+                        symbol=q, snippet=parsed[rel].line_text(line)))
                 elif nxt not in path:
                     stack.append((nxt, path + [nxt]))
-    out.sort(key=lambda fi: (fi.line, fi.rule))
+    out.sort(key=lambda fi: (fi.path, fi.line, fi.rule))
     return out
 
 
@@ -602,5 +466,4 @@ def check(pf: ParsedFile) -> List[Finding]:
         cg = classes.get(info.cls) if info.cls else None
         _MethodWalk(pf, q, info.node, cg, module_guards,
                     module_names, module_requires, out).run()
-    out.extend(check_lock_graph(pf))
     return out

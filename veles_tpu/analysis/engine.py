@@ -1,8 +1,23 @@
-"""Analysis driver: file discovery -> rules -> suppressions -> baseline.
+"""Analysis driver: file discovery -> summaries/graph -> rules ->
+suppressions -> baseline.
 
 Everything here is pure stdlib and never imports the modules it
 analyzes; ``run_analysis`` is the programmatic entry the CLI and the
-tier-1 self-check test (tests/test_analysis.py) share.
+tier-1 self-check test (tests/test_analysis.py) share.  Since the
+whole-package resolution layer landed, every run builds (or loads from
+the summary cache) a :class:`~.callgraph.PackageGraph` first: the
+closure-based families (VT1xx, VC204/VC205, VS5xx, VP6xx, VR7xx)
+consume package-wide scope/lock/lifecycle facts from it, while the
+per-file syntactic rules still walk each analyzed file's AST.
+
+Caching (``.veles-lint-cache.json``, gitignored):
+
+* **summaries** key on each file's content hash — an edit invalidates
+  exactly that file's summary, nothing else;
+* a **findings memo** keys on the digest of every (path, hash) pair
+  plus the docs and analyzer digests — a warm unchanged re-run skips
+  parsing entirely, and ``--changed`` parses only the changed files
+  while the closure reads everyone else's summary from the cache.
 """
 
 from __future__ import annotations
@@ -11,11 +26,15 @@ import os
 from typing import Dict, List, Optional, Tuple
 
 from . import (concurrency_rules, config_rules, metrics_rules,
-               recompile_rules, sharding_rules, trace_rules)
+               recompile_rules, resource_rules, sharding_rules,
+               trace_rules)
 from .baseline import (entry_file_exists, find_baseline, load_baseline,
                        split_baselined)
+from .callgraph import (CACHE_NAME, PackageGraph, SummaryCache,
+                        content_hash, docs_digest, summarize)
 from .findings import Finding, sort_key
 from .pysrc import ParsedFile, parse_file
+import hashlib
 
 _SKIP_DIRS = {"__pycache__", ".git", ".venv", "node_modules"}
 
@@ -66,37 +85,52 @@ def iter_python_files(paths) -> List[Tuple[str, str]]:
     return out
 
 
-def analyze_files(file_list: List[Tuple[str, str]], *,
-                  trace_roots: Optional[Dict[str, Dict[str, str]]] = None,
-                  docs_dir: Optional[str] = None,
-                  package_scan: Optional[bool] = None) -> List[Finding]:
-    """Run every rule over the files; returns findings AFTER inline
-    suppressions (``# lint: disable=``) but BEFORE the baseline.
+def _parse_all(file_list: List[Tuple[str, str]],
+               blobs: Optional[Dict[str, bytes]] = None):
+    """(parsed files, VA003 findings for the ones that do not).
 
-    ``package_scan`` gates the whole-inventory rules (VK302/VK303 dead/
-    undocumented config keys, VM402 ghost metrics): they can only prove
-    "nowhere" against a full package, so a subset scan (``--changed``,
-    a single file) must not fire them.  ``None`` keeps each rule's own
-    legacy inference; :func:`run_analysis` passes the real answer —
-    whether any analyzed PATH argument was a package directory."""
+    With ``blobs`` (the cached path), files parse from the bytes the
+    caller already read and hashed — the summary cache must key each
+    summary by the hash of the EXACT content it was built from, so a
+    second read racing an editor save could poison it."""
     parsed: List[ParsedFile] = []
     findings: List[Finding] = []
-    by_path: Dict[str, ParsedFile] = {}
     for full, rel in file_list:
         try:
-            pf = parse_file(full, rel)
+            if blobs is not None:
+                if rel not in blobs:
+                    continue            # unreadable at hash time
+                pf = ParsedFile(full, rel, blobs[rel].decode("utf-8"))
+            else:
+                pf = parse_file(full, rel)
         except (SyntaxError, UnicodeDecodeError) as e:
             findings.append(Finding(
                 rule="VA003", path=rel.replace(os.sep, "/"),
                 line=getattr(e, "lineno", 1) or 1, col=0,
-                message=f"file does not parse: {e.msg if hasattr(e, 'msg') else e}",
+                message=f"file does not parse: "
+                        f"{e.msg if hasattr(e, 'msg') else e}",
                 hint="the analyzer needs valid Python"))
             continue
         parsed.append(pf)
-        by_path[pf.relpath] = pf
+    return parsed, findings
+
+
+def _run_rules(parsed: List[ParsedFile], va_findings: List[Finding],
+               graph: PackageGraph, *,
+               trace_roots: Optional[dict],
+               docs_dir: Optional[str],
+               package_scan: Optional[bool]) -> List[Finding]:
+    """All rules over already-parsed files + a ready graph; returns
+    findings AFTER inline suppressions, BEFORE the baseline."""
+    findings = list(va_findings)
+    by_path: Dict[str, ParsedFile] = {pf.relpath: pf for pf in parsed}
+
+    tscope: Dict[str, Dict[str, bool]] = {}
+    for (rel, q), tainted in graph.traced_scope(trace_roots).items():
+        tscope.setdefault(rel, {})[q] = tainted
 
     for pf in parsed:
-        findings.extend(trace_rules.check(pf, trace_roots))
+        findings.extend(trace_rules.check(pf, tscope.get(pf.relpath, {})))
         findings.extend(concurrency_rules.check(pf))
         for sup in pf.comments.suppressions.values():
             if not sup.reason:
@@ -108,12 +142,16 @@ def analyze_files(file_list: List[Tuple[str, str]], *,
                             "(`# lint: disable=RULE why`)",
                     hint="say why the finding is acceptable",
                     snippet=pf.line_text(sup.comment_line)))
+    findings.extend(
+        concurrency_rules.check_lock_graph_package(graph, parsed))
     findings.extend(config_rules.check(parsed, docs_dir,
                                        package_scan=package_scan))
     findings.extend(metrics_rules.check(parsed, docs_dir,
                                         package_scan=package_scan))
-    findings.extend(sharding_rules.check(parsed))
-    findings.extend(recompile_rules.check(parsed))
+    findings.extend(sharding_rules.check(parsed, graph))
+    findings.extend(recompile_rules.check(parsed, graph))
+    findings.extend(resource_rules.check(parsed, graph,
+                                         package_scan=package_scan))
 
     kept: List[Finding] = []
     for f in findings:
@@ -124,6 +162,31 @@ def analyze_files(file_list: List[Tuple[str, str]], *,
         kept.append(f)
     kept.sort(key=sort_key)
     return kept
+
+
+def analyze_files(file_list: List[Tuple[str, str]], *,
+                  trace_roots: Optional[Dict[str, Dict[str, str]]] = None,
+                  docs_dir: Optional[str] = None,
+                  package_scan: Optional[bool] = None,
+                  cross_module: bool = True) -> List[Finding]:
+    """Run every rule over the files; returns findings AFTER inline
+    suppressions (``# lint: disable=``) but BEFORE the baseline.
+
+    ``package_scan`` gates the whole-inventory rules (VK302/VK303 dead/
+    undocumented config keys, VM402 ghost metrics, VR702 never-joined
+    threads): they can only prove "nowhere" against a full package, so
+    a subset scan (``--changed``, a single file) must not fire them.
+    ``cross_module=False`` restricts every closure to the legacy
+    module-local reach (the pre-graph analyzer — the mode the
+    blind-spot regression tests pin).  The graph here covers exactly
+    ``file_list``; :func:`run_analysis` is the entry that widens it
+    with cached summaries for ``--changed`` scans."""
+    parsed, va_findings = _parse_all(file_list)
+    graph = PackageGraph({pf.relpath: summarize(pf) for pf in parsed},
+                         cross_module=cross_module)
+    return _run_rules(parsed, va_findings, graph,
+                      trace_roots=trace_roots, docs_dir=docs_dir,
+                      package_scan=package_scan)
 
 
 def _auto_docs_dir(paths) -> Optional[str]:
@@ -142,13 +205,37 @@ def _auto_docs_dir(paths) -> Optional[str]:
     return None
 
 
+def _auto_cache_path(paths, baseline_path: Optional[str]) -> Optional[str]:
+    """The summary cache sits next to the baseline (repo root); with no
+    baseline, next to the first analyzed package's anchor."""
+    if baseline_path:
+        return os.path.join(os.path.dirname(os.path.abspath(
+            baseline_path)), CACHE_NAME)
+    for path in paths:
+        d = os.path.abspath(path)
+        if os.path.isfile(d):
+            d = os.path.dirname(d)
+        if os.path.isdir(d):
+            return os.path.join(_package_anchor(d), CACHE_NAME)
+    return None
+
+
 def run_analysis(paths, *, baseline_path: Optional[str] = "auto",
                  docs_dir: Optional[str] = "auto",
-                 trace_roots: Optional[dict] = None) -> dict:
+                 trace_roots: Optional[dict] = None,
+                 cache_path: Optional[str] = "auto",
+                 scope_paths: Optional[list] = None,
+                 cross_module: bool = True) -> dict:
     """Full pipeline; returns::
 
         {"findings": [new Finding...], "accepted": [baselined...],
          "all": [...], "files": N, "baseline_path": path_or_None}
+
+    ``scope_paths`` (the ``--changed`` shape) widens the *graph* beyond
+    the analyzed ``paths``: every Python file under it contributes a
+    summary (from the cache when its content hash matches, else a
+    fresh parse) so cross-module closures stay package-accurate while
+    rules run — and findings are emitted — only for ``paths``.
     """
     file_list = iter_python_files(paths)
     if docs_dir == "auto":
@@ -164,9 +251,88 @@ def run_analysis(paths, *, baseline_path: Optional[str] = "auto",
         os.path.isdir(p)
         and os.path.isfile(os.path.join(p, "__init__.py"))
         for p in paths)
-    all_findings = analyze_files(file_list, trace_roots=trace_roots,
-                                 docs_dir=docs_dir,
-                                 package_scan=package_scan)
+
+    cache = None
+    if cache_path == "auto":
+        cache_path = _auto_cache_path(
+            list(scope_paths or ()) + list(paths), baseline_path)
+    if cache_path and trace_roots is None and cross_module:
+        cache = SummaryCache(cache_path)
+
+    scope_list = list(file_list)
+    if scope_paths:
+        seen = {full for full, _rel in scope_list}
+        for full, rel in iter_python_files(scope_paths):
+            if full not in seen:
+                seen.add(full)
+                scope_list.append((full, rel))
+
+    all_findings: Optional[List[Finding]] = None
+    if cache is not None or (scope_paths and cross_module
+                             and trace_roots is None):
+        # hash everything in graph scope; the analyzed subset + flags
+        # key the findings memo
+        hashes: Dict[str, str] = {}
+        blobs: Dict[str, bytes] = {}
+        for full, rel in scope_list:
+            try:
+                with open(full, "rb") as f:
+                    data = f.read()
+            except OSError:
+                continue
+            hashes[rel] = content_hash(data)
+            blobs[rel] = data
+        memo = None
+        context = None
+        if cache is not None:
+            ddig = docs_digest(docs_dir)
+            h = hashlib.sha256(ddig.encode())
+            h.update(repr(sorted(rel
+                                 for _f, rel in file_list)).encode())
+            h.update(repr(bool(package_scan)).encode())
+            context = cache.context_digest(hashes, h.hexdigest()[:16])
+            memo = cache.memo(context)
+        if memo is not None:
+            all_findings = [_revive(d) for d in memo]
+        else:
+            analyzed = {rel for _full, rel in file_list}
+            parsed, va_findings = _parse_all(file_list, blobs)
+            summaries = {pf.relpath: summarize(pf) for pf in parsed}
+            if cache is not None:
+                for pf in parsed:
+                    cache.put_summary(pf.relpath,
+                                      hashes.get(pf.relpath, ""),
+                                      summaries[pf.relpath])
+            for full, rel in scope_list:
+                if rel in analyzed or rel not in hashes:
+                    continue
+                summary = cache.summary(rel, hashes[rel]) \
+                    if cache is not None else None
+                if summary is None:
+                    try:
+                        pf = ParsedFile(full, rel,
+                                        blobs[rel].decode("utf-8"))
+                        summary = summarize(pf)
+                    except (SyntaxError, UnicodeDecodeError,
+                            ValueError):
+                        continue    # out-of-scan broken file: no edges
+                    if cache is not None:
+                        cache.put_summary(rel, hashes[rel], summary)
+                summaries.setdefault(rel, summary)
+            graph = PackageGraph(summaries, cross_module=True)
+            all_findings = _run_rules(
+                parsed, va_findings, graph, trace_roots=None,
+                docs_dir=docs_dir, package_scan=package_scan)
+            if cache is not None:
+                cache.put_memo(context,
+                               [f.to_dict() for f in all_findings])
+        if cache is not None:
+            cache.save()
+    if all_findings is None:
+        all_findings = analyze_files(
+            file_list, trace_roots=trace_roots, docs_dir=docs_dir,
+            package_scan=package_scan, cross_module=cross_module)
+
     baseline = load_baseline(baseline_path)
     new, accepted = split_baselined(all_findings, baseline)
     new.extend(_stale_baseline_findings(baseline, baseline_path,
@@ -175,6 +341,13 @@ def run_analysis(paths, *, baseline_path: Optional[str] = "auto",
     return {"findings": new, "accepted": accepted, "all": all_findings,
             "files": len(file_list), "baseline_path": baseline_path,
             "docs_dir": docs_dir}
+
+
+def _revive(d: dict) -> Finding:
+    return Finding(rule=d["rule"], path=d["path"], line=d["line"],
+                   col=d["col"], message=d["message"],
+                   hint=d.get("hint", ""), symbol=d.get("symbol", ""),
+                   snippet=d.get("snippet", ""))
 
 
 def _stale_baseline_findings(baseline, baseline_path, file_list,

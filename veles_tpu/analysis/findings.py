@@ -37,12 +37,16 @@ SEVERITIES = {
     "VP603": "error",     # builder on a hot path outside StepCache
     "VC204": "error",     # lock-order cycle (deadlock)
     "VC205": "error",     # blocking call under an annotated lock
+    "VR701": "error",     # resource acquired, not released on an exit path
+    "VR702": "error",     # non-daemon thread with no join on any shutdown path
+    "VR703": "warning",   # file/socket handle outside with/try-finally
+    "VR704": "error",     # durable write skipping tmp-fsync-rename
 }
 
 #: rule families for the CLI's per-family counts (--json): prefix ->
 #: catalogue family id.  Stable key set — CI dashboards chart these.
 FAMILIES = ("VA0xx", "VT1xx", "VC2xx", "VK3xx", "VM4xx", "VS5xx",
-            "VP6xx")
+            "VP6xx", "VR7xx")
 
 
 def family(rule: str) -> str:

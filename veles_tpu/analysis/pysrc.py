@@ -1,7 +1,10 @@
 """Parsed-file model shared by every rule: one ``ast.parse`` + one
 ``tokenize`` pass per file, an import-alias map for resolving dotted
 call chains, and a function index with stable qualnames
-(``Class.method``, ``outer.inner``)."""
+(``Class.method``, ``outer.inner``).  Reachability closures live in
+:mod:`~.callgraph`, which resolves references across module boundaries
+(and, with ``cross_module=False``, reproduces the legacy module-local
+reach)."""
 
 from __future__ import annotations
 
@@ -89,46 +92,6 @@ class ParsedFile:
 
     def module_functions(self) -> Dict[str, FnInfo]:
         return {q: i for q, i in self.functions.items() if "." not in q}
-
-
-def call_targets(pf: ParsedFile, info: FnInfo):
-    """Module-local qualnames the function's body references: bare
-    ``Name`` uses of module-level functions (calls, and references
-    passed as callbacks) and ``self.<method>`` of the same class.  The
-    shared closure machinery of the trace (VT1xx), sharding (VS5xx),
-    recompile (VP6xx) and lock-graph (VC204/205) rules — all of them
-    deliberately module-local, never whole-program."""
-    mod_fns = pf.module_functions()
-    out = set()
-    for node in ast.walk(info.node):
-        if isinstance(node, ast.Name) and node.id in mod_fns:
-            out.add(node.id)
-        elif isinstance(node, ast.Attribute) and info.cls \
-                and isinstance(node.value, ast.Name) \
-                and node.value.id == "self":
-            cand = f"{info.cls}.{node.attr}"
-            if cand in pf.functions:
-                out.add(cand)
-    return out
-
-
-def local_closure(pf: ParsedFile, roots) -> set:
-    """Roots + nested ``def``s + transitively-called module-local
-    functions (see :func:`call_targets`), restricted to qualnames that
-    exist in the file."""
-    seen = {q for q in roots if q in pf.functions}
-    work = list(seen)
-    while work:
-        q = work.pop()
-        for q2 in pf.functions:
-            if q2.startswith(q + ".") and q2 not in seen:
-                seen.add(q2)
-                work.append(q2)
-        for q2 in call_targets(pf, pf.functions[q]):
-            if q2 not in seen:
-                seen.add(q2)
-                work.append(q2)
-    return seen
 
 
 def parse_file(path: str, relpath: str) -> ParsedFile:

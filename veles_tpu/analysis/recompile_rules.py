@@ -25,7 +25,7 @@ VP602  dict/set iteration constructing pytree structure inside a
        rule covers the caller-supplied-mapping case VT104 cannot see.)
 VP603  a builder reachable from a host hot loop (the engine scheduler
        tick, a REST request handler — ``HOST_LOOP_ROOTS``, closed
-       module-locally) that is not routed through ``StepCache
+       package-wide) that is not routed through ``StepCache
        .get_step`` or a registry-declared self-caching builder
        (``SELF_CACHING_BUILDERS``): a lazy recompile smuggled past
        the counters every test asserts flat — error.
@@ -33,8 +33,11 @@ VP603  a builder reachable from a host hot loop (the engine scheduler
 Builder names come from the registry (``TRACE_ROOTS`` entries in
 ``BUILDER`` mode) plus per-file ``# trace-root: builder`` markers;
 call sites match on the final name (``self.plan.init_caches`` matches
-the ``DecodePlan.init_caches`` root) — module-local resolution, the
-same deliberate scope limit as every other family here.
+the ``DecodePlan.init_caches`` root), while the host-loop reach and
+the program-composition exemption both close over the package call
+graph (analysis/callgraph.py) — a builder invoked from a REST handler
+through a helper module, or from an ``ArtifactRunner`` override of an
+engine hook, is still caught.
 """
 
 from __future__ import annotations
@@ -43,25 +46,26 @@ import ast
 from typing import Dict, List, Optional, Set
 
 from .findings import Finding
-from .pysrc import FnInfo, ParsedFile, dotted_name, local_closure
-from .registry import (BUILDER, HOST_LOOP_ROOTS, SELF_CACHING_BUILDERS,
-                       TRACE_ROOTS)
+from .pysrc import FnInfo, ParsedFile, dotted_name
+from .registry import (BUILDER, SELF_CACHING_BUILDERS, TRACE_ROOTS)
 
 #: modules whose call results vary per call (VP601 taint sources).
 _VARYING_MODULES = ("time", "uuid", "random", "secrets", "datetime")
 
 
-def builder_names(files: List[ParsedFile]) -> Set[str]:
+def builder_names(graph) -> Set[str]:
     """Final names of every registered BUILDER root (global registry +
-    inline ``# trace-root: builder`` markers in the scanned files)."""
+    inline ``# trace-root: builder`` markers anywhere in the package —
+    summaries included, so a cached unparsed module's builders still
+    bind call sites in the files under analysis)."""
     names: Set[str] = set()
     for entry in TRACE_ROOTS.values():
         for q, mode in entry.items():
             if mode == BUILDER:
                 names.add(q.split(".")[-1])
-    for pf in files:
-        for q, info in pf.functions.items():
-            if pf.comments.trace_root.get(info.node.lineno) == "builder":
+    for s in graph.summaries.values():
+        for q, mode in s["markers"]["trace"].items():
+            if mode == BUILDER:
                 names.add(q.split(".")[-1])
     return names
 
@@ -74,25 +78,6 @@ def _call_final_name(node: ast.Call) -> Optional[str]:
     return None
 
 
-def _program_scope(pf: ParsedFile) -> Set[str]:
-    """Functions inside the traced-program closure (all trace roots,
-    both modes, nested defs and called helpers included).  Builder
-    calls HERE are build-time composition inside one program build —
-    ``make_prefill_fn`` delegating to ``_make_paged_prefill_fn``, a
-    plan's ``init_caches`` assembling per-unit sub-caches — mediated by
-    whatever cache routed the outer builder; VP601/VP603 enforce at
-    the host-code boundary, not inside it.  Memoized per parse (one
-    closure walk per file, shared by VP601 and VP603)."""
-    cached = getattr(pf, "_vp_program_scope", None)
-    if cached is not None:
-        return cached
-    from .trace_rules import _roots_for
-    roots = _roots_for(pf, None)
-    scope = local_closure(pf, roots) if roots else set()
-    pf._vp_program_scope = scope
-    return scope
-
-
 def _is_test_file(pf: ParsedFile) -> bool:
     """The compile discipline binds the PRODUCT: tests loop builders
     over geometries on purpose (parameterized compile coverage), so
@@ -103,14 +88,24 @@ def _is_test_file(pf: ParsedFile) -> bool:
         or parts[-1] == "conftest.py"
 
 
-def check(files: List[ParsedFile]) -> List[Finding]:
+def check(files: List[ParsedFile], graph) -> List[Finding]:
+    """``graph`` is the :class:`~.callgraph.PackageGraph`: the
+    program-composition exemption (builder calls inside one program
+    build) and the VP603 host-loop reach both close over it, so a
+    builder invoked from a REST handler through a helper module is
+    still caught, and a builder composed by another module's builder is
+    still exempt."""
     files = [pf for pf in files if not _is_test_file(pf)]
-    builders = builder_names(files)
+    builders = builder_names(graph)
+    program = graph.program_scope()
+    host = graph.host_scope() - program
     out: List[Finding] = []
     for pf in files:
-        _vp601_file(pf, builders, out)
+        pscope = {q for (rel, q) in program if rel == pf.relpath}
+        hscope = {q for (rel, q) in host if rel == pf.relpath}
+        _vp601_file(pf, builders, out, pscope)
         _vp602_file(pf, out)
-        _vp603_file(pf, builders, out)
+        _vp603_file(pf, builders, out, hscope)
     return out
 
 
@@ -260,13 +255,12 @@ class _VaryTaint:
 
 
 def _vp601_file(pf: ParsedFile, builders: Set[str],
-                out: List[Finding]):
+                out: List[Finding], program_scope: Set[str]):
     if not builders or not any(b in pf.source for b in builders):
         return
-    scope = _program_scope(pf)
     for q, info in pf.functions.items():
-        if q in scope:
-            continue    # build-time composition: see _program_scope
+        if q in program_scope:
+            continue    # build-time composition inside one program
         _VaryTaint(pf, info, builders, out).run()
 
 
@@ -358,25 +352,10 @@ def _line_in_own_body(pf: ParsedFile, info: FnInfo, line: int) -> bool:
 
 # -- VP603: builders reachable from host loops, outside StepCache ------------
 
-def _host_roots_for(pf: ParsedFile) -> Set[str]:
-    roots: Set[str] = set()
-    best = ""
-    for key, entry in HOST_LOOP_ROOTS.items():
-        if (pf.relpath == key or pf.relpath.endswith("/" + key)) \
-                and len(key) > len(best):
-            best, roots = key, set(entry)
-    for q, info in pf.functions.items():
-        if info.node.lineno in pf.comments.host_loop_root:
-            roots.add(q)
-    return {q for q in roots if q in pf.functions}
-
-
 def _vp603_file(pf: ParsedFile, builders: Set[str],
-                out: List[Finding]):
-    roots = _host_roots_for(pf)
-    if not roots or not builders:
+                out: List[Finding], scope: Set[str]):
+    if not scope or not builders:
         return
-    scope = local_closure(pf, roots) - _program_scope(pf)
     # parent chain for the routed-through-StepCache check
     parents: Dict[int, ast.AST] = {}
     for parent in ast.walk(pf.tree):
@@ -394,6 +373,8 @@ def _vp603_file(pf: ParsedFile, builders: Set[str],
         return False
 
     for q in sorted(scope):
+        if q not in pf.functions:
+            continue
         info = pf.functions[q]
         for node in ast.walk(info.node):
             if not isinstance(node, ast.Call):

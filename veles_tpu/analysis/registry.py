@@ -2,10 +2,11 @@
 
 The trace-safety rules (VT1xx) only fire inside *traced scope* —
 functions whose bodies become XLA programs.  That set is declared here,
-per module, and closed module-locally by the analyzer: nested ``def``s
-inside a root and module-level functions a root calls are traced too
-(their parameters carry tracers), without any cross-module whole-program
-analysis.
+per module, and closed by the analyzer over the **whole-package call
+graph** (analysis/callgraph.py): nested ``def``s inside a root and
+functions a root calls — across module boundaries, through
+``from x import y``, module-attribute chains and ``self.m()``
+inheritance/override dispatch — are traced too.
 
 Two root modes:
 
@@ -24,10 +25,10 @@ Two root modes:
     static sampling/config knobs by convention.
 
 Extending for a new program kind: add its builder/step qualnames to
-the module entry below — nothing else; the call-graph closure picks up
-everything they call (the speculative-decode ``make_verify_fn`` below
-landed exactly this way).  docs/analysis.md walks through the
-workflow.
+the module entry below — nothing else; the package-wide call-graph
+closure picks up everything they call (the speculative-decode
+``make_verify_fn`` below landed exactly this way).  docs/analysis.md
+walks through the workflow.
 """
 
 from __future__ import annotations
@@ -85,7 +86,7 @@ TRACE_ROOTS = {
 #: module path -> {qualname: (axis, axis, ...)}: functions whose bodies
 #: run under ``shard_map`` (or a schedule's manual-axes scope) with the
 #: listed mesh axes bound.  The VS5xx rules (sharding_rules.py) close
-#: these module-locally exactly like TRACE_ROOTS: raw collectives
+#: these over the package graph exactly like TRACE_ROOTS: raw collectives
 #: (``psum``/``ppermute``/``all_to_all``/…) are legal only inside this
 #: closure (VS502), and literal axis names used inside it must be in
 #: the root's axis environment (VS501).  One-off modules mark roots
@@ -131,7 +132,8 @@ COLLECTIVE_OPS = {
 }
 
 #: module path -> qualnames of host hot loops (scheduler ticks, REST
-#: request handlers): traced-program *builders* reachable from these
+#: request handlers): traced-program *builders* reachable from these —
+#: across modules and subclass overrides (ArtifactRunner hooks) —
 #: must route through StepCache (recompile_rules.py, VP603) — a lazy
 #: builder call here re-traces per request and smuggles the compile
 #: past the flat-counter contract.  Fixture syntax:
@@ -155,6 +157,42 @@ SELF_CACHING_BUILDERS = frozenset({"generate", "generate_beam"})
 #: .faults`` an empty node in production so its presence check stays one
 #: falsy read (runtime/faults.py).  VK301 skips keys under these.
 DYNAMIC_CONFIG_PREFIXES = ("faults",)
+
+#: resource lifecycles the VR701 pairing rule checks over the package
+#: call graph.  Per resource: the functions that *acquire* it (take
+#: pages out of ``_page_free`` / bump ``_page_ref``), the functions
+#: that *release* it, and the **exit roots** — every failure/retire
+#: path that must provably reach a release (retire, mid-flight
+#: deadline, fail-all on scheduler crash).  Qualnames per module path,
+#: the TRACE_ROOTS convention; fixtures use ``# resource-acquire:`` /
+#: ``# resource-release:`` def-line comments instead.
+#: tests/test_analysis.py guards that the declared sets stay honest
+#: (every qualname resolves and actually touches the pool fields).
+RESOURCE_PAIRS = {
+    "kv-pages": {
+        "acquire": {"runtime/engine.py": (
+            "DecodeEngine._reserve_pages",
+            "DecodeEngine._alloc_page_locked")},
+        "release": {"runtime/engine.py": (
+            "DecodeEngine._release_slot_pages",
+            "DecodeEngine._invalidate_prefix_cache")},
+        "exit_roots": {"runtime/engine.py": (
+            "DecodeEngine._retire",
+            "DecodeEngine._post_step",      # mid-flight deadline sweep
+            "DecodeEngine._fail_all")},     # scheduler crash / stop
+    },
+}
+
+#: modules whose file writes are durability-critical (sealed artifacts,
+#: snapshots): VR704 requires the established tmp-fsync-rename idiom —
+#: a plain ``open(path, "w")`` here can leave a half-written artifact
+#: that a reader trusts.  Fixture syntax: ``# durable-write:`` on the
+#: ``def`` line marks one function outside these modules.
+DURABLE_WRITE_MODULES = (
+    "export/compiled.py",
+    "export/package.py",
+    "runtime/snapshotter.py",
+)
 
 #: modules whose calls inside traced scope are host effects (VT103).
 HOST_EFFECT_MODULES = (

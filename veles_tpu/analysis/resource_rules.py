@@ -1,0 +1,543 @@
+"""VR7xx — resource-lifecycle rules over the package call graph.
+
+The runtime's correctness depends on resources whose acquire and
+release live in *different* functions — often different modules: KV
+pages refcounted by the engine scheduler and released on four distinct
+exit paths, threads spawned in six modules that must not outlive
+shutdown, file/socket handles, and durability-critical writes that must
+stage through tmp-fsync-rename.  The whole-package resolution layer
+(:mod:`~.callgraph`) makes those lifecycles checkable:
+
+VR701  **acquire/release pairing** for declared resources (the
+       registry's ``RESOURCE_PAIRS``; fixtures mark functions with
+       ``# resource-acquire: NAME`` / ``# resource-release: NAME``):
+
+       * every declared *exit root* (retire, mid-flight deadline,
+         fail-all/crash) must transitively reach a release of the
+         resource — a refactor that stops ``_fail_all`` from dropping
+         page refs fires here, at the exit root's ``def`` line;
+       * after a call to an acquire function, a ``raise`` before the
+         acquired state is released or transferred (stored into an
+         attribute/subscript, or the function returns it) leaks the
+         resource on that error path — unless the raise is covered by
+         a ``try`` whose handler/finally reaches a release (directly
+         or through the call graph).  Error — a leaked page is pool
+         capacity gone until restart.
+
+VR702  **thread lifecycle**: every ``threading.Thread(...)`` started in
+       the package must be ``daemon=True`` (or ``.daemon = True``
+       before start) or provably ``.join()``-ed somewhere in the
+       package (shutdown path).  A non-daemon, never-joined thread
+       blocks interpreter exit forever.  Needs whole-package proof, so
+       subset scans (``--changed``) skip it, like VM402.
+
+VR703  **unclosed handles**: an ``open()``/``socket.socket()`` result
+       neither managed by ``with`` nor closed in a ``try/finally``
+       (a bare trailing ``.close()`` leaks on any exception between),
+       nor transferred out (returned / stored on an object).  Warning.
+
+VR704  **non-atomic durable writes**: in the declared export/snapshot
+       modules (``DURABLE_WRITE_MODULES``; fixture marker
+       ``# durable-write:`` on a def line), a file write must follow
+       the established tmp-fsync-rename idiom — stage to a tmp name
+       and/or ``os.replace``/``os.rename`` into place.  A plain
+       ``open(path, "w")`` can leave a half-written artifact that a
+       reader trusts.  Error.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .findings import Finding
+from .pysrc import ParsedFile, dotted_name
+from .registry import DURABLE_WRITE_MODULES, RESOURCE_PAIRS
+
+#: handle constructors VR703 tracks (resolved through import aliases).
+_HANDLE_CALLS = {
+    "open", "io.open", "gzip.open", "tokenize.open", "socket.socket",
+    "socket.create_connection",
+}
+
+
+
+def _is_test_file(pf: ParsedFile) -> bool:
+    parts = pf.relpath.split("/")
+    return "tests" in parts[:-1] or parts[-1].startswith("test_") \
+        or parts[-1] == "conftest.py"
+
+
+def _final_name(node: ast.Call) -> Optional[str]:
+    if isinstance(node.func, ast.Name):
+        return node.func.id
+    if isinstance(node.func, ast.Attribute):
+        return node.func.attr
+    return None
+
+
+def check(files: List[ParsedFile], graph, *,
+          package_scan: Optional[bool] = None) -> List[Finding]:
+    files = [pf for pf in files if not _is_test_file(pf)]
+    out: List[Finding] = []
+    _vr701(files, graph, out)
+    if package_scan is not False:
+        _vr702(files, graph, out)
+    for pf in files:
+        _vr703_file(pf, out)
+        _vr704_file(pf, out)
+    return out
+
+
+# -- VR701: declared resource acquire/release pairing ------------------------
+
+def _resource_sets(graph):
+    """Per resource: acquire / release / exit-root (rel, qual) sets,
+    from the registry plus the fixture comment markers."""
+    res: Dict[str, Dict[str, Set[Tuple[str, str]]]] = {}
+
+    def bucket(name):
+        return res.setdefault(name, {"acquire": set(), "release": set(),
+                                     "exit_roots": set()})
+
+    for name, decl in RESOURCE_PAIRS.items():
+        b = bucket(name)
+        for kind in ("acquire", "release", "exit_roots"):
+            for mod, quals in decl.get(kind, {}).items():
+                for rel, s in graph.summaries.items():
+                    if rel == mod or rel.endswith("/" + mod):
+                        for q in quals:
+                            if q in s["defs"]:
+                                b[kind].add((rel, q))
+    for rel, s in graph.summaries.items():
+        for q, name in s["markers"]["acquire"].items():
+            bucket(name)["acquire"].add((rel, q))
+        for q, name in s["markers"]["release"].items():
+            bucket(name)["release"].add((rel, q))
+    return res
+
+
+def _release_reaching(graph, releases: Set[Tuple[str, str]]
+                      ) -> Set[Tuple[str, str]]:
+    """Functions that (transitively) call a release function —
+    computed by one reverse fixpoint over resolved references.  A call
+    whose receiver is not statically resolvable (``pool.free(h)``)
+    seeds by its final name, the VP603 matching convention."""
+    rel_names = {q.split(".")[-1] for _rel, q in releases}
+    callers: Dict[Tuple[str, str], Set[Tuple[str, str]]] = {}
+    seeds: Set[Tuple[str, str]] = set(releases)
+    for rel, s in graph.summaries.items():
+        for q in s["defs"]:
+            cls = s["cls_of"].get(q) or None
+            for raw, _line in s["refs"].get(q, ()):
+                for tgt in graph.resolve(rel, cls, raw):
+                    callers.setdefault(tgt, set()).add((rel, q))
+            if rel_names.intersection(s.get("fincalls", {}).get(q, ())):
+                seeds.add((rel, q))
+            # a nested def executes inside its parent: the parent
+            # reaches whatever the child reaches
+            if "." in q:
+                parent = q.rsplit(".", 1)[0]
+                if parent in s["defs"]:
+                    callers.setdefault((rel, q), set()).add((rel, parent))
+    reach = set(seeds)
+    work = list(seeds)
+    while work:
+        tgt = work.pop()
+        for caller in callers.get(tgt, ()):
+            if caller not in reach:
+                reach.add(caller)
+                work.append(caller)
+    return reach
+
+
+def _vr701(files: List[ParsedFile], graph, out: List[Finding]):
+    resources = _resource_sets(graph)
+    if not resources:
+        return
+    parsed = {pf.relpath: pf for pf in files}
+    for name, sets in sorted(resources.items()):
+        if not sets["acquire"] or not sets["release"]:
+            continue
+        reaching = _release_reaching(graph, sets["release"])
+        # (1) every declared exit root must reach a release
+        for rel, q in sorted(sets["exit_roots"]):
+            pf = parsed.get(rel)
+            if pf is None or q not in pf.functions:
+                continue
+            if (rel, q) not in reaching:
+                line = pf.functions[q].node.lineno
+                out.append(Finding(
+                    rule="VR701", path=rel, line=line, col=0,
+                    message=f"exit path `{q}` is declared a `{name}` "
+                            "release point (registry RESOURCE_PAIRS) "
+                            "but no longer reaches any release "
+                            "function — the resource leaks on this "
+                            "path",
+                    hint="release the resource on this path, or update "
+                         "the registry if the lifecycle moved",
+                    symbol=q, snippet=pf.line_text(line)))
+        # (2) leak-on-raise after an acquire call
+        acq_names = {q.split(".")[-1] for _rel, q in sets["acquire"]}
+        rel_names = {q.split(".")[-1] for _rel, q in sets["release"]}
+        lifecycle = sets["acquire"] | sets["release"]
+        for pf in files:
+            for q, info in pf.functions.items():
+                if (pf.relpath, q) in lifecycle:
+                    continue    # the lifecycle owners balance inline
+                _LeakWalk(pf, q, info, name, acq_names, rel_names,
+                          graph, reaching, out).run()
+
+
+class _LeakWalk:
+    """Statement-order walk: after an acquire call, a ``raise`` not
+    covered by a release (direct call, handler/finally that reaches
+    one, or an ownership transfer of the bound name) is a leak.
+    Join-free and best-effort, like every other pass here."""
+
+    def __init__(self, pf, q, info, resource, acq_names, rel_names,
+                 graph, reaching, out):
+        self.pf = pf
+        self.q = q
+        self.info = info
+        self.resource = resource
+        self.acq_names = acq_names
+        self.rel_names = rel_names
+        self.graph = graph
+        self.reaching = reaching
+        self.out = out
+        self.pending: Optional[int] = None      # acquire line
+        self.bound: Optional[str] = None
+        self.covered_depth = 0
+        self.emitted = False
+
+    def _call_releases(self, node: ast.Call) -> bool:
+        name = _final_name(node)
+        if name in self.rel_names:
+            return True
+        chain = dotted_name(node.func)
+        if chain is None:
+            return False
+        cls = self.info.cls
+        return any(t in self.reaching for t in
+                   self.graph.resolve(self.pf.relpath, cls, chain))
+
+    def _scan_calls(self, node: ast.AST):
+        """Updates pending/bound state from the expressions of one
+        statement."""
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            if self._call_releases(sub):
+                self.pending = None
+                self.bound = None
+            elif _final_name(sub) in self.acq_names:
+                self.pending = sub.lineno
+
+    def run(self):
+        if not any(n in self.pf.source for n in self.acq_names):
+            return
+        self._stmts(self.info.node.body)
+
+    def _stmts(self, body):
+        for stmt in body:
+            self._stmt(stmt)
+
+    def _stmt(self, stmt: ast.stmt):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return
+        if isinstance(stmt, ast.Try):
+            covers = any(
+                isinstance(sub, ast.Call) and self._call_releases(sub)
+                for blk in ([h.body for h in stmt.handlers]
+                            + [stmt.finalbody])
+                for s in blk for sub in ast.walk(s))
+            self.covered_depth += 1 if covers else 0
+            self._stmts(stmt.body)
+            self._stmts(stmt.orelse)
+            self.covered_depth -= 1 if covers else 0
+            for h in stmt.handlers:
+                self._stmts(h.body)
+            self._stmts(stmt.finalbody)
+            return
+        if isinstance(stmt, ast.Assign):
+            self._scan_calls(stmt.value)
+            was_acquire_value = any(
+                isinstance(sub, ast.Call)
+                and _final_name(sub) in self.acq_names
+                for sub in ast.walk(stmt.value))
+            if was_acquire_value and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name):
+                self.bound = stmt.targets[0].id
+            elif self.bound is not None:
+                # ownership transfer: the bound handle (or a value
+                # derived from it) stored into an attribute/subscript
+                uses_bound = any(
+                    isinstance(sub, ast.Name) and sub.id == self.bound
+                    for sub in ast.walk(stmt.value))
+                if uses_bound and any(
+                        isinstance(t, (ast.Attribute, ast.Subscript))
+                        for t in stmt.targets):
+                    self.pending = None
+                    self.bound = None
+            return
+        if isinstance(stmt, ast.Return):
+            self._scan_calls(stmt)
+            self.pending = None
+            self.bound = None
+            return
+        if isinstance(stmt, ast.Raise):
+            if self.pending is not None and self.covered_depth == 0 \
+                    and not self.emitted:
+                self.emitted = True
+                self.out.append(Finding(
+                    rule="VR701", path=self.pf.relpath,
+                    line=stmt.lineno, col=stmt.col_offset,
+                    message=f"raise after acquiring `{self.resource}` "
+                            f"(line {self.pending}) with no release or "
+                            "ownership transfer on this path — the "
+                            "resource leaks on this error exit",
+                    hint="release in a try/finally (or an except path "
+                         "that reaches the release), or transfer "
+                         "ownership before raising",
+                    symbol=self.q,
+                    snippet=self.pf.line_text(stmt.lineno)))
+            return
+        if isinstance(stmt, (ast.If, ast.While)):
+            self._scan_calls(stmt.test)
+            self._stmts(stmt.body)
+            self._stmts(stmt.orelse)
+            return
+        if isinstance(stmt, ast.For):
+            self._scan_calls(stmt.iter)
+            self._stmts(stmt.body)
+            self._stmts(stmt.orelse)
+            return
+        if isinstance(stmt, ast.With):
+            for item in stmt.items:
+                self._scan_calls(item.context_expr)
+            self._stmts(stmt.body)
+            return
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self._scan_calls(child)
+            elif isinstance(child, ast.stmt):
+                self._stmt(child)
+
+
+# -- VR702: thread lifecycle -------------------------------------------------
+
+def _vr702(files: List[ParsedFile], graph, out: List[Finding]):
+    joined: Set[str] = set()
+    daemoned: Set[str] = set()
+    for s in graph.summaries.values():
+        joined.update(s["joins"])
+        daemoned.update(s["daemon_sets"])
+    for pf in files:
+        s = graph.summaries.get(pf.relpath)
+        if s is None:
+            continue
+        for t in s["threads"]:
+            if t["daemon"] is True:
+                continue
+            target = t.get("target")
+            ok = target is not None and (target in joined
+                                         or target in daemoned)
+            if ok:
+                continue
+            what = "anonymous" if target is None else f"`{target}`"
+            out.append(Finding(
+                rule="VR702", path=pf.relpath, line=t["line"], col=0,
+                message=f"non-daemon thread ({what}) is never joined "
+                        "anywhere in the package and never marked "
+                        "daemon — it outlives shutdown and blocks "
+                        "interpreter exit",
+                hint="pass daemon=True, or join it on a shutdown path "
+                     "(stop()/close()/drain())",
+                symbol=t.get("symbol", ""),
+                snippet=pf.line_text(t["line"])))
+
+
+# -- VR703: unclosed file/socket handles -------------------------------------
+
+def _handle_call(pf: ParsedFile, node: ast.Call) -> bool:
+    chain = dotted_name(node.func)
+    if chain is None:
+        return False
+    resolved = pf.resolve_chain(chain)
+    return resolved in _HANDLE_CALLS or chain in _HANDLE_CALLS
+
+
+def _vr703_file(pf: ParsedFile, out: List[Finding]):
+    if "open(" not in pf.source and "socket" not in pf.source:
+        return
+    parents: Dict[int, ast.AST] = {}
+    for parent in ast.walk(pf.tree):
+        for child in ast.iter_child_nodes(parent):
+            parents[id(child)] = parent
+
+    def enclosing_fn(node: ast.AST):
+        best, span = None, None
+        line = node.lineno
+        for q, info in pf.functions.items():
+            end = getattr(info.node, "end_lineno", info.node.lineno)
+            if info.node.lineno <= line <= end:
+                s = end - info.node.lineno
+                if span is None or s < span:
+                    best, span = (q, info), s
+        return best
+
+    def local_discharged(name: str, info) -> bool:
+        """The bound handle is closed in a finally/except, returned,
+        re-managed by ``with``/``closing``, or stored on an object."""
+        for node in ast.walk(info.node):
+            if isinstance(node, ast.Try):
+                for blk in [h.body for h in node.handlers] \
+                        + [node.finalbody]:
+                    for s in blk:
+                        for sub in ast.walk(s):
+                            if isinstance(sub, ast.Call) \
+                                    and isinstance(sub.func,
+                                                   ast.Attribute) \
+                                    and sub.func.attr == "close":
+                                base = dotted_name(sub.func.value)
+                                if base == name:
+                                    return True
+            if isinstance(node, ast.Return) and node.value is not None:
+                for sub in ast.walk(node.value):
+                    if isinstance(sub, ast.Name) and sub.id == name:
+                        return True
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    for sub in ast.walk(item.context_expr):
+                        if isinstance(sub, ast.Name) and sub.id == name:
+                            return True
+            if isinstance(node, ast.Call) and _final_name(node) \
+                    in ("closing", "ExitStack", "enter_context"):
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Name) and sub.id == name:
+                        return True
+            if isinstance(node, ast.Assign):
+                if any(isinstance(t, (ast.Attribute, ast.Subscript))
+                       for t in node.targets) \
+                        and any(isinstance(sub, ast.Name)
+                                and sub.id == name
+                                for sub in ast.walk(node.value)):
+                    return True
+        return False
+
+    for node in ast.walk(pf.tree):
+        if not isinstance(node, ast.Call) or not _handle_call(pf, node):
+            continue
+        parent = parents.get(id(node))
+        # `with open(...)` (possibly through an `as` binding)
+        if isinstance(parent, ast.withitem):
+            continue
+        enc = enclosing_fn(node)
+        symbol = enc[0] if enc else ""
+        if isinstance(parent, ast.Assign) and len(parent.targets) == 1:
+            t = parent.targets[0]
+            if isinstance(t, (ast.Attribute, ast.Subscript)):
+                continue        # object-lifetime handle
+            if isinstance(t, ast.Name) and enc is not None \
+                    and local_discharged(t.id, enc[1]):
+                continue
+        elif isinstance(parent, ast.Return):
+            continue            # ownership transferred to the caller
+        elif isinstance(parent, ast.Call) and _final_name(parent) \
+                in ("closing", "ExitStack", "enter_context"):
+            continue
+        out.append(Finding(
+            rule="VR703", path=pf.relpath, line=node.lineno,
+            col=node.col_offset,
+            message="file/socket handle is neither managed by `with` "
+                    "nor closed in a try/finally — it leaks on any "
+                    "exception before the close",
+            hint="use `with` (or contextlib.closing), or close in a "
+                 "finally block",
+            symbol=symbol, snippet=pf.line_text(node.lineno)))
+
+
+# -- VR704: non-atomic writes on durability-critical paths -------------------
+
+def _durable_functions(pf: ParsedFile):
+    durable_module = any(
+        pf.relpath == m or pf.relpath.endswith("/" + m)
+        for m in DURABLE_WRITE_MODULES)
+    for q, info in pf.functions.items():
+        if durable_module \
+                or info.node.lineno in pf.comments.durable_write:
+            yield q, info
+
+
+def _tmpish(node: ast.AST) -> bool:
+    """The path expression visibly stages a temp name (`.tmp` literal,
+    a ``tmp``-named variable, ``NamedTemporaryFile`` output, …)."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, str) \
+                and "tmp" in sub.value.lower():
+            return True
+        if isinstance(sub, ast.Name) and "tmp" in sub.id.lower():
+            return True
+        if isinstance(sub, ast.Attribute) and "tmp" in sub.attr.lower():
+            return True
+    return False
+
+
+def _vr704_file(pf: ParsedFile, out: List[Finding]):
+    for q, info in _durable_functions(pf):
+        has_rename = any(
+            isinstance(sub, ast.Call)
+            and _final_name(sub) in ("replace", "rename")
+            for sub in ast.walk(info.node))
+        # in-memory buffers (BytesIO staging before an atomic commit)
+        # are not durable targets
+        buffers: Set[str] = set()
+        for sub in ast.walk(info.node):
+            if isinstance(sub, ast.Assign) and len(sub.targets) == 1 \
+                    and isinstance(sub.targets[0], ast.Name) \
+                    and isinstance(sub.value, ast.Call) \
+                    and _final_name(sub.value) in ("BytesIO",
+                                                   "StringIO"):
+                buffers.add(sub.targets[0].id)
+        for node in ast.walk(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _final_name(node)
+            mode = None
+            if name in ("open", "ZipFile") and len(node.args) >= 2 \
+                    and isinstance(node.args[1], ast.Constant):
+                mode = node.args[1].value
+            elif name in ("open", "ZipFile"):
+                kw = next((k.value for k in node.keywords
+                           if k.arg == "mode"), None)
+                if isinstance(kw, ast.Constant):
+                    mode = kw.value
+            is_write = isinstance(mode, str) and mode[:1] in ("w", "x")
+            chain = dotted_name(node.func)
+            resolved = pf.resolve_chain(chain) if chain else ""
+            if resolved.split(".")[0] == "numpy" \
+                    and resolved.split(".")[-1] in (
+                        "save", "savez", "savez_compressed") \
+                    and node.args:
+                is_write = True
+            if not is_write:
+                continue
+            path_arg = node.args[0] if node.args else None
+            if isinstance(path_arg, ast.Name) \
+                    and path_arg.id in buffers:
+                continue        # in-memory staging buffer
+            if path_arg is not None and _tmpish(path_arg):
+                continue        # staged write: the idiom's first half
+            if has_rename:
+                continue        # renamed into place in this function
+            out.append(Finding(
+                rule="VR704", path=pf.relpath, line=node.lineno,
+                col=node.col_offset,
+                message="durable write lands directly on its final "
+                        "path — a crash mid-write leaves a torn file "
+                        "a reader will trust",
+                hint="stage to `<path>.tmp`, fsync, then os.replace() "
+                     "into place (the export/snapshot idiom)",
+                symbol=q, snippet=pf.line_text(node.lineno)))

@@ -17,9 +17,10 @@ Three sources of truth are cross-referenced:
   arguments to ``Mesh(...)`` constructors) and from ``config.py``
   (keys of the ``root.common.mesh`` default dict);
 * **shard-map scope** — the registry's ``SHARD_MAP_ROOTS`` (plus
-  inline ``# shard-map-root: axis[,axis]`` markers), closed
-  module-locally exactly like the trace roots: nested ``def``s and
-  called module-local helpers join the scope;
+  inline ``# shard-map-root: axis[,axis]`` markers), closed over the
+  package call graph exactly like the trace roots: nested ``def``s and
+  called helpers — in any module — join the scope, each with the axis
+  environment of the roots that actually reach it;
 * **use sites** — ``jax.lax`` collective calls (``COLLECTIVE_OPS``)
   and ``PartitionSpec``/``P`` constructions.
 
@@ -48,8 +49,8 @@ import re
 from typing import Dict, List, Optional, Set, Tuple
 
 from .findings import Finding
-from .pysrc import ParsedFile, dotted_name, local_closure
-from .registry import COLLECTIVE_OPS, SHARD_MAP_ROOTS
+from .pysrc import ParsedFile, dotted_name
+from .registry import COLLECTIVE_OPS
 
 #: cheap textual pre-filter: a file mentioning none of these cannot
 #: produce a VS5xx finding, so the AST passes skip it entirely.
@@ -121,23 +122,6 @@ def _dict_keys(node: ast.AST) -> Set[str]:
     return out
 
 
-def _shard_roots_for(pf: ParsedFile) -> Dict[str, Tuple[str, ...]]:
-    """SHARD_MAP_ROOTS entry for this file (longest path-suffix key
-    wins, the trace_rules convention) merged with inline
-    ``# shard-map-root:`` markers."""
-    roots: Dict[str, Tuple[str, ...]] = {}
-    best = ""
-    for key, entry in SHARD_MAP_ROOTS.items():
-        if (pf.relpath == key or pf.relpath.endswith("/" + key)) \
-                and len(key) > len(best):
-            best, roots = key, dict(entry)
-    for q, info in pf.functions.items():
-        env = pf.comments.shard_map_root.get(info.node.lineno)
-        if env is not None:
-            roots[q] = env
-    return roots
-
-
 def _collective_axis_literals(pf: ParsedFile,
                               node: ast.Call) -> Tuple[str, Set[str]]:
     """(op name, literal axis strings) for a jax.lax collective call;
@@ -164,23 +148,26 @@ def _collective_axis_literals(pf: ParsedFile,
     return leaf, axes
 
 
-def check(files: List[ParsedFile]) -> List[Finding]:
+def check(files: List[ParsedFile], graph) -> List[Finding]:
+    """``graph`` is the :class:`~.callgraph.PackageGraph`; shard-map
+    scope closes over it, so a collective in a helper module called
+    from a registered schedule body is in scope (and checked against
+    that root's axis environment) without its own registry entry."""
     declared = collect_declared_axes(files)
+    shard_env = graph.shard_scope()
     out: List[Finding] = []
     for pf in files:
         if _MAYBE_RE.search(pf.source):
-            out.extend(_check_file(pf, declared))
+            scope_env = {q: axes for (rel, q), axes in shard_env.items()
+                         if rel == pf.relpath}
+            out.extend(_check_file(pf, declared, scope_env))
     return out
 
 
-def _check_file(pf: ParsedFile, declared: Set[str]) -> List[Finding]:
+def _check_file(pf: ParsedFile, declared: Set[str],
+                scope_env: Dict[str, Tuple[str, ...]]) -> List[Finding]:
     out: List[Finding] = []
-    roots = _shard_roots_for(pf)
-    scope = local_closure(pf, roots) if roots else set()
-    # axis environment per in-scope function: union of the declaring
-    # roots' envs (module-local closure keeps this coarse on purpose)
-    env: Tuple[str, ...] = tuple(sorted(
-        {a for axes in roots.values() for a in axes}))
+    scope = set(scope_env)
 
     # function spans for symbol attribution
     def symbol_at(line: int) -> str:
@@ -194,14 +181,25 @@ def _check_file(pf: ParsedFile, declared: Set[str]) -> List[Finding]:
                     best, best_span = q, span
         return best
 
-    in_scope_lines: List[Tuple[int, int]] = []
+    in_scope_lines: List[Tuple[int, int, str]] = []
     for q in scope:
+        if q not in pf.functions:
+            continue
         node = pf.functions[q].node
         in_scope_lines.append(
-            (node.lineno, getattr(node, "end_lineno", node.lineno)))
+            (node.lineno, getattr(node, "end_lineno", node.lineno), q))
 
     def in_scope(line: int) -> bool:
-        return any(lo <= line <= hi for lo, hi in in_scope_lines)
+        return any(lo <= line <= hi for lo, hi, _q in in_scope_lines)
+
+    def env_at(line: int) -> Tuple[str, ...]:
+        """Axis environment of the innermost enclosing in-scope
+        function (per-root envs, not a file-wide union)."""
+        best, span = (), None
+        for lo, hi, q in in_scope_lines:
+            if lo <= line <= hi and (span is None or hi - lo < span):
+                best, span = scope_env.get(q, ()), hi - lo
+        return best
 
     for node in ast.walk(pf.tree):
         if not isinstance(node, ast.Call):
@@ -234,8 +232,8 @@ def _check_file(pf: ParsedFile, declared: Set[str]) -> List[Finding]:
                              "MeshSpec in parallel/mesh.py",
                         symbol=symbol_at(node.lineno),
                         snippet=pf.line_text(node.lineno)))
-                elif env and in_scope(node.lineno) and axis not in env \
-                        and axis in declared:
+                elif (env := env_at(node.lineno)) and axis in declared \
+                        and axis not in env:
                     out.append(Finding(
                         rule="VS501", path=pf.relpath, line=node.lineno,
                         col=node.col_offset,

@@ -1,7 +1,10 @@
 """VT1xx — trace-safety rules.
 
 Inside *traced scope* (functions reachable from the registry's trace
-roots, closed module-locally over nested ``def``s and local calls), the
+roots, closed over nested ``def``s and — since the whole-package
+resolution layer in :mod:`~.callgraph` — calls across module
+boundaries: a builder in ``runtime/engine.py`` pulling a step helper
+from ``runtime/generate.py`` taints it too), the
 analyzer runs a light forward taint pass: values produced by
 ``jax.*``/``jnp.*``/``lax.*`` calls are tracers; arithmetic, comparison,
 subscripting, method calls and calls fed tainted arguments stay
@@ -38,77 +41,27 @@ from typing import Dict, List, Optional, Set
 
 from .findings import Finding
 from .pysrc import FnInfo, ParsedFile, dotted_name
-from .registry import (BUILDER, HOST_EFFECT_BUILTINS, HOST_EFFECT_MODULES,
-                       TRACE_ROOTS, TRACED)
+from .registry import HOST_EFFECT_BUILTINS, HOST_EFFECT_MODULES
 
 #: builtins whose result is static host data even on tracer args
 #: (len/shape-like structure queries), so they break taint.
+#: ``set``/``frozenset`` qualify because tracers are unhashable — a
+#: set can only ever hold static values (``set(state_dict)`` is the
+#: static-keys idiom; ``set(traced_array)`` crashes at trace time).
 _STATIC_BUILTINS = {
     "isinstance", "issubclass", "len", "getattr", "hasattr", "type",
-    "repr", "str", "callable", "id", "format",
+    "repr", "str", "callable", "id", "format", "set", "frozenset",
 }
 
 _COERCIONS = {"float", "int", "bool"}
 _NP_COERCIONS = {"numpy.asarray", "numpy.array", "numpy.ascontiguousarray"}
 
-
-def _roots_for(pf: ParsedFile,
-               overrides: Optional[Dict[str, Dict[str, str]]]) -> dict:
-    """Registry roots for this file (longest registry key that is a
-    path suffix wins) merged with ``# trace-root:`` def-line comments."""
-    table = overrides if overrides is not None else TRACE_ROOTS
-    roots: Dict[str, str] = {}
-    best = ""
-    for key, entry in table.items():
-        if (pf.relpath == key or pf.relpath.endswith("/" + key)) \
-                and len(key) > len(best):
-            best, roots = key, dict(entry)
-    for q, info in pf.functions.items():
-        mode = pf.comments.trace_root.get(info.node.lineno)
-        if mode:
-            roots[q] = TRACED if mode == "traced" else BUILDER
-    return roots
-
-
-def _traced_closure(pf: ParsedFile, roots: dict) -> Dict[str, bool]:
-    """qualname -> params_tainted for every function in traced scope.
-
-    Declared roots keep their declared mode.  Nested ``def``s inside a
-    traced function are the literal jit/scan bodies, so their
-    parameters ARE tracers (minus defaulted params — the ``_u=u``
-    closure-binding idiom is static).  Module-local functions a traced
-    body merely *calls* join the scope with UNTAINTED parameters: they
-    are mostly host helpers fed static plan/shape data, and anything
-    tracer-valued they produce internally (jnp/jax calls) still taints.
-    """
-    modes: Dict[str, bool] = {}
-    for q, mode in roots.items():
-        if q in pf.functions:
-            modes[q] = mode == TRACED
-    mod_fns = pf.module_functions()
-    work = list(modes)
-    while work:
-        q = work.pop()
-        info = pf.functions[q]
-        for q2 in pf.functions:
-            if q2.startswith(q + ".") and "." not in q2[len(q) + 1:]:
-                if q2 not in modes:       # nested def: traced, tainted
-                    modes[q2] = True
-                    work.append(q2)
-        for node in ast.walk(info.node):
-            target = None
-            if isinstance(node, ast.Name) and node.id in mod_fns:
-                target = node.id
-            elif isinstance(node, ast.Attribute) and info.cls \
-                    and isinstance(node.value, ast.Name) \
-                    and node.value.id == "self":
-                cand = f"{info.cls}.{node.attr}"
-                if cand in pf.functions:
-                    target = cand
-            if target is not None and target not in modes:
-                modes[target] = False     # called helper: scope only
-                work.append(target)
-    return modes
+#: jax/jnp callables whose result is static host data even on tracer
+#: arguments — dtype/shape structure predicates, legal in Python
+#: control flow at trace time (``jnp.issubdtype(x.dtype, ...)`` is the
+#: PRNG-key leaf-select idiom in ops/optimizers.py).
+_STATIC_JAX = {"issubdtype", "result_type", "promote_types",
+               "isdtype", "dtype", "eval_shape", "typeof"}
 
 
 class _Taint:
@@ -207,16 +160,23 @@ class _Taint:
             return True                 # closure result: assume traced
         if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp,
                              ast.DictComp)):
-            t = False
+            # the produced collection holds ELEMENT values: iterating a
+            # tainted iterable yields tracer elements (the targets join
+            # the env for the element expressions), but a traced
+            # iterable of static projections (`{a.shape[0] for a in
+            # jax.tree.leaves(p)}`) still yields a static collection —
+            # the element/key/value expressions decide the result taint
+            saved = set(self.env)
             for gen in node.generators:
                 self._check_unordered_iter(gen.iter)
-                t |= self.taint(gen.iter)
+                self._assign_name(gen.target, self.taint(gen.iter))
                 for cond in gen.ifs:
                     self.taint(cond)
             if isinstance(node, ast.DictComp):
-                t |= self.taint(node.key) | self.taint(node.value)
+                t = self.taint(node.key) | self.taint(node.value)
             else:
-                t |= self.taint(node.elt)
+                t = self.taint(node.elt)
+            self.env = saved
             return t
         if isinstance(node, ast.JoinedStr):
             for v in node.values:
@@ -274,6 +234,10 @@ class _Taint:
                 "use jnp.asarray (stays traced) or hoist out of traced "
                 "scope")
             return False
+        if resolved is not None \
+                and resolved.split(".")[0] in ("jax", "jnp") \
+                and resolved.split(".")[-1] in _STATIC_JAX:
+            return False            # static structure predicate
         if isinstance(func, ast.Attribute):
             recv_t = self.taint(func.value)
             if func.attr == "item" and recv_t:
@@ -395,12 +359,14 @@ class _Taint:
 
 
 def check(pf: ParsedFile,
-          trace_roots: Optional[Dict[str, Dict[str, str]]] = None
-          ) -> List[Finding]:
-    roots = _roots_for(pf, trace_roots)
-    if not roots:
-        return []
+          scope: Dict[str, bool]) -> List[Finding]:
+    """Run the taint pass over this file's slice of the package-wide
+    traced scope (``qualname -> params_tainted``, computed by
+    :meth:`~.callgraph.PackageGraph.traced_scope` — declared roots keep
+    their registry mode, nested ``def``s are tainted jit/scan bodies,
+    merely-called helpers join untainted)."""
     out: List[Finding] = []
-    for q, params_tainted in sorted(_traced_closure(pf, roots).items()):
-        _Taint(pf, pf.functions[q], params_tainted, out).run()
+    for q, params_tainted in sorted(scope.items()):
+        if q in pf.functions:
+            _Taint(pf, pf.functions[q], params_tainted, out).run()
     return out

@@ -21,6 +21,7 @@ from typing import Dict, Optional
 import jax
 import numpy as np
 
+from ..runtime.snapshotter import _commit_bytes, _fsync_dir, _fsync_file
 from ..units.workflow import Workflow
 
 #: Exportable unit types and the constructor fields the native runtime
@@ -219,21 +220,72 @@ def export_package(workflow: Workflow, wstate: dict, path: str, *,
     if input_spec is not None:
         contents["input_spec"] = input_spec
 
+    # every blob serialized up front under a CONTENT-ADDRESSED name
+    # (`<unit>_<param>.<sha12>.npy`), then committed crash-safely: the
+    # previous export's blobs are never overwritten, so a crash at ANY
+    # point — staging, blob renames, the manifest — leaves the old
+    # manifest paired with the old bytes it names (every reader,
+    # load_package / deploy / the C++ runtime, resolves blob names
+    # through contents.json).  Manifest lands LAST; stale blobs from
+    # prior exports are swept only after it commits.  The VR704 lint
+    # rule pins the tmp-fsync-rename half of this discipline.
+    import hashlib
+    import os
+    blobs: Dict[str, bytes] = {}
+    renames: Dict[str, str] = {}
+    for fname, arr in arrays.items():
+        buf = io.BytesIO()
+        np.save(buf, np.ascontiguousarray(arr, np.float32))
+        data = buf.getvalue()
+        digest = hashlib.sha256(data).hexdigest()[:12]
+        final = f"{fname[:-len('.npy')]}.{digest}.npy"
+        renames[fname] = final
+        blobs[final] = data
+    for entry in units:
+        entry["weights"] = {k: renames[v]
+                            for k, v in entry["weights"].items()}
+    manifest = json.dumps(contents, indent=1).encode()
+
     if path.endswith(".zip"):
-        with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
-            z.writestr("contents.json", json.dumps(contents, indent=1))
-            for fname, arr in arrays.items():
-                buf = io.BytesIO()
-                np.save(buf, np.ascontiguousarray(arr, np.float32))
-                z.writestr(fname, buf.getvalue())
+        tmp = path + ".tmp"
+        with zipfile.ZipFile(tmp, "w", zipfile.ZIP_DEFLATED) as z:
+            z.writestr("contents.json", manifest.decode())
+            for fname, data in blobs.items():
+                z.writestr(fname, data)
+        _fsync_file(tmp)
+        os.replace(tmp, path)
+        _fsync_dir(os.path.dirname(os.path.abspath(path)))
     else:  # directory package (what the C++ serving runtime consumes)
-        import os
         os.makedirs(path, exist_ok=True)
-        with open(os.path.join(path, "contents.json"), "w") as f:
-            json.dump(contents, f, indent=1)
-        for fname, arr in arrays.items():
-            np.save(os.path.join(path, fname),
-                    np.ascontiguousarray(arr, np.float32))
+        staged = []
+        for fname, data in blobs.items():
+            tmp = os.path.join(path, fname + ".tmp")
+            with open(tmp, "wb") as f:
+                f.write(data)
+                f.flush()
+                os.fsync(f.fileno())
+            staged.append((tmp, os.path.join(path, fname)))
+        for tmp, target in staged:
+            os.replace(tmp, target)
+        # persist the blob renames BEFORE the manifest commit: POSIX
+        # orders nothing between successive renames without a dir
+        # fsync, and a durable new manifest must never name a blob
+        # whose rename was lost to power loss
+        _fsync_dir(path)
+        _commit_bytes(os.path.join(path, "contents.json"), manifest)
+        # post-commit sweep: blobs no manifest names anymore, and tmp
+        # strays from any earlier crashed export — then persist the
+        # rename/unlink metadata so a power loss cannot durably apply
+        # the sweep while losing the commit it depends on
+        keep = set(blobs) | {"contents.json"}
+        for fn in os.listdir(path):
+            if fn not in keep and (fn.endswith(".npy")
+                                   or fn.endswith(".tmp")):
+                try:
+                    os.unlink(os.path.join(path, fn))
+                except OSError:
+                    pass
+        _fsync_dir(path)
     return path
 
 

@@ -158,19 +158,23 @@ class GraphicsClient(Logger):
         os.makedirs(self.out_dir, exist_ok=True)
         sock = subscribe(self.endpoint)
         n = 0
-        while max_payloads is None or n < max_payloads:
-            try:
-                payload = recv_frame(sock)
-            except wire.WireError as e:
-                # Frame boundary is lost after a corrupt frame: drop the
-                # connection, keep the renderer process alive.
-                self.warning("dropping connection on bad frame: %s", e)
-                break
-            if payload is None:
-                break
-            self.handle(payload)
-            n += 1
-        sock.close()
+        try:
+            while max_payloads is None or n < max_payloads:
+                try:
+                    payload = recv_frame(sock)
+                except wire.WireError as e:
+                    # Frame boundary is lost after a corrupt frame: drop
+                    # the connection, keep the renderer process alive.
+                    self.warning("dropping connection on bad frame: %s", e)
+                    break
+                if payload is None:
+                    break
+                self.handle(payload)
+                n += 1
+        finally:
+            # handle() raises SystemExit on a "stop" frame — the socket
+            # must not outlive the loop on that path either
+            sock.close()
         return n
 
     def handle(self, payload: Dict) -> None:

@@ -298,8 +298,6 @@ class PipelinePlan:
         mutated = [k for k in set(st or {}) | set(st_in)
                    if k != "aux_loss"
                    and (st or {}).get(k) is not st_in.get(k)]
-        # lint: disable=VT101 trace-time structural validation — host
-        # list emptiness, raising before any program is emitted
         if mutated:
             from ..units.workflow import WorkflowError
             raise WorkflowError(

@@ -58,6 +58,20 @@ def _fsync_dir(path: str) -> None:
         os.close(fd)
 
 
+def _commit_bytes(target: str, data: bytes) -> None:
+    """Stage ``data`` as an fsynced ``*.tmp`` sibling and rename it
+    into place — a crash leaves either the old file or the new one,
+    never a torn hybrid.  Shared by the snapshot manifest commit and
+    the package exporter (docs/robustness.md: torn-write discipline;
+    the VR704 lint rule pins the idiom)."""
+    tmp = target + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, target)
+
+
 def _flatten(tree, prefix="", out=None):
     out = {} if out is None else out
     if isinstance(tree, dict):
@@ -194,12 +208,9 @@ class Snapshotter(Logger):
         manifest["tensors_sha256"] = sha256_files([npz_path])
         manifest["saved_at"] = time.time()
         man_path = os.path.join(self.directory, base + ".json")
-        man_tmp = man_path + ".tmp"
-        with open(man_tmp, "w") as f:
-            json.dump(manifest, f, indent=1, default=repr)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(man_tmp, man_path)
+        _commit_bytes(man_path,
+                      json.dumps(manifest, indent=1,
+                                 default=repr).encode())
 
         for link, active in (("_current", True), ("_best", best)):
             if not active:
