@@ -52,6 +52,10 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "spec: speculative-decoding / verify-program tests "
         "(tier-1; select alone with -m spec)")
+    config.addinivalue_line(
+        "markers", "overload: overload-survival tests — chunked "
+        "prefill, priority preemption, admission control (tier-1; "
+        "select alone with -m overload)")
 
 
 @pytest.fixture(autouse=True)

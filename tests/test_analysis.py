@@ -1950,6 +1950,51 @@ def test_vr701_exit_root_must_reach_release(tmp_path):
     assert "kv-pages" in f.message
 
 
+def test_vr701_preempt_exit_root_declared(tmp_path):
+    """The preemption requeue path is a declared kv-pages exit root
+    (docs/serving.md "Overload survival"): a file matching the engine
+    module whose ``_preempt`` retires-and-requeues a slot WITHOUT
+    releasing its pages fires at the def line — the victim's pages
+    must provably free (or transfer) before the winner reserves, or
+    every preemption leaks a span."""
+    _write(tmp_path, "runtime/engine.py", """\
+        class DecodeEngine:
+            def _reserve_pages(self, req):
+                return 1
+
+            def _alloc_page_locked(self):
+                return 1
+
+            def _release_slot_pages(self, slot):
+                pass
+
+            def _invalidate_prefix_cache(self):
+                pass
+
+            def _retire(self, slot):
+                self._release_slot_pages(slot)
+
+            def _post_step(self, finished):
+                self._release_slot_pages(0)
+
+            def _fail_all(self, err):
+                self._release_slot_pages(0)
+
+            def _preempt(self, slot):
+                self._queue.appendleft(self._slot_req[slot])
+
+            def _advance_prefills(self):
+                self._release_slot_pages(0)
+        """)
+    found = [f for f in _lint(tmp_path) if f.rule == "VR701"]
+    assert len(found) == 1
+    f = found[0]
+    assert f.symbol == "DecodeEngine._preempt"
+    assert f.line == _line_of(tmp_path, "runtime/engine.py",
+                              "def _preempt")
+    assert "kv-pages" in f.message
+
+
 def test_vr702_unjoined_thread(tmp_path):
     _write(tmp_path, "mod.py", """\
         import threading

@@ -181,3 +181,96 @@ def test_resume_across_topology_change(tmp_path):
         np.asarray(single.wstate["params"]["fc1"]["w"]), rtol=1e-6)
     resharded.run()
     assert resharded.decision.complete
+
+
+@pytest.mark.overload
+def test_admission_controller_sheds_and_recovers_under_flood():
+    """The overload-survival chaos rehearsal (docs/robustness.md
+    "Overload survival"), driven by the serving fault knobs: an
+    ``admission_burst`` queue flood plus one ``decode_stall_ms``
+    tail-latency spike push the REAL queue-wait SLO into burn, the
+    admission controller closes its window and sheds a low-class
+    submit with an adaptive Retry-After, and once the backlog drains
+    the window re-opens and traffic is accepted again — the whole
+    cycle in one process, no restart."""
+    import jax
+    import jax.numpy as jnp
+
+    import veles_tpu as vt
+    from veles_tpu.ops import optimizers as opt
+    from veles_tpu.models.standard import build_workflow
+    from veles_tpu.runtime import faults
+    from veles_tpu.runtime.admission import AdmissionController
+    from veles_tpu.runtime.engine import DecodeEngine, EngineOverloaded
+    from veles_tpu.runtime.slo import SloTracker
+
+    V = 12
+    wf = build_workflow("chaos_ovl_lm", [
+        {"type": "embedding", "vocab": V, "dim": 12, "name": "emb"},
+        {"type": "gru", "hidden": 12, "name": "g1"},
+        {"type": "seq_last", "name": "last"},
+        {"type": "softmax", "output_size": V, "name": "out"}])
+    wf.build({"@input": vt.Spec((2, 6), jnp.int32),
+              "@labels": vt.Spec((2,), jnp.int32),
+              "@mask": vt.Spec((2,), jnp.float32)})
+    ws = wf.init_state(jax.random.key(3), opt.SGD(0.1))
+
+    # a REAL SLO sensor over the process queue-wait histogram: a
+    # 1s window, any wait over 0.05ms burns — the flood trips it
+    # honestly, and the drain un-trips it within one window
+    tracker = SloTracker(window_s=1.0, slices=10,
+                         targets_ms={"queue_wait": 0.05},
+                         burn_threshold=2.0)
+
+    def sense():
+        tracker.tick()          # rotate the ring on the control beat
+        return tracker.max_burn()
+
+    qd = 16
+    ctl = AdmissionController(
+        queue_depth=qd, priorities=2, burn_fn=sense, enabled=True,
+        min_window=1, interval_s=0.02, hold_s=0.25,
+        decrease=0.5, increase=2.0, burn_threshold=2.0)
+    eng = DecodeEngine(wf, ws, slots=1, l_max=32, window_ms=0.0,
+                       queue_depth=qd, priorities=2,
+                       admission=ctl).start()
+    strays = []
+    try:
+        faults.configure(admission_burst=24, decode_stall_ms=100.0)
+        # phase 1 — SHED: the controller must close the window while
+        # the backlog exists, and a low-class submit must 429 with the
+        # congestion-derived hint
+        shed_error = None
+        deadline = time.time() + 90
+        while shed_error is None:
+            assert time.time() < deadline, eng.stats()
+            st = eng.stats()
+            closed = st["admission"]["window"] < qd
+            backlog = st["queue_depth"] >= ctl.allowance(1) + 2
+            if not (closed and backlog):
+                time.sleep(0.005)
+                continue
+            try:
+                strays.append(eng.submit(
+                    np.array([1, 2], np.int32), 1, priority=1))
+            except EngineOverloaded as e:
+                shed_error = e
+        assert shed_error.retry_after_s >= 1.0
+        st = eng.stats()
+        assert st["admission"]["shed_by_class"].get("1", 0) >= 1, st
+        # phase 2 — RECOVER: backlog drains, burn cools, the window
+        # re-opens to full admission without a restart
+        deadline = time.time() + 90
+        while eng.stats()["admission"]["window"] < qd:
+            assert time.time() < deadline, eng.stats()
+            time.sleep(0.01)
+        req = eng.submit(np.array([1, 2], np.int32), 1, priority=1)
+        assert req.done.wait(60) and req.error is None
+        st = eng.stats()
+        assert st["scheduler_crashed"] is False
+        assert st["admission"]["shedding"] is False
+        for r in strays:
+            assert r.done.wait(60)
+    finally:
+        faults.reset()
+        eng.stop()

@@ -192,6 +192,29 @@ def test_queue_overflow_answers_429(rng):
         eng.stop()
 
 
+def test_retry_after_is_adaptive():
+    """The 429's Retry-After derives from actual congestion
+    (docs/serving.md "Overload survival"): floored by the queue-wait
+    EWMA current admissions really pay, scaled by how far the
+    admission controller has closed its window, bounded to [1, 60] —
+    pinned white-box; every submit-path 429 carries this value."""
+    from veles_tpu.runtime.admission import AdmissionController
+    wf, ws = _build_lm(TRANSFORMER)
+    ctl = AdmissionController(queue_depth=8, priorities=1,
+                              burn_fn=lambda: 10.0, interval_s=0.0,
+                              min_window=2, enabled=True)
+    eng = DecodeEngine(wf, ws, slots=1, l_max=32, queue_depth=8,
+                       admission=ctl)
+    assert eng._retry_after() == 1.0        # idle, open window: floor
+    eng._qwait_ewma = 2.0
+    assert eng._retry_after() == 2.0        # the EWMA is the base hint
+    ctl.tick()
+    ctl.tick()                              # window 8 -> 4 -> 2
+    assert eng._retry_after() == 8.0        # x4: window 4x closed
+    eng._qwait_ewma = 30.0
+    assert eng._retry_after() == 60.0       # hard cap
+
+
 def test_queued_deadline_fails_loudly(rng):
     wf, ws = _build_lm(TRANSFORMER)
     eng = DecodeEngine(wf, ws, slots=1, l_max=64, queue_depth=8,

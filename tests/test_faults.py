@@ -12,6 +12,7 @@ compiled programs (the StepCache counter idiom of tests/test_step_cache.py).
 
 import json
 import os
+import time
 import urllib.error
 
 import jax
@@ -449,12 +450,82 @@ def test_scheduler_crash_fails_work_with_500_and_event(tmp_path):
         eng.stop()
 
 
+def _overload_engine(**kw):
+    """A tiny started engine for the serving fault knobs."""
+    from veles_tpu.models.standard import build_workflow
+    from veles_tpu.runtime.engine import DecodeEngine
+    V = 12
+    wf = build_workflow("fault_ovl_lm", [
+        {"type": "embedding", "vocab": V, "dim": 12, "name": "emb"},
+        {"type": "gru", "hidden": 12, "name": "g1"},
+        {"type": "seq_last", "name": "last"},
+        {"type": "softmax", "output_size": V, "name": "out"}])
+    wf.build({"@input": vt.Spec((2, 6), jnp.int32),
+              "@labels": vt.Spec((2,), jnp.int32),
+              "@mask": vt.Spec((2,), jnp.float32)})
+    ws = wf.init_state(jax.random.key(3), opt.SGD(0.1))
+    return DecodeEngine(wf, ws, slots=2, l_max=32, window_ms=0.0,
+                        **kw).start()
+
+
+def test_decode_stall_knob_slows_one_step():
+    """``decode_stall_ms`` injects ONE artificially slow decode step —
+    the request still completes correctly, the stall lands inside the
+    timed window (so SLO burn sees it like a real stall), and the
+    injection is one-shot per arming."""
+    eng = _overload_engine()
+    try:
+        # warm: programs compiled, no stall armed yet
+        req = eng.submit(np.array([1, 2, 3], np.int32), 3)
+        assert req.done.wait(120) and req.error is None
+        faults.configure(decode_stall_ms=200.0)
+        t0 = time.monotonic()
+        req = eng.submit(np.array([1, 2, 3], np.int32), 3)
+        assert req.done.wait(120) and req.error is None
+        stalled = time.monotonic() - t0
+        assert stalled >= 0.2, stalled
+        # one-shot: the next request pays no second stall
+        t0 = time.monotonic()
+        req = eng.submit(np.array([1, 2, 3], np.int32), 3)
+        assert req.done.wait(120) and req.error is None
+        assert time.monotonic() - t0 < stalled
+    finally:
+        faults.reset()
+        eng.stop()
+
+
+def test_admission_burst_knob_floods_own_queue():
+    """``admission_burst`` makes the scheduler inject N synthetic
+    lowest-class requests straight into its own queue (bypassing
+    submit's shed gate); they decode and retire like real traffic —
+    the controller-shed rehearsal's backlog, with nobody waiting on
+    the done events."""
+    eng = _overload_engine(priorities=2)
+    try:
+        base = eng.stats()["retired"]
+        faults.configure(admission_burst=5)
+        deadline = time.monotonic() + 120
+        while eng.stats()["retired"] < base + 5:
+            assert time.monotonic() < deadline, eng.stats()
+            time.sleep(0.01)
+        st = eng.stats()
+        assert st["admitted"] >= 5
+        assert st["scheduler_crashed"] is False
+    finally:
+        faults.reset()
+        eng.stop()
+
+
 # -- harness plumbing ------------------------------------------------------
 
 def test_fault_plan_parsing_and_one_shot():
     plan = faults.configure(nan_grad_at_step=3, slow_batch_ms=1.5)
     assert plan.nan_grad_at_step == (3,)
     assert plan.slow_batch_ms == 1.5
+    assert bool(plan)
+    plan = faults.configure(decode_stall_ms=7.5, admission_burst=4)
+    assert plan.decode_stall_ms == 7.5
+    assert plan.admission_burst == 4
     assert bool(plan)
     assert faults.fire_once("x", 1)
     assert not faults.fire_once("x", 1)

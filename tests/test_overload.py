@@ -1,0 +1,747 @@
+"""Overload survival (docs/serving.md "Overload survival"): chunked
+prefill must emit token streams bitwise-identical to unchunked prefill
+(greedy AND sampled, paged AND dense, attention AND recurrent chains),
+preempt-resume must be bitwise-identical to an uninterrupted run,
+priority classes must queue-jump and displace, the admission
+controller's AIMD hysteresis must be deterministic under a fake clock,
+and the compile counters must stay at the two-program-kind budget
+through all of it — chunks and resumes are plain bucket calls, never a
+third program shape."""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import veles_tpu as vt
+from veles_tpu.models.standard import build_workflow
+from veles_tpu.ops import optimizers as opt
+from veles_tpu.runtime.admission import AdmissionController
+from veles_tpu.runtime.engine import DecodeEngine, EngineOverloaded
+from veles_tpu.runtime.generate import generate
+
+pytestmark = pytest.mark.overload
+
+V = 12
+
+
+def _build_lm(layers, B=2, T=6, seed=3):
+    wf = build_workflow("ovl_lm", layers)
+    wf.build({"@input": vt.Spec((B, T), jnp.int32),
+              "@labels": vt.Spec((B,), jnp.int32),
+              "@mask": vt.Spec((B,), jnp.float32)})
+    ws = wf.init_state(jax.random.key(seed), opt.SGD(0.1))
+    return wf, ws
+
+
+TRANSFORMER = [
+    {"type": "embedding", "vocab": V, "dim": 16, "name": "emb"},
+    {"type": "attention", "n_heads": 2, "rope": True,
+     "residual": True, "name": "a1"},
+    {"type": "layer_norm", "name": "n1"},
+    {"type": "ffn", "d_hidden": 32, "name": "f1"},
+    {"type": "seq_last", "name": "last"},
+    {"type": "softmax", "output_size": V, "name": "out"},
+]
+
+RECURRENT = [
+    {"type": "embedding", "vocab": V, "dim": 12, "name": "emb"},
+    {"type": "gru", "hidden": 12, "name": "g1"},
+    {"type": "lstm", "hidden": 12, "name": "l1"},
+    {"type": "seq_last", "name": "last"},
+    {"type": "softmax", "output_size": V, "name": "out"},
+]
+
+
+def _wait_busy(eng, timeout=60):
+    deadline = time.monotonic() + timeout
+    while True:
+        st = eng.stats()
+        if st["occupancy"] >= 1 and st["queue_depth"] == 0:
+            return
+        assert time.monotonic() < deadline, st
+        time.sleep(0.001)
+
+
+# -- chunked prefill ---------------------------------------------------------
+
+@pytest.mark.parametrize("paged", [True, False], ids=["paged", "dense"])
+@pytest.mark.parametrize("sampled", [False, True],
+                         ids=["greedy", "sampled"])
+def test_chunked_prefill_bitwise_identity(rng, paged, sampled):
+    """A 24-token prompt through 8-token chunk slices: tokens bitwise
+    equal to generate() (which prefills unchunked), AND the compile
+    inventory proves chunking happened — every slice fits the
+    bucket-16 program, so bucket 32 (the unchunked prompt's bucket) is
+    never compiled.  No third program kind: compiles == one prefill
+    bucket + one decode step, zero recompiles."""
+    wf, ws = _build_lm(TRANSFORMER)
+    prompt = rng.integers(0, V, (1, 24)).astype(np.int32)
+    kwargs = ({"temperature": 1.3, "top_k": 5,
+               "key": jax.random.key(11)} if sampled else {})
+    ref = np.asarray(generate(wf, ws, prompt, 6, **kwargs))
+    eng = DecodeEngine(wf, ws, slots=2, l_max=64, window_ms=0.0,
+                       paged=paged, prefill_chunk=8).start()
+    try:
+        got = eng.generate(prompt, 6, timeout=180, **kwargs)
+        np.testing.assert_array_equal(got, ref)
+        st = eng.stats()
+        assert st["compile"]["compiles"] <= 2, st
+        assert st["compile"]["recompiles"] == 0, st
+    finally:
+        eng.stop()
+
+
+@pytest.mark.parametrize("paged", [True, False], ids=["paged", "dense"])
+def test_chunked_prefill_recurrent_carry_crosses_slices(rng, paged):
+    """Recurrent chains are position-recurrent from token 0: a chunk
+    boundary must CONTINUE the carried state (not reset it, the way a
+    fresh admission does).  GRU+LSTM chain, greedy and sampled, both
+    layouts — bitwise equal to the unchunked run."""
+    wf, ws = _build_lm(RECURRENT)
+    prompt = rng.integers(0, V, (1, 21)).astype(np.int32)
+    eng = DecodeEngine(wf, ws, slots=2, l_max=64, window_ms=0.0,
+                       paged=paged, prefill_chunk=8).start()
+    try:
+        for kwargs in ({}, {"temperature": 1.1, "top_p": 0.95,
+                            "key": jax.random.key(5)}):
+            ref = np.asarray(generate(wf, ws, prompt, 5, **kwargs))
+            got = eng.generate(prompt, 5, timeout=180, **kwargs)
+            np.testing.assert_array_equal(got, ref, err_msg=str(kwargs))
+        assert eng.stats()["compile"]["recompiles"] == 0
+    finally:
+        eng.stop()
+
+
+def test_chunked_prefill_interleaves_with_decode(rng):
+    """The point of chunking: a short request admitted WHILE a long
+    prompt is mid-chunk finishes before the long one — the long
+    prompt's prefill no longer monopolizes the scheduler."""
+    wf, ws = _build_lm(TRANSFORMER)
+    eng = DecodeEngine(wf, ws, slots=2, l_max=128, window_ms=0.0,
+                       prefill_chunk=4).start()
+    try:
+        long_req = eng.submit(rng.integers(0, V, 90), 8)
+        short_req = eng.submit(rng.integers(0, V, 4), 2)
+        assert short_req.done.wait(120) and short_req.error is None
+        assert long_req.done.wait(120) and long_req.error is None
+        assert short_req.finished_at < long_req.finished_at
+    finally:
+        eng.stop()
+
+
+@pytest.mark.parametrize("layers,paged", [
+    (TRANSFORMER, False), (RECURRENT, False), (RECURRENT, True),
+], ids=["dense-attn", "dense-rec", "paged-rec"])
+def test_chunked_prefill_bitwise_under_concurrent_decode(rng, layers,
+                                                         paged):
+    """Chunk slices interleaved with REAL decode steps of another slot:
+    the mid-chunk slot is inactive while its cache rows are being
+    filled, so the decode program must not touch them — dense KV
+    scatters drop, recurrent carry freezes (an unmasked decode step
+    used to write stale-token KV at the slot's stale position and
+    advance its carry between slices, corrupting the continuation).
+    The paged-attention side was always scratch-routed; dense KV and
+    the carry on BOTH layouts are the regression here."""
+    wf, ws = _build_lm(layers)
+    long_p = rng.integers(1, V, 40).astype(np.int32)
+    ref = np.asarray(generate(wf, ws, long_p[None], 6))[0]
+    eng = DecodeEngine(wf, ws, slots=2, l_max=64, window_ms=0.0,
+                       paged=paged, prefill_chunk=8).start()
+    try:
+        # park a long-decoding request in one slot so decode steps run
+        # between every chunk slice of the second
+        decoy = eng.submit(rng.integers(1, V, 4), 55)
+        _wait_busy(eng)
+        lr = eng.submit(long_p, 6)
+        assert lr.done.wait(180) and lr.error is None, lr.error
+        got = np.asarray(lr.result)
+        np.testing.assert_array_equal(got, ref[:got.size])
+        assert eng.stats()["compile"]["recompiles"] == 0
+        assert decoy.done.wait(180)
+    finally:
+        eng.stop()
+
+
+def test_decode_step_leaves_inactive_rows_untouched(rng):
+    """The program-level invariant behind chunked prefill: a decode
+    step must leave an INACTIVE row's state bitwise untouched — dense
+    attention KV (write dropped, not idempotently rewritten: the row's
+    cache may hold freshly chunk-prefilled KV the stale position would
+    clobber) and recurrent carry on both layouts (a cell iteration is
+    never idempotent).  Asserted directly against the engine's compiled
+    decode program with one active and one inactive row."""
+    wf, ws = _build_lm(RECURRENT)          # GRU + LSTM chain
+    wfa, wsa = _build_lm(TRANSFORMER)
+
+    def run_step(eng, paged):
+        S, L = eng.slots, eng.l_max
+        caches = {}
+        # sentinel state on every row, as if chunk slices had filled it
+        for k in eng._caches:
+            caches[k] = jax.tree.map(
+                lambda a: a + jnp.asarray(0.125, a.dtype)
+                if jnp.issubdtype(a.dtype, jnp.floating) else a,
+                eng._caches[k])
+        # the decode program DONATES its cache buffers: snapshot the
+        # before-state to host numpy first
+        before = {k: jax.tree.map(lambda a: np.array(a), caches[k])
+                  for k in caches}
+        toks = jnp.zeros((S, L), jnp.int32).at[1, 0].set(3)
+        pos = np.array([5, 0], np.int32)
+        active = np.array([True, False])
+        args = (eng.wstate["params"], caches, toks)
+        if paged:
+            args += (eng._ptab,)
+        out = eng._decode(*args, pos, active, np.zeros(S, np.float32),
+                          np.full(S, V, np.int32), np.ones(S, np.float32),
+                          np.full(S, -1, np.int32),
+                          np.full(S, L - 1, np.int32),
+                          np.stack([np.asarray(jax.random.key_data(
+                              jax.random.key(i))) for i in range(S)]))
+        return before, out[0]
+
+    # dense transformer: row 1's KV row must be bitwise unchanged
+    # (and row 0's position-5 KV must have actually been written)
+    eng = DecodeEngine(wfa, wsa, slots=2, l_max=16, paged=False)
+    before, after = run_step(eng, False)
+    attn_key = [k for k in before if "a1" in k][0]
+    np.testing.assert_array_equal(before[attn_key]["k"][1],
+                                  np.asarray(after[attn_key]["k"])[1])
+    np.testing.assert_array_equal(before[attn_key]["v"][1],
+                                  np.asarray(after[attn_key]["v"])[1])
+    assert not np.array_equal(before[attn_key]["k"][0, 5],
+                              np.asarray(after[attn_key]["k"])[0, 5])
+    # recurrent carry, dense AND paged layouts
+    for paged in (False, True):
+        eng = DecodeEngine(wf, ws, slots=2, l_max=16, paged=paged)
+        rec_keys = [k for k in eng._caches if "g1" in k or "l1" in k]
+        assert rec_keys
+        before, after = run_step(eng, paged)
+        for k in rec_keys:
+            for leaf, b in before[k].items():
+                a = np.asarray(after[k][leaf])
+                np.testing.assert_array_equal(b[1], a[1])
+                assert not np.array_equal(b[0], a[0])  # active row moved
+
+
+def test_dense_whole_tail_prefill_keeps_bucket_local_variant(rng):
+    """Chunk capability must not tax short prompts: a dense whole-tail
+    admission compiles the bucket-local prefill variant (O(pb)
+    attention per token), chunk slices the full-context one (they must
+    attend earlier positions) — two programs for the same bucket at
+    most, both bitwise vs generate(), zero recompiles."""
+    wf, ws = _build_lm(TRANSFORMER)
+    short_p = rng.integers(0, V, (1, 4)).astype(np.int32)
+    long_p = rng.integers(0, V, (1, 24)).astype(np.int32)
+    short_ref = np.asarray(generate(wf, ws, short_p, 4))
+    long_ref = np.asarray(generate(wf, ws, long_p, 4))
+    eng = DecodeEngine(wf, ws, slots=2, l_max=64, window_ms=0.0,
+                       paged=False, prefill_chunk=8).start()
+    try:
+        np.testing.assert_array_equal(
+            eng.generate(short_p, 4, timeout=180), short_ref)
+        n_short = eng.stats()["compile"]["compiles"]
+        assert n_short == 2                 # decode + local prefill
+        np.testing.assert_array_equal(
+            eng.generate(long_p, 4, timeout=180), long_ref)
+        st = eng.stats()
+        assert st["compile"]["compiles"] == n_short + 1  # + full form
+        assert st["compile"]["recompiles"] == 0, st
+        # and the fast variant is reused, not recompiled, afterwards
+        np.testing.assert_array_equal(
+            eng.generate(short_p, 4, timeout=180), short_ref)
+        assert eng.stats()["compile"]["compiles"] == n_short + 1
+    finally:
+        eng.stop()
+
+
+def test_chunked_prefill_metrics_label_whole_tail_bucket(rng):
+    """The prefill/TTFT histograms label a chunked request with the
+    WHOLE tail's bucket, not the final slice's: a long prompt whose
+    last slice fit the smallest bucket must not land its multi-slice
+    duration in the small-prefill latency series (and ``req.bucket`` /
+    the trace span carry the same honest label)."""
+    wf, ws = _build_lm(TRANSFORMER)
+    eng = DecodeEngine(wf, ws, slots=1, l_max=64, window_ms=0.0,
+                       paged=False, prefill_chunk=8).start()
+    try:
+        req = eng.submit(rng.integers(0, V, 24), 2)
+        assert req.done.wait(180) and req.error is None
+        assert req.bucket == eng._bucket(24)        # not _bucket(8)
+        assert req.bucket > eng._bucket(8)
+        short = eng.submit(rng.integers(0, V, 4), 2)
+        assert short.done.wait(180) and short.error is None
+        assert short.bucket == eng._bucket(4)       # unchunked: slice
+    finally:                                        # IS the whole tail
+        eng.stop()
+
+
+# -- priority classes --------------------------------------------------------
+
+def test_priority_queue_jump_ordering(rng):
+    """Strict-priority FIFO: with the single slot held, a class-0
+    arrival submitted AFTER two class-2 requests still decodes first
+    (preemption off — pure queue ordering)."""
+    wf, ws = _build_lm(TRANSFORMER)
+    eng = DecodeEngine(wf, ws, slots=1, l_max=64, window_ms=0.0,
+                       queue_depth=8, priorities=3,
+                       preempt=False).start()
+    try:
+        holder = eng.submit(rng.integers(0, V, 4), 30)
+        _wait_busy(eng)
+        low_a = eng.submit(rng.integers(0, V, 4), 2, priority=2)
+        low_b = eng.submit(rng.integers(0, V, 4), 2, priority=2)
+        high = eng.submit(rng.integers(0, V, 4), 2, priority=0)
+        for r in (holder, low_a, low_b, high):
+            assert r.done.wait(180) and r.error is None
+        assert high.finished_at < low_a.finished_at
+        assert high.finished_at < low_b.finished_at
+        assert low_a.finished_at < low_b.finished_at  # FIFO in-class
+    finally:
+        eng.stop()
+
+
+def test_priority_out_of_range_is_loud(rng):
+    wf, ws = _build_lm(TRANSFORMER)
+    eng = DecodeEngine(wf, ws, slots=1, l_max=32, priorities=2).start()
+    try:
+        for bad in (-1, 2, 7):
+            with pytest.raises(ValueError):
+                eng.submit(rng.integers(0, V, 4), 2, priority=bad)
+    finally:
+        eng.stop()
+
+
+def test_hard_full_queue_displaces_lowest_class(rng):
+    """On a HARD-full queue a higher-class arrival displaces the
+    youngest queued request of the lowest class below it — the
+    displaced request fails with EngineOverloaded (the REST 429), not
+    silence; an arrival of the lowest class itself still 429s."""
+    wf, ws = _build_lm(TRANSFORMER)
+    eng = DecodeEngine(wf, ws, slots=1, l_max=64, window_ms=0.0,
+                       queue_depth=2, priorities=2,
+                       preempt=False).start()
+    try:
+        holder = eng.submit(rng.integers(0, V, 4), 40)
+        _wait_busy(eng)
+        # fill the hard queue (the open window sheds nobody, so both
+        # classes queue freely up to the hard depth): one class-1 +
+        # one class-0
+        low = eng.submit(rng.integers(0, V, 4), 2, priority=1)
+        mid = eng.submit(rng.integers(0, V, 4), 2, priority=0)
+        # the lowest class at hard-full: plain 429 — there is no
+        # strictly lower class to displace
+        with pytest.raises(EngineOverloaded):
+            eng.submit(rng.integers(0, V, 4), 2, priority=1)
+        # a class-0 arrival displaces the queued class-1 request
+        high = eng.submit(rng.integers(0, V, 4), 2, priority=0)
+        assert low.done.wait(30)
+        assert isinstance(low.error, EngineOverloaded)
+        assert low.error.retry_after_s >= 1.0
+        for r in (holder, mid, high):
+            assert r.done.wait(180) and r.error is None, r.error
+        st = eng.stats()
+        # one class-1 429 + one class-1 displacement
+        assert st["admission"]["shed_by_class"].get("1") >= 2, st
+    finally:
+        eng.stop()
+
+
+def test_steal_lower_never_displaces_started_work():
+    """Displacement targets arrivals that have not run yet: a PREEMPTED
+    resume in the queue was accepted, held a slot, and carries
+    committed tokens in req.gen — shedding it with a 429 would discard
+    that work and break the acceptance.  steal_lower skips it, falls
+    back to fresher same-class arrivals, then to the next class up,
+    and returns None when only started work is queued."""
+    from veles_tpu.runtime.engine import _PrioQueue, _Request
+    kd = np.asarray(jax.random.key_data(jax.random.key(0)))
+
+    def mk(priority, preemptions=0):
+        r = _Request(np.asarray([1], np.int32), 2, 0.0, None, None,
+                     None, kd, time.monotonic() + 60, priority=priority)
+        r.preemptions = preemptions
+        return r
+
+    q = _PrioQueue(3)
+    resumed = mk(2, preemptions=1)
+    fresh_a, fresh_b, fresh_mid = mk(2), mk(2), mk(1)
+    q.appendleft(resumed)               # exactly how _preempt requeues
+    q.append(fresh_a)
+    q.append(fresh_b)
+    q.append(fresh_mid)
+    assert q.steal_lower(0) is fresh_b  # youngest fresh class-2
+    assert q.steal_lower(0) is fresh_a
+    assert q.steal_lower(0) is fresh_mid  # class-2 blocked -> class 1
+    assert q.steal_lower(0) is None     # only started work remains
+    assert q.popleft() is resumed       # ... and it still serves
+
+
+# -- preemption --------------------------------------------------------------
+
+@pytest.mark.parametrize("sampled", [False, True],
+                         ids=["greedy", "sampled"])
+def test_preempt_resume_bitwise_identity(rng, sampled):
+    """A class-0 arrival preempts the running class-1 slot
+    (retire-and-requeue, pages released); the victim later resumes by
+    re-prefilling its own history — final stream bitwise equal to an
+    uninterrupted run, for greedy and sampled decode, with compile
+    counters flat (the resume rides existing buckets)."""
+    wf, ws = _build_lm(TRANSFORMER)
+    vic_prompt = rng.integers(0, V, (1, 5)).astype(np.int32)
+    hi_prompt = rng.integers(0, V, (1, 4)).astype(np.int32)
+    kwargs = ({"temperature": 1.7, "top_k": 6,
+               "key": jax.random.key(23)} if sampled else {})
+    vic_ref = np.asarray(generate(wf, ws, vic_prompt, 40, **kwargs))
+    hi_ref = np.asarray(generate(wf, ws, hi_prompt, 3))
+    eng = DecodeEngine(wf, ws, slots=1, l_max=64, window_ms=0.0,
+                       priorities=2, preempt=True).start()
+    try:
+        key = kwargs.get("key")
+        victim = eng.submit(
+            vic_prompt[0], 40, priority=1,
+            temperature=kwargs.get("temperature", 0.0),
+            top_k=kwargs.get("top_k"), key=key)
+        _wait_busy(eng)
+        high = eng.submit(hi_prompt[0], 3, priority=0)
+        assert high.done.wait(180) and high.error is None
+        assert victim.done.wait(180) and victim.error is None
+        np.testing.assert_array_equal(high.result[None], hi_ref)
+        np.testing.assert_array_equal(victim.result[None], vic_ref)
+        assert victim.preemptions >= 1
+        st = eng.stats()
+        assert st["admission"]["preemptions"] >= 1, st
+        assert st["compile"]["recompiles"] == 0, st
+        # the high request finished while the victim waited out its
+        # preemption: priority bought latency, not different tokens
+        assert high.finished_at < victim.finished_at
+    finally:
+        eng.stop()
+
+
+def test_preempt_frees_pages_for_high_priority(rng):
+    """Page-pool preemption: with the pool sized for ~one long
+    request, a class-0 arrival that would 429 on page exhaustion
+    instead queues, the scheduler preempts the class-1 page holder,
+    and BOTH finish with correct tokens (the victim re-reserves for
+    its effective prompt on resume)."""
+    wf, ws = _build_lm(TRANSFORMER)
+    vic_prompt = rng.integers(0, V, (1, 33)).astype(np.int32)
+    hi_prompt = rng.integers(0, V, (1, 30)).astype(np.int32)
+    vic_ref = np.asarray(generate(wf, ws, vic_prompt, 8))
+    hi_ref = np.asarray(generate(wf, ws, hi_prompt, 8))
+    # 4 pages of 16 tokens: either request spans 3 — never both
+    eng = DecodeEngine(wf, ws, slots=2, l_max=64, window_ms=0.0,
+                       paged=True, page_size=16, pages=4,
+                       priorities=2, preempt=True).start()
+    try:
+        victim = eng.submit(vic_prompt[0], 8, priority=1)
+        _wait_busy(eng)
+        high = eng.submit(hi_prompt[0], 8, priority=0)
+        assert high.done.wait(180) and high.error is None
+        assert victim.done.wait(180) and victim.error is None
+        np.testing.assert_array_equal(high.result[None], hi_ref)
+        np.testing.assert_array_equal(victim.result[None], vic_ref)
+        assert eng.stats()["admission"]["preemptions"] >= 1
+    finally:
+        eng.stop()
+
+
+def test_no_futile_preemption_when_pages_cannot_suffice(rng):
+    """Slot-full preemption is feasibility-guarded like the page loop:
+    with the pool mostly pinned by a SAME-class slot, a class-0 arrival
+    needing more pages than the class-1 victim could ever free must not
+    evict it (the victim would lose all progress to a full re-prefill
+    for an admission that still cannot happen).  The victim runs to
+    completion untouched; the arrival simply waits for capacity."""
+    wf, ws = _build_lm(TRANSFORMER)
+    vic_prompt = rng.integers(0, V, (1, 5)).astype(np.int32)
+    vic_ref = np.asarray(generate(wf, ws, vic_prompt, 20))
+    # 8 pages of 8: class-0 pins 5 (span 4+36-1=39), class-1 victim 3
+    # (span 5+20-1=24); the waiter's span of 4 exceeds avail 0 +
+    # reclaimable 3, so preempting the victim can never satisfy it
+    eng = DecodeEngine(wf, ws, slots=2, l_max=64, window_ms=0.0,
+                       paged=True, page_size=8, pages=8,
+                       priorities=2, preempt=True).start()
+    try:
+        pinner = eng.submit(rng.integers(0, V, 4), 36, priority=0)
+        victim = eng.submit(vic_prompt[0], 20, priority=1)
+        _wait_busy(eng)
+        waiter = eng.submit(rng.integers(0, V, 4), 28, priority=0)
+        assert victim.done.wait(180) and victim.error is None
+        np.testing.assert_array_equal(victim.result[None], vic_ref)
+        assert victim.preemptions == 0
+        # capacity frees as the same-class slots retire; the waiter
+        # then admits normally — nobody was evicted along the way
+        assert pinner.done.wait(180) and pinner.error is None
+        assert waiter.done.wait(180) and waiter.error is None
+        assert eng.stats()["admission"]["preemptions"] == 0
+    finally:
+        eng.stop()
+
+
+# -- admission controller ----------------------------------------------------
+
+def test_controller_hysteresis_fake_clock():
+    """The AIMD control law, pinned step by step under an injected
+    clock and burn source: multiplicative shrink while burning, floor
+    at min_window, HOLD in the mid-band, regrowth only after the
+    recovery held hold_s, and a mid-band blip re-arming the hold."""
+    clock, burn = [0.0], [10.0]
+    ctl = AdmissionController(
+        queue_depth=64, priorities=4, burn_fn=lambda: burn[0],
+        clock=lambda: clock[0], enabled=True, min_window=2,
+        interval_s=1.0, hold_s=5.0, decrease=0.5, increase=2.0,
+        burn_threshold=2.0)
+    assert ctl.window() == 64.0
+
+    def step(dt=1.0):
+        clock[0] += dt
+        return ctl.tick()
+
+    assert ctl.tick() == 32.0       # first eval fires immediately
+    assert ctl.tick() == 32.0       # rate-limited: same instant, no-op
+    assert step() == 16.0
+    for want in (8.0, 4.0, 2.0, 2.0):   # floor holds
+        assert step() == want
+    burn[0] = 1.5                   # mid-band [1, 2): hold steady
+    assert step() == 2.0
+    burn[0] = 0.4                   # recovered: arm the hold clock
+    assert step() == 2.0            # armed at t, not grown yet
+    assert step(4.0) == 2.0         # 4s < hold_s
+    burn[0] = 1.5                   # blip into the mid-band: re-arm
+    assert step() == 2.0
+    burn[0] = 0.4
+    assert step() == 2.0            # hold restarts from here
+    assert step(5.0) == 4.0         # held 5s: regrow begins
+    for want in (8.0, 16.0, 32.0, 64.0, 64.0):  # ceiling holds
+        assert step() == want
+    # priority-scaled allowance: a fully-open window sheds NOBODY
+    # (every class gets the hard queue_depth — the controller is a
+    # no-op until a burn closes the window); once closed, class 0
+    # keeps the hard bound and lower classes scale with the window,
+    # the lowest down to a priorities-th of it; backoff tracks the
+    # closure
+    assert ctl.allowance(0) == 64 and ctl.allowance(3) == 64
+    assert ctl.backoff_factor() == 1.0
+    burn[0] = 10.0
+    step()                          # 32
+    step()                          # 16
+    assert ctl.allowance(0) == 64 and ctl.allowance(3) == 4
+    assert ctl.allowance(1) == 12   # 16 * 3/4
+    assert ctl.backoff_factor() == 4.0
+    st = ctl.state()
+    assert st["shedding"] and st["window"] == 16.0
+    assert st["burn"] == 10.0
+
+
+def test_controller_disabled_and_no_target_are_noops():
+    """enabled=False always reports the full window; burn_fn=None
+    (no SLO target anywhere) never shrinks — the controller must be
+    inert until an operator declares a target."""
+    off = AdmissionController(queue_depth=16, enabled=False,
+                              burn_fn=lambda: 99.0,
+                              clock=lambda: 0.0)
+    off.tick()
+    assert off.window() == 16.0 and off.backoff_factor() == 1.0
+    assert off.allowance(2) == 16   # even the lowest class: no shed
+    clock = [0.0]
+    idle = AdmissionController(queue_depth=16, enabled=True,
+                               burn_fn=None, interval_s=0.1,
+                               clock=lambda: clock[0])
+    for _ in range(5):
+        clock[0] += 1.0
+        idle.tick()
+    assert idle.window() == 16.0
+    assert idle.allowance(2) == 16
+
+
+def test_controller_knob_validation():
+    with pytest.raises(ValueError):
+        AdmissionController(queue_depth=8, decrease=1.5)
+    with pytest.raises(ValueError):
+        AdmissionController(queue_depth=8, increase=0.9)
+
+
+def test_closed_window_sheds_low_class_first(rng):
+    """A controller pinned nearly shut sheds a class-2 submit while the
+    queue holds work, and counts it in shed_by_class — the engine-side
+    half of the priority-scaled window."""
+    wf, ws = _build_lm(TRANSFORMER)
+    ctl = AdmissionController(queue_depth=8, priorities=3,
+                              burn_fn=lambda: 10.0, interval_s=0.0,
+                              min_window=2, enabled=True)
+    ctl.tick()
+    ctl.tick()                      # 8 -> 4 -> 2: allowance(2) == 1
+    eng = DecodeEngine(wf, ws, slots=1, l_max=64, window_ms=0.0,
+                       queue_depth=8, priorities=3, preempt=False,
+                       admission=ctl).start()
+    try:
+        holder = eng.submit(rng.integers(0, V, 4), 30)
+        _wait_busy(eng)
+        queued = eng.submit(rng.integers(0, V, 4), 2, priority=1)
+        with pytest.raises(EngineOverloaded) as ei:
+            eng.submit(rng.integers(0, V, 4), 2, priority=2)
+        # adaptive Retry-After: the window is 4x closed, so the hint
+        # is scaled up from the baseline floor
+        assert ei.value.retry_after_s >= 1.0
+        st = eng.stats()
+        assert st["admission"]["shed_by_class"].get("2") == 1, st
+        assert st["admission"]["window"] == 2.0
+        for r in (holder, queued):
+            assert r.done.wait(180) and r.error is None
+    finally:
+        eng.stop()
+
+
+def test_closed_window_displaces_lower_class_not_arrival(rng):
+    """A burn-closed window must not invert the priority contract: when
+    the queue that filled BEFORE the window closed holds strictly-lower
+    classes, a mid-class arrival displaces the youngest of them (same
+    as the hard-full rule) instead of 429ing while they keep their
+    spots — under any shed the low classes go first, not whoever
+    arrived later."""
+    wf, ws = _build_lm(TRANSFORMER)
+    burn = [0.0]
+    ctl = AdmissionController(queue_depth=8, priorities=3,
+                              burn_fn=lambda: burn[0], interval_s=0.0,
+                              hold_s=60.0, min_window=2, enabled=True)
+    eng = DecodeEngine(wf, ws, slots=1, l_max=64, window_ms=0.0,
+                       queue_depth=8, priorities=3, preempt=False,
+                       admission=ctl).start()
+    try:
+        holder = eng.submit(rng.integers(0, V, 4), 50)
+        _wait_busy(eng)
+        # the queue fills while the window is OPEN (burn 0: the
+        # controller is a no-op and class 2 queues freely) ...
+        low = [eng.submit(rng.integers(0, V, 4), 2, priority=2)
+               for _ in range(3)]
+        burn[0] = 10.0              # ... then the burn closes it
+        deadline = time.monotonic() + 30
+        while eng.stats()["admission"]["window"] > 2.0:
+            assert time.monotonic() < deadline
+            time.sleep(0.005)
+        # class-1 allowance is now 2 < qlen 3: without displacement
+        # this arrival would shed while three class-2 spots survive
+        mid = eng.submit(rng.integers(0, V, 4), 2, priority=1)
+        shed = [r for r in low if r.done.wait(5)
+                and isinstance(r.error, EngineOverloaded)]
+        assert len(shed) == 1       # exactly the youngest class-2
+        assert shed[0] is low[-1]
+        assert eng.stats()["admission"]["shed_by_class"].get("2") == 1
+        burn[0] = 0.0               # let the backlog drain and finish
+        for r in (holder, mid, low[0], low[1]):
+            assert r.done.wait(180) and r.error is None, r.error
+    finally:
+        eng.stop()
+
+
+# -- REST integration --------------------------------------------------------
+
+def test_restful_priority_header_and_shed_body(rng):
+    """The REST spelling of the priority contract: X-Priority header
+    and body "priority" both route to submit(priority=), out-of-range
+    classes answer 400, and a shed answers 429 whose BODY carries the
+    un-rounded adaptive retry_after_s alongside the Retry-After
+    header."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    from veles_tpu.runtime.restful import RestfulServer
+    wf, ws = _build_lm(TRANSFORMER)
+    eng = DecodeEngine(wf, ws, slots=1, l_max=64, queue_depth=2,
+                       priorities=3, preempt=False, window_ms=0.0)
+    srv = RestfulServer(wf.make_predict_step("out"), ws, 2, (6,),
+                        workflow=wf, engine=eng).start()
+    prompt = rng.integers(1, V, (1, 5)).astype(np.int32)
+
+    def post(body, headers=()):
+        hdrs = {"Content-Type": "application/json", **dict(headers)}
+        return urllib.request.urlopen(urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/generate",
+            _json.dumps(body).encode(), hdrs))
+
+    try:
+        ref = np.asarray(generate(wf, ws, prompt, 4))
+        with post({"prompt": prompt.tolist(), "steps": 4},
+                  [("X-Priority", "1")]) as r:
+            np.testing.assert_array_equal(
+                np.asarray(_json.loads(r.read())["tokens"]), ref)
+        with post({"prompt": prompt.tolist(), "steps": 4,
+                   "priority": 2}) as r:
+            assert r.status == 200
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post({"prompt": prompt.tolist(), "steps": 4,
+                  "priority": 99})
+        assert ei.value.code == 400
+        # occupy the slot and hard-fill the queue, then a class-2
+        # POST must shed with the adaptive hint (nothing strictly
+        # lower is queued for it to displace)
+        holder = eng.submit(rng.integers(0, V, 4), 40)
+        _wait_busy(eng)
+        queued = [eng.submit(rng.integers(0, V, 4), 2, priority=1)
+                  for _ in range(2)]
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post({"prompt": prompt.tolist(), "steps": 4},
+                 [("X-Priority", "2")])
+        assert ei.value.code == 429
+        assert int(ei.value.headers["Retry-After"]) >= 1
+        body = _json.loads(ei.value.read())
+        assert body["retry_after_s"] >= 1.0
+        for r in (holder, *queued):
+            assert r.done.wait(180) and r.error is None
+    finally:
+        srv.stop()
+
+
+# -- compile discipline under concurrent overload ----------------------------
+
+def test_compiles_frozen_across_chunk_preempt_shed(rng):
+    """Everything at once: chunked prefills, preemptions, priority
+    displacement, and controller shedding under concurrent submit
+    threads — the StepCache still holds ONLY the pow2 prefill buckets
+    + one decode step, with zero recompiles (no third program kind)."""
+    wf, ws = _build_lm(TRANSFORMER)
+    eng = DecodeEngine(wf, ws, slots=2, l_max=64, window_ms=0.0,
+                       queue_depth=4, priorities=3, preempt=True,
+                       prefill_chunk=8).start()
+    try:
+        # warm the inventory: one chunked long prompt + one short
+        eng.generate(rng.integers(0, V, (1, 24)).astype(np.int32), 2,
+                     timeout=180)
+        eng.generate(rng.integers(0, V, (1, 4)).astype(np.int32), 2,
+                     timeout=180)
+        frozen = eng.stats()["compile"]["compiles"]
+        ok, shed = [0], [0]
+        lock = threading.Lock()
+
+        def worker(i):
+            try:
+                eng.generate(
+                    rng.integers(0, V, (1, 4 + (i % 3) * 10))
+                    .astype(np.int32),
+                    2 + i % 3, priority=i % 3, timeout=180)
+                with lock:
+                    ok[0] += 1
+            except EngineOverloaded:
+                with lock:
+                    shed[0] += 1
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(16)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=240)
+        assert ok[0] + shed[0] == 16
+        assert ok[0] >= 1           # the engine kept serving
+        st = eng.stats()
+        assert st["compile"]["compiles"] == frozen, st
+        assert st["compile"]["recompiles"] == 0, st
+    finally:
+        eng.stop()

@@ -179,7 +179,14 @@ RESOURCE_PAIRS = {
         "exit_roots": {"runtime/engine.py": (
             "DecodeEngine._retire",
             "DecodeEngine._post_step",      # mid-flight deadline sweep
-            "DecodeEngine._fail_all")},     # scheduler crash / stop
+            "DecodeEngine._fail_all",       # scheduler crash / stop
+            "DecodeEngine._preempt",        # retire-and-requeue: the
+            #                                 victim's pages must release
+            #                                 before the winner reserves
+            "DecodeEngine._advance_prefills")},  # mid-PREFILL deadline
+        #                                          sweep (chunking slots
+        #                                          are neither queued
+        #                                          nor active)
     },
 }
 

@@ -336,6 +336,32 @@ def _defaults():
     #                                           lookup; no second model)
     root.common.serve.window_ms = 2.0        # admission batching window
     root.common.serve.queue_depth = 64       # pending requests before 429
+    # Overload survival (docs/serving.md "Overload survival"): chunked
+    # prefill bounds how long one prompt can monopolize the scheduler,
+    # priority classes give queue-jump + preemption, and the adaptive
+    # admission controller resizes the admitted queue window off the
+    # SLO burn rate instead of only flipping /ready.
+    root.common.serve.prefill_chunk = 256    # split prefills longer than
+    #                                          this into bucket-sized
+    #                                          slices interleaved with
+    #                                          decode steps (0 = off)
+    root.common.serve.priorities = 3         # request classes (0 = the
+    #                                          highest; default class 0)
+    root.common.serve.preempt = True         # a higher-class arrival may
+    #                                          retire-and-requeue the
+    #                                          lowest-class youngest slot
+    root.common.serve.admission.enabled = True  # SLO-driven admission
+    #                                             window (no-op while no
+    #                                             slo target is set)
+    root.common.serve.admission.min_window = 2  # floor the window never
+    #                                             shrinks below
+    root.common.serve.admission.interval_s = 0.25  # controller eval step
+    root.common.serve.admission.hold_s = 2.0  # burn must stay recovered
+    #                                           this long before regrowth
+    root.common.serve.admission.decrease = 0.5  # multiplicative shrink
+    #                                             while burn >= threshold
+    root.common.serve.admission.increase = 1.5  # multiplicative regrowth
+    #                                             once recovery held
     root.common.serve.deadline_s = 120.0     # default per-request deadline
     root.common.serve.runner_cache = 32      # generate() compiled-runner LRU
     root.common.serve.max_body_mb = 64       # POST body cap -> 413

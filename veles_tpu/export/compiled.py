@@ -53,10 +53,14 @@ FORMAT = "veles-tpu-compiled-artifact"
 #: 2 = paged KV-cache layout (cache avals are a page pool, the decode /
 #: prefill calling conventions carry a page table, and the manifest
 #: records ``paged`` / ``page_size`` / ``pages`` / ``prefix_reuse``).
-#: Version-1 (dense) artifacts still load — the runner keeps both
-#: layouts — but v2 artifacts are refused by older readers
-#: (docs/serving_export.md).
-FORMAT_VERSION = 2
+#: 3 = every prefill program takes the traced ``start`` (the dense
+#: convention grew it; paged always had it) and the manifest records
+#: ``prefill_start: true`` — the chunked-prefill / preempt-resume
+#: calling convention (docs/serving.md "Overload survival").  Version
+#: 1 and 2 artifacts still load — the runner keeps the old dense
+#: convention and gates chunking off — but v3 artifacts are refused by
+#: older readers (docs/serving_export.md).
+FORMAT_VERSION = 3
 
 
 def _aval_rows(tree):
@@ -344,8 +348,11 @@ def export_compiled(workflow, wstate, out_dir: str, *,
                                i32(), f32(),
                                jax.ShapeDtypeStruct(kd.shape, kd.dtype))
                 else:
+                    # v3 dense convention: (prompt, new_len, start,
+                    # slot, temp, topk, topp, key) — the traced start
+                    # the chunked-prefill / preempt-resume path feeds
                     pre_sds = (psds, csds, toks, i32(1, pb), i32(),
-                               i32(), f32(), i32(), f32(),
+                               i32(), i32(), f32(), i32(), f32(),
                                jax.ShapeDtypeStruct(kd.shape, kd.dtype))
                 # lint: disable=VP601 pb ranges over bucket_table(
                 # bucket_min, l_max) — the fixed static prefill
@@ -386,6 +393,12 @@ def export_compiled(workflow, wstate, out_dir: str, *,
             "paged_kernel": bool(geo.paged_kernel and decode_meta),
             "prefix_reuse": bool(geo.paged and decode_meta and plan
                                  is not None and not plan._rec_units),
+            # FORMAT_VERSION 3: sealed prefill programs take the traced
+            # ``start`` on BOTH layouts, so the runner may chunk
+            # prefills and resume preempted slots mid-prompt; absent
+            # (older artifacts) the runner serves unchunked and keeps
+            # the dense whole-prompt calling convention
+            "prefill_start": bool(decode_meta),
             # speculative decode support: present (with the sealed
             # verify program's static k) only when the verify program
             # is part of the sealed inventory — the ArtifactRunner's

@@ -365,6 +365,18 @@ class ArtifactRunner(DecodeEngine):
                           spec=want_spec,
                           spec_k=(int(spec_meta["k"]) if want_spec
                                   else None))
+        # v3 calling convention (manifest ``prefill_start``): the sealed
+        # prefill programs take the traced ``start``, so chunked prefill
+        # and preempt-resume are plain bucket calls on them.  Absent
+        # (v1/v2 exports), the dense programs keep the whole-prompt
+        # convention and chunking is gated off — an old PAGED program
+        # does take ``start``, but its body resets recurrent carry at
+        # every call, so mid-prompt continuation is only trusted when
+        # the exporter declared it (docs/serving.md "Overload
+        # survival").  Overrides the live-builder defaults
+        # _init_config just set.
+        self._prefill_start = bool(man.get("prefill_start", False))
+        self._chunk_capable = self._prefill_start
         # strict: a sealed program that can't AOT-compile here must
         # fail the LOAD, never lazily crash the first request
         self.step_cache = StepCache(strict=True)
@@ -445,7 +457,11 @@ class ArtifactRunner(DecodeEngine):
             self._verify_args_sds(params), pin=(self._exp_verify,))
         return step
 
-    def _prefill_fn(self, pb: int, params):
+    def _prefill_fn(self, pb: int, params, full_ctx: bool = True):
+        # ``full_ctx`` is a live-builder compile choice; a sealed
+        # inventory has exactly one program per bucket (v3 seals the
+        # chunk-capable full-context form, v1/v2 their whole-prompt
+        # convention), so the hint is accepted and ignored
         exp = self._exp_prefill.get(int(pb))
         if exp is None:
             raise ArtifactError(

@@ -73,6 +73,7 @@ import numpy as np
 from ..config import root
 from ..logger import Logger
 from ..units.base import Context
+from .admission import AdmissionController
 from .generate import DecodePlan
 from .memory import memory_monitor, tree_bytes
 from .metrics import ScopedCounter, next_trace_id, registry, span_ring
@@ -163,8 +164,15 @@ def make_decode_fn(plan, ctx, S: int, *, page_size: Optional[int] = None,
     (page indirection is traced data flow through the same program
     kind); inactive slots' KV writes route to the scratch pool row so a
     retired slot can never corrupt pages reassigned to another slot.
-    ``paged_kernel`` routes the paged attention read through the fused
-    Pallas kernel (bounded-error; runtime/generate.py)."""
+    On BOTH layouts the ``active`` mask also drops inactive rows' dense
+    KV scatters and freezes their recurrent carry (``write_ok`` /
+    ``carry_ok`` in plan.step): an inactive slot may be mid-CHUNKED-
+    prefill, its rows being filled slice by slice, and a stale-position
+    write or a carry advance between slices would corrupt the very
+    state the next slice continues from (docs/serving.md "Overload
+    survival").  ``paged_kernel`` routes the paged attention read
+    through the fused Pallas kernel (bounded-error;
+    runtime/generate.py)."""
 
     def step_tail(caches, toks, logits, pos, active, temp, topk, topp,
                   eos, end, keys, rows):
@@ -182,7 +190,8 @@ def make_decode_fn(plan, ctx, S: int, *, page_size: Optional[int] = None,
                         topp, eos, end, keys):
             rows = jnp.arange(S)
             tok = toks[rows, pos]
-            logits, caches = plan.step(params, caches, tok, pos, ctx)
+            logits, caches = plan.step(params, caches, tok, pos, ctx,
+                                       write_ok=active)
             return step_tail(caches, toks, logits, pos, active, temp,
                              topk, topp, eos, end, keys, rows)
     else:
@@ -228,9 +237,12 @@ def make_verify_fn(plan, ctx, S: int, K: int, *,
     step), samples the next token, writes it, and keeps extending only
     while the draft matched and neither eos nor the length bound hit
     (mid-block eos retirement: later micro-steps leave the slot
-    untouched).  Slots not extending re-feed their last token with
-    writes routed to the scratch pool row (paged) or idempotently
-    rewritten in place (dense) — state provably unchanged.  Returns
+    untouched).  Slots not extending re-feed their last token with KV
+    writes routed to the scratch pool row (paged) or dropped (dense)
+    and their recurrent carry frozen — state provably unchanged (the
+    same ``write_ok`` discipline as :func:`make_decode_fn`; a cell
+    iteration is not idempotent, and a mid-chunk slot's rows must not
+    be touched between its slices).  Returns
     ``(caches, toks, pos, active, finished, accepted)`` where
     ``accepted`` (S,) int32 counts draft tokens whose emission matched
     the proposal (the accept-rate numerator)."""
@@ -243,7 +255,8 @@ def make_verify_fn(plan, ctx, S: int, K: int, *,
             caches, toks, p, alive, fin, acc = carry
             tok = toks[rows, p]
             if page_size is None:
-                logits, caches2 = plan.step(params, caches, tok, p, ctx)
+                logits, caches2 = plan.step(params, caches, tok, p, ctx,
+                                            write_ok=alive)
             else:
                 logits, caches2 = plan.step(
                     params, caches, tok, p, ctx,
@@ -338,59 +351,109 @@ def ngram_draft(hist, k: int, *, n_max: int = 3, n_min: int = 1):
 
 
 def make_prefill_fn(plan, ctx, pb: int, cache_dtype, *,
-                    page_size: Optional[int] = None):
+                    page_size: Optional[int] = None,
+                    full_ctx: bool = True):
     """The engine's bucketed-prefill program for bucket length ``pb``
     (un-compiled jitted function; module-level for the same exporter
     single-source reason as :func:`make_decode_fn`).
 
-    The paged form (``page_size`` set) is the shared-prefix half of the
-    paged cache: it processes only the ``new_len`` tokens AFTER the
-    traced ``start`` offset — the prefix-cache hit — writing KV straight
-    into the slot's pool pages while ATTENDING through the page table to
-    the shared prefix pages some earlier request already prefilled.  The
-    bucket is therefore sized by the un-shared tail, so a request with a
-    hot system prompt pays a small-bucket prefill instead of a full one.
-    Positions are global throughout (RoPE, masks, sampling-key folds),
-    so tokens stay bitwise identical to an un-shared prefill."""
+    BOTH layouts take a traced ``start``: the program processes only the
+    ``new_len`` tokens AFTER the ``start`` offset, continuing from
+    whatever state the slot already holds.  On the paged side that is
+    the shared-prefix half of the paged cache (the prefix-cache hit:
+    attend through the page table to pages an earlier request already
+    prefilled, prefill only the tail — the bucket is sized by the
+    tail).  On BOTH sides it is what makes **chunked prefill** a plain
+    bucket call: a long prompt is fed as a sequence of bounded slices,
+    each continuing at the previous slice's ``start``, interleaved with
+    decode steps (docs/serving.md "Overload survival") — no new program
+    kind, the compile counters stay flat.  Positions are global
+    throughout (RoPE, masks, KV scatter, sampling-key folds), so the
+    emitted token stream is bitwise identical to a single unchunked
+    prefill.
+
+    Dense form, ``full_ctx=True`` (the chunk-capable convention, and
+    the one v3 artifacts seal): the slot's full ``(1, l_max)`` rows are
+    sliced out of the batch caches, the slice scans its positions
+    against them (a ``start > 0`` continuation must attend every
+    earlier position), and the rows splice back.  ``start == 0`` resets
+    recurrent carried state in-program (a traced select — the slot rows
+    may hold a previous occupant's carry); pad steps revert the WHOLE
+    carried tree, so a pad position's clamped scatter can never clobber
+    a real row.
+
+    ``full_ctx=False`` (static; dense only) is the bucket-local fast
+    path for whole-tail admissions — the caller guarantees
+    ``start == 0``: the scan runs against a FRESH ``(1, pb)`` local
+    cache (each of the ``pb`` steps attends at most ``pb`` positions,
+    not ``l_max`` — a short prompt on a long-context engine must not
+    pay O(l_max) attention per token just because chunking exists) and
+    splices its ``pb``-length slab into the slot's rows.  Bitwise: at
+    ``start == 0`` the two variants differ only in cache positions
+    beyond the prompt, which the causal mask guarantees are never
+    attended before decode rewrites them."""
 
     if page_size is not None:
         return _make_paged_prefill_fn(plan, ctx, pb, page_size)
+    from .generate import _rec_state_init
 
-    def prefill(params, caches, toks, prompt, true_len, slot, temp,
-                topk, topp, key_data):
-        local = plan.init_caches(params, 1, pb, cache_dtype)
+    def prefill(params, caches, toks, prompt, new_len, start, slot,
+                temp, topk, topp, key_data):
+        if full_ctx:
+            local = jax.tree.map(
+                lambda big: jax.lax.dynamic_slice(
+                    big, (slot,) + (jnp.int32(0),) * (big.ndim - 1),
+                    (1,) + big.shape[1:]),
+                caches)
+            for key, u in plan._rec_units:
+                init = _rec_state_init(u, 1)
+                local[key] = jax.tree.map(
+                    lambda i, o: jnp.where(start == 0,
+                                           i.astype(o.dtype), o),
+                    init, local[key])
+        else:
+            # whole-tail admission at start == 0: fresh bucket-length
+            # rows (KV length pb, recurrent carry at its reset state —
+            # exactly the start == 0 select above resolves to)
+            local = plan.init_caches(params, 1, pb, cache_dtype)
 
-        def body(carry, pos):
+        def body(carry, i):
             local = carry
-            tok = prompt[:, pos]
+            pos = start + i                     # global position
+            tok = prompt[:, i]
             # plan.step REBINDS the dict's top-level entries in
             # place — hand it a shallow copy so ``local`` still
             # holds the pre-step leaves the gate needs
             logits, new = plan.step(params, dict(local), tok, pos, ctx)
-            # pad positions beyond the true prompt must not advance
-            # carried state (recurrent) nor write KV
-            valid = pos < true_len
+            # pad positions (i >= new_len) must not advance carried
+            # state, write KV, or — via the update-slice clamp at the
+            # cache edge — clobber a real position: revert everything
+            valid = i < new_len
             local = jax.tree.map(
                 lambda n, o: jnp.where(valid, n, o), new, local)
             return local, logits
 
         local, ys = jax.lax.scan(body, local, jnp.arange(pb))
         last = jax.lax.dynamic_index_in_dim(
-            ys, true_len - 1, 0, keepdims=False)        # (1, V)
+            ys, new_len - 1, 0, keepdims=False)         # (1, V)
+        # the fold position is GLOBAL (start + new_len - 1): bitwise
+        # the key an unchunked prefill of the whole prompt folds
         key = jax.random.fold_in(
-            jax.random.wrap_key_data(key_data), true_len - 1)
+            jax.random.wrap_key_data(key_data), start + new_len - 1)
         first = _sample_slots(
             last, key[None], temp[None], topk[None], topp[None])[0]
-        # splice the slot's fresh state into the engine batch
+        # splice the slot's advanced rows back into the engine batch
         caches = jax.tree.map(
             lambda big, loc: jax.lax.dynamic_update_slice(
                 big, loc.astype(big.dtype),
                 (slot,) + (jnp.int32(0),) * (loc.ndim - 1)),
             caches, local)
-        row = jnp.where(jnp.arange(pb) < true_len, prompt[0], 0)
-        toks = jax.lax.dynamic_update_slice(
-            toks, row[None], (slot, jnp.int32(0)))
-        toks = toks.at[slot, true_len].set(first)
+        # like the paged path, the prompt region of ``toks`` is never
+        # written (retire assembles from the request's own prompt);
+        # only the sampled first token lands, at its global position —
+        # an intermediate chunk's sample is overwritten by nothing and
+        # read by nothing (decode starts at the FINAL chunk's sample)
+        toks = toks.at[slot, start + new_len].set(first)
         return caches, toks, first
 
     return jax.jit(prefill, donate_argnums=(1, 2))
@@ -405,9 +468,14 @@ def _make_paged_prefill_fn(plan, ctx, pb: int, psz: int):
     pages; unassigned logical pages point at the scratch row).  Attention
     KV lands directly in the pool; recurrent carried state scans a local
     B=1 copy and splices into the engine batch like the dense path.
-    NOTE: recurrent state is position-recurrent from token 0, so chains
-    with recurrent units never take prefix shortcuts — the engine admits
-    them with start=0 (enforced host-side in ``_reserve_pages``)."""
+    ``start`` is either the prefix-cache hit boundary (a page multiple)
+    or a chunked-prefill slice boundary — any earlier position whose KV
+    the slot's pages already hold (docs/serving.md "Overload
+    survival").  NOTE: recurrent state is position-recurrent from token
+    0, so chains with recurrent units never take PREFIX shortcuts — the
+    engine admits them with prefix_start=0 (enforced host-side in
+    ``_reserve_pages``); chunk boundaries instead carry the state
+    across slices (see the in-body comment)."""
     from .generate import _rec_state_init
     attn_keys = plan.attn_keys()
 
@@ -415,7 +483,22 @@ def _make_paged_prefill_fn(plan, ctx, pb: int, psz: int):
                 slot, temp, topk, topp, key_data):
         work = dict(caches)
         for key, u in plan._rec_units:
-            work[key] = _rec_state_init(u, 1)
+            # start == 0 resets the carry in-program (fresh admission);
+            # start > 0 CONTINUES from the slot's batch rows — the
+            # previous chunk's splice — which is what makes chunked
+            # prefill exact for recurrent chains too.  (Prefix-cache
+            # shortcuts still never apply to recurrent chains: the
+            # scheduler admits them with prefix_start = 0, so a start>0
+            # here is always a chunk boundary.)
+            init = _rec_state_init(u, 1)
+            cur = jax.tree.map(
+                lambda big: jax.lax.dynamic_slice(
+                    big, (slot,) + (jnp.int32(0),) * (big.ndim - 1),
+                    (1,) + big.shape[1:]),
+                caches[key])
+            work[key] = jax.tree.map(
+                lambda i, o: jnp.where(start == 0, i, o.astype(i.dtype)),
+                init, cur)
 
         def body(carry, i):
             work = carry
@@ -566,10 +649,12 @@ class _Request:
                  "eos_id", "key_data", "deadline", "done", "result",
                  "error", "submitted_at", "slot", "finished_at",
                  "page_row", "prefix_start", "page_hashes",
-                 "trace_id", "admitted_at", "first_token_at", "bucket")
+                 "trace_id", "admitted_at", "first_token_at", "bucket",
+                 "priority", "gen", "preemptions", "chunk_next",
+                 "chunk_first", "run_started_at", "_eff")
 
     def __init__(self, prompt, n_steps, temperature, top_k, top_p,
-                 eos_id, key_data, deadline):
+                 eos_id, key_data, deadline, priority: int = 0):
         self.prompt = prompt            # (P,) np.int32
         self.n_steps = n_steps
         self.temperature = temperature
@@ -591,14 +676,116 @@ class _Request:
         # request, host timestamps for the queue-wait/prefill/decode
         # span breakdown in GET /trace.json
         self.trace_id = next_trace_id()
-        self.admitted_at = None         # prefill began (left the queue)
+        self.admitted_at = None         # FIRST admission (left the queue)
         self.first_token_at = None      # prefill returned (== TTFT end)
         self.bucket = None              # prefill bucket this request took
+        # overload survival (docs/serving.md "Overload survival"):
+        # request class (0 = highest), tokens already generated before a
+        # preemption (a resume re-prefills prompt + gen so the final
+        # stream is bitwise an uninterrupted run), chunked-prefill
+        # progress, and the latest admission stamp (victim selection
+        # prefers the youngest run — the one losing least progress)
+        self.priority = int(priority)
+        self.gen = np.empty(0, np.int32)
+        self.preemptions = 0
+        self.chunk_next = 0             # next global position to prefill
+        self.chunk_first = 0            # where THIS admission's prefill
+        #                                 began (metric labels use the
+        #                                 whole tail's bucket, not the
+        #                                 final slice's)
+        self.run_started_at = None      # latest admission into a slot
+        self._eff = None                # memoized effective prompt
+
+    @property
+    def end_index(self) -> int:
+        """Global index of the FINAL token (invariant across
+        preemptions: original prompt length + n_steps - 1)."""
+        return int(self.prompt.size) + int(self.n_steps) - 1
+
+    def effective_prompt(self):
+        """What an admission prefills: the original prompt plus every
+        token generated before a preemption."""
+        if self._eff is None:
+            self._eff = (np.concatenate([self.prompt, self.gen])
+                         if self.gen.size else self.prompt)
+        return self._eff
 
     def finish(self, result=None, error=None):
         self.result, self.error = result, error
         self.finished_at = time.monotonic()
         self.done.set()
+
+
+class _PrioQueue:
+    """Strict-priority FIFO over ``priorities`` classes (0 = highest):
+    FIFO within a class, pops always drain the highest class first —
+    the queue-jump half of the priority contract.  NOT thread-safe on
+    its own: every mutation happens under the engine's ``_qlock``; the
+    scheduler's lock-free emptiness peeks read one deque's truthiness
+    at a time (GIL-atomic, the same staleness contract as the single
+    deque this replaces)."""
+
+    __slots__ = ("_qs",)
+
+    def __init__(self, priorities: int):
+        self._qs = [collections.deque()
+                    for _ in range(max(1, int(priorities)))]
+
+    def __len__(self):
+        return sum(len(q) for q in self._qs)
+
+    def __bool__(self):
+        return any(self._qs)
+
+    def __iter__(self):
+        for q in self._qs:
+            yield from q
+
+    def append(self, req):
+        self._qs[req.priority].append(req)
+
+    def appendleft(self, req):
+        self._qs[req.priority].appendleft(req)
+
+    def popleft(self):
+        for q in self._qs:
+            if q:
+                return q.popleft()
+        return None
+
+    def steal_lower(self, priority: int):
+        """Evict and return the youngest NOT-YET-STARTED queued request
+        of the LOWEST class strictly below ``priority``'s (class index
+        strictly greater); None when nothing displaceable is queued —
+        the full-queue queue-jump rule: a high-class arrival displaces
+        the request that would have been served last anyway.  A
+        PREEMPTED resume (``preemptions > 0``) is never displaced: it
+        was accepted, held a slot, and carries committed device work in
+        ``req.gen`` — finishing it with a 429 now would discard all of
+        that and break the acceptance the 200-on-submit implied."""
+        for c in range(len(self._qs) - 1, int(priority), -1):
+            q = self._qs[c]
+            for i in range(len(q) - 1, -1, -1):
+                if q[i].preemptions == 0:
+                    r = q[i]
+                    del q[i]
+                    return r
+        return None
+
+    def remove_if(self, pred):
+        """Remove and return every queued request matching ``pred``
+        (deadline sweeps), preserving order among the kept."""
+        out = []
+        for i, q in enumerate(self._qs):
+            kept = collections.deque()
+            for r in q:
+                (out if pred(r) else kept).append(r)
+            self._qs[i] = kept
+        return out
+
+    def clear(self):
+        for q in self._qs:
+            q.clear()
 
 
 def _sample_slots(logits, keys, temp, top_k, top_p):
@@ -670,14 +857,21 @@ class DecodeEngine(Logger):
                  paged_kernel: Optional[bool] = None,
                  spec: Optional[bool] = None,
                  spec_k: Optional[int] = None,
-                 spec_drafter: Optional[str] = None):
+                 spec_drafter: Optional[str] = None,
+                 priorities: Optional[int] = None,
+                 preempt: Optional[bool] = None,
+                 prefill_chunk: Optional[int] = None,
+                 admission: Optional[AdmissionController] = None):
         self.workflow = workflow
         self.wstate = wstate
         self._init_config(slots=slots, l_max=l_max, window_ms=window_ms,
                           queue_depth=queue_depth, deadline_s=deadline_s,
                           paged=paged, page_size=page_size, pages=pages,
                           paged_kernel=paged_kernel, spec=spec,
-                          spec_k=spec_k, spec_drafter=spec_drafter)
+                          spec_k=spec_k, spec_drafter=spec_drafter,
+                          priorities=priorities, preempt=preempt,
+                          prefill_chunk=prefill_chunk,
+                          admission=admission)
         self.plan = DecodePlan(workflow, output_unit)
         self.cache_dtype = cache_dtype
         self._ctx = Context(train=False, key=None, mesh=None)
@@ -692,7 +886,9 @@ class DecodeEngine(Logger):
     def _init_config(self, *, slots, l_max, window_ms, queue_depth,
                      deadline_s, bucket_min=None, paged=None,
                      page_size=None, pages=None, paged_kernel=None,
-                     spec=None, spec_k=None, spec_drafter=None):
+                     spec=None, spec_k=None, spec_drafter=None,
+                     priorities=None, preempt=None, prefill_chunk=None,
+                     admission=None):
         serve = root.common.serve
         geo = resolve_serve_geometry(slots, l_max, bucket_min,
                                      paged=paged, page_size=page_size,
@@ -710,6 +906,30 @@ class DecodeEngine(Logger):
                                else serve.get("queue_depth", 64))
         self.deadline_s = float(deadline_s if deadline_s is not None
                                 else serve.get("deadline_s", 120.0))
+        # overload survival (docs/serving.md "Overload survival"):
+        # request classes (0 = highest; priorities=1 turns the feature
+        # off), preemption of strictly-lower classes, and chunked
+        # prefill (0 = off; slices of this many tokens interleave with
+        # decode steps so one long prompt costs everyone bounded delay)
+        self.priorities = max(1, int(serve.get("priorities", 3)
+                                     if priorities is None
+                                     else priorities))
+        self.preempt = bool(serve.get("preempt", True)
+                            if preempt is None else preempt)
+        self.prefill_chunk = int(serve.get("prefill_chunk", 256)
+                                 if prefill_chunk is None
+                                 else prefill_chunk)
+        if self.prefill_chunk < 0:
+            raise ValueError(
+                f"prefill_chunk must be >= 0, got {self.prefill_chunk}")
+        # calling-convention / capability flags the ArtifactRunner
+        # overrides from its manifest: whether the prefill programs take
+        # the traced ``start`` (live builders always do; sealed dense
+        # programs from older exports do not) and whether mid-prompt
+        # continuation — chunked prefill — is safe on them
+        self._prefill_start = True
+        self._chunk_capable = True
+        self._admission_arg = admission
         # speculative decoding (docs/serving.md "Speculative decoding"):
         # the host-side drafter proposes up to spec_k tokens per slot
         # and the third program kind verifies them in one call
@@ -775,12 +995,18 @@ class DecodeEngine(Logger):
             self._cow_admissions = 0       # guarded-by: self._page_lock
             self._pool_rejected = 0        # guarded-by: self._page_lock
 
-        # queue + scheduler
-        self._queue: collections.deque = collections.deque()  # guarded-by: self._qlock
+        # queue + scheduler (priority-FIFO: class 0 pops first)
+        self._queue: _PrioQueue = _PrioQueue(self.priorities)  # guarded-by: self._qlock
         self._qlock = threading.Lock()
+        self._shed_by_class: dict = {}  # guarded-by: self._qlock
         self._wake = threading.Event()
         self._stop_evt = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # chunked prefill: slots whose admission is mid-prefill (one
+        # bounded slice per scheduler iteration, interleaved with
+        # decode steps).  Scheduler-thread state like _ptab.
+        self._chunking: set = set()
+        self._qwait_ewma = 0.0          # scheduler-thread-written
 
         # hot-swap double buffer + drain mode (runtime/deploy.py)
         self._swap_lock = threading.Lock()
@@ -806,6 +1032,18 @@ class DecodeEngine(Logger):
         # rolling SLO windows over the request histograms: the scheduler
         # tick rotates the ring (runtime/slo.py)
         self._slo = slo_tracker()
+        # overload reflexes (runtime/admission.py): preemption counter
+        # view + the SLO-driven admission-window controller, whose
+        # sensor is the tracker's windowed burn rate.  Injectable for
+        # deterministic tests (``admission=``).
+        self._preempted = ScopedCounter(self._m_preempt)
+        self._admission = (self._admission_arg
+                           if self._admission_arg is not None
+                           else AdmissionController(
+                               queue_depth=self.queue_depth,
+                               priorities=self.priorities,
+                               burn_fn=self._slo.max_burn,
+                               gauge=self._g_admission))
 
         # head width (== logits' last dim), for the top_k no-op sentinel
         self._vocab = self._head_width(params)
@@ -955,6 +1193,22 @@ class DecodeEngine(Logger):
             "vt_spec_verify_step_seconds",
             "wall time of one speculative verify step (all active "
             "slots score k+1 positions in one call)")
+        # overload survival (docs/serving.md "Overload survival"):
+        # priority preemption volume, shed load by class, and the
+        # admission controller's live window
+        self._m_preempt = reg.counter(
+            "vt_preemptions_total",
+            "slots preempted (retired-and-requeued) so a higher-"
+            "priority request could be admitted")
+        self._m_shed = reg.counter(
+            "vt_shed_total",
+            "requests shed by the admission controller or displaced "
+            "from a hard-full queue by a higher-priority arrival, by "
+            "request class", labels=("priority",))
+        self._g_admission = reg.gauge(
+            "vt_admission_window",
+            "admitted queue window the SLO-driven controller currently "
+            "grants (== serve.queue_depth when fully open)")
 
     def _register_memory(self):  # not-shared: __init__-only construction, precedes any thread
         """Publish this engine's aval-derived byte ledger (runtime/
@@ -1019,6 +1273,10 @@ class DecodeEngine(Logger):
         args = {"id": req.trace_id, "outcome": outcome,
                 "prompt_tokens": int(req.prompt.size),
                 "n_steps": int(req.n_steps)}
+        if req.priority:
+            args["priority"] = int(req.priority)
+        if req.preemptions:
+            args["preemptions"] = int(req.preemptions)
         if req.slot is not None:
             args["slot"] = int(req.slot)
         if req.bucket is not None:
@@ -1085,6 +1343,13 @@ class DecodeEngine(Logger):
                               self._ptab[0], np.zeros((1, pb), np.int32),
                               z32, z32, z32, np.float32(0), z32,
                               np.float32(1), self._keys[0]))
+        if self._prefill_start:
+            return self._sds((params, self._caches, self._toks,
+                              np.zeros((1, pb), np.int32), z32, z32,
+                              z32, np.float32(0), z32, np.float32(1),
+                              self._keys[0]))
+        # sealed dense artifacts from pre-chunking exports: the
+        # whole-prompt calling convention (no traced start)
         return self._sds((params, self._caches, self._toks,
                           np.zeros((1, pb), np.int32), z32, z32,
                           np.float32(0), z32, np.float32(1),
@@ -1130,13 +1395,19 @@ class DecodeEngine(Logger):
     def _bucket(self, p: int) -> int:
         return prefill_bucket(p, self.bucket_min, self.l_max)
 
-    def _prefill_fn(self, pb: int, params):
-        """Fetch/compile the prefill program for bucket length ``pb``."""
+    def _prefill_fn(self, pb: int, params, full_ctx: bool = True):
+        """Fetch/compile the prefill program for bucket length ``pb``.
+        ``full_ctx=False`` (dense only — the paged program always works
+        through the page table) selects the bucket-local fast variant
+        for whole-tail ``start == 0`` admissions; chunk slices need the
+        full-context form (see :func:`make_prefill_fn`)."""
         psz = self.page_size if self.paged else None
+        full_ctx = True if self.paged else bool(full_ctx)
         step, _, _ = self.step_cache.get_step(
-            "prefill", (pb,) + self._geometry_key(),
+            "prefill", (pb, full_ctx) + self._geometry_key(),
             lambda: (make_prefill_fn(self.plan, self._ctx, pb,
-                                     self.cache_dtype, page_size=psz),
+                                     self.cache_dtype, page_size=psz,
+                                     full_ctx=full_ctx),
                      None, None),
             self._prefill_args_sds(params, pb), pin=(self.workflow,))
         return step
@@ -1311,17 +1582,29 @@ class DecodeEngine(Logger):
     def submit(self, prompt, n_steps: int, *, temperature: float = 0.0,
                top_k: Optional[int] = None, top_p: Optional[float] = None,
                eos_id: Optional[int] = None, key=None,
-               deadline_s: Optional[float] = None) -> _Request:
+               deadline_s: Optional[float] = None,
+               priority: int = 0) -> _Request:
         """Enqueue one sequence; returns a request whose ``done`` event
         fires with ``result`` (np.int32, prompt + generated, trimmed at
         eos) or ``error``.  Raises :class:`EngineOverloaded` when the
-        queue is full (the REST layer's 429)."""
+        queue is full or the admission controller shed the request (the
+        REST layer's 429 with an adaptive Retry-After).  ``priority``
+        is the request class, 0 (the default, highest) to
+        ``priorities - 1``: higher classes pop first, may displace a
+        queued lower-class request from a hard-full queue, may preempt
+        a running lower-class slot, and are the last the controller
+        sheds (docs/serving.md "Overload survival")."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("prompt must hold at least one token")
         n_steps = int(n_steps)
         if n_steps < 1:
             raise ValueError("n_steps must be >= 1")
+        priority = int(priority)
+        if not 0 <= priority < self.priorities:
+            raise ValueError(
+                f"priority must be in [0, {self.priorities}) "
+                f"(serve.priorities classes, 0 = highest), got {priority}")
         # same contract as sample_logits: out-of-domain filters must be
         # a loud 400, not a silently-degenerate sentinel (top_k=0 would
         # make the k-th threshold the MAX logit — greedy in disguise)
@@ -1356,7 +1639,8 @@ class DecodeEngine(Logger):
             None if eos_id is None else int(eos_id),
             np.asarray(jax.random.key_data(key)),
             time.monotonic() + (self.deadline_s if deadline_s is None
-                                else float(deadline_s)))
+                                else float(deadline_s)),
+            priority=priority)
         if self.paged:
             # pool backpressure: when slots are free but the PAGES are
             # gone (long prompts at low slot occupancy), admission could
@@ -1379,41 +1663,79 @@ class DecodeEngine(Logger):
                 free_slots = self.slots - int(self._active.sum())
                 pool_bound = (need > avail
                               and free_slots > len(self._queue))
-                if pool_bound:
-                    self._rejected.inc()
+                if pool_bound and self.preempt and any(
+                        r is not None and r.priority > priority
+                        for r in self._slot_req):
+                    # a strictly-lower-class slot is running: the
+                    # scheduler may preempt it to free its pages, so
+                    # queueing is the right answer, not a 429 (the read
+                    # is advisory — a stale view only costs one queued
+                    # wait bounded by the deadline)
+                    pool_bound = False
             if pool_bound:
                 with self._page_lock:
                     self._pool_rejected += 1
+                self._count_shed(priority)
                 self._m_requests.labels(outcome="429").inc()
                 raise EngineOverloaded(
                     f"page pool exhausted ({avail} of {self.pages} "
                     f"pages free, request needs {need} beyond its "
                     "cached prefix)", self._retry_after())
+        evicted = None
         with self._qlock:
-            # overflow decided under the lock; the 429 (which computes
-            # Retry-After by re-taking the lock) raises outside it
-            overloaded = len(self._queue) >= self.queue_depth
+            # admission decided under the lock; the 429 (which computes
+            # Retry-After by re-taking the lock) raises outside it.
+            # The controller's window (priority-scaled) bounds what the
+            # hard queue_depth used to bound alone: under a sustained
+            # SLO burn low classes shed first, then everyone.
+            qlen = len(self._queue)
+            limit = min(self.queue_depth,
+                        self._admission.allowance(priority))
+            overloaded = qlen >= limit
             if overloaded:
-                self._rejected.inc()
-            else:
+                # full — hard depth or a burn-closed admission window —
+                # a higher-class arrival may displace the youngest
+                # queued request of a strictly lower class.  Without
+                # this the window case would invert the priority
+                # contract: a mid-class arrival 429s while
+                # strictly-lower-class requests admitted just before
+                # the window closed keep their spots.  Under ANY shed
+                # the low classes go first, not whoever arrived later;
+                # total queue length never grows (one out, one in).
+                evicted = self._queue.steal_lower(priority)
+                if evicted is not None:
+                    self._queue.append(req)
+                    overloaded = False
+            if not overloaded and evicted is None:
                 self._queue.append(req)
+        if evicted is not None:
+            retry = self._retry_after()
+            self._count_shed(evicted.priority)
+            # _observe_finish below lands the vt_requests_total 429
+            evicted.finish(error=EngineOverloaded(
+                "shed from a full queue by a higher-priority arrival",
+                retry))
+            self._observe_finish(evicted, "429")
         if overloaded:
+            self._count_shed(priority)
             self._m_requests.labels(outcome="429").inc()
             raise EngineOverloaded(
-                f"queue full ({self.queue_depth} pending)",
-                self._retry_after())
+                f"admission window full ({qlen} pending, window "
+                f"{limit} for class {priority} of "
+                f"{self.queue_depth} hard depth)", self._retry_after())
         self._wake.set()
         return req
 
     def generate(self, prompt, n_steps: int, *, temperature: float = 0.0,
                  top_k=None, top_p=None, eos_id=None, key=None,
-                 timeout: Optional[float] = None):
+                 timeout: Optional[float] = None, priority: int = 0):
         """Blocking batch decode with the ``generate()`` contract:
         (B, P) int32 -> (B, P + n_steps) int32, rows past their eos
         padded with ``eos_id``.  Each row rides its own slot; row ``r``
         of a multi-row sampled request draws from ``fold_in(key, r)``
         (single-row requests use ``key`` itself, bitwise-matching
-        ``generate()``)."""
+        ``generate()``).  ``priority`` is the request class
+        (:meth:`submit`)."""
         prompt = np.asarray(prompt, np.int32)
         if prompt.ndim != 2:
             raise ValueError("prompt must be (B, P)")
@@ -1426,7 +1748,8 @@ class DecodeEngine(Logger):
                 rk = key if B == 1 else jax.random.fold_in(key, r)
                 reqs.append(self.submit(
                     prompt[r], n_steps, temperature=temperature,
-                    top_k=top_k, top_p=top_p, eos_id=eos_id, key=rk))
+                    top_k=top_k, top_p=top_p, eos_id=eos_id, key=rk,
+                    priority=priority))
             out = np.full((B, P + n_steps),
                           eos_id if eos_id is not None else 0, np.int32)
             for r, req in enumerate(reqs):
@@ -1595,6 +1918,17 @@ class DecodeEngine(Logger):
             "rejected": self._rejected.n, "timeouts": self._timeouts.n,
             "swaps": self._swaps, "draining": self._draining,
             "scheduler_crashed": self._died,
+            # overload survival (docs/serving.md "Overload survival"):
+            # the controller's live window, preemption volume, and shed
+            # counts by request class
+            "admission": {
+                **self._admission.state(),
+                "priorities": self.priorities,
+                "preempt": self.preempt,
+                "prefill_chunk": self.prefill_chunk,
+                "preemptions": self._preempted.n,
+                "shed_by_class": self._shed_snapshot(),
+            },
             "compile": self.step_cache.stats(),
             **({"spec": {
                 "k": self.spec_k, "drafter": self.spec_drafter,
@@ -1612,32 +1946,69 @@ class DecodeEngine(Logger):
             },                              # the process ledger's
         }
 
+    def _count_shed(self, priority: int):
+        """One shed, every ledger in lockstep: the engine's rejected
+        counter, the per-class stats snapshot, and the
+        ``vt_shed_total`` series — the three paths that shed (pool
+        429, admission-window 429, hard-full displacement) must never
+        drift apart on these.  ``vt_requests_total{outcome="429"}`` is
+        deliberately NOT counted here: displacement routes it through
+        ``_observe_finish`` (the request finishes), the raise paths
+        count it at the raise site (no request object ever finishes).
+        Takes ``_qlock`` — call outside it."""
+        self._rejected.inc()
+        self._m_shed.labels(priority=str(priority)).inc()
+        with self._qlock:
+            self._shed_by_class[priority] = \
+                self._shed_by_class.get(priority, 0) + 1
+
+    def _shed_snapshot(self) -> dict:
+        """Per-class shed counts as a JSON-able dict (one consistent
+        copy under the queue lock)."""
+        with self._qlock:
+            return {str(k): v
+                    for k, v in sorted(self._shed_by_class.items())}
+
     # -- scheduler ----------------------------------------------------------
     def _retry_after(self) -> float:
-        """429 Retry-After estimate: queued decode work over recent
-        throughput (floor 1s).  Takes the queue lock itself — callers
-        raise their 429 AFTER releasing it (iterating the deque while
-        submit threads append was a mutation-during-iteration crash
-        waiting for load; veles-tpu-lint VC201)."""
+        """429 Retry-After estimate, derived from actual congestion so
+        clients back off proportionally (the honest-shedding half of
+        the overload contract): queued decode work over recent
+        throughput, floored by the queue-wait EWMA current admissions
+        are really paying, scaled by how far the admission controller
+        has closed the window (a half-closed window doubles the hint).
+        Bounded to [1, 60] seconds.  Takes the queue lock itself —
+        callers raise their 429 AFTER releasing it (iterating the
+        queue while submit threads append was a mutation-during-
+        iteration crash waiting for load; veles-tpu-lint VC201)."""
         with self._qlock:
             queued = sum(r.n_steps for r in self._queue) or 1
         rate = max(self._tokens_per_sec, 1.0)
-        return min(60.0, max(1.0, queued / rate))
+        est = max(queued / rate, self._qwait_ewma)
+        est *= self._admission.backoff_factor()
+        return min(60.0, max(1.0, est))
 
     def _loop(self):
         from . import faults
         try:
             while not self._stop_evt.is_set():
                 self._maybe_report()
-                # lint: disable=VC201 bool(deque) is atomic under the
-                # GIL; a stale wakeup read only costs one 50ms tick
-                if faults.enabled() and (self._queue
-                                         or self._active.any()):
-                    # injected crash point (tests/test_faults.py): fire
-                    # only with work pending so the crash exercises the
-                    # fail-all path, and only once per arming
-                    if faults.get_plan().scheduler_crash \
+                if faults.enabled():
+                    plan = faults.get_plan()
+                    if plan.admission_burst \
+                            and faults.fire_once("admission_burst"):
+                        # synthetic queue flood (runtime/faults.py):
+                        # the controller-shed rehearsal's backlog
+                        self._inject_burst(int(plan.admission_burst))
+                    # lint: disable=VC201 bool(deque) is atomic under
+                    # the GIL; a stale wakeup read only costs one 50ms
+                    # tick
+                    if (self._queue or self._active.any()) \
+                            and plan.scheduler_crash \
                             and faults.fire_once("scheduler_crash"):
+                        # injected crash point (tests/test_faults.py):
+                        # fire only with work pending so the crash
+                        # exercises the fail-all path, once per arming
                         raise faults.FaultInjected(
                             "injected decode-scheduler crash")
                 # decode-step boundary: no program is running right now,
@@ -1645,17 +2016,24 @@ class DecodeEngine(Logger):
                 self._apply_swap()
                 # lint: disable=VC201 bool(deque) is atomic under the
                 # GIL; a stale wakeup read only costs one 50ms tick
-                if not self._active.any() and not self._queue:
+                if not self._active.any() and not self._queue \
+                        and not self._chunking:
                     self._wake.wait(timeout=0.05)
                     self._wake.clear()
                     continue
-                if not self._active.any() and self.window_s > 0:
+                if not self._active.any() and not self._chunking \
+                        and self.window_s > 0:
                     # batching window: concurrent arrivals get admitted
                     # together and share the first decode steps instead
                     # of the first request racing its slot ahead
                     time.sleep(self.window_s)
                 self._expire_queue()
                 self._admit()  # mid-flight too: no drain barrier
+                # chunked prefill: ONE bounded slice per mid-prefill
+                # slot per iteration, so a long prompt and the decode
+                # step below take turns instead of the prompt
+                # monopolizing the scheduler for its whole length
+                self._advance_prefills()
                 if self._active.any():
                     self._advance_once()
                 self._maybe_report()
@@ -1696,6 +2074,7 @@ class DecodeEngine(Logger):
                 self._slot_req[s] = None
                 self._observe_finish(req, outcome)
             self._release_slot_pages(s)
+        self._chunking.clear()
         self._active[:] = False
 
     def _expire_queue(self):
@@ -1707,26 +2086,107 @@ class DecodeEngine(Logger):
         with self._qlock:
             if self._queue and any(now > r.deadline
                                    for r in self._queue):
-                keep = collections.deque()
-                for r in self._queue:
-                    (expired if now > r.deadline else keep).append(r)
-                self._queue = keep
+                expired = self._queue.remove_if(
+                    lambda r: now > r.deadline)
         for r in expired:
             self._timeouts.inc()
             r.finish(error=TimeoutError(
                 "request deadline expired while queued"))
             self._observe_finish(r, "504")
 
+    def _free_slot(self) -> Optional[int]:
+        """A slot that is neither decoding nor mid-(chunked-)prefill —
+        ``_slot_req`` is the occupancy truth; ``_active`` alone would
+        hand a chunking slot to a second request."""
+        for s in range(self.slots):
+            if not self._active[s] and self._slot_req[s] is None:
+                return s
+        return None
+
+    def _pick_victim(self, priority: int) -> Optional[int]:
+        """Preemption victim for an arrival of class ``priority``: the
+        occupied slot of the LOWEST class strictly below it (largest
+        class index), youngest run among ties (latest admission — the
+        one losing the least progress).  None when preemption is off or
+        nothing strictly lower is running."""
+        if not self.preempt:
+            return None
+        best, best_key = None, None
+        for s in range(self.slots):
+            req = self._slot_req[s]
+            if req is None or req.priority <= priority:
+                continue
+            k = (req.priority, req.run_started_at or 0.0)
+            if best is None or k > best_key:
+                best, best_key = s, k
+        return best
+
+    def _preempt_can_free(self, req) -> bool:
+        """Upper-bound feasibility of preempting for pages: could
+        evicting EVERY strictly-lower-class slot possibly free enough
+        pages for ``req``?  A victim's distinct mapped pages bound
+        what its release can return (shared-prefix pages stay
+        referenced elsewhere), so False means preemption can never
+        satisfy the need — requeue instead of futilely mass-evicting
+        victims that each lose their progress.  Scheduler thread only
+        (``_ptab``/``_slot_req`` are its state)."""
+        eff = req.effective_prompt()
+        P = int(eff.size)
+        need = self._page_span(P, req.end_index - P + 1)
+        hashes = req.page_hashes or self._prefix_hashes(eff)
+        reclaimable = set()
+        for s in range(self.slots):
+            r = self._slot_req[s]
+            if r is not None and r.priority > req.priority:
+                reclaimable.update(
+                    int(p) for p in np.unique(self._ptab[s])
+                    if p != self._scratch)
+        with self._page_lock:
+            need -= self._prefix_hits_locked(hashes, P)
+            avail = self.pages - int(np.count_nonzero(self._page_ref))
+        return need <= avail + len(reclaimable)
+
+    def _preempt(self, slot: int):
+        """Retire-and-requeue the slot so a higher-priority request can
+        take its place: harvest the tokens generated so far into
+        ``req.gen`` (a later resume re-prefills prompt + gen, so the
+        final stream is bitwise an uninterrupted run — the prefill's
+        sampling-key folds are global-position), release the refcounted
+        KV pages, and put the victim back at the FRONT of its own
+        class.  Scheduler thread only."""
+        req = self._slot_req[slot]
+        if self._active[slot]:
+            eff_len = int(req.prompt.size) + int(req.gen.size)
+            pos = int(self._pos[slot])
+            fresh = np.asarray(self._toks[slot, eff_len:pos + 1],
+                               np.int32)
+            if fresh.size:
+                req.gen = np.concatenate([req.gen, fresh])
+        self._active[slot] = False
+        self._chunking.discard(slot)
+        self._slot_req[slot] = None
+        self._release_slot_pages(slot)
+        req.slot = None
+        req.page_row = None
+        req.prefix_start = 0
+        req.page_hashes = ()
+        req.chunk_next = 0
+        req._eff = None                 # prompt grew by the harvest
+        req.preemptions += 1
+        self._preempted.inc()
+        with self._qlock:
+            self._queue.appendleft(req)
+
     def _admit(self) -> int:
         """Move queued requests into free slots (prefill); returns the
-        number admitted.  Runs on the scheduler thread only."""
+        number admitted.  Runs on the scheduler thread only.  When the
+        head of the queue outranks a running slot and no capacity is
+        free — slots, or pages under the paged layout — the scheduler
+        may preempt (docs/serving.md "Overload survival")."""
         n = 0
         while True:
-            free = np.flatnonzero(~self._active)
-            if not len(free):
-                return n
             with self._qlock:
-                req = self._queue.popleft() if self._queue else None
+                req = self._queue.popleft()
             if req is None:
                 return n
             now = time.monotonic()
@@ -1736,15 +2196,59 @@ class DecodeEngine(Logger):
                     "request deadline expired while queued"))
                 self._observe_finish(req, "504")
                 continue
-            if self.paged and not self._reserve_pages(req):
-                # the pool cannot host it right now: requeue at the
-                # FRONT (FIFO) and stop admitting — pages free as slots
-                # retire, deadlines bound the wait
-                with self._qlock:
-                    self._queue.appendleft(req)
+            slot = self._free_slot()
+            if slot is None:
+                victim = self._pick_victim(req.priority)
+                if victim is None or (
+                        self.paged and not self._preempt_can_free(req)):
+                    # no capacity and nothing preemptible — or, on the
+                    # paged layout, preemption could free the SLOT but
+                    # provably never enough PAGES (the same feasibility
+                    # bound the page-reservation loop below applies): a
+                    # victim evicted here would lose its progress to a
+                    # full re-prefill for an admission that still
+                    # cannot happen.  Requeue at the FRONT of its class
+                    # and stop admitting.
+                    with self._qlock:
+                        self._queue.appendleft(req)
+                    return n
+                self._preempt(victim)
+                slot = self._free_slot()
+            ok = True
+            while self.paged and not self._reserve_pages(req):
+                # the pool cannot host it right now: preempt a strictly
+                # lower class to free its pages, else requeue — pages
+                # free as slots retire, deadlines bound the wait.  But
+                # only preempt when preemption can plausibly SATISFY
+                # the need: mass-evicting every lower slot (each losing
+                # its progress to a full re-prefill) for a request the
+                # pool still cannot host would be pure waste
+                victim = self._pick_victim(req.priority)
+                if victim is None or not self._preempt_can_free(req):
+                    with self._qlock:
+                        self._queue.appendleft(req)
+                    ok = False
+                    break
+                self._preempt(victim)
+            if not ok:
                 return n
-            self._prefill(int(free[0]), req)
+            self._prefill(int(slot), req)
             n += 1
+
+    def _inject_burst(self, n: int):
+        """``faults.admission_burst``: append ``n`` synthetic minimal
+        lowest-class requests straight to the queue — deliberately
+        bypassing submit()'s shed gate, because the rehearsal is "the
+        backlog already exists; prove the controller sheds and
+        re-opens" (tests/test_chaos.py).  Nobody waits on their done
+        events; they decode and retire like any request."""
+        kd = np.asarray(jax.random.key_data(jax.random.key(0)))
+        for _ in range(int(n)):
+            r = _Request(np.asarray([0], np.int32), 2, 0.0, None, None,
+                         None, kd, time.monotonic() + self.deadline_s,
+                         priority=self.priorities - 1)
+            with self._qlock:
+                self._queue.append(r)
 
     # -- page pool (scheduler thread owns mutation; _page_lock guards the
     # cross-thread reads in submit() and stats()) ---------------------------
@@ -1795,14 +2299,19 @@ class DecodeEngine(Logger):
         refcount++), fresh pages for the rest of its worst-case span.
         On success ``req.page_row`` / ``req.prefix_start`` /
         ``req.page_hashes`` are set; on shortage every side effect is
-        rolled back and False is returned (the caller requeues)."""
+        rolled back and False is returned (the caller requeues).  A
+        preemption resume reserves for its EFFECTIVE prompt (original +
+        generated-so-far) and the steps still owed — the same total
+        span the uninterrupted run held."""
         psz = self.page_size
-        P = int(req.prompt.size)
-        need = self._page_span(P, req.n_steps)
+        eff = req.effective_prompt()
+        P = int(eff.size)
+        need = self._page_span(P, req.end_index - P + 1)
         full = P // psz                          # whole-prompt pages
         # submit() already hashed the prompt; () is also legitimate
-        # (short prompt / prefix reuse off) and free to recompute
-        hashes = req.page_hashes or self._prefix_hashes(req.prompt)
+        # (short prompt / prefix reuse off / a preemption resume, whose
+        # effective prompt grew) and free to recompute
+        hashes = req.page_hashes or self._prefix_hashes(eff)
         with self._page_lock:
             hits = self._prefix_hits_locked(hashes, P)
             row = np.full(self.n_ptab, self._scratch, np.int32)
@@ -1866,7 +2375,7 @@ class DecodeEngine(Logger):
         partial tail or generated tokens stay private (their content is
         not a pure function of a whole-page prompt prefix)."""
         psz = self.page_size
-        full = int(req.prompt.size) // psz
+        full = int(req.effective_prompt().size) // psz
         hits = req.prefix_start // psz
         with self._page_lock:
             for i in range(hits, min(full, len(req.page_hashes))):
@@ -1897,68 +2406,154 @@ class DecodeEngine(Logger):
             self._ptab[slot] = self._scratch
 
     def _prefill(self, slot: int, req: _Request):
+        """Admit ``req`` into ``slot``.  Short tails prefill in one
+        program call; a tail longer than ``prefill_chunk`` instead
+        REGISTERS the slot for chunked prefill — one bounded slice per
+        scheduler iteration, interleaved with decode steps — so a long
+        prompt costs everyone bounded latency instead of a monopolized
+        scheduler (docs/serving.md "Overload survival")."""
         # reserve the slot BEFORE the device program runs: between the
         # queue pop and _active[slot] going true the request must stay
         # visible to drain()'s idleness check (and to _fail_all)
         self._slot_req[slot] = req
         req.slot = slot
-        req.admitted_at = time.monotonic()
-        self._m_queue_wait.observe(req.admitted_at - req.submitted_at)
+        now = time.monotonic()
+        req.run_started_at = now
+        if req.admitted_at is None:
+            # first admission only: a preemption resume is not a fresh
+            # queue wait (its wait was already observed once)
+            req.admitted_at = now
+            wait = now - req.submitted_at
+            self._m_queue_wait.observe(wait)
+            self._qwait_ewma = wait if self._qwait_ewma <= 0 \
+                else 0.9 * self._qwait_ewma + 0.1 * wait
+            self._admitted.inc()
+        eff = req.effective_prompt()
+        P = int(eff.size)
+        # the bucket is sized by the UN-SHARED tail: a prefix-cache hit
+        # turns a long prompt into a short prefill
+        start = req.prefix_start if self.paged else 0
+        req.chunk_first = start
+        if self.paged:
+            self._ptab[slot] = req.page_row
+        if self.prefill_chunk > 0 and self._chunk_capable \
+                and P - start > self.prefill_chunk:
+            req.chunk_next = start
+            self._chunking.add(slot)
+            return
+        self._prefill_call(slot, req, eff, start, P - start, last=True)
+
+    def _advance_prefills(self):
+        """One chunk slice per mid-prefill slot (scheduler thread):
+        the long-prompt/decode interleave, plus the mid-prefill
+        deadline sweep (a chunking slot is neither queued nor active,
+        so neither other sweep would ever fail it)."""
+        for slot in sorted(self._chunking):
+            req = self._slot_req[slot]
+            if req is None:             # defensive: state went away
+                self._chunking.discard(slot)
+                continue
+            if time.monotonic() > req.deadline:
+                self._chunking.discard(slot)
+                self._slot_req[slot] = None
+                self._release_slot_pages(slot)
+                self._timeouts.inc()
+                req.finish(error=TimeoutError(
+                    "request deadline expired mid-prefill"))
+                self._observe_finish(req, "504")
+                continue
+            eff = req.effective_prompt()
+            P = int(eff.size)
+            cur = req.chunk_next
+            n = min(self.prefill_chunk, P - cur)
+            last = cur + n >= P
+            self._prefill_call(slot, req, eff, cur, n, last=last)
+            req.chunk_next = cur + n
+            if last:
+                self._chunking.discard(slot)
+
+    def _prefill_call(self, slot: int, req: _Request, eff, start: int,
+                      new_len: int, *, last: bool):
+        """ONE prefill program call over ``eff[start:start+new_len]``
+        (an unchunked admission, or one chunk slice).  ``last`` runs
+        the admission bookkeeping: the call's sampled token is the
+        request's next real token exactly when the slice ends at the
+        prompt end — intermediate slices' samples land at positions
+        nothing reads."""
         params = self.wstate["params"]
-        P = int(req.prompt.size)
+        pb = self._bucket(new_len)
         temp = np.float32(req.temperature)
         # sentinels: see _sample_slots
         topk = np.int32(req.top_k if req.top_k is not None
                         else self._vocab)
         topp = np.float32(req.top_p if req.top_p is not None else 1.0)
+        padded = np.zeros((1, pb), np.int32)
+        padded[0, :new_len] = eff[start:start + new_len]
+        # chunk slices (and their finals) continue from earlier
+        # positions and need the full-context program; a whole-tail
+        # admission at start == 0 takes the bucket-local fast variant
+        fn = self._prefill_fn(pb, params,
+                              full_ctx=(start > 0 or not last))
         if self.paged:
-            # the bucket is sized by the UN-SHARED tail: a prefix-cache
-            # hit turns a long prompt into a short prefill
-            start = req.prefix_start
-            new_len = P - start
-            pb = self._bucket(new_len)
-            self._ptab[slot] = req.page_row
-            padded = np.zeros((1, pb), np.int32)
-            padded[0, :new_len] = req.prompt[start:]
-            fn = self._prefill_fn(pb, params)
             self._caches, self._toks, first = fn(
                 params, self._caches, self._toks, req.page_row, padded,
                 np.int32(new_len), np.int32(start), np.int32(slot),
                 temp, topk, topp, req.key_data)
-            self._register_prefix_pages(req)
-        else:
-            pb = self._bucket(P)
-            fn = self._prefill_fn(pb, params)
-            padded = np.zeros((1, pb), np.int32)
-            padded[0, :P] = req.prompt
+        elif self._prefill_start:
             self._caches, self._toks, first = fn(
-                params, self._caches, self._toks, padded, np.int32(P),
-                np.int32(slot), temp, topk, topp, req.key_data)
+                params, self._caches, self._toks, padded,
+                np.int32(new_len), np.int32(start), np.int32(slot),
+                temp, topk, topp, req.key_data)
+        else:
+            # sealed dense artifacts from pre-chunking exports: the
+            # whole-prompt calling convention (start is always 0 and
+            # chunking is gated off by _chunk_capable)
+            self._caches, self._toks, first = fn(
+                params, self._caches, self._toks, padded,
+                np.int32(new_len), np.int32(slot), temp, topk, topp,
+                req.key_data)
+        if not last:
+            return
+        if self.paged:
+            self._register_prefix_pages(req)
         first = int(first)
         # int(first) above synced on the prefill result, so this is the
         # honest host-side time-to-first-token boundary
-        req.first_token_at = time.monotonic()
-        req.bucket = pb
-        self._m_prefill.labels(bucket=pb).observe(
-            req.first_token_at - req.admitted_at)
-        self._m_ttft.labels(bucket=pb).observe(
-            req.first_token_at - req.submitted_at)
+        now = time.monotonic()
+        # metric label: the bucket of the WHOLE tail this admission
+        # prefilled, not the final slice's — a chunked 8k prompt whose
+        # last slice fit bucket 16 must not land its multi-second
+        # duration in the small-prefill latency series (for unchunked
+        # calls the slice IS the whole tail, so the label is ``pb``)
+        lab = self._bucket(max(1, start + new_len - req.chunk_first))
+        req.bucket = lab
+        self._m_prefill.labels(bucket=lab).observe(
+            now - req.run_started_at)
+        if req.first_token_at is None:
+            # chunked or not, preempted-before-first-token or not: TTFT
+            # is observed exactly once, at the ACTUAL first token
+            req.first_token_at = now
+            self._m_ttft.labels(bucket=lab).observe(
+                now - req.submitted_at)
+        P = int(eff.size)
         self._pos[slot] = P
         self._temp[slot] = temp
         self._topk[slot] = topk
         self._topp[slot] = topp
         self._eos[slot] = -1 if req.eos_id is None else req.eos_id
-        self._end[slot] = P + req.n_steps - 1
+        # the FINAL token index is invariant across preemptions:
+        # original prompt + n_steps, however much of it already sits in
+        # req.gen
+        self._end[slot] = req.end_index
         self._keys[slot] = req.key_data
         if self.spec:
-            # drafter history: the full prompt (paged prefills never
-            # write the shared prompt region of _toks) + the first token
-            self._hist[slot, :P] = req.prompt
+            # drafter history: the full effective prompt (prefills never
+            # write the prompt region of _toks) + the first token
+            self._hist[slot, :P] = eff
             self._hist[slot, P] = first
             self._hist_pos[slot] = P
-        self._admitted.inc()
         self._tok_count.inc()
-        done = (req.n_steps == 1
+        done = (P >= req.end_index
                 or (req.eos_id is not None and first == req.eos_id))
         self._active[slot] = not done
         if done:
@@ -2105,7 +2700,17 @@ class DecodeEngine(Logger):
                 self._observe_finish(req, "504")
 
     def _step_once(self):
+        from . import faults
         t0 = time.monotonic()
+        if faults.enabled():
+            plan = faults.get_plan()
+            if plan.decode_stall_ms \
+                    and faults.fire_once("decode_stall"):
+                # injected tail-latency spike (runtime/faults.py): one
+                # artificially slow decode step, inside the timed
+                # window so it lands in vt_decode_step_seconds and the
+                # wall EWMAs exactly like a real stall would
+                time.sleep(plan.decode_stall_ms / 1e3)
         args = (self.wstate["params"], self._caches, self._toks)
         if self.paged:
             args += (self._ptab,)
@@ -2188,14 +2793,14 @@ class DecodeEngine(Logger):
         self._release_slot_pages(slot)
         if req is None:
             return
-        # paged prefill never writes the (possibly shared) prompt region
-        # of the token row, so assemble from the request's own prompt —
-        # identical bytes on the dense path, where toks[:P] IS the prompt
-        P = int(req.prompt.size)
+        # prefill never writes the (possibly shared) prompt region of
+        # the token row, so assemble from the request's own prompt +
+        # whatever a preemption already harvested + this run's tokens
+        P = int(req.prompt.size) + int(req.gen.size)
         gen = np.asarray(self._toks[slot, P:int(self._pos[slot]) + 1],
                          np.int32)
         self._retired.inc()
-        req.finish(result=np.concatenate([req.prompt, gen]))
+        req.finish(result=np.concatenate([req.prompt, req.gen, gen]))
         self._observe_finish(req, "ok")
 
     def _maybe_report(self):
@@ -2207,6 +2812,10 @@ class DecodeEngine(Logger):
         # boots status-less) — while the per-decode-step hot path never
         # pays the O(pages) pool summary.
         self._slo.tick()
+        # the admission controller evaluates on the same heartbeat
+        # (internally rate-limited to serve.admission.interval_s): its
+        # sensor is the ring the line above just rotated
+        self._admission.tick()
         now = time.monotonic()
         if now - self._status_mark < 0.5:
             return

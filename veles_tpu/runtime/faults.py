@@ -38,6 +38,18 @@ node in production, so the :func:`enabled` fast path is one falsy check):
     truthy.  The decode-engine scheduler loop raises
     :class:`FaultInjected` (once) at its next iteration with pending
     work — exercises the fail-all-loudly crash path.
+``decode_stall_ms``
+    float.  The next decode step sleeps this long before dispatching
+    (once per arming) — one artificially slow step, the SLO-burn /
+    tail-latency rehearsal the admission controller's tests drive
+    (docs/serving.md "Overload survival").
+``admission_burst``
+    int.  The decode-engine scheduler injects this many synthetic
+    minimal lowest-priority requests straight into its own queue (once
+    per arming) — a queue flood that deliberately bypasses ``submit``'s
+    shed gate, because the rehearsal is "the backlog already exists;
+    prove the controller sheds and then re-opens"
+    (tests/test_chaos.py).
 """
 
 from __future__ import annotations
@@ -74,7 +86,8 @@ class FaultPlan:
     """Immutable snapshot of the armed injection points."""
 
     __slots__ = ("nan_grad_at_step", "loader_ioerror_at_batch",
-                 "truncate_snapshot", "slow_batch_ms", "scheduler_crash")
+                 "truncate_snapshot", "slow_batch_ms", "scheduler_crash",
+                 "decode_stall_ms", "admission_burst")
 
     def __init__(self, cfg):
         get = cfg.get
@@ -84,11 +97,14 @@ class FaultPlan:
         self.truncate_snapshot = bool(get("truncate_snapshot", False))
         self.slow_batch_ms = float(get("slow_batch_ms", 0.0) or 0.0)
         self.scheduler_crash = bool(get("scheduler_crash", False))
+        self.decode_stall_ms = float(get("decode_stall_ms", 0.0) or 0.0)
+        self.admission_burst = int(get("admission_burst", 0) or 0)
 
     def __bool__(self) -> bool:
         return bool(self.nan_grad_at_step or self.loader_ioerror_at_batch
                     or self.truncate_snapshot or self.slow_batch_ms
-                    or self.scheduler_crash)
+                    or self.scheduler_crash or self.decode_stall_ms
+                    or self.admission_burst)
 
     def __repr__(self) -> str:
         armed = {k: getattr(self, k) for k in self.__slots__

@@ -79,8 +79,17 @@ def _rec_state_init(u, B: int) -> dict:
     return st
 
 
-def _rec_decode_step(u, params, st, x_t):
-    """One recurrent step via the training scan's own cell functions."""
+def _rec_decode_step(u, params, st, x_t, write_ok=None):
+    """One recurrent step via the training scan's own cell functions.
+
+    ``write_ok`` (B,) bool freezes masked-off rows' carried state: a
+    cell iteration is NOT idempotent (h moves every call, unlike a KV
+    rewrite), so without the gate an engine decode step would advance
+    the carry of an inactive slot — harmless for a retired slot, but a
+    slot mid-CHUNKED-prefill continues its next slice from these very
+    rows (runtime/engine.py), and a stale-token advance between slices
+    would corrupt that continuation.  Active rows' math is untouched
+    (the select passes their fresh h through bitwise)."""
     from ..ops import recurrent as rec_ops
     from ..units.recurrent import GRU, LSTM, RNN
     if isinstance(u, LSTM):
@@ -88,15 +97,22 @@ def _rec_decode_step(u, params, st, x_t):
                                  params["b"],
                                  compute_dtype=u.compute_dtype,
                                  forget_bias=u.forget_bias)
+        if write_ok is not None:
+            h = jnp.where(write_ok[:, None], h, st["h"])
+            c = jnp.where(write_ok[:, None], c, st["c"])
         return h, {"h": h, "c": c}
     if isinstance(u, GRU):
         h = rec_ops.gru_cell(x_t, st["h"], params["w"], params["b"],
                              compute_dtype=u.compute_dtype)
+        if write_ok is not None:
+            h = jnp.where(write_ok[:, None], h, st["h"])
         return h, {"h": h}
     assert isinstance(u, RNN)
     act = {"tanh": jnp.tanh, "relu": jax.nn.relu}[u.activation]
     h = rec_ops.rnn_cell(x_t, st["h"], params["w"], params["b"],
                          activation=act, compute_dtype=u.compute_dtype)
+    if write_ok is not None:
+        h = jnp.where(write_ok[:, None], h, st["h"])
     return h, {"h": h}
 
 
@@ -118,8 +134,8 @@ def _rope_rows(x, pos):
     return out.reshape(B, T, H, D)
 
 
-def _attn_decode_step(u, params, cache, x_t, pos, pages=None, *,
-                      paged_kernel=False):
+def _attn_decode_step(u, params, cache, x_t, pos, pages=None,
+                      write_ok=None, *, paged_kernel=False):
     """One-position attention against the cache.
 
     x_t: (B, E) activation at position ``pos``; cache k/v: (B, L, Hk, Dh).
@@ -134,10 +150,20 @@ def _attn_decode_step(u, params, cache, x_t, pos, pages=None, *,
     (runtime/engine.py): ``(ptab, page_size, write_ok)`` where the cache
     k/v are a flat page pool ``(pages + 1, page_size, Hk, Dh)`` (last
     row = scratch), ``ptab`` (B, n_ptab) int32 maps each row's logical
-    pages to physical pool rows, and ``write_ok`` (B,) bool routes
-    masked-off rows' KV writes to the scratch page (an inactive slot's
-    pages may already belong to ANOTHER slot — its write must land
-    nowhere real).  The attention itself is unchanged: the gathered
+    pages to physical pool rows, and the tuple's ``write_ok`` (B,) bool
+    routes masked-off rows' KV writes to the scratch page (an inactive
+    slot's pages may already belong to ANOTHER slot — its write must
+    land nowhere real).
+
+    The standalone ``write_ok`` parameter is the DENSE per-row
+    counterpart (ignored when ``pages`` is given): masked-off rows'
+    KV scatters are dropped outright.  A retired slot's rewrite used to
+    be idempotent (same token, same position, same values), but a slot
+    mid-CHUNKED-prefill holds a stale position over cache rows its
+    slices are actively filling — an unmasked write there would clobber
+    real prefilled KV (runtime/engine.py "Overload survival").  Active
+    rows scatter exactly as before, bitwise.  The attention itself is
+    unchanged: the gathered
     per-row view ``pool[ptab]`` reshapes to the same (B, L, Hk, Dh)
     logical cache the dense path reads, so tokens stay bitwise
     identical — page indirection is traced data flow, never new
@@ -218,8 +244,16 @@ def _attn_decode_step(u, params, cache, x_t, pos, pages=None, *,
                             new_cache={"k": ck, "v": cv})
     if per_row:
         rows = jnp.arange(B)
-        ck = cache["k"].at[rows, pos].set(k[:, 0].astype(cache["k"].dtype))
-        cv = cache["v"].at[rows, pos].set(v[:, 0].astype(cache["v"].dtype))
+        # masked-off rows scatter at L (one past the cache) and are
+        # DROPPED — the dense analogue of the paged scratch row; active
+        # rows' indices and values are untouched, so their writes stay
+        # bitwise the unmasked program's
+        wpos = pos if write_ok is None else \
+            jnp.where(write_ok, pos, cache["k"].shape[1])
+        ck = cache["k"].at[rows, wpos].set(
+            k[:, 0].astype(cache["k"].dtype), mode="drop")
+        cv = cache["v"].at[rows, wpos].set(
+            v[:, 0].astype(cache["v"].dtype), mode="drop")
     else:
         ck = jax.lax.dynamic_update_slice_in_dim(
             cache["k"], k.astype(cache["k"].dtype), pos, axis=1)
@@ -427,7 +461,7 @@ class DecodePlan:
         return caches
 
     def step(self, params, caches, tok, pos, ctx: Context, pages=None,
-             *, paged_kernel=False):
+             write_ok=None, *, paged_kernel=False):
         """One decode position: token ids (B,) -> (logits (B, V), caches).
         O(L) attention per layer via the cache.
 
@@ -440,12 +474,22 @@ class DecodePlan:
 
         ``pages`` = (ptab, page_size, write_ok) selects the paged KV
         layout for every attention unit (see :func:`_attn_decode_step`);
-        it rides the per-row path only.  ``paged_kernel`` (static,
-        keyword-only) additionally routes the paged read side through
-        the fused Pallas paged-attention kernel — bounded-error, see
-        :func:`_attn_decode_step`."""
+        it rides the per-row path only.  ``write_ok`` (B,) bool is the
+        DENSE layout's write mask — masked-off rows' KV scatters are
+        dropped and their recurrent carry is frozen, so an inactive
+        slot (retired, or mid-chunked-prefill with its rows being
+        filled by slices) provably leaves no trace in the caches.  On
+        the paged layout the tuple's own ``write_ok`` serves both
+        roles, so pass one or the other, never both.  ``paged_kernel``
+        (static, keyword-only) additionally routes the paged read side
+        through the fused Pallas paged-attention kernel —
+        bounded-error, see :func:`_attn_decode_step`."""
         x = jnp.take(params[self.embedding.name]["table"],
                      tok.astype(jnp.int32), axis=0)      # (B, E)
+        # ONE carry/write mask, whichever layout supplied it: the
+        # recurrent state is batch-laid-out regardless of how the KV
+        # cache is stored, so the paged tuple's mask gates it too
+        carry_ok = pages[2] if pages is not None else write_ok
 
         def run_pointwise(u, p, x):
             from ..parallel.moe import moe_apply
@@ -467,11 +511,11 @@ class DecodePlan:
                 u = payload
                 x, caches[u.name] = _attn_decode_step(
                     u, params[u.name], caches[u.name], x, pos, pages,
-                    paged_kernel=paged_kernel)
+                    write_ok, paged_kernel=paged_kernel)
             elif kind == "recurrent":
                 u = payload
                 x, caches[u.name] = _rec_decode_step(
-                    u, params[u.name], caches[u.name], x)
+                    u, params[u.name], caches[u.name], x, carry_ok)
             elif kind == "pointwise":
                 u = payload
                 x = run_pointwise(u, params.get(u.name, {}), x)
@@ -485,12 +529,13 @@ class DecodePlan:
                         key = f"{stack.name}/s{i}/{su.name}"
                         x, caches[key] = _attn_decode_step(
                             su, sp[f"s{i}"][su.name], caches[key], x, pos,
-                            pages, paged_kernel=paged_kernel)
+                            pages, write_ok, paged_kernel=paged_kernel)
                     elif h[0] == "recurrent":
                         _, su, i = h
                         key = f"{stack.name}/s{i}/{su.name}"
                         x, caches[key] = _rec_decode_step(
-                            su, sp[f"s{i}"][su.name], caches[key], x)
+                            su, sp[f"s{i}"][su.name], caches[key], x,
+                            carry_ok)
                     else:
                         _, su, i = h
                         x = run_pointwise(

@@ -26,7 +26,13 @@ Pass ``engine=`` (a started or startable
 program set is fixed for the engine lifetime, queue overflow — and,
 under the paged KV cache, PAGE-POOL exhaustion at low slot occupancy —
 answers **429 with a Retry-After header** (the backpressure contract of
-docs/serving.md), and GET /engine exposes the live gauges, including
+docs/serving.md) whose hint is adaptive — queue-wait EWMA scaled by how
+far the SLO-driven admission controller has closed its window — so
+clients back off proportionally to actual congestion.  Requests carry a
+class via the ``X-Priority`` header or the body's ``"priority"`` key
+(0 = highest, the default; docs/serving.md "Overload survival"):
+higher classes queue-jump, may preempt lower-class slots, and are shed
+last.  GET /engine exposes the live gauges, including
 the ``pages`` group (free/used/cached pages, prefix-cache hit rate,
 tokens resident, evictions, copy-on-write admissions) when the engine
 runs the paged layout.  Request bodies are capped at
@@ -243,13 +249,28 @@ class RestfulServer(Logger):
                                 code=409)
                         return
                     if path == "/generate":
+                        # request class (docs/serving.md "Overload
+                        # survival"): the X-Priority header is the
+                        # proxy-friendly spelling of the body key; an
+                        # explicit body "priority" wins
+                        hdr = self.headers.get("X-Priority")
+                        if hdr is not None:
+                            req.setdefault("priority", hdr)
                         self._reply(outer.decode(req))
                         return
                     self._reply(
                         {"output": outer.infer(req["input"]).tolist()})
                 except EngineOverloaded as e:
+                    # the hint is ADAPTIVE (queue-wait EWMA x how far
+                    # the admission controller closed the window —
+                    # engine._retry_after), so clients back off
+                    # proportionally to actual congestion; the body
+                    # carries the un-rounded seconds for programmatic
+                    # clients
                     self._reply(
-                        {"error": str(e)}, code=429,
+                        {"error": str(e),
+                         "retry_after_s": round(e.retry_after_s, 3)},
+                        code=429,
                         headers=(("Retry-After",
                                   str(int(round(e.retry_after_s)))),))
                 except SchedulerCrashed as e:
@@ -434,6 +455,17 @@ class RestfulServer(Logger):
             raise ValueError(
                 "top_k/top_p filter sampling and need temperature > 0 "
                 "(temperature 0 is greedy decoding)")
+        # request class (docs/serving.md "Overload survival"): 0 — the
+        # default and highest — through serve.priorities - 1.  Range is
+        # the engine's contract (submit raises ValueError -> 400); a
+        # server without an engine has no queue to jump, so a non-zero
+        # class on the per-request generate() path is refused rather
+        # than silently flattened.
+        priority = self._req_int(req.get("priority", 0), "priority")
+        if priority and self.engine is None:
+            raise ValueError(
+                "priority classes need engine= serving (per-request "
+                "generate() has no queue to prioritize)")
         eos_id = req.get("eos_id")
         if eos_id is None:
             eos_id = self.default_eos_id  # e.g. the artifact's sealed
@@ -459,6 +491,10 @@ class RestfulServer(Logger):
                 raise ValueError(
                     "beams is deterministic search; drop temperature/"
                     "top_k/top_p/seed or use beams=1")
+            if priority:
+                raise ValueError(
+                    "beam search runs outside the engine queue; "
+                    "priority classes apply to beams=1 requests")
             length_penalty = float(req.get("length_penalty", 0.0))
             if length_penalty < 0:
                 raise ValueError(
@@ -482,7 +518,8 @@ class RestfulServer(Logger):
             # handler's 429 + Retry-After.
             toks = self.engine.generate(
                 prompt.astype(np.int32), steps, temperature=temperature,
-                top_k=top_k, top_p=top_p, eos_id=eos_id, key=key)
+                top_k=top_k, top_p=top_p, eos_id=eos_id, key=key,
+                priority=priority)
             return {"tokens": np.asarray(toks).tolist()}
         toks = generate(
             self.workflow, self.wstate, prompt.astype(np.int32), steps,

@@ -140,6 +140,19 @@ class SloTracker:
                 root.common.observe.slo.get("degrade_ready", False)),
         }
 
+    def max_burn(self) -> float:
+        """Worst burn rate across the tracked SLOs right now — the
+        admission controller's sensor (runtime/admission.py).  A window
+        with fewer than the minimum sample count contributes 0, for the
+        same reason one slow request after boot must not 503 the server:
+        it must not slam the admission window shut either."""
+        worst = 0.0
+        for key, _m in _TRACKED:
+            m = self._one(key)
+            if m["count"] >= _MIN_COUNT:
+                worst = max(worst, m["burn_rate"])
+        return worst
+
     def burning(self) -> bool:
         """Any tracked SLO at/over the burn threshold right now (with
         enough window samples to mean it)."""
