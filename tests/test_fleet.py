@@ -1,0 +1,674 @@
+"""Fleet router (runtime/fleet.py): load- + prefix-affinity dispatch,
+coordinated two-phase hot swap, rolling drain under load, ejection with
+resubmission, 429 backpressure honoring, and the merged /slo.json.
+
+Replicas here are REAL serving stacks — RestfulServer over DecodeEngine
+with a DeployController attached — booted in-process on ephemeral ports
+(the same handles ``--serve --fleet N`` uses), so every behavior pinned
+here is the behavior the CLI fleet exhibits.  The SLO-merge arithmetic
+is pinned against numpy over the union of per-replica samples, with
+per-replica histograms rendered from standalone registries (in-process
+replicas share ONE process registry, which the router's registry-key
+grouping counts once — also pinned)."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import veles_tpu as vt
+from veles_tpu.config import root
+from veles_tpu.models.standard import build_workflow
+from veles_tpu.ops import optimizers as opt
+from veles_tpu.runtime.deploy import DeployController
+from veles_tpu.runtime.engine import DecodeEngine, prefix_page_hashes
+from veles_tpu.runtime.fleet import (ACTIVE, EJECTED, FleetRouter,
+                                     FleetServer, InProcessReplica)
+from veles_tpu.runtime.restful import RestfulServer
+from veles_tpu.runtime.snapshotter import Snapshotter
+
+pytestmark = pytest.mark.fleet
+
+V = 12
+
+LAYERS = [
+    {"type": "embedding", "vocab": V, "dim": 16, "name": "emb"},
+    {"type": "attention", "n_heads": 2, "rope": True,
+     "residual": True, "name": "a1"},
+    {"type": "seq_last", "name": "last"},
+    {"type": "softmax", "output_size": V, "name": "out"},
+]
+
+
+@pytest.fixture(scope="module")
+def lm():
+    wf = build_workflow("fleet_lm", LAYERS)
+    wf.build({"@input": vt.Spec((2, 6), jnp.int32),
+              "@labels": vt.Spec((2,), jnp.int32),
+              "@mask": vt.Spec((2,), jnp.float32)})
+    ws_a = wf.init_state(jax.random.key(3), opt.SGD(0.1))
+    ws_b = wf.init_state(jax.random.key(11), opt.SGD(0.1))
+    return wf, ws_a, ws_b
+
+
+@pytest.fixture
+def fast_scrape():
+    """Tight scrape cadence so health/load converges within test
+    timeouts; restored afterwards."""
+    fleet = root.common.serve.fleet
+    prev = fleet.get("scrape_interval_s", 0.5)
+    fleet.scrape_interval_s = 0.05
+    yield
+    fleet.scrape_interval_s = prev
+
+
+def _factory(wf, ws, **engine_kw):
+    """One in-process replica stack: engine + REST + deploy, started —
+    the ``--serve --fleet`` factory shape."""
+    kw = dict(slots=2, l_max=64, window_ms=0.0)
+    kw.update(engine_kw)
+    boot_source = kw.pop("boot_source", "live")
+
+    def factory():
+        eng = DecodeEngine(wf, dict(ws), **kw)
+        srv = RestfulServer(wf.make_predict_step("out"), dict(ws), 2,
+                            (6,), port=0, workflow=wf, engine=eng,
+                            input_dtype=np.int32)
+        DeployController(server=srv, boot_source=boot_source)
+        return srv.start()
+
+    return factory
+
+
+def _fleet(wf, ws, n=3, router_kw=(), **engine_kw):
+    """n replicas + router (started).  Returns (router, replicas)."""
+    replicas = [InProcessReplica(_factory(wf, ws, **engine_kw))
+                for _ in range(n)]
+    router = FleetRouter(**dict(router_kw))
+    for rep in replicas:
+        router.add_replica(url=rep.url, registry_key="in-process",
+                           restart=rep.restart, kill=rep.kill)
+    router.start()
+    return router, replicas
+
+
+def _teardown(router, replicas, fsrv=None):
+    if fsrv is not None:
+        fsrv.stop()
+    else:
+        router.stop()
+    for rep in replicas:
+        rep.stop()
+
+
+def _post(base, path, body):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=120) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        with e:
+            return e.code, json.loads(e.read())
+
+
+def _get(base, path):
+    with urllib.request.urlopen(base + path, timeout=30) as r:
+        return r.status, json.loads(r.read())
+
+
+# -- prefix-affinity dispatch ------------------------------------------------
+
+def test_affinity_routes_warm_prefix_to_page_holder(lm, fast_scrape,
+                                                    rng):
+    """Same-system-prompt requests land on the replica already holding
+    the shared pages: the first request seeds the affinity map (hash
+    ring), every later request with the same 32-token head follows it,
+    the router's hit counters rise, and the page-holding replica's OWN
+    prefix cache serves the shared head (its hit rate > 0 proves the
+    affinity actually bought cache reuse, not just stickiness)."""
+    wf, ws, _ = lm
+    router, replicas = _fleet(wf, ws, n=3, paged=True, page_size=16,
+                              l_max=128)
+    head = rng.integers(1, V, 32).tolist()     # two full 16-token pages
+    try:
+        outcomes = []
+        for i in range(6):
+            tail = rng.integers(1, V, 3).tolist()
+            status, doc, _h = router.handle_generate(
+                {"prompt": [head + tail], "steps": 2})
+            outcomes.append(status)
+            assert status == 200, doc
+        fd = router.fleet_doc()
+        aff = fd["affinity"]
+        assert aff["requests"] == 6
+        # request 1 seeds (ring — no map hit); the rest must follow it
+        assert aff["hits"] >= 5, fd
+        assert aff["hit_rate"] >= 0.83          # doc rounds to 4 places
+        by_dispatch = sorted(fd["replicas"],
+                             key=lambda r: -r["dispatched"])
+        assert by_dispatch[0]["dispatched"] == 6, fd
+        assert by_dispatch[1]["dispatched"] == 0
+        # the holder's engine really served the head from its prefix
+        # cache: requests 2..6 prefilled only their tails
+        holder = next(r for r in replicas
+                      if r.url == by_dispatch[0]["url"])
+        pages = holder.srv.engine.stats()["pages"]
+        assert pages["prefix_hit_rate"] > 0, pages
+        assert pages["prefix_tokens_reused"] >= 5 * 32, pages
+    finally:
+        _teardown(router, replicas)
+
+
+def test_affinity_hashes_match_engine_prefix_identity():
+    """The router keys affinity on the engine's own chained page-hash
+    identity (one shared function): head hashes of a prompt equal the
+    engine-side hashes of any longer prompt sharing that head — and
+    diverge from a prompt differing inside the first page."""
+    page = list(range(1, 17))
+    a = prefix_page_hashes(np.asarray(page * 2), 16)
+    b = prefix_page_hashes(np.asarray(page * 2 + [7, 8, 9]), 16)
+    assert a == b[:2]
+    c = prefix_page_hashes(np.asarray([9] + page[1:] + page), 16)
+    assert c[0] != a[0]
+
+
+# -- coordinated hot swap ----------------------------------------------------
+
+def _snap(tmp_path, wf, ws, tag):
+    snap = Snapshotter("m", str(tmp_path / "snaps"))
+    return snap.save(tag, {"wstate": ws,
+                           "workflow_checksum": wf.checksum()})
+
+
+def test_coordinated_swap_atomicity_and_rollback(lm, tmp_path, rng):
+    """One replica's flip failure rolls the WHOLE fleet back: after a
+    sabotaged commit on replica 1, every replica still serves the boot
+    version (bitwise: /generate equals the old-weights engine output);
+    with the sabotage removed the same swap commits everywhere."""
+    wf, ws_a, ws_b = lm
+    snap_a = _snap(tmp_path, wf, ws_a, "a")
+    snap_b = _snap(tmp_path, wf, ws_b, "b")
+    router, replicas = _fleet(wf, ws_a, n=3, boot_source=snap_a)
+    prompt = rng.integers(1, V, (1, 5)).astype(np.int32)
+    try:
+        ref_a = router.handle_generate(
+            {"prompt": prompt.tolist(), "steps": 4})[1]["tokens"]
+        # sabotage replica 1's flip: stage succeeds (load+validate),
+        # the commit's engine flip raises
+        victim = replicas[1].srv.engine
+        real_swap = victim.swap_params
+
+        def boom(params, **kw):
+            raise RuntimeError("injected flip failure")
+
+        victim.swap_params = boom
+        out = router.coordinated_swap(source=snap_b)
+        assert out["swapped"] is False and out["phase"] == "commit"
+        assert out["errors"], out
+        # replica 0 committed first and must have been rolled back
+        assert "r0" in out["rolled_back"], out
+        # fleet-wide: the OLD version serves everywhere, bitwise.
+        # (A rolled-back replica re-activates the boot weights through
+        # a fresh registry entry — same checksum, new version id.)
+        for rep in replicas:
+            st, models = _get(rep.url, "/models")
+            active = next(v for v in models["versions"] if v["active"])
+            assert active["checksum"] \
+                == models["versions"][0]["checksum"], models
+            st, doc = _post(rep.url, "/generate",
+                            {"prompt": prompt.tolist(), "steps": 4})
+            assert st == 200 and doc["tokens"] == ref_a, doc
+        # nothing left staged anywhere (abort swept the stragglers)
+        for rep in replicas:
+            assert rep.srv.deploy.staged_token is None
+        # sabotage removed: the same swap commits fleet-wide
+        victim.swap_params = real_swap
+        out = router.coordinated_swap(source=snap_b)
+        assert out["swapped"] is True, out
+        ref_b = None
+        for rep in replicas:
+            st, models = _get(rep.url, "/models")
+            assert models["versions"][-1]["active"], models
+            st, doc = _post(rep.url, "/generate",
+                            {"prompt": prompt.tolist(), "steps": 4})
+            assert st == 200
+            if ref_b is None:
+                ref_b = doc["tokens"]
+            assert doc["tokens"] == ref_b
+        assert ref_b != ref_a
+    finally:
+        _teardown(router, replicas)
+
+
+def test_coordinated_swap_resolves_lost_commit_reply(lm, tmp_path,
+                                                     rng):
+    """A commit whose REPLY is lost after the server-side flip landed
+    is the classic 2PC ambiguity: treating it as not-committed would
+    skip it in the rollback and leave the fleet mixed.  The router
+    resolves it by probing the replica's registry — the flipped
+    replica is rolled back with the rest, and both end on the old
+    weights."""
+    from veles_tpu.runtime.fleet_client import ReplicaUnavailable
+    wf, ws_a, ws_b = lm
+    snap_a = _snap(tmp_path, wf, ws_a, "a")
+    snap_b = _snap(tmp_path, wf, ws_b, "b")
+    router, replicas = _fleet(wf, ws_a, n=2, boot_source=snap_a)
+    prompt = rng.integers(1, V, (1, 5)).astype(np.int32)
+    try:
+        ref_a = router.handle_generate(
+            {"prompt": prompt.tolist(), "steps": 4})[1]["tokens"]
+        r0 = router.replicas()[0]
+        real_commit = r0.client.commit
+
+        def lossy_commit(token, timeout=None):
+            real_commit(token)          # the flip LANDS server-side
+            raise ReplicaUnavailable("reply lost after the flip")
+
+        r0.client.commit = lossy_commit
+        out = router.coordinated_swap(source=snap_b)
+        assert out["swapped"] is False and out["phase"] == "commit"
+        assert "r0" in out["rolled_back"], out
+        for rep in replicas:            # never mixed: old everywhere
+            st, doc = _post(rep.url, "/generate",
+                            {"prompt": prompt.tolist(), "steps": 4})
+            assert st == 200 and doc["tokens"] == ref_a, doc
+            assert rep.srv.deploy.staged_token is None
+    finally:
+        _teardown(router, replicas)
+
+
+def test_stage_abort_leaves_old_serving(lm, tmp_path, rng):
+    """The two-phase REST surface on one replica: stage places without
+    flipping (active version unchanged), abort withdraws, a commit for
+    the aborted token is refused 409, and a fresh stage+commit flips."""
+    wf, ws_a, ws_b = lm
+    snap_b = _snap(tmp_path, wf, ws_b, "b")
+    rep = InProcessReplica(_factory(wf, ws_a))
+    base = rep.url
+    try:
+        st, doc = _post(base, "/admin/stage", {"source": snap_b})
+        assert st == 200 and doc["staged"], doc
+        token = doc["staged"]
+        st, models = _get(base, "/models")
+        assert models["active"] == 1          # not serving yet
+        # a second stage before commit/abort is refused
+        st2, doc2 = _post(base, "/admin/stage", {"source": snap_b})
+        assert st2 == 409, doc2
+        st, doc2 = _post(base, "/admin/abort", {"token": token})
+        assert st == 200 and doc2["aborted"] == token
+        st, doc2 = _post(base, "/admin/commit", {"token": token})
+        assert st == 409, doc2                 # aborted = gone
+        st, models = _get(base, "/models")
+        assert models["active"] == 1
+        st, doc = _post(base, "/admin/stage", {"source": snap_b})
+        assert st == 200
+        st, doc = _post(base, "/admin/commit",
+                        {"token": doc["staged"]})
+        assert st == 200 and doc["active"]["version"] == 2, doc
+    finally:
+        rep.stop()
+
+
+# -- rolling drain -----------------------------------------------------------
+
+def test_rolling_drain_under_load_zero_dropped(lm, fast_scrape, rng):
+    """A full rolling-drain cycle under concurrent load: every replica
+    drains, restarts and is readmitted while worker threads keep
+    submitting through the router — zero failed requests, and every
+    restarted replica's compile counters stay flat after its boot
+    inventory (recompiles == 0: the churn re-traced nothing)."""
+    wf, ws, _ = lm
+    router, replicas = _fleet(wf, ws, n=3)
+    prompt = rng.integers(1, V, (1, 5)).tolist()
+    errs, done = [], []
+    stop = threading.Event()
+
+    def worker():
+        while not stop.is_set():
+            status, doc, _h = router.handle_generate(
+                {"prompt": prompt, "steps": 3})
+            if status == 200:
+                done.append(status)
+            else:
+                errs.append((status, doc))
+                return
+
+    threads = [threading.Thread(target=worker) for _ in range(3)]
+    try:
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 60
+        while len(done) < 5:                   # load is flowing
+            assert time.monotonic() < deadline, (done, errs)
+            time.sleep(0.01)
+        summary = router.rolling_drain()
+        assert summary["completed"] is True, summary
+        assert all(r["restarted"] and r["readmitted"]
+                   for r in summary["replicas"]), summary
+        # traffic kept completing THROUGH the cycle and still does
+        n_after_cycle = len(done)
+        while len(done) < n_after_cycle + 5:
+            assert time.monotonic() < deadline, (done, errs)
+            time.sleep(0.01)
+        stop.set()
+        for t in threads:
+            t.join(timeout=120)
+        assert not errs, errs
+        # all three came back as FRESH stacks and none re-traced
+        # anything past its boot inventory under the continued load
+        fd = router.fleet_doc()
+        assert [r["state"] for r in fd["replicas"]] == [ACTIVE] * 3
+        for rep in replicas:
+            st = rep.srv.engine.stats()
+            assert st["compile"]["recompiles"] == 0, st["compile"]
+    finally:
+        stop.set()
+        _teardown(router, replicas)
+
+
+# -- ejection / resubmission / backpressure ---------------------------------
+
+def test_replica_kill_ejects_and_resubmits(lm, fast_scrape, rng):
+    """Killing a replica mid-stream of dispatches: the router fails
+    over the interrupted request to a survivor (the caller sees ONE
+    200, never an error), ejects the dead replica, and the fleet doc
+    says so."""
+    wf, ws, _ = lm
+    router, replicas = _fleet(wf, ws, n=2,
+                              router_kw={"eject_failures": 1})
+    prompt = rng.integers(1, V, (1, 4)).tolist()
+    try:
+        for _ in range(2):                      # warm both candidates
+            status, doc, _h = router.handle_generate(
+                {"prompt": prompt, "steps": 2})
+            assert status == 200
+        # find who serves this stream (hysteresis keeps it put),
+        # then kill exactly that replica
+        fd = router.fleet_doc()
+        busy_url = max(fd["replicas"],
+                       key=lambda r: r["dispatched"])["url"]
+        victim = next(r for r in replicas if r.url == busy_url)
+        victim.kill()
+        status, doc, _h = router.handle_generate(
+            {"prompt": prompt, "steps": 2})
+        assert status == 200, doc               # resubmitted, not failed
+        fd = router.fleet_doc()
+        states = {r["url"]: r["state"] for r in fd["replicas"]}
+        assert states[busy_url] == EJECTED, fd
+        # the rolling drain is also the REPAIR action: an ejected
+        # replica with a restart handle is rebuilt and readmitted
+        summary = router.rolling_drain()
+        assert summary["completed"] is True, summary
+        fd = router.fleet_doc()
+        assert [r["state"] for r in fd["replicas"]] == [ACTIVE] * 2, fd
+    finally:
+        _teardown(router, replicas)
+
+
+class _SheddingReplica:
+    """A stub replica that 429s every /generate with a fixed hint —
+    the backpressure-honoring fixture (no engine, no jax)."""
+
+    def __init__(self, retry_after_s=7.5):
+        import http.server
+        outer = self
+        self.generate_calls = 0
+
+        class H(http.server.BaseHTTPRequestHandler):
+            def _reply(self, obj, code=200, headers=()):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in headers:
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                path = self.path.split("?", 1)[0].rstrip("/")
+                if path == "/ready":
+                    self._reply({"ready": True})
+                elif path == "/engine":
+                    self._reply({"slots": 1, "queue_depth": 0,
+                                 "occupancy": 0,
+                                 "admission": {"burn": 9.0}})
+                elif path == "/metrics":
+                    self._reply({})
+                else:
+                    self.send_error(404)
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                self.rfile.read(n)
+                outer.generate_calls += 1
+                self._reply(
+                    {"error": "shedding",
+                     "retry_after_s": retry_after_s}, code=429,
+                    headers=(("Retry-After",
+                              str(int(retry_after_s))),))
+
+            def log_message(self, *args):
+                pass
+
+        self.httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0),
+                                                     H)
+        self.port = self.httpd.server_address[1]
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+
+    @property
+    def url(self):
+        return f"http://127.0.0.1:{self.port}"
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def test_429_honored_as_router_backpressure():
+    """A replica's 429 Retry-After puts it in a backoff window: the
+    next low-class request is refused AT THE ROUTER (no dispatch —
+    the replica's call count proves it) with the replica's own hint,
+    while a class-0 request is still dispatched (routed to the
+    least-burned replica rather than shed by the router)."""
+    stubs = [_SheddingReplica(retry_after_s=7.5) for _ in range(2)]
+    router = FleetRouter()
+    for s in stubs:
+        router.add_replica(url=s.url, registry_key=s.url)
+    try:
+        router.start()
+        body = {"prompt": [[1, 2, 3]], "steps": 1, "priority": 1}
+        status, doc, headers = router.handle_generate(body)
+        assert status == 429
+        assert doc["retry_after_s"] == pytest.approx(7.5)
+        assert dict(headers).get("Retry-After") == "8"
+        calls_after_first = sum(s.generate_calls for s in stubs)
+        assert calls_after_first == 2          # both tried once
+        # both replicas are now inside their hinted backoff window:
+        # the low-class request never reaches them
+        status, doc, _h = router.handle_generate(body)
+        assert status == 429
+        assert sum(s.generate_calls for s in stubs) \
+            == calls_after_first
+        # class 0 is never shed by the router's backpressure: it is
+        # dispatched to the least-burned replica and carries the
+        # replica's own answer back
+        status, doc, _h = router.handle_generate(
+            {"prompt": [[1, 2, 3]], "steps": 1, "priority": 0})
+        assert status == 429                    # the stub's answer
+        assert sum(s.generate_calls for s in stubs) \
+            > calls_after_first
+        fd = router.fleet_doc()
+        assert all(r["backoff_remaining_s"] > 0
+                   for r in fd["replicas"]), fd
+    finally:
+        router.stop()
+        for s in stubs:
+            s.stop()
+
+
+# -- merged /slo.json --------------------------------------------------------
+
+def test_merged_slo_quantiles_vs_numpy():
+    """The fleet /slo.json quantiles equal numpy percentiles over the
+    UNION of per-replica samples, within one histogram bucket (the
+    same tolerance the per-process window tests pin) — and replicas
+    sharing a registry key are counted ONCE (the in-process fleet
+    shape), not once per replica."""
+    from veles_tpu.runtime.metrics import (DEFAULT_BUCKETS,
+                                           MetricsRegistry)
+    rng = np.random.default_rng(7)
+    samples_a = rng.uniform(0.001, 0.4, 300)
+    samples_b = rng.uniform(0.05, 2.0, 200)
+    texts = []
+    for samples in (samples_a, samples_b):
+        reg = MetricsRegistry(label_cap=8)
+        h = reg.histogram("vt_request_ttft_seconds", "ttft",
+                          labels=("bucket",))
+        for v in samples:
+            h.labels(bucket="16").observe(float(v))
+        texts.append(reg.render())
+
+    router = FleetRouter()
+    r0 = router.add_replica(url="http://127.0.0.1:9",
+                            registry_key="proc-a")
+    r1 = router.add_replica(url="http://127.0.0.1:9",
+                            registry_key="proc-b")
+    r2 = router.add_replica(url="http://127.0.0.1:9",
+                            registry_key="proc-b")   # same process
+    for w in router._slo_windows.values():
+        w.tick()                                # zero baseline slice
+    with router._lock:
+        r0.metrics_text = texts[0]
+        r1.metrics_text = texts[1]
+        r2.metrics_text = texts[1]              # the shared registry
+    doc = router.merged_slo_doc()
+    assert doc["replica_groups"] == 2           # proc-b counted once
+    merged = np.concatenate([samples_a, samples_b])
+    got = doc["metrics"]["ttft"]
+    assert got["count"] == merged.size          # NOT size + 200
+    assert got["sum_seconds"] == pytest.approx(merged.sum(), rel=1e-4)
+    uppers = (0.0,) + tuple(DEFAULT_BUCKETS) + (float("inf"),)
+    for q, key in ((0.5, "p50_ms"), (0.95, "p95_ms"),
+                   (0.99, "p99_ms")):
+        true = float(np.quantile(merged, q))
+        i = next(i for i in range(1, len(uppers))
+                 if true <= uppers[i])
+        width = uppers[i] - uppers[i - 1]
+        assert abs(got[key] / 1e3 - true) <= width + 1e-9, \
+            (key, got[key], true)
+
+
+def test_merged_slo_survives_replica_restart_reset():
+    """A cross-process replica restart (rolling drain) re-exposes
+    ZEROED cumulative buckets; without per-group counter-reset
+    correction the fleet window's delta would go negative and
+    quantiles/burn would read 0 right after the restart.  The merge
+    must stay monotonic: post-restart observations count, history
+    stays counted."""
+    from veles_tpu.runtime.metrics import MetricsRegistry
+
+    def render(samples):
+        reg = MetricsRegistry(label_cap=8)
+        h = reg.histogram("vt_request_ttft_seconds", "ttft",
+                          labels=("bucket",))
+        for v in samples:
+            h.labels(bucket="16").observe(float(v))
+        return reg.render()
+
+    rng = np.random.default_rng(11)
+    router = FleetRouter()
+    ra = router.add_replica(url="http://127.0.0.1:9",
+                            registry_key="proc-a")
+    rb = router.add_replica(url="http://127.0.0.1:9",
+                            registry_key="proc-b")
+    with router._lock:
+        ra.metrics_text = render(rng.uniform(0.01, 0.2, 300))
+        rb.metrics_text = render(rng.uniform(0.01, 0.2, 200))
+    for w in router._slo_windows.values():
+        w.tick()                    # baseline = 500 observations
+    # proc-b restarts: a FRESH registry with 10 new samples — its raw
+    # cumulative count DROPS 200 -> 10
+    with router._lock:
+        rb.metrics_text = render([0.05] * 10)
+    got = router.merged_slo_doc()["metrics"]["ttft"]
+    assert got["count"] == 10, got      # the window sees the NEW work
+    assert got["p50_ms"] > 0, got       # and not a zeroed-out nonsense
+
+
+def test_group_text_prefers_live_member_over_ejected_leader():
+    """After the group's metrics leader is ejected, the SLO merge must
+    read a LIVE member's scrape, not the dead leader's frozen text —
+    an in-process fleet's merged window would otherwise stop moving
+    until readmission."""
+    router = FleetRouter()
+    r0 = router.add_replica(url="http://127.0.0.1:9",
+                            registry_key="shared")
+    r1 = router.add_replica(url="http://127.0.0.1:9",
+                            registry_key="shared")
+    with router._lock:
+        r0.metrics_text = "stale"
+        r1.metrics_text = "fresh"
+        r0.state = EJECTED
+    assert router._group_items() == [("shared", "fresh")]
+    with router._lock:                  # all dead: last sight remains
+        r1.state = EJECTED
+    assert router._group_items() == [("shared", "stale")]
+
+
+def test_fleet_server_endpoints(lm, fast_scrape, rng):
+    """The router's HTTP front end-to-end: /generate dispatches (with
+    the X-Priority header honored), /fleet.json and the merged
+    /slo.json render, /ready reflects replica health, and
+    /admin/join adds a live replica that then receives traffic."""
+    wf, ws, _ = lm
+    router, replicas = _fleet(wf, ws, n=1)
+    fsrv = FleetServer(router, port=0).start()
+    base = f"http://127.0.0.1:{fsrv.port}"
+    joined = InProcessReplica(_factory(wf, ws))
+    prompt = rng.integers(1, V, (1, 4)).tolist()
+    try:
+        st, doc = _post(base, "/generate",
+                        {"prompt": prompt, "steps": 2})
+        assert st == 200 and len(doc["tokens"][0]) == 6
+        st, rd = _get(base, "/ready")
+        assert st == 200 and rd["ready"] is True
+        st, fd = _get(base, "/fleet.json")
+        assert fd["role"] == "fleet-router"
+        assert len(fd["replicas"]) == 1
+        st, slo = _get(base, "/slo.json")
+        assert slo["fleet"] is True and "ttft" in slo["metrics"]
+        # join a second replica over the wire, then drain the first:
+        # traffic keeps flowing through the joined one
+        st, jd = _post(base, "/admin/join",
+                       {"url": joined.url,
+                        "registry_key": "in-process"})
+        assert st == 200 and jd["joined"] == "r1"
+        st, fd = _get(base, "/fleet.json")
+        assert len(fd["replicas"]) == 2
+        replicas[0].srv.deploy.begin_drain()
+        deadline = time.monotonic() + 30
+        ok = False
+        while time.monotonic() < deadline:
+            st, doc = _post(base, "/generate",
+                            {"prompt": prompt, "steps": 2})
+            if st == 200:
+                ok = True
+                break
+            time.sleep(0.05)
+        assert ok
+        st, fd = _get(base, "/fleet.json")
+        served = {r["url"]: r["dispatched"] for r in fd["replicas"]}
+        assert served[joined.url] >= 1, fd
+    finally:
+        _teardown(router, replicas, fsrv)
+        joined.stop()

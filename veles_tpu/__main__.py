@@ -193,6 +193,22 @@ def build_parser() -> argparse.ArgumentParser:
                         "(runtime/restful.py; 0 = ephemeral port); "
                         "blocks until drained (SIGTERM / POST "
                         "/admin/drain) or interrupted")
+    p.add_argument("--fleet", type=int, metavar="N", default=None,
+                   help="with --serve: boot N replica serving stacks "
+                        "in this process behind the fleet router "
+                        "(load- + prefix-affinity dispatch, "
+                        "coordinated hot swap, rolling drain — "
+                        "docs/serving.md 'Fleet serving'); PORT "
+                        "serves the router, replicas take ephemeral "
+                        "ports (default root.common.serve.fleet."
+                        "replicas)")
+    p.add_argument("--join", metavar="ROUTER_URL", default=None,
+                   help="with --serve: register this replica with a "
+                        "running fleet router after boot (POST "
+                        "/admin/join) so it starts receiving "
+                        "dispatched traffic; the router drains it "
+                        "during a rolling drain and readmits it on "
+                        "/ready")
     p.add_argument("--model-dir", default=None,
                    help="snapshot directory backing --serve's model "
                         "lifecycle control plane (runtime/deploy.py): "
@@ -470,6 +486,59 @@ def _check_watch(args) -> None:
                          "directory to poll)")
 
 
+def _fleet_n(args) -> int:
+    """Replica count for ``--serve --fleet``: the flag wins, the
+    ``root.common.serve.fleet.replicas`` knob backs it (0 = plain
+    single-replica serving, no router)."""
+    if args.fleet is not None:
+        return max(0, int(args.fleet))
+    return max(0, int(root.common.serve.fleet.get("replicas", 0) or 0))
+
+
+def _serve_fleet(args, factory, banner: dict) -> int:
+    """``--serve PORT --fleet N``: N in-process replica serving stacks
+    (each built by ``factory`` — a zero-arg callable returning a
+    STARTED RestfulServer with its DeployController attached) fronted
+    by the fleet router (runtime/fleet.py).  PORT serves the router;
+    replicas listen on ephemeral local ports.  Blocks until the fleet
+    drains (SIGTERM / POST /admin/drain on the router)."""
+    from .runtime.fleet import FleetRouter, FleetServer, InProcessReplica
+
+    if args.watch:
+        raise SystemExit(
+            "--watch is per-replica and conflicts with --fleet: "
+            "fleet-wide version changes go through the router's "
+            "coordinated swap (POST /admin/reload on the router)")
+    if args.join:
+        raise SystemExit("--fleet runs the router; --join makes this "
+                         "process a replica of ANOTHER router — "
+                         "pick one")
+    n = _fleet_n(args)
+    replicas = [InProcessReplica(factory) for _ in range(n)]
+    router = FleetRouter()
+    for rep in replicas:
+        # one process = one metrics registry: the SLO merge must count
+        # the shared histograms once, not per replica
+        router.add_replica(url=rep.url, registry_key="in-process",
+                           restart=rep.restart, kill=rep.kill)
+    fsrv = FleetServer(router, port=args.serve)
+    fsrv.install_signal_handlers()
+    fsrv.start()
+    print(json.dumps(dict(
+        banner, fleet=n, serving=fsrv.port,
+        replicas=[r.url for r in replicas],
+        observe=["/metrics", "/fleet.json", "/slo.json"])), flush=True)
+    try:
+        router.wait()  # released by SIGTERM / POST /admin/drain
+    except KeyboardInterrupt:
+        router.begin_drain()
+    fsrv.stop()
+    for rep in replicas:
+        rep.stop()
+    _maybe_write_trace(args)
+    return 0
+
+
 def _run_serve_loop(args, srv, banner: dict, *, status=None,
                     boot_source: str = "live") -> int:
     """The ONE serve bootstrap/teardown config-booted (``--serve``) and
@@ -485,6 +554,28 @@ def _run_serve_loop(args, srv, banner: dict, *, status=None,
         status=status, boot_source=boot_source)
     deploy.install_signal_handlers()
     srv.start()
+    if args.join:
+        # replica mode: hand this process's serving URL to a running
+        # fleet router; retries ride the shared transient-HTTP backoff
+        # (the router may still be booting)
+        from .runtime.deploy import http_retry
+        from .runtime.fleet_client import ReplicaClient, ReplicaUnavailable
+
+        def _join():
+            try:
+                return ReplicaClient(args.join).request(
+                    "POST", "/admin/join",
+                    {"url": f"http://127.0.0.1:{srv.port}"})
+            except ReplicaUnavailable as e:
+                # surface as the transport error http_retry retries
+                raise ConnectionError(str(e)) from e
+
+        status_code, _h, doc = http_retry(_join, what="fleet join")
+        if status_code != 200:
+            srv.stop()
+            raise SystemExit(
+                f"--join {args.join}: router refused the replica "
+                f"(HTTP {status_code}: {doc})")
     if args.watch:
         deploy.start_watcher()
     print(json.dumps(dict(banner, serving=srv.port,
@@ -531,36 +622,57 @@ def _serve_artifact(args) -> int:
 
     _check_watch(args)  # fail BEFORE the expensive artifact boot
     man = read_manifest(args.artifact)
-    runner = None
-    if "decode" in man.get("programs", {}):
-        runner = ArtifactRunner(args.artifact)
-        wstate = runner.wstate
-        predict_fn = runner.predict if runner.has_forward else None
-    else:
-        predict_fn, wstate, man = load_forward(args.artifact)
 
-    if predict_fn is None:
-        def predict_fn(wstate, batch):  # noqa: ARG001
-            raise ValueError(
-                "this artifact was exported without a forward program; "
-                "only /generate is served")
+    def build_server(port):
+        runner = None
+        if "decode" in man.get("programs", {}):
+            runner = ArtifactRunner(args.artifact)
+            wstate = runner.wstate
+            predict_fn = runner.predict if runner.has_forward else None
+        else:
+            predict_fn, wstate, _m = load_forward(args.artifact)
 
-    ispec = man.get("input_spec") or {}
-    shape = [int(s) for s in (ispec.get("shape") or (1, 1))]
-    srv = RestfulServer(
-        predict_fn, wstate, shape[0], tuple(shape[1:]),
-        port=args.serve, workflow=None, engine=runner,
-        input_dtype=np.dtype(ispec.get("dtype", "float32")),
-        default_eos_id=man.get("eos_id"),
-        vocab_size=man.get("input_vocab"))
-    return _run_serve_loop(args, srv, {
+        if predict_fn is None:
+            def predict_fn(wstate, batch):  # noqa: ARG001
+                raise ValueError(
+                    "this artifact was exported without a forward "
+                    "program; only /generate is served")
+
+        ispec = man.get("input_spec") or {}
+        shape = [int(s) for s in (ispec.get("shape") or (1, 1))]
+        return RestfulServer(
+            predict_fn, wstate, shape[0], tuple(shape[1:]),
+            port=port, workflow=None, engine=runner,
+            input_dtype=np.dtype(ispec.get("dtype", "float32")),
+            default_eos_id=man.get("eos_id"),
+            vocab_size=man.get("input_vocab"))
+
+    banner = {
         "artifact": args.artifact,
         "workflow": man.get("workflow"),
         "programs": {
             "decode": "decode" in man.get("programs", {}),
             "forward": "forward" in man.get("programs", {}),
             "prefill_buckets": man.get("buckets", [])},
-    }, boot_source=str(args.artifact))
+    }
+    if _fleet_n(args):
+        # N sealed-artifact replicas behind the router: each boots the
+        # whole deserialized program inventory itself, and the rolling
+        # drain's restart handle reboots a replica from the SAME
+        # sealed artifact (docs/serving.md "Fleet serving")
+        from .runtime.deploy import DeployController
+
+        def factory():
+            srv = build_server(0)
+            DeployController(server=srv,
+                             drain_timeout_s=args.drain_timeout,
+                             boot_source=str(args.artifact))
+            return srv.start()
+
+        return _serve_fleet(args, factory, banner)
+    srv = build_server(args.serve)
+    return _run_serve_loop(args, srv, banner,
+                           boot_source=str(args.artifact))
 
 
 def main(argv=None) -> int:
@@ -684,6 +796,31 @@ def main(argv=None) -> int:
     if args.compiled and not args.export:
         raise SystemExit("--compiled modifies --export DIR (it writes "
                          "the compiled artifact there)")
+    if args.fleet is not None and args.serve is None:
+        raise SystemExit("--fleet fronts HTTP serving with the fleet "
+                         "router and needs --serve PORT")
+    if args.fleet is not None and args.watch:
+        # fail at parse time — _serve_fleet re-checks (it is also a
+        # library entry), but a pure argv conflict must not wait for
+        # a training run to finish before it fires
+        raise SystemExit(
+            "--watch is per-replica and conflicts with --fleet: "
+            "fleet-wide version changes go through the router's "
+            "coordinated swap (POST /admin/reload on the router)")
+    if args.fleet is not None and args.join:
+        raise SystemExit("--fleet runs the router; --join makes this "
+                         "process a replica of ANOTHER router — "
+                         "pick one")
+    if args.join and args.serve is None:
+        raise SystemExit("--join registers a serving replica with a "
+                         "fleet router and needs --serve PORT")
+    if args.join and args.watch:
+        raise SystemExit("--watch is a per-replica auto-swap and would "
+                         "silently break the fleet's all-or-nothing "
+                         "version invariant on a --join'ed replica; "
+                         "fleet-wide version changes go through the "
+                         "router's coordinated swap (POST /admin/reload "
+                         "on the router)")
 
     if args.artifact is not None:
         # compiled-artifact serving: no config, no model Python — the
@@ -986,6 +1123,37 @@ def main(argv=None) -> int:
         wf = trainer.workflow
         head = wf.default_output()
         spec = trainer._batch_spec["@input"]
+        if _fleet_n(args):
+            # N live replica stacks behind the router — each replica
+            # gets its OWN DecodeEngine (own slots/queue/scheduler)
+            # over the shared read-only weights, so fleet dispatch has
+            # real per-replica load to balance
+            from .logger import Logger as _Logger
+            from .runtime.deploy import DeployController
+            from .runtime.engine import DecodeEngine
+
+            def factory():
+                engine = None
+                try:
+                    engine = DecodeEngine(wf, dict(trainer.wstate),
+                                          status=trainer.status)
+                except Exception as e:  # noqa: BLE001 — a chain with
+                    # no decode path still serves /predict per replica
+                    _Logger().warning(
+                        "fleet replica serves forward-only (no decode "
+                        "engine: %s)", e)
+                srv = RestfulServer(
+                    wf.make_predict_step(head), dict(trainer.wstate),
+                    int(spec.shape[0]), tuple(spec.shape[1:]),
+                    port=0, workflow=wf, engine=engine,
+                    input_dtype=spec.dtype)
+                DeployController(server=srv,
+                                 drain_timeout_s=args.drain_timeout,
+                                 status=trainer.status,
+                                 boot_source=args.snapshot or "live")
+                return srv.start()
+
+            return _serve_fleet(args, factory, {"predict_head": head})
         srv = RestfulServer(
             wf.make_predict_step(head), trainer.wstate,
             int(spec.shape[0]), tuple(spec.shape[1:]),

@@ -141,6 +141,14 @@ COLLECTIVE_OPS = {
 HOST_LOOP_ROOTS = {
     "runtime/engine.py": ("DecodeEngine._loop",),
     "runtime/restful.py": ("RestfulServer.decode", "RestfulServer.infer"),
+    # the fleet router's host loops (runtime/fleet.py): the scrape/
+    # health thread, the per-request dispatch path, and the rolling-
+    # drain cycle.  The router is pure control plane — it must never
+    # reach a traced-program builder; declaring its loops here makes
+    # that an enforced property, not an assumption.
+    "runtime/fleet.py": ("FleetRouter._scrape_loop",
+                         "FleetRouter.handle_generate",
+                         "FleetRouter.rolling_drain"),
 }
 
 #: builders that own a documented per-geometry compile memo instead of
@@ -187,6 +195,24 @@ RESOURCE_PAIRS = {
         #                                          sweep (chunking slots
         #                                          are neither queued
         #                                          nor active)
+    },
+    # The fleet router's per-replica pending-dispatch ledger
+    # (runtime/fleet.py): every forwarded /generate registers in the
+    # chosen replica's pending set before the HTTP exchange and MUST
+    # unregister on every exit — the rolling drain waits on exactly
+    # this count, so a leaked entry wedges the drain forever.  The
+    # ejection path is the declared exit root: ejecting a crashed
+    # replica must provably empty its ledger (the dispatch threads
+    # holding entries observe the failure on their own connections and
+    # resubmit to survivors; their finally-release is idempotent).
+    "fleet-dispatch": {
+        "acquire": {"runtime/fleet.py": (
+            "FleetRouter._begin_dispatch",)},
+        "release": {"runtime/fleet.py": (
+            "FleetRouter._end_dispatch",
+            "FleetRouter._end_dispatch_locked")},
+        "exit_roots": {"runtime/fleet.py": (
+            "FleetRouter._eject_locked",)},
     },
 }
 

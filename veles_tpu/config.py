@@ -362,6 +362,31 @@ def _defaults():
     #                                             while burn >= threshold
     root.common.serve.admission.increase = 1.5  # multiplicative regrowth
     #                                             once recovery held
+    # Fleet serving (runtime/fleet.py, docs/serving.md "Fleet
+    # serving"): a lightweight router fronting N replica serving
+    # stacks — load + prefix-affinity dispatch, coordinated hot swap,
+    # rolling drain, replica ejection with resubmission.
+    root.common.serve.fleet.replicas = 0     # CLI --fleet N (0 = single
+    #                                          -replica serving, no router)
+    root.common.serve.fleet.scrape_interval_s = 0.5  # replica load/
+    #                                                  health poll period
+    root.common.serve.fleet.hysteresis = 0.5  # load-score margin a rival
+    #                                           replica must win by before
+    #                                           routing switches (stale
+    #                                           scrapes must not flap it)
+    root.common.serve.fleet.affinity_pages = 4  # prompt-head pages hashed
+    #                                             for prefix affinity
+    root.common.serve.fleet.affinity_max = 4096  # prefix->replica map
+    #                                              entries kept (LRU)
+    root.common.serve.fleet.eject_failures = 2  # consecutive scrape/
+    #                                             health failures before a
+    #                                             replica is ejected
+    root.common.serve.fleet.drain_poll_s = 0.05  # rolling-drain idle-
+    #                                              check cadence
+    root.common.serve.fleet.restart_timeout_s = 120.0  # rolling drain:
+    #                                                    replica must be
+    #                                                    /ready again
+    #                                                    within this
     root.common.serve.deadline_s = 120.0     # default per-request deadline
     root.common.serve.runner_cache = 32      # generate() compiled-runner LRU
     root.common.serve.max_body_mb = 64       # POST body cap -> 413

@@ -4,6 +4,8 @@ from .decision import Decision
 from .deploy import DeployController, ModelRegistry
 from .engine import (DecodeEngine, EngineDraining, EngineOverloaded,
                      EngineStopped, SchedulerCrashed)
+from .fleet import FleetRouter, FleetServer, InProcessReplica
+from .fleet_client import ReplicaClient, ReplicaUnavailable
 from .generate import DecodePlan, generate, generate_beam
 from .snapshotter import Snapshotter, SnapshotterToDB
 from .step_cache import StepCache, enable_persistent_cache

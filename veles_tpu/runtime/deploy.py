@@ -280,6 +280,15 @@ class DeployController(Logger):
         # re-entrant: _watch_once holds it across its check-then-act
         # (floor/dedup check -> reload()), and reload() takes it again
         self._reload_lock = threading.RLock()
+        # two-phase swap staging (the fleet router's coordinated-swap
+        # fan-out, runtime/fleet.py): (token, placed wstate, meta) —
+        # loaded + validated + on device, NOT yet serving.  Guarded by
+        # _reload_lock, which stays a deliberately-unannotated IO
+        # mutex: its contract is "one reload-shaped operation at a
+        # time, held across the load" (the VC205 carve-out), not a
+        # short-critical-section data lock.
+        self._staged_swap = None
+        self._stage_seq = 0
         self._draining = False
         self._stopped = threading.Event()
         self._drain_thread: Optional[threading.Thread] = None
@@ -619,67 +628,165 @@ class DeployController(Logger):
                 # swapping into a stopping engine would activate a
                 # version that never serves
                 raise EngineDraining("draining; not accepting reloads")
-            prev = self._live_wstate()
-            swaps_before = self.engine.swaps if self.engine is not None \
-                else None
+            return self._flip_locked(new_wstate, meta, t0, pre)
+
+    def _flip_locked(self, new_wstate: dict, meta: dict, t0: float,
+                     pre) -> dict:
+        """The flip half of a swap: apply the staged tree (rollback on
+        a mid-flip failure), record the registry entry, publish the
+        gauges.  Shared by :meth:`reload` (load+flip in one call) and
+        :meth:`commit_staged` (the fleet's two-phase commit); callers
+        hold ``_reload_lock``."""
+        prev = self._live_wstate()
+        swaps_before = self.engine.swaps if self.engine is not None \
+            else None
+        try:
+            self._apply(new_wstate)
+        except Exception as e:
+            self.last_error = f"{type(e).__name__}: {e}"
+            self._m_reload_failures.inc()
+            flipped = (swaps_before is not None
+                       and self.engine.swaps != swaps_before)
+            if flipped:
+                self.exception(
+                    "swap failed mid-flip; rolling back to the "
+                    "previous buffer")
+                try:
+                    self._apply(prev)
+                except Exception:  # noqa: BLE001
+                    self.exception("rollback failed")
+            else:
+                # the flip never landed (validation / staging /
+                # swap timeout): the old version was never
+                # displaced, so a "rollback" would only re-stage
+                # the identical live tree and block another full
+                # swap_timeout_s on an already-wedged scheduler
+                self.warning(
+                    "swap not applied (%s); old version still "
+                    "serving", self.last_error)
+            self._report()
+            raise
+        # prev dies here: only the ACTIVE buffer stays on device
+        # (re-activating an older version reloads from its source)
+        entry = self.registry.add(
+            label=meta["label"], source=meta["source"],
+            kind=meta["kind"], checksum=meta["checksum"])
+        self.registry.activate(entry["version"])
+        self.swaps += 1
+        self._m_swaps.inc()
+        self.last_swap_ms = round(1e3 * (time.monotonic() - t0), 1)
+        self._g_last_swap_ms.set(self.last_swap_ms)
+        self.last_error = None
+        post = self._compile_marker()
+        recompiled = (post - pre) if None not in (pre, post) else 0
+        if recompiled:
+            self.warning(
+                "compile counter moved across a swap (%d new "
+                "programs) — shapes should have matched exactly",
+                recompiled)
+        self.info("hot-swapped to version %d (%s, %s) in %.0f ms",
+                  entry["version"], entry["label"], entry["kind"],
+                  self.last_swap_ms)
+        if self.status is not None:
             try:
-                self._apply(new_wstate)
+                self.status.record_event(
+                    "swap", version=entry["version"],
+                    label=entry["label"], swap_ms=self.last_swap_ms)
+            except Exception:  # noqa: BLE001 — the swap LANDED; a
+                pass           # status hiccup must not report failure
+        self._report()
+        return {"active": dict(entry, active=True),
+                "swap_ms": self.last_swap_ms,
+                "compiles_during_swap": recompiled}
+
+    # -- two-phase swap (the fleet router's coordinated fan-out) ------------
+    def stage(self, source: Optional[str] = None, version=None) -> dict:
+        """Phase one of a coordinated swap (``POST /admin/stage``):
+        load, validate against the live tree, and place the new weights
+        on device as a staged buffer — WITHOUT flipping.  The old
+        version keeps serving; :meth:`commit_staged` flips,
+        :meth:`abort_staged` withdraws.  Returns ``{"staged": token,
+        ...}``; one staging at a time (a second stage before
+        commit/abort is refused, so a router fan-out can never orphan
+        a placed buffer).  Failure semantics match :meth:`reload`'s
+        load phase: any error leaves nothing staged and the old
+        version serving (the REST layer's 409)."""
+        with self._reload_lock:
+            if self.draining:
+                raise EngineDraining("draining; not accepting swaps")
+            if self._staged_swap is not None:
+                raise ValueError(
+                    f"swap {self._staged_swap[0]!r} is already staged; "
+                    "commit or abort it before staging another")
+            if version is not None:
+                entry = self.registry.get(version)
+                if entry["kind"] == "live":
+                    raise ValueError(
+                        f"version {entry['version']} is the boot state "
+                        "with no reloadable source")
+                source = entry["source"]
+            try:
+                parts, meta = self.load_source(source)
+                new_wstate = self._stage(parts)
+            except KeyError as e:
+                self.last_error = f"KeyError: {e}"
+                self._m_reload_failures.inc()
+                self._report()
+                raise ValueError(
+                    f"malformed source {source!r}: missing key "
+                    f"{e}") from e
             except Exception as e:
                 self.last_error = f"{type(e).__name__}: {e}"
                 self._m_reload_failures.inc()
-                flipped = (swaps_before is not None
-                           and self.engine.swaps != swaps_before)
-                if flipped:
-                    self.exception(
-                        "swap failed mid-flip; rolling back to the "
-                        "previous buffer")
-                    try:
-                        self._apply(prev)
-                    except Exception:  # noqa: BLE001
-                        self.exception("rollback failed")
-                else:
-                    # the flip never landed (validation / staging /
-                    # swap timeout): the old version was never
-                    # displaced, so a "rollback" would only re-stage
-                    # the identical live tree and block another full
-                    # swap_timeout_s on an already-wedged scheduler
-                    self.warning(
-                        "swap not applied (%s); old version still "
-                        "serving", self.last_error)
                 self._report()
                 raise
-            # prev dies here: only the ACTIVE buffer stays on device
-            # (re-activating an older version reloads from its source)
-            entry = self.registry.add(
-                label=meta["label"], source=meta["source"],
-                kind=meta["kind"], checksum=meta["checksum"])
-            self.registry.activate(entry["version"])
-            self.swaps += 1
-            self._m_swaps.inc()
-            self.last_swap_ms = round(1e3 * (time.monotonic() - t0), 1)
-            self._g_last_swap_ms.set(self.last_swap_ms)
-            self.last_error = None
-            post = self._compile_marker()
-            recompiled = (post - pre) if None not in (pre, post) else 0
-            if recompiled:
-                self.warning(
-                    "compile counter moved across a swap (%d new "
-                    "programs) — shapes should have matched exactly",
-                    recompiled)
-            self.info("hot-swapped to version %d (%s, %s) in %.0f ms",
-                      entry["version"], entry["label"], entry["kind"],
-                      self.last_swap_ms)
-            if self.status is not None:
-                try:
-                    self.status.record_event(
-                        "swap", version=entry["version"],
-                        label=entry["label"], swap_ms=self.last_swap_ms)
-                except Exception:  # noqa: BLE001 — the swap LANDED; a
-                    pass           # status hiccup must not report failure
-            self._report()
-            return {"active": dict(entry, active=True),
-                    "swap_ms": self.last_swap_ms,
-                    "compiles_during_swap": recompiled}
+            self._stage_seq += 1
+            token = f"stage-{self._stage_seq}"
+            self._staged_swap = (token, new_wstate, meta)
+            return {"staged": token, "label": meta["label"],
+                    "kind": meta["kind"], "checksum": meta["checksum"]}
+
+    def commit_staged(self, token: str) -> dict:
+        """Phase two: flip the buffer :meth:`stage` placed (``POST
+        /admin/commit``).  The token must match the pending staging —
+        a commit for a withdrawn or superseded stage is refused.  A
+        flip failure rolls back to the previous buffer (the
+        :meth:`reload` contract) and the staging is consumed either
+        way: the fleet's rollback path re-stages explicitly rather
+        than retrying a buffer in an unknown state."""
+        with self._reload_lock:
+            staged, self._staged_swap = self._staged_swap, None
+            if staged is None or staged[0] != str(token):
+                if staged is not None:
+                    self._staged_swap = staged  # not ours: keep it
+                raise ValueError(
+                    f"no staged swap with token {token!r} "
+                    "(stage again before committing)")
+            if self.draining:
+                raise EngineDraining("draining; not accepting swaps")
+            _tok, new_wstate, meta = staged
+            return self._flip_locked(new_wstate, meta,
+                                     time.monotonic(),
+                                     self._compile_marker())
+
+    def abort_staged(self, token: Optional[str] = None) -> dict:
+        """Withdraw a pending staging (``POST /admin/abort``): the
+        placed buffer is dropped, the old version was never displaced.
+        With no token, aborts whatever is staged (the router's
+        fan-out cleanup); idempotent — aborting nothing is fine."""
+        with self._reload_lock:
+            staged = self._staged_swap
+            if staged is not None and (token is None
+                                       or staged[0] == str(token)):
+                self._staged_swap = None
+                return {"aborted": staged[0]}
+            return {"aborted": None}
+
+    @property
+    def staged_token(self) -> Optional[str]:
+        with self._reload_lock:
+            return self._staged_swap[0] if self._staged_swap is not None \
+                else None
 
     def _compile_marker(self) -> Optional[int]:
         if self.engine is not None:
@@ -850,6 +957,7 @@ class DeployController(Logger):
         return {"swaps": self.swaps, "last_swap_ms": self.last_swap_ms,
                 "draining": self.draining, "watching": self.watching,
                 "model_dir": self.model_dir,
+                "staged": self.staged_token,
                 "last_error": self.last_error}
 
     def _report(self):

@@ -106,6 +106,27 @@ class EngineDraining(EngineStopped):
     refused (the REST layer's 503 on ``/ready`` and ``/generate``)."""
 
 
+def prefix_page_hashes(prompt, page_size: int) -> list:
+    """Chained sha256 digests of a prompt's FULL ``page_size``-token
+    pages: page ``i``'s key covers tokens ``0 .. (i+1)*page_size`` —
+    KV content depends on the whole prefix, not just the page's own
+    tokens.  This is THE prefix-cache identity (docs/serving.md "Paged
+    KV cache"): the engine keys its refcounted prefix index on it, and
+    the fleet router (runtime/fleet.py) computes the SAME digests over
+    a prompt head to route same-system-prompt sessions to the replica
+    already holding those pages — one function so the two can never
+    drift.  ``prompt`` is any 1-D int array-like; hashes are over the
+    int32 byte view, matching what the engine stores."""
+    prompt = np.asarray(prompt, np.int32).reshape(-1)
+    psz = int(page_size)
+    hashes, h = [], b""
+    for i in range(int(prompt.size) // psz):
+        h = hashlib.sha256(
+            h + prompt[i * psz:(i + 1) * psz].tobytes()).digest()
+        hashes.append(h)
+    return hashes
+
+
 def signature_mismatch(expected, got, limit: int = 6) -> str:
     """Human-readable diff of two :func:`tree_signature` results — the
     clear-error half of the hot-swap contract: name WHICH leaves differ
@@ -2266,18 +2287,12 @@ class DecodeEngine(Logger):
         return -(-(P + n_steps - 1) // self.page_size)
 
     def _prefix_hashes(self, prompt):
-        """Chained content hashes of the prompt's FULL pages: page i's
-        key covers tokens ``0 .. (i+1)*page_size`` — KV content depends
-        on the whole prefix, not just the page's own tokens."""
+        """Chained content hashes of the prompt's FULL pages
+        (:func:`prefix_page_hashes` — shared with the fleet router's
+        affinity dispatch so both sides key the same bytes)."""
         if not self._prefix_ok:
             return []
-        psz = self.page_size
-        hashes, h = [], b""
-        for i in range(int(prompt.size) // psz):
-            h = hashlib.sha256(
-                h + prompt[i * psz:(i + 1) * psz].tobytes()).digest()
-            hashes.append(h)
-        return hashes
+        return prefix_page_hashes(prompt, self.page_size)
 
     def _prefix_hits_locked(self, hashes, P: int) -> int:  # requires-lock: self._page_lock
         """Leading pages already in the prefix index (caller holds

@@ -50,6 +50,20 @@ node in production, so the :func:`enabled` fast path is one falsy check):
     shed gate, because the rehearsal is "the backlog already exists;
     prove the controller sheds and then re-opens"
     (tests/test_chaos.py).
+``replica_crash_at_request``
+    int.  The fleet router (runtime/fleet.py) kills the replica it
+    chose for its Nth dispatched request — once per arming, and only
+    replicas the router owns a kill handle for (in-process /
+    subprocess children; URL-joined replicas have no handle).  The
+    dispatch then fails over: ejection, idempotent resubmission to a
+    survivor, zero class-0 failures (tests/test_chaos.py fleet
+    rehearsal).
+``replica_slow_ms``
+    float.  Every router dispatch to the LOWEST-ID active replica is
+    held back this many milliseconds (a persistently slow replica as
+    seen from the router): its outstanding count grows and the
+    load-affinity dispatch shifts traffic to the fast survivors.
+    Fires per request while armed, like ``slow_batch_ms``.
 """
 
 from __future__ import annotations
@@ -87,7 +101,8 @@ class FaultPlan:
 
     __slots__ = ("nan_grad_at_step", "loader_ioerror_at_batch",
                  "truncate_snapshot", "slow_batch_ms", "scheduler_crash",
-                 "decode_stall_ms", "admission_burst")
+                 "decode_stall_ms", "admission_burst",
+                 "replica_crash_at_request", "replica_slow_ms")
 
     def __init__(self, cfg):
         get = cfg.get
@@ -99,12 +114,17 @@ class FaultPlan:
         self.scheduler_crash = bool(get("scheduler_crash", False))
         self.decode_stall_ms = float(get("decode_stall_ms", 0.0) or 0.0)
         self.admission_burst = int(get("admission_burst", 0) or 0)
+        self.replica_crash_at_request = int(
+            get("replica_crash_at_request", 0) or 0)
+        self.replica_slow_ms = float(get("replica_slow_ms", 0.0) or 0.0)
 
     def __bool__(self) -> bool:
         return bool(self.nan_grad_at_step or self.loader_ioerror_at_batch
                     or self.truncate_snapshot or self.slow_batch_ms
                     or self.scheduler_crash or self.decode_stall_ms
-                    or self.admission_burst)
+                    or self.admission_burst
+                    or self.replica_crash_at_request
+                    or self.replica_slow_ms)
 
     def __repr__(self) -> str:
         armed = {k: getattr(self, k) for k in self.__slots__

@@ -1,0 +1,181 @@
+"""HTTP client for one fleet replica (runtime/fleet.py).
+
+A replica is an ordinary serving stack — :class:`~.restful.RestfulServer`
+over a :class:`~.engine.DecodeEngine` or
+:class:`~.artifact.ArtifactRunner`, with a
+:class:`~.deploy.DeployController` attached — reached over plain HTTP.
+The router never links against replica objects: everything it knows
+about a replica flows through this client (scraped ``/engine`` stats,
+``/metrics`` text, ``/ready``, dispatched ``/generate`` calls, the
+two-phase ``/admin/stage`` → ``/admin/commit`` swap protocol), which is
+what makes in-process replicas, subprocess children and ``--join``ed
+remote processes indistinguishable to the dispatch logic.
+
+Connection-level failures raise :class:`ReplicaUnavailable` — the
+router's ejection/failover signal.  HTTP error *statuses* are returned,
+not raised: a 429 is backpressure to honor, a 503 a drain to route
+around, and only the router knows which of those mean "try a survivor".
+No retries happen here; the router owns failover, and its health probes
+wrap these calls in the ``deploy.http_retry`` backoff themselves.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+from typing import Optional, Tuple
+
+
+class ReplicaUnavailable(RuntimeError):
+    """The replica could not be reached at the transport level
+    (connection refused/reset, DNS, timeout) — as opposed to an HTTP
+    error status, which means the replica is alive and answering."""
+
+
+class ReplicaClient:
+    """Thin JSON-over-HTTP client bound to one replica base URL."""
+
+    def __init__(self, base_url: str, *, timeout_s: float = 30.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout_s = float(timeout_s)
+
+    def __repr__(self):
+        return f"ReplicaClient({self.base_url})"
+
+    # -- transport ----------------------------------------------------------
+    def request(self, method: str, path: str, body: Optional[dict] = None,
+                timeout: Optional[float] = None
+                ) -> Tuple[int, dict, object]:
+        """One HTTP exchange → ``(status, headers, parsed body)``.
+        Bodies are parsed as JSON when they look like it, else returned
+        as text (``/metrics``).  4xx/5xx come back as statuses with
+        their parsed bodies; only transport failures raise."""
+        data = None
+        headers = {}
+        if body is not None:
+            data = json.dumps(body).encode()
+            headers["Content-Type"] = "application/json"
+        req = urllib.request.Request(
+            self.base_url + path, data=data, headers=headers,
+            method=method)
+        timeout = self.timeout_s if timeout is None else float(timeout)
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                return r.status, dict(r.headers), self._parse(r.read())
+        except urllib.error.HTTPError as e:
+            # the server ANSWERED: an error status is information, not
+            # unavailability — read the body before the handle closes
+            with e:
+                return e.code, dict(e.headers), self._parse(e.read())
+        except (urllib.error.URLError, ConnectionError, TimeoutError,
+                OSError) as e:
+            raise ReplicaUnavailable(
+                f"{self.base_url}: {type(e).__name__}: {e}") from e
+
+    @staticmethod
+    def _parse(raw: bytes):
+        text = raw.decode("utf-8", "replace")
+        stripped = text.lstrip()
+        if stripped.startswith(("{", "[")):
+            try:
+                return json.loads(text)
+            except json.JSONDecodeError:
+                pass
+        return text
+
+    # -- scrape surface ------------------------------------------------------
+    def ready(self, timeout: Optional[float] = None) -> bool:
+        """``GET /ready`` → True on 200 (draining / SLO-degraded
+        replicas answer 503, which reads as ``False`` — deprioritized,
+        not ejected)."""
+        status, _h, _b = self.request("GET", "/ready", timeout=timeout)
+        return status == 200
+
+    def engine_stats(self, timeout: Optional[float] = None
+                     ) -> Optional[dict]:
+        """``GET /engine`` — the per-engine load/admission snapshot the
+        dispatch score reads; None when the replica serves no engine."""
+        status, _h, body = self.request("GET", "/engine",
+                                        timeout=timeout)
+        return body if status == 200 and isinstance(body, dict) else None
+
+    def metrics_text(self, timeout: Optional[float] = None) -> str:
+        """``GET /metrics`` Prometheus text — the raw material of the
+        fleet-merged ``/slo.json`` histograms."""
+        status, _h, body = self.request("GET", "/metrics",
+                                        timeout=timeout)
+        return body if status == 200 and isinstance(body, str) else ""
+
+    def models_doc(self, timeout: Optional[float] = None
+                   ) -> Optional[dict]:
+        status, _h, body = self.request("GET", "/models",
+                                        timeout=timeout)
+        return body if status == 200 and isinstance(body, dict) else None
+
+    def slo_doc(self, timeout: Optional[float] = None) -> Optional[dict]:
+        status, _h, body = self.request("GET", "/slo.json",
+                                        timeout=timeout)
+        return body if status == 200 and isinstance(body, dict) else None
+
+    # -- dispatch ------------------------------------------------------------
+    def generate(self, body: dict, timeout: Optional[float] = None
+                 ) -> Tuple[int, object, float]:
+        """Forward one ``POST /generate`` → ``(status, doc,
+        retry_after_s)``.  ``retry_after_s`` is 0.0 unless the replica
+        shed the request (429) — then it carries the replica's adaptive
+        hint (the un-rounded body value when present, else the
+        header)."""
+        status, headers, doc = self.request("POST", "/generate", body,
+                                            timeout=timeout)
+        retry = 0.0
+        if status == 429:
+            if isinstance(doc, dict) and doc.get("retry_after_s"):
+                retry = float(doc["retry_after_s"])
+            else:
+                try:
+                    retry = float(headers.get("Retry-After", 1.0))
+                except (TypeError, ValueError):
+                    retry = 1.0
+        return status, doc, retry
+
+    # -- lifecycle ops (the coordinated-swap / drain fan-out) ---------------
+    def stage(self, source: Optional[str] = None, version=None,
+              timeout: Optional[float] = None) -> Tuple[int, object]:
+        body = {}
+        if source is not None:
+            body["source"] = str(source)
+        if version is not None:
+            body["version"] = version
+        status, _h, doc = self.request("POST", "/admin/stage", body,
+                                       timeout=timeout)
+        return status, doc
+
+    def commit(self, token: str, timeout: Optional[float] = None
+               ) -> Tuple[int, object]:
+        status, _h, doc = self.request("POST", "/admin/commit",
+                                       {"token": token}, timeout=timeout)
+        return status, doc
+
+    def abort(self, token: Optional[str] = None,
+              timeout: Optional[float] = None) -> Tuple[int, object]:
+        body = {} if token is None else {"token": token}
+        status, _h, doc = self.request("POST", "/admin/abort", body,
+                                       timeout=timeout)
+        return status, doc
+
+    def reload(self, source: Optional[str] = None, version=None,
+               timeout: Optional[float] = None) -> Tuple[int, object]:
+        body = {}
+        if source is not None:
+            body["source"] = str(source)
+        if version is not None:
+            body["version"] = version
+        status, _h, doc = self.request("POST", "/admin/reload", body,
+                                       timeout=timeout)
+        return status, doc
+
+    def drain(self, timeout: Optional[float] = None) -> Tuple[int, object]:
+        status, _h, doc = self.request("POST", "/admin/drain", {},
+                                       timeout=timeout)
+        return status, doc

@@ -70,6 +70,53 @@ from .slo import slo_tracker
 from .snapshotter import SnapshotCorruptError
 
 
+def reply_json(handler, obj, code=200, headers=()):
+    """One JSON reply for every stdlib HTTP handler in the serving
+    stack (this server and the fleet router's front) — body, content
+    headers, any extras, done."""
+    body = json.dumps(obj).encode()
+    handler.send_response(code)
+    handler.send_header("Content-Type", "application/json")
+    handler.send_header("Content-Length", str(len(body)))
+    for k, v in headers:
+        handler.send_header(k, v)
+    handler.end_headers()
+    handler.wfile.write(body)
+
+
+def reply_metrics_text(handler):
+    """The Prometheus text exposition reply (``GET /metrics``) both
+    servers — and the fleet router's front — serve identically: one
+    place owns the content type and framing."""
+    body = registry().render().encode()
+    handler.send_response(200)
+    handler.send_header("Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8")
+    handler.send_header("Content-Length", str(len(body)))
+    handler.end_headers()
+    handler.wfile.write(body)
+
+
+def read_json_body(handler):
+    """Shared POST ingress: clamp a negative Content-Length (a raw
+    ``rfile.read(-1)`` would pin the handler thread until the client
+    hangs up), enforce the ``root.common.serve.max_body_mb`` cap
+    *before* reading the body into memory (the snapshot_http_max_mb
+    pattern on the ingress side), and parse JSON.  Returns the parsed
+    dict, or None after replying 413 itself.  JSON errors propagate to
+    the caller's 400 mapping."""
+    n = max(int(handler.headers.get("Content-Length", 0)), 0)
+    cap = int(float(root.common.serve.get("max_body_mb", 64)) * 2 ** 20)
+    if n > cap:
+        reply_json(handler,
+                   {"error": f"request body {n} bytes exceeds the "
+                             f"{cap} byte cap "
+                             "(root.common.serve.max_body_mb)"},
+                   code=413)
+        return None
+    return json.loads(handler.rfile.read(n)) if n else {}
+
+
 class RestfulServer(Logger):
     def __init__(self, predict_fn: Callable, wstate, batch_size: int,
                  input_shape, *, port: int = 0, host: str = "127.0.0.1",
@@ -99,14 +146,7 @@ class RestfulServer(Logger):
 
         class Handler(http.server.BaseHTTPRequestHandler):
             def _reply(self, obj, code=200, headers=()):
-                body = json.dumps(obj).encode()
-                self.send_response(code)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                for k, v in headers:
-                    self.send_header(k, v)
-                self.end_headers()
-                self.wfile.write(body)
+                reply_json(self, obj, code=code, headers=headers)
 
             def do_GET(self):
                 path = self.path.split("?", 1)[0].rstrip("/")
@@ -114,14 +154,7 @@ class RestfulServer(Logger):
                     # Prometheus text exposition on the SERVING port:
                     # the scrape target needs no second server
                     # (docs/observability.md "Metrics & tracing")
-                    body = registry().render().encode()
-                    self.send_response(200)
-                    self.send_header(
-                        "Content-Type",
-                        "text/plain; version=0.0.4; charset=utf-8")
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
+                    reply_metrics_text(self)
                     return
                 if path == "/slo.json":
                     # rolling-window latency percentiles + burn rates
@@ -169,7 +202,9 @@ class RestfulServer(Logger):
 
             def do_POST(self):
                 path = self.path.split("?", 1)[0].rstrip("/")
-                admin = path in ("/admin/reload", "/admin/drain")
+                admin = path in ("/admin/reload", "/admin/drain",
+                                 "/admin/stage", "/admin/commit",
+                                 "/admin/abort")
                 if path == "/debug/profile":
                     # duration-bounded on-demand jax.profiler capture:
                     # the shared handler (runtime/profiler.py) owns the
@@ -191,26 +226,62 @@ class RestfulServer(Logger):
                         code=404)
                     return
                 try:
-                    # negative Content-Length clamped: rfile.read(-1)
-                    # would block this thread until the client hangs up
-                    n = max(int(self.headers.get("Content-Length", 0)),
-                            0)
-                    cap = int(float(root.common.serve.get(
-                        "max_body_mb", 64)) * 2 ** 20)
-                    if n > cap:
-                        # mirror the snapshot_http_max_mb pattern: refuse
-                        # BEFORE reading an unbounded body into memory
-                        self._reply(
-                            {"error": f"request body {n} bytes exceeds "
-                                      f"the {cap} byte cap "
-                                      "(root.common.serve.max_body_mb)"},
-                            code=413)
+                    req = read_json_body(self)  # cap -> 413 inside
+                    if req is None:
                         return
-                    req = json.loads(self.rfile.read(n)) if n else {}
                     if path == "/admin/drain":
                         # async: the reply must not wait for in-flight
                         # slots to retire (202 = drain accepted)
                         self._reply(outer.deploy.begin_drain(), code=202)
+                        return
+                    if path in ("/admin/stage", "/admin/commit",
+                                "/admin/abort"):
+                        # the two-phase half of a COORDINATED fleet
+                        # swap (runtime/fleet.py): stage loads +
+                        # validates + places without flipping, commit
+                        # flips the staged buffer, abort withdraws it.
+                        # Same failure mapping as reload: a load/flip
+                        # failure is a 409 with the old version still
+                        # serving, a malformed request a 400.
+                        try:
+                            if path == "/admin/stage":
+                                source = (req.get("source")
+                                          or req.get("path"))
+                                if source is None \
+                                        and req.get("version") is None:
+                                    self._reply(
+                                        {"error": 'stage needs '
+                                                  '{"path": ...} (or '
+                                                  '"source"/"version")'},
+                                        code=400)
+                                    return
+                                self._reply(outer.deploy.stage(
+                                    source=source,
+                                    version=req.get("version")))
+                            elif path == "/admin/commit":
+                                token = req.get("token")
+                                if not token:
+                                    self._reply(
+                                        {"error": 'commit needs the '
+                                                  '{"token": ...} '
+                                                  'stage returned'},
+                                        code=400)
+                                    return
+                                self._reply(
+                                    outer.deploy.commit_staged(token))
+                            else:
+                                self._reply(outer.deploy.abort_staged(
+                                    req.get("token")))
+                        except KeyError as e:
+                            self._reply({"error": str(e)}, code=404)
+                        except (ValueError, OSError, TimeoutError,
+                                SnapshotCorruptError,
+                                ArtifactError) as e:
+                            self._reply(
+                                {"error": f"{type(e).__name__}: {e}",
+                                 "active": outer.deploy.registry
+                                 .active_version},
+                                code=409)
                         return
                     if path == "/admin/reload":
                         source = req.get("source") or req.get("path")
