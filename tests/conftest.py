@@ -60,6 +60,10 @@ def pytest_configure(config):
         "markers", "fleet: multi-replica fleet-router tests — "
         "affinity dispatch, coordinated swap, rolling drain, "
         "ejection/resubmission (tier-1; select alone with -m fleet)")
+    config.addinivalue_line(
+        "markers", "megastep: fused multi-micro-step decode tests — "
+        "bitwise identity, in-program retirement, artifact sealing "
+        "(tier-1; select alone with -m megastep)")
 
 
 @pytest.fixture(autouse=True)

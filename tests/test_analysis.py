@@ -1636,6 +1636,65 @@ def test_engine_verify_call_sites_lint_clean():
     assert [f for f in found if f.rule != "VM402"] == []
 
 
+# -- megastep builder: registry + routing contract ---------------------------
+
+def test_megastep_builder_registered_as_trace_root():
+    """The fused-decode program's builder — the fourth program kind —
+    is a declared BUILDER root exactly like the verify builder landed:
+    renaming it in runtime/engine.py without the registry would
+    silently drop its VT1xx/VP6xx coverage."""
+    from veles_tpu.analysis.registry import BUILDER
+    entry = TRACE_ROOTS["runtime/engine.py"]
+    assert entry.get("make_megastep_fn") == BUILDER
+    # routed through StepCache (VP603's contract), not a private memo
+    from veles_tpu.analysis.registry import SELF_CACHING_BUILDERS
+    assert "make_megastep_fn" not in SELF_CACHING_BUILDERS
+
+
+def test_vp603_megastep_builder_on_hot_path(tmp_path):
+    """Positive fixture: calling the megastep builder from a scheduler
+    tick without StepCache routing is the lazy-recompile hazard VP603
+    exists for — the live engine's `_compile_megastep` routes through
+    get_step, mirrored by the negative half."""
+    _write(tmp_path, "mod.py", """\
+        def make_megastep_fn(plan, ctx, S, N):  # trace-root: builder
+            def fn(x):
+                return x
+            return fn
+
+        def tick(self, plan, ctx):  # host-loop-root:
+            return make_megastep_fn(plan, ctx, 4, 8)
+
+        def tick_routed(self, plan, ctx, cache):  # host-loop-root:
+            step, _, _ = cache.get_step(
+                "megastep", ("mega", 8),
+                lambda: (make_megastep_fn(plan, ctx, 4, 8), None, None),
+                ())
+            return step
+        """)
+    found = _lint(tmp_path)
+    assert _rules(found) == ["VP603"]
+    assert found[0].symbol == "tick"
+    assert "make_megastep_fn" in found[0].message
+
+
+def test_vp601_per_call_n_into_megastep_builder(tmp_path):
+    """Positive fixture: a per-call burst length flowing into the
+    megastep builder's static N slot would compile one fused program
+    per distinct N — the exact hazard the ONE-static-N design (config
+    `serve.megastep`, sealed at export) forbids."""
+    _write(tmp_path, "mod.py", """\
+        def make_megastep_fn(plan, S, N):  # trace-root: builder
+            return N
+
+        def serve(plan, requests):
+            for req in requests:
+                make_megastep_fn(plan, 4, len(req.window))
+        """)
+    found = _lint(tmp_path)
+    assert _rules(found) == ["VP601"]
+
+
 # -- whole-package closure: the cross-module blind spot, provably closed -----
 #
 # Each pair seeds a violation SPLIT ACROSS TWO FIXTURE MODULES and

@@ -1,0 +1,406 @@
+"""Megastep decode (runtime/engine.py make_megastep_fn): the fourth
+program kind fuses N decode micro-steps into ONE compiled dispatch,
+amortizing the host scheduler pass to once per N tokens.  The emitted
+streams must be bitwise-identical to N single steps — greedy AND
+sampled, paged AND dense, attention KV AND recurrent carry — a slot
+retiring mid-block must provably stop writing KV / advancing carry for
+the remaining micro-steps, fusion must compose with speculative decode
+and chunked prefill under concurrent load with StepCache counters
+frozen after warmup, and the sealed-artifact round trip must serve the
+fused program (with pre-megastep artifacts falling back to N=1)."""
+
+import json
+import os
+import shutil
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import veles_tpu as vt
+from veles_tpu.export import export_compiled, manifest_summary
+from veles_tpu.export.compiled import MANIFEST
+from veles_tpu.models.standard import build_workflow
+from veles_tpu.ops import optimizers as opt
+from veles_tpu.runtime.artifact import ArtifactError, ArtifactRunner
+from veles_tpu.runtime.engine import DecodeEngine
+from veles_tpu.runtime.generate import generate
+from veles_tpu.runtime.snapshotter import SnapshotCorruptError
+
+pytestmark = pytest.mark.megastep
+
+V = 12
+
+LAYERS = [
+    {"type": "embedding", "vocab": V, "dim": 16, "name": "emb"},
+    {"type": "attention", "n_heads": 2, "rope": True,
+     "residual": True, "name": "a1"},
+    {"type": "layer_norm", "name": "n1"},
+    {"type": "ffn", "d_hidden": 32, "name": "f1"},
+    {"type": "seq_last", "name": "last"},
+    {"type": "softmax", "output_size": V, "name": "out"},
+]
+
+#: O(1) carried-state decode: the megastep scan threads the gru/lstm
+#: hidden state through its carry, and `write_ok` masking must freeze
+#: it — not just attention KV rows — once a slot retires mid-block.
+RECURRENT = [
+    {"type": "embedding", "vocab": V, "dim": 12, "name": "emb"},
+    {"type": "gru", "hidden": 12, "name": "g1"},
+    {"type": "lstm", "hidden": 12, "name": "l1"},
+    {"type": "seq_last", "name": "last"},
+    {"type": "softmax", "output_size": V, "name": "out"},
+]
+
+
+def _build_lm(layers=LAYERS, seed=3, name="mega_lm"):
+    wf = build_workflow(name, layers)
+    wf.build({"@input": vt.Spec((2, 6), jnp.int32),
+              "@labels": vt.Spec((2,), jnp.int32),
+              "@mask": vt.Spec((2,), jnp.float32)})
+    ws = wf.init_state(jax.random.key(seed), opt.SGD(0.1))
+    return wf, ws
+
+
+@pytest.fixture(scope="module")
+def lm():
+    return _build_lm()
+
+
+@pytest.fixture(scope="module")
+def rec_lm():
+    return _build_lm(RECURRENT, seed=5, name="mega_rec_lm")
+
+
+# -- bitwise identity ---------------------------------------------------------
+
+@pytest.mark.parametrize("paged", [True, False], ids=["paged", "dense"])
+def test_greedy_bitwise_and_dispatch_amortization(lm, rng, paged):
+    """N=4 and N=8 fused blocks emit bitwise generate()'s stream on a
+    fully-occupied (slots=1) engine, the dispatch counter drops ~N
+    below the micro-step counter, and no N ever recompiles."""
+    wf, ws = lm
+    prompt = rng.integers(0, V, (1, 7)).astype(np.int32)
+    ref = np.asarray(generate(wf, ws, prompt, 20))
+    for n in (4, 8):
+        eng = DecodeEngine(wf, ws, slots=1, l_max=64, paged=paged,
+                           megastep=n).start()
+        try:
+            got = eng.generate(prompt, 20, timeout=180)
+            st = eng.stats()
+        finally:
+            eng.stop()
+        np.testing.assert_array_equal(got, ref, err_msg=f"N={n}")
+        # every decode dispatch was a fused block: ceil(20 / n) calls,
+        # each counting its n micro-steps (the final block retires the
+        # slot mid-scan on the length bound)
+        blocks = -(-20 // n)
+        assert st["megastep"] == {"n": n, "mega_dispatches": blocks}, st
+        assert st["decode_steps"] == blocks * n, st
+        assert st["dispatches"] < st["decode_steps"], st
+        assert st["compile"]["recompiles"] == 0, st
+
+
+@pytest.mark.parametrize("paged", [True, False], ids=["paged", "dense"])
+def test_sampled_bitwise(lm, rng, paged):
+    """Sampling keys fold at the GLOBAL token position inside the scan,
+    so fused sampled streams reproduce generate() bit for bit under the
+    same key — temperature, top-k and top-p."""
+    wf, ws = lm
+    prompt = rng.integers(0, V, (1, 5)).astype(np.int32)
+    eng = DecodeEngine(wf, ws, slots=1, l_max=64, paged=paged,
+                       megastep=8).start()
+    try:
+        for kwargs in ({"temperature": 1.3, "top_k": 6},
+                       {"temperature": 1.5, "top_p": 0.9},
+                       {"temperature": 0.7, "top_k": 6, "top_p": 0.8}):
+            ref = np.asarray(generate(wf, ws, prompt, 14,
+                                      key=jax.random.key(7), **kwargs))
+            got = eng.generate(prompt, 14, key=jax.random.key(7),
+                               timeout=180, **kwargs)
+            np.testing.assert_array_equal(got, ref, err_msg=str(kwargs))
+        assert eng.stats()["megastep"]["mega_dispatches"] > 0
+    finally:
+        eng.stop()
+
+
+def test_recurrent_carry_bitwise(rec_lm, rng):
+    """The scan carry threads gru/lstm hidden state across micro-steps:
+    greedy and sampled streams on the recurrent family stay bitwise
+    generate()'s for N=4 and N=8."""
+    wf, ws = rec_lm
+    prompt = rng.integers(0, V, (1, 9)).astype(np.int32)
+    ref = np.asarray(generate(wf, ws, prompt, 18))
+    ref_s = np.asarray(generate(wf, ws, prompt, 12, temperature=1.4,
+                                top_k=5, key=jax.random.key(11)))
+    for n in (4, 8):
+        eng = DecodeEngine(wf, ws, slots=1, l_max=64, megastep=n).start()
+        try:
+            np.testing.assert_array_equal(
+                eng.generate(prompt, 18, timeout=180), ref,
+                err_msg=f"N={n}")
+            np.testing.assert_array_equal(
+                eng.generate(prompt, 12, temperature=1.4, top_k=5,
+                             key=jax.random.key(11), timeout=180),
+                ref_s, err_msg=f"N={n} sampled")
+            assert eng.stats()["megastep"]["mega_dispatches"] > 0
+        finally:
+            eng.stop()
+
+
+# -- in-program retirement ----------------------------------------------------
+
+def _snapshot_after(wf, ws, prompt, n_steps, eos, megastep):
+    """Run one request to retirement, stop the engine, and return
+    (tokens, pos, caches-as-numpy) — the post-run device state the
+    masking proof compares across N."""
+    eng = DecodeEngine(wf, ws, slots=1, l_max=64, megastep=megastep)
+    eng.start()
+    try:
+        got = eng.generate(prompt, n_steps, eos_id=eos, timeout=180)
+    finally:
+        eng.stop()
+    return got, np.array(eng._pos), jax.tree.map(np.asarray, eng._caches)
+
+
+@pytest.mark.parametrize("family", ["attention", "recurrent"])
+def test_mid_megastep_eos_retirement_freezes_kv_and_carry(
+        lm, rec_lm, rng, family):
+    """A slot whose eos lands mid-block retires INSIDE the scan: the
+    output is bitwise generate(eos_id=...)'s, and the remaining
+    micro-steps provably wrote nothing — the dense cache (attention KV
+    rows / recurrent carry) and the position vector after the fused run
+    equal the N=1 engine's bit for bit, so micro-steps past the
+    retirement point neither wrote KV nor advanced the carry."""
+    wf, ws = lm if family == "attention" else rec_lm
+    prompt = rng.integers(0, V, (1, 9)).astype(np.int32)
+    full = np.asarray(generate(wf, ws, prompt, 24))[0, 9:]
+    # latest token whose emission is its own first occurrence, chosen
+    # so retirement lands mid-block (step index not a multiple of 8) —
+    # the generated suffix is deterministic, so this is stable
+    eos = next(int(t) for i, t in reversed(list(enumerate(full)))
+               if t not in full[:i] and (i + 1) % 8 != 0)
+    ref = np.asarray(generate(wf, ws, prompt, 24, eos_id=eos))
+    got1, pos1, caches1 = _snapshot_after(wf, ws, prompt, 24, eos, 1)
+    got8, pos8, caches8 = _snapshot_after(wf, ws, prompt, 24, eos, 8)
+    np.testing.assert_array_equal(got1, ref)
+    np.testing.assert_array_equal(got8, ref)
+    np.testing.assert_array_equal(pos8, pos1)
+    leaves1 = jax.tree.leaves(caches1)
+    leaves8 = jax.tree.leaves(caches8)
+    assert len(leaves1) == len(leaves8) and leaves1
+    for a, b in zip(leaves1, leaves8):
+        np.testing.assert_array_equal(b, a)
+
+
+def test_partial_batch_drops_to_single_steps(lm, rng):
+    """Fusion engages ONLY at full occupancy: one request on a slots=2
+    engine runs plain N=1 dispatches end to end (interactive latency
+    never waits on a fused block), while two concurrent requests fill
+    the batch and fuse."""
+    wf, ws = lm
+    prompt = rng.integers(0, V, (1, 7)).astype(np.int32)
+    ref = np.asarray(generate(wf, ws, prompt, 16))
+    eng = DecodeEngine(wf, ws, slots=2, l_max=64, window_ms=1.0,
+                       megastep=4).start()
+    try:
+        got = eng.generate(prompt, 16, timeout=180)
+        np.testing.assert_array_equal(got, ref)
+        st = eng.stats()
+        assert st["megastep"]["mega_dispatches"] == 0, st
+        assert st["dispatches"] == st["decode_steps"], st
+        # now fill both slots: the all-active window fuses
+        results = [None, None]
+
+        def worker(i):
+            results[i] = eng.generate(prompt, 16, timeout=300)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600)
+        for got in results:
+            np.testing.assert_array_equal(got, ref)
+        st = eng.stats()
+        assert st["megastep"]["mega_dispatches"] > 0, st
+        assert st["dispatches"] < st["decode_steps"], st
+        assert st["compile"]["recompiles"] == 0, st
+    finally:
+        eng.stop()
+
+
+# -- composition: spec decode + chunked prefill under concurrent load ---------
+
+def test_composition_spec_chunked_counters_frozen(lm, rng):
+    """Megastep + speculative decode + chunked prefill on one engine
+    under mixed-shape concurrent load: every stream bitwise, the
+    StepCache counters FROZEN after warmup (the whole inventory —
+    prefill buckets, decode, verify, megastep — compiles once), zero
+    recompiles, and both the verify and megastep paths demonstrably
+    ran."""
+    wf, ws = lm
+    eng = DecodeEngine(wf, ws, slots=4, l_max=64, window_ms=1.0,
+                       queue_depth=64, spec=True, spec_k=4,
+                       prefill_chunk=16, megastep=4).start()
+    work = [(rng.integers(0, V, (1, int(p))).astype(np.int32), int(n))
+            for p, n in zip(rng.integers(4, 40, 16),
+                            rng.integers(6, 18, 16))]
+    # four equal-length requests saturate the batch at the tail of the
+    # warmup so the fused path provably engages before the freeze
+    burst = rng.integers(0, V, (1, 6)).astype(np.int32)
+    refs = [np.asarray(generate(wf, ws, pr, n)) for pr, n in work]
+    burst_ref = np.asarray(generate(wf, ws, burst, 12))
+    try:
+        for pr, n in work[:4]:            # warm every prefill bucket
+            eng.generate(pr, n, timeout=300)
+        results = [None] * 4
+
+        def bworker(i):
+            results[i] = eng.generate(burst, 12, timeout=300)
+
+        threads = [threading.Thread(target=bworker, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600)
+        for got in results:
+            np.testing.assert_array_equal(got, burst_ref)
+        st = eng.stats()
+        assert st["megastep"]["mega_dispatches"] > 0, st
+        compiles = st["compile"]["compiles"]
+
+        results = [None] * len(work)
+
+        def worker(i):
+            results[i] = eng.generate(work[i][0], work[i][1],
+                                      timeout=300)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(len(work))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600)
+        for i, (got, ref) in enumerate(zip(results, refs)):
+            np.testing.assert_array_equal(got, ref, err_msg=str(i))
+        st = eng.stats()
+        assert st["compile"]["compiles"] == compiles, st["compile"]
+        assert st["compile"]["recompiles"] == 0
+        assert st["spec"]["verify_steps"] > 0
+    finally:
+        eng.stop()
+
+
+# -- sealed-artifact round trip -----------------------------------------------
+
+@pytest.fixture(scope="module")
+def sealed(tmp_path_factory):
+    """One megastep-sealed export pays for the module."""
+    tmp = tmp_path_factory.mktemp("megastep_artifact")
+    wf, ws = _build_lm(seed=21, name="mega_art_lm")
+    art = str(tmp / "art")
+    man = export_compiled(wf, ws, art, slots=1, l_max=32, megastep=4)
+    return wf, ws, art, man
+
+
+def test_sealed_artifact_roundtrip_bitwise_flat_counters(sealed, rng):
+    """export_compiled(megastep=4) seals programs/megastep.bin; the
+    runner serves the fused program by default (manifest n), bitwise
+    the live generate(), dispatches amortized, counters flat after
+    boot; megastep=1 at load opts out without re-export."""
+    wf, ws, art, man = sealed
+    assert man["megastep"] == {"n": 4}
+    assert "megastep" in man["programs"]
+    assert "programs/megastep.bin" in manifest_summary(man)["programs"]
+    prompt = rng.integers(0, V, (1, 9)).astype(np.int32)
+    ref = np.asarray(generate(wf, ws, prompt, 16))
+    r = ArtifactRunner(art, window_ms=0.0).start()
+    try:
+        assert r.megastep == 4
+        boot = r.stats()["compile"]["compiles"]
+        np.testing.assert_array_equal(
+            r.generate(prompt, 16, timeout=180), ref)
+        st = r.stats()
+        assert st["megastep"]["mega_dispatches"] > 0
+        assert st["dispatches"] < st["decode_steps"], st
+        assert st["compile"]["compiles"] == boot
+        assert st["compile"]["recompiles"] == 0
+    finally:
+        r.stop()
+    # explicit opt-out: same artifact, plain N=1 serving, still bitwise
+    r = ArtifactRunner(art, window_ms=0.0, megastep=1).start()
+    try:
+        assert r.megastep == 1
+        np.testing.assert_array_equal(
+            r.generate(prompt, 16, timeout=180), ref)
+        st = r.stats()
+        assert "megastep" not in st
+        assert st["dispatches"] == st["decode_steps"], st
+    finally:
+        r.stop()
+    # a DIFFERENT N than the sealed one needs a re-export — the runner
+    # has no model code to trace a new program from
+    with pytest.raises(ArtifactError, match="re-export"):
+        ArtifactRunner(art, megastep=8)
+
+
+def test_pre_megastep_artifact_falls_back_to_single_steps(
+        tmp_path, rng):
+    """An artifact sealed BEFORE megastep existed (no manifest entry;
+    exercised literally via a format_version=2 manifest) loads
+    unchanged and serves N=1; asking it for fusion is a loud
+    ArtifactError naming the re-export fix."""
+    wf, ws = _build_lm(seed=22, name="mega_v2_lm")
+    art = str(tmp_path / "plain")
+    man = export_compiled(wf, ws, art, slots=1, l_max=32)
+    assert man["megastep"] is None
+    with pytest.raises(ArtifactError, match="re-export"):
+        ArtifactRunner(art, megastep=4)
+    # strip the key entirely and stamp the pre-megastep format version:
+    # the loader must treat absence as N=1, not KeyError
+    old = str(tmp_path / "v2")
+    shutil.copytree(art, old)
+    mp = os.path.join(old, MANIFEST)
+    doc = json.load(open(mp))
+    del doc["megastep"]
+    doc["format_version"] = 2
+    json.dump(doc, open(mp, "w"))
+    prompt = rng.integers(0, V, (1, 7)).astype(np.int32)
+    ref = np.asarray(generate(wf, ws, prompt, 10))
+    r = ArtifactRunner(old, window_ms=0.0).start()
+    try:
+        assert r.megastep == 1
+        np.testing.assert_array_equal(
+            r.generate(prompt, 10, timeout=180), ref)
+        assert "megastep" not in r.stats()
+    finally:
+        r.stop()
+
+
+def test_damaged_megastep_manifest_is_corruption(sealed, tmp_path):
+    """A manifest claiming megastep without a static n >= 2 or without
+    the sealed program blob is parseable-but-damaged: the load answers
+    SnapshotCorruptError (re-export), not a KeyError mid-boot."""
+    wf, ws, art, man = sealed
+    bad = str(tmp_path / "bad")
+    shutil.copytree(art, bad)
+    mp = os.path.join(bad, MANIFEST)
+    doc = json.load(open(mp))
+    doc["megastep"] = {"n": "four"}               # no static int n
+    json.dump(doc, open(mp, "w"))
+    with pytest.raises(SnapshotCorruptError, match="megastep"):
+        ArtifactRunner(bad)
+    doc["megastep"] = {"n": 1}                    # below the fusion floor
+    json.dump(doc, open(mp, "w"))
+    with pytest.raises(SnapshotCorruptError, match="megastep"):
+        ArtifactRunner(bad)
+    doc["megastep"] = {"n": 4}
+    del doc["programs"]["megastep"]               # claim without blob
+    json.dump(doc, open(mp, "w"))
+    with pytest.raises(SnapshotCorruptError, match="megastep"):
+        ArtifactRunner(bad)

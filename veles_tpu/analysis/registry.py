@@ -58,6 +58,8 @@ TRACE_ROOTS = {
         "_make_paged_prefill_fn": BUILDER,
         "make_verify_fn": BUILDER,      # speculative verify program
         #                                 (the third program kind)
+        "make_megastep_fn": BUILDER,    # fused N-micro-step decode
+        #                                 (the fourth program kind)
         "_sample_slots": TRACED,
     },
     # step_cache.py compiles programs other modules build; it never

@@ -334,6 +334,15 @@ def _defaults():
     root.common.serve.spec.k = 4             # draft tokens per verify
     root.common.serve.spec.drafter = "ngram"  # host drafter (prompt
     #                                           lookup; no second model)
+    # Megastep decode (docs/serving.md "Megastep decode"): fuse N decode
+    # micro-steps into ONE compiled dispatch (the fourth program kind),
+    # amortizing the host scheduler pass to once per N tokens.  Engaged
+    # only when every slot is busy and nothing is pending (admission,
+    # chunked prefill, a speculative draft) — otherwise the engine runs
+    # plain N=1 steps so interactive latency never waits on a fused
+    # block.  Emitted tokens stay bitwise the N=1 engine's.
+    root.common.serve.megastep = 1           # micro-steps per dispatch
+    #                                          (1 = off)
     root.common.serve.window_ms = 2.0        # admission batching window
     root.common.serve.queue_depth = 64       # pending requests before 429
     # Overload survival (docs/serving.md "Overload survival"): chunked

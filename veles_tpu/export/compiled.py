@@ -59,7 +59,11 @@ FORMAT = "veles-tpu-compiled-artifact"
 #: calling convention (docs/serving.md "Overload survival").  Version
 #: 1 and 2 artifacts still load — the runner keeps the old dense
 #: convention and gates chunking off — but v3 artifacts are refused by
-#: older readers (docs/serving_export.md).
+#: older readers (docs/serving_export.md).  The megastep program
+#: (``programs/megastep.bin`` + manifest ``megastep: {"n": N}``) is an
+#: ADDITIVE v3 extension like ``spec_decode``: artifacts without it —
+#: every v1/v2 artifact and any v3 export at megastep=1 — load
+#: unchanged and serve plain per-token decode.
 FORMAT_VERSION = 3
 
 
@@ -149,6 +153,7 @@ def export_compiled(workflow, wstate, out_dir: str, *,
                     paged_kernel: Optional[bool] = None,
                     spec: Optional[bool] = None,
                     spec_k: Optional[int] = None,
+                    megastep: Optional[int] = None,
                     cache_dtype=jnp.float32,
                     output_unit: Optional[str] = None,
                     input_spec: Optional[dict] = None,
@@ -180,11 +185,18 @@ def export_compiled(workflow, wstate, out_dir: str, *,
     artifacts load unchanged, ``spec_decode`` absent).  ``paged_kernel``
     seals the fused Pallas paged-attention read path into the decode /
     verify programs (bounded-error; manifest records it).
+
+    ``megastep`` (default ``root.common.serve.megastep``; > 1)
+    additionally seals the decode **megastep** program — the fourth
+    program kind, N micro-steps fused per dispatch at the decode
+    calling convention — and records ``megastep: {"n": N}``; an
+    ``ArtifactRunner`` fuses steps only when that program is sealed and
+    falls back to plain per-token decode otherwise.
     """
     from ..config import root
     from ..runtime.engine import (bucket_table, make_decode_fn,
-                                  make_prefill_fn, make_verify_fn,
-                                  resolve_serve_geometry)
+                                  make_megastep_fn, make_prefill_fn,
+                                  make_verify_fn, resolve_serve_geometry)
     from ..runtime.generate import DecodePlan
     from ..runtime.snapshotter import _flatten, _fsync_dir, _to_numpy
     from ..units.base import Context
@@ -192,8 +204,10 @@ def export_compiled(workflow, wstate, out_dir: str, *,
 
     geo = resolve_serve_geometry(slots, l_max, bucket_min, paged=paged,
                                  page_size=page_size, pages=pages,
-                                 paged_kernel=paged_kernel)
+                                 paged_kernel=paged_kernel,
+                                 megastep=megastep)
     slots, l_max, bucket_min = geo.slots, geo.l_max, geo.bucket_min
+    mega_n = geo.megastep
     spec_on = bool(root.common.serve.spec.get("enabled", False)
                    if spec is None else spec)
     spec_k = int(root.common.serve.spec.get("k", 4)
@@ -340,6 +354,21 @@ def export_compiled(workflow, wstate, out_dir: str, *,
                                           file="programs/verify.bin",
                                           sha256=sha)
 
+            if mega_n > 1:
+                # the megastep program: decode's exact calling
+                # convention, N micro-steps fused — sealed at ONE
+                # static N, the manifest's megastep contract
+                blob, info = _export_one(
+                    make_megastep_fn(plan, ctx, S, mega_n,
+                                     page_size=psz,
+                                     paged_kernel=geo.paged_kernel),
+                    decode_sds)
+                sha = _write_blob(
+                    os.path.join(out_dir, "programs", "megastep.bin"),
+                    blob, staged)
+                programs["megastep"] = dict(
+                    info, file="programs/megastep.bin", sha256=sha)
+
             prefills = {}
             for pb in bucket_table(bucket_min, l_max):
                 if geo.paged:
@@ -405,6 +434,12 @@ def export_compiled(workflow, wstate, out_dir: str, *,
             # serve-spec-or-reject contract
             "spec_decode": ({"k": spec_k} if spec_on and decode_meta
                             else None),
+            # megastep decode support: present (with the sealed fused
+            # program's static N) only when the megastep program is in
+            # the sealed inventory — artifacts without it serve plain
+            # per-token decode (additive; v1/v2 load unchanged)
+            "megastep": ({"n": mega_n} if mega_n > 1 and decode_meta
+                         else None),
             "cache_dtype": jnp.dtype(cache_dtype).name,
             "vocab": vocab,
             "input_vocab": input_vocab,
@@ -467,6 +502,7 @@ def manifest_summary(manifest: dict) -> dict:
         "pages": manifest.get("pages"),
         "paged_kernel": manifest.get("paged_kernel", False),
         "spec_decode": manifest.get("spec_decode"),
+        "megastep": manifest.get("megastep"),
         "buckets": manifest.get("buckets"),
         "vocab": manifest.get("vocab"),
         "programs": sorted(

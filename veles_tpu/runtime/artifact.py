@@ -303,7 +303,8 @@ class ArtifactRunner(DecodeEngine):
                  window_ms: Optional[float] = None,
                  queue_depth: Optional[int] = None,
                  deadline_s: Optional[float] = None, status=None,
-                 spec: Optional[bool] = None):
+                 spec: Optional[bool] = None,
+                 megastep: Optional[int] = None):
         self.art_dir = str(art_dir)
         man = read_manifest(self.art_dir)
         verify_artifact(self.art_dir, man)
@@ -335,6 +336,32 @@ class ArtifactRunner(DecodeEngine):
                 "program (spec_decode absent from the manifest); "
                 "re-export with export_compiled(..., spec=True) — the "
                 "runner cannot trace one from sealed programs")
+        # megastep decode is served iff the fused program is part of
+        # the SEALED inventory (manifest megastep + the program blob);
+        # artifacts without it — every v1/v2, and v3 exports at
+        # megastep=1 — load unchanged and serve plain per-token decode.
+        # An explicit megastep > 1 must match the sealed static N: the
+        # runner has no model code to trace another fused program from.
+        mega_meta = man.get("megastep") or None
+        if mega_meta is not None and (
+                not isinstance(mega_meta, dict)
+                or not isinstance(mega_meta.get("n"), int)
+                or mega_meta["n"] < 2
+                or "megastep" not in progs):
+            raise SnapshotCorruptError(
+                f"{art_dir}: artifact manifest megastep entry is "
+                "damaged (no static n >= 2, or no sealed megastep "
+                "program) — re-export")
+        sealed_n = int(mega_meta["n"]) if mega_meta else 1
+        want_mega = sealed_n if megastep is None else int(megastep)
+        if want_mega > 1 and want_mega != sealed_n:
+            raise ArtifactError(
+                f"artifact {art_dir!r} seals "
+                + (f"megastep N={sealed_n}" if sealed_n > 1
+                   else "no megastep program")
+                + f", megastep={want_mega} was requested; re-export "
+                "with export_compiled(..., megastep=N) — the runner "
+                "cannot trace one from sealed programs")
 
         self.manifest = man
         self.workflow = None            # the whole point: no model code
@@ -364,7 +391,8 @@ class ArtifactRunner(DecodeEngine):
                                                     False)),
                           spec=want_spec,
                           spec_k=(int(spec_meta["k"]) if want_spec
-                                  else None))
+                                  else None),
+                          megastep=want_mega)
         # v3 calling convention (manifest ``prefill_start``): the sealed
         # prefill programs take the traced ``start``, so chunked prefill
         # and preempt-resume are plain bucket calls on them.  Absent
@@ -389,6 +417,12 @@ class ArtifactRunner(DecodeEngine):
         self._exp_verify = (
             _deserialize(self.art_dir, man, "verify", progs["verify"])
             if want_spec else None)
+        # same load-before-_init_runtime ordering: the base engine
+        # compiles the megastep program there when megastep > 1
+        self._exp_mega = (
+            _deserialize(self.art_dir, man, "megastep",
+                         progs["megastep"])
+            if want_mega > 1 else None)
         self._exp_prefill = {
             int(pb): _deserialize(self.art_dir, man, f"prefill_{pb}", q)
             for pb, q in progs.get("prefill", {}).items()}
@@ -418,15 +452,18 @@ class ArtifactRunner(DecodeEngine):
                 lambda: (jax.jit(self._exp_forward.call), None, None),
                 args)
         self.info(
-            "artifact %s: %d programs (%d prefill buckets%s%s), "
+            "artifact %s: %d programs (%d prefill buckets%s%s%s), "
             "vocab=%s, %d compiles at load",
             self.art_dir, len(self._exp_prefill) + 1
             + (self._exp_forward is not None)
-            + (self._exp_verify is not None),
+            + (self._exp_verify is not None)
+            + (self._exp_mega is not None),
             len(self._exp_prefill),
             ", forward" if self._exp_forward is not None else "",
             f", verify k={self.spec_k}" if self._exp_verify is not None
             else "",
+            f", megastep n={self.megastep}"
+            if self._exp_mega is not None else "",
             man.get("vocab"), self.step_cache.compiles)
 
     # -- program hooks (everything else is the engine, unchanged) -----------
@@ -455,6 +492,14 @@ class ArtifactRunner(DecodeEngine):
             lambda: (jax.jit(self._exp_verify.call,
                              donate_argnums=(1, 2)), None, None),
             self._verify_args_sds(params), pin=(self._exp_verify,))
+        return step
+
+    def _compile_megastep(self, params):
+        step, _, _ = self.step_cache.get_step(
+            "megastep", self._geometry_key() + ("mega", self.megastep),
+            lambda: (jax.jit(self._exp_mega.call,
+                             donate_argnums=(1, 2)), None, None),
+            self._decode_args_sds(params), pin=(self._exp_mega,))
         return step
 
     def _prefill_fn(self, pb: int, params, full_ctx: bool = True):
@@ -500,6 +545,7 @@ class ArtifactRunner(DecodeEngine):
             "jax_version": self.manifest.get("jax_version"),
             "programs": len(self._exp_prefill) + 1
             + (self._exp_forward is not None)
-            + (self._exp_verify is not None),
+            + (self._exp_verify is not None)
+            + (self._exp_mega is not None),
         }
         return st

@@ -319,6 +319,84 @@ def make_verify_fn(plan, ctx, S: int, K: int, *,
     return jax.jit(verify_step, donate_argnums=(1, 2))
 
 
+def make_megastep_fn(plan, ctx, S: int, N: int, *,
+                     page_size: Optional[int] = None,
+                     paged_kernel: bool = False):
+    """The engine's decode **megastep** — the fourth program kind: ``N``
+    decode micro-steps fused into ONE compiled dispatch (static ``N =
+    root.common.serve.megastep``; module-level for the same exporter
+    single-source reason as :func:`make_decode_fn`).  The host loop, not
+    the math, bounds tokens/s at production batch sizes; keeping the
+    token loop inside XLA amortizes the dispatch + scheduler pass to
+    once per ``N`` tokens (docs/serving.md "Megastep decode").
+
+    The body is the verify scan (:func:`make_verify_fn`) minus draft
+    matching: each micro-step feeds every live slot its last written
+    token at its own position, samples with the slot's key folded at
+    that GLOBAL position (so emitted tokens are **bitwise** what N
+    separate decode steps emit, greedy and sampled alike), writes the
+    token, and retires the slot in-program on eos or its length bound.
+    A slot retired at micro-step ``i`` stops writing KV, advancing
+    recurrent carry, and emitting tokens for steps ``i+1..N`` — the
+    ``write_ok`` discipline of :func:`make_decode_fn`, with paged
+    masked writes routed to the scratch pool row and dense ones
+    dropped, so a retired slot's rows (possibly mid-chunked-prefill
+    after reassignment) are provably untouched.
+
+    Same calling convention as the decode program (paged inserts
+    ``ptab``).  Returns ``(caches, toks, pos, active, finished,
+    emitted)``: ``toks`` holds each slot's emitted-token buffer at
+    ``[old_pos+1 .. old_pos+emitted]`` and ``emitted`` (S,) int32
+    counts tokens this call emitted per slot — the host retires,
+    streams, and accounts them in one bulk pass."""
+
+    def mega_core(params, caches, toks, ptab, pos, active, temp,
+                  topk, topp, eos, end, keys):
+        rows = jnp.arange(S)
+
+        def body(carry, _):
+            caches, toks, p, alive, fin, emitted = carry
+            tok = toks[rows, p]
+            if page_size is None:
+                logits, caches2 = plan.step(params, caches, tok, p, ctx,
+                                            write_ok=alive)
+            else:
+                logits, caches2 = plan.step(
+                    params, caches, tok, p, ctx,
+                    pages=(ptab, page_size, alive),
+                    paged_kernel=paged_kernel)
+            step_keys = jax.vmap(jax.random.fold_in)(
+                jax.random.wrap_key_data(keys), p)
+            nxt = _sample_slots(logits, step_keys, temp, topk, topp)
+            new_p = jnp.where(alive, p + 1, p)
+            cur = toks[rows, new_p]
+            toks = toks.at[rows, new_p].set(jnp.where(alive, nxt, cur))
+            emitted = emitted + alive.astype(jnp.int32)
+            done = alive & ((nxt == eos) | (new_p >= end))
+            fin = fin | done
+            alive = alive & ~done
+            return (caches2, toks, new_p, alive, fin, emitted), None
+
+        init = (caches, toks, pos, active, jnp.zeros(S, bool),
+                jnp.zeros(S, jnp.int32))
+        (caches, toks, pos, _, fin, emitted), _ = jax.lax.scan(
+            body, init, None, length=N)
+        return caches, toks, pos, active & ~fin, fin, emitted
+
+    if page_size is None:
+        def megastep(params, caches, toks, pos, active, temp, topk,
+                     topp, eos, end, keys):
+            return mega_core(params, caches, toks, None, pos, active,
+                             temp, topk, topp, eos, end, keys)
+    else:
+        def megastep(params, caches, toks, ptab, pos, active, temp,
+                     topk, topp, eos, end, keys):
+            return mega_core(params, caches, toks, ptab, pos, active,
+                             temp, topk, topp, eos, end, keys)
+
+    return jax.jit(megastep, donate_argnums=(1, 2))
+
+
 #: parked/cold speculative-drafting probe interval (scheduler ticks):
 #: a workload the drafter cannot pay for decays to plain decode plus
 #: one drafting attempt — and, when a draft exists, one measuring
@@ -572,7 +650,9 @@ class ServeGeometry(NamedTuple):
     dense.  ``n_ptab`` (= l_max // page_size) is the per-slot page-table
     width — the number of logical pages a max-length request spans.
     ``paged_kernel`` routes paged attention reads through the fused
-    Pallas kernel (bounded-error; only meaningful when ``paged``)."""
+    Pallas kernel (bounded-error; only meaningful when ``paged``).
+    ``megastep`` is the decode micro-steps fused per dispatch (1 =
+    plain per-token stepping; see :func:`make_megastep_fn`)."""
     slots: int
     l_max: int
     bucket_min: int
@@ -580,6 +660,7 @@ class ServeGeometry(NamedTuple):
     page_size: int
     pages: int
     paged_kernel: bool = False
+    megastep: int = 1
 
     @property
     def n_ptab(self) -> int:
@@ -588,7 +669,7 @@ class ServeGeometry(NamedTuple):
 
 def resolve_serve_geometry(slots=None, l_max=None, bucket_min=None,
                            paged=None, page_size=None, pages=None,
-                           paged_kernel=None):
+                           paged_kernel=None, megastep=None):
     """Slot-batch geometry with ``root.common.serve`` defaults — ONE
     resolution shared by the live engine and the compiled-artifact
     exporter (export/compiled.py), so a default-configured export's
@@ -619,13 +700,18 @@ def resolve_serve_geometry(slots=None, l_max=None, bucket_min=None,
     # still loads under a paged_kernel-on config)
     use_kernel = bool(serve.get("paged_kernel", False)
                       if paged_kernel is None else paged_kernel)
+    mega = int(serve.get("megastep", 1)
+               if megastep is None else megastep)
+    if mega < 1:
+        raise ValueError(
+            f"serve.megastep must be >= 1, got {mega}")
     if not use_paged:
         if paged_kernel:
             raise ValueError(
                 "paged_kernel requires the paged KV layout "
                 "(root.common.serve.paged / paged=True)")
         return ServeGeometry(slots, l_max, bucket_min, False, psz, 0,
-                             False)
+                             False, mega)
     if psz < 1:
         raise ValueError(f"page_size must be >= 1, got {psz}")
     if l_max % psz:
@@ -644,7 +730,7 @@ def resolve_serve_geometry(slots=None, l_max=None, bucket_min=None,
             f"page pool of {pages} pages cannot hold one max-length "
             f"request ({n_ptab} pages of {psz} tokens for l_max {l_max})")
     return ServeGeometry(slots, l_max, bucket_min, True, psz, pages,
-                         use_kernel)
+                         use_kernel, mega)
 
 
 def prefill_bucket(p: int, bucket_min: int, l_max: int) -> int:
@@ -879,6 +965,7 @@ class DecodeEngine(Logger):
                  spec: Optional[bool] = None,
                  spec_k: Optional[int] = None,
                  spec_drafter: Optional[str] = None,
+                 megastep: Optional[int] = None,
                  priorities: Optional[int] = None,
                  preempt: Optional[bool] = None,
                  prefill_chunk: Optional[int] = None,
@@ -890,6 +977,7 @@ class DecodeEngine(Logger):
                           paged=paged, page_size=page_size, pages=pages,
                           paged_kernel=paged_kernel, spec=spec,
                           spec_k=spec_k, spec_drafter=spec_drafter,
+                          megastep=megastep,
                           priorities=priorities, preempt=preempt,
                           prefill_chunk=prefill_chunk,
                           admission=admission)
@@ -908,19 +996,24 @@ class DecodeEngine(Logger):
                      deadline_s, bucket_min=None, paged=None,
                      page_size=None, pages=None, paged_kernel=None,
                      spec=None, spec_k=None, spec_drafter=None,
-                     priorities=None, preempt=None, prefill_chunk=None,
-                     admission=None):
+                     megastep=None, priorities=None, preempt=None,
+                     prefill_chunk=None, admission=None):
         serve = root.common.serve
         geo = resolve_serve_geometry(slots, l_max, bucket_min,
                                      paged=paged, page_size=page_size,
                                      pages=pages,
-                                     paged_kernel=paged_kernel)
+                                     paged_kernel=paged_kernel,
+                                     megastep=megastep)
         self.slots, self.l_max, self.bucket_min = \
             geo.slots, geo.l_max, geo.bucket_min
         self.paged, self.page_size, self.pages = \
             geo.paged, geo.page_size, geo.pages
         self.n_ptab = geo.n_ptab
         self.paged_kernel = geo.paged_kernel
+        # megastep decode (docs/serving.md "Megastep decode"): N decode
+        # micro-steps per dispatch; 1 = the plain per-token loop and no
+        # fourth program is compiled at all
+        self.megastep = geo.megastep
         self.window_s = float(window_ms if window_ms is not None
                               else serve.get("window_ms", 2.0)) / 1e3
         self.queue_depth = int(queue_depth if queue_depth is not None
@@ -1045,6 +1138,7 @@ class DecodeEngine(Logger):
         self._rejected = ScopedCounter(self._m_rejected)
         self._timeouts = ScopedCounter(self._m_timeouts)
         self._decode_steps = ScopedCounter(self._m_decode_steps)
+        self._dispatches = ScopedCounter(self._m_dispatches)
         self._tok_count = ScopedCounter(self._m_tokens)
         self._occupancy_sum = 0
         self._rate_mark = (time.monotonic(), 0)
@@ -1071,6 +1165,18 @@ class DecodeEngine(Logger):
 
         # the lifetime decode program, AOT-compiled up front
         self._decode = self._compile_decode(params)
+
+        # megastep decode: the fourth program kind, compiled only when
+        # configured on (N > 1) — an N=1 engine never pays its compile.
+        # _mega_steps/_mega_bytes are scheduler-thread state.
+        self._mega = None
+        self._mega_steps = 0            # scheduler-thread-written
+        self._mega_bytes = 0.0
+        self._g_megastep_n.set(self.megastep)
+        if self.megastep > 1:
+            self._mega = self._compile_megastep(params)
+            self._mega_bytes = self.step_cache.program_cost(
+                "megastep")["bytes_accessed"]
 
         # speculative decoding: the ONE verify program (static k — the
         # third and last program kind) plus the host-side token history
@@ -1157,7 +1263,18 @@ class DecodeEngine(Logger):
             "requests failed on their deadline (queued or mid-flight; "
             "the HTTP 504 path)")
         self._m_decode_steps = reg.counter(
-            "vt_engine_decode_steps_total", "decode steps executed")
+            "vt_engine_decode_steps_total",
+            "decode micro-steps executed (a megastep dispatch counts "
+            "its N fused micro-steps)")
+        self._m_dispatches = reg.counter(
+            "vt_decode_dispatches_total",
+            "host dispatches of a token-advancing program (decode, "
+            "speculative verify, or megastep) — the megastep "
+            "amortization divides this by ~N at constant tokens")
+        self._g_megastep_n = reg.gauge(
+            "vt_megastep_n",
+            "configured decode micro-steps fused per megastep "
+            "dispatch (1 = megastep off)")
         self._m_tokens = reg.counter(
             "vt_engine_tokens_total", "tokens generated")
         self._m_swaps = reg.counter(
@@ -1411,6 +1528,21 @@ class DecodeEngine(Logger):
                                     paged_kernel=self.paged_kernel),
                      None, None),
             self._verify_args_sds(params), pin=(self.workflow,))
+        return step
+
+    def _compile_megastep(self, params):
+        # same calling convention as the decode program; N joins the
+        # StepCache key the way the verify program's k does, so two
+        # engines at different N are different programs, never a
+        # recompile of one
+        psz = self.page_size if self.paged else None
+        step, _, _ = self.step_cache.get_step(
+            "megastep", self._geometry_key() + ("mega", self.megastep),
+            lambda: (make_megastep_fn(self.plan, self._ctx, self.slots,
+                                      self.megastep, page_size=psz,
+                                      paged_kernel=self.paged_kernel),
+                     None, None),
+            self._decode_args_sds(params), pin=(self.workflow,))
         return step
 
     def _bucket(self, p: int) -> int:
@@ -1935,6 +2067,7 @@ class DecodeEngine(Logger):
             "tokens_per_sec": round(self._tokens_per_sec, 1),
             "tokens_generated": self._tok_count.n,
             "decode_steps": self._decode_steps.n,
+            "dispatches": self._dispatches.n,
             "admitted": self._admitted.n, "retired": self._retired.n,
             "rejected": self._rejected.n, "timeouts": self._timeouts.n,
             "swaps": self._swaps, "draining": self._draining,
@@ -1960,6 +2093,10 @@ class DecodeEngine(Logger):
                     self._spec_accepted.n
                     / max(self._spec_proposed.n, 1), 4),
             }} if self.spec else {}),
+            **({"megastep": {
+                "n": self.megastep,
+                "mega_dispatches": self._mega_steps,
+            }} if self.megastep > 1 else {}),
             "goodput": snap["goodput"],
             "memory": {
                 "headroom_slots": snap["headroom_slots"],
@@ -2600,10 +2737,27 @@ class DecodeEngine(Logger):
             if draft is not None and not probe \
                     and not self._verify_pays(draft):
                 draft = None
-        if draft is None:
-            self._step_once()
-        else:
+        if draft is not None:
             self._verify_once(draft)
+        elif self._mega is not None and self._mega_ready():
+            self._megastep_once()
+        else:
+            self._step_once()
+
+    def _mega_ready(self) -> bool:
+        """May this iteration fuse N micro-steps?  Only when nothing
+        could want the scheduler back sooner: every slot busy (a free
+        slot means the next arrival's admission — and any preemption
+        on its behalf — would wait out the block), the queue empty,
+        and no slot mid-chunked-prefill (its next slice interleaves
+        with single steps).  Any pending work drops this iteration to
+        N=1, so interactive latency, overload reflexes, and the
+        spec-decode interleave (which already claimed this tick if a
+        draft was worth verifying) never wait on a fused block."""
+        # lint: disable=VC201 bool(deque) is atomic under the GIL; a
+        # stale read only defers fusion by one iteration
+        return (bool(self._active.all()) and not self._queue
+                and not self._chunking)
 
     def _spec_worthwhile(self) -> bool:
         """Cheap pre-draft gate: could a verify step pay even if EVERY
@@ -2734,6 +2888,7 @@ class DecodeEngine(Logger):
             self._topp, self._eos, self._end, self._keys)
         n_active = int(self._active.sum())
         self._decode_steps.inc()
+        self._dispatches.inc()
         self._occupancy_sum += n_active
         self._tok_count.inc(n_active)
         # np.array (copy): asarray would alias the read-only device view
@@ -2776,6 +2931,7 @@ class DecodeEngine(Logger):
         emitted = int((self._pos - old_pos).sum())
         self._tok_count.inc(emitted)
         self._verify_steps += 1
+        self._dispatches.inc()
         proposed = int((draft >= 0).sum())
         acc = int(np.asarray(accepted).sum())
         self._spec_proposed.inc(proposed)
@@ -2799,6 +2955,49 @@ class DecodeEngine(Logger):
             self._bw_ewma = rate if self._bw_ewma <= 0 \
                 else 0.9 * self._bw_ewma + 0.1 * rate
         self._last_step_at = time.monotonic()
+        self._post_step(finished)
+
+    def _megastep_once(self):
+        """One megastep dispatch: every slot advances up to N tokens in
+        one program call, with in-program eos/length retirement between
+        micro-steps (bitwise the N=1 path's tokens — same sampler, same
+        per-position key folds).  The host pays ONE scheduler pass —
+        retirement, deadline sweep, accounting — for the whole block:
+        ``toks`` already holds each slot's emitted buffer and
+        ``emitted`` its count, so :meth:`_post_step` consumes the block
+        in bulk exactly like a verify step's accepted run."""
+        t0 = time.monotonic()
+        args = (self.wstate["params"], self._caches, self._toks)
+        if self.paged:
+            args += (self._ptab,)
+        (self._caches, self._toks, pos, active, finished,
+         emitted) = self._mega(
+            *args, self._pos, self._active, self._temp, self._topk,
+            self._topp, self._eos, self._end, self._keys)
+        self._pos = np.array(pos)
+        self._active = np.array(active)
+        n_emitted = int(np.asarray(emitted).sum())
+        self._tok_count.inc(n_emitted)
+        # per-micro-step accounting so occupancy and per-token latency
+        # stay comparable across N: N micro-steps ran, their summed
+        # live-slot count IS the emitted total, and the per-token wall
+        # is the dispatch wall over N
+        self._decode_steps.inc(self.megastep)
+        self._dispatches.inc()
+        self._occupancy_sum += n_emitted
+        self._mega_steps += 1
+        wall = time.monotonic() - t0
+        per_tok = wall / self.megastep
+        self._m_decode_step.observe(per_tok)
+        self._step_wall_ewma = per_tok if self._step_wall_ewma <= 0 \
+            else 0.9 * self._step_wall_ewma + 0.1 * per_tok
+        if self._mega_bytes > 0:
+            rate = self._mega_bytes / max(wall, 1e-9)
+            self._bw_ewma = rate if self._bw_ewma <= 0 \
+                else 0.9 * self._bw_ewma + 0.1 * rate
+        self._last_step_at = time.monotonic()
+        if self.spec:
+            self._ticks_since_attempt += 1
         self._post_step(finished)
 
     def _retire(self, slot: int):
