@@ -87,6 +87,16 @@ counter falling ~N× at constant tokens with the compile counters flat
 this model is dispatch-bound, so the sweep isolates exactly the host
 overhead the fusion amortizes.
 
+A tenth scenario ("disagg_transfer") measures the disaggregated
+prefill/decode tentpole (docs/serving.md "Disaggregated
+prefill/decode") on its two payoff axes: warm-TTFT through a
+serialized KV-page fetch (import + tail-only prefill) against
+re-prefilling the identical multi-page prompt after a same-weights hot
+swap invalidated the importer's cache, and the rolling drain's
+affinity pre-warm — post-drain prefix hit rate over a 2-replica fleet
+with the page hand-off vs with transfer disabled, at zero recompiles
+either way.
+
 Prints ONE JSON line in the bench.py contract:
   {"metric": "serving_decode_tokens_per_sec", "value": N,
    "unit": "tokens/s", "vs_baseline": N, ...}
@@ -887,13 +897,19 @@ def main(argv=None):
                     recompiles = sum(
                         rep.srv.engine.stats()["compile"]["recompiles"]
                         for rep in reps)
+                    aff = fd["affinity"]
                     rows.append({
                         "replicas": n_rep,
                         "tokens_per_sec": round(total / wall, 1),
                         "ttft_from_metrics": _latency_percentiles(
                             fm0, fm1, "vt_request_ttft_seconds"),
-                        "affinity_hit_rate":
-                            fd["affinity"]["hit_rate"],
+                        # per-burst: this router was born for this
+                        # size, so its counters cover exactly the
+                        # burst (BENCH_r09 reported only the last
+                        # cumulative number, hiding per-size decay)
+                        "affinity_hit_rate": aff["hit_rate"],
+                        "affinity_requests": aff["requests"],
+                        "affinity_hits": aff["hits"],
                         # per-replica mid-burst max (the windowed
                         # gauge reads 0.0 after the burst drains)
                         "tokens_per_sec_per_chip_max": {
@@ -909,12 +925,20 @@ def main(argv=None):
                     for rep in reps:
                         rep.stop()
             tps1 = max(rows[0]["tokens_per_sec"], 1e-9)
+            cum_req = sum(r["affinity_requests"] for r in rows)
+            cum_hit = sum(r["affinity_hits"] for r in rows)
             return {
                 "offered": {"requests": len(reqs), "concurrency": 8,
                             "sessions": 4, "head_tokens": 32,
                             "steps": 16},
                 "model": {"vocab": fv, "dim": 32, "layers": 1},
                 "sizes": rows,
+                # cumulative across ALL bursts (1+2+4 replicas) — the
+                # whole-run number next to each burst's own rate
+                "affinity_cumulative": {
+                    "requests": cum_req, "hits": cum_hit,
+                    "hit_rate": round(cum_hit / cum_req, 3)
+                    if cum_req else 0.0},
                 "scaling_2_replicas": round(
                     rows[1]["tokens_per_sec"] / tps1, 3),
                 "scaling_4_replicas": round(
@@ -935,6 +959,189 @@ def main(argv=None):
             }
         finally:
             _root.common.serve.fleet.scrape_interval_s = prev_scrape
+
+    def run_disagg_transfer():
+        """Disaggregated prefill/decode (docs/serving.md): (a) warm-
+        TTFT through a serialized KV-page fetch vs re-prefilling the
+        same multi-page prompt — engine A exports its prefix pages,
+        engine B imports them and serves with a tail-only prefill,
+        then a same-weights hot swap invalidates B's cache and the
+        identical request pays the full prefill; (b) the rolling
+        drain's affinity pre-warm — post-drain prefix hit rate over a
+        2-replica fleet WITH page hand-off vs with transfer disabled
+        (replicas restart cold either way; only the shipped pages
+        differ).  Compile counters must stay flat throughout: page
+        transfer is data placement, not new programs."""
+        import jax
+        from veles_tpu.config import root as _root
+        from veles_tpu.models.standard import build_workflow
+        from veles_tpu.ops import optimizers as opt
+        from veles_tpu.runtime.deploy import DeployController
+        from veles_tpu.runtime.engine import prefix_page_hashes
+        from veles_tpu.runtime.fleet import FleetRouter, InProcessReplica
+        from veles_tpu.runtime.restful import RestfulServer
+        drng = np.random.default_rng(23)
+        prompt = drng.integers(0, V, (1, 112)).astype(np.int32)
+        rounds = 4
+        a = DecodeEngine(wf, dict(ws), slots=4, l_max=128,
+                         window_ms=1.0).start()
+        b = DecodeEngine(wf, dict(ws), slots=4, l_max=128,
+                         window_ms=1.0).start()
+        fetch_ms, reprefill_ms = [], []
+        try:
+            # warm every program either measured leg will run: A's
+            # full-prompt bucket, B's full-prompt AND remote-hit-tail
+            # buckets, the decode step, and the import write path
+            a.generate(prompt, 1, timeout=600)
+            b.generate(drng.integers(0, V, (1, 112)).astype(np.int32),
+                       1, timeout=600)
+            b.generate(drng.integers(0, V, (1, 10)).astype(np.int32),
+                       1, timeout=600)
+            hashes = prefix_page_hashes(prompt[0], a.page_size)
+            b.import_pages(a.export_pages(hashes))
+            # both sides swap once so the measurement loop starts in
+            # weights-version lockstep with warm swap programs
+            b.swap_params(ws["params"])
+            a.swap_params(ws["params"])
+            a.generate(prompt, 1, timeout=600)
+            for _ in range(rounds):
+                blob = a.export_pages(hashes)
+                t0 = time.perf_counter()
+                b.import_pages(blob)
+                b.generate(prompt, 1, timeout=600)
+                fetch_ms.append(1e3 * (time.perf_counter() - t0))
+                # same-weights swap: B's prefix cache invalidates (the
+                # staleness rule), so the SAME request re-prefills
+                b.swap_params(ws["params"])
+                t0 = time.perf_counter()
+                b.generate(prompt, 1, timeout=600)
+                reprefill_ms.append(1e3 * (time.perf_counter() - t0))
+                # A follows to keep export wver matching B's next round
+                a.swap_params(ws["params"])
+                a.generate(prompt, 1, timeout=600)
+            kvt_b = b.stats()["kv_transfer"]
+            wire_bytes = len(blob)
+            recompiles = (a.stats()["compile"]["recompiles"]
+                          + b.stats()["compile"]["recompiles"])
+        finally:
+            a.stop()
+            b.stop()
+        fetch_med = float(np.median(fetch_ms))
+        reprefill_med = float(np.median(reprefill_ms))
+
+        # -- (b) drain pre-warm vs cold restart ------------------------------
+        fv = 64
+        fwf = build_workflow("bench_disagg_lm", [
+            {"type": "embedding", "vocab": fv, "dim": 32, "name": "emb"},
+            {"type": "attention", "n_heads": 2, "rope": True,
+             "residual": True, "name": "a1"},
+            {"type": "seq_last", "name": "last"},
+            {"type": "softmax", "output_size": fv, "name": "out"},
+        ])
+        fwf.build({"@input": vt.Spec((1, 8), jnp.int32),
+                   "@labels": vt.Spec((1,), jnp.int32),
+                   "@mask": vt.Spec((1,), jnp.float32)})
+        fws = fwf.init_state(jax.random.key(9), opt.SGD(0.01))
+
+        def factory():
+            feng = DecodeEngine(fwf, dict(fws), slots=2, l_max=128,
+                                window_ms=0.0)
+            srv = RestfulServer(fwf.make_predict_step("out"),
+                                dict(fws), 1, (8,), port=0,
+                                workflow=fwf, engine=feng,
+                                input_dtype=np.int32)
+            DeployController(server=srv)
+            return srv.start()
+
+        frng = np.random.default_rng(31)
+        heads = [frng.integers(0, fv, 48).tolist() for _ in range(4)]
+        sessions = [(h + frng.integers(0, fv, 4).tolist(), 8)
+                    for h in heads]
+        prev_scrape = _root.common.serve.fleet.get(
+            "scrape_interval_s", 0.5)
+        _root.common.serve.fleet.scrape_interval_s = 0.1
+        kvt_node = _root.common.serve.kv_transfer
+        prev_enabled = kvt_node.get("enabled", True)
+
+        def drain_leg(enabled):
+            kvt_node.enabled = enabled
+            reps = [InProcessReplica(factory) for _ in range(2)]
+            router = FleetRouter()
+            for rep in reps:
+                router.add_replica(url=rep.url,
+                                   registry_key="in-process",
+                                   restart=rep.restart, kill=rep.kill)
+            router.start()
+            try:
+                for p, n in sessions:
+                    st, doc, _h = router.handle_generate(
+                        {"prompt": [p], "steps": n})
+                    assert st == 200, doc
+                summary = router.rolling_drain()
+                # restarted engines are fresh: every post-drain hit
+                # page below came from the pre-warm hand-off
+                for p, n in sessions:
+                    st, doc, _h = router.handle_generate(
+                        {"prompt": [p], "steps": n})
+                    assert st == 200, doc
+                hit = miss = recompiles = 0
+                for rep in reps:
+                    pg = rep.srv.engine.stats()["pages"]
+                    hit += pg["prefix_hit_pages"]
+                    miss += pg["prefix_miss_pages"]
+                    recompiles += rep.srv.engine.stats()[
+                        "compile"]["recompiles"]
+                return {
+                    "drain_completed": summary["completed"],
+                    "prewarmed_pages": sum(
+                        (e.get("prewarm") or {}).get("pages", 0)
+                        for e in summary["replicas"]),
+                    "post_drain_prefix_hit_pages": hit,
+                    "post_drain_prefix_hit_rate": round(
+                        hit / (hit + miss), 3) if hit + miss else 0.0,
+                    "recompiles": recompiles,
+                }
+            finally:
+                router.stop()
+                for rep in reps:
+                    rep.stop()
+
+        try:
+            with_prewarm = drain_leg(True)
+            without_prewarm = drain_leg(False)
+        finally:
+            kvt_node.enabled = prev_enabled
+            _root.common.serve.fleet.scrape_interval_s = prev_scrape
+        return {
+            "prompt_tokens": int(prompt.shape[1]),
+            "pages_shipped": len(hashes),
+            "wire_bytes": wire_bytes,
+            "rounds": rounds,
+            "ttft_fetch_ms": {
+                "median": round(fetch_med, 2),
+                "all": [round(x, 2) for x in fetch_ms]},
+            "ttft_reprefill_ms": {
+                "median": round(reprefill_med, 2),
+                "all": [round(x, 2) for x in reprefill_ms]},
+            # the acceptance ratio: importing beats re-prefilling
+            "fetch_speedup": round(
+                reprefill_med / max(fetch_med, 1e-9), 3),
+            "remote_hit_pages": kvt_b["remote_hit_pages"],
+            "recompiles": recompiles,
+            "drain_prewarm": {
+                "sessions": len(sessions),
+                "head_tokens": 48,
+                "with_prewarm": with_prewarm,
+                "without_prewarm": without_prewarm,
+            },
+            "note": "fetch TTFT = import + tail-only prefill + first "
+                    "decode step; reprefill TTFT = the identical "
+                    "request after a same-weights hot swap "
+                    "invalidated the importer's prefix cache.  The "
+                    "drain legs restart replicas cold either way — "
+                    "only the pre-warm hand-off differs, so its "
+                    "post-drain hit pages are pure transfer value.",
+        }
 
     def run_megastep_sweep():
         """Megastep sweep (docs/serving.md "Megastep decode"): the
@@ -1043,6 +1250,7 @@ def main(argv=None):
         spec_vs_autoregressive = run_spec_vs_autoregressive()
         overload_survival = run_overload_survival()
         fleet_scaling = run_fleet_scaling()
+        disagg_transfer = run_disagg_transfer()
         megastep_sweep = run_megastep_sweep()
         final = eng.stats()
     finally:
@@ -1099,6 +1307,7 @@ def main(argv=None):
         "spec_vs_autoregressive": spec_vs_autoregressive,
         "overload_survival": overload_survival,
         "fleet_scaling": fleet_scaling,
+        "disagg_transfer": disagg_transfer,
         "megastep_sweep": megastep_sweep,
         "paged": final.get("pages"),
         "decode_recompiles": final["compile"]["recompiles"],

@@ -64,6 +64,10 @@ def pytest_configure(config):
         "markers", "megastep: fused multi-micro-step decode tests — "
         "bitwise identity, in-program retirement, artifact sealing "
         "(tier-1; select alone with -m megastep)")
+    config.addinivalue_line(
+        "markers", "disagg: disaggregated prefill/decode tests — "
+        "KV-page wire format, fleet transfer, capacity roles, drain "
+        "pre-warm (tier-1; select alone with -m disagg)")
 
 
 @pytest.fixture(autouse=True)

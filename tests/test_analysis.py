@@ -1349,6 +1349,8 @@ def test_pre_commit_config_runs_the_gate():
     assert _re.search(r"^\s*-?\s*id:\s*veles-tpu-lint\s*$", cfg, _re.M)
 
 
+@pytest.mark.slow  # cold+warm full-gate wall-budget probe (~21s); the gate
+# itself still runs tier-1 via the analysis marker's subprocess test
 def test_full_package_run_under_budget(tmp_path):
     """New rule families must not quietly make the tier-1 gate slow.
     At whole-package scope with the cross-module graph the budget is
@@ -2210,6 +2212,9 @@ def test_resource_pairs_registry_honest():
         # the ledger dict, or the locked helper that owns its mutation
         # (the public release is a lock-taking delegate)
         "fleet-dispatch": ("_pending", "_end_dispatch_locked"),
+        # the import lifecycle moves pages between the SAME pool
+        # fields the kv-pages pair guards
+        "kv-transfer": ("_page_free", "_page_ref"),
     }
     assert set(RESOURCE_PAIRS) == set(backing_fields), \
         "new resource? declare its backing fields here too"

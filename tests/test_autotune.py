@@ -290,6 +290,8 @@ def test_attention_flash_choice_via_autotune(tuned):
     assert y.shape == x.shape
 
 
+@pytest.mark.slow  # block-size sweep compiles one program per
+# candidate (~8s); autotune selection/persistence stays tier-1
 def test_attention_block_size_sweep(tuned, monkeypatch):
     """Round-5: the attention autotune sweeps flash (block_q, block_k)
     candidates per build shape (deduped by the kernel's effective

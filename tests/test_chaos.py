@@ -302,6 +302,132 @@ def test_fleet_survives_replica_kill_mid_burst():
             rep.stop()
 
 
+@pytest.mark.disagg
+@pytest.mark.faults
+def test_kv_transfer_fails_mid_fetch_requests_survive():
+    """The disaggregated-serving chaos rehearsal (docs/robustness.md,
+    KV-transfer failure semantics): the affinity holder starts
+    draining and EVERY page fetch from it fails mid-transfer
+    (``kv_transfer_drop`` armed, with the slow knob so failures land
+    under latency skew).  A concurrent class-0 same-prefix burst must
+    complete with ZERO failures — each cold replica falls back to its
+    own local prefill — with identical tokens throughout, no pages
+    imported anywhere, and the router's transfer ledger showing only
+    failed attempts."""
+    import json as _json
+    import threading
+    import urllib.request
+
+    import jax
+    import jax.numpy as jnp
+
+    import veles_tpu as vt
+    from veles_tpu.config import root
+    from veles_tpu.models.standard import build_workflow
+    from veles_tpu.ops import optimizers as opt
+    from veles_tpu.runtime import faults
+    from veles_tpu.runtime.deploy import DeployController
+    from veles_tpu.runtime.engine import DecodeEngine
+    from veles_tpu.runtime.fleet import (DRAINING, FleetRouter,
+                                         FleetServer, InProcessReplica)
+    from veles_tpu.runtime.restful import RestfulServer
+
+    V = 12
+    wf = build_workflow("chaos_kv_lm", [
+        {"type": "embedding", "vocab": V, "dim": 16, "name": "emb"},
+        {"type": "attention", "n_heads": 2, "rope": True,
+         "residual": True, "name": "a1"},
+        {"type": "seq_last", "name": "last"},
+        {"type": "softmax", "output_size": V, "name": "out"}])
+    wf.build({"@input": vt.Spec((2, 6), jnp.int32),
+              "@labels": vt.Spec((2,), jnp.int32),
+              "@mask": vt.Spec((2,), jnp.float32)})
+    ws = wf.init_state(jax.random.key(3), opt.SGD(0.1))
+
+    def factory():
+        eng = DecodeEngine(wf, dict(ws), slots=2, l_max=64,
+                           window_ms=0.0)
+        srv = RestfulServer(wf.make_predict_step("out"), dict(ws), 2,
+                            (6,), port=0, workflow=wf, engine=eng,
+                            input_dtype=np.int32)
+        DeployController(server=srv)
+        return srv.start()
+
+    prev_scrape = root.common.serve.fleet.get("scrape_interval_s", 0.5)
+    root.common.serve.fleet.scrape_interval_s = 0.05
+    replicas = [InProcessReplica(factory) for _ in range(3)]
+    router = FleetRouter()
+    for rep in replicas:
+        router.add_replica(url=rep.url, registry_key="in-process",
+                           restart=rep.restart, kill=rep.kill)
+    fsrv = FleetServer(router, port=0).start()
+    base = f"http://127.0.0.1:{fsrv.port}"
+    prompt = [[(i * 5 + 3) % V for i in range(48)]]     # 3 full pages
+
+    def post_generate():
+        body = _json.dumps({"prompt": prompt, "steps": 3,
+                            "priority": 0}).encode()
+        req = urllib.request.Request(
+            base + "/generate", data=body,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=120) as r:
+                return r.status, _json.loads(r.read())["tokens"]
+        except urllib.error.HTTPError as e:
+            with e:
+                return e.code, e.read().decode()
+        except Exception as e:  # noqa: BLE001 — a transport failure =
+            return repr(e), None  # a dropped request; assertions name it
+
+    results = []
+    res_lock = threading.Lock()
+
+    def worker():
+        for _ in range(4):
+            out = post_generate()
+            with res_lock:
+                results.append(out)
+
+    try:
+        # warm the affinity holder, then start draining it: every
+        # subsequent same-prefix request lands cold elsewhere and the
+        # router tries to fetch the pages from the draining holder
+        st, toks = post_generate()
+        assert st == 200, toks
+        with router._lock:
+            holder_id = router._affinity[next(iter(router._affinity))]
+            holder = next(r for r in router._replicas
+                          if r.id == holder_id)
+            holder.state = DRAINING
+        faults.configure(kv_transfer_drop=100,
+                         kv_transfer_slow_ms=10.0)
+        threads = [threading.Thread(target=worker) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        assert not any(t.is_alive() for t in threads)
+        # THE acceptance: zero failed class-0 requests across the
+        # transfer outage, all bitwise the warm answer
+        assert [s for s, _ in results] == [200] * 16, results
+        assert all(t == toks for _, t in results), results
+        # nothing was imported anywhere; the ledger shows only failed
+        # attempts (no successful transfer ever completed)
+        fd = router.fleet_doc()
+        assert fd["kv_transfer"]["transfers"] == 0, fd["kv_transfer"]
+        for rep in fd["replicas"]:
+            with urllib.request.urlopen(rep["url"] + "/engine",
+                                        timeout=30) as r:
+                kvt = _json.loads(r.read())["kv_transfer"]
+            assert kvt["imported_pages"] == 0, (rep["id"], kvt)
+    finally:
+        faults.reset()
+        root.common.serve.fleet.scrape_interval_s = prev_scrape
+        fsrv.stop()
+        for rep in replicas:
+            rep.stop()
+
+
 @pytest.mark.overload
 def test_admission_controller_sheds_and_recovers_under_flood():
     """The overload-survival chaos rehearsal (docs/robustness.md

@@ -315,6 +315,8 @@ LM_CONFIG_JSON = {
 }
 
 
+@pytest.mark.slow  # CLI training subprocess (~15s); in-process generate()
+# and the REST path keep decode coverage tier-1
 def test_cli_generate_mode(tmp_path):
     """--generate decodes a continuation with the (restored) model
     instead of training (pairs with veles_serve --generate)."""
@@ -341,6 +343,8 @@ def test_cli_generate_mode(tmp_path):
     assert r3.returncode != 0 and "--prompt" in (r3.stderr + r3.stdout)
 
 
+@pytest.mark.slow  # CLI serve subprocess (~13s); RestfulServer is driven
+# in-process throughout test_serving/test_engine
 def test_cli_serve_mode(tmp_path):
     """--serve exposes the restored model over HTTP: /predict and (for
     sequence chains) /generate, until the process is stopped."""
@@ -399,6 +403,8 @@ def test_cli_serve_mode(tmp_path):
         proc.wait(timeout=30)
 
 
+@pytest.mark.slow  # CLI export subprocess (~9s); export_package itself is
+# covered in-process by test_serving
 def test_cli_export_mode(tmp_path):
     """--export writes a native-serving package of the restored model:
     train -> snapshot -> export -> veles_serve is fully CLI-driven."""

@@ -1,0 +1,587 @@
+"""Disaggregated prefill/decode (runtime/engine.py KV-page transfer +
+runtime/fleet.py placement, docs/serving.md "Disaggregated
+prefill/decode"): serialized prefix pages must decode BITWISE-identical
+on the importer (greedy and sampled), every wire defect — corruption,
+geometry drift, a weights version the importer never served — must
+reject loudly with the local pool untouched, imported pages must live
+the full refcount lifecycle of locally-prefilled ones (cached at 0,
+pinned by admission, dropped by a swap's invalidation), and the fleet
+paths — affinity-holder fetch before a cold dispatch, prefill-role
+shipping, drain pre-warm — must all degrade to local prefill on any
+failure, never to an errored request.  StepCache counters stay flat
+across every import: page transfer is data placement, not new
+programs."""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import veles_tpu as vt
+from veles_tpu.config import root
+from veles_tpu.models.standard import build_workflow
+from veles_tpu.ops import optimizers as opt
+from veles_tpu.runtime import faults
+from veles_tpu.runtime.deploy import DeployController
+from veles_tpu.runtime.engine import DecodeEngine, prefix_page_hashes
+from veles_tpu.runtime.fleet import (ACTIVE, FleetRouter,
+                                     InProcessReplica)
+from veles_tpu.runtime.generate import generate
+from veles_tpu.runtime.restful import RestfulServer
+
+pytestmark = pytest.mark.disagg
+
+V = 12
+
+LAYERS = [
+    {"type": "embedding", "vocab": V, "dim": 16, "name": "emb"},
+    {"type": "attention", "n_heads": 2, "rope": True,
+     "residual": True, "name": "a1"},
+    {"type": "layer_norm", "name": "n1"},
+    {"type": "ffn", "d_hidden": 32, "name": "f1"},
+    {"type": "seq_last", "name": "last"},
+    {"type": "softmax", "output_size": V, "name": "out"},
+]
+
+
+def _build_lm(layers=LAYERS, seed=3, name="disagg_lm"):
+    wf = build_workflow(name, layers)
+    wf.build({"@input": vt.Spec((2, 6), jnp.int32),
+              "@labels": vt.Spec((2,), jnp.int32),
+              "@mask": vt.Spec((2,), jnp.float32)})
+    ws = wf.init_state(jax.random.key(seed), opt.SGD(0.1))
+    return wf, ws
+
+
+@pytest.fixture(scope="module")
+def lm():
+    return _build_lm()
+
+
+def _prompt(rng, n_tokens=48):
+    """A prompt spanning full pages (page_size 16 at l_max=64)."""
+    return rng.integers(0, V, (1, n_tokens)).astype(np.int32)
+
+
+def _warm_export(wf, ws, prompt, steps=4):
+    """Prefill ``prompt`` on a fresh engine A and export its full-page
+    prefix; returns (blob, hashes, A's greedy tokens)."""
+    a = DecodeEngine(wf, dict(ws), slots=4, l_max=64,
+                     window_ms=1.0).start()
+    try:
+        toks = a.generate(prompt, steps, timeout=120)
+        hashes = prefix_page_hashes(prompt[0], a.page_size)
+        blob = a.export_pages(hashes)
+    finally:
+        a.stop()
+    return blob, hashes, toks
+
+
+# -- wire format + bitwise identity -------------------------------------------
+
+def test_export_import_roundtrip_counts(lm, rng):
+    """Export names pages by chained prefix digest; import is
+    idempotent (resident hashes skip) and both sides account pages and
+    wire bytes in stats()["kv_transfer"]."""
+    wf, ws = lm
+    prompt = _prompt(rng)
+    blob, hashes, _ = _warm_export(wf, ws, prompt)
+    assert len(hashes) == 3
+    b = DecodeEngine(wf, dict(ws), slots=4, l_max=64,
+                     window_ms=1.0).start()
+    try:
+        doc = b.import_pages(blob)
+        assert doc["imported"] == 3 and doc["dropped"] == 0, doc
+        assert doc["hashes"] == [h.hex() for h in hashes]
+        again = b.import_pages(blob)
+        assert again["imported"] == 0 and again["skipped"] == 3, again
+        kvt = b.stats()["kv_transfer"]
+        assert kvt["imported_pages"] == 3
+        assert kvt["import_bytes"] == 2 * len(blob)
+        assert kvt["page_bytes"] > 0
+        # unknown hashes export an empty (but valid) blob
+        empty = b.export_pages([bytes(32)])
+        assert b.import_pages(empty)["imported"] == 0
+    finally:
+        b.stop()
+
+
+def test_imported_pages_serve_bitwise_greedy(lm, rng):
+    """THE tentpole acceptance: a cold engine that imported a peer's
+    pages serves greedy tokens bitwise equal to the peer's local
+    prefill (and to per-request generate()), attributing the admission
+    to remote pages."""
+    wf, ws = lm
+    prompt = _prompt(rng)
+    blob, _, toks_a = _warm_export(wf, ws, prompt)
+    ref = np.asarray(generate(wf, ws, prompt, 4))
+    np.testing.assert_array_equal(toks_a, ref)
+    b = DecodeEngine(wf, dict(ws), slots=4, l_max=64,
+                     window_ms=1.0).start()
+    try:
+        assert b.import_pages(blob)["imported"] == 3
+        got = b.generate(prompt, 4, timeout=120)
+        np.testing.assert_array_equal(got, ref)
+        kvt = b.stats()["kv_transfer"]
+        # the prompt tail always re-runs locally, so the hit covers the
+        # full pages strictly before it
+        assert kvt["remote_hit_pages"] >= 2, kvt
+    finally:
+        b.stop()
+
+
+def test_imported_pages_serve_bitwise_sampled(lm, rng):
+    """Sampling folds the GLOBAL position into the per-slot key, so a
+    remote-hit admission (which starts mid-prompt) reproduces
+    generate() bit for bit under the same key."""
+    wf, ws = lm
+    prompt = _prompt(rng)
+    kwargs = {"temperature": 1.5, "top_k": 4}
+    a = DecodeEngine(wf, dict(ws), slots=4, l_max=64,
+                     window_ms=1.0).start()
+    try:
+        toks_a = a.generate(prompt, 5, key=jax.random.key(7),
+                            timeout=120, **kwargs)
+        blob = a.export_pages(
+            prefix_page_hashes(prompt[0], a.page_size))
+    finally:
+        a.stop()
+    ref = np.asarray(generate(wf, ws, prompt, 5,
+                              key=jax.random.key(7), **kwargs))
+    np.testing.assert_array_equal(toks_a, ref)
+    b = DecodeEngine(wf, dict(ws), slots=4, l_max=64,
+                     window_ms=1.0).start()
+    try:
+        assert b.import_pages(blob)["imported"] == 3
+        got = b.generate(prompt, 5, key=jax.random.key(7),
+                         timeout=120, **kwargs)
+        np.testing.assert_array_equal(got, ref)
+        assert b.stats()["kv_transfer"]["remote_hit_pages"] >= 2
+    finally:
+        b.stop()
+
+
+def test_dense_engine_rejects_transfer_loudly(lm, rng):
+    """Dense caches have no content-addressed pages: both directions
+    raise ValueError naming the paged requirement — loud rejection,
+    not an empty blob silently mistaken for 'no pages'."""
+    wf, ws = lm
+    eng = DecodeEngine(wf, dict(ws), slots=2, l_max=32, paged=False)
+    with pytest.raises(ValueError, match="paged KV layout"):
+        eng.export_pages([])
+    with pytest.raises(ValueError, match="paged KV layout"):
+        eng.import_pages(b"VTKV1\x00whatever")
+    # recurrent chains disable prefix reuse -> same loud refusal
+    wf_r, ws_r = _build_lm([
+        {"type": "embedding", "vocab": V, "dim": 12, "name": "emb"},
+        {"type": "gru", "hidden": 12, "name": "g1"},
+        {"type": "seq_last", "name": "last"},
+        {"type": "softmax", "output_size": V, "name": "out"},
+    ], name="disagg_rec")
+    eng_r = DecodeEngine(wf_r, dict(ws_r), slots=2, l_max=32)
+    with pytest.raises(ValueError, match="prefix reuse"):
+        eng_r.export_pages([])
+
+
+def test_corrupt_and_malformed_blobs_reject_pool_unchanged(lm, rng):
+    """Every defect class — bad magic, torn header, flipped payload
+    byte — is a ValueError, and the importer's pool and prefix index
+    are provably untouched afterwards (all-or-nothing validation)."""
+    wf, ws = lm
+    prompt = _prompt(rng)
+    blob, _, _ = _warm_export(wf, ws, prompt)
+    b = DecodeEngine(wf, dict(ws), slots=4, l_max=64,
+                     window_ms=1.0).start()
+    try:
+        with pytest.raises(ValueError, match="bad magic"):
+            b.import_pages(b"NOTKV" + blob)
+        with pytest.raises(ValueError, match="truncated"):
+            b.import_pages(blob[:8])
+        flipped = bytearray(blob)
+        flipped[-1] ^= 0xFF               # last payload byte
+        with pytest.raises(ValueError, match="integrity"):
+            b.import_pages(bytes(flipped))
+        with b._page_lock:
+            assert not b._prefix_index and not b._imported_pages
+            assert len(b._page_free) == b.pages
+        pg = b.stats()["pages"]
+        assert pg["free"] == b.pages and pg["cached"] == 0
+        assert b.stats()["kv_transfer"]["imported_pages"] == 0
+    finally:
+        b.stop()
+
+
+def test_weights_version_mismatch_rejects(lm, rng):
+    """A blob exported before the importer's hot swap carries a stale
+    ``wver`` — pages computed under other weights must never enter the
+    prefix index (the same staleness rule a swap applies locally)."""
+    wf, ws = lm
+    prompt = _prompt(rng)
+    blob, _, _ = _warm_export(wf, ws, prompt)
+    b = DecodeEngine(wf, dict(ws), slots=4, l_max=64,
+                     window_ms=1.0).start()
+    try:
+        b.swap_params(ws["params"])    # same weights, new version
+        with pytest.raises(ValueError, match="weights-version"):
+            b.import_pages(blob)
+        # a post-swap export round-trips again
+        a2 = DecodeEngine(wf, dict(ws), slots=4, l_max=64,
+                          window_ms=1.0).start()
+        try:
+            a2.generate(prompt, 2, timeout=120)
+            a2.swap_params(ws["params"])
+            a2.generate(prompt, 2, timeout=120)
+            blob2 = a2.export_pages(
+                prefix_page_hashes(prompt[0], a2.page_size))
+        finally:
+            a2.stop()
+        assert b.import_pages(blob2)["imported"] == 3
+    finally:
+        b.stop()
+
+
+# -- refcount lifecycle + compile counters ------------------------------------
+
+def test_imported_page_refcount_lifecycle(lm, rng):
+    """Imported pages are cached (refcount 0, evictable), a prefix-hit
+    admission pins them exactly like local pages, release returns them
+    to cached, and a swap's invalidation frees them and clears the
+    imported attribution — no page leaks at any stage."""
+    wf, ws = lm
+    prompt = _prompt(rng)
+    blob, hashes, _ = _warm_export(wf, ws, prompt)
+    b = DecodeEngine(wf, dict(ws), slots=4, l_max=64,
+                     window_ms=1.0).start()
+    try:
+        assert b.import_pages(blob)["imported"] == 3
+        with b._page_lock:
+            pids = [b._prefix_index[h] for h in hashes]
+            assert all(b._page_ref[p] == 0 for p in pids)
+            assert set(pids) <= b._imported_pages
+        pg = b.stats()["pages"]
+        assert pg["cached"] == 3 and pg["used"] == 0
+        # admission through the imported prefix pins the shared pages,
+        # and retirement returns them to the cached state
+        b.generate(prompt, 3, timeout=120)
+        pg = b.stats()["pages"]
+        assert pg["used"] == 0 and pg["free"] < b.pages
+        # swap invalidation: imported pages drop with the prefix index
+        b.swap_params(ws["params"])
+        with b._page_lock:
+            assert not b._prefix_index and not b._imported_pages
+            assert len(b._page_free) == b.pages
+    finally:
+        b.stop()
+
+
+def test_import_keeps_step_cache_flat(lm, rng):
+    """Page transfer is data placement: importing and serving through
+    imported pages must compile NOTHING new once the engine's buckets
+    are warm, and must never recompile."""
+    wf, ws = lm
+    prompt = _prompt(rng)
+    blob, _, _ = _warm_export(wf, ws, prompt)
+    ref = np.asarray(generate(wf, ws, prompt, 4))
+    b = DecodeEngine(wf, dict(ws), slots=4, l_max=64,
+                     window_ms=1.0).start()
+    try:
+        # warm B's decode program, the full-prompt bucket AND the
+        # short bucket the remote-hit tail (48 - 32 = 16 tokens)
+        # admits through, all with UNRELATED prompts
+        b.generate(_prompt(rng), 4, timeout=120)
+        b.generate(_prompt(rng, 10), 2, timeout=120)
+        compiles = b.stats()["compile"]["compiles"]
+        assert b.import_pages(blob)["imported"] == 3
+        np.testing.assert_array_equal(
+            b.generate(prompt, 4, timeout=120), ref)
+        st = b.stats()["compile"]
+        assert st["compiles"] == compiles, st
+        assert st["recompiles"] == 0
+    finally:
+        b.stop()
+
+
+def test_hot_page_hashes_ranks_resident_pages(lm, rng):
+    """The drain pre-warm set: every exported-and-resident page is
+    reachable through hot_page_hashes, K truncates, and the engine
+    refuses the call on dense layouts."""
+    wf, ws = lm
+    prompt = _prompt(rng)
+    a = DecodeEngine(wf, dict(ws), slots=4, l_max=64,
+                     window_ms=1.0).start()
+    try:
+        a.generate(prompt, 3, timeout=120)
+        hashes = prefix_page_hashes(prompt[0], a.page_size)
+        hot = a.hot_page_hashes(16)
+        assert set(hashes) <= set(hot)
+        assert len(a.hot_page_hashes(2)) == 2
+        assert a.hot_page_hashes(0) == []
+    finally:
+        a.stop()
+
+
+# -- REST endpoints -----------------------------------------------------------
+
+def _rest_server(wf, ws, **engine_kw):
+    kw = dict(slots=4, l_max=64, window_ms=1.0)
+    kw.update(engine_kw)
+    eng = DecodeEngine(wf, dict(ws), **kw)
+    srv = RestfulServer(wf.make_predict_step("out"), dict(ws), 2,
+                        (6,), port=0, workflow=wf, engine=eng,
+                        input_dtype=np.int32)
+    return srv.start(), eng
+
+
+def _http(url, data=None, method=None):
+    req = urllib.request.Request(url, data=data, method=method)
+    if data is not None:
+        req.add_header("Content-Type", "application/octet-stream")
+    try:
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return r.status, r.headers.get("Content-Type"), r.read()
+    except urllib.error.HTTPError as e:
+        with e:
+            return e.code, e.headers.get("Content-Type"), e.read()
+
+
+def test_rest_kv_pages_roundtrip_and_rejections(lm, rng):
+    """GET /kv/pages?hashes= and ?top= serve the octet-stream wire
+    format, PUT imports it, a corrupt body answers 400, a body over the
+    serve.max_body_mb ingress cap answers 413, and a dense replica
+    answers 400 on both verbs."""
+    wf, ws = lm
+    prompt = _prompt(rng)
+    ref = np.asarray(generate(wf, ws, prompt, 3))
+    srv_a, eng_a = _rest_server(wf, ws)
+    srv_b, eng_b = _rest_server(wf, ws)
+    base_a = f"http://127.0.0.1:{srv_a.port}"
+    base_b = f"http://127.0.0.1:{srv_b.port}"
+    try:
+        np.testing.assert_array_equal(
+            eng_a.generate(prompt, 3, timeout=120), ref)
+        hx = ",".join(h.hex() for h in prefix_page_hashes(
+            prompt[0], eng_a.page_size))
+        st, ctype, blob = _http(base_a + "/kv/pages?hashes=" + hx)
+        assert st == 200 and ctype == "application/octet-stream"
+        st, _, top_blob = _http(base_a + "/kv/pages?top=8")
+        assert st == 200 and len(top_blob) >= len(blob)
+        st, _, body = _http(base_b + "/kv/pages", data=blob,
+                            method="PUT")
+        assert st == 200 and json.loads(body)["imported"] == 3
+        np.testing.assert_array_equal(
+            eng_b.generate(prompt, 3, timeout=120), ref)
+        # corrupt payload -> the importer's 400, not a 500
+        bad = bytearray(blob)
+        bad[-1] ^= 0xFF
+        st, _, body = _http(base_b + "/kv/pages", data=bytes(bad),
+                            method="PUT")
+        assert st == 400 and b"integrity" in body
+        # ingress cap: the SAME max_body_mb knob JSON POSTs honor
+        prev = root.common.serve.get("max_body_mb", 64)
+        root.common.serve.max_body_mb = len(blob) / 2 ** 20 / 2
+        try:
+            st, _, body = _http(base_b + "/kv/pages", data=blob,
+                                method="PUT")
+            assert st == 413 and b"max_body_mb" in body
+        finally:
+            root.common.serve.max_body_mb = prev
+    finally:
+        srv_a.stop()
+        srv_b.stop()
+
+    srv_d, _eng_d = _rest_server(wf, ws, paged=False)
+    base_d = f"http://127.0.0.1:{srv_d.port}"
+    try:
+        st, _, body = _http(base_d + "/kv/pages?top=4")
+        assert st == 400 and b"paged" in body
+        st, _, body = _http(base_d + "/kv/pages", data=blob,
+                            method="PUT")
+        assert st == 400 and b"paged" in body
+    finally:
+        srv_d.stop()
+
+
+# -- fleet placement ----------------------------------------------------------
+
+@pytest.fixture
+def fast_scrape():
+    fleet = root.common.serve.fleet
+    prev = fleet.get("scrape_interval_s", 0.5)
+    fleet.scrape_interval_s = 0.05
+    yield
+    fleet.scrape_interval_s = prev
+
+
+def _factory(wf, ws, **engine_kw):
+    kw = dict(slots=2, l_max=64, window_ms=0.0)
+    kw.update(engine_kw)
+
+    def factory():
+        eng = DecodeEngine(wf, dict(ws), **kw)
+        srv = RestfulServer(wf.make_predict_step("out"), dict(ws), 2,
+                            (6,), port=0, workflow=wf, engine=eng,
+                            input_dtype=np.int32)
+        DeployController(server=srv, boot_source="live")
+        return srv.start()
+
+    return factory
+
+
+def _fleet(wf, ws, roles=("mixed", "mixed"), **engine_kw):
+    replicas = [InProcessReplica(_factory(wf, ws, **engine_kw))
+                for _ in roles]
+    router = FleetRouter()
+    for rep, role in zip(replicas, roles):
+        router.add_replica(url=rep.url, registry_key="in-process",
+                           restart=rep.restart, kill=rep.kill,
+                           role=role)
+    router.start()
+    return router, replicas
+
+
+def _teardown(router, replicas):
+    router.stop()
+    for rep in replicas:
+        rep.stop()
+
+
+def _engine_kvt(rep):
+    with urllib.request.urlopen(rep.client.base_url + "/engine",
+                                timeout=30) as r:
+        return json.loads(r.read())["kv_transfer"]
+
+
+FLEET_PROMPT = [[(i * 5 + 3) % V for i in range(48)]]   # 3 full pages
+
+
+def test_fleet_fetches_pages_before_cold_dispatch(lm, rng,
+                                                  fast_scrape):
+    """Fleet-wide prefix sharing: a request diverted off its affinity
+    holder lands on a replica the router just warmed by fetching the
+    holder's pages — same tokens, remote-hit attribution on the cold
+    replica, a measured transfer in /fleet.json."""
+    wf, ws = lm
+    router, replicas = _fleet(wf, ws)
+    try:
+        body = {"prompt": FLEET_PROMPT, "steps": 4, "temperature": 0.0}
+        st, doc, _ = router.handle_generate(dict(body))
+        assert st == 200, doc
+        with router._lock:
+            holder_id = router._affinity[next(iter(router._affinity))]
+            holder = next(r for r in router._replicas
+                          if r.id == holder_id)
+            other = next(r for r in router._replicas
+                         if r.id != holder_id)
+            # divert the next request off the holder (its 429 window)
+            holder.backoff_until = time.monotonic() + 60
+        st2, doc2, _ = router.handle_generate(
+            dict(body, priority=1))
+        assert st2 == 200, doc2
+        assert doc2["tokens"] == doc["tokens"]
+        kvt = _engine_kvt(other)
+        assert kvt["imported_pages"] == 3, kvt
+        assert kvt["remote_hit_pages"] >= 2, kvt
+        fd = router.fleet_doc()
+        assert fd["kv_transfer"]["transfers"] >= 1, fd["kv_transfer"]
+        assert fd["kv_transfer"]["bandwidth_Bps"] > 0
+    finally:
+        _teardown(router, replicas)
+
+
+def test_fetch_failure_falls_back_to_local_prefill(lm, rng,
+                                                   fast_scrape):
+    """Satellite (a): the transfer fails mid-fetch (fault knob) — the
+    request still answers 200 with the SAME tokens via local prefill,
+    the failure is counted, and nothing was imported anywhere."""
+    wf, ws = lm
+    router, replicas = _fleet(wf, ws)
+    try:
+        body = {"prompt": FLEET_PROMPT, "steps": 4, "temperature": 0.0}
+        st, doc, _ = router.handle_generate(dict(body))
+        assert st == 200, doc
+        with router._lock:
+            holder_id = router._affinity[next(iter(router._affinity))]
+            holder = next(r for r in router._replicas
+                          if r.id == holder_id)
+            other = next(r for r in router._replicas
+                         if r.id != holder_id)
+            holder.backoff_until = time.monotonic() + 60
+        faults.configure(kv_transfer_drop=5, kv_transfer_slow_ms=1.0)
+        try:
+            st2, doc2, _ = router.handle_generate(
+                dict(body, priority=1))
+        finally:
+            faults.reset()
+        assert st2 == 200, doc2
+        assert doc2["tokens"] == doc["tokens"]
+        assert _engine_kvt(other)["imported_pages"] == 0
+        fd = router.fleet_doc()
+        assert fd["kv_transfer"]["transfers"] == 0, fd["kv_transfer"]
+    finally:
+        _teardown(router, replicas)
+
+
+def test_prefill_role_runs_leg_and_ships_pages(lm, rng, fast_scrape):
+    """Capacity classes: the prefill-class replica absorbs the prefill
+    leg and ships the finished pages; the decode replica serves the
+    request through the import and never sees the cold prefill.  The
+    prefill replica takes no normal dispatch while a decode-capable
+    replica is up."""
+    wf, ws = lm
+    router, replicas = _fleet(wf, ws, roles=("prefill", "decode"))
+    try:
+        body = {"prompt": FLEET_PROMPT, "steps": 4, "temperature": 0.0}
+        st, doc, _ = router.handle_generate(dict(body))
+        assert st == 200, doc
+        ref = np.asarray(generate(
+            wf, ws, np.asarray(FLEET_PROMPT, np.int32), 4))
+        assert doc["tokens"] == ref.tolist(), (doc["tokens"], ref)
+        with router._lock:
+            dec = next(r for r in router._replicas
+                       if r.role == "decode")
+            pre = next(r for r in router._replicas
+                       if r.role == "prefill")
+        kvt = _engine_kvt(dec)
+        assert kvt["imported_pages"] == 3, kvt
+        assert kvt["remote_hit_pages"] >= 2, kvt
+        assert _engine_kvt(pre)["exported_pages"] == 3
+        fd = router.fleet_doc()
+        assert fd["roles"] == {"prefill": 1, "decode": 1}, fd["roles"]
+        roles = {r["id"]: r["role"] for r in fd["replicas"]}
+        assert set(roles.values()) == {"prefill", "decode"}
+        # normal dispatch stayed off the prefill replica — its leg
+        # rode the direct disagg call, not the dispatch ledger
+        assert dec.dispatched >= 1 and pre.dispatched == 0
+    finally:
+        _teardown(router, replicas)
+
+
+def test_rolling_drain_prewarms_successor(lm, rng, fast_scrape):
+    """Affinity-preserving drain: before routing stops, the victim's
+    hot pages ship to the least-loaded survivor and the affinity map
+    repoints — the same prefix re-served post-drain hits warm pages
+    (remote attribution on the successor) instead of re-prefilling."""
+    wf, ws = lm
+    router, replicas = _fleet(wf, ws)
+    try:
+        body = {"prompt": FLEET_PROMPT, "steps": 4, "temperature": 0.0}
+        st, doc, _ = router.handle_generate(dict(body))
+        assert st == 200, doc
+        summary = router.rolling_drain()
+        assert summary["completed"], summary
+        prewarms = [e.get("prewarm") for e in summary["replicas"]]
+        assert any(p and p["pages"] == 3 for p in prewarms), prewarms
+        st2, doc2, _ = router.handle_generate(dict(body))
+        assert st2 == 200, doc2
+        assert doc2["tokens"] == doc["tokens"]
+        fd = router.fleet_doc()
+        assert fd["affinity"]["hits"] >= 1, fd["affinity"]
+        outcomes = {r["state"] for r in fd["replicas"]}
+        assert outcomes == {ACTIVE}
+    finally:
+        _teardown(router, replicas)

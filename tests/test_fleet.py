@@ -319,6 +319,8 @@ def test_stage_abort_leaves_old_serving(lm, tmp_path, rng):
 
 # -- rolling drain -----------------------------------------------------------
 
+@pytest.mark.slow  # rolling drain under live load (~24s); drain mechanics
+# stay tier-1 via test_disagg drain pre-warm + the fleet chaos rehearsals
 def test_rolling_drain_under_load_zero_dropped(lm, fast_scrape, rng):
     """A full rolling-drain cycle under concurrent load: every replica
     drains, restarts and is readmitted while worker threads keep

@@ -91,6 +91,8 @@ def test_cifar_workflow_single_step():
     assert np.isfinite(float(mets["loss"]))
 
 
+@pytest.mark.slow  # full AlexNet trunk build+steps (~17s); the small-model
+# zoo tests keep builds/steps tier-1
 def test_alexnet_builds_and_steps():
     sw = alexnet_workflow(minibatch_size=4)
     wf = sw.workflow
@@ -127,6 +129,8 @@ def test_imagenet_host_loader_augmentation():
     np.testing.assert_array_equal(bv["@input"], bv2["@input"])
 
 
+@pytest.mark.slow  # AlexNet e2e train steps (~16s); normalization + conv
+# trunk coverage stays tier-1 on the small models
 def test_alexnet_e2e_workflow_steps():
     """uint8 batch -> device-side mean/disp norm -> conv trunk: one train
     step of the end-to-end bench configuration (tiny host store)."""

@@ -97,6 +97,8 @@ def test_softmax_cross_entropy_grad(rng):
     np.testing.assert_allclose(analytic, numeric, rtol=2e-3, atol=2e-4)
 
 
+@pytest.mark.slow  # finite-difference sweep over the recurrent cells
+# (~12s); AD exactness is covered per-cell elsewhere in this file
 def test_recurrent_cell_grads(rng):
     from veles_tpu.ops.recurrent import gru_scan, lstm_scan
     B, T, I, H = 2, 3, 4, 3

@@ -324,6 +324,8 @@ def test_slo_degrade_ready_flips_readiness():
 
 # -- on-demand profiler capture ----------------------------------------------
 
+@pytest.mark.slow  # real jax.profiler capture (~16s); the concurrent-load
+# acceptance keeps the profiler-active path tier-1
 def test_profiler_endpoint_lifecycle(tmp_path):
     """Capture -> files exist on disk -> a second POST mid-capture
     answers 409 -> after completion the next capture succeeds again."""
@@ -482,11 +484,16 @@ def test_compile_flat_with_memory_slo_mfu_enabled(rng, tmp_path):
 
         threads = [threading.Thread(target=client, args=(i,))
                    for i in range(10)]
-        threads.append(threading.Thread(target=observer))
-        for t in threads:
+        obs = threading.Thread(target=observer)
+        for t in threads + [obs]:
             t.start()
         for t in threads:
             t.join(timeout=180)
+        # read the bandwidth gauge while the decode EWMA is fresh — it
+        # decays to 0 after 2s idle by design, and the observer's
+        # profile capture can outlive that window on a loaded machine
+        bandwidth = eng.stats()["goodput"]["decode_bandwidth_bytes_per_sec"]
+        obs.join(timeout=180)
         assert not errs, errs
 
         st = eng.stats()
@@ -500,7 +507,7 @@ def test_compile_flat_with_memory_slo_mfu_enabled(rng, tmp_path):
             url + "/memory.json").read())
         assert mem["components"]["engine.kv_cache"] > 0
         assert st["goodput"]["decode_step_bytes"] > 0
-        assert st["goodput"]["decode_bandwidth_bytes_per_sec"] > 0
+        assert bandwidth > 0
     finally:
         root.common.observe.profile_dir = old_dir
         srv.stop()

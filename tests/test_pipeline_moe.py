@@ -417,6 +417,8 @@ def test_pipeline_1f1b_schedule_sweep(rng, S, M):
     np.testing.assert_allclose(float(loss), total / M, rtol=2e-5)
 
 
+@pytest.mark.slow  # brute-force sort-vs-dense dispatch sweep (~19s); moe
+# router/aux coverage stays tier-1
 def test_moe_sort_equals_dense_dispatch(rng):
     """Round 3: the sort/segment dispatch must reproduce the one-hot
     formulation EXACTLY — outputs, aux loss, and all grads — including

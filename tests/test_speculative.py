@@ -175,6 +175,8 @@ def test_mid_block_eos_retirement(lm, rng):
 
 # -- program inventory / counters ---------------------------------------------
 
+@pytest.mark.slow  # compiles a verify program per k in {1,2,5} (~9s); the
+# bitwise + frozen-counter spec tests keep the one-program claim tier-1
 def test_k_sweep_one_verify_program_each(lm, rng):
     """Every k compiles exactly ONE verify program (keyed by geometry +
     k) and stays bitwise; within one engine no draft pattern ever

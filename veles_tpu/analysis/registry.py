@@ -216,6 +216,25 @@ RESOURCE_PAIRS = {
         "exit_roots": {"runtime/fleet.py": (
             "FleetRouter._eject_locked",)},
     },
+    # KV-page import (runtime/engine.py, docs/serving.md
+    # "Disaggregated prefill/decode"): applying a peer's serialized
+    # prefix pages claims pool pages (refcount 1 via
+    # ``_claim_import_page``) that MUST either register in the prefix
+    # index (``_register_import_page`` drops the refcount to the
+    # cached/evictable 0 state) or return to ``_page_free`` on an
+    # aborted apply (``_abort_import_page``) — a claimed-but-orphaned
+    # page would shrink the pool forever.  The scheduler-side apply
+    # loop is the exit root: every abort path there must provably
+    # reach the release.
+    "kv-transfer": {
+        "acquire": {"runtime/engine.py": (
+            "DecodeEngine._claim_import_page",)},
+        "release": {"runtime/engine.py": (
+            "DecodeEngine._abort_import_page",
+            "DecodeEngine._register_import_page")},
+        "exit_roots": {"runtime/engine.py": (
+            "DecodeEngine._apply_kv_imports",)},
+    },
 }
 
 #: modules whose file writes are durability-critical (sealed artifacts,

@@ -396,6 +396,23 @@ def _defaults():
     #                                                    replica must be
     #                                                    /ready again
     #                                                    within this
+    root.common.serve.fleet.role = "mixed"   # capacity class replicas
+    #                                          join with (mixed | prefill
+    #                                          | decode) unless add_replica
+    #                                          / --join names one
+    # Disaggregated prefill/decode (runtime/fleet.py + engine
+    # export_pages/import_pages, docs/serving.md "Disaggregated
+    # prefill/decode"): serialized KV-page transfer between replicas.
+    root.common.serve.kv_transfer.enabled = True  # router-initiated
+    #                                               page transfers
+    root.common.serve.kv_transfer.min_pages = 2  # smallest prefix (full
+    #                                              pages) worth shipping
+    root.common.serve.kv_transfer.timeout_s = 5.0  # per-leg transfer
+    #                                                HTTP deadline
+    root.common.serve.kv_transfer.prewarm_pages = 64  # top-K hottest
+    #                                                   pages the rolling
+    #                                                   drain pushes to
+    #                                                   the successor
     root.common.serve.deadline_s = 120.0     # default per-request deadline
     root.common.serve.runner_cache = 32      # generate() compiled-runner LRU
     root.common.serve.max_body_mb = 64       # POST body cap -> 413

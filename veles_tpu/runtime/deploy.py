@@ -799,24 +799,76 @@ class DeployController(Logger):
         return self._draining or (self.engine is not None
                                   and self.engine.draining)
 
-    def begin_drain(self) -> dict:
+    def begin_drain(self, handoff: Optional[str] = None) -> dict:
         """Async drain (the ``POST /admin/drain`` handler): flips
         ``/ready`` to 503 immediately, retires in-flight work on a
-        background thread, then releases :meth:`wait`."""
+        background thread, then releases :meth:`wait`.  ``handoff``
+        names a successor replica's base URL: the engine's hottest
+        prefix pages ship there (``PUT /kv/pages``) before the engine
+        stops, so sessions landing on the successor keep their warm
+        TTFT (docs/serving.md "Disaggregated prefill/decode")."""
         self._draining = True
         if self._drain_thread is None or not self._drain_thread.is_alive():
             self._drain_thread = threading.Thread(
-                target=self.drain, name="deploy-drain", daemon=True)
+                target=self.drain, kwargs={"handoff": handoff},
+                name="deploy-drain", daemon=True)
             self._drain_thread.start()
         return {"draining": True,
-                "drain_timeout_s": self.drain_timeout_s}
+                "drain_timeout_s": self.drain_timeout_s,
+                **({"handoff": handoff} if handoff else {})}
 
-    def drain(self, timeout: Optional[float] = None) -> bool:
+    def _handoff_pages(self, url: str) -> Optional[dict]:
+        """Ship the engine's hottest prefix pages to the successor at
+        ``url`` — the drain-side half of the rolling drain's pre-warm.
+        Best-effort end to end: any failure (dense layout, transfer
+        fault, unreachable successor, rejected blob) logs and returns
+        None; it must never delay or fail the drain itself."""
+        eng = self.engine
+        if eng is None or not getattr(eng, "paged", False):
+            return None
+        kvt = root.common.serve.kv_transfer
+        top = int(kvt.get("prewarm_pages", 64))
+        if top <= 0:
+            return None
+        try:
+            from . import faults
+            if faults.enabled():
+                plan = faults.get_plan()
+                if plan.kv_transfer_slow_ms:
+                    time.sleep(plan.kv_transfer_slow_ms / 1e3)
+                if plan.kv_transfer_drop \
+                        and faults.fire_once("deploy_kv_handoff"):
+                    raise OSError("fault: kv_transfer_drop")
+            hashes = eng.hot_page_hashes(top)
+            if not hashes:
+                return None
+            blob = eng.export_pages(hashes)
+            from .fleet_client import ReplicaClient
+            status, doc = ReplicaClient(
+                url, timeout_s=float(kvt.get("timeout_s", 5.0))
+            ).put_pages(blob)
+            if status == 200 and isinstance(doc, dict):
+                self.info("drain handoff: %d pages -> %s",
+                          int(doc.get("imported", 0))
+                          + int(doc.get("skipped", 0)), url)
+                return doc
+            self.warning("drain handoff rejected by %s (HTTP %s: %s)",
+                         url, status, doc)
+        except Exception as e:  # noqa: BLE001 — the drain proceeds
+            self.warning("drain handoff to %s failed: %s", url, e)
+        return None
+
+    def drain(self, timeout: Optional[float] = None,
+              handoff: Optional[str] = None) -> bool:
         """Graceful drain: stop admissions (503 on ``/ready``), stop the
         watcher, let in-flight slots retire, stop the engine, release
         :meth:`wait`.  Returns True when everything retired before the
-        deadline.  ``timeout=0`` skips the grace window (Ctrl-C)."""
+        deadline.  ``timeout=0`` skips the grace window (Ctrl-C).
+        ``handoff`` pre-warms a successor (see :meth:`begin_drain`)
+        while the engine is still alive to serve its pages."""
         self._draining = True
+        if handoff:
+            self._handoff_pages(handoff)
         self.stop_watcher()
         timeout = timeout if timeout is not None else self.drain_timeout_s
         t0 = time.monotonic()

@@ -61,6 +61,7 @@ from __future__ import annotations
 
 import collections
 import hashlib
+import json
 import math
 import threading
 import time
@@ -125,6 +126,12 @@ def prefix_page_hashes(prompt, page_size: int) -> list:
             h + prompt[i * psz:(i + 1) * psz].tobytes()).digest()
         hashes.append(h)
     return hashes
+
+
+# KV-page transfer wire magic (docs/serving.md "Disaggregated
+# prefill/decode"): version byte baked into the tag so a future format
+# bump rejects loudly instead of misparsing
+_KV_MAGIC = b"VTKV1\x00"
 
 
 def signature_mismatch(expected, got, limit: int = 6) -> str:
@@ -1108,6 +1115,32 @@ class DecodeEngine(Logger):
             self._evictions = 0            # guarded-by: self._page_lock
             self._cow_admissions = 0       # guarded-by: self._page_lock
             self._pool_rejected = 0        # guarded-by: self._page_lock
+            # KV-page transfer (docs/serving.md "Disaggregated
+            # prefill/decode"): which resident pages arrived over the
+            # wire (import_pages) rather than from a local prefill, so
+            # prefix hits on them can be attributed to the transfer
+            self._imported_pages: set = set()  # guarded-by: self._page_lock
+            self._remote_hit_pages = 0     # guarded-by: self._page_lock
+            self._kv_exported_pages = 0    # guarded-by: self._page_lock
+            self._kv_imported_pages = 0    # guarded-by: self._page_lock
+            self._kv_export_bytes = 0      # guarded-by: self._page_lock
+            self._kv_import_bytes = 0      # guarded-by: self._page_lock
+
+        # staged KV-page imports: parsed+validated blobs wait here for
+        # the scheduler to apply them at a decode-step boundary (the
+        # same discipline as the swap double buffer — the scheduler
+        # thread owns every _caches write).  Defined for dense engines
+        # too (always empty there: import_pages rejects before staging).
+        self._kv_imports: collections.deque = collections.deque()  # guarded-by: self._kv_import_lock
+        self._kv_import_lock = threading.Lock()
+        # wire-format identity: same-architecture weight sets share the
+        # signature hash; the swap counter separates weight VERSIONS so
+        # a blob exported before a hot swap can never contaminate the
+        # post-swap prefix index (kv_wver property)
+        self._kv_sig = hashlib.sha256(
+            repr(tree_signature(params)).encode()).hexdigest()[:12]
+        self._kv_entry_cache = None     # lazy _kv_entries() memo
+        self._prefill_tok_s = 0.0       # scheduler-thread-written
 
         # queue + scheduler (priority-FIFO: class 0 pops first)
         self._queue: _PrioQueue = _PrioQueue(self.priorities)  # guarded-by: self._qlock
@@ -1347,6 +1380,31 @@ class DecodeEngine(Logger):
             "vt_admission_window",
             "admitted queue window the SLO-driven controller currently "
             "grants (== serve.queue_depth when fully open)")
+        # KV-page transfer (docs/serving.md "Disaggregated
+        # prefill/decode"): serialized prefix-page export/import volume
+        # and the prefix hits that landed on imported pages
+        self._m_kv_exported = reg.counter(
+            "vt_kv_pages_exported_total",
+            "prefix pages serialized out by export_pages "
+            "(GET /kv/pages)")
+        self._m_kv_imported = reg.counter(
+            "vt_kv_pages_imported_total",
+            "prefix pages deserialized into the pool by import_pages "
+            "(PUT /kv/pages) — skipped duplicates and pool-full drops "
+            "not included")
+        self._m_kv_bytes = reg.counter(
+            "vt_kv_transfer_bytes_total",
+            "serialized KV-page wire bytes, by transfer direction",
+            labels=("direction",))
+        self._m_kv_seconds = reg.histogram(
+            "vt_kv_transfer_seconds",
+            "wall time of one export_pages / import_pages call "
+            "(serialize or validate+apply; not the network leg), by "
+            "direction", labels=("direction",))
+        self._m_remote_hits = reg.counter(
+            "vt_prefix_remote_hits_total",
+            "prefix-cache page hits served by pages that arrived via "
+            "KV-page import rather than a local prefill")
 
     def _register_memory(self):  # not-shared: __init__-only construction, precedes any thread
         """Publish this engine's aval-derived byte ledger (runtime/
@@ -1699,6 +1757,9 @@ class DecodeEngine(Logger):
                 del self._prefix_index[self._page_key.pop(pid)]
                 if self._page_ref[pid] == 0:
                     self._page_free.append(pid)
+            # imported pages hold peer KV computed under the OLD
+            # weights too — same staleness, same drop
+            self._imported_pages.clear()
 
     def drain(self, timeout: Optional[float] = None) -> bool:
         """Graceful drain: stop admissions (``submit`` raises
@@ -2097,6 +2158,9 @@ class DecodeEngine(Logger):
                 "n": self.megastep,
                 "mega_dispatches": self._mega_steps,
             }} if self.megastep > 1 else {}),
+            **({"kv_transfer": kvt}
+               if (kvt := self._kv_transfer_summary()) is not None
+               else {}),
             "goodput": snap["goodput"],
             "memory": {
                 "headroom_slots": snap["headroom_slots"],
@@ -2170,8 +2234,11 @@ class DecodeEngine(Logger):
                         raise faults.FaultInjected(
                             "injected decode-scheduler crash")
                 # decode-step boundary: no program is running right now,
-                # so a staged weight swap flips here atomically
+                # so a staged weight swap flips here atomically — and
+                # staged KV-page imports land on the same boundary (the
+                # scheduler thread owns every _caches write)
                 self._apply_swap()
+                self._apply_kv_imports()
                 # lint: disable=VC201 bool(deque) is atomic under the
                 # GIL; a stale wakeup read only costs one 50ms tick
                 if not self._active.any() and not self._queue \
@@ -2213,8 +2280,10 @@ class DecodeEngine(Logger):
                 f"engine scheduler crashed: {type(e).__name__}: {e}"))
         finally:
             # a swap staged during shutdown still flips (harmless) so
-            # its waiter is released instead of blocking to timeout
+            # its waiter is released instead of blocking to timeout;
+            # staged KV imports drain for the same reason
             self._apply_swap()
+            self._apply_kv_imports()
             self._fail_all(EngineStopped("engine stopped"))
 
     def _fail_all(self, err: Exception):
@@ -2468,12 +2537,15 @@ class DecodeEngine(Logger):
             hits = self._prefix_hits_locked(hashes, P)
             row = np.full(self.n_ptab, self._scratch, np.int32)
             taken = []
+            remote = 0
             for i in range(hits):
                 pid = self._prefix_index[hashes[i]]
                 self._page_ref[pid] += 1
                 self._touch(pid)
                 row[i] = pid
                 taken.append(pid)
+                if pid in self._imported_pages:
+                    remote += 1
             for i in range(hits, need):
                 pid = self._alloc_page_locked()
                 if pid is None:          # shortage: roll back, requeue
@@ -2488,6 +2560,12 @@ class DecodeEngine(Logger):
                 taken.append(pid)
             self._prefix_hit_pages += hits
             self._prefix_miss_pages += max(full - hits, 0)
+            if remote:
+                # the hit landed on pages a peer prefilled and shipped
+                # over (import_pages) — the fleet-wide prefix-sharing
+                # payoff signal (vt_prefix_remote_hits_total)
+                self._remote_hit_pages += remote
+                self._m_remote_hits.inc(remote)
             if hits:
                 # copy-on-write admission: a shared prefix was mapped
                 # read-only and the first divergent token onward is
@@ -2505,6 +2583,7 @@ class DecodeEngine(Logger):
         if self._page_free:
             pid = self._page_free.pop()
             self._page_ref[pid] = 1
+            self._imported_pages.discard(pid)
             self._touch(pid)
             return pid
         best, best_tick = None, None
@@ -2517,6 +2596,7 @@ class DecodeEngine(Logger):
         del self._prefix_index[self._page_key.pop(best)]
         self._evictions += 1
         self._page_ref[best] = 1
+        self._imported_pages.discard(best)
         self._touch(best)
         return best
 
@@ -2556,6 +2636,350 @@ class DecodeEngine(Logger):
                     if pid not in self._page_key:
                         self._page_free.append(pid)
             self._ptab[slot] = self._scratch
+
+    # -- KV-page transfer: serialized prefix-page export/import across
+    # replicas (docs/serving.md "Disaggregated prefill/decode").  The
+    # wire format is magic + length-prefixed JSON header (page_size,
+    # weights version, per-entry dtype/shape layout, per-page integrity
+    # sha256) + concatenated raw page rows; pages are keyed by the same
+    # chained content hashes the prefix index uses, so an imported page
+    # is bitwise the page a local prefill would have computed. ---------
+
+    def _require_transfer(self):
+        """KV-page transfer needs content-addressed pages: dense caches
+        and recurrent chains reject LOUDLY (the REST layer's 400) —
+        shipping rows whose content is not a pure function of a prompt
+        prefix would silently corrupt the importer's decode."""
+        if not self.paged:
+            raise ValueError(
+                "KV-page transfer requires the paged KV layout "
+                "(serve.paged=True); dense caches have no "
+                "content-addressed pages to ship")
+        if not self._prefix_ok:
+            raise ValueError(
+                "KV-page transfer requires prefix reuse, which "
+                "recurrent units disable (their cache content is not a "
+                "pure function of a whole-page prompt prefix)")
+
+    @property
+    def kv_wver(self) -> str:
+        """Weights-version token stamped into every exported blob: the
+        parameter-tree signature hash joined with the hot-swap counter.
+        Import refuses a mismatch — pages computed under other weights
+        must never enter the prefix index (the same staleness rule that
+        makes :meth:`_apply_swap` invalidate the local cache)."""
+        return f"{self._kv_sig}.{self._swaps}"
+
+    def _kv_xfer_entries(self) -> list:
+        """Per-entry wire layout ``(name, part, dtype, row_shape)`` over
+        the attention caches, in deterministic order — the header both
+        sides must agree on byte for byte."""
+        if self._kv_entry_cache is None:
+            ents = []
+            for name in sorted(self._attn_cache_keys()):
+                for part in ("k", "v"):
+                    arr = self._caches[name][part]
+                    ents.append((name, part, str(np.dtype(arr.dtype)),
+                                 tuple(int(d) for d in arr.shape[1:])))
+            self._kv_entry_cache = ents
+        return self._kv_entry_cache
+
+    def _kv_page_bytes(self) -> int:
+        """Wire payload bytes of ONE page (all cache entries)."""
+        return sum(int(np.dtype(dt).itemsize) * int(np.prod(shape))
+                   for _n, _p, dt, shape in self._kv_xfer_entries())
+
+    @staticmethod
+    def _norm_hash(h) -> bytes:
+        """Page hashes are raw sha256 digests internally; the wire and
+        query-string forms are hex."""
+        return bytes.fromhex(h) if isinstance(h, str) else bytes(h)
+
+    def hot_page_hashes(self, k: int) -> list:
+        """The K hottest cached prefix pages (refcount desc, then LRU
+        recency) as raw digests — the rolling drain's pre-warm set.
+        Pages ship independently, so a truncated chain still serves
+        hits up to its first missing page."""
+        self._require_transfer()
+        with self._page_lock:
+            ranked = sorted(
+                self._page_key.items(),
+                key=lambda it: (int(self._page_ref[it[0]]),
+                                int(self._page_tick[it[0]])),
+                reverse=True)
+            return [h for _pid, h in ranked[:max(int(k), 0)]]
+
+    def export_pages(self, prefix_hashes) -> bytes:
+        """Serialize the requested prefix pages (those present; unknown
+        hashes are silently omitted) into the transfer wire format.
+        Requested pages are pinned (refcount++) for the gather so
+        eviction cannot recycle a row mid-read — registered pages are
+        written only by their original prefill, so the pinned rows are
+        immutable."""
+        self._require_transfer()
+        t0 = time.monotonic()
+        pinned = []
+        with self._page_lock:
+            seen = set()
+            for h in prefix_hashes:
+                h = self._norm_hash(h)
+                pid = self._prefix_index.get(h)
+                if pid is None or h in seen:
+                    continue
+                seen.add(h)
+                self._page_ref[pid] += 1
+                self._touch(pid)
+                pinned.append((h, pid))
+        try:
+            entries = self._kv_xfer_entries()
+            caches = self._caches
+            rows = []
+            if pinned:
+                pids = np.asarray([pid for _h, pid in pinned], np.int32)
+                rows = [np.asarray(caches[name][part][pids])
+                        for name, part, _dt, _shape in entries]
+            pages = []
+            payload = bytearray()
+            for i, (h, _pid) in enumerate(pinned):
+                page = b"".join(np.ascontiguousarray(r[i]).tobytes()
+                                for r in rows)
+                pages.append({"hash": h.hex(),
+                              "sha256": hashlib.sha256(page).hexdigest()})
+                payload += page
+        finally:
+            # unpin: same discipline as _release_slot_pages — a page a
+            # concurrent swap unregistered while we held it goes back
+            # to the free list here
+            with self._page_lock:
+                for _h, pid in pinned:
+                    self._page_ref[pid] -= 1
+                    if self._page_ref[pid] <= 0:
+                        self._page_ref[pid] = 0
+                        if pid not in self._page_key:
+                            self._page_free.append(pid)
+        hdr = json.dumps({
+            "page_size": self.page_size, "wver": self.kv_wver,
+            "entries": [[n, p, dt, list(s)] for n, p, dt, s in entries],
+            "pages": pages,
+        }).encode()
+        blob = _KV_MAGIC + len(hdr).to_bytes(4, "little") + hdr \
+            + bytes(payload)
+        with self._page_lock:
+            self._kv_exported_pages += len(pinned)
+            self._kv_export_bytes += len(blob)
+        self._m_kv_exported.inc(len(pinned))
+        self._m_kv_bytes.labels(direction="out").inc(len(blob))
+        self._m_kv_seconds.labels(direction="out").observe(
+            time.monotonic() - t0)
+        return blob
+
+    def _decode_pages_blob(self, blob) -> list:
+        """Validate a wire blob against the LOCAL geometry and weights
+        version; returns ``[(hash, [row arrays in entry order]), ...]``.
+        Every defect is a loud ValueError (the REST layer's 400) — a
+        page of someone else's KV silently entering the prefix index
+        would break bitwise identity for every request hitting it."""
+        blob = bytes(blob)
+        if blob[:len(_KV_MAGIC)] != _KV_MAGIC:
+            raise ValueError("not a KV-page blob (bad magic)")
+        off = len(_KV_MAGIC)
+        if len(blob) < off + 4:
+            raise ValueError("truncated KV-page blob (no header)")
+        n = int.from_bytes(blob[off:off + 4], "little")
+        off += 4
+        try:
+            hdr = json.loads(blob[off:off + n].decode())
+        except (UnicodeDecodeError, json.JSONDecodeError) as e:
+            raise ValueError(f"corrupt KV-page header: {e}") from e
+        off += n
+        if int(hdr.get("page_size", -1)) != self.page_size:
+            raise ValueError(
+                f"page_size mismatch: blob {hdr.get('page_size')} vs "
+                f"local {self.page_size}")
+        if str(hdr.get("wver")) != self.kv_wver:
+            raise ValueError(
+                f"weights-version mismatch: blob {hdr.get('wver')!r} "
+                f"vs local {self.kv_wver!r} — pages computed under "
+                "other weights cannot serve here")
+        local = [[n_, p, dt, list(s)]
+                 for n_, p, dt, s in self._kv_xfer_entries()]
+        if hdr.get("entries") != local:
+            raise ValueError(
+                "cache-entry layout mismatch (names/dtypes/shapes "
+                "differ from the local paged caches)")
+        sizes = [(np.dtype(dt), tuple(s),
+                  int(np.dtype(dt).itemsize) * int(np.prod(s)))
+                 for _n, _p, dt, s in self._kv_xfer_entries()]
+        page_bytes = sum(sz for _dt, _s, sz in sizes)
+        pages_hdr = hdr.get("pages") or []
+        if len(blob) - off != page_bytes * len(pages_hdr):
+            raise ValueError(
+                f"payload size mismatch: {len(blob) - off} bytes for "
+                f"{len(pages_hdr)} pages of {page_bytes}")
+        out = []
+        for meta in pages_hdr:
+            page = blob[off:off + page_bytes]
+            off += page_bytes
+            if hashlib.sha256(page).hexdigest() != meta.get("sha256"):
+                raise ValueError(
+                    "page integrity check failed for "
+                    f"{meta.get('hash')!r}")
+            rows, p_off = [], 0
+            for dt, shape, sz in sizes:
+                rows.append(np.frombuffer(
+                    page[p_off:p_off + sz], dtype=dt).reshape(shape))
+                p_off += sz
+            out.append((self._norm_hash(str(meta.get("hash"))), rows))
+        return out
+
+    def import_pages(self, blob, *, timeout: float = 30.0) -> dict:
+        """Deserialize a peer's prefix pages into the local pool.
+        Validation (geometry, weights version, per-page integrity) is
+        all-or-nothing and raises ValueError; the APPLY is per-page
+        best-effort: already-resident hashes are skipped, and when the
+        pool is fully referenced the page is dropped rather than the
+        transfer failed.  The device writes land on the scheduler
+        thread at a decode-step boundary (the swap discipline), so this
+        blocks until the next tick applies them.  Imported pages enter
+        the prefix index refcount-0 — cached, evictable, and dropped by
+        a swap's invalidation exactly like locally-prefilled ones."""
+        self._require_transfer()
+        t0 = time.monotonic()
+        pages = self._decode_pages_blob(blob)
+        box = {"applied": None, "error": None}
+        done = threading.Event()
+        with self._kv_import_lock:
+            self._kv_imports.append((pages, box, done))
+        if self.started:
+            self._wake.set()
+        else:
+            self._apply_kv_imports()
+        deadline = time.monotonic() + float(timeout)
+        while not done.wait(0.05):
+            if not self.started:
+                # the scheduler stopped between the enqueue and its
+                # drain: apply inline (the deque pop under
+                # _kv_import_lock makes concurrent drains safe)
+                self._apply_kv_imports()
+            elif time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"KV-page import not applied within {timeout}s "
+                    "(scheduler wedged?)")
+        if box["error"]:
+            raise ValueError(
+                f"KV-page import failed mid-apply: {box['error']}")
+        imported, skipped, dropped, hashes = box["applied"]
+        with self._page_lock:
+            self._kv_imported_pages += imported
+            self._kv_import_bytes += len(blob)
+        self._m_kv_imported.inc(imported)
+        self._m_kv_bytes.labels(direction="in").inc(len(blob))
+        self._m_kv_seconds.labels(direction="in").observe(
+            time.monotonic() - t0)
+        return {"imported": imported, "skipped": skipped,
+                "dropped": dropped,
+                "hashes": [h.hex() for h in hashes]}
+
+    def _claim_import_page(self):
+        """One pool page claimed (``self._page_ref`` goes 1) for an
+        in-flight KV-page import; None when every page is referenced
+        by a live slot.  The "kv-transfer" acquire (analysis registry
+        RESOURCE_PAIRS): every exit must reach
+        :meth:`_abort_import_page` or hand the page to
+        :meth:`_register_import_page`."""
+        with self._page_lock:
+            return self._alloc_page_locked()
+
+    def _abort_import_page(self, pid: int):
+        """Return a claimed-but-unregistered import page to
+        ``self._page_free`` (the "kv-transfer" release): the apply
+        aborted and the page never entered the prefix index."""
+        with self._page_lock:
+            self._page_ref[pid] = 0
+            self._page_free.append(pid)
+
+    def _register_import_page(self, pid: int, h: bytes):
+        """Publish an imported page in the prefix index exactly like a
+        locally-prefilled one: refcount back to 0 (cached state —
+        evictable under pressure, freed by release-path bookkeeping
+        once unregistered) plus the imported-page attribution set."""
+        with self._page_lock:
+            self._page_ref[pid] = 0
+            self._prefix_index[h] = pid
+            self._page_key[pid] = h
+            self._imported_pages.add(pid)
+            self._touch(pid)
+
+    def _apply_kv_imports(self):
+        """Drain staged KV-page imports (scheduler thread at a decode-
+        step boundary, or inline on a stopped engine).  Per page:
+        skip duplicates, claim a pool page, write the device rows,
+        register.  A write failure releases the claimed page and fails
+        THAT import's caller — never the scheduler every other request
+        shares."""
+        while True:
+            with self._kv_import_lock:
+                if not self._kv_imports:
+                    return
+                pages, box, done = self._kv_imports.popleft()
+            imported = skipped = dropped = 0
+            hashes = []
+            entries = self._kv_xfer_entries()
+            try:
+                for h, rows in pages:
+                    with self._page_lock:
+                        pid0 = self._prefix_index.get(h)
+                        if pid0 is not None:
+                            self._touch(pid0)
+                    if pid0 is not None:
+                        skipped += 1
+                        hashes.append(h)
+                        continue
+                    pid = self._claim_import_page()
+                    if pid is None:
+                        # every page is referenced by a live slot:
+                        # drop this page rather than fail the
+                        # transfer — the peer's prefix simply stays
+                        # cold here
+                        dropped += 1
+                        continue
+                    try:
+                        for (name, part, _d, _s), row in zip(entries,
+                                                             rows):
+                            self._caches[name][part] = \
+                                self._caches[name][part].at[pid].set(row)
+                    except Exception:
+                        self._abort_import_page(pid)
+                        raise
+                    self._register_import_page(pid, h)
+                    imported += 1
+                    hashes.append(h)
+            except Exception as e:  # noqa: BLE001 — surface on the
+                # importer's call, never crash the shared scheduler
+                box["error"] = f"{type(e).__name__}: {e}"
+            box["applied"] = (imported, skipped, dropped, hashes)
+            done.set()
+
+    def _kv_transfer_summary(self) -> Optional[dict]:
+        """The ``stats()["kv_transfer"]`` group: transfer volume, the
+        remote-hit attribution, and the two numbers the fleet router's
+        fetch-payoff policy scrapes (wire bytes per page and the
+        prefill-throughput EWMA)."""
+        if not self.paged:
+            return None
+        with self._page_lock:
+            out = {
+                "exported_pages": self._kv_exported_pages,
+                "imported_pages": self._kv_imported_pages,
+                "export_bytes": self._kv_export_bytes,
+                "import_bytes": self._kv_import_bytes,
+                "remote_hit_pages": self._remote_hit_pages,
+            }
+        out["page_bytes"] = self._kv_page_bytes() if self._prefix_ok \
+            else 0
+        out["prefill_tok_s"] = round(self._prefill_tok_s, 1)
+        out["wver"] = self.kv_wver
+        return out
 
     def _prefill(self, slot: int, req: _Request):
         """Admit ``req`` into ``slot``.  Short tails prefill in one
@@ -2681,6 +3105,14 @@ class DecodeEngine(Logger):
         req.bucket = lab
         self._m_prefill.labels(bucket=lab).observe(
             now - req.run_started_at)
+        # prefill-throughput EWMA (tokens/s over the whole tail) — the
+        # fleet router's fetch-vs-reprefill payoff reads this off
+        # stats()["kv_transfer"] to estimate what a local re-prefill of
+        # N tokens would cost (scheduler thread only)
+        rate = max(1, start + new_len - req.chunk_first) \
+            / max(now - req.run_started_at, 1e-9)
+        self._prefill_tok_s = rate if self._prefill_tok_s <= 0 \
+            else 0.8 * self._prefill_tok_s + 0.2 * rate
         if req.first_token_at is None:
             # chunked or not, preempted-before-first-token or not: TTFT
             # is observed exactly once, at the ACTUAL first token

@@ -64,6 +64,18 @@ node in production, so the :func:`enabled` fast path is one falsy check):
     seen from the router): its outstanding count grows and the
     load-affinity dispatch shifts traffic to the fast survivors.
     Fires per request while armed, like ``slow_batch_ms``.
+``kv_transfer_drop``
+    int.  The fleet router's first N KV-page transfers (remote prefix
+    fetch, disagg prefill ship, drain pre-warm — runtime/fleet.py
+    ``_transfer_pages``) fail as transport errors before any bytes
+    move.  The request they were placed for must complete via local
+    prefill — the transfer path is an optimization, never a
+    dependency (tests/test_chaos.py).
+``kv_transfer_slow_ms``
+    float.  Every router KV-page transfer sleeps this many
+    milliseconds first (a slow inter-replica link): the measured
+    bandwidth EWMA degrades and the fetch-vs-reprefill payoff policy
+    starts choosing local prefill on its own.
 """
 
 from __future__ import annotations
@@ -102,7 +114,8 @@ class FaultPlan:
     __slots__ = ("nan_grad_at_step", "loader_ioerror_at_batch",
                  "truncate_snapshot", "slow_batch_ms", "scheduler_crash",
                  "decode_stall_ms", "admission_burst",
-                 "replica_crash_at_request", "replica_slow_ms")
+                 "replica_crash_at_request", "replica_slow_ms",
+                 "kv_transfer_drop", "kv_transfer_slow_ms")
 
     def __init__(self, cfg):
         get = cfg.get
@@ -117,6 +130,9 @@ class FaultPlan:
         self.replica_crash_at_request = int(
             get("replica_crash_at_request", 0) or 0)
         self.replica_slow_ms = float(get("replica_slow_ms", 0.0) or 0.0)
+        self.kv_transfer_drop = int(get("kv_transfer_drop", 0) or 0)
+        self.kv_transfer_slow_ms = float(
+            get("kv_transfer_slow_ms", 0.0) or 0.0)
 
     def __bool__(self) -> bool:
         return bool(self.nan_grad_at_step or self.loader_ioerror_at_batch
@@ -124,7 +140,9 @@ class FaultPlan:
                     or self.scheduler_crash or self.decode_stall_ms
                     or self.admission_burst
                     or self.replica_crash_at_request
-                    or self.replica_slow_ms)
+                    or self.replica_slow_ms
+                    or self.kv_transfer_drop
+                    or self.kv_transfer_slow_ms)
 
     def __repr__(self) -> str:
         armed = {k: getattr(self, k) for k in self.__slots__

@@ -50,6 +50,20 @@ fleet-level behaviors horizontal scale needs:
   requests are instead routed to the least-burned replica), and
   automatic readmission when an ejected replica answers ``/ready``
   again;
+* **disaggregated prefill/decode + fleet-wide prefix sharing**
+  (docs/serving.md "Disaggregated prefill/decode"): serialized KV-page
+  transfer between replicas (``GET/PUT /kv/pages``) lets the router
+  place a request's prefix pages BEFORE dispatching it — a fetch from
+  the affinity-known holder when the routed replica is cold (gated by
+  a measured fetch-vs-reprefill payoff: wire bytes over the link
+  bandwidth EWMA against the replica's scraped prefill throughput), or
+  a full disagg leg when ``fleet.role = prefill`` capacity exists (the
+  prefill replica runs the chunked prefill, its finished pages ship to
+  the decode target, whose admission then starts at the shipped
+  length); the rolling drain pushes the victim's hottest pages to a
+  successor and repoints their affinity so post-drain warm-TTFT holds.
+  Every transfer failure falls back to local prefill — the path is an
+  optimization, never a dependency;
 * **aggregated observability**: fleet ``/metrics`` (the ``vt_fleet_*``
   family, per-replica labels), a merged ``/slo.json`` whose windowed
   quantiles come from summing the replicas' scraped cumulative
@@ -100,9 +114,16 @@ class Replica:
     def __init__(self, rid: str, client: ReplicaClient, *,
                  registry_key: Optional[str] = None,
                  restart: Optional[Callable[[], str]] = None,
-                 kill: Optional[Callable[[], None]] = None):
+                 kill: Optional[Callable[[], None]] = None,
+                 role: str = "mixed"):
         self.id = rid
         self.client = client
+        #: capacity class (docs/serving.md "Disaggregated
+        #: prefill/decode"): "mixed" serves everything; "prefill"
+        #: replicas absorb prefill work and ship pages, never taking
+        #: normal dispatch while a non-prefill replica is up; "decode"
+        #: replicas receive shipped pages
+        self.role = role
         #: replicas sharing a metrics registry (in-process fleets)
         #: share a key; the SLO merge counts each key once
         self.registry_key = registry_key or client.base_url
@@ -130,6 +151,7 @@ class Replica:
         st = self.load or {}
         return {
             "id": self.id, "url": self.client.base_url,
+            "role": self.role,
             "state": self.state, "ready": self.ready,
             "outstanding": self.outstanding,
             "dispatched": self.dispatched,
@@ -272,6 +294,17 @@ class FleetRouter(Logger):
         # index (engine.prefix_page_hashes) or affinity keys never hit
         self.page_size = int(serve.get("page_size", 16)
                              if page_size is None else page_size)
+        # KV-page transfer policy (docs/serving.md "Disaggregated
+        # prefill/decode"): fetch-vs-reprefill is a measured payoff
+        # call, never a correctness one — every transfer failure falls
+        # back to local prefill
+        kvt = root.common.serve.kv_transfer
+        self.kv_transfer_enabled = bool(kvt.get("enabled", True))
+        self.kv_min_pages = int(kvt.get("min_pages", 2))
+        self.kv_timeout_s = float(kvt.get("timeout_s", 5.0))
+        self.prewarm_pages = int(kvt.get("prewarm_pages", 64))
+        #: replicas added without an explicit role class
+        self.default_role = str(fleet.get("role", "mixed"))
 
         self._lock = threading.Lock()
         self._replicas: List[Replica] = []  # guarded-by: self._lock
@@ -283,6 +316,12 @@ class FleetRouter(Logger):
         self._last_pick: Optional[str] = None  # guarded-by: self._lock
         self._affinity_hits = 0  # guarded-by: self._lock
         self._affinity_requests = 0  # guarded-by: self._lock
+        # KV-transfer payoff inputs: link bandwidth EWMA over measured
+        # transfers (the spec-decode _spec_worthwhile idiom — the first
+        # few transfers are optimistic probes that seed the estimate)
+        self._kv_bw_ewma = 0.0  # bytes/s  # guarded-by: self._lock
+        self._kv_transfers = 0  # guarded-by: self._lock
+        self._kv_drops = 0  # fault-plan drop budget used  # guarded-by: self._lock
         self._draining = False
         self._stop_evt = threading.Event()
         self._scrape_thread: Optional[threading.Thread] = None
@@ -349,6 +388,17 @@ class FleetRouter(Logger):
             "vt_fleet_rolling_drains_total",
             "completed rolling-drain cycles (every replica drained, "
             "restarted and readmitted in turn)")
+        self._m_kv_fetches = reg.counter(
+            "vt_fleet_kv_fetches_total",
+            "router-initiated KV-page transfers between replicas, by "
+            "outcome (ok / skipped by payoff / failed / rejected / "
+            "disagg / prewarm)",
+            labels=("outcome",))
+        self._g_kv_payoff = reg.gauge(
+            "vt_fleet_kv_fetch_payoff",
+            "last fetch-vs-reprefill payoff estimate (estimated local "
+            "prefill seconds over estimated transfer seconds; >= 1 "
+            "means fetching beats recomputing; 0 while probing cold)")
 
         # fleet-merged rolling SLO windows over the scraped histograms
         # (the same HistogramWindow machinery /slo.json uses per
@@ -378,21 +428,29 @@ class FleetRouter(Logger):
                     client: Optional[ReplicaClient] = None,
                     registry_key: Optional[str] = None,
                     restart: Optional[Callable[[], str]] = None,
-                    kill: Optional[Callable[[], None]] = None) -> Replica:
+                    kill: Optional[Callable[[], None]] = None,
+                    role: Optional[str] = None) -> Replica:
         """Register one replica (by base URL or a prebuilt client).
         New replicas start ACTIVE but un-``ready``; the next scrape (or
-        first dispatch) fills in their health."""
+        first dispatch) fills in their health.  ``role`` assigns the
+        capacity class (mixed | prefill | decode —
+        ``serve.fleet.role`` when omitted)."""
         if client is None:
             if not url:
                 raise ValueError("add_replica needs a url or a client")
             client = ReplicaClient(url)
+        role = self.default_role if role is None else str(role)
+        if role not in ("mixed", "prefill", "decode"):
+            raise ValueError(
+                f"fleet role must be mixed | prefill | decode, "
+                f"got {role!r}")
         with self._lock:
             rid = f"r{len(self._replicas)}"
             rep = Replica(rid, client, registry_key=registry_key,
-                          restart=restart, kill=kill)
+                          restart=restart, kill=kill, role=role)
             self._replicas.append(rep)
-        self.info("fleet: replica %s joined at %s", rep.id,
-                  client.base_url)
+        self.info("fleet: replica %s joined at %s (role %s)", rep.id,
+                  client.base_url, role)
         return rep
 
     def replicas(self) -> List[Replica]:
@@ -621,6 +679,13 @@ class FleetRouter(Logger):
         with self._lock:
             cands = [r for r in self._replicas
                      if r.state == ACTIVE and r.id not in tried]
+            # capacity classes: prefill-role replicas serve the disagg
+            # prefill leg (docs/serving.md "Disaggregated
+            # prefill/decode"), not normal dispatch — unless the fleet
+            # has NOTHING else (availability beats role purity)
+            serving = [r for r in cands if r.role != "prefill"]
+            if serving:
+                cands = serving
             if not cands:
                 return None, False
             open_ = [r for r in cands if r.backoff_until <= now]
@@ -700,6 +765,182 @@ class FleetRouter(Logger):
                 + max(0.1, float(retry_after_s))
         self._m_backpressure.inc()
 
+    # -- KV-page transfer (docs/serving.md "Disaggregated
+    # prefill/decode"): every helper here is BEST-EFFORT — a failed or
+    # rejected transfer means the target replica prefills locally,
+    # never an errored request -----------------------------------------------
+    def _full_hashes(self, prompt) -> List[bytes]:
+        """Chained page hashes of the WHOLE prompt head row — the
+        disagg ship set, unlike :meth:`_head_hashes` which truncates to
+        ``affinity_pages`` for routing keys."""
+        if prompt is None:
+            return []
+        try:
+            row = np.asarray(prompt)
+            if row.ndim == 2:
+                row = row[0]
+            row = row.reshape(-1)
+            if not np.issubdtype(row.dtype, np.number):
+                return []
+            return prefix_page_hashes(row.astype(np.int64),
+                                      self.page_size)
+        except (TypeError, ValueError):
+            return []
+
+    def _kv_fault_drop(self) -> bool:
+        """Consult the fault plan's transfer knobs: sleep
+        ``kv_transfer_slow_ms`` per transfer, and report True while the
+        ``kv_transfer_drop`` budget (first N transfers fail) lasts."""
+        from . import faults
+        if not faults.enabled():
+            return False
+        plan = faults.get_plan()
+        if plan.kv_transfer_slow_ms:
+            time.sleep(plan.kv_transfer_slow_ms / 1e3)
+        if plan.kv_transfer_drop:
+            with self._lock:
+                if self._kv_drops < int(plan.kv_transfer_drop):
+                    self._kv_drops += 1
+                    return True
+        return False
+
+    def _fetch_worthwhile(self, rep: Replica, n_pages: int) -> bool:
+        """Fetch-vs-reprefill payoff (the spec-decode _spec_worthwhile
+        idiom): estimated transfer wall (page wire bytes over the
+        measured link-bandwidth EWMA) against estimated local prefill
+        wall (page tokens over the replica's scraped prefill
+        throughput).  Cold — no measured bandwidth yet, or the replica
+        hasn't scraped transfer geometry — is OPTIMISTIC: probe
+        transfers are how the estimate gets seeded."""
+        with self._lock:
+            cold = self._kv_transfers < 3
+            bw = self._kv_bw_ewma
+        xfer = (rep.load or {}).get("kv_transfer") or {}
+        try:
+            page_bytes = float(xfer.get("page_bytes", 0) or 0)
+            tok_s = float(xfer.get("prefill_tok_s", 0) or 0)
+        except (TypeError, ValueError):
+            page_bytes = tok_s = 0.0
+        if cold or bw <= 0 or page_bytes <= 0 or tok_s <= 0:
+            self._g_kv_payoff.set(0.0)
+            return True
+        est_fetch_s = n_pages * page_bytes / bw
+        est_prefill_s = n_pages * self.page_size / tok_s
+        payoff = est_prefill_s / max(est_fetch_s, 1e-9)
+        self._g_kv_payoff.set(round(payoff, 4))
+        return payoff >= 1.0
+
+    def _transfer_pages(self, src: Replica, dst: Replica, *,
+                        hashes: Optional[List[bytes]] = None,
+                        top: Optional[int] = None,
+                        outcome: str = "ok") -> Optional[dict]:
+        """Move pages ``src`` → ``dst`` (named hashes or src's top-K
+        hottest); returns dst's import doc on success, None on any
+        failure.  The measured wall feeds the bandwidth EWMA."""
+        t0 = time.monotonic()
+        try:
+            if self._kv_fault_drop():
+                raise ReplicaUnavailable("fault: kv_transfer_drop")
+            status, blob = src.client.fetch_pages(
+                hashes, top=top, timeout=self.kv_timeout_s)
+            if status != 200 or not blob:
+                self._m_kv_fetches.labels(outcome="failed").inc()
+                return None
+            status2, doc = dst.client.put_pages(
+                blob, timeout=self.kv_timeout_s)
+            if status2 != 200 or not isinstance(doc, dict):
+                # dst REJECTED the blob (geometry/weights-version) —
+                # its local prefill is the correct fallback
+                self._m_kv_fetches.labels(outcome="rejected").inc()
+                return None
+        except ReplicaUnavailable:
+            self._m_kv_fetches.labels(outcome="failed").inc()
+            return None
+        wall = max(time.monotonic() - t0, 1e-6)
+        with self._lock:
+            bw = len(blob) / wall
+            self._kv_bw_ewma = bw if self._kv_bw_ewma <= 0 \
+                else 0.8 * self._kv_bw_ewma + 0.2 * bw
+            self._kv_transfers += 1
+        self._m_kv_fetches.labels(outcome=outcome).inc()
+        return doc
+
+    def _maybe_fetch_remote(self, rep: Replica,
+                            hashes: List[bytes]) -> bool:
+        """Fleet-wide prefix-cache sharing: the deepest affinity-known
+        holder of the request's prefix pages ships them to the routed
+        replica before dispatch, payoff permitting, so the admission
+        there hits the imported prefix instead of re-prefilling."""
+        if len(hashes) < self.kv_min_pages:
+            return False
+        with self._lock:
+            holder_id = None
+            for h in reversed(hashes):
+                rid = self._affinity.get(h)
+                if rid is not None and rid != rep.id:
+                    holder_id = rid
+                    break
+            holder = next(
+                (r for r in self._replicas if r.id == holder_id
+                 and r.state in (ACTIVE, DRAINING)), None)
+        if holder is None:
+            return False
+        if not self._fetch_worthwhile(rep, len(hashes)):
+            self._m_kv_fetches.labels(outcome="skipped").inc()
+            return False
+        return self._transfer_pages(holder, rep,
+                                    hashes=hashes) is not None
+
+    def _disagg_prefill(self, rep: Replica, body: dict) -> bool:
+        """Disaggregated dispatch: a prefill-class replica runs the
+        (chunked) prefill — a steps=1 dispatch, whose single decode
+        step is the prefill's first token — then its finished pages
+        ship to the decode target, whose real admission starts at the
+        shipped length via prefix hits.  Any failed leg falls back to
+        a plain dispatch (``rep`` prefills locally)."""
+        full = self._full_hashes(body.get("prompt"))
+        if len(full) < self.kv_min_pages:
+            return False
+        with self._lock:
+            pre = [r for r in self._replicas
+                   if r.state == ACTIVE and r.role == "prefill"
+                   and r.id != rep.id]
+            p = min(pre, key=self._score_locked) if pre else None
+        if p is None:
+            return False
+        pb = dict(body)
+        pb["steps"] = 1
+        pb.pop("priority", None)  # the prefill leg must not queue-jump
+        try:
+            status, _doc, _retry = p.client.generate(
+                pb, timeout=self.dispatch_timeout_s)
+        except ReplicaUnavailable:
+            self._m_kv_fetches.labels(outcome="failed").inc()
+            return False
+        if status != 200:
+            self._m_kv_fetches.labels(outcome="failed").inc()
+            return False
+        self._record_affinity(full[:self.affinity_pages], p)
+        return self._transfer_pages(p, rep, hashes=full,
+                                    outcome="disagg") is not None
+
+    def _kv_prefetch(self, rep: Replica, body: dict,
+                     hashes: List[bytes]):
+        """Pre-dispatch page placement, in preference order: the
+        disagg prefill leg when prefill-class capacity exists, else a
+        remote fetch from the affinity holder.  Never raises — the
+        transfer path is an optimization over local prefill, not a
+        dependency of the request."""
+        if not self.kv_transfer_enabled:
+            return
+        try:
+            if self._disagg_prefill(rep, body):
+                return
+            self._maybe_fetch_remote(rep, hashes)
+        except Exception:  # noqa: BLE001 — local prefill serves
+            self.exception("kv prefetch failed; falling back to "
+                           "local prefill")
+
     def handle_generate(self, body: dict) -> Tuple[int, object, Tuple]:
         """Route + forward one ``/generate`` →
         ``(status, doc, extra headers)``.  Failover policy: transport
@@ -729,6 +970,7 @@ class FleetRouter(Logger):
         tried: set = set()
         retry_hint: Optional[float] = None
         hit_counted = False
+        prefetched = False
         for _attempt in range(n_replicas + 1):
             rep, hit = self._route(priority, hashes, tried)
             if rep is None:
@@ -742,6 +984,14 @@ class FleetRouter(Logger):
                     self._affinity_hits += 1
             if plan is not None:
                 self._inject_faults(plan, rep, route_n)
+            if hashes and not hit and not prefetched:
+                # cold here (no affinity hit on the routed replica):
+                # place the prefix pages there first — a disagg
+                # prefill leg or a fetch from the holder — so the
+                # admission below skips the re-prefill.  Once per
+                # request: a failover retry must not pay twice.
+                prefetched = True
+                self._kv_prefetch(rep, body, hashes)
             seq = self._begin_dispatch(rep)
             try:
                 try:
@@ -985,6 +1235,15 @@ class FleetRouter(Logger):
                          "readmitted": False}
                 with self._lock:
                     was_ejected = rep.state == EJECTED
+                if not was_ejected:
+                    # affinity-preserving drain: push the victim's hot
+                    # prefix pages to a successor BEFORE routing stops,
+                    # so sessions landing elsewhere post-drain keep
+                    # their warm TTFT (a dead replica has no pages to
+                    # push).  Best-effort like every transfer.
+                    entry["prewarm"] = self._prewarm_successor(rep)
+                with self._lock:
+                    was_ejected = rep.state == EJECTED
                     rep.state = DRAINING
                 entry["idle"] = True if was_ejected \
                     else self._wait_replica_idle(rep)
@@ -1028,6 +1287,42 @@ class FleetRouter(Logger):
             if summary["completed"]:
                 self._m_rolling_drains.inc()
             return summary
+
+    def _prewarm_successor(self, rep: Replica) -> Optional[dict]:
+        """Ship ``rep``'s top-K hottest prefix pages (refcount-ranked
+        — ``GET /kv/pages?top=K``) to the least-loaded surviving
+        replica and REPOINT the affinity entries that named ``rep`` as
+        holder, so post-drain routing lands where the pages now live.
+        Returns a summary dict for the drain report, None when skipped
+        or failed."""
+        if not self.kv_transfer_enabled or self.prewarm_pages <= 0:
+            return None
+        with self._lock:
+            others = [r for r in self._replicas
+                      if r.state == ACTIVE and r.id != rep.id
+                      and r.role != "prefill"]
+            succ = min(others, key=self._score_locked) if others \
+                else None
+        if succ is None:
+            return None
+        doc = self._transfer_pages(rep, succ, top=self.prewarm_pages,
+                                   outcome="prewarm")
+        if doc is None:
+            return None
+        moved = []
+        for hx in doc.get("hashes", ()):
+            try:
+                moved.append(bytes.fromhex(hx))
+            except (TypeError, ValueError):
+                continue
+        with self._lock:
+            for h in moved:
+                if self._affinity.get(h) == rep.id:
+                    self._affinity[h] = succ.id
+        return {"to": succ.id,
+                "pages": int(doc.get("imported", 0))
+                + int(doc.get("skipped", 0)),
+                "dropped": int(doc.get("dropped", 0))}
 
     def _wait_replica_idle(self, rep: Replica) -> bool:
         """The drained replica's router-tracked in-flight count AND
@@ -1159,6 +1454,11 @@ class FleetRouter(Logger):
             replicas = [r.doc() for r in self._replicas]
             hits, reqs = self._affinity_hits, self._affinity_requests
             affinity_entries = len(self._affinity)
+            kv_bw = self._kv_bw_ewma
+            kv_transfers = self._kv_transfers
+            roles: Dict[str, int] = {}
+            for r in self._replicas:
+                roles[r.role] = roles.get(r.role, 0) + 1
             # versions come from the scrape cache, NOT live HTTP: the
             # topology document is what operators poll during an
             # incident, and a wedged replica must not make it hang
@@ -1181,6 +1481,15 @@ class FleetRouter(Logger):
                 "entries": affinity_entries,
                 "requests": reqs, "hits": hits,
                 "hit_rate": round(hits / reqs, 4) if reqs else 0.0,
+            },
+            "roles": roles,
+            "kv_transfer": {
+                "enabled": self.kv_transfer_enabled,
+                "min_pages": self.kv_min_pages,
+                "timeout_s": self.kv_timeout_s,
+                "prewarm_pages": self.prewarm_pages,
+                "transfers": kv_transfers,
+                "bandwidth_Bps": round(kv_bw, 1),
             },
             "last_swap": self._last_swap,
             "last_rolling_drain": self._last_drain,
@@ -1269,7 +1578,8 @@ class FleetServer(Logger):
                             return
                         rep = outer.router.add_replica(
                             url=str(url),
-                            registry_key=req.get("registry_key"))
+                            registry_key=req.get("registry_key"),
+                            role=req.get("role"))
                         self._reply({"joined": rep.id,
                                      "url": rep.client.base_url})
                         return

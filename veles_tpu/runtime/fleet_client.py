@@ -118,6 +118,61 @@ class ReplicaClient:
                                         timeout=timeout)
         return body if status == 200 and isinstance(body, dict) else None
 
+    # -- KV-page transfer (docs/serving.md "Disaggregated
+    # prefill/decode"): the one raw-bytes path in the client — page
+    # blobs are binary wire format, not JSON ---------------------------------
+    def fetch_pages(self, hashes=None, top: Optional[int] = None,
+                    timeout: Optional[float] = None
+                    ) -> Tuple[int, bytes]:
+        """``GET /kv/pages`` → ``(status, blob)``.  ``hashes`` is an
+        iterable of page digests (raw bytes or hex — the query string
+        carries hex); ``top=K`` instead fetches the replica's K hottest
+        cached pages (the drain pre-warm set).  Non-200 answers return
+        the status with an empty blob; transport failures raise
+        :class:`ReplicaUnavailable` like every other call."""
+        if top is not None:
+            path = f"/kv/pages?top={int(top)}"
+        else:
+            hx = ",".join(h if isinstance(h, str) else bytes(h).hex()
+                          for h in (hashes or []))
+            path = f"/kv/pages?hashes={hx}"
+        req = urllib.request.Request(self.base_url + path, method="GET")
+        t = self.timeout_s if timeout is None else float(timeout)
+        try:
+            with urllib.request.urlopen(req, timeout=t) as r:
+                return r.status, r.read()
+        except urllib.error.HTTPError as e:
+            with e:
+                e.read()
+            return e.code, b""
+        except (urllib.error.URLError, ConnectionError, TimeoutError,
+                OSError) as e:
+            raise ReplicaUnavailable(
+                f"{self.base_url}: {type(e).__name__}: {e}") from e
+
+    def put_pages(self, blob: bytes, timeout: Optional[float] = None
+                  ) -> Tuple[int, object]:
+        """``PUT /kv/pages`` → ``(status, doc)`` — ship a serialized
+        page blob into the replica's prefix cache.  400 means the
+        replica REJECTED the blob (geometry/weights-version/integrity);
+        the caller falls back to local prefill, never errors the
+        request."""
+        req = urllib.request.Request(
+            self.base_url + "/kv/pages", data=bytes(blob),
+            headers={"Content-Type": "application/octet-stream"},
+            method="PUT")
+        t = self.timeout_s if timeout is None else float(timeout)
+        try:
+            with urllib.request.urlopen(req, timeout=t) as r:
+                return r.status, self._parse(r.read())
+        except urllib.error.HTTPError as e:
+            with e:
+                return e.code, self._parse(e.read())
+        except (urllib.error.URLError, ConnectionError, TimeoutError,
+                OSError) as e:
+            raise ReplicaUnavailable(
+                f"{self.base_url}: {type(e).__name__}: {e}") from e
+
     # -- dispatch ------------------------------------------------------------
     def generate(self, body: dict, timeout: Optional[float] = None
                  ) -> Tuple[int, object, float]:

@@ -38,6 +38,11 @@ tokens resident, evictions, copy-on-write admissions) when the engine
 runs the paged layout.  Request bodies are capped at
 ``root.common.serve.max_body_mb`` (413 beyond it — the
 snapshot_http_max_mb pattern applied to the ingress side).
+``GET /kv/pages?hashes=hex,...`` (or ``?top=K`` for the hottest
+cached pages) and ``PUT /kv/pages`` serve the serialized KV-page
+transfer path between replicas (docs/serving.md "Disaggregated
+prefill/decode") under the same ingress cap; dense engines answer
+400.
 
 Operational endpoints (docs/serving.md "Model lifecycle"): ``GET
 /healthz`` (liveness — answers whenever the process serves HTTP, engine
@@ -56,6 +61,7 @@ import http.server
 import json
 import threading
 from typing import Callable, Optional
+from urllib.parse import parse_qs, urlsplit
 
 import numpy as np
 
@@ -198,7 +204,73 @@ class RestfulServer(Logger):
                 if path == "/engine" and outer.engine is not None:
                     self._reply(outer.engine.stats())
                     return
+                if path == "/kv/pages" and outer.engine is not None:
+                    # serialized prefix-page export (docs/serving.md
+                    # "Disaggregated prefill/decode"): ?hashes=hex,hex
+                    # names pages by their chained prefix digests;
+                    # ?top=K ships the K hottest cached pages (the
+                    # rolling drain's pre-warm set).  Dense engines
+                    # answer 400 — loud rejection, not an empty blob.
+                    q = parse_qs(urlsplit(self.path).query)
+                    try:
+                        if "top" in q:
+                            hashes = outer.engine.hot_page_hashes(
+                                int(q["top"][0]))
+                        else:
+                            hashes = [h for part in q.get("hashes", [])
+                                      for h in part.split(",") if h]
+                        blob = outer.engine.export_pages(hashes)
+                    except ValueError as e:
+                        self._reply({"error": str(e)}, code=400)
+                        return
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "application/octet-stream")
+                    self.send_header("Content-Length", str(len(blob)))
+                    self.end_headers()
+                    self.wfile.write(blob)
+                    return
                 self.send_error(404)
+
+            def do_PUT(self):
+                # PUT /kv/pages: import a peer's serialized prefix
+                # pages.  Raw octet-stream body under the SAME
+                # root.common.serve.max_body_mb ingress cap as JSON
+                # POSTs (413 beyond it); every validation defect —
+                # bad magic, geometry or weights-version mismatch,
+                # integrity failure, dense layout — is the client's
+                # 400, never a silently-poisoned prefix cache.
+                path = self.path.split("?", 1)[0].rstrip("/")
+                if path != "/kv/pages":
+                    self.send_error(404)
+                    return
+                if outer.engine is None:
+                    self._reply(
+                        {"error": "KV-page transfer needs engine= "
+                                  "serving (see docs/serving.md "
+                                  '"Disaggregated prefill/decode")'},
+                        code=404)
+                    return
+                n = max(int(self.headers.get("Content-Length", 0)), 0)
+                cap = int(float(root.common.serve.get(
+                    "max_body_mb", 64)) * 2 ** 20)
+                if n > cap:
+                    self._reply(
+                        {"error": f"request body {n} bytes exceeds "
+                                  f"the {cap} byte cap "
+                                  "(root.common.serve.max_body_mb)"},
+                        code=413)
+                    return
+                blob = self.rfile.read(n)
+                try:
+                    self._reply(outer.engine.import_pages(blob))
+                except ValueError as e:
+                    self._reply({"error": str(e)}, code=400)
+                except TimeoutError as e:
+                    self._reply({"error": str(e)}, code=504)
+                except Exception as e:  # noqa: BLE001 — server answers
+                    self._reply({"error": f"{type(e).__name__}: {e}"},
+                                code=500)
 
             def do_POST(self):
                 path = self.path.split("?", 1)[0].rstrip("/")
@@ -231,8 +303,13 @@ class RestfulServer(Logger):
                         return
                     if path == "/admin/drain":
                         # async: the reply must not wait for in-flight
-                        # slots to retire (202 = drain accepted)
-                        self._reply(outer.deploy.begin_drain(), code=202)
+                        # slots to retire (202 = drain accepted).  An
+                        # optional {"handoff": url} pre-warms that
+                        # successor with this engine's hot prefix pages
+                        # before the engine stops (docs/serving.md
+                        # "Disaggregated prefill/decode").
+                        self._reply(outer.deploy.begin_drain(
+                            handoff=req.get("handoff")), code=202)
                         return
                     if path in ("/admin/stage", "/admin/commit",
                                 "/admin/abort"):
