@@ -68,6 +68,10 @@ def pytest_configure(config):
         "markers", "disagg: disaggregated prefill/decode tests — "
         "KV-page wire format, fleet transfer, capacity roles, drain "
         "pre-warm (tier-1; select alone with -m disagg)")
+    config.addinivalue_line(
+        "markers", "jobs: batch job manager / trough-filler lane tests "
+        "— durable store, REST job API, batch-class preemption "
+        "(tier-1; select alone with -m jobs)")
 
 
 @pytest.fixture(autouse=True)

@@ -2080,6 +2080,37 @@ def test_vr701_preempt_exit_root_declared(tmp_path):
     assert "kv-pages" in f.message
 
 
+def test_vr701_job_slots_exit_root_declared(tmp_path):
+    """The batch-lane ledger is registry-tracked: a file matching the
+    jobs module whose ``cancel`` no longer sweeps the in-flight ledger
+    (reaches no release) fires at the def line — a cancelled job would
+    otherwise pin ``vt_job_prompts_inflight`` forever."""
+    _write(tmp_path, "runtime/jobs.py", """\
+        class JobManager:
+            def _acquire_job_slot(self, key):
+                self._inflight[key] = 1
+
+            def _release_job_slot(self, key):
+                self._release_job_slot_locked(key)
+
+            def _release_job_slot_locked(self, key):
+                self._inflight.pop(key, None)
+
+            def cancel(self, job_id):
+                return job_id
+
+            def stop(self):
+                self._release_job_slot(None)
+        """)
+    found = [f for f in _lint(tmp_path) if f.rule == "VR701"]
+    assert len(found) == 1
+    f = found[0]
+    assert f.symbol == "JobManager.cancel"
+    assert f.line == _line_of(tmp_path, "runtime/jobs.py",
+                              "def cancel")
+    assert "job-slots" in f.message
+
+
 def test_vr702_unjoined_thread(tmp_path):
     _write(tmp_path, "mod.py", """\
         import threading
@@ -2215,6 +2246,10 @@ def test_resource_pairs_registry_honest():
         # the import lifecycle moves pages between the SAME pool
         # fields the kv-pages pair guards
         "kv-transfer": ("_page_free", "_page_ref"),
+        # the job manager's in-flight dispatch ledger (batch lane) —
+        # same delegate shape as fleet-dispatch: the public release
+        # takes the lock and calls the locked mutator
+        "job-slots": ("_inflight", "_release_job_slot_locked"),
     }
     assert set(RESOURCE_PAIRS) == set(backing_fields), \
         "new resource? declare its backing fields here too"

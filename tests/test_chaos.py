@@ -302,6 +302,140 @@ def test_fleet_survives_replica_kill_mid_burst():
             rep.stop()
 
 
+@pytest.mark.fleet
+@pytest.mark.faults
+@pytest.mark.jobs
+def test_batch_job_survives_replica_kill_mid_job(tmp_path):
+    """The batch-lane chaos rehearsal (docs/serving.md "Batch lane"):
+    a bulk job is mid-flight across a three-replica fleet when the
+    ``replica_crash_at_request`` fault kills the replica serving its
+    fifth dispatch.  The job must complete on the survivors with ZERO
+    duplicate and ZERO missing results — exactly one committed result
+    file per prompt — and every token stream must be bitwise-identical
+    to an uninterrupted run (sampled decode: the per-prompt derived
+    seed makes each result a pure function of the job spec, whatever
+    replica or retry produced it)."""
+    import json as _json
+    import urllib.request
+
+    import jax
+    import jax.numpy as jnp
+
+    import veles_tpu as vt
+    from veles_tpu.config import root
+    from veles_tpu.models.standard import build_workflow
+    from veles_tpu.ops import optimizers as opt
+    from veles_tpu.runtime import faults
+    from veles_tpu.runtime.deploy import DeployController
+    from veles_tpu.runtime.engine import DecodeEngine
+    from veles_tpu.runtime.fleet import (EJECTED, FleetRouter,
+                                         FleetServer, InProcessReplica)
+    from veles_tpu.runtime.generate import generate
+    from veles_tpu.runtime.restful import RestfulServer
+
+    V = 12
+    wf = build_workflow("chaos_jobs_lm", [
+        {"type": "embedding", "vocab": V, "dim": 16, "name": "emb"},
+        {"type": "attention", "n_heads": 2, "rope": True,
+         "residual": True, "name": "a1"},
+        {"type": "seq_last", "name": "last"},
+        {"type": "softmax", "output_size": V, "name": "out"}])
+    wf.build({"@input": vt.Spec((2, 6), jnp.int32),
+              "@labels": vt.Spec((2,), jnp.int32),
+              "@mask": vt.Spec((2,), jnp.float32)})
+    ws = wf.init_state(jax.random.key(3), opt.SGD(0.1))
+
+    rng = np.random.default_rng(7)
+    prompts = [rng.integers(0, V, (n,)).tolist()
+               for n in (4, 5, 3, 6, 4, 5, 4, 3, 5, 4)]
+    STEPS, SEED, TEMP, TOPK = 4, 77, 1.3, 5
+    # the uninterrupted run: generate() with each prompt's derived key
+    # IS the engine's bitwise contract for a 1-row request
+    refs = [np.asarray(generate(
+                wf, ws, np.asarray([p], np.int32), STEPS,
+                temperature=TEMP, top_k=TOPK,
+                key=jax.random.key(SEED + i)))[0]
+            for i, p in enumerate(prompts)]
+
+    def factory():
+        eng = DecodeEngine(wf, dict(ws), slots=2, l_max=64,
+                           window_ms=0.0)
+        srv = RestfulServer(wf.make_predict_step("out"), dict(ws), 2,
+                            (6,), port=0, workflow=wf, engine=eng,
+                            input_dtype=np.int32)
+        DeployController(server=srv)
+        return srv.start()
+
+    prev_scrape = root.common.serve.fleet.get("scrape_interval_s", 0.5)
+    root.common.serve.fleet.scrape_interval_s = 0.05
+    replicas = [InProcessReplica(factory) for _ in range(3)]
+    router = FleetRouter()
+    for rep in replicas:
+        router.add_replica(url=rep.url, registry_key="in-process",
+                           restart=rep.restart, kill=rep.kill)
+    fsrv = FleetServer(router, port=0,
+                       jobs_dir=str(tmp_path / "jobs")).start()
+    base = f"http://127.0.0.1:{fsrv.port}"
+
+    def fleet_doc():
+        with urllib.request.urlopen(base + "/fleet.json",
+                                    timeout=30) as r:
+            return _json.loads(r.read())
+
+    try:
+        # the 5th routed /generate kills the replica serving it — the
+        # job is mid-flight, with committed results on every replica
+        faults.configure(replica_crash_at_request=5,
+                         replica_slow_ms=10.0)
+        req = urllib.request.Request(
+            base + "/jobs",
+            data=_json.dumps({"prompts": prompts, "steps": STEPS,
+                              "temperature": TEMP, "top_k": TOPK,
+                              "seed": SEED}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            assert r.status == 200
+            jid = _json.loads(r.read())["id"]
+        assert fsrv.jobs.wait(jid, timeout_s=240), \
+            fsrv.jobs.status(jid)
+        st = fsrv.jobs.status(jid)
+        assert st["state"] == "done", st
+        assert st["done"] == len(prompts) and st["failed"] == 0, st
+        # zero duplicate / zero missing: exactly one committed result
+        # file per prompt, indices 0..9
+        rdir = tmp_path / "jobs" / jid / "results"
+        files = sorted(os.listdir(rdir))
+        assert files == [f"{i:06d}.json" for i in
+                         range(len(prompts))], files
+        # bitwise-identical to the uninterrupted run, in prompt order
+        with urllib.request.urlopen(
+                base + f"/jobs/{jid}/results?limit=64",
+                timeout=30) as r:
+            docs = _json.loads(r.read())["results"]
+        assert [d["index"] for d in docs] == list(range(len(prompts)))
+        for d in docs:
+            np.testing.assert_array_equal(
+                np.asarray(d["tokens"], np.int32), refs[d["index"]])
+        # the kill really happened and the fleet view carries the
+        # job summary (the merged /fleet.json surface)
+        deadline = time.monotonic() + 60
+        while True:
+            fd = fleet_doc()
+            states = [rep["state"] for rep in fd["replicas"]]
+            if states.count(EJECTED) == 1:
+                break
+            assert time.monotonic() < deadline, fd
+            time.sleep(0.05)
+        assert fd["jobs"]["by_state"] == {"done": 1}, fd["jobs"]
+        assert fd["jobs"]["prompts_inflight"] == 0, fd["jobs"]
+    finally:
+        faults.reset()
+        root.common.serve.fleet.scrape_interval_s = prev_scrape
+        fsrv.stop()
+        for rep in replicas:
+            rep.stop()
+
+
 @pytest.mark.disagg
 @pytest.mark.faults
 def test_kv_transfer_fails_mid_fetch_requests_survive():

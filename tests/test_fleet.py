@@ -518,6 +518,38 @@ def test_429_honored_as_router_backpressure():
             s.stop()
 
 
+def test_batch_429_does_not_backpressure_interactive():
+    """A 429 on a BATCH dispatch (trough closed) means the replica is
+    busy serving interactive — the opposite of shedding.  The router
+    must propagate it to the job manager WITHOUT opening a backoff
+    window: the next interactive request is still dispatched, and no
+    replica reports backoff remaining."""
+    stubs = [_SheddingReplica(retry_after_s=0.05) for _ in range(2)]
+    router = FleetRouter()
+    for s in stubs:
+        router.add_replica(url=s.url, registry_key=s.url)
+    try:
+        router.start()
+        status, doc, _h = router.handle_generate(
+            {"prompt": [[1, 2, 3]], "steps": 1, "batch": True})
+        assert status == 429                    # propagated to the job
+        calls_after_batch = sum(s.generate_calls for s in stubs)
+        assert calls_after_batch == 2           # both were tried
+        fd = router.fleet_doc()
+        assert all(r["backoff_remaining_s"] == 0
+                   for r in fd["replicas"]), fd
+        # a low-class interactive request STILL reaches a replica (the
+        # interactive test above pins the opposite: its 429s do open
+        # backoff windows that refuse class 1 at the router)
+        status, _doc, _h = router.handle_generate(
+            {"prompt": [[1, 2, 3]], "steps": 1, "priority": 1})
+        assert sum(s.generate_calls for s in stubs) > calls_after_batch
+    finally:
+        router.stop()
+        for s in stubs:
+            s.stop()
+
+
 # -- merged /slo.json --------------------------------------------------------
 
 def test_merged_slo_quantiles_vs_numpy():

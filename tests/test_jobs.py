@@ -1,0 +1,561 @@
+"""Batch job lane (runtime/jobs.py + docs/serving.md "Batch lane"):
+the durable job store commits through tmp-fsync-rename and rebuilds
+progress from disk, the manager shards jobs into per-prompt batch-class
+dispatches with exactly-once result commits across 429 backoff and
+crash/resume, the REST surface (POST/GET/DELETE /jobs*) round-trips
+against a live engine-backed replica, the engine's trough gate 429s
+batch work when headroom or burn say no, batch requests stay OUT of the
+interactive SLO histograms and are preempted first — bitwise-identical
+results either way — and the ensemble scoring sweep (the job API's
+first real consumer) runs entirely on the batch class."""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import veles_tpu as vt
+from veles_tpu.config import root
+from veles_tpu.ensemble import score_candidates
+from veles_tpu.models.standard import build_workflow
+from veles_tpu.ops import optimizers as opt
+from veles_tpu.runtime.engine import DecodeEngine, EngineOverloaded
+from veles_tpu.runtime.generate import generate
+from veles_tpu.runtime.jobs import (JobError, JobManager, JobStore,
+                                    handle_jobs_request)
+from veles_tpu.runtime.metrics import registry
+from veles_tpu.runtime.restful import RestfulServer
+
+pytestmark = pytest.mark.jobs
+
+V = 12
+
+LAYERS = [
+    {"type": "embedding", "vocab": V, "dim": 16, "name": "emb"},
+    {"type": "attention", "n_heads": 2, "rope": True,
+     "residual": True, "name": "a1"},
+    {"type": "seq_last", "name": "last"},
+    {"type": "softmax", "output_size": V, "name": "out"},
+]
+
+
+@pytest.fixture(scope="module")
+def lm():
+    wf = build_workflow("jobs_lm", LAYERS)
+    wf.build({"@input": vt.Spec((2, 6), jnp.int32),
+              "@labels": vt.Spec((2,), jnp.int32),
+              "@mask": vt.Spec((2,), jnp.float32)})
+    ws = wf.init_state(jax.random.key(3), opt.SGD(0.1))
+    return wf, ws
+
+
+def _fake_dispatch(body):
+    """Deterministic stand-in replica: echoes the prompt plus ``steps``
+    tokens derived from the per-prompt seed — a pure function of the
+    body, like the real engine's seeded decode."""
+    prompt = body["prompt"][0]
+    steps = body["steps"]
+    seed = body.get("seed", 0)
+    return 200, {"tokens": [list(prompt)
+                            + [(seed + k) % V for k in range(steps)]]}, ()
+
+
+def _mgr(tmp_path, dispatch, **kw):
+    kw.setdefault("workers", 2)
+    kw.setdefault("retry_s", 0.01)
+    return JobManager(str(tmp_path / "jobs"), dispatch, **kw).start()
+
+
+def _result_files(tmp_path, job_id):
+    d = tmp_path / "jobs" / job_id / "results"
+    return sorted(os.listdir(d)) if d.exists() else []
+
+
+# -- durable store -----------------------------------------------------------
+
+def test_store_roundtrip_rebuilds_done_set_from_disk(tmp_path):
+    """load_all recovers manifest + params and recomputes the done-set
+    from the committed result files — including error results, which
+    land in failed_idx.  A half-created dir (no manifest) is skipped."""
+    from veles_tpu.runtime.jobs import _Job
+    store = JobStore(str(tmp_path))
+    job = _Job("j1", [[1, 2], [3, 4], [5, 6]], {"steps": 4}, 7,
+               created=123.0)
+    store.commit_manifest(job)
+    store.commit_result("j1", 0, {"index": 0, "tokens": [1, 2, 9]})
+    store.commit_result("j1", 2, {"index": 2, "error": "too long"})
+    os.makedirs(tmp_path / "half-created")       # crash pre-manifest
+    loaded = store.load_all()
+    assert len(loaded) == 1
+    got = loaded[0]
+    assert got.id == "j1" and got.seed == 7
+    assert got.prompts == [[1, 2], [3, 4], [5, 6]]
+    assert got.params == {"steps": 4}
+    assert got.done_idx == {0, 2}
+    assert got.failed_idx == {2}
+    assert got.error_by_idx[2] == "too long"
+    assert store.read_result("j1", 1) is None
+
+
+def test_manager_completes_job_deterministically(tmp_path):
+    """A submitted job reaches state=done with one committed result per
+    prompt, in prompt order; every dispatched body rides the batch
+    class with the per-prompt derived seed."""
+    seen = []
+    lock = threading.Lock()
+
+    def dispatch(body):
+        with lock:
+            seen.append(body)
+        return _fake_dispatch(body)
+
+    mgr = _mgr(tmp_path, dispatch)
+    try:
+        doc = mgr.submit({"prompts": [[1, 2], [3], [4, 5, 6]],
+                          "steps": 3, "seed": 100})
+        assert doc["state"] == "running" and doc["prompts"] == 3
+        assert mgr.wait(doc["id"], timeout_s=30)
+        st = mgr.status(doc["id"])
+        assert st["state"] == "done"
+        assert st["done"] == 3 and st["failed"] == 0
+        res = mgr.results(doc["id"])
+        assert [r["index"] for r in res["results"]] == [0, 1, 2]
+        assert res["results"][0]["tokens"] == \
+            [1, 2] + [(100 + k) % V for k in range(3)]
+        assert res["results"][2]["tokens"] == \
+            [4, 5, 6] + [(102 + k) % V for k in range(3)]
+        assert "next_offset" not in res
+        with lock:
+            assert len(seen) == 3
+            assert all(b["batch"] is True for b in seen)
+            assert sorted(b["seed"] for b in seen) == [100, 101, 102]
+        assert _result_files(tmp_path, doc["id"]) == \
+            ["000000.json", "000001.json", "000002.json"]
+        assert mgr.summary()["by_state"] == {"done": 1}
+    finally:
+        mgr.stop()
+
+
+def test_spec_validation(tmp_path):
+    mgr = _mgr(tmp_path, _fake_dispatch, max_prompts=4)
+    try:
+        for bad in (
+            {"prompts": [[1]], "steps": 2, "temprature": 1.0},  # typo
+            {"steps": 2},                       # neither prompt source
+            {"prompts": [[1]], "prompt_file": "x"},      # both
+            {"prompts": [], "steps": 2},
+            {"prompts": [[]], "steps": 2},
+            {"prompts": [[1, "a"]], "steps": 2},
+            {"prompts": [[1.5]], "steps": 2},   # non-integral float
+            {"prompts": [[1]], "steps": 0},
+            {"prompts": [[1]] * 5, "steps": 2},  # over max_prompts
+            {"prompt_file": str(tmp_path / "missing.json")},
+        ):
+            with pytest.raises(JobError):
+                mgr.submit(bad)
+        assert mgr.summary()["total"] == 0
+    finally:
+        mgr.stop()
+
+
+def test_prompt_file_submission(tmp_path):
+    pf = tmp_path / "prompts.json"
+    pf.write_text(json.dumps([[1, 2], [3, 4]]))
+    mgr = _mgr(tmp_path, _fake_dispatch)
+    try:
+        doc = mgr.submit({"prompt_file": str(pf), "steps": 2})
+        assert mgr.wait(doc["id"], timeout_s=30)
+        assert mgr.status(doc["id"])["done"] == 2
+    finally:
+        mgr.stop()
+
+
+def test_429_backs_off_then_completes_exactly_once(tmp_path):
+    """429s (closed trough / replica backpressure) requeue with backoff
+    and never double-commit: each prompt lands exactly one result file
+    even though every prompt was turned away twice first."""
+    calls = {}
+    lock = threading.Lock()
+
+    def dispatch(body):
+        idx = body["seed"]          # seed==index here (job seed 0)
+        with lock:
+            calls[idx] = calls.get(idx, 0) + 1
+            if calls[idx] <= 2:
+                return 429, {"error": "batch trough closed: busy",
+                             "retry_after_s": 0.01}, ()
+        return _fake_dispatch(body)
+
+    mgr = _mgr(tmp_path, dispatch)
+    try:
+        doc = mgr.submit({"prompts": [[1], [2], [3]], "steps": 2})
+        assert mgr.wait(doc["id"], timeout_s=60)
+        assert mgr.status(doc["id"])["done"] == 3
+        with lock:
+            assert all(n == 3 for n in calls.values()), calls
+        assert len(_result_files(tmp_path, doc["id"])) == 3
+        assert mgr.summary()["prompts_inflight"] == 0
+    finally:
+        mgr.stop()
+
+
+def test_400_is_permanent_per_prompt_failure(tmp_path):
+    """A replica 400 (bad prompt) terminates that prompt with an error
+    result — the job still completes, failed count visible in status,
+    the error doc in the results page."""
+    def dispatch(body):
+        if len(body["prompt"][0]) > 2:
+            return 400, {"error": "prompt too long"}, ()
+        return _fake_dispatch(body)
+
+    mgr = _mgr(tmp_path, dispatch)
+    try:
+        doc = mgr.submit({"prompts": [[1], [2, 3, 4, 5], [6]],
+                          "steps": 2})
+        assert mgr.wait(doc["id"], timeout_s=30)
+        st = mgr.status(doc["id"])
+        assert st["state"] == "done"
+        assert st["done"] == 3 and st["failed"] == 1
+        res = mgr.results(doc["id"])["results"]
+        assert res[1] == {"index": 1, "error": "prompt too long"}
+        assert "tokens" in res[0] and "tokens" in res[2]
+    finally:
+        mgr.stop()
+
+
+def test_cancel_drops_queued_work_and_is_idempotent(tmp_path):
+    """DELETE semantics: queued prompts are dropped immediately, the
+    state is terminal and persisted (a restarted manager must NOT
+    resume a cancelled job), and cancelling twice is a no-op."""
+    gate = threading.Event()
+
+    def dispatch(body):
+        gate.wait(timeout=30)
+        return _fake_dispatch(body)
+
+    mgr = _mgr(tmp_path, dispatch, workers=1)
+    try:
+        doc = mgr.submit({"prompts": [[i + 1] for i in range(20)],
+                          "steps": 1})
+        st = mgr.cancel(doc["id"])
+        assert st["state"] == "cancelled"
+        assert mgr.cancel(doc["id"])["state"] == "cancelled"
+        gate.set()
+        assert mgr.summary()["cancelled"] == 1
+        assert mgr.summary()["prompts_pending"] == 0
+    finally:
+        gate.set()
+        mgr.stop()
+    # the terminal state survived: a fresh manager re-enqueues nothing
+    calls = []
+    mgr2 = JobManager(str(tmp_path / "jobs"),
+                      lambda b: calls.append(b) or _fake_dispatch(b),
+                      workers=1, retry_s=0.01).start()
+    try:
+        assert mgr2.status(doc["id"])["state"] == "cancelled"
+        time.sleep(0.2)
+        assert calls == []
+    finally:
+        mgr2.stop()
+
+
+def test_crash_resume_completes_missing_only_bitwise(tmp_path):
+    """The durability contract end to end: manager #1 commits a prefix
+    of the job then 'crashes' (stop()); manager #2 on the same store
+    dispatches ONLY the missing prompts, the job completes, and the
+    result files committed before the crash are byte-identical after —
+    resumed work never rewrites or re-runs finished work."""
+    def first_run_dispatch(body):
+        if body["seed"] >= 3:       # seed==index (job seed 0)
+            return 429, {"error": "later"}, ()
+        return _fake_dispatch(body)
+
+    mgr = _mgr(tmp_path, first_run_dispatch, workers=1)
+    doc = mgr.submit({"prompts": [[i + 1] for i in range(6)],
+                      "steps": 2})
+    job_id = doc["id"]
+    deadline = time.monotonic() + 30
+    while mgr.status(job_id)["done"] < 3:
+        assert time.monotonic() < deadline, mgr.status(job_id)
+        time.sleep(0.01)
+    mgr.stop()                      # the crash
+    rdir = tmp_path / "jobs" / job_id / "results"
+    before = {p: (rdir / p).read_bytes()
+              for p in _result_files(tmp_path, job_id)}
+    assert set(before) == {"000000.json", "000001.json", "000002.json"}
+
+    resumed = []
+    lock = threading.Lock()
+
+    def second_run_dispatch(body):
+        with lock:
+            resumed.append(body["seed"])
+        return _fake_dispatch(body)
+
+    mgr2 = JobManager(str(tmp_path / "jobs"), second_run_dispatch,
+                      workers=2, retry_s=0.01).start()
+    try:
+        assert mgr2.wait(job_id, timeout_s=30)
+        st = mgr2.status(job_id)
+        assert st["state"] == "done" and st["done"] == 6
+        with lock:
+            assert sorted(resumed) == [3, 4, 5]     # missing ONLY
+        for p, blob in before.items():
+            assert (rdir / p).read_bytes() == blob
+        assert len(_result_files(tmp_path, job_id)) == 6
+    finally:
+        mgr2.stop()
+
+
+# -- REST surface against a live replica -------------------------------------
+
+def _post(base, path, body):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=120) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        with e:
+            return e.code, json.loads(e.read())
+
+
+def _get(base, path):
+    try:
+        with urllib.request.urlopen(base + path, timeout=30) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        with e:
+            return e.code, json.loads(e.read())
+
+
+def _delete(base, path):
+    req = urllib.request.Request(base + path, method="DELETE")
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        with e:
+            return e.code, json.loads(e.read())
+
+
+def test_rest_job_api_end_to_end(lm, tmp_path, rng):
+    """POST /jobs → GET /jobs/<id> → paged GET results → DELETE, over
+    HTTP against an engine-backed replica; tokens bitwise-equal to the
+    reference generate() per prompt (greedy — the engine really decoded
+    them, on the batch class)."""
+    wf, ws = lm
+    prompts = [rng.integers(0, V, (n,)).tolist() for n in (4, 5, 3, 6)]
+    refs = [np.asarray(generate(wf, ws,
+                                np.asarray([p], np.int32), 4))[0]
+            for p in prompts]
+    eng = DecodeEngine(wf, dict(ws), slots=2, l_max=64, window_ms=0.0)
+    srv = RestfulServer(wf.make_predict_step("out"), dict(ws), 2, (6,),
+                        port=0, workflow=wf, engine=eng,
+                        input_dtype=np.int32,
+                        jobs_dir=str(tmp_path / "jobs")).start()
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        code, doc = _post(base, "/jobs",
+                          {"prompts": prompts, "steps": 4})
+        assert code == 200, doc
+        jid = doc["id"]
+        assert srv.jobs.wait(jid, timeout_s=120)
+        code, st = _get(base, f"/jobs/{jid}")
+        assert code == 200 and st["state"] == "done"
+        assert st["done"] == 4 and st["failed"] == 0
+        # paged read: limit=2 → two pages chained by next_offset
+        code, p1 = _get(base, f"/jobs/{jid}/results?limit=2")
+        assert code == 200 and len(p1["results"]) == 2
+        assert p1["next_offset"] == 2
+        code, p2 = _get(base,
+                        f"/jobs/{jid}/results?offset=2&limit=2")
+        assert code == 200 and "next_offset" not in p2
+        docs = p1["results"] + p2["results"]
+        for i, r in enumerate(docs):
+            np.testing.assert_array_equal(np.asarray(r["tokens"]),
+                                          refs[i])
+        # list + fleet-style summary via the manager
+        code, ls = _get(base, "/jobs")
+        assert code == 200 and len(ls["jobs"]) == 1
+        # error paths: unknown id 404, malformed spec 400
+        assert _get(base, "/jobs/nope")[0] == 404
+        assert _post(base, "/jobs", {"steps": 2})[0] == 400
+        assert _post(base, "/jobs",
+                     {"prompts": [[1]], "bogus": 1})[0] == 400
+        # DELETE cancels a second job
+        code, d2 = _post(base, "/jobs",
+                         {"prompts": [[1, 2]] * 3, "steps": 2})
+        assert code == 200
+        code, cd = _delete(base, f"/jobs/{d2['id']}")
+        assert code == 200 and cd["state"] in ("cancelled", "done")
+    finally:
+        srv.stop()
+
+
+def test_handle_jobs_request_routing():
+    """The shared glue: non-/jobs paths fall through (None) and a
+    missing manager is a 404 pointing at serve.jobs.dir."""
+    assert handle_jobs_request(None, "GET", "/predict", None) is None
+    code, doc = handle_jobs_request(None, "GET", "/jobs", None)
+    assert code == 404 and "serve.jobs.dir" in doc["error"]
+
+
+# -- trough gate + batch-class engine behavior -------------------------------
+
+@pytest.fixture
+def jobs_knobs():
+    """Save/restore the trough-gate knobs."""
+    jobs_cfg = root.common.serve.jobs
+    prev = (jobs_cfg.get("min_headroom_slots", 1),
+            jobs_cfg.get("burn_ceiling", 1.0))
+    yield jobs_cfg
+    jobs_cfg.min_headroom_slots = prev[0]
+    jobs_cfg.burn_ceiling = prev[1]
+
+
+def test_trough_gate_sheds_batch_submit(lm, jobs_knobs):
+    """With the headroom floor raised above the slot count the trough
+    is closed: batch submits 429 with the reason in the message, while
+    an interactive submit on the same engine still runs."""
+    wf, ws = lm
+    eng = DecodeEngine(wf, dict(ws), slots=2, l_max=64,
+                       window_ms=0.0).start()
+    try:
+        jobs_knobs.min_headroom_slots = 99
+        assert eng.trough_open() == (
+            False, "headroom 2 slots < serve.jobs.min_headroom_slots 99")
+        with pytest.raises(EngineOverloaded) as ei:
+            eng.submit(np.asarray([1, 2, 3], np.int32), 2, batch=True)
+        assert "batch trough closed" in str(ei.value)
+        # the hint is the sub-second trough re-probe knob, NOT the
+        # >=1s congestion-derived interactive hint — a 1s floor would
+        # park the job manager past every short trough
+        assert ei.value.retry_after_s == pytest.approx(0.05)
+        assert eng.stats()["batch"]["trough_open"] is False
+        # interactive is untouched by the batch gate
+        out = eng.generate(np.asarray([[1, 2, 3]], np.int32), 2,
+                           timeout=180)
+        assert out.shape == (1, 5)
+        # burn ceiling closes it too (any burn > -1 trips)
+        jobs_knobs.min_headroom_slots = 1
+        jobs_knobs.burn_ceiling = -1.0
+        open_, why = eng.trough_open()
+        assert not open_ and "burn" in why
+    finally:
+        eng.stop()
+
+
+def test_batch_excluded_from_slo_histograms(lm):
+    """Batch decodes leave the interactive queue-wait and TTFT
+    histograms untouched (they'd poison the SLO tracker's burn math),
+    while an interactive decode on the same engine observes both; batch
+    tokens DO land in the batch throughput accounting."""
+    wf, ws = lm
+    reg = registry()
+    eng = DecodeEngine(wf, dict(ws), slots=2, l_max=64,
+                       window_ms=0.0).start()
+    try:
+        h_ttft = reg.get("vt_request_ttft_seconds")
+        h_qw = reg.get("vt_request_queue_wait_seconds")
+        t0 = h_ttft.aggregate_snapshot()[2]
+        q0 = h_qw.aggregate_snapshot()[2]
+        prompt = np.asarray([[3, 1, 4, 1, 5]], np.int32)
+        ref = np.asarray(generate(wf, ws, prompt, 6))
+        got = eng.generate(prompt, 6, timeout=180, batch=True)
+        np.testing.assert_array_equal(got, ref)   # same tokens, just
+        assert h_ttft.aggregate_snapshot()[2] == t0   # no SLO burn
+        assert h_qw.aggregate_snapshot()[2] == q0
+        st = eng.stats()["batch"]
+        assert st["tokens_generated"] >= 6, st
+        eng.generate(prompt, 2, timeout=180)      # interactive: counts
+        assert h_ttft.aggregate_snapshot()[2] == t0 + 1
+        assert h_qw.aggregate_snapshot()[2] == q0 + 1
+    finally:
+        eng.stop()
+
+
+def test_interactive_preempts_batch_first_bitwise(lm, rng):
+    """A class-0 arrival preempts the RUNNING batch request (the
+    trough class is always the first victim), the batch stream resumes
+    bitwise-identical, and the dedicated preemption counters tick."""
+    wf, ws = lm
+    bat_prompt = rng.integers(0, V, (1, 5)).astype(np.int32)
+    hi_prompt = rng.integers(0, V, (1, 4)).astype(np.int32)
+    bat_ref = np.asarray(generate(wf, ws, bat_prompt, 40))
+    reg = registry()
+    c_pre = reg.get("vt_batch_preemptions_total")
+    n0 = c_pre.value
+    eng = DecodeEngine(wf, dict(ws), slots=1, l_max=64, window_ms=0.0,
+                       preempt=True).start()
+    try:
+        victim = eng.submit(bat_prompt[0], 40, batch=True)
+        deadline = time.monotonic() + 60
+        while eng.stats()["occupancy"] < 1:
+            assert time.monotonic() < deadline
+            time.sleep(0.001)
+        high = eng.submit(hi_prompt[0], 3, priority=0)
+        assert high.done.wait(180) and high.error is None
+        assert victim.done.wait(180) and victim.error is None
+        np.testing.assert_array_equal(victim.result[None], bat_ref)
+        assert victim.preemptions >= 1
+        assert high.finished_at < victim.finished_at
+        assert eng.stats()["batch"]["preemptions"] >= 1
+        assert c_pre.value >= n0 + 1
+    finally:
+        eng.stop()
+
+
+# -- ensemble sweep: the job API's first real consumer -----------------------
+
+def test_ensemble_sweep_runs_on_batch_class(tmp_path):
+    """score_candidates flattens every candidate's eval prompts into
+    ONE job whose every dispatch carries batch=True, unflattens the
+    committed results per candidate, and produces deterministic scores
+    (rerunning the sweep on a fresh manager scores identically)."""
+    dispatched = []
+    lock = threading.Lock()
+
+    def dispatch(body):
+        with lock:
+            dispatched.append(body)
+        return _fake_dispatch(body)
+
+    candidates = [
+        {"name": "cand-a", "prompts": [[1, 2], [3]]},
+        {"name": "cand-b", "prompts": [[4, 5, 6]]},
+        {"name": "cand-c", "prompts": [[7], [8], [9]]},
+    ]
+
+    def scorer(cand, docs):
+        # mean generated-token value — any pure function of results
+        toks = [t for d in docs for t in d["tokens"]]
+        return sum(toks) / len(toks)
+
+    def sweep(store):
+        mgr = _mgr(store, dispatch)
+        try:
+            return score_candidates(mgr, candidates, scorer,
+                                    steps=3, seed=50, timeout_s=30)
+        finally:
+            mgr.stop()
+
+    scores = sweep(tmp_path / "s1")
+    assert [s["name"] for s in scores] == ["cand-a", "cand-b", "cand-c"]
+    assert [s["n_prompts"] for s in scores] == [2, 1, 3]
+    assert len({s["job_id"] for s in scores}) == 1   # ONE batch job
+    with lock:
+        assert len(dispatched) == 6
+        assert all(b["batch"] is True for b in dispatched)
+        assert sorted(b["seed"] for b in dispatched) == list(
+            range(50, 56))
+    again = sweep(tmp_path / "s2")
+    assert [s["score"] for s in again] == [s["score"] for s in scores]

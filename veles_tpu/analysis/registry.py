@@ -151,6 +151,10 @@ HOST_LOOP_ROOTS = {
     "runtime/fleet.py": ("FleetRouter._scrape_loop",
                          "FleetRouter.handle_generate",
                          "FleetRouter.rolling_drain"),
+    # the batch job manager (runtime/jobs.py): dispatch workers and the
+    # REST glue are pure control plane — bodies in, committed result
+    # files out; they must never reach a traced-program builder.
+    "runtime/jobs.py": ("JobManager._worker", "handle_jobs_request"),
 }
 
 #: builders that own a documented per-geometry compile memo instead of
@@ -235,6 +239,22 @@ RESOURCE_PAIRS = {
         "exit_roots": {"runtime/engine.py": (
             "DecodeEngine._apply_kv_imports",)},
     },
+    # The batch job manager's in-flight ledger (runtime/jobs.py):
+    # every dispatched prompt registers in ``_inflight`` before its
+    # HTTP exchange and MUST unregister on result, permanent failure,
+    # cancel and shutdown — a leaked entry overstates
+    # vt_job_prompts_inflight and wedges the cancel path's accounting.
+    # The cancel and stop paths are the exit roots: both must provably
+    # reach the release.
+    "job-slots": {
+        "acquire": {"runtime/jobs.py": (
+            "JobManager._acquire_job_slot",)},
+        "release": {"runtime/jobs.py": (
+            "JobManager._release_job_slot",
+            "JobManager._release_job_slot_locked")},
+        "exit_roots": {"runtime/jobs.py": (
+            "JobManager.cancel", "JobManager.stop")},
+    },
 }
 
 #: modules whose file writes are durability-critical (sealed artifacts,
@@ -245,6 +265,7 @@ RESOURCE_PAIRS = {
 DURABLE_WRITE_MODULES = (
     "export/compiled.py",
     "export/package.py",
+    "runtime/jobs.py",
     "runtime/snapshotter.py",
 )
 

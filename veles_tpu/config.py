@@ -413,6 +413,31 @@ def _defaults():
     #                                                   pages the rolling
     #                                                   drain pushes to
     #                                                   the successor
+    # Batch job lane (runtime/jobs.py, docs/serving.md "Batch lane"):
+    # durable bulk-inference jobs riding the trough-filler class below
+    # every interactive priority.
+    root.common.serve.jobs.dir = ""          # durable job store root
+    #                                          ("" = job API off)
+    root.common.serve.jobs.workers = 2       # manager dispatch threads
+    root.common.serve.jobs.min_headroom_slots = 1  # idle admissible slots
+    #                                                required before batch
+    #                                                enters (trough gate)
+    root.common.serve.jobs.burn_ceiling = 1.0  # max SLO burn rate the
+    #                                            trough gate admits under
+    #                                            (interactive sheds at
+    #                                            admission.burn_threshold)
+    root.common.serve.jobs.trough_retry_s = 0.05  # Retry-After hint on a
+    #                                               trough-closed 429 —
+    #                                               sub-second because the
+    #                                               trough reopens at slot
+    #                                               granularity, unlike the
+    #                                               >=1s interactive hint
+    root.common.serve.jobs.retry_s = 0.25    # base backoff after a batch
+    #                                          429 (Retry-After overrides
+    #                                          upward)
+    root.common.serve.jobs.max_prompts = 100000  # per-job prompt cap
+    root.common.serve.jobs.page_limit = 256  # GET /jobs/<id>/results
+    #                                          default page size
     root.common.serve.deadline_s = 120.0     # default per-request deadline
     root.common.serve.runner_cache = 32      # generate() compiled-runner LRU
     root.common.serve.max_body_mb = 64       # POST body cap -> 413

@@ -1,1 +1,2 @@
 from .driver import EnsembleTrainer, EnsembleTester
+from .scoring import score_candidates

@@ -1,0 +1,81 @@
+"""Ensemble candidate scoring on the batch lane.
+
+The first real consumer of the job API (``runtime/jobs.py``): an
+ensemble eval sweep — N candidate configurations, each with its own
+eval prompt set — becomes ONE batch job.  Every prompt rides the
+engine's trough-filler class (``"batch": true`` in each dispatched
+body), so a sweep over hundreds of candidates runs entirely in the
+capacity interactive traffic is not using, yields instantly when a
+burst arrives, and survives crashes/drains via the job store's
+committed per-prompt results.  Contrast with :class:`~.driver.
+EnsembleTester`, which re-runs inference in-process per batch — the
+batch lane lets the sweep share a *serving* fleet instead.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+
+def score_candidates(jobs, candidates: Sequence[dict],
+                     scorer: Callable[[dict, List[dict]], float], *,
+                     steps: int = 8, seed: int = 0,
+                     temperature: Optional[float] = None,
+                     top_k: Optional[int] = None,
+                     top_p: Optional[float] = None,
+                     eos_id: Optional[int] = None,
+                     timeout_s: float = 120.0) -> List[dict]:
+    """Score every candidate by running its eval prompts through one
+    batch job and handing the committed results to ``scorer``.
+
+    ``jobs`` is a started :class:`~veles_tpu.runtime.jobs.JobManager`;
+    ``candidates`` is a sequence of ``{"name": str, "prompts":
+    [[token ids], ...]}``; ``scorer(candidate, results)`` maps a
+    candidate plus its prompt-ordered result docs (each ``{"index",
+    "tokens"}`` or ``{"index", "error"}``) to a float.  All candidate
+    prompt lists are flattened into a single job — per-prompt seeds are
+    ``seed + flat_index``, so scores are deterministic regardless of
+    which replica (or how many retries) served each prompt.  Returns
+    one ``{"name", "score", "n_prompts", "job_id"}`` per candidate, in
+    input order.
+    """
+    if not candidates:
+        return []
+    flat: List[List[int]] = []
+    bounds: List[int] = [0]
+    for cand in candidates:
+        prompts = cand["prompts"]
+        if not prompts:
+            raise ValueError(
+                f"candidate {cand.get('name')!r} has no eval prompts")
+        flat.extend(prompts)
+        bounds.append(len(flat))
+    spec = {"prompts": flat, "steps": int(steps), "seed": int(seed)}
+    for k, v in (("temperature", temperature), ("top_k", top_k),
+                 ("top_p", top_p), ("eos_id", eos_id)):
+        if v is not None:
+            spec[k] = v
+    doc = jobs.submit(spec)
+    job_id = doc["id"]
+    if not jobs.wait(job_id, timeout_s=timeout_s):
+        raise TimeoutError(
+            f"ensemble sweep job {job_id} not terminal after "
+            f"{timeout_s}s: {jobs.status(job_id)}")
+    by_idx = {}
+    offset = 0
+    while True:
+        page = jobs.results(job_id, offset)
+        for r in page["results"]:
+            by_idx[r["index"]] = r
+        if "next_offset" not in page:
+            break
+        offset = page["next_offset"]
+    out: List[dict] = []
+    for ci, cand in enumerate(candidates):
+        docs = [by_idx[i] for i in range(bounds[ci], bounds[ci + 1])
+                if i in by_idx]
+        out.append({"name": cand.get("name", str(ci)),
+                    "score": float(scorer(cand, docs)),
+                    "n_prompts": bounds[ci + 1] - bounds[ci],
+                    "job_id": job_id})
+    return out

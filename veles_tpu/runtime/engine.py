@@ -65,7 +65,7 @@ import json
 import math
 import threading
 import time
-from typing import NamedTuple, Optional
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -83,11 +83,17 @@ from .step_cache import StepCache, tree_signature
 
 
 class EngineOverloaded(RuntimeError):
-    """Request queue is full; retry after ``retry_after_s`` seconds."""
+    """Request queue is full; retry after ``retry_after_s`` seconds.
+
+    Interactive overload hints come from :meth:`_retry_after` (floored
+    at 1s — real congestion drains slowly); the batch trough-closed 429
+    passes a sub-second hint instead, because trough state flips at
+    slot granularity and a 1s floor would make the job manager sleep
+    through every short trough it exists to harvest."""
 
     def __init__(self, msg: str, retry_after_s: float = 1.0):
         super().__init__(msg)
-        self.retry_after_s = max(1.0, float(retry_after_s))
+        self.retry_after_s = max(0.0, float(retry_after_s))
 
 
 class EngineStopped(RuntimeError):
@@ -764,11 +770,12 @@ class _Request:
                  "error", "submitted_at", "slot", "finished_at",
                  "page_row", "prefix_start", "page_hashes",
                  "trace_id", "admitted_at", "first_token_at", "bucket",
-                 "priority", "gen", "preemptions", "chunk_next",
-                 "chunk_first", "run_started_at", "_eff")
+                 "priority", "batch", "gen", "preemptions",
+                 "chunk_next", "chunk_first", "run_started_at", "_eff")
 
     def __init__(self, prompt, n_steps, temperature, top_k, top_p,
-                 eos_id, key_data, deadline, priority: int = 0):
+                 eos_id, key_data, deadline, priority: int = 0,
+                 batch: bool = False):
         self.prompt = prompt            # (P,) np.int32
         self.n_steps = n_steps
         self.temperature = temperature
@@ -800,6 +807,12 @@ class _Request:
         # progress, and the latest admission stamp (victim selection
         # prefers the youngest run — the one losing least progress)
         self.priority = int(priority)
+        # batch lane (docs/serving.md "Batch lane"): trough-filler
+        # class strictly below every interactive priority — admitted
+        # only with headroom, first-preempted, excluded from SLO
+        # histograms (the tracker snapshots whole registry histograms,
+        # so exclusion must happen at observation time)
+        self.batch = bool(batch)
         self.gen = np.empty(0, np.int32)
         self.preemptions = 0
         self.chunk_next = 0             # next global position to prefill
@@ -1142,8 +1155,14 @@ class DecodeEngine(Logger):
         self._kv_entry_cache = None     # lazy _kv_entries() memo
         self._prefill_tok_s = 0.0       # scheduler-thread-written
 
-        # queue + scheduler (priority-FIFO: class 0 pops first)
-        self._queue: _PrioQueue = _PrioQueue(self.priorities)  # guarded-by: self._qlock
+        # queue + scheduler (priority-FIFO: class 0 pops first).  One
+        # extra INTERNAL class beyond the configured interactive range
+        # holds batch-lane work (docs/serving.md "Batch lane"): index
+        # self.priorities, strictly below every submittable priority,
+        # so victim selection preempts batch first and displacement
+        # sheds queued batch first — with no code path treating batch
+        # as anything but "just another (lowest) class".
+        self._queue: _PrioQueue = _PrioQueue(self.priorities + 1)  # guarded-by: self._qlock
         self._qlock = threading.Lock()
         self._shed_by_class: dict = {}  # guarded-by: self._qlock
         self._wake = threading.Event()
@@ -1185,6 +1204,12 @@ class DecodeEngine(Logger):
         # sensor is the tracker's windowed burn rate.  Injectable for
         # deterministic tests (``admission=``).
         self._preempted = ScopedCounter(self._m_preempt)
+        # batch lane: preemption counter view + a dedicated token rate
+        # (scheduler-thread-written, published by _publish_gauges)
+        self._batch_preempted = ScopedCounter(self._m_batch_preempt)
+        self._batch_tok_n = 0           # scheduler-thread-written
+        self._batch_rate_mark = (time.monotonic(), 0)
+        self._batch_tok_s = 0.0         # scheduler-thread-written
         self._admission = (self._admission_arg
                            if self._admission_arg is not None
                            else AdmissionController(
@@ -1405,6 +1430,18 @@ class DecodeEngine(Logger):
             "vt_prefix_remote_hits_total",
             "prefix-cache page hits served by pages that arrived via "
             "KV-page import rather than a local prefill")
+        # batch lane (docs/serving.md "Batch lane"): trough-filler
+        # throughput and how often interactive traffic reclaimed its
+        # slots.  Batch requests never touch the SLO histograms above —
+        # exclusion happens at observation time.
+        self._g_batch_tps = reg.gauge(
+            "vt_batch_tokens_per_sec",
+            "recent batch-lane decode throughput (0.5s window) — the "
+            "trough goodput interactive SLOs never see")
+        self._m_batch_preempt = reg.counter(
+            "vt_batch_preemptions_total",
+            "batch-lane slots preempted so interactive work could be "
+            "admitted (subset of vt_preemptions_total)")
 
     def _register_memory(self):  # not-shared: __init__-only construction, precedes any thread
         """Publish this engine's aval-derived byte ledger (runtime/
@@ -1797,7 +1834,7 @@ class DecodeEngine(Logger):
                top_k: Optional[int] = None, top_p: Optional[float] = None,
                eos_id: Optional[int] = None, key=None,
                deadline_s: Optional[float] = None,
-               priority: int = 0) -> _Request:
+               priority: int = 0, batch: bool = False) -> _Request:
         """Enqueue one sequence; returns a request whose ``done`` event
         fires with ``result`` (np.int32, prompt + generated, trimmed at
         eos) or ``error``.  Raises :class:`EngineOverloaded` when the
@@ -1807,7 +1844,14 @@ class DecodeEngine(Logger):
         ``priorities - 1``: higher classes pop first, may displace a
         queued lower-class request from a hard-full queue, may preempt
         a running lower-class slot, and are the last the controller
-        sheds (docs/serving.md "Overload survival")."""
+        sheds (docs/serving.md "Overload survival").
+
+        ``batch=True`` rides the trough-filler class (docs/serving.md
+        "Batch lane"): strictly below every interactive priority,
+        admitted only while slot headroom and SLO burn leave room
+        (429 "trough closed" otherwise), first-preempted when
+        interactive traffic arrives, and excluded from the queue-wait/
+        TTFT SLO histograms.  ``priority`` is ignored for batch."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("prompt must hold at least one token")
@@ -1815,7 +1859,11 @@ class DecodeEngine(Logger):
         if n_steps < 1:
             raise ValueError("n_steps must be >= 1")
         priority = int(priority)
-        if not 0 <= priority < self.priorities:
+        if batch:
+            # the internal lowest class — index self.priorities, one
+            # past the submittable range, reserved for the batch lane
+            priority = self.priorities
+        elif not 0 <= priority < self.priorities:
             raise ValueError(
                 f"priority must be in [0, {self.priorities}) "
                 f"(serve.priorities classes, 0 = highest), got {priority}")
@@ -1846,6 +1894,24 @@ class DecodeEngine(Logger):
             # the request queued forever with nothing enforcing its
             # deadline — fail the caller loudly instead
             raise EngineStopped("engine is not running (call start())")
+        if batch:
+            # trough-filler admission: batch enters only while
+            # interactive occupancy and SLO burn leave headroom — the
+            # 429 tells the job manager to wait the burst out, not to
+            # compete with it.  (Queued batch that was admitted before
+            # a burst is handled by _admit's gate + preemption.)
+            open_, why = self.trough_open()
+            if not open_:
+                self._count_shed(priority)
+                self._m_requests.labels(outcome="429").inc()
+                # short re-probe hint, NOT _retry_after(): the trough
+                # reopens as soon as a slot frees (milliseconds), so
+                # the congestion-derived >=1s interactive hint would
+                # park the job manager past every trough worth filling
+                raise EngineOverloaded(
+                    f"batch trough closed: {why}",
+                    float(root.common.serve.jobs.get(
+                        "trough_retry_s", 0.05)))
         req = _Request(
             prompt, n_steps, float(temperature),
             None if top_k is None else int(top_k),
@@ -1854,7 +1920,7 @@ class DecodeEngine(Logger):
             np.asarray(jax.random.key_data(key)),
             time.monotonic() + (self.deadline_s if deadline_s is None
                                 else float(deadline_s)),
-            priority=priority)
+            priority=priority, batch=batch)
         if self.paged:
             # pool backpressure: when slots are free but the PAGES are
             # gone (long prompts at low slot occupancy), admission could
@@ -1903,8 +1969,12 @@ class DecodeEngine(Logger):
             # hard queue_depth used to bound alone: under a sustained
             # SLO burn low classes shed first, then everyone.
             qlen = len(self._queue)
-            limit = min(self.queue_depth,
-                        self._admission.allowance(priority))
+            # batch bypasses the AIMD window (the trough gate above is
+            # its admission control) but never the hard queue depth;
+            # it also cannot displace anyone — no class sits below it
+            limit = (self.queue_depth if batch
+                     else min(self.queue_depth,
+                              self._admission.allowance(priority)))
             overloaded = qlen >= limit
             if overloaded:
                 # full — hard depth or a burn-closed admission window —
@@ -1942,7 +2012,8 @@ class DecodeEngine(Logger):
 
     def generate(self, prompt, n_steps: int, *, temperature: float = 0.0,
                  top_k=None, top_p=None, eos_id=None, key=None,
-                 timeout: Optional[float] = None, priority: int = 0):
+                 timeout: Optional[float] = None, priority: int = 0,
+                 batch: bool = False):
         """Blocking batch decode with the ``generate()`` contract:
         (B, P) int32 -> (B, P + n_steps) int32, rows past their eos
         padded with ``eos_id``.  Each row rides its own slot; row ``r``
@@ -1963,7 +2034,7 @@ class DecodeEngine(Logger):
                 reqs.append(self.submit(
                     prompt[r], n_steps, temperature=temperature,
                     top_k=top_k, top_p=top_p, eos_id=eos_id, key=rk,
-                    priority=priority))
+                    priority=priority, batch=batch))
             out = np.full((B, P + n_steps),
                           eos_id if eos_id is not None else 0, np.int32)
             for r, req in enumerate(reqs):
@@ -2059,6 +2130,44 @@ class DecodeEngine(Logger):
         avail = pages["free"] + pages["cached"]
         return max(min(free_slots, avail // max(self.n_ptab, 1)), 0)
 
+    def trough_open(self) -> Tuple[bool, str]:
+        """Batch-lane admission sensor (docs/serving.md "Batch lane"):
+        batch work enters only while BOTH hold — interactive occupancy
+        leaves at least ``serve.jobs.min_headroom_slots`` admissible
+        slots (the vt_memory_headroom_slots signal) and the windowed
+        SLO burn sits at or under ``serve.jobs.burn_ceiling`` (below
+        the interactive controller's own shed threshold, so batch
+        yields BEFORE interactive classes start paying).  Returns
+        ``(open, reason)`` — the reason lands in the 429 body."""
+        jobs_cfg = root.common.serve.jobs
+        min_headroom = int(jobs_cfg.get("min_headroom_slots", 1))
+        burn_ceiling = float(jobs_cfg.get("burn_ceiling", 1.0))
+        headroom = self._headroom_slots(self._pages_summary())
+        return self._trough_open_for(headroom, min_headroom,
+                                     burn_ceiling)
+
+    def _trough_open_for(self, headroom: int,
+                         min_headroom: Optional[int] = None,
+                         burn_ceiling: Optional[float] = None
+                         ) -> Tuple[bool, str]:
+        """The gate itself, on an already-computed headroom sample (the
+        scheduler's _admit re-checks per tick without re-walking the
+        pool)."""
+        jobs_cfg = root.common.serve.jobs
+        if min_headroom is None:
+            min_headroom = int(jobs_cfg.get("min_headroom_slots", 1))
+        if burn_ceiling is None:
+            burn_ceiling = float(jobs_cfg.get("burn_ceiling", 1.0))
+        if headroom < min_headroom:
+            return False, (f"headroom {headroom} slots < "
+                           f"serve.jobs.min_headroom_slots "
+                           f"{min_headroom}")
+        burn = self._admission.last_burn()
+        if burn > burn_ceiling:
+            return False, (f"SLO burn {burn:.2f} > "
+                           f"serve.jobs.burn_ceiling {burn_ceiling}")
+        return True, "ok"
+
     def _publish_gauges(self) -> dict:
         """Sample the point-in-time gauges (occupancy, queue depth,
         throughput, pool, goodput, memory headroom) into the registry
@@ -2074,6 +2183,11 @@ class DecodeEngine(Logger):
             self._tokens_per_sec = ((self._tok_count.n - mark_n)
                                     / max(now - mark_t, 1e-9))
             self._rate_mark = (now, self._tok_count.n)
+        b_t, b_n = self._batch_rate_mark
+        if now - b_t >= 0.5:
+            self._batch_tok_s = ((self._batch_tok_n - b_n)
+                                 / max(now - b_t, 1e-9))
+            self._batch_rate_mark = (now, self._batch_tok_n)
         s_t, s_prop, s_acc = self._spec_rate_mark
         if now - s_t >= 0.5:
             d_prop = self._spec_proposed.n - s_prop
@@ -2090,6 +2204,7 @@ class DecodeEngine(Logger):
         self._g_occupancy.set(occupancy)
         self._g_queue_depth.set(queue_depth)
         self._g_tokens_per_sec.set(self._tokens_per_sec)
+        self._g_batch_tps.set(self._batch_tok_s)
         self._g_headroom.set(headroom)
         self._g_spec_accept_rate.set(self._spec_accept_rate)
         self._g_decode_bw.set(good["decode_bandwidth_bytes_per_sec"])
@@ -2161,6 +2276,16 @@ class DecodeEngine(Logger):
             **({"kv_transfer": kvt}
                if (kvt := self._kv_transfer_summary()) is not None
                else {}),
+            # batch lane (docs/serving.md "Batch lane"): whether the
+            # trough gate would admit right now, and the throughput
+            # the SLO histograms deliberately never see
+            "batch": {
+                "trough_open": self._trough_open_for(
+                    snap["headroom_slots"])[0],
+                "tokens_generated": self._batch_tok_n,
+                "tokens_per_sec": round(self._batch_tok_s, 1),
+                "preemptions": self._batch_preempted.n,
+            },
             "goodput": snap["goodput"],
             "memory": {
                 "headroom_slots": snap["headroom_slots"],
@@ -2401,6 +2526,10 @@ class DecodeEngine(Logger):
         req._eff = None                 # prompt grew by the harvest
         req.preemptions += 1
         self._preempted.inc()
+        if req.batch:
+            # the batch lane yielding to interactive traffic — the
+            # instant-yield half of the trough-filler contract
+            self._batch_preempted.inc()
         with self._qlock:
             self._queue.appendleft(req)
 
@@ -2423,6 +2552,17 @@ class DecodeEngine(Logger):
                     "request deadline expired while queued"))
                 self._observe_finish(req, "504")
                 continue
+            if req.batch:
+                # trough gate, re-checked at admission time: batch that
+                # queued during a lull must keep waiting when a burst
+                # arrived in between.  Batch is the LOWEST class, so
+                # popleft only surfaces it once no interactive request
+                # is queued — requeue-at-front and stop admitting.
+                open_, _why = self.trough_open()
+                if not open_:
+                    with self._qlock:
+                        self._queue.appendleft(req)
+                    return n
             slot = self._free_slot()
             if slot is None:
                 victim = self._pick_victim(req.priority)
@@ -3000,9 +3140,14 @@ class DecodeEngine(Logger):
             # queue wait (its wait was already observed once)
             req.admitted_at = now
             wait = now - req.submitted_at
-            self._m_queue_wait.observe(wait)
-            self._qwait_ewma = wait if self._qwait_ewma <= 0 \
-                else 0.9 * self._qwait_ewma + 0.1 * wait
+            if not req.batch:
+                # batch never lands in the SLO histograms (the tracker
+                # snapshots whole registry histograms, so exclusion
+                # must happen here) nor in the Retry-After EWMA — a
+                # deliberately-parked bulk prompt would poison both
+                self._m_queue_wait.observe(wait)
+                self._qwait_ewma = wait if self._qwait_ewma <= 0 \
+                    else 0.9 * self._qwait_ewma + 0.1 * wait
             self._admitted.inc()
         eff = req.effective_prompt()
         P = int(eff.size)
@@ -3115,10 +3260,12 @@ class DecodeEngine(Logger):
             else 0.8 * self._prefill_tok_s + 0.2 * rate
         if req.first_token_at is None:
             # chunked or not, preempted-before-first-token or not: TTFT
-            # is observed exactly once, at the ACTUAL first token
+            # is observed exactly once, at the ACTUAL first token —
+            # and never for batch (SLO exclusion, see _prefill)
             req.first_token_at = now
-            self._m_ttft.labels(bucket=lab).observe(
-                now - req.submitted_at)
+            if not req.batch:
+                self._m_ttft.labels(bucket=lab).observe(
+                    now - req.submitted_at)
         P = int(eff.size)
         self._pos[slot] = P
         self._temp[slot] = temp
@@ -3137,6 +3284,8 @@ class DecodeEngine(Logger):
             self._hist[slot, P] = first
             self._hist_pos[slot] = P
         self._tok_count.inc()
+        if req.batch:
+            self._batch_tok_n += 1
         done = (P >= req.end_index
                 or (req.eos_id is not None and first == req.eos_id))
         self._active[slot] = not done
@@ -3300,6 +3449,17 @@ class DecodeEngine(Logger):
                     "request deadline expired while decoding"))
                 self._observe_finish(req, "504")
 
+    def _note_batch_tokens(self, per_slot):
+        """Attribute one dispatch's per-slot emitted counts to the
+        batch-lane token total (vt_batch_tokens_per_sec's feed).
+        Scheduler thread, called BEFORE _post_step — ``_slot_req``
+        still maps every slot that just emitted."""
+        for slot, n in enumerate(np.asarray(per_slot)):
+            if n:
+                req = self._slot_req[slot]
+                if req is not None and req.batch:
+                    self._batch_tok_n += int(n)
+
     def _step_once(self):
         from . import faults
         t0 = time.monotonic()
@@ -3323,6 +3483,8 @@ class DecodeEngine(Logger):
         self._dispatches.inc()
         self._occupancy_sum += n_active
         self._tok_count.inc(n_active)
+        # pre-step mask: every then-active slot emitted exactly one
+        self._note_batch_tokens(self._active.astype(np.int64))
         # np.array (copy): asarray would alias the read-only device view
         self._pos = np.array(pos)
         self._active = np.array(active)
@@ -3362,6 +3524,7 @@ class DecodeEngine(Logger):
         self._active = np.array(active)
         emitted = int((self._pos - old_pos).sum())
         self._tok_count.inc(emitted)
+        self._note_batch_tokens(self._pos - old_pos)
         self._verify_steps += 1
         self._dispatches.inc()
         proposed = int((draft >= 0).sum())
@@ -3410,6 +3573,7 @@ class DecodeEngine(Logger):
         self._active = np.array(active)
         n_emitted = int(np.asarray(emitted).sum())
         self._tok_count.inc(n_emitted)
+        self._note_batch_tokens(np.asarray(emitted))
         # per-micro-step accounting so occupancy and per-token latency
         # stay comparable across N: N micro-steps ran, their summed
         # live-slot count IS the emitted total, and the per-token wall

@@ -334,6 +334,12 @@ class FleetRouter(Logger):
         self._last_swap: dict = {"swapped": None}
         self._last_drain: dict = {"completed": None}
         self._drain_thread: Optional[threading.Thread] = None
+        # batch lane (docs/serving.md "Batch lane"): the fleet-level
+        # job manager, attached by FleetServer when serve.jobs.dir (or
+        # its jobs_dir arg) names a store — fleet_doc merges its
+        # summary so /fleet.json shows the bulk backlog next to the
+        # interactive topology
+        self.jobs = None
 
         # the fleet metric family (docs/observability.md table; VM4xx)
         reg = registry()
@@ -956,6 +962,13 @@ class FleetRouter(Logger):
             priority = int(body.get("priority", 0) or 0)
         except (TypeError, ValueError):
             priority = 0
+        if body.get("batch"):
+            # batch lane (docs/serving.md "Batch lane"): route as a
+            # non-zero class so a backed-off replica is SKIPPED, never
+            # ridden through its 429 window the way class 0 rides the
+            # least-burned replica — batch always defers to whatever
+            # interactive pressure caused the backoff
+            priority = max(priority, 1)
         hashes = self._head_hashes(body.get("prompt"))
         if hashes:
             self._m_affinity_requests.inc()
@@ -1008,7 +1021,14 @@ class FleetRouter(Logger):
             finally:
                 self._end_dispatch(rep, seq)
             if status == 429:
-                self._note_backpressure(rep, retry)
+                # a batch-class 429 is "no headroom for BATCH" — the
+                # replica is busy serving interactive, which is the
+                # opposite of shedding.  Honoring it as router-level
+                # backpressure would let the job manager's trough
+                # probes black-hole class-0 traffic (every replica
+                # "shedding" while all of them serve fine).
+                if not body.get("batch"):
+                    self._note_backpressure(rep, retry)
                 retry_hint = retry if retry_hint is None \
                     else min(retry_hint, retry)
                 tried.add(rep.id)
@@ -1493,6 +1513,8 @@ class FleetRouter(Logger):
             },
             "last_swap": self._last_swap,
             "last_rolling_drain": self._last_drain,
+            **({"jobs": self.jobs.summary()}
+               if self.jobs is not None else {}),
         }
 
 
@@ -1506,12 +1528,25 @@ class FleetServer(Logger):
     server shape as :class:`~.restful.RestfulServer`."""
 
     def __init__(self, router: FleetRouter, *, port: int = 0,
-                 host: str = "127.0.0.1"):
+                 host: str = "127.0.0.1", jobs_dir: Optional[str] = None):
         import http.server
 
+        from .jobs import JobManager, handle_jobs_request
         from .restful import (read_json_body, reply_json,
                               reply_metrics_text)
         self.router = router
+        # batch lane (docs/serving.md "Batch lane"): a job store dir —
+        # explicit arg or root.common.serve.jobs.dir — turns on the
+        # fleet-level job API.  Dispatch IS handle_generate: every
+        # sharded prompt rides the same affinity routing, failover and
+        # idempotent resubmission as interactive traffic, just on the
+        # trough class.
+        if jobs_dir is None:
+            jobs_dir = str(root.common.serve.jobs.get("dir", "") or "")
+        self.jobs: Optional[JobManager] = None
+        if jobs_dir:
+            self.jobs = JobManager(jobs_dir, router.handle_generate)
+            router.jobs = self.jobs
         outer = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
@@ -1540,6 +1575,19 @@ class FleetServer(Logger):
                     self._reply(
                         {"ready": ok, "replicas_ready": len(up)},
                         code=200 if ok else 503)
+                    return
+                hit = handle_jobs_request(outer.jobs, "GET",
+                                          self.path, None)
+                if hit is not None:
+                    self._reply(hit[1], code=hit[0])
+                    return
+                self.send_error(404)
+
+            def do_DELETE(self):
+                hit = handle_jobs_request(outer.jobs, "DELETE",
+                                          self.path, None)
+                if hit is not None:
+                    self._reply(hit[1], code=hit[0])
                     return
                 self.send_error(404)
 
@@ -1587,6 +1635,11 @@ class FleetServer(Logger):
                         self._reply(outer.router.begin_drain(),
                                     code=202)
                         return
+                    hit = handle_jobs_request(outer.jobs, "POST",
+                                              self.path, req)
+                    if hit is not None:
+                        self._reply(hit[1], code=hit[0])
+                        return
                     self.send_error(404)
                 except (KeyError, TypeError, ValueError,
                         json.JSONDecodeError) as e:
@@ -1606,6 +1659,8 @@ class FleetServer(Logger):
 
     def start(self) -> "FleetServer":
         self.router.start()
+        if self.jobs is not None:
+            self.jobs.start()
         self._thread = threading.Thread(target=self.httpd.serve_forever,
                                         daemon=True)
         self._thread.start()
@@ -1614,6 +1669,10 @@ class FleetServer(Logger):
         return self
 
     def stop(self):
+        if self.jobs is not None:
+            # stop scheduling batch dispatches before the router's
+            # replicas go away; committed results resume elsewhere
+            self.jobs.stop()
         self.httpd.shutdown()
         self.httpd.server_close()
         self.router.stop()

@@ -69,6 +69,7 @@ from ..config import root
 from ..logger import Logger
 from .artifact import ArtifactError
 from .engine import EngineOverloaded, EngineStopped, SchedulerCrashed
+from .jobs import JobManager, handle_jobs_request
 from .memory import memory_monitor
 from .metrics import registry, span_ring
 from .profiler import serve_profile_post
@@ -128,7 +129,7 @@ class RestfulServer(Logger):
                  input_shape, *, port: int = 0, host: str = "127.0.0.1",
                  normalizer=None, denormalizer=None, workflow=None,
                  engine=None, input_dtype=np.float32,
-                 default_eos_id=None, vocab_size=None):
+                 default_eos_id=None, vocab_size=None, jobs_dir=None):
         self.predict_fn = predict_fn
         self.wstate = wstate
         self.batch_size = int(batch_size)
@@ -148,6 +149,14 @@ class RestfulServer(Logger):
         self.vocab_size = (None if vocab_size is None
                            else int(vocab_size))
         self.deploy = None        # set by DeployController (lifecycle ops)
+        # batch lane (docs/serving.md "Batch lane"): a jobs_dir turns
+        # on the durable job API (/jobs*) against THIS replica's
+        # engine — dispatch stays in-process through decode(), so the
+        # 429/400/5xx mapping is byte-identical to the HTTP path the
+        # fleet-level manager rides
+        self.jobs: Optional[JobManager] = None
+        if jobs_dir and engine is not None:
+            self.jobs = JobManager(jobs_dir, self._local_dispatch)
         outer = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
@@ -230,6 +239,22 @@ class RestfulServer(Logger):
                     self.end_headers()
                     self.wfile.write(blob)
                     return
+                hit = handle_jobs_request(outer.jobs, "GET",
+                                          self.path, None)
+                if hit is not None:
+                    self._reply(hit[1], code=hit[0])
+                    return
+                self.send_error(404)
+
+            def do_DELETE(self):
+                # DELETE /jobs/<id>: cancel a batch job — queued work
+                # drops immediately; its trough-class slots are
+                # interactive traffic's to reclaim anyway
+                hit = handle_jobs_request(outer.jobs, "DELETE",
+                                          self.path, None)
+                if hit is not None:
+                    self._reply(hit[1], code=hit[0])
+                    return
                 self.send_error(404)
 
             def do_PUT(self):
@@ -287,6 +312,19 @@ class RestfulServer(Logger):
                                                    self.rfile)
                     self._reply(obj, code=code)
                     return
+                if path == "/jobs" or path.startswith("/jobs/"):
+                    try:
+                        body = read_json_body(self)  # cap -> 413 inside
+                    except json.JSONDecodeError as e:
+                        self._reply({"error": str(e)}, code=400)
+                        return
+                    if body is None:
+                        return
+                    hit = handle_jobs_request(outer.jobs, "POST",
+                                              self.path, body)
+                    if hit is not None:
+                        self._reply(hit[1], code=hit[0])
+                        return
                 if path not in ("/predict", "/generate") and not admin:
                     self.send_error(404)
                     return
@@ -614,6 +652,19 @@ class RestfulServer(Logger):
             raise ValueError(
                 "priority classes need engine= serving (per-request "
                 "generate() has no queue to prioritize)")
+        # batch lane (docs/serving.md "Batch lane"): the trough-filler
+        # class below every interactive priority — engine-only (the
+        # per-request path has no trough to fill), and exclusive with
+        # an explicit priority (batch IS the class)
+        batch = bool(req.get("batch", False))
+        if batch and self.engine is None:
+            raise ValueError(
+                "batch-class requests need engine= serving "
+                '(docs/serving.md "Batch lane")')
+        if batch and priority:
+            raise ValueError(
+                "batch rides the trough class below every priority; "
+                "drop the priority key (or drop batch)")
         eos_id = req.get("eos_id")
         if eos_id is None:
             eos_id = self.default_eos_id  # e.g. the artifact's sealed
@@ -639,10 +690,11 @@ class RestfulServer(Logger):
                 raise ValueError(
                     "beams is deterministic search; drop temperature/"
                     "top_k/top_p/seed or use beams=1")
-            if priority:
+            if priority or batch:
                 raise ValueError(
                     "beam search runs outside the engine queue; "
-                    "priority classes apply to beams=1 requests")
+                    "priority classes and the batch lane apply to "
+                    "beams=1 requests")
             length_penalty = float(req.get("length_penalty", 0.0))
             if length_penalty < 0:
                 raise ValueError(
@@ -667,7 +719,7 @@ class RestfulServer(Logger):
             toks = self.engine.generate(
                 prompt.astype(np.int32), steps, temperature=temperature,
                 top_k=top_k, top_p=top_p, eos_id=eos_id, key=key,
-                priority=priority)
+                priority=priority, batch=batch)
             return {"tokens": np.asarray(toks).tolist()}
         toks = generate(
             self.workflow, self.wstate, prompt.astype(np.int32), steps,
@@ -675,9 +727,33 @@ class RestfulServer(Logger):
             eos_id=eos_id, key=key)
         return {"tokens": np.asarray(toks).tolist()}
 
+    def _local_dispatch(self, body: dict):
+        """The job manager's in-process dispatch against THIS replica:
+        decode() with the handler's exact exception->status mapping, as
+        a ``(status, doc, headers)`` triple — the same shape the fleet
+        router's ``handle_generate`` returns, so :class:`JobManager`
+        cannot tell a single replica from a fleet."""
+        try:
+            return 200, self.decode(body), ()
+        except EngineOverloaded as e:
+            return 429, {"error": str(e),
+                         "retry_after_s": round(e.retry_after_s, 3)}, ()
+        except SchedulerCrashed as e:
+            return 500, {"error": str(e),
+                         "kind": "scheduler_crash"}, ()
+        except EngineStopped as e:
+            return 503, {"error": str(e)}, ()
+        except TimeoutError as e:
+            return 504, {"error": str(e)}, ()
+        except (KeyError, TypeError, ValueError,
+                json.JSONDecodeError) as e:
+            return 400, {"error": str(e)}, ()
+
     def start(self):
         if self.engine is not None and not self.engine.started:
             self.engine.start()
+        if self.jobs is not None:
+            self.jobs.start()
         self._thread = threading.Thread(target=self.httpd.serve_forever,
                                         daemon=True)
         self._thread.start()
@@ -686,6 +762,10 @@ class RestfulServer(Logger):
         return self
 
     def stop(self):
+        if self.jobs is not None:
+            # stop scheduling batch dispatches BEFORE the engine goes
+            # away; committed results survive for the next manager
+            self.jobs.stop()
         self.httpd.shutdown()
         self.httpd.server_close()
         if self.engine is not None:
