@@ -106,6 +106,17 @@ first-preempted) while fleet tokens/s rises by the tokens the job
 harvested from the standing trough; the job's completion wall and
 preemption counts ride along, at zero recompiles.
 
+A twelfth scenario ("streaming") measures streaming serving with
+crash-safe resume (docs/serving.md "Streaming and mid-stream
+failover"): a burst of token streams through a 3-replica fleet,
+first undisturbed and then with one replica KILLED mid-burst —
+client-observed TTFT and inter-token gap p50/p99 on both sides, and
+on the kill side every stream must still complete gapless and
+duplicate-free (the router resumes the suffix on a survivor from the
+last relayed token), with the resume/resubmission counter deltas and
+the failover's cost reported honestly as TTFT and inter-token p99
+deltas — a pause in the affected tails, never a lost token.
+
 Prints ONE JSON line in the bench.py contract:
   {"metric": "serving_decode_tokens_per_sec", "value": N,
    "unit": "tokens/s", "vs_baseline": N, ...}
@@ -1421,6 +1432,206 @@ def main(argv=None):
             _root.common.serve.fleet.scrape_interval_s = prev_scrape
             shutil.rmtree(jobs_dir, ignore_errors=True)
 
+    def run_streaming():
+        """Streaming + mid-stream failover (docs/serving.md "Streaming
+        and mid-stream failover"): the same burst of token streams
+        through a 3-replica fleet, first undisturbed, then with one
+        replica killed mid-burst plus one relay leg deterministically
+        severed mid-stream (faults.stream_cut_at_token, fire-once).
+        The crash-safe-resume contract is
+        the payoff being measured: every stream on the kill side must
+        still complete gapless and duplicate-free (the router resumes
+        the suffix on a survivor from the last relayed token via the
+        emitted_prefix form), and the failover's cost shows up ONLY in
+        the latency tails — as a TTFT spike for streams cut before
+        their first frame relayed, as an inter-token stall for streams
+        cut mid-decode — which is what an SLO for streamed UX actually
+        budgets: a pause, never a lost or duplicated token."""
+        import jax
+        from veles_tpu.config import root as _root
+        from veles_tpu.models.standard import build_workflow
+        from veles_tpu.ops import optimizers as opt
+        from veles_tpu.runtime.deploy import DeployController
+        from veles_tpu.runtime.fleet import FleetRouter, InProcessReplica
+        from veles_tpu.runtime.restful import RestfulServer
+        srng = np.random.default_rng(47)
+        sv, sslots = 64, 3
+        swf = build_workflow("bench_stream_lm", [
+            {"type": "embedding", "vocab": sv, "dim": 32, "name": "emb"},
+            {"type": "attention", "n_heads": 2, "rope": True,
+             "residual": True, "name": "a1"},
+            {"type": "seq_last", "name": "last"},
+            {"type": "softmax", "output_size": sv, "name": "out"},
+        ])
+        swf.build({"@input": vt.Spec((1, 8), jnp.int32),
+                   "@labels": vt.Spec((1,), jnp.int32),
+                   "@mask": vt.Spec((1,), jnp.float32)})
+        sws = swf.init_state(jax.random.key(12), opt.SGD(0.01))
+        SP, SN = 16, 24            # stream shape: prompt tokens, steps
+        # 6 concurrent consumers over 3x3 slots: every replica holds
+        # in-flight streams throughout the burst, so the mid-burst
+        # kill reliably severs ACTIVE relays (the resume path), not
+        # just queued dispatches
+        n_streams, n_threads = 24, 6
+        # pre-generated so worker threads never share the Generator,
+        # and both phases replay the IDENTICAL prompt set
+        prompts = [srng.integers(0, sv, SP).tolist()
+                   for _ in range(n_streams)]
+
+        def factory():
+            seng = DecodeEngine(swf, dict(sws), slots=sslots, l_max=64,
+                                window_ms=0.0, preempt=True)
+            srv = RestfulServer(swf.make_predict_step("out"),
+                                dict(sws), 2, (8,), port=0,
+                                workflow=swf, engine=seng,
+                                input_dtype=np.int32)
+            DeployController(server=srv)
+            return srv.start()
+
+        prev_scrape = _root.common.serve.fleet.get(
+            "scrape_interval_s", 0.5)
+        _root.common.serve.fleet.scrape_interval_s = 0.05
+        replicas = [InProcessReplica(factory) for _ in range(3)]
+        router = FleetRouter()
+        for rep in replicas:
+            router.add_replica(url=rep.url, registry_key="in-process",
+                               restart=rep.restart, kill=rep.kill)
+        engines = [rep.srv.engine for rep in replicas]
+
+        def burst():
+            """All n_streams streams over n_threads concurrent
+            consumers; returns (wall_s, ttfts, gaps, bad) where ttfts
+            and gaps are client-observed seconds and bad lists any
+            stream that was not a gapless length-SN completion."""
+            ttfts, gaps, bad = [], [], []
+            lock = threading.Lock()
+            per = n_streams // n_threads
+
+            def worker(wid):
+                for i in range(per):
+                    prompt = prompts[wid * per + i]
+                    t_req = time.perf_counter()
+                    status, frames, _h = router.handle_generate_stream(
+                        {"prompt": prompt, "steps": SN, "stream": True})
+                    if status != 200:
+                        with lock:
+                            bad.append((wid, i, "status", status))
+                        continue
+                    idx, my_gaps, ttft, fin = [], [], None, None
+                    t_prev = t_req
+                    for f in frames:
+                        now = time.perf_counter()
+                        if f.get("done"):
+                            fin = f.get("finish_reason")
+                            break
+                        if ttft is None:
+                            ttft = now - t_req
+                        else:
+                            my_gaps.append(now - t_prev)
+                        t_prev = now
+                        idx.append(f["i"])
+                    ok = (idx == list(range(SN)) and fin == "length")
+                    with lock:
+                        if ok:
+                            ttfts.append(ttft)
+                            gaps.extend(my_gaps)
+                        else:
+                            bad.append((wid, i, fin, idx[-3:]))
+
+            t0 = time.perf_counter()
+            threads = [threading.Thread(target=worker, args=(w,))
+                       for w in range(n_threads)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            return time.perf_counter() - t0, ttfts, gaps, bad
+
+        def pct(xs):
+            if not xs:
+                return {"p50_ms": None, "p99_ms": None}
+            return {"p50_ms": round(1e3 * float(np.percentile(xs, 50)), 2),
+                    "p99_ms": round(1e3 * float(np.percentile(xs, 99)), 2)}
+
+        try:
+            # warm every bucket either side can reach on all three
+            # replicas — SP hits bucket 16; a resume's re-prefill is
+            # prompt + emitted prefix (17..SP+SN-1 tokens), buckets 32
+            # and 64 — then freeze the compile counters: streaming AND
+            # mid-stream failover must ride the existing programs
+            for e in engines:
+                for warm_p in (SP, SP + 1, 33):
+                    e.generate(srng.integers(0, sv, (1, warm_p)), 2,
+                               timeout=600)
+            frozen = [e.stats()["compile"]["compiles"]
+                      for e in engines]
+
+            # phase A: the burst with the fleet healthy
+            wall_a, ttft_a, gaps_a, bad_a = burst()
+
+            # phase B: same burst under two fault shapes at once — a
+            # timer scaled off phase A kills a replica mid-flight
+            # (whichever of its streams are pre-first-frame fail over
+            # on the pre-stream path; mid-relay ones resume), and
+            # faults.stream_cut_at_token severs exactly ONE relay leg
+            # mid-stream (fire-once), so every bench record carries at
+            # least one true suffix-resume splice regardless of where
+            # the racy kill lands
+            from veles_tpu.runtime import faults
+            resumes0 = router._m_stream_resumes.value
+            resubs0 = router._m_resubmissions.value
+            faults.configure(stream_cut_at_token=6)
+            killer = threading.Timer(0.4 * wall_a, replicas[0].kill)
+            killer.start()
+            try:
+                wall_b, ttft_b, gaps_b, bad_b = burst()
+            finally:
+                killer.join()
+                faults.reset()
+            resumes = int(router._m_stream_resumes.value - resumes0)
+            resubs = int(router._m_resubmissions.value - resubs0)
+            new_compiles = sum(
+                e.stats()["compile"]["compiles"]
+                for e in engines[1:]) - sum(frozen[1:])
+            return {
+                "replicas": 3, "slots_per_replica": sslots,
+                "model": {"vocab": sv, "dim": 32, "layers": 1},
+                "streams": n_streams, "concurrency": n_threads,
+                "prompt_tokens": SP, "steps": SN,
+                "clean": {
+                    "wall_s": round(wall_a, 3),
+                    "ttft": pct(ttft_a),
+                    "inter_token": pct(gaps_a),
+                    "incomplete_streams": len(bad_a),
+                },
+                "replica_killed_mid_burst": {
+                    "wall_s": round(wall_b, 3),
+                    "ttft": pct(ttft_b),
+                    "inter_token": pct(gaps_b),
+                    # THE acceptance number: every stream still a
+                    # gapless duplicate-free length-SN completion
+                    "incomplete_streams": len(bad_b),
+                    "stream_resumes": resumes,
+                    "resubmissions": resubs,
+                },
+                # failover cost surfaces as latency tails, not loss:
+                # TTFT for streams cut pre-first-frame, inter-token
+                # stalls for streams cut mid-decode
+                "ttft_p99_delta_ms": (
+                    None if not (ttft_a and ttft_b) else round(
+                        pct(ttft_b)["p99_ms"] - pct(ttft_a)["p99_ms"],
+                        2)),
+                "inter_token_p99_delta_ms": (
+                    None if not (gaps_a and gaps_b) else round(
+                        pct(gaps_b)["p99_ms"] - pct(gaps_a)["p99_ms"],
+                        2)),
+                "new_compiles_on_survivors": new_compiles,
+            }
+        finally:
+            for rep in replicas:
+                rep.stop()
+            _root.common.serve.fleet.scrape_interval_s = prev_scrape
+
     try:
         m0 = scrape()
         finish_goodput = start_goodput_poller([eng])
@@ -1448,6 +1659,7 @@ def main(argv=None):
         disagg_transfer = run_disagg_transfer()
         megastep_sweep = run_megastep_sweep()
         batch_lane = run_batch_lane()
+        streaming = run_streaming()
         final = eng.stats()
     finally:
         eng.stop()
@@ -1506,6 +1718,7 @@ def main(argv=None):
         "disagg_transfer": disagg_transfer,
         "megastep_sweep": megastep_sweep,
         "batch_lane": batch_lane,
+        "streaming": streaming,
         "paged": final.get("pages"),
         "decode_recompiles": final["compile"]["recompiles"],
         "compiled_programs": final["compile"]["programs"],

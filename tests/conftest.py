@@ -72,6 +72,69 @@ def pytest_configure(config):
         "markers", "jobs: batch job manager / trough-filler lane tests "
         "— durable store, REST job API, batch-class preemption "
         "(tier-1; select alone with -m jobs)")
+    config.addinivalue_line(
+        "markers", "streaming: streaming serving / crash-safe resume "
+        "tests — per-token frames, stop sequences, mid-stream "
+        "failover (tier-1; select alone with -m streaming)")
+
+
+# -- tier-1 wall budget -------------------------------------------------------
+# The tier-1 suite (-m 'not slow') is the per-PR gate; every PR adds
+# tests, and a gate that quietly drifts past the CI timeout fails in
+# the worst possible way (killed mid-run, no culprit named).  Budget
+# the wall here instead: when a tier-1 run exceeds the budget, fail
+# the SESSION loudly with the slowest offenders listed, so the PR that
+# broke the budget is the PR that pays for it.  The default is
+# calibrated to the measured full-suite wall on the dev box (~910s at
+# 663 tests) plus ~20% headroom for machine noise — re-measure and
+# re-calibrate (or slow-mark offenders, the PR-14 fire drill) when a
+# trip names this budget rather than a runaway test.
+
+_TIER1_WALL_BUDGET_S = float(os.environ.get(
+    "VT_TIER1_WALL_BUDGET_S", "1100"))
+_tier1_state = {"t0": None, "durations": []}
+
+
+def _is_tier1_run(config) -> bool:
+    return "not slow" in (config.getoption("-m", default="") or "")
+
+
+def pytest_sessionstart(session):
+    if _is_tier1_run(session.config):
+        import time as _time
+        _tier1_state["t0"] = _time.monotonic()
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_protocol(item, nextitem):
+    if _tier1_state["t0"] is None:
+        yield
+        return
+    import time as _time
+    t0 = _time.monotonic()
+    yield
+    _tier1_state["durations"].append(
+        (_time.monotonic() - t0, item.nodeid))
+
+
+def pytest_sessionfinish(session, exitstatus):
+    if _tier1_state["t0"] is None:
+        return
+    import time as _time
+    wall = _time.monotonic() - _tier1_state["t0"]
+    if wall <= _TIER1_WALL_BUDGET_S:
+        return
+    slowest = sorted(_tier1_state["durations"], reverse=True)[:10]
+    lines = [f"  {d:8.1f}s  {nodeid}" for d, nodeid in slowest]
+    tr = session.config.pluginmanager.get_plugin("terminalreporter")
+    msg = (f"tier-1 wall budget exceeded: {wall:.0f}s > "
+           f"{_TIER1_WALL_BUDGET_S:.0f}s "
+           "(VT_TIER1_WALL_BUDGET_S); slowest tests:\n"
+           + "\n".join(lines))
+    if tr is not None:
+        tr.write_sep("=", "tier-1 wall budget", red=True)
+        tr.write_line(msg)
+    session.exitstatus = 1
 
 
 @pytest.fixture(autouse=True)

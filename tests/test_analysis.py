@@ -2250,6 +2250,8 @@ def test_resource_pairs_registry_honest():
         # same delegate shape as fleet-dispatch: the public release
         # takes the lock and calls the locked mutator
         "job-slots": ("_inflight", "_release_job_slot_locked"),
+        # the engine's open streaming-handle set (streaming serving)
+        "stream-handles": ("_streams",),
     }
     assert set(RESOURCE_PAIRS) == set(backing_fields), \
         "new resource? declare its backing fields here too"

@@ -190,10 +190,11 @@ def test_fleet_survives_replica_kill_mid_burst():
     failure semantics): three replicas under a concurrent mixed-class
     burst through the router's HTTP front; the
     ``replica_crash_at_request`` fault kills one replica mid-burst.
-    The router must eject it, resubmit the interrupted (queued, never
-    mid-stream — requests are unary) work to the survivors, and every
-    class-0 request must complete with ZERO failures; the slow-replica
-    knob is armed too, so the kill lands under skewed load."""
+    The router must eject it, resubmit the interrupted work whole to
+    the survivors (these requests are unary — the streaming rehearsal
+    below resumes mid-stream instead), and every class-0 request must
+    complete with ZERO failures; the slow-replica knob is armed too,
+    so the kill lands under skewed load."""
     import json as _json
     import threading
     import urllib.request
@@ -294,6 +295,157 @@ def test_fleet_survives_replica_kill_mid_burst():
         # survivors absorbed the whole burst (the interrupted request
         # was resubmitted, so total dispatches exceed the 48 submits)
         assert sum(rep["dispatched"] for rep in fd["replicas"]) >= 49
+    finally:
+        faults.reset()
+        root.common.serve.fleet.scrape_interval_s = prev_scrape
+        fsrv.stop()
+        for rep in replicas:
+            rep.stop()
+
+
+@pytest.mark.fleet
+@pytest.mark.faults
+@pytest.mark.streaming
+def test_streams_survive_replica_kill_mid_burst():
+    """The streaming chaos rehearsal (docs/serving.md "Streaming and
+    mid-stream failover"): three replicas under a concurrent class-0
+    streaming burst through the router's HTTP front, with BOTH stream
+    faults armed — ``replica_crash_at_request`` kills a replica
+    mid-burst (cutting every stream in flight on it) and
+    ``stream_cut_at_token`` severs one healthy relay leg.  Every
+    stream must complete gapless and duplicate-free with the BITWISE
+    token sequence of an undisturbed run — greedy and sampled — and
+    the resume path must show up in vt_fleet_resubmissions_total /
+    vt_stream_resumes_total."""
+    import json as _json
+    import threading
+    import urllib.request
+
+    import jax
+    import jax.numpy as jnp
+
+    import veles_tpu as vt
+    from veles_tpu.config import root
+    from veles_tpu.models.standard import build_workflow
+    from veles_tpu.ops import optimizers as opt
+    from veles_tpu.runtime import faults
+    from veles_tpu.runtime.deploy import DeployController
+    from veles_tpu.runtime.engine import DecodeEngine
+    from veles_tpu.runtime.fleet import (EJECTED, FleetRouter,
+                                         FleetServer, InProcessReplica)
+    from veles_tpu.runtime.generate import generate
+    from veles_tpu.runtime.restful import RestfulServer
+
+    V = 12
+    wf = build_workflow("chaos_stream_lm", [
+        {"type": "embedding", "vocab": V, "dim": 16, "name": "emb"},
+        {"type": "attention", "n_heads": 2, "rope": True,
+         "residual": True, "name": "a1"},
+        {"type": "seq_last", "name": "last"},
+        {"type": "softmax", "output_size": V, "name": "out"}])
+    wf.build({"@input": vt.Spec((2, 6), jnp.int32),
+              "@labels": vt.Spec((2,), jnp.int32),
+              "@mask": vt.Spec((2,), jnp.float32)})
+    ws = wf.init_state(jax.random.key(3), opt.SGD(0.1))
+
+    def factory():
+        eng = DecodeEngine(wf, dict(ws), slots=2, l_max=64,
+                           window_ms=0.0)
+        srv = RestfulServer(wf.make_predict_step("out"), dict(ws), 2,
+                            (6,), port=0, workflow=wf, engine=eng,
+                            input_dtype=np.int32)
+        DeployController(server=srv)
+        return srv.start()
+
+    prompt = (np.arange(8) % V).astype(np.int32)
+    N = 8
+    greedy_ref = [int(t) for t in
+                  np.asarray(generate(wf, ws, prompt[None], N))[0][8:]]
+    sampled_ref = [int(t) for t in
+                   np.asarray(generate(
+                       wf, ws, prompt[None], N, temperature=1.3,
+                       top_k=5, key=jax.random.key(11)))[0][8:]]
+
+    prev_scrape = root.common.serve.fleet.get("scrape_interval_s", 0.5)
+    root.common.serve.fleet.scrape_interval_s = 0.05
+    replicas = [InProcessReplica(factory) for _ in range(3)]
+    router = FleetRouter()
+    for rep in replicas:
+        router.add_replica(url=rep.url, registry_key="in-process",
+                           restart=rep.restart, kill=rep.kill)
+    fsrv = FleetServer(router, port=0).start()
+    base = f"http://127.0.0.1:{fsrv.port}"
+
+    def consume_stream(sampled):
+        body = {"prompt": prompt.tolist(), "steps": N, "stream": True}
+        if sampled:
+            body.update(temperature=1.3, top_k=5, seed=11)
+        rq = urllib.request.Request(
+            base + "/generate", data=_json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(rq, timeout=120) as r:
+                if r.status != 200:
+                    return f"HTTP {r.status}"
+                frames = [_json.loads(l) for l in r if l.strip()]
+        except Exception as e:  # noqa: BLE001 — a dropped stream is
+            return repr(e)      # the failure the assertion must name
+        idx = [f["i"] for f in frames if not f.get("done")]
+        toks = [f["token"] for f in frames if not f.get("done")]
+        if idx != list(range(N)):
+            return f"gap/duplicate frames: {idx}"
+        ref = sampled_ref if sampled else greedy_ref
+        if toks != ref:
+            return f"token divergence: {toks} != {ref}"
+        term = frames[-1]
+        if not (term.get("done")
+                and term.get("finish_reason") == "length"):
+            return f"bad terminal: {term}"
+        return "ok"
+
+    results = []
+    res_lock = threading.Lock()
+
+    def worker(sampled):
+        for _ in range(3):
+            out = consume_stream(sampled)
+            with res_lock:
+                results.append(out)
+
+    try:
+        resubs0 = router._m_resubmissions.value
+        resumes0 = router._m_stream_resumes.value
+        # the 8th routed dispatch kills its chosen replica (cutting
+        # every stream in flight there); one healthy leg is severed
+        # after its 3rd relayed frame
+        faults.configure(replica_crash_at_request=8,
+                         stream_cut_at_token=3)
+        threads = [threading.Thread(target=worker, args=(s,))
+                   for s in (False, False, False, True, True, True)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        assert not any(t.is_alive() for t in threads)
+        # THE acceptance: every class-0 stream completed bitwise,
+        # gapless and duplicate-free, across the kill and the cut
+        assert results == ["ok"] * 18, results
+        # the failover really ran: the injected cut resumed at least
+        # once, counted inside the router's resubmission ledger
+        assert router._m_stream_resumes.value >= resumes0 + 1
+        assert router._m_resubmissions.value >= resubs0 + 1
+        # the kill really happened and the router ejected the victim
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            with urllib.request.urlopen(base + "/fleet.json",
+                                        timeout=30) as r:
+                fd = _json.loads(r.read())
+            if [rep["state"] for rep in
+                    fd["replicas"]].count(EJECTED) == 1:
+                break
+            time.sleep(0.1)
+        else:
+            raise AssertionError(f"victim never ejected: {fd}")
     finally:
         faults.reset()
         root.common.serve.fleet.scrape_interval_s = prev_scrape

@@ -706,3 +706,145 @@ def test_fleet_server_endpoints(lm, fast_scrape, rng):
     finally:
         _teardown(router, replicas, fsrv)
         joined.stop()
+
+
+# -- streaming relay + mid-stream failover (docs/serving.md "Streaming
+# and mid-stream failover") --------------------------------------------------
+
+def _stream_ref(wf, ws, prompt, n, **kw):
+    from veles_tpu.runtime.generate import generate
+    return [int(t) for t in
+            np.asarray(generate(wf, ws, prompt[None], n, **kw))[0]
+            [prompt.size:]]
+
+
+@pytest.mark.streaming
+def test_stream_relay_clean_and_cut_resume(lm, fast_scrape):
+    """The router relays a replica's NDJSON stream frame-for-frame; a
+    severed leg (stream_cut_at_token) resumes the SUFFIX on a survivor
+    via the emitted_prefix form, and the spliced stream is gapless,
+    duplicate-free and bitwise the uninterrupted sampled sequence.
+    vt_stream_resumes_total counts the failover inside
+    vt_fleet_resubmissions_total."""
+    from veles_tpu.runtime import faults
+
+    wf, ws, _ = lm
+    router, replicas = _fleet(wf, ws, n=3)
+    prompt = (np.arange(8) % V).astype(np.int32)
+    N = 12
+    try:
+        gref = _stream_ref(wf, ws, prompt, N, temperature=1.3,
+                           top_k=5, key=jax.random.key(11))
+        body = {"prompt": prompt.tolist(), "steps": N, "stream": True,
+                "temperature": 1.3, "top_k": 5, "seed": 11}
+        code, frames, _h = router.handle_generate_stream(dict(body))
+        assert code == 200
+        out = list(frames)
+        assert [f["i"] for f in out if not f.get("done")] == \
+            list(range(N))
+        assert [f["token"] for f in out if not f.get("done")] == gref
+        assert out[-1]["finish_reason"] == "length", out[-1]
+
+        resubs0 = router._m_resubmissions.value
+        resumes0 = router._m_stream_resumes.value
+        faults.configure(stream_cut_at_token=4)
+        code, frames, _h = router.handle_generate_stream(dict(body))
+        assert code == 200
+        out = list(frames)
+        assert [f["i"] for f in out if not f.get("done")] == \
+            list(range(N))                      # gapless, no duplicates
+        assert [f["token"] for f in out if not f.get("done")] == gref
+        assert out[-1]["finish_reason"] == "length", out[-1]
+        assert router._m_stream_resumes.value == resumes0 + 1
+        assert router._m_resubmissions.value >= resubs0 + 1
+    finally:
+        faults.reset()
+        _teardown(router, replicas)
+
+
+@pytest.mark.streaming
+@pytest.mark.faults
+def test_stream_retry_budget_bounds_total_outage(lm, fast_scrape):
+    """Every replica dies mid-stream: the resume retry budget
+    (serve.stream.retry_budget) bounds the failover storm and the
+    consumer receives ONE terminal error frame well inside the request
+    deadline — never a hang, counted in
+    vt_stream_retry_exhausted_total."""
+    from veles_tpu.runtime import faults
+
+    wf, ws, _ = lm
+    stream_cfg = root.common.serve.stream
+    prev = {k: stream_cfg.get(k) for k in
+            ("retry_budget", "backoff_s", "backoff_max_s")}
+    stream_cfg.retry_budget = 2
+    stream_cfg.backoff_s = 0.01
+    stream_cfg.backoff_max_s = 0.05
+    try:
+        router, replicas = _fleet(wf, ws, n=2)
+        prompt = (np.arange(8) % V).astype(np.int32)
+        body = {"prompt": prompt.tolist(), "steps": 12, "stream": True,
+                "deadline_s": 60.0}
+        try:
+            faults.configure(stream_cut_at_token=2)
+            code, frames, _h = router.handle_generate_stream(body)
+            assert code == 200
+            exhausted0 = router._m_stream_retry_exhausted.value
+            got = [next(frames), next(frames)]   # two live frames
+            assert [f["i"] for f in got] == [0, 1]
+            for rep in replicas:                 # total fleet outage
+                rep.stop()
+            t0 = time.monotonic()
+            rest = list(frames)
+            elapsed = time.monotonic() - t0
+            assert elapsed < 30.0, elapsed       # bounded by budget,
+            #                                      far inside deadline
+            assert len(rest) == 1 and rest[0].get("done"), rest
+            assert rest[0]["finish_reason"] == "error", rest
+            assert "retry budget" in rest[0]["error"], rest
+            assert router._m_stream_retry_exhausted.value == \
+                exhausted0 + 1
+        finally:
+            faults.reset()
+            router.stop()
+            for rep in replicas:
+                rep.stop()
+    finally:
+        for k, v in prev.items():
+            if v is None:
+                if k in stream_cfg:
+                    delattr(stream_cfg, k)
+            else:
+                setattr(stream_cfg, k, v)
+
+
+@pytest.mark.streaming
+@pytest.mark.faults
+def test_stream_deadline_propagates_through_router(lm, fast_scrape):
+    """deadline_s rides engine → REST → router: a decode stall expires
+    the request mid-stream on the replica, the engine emits a terminal
+    "deadline" frame, and the router relays it as-is (an expired
+    deadline is the request's ANSWER, not a resumable leg failure)."""
+    from veles_tpu.runtime import faults
+
+    wf, ws, _ = lm
+    router, replicas = _fleet(wf, ws, n=2)
+    prompt = (np.arange(8) % V).astype(np.int32)
+    try:
+        # warm the replica programs so the injected stall dominates
+        code, frames, _h = router.handle_generate_stream(
+            {"prompt": prompt.tolist(), "steps": 2, "stream": True})
+        assert code == 200 and list(frames)[-1]["done"]
+        faults.configure(decode_stall_ms=400.0)
+        t0 = time.monotonic()
+        code, frames, _h = router.handle_generate_stream(
+            {"prompt": prompt.tolist(), "steps": 30, "stream": True,
+             "deadline_s": 0.2})
+        assert code == 200
+        out = list(frames)
+        assert time.monotonic() - t0 < 30.0
+        term = out[-1]
+        assert term.get("done") and \
+            term["finish_reason"] == "deadline", out
+    finally:
+        faults.reset()
+        _teardown(router, replicas)

@@ -745,3 +745,76 @@ def test_compiles_frozen_across_chunk_preempt_shed(rng):
         assert st["compile"]["recompiles"] == 0, st
     finally:
         eng.stop()
+
+
+# -- streaming deadlines (docs/serving.md "Streaming and mid-stream
+# failover"): an expired deadline yields a terminal frame, never a hang ------
+
+@pytest.mark.streaming
+@pytest.mark.faults
+def test_stream_deadline_mid_decode_yields_terminal_frame():
+    """Engine-direct: a decode stall (decode_stall_ms) pushes a
+    streaming request past its deadline_s mid-generation.  The consumer
+    must receive a terminal ("done", "deadline", ...) event — within
+    the event-wait timeout, never a hang — and the request errors with
+    the same TimeoutError the unary path raises."""
+    from veles_tpu.runtime import faults
+
+    wf, ws = _build_lm(TRANSFORMER)
+    prompt = (np.arange(8) % V).astype(np.int32)
+    eng = DecodeEngine(wf, ws, slots=2, l_max=64, window_ms=0.0).start()
+    try:
+        # warm the programs so the stall is the ONLY slow step
+        eng.generate(prompt[None], 2, timeout=180)
+        faults.configure(decode_stall_ms=400.0)
+        req = eng.submit(prompt, 30, stream=True, deadline_s=0.2)
+        events = list(req.stream.events(timeout_s=60))
+        term = events[-1]
+        assert term[0] == "done" and term[1] == "deadline", events
+        assert "deadline" in term[2]
+        assert req.done.wait(60)
+        assert isinstance(req.error, TimeoutError)
+    finally:
+        faults.reset()
+        eng.stop()
+
+
+@pytest.mark.streaming
+@pytest.mark.faults
+def test_stream_deadline_over_rest_yields_terminal_frame():
+    """REST layer: the per-request deadline_s rides the streaming body;
+    when it expires mid-decode the NDJSON stream ends with a
+    finish_reason "deadline" terminal frame and the connection closes
+    — the consumer never hangs on a silent socket."""
+    import json as _json
+    import urllib.request
+
+    from veles_tpu.runtime import faults
+    from veles_tpu.runtime.restful import RestfulServer
+
+    wf, ws = _build_lm(TRANSFORMER)
+    prompt = (np.arange(8) % V).astype(np.int32)
+    eng = DecodeEngine(wf, ws, slots=2, l_max=64, window_ms=0.0)
+    srv = RestfulServer(wf.make_predict_step("out"), dict(ws), 2, (6,),
+                        port=0, workflow=wf, engine=eng,
+                        input_dtype=np.int32).start()
+    try:
+        eng.generate(prompt[None], 2, timeout=180)
+        faults.configure(decode_stall_ms=400.0)
+        body = {"prompt": prompt.tolist(), "steps": 30, "stream": True,
+                "deadline_s": 0.2}
+        rq = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/generate",
+            data=_json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"})
+        t0 = time.monotonic()
+        with urllib.request.urlopen(rq, timeout=60) as r:
+            frames = [_json.loads(l) for l in r if l.strip()]
+        assert time.monotonic() - t0 < 30.0     # bounded, not a hang
+        term = frames[-1]
+        assert term.get("done") and \
+            term["finish_reason"] == "deadline", frames
+        assert "deadline" in term.get("error", "")
+    finally:
+        faults.reset()
+        srv.stop()

@@ -887,3 +887,238 @@ def test_export_package_crash_leaves_previous_package_intact(
     assert after == before          # previous package byte-intact
     data = load_package(dest)       # and still fully loadable
     assert data["checksum"] == wf.checksum()
+
+
+# -- streaming serving (docs/serving.md "Streaming and mid-stream
+# failover"): per-token frames, stop sequences, finish reasons ---------------
+
+V_LM = 12
+
+LM_LAYERS = [
+    {"type": "embedding", "vocab": V_LM, "dim": 16, "name": "emb"},
+    {"type": "attention", "n_heads": 2, "rope": True,
+     "residual": True, "name": "a1"},
+    {"type": "seq_last", "name": "last"},
+    {"type": "softmax", "output_size": V_LM, "name": "out"},
+]
+
+
+@pytest.fixture(scope="module")
+def stream_lm():
+    wf = build_workflow("stream_lm", LM_LAYERS)
+    wf.build({"@input": vt.Spec((2, 6), jnp.int32),
+              "@labels": vt.Spec((2,), jnp.int32),
+              "@mask": vt.Spec((2,), jnp.float32)})
+    ws = wf.init_state(jax.random.key(3), opt.SGD(0.1))
+    return wf, ws
+
+
+def _drain_stream(handle, timeout_s=120.0):
+    """Consume a stream handle → (frame indices, tokens, terminal)."""
+    idx, toks, term = [], [], None
+    for ev in handle.events(timeout_s=timeout_s):
+        if ev[0] == "token":
+            idx.append(ev[1])
+            toks.append(ev[2])
+        else:
+            term = ev
+    return idx, toks, term
+
+
+@pytest.mark.streaming
+def test_stream_stop_sequence_spans_flush_boundary(stream_lm):
+    """Stop sequences match at flush time: with one token per decode
+    dispatch, a 2-token stop sequence ALWAYS straddles two flushes —
+    detection must carry the already-flushed tail across the boundary.
+    The result trims at the earliest match end, the finish reason is
+    "stop", and the frames delivered are exactly the kept tokens."""
+    from veles_tpu.runtime.engine import DecodeEngine
+
+    wf, ws = stream_lm
+    prompt = (np.arange(8) % V_LM).astype(np.int32)
+    N = 12
+    eng = DecodeEngine(wf, dict(ws), slots=2, l_max=64,
+                       window_ms=0.0).start()
+    try:
+        ref = eng.generate(prompt[None], N, timeout=180)[0]
+        gref = [int(t) for t in ref[8:]]
+        # earliest occurrence of the pair gref[k:k+2] must be at k, so
+        # the trim point is known exactly
+        k = next(k for k in range(N - 1)
+                 if [gref[k], gref[k + 1]] not in
+                 [gref[j:j + 2] for j in range(k)])
+        stop = [gref[k], gref[k + 1]]
+        req = eng.submit(prompt, N, stream=True, stop=[stop])
+        idx, toks, term = _drain_stream(req.stream)
+        assert term == ("done", "stop", None), term
+        assert req.done.wait(60) and req.error is None
+        got = [int(t) for t in req.result[8:]]
+        assert got == gref[:k + 2], (got, gref, k)
+        assert toks == got, (toks, got)
+        assert idx == list(range(k + 2)), idx
+    finally:
+        eng.stop()
+
+
+@pytest.mark.streaming
+def test_stream_stop_sequence_on_prefill_first_token(stream_lm):
+    """A stop sequence equal to the FIRST generated token retires the
+    request straight out of prefill — the stop check runs on the
+    prefill-sampled token too, not only at decode flushes."""
+    from veles_tpu.runtime.engine import DecodeEngine
+
+    wf, ws = stream_lm
+    prompt = (np.arange(8) % V_LM).astype(np.int32)
+    eng = DecodeEngine(wf, dict(ws), slots=2, l_max=64,
+                       window_ms=0.0).start()
+    try:
+        first = int(eng.generate(prompt[None], 1, timeout=180)[0][8])
+        req = eng.submit(prompt, 6, stream=True, stop=[[first]])
+        idx, toks, term = _drain_stream(req.stream)
+        assert term == ("done", "stop", None), term
+        assert req.done.wait(60) and req.error is None
+        assert [int(t) for t in req.result[8:]] == [first]
+        assert (idx, toks) == ([0], [first])
+    finally:
+        eng.stop()
+
+
+@pytest.mark.streaming
+def test_stream_finish_reasons_length_and_eos(stream_lm):
+    """Max-token enforcement and eos on the streaming path: a full run
+    ends "length" with exactly n_steps frames; an eos_id placed at a
+    known generated position ends "eos" with the trimmed frames."""
+    from veles_tpu.runtime.engine import DecodeEngine
+
+    wf, ws = stream_lm
+    prompt = (np.arange(8) % V_LM).astype(np.int32)
+    N = 10
+    eng = DecodeEngine(wf, dict(ws), slots=2, l_max=64,
+                       window_ms=0.0).start()
+    try:
+        gref = [int(t) for t in
+                eng.generate(prompt[None], N, timeout=180)[0][8:]]
+        req = eng.submit(prompt, N, stream=True)
+        idx, toks, term = _drain_stream(req.stream)
+        assert term == ("done", "length", None), term
+        assert toks == gref and idx == list(range(N))
+        # eos at a known position: the chosen id's FIRST occurrence
+        # (the last novel token of the greedy run) is where it fires
+        j = max(j for j in range(N) if gref[j] not in gref[:j])
+        req = eng.submit(prompt, N, stream=True, eos_id=gref[j])
+        idx, toks, term = _drain_stream(req.stream)
+        assert term == ("done", "eos", None), term
+        assert toks == gref[:j + 1], (toks, gref)
+        assert idx == list(range(j + 1))
+    finally:
+        eng.stop()
+
+
+@pytest.mark.streaming
+def test_stream_resume_is_bitwise_and_renumbers(stream_lm):
+    """The crash-safe resume form: ORIGINAL prompt/n_steps/key plus the
+    emitted prefix continues bitwise-identically (sampled), with frames
+    numbered from len(emitted_prefix) — the splice contract."""
+    from veles_tpu.runtime.engine import DecodeEngine
+
+    wf, ws = stream_lm
+    prompt = (np.arange(8) % V_LM).astype(np.int32)
+    N = 12
+    kw = dict(temperature=1.3, top_k=5)
+    eng = DecodeEngine(wf, dict(ws), slots=2, l_max=64,
+                       window_ms=0.0).start()
+    try:
+        ref = eng.generate(prompt[None], N, timeout=180,
+                           key=jax.random.key(11), **kw)[0]
+        gref = [int(t) for t in ref[8:]]
+        cut = 5                      # "the stream died after 5 tokens"
+        req = eng.submit(prompt, N, stream=True,
+                         key=jax.random.key(11),
+                         emitted_prefix=gref[:cut], **kw)
+        idx, toks, term = _drain_stream(req.stream)
+        assert term == ("done", "length", None), term
+        assert idx == list(range(cut, N)), idx
+        assert toks == gref[cut:], (toks, gref)
+        assert req.done.wait(60) and req.error is None
+        assert [int(t) for t in req.result] == [int(t) for t in ref]
+    finally:
+        eng.stop()
+
+
+@pytest.mark.streaming
+def test_stream_submit_validation(stream_lm):
+    """Loud 400-shaped errors: stop without stream, too many / too long
+    stop sequences, and an emitted_prefix with nothing left to
+    generate."""
+    from veles_tpu.runtime.engine import DecodeEngine
+
+    wf, ws = stream_lm
+    prompt = (np.arange(8) % V_LM).astype(np.int32)
+    eng = DecodeEngine(wf, dict(ws), slots=2, l_max=64,
+                       window_ms=0.0).start()
+    try:
+        with pytest.raises(ValueError, match="stream=True"):
+            eng.submit(prompt, 4, stop=[[1, 2]])
+        with pytest.raises(ValueError, match="at most 16"):
+            eng.submit(prompt, 4, stream=True,
+                       stop=[[1]] * 17)
+        with pytest.raises(ValueError, match="1..32"):
+            eng.submit(prompt, 4, stream=True, stop=[list(range(33))])
+        with pytest.raises(ValueError, match="emitted_prefix"):
+            eng.submit(prompt, 4, stream=True,
+                       emitted_prefix=[1, 2, 3, 4])
+    finally:
+        eng.stop()
+
+
+@pytest.mark.streaming
+def test_stream_rest_ndjson_stop_and_usage(stream_lm):
+    """The REST streaming surface end-to-end: NDJSON token frames, a
+    stop sequence honored across the wire, and the terminal frame's
+    finish_reason + usage accounting."""
+    import urllib.request
+    from veles_tpu.runtime.engine import DecodeEngine
+    from veles_tpu.runtime.restful import RestfulServer
+
+    wf, ws = stream_lm
+    prompt = (np.arange(8) % V_LM).astype(np.int32)
+    N = 10
+    eng = DecodeEngine(wf, dict(ws), slots=2, l_max=64, window_ms=0.0)
+    srv = RestfulServer(wf.make_predict_step("out"), dict(ws), 2, (6,),
+                        port=0, workflow=wf, engine=eng,
+                        input_dtype=np.int32).start()
+    try:
+        gref = [int(t) for t in
+                eng.generate(prompt[None], N, timeout=180)[0][8:]]
+        k = next(k for k in range(N - 1)
+                 if [gref[k], gref[k + 1]] not in
+                 [gref[j:j + 2] for j in range(k)])
+        body = {"prompt": prompt.tolist(), "steps": N, "stream": True,
+                "stop": [[gref[k], gref[k + 1]]]}
+        rq = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/generate",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(rq, timeout=120) as r:
+            assert r.status == 200
+            assert r.headers["Content-Type"] == "application/x-ndjson"
+            frames = [json.loads(l) for l in r if l.strip()]
+        toks = [f["token"] for f in frames if not f.get("done")]
+        assert toks == gref[:k + 2], (toks, gref)
+        term = frames[-1]
+        assert term["done"] and term["finish_reason"] == "stop", term
+        assert term["usage"] == {"prompt_tokens": 8,
+                                 "completion_tokens": k + 2}, term
+        # stop / emitted_prefix on the UNARY path answer 400, loudly
+        rq = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/generate",
+            data=json.dumps({"prompt": prompt.tolist(), "steps": 4,
+                             "stop": [[1]]}).encode(),
+            headers={"Content-Type": "application/json"})
+        import urllib.error
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(rq, timeout=60)
+        with ei.value:
+            assert ei.value.code == 400
+    finally:
+        srv.stop()

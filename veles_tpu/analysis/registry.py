@@ -150,6 +150,7 @@ HOST_LOOP_ROOTS = {
     # that an enforced property, not an assumption.
     "runtime/fleet.py": ("FleetRouter._scrape_loop",
                          "FleetRouter.handle_generate",
+                         "FleetRouter.handle_generate_stream",
                          "FleetRouter.rolling_drain"),
     # the batch job manager (runtime/jobs.py): dispatch workers and the
     # REST glue are pure control plane — bodies in, committed result
@@ -238,6 +239,27 @@ RESOURCE_PAIRS = {
             "DecodeEngine._register_import_page")},
         "exit_roots": {"runtime/engine.py": (
             "DecodeEngine._apply_kv_imports",)},
+    },
+    # Streaming token handles (runtime/engine.py, docs/serving.md
+    # "Streaming and mid-stream failover"): every ``submit(stream=
+    # True)`` registers a ``_StreamHandle`` in ``_streams`` before the
+    # request enters the queue, and EVERY terminal edge — retire, EOS,
+    # stop-sequence, mid-flight deadline, shed, scheduler crash — must
+    # provably close it, or the consumer blocks forever on a stream
+    # whose request already died.  ``_observe_finish`` funnels every
+    # outcome through the release, so the exit roots are the same
+    # failure sweeps the kv-pages pair declares.
+    "stream-handles": {
+        "acquire": {"runtime/engine.py": (
+            "DecodeEngine._acquire_stream",)},
+        "release": {"runtime/engine.py": (
+            "DecodeEngine._release_stream",)},
+        "exit_roots": {"runtime/engine.py": (
+            "DecodeEngine._retire",
+            "DecodeEngine._post_step",
+            "DecodeEngine._fail_all",
+            "DecodeEngine._expire_queue",
+            "DecodeEngine._advance_prefills")},
     },
     # The batch job manager's in-flight ledger (runtime/jobs.py):
     # every dispatched prompt registers in ``_inflight`` before its

@@ -438,6 +438,25 @@ def _defaults():
     root.common.serve.jobs.max_prompts = 100000  # per-job prompt cap
     root.common.serve.jobs.page_limit = 256  # GET /jobs/<id>/results
     #                                          default page size
+    # Streaming + mid-stream failover (docs/serving.md "Streaming and
+    # mid-stream failover"): incremental token frames with the router
+    # resuming an interrupted stream from its last delivered token.
+    root.common.serve.stream.buffer_tokens = 4096  # undrained frames a
+    #                                                consumer may leave
+    #                                                buffered before its
+    #                                                stream closes with
+    #                                                an overflow error
+    root.common.serve.stream.retry_budget = 3  # mid-stream failover
+    #                                            resubmissions per
+    #                                            request before the
+    #                                            router gives up with an
+    #                                            error terminal frame
+    root.common.serve.stream.backoff_s = 0.05  # base sleep before a
+    #                                            mid-stream resubmission
+    #                                            (doubles per attempt)
+    root.common.serve.stream.backoff_max_s = 2.0  # backoff growth cap —
+    #                                               bounds a failover
+    #                                               storm's dispatch rate
     root.common.serve.deadline_s = 120.0     # default per-request deadline
     root.common.serve.runner_cache = 32      # generate() compiled-runner LRU
     root.common.serve.max_body_mb = 64       # POST body cap -> 413

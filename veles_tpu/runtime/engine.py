@@ -764,6 +764,104 @@ def bucket_table(bucket_min: int, l_max: int):
                    for p in range(1, l_max + 1)})
 
 
+class _StreamHandle:
+    """Incremental token feed for ONE streaming request (docs/serving.md
+    "Streaming and mid-stream failover").  The scheduler thread is the
+    only producer: it pushes monotonically numbered frames — the index
+    is the GLOBAL generated-token index, so a resume seeded from an
+    emitted prefix numbers its first frame exactly one past the last
+    frame the interrupted run delivered, and the router can splice the
+    two streams gaplessly.  The consumer drains via :meth:`events`,
+    which always ends with exactly one terminal event (the engine
+    closes the handle from ``_observe_finish``, which every terminal
+    edge reaches).  The buffer is bounded: a consumer that stops
+    draining gets its stream closed with an overflow error instead of
+    growing host memory — the request itself still retires unary."""
+
+    __slots__ = ("_cond", "_frames", "next_i", "prompt_tokens",
+                 "buffer_tokens", "closed", "finish_reason", "error",
+                 "overflowed")
+
+    def __init__(self, start_i: int, prompt_tokens: int,
+                 buffer_tokens: int):
+        self._cond = threading.Condition()
+        self._frames = collections.deque()  # pending (i, token)  # guarded-by: self._cond
+        # next global generated index the engine will push; the
+        # scheduler thread is the sole writer, so its own unlocked
+        # reads are safe
+        self.next_i = int(start_i)          # guarded-by: self._cond
+        self.prompt_tokens = int(prompt_tokens)
+        self.buffer_tokens = int(buffer_tokens)
+        self.closed = False                 # guarded-by: self._cond
+        self.finish_reason = None           # guarded-by: self._cond
+        self.error: Optional[str] = None    # guarded-by: self._cond
+        self.overflowed = False             # guarded-by: self._cond
+
+    def push(self, start_i: int, tokens) -> int:
+        """Producer: append frames numbered ``start_i`` onward, skipping
+        indices already pushed (idempotent across prefill/flush overlap).
+        Returns the number of frames actually appended."""
+        n = 0
+        with self._cond:
+            if self.closed:
+                return 0
+            i = int(start_i)
+            for t in tokens:
+                if i >= self.next_i:
+                    self._frames.append((i, int(t)))
+                    self.next_i = i + 1
+                    n += 1
+                i += 1
+            if self.buffer_tokens and len(self._frames) > self.buffer_tokens:
+                # slow consumer: close the stream rather than stall the
+                # scheduler or grow without bound; the unary result on
+                # the request stays available
+                self.overflowed = True
+                self.closed = True
+                self.finish_reason = "error"
+                self.error = (f"stream buffer overflow: consumer left "
+                              f"more than {self.buffer_tokens} frames "
+                              "undrained (serve.stream.buffer_tokens)")
+            self._cond.notify_all()
+        return n
+
+    def close(self, finish_reason: str, error: Optional[str] = None):
+        """Producer: mark the stream terminal (first close wins)."""
+        with self._cond:
+            if not self.closed:
+                self.closed = True
+                self.finish_reason = finish_reason
+                self.error = error
+            self._cond.notify_all()
+
+    def events(self, timeout_s: Optional[float] = None):
+        """Consumer generator: every pending ``("token", i, tok)`` frame
+        in index order, then exactly one ``("done", finish_reason,
+        error)``.  ``timeout_s`` bounds the TOTAL wait for a live
+        producer (a dead engine thread must not hang the consumer
+        forever); expiry raises :class:`TimeoutError`."""
+        end = None if timeout_s is None else time.monotonic() + timeout_s
+        while True:
+            with self._cond:
+                while not self._frames and not self.closed:
+                    rem = 1.0 if end is None \
+                        else end - time.monotonic()
+                    if rem <= 0:
+                        raise TimeoutError(
+                            "stream consumer timed out waiting for the "
+                            "next frame")
+                    self._cond.wait(min(rem, 1.0))
+                frames = list(self._frames)
+                self._frames.clear()
+                closed = self.closed
+                reason, err = self.finish_reason, self.error
+            for i, t in frames:
+                yield ("token", i, t)
+            if closed:
+                yield ("done", reason, err)
+                return
+
+
 class _Request:
     __slots__ = ("prompt", "n_steps", "temperature", "top_k", "top_p",
                  "eos_id", "key_data", "deadline", "done", "result",
@@ -771,7 +869,8 @@ class _Request:
                  "page_row", "prefix_start", "page_hashes",
                  "trace_id", "admitted_at", "first_token_at", "bucket",
                  "priority", "batch", "gen", "preemptions",
-                 "chunk_next", "chunk_first", "run_started_at", "_eff")
+                 "chunk_next", "chunk_first", "run_started_at", "_eff",
+                 "stream", "stop_seqs", "stop_hit")
 
     def __init__(self, prompt, n_steps, temperature, top_k, top_p,
                  eos_id, key_data, deadline, priority: int = 0,
@@ -822,6 +921,13 @@ class _Request:
         #                                 final slice's)
         self.run_started_at = None      # latest admission into a slot
         self._eff = None                # memoized effective prompt
+        # streaming (docs/serving.md "Streaming and mid-stream
+        # failover"): the per-request frame feed, optional stop
+        # sequences (token-id arrays, stream-only), and whether a stop
+        # sequence — not eos/length — ended the run
+        self.stream: Optional["_StreamHandle"] = None
+        self.stop_seqs = ()
+        self.stop_hit = False
 
     @property
     def end_index(self) -> int:
@@ -1053,6 +1159,11 @@ class DecodeEngine(Logger):
         self.prefill_chunk = int(serve.get("prefill_chunk", 256)
                                  if prefill_chunk is None
                                  else prefill_chunk)
+        # streaming (docs/serving.md "Streaming and mid-stream
+        # failover"): how many undrained frames a consumer may leave
+        # buffered before its stream is closed with an overflow error
+        self.stream_buffer_tokens = int(
+            serve.stream.get("buffer_tokens", 4096))
         if self.prefill_chunk < 0:
             raise ValueError(
                 f"prefill_chunk must be >= 0, got {self.prefill_chunk}")
@@ -1165,6 +1276,11 @@ class DecodeEngine(Logger):
         self._queue: _PrioQueue = _PrioQueue(self.priorities + 1)  # guarded-by: self._qlock
         self._qlock = threading.Lock()
         self._shed_by_class: dict = {}  # guarded-by: self._qlock
+        # streaming: the live stream handles — the backing set of the
+        # "stream-handles" resource pair (analysis/registry.py): every
+        # _acquire_stream is balanced by a _release_stream on every
+        # terminal edge via _observe_finish
+        self._streams: set = set()      # guarded-by: self._qlock
         self._wake = threading.Event()
         self._stop_evt = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -1442,6 +1558,14 @@ class DecodeEngine(Logger):
             "vt_batch_preemptions_total",
             "batch-lane slots preempted so interactive work could be "
             "admitted (subset of vt_preemptions_total)")
+        # streaming (docs/serving.md "Streaming and mid-stream
+        # failover"): engine-side frame volume and live handle count
+        self._m_stream_frames = reg.counter(
+            "vt_stream_frames_total",
+            "token frames pushed to streaming consumers")
+        self._g_stream_active = reg.gauge(
+            "vt_stream_active",
+            "stream handles currently open on this engine")
 
     def _register_memory(self):  # not-shared: __init__-only construction, precedes any thread
         """Publish this engine's aval-derived byte ledger (runtime/
@@ -1498,6 +1622,10 @@ class DecodeEngine(Logger):
         outcome counter plus the request's span-ring timeline
         (queue-wait → prefill → decode nested under one request span,
         one trace track per request id)."""
+        # the stream handle (when one exists) closes at the SAME edge
+        # the outcome counter observes — a streaming consumer always
+        # gets exactly one terminal frame, whatever ended the request
+        self._release_stream(req, outcome)
         self._m_requests.labels(outcome=outcome).inc()
         sub = req.submitted_at
         fin = req.finished_at if req.finished_at is not None \
@@ -1532,6 +1660,54 @@ class DecodeEngine(Logger):
             ring.add("decode", req.first_token_at,
                      fin - req.first_token_at, cat="serve",
                      tid=req.trace_id)
+
+    # -- stream handles (analysis/registry.py RESOURCE_PAIRS
+    # "stream-handles"): acquired in submit(), released at every
+    # terminal edge via _observe_finish -------------------------------------
+    def _acquire_stream(self, req: _Request) -> _StreamHandle:
+        """Open the request's frame feed and register it in the live
+        set (``_streams``) — the VR7xx lifecycle rules prove every
+        terminal edge releases it.  Frame numbering starts at the
+        request's emitted-prefix size, so a failover resume continues
+        the interrupted run's numbering."""
+        h = _StreamHandle(int(req.gen.size), int(req.prompt.size),
+                          self.stream_buffer_tokens)
+        with self._qlock:
+            self._streams.add(h)
+            self._g_stream_active.set(len(self._streams))
+        return h
+
+    def _release_stream(self, req: _Request, outcome: str):
+        """Close + unregister the request's stream handle (no-op for
+        unary requests).  The terminal frame's finish reason maps from
+        the request outcome: ok → stop/eos/length, 504 → deadline,
+        everything else (shed, crash, stopped) → error."""
+        h = req.stream
+        if h is None:
+            return
+        err = None
+        if outcome == "ok":
+            gen_n = (0 if req.result is None
+                     else int(req.result.size) - int(req.prompt.size))
+            if req.stop_hit:
+                reason = "stop"
+            elif (req.eos_id is not None and gen_n
+                    and gen_n < int(req.n_steps)
+                    and int(req.result[-1]) == int(req.eos_id)):
+                reason = "eos"
+            else:
+                reason = "length"
+        elif outcome == "504":
+            reason = "deadline"
+            err = (str(req.error) if req.error is not None
+                   else "request deadline expired")
+        else:
+            reason = "error"
+            err = str(req.error) if req.error is not None else outcome
+        h.close(reason, err)
+        with self._qlock:
+            self._streams.discard(h)
+            self._g_stream_active.set(len(self._streams))
 
     # -- compiled programs --------------------------------------------------
     @staticmethod
@@ -1834,10 +2010,29 @@ class DecodeEngine(Logger):
                top_k: Optional[int] = None, top_p: Optional[float] = None,
                eos_id: Optional[int] = None, key=None,
                deadline_s: Optional[float] = None,
-               priority: int = 0, batch: bool = False) -> _Request:
+               priority: int = 0, batch: bool = False,
+               stream: bool = False, emitted_prefix=None,
+               stop=None) -> _Request:
         """Enqueue one sequence; returns a request whose ``done`` event
         fires with ``result`` (np.int32, prompt + generated, trimmed at
-        eos) or ``error``.  Raises :class:`EngineOverloaded` when the
+        eos) or ``error``.
+
+        ``stream=True`` opens an incremental frame feed on
+        ``req.stream`` (a :class:`_StreamHandle`): consume
+        ``req.stream.events()`` for monotonically numbered token frames
+        plus exactly one terminal event (docs/serving.md "Streaming and
+        mid-stream failover").  ``emitted_prefix`` is the crash-safe
+        RESUME form: pass the ORIGINAL prompt, ORIGINAL ``n_steps`` and
+        ORIGINAL ``key`` plus the tokens already emitted by an
+        interrupted run, and the continuation is bitwise-identical to
+        the uninterrupted run — greedy and sampled — because it rides
+        the preemption harvest/re-prefill path, whose sampling keys
+        fold in GLOBAL token positions.  Frames of a resume are
+        numbered from ``len(emitted_prefix)``, so a router can splice
+        the streams gaplessly.  ``stop`` (streaming only) is a list of
+        token-id sequences: generation retires early — "stop" finish
+        reason — when the generated tail matches one, even across a
+        flush boundary.  Raises :class:`EngineOverloaded` when the
         queue is full or the admission controller shed the request (the
         REST layer's 429 with an adaptive Retry-After).  ``priority``
         is the request class, 0 (the default, highest) to
@@ -1858,6 +2053,34 @@ class DecodeEngine(Logger):
         n_steps = int(n_steps)
         if n_steps < 1:
             raise ValueError("n_steps must be >= 1")
+        pref = None
+        if emitted_prefix is not None:
+            pref = np.asarray(emitted_prefix, np.int32).reshape(-1)
+            # strictly fewer than n_steps: at == the resume would have
+            # nothing left to generate, yet prefill always samples one
+            # token — it would emit one PAST the original end_index
+            if pref.size >= n_steps:
+                raise ValueError(
+                    f"emitted_prefix holds {pref.size} tokens but "
+                    f"n_steps is {n_steps}; the resume form needs at "
+                    "least one token left to generate (pass the "
+                    "ORIGINAL n_steps, not the remainder)")
+        stop_seqs = ()
+        if stop:
+            stop_seqs = tuple(np.asarray(s, np.int32).reshape(-1)
+                              for s in stop)
+            if not stream:
+                raise ValueError(
+                    "stop sequences ride the streaming path (their "
+                    "detection runs at flush time); pass stream=True")
+            if len(stop_seqs) > 16:
+                raise ValueError(
+                    f"at most 16 stop sequences, got {len(stop_seqs)}")
+            for s in stop_seqs:
+                if not 1 <= s.size <= 32:
+                    raise ValueError(
+                        "each stop sequence must hold 1..32 tokens, "
+                        f"got {s.size}")
         priority = int(priority)
         if batch:
             # the internal lowest class — index self.priorities, one
@@ -1921,6 +2144,15 @@ class DecodeEngine(Logger):
             time.monotonic() + (self.deadline_s if deadline_s is None
                                 else float(deadline_s)),
             priority=priority, batch=batch)
+        if pref is not None and pref.size:
+            # the resume form IS the preemption harvest/resume state:
+            # admission prefills prompt + prefix and decode continues
+            # from the global position the interrupted run reached
+            req.gen = pref
+        req.stop_seqs = stop_seqs
+        if stream:
+            h = self._acquire_stream(req)
+            req.stream = h
         if self.paged:
             # pool backpressure: when slots are free but the PAGES are
             # gone (long prompts at low slot occupancy), admission could
@@ -1932,11 +2164,17 @@ class DecodeEngine(Logger):
             # from the need: a request whose system prompt is already
             # resident only allocates its tail — the hot-shared-prefix
             # workload must not be the one spuriously rejected.
-            need = self._page_span(prompt.size, n_steps)
-            hashes = self._prefix_hashes(prompt)
+            # a resume submit sizes/hashes its EFFECTIVE prompt
+            # (prompt + emitted prefix) — the same total span the
+            # uninterrupted run held, with the prefix-covered pages
+            # eligible for cache hits
+            eff = req.effective_prompt()
+            need = self._page_span(eff.size, req.end_index
+                                   - int(eff.size) + 1)
+            hashes = self._prefix_hashes(eff)
             req.page_hashes = hashes    # _reserve_pages reuses them
             with self._page_lock:
-                need -= self._prefix_hits_locked(hashes, prompt.size)
+                need -= self._prefix_hits_locked(hashes, eff.size)
                 avail = self.pages - int(
                     np.count_nonzero(self._page_ref))
             with self._qlock:
@@ -1957,6 +2195,7 @@ class DecodeEngine(Logger):
                     self._pool_rejected += 1
                 self._count_shed(priority)
                 self._m_requests.labels(outcome="429").inc()
+                self._release_stream(req, "429")
                 raise EngineOverloaded(
                     f"page pool exhausted ({avail} of {self.pages} "
                     f"pages free, request needs {need} beyond its "
@@ -2003,6 +2242,7 @@ class DecodeEngine(Logger):
         if overloaded:
             self._count_shed(priority)
             self._m_requests.labels(outcome="429").inc()
+            self._release_stream(req, "429")
             raise EngineOverloaded(
                 f"admission window full ({qlen} pending, window "
                 f"{limit} for class {priority} of "
@@ -3286,8 +3526,22 @@ class DecodeEngine(Logger):
         self._tok_count.inc()
         if req.batch:
             self._batch_tok_n += 1
+        if req.stream is not None:
+            # the first token is already host-side (int(first) above):
+            # its frame streams now, not at the next dispatch's flush
+            if req.stream.push(int(req.gen.size), (first,)):
+                self._m_stream_frames.inc()
         done = (P >= req.end_index
                 or (req.eos_id is not None and first == req.eos_id))
+        if not done and req.stop_seqs:
+            tail = np.concatenate(
+                [req.gen, np.asarray([first], np.int32)])
+            if self._match_stop(req, tail, int(req.gen.size)) is not None:
+                # the first generated token completed a stop sequence
+                # (possibly one spanning into the resume prefix):
+                # retiring here keeps it — same shape as an eos hit
+                req.stop_hit = True
+                done = True
         self._active[slot] = not done
         if done:
             self._retire(slot)
@@ -3431,9 +3685,93 @@ class DecodeEngine(Logger):
             self._hist[s, lo + 1:hi + 1] = htoks[s, lo + 1:hi + 1]
             self._hist_pos[s] = hi
 
+    @staticmethod
+    def _match_stop(req: _Request, gen_all, start: int):
+        """Earliest count ``n`` of generated tokens to KEEP such that a
+        stop sequence ends at ``gen_all[n - 1]``, scanning only match
+        ends at index >= ``start`` — earlier ends were scanned at
+        earlier flushes, so a sequence SPANNING a flush boundary still
+        matches (its end is new even though its head streamed already).
+        ``gen_all`` is every generated token including the resume
+        prefix.  None = no match."""
+        for j in range(int(start), int(gen_all.size)):
+            for seq in req.stop_seqs:
+                ln = int(seq.size)
+                if ln <= j + 1 and np.array_equal(
+                        gen_all[j + 1 - ln:j + 1], seq):
+                    return j + 1
+        return None
+
+    def _stop_retire(self, slot: int, n_keep: int):
+        """Early retirement on a stop-sequence match: the slot frees
+        like any retire, the result keeps the generated tokens THROUGH
+        the match (``n_keep``, counting the resume prefix), and
+        ``stop_hit`` routes the terminal frame's finish reason."""
+        req = self._slot_req[slot]
+        self._active[slot] = False
+        self._slot_req[slot] = None
+        self._release_slot_pages(slot)
+        req.stop_hit = True
+        P = int(req.prompt.size) + int(req.gen.size)
+        fresh = np.asarray(
+            self._toks[slot, P:P + n_keep - int(req.gen.size)],
+            np.int32)
+        self._retired.inc()
+        req.finish(result=np.concatenate([req.prompt, req.gen, fresh]))
+        self._observe_finish(req, "ok")
+
+    def _flush_streams(self):
+        """Push every streaming slot's freshly decoded tokens as frames
+        — ONE bulk token-matrix D2H per dispatch, paid only while a
+        streaming request is active (the same discipline as
+        :meth:`_sync_hist`).  Runs once per dispatch whatever the
+        dispatch shape, so a megastep/verify block flushes its whole
+        emitted run in one pass — the megastep-aware "flush every N
+        micro-steps" cadence falls out for free.  Stop sequences are
+        matched here BEFORE pushing, so no frame past the stop point
+        ever streams."""
+        htoks = None
+        for slot in range(self.slots):
+            req = self._slot_req[slot]
+            # a mid-chunked-prefill slot still carries the PREVIOUS
+            # occupant's _pos — nothing to flush until its final slice
+            if req is None or req.stream is None \
+                    or slot in self._chunking:
+                continue
+            h = req.stream
+            total = int(self._pos[slot]) + 1 - int(req.prompt.size)
+            start = h.next_i    # scheduler thread is the sole writer
+            if total <= start:
+                continue
+            if htoks is None:
+                htoks = np.asarray(self._toks)
+            P = int(req.prompt.size)
+            lim = total
+            n_keep = None
+            if req.stop_seqs and not req.stop_hit:
+                gen_all = np.concatenate([
+                    req.gen,
+                    htoks[slot, P + int(req.gen.size):P + total]])
+                n_keep = self._match_stop(req, gen_all, start)
+                if n_keep is not None:
+                    lim = n_keep
+            if lim > start:
+                n = h.push(start, htoks[slot, P + start:P + lim])
+                if n:
+                    self._m_stream_frames.inc(n)
+            if n_keep is not None:
+                self._stop_retire(slot, n_keep)
+
     def _post_step(self, finished):
         """Retirement + mid-flight deadline sweep shared by the decode
         and verify steps."""
+        # stream flush FIRST: _slot_req still maps every slot that just
+        # emitted, and a deadline expiry below must deliver the tokens
+        # this dispatch produced before its terminal frame.
+        with self._qlock:
+            flush = bool(self._streams)
+        if flush:
+            self._flush_streams()
         now = time.monotonic()
         for slot in np.flatnonzero(np.asarray(finished)):
             self._retire(int(slot))

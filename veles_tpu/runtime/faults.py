@@ -76,6 +76,20 @@ node in production, so the :func:`enabled` fast path is one falsy check):
     milliseconds first (a slow inter-replica link): the measured
     bandwidth EWMA degrades and the fetch-vs-reprefill payoff policy
     starts choosing local prefill on its own.
+``stream_cut_at_token``
+    int.  The fleet router's streaming relay
+    (runtime/fleet.py ``handle_generate_stream``) severs the replica
+    leg — as a transport failure — after relaying this many token
+    frames (once per arming).  The relay must resume the SUFFIX on a
+    survivor from the recorded high-water mark: the client sees one
+    gapless, duplicate-free stream (tests/test_chaos.py streaming
+    rehearsal).
+``stream_stall_ms``
+    float.  The streaming relay sleeps this many milliseconds per
+    relayed frame (a slow consumer as seen from the replica): the
+    engine-side stream handle buffers up to
+    ``serve.stream.buffer_tokens`` and then terminates the stream
+    with a loud overflow error instead of growing without bound.
 """
 
 from __future__ import annotations
@@ -115,7 +129,8 @@ class FaultPlan:
                  "truncate_snapshot", "slow_batch_ms", "scheduler_crash",
                  "decode_stall_ms", "admission_burst",
                  "replica_crash_at_request", "replica_slow_ms",
-                 "kv_transfer_drop", "kv_transfer_slow_ms")
+                 "kv_transfer_drop", "kv_transfer_slow_ms",
+                 "stream_cut_at_token", "stream_stall_ms")
 
     def __init__(self, cfg):
         get = cfg.get
@@ -133,6 +148,9 @@ class FaultPlan:
         self.kv_transfer_drop = int(get("kv_transfer_drop", 0) or 0)
         self.kv_transfer_slow_ms = float(
             get("kv_transfer_slow_ms", 0.0) or 0.0)
+        self.stream_cut_at_token = int(
+            get("stream_cut_at_token", 0) or 0)
+        self.stream_stall_ms = float(get("stream_stall_ms", 0.0) or 0.0)
 
     def __bool__(self) -> bool:
         return bool(self.nan_grad_at_step or self.loader_ioerror_at_batch
@@ -142,7 +160,9 @@ class FaultPlan:
                     or self.replica_crash_at_request
                     or self.replica_slow_ms
                     or self.kv_transfer_drop
-                    or self.kv_transfer_slow_ms)
+                    or self.kv_transfer_slow_ms
+                    or self.stream_cut_at_token
+                    or self.stream_stall_ms)
 
     def __repr__(self) -> str:
         armed = {k: getattr(self, k) for k in self.__slots__

@@ -44,7 +44,9 @@ fleet-level behaviors horizontal scale needs:
 * **graceful degradation**: per-replica health checks with the
   ``deploy.http_retry`` backoff shape, ejection after consecutive
   transport failures with idempotent resubmission of the failed
-  dispatch to survivors (requests here are unary — never mid-stream),
+  dispatch to survivors (unary requests resubmit whole; streams
+  resume from their last delivered token — see
+  :meth:`FleetRouter.handle_generate_stream`),
   per-replica **429 Retry-After honored as router-level backpressure**
   (a shedding replica is backed off for its hinted window; class-0
   requests are instead routed to the least-burned replica), and
@@ -303,6 +305,21 @@ class FleetRouter(Logger):
         self.kv_min_pages = int(kvt.get("min_pages", 2))
         self.kv_timeout_s = float(kvt.get("timeout_s", 5.0))
         self.prewarm_pages = int(kvt.get("prewarm_pages", 64))
+        # streaming failover policy (docs/serving.md "Streaming and
+        # mid-stream failover"): how many mid-stream resubmissions one
+        # request may spend, and the capped exponential backoff between
+        # them — together they bound a failover storm
+        stream_cfg = root.common.serve.stream
+        self.stream_retry_budget = int(
+            stream_cfg.get("retry_budget", 3))
+        self.stream_backoff_s = float(stream_cfg.get("backoff_s", 0.05))
+        self.stream_backoff_max_s = float(
+            stream_cfg.get("backoff_max_s", 2.0))
+        # router-side default for a streaming request naming no
+        # deadline_s of its own: the same per-request deadline the
+        # replicas enforce (the router's failover loop must terminate
+        # within it)
+        self.stream_deadline_s = float(serve.get("deadline_s", 120.0))
         #: replicas added without an explicit role class
         self.default_role = str(fleet.get("role", "mixed"))
 
@@ -400,6 +417,22 @@ class FleetRouter(Logger):
             "outcome (ok / skipped by payoff / failed / rejected / "
             "disagg / prewarm)",
             labels=("outcome",))
+        # streaming failover (docs/serving.md "Streaming and
+        # mid-stream failover")
+        self._m_stream_resumes = reg.counter(
+            "vt_stream_resumes_total",
+            "mid-stream failovers: an interrupted stream resubmitted "
+            "to a survivor from its last delivered token (subset of "
+            "vt_fleet_resubmissions_total)")
+        self._m_stream_splice = reg.histogram(
+            "vt_stream_splice_seconds",
+            "gap a mid-stream failover added: from the interruption "
+            "to the resumed replica accepting the suffix dispatch")
+        self._m_stream_retry_exhausted = reg.counter(
+            "vt_stream_retry_exhausted_total",
+            "streams terminated with an error frame after the "
+            "per-request resume retry budget ran out "
+            "(serve.stream.retry_budget)")
         self._g_kv_payoff = reg.gauge(
             "vt_fleet_kv_fetch_payoff",
             "last fetch-vs-reprefill payoff estimate (estimated local "
@@ -1066,6 +1099,270 @@ class FleetRouter(Logger):
         return 503, {"error": "no replica available"}, \
             (("Retry-After", "5"),)
 
+    def handle_generate_stream(self, body: dict
+                               ) -> Tuple[int, object, Tuple]:
+        """Route + relay one STREAMING ``/generate`` (docs/serving.md
+        "Streaming and mid-stream failover") → ``(status, result,
+        extra headers)``.  On 200 ``result`` is a GENERATOR of NDJSON
+        frame dicts; any pre-stream failure returns the same statuses
+        :meth:`handle_generate` would.  The relay records the
+        per-request token high-water mark; when a replica dies
+        mid-stream (transport cut, or an error terminal frame from a
+        crashed/stopped scheduler) it resubmits the SUFFIX — the
+        original prompt/steps/seed plus every token already delivered,
+        via the engine's ``emitted_prefix`` resume form — to a
+        survivor and splices the streams, so the client sees one
+        gapless, duplicate-free sequence bitwise-identical to an
+        uninterrupted run.  ``serve.stream.retry_budget`` resumes with
+        ``serve.stream.backoff_s``-based capped backoff bound the
+        failover storm; the budget or the request deadline running out
+        yields ONE error/deadline terminal frame, never a hang."""
+        if self._draining:
+            return 503, {"error": "fleet is draining"}, \
+                (("Retry-After", "5"),)
+        try:
+            priority = int(body.get("priority", 0) or 0)
+        except (TypeError, ValueError):
+            priority = 0
+        hashes = self._head_hashes(body.get("prompt"))
+        if hashes:
+            self._m_affinity_requests.inc()
+            with self._lock:
+                self._affinity_requests += 1
+        from . import faults
+        plan = faults.get_plan() if faults.enabled() else None
+        with self._lock:
+            self._route_count += 1
+            route_n = self._route_count
+        # the router-side failover clock: every resume leg must fit
+        # inside what remains of the ORIGINAL request deadline (resumed
+        # legs get the shrunken remainder as their deadline_s)
+        try:
+            total_s = float(body.get("deadline_s")
+                            or self.stream_deadline_s)
+        except (TypeError, ValueError):
+            total_s = self.stream_deadline_s
+        deadline = time.monotonic() + total_s
+        # high-water mark: every token already DELIVERED to the client
+        # (seeded by a client-side resume's own prefix); the resume
+        # body sends exactly this list, so a survivor numbers its
+        # first frame one past it
+        tokens: List[int] = [int(t) for t in
+                             np.asarray(body.get("emitted_prefix")
+                                        if body.get("emitted_prefix")
+                                        is not None else [],
+                                        np.int64).reshape(-1)]
+        state = {"hit_counted": False, "prefetched": False}
+
+        def run_leg(tried: set, leg_body: dict):
+            """One routed streaming dispatch, with the unary loop's
+            skip/backoff/failover semantics.  Returns ``("stream",
+            rep, seq, frames)`` holding the dispatch ledger entry open
+            (the relay closes it), ``("status", code, doc, headers)``
+            for an answered non-200, or ``("exhausted", retry_hint)``."""
+            retry_hint = None
+            with self._lock:
+                n_replicas = len(self._replicas)
+            for _attempt in range(n_replicas + 1):
+                rep, hit = self._route(priority, hashes, tried)
+                if rep is None:
+                    break
+                if hit and not state["hit_counted"]:
+                    state["hit_counted"] = True
+                    self._m_affinity_hits.inc()
+                    with self._lock:
+                        self._affinity_hits += 1
+                if plan is not None:
+                    self._inject_faults(plan, rep, route_n)
+                if hashes and not hit and not state["prefetched"]:
+                    state["prefetched"] = True
+                    self._kv_prefetch(rep, leg_body, hashes)
+                seq = self._begin_dispatch(rep)
+                try:
+                    status, result, retry = rep.client.generate_stream(
+                        leg_body, timeout=self.dispatch_timeout_s)
+                except ReplicaUnavailable as e:
+                    self._end_dispatch(rep, seq)
+                    self._note_dispatch_failure(rep, str(e))
+                    self._m_resubmissions.inc()
+                    tried.add(rep.id)
+                    continue
+                if status == 200:
+                    self._record_affinity(hashes, rep)
+                    return ("stream", rep, seq, result)
+                self._end_dispatch(rep, seq)
+                if status == 429:
+                    self._note_backpressure(rep, retry)
+                    retry_hint = retry if retry_hint is None \
+                        else min(retry_hint, retry)
+                    tried.add(rep.id)
+                    continue
+                if status == 503 or (status == 500
+                                     and isinstance(result, dict)
+                                     and result.get("kind")
+                                     == "scheduler_crash"):
+                    self._note_dispatch_failure(rep, f"HTTP {status}")
+                    self._m_resubmissions.inc()
+                    tried.add(rep.id)
+                    continue
+                return ("status", status, result, ())
+            return ("exhausted", retry_hint)
+
+        def leg_body_now() -> dict:
+            b = dict(body)
+            b["stream"] = True
+            b["emitted_prefix"] = list(tokens)
+            # the remaining budget, floored just enough to keep the
+            # replica's deadline_s validation (> 0) satisfied — the
+            # engine, not the router, owns expiry semantics
+            b["deadline_s"] = max(0.05, deadline - time.monotonic())
+            return b
+
+        first = run_leg(set(), leg_body_now())
+        if first[0] == "status":
+            return first[1], first[2], first[3]
+        if first[0] == "exhausted":
+            retry_hint = first[1]
+            if retry_hint is None:
+                # same soonest-reopen answer as the unary path: backed-
+                # off replicas are backpressure, not an outage
+                now = time.monotonic()
+                with self._lock:
+                    waits = [r.backoff_until - now
+                             for r in self._replicas
+                             if r.state == ACTIVE
+                             and r.backoff_until > now]
+                if waits:
+                    retry_hint = min(waits)
+            if retry_hint is not None:
+                return 429, {"error": "every replica is shedding "
+                                      "(router-level backpressure)",
+                             "retry_after_s": round(retry_hint, 3)}, \
+                    (("Retry-After", str(int(round(max(
+                        1.0, retry_hint))))),)
+            return 503, {"error": "no replica available"}, \
+                (("Retry-After", "5"),)
+
+        def relay(rep, seq, frames):
+            cut_at = plan.stream_cut_at_token if plan is not None else 0
+            stall_ms = plan.stream_stall_ms if plan is not None else 0.0
+            resumes_left = self.stream_retry_budget
+            relayed = 0
+            while True:
+                failure = None
+                try:
+                    try:
+                        for frame in frames:
+                            if frame.get("done"):
+                                reason = frame.get("finish_reason")
+                                if reason == "error":
+                                    # the replica-side request FAILED
+                                    # (scheduler crash/stop, shed
+                                    # mid-flight): resumable, exactly
+                                    # like a transport cut — but the
+                                    # replica itself answered, so no
+                                    # ejection strike
+                                    failure = ("terminal",
+                                               str(frame.get("error")))
+                                    break
+                                yield frame
+                                return
+                            i = int(frame["i"])
+                            if i < len(tokens):
+                                continue    # overlap after a resume:
+                                #             already delivered, drop
+                            if i > len(tokens):
+                                # a gap is stream corruption — never
+                                # deliver it; resume from the mark
+                                failure = ("gap",
+                                           f"frame {i} past high-water "
+                                           f"mark {len(tokens)}")
+                                break
+                            tokens.append(int(frame["token"]))
+                            relayed += 1
+                            if stall_ms:
+                                # injected slow consumer
+                                # (faults.stream_stall_ms): the relay
+                                # lags, the replica-side handle buffers
+                                time.sleep(stall_ms / 1e3)
+                            yield frame
+                            if cut_at and relayed >= cut_at \
+                                    and faults.fire_once("stream_cut"):
+                                raise ReplicaUnavailable(
+                                    f"{rep.id}: injected stream cut "
+                                    f"after frame {relayed} "
+                                    "(faults.stream_cut_at_token)")
+                    except ReplicaUnavailable as e:
+                        failure = ("transport", str(e))
+                finally:
+                    # the leg's ledger entry closes however the leg
+                    # ends — clean terminal, failover, or the client
+                    # closing the relay generator mid-stream
+                    self._end_dispatch(rep, seq)
+                if failure is None:
+                    # replica closed the stream with no terminal frame:
+                    # the transport died between frames
+                    failure = ("transport",
+                               f"{rep.id}: stream ended without a "
+                               "terminal frame")
+                cut_at = 0      # the injected cut fires once
+                if hasattr(frames, "close"):
+                    frames.close()
+                if failure[0] == "transport":
+                    self._note_dispatch_failure(rep, failure[1])
+                interrupted = time.monotonic()
+                resumed = None
+                while resumes_left > 0:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    resumes_left -= 1
+                    attempt = self.stream_retry_budget - resumes_left
+                    backoff = min(
+                        self.stream_backoff_s * (2 ** (attempt - 1)),
+                        self.stream_backoff_max_s, max(remaining, 0.0))
+                    if backoff > 0:
+                        time.sleep(backoff)
+                    self._m_resubmissions.inc()
+                    self._m_stream_resumes.inc()
+                    # first retry skips the replica that just died; later
+                    # retries re-admit it (a restart may have brought it
+                    # back inside the backoff window)
+                    nxt = run_leg({rep.id} if attempt == 1 else set(),
+                                  leg_body_now())
+                    if nxt[0] == "stream":
+                        resumed = nxt
+                        break
+                    if nxt[0] == "status":
+                        # a survivor ANSWERED with a non-resumable
+                        # error (e.g. 400): surface it terminally
+                        doc = nxt[2] if isinstance(nxt[2], dict) else {}
+                        yield {"done": True, "finish_reason": "error",
+                               "error": f"resume failed with HTTP "
+                                        f"{nxt[1]}: "
+                                        f"{doc.get('error', nxt[2])}"}
+                        return
+                    # exhausted this pass: let the backoff window give
+                    # ejection/readmission a chance before retrying
+                if resumed is None:
+                    if deadline - time.monotonic() <= 0:
+                        yield {"done": True,
+                               "finish_reason": "deadline",
+                               "error": "request deadline expired "
+                                        "during mid-stream failover"}
+                        return
+                    self._m_stream_retry_exhausted.inc()
+                    yield {"done": True, "finish_reason": "error",
+                           "error": "mid-stream failover retry budget "
+                                    f"exhausted after {failure[1]} "
+                                    "(serve.stream.retry_budget)"}
+                    return
+                self._m_stream_splice.observe(
+                    time.monotonic() - interrupted)
+                _tag, rep, seq, frames = resumed
+
+        return 200, relay(first[1], first[2], first[3]), ()
+
     def _inject_faults(self, plan, rep: Replica, route_n: int):
         """Fleet fault knobs (runtime/faults.py): ``replica_slow_ms``
         delays every dispatch to the lowest-id active replica;
@@ -1553,6 +1850,31 @@ class FleetServer(Logger):
             def _reply(self, obj, code=200, headers=()):
                 reply_json(self, obj, code=code, headers=headers)
 
+            def _stream_reply(self, frames):
+                """Relay router stream frames as chunkless NDJSON —
+                headers first, then one flushed JSON line per frame
+                (same wire shape as the replica's own streaming
+                ``/generate``); the consumer reads to connection
+                close.  A client that disconnects mid-stream closes
+                the relay generator, which releases the upstream
+                dispatch leg."""
+                try:
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "application/x-ndjson")
+                    self.send_header("Cache-Control", "no-store")
+                    self.send_header("Connection", "close")
+                    self.end_headers()
+                    for frame in frames:
+                        self.wfile.write(
+                            (json.dumps(frame) + "\n").encode())
+                        self.wfile.flush()
+                except (BrokenPipeError, ConnectionError, OSError):
+                    pass    # consumer went away; generator close below
+                finally:    # ends the upstream leg either way
+                    if hasattr(frames, "close"):
+                        frames.close()
+
             def do_GET(self):
                 path = self.path.split("?", 1)[0].rstrip("/")
                 if path == "/metrics":
@@ -1601,6 +1923,15 @@ class FleetServer(Logger):
                         hdr = self.headers.get("X-Priority")
                         if hdr is not None:
                             req.setdefault("priority", hdr)
+                        if req.get("stream"):
+                            code, result, headers = \
+                                outer.router.handle_generate_stream(req)
+                            if code != 200:
+                                self._reply(result, code=code,
+                                            headers=headers)
+                                return
+                            self._stream_reply(result)
+                            return
                         code, doc, headers = \
                             outer.router.handle_generate(req)
                         self._reply(doc, code=code, headers=headers)

@@ -21,6 +21,7 @@ wrap these calls in the ``deploy.http_retry`` backoff themselves.
 
 from __future__ import annotations
 
+import http.client
 import json
 import urllib.error
 import urllib.request
@@ -193,6 +194,66 @@ class ReplicaClient:
                 except (TypeError, ValueError):
                     retry = 1.0
         return status, doc, retry
+
+    def generate_stream(self, body: dict,
+                        timeout: Optional[float] = None):
+        """Forward one streaming ``POST /generate`` (``{"stream":
+        true}`` body) → ``(status, frames_or_doc, retry_after_s)``.
+        On 200, ``frames_or_doc`` is an ITERATOR of parsed NDJSON frame
+        dicts — the connection stays open while the caller drains it,
+        and a transport failure mid-stream raises
+        :class:`ReplicaUnavailable` FROM THE ITERATOR (the router's
+        resume-from-last-frame signal).  On any error status the
+        connection is already drained and closed and ``frames_or_doc``
+        is the parsed error body, matching :meth:`generate`'s shape."""
+        data = json.dumps(body).encode()
+        req = urllib.request.Request(
+            self.base_url + "/generate", data=data,
+            headers={"Content-Type": "application/json"},
+            method="POST")
+        t = self.timeout_s if timeout is None else float(timeout)
+        try:
+            resp = urllib.request.urlopen(req, timeout=t)
+        except urllib.error.HTTPError as e:
+            with e:
+                doc = self._parse(e.read())
+            retry = 0.0
+            if e.code == 429:
+                if isinstance(doc, dict) and doc.get("retry_after_s"):
+                    retry = float(doc["retry_after_s"])
+                else:
+                    try:
+                        retry = float(e.headers.get("Retry-After", 1.0))
+                    except (TypeError, ValueError):
+                        retry = 1.0
+            return e.code, doc, retry
+
+        except (urllib.error.URLError, ConnectionError, TimeoutError,
+                OSError) as e:
+            raise ReplicaUnavailable(
+                f"{self.base_url}: {type(e).__name__}: {e}") from e
+
+        def frames():
+            try:
+                with resp:
+                    for raw in resp:
+                        raw = raw.strip()
+                        if not raw:
+                            continue
+                        try:
+                            yield json.loads(raw)
+                        except json.JSONDecodeError as e:
+                            # a half-written line is a mid-stream cut,
+                            # same failover signal as a dropped socket
+                            raise ReplicaUnavailable(
+                                f"{self.base_url}: truncated stream "
+                                f"frame: {e}") from e
+            except (ConnectionError, TimeoutError, OSError,
+                    http.client.HTTPException) as e:
+                raise ReplicaUnavailable(
+                    f"{self.base_url}: {type(e).__name__}: {e}") from e
+
+        return resp.status, frames(), 0.0
 
     # -- lifecycle ops (the coordinated-swap / drain fan-out) ---------------
     def stage(self, source: Optional[str] = None, version=None,

@@ -442,6 +442,14 @@ class RestfulServer(Logger):
                         hdr = self.headers.get("X-Priority")
                         if hdr is not None:
                             req.setdefault("priority", hdr)
+                        if req.get("stream"):
+                            # incremental NDJSON frames (docs/serving.md
+                            # "Streaming and mid-stream failover").
+                            # Validation/submit errors raise BEFORE any
+                            # header is written, so they ride the same
+                            # status mapping below as the unary path.
+                            outer.stream_generate(req, self)
+                            return
                         self._reply(outer.decode(req))
                         return
                     self._reply(
@@ -586,6 +594,14 @@ class RestfulServer(Logger):
             raise ValueError(
                 "this server was started without a workflow; /generate "
                 "needs RestfulServer(..., workflow=wf) or engine=")
+        if req.get("stop") is not None \
+                or req.get("emitted_prefix") is not None:
+            # silently ignoring either would return a WRONG unary 200
+            # (un-stopped tokens / a restarted-from-zero sequence)
+            raise ValueError(
+                'stop and emitted_prefix ride the streaming path; add '
+                '{"stream": true} (docs/serving.md "Streaming and '
+                'mid-stream failover")')
         from .generate import generate
         # Coerce once at the boundary: np.asarray(..., int64) would
         # silently TRUNCATE fractional ids (2.7 -> 2) and a float/str
@@ -726,6 +742,161 @@ class RestfulServer(Logger):
             temperature=temperature, top_k=top_k, top_p=top_p,
             eos_id=eos_id, key=key)
         return {"tokens": np.asarray(toks).tolist()}
+
+    def _stream_submit(self, req: dict):
+        """Validate a ``{"stream": true}`` /generate body and submit it
+        to the engine's streaming path.  Returns ``(engine_request,
+        consumer_timeout_s)``.  Every error raises BEFORE the caller
+        writes response headers, so malformed bodies get the normal
+        400/429/5xx statuses, never a broken half-stream."""
+        if self.engine is None:
+            raise ValueError(
+                "streaming needs engine= serving (per-request "
+                "generate() has no incremental token feed)")
+        if self._req_int(req.get("beams", 1), "beams") != 1:
+            raise ValueError("streaming supports beams=1 only")
+        if req.get("batch"):
+            raise ValueError(
+                "the batch lane is unary; drop stream or batch")
+        prompt = np.asarray(req["prompt"])
+        if not np.issubdtype(prompt.dtype, np.integer):
+            raise ValueError(
+                "prompt token ids must be integers "
+                f"(got dtype {prompt.dtype})")
+        if prompt.ndim == 2 and prompt.shape[0] == 1:
+            prompt = prompt.reshape(-1)
+        if prompt.ndim != 1 or prompt.size == 0:
+            raise ValueError(
+                "a streamed prompt is ONE non-empty sequence: "
+                "[ids] or [[ids]]")
+        vocab = self._vocab_size()
+        hi = vocab if vocab is not None else 2 ** 31
+        if prompt.min() < 0 or prompt.max() >= hi:
+            raise ValueError(
+                f"prompt token ids must be in [0, {hi}) "
+                f"(got min {prompt.min()}, max {prompt.max()})")
+        steps = self._req_int(req.get("steps", 16), "steps")
+        if not 0 < steps <= 65536:
+            raise ValueError(f"steps must be in [1, 65536], got {steps}")
+        try:
+            temperature = float(req.get("temperature", 0.0))
+            top_p = req.get("top_p")
+            top_p = None if top_p is None else float(top_p)
+        except (TypeError, ValueError) as e:
+            raise ValueError(
+                f"temperature/top_p must be numeric: {e}") from None
+        top_k = req.get("top_k")
+        if top_k is not None:
+            top_k = self._req_int(top_k, "top_k")
+        if (top_k is not None or top_p is not None) and temperature <= 0:
+            raise ValueError(
+                "top_k/top_p filter sampling and need temperature > 0 "
+                "(temperature 0 is greedy decoding)")
+        priority = self._req_int(req.get("priority", 0), "priority")
+        eos_id = req.get("eos_id")
+        if eos_id is None:
+            eos_id = self.default_eos_id
+        if eos_id is not None:
+            eos_id = self._req_int(eos_id, "eos_id")
+            if not 0 <= eos_id < hi:
+                raise ValueError(
+                    f"eos_id {eos_id} is outside the model "
+                    f"vocabulary [0, {hi})")
+        # the crash-safe resume form (engine.submit): the ORIGINAL
+        # prompt/steps/seed plus the tokens an interrupted stream
+        # already delivered — the engine re-prefills prompt + prefix
+        # and continues bitwise-identically
+        pref = req.get("emitted_prefix")
+        if pref is not None:
+            pref = np.asarray(pref)
+            if pref.size and not np.issubdtype(pref.dtype, np.integer):
+                raise ValueError("emitted_prefix must hold integer "
+                                 "token ids")
+            pref = pref.reshape(-1).astype(np.int64)
+            if pref.size and (pref.min() < 0 or pref.max() >= hi):
+                raise ValueError(
+                    f"emitted_prefix token ids must be in [0, {hi})")
+            pref = pref.astype(np.int32)
+        stop = req.get("stop")
+        if stop is not None:
+            if not isinstance(stop, (list, tuple)):
+                raise ValueError(
+                    'stop must be a list of token-id sequences, e.g. '
+                    '{"stop": [[13, 198]]}')
+            stop = [np.asarray(s, np.int64).reshape(-1) for s in stop]
+            for s in stop:
+                if s.size and (s.min() < 0 or s.max() >= hi):
+                    raise ValueError(
+                        f"stop token ids must be in [0, {hi})")
+            stop = [s.astype(np.int32) for s in stop]
+        deadline_s = req.get("deadline_s")
+        if deadline_s is not None:
+            deadline_s = float(deadline_s)
+            if not deadline_s > 0:
+                raise ValueError(
+                    f"deadline_s must be > 0, got {deadline_s}")
+        import jax
+        key = jax.random.key(self._req_int(req.get("seed", 0), "seed"))
+        r = self.engine.submit(
+            prompt.astype(np.int32), steps, temperature=temperature,
+            top_k=top_k, top_p=top_p, eos_id=eos_id, key=key,
+            deadline_s=deadline_s, priority=priority, stream=True,
+            emitted_prefix=pref, stop=stop)
+        # the consumer timeout is a hang-guard over the ENGINE-enforced
+        # deadline, not a second deadline: slack covers the terminal
+        # frame's delivery
+        wait = (deadline_s if deadline_s is not None
+                else self.engine.deadline_s) + 30.0
+        return r, wait
+
+    def stream_generate(self, req: dict, handler):
+        """POST /generate with ``{"stream": true}``: one NDJSON line
+        per token frame — ``{"i": n, "token": t}`` with ``i`` the
+        GLOBAL generated-token index — then exactly one terminal line
+        ``{"done": true, "finish_reason": ..., "usage": {...}}``
+        (+ ``"error"`` when the reason is error/deadline).  The reply
+        closes the connection to frame the stream (the handler speaks
+        HTTP/1.0); a resume via ``emitted_prefix`` numbers its first
+        frame one past the prefix, which is what lets the fleet router
+        splice failover streams gaplessly (docs/serving.md "Streaming
+        and mid-stream failover")."""
+        r, wait = self._stream_submit(req)
+        h = r.stream
+        handler.send_response(200)
+        handler.send_header("Content-Type", "application/x-ndjson")
+        handler.send_header("Cache-Control", "no-store")
+        handler.send_header("Connection", "close")
+        handler.end_headers()
+        try:
+            for ev in h.events(timeout_s=wait):
+                if ev[0] == "token":
+                    line = {"i": ev[1], "token": ev[2]}
+                else:
+                    _, reason, err = ev
+                    line = {"done": True, "finish_reason": reason,
+                            "usage": {
+                                "prompt_tokens": h.prompt_tokens,
+                                "completion_tokens": int(h.next_i)}}
+                    if err is not None:
+                        line["error"] = err
+                handler.wfile.write(
+                    (json.dumps(line) + "\n").encode())
+                handler.wfile.flush()
+        except TimeoutError:
+            # hang-guard tripped (a dead scheduler with the handle
+            # still open): best-effort terminal frame, then close
+            try:
+                handler.wfile.write((json.dumps(
+                    {"done": True, "finish_reason": "error",
+                     "error": "stream stalled past its deadline"})
+                    + "\n").encode())
+            except OSError:
+                pass
+        except (BrokenPipeError, ConnectionError, OSError):
+            # consumer went away mid-stream: nothing to reply to; the
+            # request itself keeps running and retires unary (the
+            # bounded handle buffer caps what it can accumulate)
+            pass
 
     def _local_dispatch(self, body: dict):
         """The job manager's in-process dispatch against THIS replica:
