@@ -117,6 +117,16 @@ last relayed token), with the resume/resubmission counter deltas and
 the failover's cost reported honestly as TTFT and inter-token p99
 deltas — a pause in the affected tails, never a lost token.
 
+A thirteenth scenario ("experiment_sweep") measures the experiment
+manager (docs/experiments.md): the same paced interactive class-0
+burst through a 2-replica fleet, alone and while a full autonomous
+experiment runs underneath it — trial trainings, batch-lane scoring
+sweeps, and the winner hot-swapped through the two-phase coordinated
+fleet swap.  The interactive TTFT p99 delta must sit within timer
+noise, the promotion must complete (winner beat the baseline and
+shipped), and the compile counters stay flat — trial snapshots are
+topology-identical, so the swap re-traces nothing.
+
 Prints ONE JSON line in the bench.py contract:
   {"metric": "serving_decode_tokens_per_sec", "value": N,
    "unit": "tokens/s", "vs_baseline": N, ...}
@@ -1432,6 +1442,202 @@ def main(argv=None):
             _root.common.serve.fleet.scrape_interval_s = prev_scrape
             shutil.rmtree(jobs_dir, ignore_errors=True)
 
+    def run_experiment_sweep():
+        """Experiment manager (docs/experiments.md): the SAME
+        interactive burst through a 2-replica fleet, first alone, then
+        while a full autonomous experiment runs underneath it — trial
+        trainings in the manager's drive thread, generation scoring
+        sweeps riding the batch lane, and the winner hot-swapped into
+        the serving fleet through the two-phase coordinated swap.  The
+        serving-side contract is the payoff being measured: the
+        interactive class-0 TTFT p99 must be statistically unmoved by
+        the concurrent experiment (its sweeps are batch-class, its
+        swap flips at decode-step boundaries), the promotion must
+        complete (winner beat the baseline and shipped), and the
+        compile counters must stay flat — the trial snapshots are
+        topology-identical, so the swap re-traces nothing."""
+        import shutil
+        import jax
+        from veles_tpu.config import Config, Range
+        from veles_tpu.config import root as _root
+        from veles_tpu.experiments import (ExperimentManager,
+                                           fleet_promoter)
+        from veles_tpu.loader.base import TRAIN, VALID
+        from veles_tpu.models.standard import build_workflow
+        from veles_tpu.ops import optimizers as opt
+        from veles_tpu.runtime.deploy import DeployController
+        from veles_tpu.runtime.fleet import (FleetRouter, FleetServer,
+                                             InProcessReplica)
+        from veles_tpu.runtime.restful import RestfulServer
+        xrng = np.random.default_rng(53)
+        xv, xslots = 12, 3
+        XLAYERS = [
+            {"type": "embedding", "vocab": xv, "dim": 16, "name": "emb"},
+            {"type": "attention", "n_heads": 2, "rope": True,
+             "residual": True, "name": "a1"},
+            {"type": "seq_last", "name": "last"},
+            {"type": "softmax", "output_size": xv, "name": "out"},
+        ]
+        xwf = build_workflow("bench_exp_lm", XLAYERS)
+        xwf.build({"@input": vt.Spec((1, 6), jnp.int32),
+                   "@labels": vt.Spec((1,), jnp.int32),
+                   "@mask": vt.Spec((1,), jnp.float32)})
+        xws = xwf.init_state(jax.random.key(11), opt.SGD(0.01))
+        XP, XN = 4, 8              # interactive request shape
+        n_interactive, n_threads = 60, 3
+        gap_s = 0.06               # paced: a standing trough for the
+        # experiment's batch-class sweeps to harvest
+
+        # the search space: learning rate over the same 2-epoch
+        # predict-last task the chaos rehearsal uses — the tiny
+        # baseline lr plateaus, any sampled lr wins, the gate FIRES
+        xcfg = Config()
+        xcfg.lr = Range(0.002, 0.001, 0.3)
+
+        def trial_factory(trial, tcfg):
+            drng = np.random.default_rng(0)
+            x = drng.integers(1, xv, (48, 6)).astype(np.int32)
+            vx = drng.integers(1, xv, (16, 6)).astype(np.int32)
+            loader = vt.ArrayLoader(
+                {TRAIN: x, VALID: vx},
+                {TRAIN: x[:, -1].astype(np.int32),
+                 VALID: vx[:, -1].astype(np.int32)}, minibatch_size=8)
+            twf = build_workflow("bench_exp_trial", XLAYERS)
+            return vt.Trainer(
+                twf, loader,
+                vt.optimizers.SGD(float(tcfg.lr), momentum=0.9),
+                vt.Decision(max_epochs=2, fail_iterations=10))
+
+        def factory():
+            xeng = DecodeEngine(xwf, dict(xws), slots=xslots, l_max=64,
+                                window_ms=0.0, preempt=True)
+            srv = RestfulServer(xwf.make_predict_step("out"),
+                                dict(xws), 2, (6,), port=0,
+                                workflow=xwf, engine=xeng,
+                                input_dtype=np.int32)
+            DeployController(server=srv)
+            return srv.start()
+
+        prev_scrape = _root.common.serve.fleet.get(
+            "scrape_interval_s", 0.5)
+        _root.common.serve.fleet.scrape_interval_s = 0.05
+        work_dir = tempfile.mkdtemp(prefix="bench_exp_")
+        replicas = [InProcessReplica(factory) for _ in range(2)]
+        router = FleetRouter()
+        for rep in replicas:
+            router.add_replica(url=rep.url, registry_key="in-process",
+                               restart=rep.restart, kill=rep.kill)
+        fsrv = FleetServer(router, port=0,
+                           jobs_dir=os.path.join(work_dir, "jobs"))
+        mgr = ExperimentManager(
+            os.path.join(work_dir, "exps"), trial_factory, config=xcfg,
+            jobs=fsrv.jobs, promote=fleet_promoter(router),
+            eval_prompts=[[1, 2, 3, 4], [5, 6, 7, 8]],
+            eval_timeout_s=300.0)
+        fsrv.experiments = mgr
+        router.experiments = mgr
+        fsrv.start()
+        engines = [rep.srv.engine for rep in replicas]
+
+        def burst():
+            errs = []
+            lock = threading.Lock()
+            per = n_interactive // n_threads
+
+            def worker(wid):
+                for i in range(per):
+                    if i:
+                        time.sleep(gap_s)
+                    prompt = xrng.integers(1, xv, XP).tolist()
+                    status, doc, _h = router.handle_generate(
+                        {"prompt": [prompt], "steps": XN})
+                    if status != 200:
+                        with lock:
+                            errs.append((wid, i, status, doc))
+
+            t0 = time.perf_counter()
+            threads = [threading.Thread(target=worker, args=(w,))
+                       for w in range(n_threads)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            return time.perf_counter() - t0, errs
+
+        try:
+            # warm the only programs in play (eval prompts share the
+            # interactive bucket), then freeze the compile counters
+            for e in engines:
+                e.generate(xrng.integers(1, xv, (1, XP)), 2,
+                           timeout=600)
+            frozen = [e.stats()["compile"]["compiles"]
+                      for e in engines]
+
+            # phase A: the interactive burst ALONE
+            ma0 = scrape()
+            wall_a, errs_a = burst()
+            ma1 = scrape()
+            ttft_a = _latency_percentiles(
+                ma0, ma1, "vt_request_ttft_seconds")
+
+            # phase B: same burst while the experiment trains, sweeps
+            # and (after the burst window) hot-swaps underneath it
+            t_exp = time.perf_counter()
+            doc = mgr.submit({"policy": "genetic", "generations": 2,
+                              "population": 3, "seed": 5,
+                              "name": "bench-sweep"})
+            mb0 = scrape()
+            wall_b, errs_b = burst()
+            mb1 = scrape()
+            ttft_b = _latency_percentiles(
+                mb0, mb1, "vt_request_ttft_seconds")
+            done = mgr.wait(doc["id"], timeout_s=600.0)
+            exp_wall = time.perf_counter() - t_exp
+            st = mgr.status(doc["id"])
+            new_compiles = sum(
+                e.stats()["compile"]["compiles"] for e in engines) \
+                - sum(frozen)
+            return {
+                "replicas": 2, "slots_per_replica": xslots,
+                "model": {"vocab": xv, "dim": 16, "layers": 1},
+                "interactive": {
+                    "requests": n_interactive,
+                    "concurrency": n_threads,
+                    "prompt_tokens": XP, "steps": XN,
+                    "alone": {"wall_s": round(wall_a, 3),
+                              "ttft": ttft_a, "errors": errs_a},
+                    "with_experiment": {
+                        "wall_s": round(wall_b, 3),
+                        "ttft": ttft_b, "errors": errs_b},
+                    # THE acceptance number: the experiment must not
+                    # move the interactive tail (CPU-timer noise)
+                    "ttft_p99_delta_ms": round(
+                        ttft_b["p99_ms"] - ttft_a["p99_ms"], 2),
+                },
+                "experiment": {
+                    "state": st["state"],
+                    "completed": bool(done and st["state"] == "done"),
+                    "generations": st["generations"],
+                    "population": st["population"],
+                    "trials": st["trials"],
+                    "wall_s": round(exp_wall, 3),
+                    "baseline_score": st.get("baseline_score"),
+                    "best_score": (st.get("best") or {}).get("score"),
+                    "promoted": bool(
+                        (st.get("promotion") or {}).get("promoted")),
+                },
+                "new_compiles_in_phases": new_compiles,
+                "recompiles": sum(
+                    e.stats()["compile"]["recompiles"]
+                    for e in engines),
+            }
+        finally:
+            fsrv.stop()
+            for rep in replicas:
+                rep.stop()
+            _root.common.serve.fleet.scrape_interval_s = prev_scrape
+            shutil.rmtree(work_dir, ignore_errors=True)
+
     def run_streaming():
         """Streaming + mid-stream failover (docs/serving.md "Streaming
         and mid-stream failover"): the same burst of token streams
@@ -1659,6 +1865,7 @@ def main(argv=None):
         disagg_transfer = run_disagg_transfer()
         megastep_sweep = run_megastep_sweep()
         batch_lane = run_batch_lane()
+        experiment_sweep = run_experiment_sweep()
         streaming = run_streaming()
         final = eng.stats()
     finally:
@@ -1718,6 +1925,7 @@ def main(argv=None):
         "disagg_transfer": disagg_transfer,
         "megastep_sweep": megastep_sweep,
         "batch_lane": batch_lane,
+        "experiment_sweep": experiment_sweep,
         "streaming": streaming,
         "paged": final.get("pages"),
         "decode_recompiles": final["compile"]["recompiles"],

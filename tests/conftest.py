@@ -76,6 +76,11 @@ def pytest_configure(config):
         "markers", "streaming: streaming serving / crash-safe resume "
         "tests — per-token frames, stop sequences, mid-stream "
         "failover (tier-1; select alone with -m streaming)")
+    config.addinivalue_line(
+        "markers", "experiments: experiment-manager tests — durable "
+        "store resume, search policies, generation replay, batch-lane "
+        "scoring, promotion gate (tier-1; select alone with "
+        "-m experiments)")
 
 
 # -- tier-1 wall budget -------------------------------------------------------

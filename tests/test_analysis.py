@@ -2252,6 +2252,9 @@ def test_resource_pairs_registry_honest():
         "job-slots": ("_inflight", "_release_job_slot_locked"),
         # the engine's open streaming-handle set (streaming serving)
         "stream-handles": ("_streams",),
+        # the experiment manager's claimed-trial ledger — claim before
+        # training, pop on durable commit or abort
+        "experiment-trials": ("_claimed",),
     }
     assert set(RESOURCE_PAIRS) == set(backing_fields), \
         "new resource? declare its backing fields here too"

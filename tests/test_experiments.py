@@ -1,0 +1,644 @@
+"""Experiment manager (experiments/ + docs/experiments.md): the durable
+store commits through tmp-fsync-rename and rebuilds progress from trial
+files alone, search policies propose generations bitwise-replayably from
+``(seed, generation)`` with the baseline genome always first, the
+manager drives the full train → select → (hot-swap) loop with
+exactly-once trial training across crash/resume, scoring rides the
+batch lane via ``score_candidates`` (whose error-doc delivery and typed
+sweep timeout are pinned here too), the promotion gate only ships a
+winner that beats the serving baseline by the configured margin, and
+the REST glue + CLI expose the whole thing."""
+
+import json
+import math
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from veles_tpu.config import Config, Range, root
+from veles_tpu.ensemble import SweepTimeout, score_candidates
+from veles_tpu.experiments import (EnsemblePolicy, ExperimentError,
+                                   ExperimentManager, ExperimentStore,
+                                   GeneticPolicy, GridPolicy,
+                                   RandomPolicy, default_scorer,
+                                   handle_experiments_request)
+from veles_tpu.genetics import GeneticOptimizer
+from veles_tpu.runtime import faults
+from veles_tpu.runtime.jobs import JobManager
+
+pytestmark = pytest.mark.experiments
+
+V = 12
+
+
+def _cfg():
+    """The quadratic-over-Ranges search space every GA test uses."""
+    cfg = Config()
+    cfg.model.x = Range(5.0, -10.0, 10.0)
+    cfg.model.y = Range(-3.0, -10.0, 10.0)
+    return cfg
+
+
+def _quad(genome):
+    return ((genome["model.x"] - 2.0) ** 2
+            + (genome["model.y"] - 1.0) ** 2)
+
+
+class _FakeDecision:
+    def __init__(self, best_value):
+        self.best_value = best_value
+
+
+class _FakeTrainer:
+    """Stands in for a real Trainer: deterministic 'training' whose
+    best_value is the quadratic objective of the materialized config —
+    the manager only touches initialize/run/_payload/decision."""
+
+    def __init__(self, value):
+        self.decision = _FakeDecision(float(value))
+        self.seed = None
+
+    def initialize(self, seed=0):
+        self.seed = seed
+
+    def run(self):
+        return {}
+
+    def _payload(self):
+        return {"wstate": {"w": np.zeros(2, np.float32)},
+                "workflow_checksum": "fake"}
+
+
+def _quad_factory(calls=None, delay=0.0):
+    def factory(trial, cfg):
+        if calls is not None:
+            calls.append((trial["generation"], trial["index"]))
+        if delay:
+            time.sleep(delay)
+        return _FakeTrainer((cfg.model.x - 2.0) ** 2
+                            + (cfg.model.y - 1.0) ** 2)
+    return factory
+
+
+def _fake_dispatch(body):
+    prompt = body["prompt"][0]
+    steps = body["steps"]
+    seed = body.get("seed", 0)
+    return 200, {"tokens": [list(prompt)
+                            + [(seed + k) % V for k in range(steps)]]}, ()
+
+
+def _wait_idle(mgr, timeout=60.0):
+    """Block until every drive thread exited (terminal OR crashed)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        with mgr._lock:
+            if not mgr._threads:
+                return
+        time.sleep(0.02)
+    raise TimeoutError("experiment threads still running")
+
+
+# -- durable store -----------------------------------------------------------
+
+def test_store_roundtrip_and_half_created_skip(tmp_path):
+    """Manifests and trial files round-trip through the fsync-rename
+    commits; load_all skips half-created dirs (crash before the first
+    manifest commit) and orders by creation time; load_trials keys by
+    (generation, index)."""
+    store = ExperimentStore(str(tmp_path / "exps"))
+    man = {"id": "e1", "name": "", "state": "running", "created": 5.0,
+           "policy": "genetic", "generations": 2, "population": 4,
+           "seed": 3, "generation": 0, "spec": {}}
+    store.commit_manifest(man)
+    store.commit_trial("e1", {"generation": 0, "index": 2, "seed": 5,
+                              "genome": {"model.x": 1.5},
+                              "status": "trained", "snapshot": None,
+                              "best_value": 0.25})
+    store.commit_trial("e1", {"generation": 1, "index": 0, "seed": 9,
+                              "genome": {}, "status": "failed",
+                              "snapshot": None, "best_value": None,
+                              "error": "boom"})
+    (tmp_path / "exps" / "half-created").mkdir()   # no manifest inside
+    docs = store.load_all()
+    assert [d["id"] for d in docs] == ["e1"]
+    assert docs[0] == man
+    trials = store.load_trials("e1")
+    assert set(trials) == {(0, 2), (1, 0)}
+    assert trials[(0, 2)]["best_value"] == 0.25
+    assert trials[(1, 0)]["error"] == "boom"
+    assert store.read_trial("e1", 0, 1) is None
+    assert store.has_trial("e1", 0, 2)
+
+
+# -- generation replay (the GA seeding contract) -----------------------------
+
+def test_generation_rng_bitwise_replay():
+    """``generation_rng(g)`` is a pure function of ``(seed, g)``: the
+    stream neither depends on how many draws happened before nor on the
+    optimizer instance — the property the resume path leans on."""
+    ga1 = GeneticOptimizer(_cfg(), lambda c: 0.0, seed=7)
+    ga2 = GeneticOptimizer(_cfg(), lambda c: 0.0, seed=7)
+    ga2.rng.random(100)             # perturb the legacy instance stream
+    _ = ga2.generation_rng(0).random(3)     # and draw other generations
+    for g in (0, 1, 5):
+        np.testing.assert_array_equal(ga1.generation_rng(g).random(8),
+                                      ga2.generation_rng(g).random(8))
+    # pinned construction: the stream IS default_rng([seed, g])
+    np.testing.assert_array_equal(
+        ga1.generation_rng(3).random(4),
+        np.random.default_rng([7, 3]).random(4))
+    # different seed or generation = different stream
+    assert not np.array_equal(ga1.generation_rng(1).random(8),
+                              ga1.generation_rng(2).random(8))
+
+
+def test_genetic_policy_generations_replay_bitwise():
+    """A fresh policy replaying the recorded scores re-proposes every
+    generation identically — crash-safe resume needs propose(g) to be a
+    pure function of (seed, g) + observed history."""
+    scores0 = [float(i) for i in range(6)]
+    histories = []
+    for _ in range(2):
+        pol = GeneticPolicy(_cfg(), population=6, generations=3, seed=11)
+        gens = [pol.propose(0)]
+        pol.observe(0, scores0)
+        gens.append(pol.propose(1))
+        pol.observe(1, [_quad(g) for g in gens[1]])
+        gens.append(pol.propose(2))
+        histories.append(gens)
+    assert histories[0] == histories[1]
+    # out-of-order driving is rejected loudly, not silently wrong
+    pol = GeneticPolicy(_cfg(), population=6, generations=3, seed=11)
+    pol.propose(0)
+    with pytest.raises(ValueError, match="observed"):
+        pol.propose(1)
+
+
+def test_policies_baseline_first_and_json_genomes():
+    """Every config-searching policy proposes the BASELINE genome (the
+    config's current values) first at generation 0 — trial (0, 0) is
+    the promotion gate's reference — and every genome is
+    JSON-serializable (trial files commit them)."""
+    baseline = {"model.x": 5.0, "model.y": -3.0}
+    for cls in (GeneticPolicy, RandomPolicy, GridPolicy):
+        pol = cls(_cfg(), population=5, generations=2, seed=4)
+        g0 = pol.propose(0)
+        assert g0[0] == baseline, cls.__name__
+        assert len(g0) == 5
+        for genome in g0:
+            json.loads(json.dumps(genome))
+            cfg = pol.materialize(genome)
+            assert cfg.model.x == genome["model.x"]
+    # grid + random are deterministic replays too (observe is a no-op)
+    for cls in (RandomPolicy, GridPolicy):
+        a, b = (cls(_cfg(), population=5, generations=2, seed=4)
+                for _ in range(2))
+        for g in range(2):
+            assert a.propose(g) == b.propose(g)
+            a.observe(g, [0.0] * 5)
+            b.observe(g, [0.0] * 5)
+    # the ensemble degenerate case: one generation of identical empty
+    # genomes, dedup intentionally off (trials differ by seed only)
+    pol = EnsemblePolicy(None, population=3)
+    assert pol.propose(0) == [{}, {}, {}]
+    assert pol.n_generations == 1 and EnsemblePolicy.dedup is False
+
+
+# -- score_candidates hardening (the sweep the manager leans on) -------------
+
+def test_score_candidates_error_docs_reach_scorer(tmp_path):
+    """A permanent per-prompt failure arrives at the scorer as that
+    prompt's committed {"index", "error"} doc, in prompt order, with
+    the window complete — never a silently shorter (misaligned) doc
+    list — and default_scorer turns any error into inf."""
+    def dispatch(body):
+        if body["prompt"][0][0] == 9:      # the replica rejects this
+            return 400, {"error": "kaput"}, ()    # prompt permanently
+        return _fake_dispatch(body)
+
+    mgr = JobManager(str(tmp_path / "jobs"), dispatch, workers=2,
+                     retry_s=0.01).start()
+    seen = {}
+    try:
+        def scorer(cand, docs):
+            seen[cand["name"]] = docs
+            return default_scorer(
+                {"trial": {"best_value": 1.0}}, docs)
+
+        out = score_candidates(
+            mgr,
+            [{"name": "ok", "prompts": [[1, 2], [3, 4]]},
+             {"name": "bad", "prompts": [[9, 9], [5, 6]]}],
+            scorer, steps=3, seed=0, timeout_s=60.0)
+    finally:
+        mgr.stop()
+    assert [o["name"] for o in out] == ["ok", "bad"]
+    assert out[0]["score"] == 1.0
+    assert out[1]["score"] == math.inf
+    # complete, ordered windows: flat indices 0-1 and 2-3
+    assert [d["index"] for d in seen["ok"]] == [0, 1]
+    assert [d["index"] for d in seen["bad"]] == [2, 3]
+    assert all("tokens" in d for d in seen["ok"])
+    assert seen["bad"][0]["error"] == "kaput"
+    assert "tokens" in seen["bad"][1]
+
+
+def test_score_candidates_timeout_raises_typed_error(tmp_path):
+    """A sweep whose job never terminates raises SweepTimeout carrying
+    the job id (machine-readable AND in the message) — the unattended
+    manager can cancel/resume the exact job instead of string-parsing."""
+    gate = threading.Event()
+
+    def dispatch(body):
+        gate.wait(timeout=30.0)
+        return _fake_dispatch(body)
+
+    mgr = JobManager(str(tmp_path / "jobs"), dispatch, workers=1,
+                     retry_s=0.01).start()
+    try:
+        with pytest.raises(SweepTimeout) as ei:
+            score_candidates(
+                mgr, [{"name": "c", "prompts": [[1, 2]]}],
+                lambda c, d: 0.0, steps=2, timeout_s=0.3)
+        err = ei.value
+        assert isinstance(err, TimeoutError)
+        assert err.job_id and err.job_id in str(err)
+        assert err.timeout_s == 0.3
+        assert mgr.status(err.job_id)["id"] == err.job_id
+    finally:
+        gate.set()
+        mgr.stop()
+
+
+# -- the manager's autonomous loop -------------------------------------------
+
+def _spec(**kw):
+    spec = {"policy": "genetic", "generations": 2, "population": 4,
+            "seed": 3}
+    spec.update(kw)
+    return spec
+
+
+def test_manager_end_to_end_loop_scores_on_batch_lane(tmp_path):
+    """The full loop in miniature: 2 generations x 4 trials train
+    through the trial factory, every trained trial is scored through
+    ONE batch job per generation (score_candidates via JobManager),
+    the winner beats the baseline and ships through the promotion
+    hook, and the durable store ends with every trial scored."""
+    swaps = []
+
+    def promote(snapshot):
+        swaps.append(snapshot)
+        return {"swapped": True, "phase": "commit"}
+
+    def scorer(cand, docs):
+        assert docs and all("tokens" in d for d in docs)
+        return float(cand["trial"]["best_value"])
+
+    jobs = JobManager(str(tmp_path / "jobs"), _fake_dispatch,
+                      workers=2, retry_s=0.01).start()
+    mgr = ExperimentManager(
+        str(tmp_path / "exps"), _quad_factory(), config=_cfg(),
+        jobs=jobs, promote=promote, scorer=scorer,
+        eval_prompts=[[1, 2, 3], [4, 5]], promote_margin=0.0)
+    try:
+        doc = mgr.submit(_spec())
+        eid = doc["id"]
+        assert doc["state"] == "running"
+        assert mgr.wait(eid, timeout_s=120.0)
+        st = mgr.status(eid)
+    finally:
+        mgr.stop()
+        jobs.stop()
+    assert st["state"] == "done", st
+    assert st["baseline_score"] == pytest.approx(25.0)  # (5-2)^2+(-3-1)^2
+    assert st["best"]["score"] < 25.0
+    assert st["promotion"]["promoted"] is True
+    assert swaps == [st["best"]["snapshot"]]
+    # the store is the record: every non-failed trial carries a score
+    # and a scored trial names the batch job that produced it
+    store = ExperimentStore(str(tmp_path / "exps"))
+    trials = store.load_trials(eid)
+    assert len(trials) == 8
+    for t in trials.values():
+        if t["status"] == "scored":
+            assert t["job_id"]
+        if t["status"] != "failed":
+            assert t.get("score") is not None
+    # summary feeds /fleet.json
+    s = mgr.summary()
+    assert s["total"] == 1 and s["by_state"] == {"done": 1}
+    assert s["trials"] == 8 and s["trials_inflight"] == 0
+
+
+def test_manager_promotion_gate_margin_and_baseline(tmp_path):
+    """The gate holds: a winner inside the margin does NOT swap; the
+    baseline winning outright does NOT swap; and the losing experiment
+    still completes with the reason recorded."""
+    swaps = []
+
+    def promote(snapshot):
+        swaps.append(snapshot)
+        return {"swapped": True}
+
+    mgr = ExperimentManager(
+        str(tmp_path / "exps"), _quad_factory(), config=_cfg(),
+        promote=promote, promote_margin=1e9)   # nothing can clear this
+    try:
+        eid = mgr.submit(_spec())["id"]
+        assert mgr.wait(eid, timeout_s=60.0)
+        st = mgr.status(eid)
+    finally:
+        mgr.stop()
+    assert st["state"] == "done"
+    assert st["promotion"]["promoted"] is False
+    assert "promote_margin" in st["promotion"]["reason"]
+    assert swaps == []
+
+
+def test_manager_failed_trial_scores_inf_not_experiment_failure(tmp_path):
+    """One genome whose training blows up becomes a failed TRIAL scored
+    inf — the experiment completes and the winner comes from the
+    survivors."""
+    def factory(trial, cfg):
+        if trial["generation"] == 0 and trial["index"] == 1:
+            raise RuntimeError("divergence injected")
+        return _FakeTrainer((cfg.model.x - 2.0) ** 2
+                            + (cfg.model.y - 1.0) ** 2)
+
+    mgr = ExperimentManager(str(tmp_path / "exps"), factory,
+                            config=_cfg())
+    try:
+        eid = mgr.submit(_spec(generations=1))["id"]
+        assert mgr.wait(eid, timeout_s=60.0)
+        st = mgr.status(eid)
+        failed = ExperimentStore(
+            str(tmp_path / "exps")).read_trial(eid, 0, 1)
+    finally:
+        mgr.stop()
+    assert st["state"] == "done"
+    assert st["trials"]["failed"] == 1
+    assert failed["status"] == "failed"
+    assert "divergence" in failed["error"]
+    assert st["best"]["score"] < math.inf
+
+
+def test_manager_crash_resume_never_retrains_and_same_winner(tmp_path):
+    """THE resume contract: the ``trial_crash_at_step`` fault kills the
+    manager mid-generation-1 (after the claim, before any commit); the
+    experiment stays ``running`` on disk with no terminal state; a
+    FRESH manager over the same store resumes it — no committed trial
+    ever retrains (exactly-once per (gen, idx) across both lives), the
+    killed trial restarts from its deterministic seed, and the final
+    winner is identical to an undisturbed run's."""
+    calls = []
+    spec = _spec(population=6)
+    try:
+        # launch 6 = the LAST generation-0 trial: the claim lands, no
+        # commit does — mid-generation death by construction
+        faults.configure(trial_crash_at_step=6)
+        m1 = ExperimentManager(str(tmp_path / "exps"),
+                               _quad_factory(calls), config=_cfg())
+        eid = m1.submit(spec)["id"]
+        _wait_idle(m1)                  # the drive thread died injected
+    finally:
+        faults.reset()
+    # no terminal state written: disk still says running, resumable
+    store = ExperimentStore(str(tmp_path / "exps"))
+    assert store.read_manifest(eid)["state"] == "running"
+    done_before = set(store.load_trials(eid))
+    assert done_before == {(0, i) for i in range(5)}
+    assert m1.summary()["trials_inflight"] == 0
+
+    m2 = ExperimentManager(str(tmp_path / "exps"),
+                           _quad_factory(calls), config=_cfg())
+    try:
+        m2.start()
+        assert m2.wait(eid, timeout_s=60.0)
+        st = m2.status(eid)
+    finally:
+        m2.stop()
+    assert st["state"] == "done"
+    # exactly-once: no (gen, idx) trained twice across both managers,
+    # and none of the pre-crash committed trials re-ran
+    assert len(calls) == len(set(calls)), calls
+    assert not (set(calls[len(done_before):]) & done_before)
+
+    # the undisturbed control run lands on the identical winner
+    m3 = ExperimentManager(str(tmp_path / "ctl"), _quad_factory(),
+                           config=_cfg())
+    try:
+        cid = m3.submit(spec)["id"]
+        assert m3.wait(cid, timeout_s=60.0)
+        ctl = m3.status(cid)
+    finally:
+        m3.stop()
+    assert st["best"]["genome"] == ctl["best"]["genome"]
+    assert st["best"]["score"] == ctl["best"]["score"]
+
+
+def test_manager_rejects_store_from_different_history(tmp_path):
+    """A committed trial whose genome contradicts the deterministic
+    replay fails the experiment loudly — never silently mixes two
+    histories."""
+    spec = _spec(generations=1)
+    store = ExperimentStore(str(tmp_path / "exps"))
+    m1 = ExperimentManager(str(tmp_path / "exps"), _quad_factory(),
+                           config=_cfg())
+    try:
+        eid = m1.submit(spec)["id"]
+        assert m1.wait(eid, timeout_s=60.0)
+    finally:
+        m1.stop()
+    # tamper: rewrite trial (0,1) with a foreign genome, reopen running
+    t = store.read_trial(eid, 0, 1)
+    t["genome"] = {"model.x": 123.0, "model.y": 123.0}
+    store.commit_trial(eid, t)
+    man = store.read_manifest(eid)
+    man["state"] = "running"
+    store.commit_manifest(man)
+    m2 = ExperimentManager(str(tmp_path / "exps"), _quad_factory(),
+                           config=_cfg())
+    try:
+        m2.start()
+        assert m2.wait(eid, timeout_s=60.0)
+        st = m2.status(eid)
+    finally:
+        m2.stop()
+    assert st["state"] == "failed"
+    assert "different histories" in st["error"]
+
+
+def test_manager_cancel_sweeps_claims_and_is_terminal(tmp_path):
+    """DELETE semantics: cancel marks the experiment terminal on disk,
+    the in-flight trial finishes (completed work is never thrown away),
+    the claim ledger drains, and the drive thread exits."""
+    started = threading.Event()
+
+    def factory(trial, cfg):
+        started.set()
+        time.sleep(0.1)
+        return _FakeTrainer(1.0)
+
+    mgr = ExperimentManager(str(tmp_path / "exps"), factory,
+                            config=_cfg())
+    try:
+        eid = mgr.submit(_spec(generations=4, population=4))["id"]
+        assert started.wait(timeout=30.0)
+        st = mgr.cancel(eid)
+        assert st["state"] == "cancelled"
+        _wait_idle(mgr)
+        assert mgr.summary()["trials_inflight"] == 0
+        # terminal on disk too; a successor manager does NOT resume it
+        disk = ExperimentStore(
+            str(tmp_path / "exps")).read_manifest(eid)
+        assert disk["state"] == "cancelled"
+        assert mgr.cancel(eid)["state"] == "cancelled"   # idempotent
+    finally:
+        mgr.stop()
+
+
+def test_ensemble_policy_trials_differ_only_by_seed(tmp_path):
+    """The EnsembleTrainer degenerate case: one generation, shared
+    empty genome, every member trains (dedup off) with its own derived
+    seed — the winner is the best member."""
+    seeds = []
+
+    def factory(trial, cfg):
+        seeds.append(trial["seed"])
+        return _FakeTrainer(float(trial["index"] + 1))
+
+    mgr = ExperimentManager(str(tmp_path / "exps"), factory,
+                            config=None, promote=None)
+    try:
+        eid = mgr.submit({"policy": "ensemble", "population": 3,
+                          "seed": 10})["id"]
+        assert mgr.wait(eid, timeout_s=60.0)
+        st = mgr.status(eid)
+    finally:
+        mgr.stop()
+    assert st["state"] == "done"
+    assert st["trials"] == {"total": 3, "scored": 3}
+    assert len(set(seeds)) == 3          # every member trained, own seed
+    assert st["best"]["index"] == 0
+
+
+def test_ga_elites_become_cached_trials_not_retrained(tmp_path):
+    """Dedup: a genome re-proposed in a later generation (the GA elite)
+    commits as a ``cached`` trial pointing at its source — the factory
+    never re-runs it and its score resolves from the source."""
+    calls = []
+    mgr = ExperimentManager(str(tmp_path / "exps"),
+                            _quad_factory(calls), config=_cfg())
+    try:
+        eid = mgr.submit(_spec(generations=3, population=6))["id"]
+        assert mgr.wait(eid, timeout_s=120.0)
+    finally:
+        mgr.stop()
+    trials = ExperimentStore(str(tmp_path / "exps")).load_trials(eid)
+    cached = [t for t in trials.values() if t["status"] == "cached"]
+    assert cached, "3 generations of GA must carry at least one elite"
+    assert len(calls) == len(set(calls))
+    for t in cached:
+        src = tuple(t["cached_from"])
+        assert trials[src]["genome"] == t["genome"]
+        assert t["score"] == trials[src]["score"]
+        assert (t["generation"], t["index"]) not in calls
+
+
+# -- spec validation + REST glue ---------------------------------------------
+
+def test_submit_validation_rejects_bad_specs(tmp_path):
+    mgr = ExperimentManager(str(tmp_path / "exps"), _quad_factory(),
+                            config=_cfg())
+    try:
+        with pytest.raises(ExperimentError, match="unknown experiment"):
+            mgr.submit({"populaton": 8})
+        with pytest.raises(ExperimentError, match="unknown policy"):
+            mgr.submit({"policy": "simulated-annealing"})
+        with pytest.raises(ExperimentError, match=">= 1"):
+            mgr.submit({"generations": 0})
+        with pytest.raises(ExperimentError, match="eval_prompts"):
+            mgr.submit({"eval_prompts": [[]]})
+        no_factory = ExperimentManager(str(tmp_path / "e2"),
+                                       config=_cfg())
+        with pytest.raises(ExperimentError, match="cannot launch"):
+            no_factory.submit({})
+        with pytest.raises(ExperimentError, match="needs a base config"):
+            ExperimentManager(str(tmp_path / "e3"),
+                              _quad_factory()).submit({})
+    finally:
+        mgr.stop()
+    # no store at all fails loudly at construction, not first use
+    prev = root.common.experiment.dir
+    root.common.experiment.dir = ""
+    try:
+        with pytest.raises(ExperimentError, match="no experiment store"):
+            ExperimentManager()
+    finally:
+        root.common.experiment.dir = prev
+
+
+def test_rest_glue_routes_and_errors(tmp_path):
+    """The shared /experiments* glue: config-hinting 404 with no
+    manager, non-experiment paths fall through as None, submit/list/
+    status/cancel round-trip, unknown ids 404, bad specs 400."""
+    assert handle_experiments_request(None, "GET", "/jobs", None) is None
+    status, doc = handle_experiments_request(None, "GET",
+                                             "/experiments", None)
+    assert status == 404 and "experiment.dir" in doc["error"]
+
+    mgr = ExperimentManager(str(tmp_path / "exps"), _quad_factory(),
+                            config=_cfg())
+    try:
+        status, doc = handle_experiments_request(
+            mgr, "POST", "/experiments", _spec(generations=1))
+        assert status == 200
+        eid = doc["id"]
+        status, lst = handle_experiments_request(
+            mgr, "GET", "/experiments", None)
+        assert status == 200
+        assert [e["id"] for e in lst["experiments"]] == [eid]
+        status, one = handle_experiments_request(
+            mgr, "GET", f"/experiments/{eid}", None)
+        assert status == 200 and one["id"] == eid
+        status, doc = handle_experiments_request(
+            mgr, "GET", "/experiments/nope", None)
+        assert status == 404
+        status, doc = handle_experiments_request(
+            mgr, "POST", "/experiments", {"policy": "nah"})
+        assert status == 400 and "unknown policy" in doc["error"]
+        status, doc = handle_experiments_request(
+            mgr, "DELETE", f"/experiments/{eid}", None)
+        assert status == 200 and doc["state"] in ("cancelled", "done")
+        status, doc = handle_experiments_request(
+            mgr, "PUT", f"/experiments/{eid}/x/y", None)
+        assert status == 404
+    finally:
+        mgr.stop()
+
+
+def test_cli_experiment_list_and_status(tmp_path, capsys):
+    """``python -m veles_tpu experiment list|status`` reads the durable
+    store directly (no live manager) and prints JSON."""
+    from veles_tpu.__main__ import main
+    mgr = ExperimentManager(str(tmp_path / "exps"), _quad_factory(),
+                            config=_cfg())
+    try:
+        eid = mgr.submit(_spec(generations=1))["id"]
+        assert mgr.wait(eid, timeout_s=60.0)
+    finally:
+        mgr.stop()
+    assert main(["experiment", "list", str(tmp_path / "exps")]) == 0
+    listing = json.loads(capsys.readouterr().out)
+    assert [e["id"] for e in listing["experiments"]] == [eid]
+    assert main(["experiment", "status", str(tmp_path / "exps"),
+                 eid]) == 0
+    st = json.loads(capsys.readouterr().out)
+    assert st["state"] == "done" and len(st["trials"]) == 4
+    assert main(["experiment", "status", str(tmp_path / "exps"),
+                 "nope"]) == 1
+    assert "no such experiment" in capsys.readouterr().out

@@ -675,6 +675,70 @@ def _serve_artifact(args) -> int:
                            boot_source=str(args.artifact))
 
 
+def _experiment_main(argv) -> int:
+    """``python -m veles_tpu experiment <action>``: inspect or cancel
+    experiments in a durable store (docs/experiments.md).  ``list`` and
+    ``status`` read the store directly (no running manager needed —
+    trial files ARE the progress record); ``cancel`` and ``submit``
+    need a live manager and go through its REST surface (``--server``),
+    because only the owning process can drive or stop trials."""
+    p = argparse.ArgumentParser(prog="veles_tpu experiment")
+    sub = p.add_subparsers(dest="action", required=True)
+    for act in ("list", "status"):
+        sp = sub.add_parser(act)
+        sp.add_argument("store_dir")
+        if act == "status":
+            sp.add_argument("id")
+    sp = sub.add_parser("submit")
+    sp.add_argument("--server", "-s", required=True,
+                    help="fleet/replica base URL serving /experiments")
+    sp.add_argument("spec", help="experiment spec JSON file or inline "
+                                 "JSON object")
+    sp = sub.add_parser("cancel")
+    sp.add_argument("--server", "-s", required=True)
+    sp.add_argument("id")
+    a = p.parse_args(argv)
+
+    from .experiments import ExperimentStore
+    if a.action == "list":
+        store = ExperimentStore(a.store_dir)
+        print(json.dumps({"experiments": store.load_all()}, indent=1))
+        return 0
+    if a.action == "status":
+        store = ExperimentStore(a.store_dir)
+        man = store.read_manifest(a.id)
+        if man is None:
+            print(json.dumps({"error": f"no such experiment: {a.id}"}))
+            return 1
+        trials = store.load_trials(a.id)
+        man["trials"] = [trials[k] for k in sorted(trials)]
+        print(json.dumps(man, indent=1))
+        return 0
+    import urllib.request
+    base = a.server.rstrip("/")
+    if a.action == "submit":
+        import os
+        if os.path.exists(a.spec):
+            with open(a.spec) as f:
+                spec = json.load(f)
+        else:
+            spec = json.loads(a.spec)
+        req = urllib.request.Request(
+            f"{base}/experiments", method="POST",
+            data=json.dumps(spec).encode(),
+            headers={"Content-Type": "application/json"})
+    else:
+        req = urllib.request.Request(
+            f"{base}/experiments/{a.id}", method="DELETE")
+    try:
+        with urllib.request.urlopen(req) as resp:
+            print(json.dumps(json.load(resp), indent=1))
+        return 0
+    except urllib.error.HTTPError as e:
+        print(e.read().decode())
+        return 1
+
+
 def main(argv=None) -> int:
     if argv is None:
         argv = sys.argv[1:]
@@ -742,6 +806,9 @@ def main(argv=None) -> int:
     if argv and argv[0] == "forge":
         setup_logging()
         return _forge_main(argv[1:])
+    if argv and argv[0] == "experiment":
+        setup_logging()
+        return _experiment_main(argv[1:])
     if "--frontend" in argv:
         # reference: veles --frontend web form -> composed cmdline
         # (veles/__main__.py:258-332)

@@ -277,6 +277,24 @@ RESOURCE_PAIRS = {
         "exit_roots": {"runtime/jobs.py": (
             "JobManager.cancel", "JobManager.stop")},
     },
+    # The experiment manager's claimed-trial ledger
+    # (experiments/manager.py, docs/experiments.md): every trial claims
+    # a ``_claimed`` entry before any training work and MUST either
+    # commit its durable doc (which pops the claim) or abort the claim
+    # on every failure edge — a leaked entry overstates
+    # vt_experiment summary inflight and marks a trial as eternally
+    # in-progress for successor processes.  Cancel and drain are the
+    # exit roots: both must provably sweep the ledger.
+    "experiment-trials": {
+        "acquire": {"experiments/manager.py": (
+            "ExperimentManager._claim_trial",)},
+        "release": {"experiments/manager.py": (
+            "ExperimentManager._commit_trial",
+            "ExperimentManager._abort_trial")},
+        "exit_roots": {"experiments/manager.py": (
+            "ExperimentManager.cancel",
+            "ExperimentManager.stop")},
+    },
 }
 
 #: modules whose file writes are durability-critical (sealed artifacts,
@@ -285,6 +303,7 @@ RESOURCE_PAIRS = {
 #: that a reader trusts.  Fixture syntax: ``# durable-write:`` on the
 #: ``def`` line marks one function outside these modules.
 DURABLE_WRITE_MODULES = (
+    "experiments/store.py",
     "export/compiled.py",
     "export/package.py",
     "runtime/jobs.py",

@@ -467,6 +467,21 @@ def _defaults():
     root.common.serve.drain_grace_s = 2.0    # min /ready-503 hold on drain
     root.common.serve.watch_interval_s = 5.0  # snapshot watcher poll period
     root.common.serve.watch_backoff_max_s = 300.0  # watcher retry ceiling
+    # Experiment manager (experiments/, docs/experiments.md): the
+    # autonomous train -> select -> hot-swap loop.
+    root.common.experiment.dir = ""          # durable experiment store
+    #                                          root ("" = API off)
+    root.common.experiment.generations = 4   # default search generations
+    root.common.experiment.population = 8    # default trials/generation
+    root.common.experiment.workers = 1       # >1 + cli_argv: parallel
+    #                                          trial subprocess pool
+    root.common.experiment.promote_margin = 0.0  # score improvement over
+    #                                              the baseline a winner
+    #                                              must exceed to swap
+    root.common.experiment.eval_steps = 8    # decode steps per eval
+    #                                          prompt in the scoring sweep
+    root.common.experiment.eval_timeout_s = 300.0  # batch-lane sweep
+    #                                                wait deadline
 
 
 _defaults()

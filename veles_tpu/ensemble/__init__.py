@@ -1,2 +1,2 @@
 from .driver import EnsembleTrainer, EnsembleTester
-from .scoring import score_candidates
+from .scoring import SweepTimeout, score_candidates

@@ -17,6 +17,23 @@ from __future__ import annotations
 from typing import Callable, List, Optional, Sequence
 
 
+class SweepTimeout(TimeoutError):
+    """An ensemble sweep's job did not reach a terminal state in time.
+
+    Carries ``job_id`` so unattended callers (the experiment manager)
+    can cancel or resume the exact job instead of string-parsing the
+    message — the job itself keeps running and its committed results
+    remain resumable."""
+
+    def __init__(self, job_id: str, timeout_s: float, status: dict):
+        super().__init__(
+            f"ensemble sweep job {job_id} not terminal after "
+            f"{timeout_s}s: {status}")
+        self.job_id = job_id
+        self.timeout_s = timeout_s
+        self.status = status
+
+
 def score_candidates(jobs, candidates: Sequence[dict],
                      scorer: Callable[[dict, List[dict]], float], *,
                      steps: int = 8, seed: int = 0,
@@ -58,9 +75,7 @@ def score_candidates(jobs, candidates: Sequence[dict],
     doc = jobs.submit(spec)
     job_id = doc["id"]
     if not jobs.wait(job_id, timeout_s=timeout_s):
-        raise TimeoutError(
-            f"ensemble sweep job {job_id} not terminal after "
-            f"{timeout_s}s: {jobs.status(job_id)}")
+        raise SweepTimeout(job_id, timeout_s, jobs.status(job_id))
     by_idx = {}
     offset = 0
     while True:
@@ -72,8 +87,14 @@ def score_candidates(jobs, candidates: Sequence[dict],
         offset = page["next_offset"]
     out: List[dict] = []
     for ci, cand in enumerate(candidates):
-        docs = [by_idx[i] for i in range(bounds[ci], bounds[ci + 1])
-                if i in by_idx]
+        # exactly one doc per prompt, in prompt order: permanent per-prompt
+        # failures arrive as the store's committed {"index", "error"} docs,
+        # and any index with no committed result at all (job cancelled
+        # mid-flight) becomes a synthesized error doc — the scorer sees a
+        # deterministic, complete window either way instead of a silently
+        # shorter (misaligned) list poisoning the sweep.
+        docs = [by_idx.get(i, {"index": i, "error": "no committed result"})
+                for i in range(bounds[ci], bounds[ci + 1])]
         out.append({"name": cand.get("name", str(ci)),
                     "score": float(scorer(cand, docs)),
                     "n_prompts": bounds[ci + 1] - bounds[ci],

@@ -1,0 +1,9 @@
+"""Fleet-scale experiment manager: train → select → hot-swap with no
+human in the loop (docs/experiments.md)."""
+
+from .manager import (ExperimentError, ExperimentManager,
+                      default_scorer, fleet_promoter,
+                      handle_experiments_request)
+from .policies import (POLICIES, EnsemblePolicy, GeneticPolicy,
+                       GridPolicy, RandomPolicy, SearchPolicy)
+from .store import ExperimentStore

@@ -1,0 +1,909 @@
+"""The autonomous optimization loop: train → select → hot-swap.
+
+:class:`ExperimentManager` closes the loop the rest of the runtime only
+provides pieces of (ROADMAP "fleet-scale experiment manager"): a search
+policy (``policies.py``) mints trial configs over the base config's
+``Range`` tuneables; each trial is a short training run through
+:class:`~veles_tpu.runtime.trainer.Trainer` + the snapshotter; trained
+candidates are scored **on the serving fleet** through the batch lane
+(:func:`~veles_tpu.ensemble.score_candidates` via ``JobManager``), so
+evaluation consumes only slot/SLO headroom and interactive p99 is
+untouched; the winner ships through the fleet's two-phase coordinated
+swap, gated by an improvement margin over the baseline — all with no
+human in the loop.
+
+Durability is the same contract as the batch lane.  Experiment state
+lives in an :class:`~.store.ExperimentStore` (fsync-rename commits):
+the manifest records spec + coarse state, one file per finished trial
+records seed/genome/snapshot/score.  A crashed or drained manager
+resumes mid-generation — ``start()`` relaunches every non-terminal
+experiment, the drive loop re-proposes each generation (policies are
+deterministic from ``(seed, generation)`` + observed scores, the PR's
+``generation_rng`` contract), committed trials are never re-run, and
+interrupted ones restart from their deterministic per-trial seeds.
+Genomes found in committed trials are verified against the replay — a
+store that does not match its seed fails loudly instead of silently
+mixing two histories.
+
+Trials being materialized register in the ``_claimed`` ledger (the
+``experiment-trials`` resource the VR701 pairing rule tracks): claim
+before any work, release on commit (:meth:`_commit_trial`) or abort
+(:meth:`_abort_trial`), with ``cancel`` and ``stop`` sweeping leftovers.
+
+REST surface (fleet server and single replica): ``POST /experiments``
+submit → ``GET /experiments/<id>`` status, ``GET /experiments`` list,
+``DELETE /experiments/<id>`` cancel; the fleet merges
+:meth:`ExperimentManager.summary` into ``/fleet.json``.  See
+docs/experiments.md for the loop anatomy and failure semantics.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import threading
+import time
+import uuid
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..config import Config, root
+from ..ensemble.scoring import score_candidates
+from ..logger import Logger
+from ..runtime import faults
+from ..runtime.metrics import registry
+from ..runtime.snapshotter import Snapshotter
+from .policies import POLICIES, SearchPolicy
+from .store import ExperimentStore
+
+#: spec keys a ``POST /experiments`` body may carry (anything else is a
+#: 400 — a typoed ``"populaton"`` must not silently run the default).
+_SPEC_KEYS = frozenset({
+    "policy", "generations", "population", "seed", "name",
+    "eval_prompts", "eval_steps", "eval_seed", "promote",
+})
+
+#: terminal experiment states (the drive thread is gone).
+_TERMINAL = ("done", "failed", "cancelled")
+
+
+class ExperimentError(ValueError):
+    """Malformed experiment spec, unusable manager wiring, or a store
+    that contradicts its deterministic replay (the REST 400 path)."""
+
+
+class _Cancelled(Exception):
+    """Internal unwind for cancel()/stop(): the drive thread exits
+    between trials without writing a terminal state itself — cancel()
+    already committed ``cancelled``, and stop() deliberately leaves
+    ``running`` on disk for a successor manager to resume."""
+
+
+def default_scorer(candidate: dict, docs: List[dict]) -> float:
+    """Train-metric scoring with serving-side disqualification: the
+    score is the trial's ``best_value`` (lower = better, the Decision's
+    stopping metric), but any per-prompt ``error`` doc in the sweep —
+    the candidate's snapshot failed to serve its eval prompts — scores
+    ``inf`` so a candidate that trains well but cannot serve never
+    wins.  Replace via the manager's ``scorer=`` hook to score from the
+    generated tokens themselves."""
+    if any("error" in d for d in docs):
+        return math.inf
+    bv = (candidate.get("trial") or {}).get("best_value")
+    return float(bv) if bv is not None else math.inf
+
+
+def fleet_promoter(router) -> Callable[[str], dict]:
+    """Promotion hook wrapping the fleet's two-phase coordinated swap:
+    stage the winner's snapshot on every active replica, commit only
+    when all staged, roll back on any failure — the returned dict's
+    ``swapped`` False means the old version is still serving
+    everywhere (the swap's own atomicity guarantee)."""
+    def _promote(snapshot_path: str) -> dict:
+        return router.coordinated_swap(source=snapshot_path)
+    return _promote
+
+
+def _genome_key(genome: dict) -> str:
+    return json.dumps(genome, sort_keys=True)
+
+
+class ExperimentManager(Logger):
+    """Drives experiments end to end (one daemon thread per experiment).
+
+    ``trial_factory(trial, config) -> Trainer`` builds one trial's
+    training run from the materialized config; ``trial`` is a dict of
+    ``{"experiment", "generation", "index", "seed", "genome",
+    "out_dir"}`` — factories typically derive the data subset and any
+    member identity from ``seed``.  With ``cli_argv`` + ``workers > 1``
+    trials instead run as standalone CLI trainings on a bounded
+    subprocess pool (genome injected as inline ``path=value``
+    overrides, the :class:`~veles_tpu.genetics.SubprocessEvaluator`
+    shape); in-process sequential is the default — one training already
+    fills the device mesh.
+
+    ``jobs`` (a started :class:`~veles_tpu.runtime.jobs.JobManager`)
+    plus ``eval_prompts`` turn scoring into a batch-lane sweep on the
+    serving fleet; without them, scores fall back to the trials' own
+    ``best_value``.  ``promote`` is the promotion hook
+    (:func:`fleet_promoter`); None records the winner without swapping.
+    """
+
+    def __init__(self, store_dir: Optional[str] = None,
+                 trial_factory: Optional[Callable] = None, *,
+                 config: Optional[Config] = None,
+                 policy_factory: Optional[Callable] = None,
+                 jobs=None,
+                 promote: Optional[Callable[[str], dict]] = None,
+                 scorer: Optional[Callable] = None,
+                 eval_prompts: Optional[List[List[int]]] = None,
+                 workers: Optional[int] = None,
+                 promote_margin: Optional[float] = None,
+                 eval_timeout_s: Optional[float] = None,
+                 cli_argv: Optional[List[str]] = None,
+                 env: Optional[Dict[str, str]] = None):
+        exp_cfg = root.common.experiment
+        if store_dir is None:
+            store_dir = str(exp_cfg.get("dir", "") or "")
+            if not store_dir:
+                raise ExperimentError(
+                    "no experiment store: pass store_dir or set "
+                    "root.common.experiment.dir")
+        self._store = ExperimentStore(store_dir)
+        self.trial_factory = trial_factory
+        self.config = config
+        self.policy_factory = policy_factory
+        self.jobs = jobs
+        self.promote_fn = promote
+        self.scorer = default_scorer if scorer is None else scorer
+        self.eval_prompts = eval_prompts
+        self.workers = max(1, int(exp_cfg.get("workers", 1)
+                                  if workers is None else workers))
+        self.promote_margin = float(
+            exp_cfg.get("promote_margin", 0.0)
+            if promote_margin is None else promote_margin)
+        self.eval_timeout_s = float(
+            exp_cfg.get("eval_timeout_s", 300.0)
+            if eval_timeout_s is None else eval_timeout_s)
+        self.cli_argv = list(cli_argv) if cli_argv is not None else None
+        self.env = env
+        self._lock = threading.Lock()
+        self._exps: Dict[str, dict] = {}        # guarded-by: self._lock
+        self._trials: Dict[str, Dict[Tuple[int, int], dict]] = {}  # guarded-by: self._lock
+        self._claimed: Dict[Tuple[str, int, int], float] = {}  # guarded-by: self._lock
+        self._threads: Dict[str, threading.Thread] = {}  # guarded-by: self._lock
+        self._cancelled: set = set()            # guarded-by: self._lock
+        self._counts = {"submitted": 0, "completed": 0, "failed": 0,
+                        "cancelled": 0}         # guarded-by: self._lock
+        self._trial_launches = 0                # guarded-by: self._lock
+        self._stop_evt = threading.Event()
+        reg = registry()
+        self._m_submitted = reg.counter(
+            "vt_experiments_submitted_total", "experiments accepted by "
+            "POST /experiments (resumed-from-disk ones not re-counted)")
+        self._m_completed = reg.counter(
+            "vt_experiments_completed_total",
+            "experiments that ran their full loop to the done state")
+        self._m_trials = reg.counter(
+            "vt_experiment_trials_total", "trials actually trained "
+            "(committed through the claim ledger, incl. failed ones)")
+        self._m_trials_cached = reg.counter(
+            "vt_experiment_trials_cached_total", "trials satisfied from "
+            "an earlier identical genome (GA elites) without retraining")
+        self._m_promotions = reg.counter(
+            "vt_experiment_promotions_total", "winners committed to the "
+            "fleet via the two-phase coordinated swap")
+        self._m_promote_failures = reg.counter(
+            "vt_experiment_promote_failures_total", "promotion attempts "
+            "whose swap failed or rolled back (old version kept serving)")
+        self._g_running = reg.gauge(
+            "vt_experiment_running",
+            "experiments currently in the running state")
+        self._g_best = reg.gauge(
+            "vt_experiment_best_score",
+            "best (lowest) candidate score of the most recently "
+            "finished experiment")
+        # crash/drain resume: reload every persisted experiment; the
+        # non-terminal ones relaunch on start()
+        for man in self._store.load_all():
+            self._exps[man["id"]] = man
+            self._trials[man["id"]] = self._store.load_trials(man["id"])
+        self._g_running.set(sum(
+            1 for e in self._exps.values() if e["state"] == "running"))
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "ExperimentManager":
+        """Relaunch every persisted non-terminal experiment — the
+        crashed/drained-manager resume path.  Completed trials are
+        never re-run: each generation is re-proposed deterministically
+        and matched against the committed trial files."""
+        self._stop_evt.clear()
+        with self._lock:
+            resumable = [eid for eid, e in self._exps.items()
+                         if e["state"] not in _TERMINAL
+                         and eid not in self._threads]
+        for eid in resumable:
+            self.info("resuming experiment %s", eid)
+            self._spawn(eid)
+        return self
+
+    def stop(self):
+        """Drain: stop driving, leave every running experiment's state
+        ``running`` on disk — a successor manager (or this one after
+        ``start()``) resumes from exactly the committed trials."""
+        self._stop_evt.set()
+        with self._lock:
+            threads = list(self._threads.values())
+        for t in threads:
+            t.join(timeout=10.0)
+        with self._lock:
+            stale = list(self._claimed)
+        for key in stale:
+            self._abort_trial(key)
+
+    def _spawn(self, exp_id: str) -> None:
+        with self._lock:
+            if exp_id in self._threads:
+                return
+            t = threading.Thread(target=self._run_experiment,
+                                 args=(exp_id,), daemon=True,
+                                 name=f"experiment-{exp_id}")
+            self._threads[exp_id] = t
+        t.start()
+
+    # -- the experiment-trials ledger (analysis RESOURCE_PAIRS) --------------
+    def _claim_trial(self, key: Tuple[str, int, int]) -> None:
+        """Register one trial being materialized in the ``_claimed``
+        ledger.  Every claim MUST reach :meth:`_commit_trial` (result
+        committed) or :meth:`_abort_trial` (crash/cancel/shutdown
+        paths) — VR701 pins the pairing."""
+        with self._lock:
+            self._claimed[key] = time.monotonic()
+
+    def _commit_trial(self, key: Tuple[str, int, int], doc: dict) -> None:
+        """Durably commit one finished trial, then release its
+        ``_claimed`` entry.  The store write lands first: a crash
+        between the two leaves a committed trial plus a stale claim the
+        exit sweeps drop — never a released claim whose work is lost."""
+        exp_id, gen, idx = key
+        self._store.commit_trial(exp_id, doc)
+        with self._lock:
+            self._trials.setdefault(exp_id, {})[(gen, idx)] = doc
+            self._claimed.pop(key, None)
+
+    def _abort_trial(self, key: Tuple[str, int, int]) -> None:
+        """Release one ``_claimed`` entry without committing (idempotent
+        — the cancel and stop sweeps race the drive thread's own
+        finally)."""
+        with self._lock:
+            self._claimed.pop(key, None)
+
+    # -- submission / query API ----------------------------------------------
+    def submit(self, spec: dict) -> dict:
+        """Validate + persist one experiment, launch its drive thread,
+        return the status doc.  The manifest commits BEFORE the thread
+        starts: from the client's 200 onward the experiment survives
+        any crash and resumes on the next ``start()``."""
+        if not isinstance(spec, dict):
+            raise ExperimentError("experiment spec must be a JSON object")
+        unknown = set(spec) - _SPEC_KEYS
+        if unknown:
+            raise ExperimentError(
+                f"unknown experiment spec keys: {sorted(unknown)}")
+        if self.trial_factory is None and self.cli_argv is None:
+            raise ExperimentError(
+                "this manager cannot launch trials (no trial_factory "
+                "or cli_argv attached; see docs/experiments.md)")
+        exp_cfg = root.common.experiment
+        exp = {
+            "id": uuid.uuid4().hex[:12],
+            "name": str(spec.get("name") or ""),
+            "state": "running",
+            "created": time.time(),
+            "policy": str(spec.get("policy", "genetic")),
+            "generations": int(spec.get(
+                "generations", exp_cfg.get("generations", 4))),
+            "population": int(spec.get(
+                "population", exp_cfg.get("population", 8))),
+            "seed": int(spec.get("seed", 0)),
+            "generation": 0,
+            "spec": self._validate_spec(spec),
+        }
+        if exp["generations"] < 1 or exp["population"] < 1:
+            raise ExperimentError(
+                "generations and population must be >= 1")
+        self._make_policy(exp)      # reject bad policy/config at submit
+        self._store.commit_manifest(exp)
+        with self._lock:
+            self._exps[exp["id"]] = exp
+            self._trials[exp["id"]] = {}
+            self._counts["submitted"] += 1
+            running = sum(1 for e in self._exps.values()
+                          if e["state"] == "running")
+        self._m_submitted.inc()
+        self._g_running.set(running)
+        self._spawn(exp["id"])
+        return self.status(exp["id"])
+
+    @staticmethod
+    def _validate_spec(spec: dict) -> dict:
+        clean = {}
+        prompts = spec.get("eval_prompts")
+        if prompts is not None:
+            if not isinstance(prompts, list) or not prompts or not all(
+                    isinstance(p, (list, tuple)) and p for p in prompts):
+                raise ExperimentError(
+                    "eval_prompts must be a non-empty list of non-empty "
+                    "token-id lists")
+            clean["eval_prompts"] = [[int(t) for t in p]
+                                     for p in prompts]
+        for k, cast in (("eval_steps", int), ("eval_seed", int)):
+            if spec.get(k) is not None:
+                clean[k] = cast(spec[k])
+        if spec.get("promote") is not None:
+            clean["promote"] = bool(spec["promote"])
+        return clean
+
+    def _make_policy(self, exp: dict) -> SearchPolicy:
+        if self.policy_factory is not None:
+            return self.policy_factory(exp, self.config)
+        cls = POLICIES.get(exp["policy"])
+        if cls is None:
+            raise ExperimentError(
+                f"unknown policy {exp['policy']!r}; have "
+                f"{sorted(POLICIES)}")
+        if self.config is None and exp["policy"] != "ensemble":
+            raise ExperimentError(
+                f"policy {exp['policy']!r} needs a base config with "
+                "Range tuneables attached to the manager")
+        return cls(self.config, population=exp["population"],
+                   generations=exp["generations"], seed=exp["seed"])
+
+    def _get(self, exp_id: str) -> dict:
+        with self._lock:
+            exp = self._exps.get(exp_id)
+        if exp is None:
+            raise KeyError(f"no such experiment: {exp_id}")
+        return exp
+
+    def status(self, exp_id: str) -> dict:
+        exp = self._get(exp_id)
+        with self._lock:
+            trials = self._trials.get(exp_id, {})
+            by_status: Dict[str, int] = {}
+            for t in trials.values():
+                by_status[t["status"]] = by_status.get(t["status"], 0) + 1
+            doc = {
+                "id": exp["id"], "name": exp["name"],
+                "state": exp["state"], "created": exp["created"],
+                "policy": exp["policy"],
+                "generations": exp["generations"],
+                "population": exp["population"],
+                "generation": exp.get("generation", 0),
+                "trials": {"total": len(trials), **by_status},
+            }
+            for k in ("baseline_score", "best", "promotion", "error"):
+                if exp.get(k) is not None:
+                    doc[k] = exp[k]
+        return doc
+
+    def list_experiments(self) -> dict:
+        with self._lock:
+            ids = sorted(self._exps,
+                         key=lambda e: self._exps[e]["created"])
+        return {"experiments": [self.status(e) for e in ids]}
+
+    def summary(self) -> dict:
+        """The fleet-level view ``/fleet.json`` merges: experiment
+        counts by state plus trial progress."""
+        with self._lock:
+            states: Dict[str, int] = {}
+            for e in self._exps.values():
+                states[e["state"]] = states.get(e["state"], 0) + 1
+            return {
+                "total": len(self._exps),
+                "by_state": states,
+                "trials": sum(len(t) for t in self._trials.values()),
+                "trials_inflight": len(self._claimed),
+                **{k: v for k, v in self._counts.items()},
+            }
+
+    def cancel(self, exp_id: str) -> dict:
+        """Cancel: mark terminal, stop scheduling new trials, sweep the
+        claim ledger.  The trial currently inside ``Trainer.run`` (if
+        any) finishes and commits — completed work is never thrown away
+        — and the drive thread exits at its next liveness check."""
+        exp = self._get(exp_id)
+        with self._lock:
+            already = exp["state"] in _TERMINAL
+            if not already:
+                exp["state"] = "cancelled"
+                self._cancelled.add(exp_id)
+                self._counts["cancelled"] += 1
+                running = sum(1 for e in self._exps.values()
+                              if e["state"] == "running")
+            stale = [k for k in self._claimed if k[0] == exp_id]
+            man = dict(exp)
+        if not already:
+            for key in stale:
+                self._abort_trial(key)
+            self._store.commit_manifest(man)
+            self._g_running.set(running)
+        return self.status(exp_id)
+
+    def wait(self, exp_id: str, timeout_s: float = 120.0) -> bool:
+        """Block until the experiment is terminal (poll-based:
+        terminality is a disk-backed property)."""
+        deadline = time.monotonic() + float(timeout_s)
+        while time.monotonic() < deadline:
+            with self._lock:
+                exp = self._exps.get(exp_id)
+                if exp is not None and exp["state"] in _TERMINAL:
+                    return True
+            time.sleep(0.05)
+        return False
+
+    # -- drive loop (one thread per experiment) ------------------------------
+    def _check_live(self, exp_id: str) -> None:
+        with self._lock:
+            dead = (self._stop_evt.is_set()
+                    or exp_id in self._cancelled)
+        if dead:
+            raise _Cancelled(exp_id)
+
+    def _run_experiment(self, exp_id: str):
+        try:
+            self._drive(exp_id)
+        except _Cancelled:
+            pass        # cancel() committed the state; stop() leaves
+            # "running" on disk for the successor's resume
+        except faults.FaultInjected as e:
+            # simulated process death (trial_crash_at_step): state
+            # stays "running" on disk, a fresh manager must resume —
+            # deliberately NOT recorded as a failed experiment
+            self.warning("experiment %s crashed (injected): %s",
+                         exp_id, e)
+        except Exception as e:  # noqa: BLE001 — a failed experiment
+            # must not kill the manager; record and move on
+            self.exception("experiment %s failed", exp_id)
+            with self._lock:
+                exp = self._exps[exp_id]
+                exp["state"] = "failed"
+                exp["error"] = str(e)[:500]
+                self._counts["failed"] += 1
+                running = sum(1 for e2 in self._exps.values()
+                              if e2["state"] == "running")
+                man = dict(exp)
+            self._store.commit_manifest(man)
+            self._g_running.set(running)
+        finally:
+            with self._lock:
+                self._threads.pop(exp_id, None)
+
+    def _drive(self, exp_id: str):
+        with self._lock:
+            exp = dict(self._exps[exp_id])
+        policy = self._make_policy(exp)
+        memo: Dict[str, Tuple[int, int]] = {}
+        for gen in range(policy.n_generations):
+            self._check_live(exp_id)
+            genomes = policy.propose(gen)
+            self._train_generation(exp, gen, genomes, policy, memo)
+            scores = self._score_generation(exp, gen, genomes)
+            policy.observe(gen, scores)
+            with self._lock:
+                live = self._exps[exp_id]
+                live["generation"] = gen + 1
+                if gen == 0:
+                    live["baseline_score"] = scores[0]
+                man = dict(live)
+            self._store.commit_manifest(man)
+            self.info("experiment %s gen %d/%d: best=%.6g", exp_id,
+                      gen + 1, policy.n_generations, min(scores))
+        self._promote(exp_id)
+
+    def _trial(self, exp_id: str, gen: int, idx: int) -> Optional[dict]:
+        with self._lock:
+            return self._trials.get(exp_id, {}).get((gen, idx))
+
+    @staticmethod
+    def _trial_seed(exp: dict, gen: int, idx: int) -> int:
+        # pure function of (experiment seed, generation, index): an
+        # interrupted trial restarts with the identical seed
+        return int(exp["seed"]) + gen * 100003 + idx
+
+    @staticmethod
+    def _note_memo(memo: Dict[str, Tuple[int, int]], genome: dict,
+                   t: dict) -> None:
+        src = t.get("cached_from") or (t["generation"], t["index"])
+        memo.setdefault(_genome_key(genome), (int(src[0]), int(src[1])))
+
+    def _train_generation(self, exp: dict, gen: int,
+                          genomes: List[dict], policy: SearchPolicy,
+                          memo: Dict[str, Tuple[int, int]]) -> None:
+        exp_id = exp["id"]
+        todo: List[Tuple[int, dict]] = []
+        for idx, genome in enumerate(genomes):
+            self._check_live(exp_id)
+            t = self._trial(exp_id, gen, idx)
+            if t is not None:
+                # resume: the committed trial must match the replay
+                if t.get("genome") != genome:
+                    raise ExperimentError(
+                        f"experiment {exp_id} trial g{gen}t{idx} on "
+                        "disk does not match its deterministic replay "
+                        f"(seed {exp['seed']}): the store and the spec "
+                        "come from different histories")
+                self._note_memo(memo, genome, t)
+                continue
+            src = memo.get(_genome_key(genome))
+            if policy.dedup and src is not None:
+                self._cache_trial(exp, gen, idx, genome, src)
+                self._note_memo(memo, genome,
+                                self._trial(exp_id, gen, idx))
+                continue
+            todo.append((idx, genome))
+        if not todo:
+            return
+        if self.workers > 1 and self.cli_argv is not None:
+            self._train_subprocess(exp, gen, todo, memo)
+            return
+        for idx, genome in todo:
+            self._check_live(exp_id)
+            self._train_trial(exp, gen, idx, genome, policy)
+            self._note_memo(memo, genome, self._trial(exp_id, gen, idx))
+
+    def _cache_trial(self, exp: dict, gen: int, idx: int, genome: dict,
+                     src: Tuple[int, int]) -> None:
+        """A genome already materialized earlier (a GA elite carried
+        over): commit a ``cached`` trial pointing at the source instead
+        of retraining — same claim/commit ledger discipline as a real
+        training."""
+        exp_id = exp["id"]
+        key = (exp_id, gen, idx)
+        src_doc = self._trial(exp_id, *src) or {}
+        doc = {"generation": gen, "index": idx,
+               "seed": self._trial_seed(exp, gen, idx),
+               "genome": dict(genome), "status": "cached",
+               "cached_from": [int(src[0]), int(src[1])],
+               "snapshot": src_doc.get("snapshot"),
+               "best_value": src_doc.get("best_value")}
+        if src_doc.get("score") is not None:
+            doc["score"] = src_doc["score"]
+        self._claim_trial(key)
+        committed = False
+        try:
+            self._commit_trial(key, doc)
+            committed = True
+            self._m_trials_cached.inc()
+        finally:
+            if not committed:
+                self._abort_trial(key)
+
+    def _maybe_crash_trial(self) -> None:
+        """The ``trial_crash_at_step`` injection point: the manager's
+        Nth trial launch (process-lifetime ordinal) dies after claiming
+        its ledger entry and before any commit — a simulated process
+        death the resume path must absorb."""
+        with self._lock:
+            self._trial_launches += 1
+            n = self._trial_launches
+        if not faults.enabled():
+            return
+        plan = faults.get_plan()
+        if plan.trial_crash_at_step \
+                and n == plan.trial_crash_at_step \
+                and faults.fire_once("trial_crash",
+                                     plan.trial_crash_at_step):
+            raise faults.FaultInjected(
+                f"trial_crash_at_step: killing trial launch {n}")
+
+    def _train_trial(self, exp: dict, gen: int, idx: int, genome: dict,
+                     policy: SearchPolicy) -> None:
+        exp_id = exp["id"]
+        key = (exp_id, gen, idx)
+        seed = self._trial_seed(exp, gen, idx)
+        self._claim_trial(key)
+        committed = False
+        try:
+            self._maybe_crash_trial()
+            doc = {"generation": gen, "index": idx, "seed": seed,
+                   "genome": dict(genome)}
+            try:
+                cfg = policy.materialize(genome)
+                trial = {"experiment": exp_id, "generation": gen,
+                         "index": idx, "seed": seed,
+                         "genome": dict(genome),
+                         "out_dir": self._store.snap_dir(
+                             exp_id, gen, idx)}
+                trainer = self.trial_factory(trial, cfg)
+                trainer.initialize(seed=seed)
+                trainer.run()
+                snap = Snapshotter(f"g{gen}t{idx}", trial["out_dir"],
+                                   interval=1)
+                path = snap.save("final", trainer._payload())
+                doc.update(status="trained", snapshot=path,
+                           best_value=float(
+                               trainer.decision.best_value))
+            except faults.FaultInjected:
+                raise           # a simulated crash, not a failed trial
+            except Exception as e:  # noqa: BLE001 — one broken config
+                # (materialize/train blowing up) is a failed TRIAL, not
+                # a failed experiment: record it, score it inf, go on
+                self.warning("trial %s g%dt%d failed: %s", exp_id, gen,
+                             idx, e)
+                doc.update(status="failed", snapshot=None,
+                           best_value=None, error=str(e)[:500])
+            self._commit_trial(key, doc)
+            committed = True
+            self._m_trials.inc()
+        finally:
+            if not committed:
+                self._abort_trial(key)
+
+    def _train_subprocess(self, exp: dict, gen: int,
+                          todo: List[Tuple[int, dict]],
+                          memo: Dict[str, Tuple[int, int]]) -> None:
+        """Bounded parallel trials: each todo genome becomes one
+        standalone CLI training (inline ``path=value`` overrides +
+        derived seed + per-trial snapshot dir) on a ``workers``-wide
+        subprocess pool.  All trials claim before the pool runs and
+        commit/abort after — a crash mid-pool leaves only claims the
+        exit sweeps drop, and committed snapshots resume as usual."""
+        from ..parallel.pool import CliRunner
+        exp_id = exp["id"]
+        keys: List[Tuple[Tuple[str, int, int], int, dict]] = []
+        jobs: List[List[str]] = []
+        try:
+            for idx, genome in todo:
+                self._check_live(exp_id)
+                key = (exp_id, gen, idx)
+                self._claim_trial(key)
+                keys.append((key, idx, genome))
+                self._maybe_crash_trial()
+                out_dir = self._store.snap_dir(exp_id, gen, idx)
+                ovs = [f"{p}={json.dumps(v)}"
+                       for p, v in genome.items()]
+                jobs.append([
+                    *self.cli_argv, *ovs,
+                    "--random-seed",
+                    str(self._trial_seed(exp, gen, idx)),
+                    "--snapshot-dir", out_dir,
+                ])
+            runner = CliRunner(n_workers=self.workers, env=self.env)
+            results = runner.run_jobs(jobs)
+        except BaseException:
+            for key, _idx, _genome in keys:
+                self._abort_trial(key)
+            raise
+        for (key, idx, genome), res in zip(keys, results):
+            doc = {"generation": gen, "index": idx,
+                   "seed": self._trial_seed(exp, gen, idx),
+                   "genome": dict(genome)}
+            snap = self._find_snapshot(
+                self._store.snap_dir(exp_id, gen, idx))
+            if "error" in res or res.get("best_value") is None \
+                    or snap is None:
+                doc.update(status="failed", snapshot=snap,
+                           best_value=None,
+                           error=str(res.get(
+                               "error", "no best_value/snapshot"))[:500])
+            else:
+                doc.update(status="trained", snapshot=snap,
+                           best_value=float(res["best_value"]))
+            self._commit_trial(key, doc)
+            self._m_trials.inc()
+            self._note_memo(memo, genome, doc)
+
+    @staticmethod
+    def _find_snapshot(out_dir: str) -> Optional[str]:
+        """Resolve a CLI trial's final snapshot via the snapshotter's
+        ``_best``/``_current`` links (the EnsembleTrainer farm-out
+        idiom)."""
+        import os
+        if not os.path.isdir(out_dir):
+            return None
+        for link in ("_best.json", "_current.json"):
+            cands = [f for f in os.listdir(out_dir)
+                     if f.endswith(link)]
+            if cands:
+                return os.path.realpath(
+                    os.path.join(out_dir, cands[0]))
+        return None
+
+    # -- scoring -------------------------------------------------------------
+    def _score_generation(self, exp: dict, gen: int,
+                          genomes: List[dict]) -> List[float]:
+        exp_id = exp["id"]
+        spec = exp["spec"]
+        sweep = []
+        for idx in range(len(genomes)):
+            t = self._trial(exp_id, gen, idx)
+            if t is None:
+                raise ExperimentError(
+                    f"experiment {exp_id} trial g{gen}t{idx} missing "
+                    "after the training phase")
+            if t["status"] == "trained" and t.get("score") is None:
+                sweep.append(t)
+        if sweep:
+            self._check_live(exp_id)
+            prompts = spec.get("eval_prompts") or self.eval_prompts
+            if self.jobs is not None and prompts:
+                cands = [{"name": f"g{gen}t{t['index']}",
+                          "prompts": prompts, "trial": t}
+                         for t in sweep]
+                results = score_candidates(
+                    self.jobs, cands, self.scorer,
+                    steps=int(spec.get(
+                        "eval_steps",
+                        root.common.experiment.get("eval_steps", 8))),
+                    seed=int(spec.get("eval_seed", 0)),
+                    timeout_s=self.eval_timeout_s)
+                for t, r in zip(sweep, results):
+                    self._recommit(exp_id, dict(
+                        t, status="scored", score=float(r["score"]),
+                        job_id=r["job_id"]))
+            else:
+                # no batch lane attached: the training metric IS the
+                # score (still deterministic, still resumable)
+                for t in sweep:
+                    bv = t.get("best_value")
+                    self._recommit(exp_id, dict(
+                        t, status="scored",
+                        score=float(bv) if bv is not None
+                        else math.inf))
+        scores = []
+        for idx in range(len(genomes)):
+            scores.append(self._resolved_score(
+                exp_id, self._trial(exp_id, gen, idx)))
+        return scores
+
+    def _recommit(self, exp_id: str, doc: dict) -> None:
+        """Update an already-committed trial (score attach): a plain
+        durable re-commit, no ledger traffic — the trial's claim was
+        released when its training committed."""
+        self._store.commit_trial(exp_id, doc)
+        with self._lock:
+            self._trials.setdefault(exp_id, {})[
+                (doc["generation"], doc["index"])] = doc
+
+    def _resolved_score(self, exp_id: str, t: dict) -> float:
+        if t["status"] == "failed":
+            return math.inf
+        if t["status"] == "cached":
+            if t.get("score") is not None:
+                return float(t["score"])
+            src = self._trial(exp_id, *t["cached_from"])
+            score = float(src["score"])
+            self._recommit(exp_id, dict(t, score=score))
+            return score
+        return float(t["score"])
+
+    # -- promotion -----------------------------------------------------------
+    def _promote(self, exp_id: str) -> None:
+        """The gate + the swap.  Winner = lowest resolved score across
+        every trial.  It ships only when (a) a promotion hook is
+        attached and the spec did not disable it, (b) it is not the
+        baseline trial ``(0, 0)`` itself, and (c) it beats the baseline
+        score by more than ``experiment.promote_margin``.  The swap's
+        own two-phase atomicity guarantees a failed promotion leaves
+        the old version serving everywhere."""
+        with self._lock:
+            exp = self._exps[exp_id]
+            spec = exp["spec"]
+            trials = dict(self._trials.get(exp_id, {}))
+        scored = {k: t for k, t in trials.items()
+                  if t.get("score") is not None}
+        promotion: dict
+        best_doc = None
+        if not scored:
+            promotion = {"promoted": False, "reason": "no scored trials"}
+        else:
+            best_k = min(scored,
+                         key=lambda k: (scored[k]["score"], k))
+            best = scored[best_k]
+            best_doc = {"generation": best_k[0], "index": best_k[1],
+                        "score": best["score"],
+                        "snapshot": best.get("snapshot"),
+                        "genome": best.get("genome")}
+            self._g_best.set(float(best["score"]))
+            baseline = scored.get((0, 0))
+            base_score = baseline["score"] if baseline else None
+            want = spec.get("promote", True) \
+                and self.promote_fn is not None
+            if not want:
+                promotion = {"promoted": False,
+                             "reason": "promotion disabled (no hook "
+                                       "attached or spec promote=false)"}
+            elif best_k == (0, 0):
+                promotion = {"promoted": False,
+                             "reason": "baseline is already the best "
+                                       "candidate"}
+            elif base_score is not None and not (
+                    best["score"] < base_score - self.promote_margin):
+                promotion = {
+                    "promoted": False,
+                    "reason": f"improvement {base_score - best['score']:.6g}"
+                              f" below promote_margin "
+                              f"{self.promote_margin:.6g}"}
+            elif not best.get("snapshot"):
+                promotion = {"promoted": False,
+                             "reason": "winner has no snapshot"}
+            else:
+                promotion = self._run_swap(best)
+        with self._lock:
+            exp = self._exps[exp_id]
+            exp["state"] = "done"
+            exp["best"] = best_doc
+            exp["promotion"] = promotion
+            self._counts["completed"] += 1
+            running = sum(1 for e in self._exps.values()
+                          if e["state"] == "running")
+            man = dict(exp)
+        self._store.commit_manifest(man)
+        self._m_completed.inc()
+        self._g_running.set(running)
+        self.info("experiment %s done: best=%s promotion=%s", exp_id,
+                  best_doc and best_doc["score"], promotion["reason"]
+                  if "reason" in promotion else promotion)
+
+    def _run_swap(self, best: dict) -> dict:
+        try:
+            out = self.promote_fn(best["snapshot"])
+        except Exception as e:  # noqa: BLE001 — a promotion hook
+            # blowing up must leave a failed-promotion record, never a
+            # failed experiment (the fleet is still serving the old
+            # version; the swap never started or rolled back)
+            out = {"swapped": False, "error": str(e)[:500]}
+        if isinstance(out, dict):
+            swapped = bool(out.get("swapped"))
+            detail = {k: out[k] for k in
+                      ("phase", "rolled_back", "error") if k in out}
+            if out.get("errors"):
+                detail["errors"] = {str(k): str(v)[:200]
+                                    for k, v in out["errors"].items()}
+        else:
+            swapped = bool(out)
+            detail = {}
+        if swapped:
+            self._m_promotions.inc()
+            return {"promoted": True, "reason": "swapped",
+                    "snapshot": best["snapshot"], **detail}
+        self._m_promote_failures.inc()
+        return {"promoted": False,
+                "reason": "swap failed (rolled back; old version keeps "
+                          "serving)",
+                "snapshot": best["snapshot"], **detail}
+
+
+def handle_experiments_request(manager: Optional[ExperimentManager],
+                               method: str, path: str,
+                               body: Optional[dict]
+                               ) -> Optional[Tuple[int, object]]:
+    """Shared REST glue for the experiment API — both the fleet server
+    and a single replica route ``/experiments*`` requests here.
+    Returns ``(status, doc)`` or None when ``path`` is not an
+    experiments route (the caller falls through to its own 404)."""
+    from urllib.parse import urlparse
+    parsed = urlparse(path)
+    parts = [p for p in parsed.path.split("/") if p]
+    if not parts or parts[0] != "experiments":
+        return None
+    if manager is None:
+        return 404, {"error": "no experiment manager attached (set "
+                              "experiment.dir and wire an "
+                              "ExperimentManager; see "
+                              "docs/experiments.md)"}
+    try:
+        if method == "POST" and len(parts) == 1:
+            return 200, manager.submit(body or {})
+        if method == "GET" and len(parts) == 1:
+            return 200, manager.list_experiments()
+        if method == "GET" and len(parts) == 2:
+            return 200, manager.status(parts[1])
+        if method == "DELETE" and len(parts) == 2:
+            return 200, manager.cancel(parts[1])
+    except KeyError as e:
+        return 404, {"error": str(e)}
+    except (ExperimentError, TypeError, ValueError) as e:
+        return 400, {"error": str(e)}
+    return 404, {"error": f"unknown experiments route {parsed.path}"}

@@ -1,0 +1,245 @@
+"""Search policies: who decides which trial configs an experiment runs.
+
+A policy is a *stepwise* generator of trial genomes (``{config path ->
+value}`` over the base config's ``Range`` tuneables) that the experiment
+manager drives one generation at a time::
+
+    genomes = policy.propose(g)          # deterministic from (seed, g)
+    ...train + score each genome...      # manager's job
+    policy.observe(g, scores)            # lower score = better
+    cfg = policy.materialize(genome)     # genome -> full Config
+
+The split matters for crash safety: the manager persists trial *scores*,
+not populations — on resume it re-proposes every generation from scratch
+and replays the recorded scores through ``observe``, so ``propose(g)``
+MUST be a pure function of ``(seed, g)`` plus everything observed before
+``g``.  :meth:`~veles_tpu.genetics.GeneticOptimizer.generation_rng` is
+exactly that contract for the GA.
+
+Two invariants every policy keeps:
+
+* ``propose(0)[0]`` is the **baseline** genome — the base config's
+  current values.  Trial ``(0, 0)``'s score is the promotion gate's
+  reference point: a winner only ships if it beats what is already
+  serving by the configured margin.
+* genomes are JSON-serializable (they are committed into trial files).
+
+``dedup`` (class attribute, default True) lets the manager collapse
+repeated genomes — a GA elite re-proposed in the next generation is the
+*same* candidate and must not retrain (it becomes a ``cached`` trial).
+:class:`EnsemblePolicy` turns it off: its trials share one genome on
+purpose and differ only by trial seed.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..config import Config
+from ..genetics import GeneticOptimizer
+
+
+def _policy_driven(cfg) -> float:
+    raise RuntimeError(
+        "policy-driven GA: fitness comes from ExperimentManager scores "
+        "via observe(), never from an in-loop fitness_fn")
+
+
+class SearchPolicy:
+    """Base contract (see the module docstring for the drive cycle)."""
+
+    #: may the manager collapse equal genomes into cached trials?
+    dedup = True
+    #: generations this policy wants; the manager drives 0..n-1.
+    n_generations = 1
+
+    def propose(self, generation: int) -> List[Dict[str, object]]:
+        raise NotImplementedError
+
+    def observe(self, generation: int,
+                scores: Sequence[float]) -> None:
+        raise NotImplementedError
+
+    def materialize(self, genome: Dict[str, object]) -> Config:
+        raise NotImplementedError
+
+
+class GeneticPolicy(SearchPolicy):
+    """The flagship: the rebuilt :class:`~veles_tpu.genetics.
+    GeneticOptimizer` over config ``Range`` tuneables, driven stepwise.
+    Generation 0 is the seed individual (baseline) plus randoms from
+    ``generation_rng(0)``; generation g breeds from the observed
+    generation g-1 with ``generation_rng(g)`` — so any generation
+    replays bitwise from ``(seed, g)`` and the stored scores."""
+
+    def __init__(self, config: Config, *, population: int = 8,
+                 generations: int = 4, seed: int = 0, **ga_kw):
+        self.ga = GeneticOptimizer(
+            config, fitness_fn=_policy_driven,
+            population_size=int(population),
+            generations=int(generations), seed=int(seed), **ga_kw)
+        self.population = int(population)
+        self.n_generations = int(generations)
+        self._pop = None
+        self._gen = -1
+
+    def propose(self, generation: int) -> List[Dict[str, object]]:
+        generation = int(generation)
+        if generation == 0:
+            g0 = self.ga.generation_rng(0)
+            pop = [self.ga.seed_individual()] + [
+                self.ga.random_individual(g0)
+                for _ in range(self.population - 1)]
+        else:
+            if self._gen != generation - 1 or self._pop is None \
+                    or not all(i.evaluated for i in self._pop):
+                raise ValueError(
+                    f"propose({generation}) needs generation "
+                    f"{generation - 1} proposed and observed first")
+            pop = self.ga.breed(self._pop,
+                                self.ga.generation_rng(generation))
+        self._pop = pop
+        self._gen = generation
+        return [dict(i.genome) for i in pop]
+
+    def observe(self, generation: int,
+                scores: Sequence[float]) -> None:
+        if int(generation) != self._gen or self._pop is None \
+                or len(scores) != len(self._pop):
+            raise ValueError(
+                f"observe({generation}) does not match the last "
+                f"proposed generation {self._gen}")
+        for ind, s in zip(self._pop, scores):
+            ind.fitness = float(s)
+            ind.evaluated = True
+
+    def materialize(self, genome: Dict[str, object]) -> Config:
+        return self.ga.materialize(genome)
+
+
+class RandomPolicy(SearchPolicy):
+    """Random-search baseline: every generation is an independent draw
+    from ``generation_rng(g)`` (scores are ignored) — the control arm a
+    GA claim is measured against."""
+
+    def __init__(self, config: Config, *, population: int = 8,
+                 generations: int = 4, seed: int = 0):
+        self.ga = GeneticOptimizer(
+            config, fitness_fn=_policy_driven,
+            population_size=int(population),
+            generations=int(generations), seed=int(seed))
+        self.population = int(population)
+        self.n_generations = int(generations)
+
+    def propose(self, generation: int) -> List[Dict[str, object]]:
+        rng = self.ga.generation_rng(int(generation))
+        out: List[Dict[str, object]] = []
+        if int(generation) == 0:
+            out.append(dict(self.ga.seed_individual().genome))
+        while len(out) < self.population:
+            out.append(dict(self.ga.random_individual(rng).genome))
+        return out
+
+    def observe(self, generation: int,
+                scores: Sequence[float]) -> None:
+        pass                    # memoryless by design
+
+    def materialize(self, genome: Dict[str, object]) -> Config:
+        return self.ga.materialize(genome)
+
+
+class GridPolicy(SearchPolicy):
+    """Full-factorial grid baseline: each tuneable gets evenly spaced
+    levels (or its discrete choices), the cartesian product is chunked
+    into generations of ``population`` trials, wrapping around if the
+    grid is smaller than the trial budget (the manager's dedup turns
+    wrapped repeats into cached trials).  Purely deterministic; scores
+    are ignored."""
+
+    def __init__(self, config: Config, *, population: int = 8,
+                 generations: int = 4, seed: int = 0):
+        self.ga = GeneticOptimizer(
+            config, fitness_fn=_policy_driven,
+            population_size=int(population),
+            generations=int(generations), seed=int(seed))
+        self.population = int(population)
+        self.n_generations = int(generations)
+        slots = max(self.population * self.n_generations - 1, 1)
+        axes: List[List[object]] = []
+        n_axes = len(self.ga.tuneables)
+        per_axis = max(2, int(round(slots ** (1.0 / n_axes))))
+        for p, r in self.ga.tuneables.items():
+            if r.choices is not None:
+                axes.append(list(r.choices))
+                continue
+            lo, hi = self.ga._gene_bounds(p)
+            levels = np.linspace(lo, hi, per_axis)
+            axes.append([int(round(v)) if r.integer else float(v)
+                         for v in levels])
+        paths = list(self.ga.tuneables)
+        self._points = [dict(zip(paths, combo))
+                        for combo in itertools.product(*axes)]
+
+    def propose(self, generation: int) -> List[Dict[str, object]]:
+        generation = int(generation)
+        out: List[Dict[str, object]] = []
+        if generation == 0:
+            out.append(dict(self.ga.seed_individual().genome))
+        base = max(generation * self.population - 1, 0)
+        k = base
+        while len(out) < self.population:
+            out.append(dict(self._points[k % len(self._points)]))
+            k += 1
+        return out
+
+    def observe(self, generation: int,
+                scores: Sequence[float]) -> None:
+        pass                    # exhaustive by design
+
+    def materialize(self, genome: Dict[str, object]) -> Config:
+        return self.ga.materialize(genome)
+
+
+class EnsemblePolicy(SearchPolicy):
+    """:class:`~veles_tpu.ensemble.EnsembleTrainer` as a one-generation
+    degenerate case: N trials of the *same* config whose only variation
+    is the trial seed (the manager derives per-trial seeds from the
+    experiment seed, like the ensemble's ``base_seed + member``), so the
+    trial factory can split data / init weights per member.  ``dedup``
+    is off — the shared empty genome is intentional, every member must
+    train.  The "winner" is simply the best member; with the promotion
+    gate this doubles as seed-selection for the serving fleet."""
+
+    dedup = False
+
+    def __init__(self, config: Optional[Config] = None, *,
+                 population: int = 8, generations: int = 1,
+                 seed: int = 0):
+        self.config = config
+        self.population = int(population)
+        self.n_generations = 1  # degenerate by definition
+
+    def propose(self, generation: int) -> List[Dict[str, object]]:
+        return [{} for _ in range(self.population)]
+
+    def observe(self, generation: int,
+                scores: Sequence[float]) -> None:
+        pass
+
+    def materialize(self, genome: Dict[str, object]) -> Config:
+        cfg = Config()
+        if self.config is not None:
+            cfg.update(self.config.to_dict(unwrap_ranges=True))
+        return cfg
+
+
+#: name -> class, the REST spec's ``"policy"`` field.
+POLICIES = {
+    "genetic": GeneticPolicy,
+    "random": RandomPolicy,
+    "grid": GridPolicy,
+    "ensemble": EnsemblePolicy,
+}

@@ -1,0 +1,112 @@
+"""Durable experiment persistence (the ``runtime/jobs.py`` store idiom).
+
+One directory per experiment under ``base``::
+
+    <base>/<exp_id>/manifest.json            # spec + coarse state
+    <base>/<exp_id>/trials/g0001_t0003.json  # one file per finished trial
+    <base>/<exp_id>/snaps/g0001_t0003/       # that trial's snapshots
+
+The manifest is the experiment's coarse record (spec, state, promotion
+outcome) and is re-committed on every state change; the per-trial files
+are the fine-grained progress record — a trial exists on disk exactly
+when its training (or cache-copy) finished, so a restarted manager
+recomputes "what is left to run" from the trial files alone, never from
+counters a crash could have torn.  Every write stages through the
+snapshotter's tmp-fsync-rename helpers (``_commit_bytes``; the VR704
+lint rule pins the idiom here too): a crash leaves the previous
+committed state, never a half-written file a resume would trust.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+from ..runtime.snapshotter import _commit_bytes, _fsync_dir
+
+
+class ExperimentStore:
+    """Filesystem layout + committed reads/writes for experiments."""
+
+    def __init__(self, base: str):
+        self.base = str(base)
+        os.makedirs(self.base, exist_ok=True)
+
+    def exp_dir(self, exp_id: str) -> str:
+        return os.path.join(self.base, exp_id)
+
+    def snap_dir(self, exp_id: str, gen: int, idx: int) -> str:
+        return os.path.join(self.exp_dir(exp_id), "snaps",
+                            f"g{int(gen):04d}_t{int(idx):04d}")
+
+    def _trial_path(self, exp_id: str, gen: int, idx: int) -> str:
+        return os.path.join(self.exp_dir(exp_id), "trials",
+                            f"g{int(gen):04d}_t{int(idx):04d}.json")
+
+    def commit_manifest(self, doc: dict) -> None:
+        d = self.exp_dir(doc["id"])
+        os.makedirs(os.path.join(d, "trials"), exist_ok=True)
+        _commit_bytes(os.path.join(d, "manifest.json"),
+                      json.dumps(doc).encode())
+        _fsync_dir(d)
+
+    def read_manifest(self, exp_id: str) -> Optional[dict]:
+        try:
+            with open(os.path.join(self.exp_dir(exp_id),
+                                   "manifest.json")) as f:
+                return json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return None
+
+    def commit_trial(self, exp_id: str, doc: dict) -> None:
+        path = self._trial_path(exp_id, doc["generation"], doc["index"])
+        _commit_bytes(path, json.dumps(doc).encode())
+        _fsync_dir(os.path.dirname(path))
+
+    def has_trial(self, exp_id: str, gen: int, idx: int) -> bool:
+        return os.path.exists(self._trial_path(exp_id, gen, idx))
+
+    def read_trial(self, exp_id: str, gen: int, idx: int
+                   ) -> Optional[dict]:
+        try:
+            with open(self._trial_path(exp_id, gen, idx)) as f:
+                return json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return None
+
+    def load_trials(self, exp_id: str) -> Dict[Tuple[int, int], dict]:
+        """Every committed trial of one experiment, keyed by
+        ``(generation, index)`` — the on-disk trial files ARE the
+        progress record the resume path trusts."""
+        out: Dict[Tuple[int, int], dict] = {}
+        tdir = os.path.join(self.exp_dir(exp_id), "trials")
+        try:
+            names = sorted(os.listdir(tdir))
+        except OSError:
+            return out
+        for name in names:
+            try:
+                with open(os.path.join(tdir, name)) as f:
+                    doc = json.load(f)
+            except (OSError, json.JSONDecodeError):
+                continue        # torn tmp leftovers never commit
+            out[(int(doc["generation"]), int(doc["index"]))] = doc
+        return out
+
+    def load_all(self) -> List[dict]:
+        """Every persisted experiment manifest, oldest first.  Dirs
+        without a readable manifest are half-created (crash before the
+        first commit) and are skipped — the client never got a 200 for
+        them."""
+        docs: List[dict] = []
+        try:
+            entries = sorted(os.listdir(self.base))
+        except OSError:
+            return docs
+        for name in entries:
+            doc = self.read_manifest(name)
+            if doc is not None:
+                docs.append(doc)
+        docs.sort(key=lambda d: d.get("created", 0.0))
+        return docs

@@ -82,22 +82,38 @@ class GeneticOptimizer(Logger):
         self.mutation_rate = mutation_rate
         self.selection = selection
         self.tournament_k = tournament_k
+        self.seed = int(seed)
         self.rng = np.random.default_rng(seed)
         self.on_generation = on_generation
         self.binary_bits = binary_bits
         self.history: List[dict] = []
         self.best: Optional[Individual] = None
 
+    # -- deterministic replay -----------------------------------------------
+    def generation_rng(self, generation: int) -> np.random.Generator:
+        """The RNG stream for one generation's variation ops, derived from
+        ``(seed, generation)`` alone.  ``run()`` draws the initial random
+        population from ``generation_rng(0)`` and breeds generation ``g``
+        from ``generation_rng(g)``, so any generation's genomes replay
+        bitwise given the seed and the previous generation's evaluated
+        population — the contract crash-safe experiment resume relies on
+        (experiments/policies.py re-proposes instead of persisting
+        genomes it can re-derive)."""
+        return np.random.default_rng([self.seed, int(generation)])
+
     # -- genome ops ---------------------------------------------------------
-    def _random_value(self, p: str, r: Range):
+    def _random_value(self, p: str, r: Range,
+                      rng: Optional[np.random.Generator] = None):
+        rng = self.rng if rng is None else rng
         if r.choices is not None:
-            return r.choices[self.rng.integers(len(r.choices))]
+            return r.choices[rng.integers(len(r.choices))]
         lo, hi = self._gene_bounds(p)
-        v = self.rng.uniform(lo, hi)
+        v = rng.uniform(lo, hi)
         return int(round(v)) if r.integer else float(v)
 
-    def random_individual(self) -> Individual:
-        return Individual({p: self._random_value(p, r)
+    def random_individual(self, rng: Optional[np.random.Generator] = None
+                          ) -> Individual:
+        return Individual({p: self._random_value(p, r, rng)
                            for p, r in self.tuneables.items()})
 
     def seed_individual(self) -> Individual:
@@ -147,22 +163,24 @@ class GeneticOptimizer(Logger):
                 genome[p] = r.clip(int(round(v)) if r.integer else float(v))
         return genome
 
-    def crossover(self, a: Individual, b: Individual) -> Individual:
+    def crossover(self, a: Individual, b: Individual,
+                  rng: Optional[np.random.Generator] = None) -> Individual:
+        rng = self.rng if rng is None else rng
         if self.binary_bits:
             # binary-code single-point: cut the concatenated bitstring
             ba, bb = self.encode_bits(a.genome), self.encode_bits(b.genome)
-            cut = self.rng.integers(1, max(len(ba), 2))
+            cut = rng.integers(1, max(len(ba), 2))
             return Individual(self.decode_bits(
                 np.concatenate([ba[:cut], bb[cut:]])))
         paths = list(self.tuneables)
         child = {}
-        op = self.rng.integers(5)
+        op = rng.integers(5)
         if op == 0:      # uniform
             for p in paths:
-                child[p] = a.genome[p] if self.rng.random() < 0.5 \
+                child[p] = a.genome[p] if rng.random() < 0.5 \
                     else b.genome[p]
         elif op == 1:    # single-point (reference "pointed")
-            cut = self.rng.integers(1, max(len(paths), 2))
+            cut = rng.integers(1, max(len(paths), 2))
             for i, p in enumerate(paths):
                 child[p] = a.genome[p] if i < cut else b.genome[p]
         elif op in (2, 3, 4):
@@ -171,10 +189,10 @@ class GeneticOptimizer(Logger):
                 r = self.tuneables[p]
                 va, vb = a.genome[p], b.genome[p]
                 if r.choices is not None or not isinstance(va, (int, float)):
-                    child[p] = va if self.rng.random() < 0.5 else vb
+                    child[p] = va if rng.random() < 0.5 else vb
                     continue
                 if op == 2:      # blend: random convex combination
-                    t = self.rng.random()
+                    t = rng.random()
                     v = va * t + vb * (1 - t)
                 elif op == 3:    # arithmetic mean (reference :409)
                     v = (va + vb) / 2.0
@@ -187,43 +205,47 @@ class GeneticOptimizer(Logger):
                 child[p] = r.clip(int(round(v)) if r.integer else float(v))
         return Individual(child)
 
-    def mutate(self, ind: Individual) -> Individual:
+    def mutate(self, ind: Individual,
+               rng: Optional[np.random.Generator] = None) -> Individual:
+        rng = self.rng if rng is None else rng
         if self.binary_bits:
             # bit-flip mutation: expected flips per genome track the
             # gene-level mutation_rate
             bits = self.encode_bits(ind.genome)
             rate = self.mutation_rate / self.binary_bits
-            flips = self.rng.random(len(bits)) < rate
+            flips = rng.random(len(bits)) < rate
             bits = bits ^ flips.astype(np.uint8)
             return Individual(self.decode_bits(bits))
         g = dict(ind.genome)
         for p, r in self.tuneables.items():
-            if self.rng.random() >= self.mutation_rate:
+            if rng.random() >= self.mutation_rate:
                 continue
             if r.choices is not None:
-                g[p] = r.choices[self.rng.integers(len(r.choices))]
+                g[p] = r.choices[rng.integers(len(r.choices))]
             elif r.integer:
                 lo = r.min_value if r.min_value is not None else g[p] - 5
                 hi = r.max_value if r.max_value is not None else g[p] + 5
                 step = max(1, int((hi - lo) * 0.1))
-                g[p] = r.clip(g[p] + int(self.rng.integers(-step, step + 1)))
+                g[p] = r.clip(g[p] + int(rng.integers(-step, step + 1)))
             else:
                 lo = r.min_value if r.min_value is not None else g[p] * 0.1
                 hi = r.max_value if r.max_value is not None else g[p] * 10
                 sigma = (hi - lo) * 0.1
-                g[p] = r.clip(float(g[p] + self.rng.normal(0, sigma)))
+                g[p] = r.clip(float(g[p] + rng.normal(0, sigma)))
         return Individual(g)
 
     # -- selection ----------------------------------------------------------
-    def _select(self, pop: List[Individual]) -> Individual:
+    def _select(self, pop: List[Individual],
+                rng: Optional[np.random.Generator] = None) -> Individual:
+        rng = self.rng if rng is None else rng
         if self.selection == "tournament":
-            idx = self.rng.choice(len(pop), size=self.tournament_k,
-                                  replace=False)
+            idx = rng.choice(len(pop), size=self.tournament_k,
+                             replace=False)
             return min((pop[i] for i in idx), key=lambda i: i.fitness)
         # roulette on inverse fitness (lower fitness = larger slice)
         inv = np.array([1.0 / (1e-9 + i.fitness) for i in pop])
         probs = inv / inv.sum()
-        return pop[self.rng.choice(len(pop), p=probs)]
+        return pop[rng.choice(len(pop), p=probs)]
 
     # -- evaluation ---------------------------------------------------------
     def materialize(self, genome: Dict[str, object]) -> Config:
@@ -257,10 +279,34 @@ class GeneticOptimizer(Logger):
             ind.fitness = float(fit)
             ind.evaluated = True
 
+    # -- breeding -----------------------------------------------------------
+    def breed(self, pop: List[Individual],
+              rng: Optional[np.random.Generator] = None
+              ) -> List[Individual]:
+        """Produce the next population from an evaluated one: elites carry
+        over (still evaluated — they are never retrained), the rest come
+        from selection + crossover/copy + mutation.  With ``rng`` from
+        ``generation_rng(g)`` the offspring are a pure function of ``pop``
+        and ``(seed, g)``."""
+        rng = self.rng if rng is None else rng
+        ranked = sorted(pop, key=lambda i: i.fitness)
+        nxt = ranked[:self.elite]
+        while len(nxt) < self.population_size:
+            if rng.random() < self.crossover_rate:
+                child = self.crossover(self._select(ranked, rng),
+                                       self._select(ranked, rng), rng)
+            else:
+                child = dataclasses.replace(
+                    self._select(ranked, rng),
+                    fitness=math.inf, evaluated=False)
+            nxt.append(self.mutate(child, rng))
+        return nxt
+
     # -- main loop ----------------------------------------------------------
     def run(self) -> Individual:
+        g0 = self.generation_rng(0)
         pop = [self.seed_individual()] + [
-            self.random_individual()
+            self.random_individual(g0)
             for _ in range(self.population_size - 1)]
         for gen in range(self.generations):
             self._evaluate_all(pop)
@@ -278,16 +324,7 @@ class GeneticOptimizer(Logger):
                 self.on_generation(gen, pop)
             if gen == self.generations - 1:
                 break
-            nxt = pop[:self.elite]
-            while len(nxt) < self.population_size:
-                if self.rng.random() < self.crossover_rate:
-                    child = self.crossover(self._select(pop),
-                                           self._select(pop))
-                else:
-                    child = dataclasses.replace(
-                        self._select(pop), fitness=math.inf, evaluated=False)
-                nxt.append(self.mutate(child))
-            pop = nxt
+            pop = self.breed(pop, self.generation_rng(gen + 1))
         return self.best
 
 

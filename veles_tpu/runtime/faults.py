@@ -90,6 +90,17 @@ node in production, so the :func:`enabled` fast path is one falsy check):
     engine-side stream handle buffers up to
     ``serve.stream.buffer_tokens`` and then terminates the stream
     with a loud overflow error instead of growing without bound.
+``trial_crash_at_step``
+    int.  The experiment manager (experiments/manager.py) raises
+    :class:`FaultInjected` as its Nth launched trial begins training —
+    once per arming, counted across the manager's process lifetime,
+    AFTER the trial claimed its ledger entry and BEFORE any result
+    commit.  The manager deliberately re-raises it past its own
+    failure handling (a simulated process death, not a failed trial):
+    the experiment stays ``running`` on disk and a fresh manager must
+    resume it mid-generation — completed trials never re-run, the
+    killed trial restarts from its deterministic seed
+    (tests/test_chaos.py experiment rehearsal).
 """
 
 from __future__ import annotations
@@ -130,7 +141,8 @@ class FaultPlan:
                  "decode_stall_ms", "admission_burst",
                  "replica_crash_at_request", "replica_slow_ms",
                  "kv_transfer_drop", "kv_transfer_slow_ms",
-                 "stream_cut_at_token", "stream_stall_ms")
+                 "stream_cut_at_token", "stream_stall_ms",
+                 "trial_crash_at_step")
 
     def __init__(self, cfg):
         get = cfg.get
@@ -151,6 +163,8 @@ class FaultPlan:
         self.stream_cut_at_token = int(
             get("stream_cut_at_token", 0) or 0)
         self.stream_stall_ms = float(get("stream_stall_ms", 0.0) or 0.0)
+        self.trial_crash_at_step = int(
+            get("trial_crash_at_step", 0) or 0)
 
     def __bool__(self) -> bool:
         return bool(self.nan_grad_at_step or self.loader_ioerror_at_batch
@@ -162,7 +176,8 @@ class FaultPlan:
                     or self.kv_transfer_drop
                     or self.kv_transfer_slow_ms
                     or self.stream_cut_at_token
-                    or self.stream_stall_ms)
+                    or self.stream_stall_ms
+                    or self.trial_crash_at_step)
 
     def __repr__(self) -> str:
         armed = {k: getattr(self, k) for k in self.__slots__

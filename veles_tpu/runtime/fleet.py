@@ -357,6 +357,11 @@ class FleetRouter(Logger):
         # summary so /fleet.json shows the bulk backlog next to the
         # interactive topology
         self.jobs = None
+        # experiment control plane (docs/experiments.md): an
+        # ExperimentManager attached by FleetServer — fleet_doc merges
+        # its summary so /fleet.json shows the optimization loop's
+        # progress next to the serving topology
+        self.experiments = None
 
         # the fleet metric family (docs/observability.md table; VM4xx)
         reg = registry()
@@ -1812,6 +1817,8 @@ class FleetRouter(Logger):
             "last_rolling_drain": self._last_drain,
             **({"jobs": self.jobs.summary()}
                if self.jobs is not None else {}),
+            **({"experiments": self.experiments.summary()}
+               if self.experiments is not None else {}),
         }
 
 
@@ -1825,9 +1832,11 @@ class FleetServer(Logger):
     server shape as :class:`~.restful.RestfulServer`."""
 
     def __init__(self, router: FleetRouter, *, port: int = 0,
-                 host: str = "127.0.0.1", jobs_dir: Optional[str] = None):
+                 host: str = "127.0.0.1", jobs_dir: Optional[str] = None,
+                 experiments=None):
         import http.server
 
+        from ..experiments.manager import handle_experiments_request
         from .jobs import JobManager, handle_jobs_request
         from .restful import (read_json_body, reply_json,
                               reply_metrics_text)
@@ -1844,6 +1853,14 @@ class FleetServer(Logger):
         if jobs_dir:
             self.jobs = JobManager(jobs_dir, router.handle_generate)
             router.jobs = self.jobs
+        # experiment control plane (docs/experiments.md): an attached
+        # ExperimentManager serves /experiments* fleet-wide and shows
+        # up in /fleet.json.  The manager is owned by the caller (its
+        # trial factory / promotion hook are wired there); this server
+        # routes to it and stops it on shutdown.
+        self.experiments = experiments
+        if experiments is not None:
+            router.experiments = experiments
         outer = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
@@ -1900,6 +1917,9 @@ class FleetServer(Logger):
                     return
                 hit = handle_jobs_request(outer.jobs, "GET",
                                           self.path, None)
+                if hit is None:
+                    hit = handle_experiments_request(
+                        outer.experiments, "GET", self.path, None)
                 if hit is not None:
                     self._reply(hit[1], code=hit[0])
                     return
@@ -1908,6 +1928,9 @@ class FleetServer(Logger):
             def do_DELETE(self):
                 hit = handle_jobs_request(outer.jobs, "DELETE",
                                           self.path, None)
+                if hit is None:
+                    hit = handle_experiments_request(
+                        outer.experiments, "DELETE", self.path, None)
                 if hit is not None:
                     self._reply(hit[1], code=hit[0])
                     return
@@ -1968,6 +1991,9 @@ class FleetServer(Logger):
                         return
                     hit = handle_jobs_request(outer.jobs, "POST",
                                               self.path, req)
+                    if hit is None:
+                        hit = handle_experiments_request(
+                            outer.experiments, "POST", self.path, req)
                     if hit is not None:
                         self._reply(hit[1], code=hit[0])
                         return
@@ -1992,6 +2018,9 @@ class FleetServer(Logger):
         self.router.start()
         if self.jobs is not None:
             self.jobs.start()
+        if self.experiments is not None:
+            # resumes every persisted non-terminal experiment
+            self.experiments.start()
         self._thread = threading.Thread(target=self.httpd.serve_forever,
                                         daemon=True)
         self._thread.start()
@@ -2000,6 +2029,11 @@ class FleetServer(Logger):
         return self
 
     def stop(self):
+        if self.experiments is not None:
+            # drain the optimization loop first (its sweeps ride the
+            # job manager below); state stays "running" on disk for
+            # the successor manager's resume
+            self.experiments.stop()
         if self.jobs is not None:
             # stop scheduling batch dispatches before the router's
             # replicas go away; committed results resume elsewhere

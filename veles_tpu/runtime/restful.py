@@ -66,6 +66,7 @@ from urllib.parse import parse_qs, urlsplit
 import numpy as np
 
 from ..config import root
+from ..experiments.manager import handle_experiments_request
 from ..logger import Logger
 from .artifact import ArtifactError
 from .engine import EngineOverloaded, EngineStopped, SchedulerCrashed
@@ -129,7 +130,8 @@ class RestfulServer(Logger):
                  input_shape, *, port: int = 0, host: str = "127.0.0.1",
                  normalizer=None, denormalizer=None, workflow=None,
                  engine=None, input_dtype=np.float32,
-                 default_eos_id=None, vocab_size=None, jobs_dir=None):
+                 default_eos_id=None, vocab_size=None, jobs_dir=None,
+                 experiments=None):
         self.predict_fn = predict_fn
         self.wstate = wstate
         self.batch_size = int(batch_size)
@@ -157,6 +159,12 @@ class RestfulServer(Logger):
         self.jobs: Optional[JobManager] = None
         if jobs_dir and engine is not None:
             self.jobs = JobManager(jobs_dir, self._local_dispatch)
+        # experiment control plane (docs/experiments.md): an attached
+        # ExperimentManager serves /experiments* from this replica.
+        # Unlike self.jobs the manager is owned by the caller (it may
+        # be shared fleet-wide), so this server only routes to it —
+        # lifecycle stays with whoever constructed it.
+        self.experiments = experiments
         outer = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
@@ -241,6 +249,9 @@ class RestfulServer(Logger):
                     return
                 hit = handle_jobs_request(outer.jobs, "GET",
                                           self.path, None)
+                if hit is None:
+                    hit = handle_experiments_request(
+                        outer.experiments, "GET", self.path, None)
                 if hit is not None:
                     self._reply(hit[1], code=hit[0])
                     return
@@ -249,9 +260,13 @@ class RestfulServer(Logger):
             def do_DELETE(self):
                 # DELETE /jobs/<id>: cancel a batch job — queued work
                 # drops immediately; its trough-class slots are
-                # interactive traffic's to reclaim anyway
+                # interactive traffic's to reclaim anyway.
+                # DELETE /experiments/<id>: cancel an experiment.
                 hit = handle_jobs_request(outer.jobs, "DELETE",
                                           self.path, None)
+                if hit is None:
+                    hit = handle_experiments_request(
+                        outer.experiments, "DELETE", self.path, None)
                 if hit is not None:
                     self._reply(hit[1], code=hit[0])
                     return
@@ -312,7 +327,9 @@ class RestfulServer(Logger):
                                                    self.rfile)
                     self._reply(obj, code=code)
                     return
-                if path == "/jobs" or path.startswith("/jobs/"):
+                if path == "/jobs" or path.startswith("/jobs/") \
+                        or path == "/experiments" \
+                        or path.startswith("/experiments/"):
                     try:
                         body = read_json_body(self)  # cap -> 413 inside
                     except json.JSONDecodeError as e:
@@ -322,6 +339,9 @@ class RestfulServer(Logger):
                         return
                     hit = handle_jobs_request(outer.jobs, "POST",
                                               self.path, body)
+                    if hit is None:
+                        hit = handle_experiments_request(
+                            outer.experiments, "POST", self.path, body)
                     if hit is not None:
                         self._reply(hit[1], code=hit[0])
                         return
